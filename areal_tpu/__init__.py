@@ -1,0 +1,11 @@
+"""areal_tpu — a TPU-native asynchronous RL training framework for LLMs.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of AReaL
+(reference: /root/reference, surveyed in SURVEY.md): asynchronous rollout
+with staleness control, decoupled-PPO training under GSPMD/pjit on TPU
+meshes, an MFC dataflow runtime with a metadata-only control plane, an
+interruptible JAX generation server, HF checkpoint conversion, and
+fault-tolerant recovery.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,139 @@
+"""Per-process global experiment context.
+
+Counterpart of the reference's constants module (realhf/base/constants.py):
+holds the experiment/trial names, the current model scope (the model an MFC
+is executing for), filesystem layout helpers, and a registry of per-model
+mesh/engine handles. Everything here is host-side Python state — device
+state lives in the engines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import getpass
+import os
+from typing import Any, Dict, Optional
+
+# ---------------------------------------------------------------------------
+# Experiment identity
+# ---------------------------------------------------------------------------
+
+_experiment_name: Optional[str] = None
+_trial_name: Optional[str] = None
+
+# Filesystem root for logs/checkpoints/realloc params. Overridable by env.
+FILEROOT = os.environ.get("AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}")
+
+MODEL_SAVE_ROOT = os.path.join(FILEROOT, "checkpoints")
+LOG_ROOT = os.path.join(FILEROOT, "logs")
+RECOVER_ROOT = os.path.join(FILEROOT, "recover")
+PARAM_REALLOC_ROOT = os.path.join(FILEROOT, "param_realloc")
+
+# Mirrors the reference's NCCL timeout role: how long collective setup /
+# barrier operations may block before we declare a peer dead.
+DEFAULT_COLLECTIVE_TIMEOUT_SECONDS = 3600
+
+
+def set_experiment_trial_names(experiment_name: str, trial_name: str):
+    global _experiment_name, _trial_name
+    _experiment_name = experiment_name
+    _trial_name = trial_name
+
+
+def experiment_name() -> str:
+    if _experiment_name is None:
+        raise RuntimeError("experiment_name accessed before set_experiment_trial_names")
+    return _experiment_name
+
+
+def trial_name() -> str:
+    if _trial_name is None:
+        raise RuntimeError("trial_name accessed before set_experiment_trial_names")
+    return _trial_name
+
+
+def has_experiment_trial_names() -> bool:
+    return _experiment_name is not None and _trial_name is not None
+
+
+# ---------------------------------------------------------------------------
+# Paths
+# ---------------------------------------------------------------------------
+
+
+def get_log_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    p = os.path.join(LOG_ROOT, experiment or experiment_name(), trial or trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_save_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    p = os.path.join(MODEL_SAVE_ROOT, experiment or experiment_name(), trial or trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_recover_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    p = os.path.join(RECOVER_ROOT, experiment or experiment_name(), trial or trial_name())
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+def get_param_realloc_path(
+    experiment: Optional[str] = None, trial: Optional[str] = None
+) -> str:
+    p = os.path.join(
+        PARAM_REALLOC_ROOT, experiment or experiment_name(), trial or trial_name()
+    )
+    os.makedirs(p, exist_ok=True)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Model scope
+# ---------------------------------------------------------------------------
+
+_model_scope_stack = []
+
+# Per-model host-side handles (mesh, engine, tokenizer, ...). Keyed by the
+# string form of a ModelName.
+_model_registries: Dict[str, Dict[str, Any]] = {}
+
+
+@contextlib.contextmanager
+def model_scope(model_name):
+    """Execute a block with `current_model_name()` set (MFC execution)."""
+    _model_scope_stack.append(model_name)
+    try:
+        yield
+    finally:
+        _model_scope_stack.pop()
+
+
+def current_model_name():
+    if not _model_scope_stack:
+        raise RuntimeError("current_model_name accessed outside model_scope")
+    return _model_scope_stack[-1]
+
+
+def has_model_scope() -> bool:
+    return bool(_model_scope_stack)
+
+
+def set_model_attr(model_name, key: str, value: Any):
+    _model_registries.setdefault(str(model_name), {})[key] = value
+
+
+def get_model_attr(model_name, key: str) -> Any:
+    try:
+        return _model_registries[str(model_name)][key]
+    except KeyError:
+        raise KeyError(f"no attr {key!r} registered for model {model_name}")
+
+
+def has_model_attr(model_name, key: str) -> bool:
+    return key in _model_registries.get(str(model_name), {})
+
+
+def clear_model_registry():
+    _model_registries.clear()

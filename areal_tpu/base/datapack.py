@@ -1,0 +1,93 @@
+"""Sequence packing / partitioning algorithms.
+
+Counterpart of the reference's datapack utilities (realhf/base/datapack.py):
+first-fit-decreasing bin packing for token-budget micro-batch splitting and
+balanced contiguous partitioning for data-parallel dispatch. Pure numpy —
+these run on the host in the control plane, never inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def flat2d(lists: Sequence[Sequence]) -> List:
+    return [x for sub in lists for x in sub]
+
+
+def ffd_allocate(
+    lengths: Sequence[int],
+    capacity: int,
+    min_groups: int = 1,
+) -> List[List[int]]:
+    """First-fit-decreasing bin packing.
+
+    Partition items with the given `lengths` into bins of at most `capacity`
+    total length (a single item longer than capacity gets its own bin),
+    producing at least `min_groups` bins. Returns a list of index groups.
+    """
+    lengths = np.asarray(lengths)
+    order = np.argsort(-lengths, kind="stable")
+    groups: List[List[int]] = [[] for _ in range(min_groups)]
+    sums = [0] * min_groups
+    for idx in order:
+        idx = int(idx)
+        l = int(lengths[idx])
+        # Least-loaded bin with room (keeps the min_groups bins balanced);
+        # empty bins always accept, so oversized items get their own bin.
+        candidates = [g for g in range(len(groups)) if sums[g] + l <= capacity or not groups[g]]
+        if candidates:
+            g = min(candidates, key=lambda g: sums[g])
+            groups[g].append(idx)
+            sums[g] += l
+        else:
+            groups.append([idx])
+            sums.append(l)
+    # Drop empty bins (possible when min_groups > n items).
+    out = [g for g in groups if g]
+    return out
+
+
+def min_abs_diff_partition(nums: Sequence[int], k: int) -> List[List[int]]:
+    """Split `nums` into k *contiguous* groups with balanced sums.
+
+    Returns index groups. Used for data-parallel dispatch where sample order
+    must be preserved. Greedy prefix walking against the ideal per-group sum;
+    guarantees each group is non-empty when len(nums) >= k.
+    """
+    n = len(nums)
+    if k <= 0:
+        raise ValueError("k must be positive")
+    if n < k:
+        raise ValueError(f"cannot partition {n} items into {k} non-empty groups")
+    cum = np.cumsum(np.asarray(nums, dtype=np.float64))
+    total = cum[-1]
+    bounds = [0]
+    for g in range(1, k):
+        ideal = total * g / k
+        j = int(np.searchsorted(cum, ideal))
+        # Pick the neighbor closest to the ideal prefix sum, then clamp so
+        # every remaining group stays non-empty.
+        if j + 1 <= n - (k - g) and j >= 1:
+            if abs(cum[j] - ideal) < abs(cum[j - 1] - ideal):
+                j = j + 1
+        j = max(bounds[-1] + 1, min(j, n - (k - g)))
+        bounds.append(j)
+    bounds.append(n)
+    groups = [list(range(bounds[i], bounds[i + 1])) for i in range(k)]
+    assert len(groups) == k and all(groups), [len(g) for g in groups]
+    return groups
+
+
+def balanced_partition(nums: Sequence[int], k: int) -> List[List[int]]:
+    """Split into k groups balanced by sum, order-free (greedy LPT)."""
+    order = np.argsort(-np.asarray(nums), kind="stable")
+    groups: List[List[int]] = [[] for _ in range(k)]
+    sums = np.zeros(k)
+    for idx in order:
+        g = int(np.argmin(sums))
+        groups[g].append(int(idx))
+        sums[g] += nums[int(idx)]
+    return [sorted(g) for g in groups]

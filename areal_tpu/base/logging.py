@@ -1,0 +1,75 @@
+"""Colored, multi-sink logging.
+
+TPU-native counterpart of the reference logging utilities
+(reference: realhf/base/logging.py). Provides `getLogger` with optional
+file sinks and a helper that mirrors scalar metrics to wandb /
+tensorboard when available.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Dict, Optional
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_LEVEL_COLORS = {
+    logging.DEBUG: "\033[36m",  # cyan
+    logging.INFO: "\033[32m",  # green
+    logging.WARNING: "\033[33m",  # yellow
+    logging.ERROR: "\033[31m",  # red
+    logging.CRITICAL: "\033[41m",  # red background
+}
+_RESET = "\033[0m"
+
+_configured_sinks = set()
+
+
+class _ColorFormatter(logging.Formatter):
+
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if sys.stderr.isatty():
+            color = _LEVEL_COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def getLogger(name: str = "areal_tpu", file_path: Optional[str] = None) -> logging.Logger:
+    """Return a configured logger; optionally tee to ``file_path``."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("AREAL_LOG_LEVEL", "INFO").upper())
+        logger.propagate = False
+    if file_path is not None and (name, file_path) not in _configured_sinks:
+        if os.path.dirname(file_path):
+            os.makedirs(os.path.dirname(file_path), exist_ok=True)
+        fh = logging.FileHandler(file_path)
+        fh.setFormatter(logging.Formatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+        logger.addHandler(fh)
+        _configured_sinks.add((name, file_path))
+    return logger
+
+
+def log_scalars_to_trackers(
+    scalars: Dict[str, float],
+    step: int,
+    summary_writer=None,
+    wandb_run=None,
+):
+    """Mirror scalar metrics to tensorboard / wandb when configured.
+
+    Counterpart of the reference's log_swanlab_wandb_tensorboard; swanlab
+    is not available in this environment and is intentionally omitted.
+    """
+    if summary_writer is not None:
+        for k, v in scalars.items():
+            summary_writer.add_scalar(k, v, step)
+    if wandb_run is not None:
+        wandb_run.log(dict(scalars), step=step)

@@ -1,0 +1,91 @@
+"""Throughput accounting: analytic FLOP formulas and rollout statistics.
+
+Counterpart of the reference's monitor module (realhf/base/monitor.py),
+minus CUDA-specific kernel-trace parsing (the TPU analogue is
+`jax.profiler` traces, handled in `areal_tpu.utils.profiling`). The FLOP
+formulas are the standard dense-transformer counts used to report
+TFLOP/s-per-chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass
+class RolloutStat:
+    """Counters the generation manager logs per interval."""
+
+    submitted: int = 0
+    accepted: int = 0
+    running: int = 0
+    gen_tokens: int = 0
+
+
+def caculuate_llama_forward_flops(
+    batch_size: int,
+    seqlens: Sequence[int],
+    hidden_size: int,
+    intermediate_size: int,
+    vocab_size: int,
+    n_layers: int,
+    num_heads: int,
+    num_kv_heads: int,
+) -> int:
+    """Forward FLOPs of a llama-family model over packed sequences.
+
+    Matmul-only accounting (2*m*n*k per matmul), including the quadratic
+    attention term computed per-sequence from `seqlens`.
+    """
+    total_tokens = int(sum(seqlens))
+    head_dim = hidden_size // num_heads
+    kv_size = head_dim * num_kv_heads
+    # Projections: q (h->h), k/v (h->kv), o (h->h)
+    attn_proj = 2 * total_tokens * hidden_size * (2 * hidden_size + 2 * kv_size)
+    # Attention scores + values: 2 * sum(len^2) * h per each of QK^T and PV
+    attn_quad = 4 * sum(int(l) ** 2 for l in seqlens) * hidden_size
+    # Gated MLP: gate+up (h->i each), down (i->h)
+    mlp = 2 * total_tokens * hidden_size * intermediate_size * 3
+    # LM head
+    head = 2 * total_tokens * hidden_size * vocab_size
+    return n_layers * (attn_proj + attn_quad + mlp) + head
+
+
+def calculate_llama_train_flops(*args, **kwargs) -> int:
+    """Training = forward + backward ~= 3x forward."""
+    return 3 * caculuate_llama_forward_flops(*args, **kwargs)
+
+
+def calculate_llama_gen_flops(
+    batch_size: int,
+    prompt_lens: Sequence[int],
+    gen_len: int,
+    hidden_size: int,
+    intermediate_size: int,
+    vocab_size: int,
+    n_layers: int,
+    num_heads: int,
+    num_kv_heads: int,
+) -> int:
+    """Generation FLOPs: one prefill over prompts plus `gen_len` decode steps."""
+    flops = caculuate_llama_forward_flops(
+        batch_size,
+        prompt_lens,
+        hidden_size,
+        intermediate_size,
+        vocab_size,
+        n_layers,
+        num_heads,
+        num_kv_heads,
+    )
+    head_dim = hidden_size // num_heads
+    kv_size = head_dim * num_kv_heads
+    for i in range(gen_len):
+        lens = [int(l) + i for l in prompt_lens]
+        attn_proj = 2 * batch_size * hidden_size * (2 * hidden_size + 2 * kv_size)
+        attn_quad = 4 * sum(lens) * hidden_size
+        mlp = 2 * batch_size * hidden_size * intermediate_size * 3
+        head = 2 * batch_size * hidden_size * vocab_size
+        flops += n_layers * (attn_proj + attn_quad + mlp) + head
+    return flops

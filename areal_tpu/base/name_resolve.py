@@ -1,0 +1,386 @@
+"""Pluggable cluster-wide key-value naming/discovery service.
+
+TPU-native counterpart of the reference name-resolve layer
+(reference: realhf/base/name_resolve.py). Workers publish addresses,
+versions, and statuses under hierarchical string keys; peers `get`/`wait`/
+`watch` them. Two backends are provided:
+
+- ``memory``: in-process dict (unit tests, single-process runs).
+- ``nfs``: file-per-key under a shared directory (multi-process on one
+  host, or cross-host over NFS). This is the default for tests and
+  single-host launches; etcd/Redis equivalents can be added behind the
+  same ABC when a real cluster KV is available.
+
+All values are strings. `add(..., keepalive_ttl=...)` spawns a background
+toucher so stale records from dead workers expire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import shutil
+import threading
+import time
+import uuid
+from abc import ABC, abstractmethod
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import logging as areal_logging
+
+logger = areal_logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+class NameRecordRepository(ABC):
+    """Abstract KV repository for cluster naming."""
+
+    @abstractmethod
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: Optional[float] = None,
+        replace: bool = False,
+    ):
+        ...
+
+    def add_subentry(self, name: str, value: str, **kwargs) -> str:
+        """Add under a unique sub-key of `name`; returns the sub-key."""
+        sub_name = f"{name.rstrip('/')}/{uuid.uuid4().hex[:8]}"
+        self.add(sub_name, value, **kwargs)
+        return sub_name
+
+    @abstractmethod
+    def delete(self, name: str):
+        ...
+
+    @abstractmethod
+    def clear_subtree(self, name_root: str):
+        ...
+
+    @abstractmethod
+    def get(self, name: str) -> str:
+        ...
+
+    @abstractmethod
+    def get_subtree(self, name_root: str) -> List[str]:
+        """Values of all keys under `name_root`."""
+        ...
+
+    @abstractmethod
+    def find_subtree(self, name_root: str) -> List[str]:
+        """Keys (sorted) under `name_root`."""
+        ...
+
+    def wait(
+        self,
+        name: str,
+        timeout: Optional[float] = None,
+        poll_frequency: float = 0.1,
+    ) -> str:
+        """Block until `name` exists, then return its value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"name_resolve.wait timeout on key: {name}")
+                time.sleep(poll_frequency * (0.8 + 0.4 * random.random()))
+
+    def watch_names(
+        self,
+        names: List[str],
+        call_back: Callable[[], None],
+        poll_frequency: float = 5.0,
+    ):
+        """Invoke `call_back` once any of `names` disappears (polling watcher)."""
+
+        def _watch():
+            while True:
+                for n in names:
+                    try:
+                        self.get(n)
+                    except NameEntryNotFoundError:
+                        call_back()
+                        return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watch, daemon=True)
+        t.start()
+        return t
+
+    def reset(self):
+        """Remove every entry added by this repository instance."""
+
+    def close(self):
+        self.reset()
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    """In-process dict backend (single-process tests)."""
+
+    # Class-level store so that separate instances within one process share
+    # names, mirroring how a external KV service would behave.
+    _store: Dict[str, str] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._my_keys = set()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+            if delete_on_exit:
+                self._my_keys.add(name)
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+            self._my_keys.discard(name)
+
+    def clear_subtree(self, name_root):
+        root = name_root.rstrip("/")
+        with self._lock:
+            for k in [k for k in self._store if k == root or k.startswith(root + "/")]:
+                del self._store[k]
+                self._my_keys.discard(k)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def get_subtree(self, name_root):
+        return [self._store[k] for k in self.find_subtree(name_root)]
+
+    def find_subtree(self, name_root):
+        root = name_root.rstrip("/")
+        with self._lock:
+            return sorted(k for k in self._store if k == root or k.startswith(root + "/"))
+
+    def reset(self):
+        with self._lock:
+            for k in list(self._my_keys):
+                self._store.pop(k, None)
+            self._my_keys.clear()
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """File-per-key backend under a shared directory.
+
+    Works across processes on one host (default root under /tmp) and across
+    hosts when the root lives on NFS. TTL records carry a heartbeat mtime;
+    a reader treats records older than their TTL as absent.
+    """
+
+    RECORD_ROOT = os.environ.get("AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu/name_resolve")
+
+    def __init__(self, record_root: Optional[str] = None):
+        self._root = record_root or self.RECORD_ROOT
+        self._my_keys: Dict[str, bool] = {}
+        self._keepalive_threads: Dict[str, threading.Event] = {}
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self._root, name.strip("/"), "ENTRY")
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+            if keepalive_ttl is not None:
+                f.write(f"\n__TTL__={keepalive_ttl}")
+        if replace:
+            os.replace(tmp, path)
+        else:
+            # Atomic create-if-absent: hard-link fails with EEXIST if a live
+            # record is present, so two concurrent adders cannot both win.
+            # A TTL'd record whose owner died can be replaced.
+            while True:
+                try:
+                    os.link(tmp, path)
+                    os.remove(tmp)
+                    break
+                except FileExistsError:
+                    if self._is_expired(path):
+                        try:
+                            os.remove(path)
+                        except FileNotFoundError:
+                            pass
+                        continue
+                    os.remove(tmp)
+                    raise NameEntryExistsError(name)
+        if delete_on_exit:
+            self._my_keys[name] = True
+        if keepalive_ttl is not None:
+            self._start_keepalive(name, path, keepalive_ttl)
+
+    def _start_keepalive(self, name: str, path: str, ttl: float):
+        old = self._keepalive_threads.pop(name, None)
+        if old is not None:
+            old.set()
+        stop = threading.Event()
+        self._keepalive_threads[name] = stop
+
+        def _touch():
+            while not stop.wait(max(ttl / 3, 0.2)):
+                try:
+                    os.utime(path, None)
+                except OSError:
+                    return
+
+        threading.Thread(target=_touch, daemon=True).start()
+
+    @staticmethod
+    def _read(path: str):
+        with open(path) as f:
+            content = f.read()
+        ttl = None
+        if "\n__TTL__=" in content:
+            content, ttl_s = content.rsplit("\n__TTL__=", 1)
+            ttl = float(ttl_s)
+        return content, ttl
+
+    @classmethod
+    def _is_expired(cls, path: str) -> bool:
+        try:
+            _, ttl = cls._read(path)
+            if ttl is None:
+                return False
+            return time.time() - os.path.getmtime(path) > ttl * 3
+        except OSError:
+            return True
+
+    def delete(self, name):
+        path = self._path(name)
+        if not os.path.isfile(path):
+            raise NameEntryNotFoundError(name)
+        os.remove(path)
+        stop = self._keepalive_threads.pop(name, None)
+        if stop is not None:
+            stop.set()
+        self._my_keys.pop(name, None)
+        # Prune now-empty directories up the tree.
+        d = os.path.dirname(path)
+        while d != self._root and os.path.isdir(d) and not os.listdir(d):
+            os.rmdir(d)
+            d = os.path.dirname(d)
+
+    def clear_subtree(self, name_root):
+        d = os.path.join(self._root, name_root.strip("/"))
+        if os.path.isdir(d):
+            shutil.rmtree(d, ignore_errors=True)
+
+    def get(self, name):
+        path = self._path(name)
+        if not os.path.isfile(path) or self._is_expired(path):
+            raise NameEntryNotFoundError(name)
+        value, _ = self._read(path)
+        return value
+
+    def find_subtree(self, name_root):
+        d = os.path.join(self._root, name_root.strip("/"))
+        found = []
+        for dirpath, _, filenames in os.walk(d):
+            if "ENTRY" in filenames and not self._is_expired(os.path.join(dirpath, "ENTRY")):
+                found.append(os.path.relpath(dirpath, self._root))
+        return sorted(found)
+
+    def get_subtree(self, name_root):
+        return [self.get(k) for k in self.find_subtree(name_root)]
+
+    def reset(self):
+        for stop in self._keepalive_threads.values():
+            stop.set()
+        self._keepalive_threads.clear()
+        for name in list(self._my_keys):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._my_keys.clear()
+
+
+@dataclasses.dataclass
+class _DefaultRepo:
+    repo: NameRecordRepository = dataclasses.field(default_factory=NfsNameRecordRepository)
+
+
+_default = _DefaultRepo()
+
+
+def reconfigure(backend: str = "nfs", **kwargs):
+    """Switch the process-global repository backend ('memory' or 'nfs')."""
+    if backend == "memory":
+        _default.repo = MemoryNameRecordRepository()
+    elif backend == "nfs":
+        _default.repo = NfsNameRecordRepository(**kwargs)
+    else:
+        raise NotImplementedError(f"name_resolve backend: {backend}")
+    return _default.repo
+
+
+def default_repo() -> NameRecordRepository:
+    return _default.repo
+
+
+# Module-level facade mirroring the reference's usage style
+# (`name_resolve.add(...)`, `name_resolve.wait(...)`).
+def add(name, value, **kwargs):
+    return _default.repo.add(name, value, **kwargs)
+
+
+def add_subentry(name, value, **kwargs):
+    return _default.repo.add_subentry(name, value, **kwargs)
+
+
+def delete(name):
+    return _default.repo.delete(name)
+
+
+def clear_subtree(name_root):
+    return _default.repo.clear_subtree(name_root)
+
+
+def get(name):
+    return _default.repo.get(name)
+
+
+def get_subtree(name_root):
+    return _default.repo.get_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return _default.repo.find_subtree(name_root)
+
+
+def wait(name, timeout=None, poll_frequency=0.1):
+    return _default.repo.wait(name, timeout=timeout, poll_frequency=poll_frequency)
+
+
+def watch_names(names, call_back, poll_frequency=5.0):
+    return _default.repo.watch_names(names, call_back, poll_frequency)
+
+
+def reset():
+    return _default.repo.reset()

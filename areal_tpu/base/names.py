@@ -1,0 +1,93 @@
+"""Canonical name_resolve key schema.
+
+Mirrors the key layout of the reference (realhf/base/names.py) so that every
+subsystem agrees on where discovery records live. All functions return
+string keys under a per-(experiment, trial) root.
+"""
+
+from __future__ import annotations
+
+USER_NAMESPACE = "areal_tpu"
+
+
+def trial_root(experiment_name: str, trial_name: str) -> str:
+    return f"{USER_NAMESPACE}/{experiment_name}/{trial_name}"
+
+
+def trial_registry(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/trial_registry"
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/status/{worker_name}"
+
+
+def worker(experiment_name: str, trial_name: str, worker_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/workers/{worker_name}"
+
+
+def worker_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/workers/"
+
+
+def worker_key(experiment_name: str, trial_name: str, key: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/worker_key/{key}"
+
+
+def request_reply_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/request_reply_stream/{stream_name}"
+
+
+def push_pull_stream(experiment_name: str, trial_name: str, stream_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream/{stream_name}"
+
+
+def push_pull_stream_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/push_pull_stream/"
+
+
+def distributed_peer(experiment_name: str, trial_name: str, peer_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_peer/{peer_name}"
+
+
+def distributed_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_peer/"
+
+def distributed_coordinator(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/distributed_coordinator"
+
+
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server_url(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_url/{server_id}"
+
+
+def gen_server_url_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_url/"
+
+
+def gen_server_manager(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gen_server_manager"
+
+
+def model_version(experiment_name: str, trial_name: str, model_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/model_version/{model_name}"
+
+
+def training_samples(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/training_samples"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/experiment_status"
+
+
+def metric_server(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/metric_server"
+
+
+def used_hash_vals(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"

@@ -1,0 +1,52 @@
+"""Networking helpers: hostname/IP discovery and lock-protected free-port finding.
+
+Counterpart of the reference's network utilities (realhf/base/network.py).
+The lockfile protocol prevents two workers racing to bind the same port on
+one host between `find_free_port` and the actual bind.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import os
+import socket
+from contextlib import closing
+from typing import List
+
+_PORT_LOCK_DIR = "/tmp/areal_tpu/ports"
+
+
+def gethostname() -> str:
+    return socket.gethostname()
+
+
+def gethostip() -> str:
+    try:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_DGRAM)) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def find_free_port(low: int = 10000, high: int = 60000, exp_name: str = "port") -> int:
+    """Find a free TCP port and hold a lockfile so peers skip it."""
+    os.makedirs(_PORT_LOCK_DIR, exist_ok=True)
+    while True:
+        with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        if not (low <= port <= high):
+            continue
+        lock_path = os.path.join(_PORT_LOCK_DIR, f"{port}.lock")
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return port
+        except OSError:
+            os.close(fd)
+            continue
+
+
+def find_multiple_free_ports(count: int, **kwargs) -> List[int]:
+    return [find_free_port(**kwargs) for _ in range(count)]

@@ -1,0 +1,72 @@
+"""Recovery metadata: step counters, frequency-control state, consumed data.
+
+Counterpart of the reference's recover module (realhf/base/recover.py).
+`RecoverInfo` is dumped at checkpoint time by the master worker and loaded
+on relaunch so training resumes exactly where it stopped, with already-
+consumed samples excluded via their hashes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import constants
+
+
+@dataclasses.dataclass
+class StepInfo:
+    epoch: int = 0
+    epoch_step: int = 0
+    global_step: int = 0
+
+    def next(self):
+        return StepInfo(
+            epoch=self.epoch,
+            epoch_step=self.epoch_step + 1,
+            global_step=self.global_step + 1,
+        )
+
+
+@dataclasses.dataclass
+class RecoverInfo:
+    recover_start: StepInfo = dataclasses.field(default_factory=StepInfo)
+    last_step_info: StepInfo = dataclasses.field(default_factory=StepInfo)
+    save_ctl_info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ckpt_ctl_info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    eval_ctl_info: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    data_loading_dp_idx: int = 0
+    hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+
+
+def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
+    return os.path.join(constants.get_recover_path(experiment, trial), "recover_info.pkl")
+
+
+def dump(info: RecoverInfo, experiment: Optional[str] = None, trial: Optional[str] = None):
+    path = dump_path(experiment, trial)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(info, f)
+    os.replace(tmp, path)
+
+
+def load(experiment: Optional[str] = None, trial: Optional[str] = None) -> RecoverInfo:
+    path = dump_path(experiment, trial)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no recover info at {path}")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def discover_ckpt(model_name: str, experiment=None, trial=None) -> Optional[str]:
+    """Latest recover checkpoint directory for a model role, if any."""
+    root = os.path.join(constants.get_recover_path(experiment, trial), "ckpt", model_name)
+    if not os.path.isdir(root):
+        return None
+    steps = [d for d in os.listdir(root) if d.isdigit()]
+    if not steps:
+        return None
+    return os.path.join(root, max(steps, key=int))

@@ -1,0 +1,47 @@
+"""Deterministic per-key seeding.
+
+Counterpart of the reference's seeding utilities (realhf/base/seeding.py):
+a single experiment-level base seed plus stable per-key offsets, so every
+worker / dataset / sampler derives a reproducible but distinct stream.
+JAX-native: `prng_key(key)` returns a `jax.random.PRNGKey` folded with the
+per-key hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_BASE_SEED = 0
+_SEED_FROM = "default"
+
+
+def _hash_key(key: str) -> int:
+    return int(hashlib.sha256(key.encode()).hexdigest(), 16) % (2**31)
+
+
+def set_random_seed(base_seed: int, key: str):
+    """Seed python/numpy for this process deterministically from (seed, key)."""
+    global _BASE_SEED, _SEED_FROM
+    _BASE_SEED = base_seed
+    _SEED_FROM = key
+    seed = base_seed + _hash_key(key)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+
+
+def get_seed() -> int:
+    return _BASE_SEED
+
+
+def get_shuffle_seed(key: str = "shuffle") -> int:
+    return (_BASE_SEED + _hash_key(f"{_SEED_FROM}/{key}")) % (2**31)
+
+
+def prng_key(key: str):
+    """A jax PRNGKey derived from the experiment seed and a string key."""
+    import jax
+
+    return jax.random.fold_in(jax.random.PRNGKey(_BASE_SEED), _hash_key(key))

@@ -1,0 +1,152 @@
+"""Scoped metric aggregation with masked denominators.
+
+Counterpart of the reference's stats tracker (realhf/base/stats_tracker.py):
+training code registers boolean *denominators* (e.g. which tokens are
+response tokens) and float *stats* tied to a denominator; `export()`
+reduces each stat over its mask with AVG/SUM/MIN/MAX semantics so logged
+averages are semantically correct (per-token, per-sequence, ...).
+
+Host-side numpy: engines pull device arrays once per step and feed them
+here; cross-host aggregation happens naturally because under GSPMD each
+host sees globally-reduced values (losses are psum'd inside jit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ReduceType(enum.Enum):
+    AVG = "avg"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    SCALAR = "scalar"
+
+
+# MoE layers deposit their aux losses here during the forward pass (keyed by
+# loss name -> per-layer values); the tracker merges them at export time.
+MOE_AUX_LOSSES: Dict[str, list] = {}
+
+
+def _to_np(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+class DistributedStatsTracker:
+
+    def __init__(self, name: str = ""):
+        self._scopes: List[str] = [name] if name else []
+        self._denominators: Dict[str, List[np.ndarray]] = {}
+        # Each stat entry is a (value, mask) pair captured at record time so
+        # conditionally-logged stats can never mispair with older masks.
+        self._stats: Dict[str, List[tuple]] = {}
+        self._reduce_types: Dict[str, ReduceType] = {}
+        self._scalars: Dict[str, List[float]] = {}
+
+    def _key(self, name: str) -> str:
+        return "/".join(self._scopes + [name])
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        self._scopes.append(name)
+        try:
+            yield
+        finally:
+            self._scopes.pop()
+
+    def denominator(self, **kwargs):
+        for name, mask in kwargs.items():
+            key = self._key(name)
+            mask = _to_np(mask).astype(bool)
+            self._denominators.setdefault(key, []).append(mask)
+
+    def stat(
+        self,
+        denominator: str,
+        reduce_type: ReduceType = ReduceType.AVG,
+        **kwargs,
+    ):
+        denom_key = self._key(denominator)
+        if denom_key not in self._denominators or not self._denominators[denom_key]:
+            raise ValueError(f"unknown denominator {denominator!r} (key {denom_key})")
+        mask = self._denominators[denom_key][-1]
+        for name, value in kwargs.items():
+            key = self._key(name)
+            value = _to_np(value).astype(np.float32)
+            if value.shape != mask.shape:
+                raise ValueError(
+                    f"stat {key} shape {value.shape} mismatches denominator "
+                    f"{denom_key} shape {mask.shape}"
+                )
+            self._stats.setdefault(key, []).append((value, mask))
+            self._reduce_types[key] = reduce_type
+
+    def scalar(self, **kwargs):
+        for name, value in kwargs.items():
+            key = self._key(name)
+            self._scalars.setdefault(key, []).append(float(value))
+
+    def moe_aux_losses(self):
+        """Fold MoE aux losses recorded during forward into scalar stats."""
+        for name, values in MOE_AUX_LOSSES.items():
+            if values:
+                self.scalar(**{f"moe_aux/{name}": float(np.mean([float(v) for v in values]))})
+        MOE_AUX_LOSSES.clear()
+
+    @staticmethod
+    def _match(key: Optional[str], k: str) -> bool:
+        # Prefix match on full name components only: "train" matches
+        # "train/loss" but not "train_eval/acc".
+        return key is None or k == key or k.startswith(key.rstrip("/") + "/")
+
+    def export(self, key: Optional[str] = None, reset: bool = True) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for k, masks in self._denominators.items():
+            if not self._match(key, k):
+                continue
+            out[k] = float(sum(m.sum() for m in masks))
+        for k, pairs in self._stats.items():
+            if not self._match(key, k):
+                continue
+            rt = self._reduce_types[k]
+            masked = [v[m] for v, m in pairs]
+            flat = np.concatenate(masked) if masked else np.array([])
+            if flat.size == 0:
+                continue
+            if rt == ReduceType.AVG:
+                out[k] = float(flat.mean())
+            elif rt == ReduceType.SUM:
+                out[k] = float(flat.sum())
+            elif rt == ReduceType.MIN:
+                out[k] = float(flat.min())
+            elif rt == ReduceType.MAX:
+                out[k] = float(flat.max())
+        for k, vals in self._scalars.items():
+            if not self._match(key, k):
+                continue
+            out[k] = float(np.mean(vals))
+        if reset:
+            for k in [k for k in self._denominators if self._match(key, k)]:
+                del self._denominators[k]
+            for k in [k for k in self._stats if self._match(key, k)]:
+                del self._stats[k]
+                self._reduce_types.pop(k, None)
+            for k in [k for k in self._scalars if self._match(key, k)]:
+                del self._scalars[k]
+        return out
+
+
+# Process-global default tracker, mirroring the reference's module-level API.
+DEFAULT_TRACKER = DistributedStatsTracker()
+
+scope = DEFAULT_TRACKER.scope
+denominator = DEFAULT_TRACKER.denominator
+stat = DEFAULT_TRACKER.stat
+scalar = DEFAULT_TRACKER.scalar
+export = DEFAULT_TRACKER.export
+moe_aux_losses = DEFAULT_TRACKER.moe_aux_losses

@@ -1,0 +1,157 @@
+"""Process/device topology math.
+
+Counterpart of the reference's topology layer (realhf/base/topology.py) —
+re-thought for TPU. In the reference, a (pipe, data, tensor) grid maps one
+process per GPU. On TPU under GSPMD there is one process per *host* and a
+`jax.sharding.Mesh` spans all devices of a partition, so the heavy rank
+bookkeeping collapses into mesh axis math. What remains host-side:
+
+- `ProcessTopology`: generic N-axis coordinate<->rank math, still used for
+  placing *worker processes* (hosts) and for parity with reference
+  semantics in the control plane.
+- `MeshSpec`: named per-model parallelism shape (data/fsdp/tensor axes +
+  optional seq for context parallelism) that `areal_tpu.parallel.mesh`
+  turns into a real `jax.sharding.Mesh` over a device subset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+
+class ProcessTopology:
+    """Maps between flat ranks and named-axis coordinates (row-major)."""
+
+    def __init__(self, axes: List[str], dims: List[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims length mismatch")
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self._strides = []
+        s = 1
+        for d in reversed(dims):
+            self._strides.append(s)
+            s *= d
+        self._strides.reverse()
+        self.world_size = s
+
+    def get_rank(self, **coords) -> int:
+        if set(coords) != set(self.axes):
+            raise ValueError(f"expected coords for axes {self.axes}, got {list(coords)}")
+        rank = 0
+        for ax, stride, dim in zip(self.axes, self._strides, self.dims):
+            c = coords[ax]
+            if not 0 <= c < dim:
+                raise ValueError(f"coordinate {ax}={c} out of range [0,{dim})")
+            rank += c * stride
+        return rank
+
+    def get_coord(self, rank: int) -> Dict[str, int]:
+        if not 0 <= rank < self.world_size:
+            raise ValueError(f"rank {rank} out of range")
+        out = {}
+        for ax, stride, dim in zip(self.axes, self._strides, self.dims):
+            out[ax] = (rank // stride) % dim
+        return out
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)]
+
+    def filter_match(self, **constraints) -> List[int]:
+        """Ranks whose coordinates match every given axis=value constraint."""
+        out = []
+        for rank in range(self.world_size):
+            coord = self.get_coord(rank)
+            if all(coord[ax] == v for ax, v in constraints.items()):
+                out.append(rank)
+        return out
+
+    def get_axis_list(self, axis: str, rank: int) -> List[int]:
+        """All ranks sharing this rank's coordinates except along `axis`."""
+        coord = self.get_coord(rank)
+        coord.pop(axis)
+        return self.filter_match(**coord)
+
+    def all_coords(self) -> List[Dict[str, int]]:
+        return [self.get_coord(r) for r in range(self.world_size)]
+
+    def __repr__(self):
+        body = ",".join(f"{a}={d}" for a, d in zip(self.axes, self.dims))
+        return f"ProcessTopology({body})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessTopology)
+            and self.axes == other.axes
+            and self.dims == other.dims
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named parallelism shape for one model's device mesh.
+
+    TPU equivalent of the reference's (pipe, data, tensor) topology: `data`
+    is pure data parallelism, `fsdp` additionally shards params/optimizer
+    state (ZeRO), `tensor` is megatron-style tensor parallelism realised as
+    GSPMD sharding annotations, and `seq` (optional, >1) enables
+    sequence/context parallelism for long-context attention. The product
+    must equal the number of devices of the partition the model runs on.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    AXIS_NAMES = ("data", "fsdp", "seq", "tensor")
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+    @property
+    def shape(self) -> Dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "seq": self.seq,
+            "tensor": self.tensor,
+        }
+
+    @property
+    def dp_size(self) -> int:
+        """Global data-parallel degree (data x fsdp): batch is split this many ways."""
+        return self.data * self.fsdp
+
+    def __str__(self):
+        return f"d{self.data}f{self.fsdp}s{self.seq}t{self.tensor}"
+
+    @classmethod
+    def parse(cls, s: str) -> "MeshSpec":
+        """Parse 'd2f2s1t2'-style strings (missing axes default to 1)."""
+        import re
+
+        vals = dict(data=1, fsdp=1, seq=1, tensor=1)
+        key_map = {"d": "data", "f": "fsdp", "s": "seq", "t": "tensor", "m": "tensor", "p": "pipe"}
+        for m in re.finditer(r"([a-z])(\d+)", s):
+            k, v = m.group(1), int(m.group(2))
+            name = key_map.get(k)
+            if name == "pipe":
+                if v != 1:
+                    raise ValueError(
+                        "pipeline parallelism is expressed as extra data/fsdp axes on TPU; "
+                        f"got p{v} in {s!r}"
+                    )
+                continue
+            if name is None:
+                raise ValueError(f"unknown axis {k!r} in mesh spec {s!r}")
+            vals[name] = v
+        return cls(**vals)
+
+
+def device_grid_iter(dims: List[int]):
+    """Iterate coordinates of an N-D grid row-major."""
+    yield from itertools.product(*[range(d) for d in dims])

@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+from areal_tpu.base.datapack import (
+    balanced_partition,
+    ffd_allocate,
+    flat2d,
+    min_abs_diff_partition,
+)
+
+
+def test_flat2d():
+    assert flat2d([[1, 2], [3], []]) == [1, 2, 3]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_ffd_allocate_respects_capacity(seed):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(1, 500, size=50)
+    cap = 1000
+    groups = ffd_allocate(lengths, capacity=cap, min_groups=1)
+    seen = sorted(flat2d(groups))
+    assert seen == list(range(50))
+    for g in groups:
+        if len(g) > 1:
+            assert sum(lengths[i] for i in g) <= cap
+
+
+def test_ffd_min_groups():
+    groups = ffd_allocate([5, 5, 5, 5], capacity=1000, min_groups=3)
+    assert len(groups) >= 3
+
+
+def test_ffd_oversized_item_own_bin():
+    groups = ffd_allocate([2000, 10], capacity=100, min_groups=1)
+    assert sorted(flat2d(groups)) == [0, 1]
+
+
+@pytest.mark.parametrize("k", [1, 2, 3, 7])
+def test_min_abs_diff_partition(k):
+    rng = np.random.RandomState(0)
+    nums = rng.randint(1, 100, size=23)
+    groups = min_abs_diff_partition(nums, k)
+    assert len(groups) == k
+    assert flat2d(groups) == list(range(23))  # contiguous, ordered
+    assert all(groups)
+    sums = [sum(nums[i] for i in g) for g in groups]
+    assert max(sums) - min(sums) <= max(nums) * 2  # roughly balanced
+
+
+def test_balanced_partition():
+    groups = balanced_partition([10, 1, 1, 1, 10, 1], 2)
+    sums = [sum([10, 1, 1, 1, 10, 1][i] for i in g) for g in groups]
+    assert abs(sums[0] - sums[1]) <= 2

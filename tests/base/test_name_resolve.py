@@ -1,0 +1,85 @@
+"""name_resolve backend tests (mirrors reference tests/distributed/test_name_resolve.py)."""
+
+import threading
+import time
+
+import pytest
+
+from areal_tpu.base import name_resolve
+from areal_tpu.base.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        r = MemoryNameRecordRepository()
+    else:
+        r = NfsNameRecordRepository(record_root=str(tmp_path / "nr"))
+    yield r
+    r.reset()
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.delete("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "a")
+    repo.add("root/x/2", "b")
+    repo.add("root/y", "c")
+    assert repo.get_subtree("root/x") == ["a", "b"]
+    assert len(repo.find_subtree("root")) == 3
+    repo.clear_subtree("root/x")
+    assert repo.get_subtree("root/x") == []
+    assert repo.get("root/y") == "c"
+
+
+def test_add_subentry(repo):
+    k1 = repo.add_subentry("servers", "url1")
+    k2 = repo.add_subentry("servers", "url2")
+    assert k1 != k2
+    assert sorted(repo.get_subtree("servers")) == ["url1", "url2"]
+
+
+def test_wait(repo):
+    def _later():
+        time.sleep(0.2)
+        repo.add("late/key", "done")
+
+    t = threading.Thread(target=_later)
+    t.start()
+    assert repo.wait("late/key", timeout=5) == "done"
+    t.join()
+    with pytest.raises(TimeoutError):
+        repo.wait("never/key", timeout=0.2)
+
+
+def test_module_facade(tmp_path):
+    name_resolve.reconfigure("nfs", record_root=str(tmp_path / "nr2"))
+    name_resolve.add("k", "v")
+    assert name_resolve.get("k") == "v"
+    name_resolve.reset()
+
+
+def test_nfs_cross_instance(tmp_path):
+    # Two repo instances over the same root see each other's records.
+    r1 = NfsNameRecordRepository(record_root=str(tmp_path / "shared"))
+    r2 = NfsNameRecordRepository(record_root=str(tmp_path / "shared"))
+    r1.add("peer/0", "addr0")
+    assert r2.get("peer/0") == "addr0"
+    r1.reset()

@@ -1,0 +1,88 @@
+"""Mirrors reference tests/data/test_stats_tracker.py semantics."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.base.stats_tracker import DistributedStatsTracker, ReduceType
+
+
+def test_masked_avg_sum_min_max():
+    t = DistributedStatsTracker()
+    mask = np.array([True, True, False, True])
+    vals = np.array([1.0, 2.0, 100.0, 3.0])
+    t.denominator(tokens=mask)
+    t.stat(denominator="tokens", loss=vals)
+    t.stat(denominator="tokens", reduce_type=ReduceType.SUM, total=vals)
+    t.stat(denominator="tokens", reduce_type=ReduceType.MAX, mx=vals)
+    t.stat(denominator="tokens", reduce_type=ReduceType.MIN, mn=vals)
+    out = t.export()
+    assert out["tokens"] == 3
+    assert out["loss"] == pytest.approx(2.0)
+    assert out["total"] == pytest.approx(6.0)
+    assert out["mx"] == pytest.approx(3.0)
+    assert out["mn"] == pytest.approx(1.0)
+
+
+def test_scopes_and_accumulation():
+    t = DistributedStatsTracker()
+    with t.scope("ppo"):
+        t.denominator(n=np.array([True, True]))
+        t.stat(denominator="n", x=np.array([1.0, 3.0]))
+        with t.scope("inner"):
+            t.scalar(lr=0.1)
+    # Second batch accumulates before export.
+    with t.scope("ppo"):
+        t.denominator(n=np.array([True]))
+        t.stat(denominator="n", x=np.array([5.0]))
+    out = t.export()
+    assert out["ppo/n"] == 3
+    assert out["ppo/x"] == pytest.approx(3.0)
+    assert out["ppo/inner/lr"] == pytest.approx(0.1)
+    assert t.export() == {}  # reset
+
+
+def test_shape_mismatch_raises_at_record_time():
+    t = DistributedStatsTracker()
+    t.denominator(n=np.array([True, False]))
+    with pytest.raises(ValueError):
+        t.stat(denominator="n", x=np.array([1.0, 2.0, 3.0]))
+
+
+def test_conditional_stat_pairs_with_latest_mask():
+    # A stat recorded only on some batches must pair with the mask that was
+    # current when it was recorded, not positionally with the first mask.
+    t = DistributedStatsTracker()
+    t.denominator(n=np.array([True, True]))
+    t.denominator(n=np.array([True, False]))
+    t.stat(denominator="n", x=np.array([10.0, 99.0]))
+    out = t.export()
+    assert out["x"] == pytest.approx(10.0)
+
+
+def test_partial_export_reset_is_scope_safe():
+    t = DistributedStatsTracker()
+    with t.scope("train"):
+        t.denominator(n=np.array([True]))
+        t.stat(denominator="n", x=np.array([1.0]))
+    with t.scope("train_eval"):
+        t.scalar(acc=0.5)
+    out = t.export(key="train")
+    assert "train/x" in out and "train_eval/acc" not in out
+    out2 = t.export()
+    assert out2["train_eval/acc"] == pytest.approx(0.5)
+    assert "train/x" not in out2
+
+
+def test_unknown_denominator_raises():
+    t = DistributedStatsTracker()
+    with pytest.raises(ValueError):
+        t.stat(denominator="nope", x=np.array([1.0]))
+
+
+def test_empty_mask_skips_stat():
+    t = DistributedStatsTracker()
+    t.denominator(n=np.zeros(3, dtype=bool))
+    t.stat(denominator="n", x=np.array([1.0, 2.0, 3.0]))
+    out = t.export()
+    assert out["n"] == 0
+    assert "x" not in out

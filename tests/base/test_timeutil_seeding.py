@@ -1,0 +1,54 @@
+import numpy as np
+
+from areal_tpu.base import seeding
+from areal_tpu.base.timeutil import FrequencyControl, Timer
+
+
+def test_frequency_step():
+    fc = FrequencyControl(frequency_step=3)
+    assert [fc.check() for _ in range(7)] == [False, False, True, False, False, True, False]
+
+
+def test_frequency_initial_value():
+    fc = FrequencyControl(frequency_step=100, initial_value=True)
+    assert fc.check() is True
+    assert fc.check() is False
+
+
+def test_frequency_state_roundtrip():
+    fc = FrequencyControl(frequency_step=3)
+    fc.check()
+    state = fc.state_dict()
+    fc2 = FrequencyControl(frequency_step=3)
+    fc2.load_state_dict(state)
+    assert fc2.check() is False
+    assert fc2.check() is True
+
+
+def test_frequency_epoch():
+    fc = FrequencyControl(frequency_epoch=2)
+    assert fc.check(epochs=1) is False
+    assert fc.check(epochs=1) is True
+
+
+def test_seeding_deterministic():
+    seeding.set_random_seed(123, "worker0")
+    a = np.random.rand(3)
+    seeding.set_random_seed(123, "worker0")
+    b = np.random.rand(3)
+    assert np.allclose(a, b)
+    seeding.set_random_seed(123, "worker1")
+    c = np.random.rand(3)
+    assert not np.allclose(a, c)
+    k1 = seeding.prng_key("gen")
+    k2 = seeding.prng_key("gen")
+    assert (np.asarray(k1) == np.asarray(k2)).all()
+
+
+def test_timer():
+    t = Timer()
+    with t.scope("a"):
+        pass
+    with t.scope("a"):
+        pass
+    assert t.totals["a"] >= 0

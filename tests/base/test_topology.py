@@ -1,0 +1,32 @@
+import pytest
+
+from areal_tpu.base.topology import MeshSpec, ProcessTopology
+
+
+def test_rank_coord_roundtrip():
+    topo = ProcessTopology(axes=["data", "pipe", "tensor"], dims=[2, 3, 4])
+    assert topo.world_size == 24
+    for r in range(24):
+        coord = topo.get_coord(r)
+        assert topo.get_rank(**coord) == r
+
+
+def test_filter_match():
+    topo = ProcessTopology(axes=["data", "tensor"], dims=[2, 4])
+    ranks = topo.filter_match(data=1)
+    assert ranks == [4, 5, 6, 7]
+    assert topo.get_axis_list("tensor", 5) == [4, 5, 6, 7]
+    assert topo.get_axis_list("data", 5) == [1, 5]
+
+
+def test_mesh_spec_parse():
+    s = MeshSpec.parse("d2t4")
+    assert s.data == 2 and s.tensor == 4 and s.size == 8
+    s = MeshSpec.parse("d2f2s1t2")
+    assert s.dp_size == 4 and s.size == 8
+    # Megatron-style 'm' alias for tensor; p1 tolerated.
+    s = MeshSpec.parse("d4p1m2")
+    assert s.data == 4 and s.tensor == 2
+    with pytest.raises(ValueError):
+        MeshSpec.parse("d2p2m1")  # real PP stages unsupported by design
+    assert str(MeshSpec(data=2, tensor=4)) == "d2f1s1t4"

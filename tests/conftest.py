@@ -1,0 +1,42 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Mirrors the reference's CPU-only multi-process test strategy (SURVEY.md §4)
+the TPU way: a single process with 8 virtual CPU devices so every sharding
+path (data/fsdp/tensor/seq mesh axes) exercises real XLA collectives
+without TPU hardware.
+
+Must run before jax initializes its backends, hence the env mutation at
+import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import uuid
+
+import pytest
+
+
+@pytest.fixture
+def tmp_name_resolve(tmp_path):
+    """Fresh NFS-backend name_resolve rooted in a tmp dir."""
+    from areal_tpu.base import name_resolve
+
+    repo = name_resolve.reconfigure("nfs", record_root=str(tmp_path / "name_resolve"))
+    yield repo
+    repo.reset()
+
+
+@pytest.fixture
+def experiment_context():
+    from areal_tpu.base import constants
+
+    exp, trial = f"test-exp-{uuid.uuid4().hex[:6]}", "trial0"
+    constants.set_experiment_trial_names(exp, trial)
+    yield exp, trial
