@@ -1,0 +1,115 @@
+"""Abstraction dataclasses and the generic named-factory registry.
+
+Counterpart of the reference's core config module
+(reference: realhf/api/core/config.py). An *abstraction* is a
+(type-name, kwargs) pair resolved through a registry at runtime, which is
+how experiments select dataset/interface/backend/agent implementations
+declaratively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass(unsafe_hash=True, order=True)
+class ModelName:
+    """A named model replica: role ('actor', 'critic', ...) + replica index.
+
+    Different replicas of one role (e.g. 'actor' for training vs 'actor' for
+    generation) share weights logically but may live on different meshes.
+    """
+
+    role: str = "default"
+    replica_id: int = 0
+
+    def __str__(self):
+        return f"{self.role}@{self.replica_id}"
+
+    @classmethod
+    def parse(cls, s: str) -> "ModelName":
+        if "@" in s:
+            role, rid = s.split("@")
+            return cls(role=role, replica_id=int(rid))
+        return cls(role=s)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class ModelShardID:
+    """Identifies one host process's shard of a model deployment.
+
+    On TPU a model spans a whole `jax.sharding.Mesh` as a single SPMD
+    program; host processes each drive the same program over their local
+    devices. So unlike the reference's per-GPU (dp, pp, tp) coordinates
+    (realhf/api/core/config.py:85), a shard here is just (model, host
+    index, host count) plus the mesh spec string for validation.
+    """
+
+    model_name: ModelName = dataclasses.field(default_factory=ModelName)
+    host_rank: int = 0
+    n_hosts: int = 1
+    mesh_spec: str = "d1f1s1t1"
+
+    def __str__(self):
+        return f"{self.model_name}:{self.host_rank}of{self.n_hosts}"
+
+
+@dataclasses.dataclass
+class ModelFamily:
+    """HF model family tag: which converter/architecture to use."""
+
+    _class: str = "qwen2"
+    is_critic: bool = False
+
+    def __str__(self):
+        return f"{self._class}{'-critic' if self.is_critic else ''}"
+
+
+def _abstraction(cls_name: str):
+    @dataclasses.dataclass
+    class _Abstraction:
+        type_: str = "default"
+        args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    _Abstraction.__name__ = cls_name
+    _Abstraction.__qualname__ = cls_name
+    return _Abstraction
+
+
+ModelAbstraction = _abstraction("ModelAbstraction")
+ModelInterfaceAbstraction = _abstraction("ModelInterfaceAbstraction")
+ModelBackendAbstraction = _abstraction("ModelBackendAbstraction")
+DatasetAbstraction = _abstraction("DatasetAbstraction")
+AgentAbstraction = _abstraction("AgentAbstraction")
+EnvServiceAbstraction = _abstraction("EnvServiceAbstraction")
+
+
+class Registry:
+    """Simple name -> factory registry with helpful errors."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._factories: Dict[str, Any] = {}
+
+    def register(self, name: str, factory):
+        if name in self._factories:
+            raise ValueError(f"{self.kind} {name!r} already registered")
+        self._factories[name] = factory
+
+    def make(self, abstraction_or_name, *args, **kwargs):
+        if isinstance(abstraction_or_name, str):
+            name, extra = abstraction_or_name, {}
+        else:
+            name, extra = abstraction_or_name.type_, abstraction_or_name.args
+        if name not in self._factories:
+            raise KeyError(
+                f"unknown {self.kind} {name!r}; registered: {sorted(self._factories)}"
+            )
+        return self._factories[name](*args, **{**extra, **kwargs})
+
+    def __contains__(self, name: str):
+        return name in self._factories
+
+    def keys(self):
+        return sorted(self._factories)

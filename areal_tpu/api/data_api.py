@@ -1,0 +1,380 @@
+"""Packed variable-length batch contracts and the dataset registry.
+
+Counterpart of the reference's data API (realhf/api/core/data_api.py):
+`SequenceSample` is the universal exchange format between datasets, MFCs,
+buffers and engines — every tensor is packed along a single leading
+dimension with explicit per-sample sequence lengths, no padding. Padding
+to static shapes (what XLA wants) happens at the last moment inside the
+engines, with bucketed shapes to bound recompilation.
+
+Host-side numpy throughout; engines convert to jnp on device entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from areal_tpu.base import datapack
+from areal_tpu.api.config import DatasetAbstraction, Registry
+
+
+@dataclasses.dataclass
+class MicroBatchSpec:
+    """How to split a batch into micro-batches.
+
+    n_mbs: minimum number of micro-batches (DP ranks may sync to the max).
+    max_tokens_per_mb: token budget per micro-batch (None = unbounded).
+    """
+
+    n_mbs: int = 1
+    max_tokens_per_mb: Optional[int] = None
+
+    @classmethod
+    def new(cls, other: "MicroBatchSpec", **kwargs) -> "MicroBatchSpec":
+        d = dataclasses.asdict(other)
+        d.update(kwargs)
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class SequenceSample:
+    """A batch of variable-length packed sequences.
+
+    ids: unique sample identifiers (hashable strings).
+    keys: the set of data keys present.
+    data: key -> packed array of shape (sum(seqlens[key]), *trailing) or
+        None for metadata-only (control-plane) samples.
+    seqlens: key -> per-sample list of sequence lengths. A sample may hold
+        several sequences under one key (e.g. grouped GRPO responses), hence
+        the inner list.
+    dtypes / trailing_shapes: per-key array metadata, kept even when data is
+        None so receivers can preallocate.
+    metadata: free-form per-batch lists (rewards, versions, ...), each value
+        a list aligned with ids.
+    """
+
+    ids: List[str]
+    keys: Set[str]
+    data: Dict[str, Optional[np.ndarray]]
+    seqlens: Dict[str, List[List[int]]]
+    dtypes: Dict[str, Optional[np.dtype]] = dataclasses.field(default_factory=dict)
+    trailing_shapes: Dict[str, Optional[Tuple[int, ...]]] = dataclasses.field(
+        default_factory=dict
+    )
+    metadata: Dict[str, List[Any]] = dataclasses.field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+
+    def __post_init__(self):
+        self.keys = set(self.keys)
+        for k in self.keys:
+            if k not in self.seqlens:
+                raise ValueError(f"missing seqlens for key {k!r}")
+            if len(self.seqlens[k]) != len(self.ids):
+                raise ValueError(
+                    f"seqlens[{k!r}] has {len(self.seqlens[k])} entries for "
+                    f"{len(self.ids)} ids"
+                )
+            self.seqlens[k] = [[int(x) for x in sl] for sl in self.seqlens[k]]
+            d = self.data.get(k)
+            if d is not None:
+                expected = sum(sum(sl) for sl in self.seqlens[k])
+                if d.shape[0] != expected:
+                    raise ValueError(
+                        f"data[{k!r}] leading dim {d.shape[0]} != total seqlen {expected}"
+                    )
+                self.dtypes.setdefault(k, d.dtype)
+                self.trailing_shapes.setdefault(k, tuple(d.shape[1:]))
+            else:
+                self.dtypes.setdefault(k, None)
+                self.trailing_shapes.setdefault(k, None)
+        for mk, mv in self.metadata.items():
+            if not isinstance(mv, list) or len(mv) != len(self.ids):
+                raise ValueError(
+                    f"metadata[{mk!r}] must be a list aligned with ids "
+                    f"({len(self.ids)}), got {mv!r}"
+                )
+
+    @classmethod
+    def from_default(
+        cls,
+        ids: Sequence[str],
+        seqlens: Sequence[int],
+        data: Dict[str, np.ndarray],
+        metadata: Optional[Dict[str, List[Any]]] = None,
+    ) -> "SequenceSample":
+        """All keys share one sequence per sample with the same lengths,
+        except scalar-per-sequence keys (detected by data length == n_samples
+        while total tokens differ)."""
+        ids = [str(i) for i in ids]
+        seqlens = [int(x) for x in seqlens]
+        total = sum(seqlens)
+        key_seqlens = {}
+        for k, v in data.items():
+            if v is None:
+                key_seqlens[k] = [[l] for l in seqlens]
+            elif v.shape[0] == total:
+                key_seqlens[k] = [[l] for l in seqlens]
+            elif v.shape[0] == len(ids):
+                key_seqlens[k] = [[1] for _ in ids]
+            else:
+                raise ValueError(
+                    f"cannot infer seqlens for key {k!r}: leading dim "
+                    f"{v.shape[0]} is neither total tokens {total} nor batch {len(ids)}"
+                )
+        return cls(
+            ids=ids,
+            keys=set(data.keys()),
+            data=dict(data),
+            seqlens=key_seqlens,
+            metadata=metadata or {},
+        )
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def bs(self) -> int:
+        return len(self.ids)
+
+    def sample_total_len(self, i: int, key: Optional[str] = None) -> int:
+        key = key or self._main_key()
+        return sum(self.seqlens[key][i])
+
+    def _main_key(self) -> str:
+        for k in ("packed_input_ids", "packed_prompts", "seq"):
+            if k in self.keys:
+                return k
+        return sorted(self.keys)[0]
+
+    def total_seqlen(self, key: Optional[str] = None) -> int:
+        key = key or self._main_key()
+        return sum(sum(sl) for sl in self.seqlens[key])
+
+    def seqlens_of(self, key: Optional[str] = None) -> List[int]:
+        """Per-sample total lengths under `key` (the packing weight)."""
+        key = key or self._main_key()
+        return [sum(sl) for sl in self.seqlens[key]]
+
+    # ------------------------------------------------------------------
+    # Gather / split
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def gather(
+        cls, samples: Sequence["SequenceSample"], keys: Optional[Sequence[str]] = None
+    ) -> "SequenceSample":
+        if not samples:
+            raise ValueError("cannot gather zero samples")
+        keys = set(keys) if keys is not None else set(samples[0].keys)
+        for s in samples:
+            if not keys.issubset(s.keys):
+                raise ValueError(f"sample missing keys {keys - s.keys}")
+        ids = datapack.flat2d([s.ids for s in samples])
+        if len(set(ids)) != len(ids):
+            raise ValueError("duplicate ids in gathered samples")
+        data = {}
+        seqlens = {}
+        dtypes = {}
+        trailing = {}
+        for k in keys:
+            seqlens[k] = datapack.flat2d([s.seqlens[k] for s in samples])
+            chunks = [s.data.get(k) for s in samples]
+            if all(c is None for c in chunks):
+                data[k] = None
+            elif any(c is None for c in chunks):
+                raise ValueError(f"mixed data/None for key {k!r} in gather")
+            else:
+                data[k] = np.concatenate(chunks, axis=0)
+            dtypes[k] = samples[0].dtypes.get(k)
+            trailing[k] = samples[0].trailing_shapes.get(k)
+        metadata = {}
+        meta_keys = set(itertools.chain.from_iterable(s.metadata for s in samples))
+        for mk in meta_keys:
+            vals = []
+            for s in samples:
+                if mk not in s.metadata:
+                    raise ValueError(f"metadata key {mk!r} missing in some samples")
+                vals.extend(s.metadata[mk])
+            metadata[mk] = vals
+        return cls(
+            ids=ids,
+            keys=keys,
+            data=data,
+            seqlens=seqlens,
+            dtypes=dtypes,
+            trailing_shapes=trailing,
+            metadata=metadata,
+        )
+
+    def _select_indices(self, indices: Sequence[int]) -> "SequenceSample":
+        """New sample containing the given sample positions, in that order."""
+        indices = list(indices)
+        data = {}
+        seqlens = {}
+        for k in self.keys:
+            seqlens[k] = [self.seqlens[k][i] for i in indices]
+            d = self.data.get(k)
+            if d is None:
+                data[k] = None
+                continue
+            # Per-sample offsets into the packed dim.
+            lens = [sum(sl) for sl in self.seqlens[k]]
+            offsets = np.concatenate([[0], np.cumsum(lens)])
+            data[k] = np.concatenate(
+                [d[offsets[i] : offsets[i] + lens[i]] for i in indices], axis=0
+            ) if indices else d[:0]
+        return SequenceSample(
+            ids=[self.ids[i] for i in indices],
+            keys=set(self.keys),
+            data=data,
+            seqlens=seqlens,
+            dtypes=dict(self.dtypes),
+            trailing_shapes=dict(self.trailing_shapes),
+            metadata={k: [v[i] for i in indices] for k, v in self.metadata.items()},
+        )
+
+    def select_ids(self, ids: Sequence[str]) -> "SequenceSample":
+        pos = {i: p for p, i in enumerate(self.ids)}
+        return self._select_indices([pos[i] for i in ids])
+
+    def select_keys(self, keys: Sequence[str]) -> "SequenceSample":
+        keys = set(keys)
+        if not keys.issubset(self.keys):
+            raise ValueError(f"missing keys: {keys - self.keys}")
+        return SequenceSample(
+            ids=list(self.ids),
+            keys=keys,
+            data={k: self.data.get(k) for k in keys},
+            seqlens={k: self.seqlens[k] for k in keys},
+            dtypes={k: self.dtypes.get(k) for k in keys},
+            trailing_shapes={k: self.trailing_shapes.get(k) for k in keys},
+            metadata=dict(self.metadata),
+        )
+
+    def split_with_partitions(
+        self, partitions: Sequence[Sequence[int]]
+    ) -> List["SequenceSample"]:
+        return [self._select_indices(p) for p in partitions]
+
+    def split(
+        self, spec: MicroBatchSpec
+    ) -> Tuple[List["SequenceSample"], List[int], List[int]]:
+        """Token-budget micro-batch split (FFD bin packing).
+
+        Returns (micro_batches, forward_indices, backward_indices):
+        `forward_indices[j]` is the original position of the j-th sample in
+        the concatenated micro-batch order; `backward_indices` inverts it,
+        for `reorder_output`.
+        """
+        lens = self.seqlens_of()
+        cap = spec.max_tokens_per_mb or int(np.sum(lens)) + 1
+        groups = datapack.ffd_allocate(lens, capacity=cap, min_groups=spec.n_mbs)
+        groups = [sorted(g) for g in groups]
+        forward_indices = datapack.flat2d(groups)
+        backward_indices = np.argsort(forward_indices).tolist()
+        mbs = self.split_with_partitions(groups)
+        return mbs, forward_indices, backward_indices
+
+    @staticmethod
+    def reorder_output(
+        x: np.ndarray,
+        mb_seqlens: Sequence[Sequence[int]],
+        backward_indices: Sequence[int],
+    ) -> np.ndarray:
+        """Un-permute packed outputs concatenated over micro-batches.
+
+        mb_seqlens: per-micro-batch per-sample total lengths, in mb order.
+        """
+        flat_lens = datapack.flat2d(mb_seqlens)
+        offsets = np.concatenate([[0], np.cumsum(flat_lens)])
+        chunks = [
+            x[offsets[i] : offsets[i + 1]] for i in range(len(flat_lens))
+        ]
+        return np.concatenate([chunks[i] for i in backward_indices], axis=0)
+
+    # ------------------------------------------------------------------
+    # Mutation
+    # ------------------------------------------------------------------
+
+    def update_(self, other: "SequenceSample"):
+        """Merge `other`'s keys into self (ids must match)."""
+        if other.ids != self.ids:
+            raise ValueError("update_ requires identical id order")
+        for k in other.keys:
+            self.keys.add(k)
+            self.data[k] = other.data.get(k)
+            self.seqlens[k] = other.seqlens[k]
+            self.dtypes[k] = other.dtypes.get(k)
+            self.trailing_shapes[k] = other.trailing_shapes.get(k)
+        self.metadata.update(other.metadata)
+
+    def remap_keys_(self, remap: Dict[str, str]):
+        for src, dst in remap.items():
+            if src not in self.keys:
+                continue
+            self.keys.discard(src)
+            self.keys.add(dst)
+            self.data[dst] = self.data.pop(src)
+            self.seqlens[dst] = self.seqlens.pop(src)
+            self.dtypes[dst] = self.dtypes.pop(src)
+            self.trailing_shapes[dst] = self.trailing_shapes.pop(src)
+
+    def meta(self) -> "SequenceSample":
+        """Metadata-only copy (control-plane payloads carry no tensors)."""
+        return SequenceSample(
+            ids=list(self.ids),
+            keys=set(self.keys),
+            data={k: None for k in self.keys},
+            seqlens={k: [list(sl) for sl in v] for k, v in self.seqlens.items()},
+            dtypes=dict(self.dtypes),
+            trailing_shapes=dict(self.trailing_shapes),
+            metadata=dict(self.metadata),
+        )
+
+    def unpack(self) -> List["SequenceSample"]:
+        return [self._select_indices([i]) for i in range(self.bs)]
+
+
+# ---------------------------------------------------------------------------
+# Dataset registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DatasetUtility:
+    """Context handed to dataset constructors."""
+
+    seed: int = 0
+    dp_rank: int = 0
+    world_size: int = 1
+    tokenizer: Any = None
+
+
+DATASET_REGISTRY = Registry("dataset")
+
+
+def register_dataset(name: str, factory):
+    DATASET_REGISTRY.register(name, factory)
+
+
+def make_dataset(cfg: "DatasetAbstraction | str", util: DatasetUtility):
+    return DATASET_REGISTRY.make(cfg, util=util)
+
+
+def load_hf_tokenizer(path: str, fast: bool = True):
+    import transformers
+
+    tok = transformers.AutoTokenizer.from_pretrained(
+        path, use_fast=fast, trust_remote_code=True
+    )
+    if tok.pad_token_id is None:
+        tok.pad_token_id = tok.eos_token_id
+    return tok

@@ -81,11 +81,14 @@ def calculate_llama_gen_flops(
     )
     head_dim = hidden_size // num_heads
     kv_size = head_dim * num_kv_heads
-    for i in range(gen_len):
-        lens = [int(l) + i for l in prompt_lens]
-        attn_proj = 2 * batch_size * hidden_size * (2 * hidden_size + 2 * kv_size)
-        attn_quad = 4 * sum(lens) * hidden_size
-        mlp = 2 * batch_size * hidden_size * intermediate_size * 3
-        head = 2 * batch_size * hidden_size * vocab_size
-        flops += n_layers * (attn_proj + attn_quad + mlp) + head
+    # Closed form of sum_i sum_j (prompt_j + i) over decode steps i:
+    # gen_len * sum(prompt) + B * gen_len*(gen_len-1)/2.
+    total_ctx = gen_len * sum(int(l) for l in prompt_lens) + batch_size * (
+        gen_len * (gen_len - 1) // 2
+    )
+    attn_proj = 2 * batch_size * hidden_size * (2 * hidden_size + 2 * kv_size)
+    mlp = 2 * batch_size * hidden_size * intermediate_size * 3
+    head = 2 * batch_size * hidden_size * vocab_size
+    flops += gen_len * (n_layers * (attn_proj + mlp) + head)
+    flops += n_layers * 4 * total_ctx * hidden_size
     return flops

@@ -107,6 +107,10 @@ class NameRecordRepository(ABC):
         """Invoke `call_back` once any of `names` disappears (polling watcher)."""
 
         def _watch():
+            # First wait for every name to exist, so a worker that merely
+            # hasn't registered yet is not reported as dead.
+            for n in names:
+                self.wait(n, poll_frequency=poll_frequency)
             while True:
                 for n in names:
                     try:
@@ -170,7 +174,12 @@ class MemoryNameRecordRepository(NameRecordRepository):
             return self._store[name]
 
     def get_subtree(self, name_root):
-        return [self._store[k] for k in self.find_subtree(name_root)]
+        root = name_root.rstrip("/")
+        with self._lock:
+            keys = sorted(
+                k for k in self._store if k == root or k.startswith(root + "/")
+            )
+            return [self._store[k] for k in keys]
 
     def find_subtree(self, name_root):
         root = name_root.rstrip("/")
@@ -280,11 +289,16 @@ class NfsNameRecordRepository(NameRecordRepository):
         if stop is not None:
             stop.set()
         self._my_keys.pop(name, None)
-        # Prune now-empty directories up the tree.
+        # Prune now-empty directories up the tree. Best-effort: a concurrent
+        # add may repopulate (ENOTEMPTY) or a sibling delete may win the
+        # rmdir race (ENOENT); either just ends the pruning.
         d = os.path.dirname(path)
-        while d != self._root and os.path.isdir(d) and not os.listdir(d):
-            os.rmdir(d)
-            d = os.path.dirname(d)
+        try:
+            while d != self._root and os.path.isdir(d) and not os.listdir(d):
+                os.rmdir(d)
+                d = os.path.dirname(d)
+        except OSError:
+            pass
 
     def clear_subtree(self, name_root):
         d = os.path.join(self._root, name_root.strip("/"))
@@ -293,9 +307,12 @@ class NfsNameRecordRepository(NameRecordRepository):
 
     def get(self, name):
         path = self._path(name)
-        if not os.path.isfile(path) or self._is_expired(path):
+        try:
+            if self._is_expired(path):
+                raise NameEntryNotFoundError(name)
+            value, _ = self._read(path)
+        except (FileNotFoundError, NotADirectoryError):
             raise NameEntryNotFoundError(name)
-        value, _ = self._read(path)
         return value
 
     def find_subtree(self, name_root):
@@ -307,7 +324,14 @@ class NfsNameRecordRepository(NameRecordRepository):
         return sorted(found)
 
     def get_subtree(self, name_root):
-        return [self.get(k) for k in self.find_subtree(name_root)]
+        out = []
+        for k in self.find_subtree(name_root):
+            try:
+                out.append(self.get(k))
+            except NameEntryNotFoundError:
+                # Record vanished between listing and read; skip it.
+                pass
+        return out
 
     def reset(self):
         for stop in self._keepalive_threads.values():

@@ -41,7 +41,11 @@ def get_shuffle_seed(key: str = "shuffle") -> int:
 
 
 def prng_key(key: str):
-    """A jax PRNGKey derived from the experiment seed and a string key."""
+    """A jax PRNGKey derived from the experiment seed, this process's
+    identity key (from set_random_seed), and a string key — distinct
+    processes get distinct streams for the same `key`."""
     import jax
 
-    return jax.random.fold_in(jax.random.PRNGKey(_BASE_SEED), _hash_key(key))
+    return jax.random.fold_in(
+        jax.random.PRNGKey(_BASE_SEED), _hash_key(f"{_SEED_FROM}/{key}")
+    )

@@ -134,10 +134,19 @@ class MeshSpec:
         """Parse 'd2f2s1t2'-style strings (missing axes default to 1)."""
         import re
 
+        if not re.fullmatch(r"([a-z]\d+)+", s):
+            raise ValueError(
+                f"malformed mesh spec {s!r}: expected axis-letter/size pairs "
+                "like 'd2t4' (axes: d=data, f=fsdp, s=seq, t/m=tensor)"
+            )
         vals = dict(data=1, fsdp=1, seq=1, tensor=1)
         key_map = {"d": "data", "f": "fsdp", "s": "seq", "t": "tensor", "m": "tensor", "p": "pipe"}
+        seen = set()
         for m in re.finditer(r"([a-z])(\d+)", s):
             k, v = m.group(1), int(m.group(2))
+            if k in seen:
+                raise ValueError(f"duplicate axis {k!r} in mesh spec {s!r}")
+            seen.add(k)
             name = key_map.get(k)
             if name == "pipe":
                 if v != 1:

@@ -1,0 +1,75 @@
+"""Transformer architecture configuration.
+
+Counterpart of the reference's ReaLModelConfig (realhf/api/core/model_api.py:340),
+covering the same architecture space: GQA attention, rotary variants,
+RMS/LayerNorm, gated MLPs, optional MoE, actor (LM head) or critic (scalar
+head) outputs, tied embeddings, and qk-norm (qwen3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    routed_scaling_factor: float = 1.0
+    aux_loss_coef: float = 1e-3
+    z_loss_coef: float = 0.0
+    # Size of each expert's hidden dim; defaults to intermediate_dim.
+    expert_intermediate_dim: Optional[int] = None
+    # Dense layers interleaved with MoE (e.g. first k layers dense).
+    first_k_dense: int = 0
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    n_layers: int = 2
+    hidden_dim: int = 64
+    n_q_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 16
+    intermediate_dim: int = 128
+    vocab_size: int = 128
+    max_position_embeddings: int = 2048
+
+    activation: str = "silu"  # silu | gelu
+    mlp_type: str = "gated"  # gated | plain
+    norm_type: str = "rms"  # rms | layer
+    norm_eps: float = 1e-6
+
+    rotary_base: float = 10000.0
+    rotary_scaling: Optional[float] = None
+    rotary_scaling_type: Optional[str] = None  # linear | llama3 | None
+    rotary_interleaved: bool = False
+
+    attn_bias: bool = False  # qwen2 uses qkv bias
+    mlp_bias: bool = False
+    qk_norm: bool = False  # qwen3 per-head RMSNorm on q/k
+    tied_embeddings: bool = False
+    embedding_multiplier: Optional[float] = None  # gemma normalizer
+
+    is_critic: bool = False
+    moe: Optional[MoEConfig] = None
+
+    # Numerics: params kept in param_dtype, compute in compute_dtype.
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+    def __post_init__(self):
+        if self.n_q_heads % self.n_kv_heads != 0:
+            raise ValueError("n_q_heads must be a multiple of n_kv_heads")
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_q_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def layer_uses_moe(self, layer_idx: int) -> bool:
+        return self.moe is not None and layer_idx >= self.moe.first_k_dense

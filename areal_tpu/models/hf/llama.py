@@ -1,0 +1,187 @@
+"""LLaMA-family HF conversion (covers llama 1/2/3, and the shared layout
+used by mistral). Reference parity: realhf/api/from_hf/llama.py."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+    rope_scaling = hf.get("rope_scaling") or {}
+    return TransformerConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=head_dim,
+        intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 4096),
+        activation="silu" if hf.get("hidden_act", "silu") == "silu" else "gelu",
+        mlp_type="gated",
+        norm_type="rms",
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rotary_base=hf.get("rope_theta", 10000.0),
+        rotary_scaling=rope_scaling.get("factor"),
+        rotary_scaling_type=rope_scaling.get("rope_type") or rope_scaling.get("type"),
+        attn_bias=bool(hf.get("attention_bias", False)),
+        tied_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        is_critic=is_critic,
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "num_hidden_layers": cfg.n_layers,
+        "hidden_size": cfg.hidden_dim,
+        "num_attention_heads": cfg.n_q_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "hidden_act": "silu",
+        "rms_norm_eps": cfg.norm_eps,
+        "rope_theta": cfg.rotary_base,
+        "attention_bias": cfg.attn_bias,
+        "tie_word_embeddings": cfg.tied_embeddings,
+        "torch_dtype": "bfloat16",
+    }
+    if cfg.rotary_scaling:
+        hf["rope_scaling"] = {
+            "factor": cfg.rotary_scaling,
+            "rope_type": cfg.rotary_scaling_type or "linear",
+        }
+    return hf
+
+
+def params_from_hf_llama_style(
+    sd: Dict[str, np.ndarray],
+    cfg: TransformerConfig,
+    qkv_bias: bool = False,
+    qk_norm: bool = False,
+) -> Dict:
+    """Shared llama-layout importer. HF linear weights are [out, in] and are
+    transposed into the matmul-ready [in, out] layout used on TPU."""
+    L = cfg.n_layers
+
+    def t(name):
+        return np.ascontiguousarray(sd[name].astype(np.float32).T)
+
+    def w(name):
+        return sd[name].astype(np.float32)
+
+    attn: Dict[str, np.ndarray] = {
+        "wq": np.stack([t(f"model.layers.{i}.self_attn.q_proj.weight") for i in range(L)]),
+        "wk": np.stack([t(f"model.layers.{i}.self_attn.k_proj.weight") for i in range(L)]),
+        "wv": np.stack([t(f"model.layers.{i}.self_attn.v_proj.weight") for i in range(L)]),
+        "wo": np.stack([t(f"model.layers.{i}.self_attn.o_proj.weight") for i in range(L)]),
+    }
+    if qkv_bias:
+        attn["bq"] = np.stack([w(f"model.layers.{i}.self_attn.q_proj.bias") for i in range(L)])
+        attn["bk"] = np.stack([w(f"model.layers.{i}.self_attn.k_proj.bias") for i in range(L)])
+        attn["bv"] = np.stack([w(f"model.layers.{i}.self_attn.v_proj.bias") for i in range(L)])
+    if qk_norm:
+        attn["q_norm"] = np.stack([w(f"model.layers.{i}.self_attn.q_norm.weight") for i in range(L)])
+        attn["k_norm"] = np.stack([w(f"model.layers.{i}.self_attn.k_norm.weight") for i in range(L)])
+
+    params: Dict = {
+        "embedding": {"weight": w("model.embed_tokens.weight")},
+        "layers": {
+            "ln1": {
+                "weight": np.stack(
+                    [w(f"model.layers.{i}.input_layernorm.weight") for i in range(L)]
+                )
+            },
+            "ln2": {
+                "weight": np.stack(
+                    [w(f"model.layers.{i}.post_attention_layernorm.weight") for i in range(L)]
+                )
+            },
+            "attn": attn,
+            "mlp": {
+                "w_gate": np.stack([t(f"model.layers.{i}.mlp.gate_proj.weight") for i in range(L)]),
+                "w_up": np.stack([t(f"model.layers.{i}.mlp.up_proj.weight") for i in range(L)]),
+                "w_down": np.stack([t(f"model.layers.{i}.mlp.down_proj.weight") for i in range(L)]),
+            },
+        },
+        "final_norm": {"weight": w("model.norm.weight")},
+    }
+    if cfg.is_critic:
+        # Critic heads don't exist in HF causal-LM checkpoints; use score/
+        # v_head when present, else zero-init (reference does random init).
+        if "score.weight" in sd:
+            params["head"] = {"weight": t("score.weight")}
+        else:
+            params["head"] = {"weight": np.zeros((cfg.hidden_dim, 1), np.float32)}
+    elif not cfg.tied_embeddings:
+        params["head"] = {"weight": t("lm_head.weight")}
+    return params
+
+
+def params_to_hf_llama_style(
+    params: Dict,
+    cfg: TransformerConfig,
+    qkv_bias: bool = False,
+    qk_norm: bool = False,
+) -> Dict[str, np.ndarray]:
+    L = cfg.n_layers
+    layers = params["layers"]
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embedding"]["weight"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["weight"]),
+    }
+    a, m = layers["attn"], layers["mlp"]
+    for i in range(L):
+        sd[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(layers["ln1"]["weight"][i])
+        sd[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(layers["ln2"]["weight"][i])
+        sd[f"model.layers.{i}.self_attn.q_proj.weight"] = np.asarray(a["wq"][i]).T
+        sd[f"model.layers.{i}.self_attn.k_proj.weight"] = np.asarray(a["wk"][i]).T
+        sd[f"model.layers.{i}.self_attn.v_proj.weight"] = np.asarray(a["wv"][i]).T
+        sd[f"model.layers.{i}.self_attn.o_proj.weight"] = np.asarray(a["wo"][i]).T
+        if qkv_bias:
+            sd[f"model.layers.{i}.self_attn.q_proj.bias"] = np.asarray(a["bq"][i])
+            sd[f"model.layers.{i}.self_attn.k_proj.bias"] = np.asarray(a["bk"][i])
+            sd[f"model.layers.{i}.self_attn.v_proj.bias"] = np.asarray(a["bv"][i])
+        if qk_norm:
+            sd[f"model.layers.{i}.self_attn.q_norm.weight"] = np.asarray(a["q_norm"][i])
+            sd[f"model.layers.{i}.self_attn.k_norm.weight"] = np.asarray(a["k_norm"][i])
+        sd[f"model.layers.{i}.mlp.gate_proj.weight"] = np.asarray(m["w_gate"][i]).T
+        sd[f"model.layers.{i}.mlp.up_proj.weight"] = np.asarray(m["w_up"][i]).T
+        sd[f"model.layers.{i}.mlp.down_proj.weight"] = np.asarray(m["w_down"][i]).T
+    if cfg.is_critic:
+        sd["score.weight"] = np.asarray(params["head"]["weight"]).T
+    elif not cfg.tied_embeddings:
+        sd["lm_head.weight"] = np.asarray(params["head"]["weight"]).T
+    return sd
+
+
+def _params_from_hf(sd, cfg):
+    return params_from_hf_llama_style(sd, cfg, qkv_bias=cfg.attn_bias, qk_norm=False)
+
+
+def _params_to_hf(params, cfg):
+    return params_to_hf_llama_style(params, cfg, qkv_bias=cfg.attn_bias, qk_norm=False)
+
+
+from areal_tpu.models.hf import HFFamily  # noqa: E402
+
+register_hf_family(
+    "llama",
+    HFFamily(
+        name="llama",
+        hf_model_type="llama",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    ),
+)

@@ -1,0 +1,44 @@
+"""Qwen2/Qwen2.5 HF conversion: llama layout + qkv bias.
+Reference parity: realhf/api/from_hf/qwen2.py."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf import HFFamily
+from areal_tpu.models.hf.llama import (
+    _config_from_hf as llama_config_from_hf,
+    _config_to_hf as llama_config_to_hf,
+    params_from_hf_llama_style,
+    params_to_hf_llama_style,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    cfg = llama_config_from_hf(hf, is_critic)
+    cfg.attn_bias = True  # qwen2 always uses qkv bias
+    return cfg
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    hf = llama_config_to_hf(cfg)
+    hf["architectures"] = ["Qwen2ForCausalLM"]
+    hf["model_type"] = "qwen2"
+    hf.pop("attention_bias", None)
+    hf.pop("head_dim", None)
+    return hf
+
+
+register_hf_family(
+    "qwen2",
+    HFFamily(
+        name="qwen2",
+        hf_model_type="qwen2",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=lambda sd, cfg: params_from_hf_llama_style(sd, cfg, qkv_bias=True),
+        params_to_hf=lambda p, cfg: params_to_hf_llama_style(p, cfg, qkv_bias=True),
+    ),
+)

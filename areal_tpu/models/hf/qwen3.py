@@ -1,0 +1,48 @@
+"""Qwen3 HF conversion: llama layout + per-head q/k RMSNorm, no qkv bias,
+decoupled head_dim. Reference parity: realhf/api/from_hf/qwen3.py."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf import HFFamily
+from areal_tpu.models.hf.llama import (
+    _config_from_hf as llama_config_from_hf,
+    _config_to_hf as llama_config_to_hf,
+    params_from_hf_llama_style,
+    params_to_hf_llama_style,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    cfg = llama_config_from_hf(hf, is_critic)
+    cfg.attn_bias = False
+    cfg.qk_norm = True
+    return cfg
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    hf = llama_config_to_hf(cfg)
+    hf["architectures"] = ["Qwen3ForCausalLM"]
+    hf["model_type"] = "qwen3"
+    hf["attention_bias"] = False
+    return hf
+
+
+register_hf_family(
+    "qwen3",
+    HFFamily(
+        name="qwen3",
+        hf_model_type="qwen3",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=lambda sd, cfg: params_from_hf_llama_style(
+            sd, cfg, qkv_bias=False, qk_norm=True
+        ),
+        params_to_hf=lambda p, cfg: params_to_hf_llama_style(
+            p, cfg, qkv_bias=False, qk_norm=True
+        ),
+    ),
+)

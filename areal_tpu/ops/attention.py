@@ -1,0 +1,101 @@
+"""Packed variable-length causal attention with GQA.
+
+Replaces the reference's flash-attn varlen path
+(realhf/impl/model/modules/attn.py:272-289) the TPU way: batches are packed
+token streams with *segment ids* (0 = padding, sequences numbered from 1)
+and per-token positions; attention is masked to (same segment) AND
+(causal by position). Two implementations share one signature:
+
+- `reference_packed_attention`: dense jnp einsum + mask. O(T^2) memory;
+  used on CPU tests and as the numerical oracle.
+- `flash_packed_attention` (areal_tpu.ops.pallas.flash_attn): blocked
+  Pallas kernel, online softmax, segment-aware block skipping.
+
+`packed_attention` dispatches on platform/size.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0**30
+
+
+def segment_causal_mask(
+    q_seg: jnp.ndarray, kv_seg: jnp.ndarray, q_pos: jnp.ndarray, kv_pos: jnp.ndarray
+) -> jnp.ndarray:
+    """Boolean [Tq, Tk]: token i may attend to token j."""
+    same = q_seg[:, None] == kv_seg[None, :]
+    causal = q_pos[:, None] >= kv_pos[None, :]
+    valid = (q_seg[:, None] > 0) & (kv_seg[None, :] > 0)
+    return same & causal & valid
+
+
+def reference_packed_attention(
+    q: jnp.ndarray,  # [T, Hq, hd]
+    k: jnp.ndarray,  # [T, Hkv, hd]
+    v: jnp.ndarray,  # [T, Hkv, hd]
+    segment_ids: jnp.ndarray,  # [T] int32, 0 = pad
+    positions: jnp.ndarray,  # [T] int32 within-sequence positions
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    T, Hq, hd = q.shape
+    Hkv = k.shape[1]
+    group = Hq // Hkv
+    scale = softmax_scale if softmax_scale is not None else hd**-0.5
+    qg = q.reshape(T, Hkv, group, hd).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # scores: [Hkv, group, Tq, Tk]
+    scores = jnp.einsum("qhgd,khd->hgqk", qg, kf) * scale
+    mask = segment_causal_mask(segment_ids, segment_ids, positions, positions)
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Fully-masked (padding) rows: zero out.
+    probs = jnp.where(mask.any(axis=-1)[None, None, :, None], probs, 0.0)
+    out = jnp.einsum("hgqk,khd->qhgd", probs, vf)
+    return out.reshape(T, Hq, hd).astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, Hq, hd] — one new token per sequence
+    k_cache: jnp.ndarray,  # [B, S, Hkv, hd]
+    v_cache: jnp.ndarray,  # [B, S, Hkv, hd]
+    cache_lens: jnp.ndarray,  # [B] valid lengths INCLUDING the new token
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-step decode attention against a padded KV cache."""
+    B, Hq, hd = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    group = Hq // Hkv
+    scale = softmax_scale if softmax_scale is not None else hd**-0.5
+    qg = q.reshape(B, Hkv, group, hd).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bshd->bhgs", qg, k_cache.astype(jnp.float32)) * scale
+    pos = jnp.arange(S)[None, :]  # [1, S]
+    mask = pos < cache_lens[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", probs, v_cache.astype(jnp.float32))
+    return out.reshape(B, Hq, hd).astype(q.dtype)
+
+
+def packed_attention(q, k, v, segment_ids, positions, softmax_scale=None, impl="auto"):
+    """Dispatch between implementations. Static decision (trace-time): `impl`
+    is 'reference', 'flash', or 'auto' (flash on TPU backends when T is a
+    multiple of the kernel block, reference otherwise)."""
+    T = q.shape[0]
+    if impl == "auto":
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        impl = "flash" if (on_tpu and T >= 512 and T % 512 == 0) else "reference"
+    if impl == "flash":
+        from areal_tpu.ops.pallas.flash_attn import flash_packed_attention
+
+        return flash_packed_attention(
+            q, k, v, segment_ids, positions, softmax_scale=softmax_scale
+        )
+    return reference_packed_attention(
+        q, k, v, segment_ids, positions, softmax_scale=softmax_scale
+    )

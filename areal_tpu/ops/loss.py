@@ -1,0 +1,96 @@
+"""Token-level loss/logprob primitives over packed rows.
+
+Replaces the reference's vocab-parallel cross entropy and packed logprob
+gathering (realhf/impl/model/parallelism/tensor_parallel/modules.py:1180,
+realhf/impl/model/utils/functional.py): under GSPMD the vocab dimension is
+just a sharded axis, so a plain log_softmax + gather compiles to the same
+collectives the hand-written vocab-parallel CE performs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_logprobs(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """log P(labels) under logits along the last axis. fp32."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return picked - lse
+
+
+def next_token_logprobs(
+    logits: jnp.ndarray,  # [R, T, V] fp32
+    input_ids: jnp.ndarray,  # [R, T]
+    segment_ids: jnp.ndarray,  # [R, T], 0 = pad
+) -> jnp.ndarray:
+    """logprob[t] = log P(token[t+1] | prefix) when t+1 continues the same
+    segment; 0 elsewhere (sequence-final tokens, padding). Shape [R, T].
+
+    Matches the reference convention where packed logprobs are shifted so
+    position t scores the token emitted *at* t+1.
+    """
+    next_ids = jnp.concatenate(
+        [input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])], axis=1
+    )
+    next_seg = jnp.concatenate(
+        [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+    )
+    valid = (segment_ids > 0) & (next_seg == segment_ids)
+    logp = gather_logprobs(logits, next_ids)
+    return jnp.where(valid, logp, 0.0)
+
+
+def next_token_entropy(
+    logits: jnp.ndarray, segment_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-position predictive entropy, masked like next_token_logprobs."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ent = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+    return jnp.where(segment_ids > 0, ent, 0.0)
+
+
+def sft_loss(
+    logits: jnp.ndarray,  # [R, T, V]
+    input_ids: jnp.ndarray,  # [R, T]
+    segment_ids: jnp.ndarray,  # [R, T]
+    loss_mask: jnp.ndarray,  # [R, T] 1.0 where the *target* token (t+1) counts
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token cross entropy over masked positions.
+
+    loss_mask is given per-position in the shifted frame: mask[t] = 1 means
+    the prediction made at t (of token t+1) contributes. Returns
+    (sum_loss, n_tokens); callers normalize globally so DP shards with
+    different token counts average correctly.
+    """
+    logp = next_token_logprobs(logits, input_ids, segment_ids)
+    mask = loss_mask.astype(jnp.float32)
+    return -jnp.sum(logp * mask), jnp.sum(mask)
+
+
+def masked_normalization(
+    x: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float = 1e-5,
+    unbiased: bool = True,
+) -> jnp.ndarray:
+    """Whiten x over masked elements (advantage normalization).
+
+    Under pjit the batch is global, so the mean/std are global without any
+    explicit collective (reference: realhf/impl/model/utils/functional.py
+    masked_normalization with its dist.all_reduce).
+    """
+    mask = mask.astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    n = jnp.maximum(mask.sum(), 1.0)
+    mean = jnp.sum(x32 * mask) / n
+    var = jnp.sum(((x32 - mean) ** 2) * mask) / jnp.maximum(
+        n - (1.0 if unbiased else 0.0), 1.0
+    )
+    out = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return jnp.where(mask > 0, out, 0.0).astype(x.dtype)

@@ -1,0 +1,78 @@
+"""Rotary position embeddings with scaling variants.
+
+Replaces the reference's torch rotary module
+(realhf/impl/model/modules/rotary.py) with position-indexed jnp: because
+batches are packed, every token carries an explicit position id and the
+embedding is gathered per token rather than sliced per sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rotary_inv_freq(
+    head_dim: int,
+    base: float = 10000.0,
+    scaling: Optional[float] = None,
+    scaling_type: Optional[str] = None,
+) -> np.ndarray:
+    inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling_type == "linear" and scaling:
+        inv_freq = inv_freq / scaling
+    elif scaling_type == "llama3" and scaling:
+        # llama3-style NTK frequency interpolation: low frequencies scaled,
+        # high frequencies kept, smooth ramp between.
+        low_freq_factor, high_freq_factor, orig_ctx = 1.0, 4.0, 8192
+        wavelen = 2 * np.pi / inv_freq
+        low_wl = orig_ctx / low_freq_factor
+        high_wl = orig_ctx / high_freq_factor
+        scaled = inv_freq / scaling
+        smooth = (orig_ctx / wavelen - low_freq_factor) / (
+            high_freq_factor - low_freq_factor
+        )
+        smoothed = (1 - smooth) * scaled + smooth * inv_freq
+        inv_freq = np.where(
+            wavelen < high_wl, inv_freq, np.where(wavelen > low_wl, scaled, smoothed)
+        )
+    return inv_freq.astype(np.float32)
+
+
+def rotary_cos_sin(positions: jnp.ndarray, inv_freq: jnp.ndarray):
+    """cos/sin of shape (*positions.shape, head_dim/2), fp32."""
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq[None, :]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rotary(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    interleaved: bool = False,
+) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: (..., n_heads, head_dim); cos/sin: (..., head_dim/2) broadcast over heads.
+    Non-interleaved (HF neox style): pairs are (x[:d/2], x[d/2:]).
+    """
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    d2 = x.shape[-1] // 2
+    if interleaved:
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    else:
+        x1 = x[..., :d2]
+        x2 = x[..., d2:]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.concatenate([o1, o2], axis=-1)
+    return out.astype(dtype)

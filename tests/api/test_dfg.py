@@ -1,0 +1,95 @@
+"""DFG construction tests (mirrors reference tests/data/test_dfg.py)."""
+
+import pytest
+
+from areal_tpu.api.config import ModelInterfaceAbstraction, ModelName
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, build_graph
+
+
+def _mfc(name, role, itype, inputs, outputs, **kw):
+    return MFCDef(
+        name=name,
+        model_name=ModelName(role, 0),
+        interface_type=itype,
+        interface_impl=ModelInterfaceAbstraction("null"),
+        input_keys=inputs,
+        output_keys=outputs,
+        **kw,
+    )
+
+
+def make_ppo_rpcs():
+    gen = _mfc(
+        "actor_gen", "actor", ModelInterfaceType.GENERATE,
+        ["packed_prompts"], ["packed_input_ids", "prompt_mask", "logprobs"],
+    )
+    rew = _mfc(
+        "rew_inf", "reward", ModelInterfaceType.INFERENCE,
+        ["packed_input_ids"], ["rewards"],
+    )
+    ref = _mfc(
+        "ref_inf", "ref", ModelInterfaceType.INFERENCE,
+        ["packed_input_ids"], ["ref_logprobs"],
+    )
+    critic_inf = _mfc(
+        "critic_inf", "critic", ModelInterfaceType.INFERENCE,
+        ["packed_input_ids"], ["values"],
+    )
+    actor_train = _mfc(
+        "actor_train", "actor", ModelInterfaceType.TRAIN_STEP,
+        ["packed_input_ids", "prompt_mask", "logprobs", "rewards", "ref_logprobs", "values"],
+        [],
+    )
+    critic_train = _mfc(
+        "critic_train", "critic", ModelInterfaceType.TRAIN_STEP,
+        ["packed_input_ids", "prompt_mask", "logprobs", "rewards", "ref_logprobs", "values"],
+        [],
+    )
+    return [gen, rew, ref, critic_inf, actor_train, critic_train]
+
+
+def test_ppo_graph_structure():
+    rpcs = make_ppo_rpcs()
+    g = build_graph(rpcs)
+    by = g.rpcs
+    assert by["actor_gen"].is_src
+    assert set(by["actor_gen"].children) == {"rew_inf", "ref_inf", "critic_inf",
+                                             "actor_train", "critic_train"}
+    assert by["actor_train"].is_dst and by["critic_train"].is_dst
+    assert set(by["actor_train"].parents) == {"actor_gen", "rew_inf", "ref_inf", "critic_inf"}
+    assert g.topo_order[0] == ["actor_gen"]
+    assert set(g.topo_order[1]) == {"critic_inf", "ref_inf", "rew_inf"}
+    assert set(g.topo_order[2]) == {"actor_train", "critic_train"}
+    # packed_prompts comes from the dataset.
+    assert g.data_keys == {"packed_prompts"}
+
+
+def test_output_key_remap():
+    a = _mfc("a", "m", ModelInterfaceType.INFERENCE, ["x"], ["logprobs"],
+             output_key_remap={"logprobs": "old_logprobs"})
+    b = _mfc("b", "m", ModelInterfaceType.TRAIN_STEP, ["old_logprobs"], [])
+    g = build_graph([a, b])
+    assert g.rpcs["b"].parents == ["a"]
+    assert g.producers["old_logprobs"] == "a"
+
+
+def test_duplicate_producer_raises():
+    a = _mfc("a", "m", ModelInterfaceType.INFERENCE, [], ["y"])
+    b = _mfc("b", "m", ModelInterfaceType.INFERENCE, [], ["y"])
+    with pytest.raises(ValueError):
+        build_graph([a, b])
+
+
+def test_cycle_detection():
+    a = _mfc("a", "m", ModelInterfaceType.INFERENCE, ["u"], ["v"])
+    b = _mfc("b", "m", ModelInterfaceType.INFERENCE, ["v"], ["u"])
+    with pytest.raises(ValueError):
+        build_graph([a, b])
+
+
+def test_sft_single_node():
+    t = _mfc("sft_train", "default", ModelInterfaceType.TRAIN_STEP,
+             ["packed_input_ids", "prompt_mask"], [])
+    g = build_graph([t])
+    assert t.is_src and t.is_dst
+    assert g.data_keys == {"packed_input_ids", "prompt_mask"}

@@ -1,0 +1,133 @@
+"""SequenceSample invariants (mirrors reference tests/data/test_sequence_gather_split.py)."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+
+
+def make_sample(n, seed=0, keys=("packed_input_ids", "rewards")):
+    rng = np.random.RandomState(seed)
+    seqlens = rng.randint(3, 20, size=n).tolist()
+    ids = [f"s{seed}-{i}" for i in range(n)]
+    data = {}
+    if "packed_input_ids" in keys:
+        data["packed_input_ids"] = rng.randint(0, 100, size=sum(seqlens))
+    if "rewards" in keys:
+        data["rewards"] = rng.rand(n).astype(np.float32)
+    return SequenceSample.from_default(ids=ids, seqlens=seqlens, data=data)
+
+
+def test_from_default_infers_seqlens():
+    s = make_sample(5)
+    assert s.bs == 5
+    assert s.seqlens["rewards"] == [[1]] * 5
+    assert s.total_seqlen("packed_input_ids") == sum(s.seqlens_of())
+
+
+def test_gather_split_roundtrip():
+    parts = [make_sample(3, seed=i) for i in range(4)]
+    g = SequenceSample.gather(parts)
+    assert g.bs == 12
+    back = g.split_with_partitions([[0, 1, 2], [3, 4, 5], [6, 7, 8], [9, 10, 11]])
+    for orig, rec in zip(parts, back):
+        assert orig.ids == rec.ids
+        np.testing.assert_array_equal(
+            orig.data["packed_input_ids"], rec.data["packed_input_ids"]
+        )
+        np.testing.assert_array_equal(orig.data["rewards"], rec.data["rewards"])
+
+
+def test_gather_duplicate_ids_raises():
+    s = make_sample(3)
+    with pytest.raises(ValueError):
+        SequenceSample.gather([s, s])
+
+
+def test_select_ids_and_keys():
+    s = make_sample(6)
+    sub = s.select_ids([s.ids[4], s.ids[1]])
+    assert sub.ids == [s.ids[4], s.ids[1]]
+    assert sub.sample_total_len(0) == s.sample_total_len(4)
+    ks = s.select_keys(["rewards"])
+    assert ks.keys == {"rewards"}
+    np.testing.assert_array_equal(ks.data["rewards"], s.data["rewards"])
+
+
+def test_mb_split_and_reorder_output():
+    s = make_sample(10)
+    mbs, fwd, bwd = s.split(MicroBatchSpec(n_mbs=3, max_tokens_per_mb=60))
+    assert len(mbs) >= 3
+    assert sorted(fwd) == list(range(10))
+    # Simulate per-token outputs computed per micro-batch, then reorder.
+    outs = [mb.data["packed_input_ids"] * 2 for mb in mbs]
+    merged = SequenceSample.reorder_output(
+        np.concatenate(outs),
+        [mb.seqlens_of() for mb in mbs],
+        bwd,
+    )
+    np.testing.assert_array_equal(merged, s.data["packed_input_ids"] * 2)
+
+
+def test_update_and_remap():
+    s = make_sample(4)
+    logp = np.random.rand(s.total_seqlen()).astype(np.float32)
+    other = SequenceSample(
+        ids=list(s.ids),
+        keys={"logprobs"},
+        data={"logprobs": logp},
+        seqlens={"logprobs": s.seqlens["packed_input_ids"]},
+    )
+    s.update_(other)
+    assert "logprobs" in s.keys
+    s.remap_keys_({"logprobs": "old_logprobs"})
+    assert "old_logprobs" in s.keys and "logprobs" not in s.keys
+    np.testing.assert_array_equal(s.data["old_logprobs"], logp)
+
+
+def test_meta_carries_no_data():
+    s = make_sample(3)
+    m = s.meta()
+    assert all(v is None for v in m.data.values())
+    assert m.seqlens == s.seqlens
+    assert m.dtypes["packed_input_ids"] == s.dtypes["packed_input_ids"]
+
+
+def test_metadata_alignment():
+    s = make_sample(3)
+    with pytest.raises(ValueError):
+        SequenceSample(
+            ids=["a", "b"],
+            keys={"x"},
+            data={"x": np.zeros(2)},
+            seqlens={"x": [[1], [1]]},
+            metadata={"scores": [1.0]},
+        )
+    sub = SequenceSample(
+        ids=["a", "b"],
+        keys={"x"},
+        data={"x": np.zeros(2)},
+        seqlens={"x": [[1], [1]]},
+        metadata={"scores": [1.0, 2.0]},
+    )._select_indices([1])
+    assert sub.metadata["scores"] == [2.0]
+
+
+def test_grouped_inner_seqlens():
+    # One id holding a group of 2 sequences under one key (GRPO-style).
+    s = SequenceSample(
+        ids=["p0"],
+        keys={"seq"},
+        data={"seq": np.arange(7)},
+        seqlens={"seq": [[3, 4]]},
+    )
+    assert s.sample_total_len(0, "seq") == 7
+    u = s.unpack()
+    assert len(u) == 1 and u[0].seqlens["seq"] == [[3, 4]]
+
+
+def test_data_shape_validation():
+    with pytest.raises(ValueError):
+        SequenceSample(
+            ids=["a"], keys={"x"}, data={"x": np.zeros(5)}, seqlens={"x": [[3]]}
+        )

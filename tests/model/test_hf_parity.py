@@ -1,0 +1,123 @@
+"""HF -> JAX conversion parity: logits must match transformers on CPU.
+
+Mirror of the reference's tests/model/test_cpu_inference.py gate
+(SURVEY.md §7.3 minimum slice gate).
+"""
+
+import numpy as np
+import pytest
+
+import areal_tpu.models.hf  # noqa: F401  (registers families)
+from areal_tpu.models.hf import (
+    get_family,
+    save_hf_model,
+    load_hf_model,
+    torch_state_dict_to_numpy,
+)
+from areal_tpu.models.packing import pack_sequences
+from areal_tpu.models.transformer import forward
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def tiny_hf_model(family: str):
+    if family == "llama":
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, rms_norm_eps=1e-6, tie_word_embeddings=False,
+        )
+        return transformers.LlamaForCausalLM(cfg)
+    if family == "qwen2":
+        cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, tie_word_embeddings=False,
+        )
+        return transformers.Qwen2ForCausalLM(cfg)
+    if family == "qwen3":
+        cfg = transformers.Qwen3Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256, tie_word_embeddings=False,
+        )
+        return transformers.Qwen3ForCausalLM(cfg)
+    raise ValueError(family)
+
+
+@pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3"])
+def test_logits_match_hf(family):
+    torch.manual_seed(0)
+    hf_model = tiny_hf_model(family).eval()
+    fam = get_family(family)
+    cfg = fam.config_from_hf(hf_model.config.to_dict(), False)
+    cfg.compute_dtype = "float32"  # parity in fp32
+    params = fam.params_from_hf(torch_state_dict_to_numpy(hf_model.state_dict()), cfg)
+
+    rng = np.random.RandomState(0)
+    lens = [13, 7, 21]
+    seqs = [rng.randint(0, 128, size=l) for l in lens]
+
+    with torch.no_grad():
+        hf_logits = [
+            hf_model(torch.tensor(s[None], dtype=torch.long)).logits[0].numpy()
+            for s in seqs
+        ]
+
+    batch = pack_sequences(seqs, row_len_multiple=16)
+    logits = np.asarray(
+        forward(
+            params, cfg,
+            batch.input_ids, batch.segment_ids, batch.positions,
+            attn_impl="reference",
+        )
+    )
+    ours = batch.gather_per_token(logits)
+    for h, o in zip(hf_logits, ours):
+        np.testing.assert_allclose(h, o, atol=5e-3, rtol=5e-3)
+
+
+@pytest.mark.parametrize("family", ["qwen2"])
+def test_hf_save_load_roundtrip(family, tmp_path):
+    torch.manual_seed(1)
+    hf_model = tiny_hf_model(family).eval()
+    fam = get_family(family)
+    cfg = fam.config_from_hf(hf_model.config.to_dict(), False)
+    cfg.compute_dtype = "float32"
+    params = fam.params_from_hf(torch_state_dict_to_numpy(hf_model.state_dict()), cfg)
+
+    save_hf_model(str(tmp_path / "ckpt"), cfg, params, family)
+    cfg2, params2 = load_hf_model(str(tmp_path / "ckpt"))
+    assert cfg2.n_layers == cfg.n_layers and cfg2.attn_bias == cfg.attn_bias
+
+    import jax
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(params2)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # And the roundtripped checkpoint still loads into transformers.
+    reloaded = transformers.AutoModelForCausalLM.from_pretrained(str(tmp_path / "ckpt"))
+    x = torch.randint(0, 128, (1, 9))
+    with torch.no_grad():
+        a = hf_model(x).logits
+        b = reloaded(x).logits
+    np.testing.assert_allclose(a.numpy(), b.numpy(), atol=1e-5)
+
+
+def test_critic_head_conversion():
+    hf_model = tiny_hf_model("qwen2").eval()
+    fam = get_family("qwen2")
+    cfg = fam.config_from_hf(hf_model.config.to_dict(), True)
+    assert cfg.is_critic
+    params = fam.params_from_hf(torch_state_dict_to_numpy(hf_model.state_dict()), cfg)
+    assert params["head"]["weight"].shape == (64, 1)
+    cfg.compute_dtype = "float32"
+    batch = pack_sequences([np.arange(10)], row_len_multiple=16)
+    values = forward(
+        params, cfg, batch.input_ids, batch.segment_ids, batch.positions,
+        attn_impl="reference",
+    )
+    assert values.shape == batch.input_ids.shape
