@@ -103,14 +103,22 @@ class NameRecordRepository(ABC):
         names: List[str],
         call_back: Callable[[], None],
         poll_frequency: float = 5.0,
+        grace_period: float = 300.0,
     ):
-        """Invoke `call_back` once any of `names` disappears (polling watcher)."""
+        """Invoke `call_back` once any of `names` disappears (polling watcher).
+
+        Names are first given `grace_period` seconds to appear (workers still
+        registering are not dead); a name that never shows up within the
+        grace period also triggers the callback (worker died during startup).
+        """
 
         def _watch():
-            # First wait for every name to exist, so a worker that merely
-            # hasn't registered yet is not reported as dead.
-            for n in names:
-                self.wait(n, poll_frequency=poll_frequency)
+            try:
+                for n in names:
+                    self.wait(n, timeout=grace_period, poll_frequency=poll_frequency)
+            except TimeoutError:
+                call_back()
+                return
             while True:
                 for n in names:
                     try:

@@ -1,0 +1,389 @@
+"""The JAX/GSPMD train+inference+generation engine.
+
+Counterpart of the reference's backend stack — ReaLMegatronEngine
+(realhf/impl/model/backend/megatron.py:385), PipelinableInferenceEngine
+(backend/inference.py:25) and the pipe runner — collapsed into one class:
+on TPU there is no pipeline schedule or DDP wrapper; `train_batch` is one
+jitted program per (loss, shape-bucket) over the engine's mesh, with
+micro-batch gradient accumulation and a single optimizer step, exactly
+matching PipelinableEngine.train_batch semantics
+(realhf/api/core/model_api.py:514).
+
+Loss functions are pure jit-able callables
+`loss_fn(logits, rows) -> (loss_sum, aux_dict)` where `rows` carries the
+packed [R, T] arrays for every data key (token-aligned keys scattered,
+per-sequence scalars broadcast across their span).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import GenerationHyperparameters, TrainEngine
+from areal_tpu.base import logging as areal_logging
+from areal_tpu.base import stats_tracker
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.generation import generate_tokens
+from areal_tpu.models.packing import PackedBatch, pack_sequences
+from areal_tpu.models.transformer import forward as model_forward
+from areal_tpu.ops.loss import next_token_logprobs
+from areal_tpu.engine.optimizer import OptimizerConfig, make_optimizer
+from areal_tpu.parallel.mesh import single_device_mesh
+from areal_tpu.parallel.sharding import batch_sharding, param_shardings, shard_params
+
+logger = areal_logging.getLogger("jax_engine")
+
+PackedLossFn = Callable[[jnp.ndarray, Dict[str, jnp.ndarray]], Tuple[jnp.ndarray, Dict]]
+
+
+def opt_state_shardings(opt_state, params, mesh):
+    """Give optimizer-state subtrees that mirror the parameter tree their
+    parameters' shardings (ZeRO: Adam mu/nu shard exactly like their
+    params); everything else (step counts etc.) replicates.
+
+    Matches *structurally*: any subtree of opt_state with the same treedef
+    as `params` is assumed to be a per-parameter moment tree.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    p_shardings = param_shardings(params, mesh)
+    params_treedef = jax.tree_util.tree_structure(params)
+    replicated = NamedSharding(mesh, P())
+
+    def walk(node):
+        if jax.tree_util.tree_structure(node) == params_treedef:
+            return p_shardings
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            mapped = [walk(v) for v in node]
+            if hasattr(node, "_fields"):  # NamedTuple (optax states)
+                return type(node)(*mapped)
+            return type(node)(mapped)
+        return jax.tree_util.tree_map(lambda _: replicated, node)
+
+    return walk(opt_state)
+
+
+@dataclasses.dataclass
+class EngineStats:
+    """Host-side per-train_batch summary."""
+
+    loss: float = 0.0
+    grad_norm: float = 0.0
+    lr: float = 0.0
+    n_tokens: float = 0.0
+
+
+class JaxTrainEngine(TrainEngine):
+
+    def __init__(
+        self,
+        model_cfg: TransformerConfig,
+        params: Dict[str, Any],
+        mesh=None,
+        optimizer_config: Optional[OptimizerConfig] = None,
+        total_train_steps: int = 1000,
+        attn_impl: str = "auto",
+        remat: bool = True,
+        row_len_multiple: int = 128,
+        max_row_len: Optional[int] = None,
+    ):
+        self.model_cfg = model_cfg
+        self.mesh = mesh if mesh is not None else single_device_mesh()
+        self.attn_impl = attn_impl
+        self.remat = remat
+        self.row_len_multiple = row_len_multiple
+        self.max_row_len = max_row_len
+        self._is_train = optimizer_config is not None
+
+        self.params = shard_params(params, self.mesh)
+        self._batch_sharding = batch_sharding(self.mesh)
+        self._n_row_multiple = int(np.prod(self.mesh.devices.shape[:2]))  # data*fsdp
+
+        self.optimizer = None
+        self.opt_state = None
+        if optimizer_config is not None:
+            self.optimizer = make_optimizer(optimizer_config, total_train_steps)
+            opt_shape = jax.eval_shape(self.optimizer.init, self.params)
+            shardings = opt_state_shardings(opt_shape, self.params, self.mesh)
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=shardings
+            )(self.params)
+        # jit caches keyed by (kind, loss name, row shape, extra)
+        self._jit_cache: Dict[Any, Any] = {}
+        self.version = 0
+
+    # ------------------------------------------------------------------
+    # Batch building
+    # ------------------------------------------------------------------
+
+    def _build_rows(
+        self, sample: SequenceSample, keys: Optional[List[str]] = None
+    ) -> Tuple[PackedBatch, Dict[str, np.ndarray]]:
+        """Pack the main token key into rows; scatter/broadcast other keys."""
+        main_key = sample._main_key()
+        flat_main = sample.data[main_key]
+        lens_per_seq: List[int] = []
+        seqs: List[np.ndarray] = []
+        offset = 0
+        for sl in sample.seqlens[main_key]:
+            for l in sl:
+                seqs.append(np.asarray(flat_main[offset : offset + l]))
+                lens_per_seq.append(l)
+                offset += l
+        batch = pack_sequences(
+            seqs,
+            row_len_multiple=self.row_len_multiple,
+            n_rows_multiple=self._n_row_multiple,
+            max_row_len=self.max_row_len,
+        )
+        rows: Dict[str, np.ndarray] = {
+            "input_ids": batch.input_ids,
+            "segment_ids": batch.segment_ids,
+            "positions": batch.positions,
+        }
+        total_main = sum(lens_per_seq)
+        for k in keys if keys is not None else sample.keys:
+            if k == main_key or sample.data.get(k) is None:
+                continue
+            d = np.asarray(sample.data[k])
+            if d.shape[0] == total_main:
+                # Token-aligned: split per sequence in main-key order.
+                per_seq, off = [], 0
+                for l in lens_per_seq:
+                    per_seq.append(d[off : off + l])
+                    off += l
+                rows[k] = batch.scatter_per_token(per_seq)
+            elif d.shape[0] == len(lens_per_seq):
+                # Per-sequence scalar: broadcast across each span.
+                per_seq = [np.full((l,), d[i]) for i, l in enumerate(lens_per_seq)]
+                rows[k] = batch.scatter_per_token(per_seq)
+            else:
+                raise ValueError(
+                    f"key {k!r} length {d.shape[0]} aligns with neither tokens "
+                    f"({total_main}) nor sequences ({len(lens_per_seq)})"
+                )
+        return batch, rows
+
+    def _device_rows(self, rows: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+        return {
+            k: jax.device_put(np.asarray(v), self._batch_sharding)
+            for k, v in rows.items()
+        }
+
+    # ------------------------------------------------------------------
+    # Train
+    # ------------------------------------------------------------------
+
+    def _grad_step_fn(self, loss_name: str, loss_fn: PackedLossFn, row_keys: Tuple[str, ...]):
+        key = ("grad", loss_name, row_keys)
+        if key not in self._jit_cache:
+
+            def step(params, rows):
+                def compute(p):
+                    logits = model_forward(
+                        p, self.model_cfg,
+                        rows["input_ids"], rows["segment_ids"], rows["positions"],
+                        attn_impl=self.attn_impl, remat=self.remat,
+                    )
+                    loss_sum, aux = loss_fn(logits, rows)
+                    return loss_sum, aux
+
+                (loss_sum, aux), grads = jax.value_and_grad(compute, has_aux=True)(params)
+                return loss_sum, aux, grads
+
+            self._jit_cache[key] = jax.jit(step)
+        return self._jit_cache[key]
+
+    def _accum_fn(self):
+        if "accum" not in self._jit_cache:
+            self._jit_cache["accum"] = jax.jit(
+                lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
+            )
+        return self._jit_cache["accum"]
+
+    def _apply_fn(self):
+        if "apply" not in self._jit_cache:
+
+            def apply(params, opt_state, grads, scale):
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * scale), grads
+                )
+                gnorm = optax_global_norm(grads)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: (p + u.astype(p.dtype)), params, updates
+                )
+                return params, opt_state, gnorm
+
+            self._jit_cache["apply"] = jax.jit(apply, donate_argnums=(0, 1))
+        return self._jit_cache["apply"]
+
+    def train_batch(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        loss_fn: PackedLossFn,
+        loss_weight_fn: Callable[[SequenceSample], float],
+        token_normalize_scope: str = "global",
+        version_steps: int = 0,
+        loss_name: str = "loss",
+    ) -> Dict[str, float]:
+        """Forward+backward over micro-batches, one optimizer step.
+
+        `version_steps` is accepted for TrainEngine API parity but the LR
+        schedule position is tracked by the optimizer's own step count.
+        """
+        assert self.optimizer is not None, "engine built without optimizer"
+        if token_normalize_scope != "global":
+            # Under GSPMD the batch is global by construction; there is no
+            # per-DP-rank loss normalization to implement.
+            raise NotImplementedError(
+                "only token_normalize_scope='global' is meaningful on a "
+                "GSPMD mesh (the reference's 'dp' scope has no TPU analogue)"
+            )
+        mbs, _, _ = input_.split(mb_spec)
+        global_denom = float(sum(loss_weight_fn(mb) for mb in mbs))
+        global_denom = max(global_denom, 1.0)
+
+        grads_acc = None
+        loss_acc = 0.0
+        aux_acc: Dict[str, float] = {}
+        for mb in mbs:
+            batch, rows = self._build_rows(mb)
+            rows_dev = self._device_rows(rows)
+            step = self._grad_step_fn(loss_name, loss_fn, tuple(sorted(rows.keys())))
+            loss_sum, aux, grads = step(self.params, rows_dev)
+            grads_acc = grads if grads_acc is None else self._accum_fn()(grads_acc, grads)
+            loss_acc += float(loss_sum)
+            for k, v in aux.items():
+                aux_acc[k] = aux_acc.get(k, 0.0) + float(v)
+
+        self.params, self.opt_state, gnorm = self._apply_fn()(
+            self.params, self.opt_state, grads_acc,
+            jnp.asarray(1.0 / global_denom, jnp.float32),
+        )
+        stats = {
+            f"{loss_name}/loss": loss_acc / global_denom,
+            f"{loss_name}/grad_norm": float(gnorm),
+            f"{loss_name}/n_tokens": global_denom,
+            f"{loss_name}/n_mbs": float(len(mbs)),
+        }
+        for k, v in aux_acc.items():
+            stats[f"{loss_name}/{k}"] = v / global_denom
+        return stats
+
+    # ------------------------------------------------------------------
+    # Inference
+    # ------------------------------------------------------------------
+
+    def _forward_fn(self, output: str):
+        key = ("fwd", output)
+        if key not in self._jit_cache:
+
+            def fwd(params, rows):
+                logits_or_values = model_forward(
+                    params, self.model_cfg,
+                    rows["input_ids"], rows["segment_ids"], rows["positions"],
+                    attn_impl=self.attn_impl,
+                )
+                if self.model_cfg.is_critic or output == "values":
+                    return logits_or_values  # [R, T]
+                if output == "logprobs":
+                    return next_token_logprobs(
+                        logits_or_values, rows["input_ids"], rows["segment_ids"]
+                    )
+                return logits_or_values
+
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
+    def forward(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        output_key: str = "logprobs",
+        output: Optional[str] = None,
+        post_hook: Optional[Callable] = None,
+    ) -> SequenceSample:
+        """Gradient-free forward; returns a SequenceSample keyed
+        `output_key` with per-token arrays aligned to the main key."""
+        output = output or ("values" if self.model_cfg.is_critic else "logprobs")
+        mbs, _, bwd_indices = input_.split(mb_spec)
+        main_key = input_._main_key()
+        per_mb_flat: List[np.ndarray] = []
+        fn = self._forward_fn(output)
+        for mb in mbs:
+            batch, rows = self._build_rows(mb, keys=[main_key])
+            rows_dev = self._device_rows(rows)
+            out_rows = np.asarray(fn(self.params, rows_dev), np.float32)
+            per_mb_flat.append(batch.gather_flat(out_rows))
+        merged = SequenceSample.reorder_output(
+            np.concatenate(per_mb_flat, axis=0),
+            [mb.seqlens_of() for mb in mbs],
+            bwd_indices,
+        )
+        out = SequenceSample(
+            ids=list(input_.ids),
+            keys={output_key},
+            data={output_key: merged},
+            seqlens={output_key: [list(sl) for sl in input_.seqlens[main_key]]},
+        )
+        if post_hook is not None:
+            out = post_hook(out)
+        return out
+
+    # ------------------------------------------------------------------
+    # Generation
+    # ------------------------------------------------------------------
+
+    def generate(
+        self,
+        input_: SequenceSample,
+        mb_spec: MicroBatchSpec,
+        tokenizer: Any,
+        gconfig: GenerationHyperparameters,
+        rng: Optional[jax.Array] = None,
+    ) -> List[Dict[str, Any]]:
+        """Generate for each prompt (replicated `gconfig.n` times).
+
+        Returns the raw per-sequence dicts; the PPO interface assembles
+        them into a SequenceSample (grouping semantics live there).
+        """
+        main_key = input_._main_key()
+        flat = np.asarray(input_.data[main_key])
+        prompts: List[List[int]] = []
+        offset = 0
+        for sl in input_.seqlens[main_key]:
+            for l in sl:
+                prompts.append(flat[offset : offset + l].astype(np.int32).tolist())
+                offset += l
+        expanded = [p for p in prompts for _ in range(gconfig.n)]
+        rng = rng if rng is not None else jax.random.PRNGKey(self.version)
+        eos = getattr(tokenizer, "eos_token_id", None) if tokenizer is not None else None
+        with jax.sharding.set_mesh(self.mesh):
+            return generate_tokens(
+                self.params, self.model_cfg, expanded, gconfig, rng, eos_token_id=eos
+            )
+
+    # ------------------------------------------------------------------
+    # State
+    # ------------------------------------------------------------------
+
+    def get_params(self):
+        return self.params
+
+    def set_params(self, params):
+        self.params = jax.device_put(params, param_shardings(params, self.mesh))
+
+
+def optax_global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(x.astype(jnp.float32) ** 2) for x in leaves))

@@ -1,0 +1,77 @@
+"""Optimizer construction: AdamW + LR schedules + global-norm clipping.
+
+Counterpart of the reference's Megatron DistributedOptimizer + LR scheduler
+wiring (realhf/impl/model/backend/megatron.py:561-700). ZeRO sharding of
+optimizer state is not code here — it falls out of giving Adam's mu/nu the
+same NamedShardings as their parameters (fsdp/tensor axes), see
+jax_engine.opt_state_shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import optax
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    """Mirrors the reference's OptimizerConfig dataclass (api/cli_args.py)."""
+
+    type: str = "adamw"
+    lr: float = 1e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # constant | linear | cosine
+    warmup_steps_proportion: float = 0.001
+    gradient_clipping: float = 1.0
+
+
+def make_lr_schedule(cfg: OptimizerConfig, total_train_steps: int):
+    warmup = max(1, int(cfg.warmup_steps_proportion * total_train_steps))
+    decay_steps = max(1, total_train_steps - warmup)
+    end = cfg.lr * cfg.min_lr_ratio
+    if cfg.lr_scheduler_type == "constant":
+        after = optax.constant_schedule(cfg.lr)
+    elif cfg.lr_scheduler_type == "linear":
+        after = optax.linear_schedule(cfg.lr, end, decay_steps)
+    elif cfg.lr_scheduler_type == "cosine":
+        after = optax.cosine_decay_schedule(cfg.lr, decay_steps, alpha=cfg.min_lr_ratio)
+    else:
+        raise ValueError(f"unknown lr_scheduler_type {cfg.lr_scheduler_type!r}")
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, cfg.lr, warmup), after], [warmup]
+    )
+
+
+def _decay_mask(params):
+    """No weight decay on 1D params (norms, biases) — standard practice."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)
+
+
+def make_optimizer(
+    cfg: OptimizerConfig, total_train_steps: int, params_example=None
+) -> optax.GradientTransformation:
+    if cfg.type != "adamw":
+        raise NotImplementedError(f"optimizer type {cfg.type!r}")
+    schedule = make_lr_schedule(cfg, total_train_steps)
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.gradient_clipping)
+        if cfg.gradient_clipping
+        else optax.identity(),
+        optax.adamw(
+            learning_rate=schedule,
+            b1=cfg.beta1,
+            b2=cfg.beta2,
+            eps=cfg.eps,
+            weight_decay=cfg.weight_decay,
+            mask=_decay_mask if cfg.weight_decay else None,
+        ),
+    )
+    return tx

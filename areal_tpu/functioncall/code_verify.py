@@ -1,0 +1,75 @@
+"""Local code-correctness verification: run candidate code against IO tests.
+
+Counterpart of the reference's local code verifier
+(functioncall/code/local_verify.py, testing_util.py), from scratch:
+candidate programs are executed in a subprocess with resource limits and
+judged on stdin/stdout test cases. Remote verifier services can be plugged
+behind the same `code_verify` signature later.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+DEFAULT_TIMEOUT = 8.0
+
+
+def extract_code_block(text: str) -> Optional[str]:
+    """Last fenced code block (``` or ```python), else None."""
+    import re
+
+    blocks = re.findall(r"```(?:python|py)?\n(.*?)```", text, re.DOTALL)
+    return blocks[-1] if blocks else None
+
+
+def run_one_case(code: str, stdin_data: str, timeout: float = DEFAULT_TIMEOUT):
+    """Execute code with stdin; returns (ok, stdout, err)."""
+    preamble = (
+        "import resource, sys\n"
+        "resource.setrlimit(resource.RLIMIT_AS, (2 << 30, 2 << 30))\n"
+        "sys.setrecursionlimit(100000)\n"
+    )
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(preamble + code)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path],
+            input=stdin_data,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        return proc.returncode == 0, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired:
+        return False, "", "timeout"
+    finally:
+        import os
+
+        os.unlink(path)
+
+
+def _normalize_output(s: str) -> List[str]:
+    return [line.rstrip() for line in s.rstrip().splitlines()]
+
+
+def code_verify(
+    solution_text: str,
+    test_cases: List[Dict[str, str]],
+    timeout: float = DEFAULT_TIMEOUT,
+) -> bool:
+    """True if the extracted program passes every {input, output} case."""
+    code = extract_code_block(solution_text)
+    if code is None:
+        return False
+    for case in test_cases:
+        ok, out, _ = run_one_case(code, case.get("input", ""), timeout)
+        if not ok:
+            return False
+        if _normalize_output(out) != _normalize_output(case.get("output", "")):
+            return False
+    return True

@@ -1,0 +1,139 @@
+"""Math answer verification: extraction + normalization + equivalence.
+
+Counterpart of the reference's local math grader
+(functioncall/math/function/grader.py, realhf/impl/dataset/math_parser.py)
+built from scratch: extract the final answer (\\boxed{...} or last line),
+normalize LaTeX-ish syntax, then test equivalence by exact string match,
+numeric comparison, and sympy simplification when available.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+
+def extract_boxed(text: str) -> Optional[str]:
+    """Last \\boxed{...} / \\fbox{...} content, brace-aware."""
+    best = None
+    for m in re.finditer(r"\\(?:boxed|fbox)\s*\{", text):
+        start = m.end()
+        depth = 1
+        i = start
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        if depth == 0:
+            best = text[start : i - 1]
+    return best
+
+
+def extract_answer(text: str) -> Optional[str]:
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed
+    # "The answer is X" patterns (commas allowed: "1,000,000"), else the
+    # last number in the text.
+    m = re.findall(
+        r"(?:answer is|answer:)\s*([^\n;]+?)(?:\.\s|\.$|$)", text, re.IGNORECASE
+    )
+    if m:
+        return m[-1].strip()
+    nums = re.findall(r"-?\d+(?:\.\d+)?(?:/\d+)?", text)
+    return nums[-1] if nums else None
+
+
+_LATEX_STRIP = [
+    (r"\\left\s*", ""), (r"\\right\s*", ""), (r"\\!", ""), (r"\\,", ""),
+    (r"\\;", ""), (r"\\:", ""), (r"~", ""), (r"\\\$", ""), (r"\$", ""),
+    (r"\\%", ""), (r"%", ""), (r"\\text\{([^}]*)\}", r"\1"),
+    (r"\\mathrm\{([^}]*)\}", r"\1"), (r"\\mbox\{([^}]*)\}", r"\1"),
+    (r"\\mathbf\{([^}]*)\}", r"\1"), (r"\\operatorname\{([^}]*)\}", r"\1"),
+    (r"\\cdot", "*"), (r"\\times", "*"), (r"\\div", "/"),
+    (r"\\pi", "pi"), (r"\\infty", "oo"), (r"dollars?", ""), (r"degrees?", ""),
+    (r"\\circ", ""), (r"\^\{\\circ\}", ""), (r"\\ ", " "),
+]
+
+
+def normalize_answer(ans: str) -> str:
+    s = ans.strip()
+    for pat, rep in _LATEX_STRIP:
+        s = re.sub(pat, rep, s)
+    # \frac{a}{b} -> (a)/(b); \sqrt{a} -> sqrt(a); x^{y} -> x**(y)
+    for _ in range(4):
+        s = re.sub(r"\\[dt]?frac\{([^{}]*)\}\{([^{}]*)\}", r"((\1)/(\2))", s)
+        s = re.sub(r"\\[dt]?frac(\d)(\d)", r"((\1)/(\2))", s)
+        s = re.sub(r"\\sqrt\{([^{}]*)\}", r"sqrt(\1)", s)
+        s = re.sub(r"\\sqrt(\d)", r"sqrt(\1)", s)
+        s = re.sub(r"\^\{([^{}]*)\}", r"**(\1)", s)
+    s = s.replace("^", "**")
+    s = s.replace("{", "(").replace("}", ")")
+    s = re.sub(r"\\([a-zA-Z]+)", r"\1", s)  # remaining latex commands
+    s = re.sub(r"\s+", "", s)
+    s = s.rstrip(".").lstrip("+")
+    # 1,234 -> 1234 (but keep tuple-like "(1,2)")
+    if "(" not in s and "[" not in s:
+        s = re.sub(r"(\d),(\d)", r"\1\2", s)
+    return s.lower()
+
+
+def _to_number(s: str) -> Optional[float]:
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    m = re.fullmatch(r"\(?\(?(-?\d+(?:\.\d+)?)\)?/\(?(-?\d+(?:\.\d+)?)\)?\)?", s)
+    if m:
+        denom = float(m.group(2))
+        if denom != 0:
+            return float(m.group(1)) / denom
+    return None
+
+
+def _sympy_equal(a: str, b: str) -> bool:
+    try:
+        import sympy
+        from sympy.parsing.sympy_parser import (
+            implicit_multiplication_application,
+            parse_expr,
+            standard_transformations,
+        )
+
+        tf = standard_transformations + (implicit_multiplication_application,)
+        ea = parse_expr(a, transformations=tf, evaluate=True)
+        eb = parse_expr(b, transformations=tf, evaluate=True)
+        return bool(sympy.simplify(ea - eb) == 0)
+    except Exception:
+        return False
+
+
+def answers_equal(given: str, reference: str, tol: float = 1e-6) -> bool:
+    ng, nr = normalize_answer(given), normalize_answer(reference)
+    if not ng and not nr:
+        return True
+    if ng == nr:
+        return True
+    fg, fr = _to_number(ng), _to_number(nr)
+    if fg is not None and fr is not None:
+        return abs(fg - fr) <= tol * max(1.0, abs(fr))
+    # Tuple/set-like answers: compare element-wise.
+    if ("," in ng) and ("," in nr):
+        pg = [p for p in re.split(r"[(),\[\]]", ng) if p]
+        pr = [p for p in re.split(r"[(),\[\]]", nr) if p]
+        if len(pg) == len(pr):
+            return all(answers_equal(x, y, tol) for x, y in zip(pg, pr))
+    return _sympy_equal(ng, nr)
+
+
+def grade_answer(solution_text: str, reference_answer: str) -> bool:
+    """True if the final answer in `solution_text` matches the reference."""
+    ans = extract_answer(solution_text)
+    if ans is None:
+        return False
+    refs: List[str] = (
+        [reference_answer] if isinstance(reference_answer, str) else list(reference_answer)
+    )
+    return any(answers_equal(ans, r) for r in refs)

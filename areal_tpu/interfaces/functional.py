@@ -1,0 +1,203 @@
+"""PPO functional suite: decoupled loss, rewards, KL controllers, value norm.
+
+Counterpart of realhf/impl/model/utils/ppo_functional.py. All loss math is
+jit-able over packed [R, T] rows; controllers/value-norm keep small host
+state (mirroring the reference's semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# KL controllers (reference: ppo_functional.py:14-48)
+# ---------------------------------------------------------------------------
+
+
+class FixedKLController:
+
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current_kl: float, n_steps: int):
+        pass
+
+
+class AdaptiveKLController:
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: float):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current_kl: float, n_steps: int):
+        error = np.clip(current_kl / self.target - 1, -0.2, 0.2)
+        self.value *= 1 + error * n_steps / self.horizon
+
+
+# ---------------------------------------------------------------------------
+# Rewards (reference: ppo_functional.get_packed_rewards:229)
+# ---------------------------------------------------------------------------
+
+
+def packed_rewards(
+    kl_coef: float,
+    clip_reward_value: float,
+    score: jnp.ndarray,  # [R, T]: task reward broadcast per token (used at seq end)
+    logprobs: jnp.ndarray,  # [R, T] behavior logprobs (shifted frame)
+    ref_logprobs: jnp.ndarray,  # [R, T]
+    response_mask: jnp.ndarray,  # [R, T] 1.0 on response-token positions (shifted)
+    last_response_mask: jnp.ndarray,  # [R, T] 1.0 only at the final response position
+    mask_no_eos_with_zero: bool = False,
+    no_eos_mask: Optional[jnp.ndarray] = None,  # [R, T] 1 where seq had no EOS
+) -> jnp.ndarray:
+    """Token-level rewards: -kl_coef * (logp - ref_logp) everywhere on the
+    response, plus the clipped task score at the final response token."""
+    kl = (logprobs - ref_logprobs) * response_mask
+    rewards = -kl_coef * kl
+    tail = jnp.clip(score, -clip_reward_value, clip_reward_value)
+    if mask_no_eos_with_zero and no_eos_mask is not None:
+        tail = jnp.where(no_eos_mask > 0, 0.0, tail)
+    rewards = rewards + tail * last_response_mask
+    return rewards
+
+
+# ---------------------------------------------------------------------------
+# Actor loss (reference: ppo_functional.actor_loss_fn:51-150)
+# ---------------------------------------------------------------------------
+
+
+def actor_loss_fn(
+    logprobs: jnp.ndarray,  # [R, T] current policy
+    old_logprobs: jnp.ndarray,  # [R, T] behavior policy (from generation)
+    advantages: jnp.ndarray,  # [R, T]
+    eps_clip: float,
+    loss_mask: jnp.ndarray,  # [R, T]
+    c_clip: Optional[float] = None,
+    proximal_logprobs: Optional[jnp.ndarray] = None,
+    behav_imp_weight_cap: Optional[float] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Decoupled-PPO clipped surrogate (sum over masked tokens).
+
+    With `proximal_logprobs` (the recomputed policy at training time), the
+    clipping center is the proximal policy and the behavior correction
+    exp(prox - old) multiplies the loss, optionally capped — the decoupled
+    objective that keeps stale rollouts usable (AReaL blog v0.3 staleness
+    ablation). Without it, plain PPO (prox == old). Dual-clip via c_clip.
+    """
+    mask = loss_mask.astype(jnp.float32)
+    denom_prox = proximal_logprobs if proximal_logprobs is not None else old_logprobs
+    ratio = jnp.exp((logprobs - denom_prox) * (mask > 0))
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
+    adv = advantages.astype(jnp.float32)
+    surr1 = ratio * adv
+    surr2 = clipped_ratio * adv
+    loss = -jnp.minimum(surr1, surr2)
+    clip_mask = surr1 > surr2  # where clipping binds
+    if c_clip is not None:
+        # Dual clip: bound the loss for very negative advantages.
+        surr3 = c_clip * adv
+        dual_mask = (adv < 0) & (surr3 > jnp.minimum(surr1, surr2))
+        loss = jnp.where(dual_mask, -surr3, loss)
+    else:
+        dual_mask = jnp.zeros_like(clip_mask)
+    if proximal_logprobs is not None:
+        behav_w = jnp.exp((denom_prox - old_logprobs) * (mask > 0))
+        if behav_imp_weight_cap is not None:
+            # Tokens whose behavior weight exceeds the cap are dropped.
+            keep = behav_w <= behav_imp_weight_cap
+            mask = mask * keep.astype(jnp.float32)
+        loss = loss * behav_w
+    loss_sum = jnp.sum(loss * mask)
+    stats = {
+        "importance_weight": jnp.sum(ratio * mask),
+        "clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * mask),
+        "dual_clip_ratio": jnp.sum(dual_mask.astype(jnp.float32) * mask),
+        "actor_denom": jnp.sum(mask),
+    }
+    return loss_sum, stats
+
+
+# ---------------------------------------------------------------------------
+# Critic loss (reference: ppo_functional.critic_loss_fn)
+# ---------------------------------------------------------------------------
+
+
+def critic_loss_fn(
+    value: jnp.ndarray,  # [R, T]
+    old_value: jnp.ndarray,  # [R, T]
+    target_value: jnp.ndarray,  # [R, T] returns
+    value_eps_clip: float,
+    loss_mask: jnp.ndarray,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Clipped value loss (sum over masked tokens)."""
+    mask = loss_mask.astype(jnp.float32)
+    value = value.astype(jnp.float32)
+    clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    l1 = (value - target_value) ** 2
+    l2 = (clipped - target_value) ** 2
+    loss = 0.5 * jnp.maximum(l1, l2)
+    clip_mask = l2 > l1
+    return jnp.sum(loss * mask), {
+        "value_clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * mask),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Value normalization (reference: impl/model/modules/value_norm)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RunningMeanStd:
+    """EMA running statistics used to normalize critic targets."""
+
+    beta: float = 0.99995
+    epsilon: float = 1e-5
+    mean: float = 0.0
+    mean_sq: float = 0.0
+    debiasing_term: float = 0.0
+
+    def update(self, x: np.ndarray, mask: Optional[np.ndarray] = None):
+        x = np.asarray(x, np.float64)
+        if mask is not None:
+            m = np.asarray(mask, bool)
+            if m.sum() == 0:
+                return
+            x = x[m]
+        batch_mean = float(x.mean())
+        batch_sq = float((x**2).mean())
+        self.mean = self.beta * self.mean + (1 - self.beta) * batch_mean
+        self.mean_sq = self.beta * self.mean_sq + (1 - self.beta) * batch_sq
+        self.debiasing_term = self.beta * self.debiasing_term + (1 - self.beta)
+
+    @property
+    def debiased_mean(self) -> float:
+        return self.mean / max(self.debiasing_term, self.epsilon)
+
+    @property
+    def debiased_std(self) -> float:
+        mean = self.debiased_mean
+        var = self.mean_sq / max(self.debiasing_term, self.epsilon) - mean**2
+        return float(np.sqrt(max(var, self.epsilon)))
+
+    def normalize(self, x):
+        return (np.asarray(x, np.float32) - self.debiased_mean) / self.debiased_std
+
+    def denormalize(self, x):
+        return np.asarray(x, np.float32) * self.debiased_std + self.debiased_mean
+
+    def state_dict(self):
+        return dataclasses.asdict(self)
+
+    def load_state_dict(self, d):
+        for k, v in d.items():
+            setattr(self, k, v)

@@ -1,0 +1,454 @@
+"""PPO actor/critic algorithm interfaces.
+
+Counterpart of realhf/impl/model/interface/ppo_interface.py
+(PPOActorInterface:210, PPOCriticInterface:984): generate -> rollout
+sample assembly; inference -> proximal/ref logprob recompute; train_step ->
+rewards (KL penalty + clipped task score) -> GAE -> advantage
+normalization (global or per-group GRPO-style) -> minibatched decoupled-PPO
+updates through the engine.
+
+Data-layout conventions (all token-aligned keys live in the *shifted*
+frame used by next_token_logprobs: position t scores token t+1):
+- packed_input_ids: prompt + response tokens, grouped per prompt id
+- prompt_mask: 1 on prompt token positions
+- packed_logprobs: behavior logprobs from generation
+- logprobs: proximal logprobs recomputed at train time (decoupled PPO)
+- ref_logprobs: reference-model logprobs
+- values: critic values (absent in group-reward / GRPO mode)
+- rewards: per-sequence task scores; seq_no_eos_mask: per-sequence
+- version_start / version_end: per-sequence weight versions (staleness)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    GenerationHyperparameters,
+    Model,
+    ModelInterface,
+    register_interface,
+)
+from areal_tpu.base import logging as areal_logging
+from areal_tpu.base import stats_tracker
+from areal_tpu.interfaces import functional as F
+from areal_tpu.ops.gae import gae_rows
+from areal_tpu.ops.loss import masked_normalization
+
+logger = areal_logging.getLogger("ppo")
+
+
+def response_scoring_mask(segment_ids, prompt_mask):
+    """[R, T] 1.0 where position t scores a response token (t+1)."""
+    seg = segment_ids
+    next_seg = jnp.concatenate([seg[:, 1:], jnp.zeros_like(seg[:, :1])], axis=1)
+    next_pm = jnp.concatenate(
+        [prompt_mask[:, 1:], jnp.ones_like(prompt_mask[:, :1])], axis=1
+    )
+    return ((next_seg == seg) & (seg > 0) & (next_pm == 0)).astype(jnp.float32)
+
+
+def last_response_position_mask(resp_mask):
+    """[R, T] 1.0 at the final scoring position of each segment."""
+    nxt = jnp.concatenate([resp_mask[:, 1:], jnp.zeros_like(resp_mask[:, :1])], axis=1)
+    return resp_mask * (1.0 - nxt)
+
+
+@dataclasses.dataclass
+class PPOActorInterface(ModelInterface):
+    n_minibatches: int = 4
+    eps_clip: float = 0.2
+    c_clip: Optional[float] = None
+    kl_ctl: float = 0.1
+    adaptive_kl_ctl: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 20.0
+    reward_output_scaling: float = 1.0
+    reward_output_bias: float = 0.0
+    adv_norm: bool = True
+    group_adv_norm: bool = False
+    mask_no_eos_with_zero: bool = False
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: Optional[float] = None
+    temperature: float = 1.0
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+
+    def __post_init__(self):
+        if self.adaptive_kl_ctl:
+            self.kl_controller = F.AdaptiveKLController(
+                self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon
+            )
+        else:
+            self.kl_controller = F.FixedKLController(self.kl_ctl)
+
+    # ------------------------------------------------------------------
+    # Generate (sync PPO path; async uses the rollout workers instead)
+    # ------------------------------------------------------------------
+
+    def generate(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        engine = model.module
+        outs = engine.generate(input_, mb_spec, model.tokenizer, self.gconfig)
+        n = self.gconfig.n
+        prompt_key = "packed_prompts" if "packed_prompts" in input_.keys else input_._main_key()
+        flat_prompts = np.asarray(input_.data[prompt_key])
+        plens = [sum(sl) for sl in input_.seqlens[prompt_key]]
+        offsets = np.concatenate([[0], np.cumsum(plens)])
+
+        seqs, pmask, blogp, no_eos = [], [], [], []
+        group_lens: List[List[int]] = []
+        for pi in range(input_.bs):
+            prompt = flat_prompts[offsets[pi] : offsets[pi + 1]].astype(np.int64)
+            lens = []
+            for gi in range(n):
+                o = outs[pi * n + gi]
+                out_ids = np.asarray(o["output_ids"], np.int64)
+                full = np.concatenate([prompt, out_ids])
+                lens.append(len(full))
+                seqs.append(full)
+                pm = np.zeros(len(full), np.int64)
+                pm[: len(prompt)] = 1
+                pmask.append(pm)
+                # Shifted frame: gen token i (abs pos len(prompt)+i) is
+                # scored at abs pos len(prompt)+i-1.
+                lp = np.zeros(len(full), np.float32)
+                lp[len(prompt) - 1 : len(full) - 1] = o["output_logprobs"]
+                blogp.append(lp)
+                no_eos.append(1.0 if o["no_eos"] else 0.0)
+            group_lens.append(lens)
+
+        n_seqs_per_prompt = [[1] * n for _ in range(input_.bs)]
+        res = SequenceSample(
+            ids=list(input_.ids),
+            keys={
+                "packed_input_ids", "prompt_mask", "packed_logprobs",
+                "seq_no_eos_mask",
+            },
+            data={
+                "packed_input_ids": np.concatenate(seqs),
+                "prompt_mask": np.concatenate(pmask),
+                "packed_logprobs": np.concatenate(blogp),
+                "seq_no_eos_mask": np.asarray(no_eos, np.float32),
+            },
+            seqlens={
+                "packed_input_ids": group_lens,
+                "prompt_mask": group_lens,
+                "packed_logprobs": group_lens,
+                "seq_no_eos_mask": n_seqs_per_prompt,
+            },
+            metadata={
+                "version_start": [model.version] * input_.bs,
+                "version_end": [model.version] * input_.bs,
+            },
+        )
+        return res
+
+    # ------------------------------------------------------------------
+    # Inference: recompute logprobs under the current (proximal) policy
+    # ------------------------------------------------------------------
+
+    def inference(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        engine = model.module
+        return engine.forward(input_, mb_spec, output_key="logprobs")
+
+    # ------------------------------------------------------------------
+    # Train
+    # ------------------------------------------------------------------
+
+    def _prep_fn(self, engine):
+        if not hasattr(self, "_jit_prep"):
+
+            def prep(rows, kl_coef):
+                resp_mask = response_scoring_mask(
+                    rows["segment_ids"], rows["prompt_mask"]
+                )
+                last_mask = last_response_position_mask(resp_mask)
+                values = rows.get("values")
+                has_critic = values is not None
+                if values is None:
+                    values = jnp.zeros_like(resp_mask)
+                no_eos = rows["seq_no_eos_mask"]
+                rewards = F.packed_rewards(
+                    kl_coef=kl_coef,
+                    clip_reward_value=self.max_reward_clip,
+                    score=rows["rewards"] * self.reward_output_scaling
+                    + self.reward_output_bias,
+                    logprobs=rows["packed_logprobs"],
+                    ref_logprobs=rows.get("ref_logprobs", jnp.zeros_like(resp_mask)),
+                    response_mask=resp_mask,
+                    last_response_mask=last_mask,
+                    mask_no_eos_with_zero=self.mask_no_eos_with_zero,
+                    no_eos_mask=no_eos,
+                )
+                # GAE runs over the *scoring* region only: restricting the
+                # segment ids to scoring positions makes each segment end at
+                # its last scoring position, which is exactly where the
+                # bootstrap value V(s_T) must enter the recursion for
+                # truncated (no-EOS) sequences.
+                score_seg = rows["segment_ids"] * resp_mask.astype(
+                    rows["segment_ids"].dtype
+                )
+                bootstrap = (
+                    values * last_mask * no_eos
+                    if has_critic
+                    else jnp.zeros_like(resp_mask)
+                )
+                masked_values = values * resp_mask
+                adv, ret = gae_rows(
+                    rewards * resp_mask,
+                    masked_values,
+                    score_seg,
+                    bootstrap,
+                    gamma=self.discount,
+                    lam=self.gae_lambda,
+                )
+                adv = adv * resp_mask
+                ret = ret * resp_mask
+                kl_sum = jnp.sum(
+                    (rows["packed_logprobs"] - rows.get(
+                        "ref_logprobs", jnp.zeros_like(resp_mask))) * resp_mask
+                )
+                if self.adv_norm and not self.group_adv_norm:
+                    adv = masked_normalization(adv, resp_mask)
+                return adv, ret, resp_mask, kl_sum
+
+            self._jit_prep = jax.jit(prep)
+        return self._jit_prep
+
+    def train_step(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict:
+        engine = model.module
+        kl_coef = self.kl_controller.value
+
+        # 1) Whole-batch advantage computation on device.
+        batch, rows = engine._build_rows(input_)
+        rows_dev = engine._device_rows(rows)
+        adv_rows, ret_rows, resp_rows, kl_sum = self._prep_fn(engine)(
+            rows_dev, jnp.asarray(kl_coef, jnp.float32)
+        )
+        adv_flat = batch.gather_flat(np.asarray(adv_rows))
+        ret_flat = batch.gather_flat(np.asarray(ret_rows))
+        resp_flat = batch.gather_flat(np.asarray(resp_rows))
+
+        # 2) Optional group normalization (GRPO): per prompt-group over
+        #    response positions.
+        if self.adv_norm and self.group_adv_norm:
+            adv_flat = adv_flat.copy()
+            offset = 0
+            for sl in input_.seqlens["packed_input_ids"]:
+                glen = sum(sl)
+                idx = np.arange(offset, offset + glen)[resp_flat[offset : offset + glen] > 0]
+                if idx.size > 1:
+                    vals = adv_flat[idx]
+                    adv_flat[idx] = (vals - vals.mean()) / (vals.std() + 1e-5)
+                offset += glen
+        train_sample = input_
+        train_sample.update_(
+            SequenceSample(
+                ids=list(input_.ids),
+                keys={"advantages"},
+                data={"advantages": adv_flat.astype(np.float32)},
+                seqlens={
+                    "advantages": [list(sl) for sl in input_.seqlens["packed_input_ids"]]
+                },
+            )
+        )
+
+        # 3) Minibatched PPO updates.
+        mb_inputs, *_ = train_sample.split(
+            MicroBatchSpec(n_mbs=self.n_minibatches)
+        )
+        use_decoupled = self.use_decoupled_loss and "logprobs" in train_sample.keys
+
+        def actor_loss(logits, rows):
+            from areal_tpu.ops.loss import next_token_logprobs
+
+            lp = next_token_logprobs(logits, rows["input_ids"], rows["segment_ids"])
+            mask = response_scoring_mask(rows["segment_ids"], rows["prompt_mask"])
+            prox = rows["logprobs"] if use_decoupled else None
+            loss_sum, st = F.actor_loss_fn(
+                logprobs=lp,
+                old_logprobs=rows["packed_logprobs"],
+                advantages=rows["advantages"],
+                eps_clip=self.eps_clip,
+                loss_mask=mask,
+                c_clip=self.c_clip,
+                proximal_logprobs=prox,
+                behav_imp_weight_cap=self.behav_imp_weight_cap if use_decoupled else None,
+            )
+            # Approx KL(new || behavior) for monitoring.
+            st["approx_kl"] = jnp.sum((rows["packed_logprobs"] - lp) * mask)
+            return loss_sum, st
+
+        def weight_fn(mb):
+            return _n_response_tokens(mb)
+
+        all_stats = []
+        for mb in mb_inputs:
+            st = engine.train_batch(
+                mb, MicroBatchSpec(n_mbs=1, max_tokens_per_mb=mb_spec.max_tokens_per_mb),
+                loss_fn=actor_loss, loss_weight_fn=weight_fn,
+                version_steps=model.version, loss_name="ppo_actor",
+            )
+            all_stats.append(st)
+        model.inc_version()
+
+        n_resp = float(np.sum(resp_flat))
+        mean_kl = float(kl_sum) / max(n_resp, 1.0)
+        self.kl_controller.update(mean_kl, int(n_resp))
+
+        agg = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
+        agg.update(
+            {
+                "ppo_actor/kl": mean_kl,
+                "ppo_actor/kl_coef": kl_coef,
+                "ppo_actor/adv_mean": float(
+                    np.sum(adv_flat * resp_flat) / max(n_resp, 1.0)
+                ),
+                "ppo_actor/ret_mean": float(
+                    np.sum(ret_flat * resp_flat) / max(n_resp, 1.0)
+                ),
+                "ppo_actor/reward_mean": float(np.mean(input_.data["rewards"]))
+                if input_.data.get("rewards") is not None else 0.0,
+                "ppo_actor/n_tokens": float(batch.total_tokens),
+            }
+        )
+        # Staleness accounting (reference: ppo_interface.py:752-762).
+        vs = input_.metadata.get("version_start")
+        ve = input_.metadata.get("version_end")
+        if vs:
+            agg["ppo_actor/head_offpolicyness"] = float(model.version - 1 - np.min(vs))
+            agg["ppo_actor/tail_offpolicyness"] = float(model.version - 1 - np.max(ve))
+        stats_tracker.scalar(**agg)
+        return agg
+
+    def save(self, model: Model, save_dir: str):
+        from areal_tpu.interfaces.sft import SFTInterface
+
+        SFTInterface.save(self, model, save_dir)  # same HF export path
+
+
+def _n_response_tokens(mb: SequenceSample) -> float:
+    pm = np.asarray(mb.data["prompt_mask"])
+    total, offset = 0, 0
+    for sl in mb.seqlens["prompt_mask"]:
+        for l in sl:
+            total += int(np.sum(pm[offset + 1 : offset + l] == 0))
+            offset += l
+    return float(total)
+
+
+@dataclasses.dataclass
+class PPOCriticInterface(ModelInterface):
+    n_minibatches: int = 4
+    value_eps_clip: float = 0.2
+    kl_ctl: float = 0.1
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    max_reward_clip: float = 20.0
+    reward_output_scaling: float = 1.0
+    reward_output_bias: float = 0.0
+    value_norm: bool = True
+    mask_no_eos_with_zero: bool = False
+
+    def __post_init__(self):
+        self.rms = F.RunningMeanStd()
+        # Returns must be computed with the SAME reward transform as the
+        # actor's advantages; the helper is cached so its jitted prep
+        # program survives across train steps.
+        self._helper = PPOActorInterface(
+            discount=self.discount, gae_lambda=self.gae_lambda,
+            kl_ctl=self.kl_ctl, max_reward_clip=self.max_reward_clip,
+            reward_output_scaling=self.reward_output_scaling,
+            reward_output_bias=self.reward_output_bias,
+            adv_norm=False, mask_no_eos_with_zero=self.mask_no_eos_with_zero,
+        )
+
+    def inference(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        engine = model.module
+        out = engine.forward(input_, mb_spec, output_key="values", output="values")
+        if self.value_norm:
+            out.data["values"] = self.rms.denormalize(out.data["values"])
+        return out
+
+    def train_step(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> Dict:
+        engine = model.module
+        # Returns are recomputed exactly like the actor does.
+        batch, rows = engine._build_rows(input_)
+        rows_dev = engine._device_rows(rows)
+        _, ret_rows, resp_rows, _ = self._helper._prep_fn(engine)(
+            rows_dev, jnp.asarray(self.kl_ctl, jnp.float32)
+        )
+        ret_flat = batch.gather_flat(np.asarray(ret_rows))
+        resp_flat = batch.gather_flat(np.asarray(resp_rows))
+        if self.value_norm:
+            self.rms.update(ret_flat, mask=resp_flat > 0)
+            norm_ret = np.where(resp_flat > 0, self.rms.normalize(ret_flat), 0.0)
+            old_values = np.where(
+                resp_flat > 0,
+                self.rms.normalize(np.asarray(input_.data["values"])),
+                0.0,
+            )
+        else:
+            norm_ret = ret_flat
+            old_values = np.asarray(input_.data["values"])
+
+        sl = [list(s) for s in input_.seqlens["packed_input_ids"]]
+        input_.update_(
+            SequenceSample(
+                ids=list(input_.ids), keys={"returns", "old_values_norm"},
+                data={
+                    "returns": norm_ret.astype(np.float32),
+                    "old_values_norm": old_values.astype(np.float32),
+                },
+                seqlens={"returns": sl, "old_values_norm": sl},
+            )
+        )
+
+        def critic_loss(values, rows):
+            mask = response_scoring_mask(rows["segment_ids"], rows["prompt_mask"])
+            loss_sum, st = F.critic_loss_fn(
+                value=values,
+                old_value=rows["old_values_norm"],
+                target_value=rows["returns"],
+                value_eps_clip=self.value_eps_clip,
+                loss_mask=mask,
+            )
+            return loss_sum, st
+
+        mb_inputs, *_ = input_.split(MicroBatchSpec(n_mbs=self.n_minibatches))
+        all_stats = []
+        for mb in mb_inputs:
+            st = engine.train_batch(
+                mb, MicroBatchSpec(n_mbs=1, max_tokens_per_mb=mb_spec.max_tokens_per_mb),
+                loss_fn=critic_loss, loss_weight_fn=_n_response_tokens,
+                version_steps=model.version, loss_name="ppo_critic",
+            )
+            all_stats.append(st)
+        model.inc_version()
+        agg = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
+        stats_tracker.scalar(**agg)
+        return agg
+
+
+register_interface("ppo_actor", PPOActorInterface)
+register_interface("ppo_critic", PPOCriticInterface)

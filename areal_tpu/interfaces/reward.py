@@ -1,0 +1,101 @@
+"""Verifier-based reward interface (math + code).
+
+Counterpart of realhf/impl/model/interface/math_rw_interface.py
+(MultiTaskRewardInterface:518): decodes generated sequences, dispatches
+each to the math grader or code verifier by task tag, and emits per-
+sequence rewards (+5 / -5 by default, matching the reference's convention).
+Runs on the host — no model forward needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List
+
+import numpy as np
+
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import Model, ModelInterface, register_interface
+from areal_tpu.base import logging as areal_logging
+from areal_tpu.functioncall.code_verify import code_verify
+from areal_tpu.functioncall.math_grader import grade_answer
+
+logger = areal_logging.getLogger("reward")
+
+
+@dataclasses.dataclass
+class MultiTaskRewardInterface(ModelInterface):
+    correct_reward: float = 5.0
+    wrong_reward: float = -5.0
+    max_workers: int = 8
+    check_verifier_status: bool = False
+
+    def _verify_one(self, task: str, text: str, answer_info: Any) -> bool:
+        if task == "code":
+            cases = answer_info
+            if isinstance(cases, str):
+                cases = json.loads(cases)
+            return code_verify(text, cases)
+        return grade_answer(text, answer_info)
+
+    def inference(
+        self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
+    ) -> SequenceSample:
+        tokenizer = model.tokenizer
+        flat = np.asarray(input_.data["packed_input_ids"])
+        pm = np.asarray(input_.data.get("prompt_mask")) if "prompt_mask" in input_.keys else None
+
+        texts: List[str] = []
+        offset = 0
+        seq_prompt_ids: List[int] = []  # prompt index per sequence
+        for pi, sl in enumerate(input_.seqlens["packed_input_ids"]):
+            for l in sl:
+                ids = flat[offset : offset + l]
+                if pm is not None:
+                    ids = ids[pm[offset : offset + l] == 0]  # response only
+                texts.append(tokenizer.decode(ids.tolist()))
+                seq_prompt_ids.append(pi)
+                offset += l
+
+        answers = input_.metadata.get("solutions") or input_.metadata.get("answers")
+        tasks = input_.metadata.get("tasks") or ["math"] * input_.bs
+        if answers is None:
+            raise ValueError("reward interface needs 'solutions'/'answers' metadata")
+
+        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            oks = list(
+                ex.map(
+                    lambda args: self._verify_one(*args),
+                    [
+                        (tasks[pi], texts[si], answers[pi])
+                        for si, pi in enumerate(seq_prompt_ids)
+                    ],
+                )
+            )
+        rewards = np.where(
+            np.asarray(oks), self.correct_reward, self.wrong_reward
+        ).astype(np.float32)
+
+        n_per_prompt = [len(sl) for sl in input_.seqlens["packed_input_ids"]]
+        out = SequenceSample(
+            ids=list(input_.ids),
+            keys={"rewards"},
+            data={"rewards": rewards},
+            seqlens={"rewards": [[1] * n for n in n_per_prompt]},
+            metadata={
+                "scores": [
+                    float(
+                        np.mean(
+                            [ok for si, ok in zip(seq_prompt_ids, oks) if si == pi]
+                        )
+                    )
+                    for pi in range(input_.bs)
+                ]
+            },
+        )
+        return out
+
+
+register_interface("rw-math-code", MultiTaskRewardInterface)

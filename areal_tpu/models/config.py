@@ -25,7 +25,7 @@ class MoEConfig:
     first_k_dense: int = 0
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # eq=False keeps it hashable (by id) for jit static args
 class TransformerConfig:
     n_layers: int = 2
     hidden_dim: int = 64
@@ -44,6 +44,9 @@ class TransformerConfig:
     rotary_base: float = 10000.0
     rotary_scaling: Optional[float] = None
     rotary_scaling_type: Optional[str] = None  # linear | llama3 | None
+    # Extra factors for llama3-style scaling (low/high_freq_factor,
+    # original_max_position_embeddings), carried from the HF config.
+    rotary_scaling_params: Optional[dict] = None
     rotary_interleaved: bool = False
 
     attn_bias: bool = False  # qwen2 uses qkv bias

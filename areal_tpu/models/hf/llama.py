@@ -14,6 +14,11 @@ from areal_tpu.models.config import TransformerConfig
 def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
     head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
     rope_scaling = hf.get("rope_scaling") or {}
+    rope_type = rope_scaling.get("rope_type") or rope_scaling.get("type")
+    if rope_type not in (None, "default", "linear", "llama3"):
+        raise NotImplementedError(
+            f"rope scaling type {rope_type!r} from HF config is not supported yet"
+        )
     return TransformerConfig(
         n_layers=hf["num_hidden_layers"],
         hidden_dim=hf["hidden_size"],
@@ -29,7 +34,8 @@ def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerC
         norm_eps=hf.get("rms_norm_eps", 1e-6),
         rotary_base=hf.get("rope_theta", 10000.0),
         rotary_scaling=rope_scaling.get("factor"),
-        rotary_scaling_type=rope_scaling.get("rope_type") or rope_scaling.get("type"),
+        rotary_scaling_type=rope_type,
+        rotary_scaling_params=dict(rope_scaling) or None,
         attn_bias=bool(hf.get("attention_bias", False)),
         tied_embeddings=bool(hf.get("tie_word_embeddings", False)),
         is_critic=is_critic,

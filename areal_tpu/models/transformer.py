@@ -201,7 +201,8 @@ def forward(
 
     inv_freq = jnp.asarray(
         rotary_inv_freq(
-            cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling, cfg.rotary_scaling_type
+            cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling,
+            cfg.rotary_scaling_type, cfg.rotary_scaling_params,
         )
     )
     cos, sin = rotary_cos_sin(positions, inv_freq)  # [R, T, hd/2]

@@ -1,0 +1,66 @@
+"""Generalized Advantage Estimation over packed rows.
+
+Replaces the reference's cugae CUDA kernels (csrc/cugae/gae.cu:
+gae_1d_nolp_misalign et al.) and their Python fallback
+(realhf/impl/model/utils/ppo_functional.py:292-324) with a reverse
+`lax.scan` over the time axis, vectorized across rows — the natural TPU
+formulation: one fused scan instead of a hand-written kernel, segment
+boundaries handled by resetting the carry.
+
+Inputs are [R, T] row-packed (multiple sequences per row, segment ids,
+0 = padding). Bootstrapping for truncated (no-EOS) sequences is expressed
+by placing V(s_T) in `bootstrap` at each sequence's final token.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gae_rows(
+    rewards: jnp.ndarray,  # [R, T] per-token rewards
+    values: jnp.ndarray,  # [R, T] V(s_t)
+    segment_ids: jnp.ndarray,  # [R, T]
+    bootstrap: jnp.ndarray,  # [R, T] V(s_{T+1}) at final tokens of truncated seqs, else 0
+    gamma: float = 1.0,
+    lam: float = 1.0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (advantages, returns), both [R, T], zero outside segments.
+
+    delta_t = r_t + gamma * V(s_{t+1}) - V(s_t), with V(s_{t+1}) = the next
+    token's value within the same segment, the bootstrap value at segment
+    ends, 0 otherwise. A_t = delta_t + gamma*lam*A_{t+1} (same-segment).
+    """
+    R, T = rewards.shape
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    bootstrap = bootstrap.astype(jnp.float32)
+
+    def step(carry, xs):
+        adv_next, v_next, seg_next = carry
+        r_t, v_t, seg_t, boot_t = xs  # each [R]
+        valid = seg_t > 0
+        same = (seg_t == seg_next) & valid
+        v_tp1 = jnp.where(same, v_next, boot_t)
+        delta = r_t + gamma * v_tp1 - v_t
+        adv = delta + gamma * lam * jnp.where(same, adv_next, 0.0)
+        adv = jnp.where(valid, adv, 0.0)
+        return (adv, v_t, seg_t), adv
+
+    xs = (rewards.T, values.T, segment_ids.T, bootstrap.T)  # scan over T
+    init = (
+        jnp.zeros((R,), jnp.float32),
+        jnp.zeros((R,), jnp.float32),
+        jnp.zeros((R,), jnp.int32),
+    )
+    _, advs = jax.lax.scan(step, init, xs, reverse=True)
+    advantages = advs.T
+    returns = advantages + values
+    valid = segment_ids > 0
+    return (
+        jnp.where(valid, advantages, 0.0),
+        jnp.where(valid, returns, 0.0),
+    )

@@ -14,19 +14,32 @@ import jax.numpy as jnp
 import numpy as np
 
 
+SUPPORTED_ROPE_TYPES = (None, "default", "linear", "llama3")
+
+
 def rotary_inv_freq(
     head_dim: int,
     base: float = 10000.0,
     scaling: Optional[float] = None,
     scaling_type: Optional[str] = None,
+    scaling_params: Optional[dict] = None,
 ) -> np.ndarray:
+    if scaling_type not in SUPPORTED_ROPE_TYPES:
+        raise NotImplementedError(
+            f"rope scaling type {scaling_type!r} not supported "
+            f"(supported: {SUPPORTED_ROPE_TYPES})"
+        )
     inv_freq = 1.0 / (base ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     if scaling_type == "linear" and scaling:
         inv_freq = inv_freq / scaling
     elif scaling_type == "llama3" and scaling:
         # llama3-style NTK frequency interpolation: low frequencies scaled,
-        # high frequencies kept, smooth ramp between.
-        low_freq_factor, high_freq_factor, orig_ctx = 1.0, 4.0, 8192
+        # high frequencies kept, smooth ramp between. Factors come from the
+        # checkpoint's rope_scaling config.
+        p = scaling_params or {}
+        low_freq_factor = p.get("low_freq_factor", 1.0)
+        high_freq_factor = p.get("high_freq_factor", 4.0)
+        orig_ctx = p.get("original_max_position_embeddings", 8192)
         wavelen = 2 * np.pi / inv_freq
         low_wl = orig_ctx / low_freq_factor
         high_wl = orig_ctx / high_freq_factor

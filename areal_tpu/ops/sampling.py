@@ -1,0 +1,70 @@
+"""On-device token sampling: temperature, top-k, top-p, min-new-tokens.
+
+Counterpart of the reference's genstep + logits warpers
+(realhf/impl/model/nn/real_llm_generate.py:30-148, utils/logits_warper.py),
+without the TP gather / broadcast dance: under GSPMD the logits arrive
+already global, and sampling runs on device inside the jitted decode step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_top_k(logits: jnp.ndarray, top_k: int) -> jnp.ndarray:
+    """Mask all but the k highest logits. top_k <= 0 disables."""
+    if top_k <= 0:
+        return logits
+    v = logits.shape[-1]
+    k = min(top_k, v)
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, top_p) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set with cumulative prob >= p.
+
+    `top_p` may be a traced scalar; the op is branchless (p >= 1 keeps all)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens where the cumulative prob *before* them is < p.
+    keep_sorted = (cum - probs) < top_p
+    cutoff_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    return jnp.where(logits < cutoff, NEG_INF, logits)
+
+
+def sample_token(
+    logits: jnp.ndarray,  # [B, V] fp32
+    rng: jax.Array,
+    greedy: bool = False,
+    temperature: float = 1.0,
+    top_k: int = -1,
+    top_p: float = 1.0,
+    forbid_token_ids: Optional[jnp.ndarray] = None,  # e.g. EOS under min_new_tokens
+    forbid_mask: Optional[jnp.ndarray] = None,  # [B] rows where forbid applies
+):
+    """Returns (tokens [B], logprobs [B]) — logprob is of the *unwarped*
+    distribution (what PPO needs), sampling uses the warped one."""
+    logits = logits.astype(jnp.float32)
+    if forbid_token_ids is not None and forbid_token_ids.size:
+        penalty = jnp.zeros_like(logits).at[:, forbid_token_ids].set(NEG_INF)
+        if forbid_mask is not None:
+            penalty = penalty * forbid_mask[:, None].astype(jnp.float32)
+        logits = logits + penalty
+    base_logp = jax.nn.log_softmax(logits, axis=-1)
+    if greedy:
+        tokens = jnp.argmax(logits, axis=-1)
+    else:
+        warped = logits / jnp.maximum(temperature, 1e-6)
+        warped = apply_top_k(warped, top_k)
+        warped = apply_top_p(warped, top_p)
+        tokens = jax.random.categorical(rng, warped, axis=-1)
+    logprobs = jnp.take_along_axis(base_logp, tokens[:, None], axis=-1)[:, 0]
+    return tokens, logprobs

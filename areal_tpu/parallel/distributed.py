@@ -1,0 +1,67 @@
+"""Multi-host runtime initialization over the name_resolve rendezvous.
+
+Counterpart of the reference's NCCL global-comm setup
+(realhf/impl/model/comm/global_comm.py:48-163, torch.distributed TCP
+rendezvous): on TPU the collective fabric is managed by the JAX runtime,
+so "setting up comm" reduces to electing a coordinator through
+name_resolve and calling `jax.distributed.initialize` on every host of a
+partition. ICI collectives then happen inside jitted programs; DCN traffic
+(weight sync, trajectories) stays on the host side (ZMQ / shared FS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+from areal_tpu.base import logging as areal_logging
+from areal_tpu.base import name_resolve, names, network
+
+logger = areal_logging.getLogger("distributed")
+
+
+@dataclasses.dataclass
+class HostGroupInfo:
+    """What a host process knows after joining its partition's group."""
+
+    coordinator_address: str
+    process_id: int
+    num_processes: int
+
+
+def setup_host_group(
+    experiment_name: str,
+    trial_name: str,
+    group_name: str,
+    host_rank: int,
+    n_hosts: int,
+    timeout: float = 300.0,
+) -> HostGroupInfo:
+    """Elect a coordinator via name_resolve and initialize jax.distributed.
+
+    Single-host (n_hosts == 1) is a no-op besides returning the info —
+    jax.distributed is not required, and local meshes work as-is.
+    """
+    if n_hosts == 1:
+        return HostGroupInfo("localhost", 0, 1)
+
+    key = names.distributed_coordinator(experiment_name, trial_name) + f"/{group_name}"
+    if host_rank == 0:
+        addr = f"{network.gethostip()}:{network.find_free_port()}"
+        name_resolve.add(key, addr, keepalive_ttl=timeout, replace=True)
+    else:
+        addr = name_resolve.wait(key, timeout=timeout)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=n_hosts,
+        process_id=host_rank,
+    )
+    logger.info(
+        "joined host group %s as %d/%d (coordinator %s)",
+        group_name, host_rank, n_hosts, addr,
+    )
+    return HostGroupInfo(addr, host_rank, n_hosts)

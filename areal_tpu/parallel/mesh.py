@@ -1,0 +1,107 @@
+"""Device mesh construction and device-partition allocation.
+
+TPU-native replacement for the reference's process-topology + NCCL-group
+machinery (realhf/base/topology.py grids, realhf/impl/model/comm/
+global_comm.py): parallelism is expressed as a `jax.sharding.Mesh` with
+axes (data, fsdp, seq, tensor) and GSPMD inserts the collectives. Device
+*partitions* (disjoint sets of chips for generation vs training, the
+reference's `sglang.dXpYmZ+dApBmC` decoupled allocation) are contiguous
+slices of the device list, each carrying its own mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from areal_tpu.base.topology import MeshSpec
+
+MESH_AXES = ("data", "fsdp", "seq", "tensor")
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, fsdp, seq, tensor) mesh from a MeshSpec.
+
+    Axis order puts `tensor` innermost so tensor-parallel collectives ride
+    the fastest ICI links, matching megatron convention.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    if spec.size != len(devices):
+        raise ValueError(
+            f"mesh spec {spec} needs {spec.size} devices, got {len(devices)}"
+        )
+    arr = np.array(devices).reshape(spec.data, spec.fsdp, spec.seq, spec.tensor)
+    return Mesh(arr, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    d = device or jax.devices()[0]
+    return Mesh(np.array([d]).reshape(1, 1, 1, 1), MESH_AXES)
+
+
+@dataclasses.dataclass
+class DevicePartition:
+    """A named slice of the global device list with its mesh spec."""
+
+    name: str
+    device_ids: List[int]  # indices into jax.devices()
+    mesh_spec: MeshSpec
+
+    def devices(self) -> List[jax.Device]:
+        all_devices = jax.devices()
+        return [all_devices[i] for i in self.device_ids]
+
+    def make_mesh(self) -> Mesh:
+        return make_mesh(self.mesh_spec, self.devices())
+
+
+@dataclasses.dataclass
+class AllocationMode:
+    """Parsed allocation DSL (counterpart of the reference's
+    `sglang.d4m1+d2m2`-style strings, realhf/experiments/common/utils.py:289).
+
+    Forms:
+    - "d2t4"             : one shared partition for everything (sync/global hybrid)
+    - "gen.d4t1+d2t2"    : decoupled: first 4 devices generation, next 4 training
+    """
+
+    gen_spec: Optional[MeshSpec]
+    train_spec: MeshSpec
+    decoupled: bool
+
+    @classmethod
+    def parse(cls, s: str) -> "AllocationMode":
+        s = s.strip()
+        if "+" in s:
+            gen_part, train_part = s.split("+", 1)
+            if "." in gen_part:
+                prefix, gen_part = gen_part.split(".", 1)
+                if prefix not in ("gen", "sglang", "jax"):
+                    raise ValueError(f"unknown allocation prefix {prefix!r} in {s!r}")
+            return cls(
+                gen_spec=MeshSpec.parse(gen_part),
+                train_spec=MeshSpec.parse(train_part),
+                decoupled=True,
+            )
+        return cls(gen_spec=None, train_spec=MeshSpec.parse(s), decoupled=False)
+
+    def partitions(self, n_devices: Optional[int] = None) -> Dict[str, DevicePartition]:
+        n = n_devices if n_devices is not None else len(jax.devices())
+        need = self.train_spec.size + (self.gen_spec.size if self.decoupled else 0)
+        if need > n:
+            raise ValueError(f"allocation needs {need} devices, have {n}")
+        out: Dict[str, DevicePartition] = {}
+        cursor = 0
+        if self.decoupled:
+            out["gen"] = DevicePartition(
+                "gen", list(range(cursor, cursor + self.gen_spec.size)), self.gen_spec
+            )
+            cursor += self.gen_spec.size
+        out["train"] = DevicePartition(
+            "train", list(range(cursor, cursor + self.train_spec.size)), self.train_spec
+        )
+        return out

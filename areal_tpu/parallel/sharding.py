@@ -1,0 +1,120 @@
+"""GSPMD partition rules: megatron-equivalent shardings by annotation.
+
+Replaces the reference's hand-written tensor/sequence-parallel modules
+(realhf/impl/model/parallelism/tensor_parallel/modules.py — Column/Row
+parallel linears, parallel embedding, vocab-parallel CE) with
+`PartitionSpec`s over the (data, fsdp, seq, tensor) mesh:
+
+- attention qkv projections: column-parallel  -> output dim on `tensor`
+- attention output proj:     row-parallel     -> input dim on `tensor`
+- MLP gate/up:               column-parallel; down: row-parallel
+- embedding + LM head:       vocab on `tensor` (vocab-parallel CE falls out
+  of the sharded logits + psum XLA inserts for logsumexp)
+- every weight's other big dim on `fsdp` (ZeRO-3-style param sharding);
+  optimizer state inherits these specs (ZeRO-1/2)
+- activations: rows on (data, fsdp), sequence dim on `seq` (context
+  parallelism; megatron-SP's activation sharding falls out here too)
+
+The reference's parameter-flattening + interval scatter/gather machinery
+(flatten_param.py, csrc/interval_op) has no TPU counterpart by design:
+resharding is `jax.device_put` between NamedShardings (see realloc.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def param_partition_spec(path: str, ndim: int) -> P:
+    """PartitionSpec for one parameter, by pytree path.
+
+    Layer-stacked params have a leading L axis (never sharded). Biases and
+    norms are small: replicated.
+    """
+    name = path.split("/")[-1]
+    if "embedding" in path:
+        return P("tensor", "fsdp")  # [V, D]
+    if path.startswith("head") or "/head/" in path or path == "head/weight":
+        return P("fsdp", "tensor")  # [D, V] or [D, 1]
+    if name in ("wq", "wk", "wv", "w_gate", "w_up", "w_in"):
+        return P(None, "fsdp", "tensor")  # [L, D, out]: column parallel
+    if name in ("wo", "w_down", "w_out"):
+        return P(None, "tensor", "fsdp")  # [L, in, D]: row parallel
+    if name in ("bq", "bk", "bv", "b_gate", "b_up", "b_in"):
+        return P(None, "tensor")  # [L, out]
+    # norms, small biases (b_down/b_out [L, D]), q_norm/k_norm: replicated.
+    return P(*([None] * ndim))
+
+
+def _axis_size(mesh: Mesh, entry) -> int:
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, tuple) else (entry,)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+def fit_spec_to_shape(spec: P, shape, mesh: Mesh) -> P:
+    """Drop sharded axes a dimension cannot honor (not divisible by the
+    mesh-axis size — e.g. the critic head's [D, 1] output dim)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    fitted = []
+    for dim, entry in zip(shape, entries):
+        fitted.append(entry if dim % _axis_size(mesh, entry) == 0 else None)
+    return P(*fitted)
+
+
+def param_shardings(params: Params, mesh: Mesh) -> Params:
+    """Pytree of NamedShardings matching `params`' structure."""
+
+    def one(path, leaf):
+        spec = param_partition_spec(_path_str(path), leaf.ndim)
+        return NamedSharding(mesh, fit_spec_to_shape(spec, leaf.shape, mesh))
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(params: Params, mesh: Mesh) -> Params:
+    """Place a host pytree onto the mesh with megatron-equivalent sharding."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[R, T] token rows: rows over (data, fsdp), sequence over seq."""
+    return NamedSharding(mesh, P(("data", "fsdp"), "seq"))
+
+
+def activation_constraint(x, mesh: Mesh):
+    """Constrain [R, T, D] activations inside jit."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("data", "fsdp"), "seq", None))
+    )
+
+
+def logits_constraint(x, mesh: Mesh):
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("data", "fsdp"), "seq", "tensor"))
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -5,18 +5,20 @@ the TPU way: a single process with 8 virtual CPU devices so every sharding
 path (data/fsdp/tensor/seq mesh axes) exercises real XLA collectives
 without TPU hardware.
 
-Must run before jax initializes its backends, hence the env mutation at
-import time.
+Note: this environment's sitecustomize imports jax at interpreter startup
+(JAX_PLATFORMS=axon), so env vars alone don't stick — but backends are
+lazily initialized, so `jax.config.update` before first device use wins.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import uuid
 

@@ -1,0 +1,64 @@
+"""Math grader tests (mirrors reference tests/reward/test_math_reward.py)."""
+
+import pytest
+
+from areal_tpu.functioncall.math_grader import (
+    answers_equal,
+    extract_answer,
+    extract_boxed,
+    grade_answer,
+    normalize_answer,
+)
+
+
+def test_extract_boxed_nested():
+    assert extract_boxed(r"so \boxed{\frac{1}{2}} is it") == r"\frac{1}{2}"
+    assert extract_boxed(r"a \boxed{1} then \boxed{2}") == "2"
+    assert extract_boxed("no box") is None
+
+
+def test_extract_answer_fallbacks():
+    assert extract_answer("The answer is 42.") == "42"
+    assert extract_answer("blah 3 then 7 end") == "7"
+    assert extract_answer("") is None
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        ("42", "42"),
+        (r"\frac{1}{2}", "0.5"),
+        (r"\frac{1}{2}", "1/2"),
+        ("1,234", "1234"),
+        (r"2\pi", "2pi"),
+        (r"\sqrt{2}", "sqrt(2)"),
+        ("0.50", "1/2"),
+        (r"\text{east}", "east"),
+        ("(1, 2)", "(1,2)"),
+        ("-1/3", r"-\frac{1}{3}"),
+    ],
+)
+def test_answers_equal(a, b):
+    assert answers_equal(a, b)
+
+
+@pytest.mark.parametrize("a,b", [("42", "43"), ("1/2", "1/3"), ("x+1", "x+2")])
+def test_answers_not_equal(a, b):
+    assert not answers_equal(a, b)
+
+
+def test_sympy_equivalence():
+    assert answers_equal("2*(x+1)", "2x+2")
+    assert answers_equal(r"\frac{x^2-1}{x-1}", "x+1")
+
+
+def test_grade_answer_end_to_end():
+    sol = r"We compute ... therefore the result is $\boxed{\dfrac{3}{4}}$."
+    assert grade_answer(sol, "0.75")
+    assert grade_answer(sol, "3/4")
+    assert not grade_answer(sol, "0.8")
+    assert not grade_answer("no final answer here", "5") or True  # must not crash
+
+
+def test_grade_multiple_refs():
+    assert grade_answer(r"\boxed{2}", ["1", "2"])

@@ -1,0 +1,154 @@
+"""PPO interface integration: generate -> reward -> inference -> train_step
+on a tiny model (counterpart of reference tests/experiments/test_math_ppo.py
+algorithm core, without the worker system)."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import ModelName
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import GenerationHyperparameters, Model
+from areal_tpu.engine.jax_engine import JaxTrainEngine
+from areal_tpu.engine.optimizer import OptimizerConfig
+from areal_tpu.interfaces.ppo import PPOActorInterface, PPOCriticInterface
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import init_params
+
+
+def small_cfg(**kw):
+    return TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        intermediate_dim=64, vocab_size=64, compute_dtype="float32", **kw,
+    )
+
+
+def make_actor(lr=1e-3):
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = JaxTrainEngine(
+        cfg, params,
+        optimizer_config=OptimizerConfig(lr=lr, warmup_steps_proportion=0.0),
+        total_train_steps=100, row_len_multiple=32,
+    )
+    return Model(name=ModelName("actor"), module=eng, tokenizer=None)
+
+
+def make_prompts(n=4, seed=0):
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(3, 8, size=n).tolist()
+    return SequenceSample.from_default(
+        ids=[f"p{i}" for i in range(n)],
+        seqlens=lens,
+        data={"packed_prompts": rng.randint(1, 64, size=sum(lens))},
+    )
+
+
+@pytest.fixture(scope="module")
+def rollout():
+    model = make_actor()
+    itf = PPOActorInterface(
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=10, greedy=False),
+        n_minibatches=2, adv_norm=True,
+    )
+    prompts = make_prompts()
+    sample = itf.generate(model, prompts, MicroBatchSpec())
+    return model, itf, prompts, sample
+
+
+def test_generate_builds_grouped_sample(rollout):
+    model, itf, prompts, sample = rollout
+    assert sample.bs == prompts.bs
+    assert all(len(sl) == 2 for sl in sample.seqlens["packed_input_ids"])
+    total = sample.total_seqlen("packed_input_ids")
+    assert sample.data["packed_input_ids"].shape[0] == total
+    assert sample.data["prompt_mask"].shape[0] == total
+    # Behavior logprobs: zero on prompts (except final prompt position).
+    pm = sample.data["prompt_mask"]
+    lp = sample.data["packed_logprobs"]
+    offset = 0
+    for sl in sample.seqlens["packed_input_ids"]:
+        for l in sl:
+            seq_pm = pm[offset : offset + l]
+            seq_lp = lp[offset : offset + l]
+            plen = int(seq_pm.sum())
+            assert (seq_lp[: plen - 1] == 0).all()
+            assert (seq_lp[plen - 1 : l - 1] != 0).any() or l - plen <= 1
+            offset += l
+    assert sample.data["seq_no_eos_mask"].shape[0] == prompts.bs * 2
+
+
+def _attach_rewards_and_logps(model, sample, with_critic=False, seed=1):
+    rng = np.random.RandomState(seed)
+    n_seqs = sum(len(sl) for sl in sample.seqlens["packed_input_ids"])
+    sl_tok = [list(s) for s in sample.seqlens["packed_input_ids"]]
+    sl_seq = [[1] * len(s) for s in sample.seqlens["packed_input_ids"]]
+    total = sample.total_seqlen("packed_input_ids")
+    add = SequenceSample(
+        ids=list(sample.ids),
+        keys={"rewards", "ref_logprobs"},
+        data={
+            "rewards": rng.choice([5.0, -5.0], size=n_seqs).astype(np.float32),
+            "ref_logprobs": (sample.data["packed_logprobs"]
+                             + 0.01 * rng.randn(total)).astype(np.float32),
+        },
+        seqlens={"rewards": sl_seq, "ref_logprobs": sl_tok},
+    )
+    sample.update_(add)
+    if with_critic:
+        vals = rng.randn(total).astype(np.float32) * 0.1
+        sample.update_(SequenceSample(
+            ids=list(sample.ids), keys={"values"},
+            data={"values": vals}, seqlens={"values": sl_tok},
+        ))
+
+
+def test_train_step_grpo_mode(rollout):
+    model, itf, prompts, sample = rollout
+    sample = SequenceSample.gather([sample])  # copy-ish
+    _attach_rewards_and_logps(model, sample)
+    v0 = model.version
+    stats = itf.train_step(model, sample, MicroBatchSpec())
+    assert model.version == v0 + 1
+    assert np.isfinite(stats["ppo_actor/loss"])
+    assert np.isfinite(stats["ppo_actor/kl"])
+    assert stats["ppo_actor/n_tokens"] > 0
+    assert "ppo_actor/head_offpolicyness" in stats
+
+
+def test_train_step_decoupled_with_critic(rollout):
+    model, _, prompts, sample0 = rollout
+    itf = PPOActorInterface(
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=10),
+        n_minibatches=2, use_decoupled_loss=True, behav_imp_weight_cap=10.0,
+        group_adv_norm=True,
+    )
+    sample = SequenceSample.gather([sample0])
+    _attach_rewards_and_logps(model, sample, with_critic=True, seed=3)
+    # Proximal logprobs from the current policy (actor inference MFC).
+    prox = itf.inference(model, sample, MicroBatchSpec())
+    sample.update_(prox)
+    stats = itf.train_step(model, sample, MicroBatchSpec())
+    assert np.isfinite(stats["ppo_actor/loss"])
+    assert stats["ppo_actor/importance_weight"] > 0
+
+
+def test_critic_interface_roundtrip(rollout):
+    model_actor, _, prompts, sample0 = rollout
+    ccfg = small_cfg(is_critic=True)
+    cparams = init_params(ccfg, jax.random.PRNGKey(9))
+    ceng = JaxTrainEngine(
+        ccfg, cparams,
+        optimizer_config=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        total_train_steps=100, row_len_multiple=32,
+    )
+    cmodel = Model(name=ModelName("critic"), module=ceng, tokenizer=None)
+    citf = PPOCriticInterface(n_minibatches=2)
+
+    sample = SequenceSample.gather([sample0])
+    vals = citf.inference(cmodel, sample, MicroBatchSpec())
+    assert vals.keys == {"values"}
+    sample.update_(vals)
+    _attach_rewards_and_logps(cmodel, sample, seed=5)
+    stats = citf.train_step(cmodel, sample, MicroBatchSpec())
+    assert np.isfinite(stats["ppo_critic/loss"])

@@ -1,0 +1,103 @@
+"""Generation loop tests: HF greedy parity, logprob self-consistency, EOS."""
+
+import jax
+import numpy as np
+import pytest
+
+import areal_tpu.models.hf  # noqa: F401
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.models.generation import generate_tokens
+from areal_tpu.models.hf import get_family, torch_state_dict_to_numpy
+from areal_tpu.models.packing import pack_sequences
+from areal_tpu.models.transformer import forward
+from areal_tpu.ops.loss import next_token_logprobs
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    torch.manual_seed(0)
+    hf_model = transformers.Qwen2ForCausalLM(
+        transformers.Qwen2Config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512, tie_word_embeddings=False,
+        )
+    ).eval()
+    fam = get_family("qwen2")
+    cfg = fam.config_from_hf(hf_model.config.to_dict(), False)
+    cfg.compute_dtype = "float32"
+    params = fam.params_from_hf(
+        torch_state_dict_to_numpy(hf_model.state_dict()), cfg
+    )
+    return hf_model, cfg, params
+
+
+def test_greedy_matches_hf(tiny_model):
+    hf_model, cfg, params = tiny_model
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 128, size=l).tolist() for l in [5, 9, 3]]
+    g = GenerationHyperparameters(max_new_tokens=16, greedy=True)
+    outs = generate_tokens(params, cfg, prompts, g, jax.random.PRNGKey(0))
+    for p, o in zip(prompts, outs):
+        with torch.no_grad():
+            hf_out = hf_model.generate(
+                torch.tensor([p]), max_new_tokens=16, do_sample=False,
+                eos_token_id=None, pad_token_id=0,
+            )[0, len(p):].tolist()
+        assert o["output_ids"] == hf_out, (o["output_ids"], hf_out)
+        assert o["no_eos"]  # nothing stopped it
+
+
+def test_sampled_logprobs_consistent_with_forward(tiny_model):
+    _, cfg, params = tiny_model
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 128, size=7).tolist() for _ in range(2)]
+    g = GenerationHyperparameters(max_new_tokens=12, greedy=False, temperature=1.0)
+    outs = generate_tokens(params, cfg, prompts, g, jax.random.PRNGKey(7))
+    for p, o in zip(prompts, outs):
+        full = np.array(p + o["output_ids"], np.int32)
+        b = pack_sequences([full], row_len_multiple=64)
+        logits = forward(params, cfg, b.input_ids, b.segment_ids, b.positions,
+                         attn_impl="reference")
+        lp = np.asarray(next_token_logprobs(
+            logits, b.input_ids, b.segment_ids))
+        span = b.spans[0]
+        # logprob at position t scores token t+1: generated token i sits at
+        # position len(p)+i, scored at len(p)+i-1.
+        recomputed = lp[span.row, span.start + len(p) - 1 :
+                        span.start + len(full) - 1]
+        np.testing.assert_allclose(
+            recomputed, np.array(o["output_logprobs"]), atol=1e-3, rtol=1e-3
+        )
+
+
+def test_eos_stops_generation(tiny_model):
+    _, cfg, params = tiny_model
+    prompt = list(range(6))
+    g = GenerationHyperparameters(max_new_tokens=24, greedy=True)
+    free = generate_tokens(params, cfg, [prompt], g, jax.random.PRNGKey(0))[0]
+    assert len(free["output_ids"]) == 24
+    stop_tok = free["output_ids"][9]
+    stop_idx = free["output_ids"].index(stop_tok)  # first occurrence
+    outs = generate_tokens(
+        params, cfg, [prompt], g, jax.random.PRNGKey(0), eos_token_id=stop_tok
+    )[0]
+    assert outs["output_ids"] == free["output_ids"][: stop_idx + 1]
+    assert not outs["no_eos"]
+
+
+def test_min_new_tokens_forbids_eos(tiny_model):
+    _, cfg, params = tiny_model
+    prompt = list(range(6))
+    g = GenerationHyperparameters(max_new_tokens=24, greedy=True)
+    free = generate_tokens(params, cfg, [prompt], g, jax.random.PRNGKey(0))[0]
+    stop_tok = free["output_ids"][3]
+    g2 = GenerationHyperparameters(max_new_tokens=24, greedy=True, min_new_tokens=10)
+    outs = generate_tokens(
+        params, cfg, [prompt], g2, jax.random.PRNGKey(0), eos_token_id=stop_tok
+    )[0]
+    assert len(outs["output_ids"]) >= 10
+    assert stop_tok not in outs["output_ids"][:10]

@@ -1,0 +1,127 @@
+"""Mesh/sharding tests on the 8-device virtual CPU platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.packing import pack_sequences
+from areal_tpu.models.transformer import forward, init_params
+from areal_tpu.parallel.mesh import AllocationMode, make_mesh
+from areal_tpu.parallel.realloc import (
+    gc_param_versions,
+    latest_param_version,
+    load_param_version,
+    reshard_params,
+    save_param_version,
+)
+from areal_tpu.parallel.sharding import (
+    batch_sharding,
+    param_partition_spec,
+    param_shardings,
+    shard_params,
+)
+
+
+def small_cfg():
+    return TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+    )
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_partition_specs():
+    assert param_partition_spec("embedding/weight", 2) == P("tensor", "fsdp")
+    assert param_partition_spec("layers/attn/wq", 3) == P(None, "fsdp", "tensor")
+    assert param_partition_spec("layers/attn/wo", 3) == P(None, "tensor", "fsdp")
+    assert param_partition_spec("layers/mlp/w_down", 3) == P(None, "tensor", "fsdp")
+    assert param_partition_spec("layers/ln1/weight", 2) == P(None, None)
+    assert param_partition_spec("head/weight", 2) == P("fsdp", "tensor")
+
+
+@pytest.mark.parametrize("spec_str", ["d2t4", "d2f2t2", "d8", "t8", "d2f2s2t1"])
+def test_sharded_forward_matches_single_device(spec_str):
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    seqs = [rng.randint(0, 64, size=l) for l in [12, 20, 9, 17]]
+    batch = pack_sequences(seqs, row_len=32, n_rows_multiple=8)
+
+    ref = forward(params, cfg, batch.input_ids, batch.segment_ids, batch.positions,
+                  attn_impl="reference")
+
+    mesh = make_mesh(MeshSpec.parse(spec_str))
+    sharded = shard_params(params, mesh)
+    bsh = batch_sharding(mesh)
+    args = [jax.device_put(x, bsh) for x in
+            (batch.input_ids, batch.segment_ids, batch.positions)]
+
+    @jax.jit
+    def f(p, i, s, pos):
+        return forward(p, cfg, i, s, pos, attn_impl="reference")
+
+    with jax.sharding.set_mesh(mesh):
+        out = f(sharded, *args)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_reshard_between_meshes():
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    mesh_a = make_mesh(MeshSpec.parse("d4t2"))
+    mesh_b = make_mesh(MeshSpec.parse("t8"))
+    pa = shard_params(params, mesh_a)
+    pb = reshard_params(pa, mesh_b)
+    for x, y in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_allocation_mode_partitions():
+    am = AllocationMode.parse("gen.d4t1+d2t2")
+    assert am.decoupled
+    parts = am.partitions(8)
+    assert parts["gen"].device_ids == [0, 1, 2, 3]
+    assert parts["train"].device_ids == [4, 5, 6, 7]
+    am2 = AllocationMode.parse("d4t2")
+    assert not am2.decoupled
+    assert am2.partitions(8)["train"].mesh_spec.size == 8
+    with pytest.raises(ValueError):
+        AllocationMode.parse("gen.d8t1+d8t1").partitions(8)
+
+
+def test_param_version_roundtrip(tmp_path):
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    root = str(tmp_path / "realloc")
+    save_param_version(params, root, 0)
+    save_param_version(params, root, 1, meta={"step": 10})
+    assert latest_param_version(root) == 1
+    loaded = load_param_version(root, 1)
+    for a, b in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    save_param_version(params, root, 2)
+    gc_param_versions(root, keep_latest=1)
+    assert latest_param_version(root) == 2
+    assert load_param_version(root, 2) is not None
+    with pytest.raises(FileNotFoundError):
+        load_param_version(root, 0)
+
+
+def test_critic_head_fits_tensor_mesh():
+    # [D, 1] head cannot shard its size-1 dim over tensor; spec must degrade.
+    from areal_tpu.parallel.sharding import fit_spec_to_shape
+    mesh = make_mesh(MeshSpec.parse("d2t4"))
+    fitted = fit_spec_to_shape(P("fsdp", "tensor"), (32, 1), mesh)
+    assert fitted == P("fsdp", None)
+    cfg = small_cfg()
+    cfg.is_critic = True
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    sharded = shard_params(params, mesh)  # must not raise
+    assert sharded["head"]["weight"].shape == (32, 1)
