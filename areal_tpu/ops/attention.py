@@ -89,7 +89,7 @@ def packed_attention(q, k, v, segment_ids, positions, softmax_scale=None, impl="
     T = q.shape[0]
     if impl == "auto":
         on_tpu = jax.default_backend() in ("tpu", "axon")
-        impl = "flash" if (on_tpu and T >= 512 and T % 512 == 0) else "reference"
+        impl = "flash" if (on_tpu and T >= 128 and T % 128 == 0) else "reference"
     if impl == "flash":
         from areal_tpu.ops.pallas.flash_attn import flash_packed_attention
 

@@ -1,0 +1,134 @@
+"""Benchmark: training throughput per chip on the flagship architecture.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: achieved model TFLOP/s per chip for the full training step
+(fwd + bwd + sharded optimizer) on a Qwen2.5-style packed-varlen model in
+bfloat16. FLOPs are computed analytically from the model dims (the
+reference does the same for its TFLOP/s logs — realhf/base/monitor.py:288
+llama formulas, realhf/system/flops_counter.py).
+
+vs_baseline: ratio against 198 TFLOP/s/GPU — the reference's efficiency
+class on its H800 benchmark hardware (~40% MFU of H800 dense bf16
+~495 TFLOP/s; its headline runs are throughput-bound on exactly this
+train path, benchmark/verl_v0_3_0_post1_76084d3/README.md). >1.0 means a
+chip running this framework outruns an H800 running the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TFLOPS = 198.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def train_step_flops(cfg, n_params: int, seqlens) -> float:
+    """Analytic fwd+bwd FLOPs for a packed batch (llama-formula style:
+    6*N per token for matmuls, plus causal attention score/context terms)."""
+    total = 0.0
+    q_dim = cfg.n_q_heads * cfg.head_dim
+    for l in seqlens:
+        total += 6.0 * n_params * l
+        # QK^T + AV: 2 * (2 * l^2 * q_dim) * 0.5 (causal) per layer, x3 for bwd.
+        total += 6.0 * cfg.n_layers * q_dim * float(l) * l
+    return total
+
+
+def main():
+    import jax
+
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.engine.jax_engine import JaxTrainEngine
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import count_params, init_params
+    from areal_tpu.ops.loss import sft_loss
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    log(f"bench: platform={platform} n_devices={len(jax.devices())}")
+
+    if on_tpu:
+        # ~0.5B-class Qwen2.5-style model, 2k packed context, bf16.
+        cfg = TransformerConfig(
+            n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
+            intermediate_dim=4864, vocab_size=32768, attn_bias=True,
+            compute_dtype="bfloat16",
+        )
+        seqlen, n_seqs, n_warmup, n_steps = 2048, 16, 2, 5
+    else:
+        # CPU smoke mode so dev runs terminate quickly.
+        cfg = TransformerConfig(
+            n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
+            intermediate_dim=128, vocab_size=256, compute_dtype="float32",
+        )
+        seqlen, n_seqs, n_warmup, n_steps = 128, 4, 1, 2
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    log(f"bench: n_params={n_params/1e6:.1f}M")
+
+    eng = JaxTrainEngine(
+        cfg, params,
+        optimizer_config=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        total_train_steps=1000, row_len_multiple=seqlen, max_row_len=seqlen,
+    )
+
+    rng = np.random.RandomState(0)
+    seqlens = [seqlen] * n_seqs
+    total = sum(seqlens)
+    batch = SequenceSample.from_default(
+        ids=[f"b{i}" for i in range(n_seqs)],
+        seqlens=seqlens,
+        data={
+            "packed_input_ids": rng.randint(0, cfg.vocab_size, size=total),
+            "loss_mask": np.ones(total, np.float32),
+        },
+    )
+
+    def packed_loss(logits, rows):
+        tot, n = sft_loss(logits, rows["input_ids"], rows["segment_ids"], rows["loss_mask"])
+        return tot, {}
+
+    def weight(mb):
+        return float(np.sum(mb.data["loss_mask"]))
+
+    def one_step(i):
+        return eng.train_batch(batch, MicroBatchSpec(n_mbs=1), packed_loss, weight,
+                               version_steps=i, loss_name="bench")
+
+    for i in range(n_warmup):
+        t = time.perf_counter()
+        one_step(i)
+        log(f"bench: warmup step {i} {time.perf_counter() - t:.2f}s")
+
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        one_step(n_warmup + i)
+    jax.block_until_ready(eng.params)
+    dt = (time.perf_counter() - t0) / n_steps
+
+    flops = train_step_flops(cfg, n_params, seqlens)
+    tflops = flops / dt / 1e12
+    tokens_per_sec = total / dt
+    log(f"bench: {dt:.3f}s/step {tokens_per_sec:.0f} tok/s {tflops:.1f} TFLOP/s")
+
+    print(json.dumps({
+        "metric": "train_tflops_per_chip",
+        "value": round(tflops, 2),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
