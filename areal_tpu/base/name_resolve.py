@@ -410,8 +410,8 @@ def wait(name, timeout=None, poll_frequency=0.1):
     return _default.repo.wait(name, timeout=timeout, poll_frequency=poll_frequency)
 
 
-def watch_names(names, call_back, poll_frequency=5.0):
-    return _default.repo.watch_names(names, call_back, poll_frequency)
+def watch_names(names, call_back, poll_frequency=5.0, grace_period=300.0):
+    return _default.repo.watch_names(names, call_back, poll_frequency, grace_period)
 
 
 def reset():

@@ -94,8 +94,12 @@ class JaxTrainEngine(TrainEngine):
         remat: bool = True,
         row_len_multiple: int = 128,
         max_row_len: Optional[int] = None,
+        hf_family: Optional[str] = None,
     ):
         self.model_cfg = model_cfg
+        # HF model family ("qwen2", "llama", ...) used by interface.save
+        # to pick the weight-export mapping; None = not HF-exportable.
+        self.hf_family = hf_family
         self.mesh = mesh if mesh is not None else single_device_mesh()
         self.attn_impl = attn_impl
         self.remat = remat
@@ -119,6 +123,7 @@ class JaxTrainEngine(TrainEngine):
         # jit caches keyed by (kind, loss name, row shape, extra)
         self._jit_cache: Dict[Any, Any] = {}
         self.version = 0
+        self._gen_calls = 0
 
     # ------------------------------------------------------------------
     # Batch building
@@ -366,7 +371,10 @@ class JaxTrainEngine(TrainEngine):
                 prompts.append(flat[offset : offset + l].astype(np.int32).tolist())
                 offset += l
         expanded = [p for p in prompts for _ in range(gconfig.n)]
-        rng = rng if rng is not None else jax.random.PRNGKey(self.version)
+        # Default RNG: fold in a per-call counter so repeated generate
+        # calls draw independent sampling streams.
+        self._gen_calls += 1
+        rng = rng if rng is not None else jax.random.PRNGKey(self._gen_calls)
         eos = getattr(tokenizer, "eos_token_id", None) if tokenizer is not None else None
         with jax.sharding.set_mesh(self.mesh):
             return generate_tokens(

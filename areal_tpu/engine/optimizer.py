@@ -32,7 +32,7 @@ class OptimizerConfig:
 
 
 def make_lr_schedule(cfg: OptimizerConfig, total_train_steps: int):
-    warmup = max(1, int(cfg.warmup_steps_proportion * total_train_steps))
+    warmup = int(cfg.warmup_steps_proportion * total_train_steps)
     decay_steps = max(1, total_train_steps - warmup)
     end = cfg.lr * cfg.min_lr_ratio
     if cfg.lr_scheduler_type == "constant":
@@ -43,8 +43,11 @@ def make_lr_schedule(cfg: OptimizerConfig, total_train_steps: int):
         after = optax.cosine_decay_schedule(cfg.lr, decay_steps, alpha=cfg.min_lr_ratio)
     else:
         raise ValueError(f"unknown lr_scheduler_type {cfg.lr_scheduler_type!r}")
+    if warmup == 0:
+        return after
+    # Ramp starts at lr/warmup (not 0) so the very first step trains.
     return optax.join_schedules(
-        [optax.linear_schedule(0.0, cfg.lr, warmup), after], [warmup]
+        [optax.linear_schedule(cfg.lr / warmup, cfg.lr, warmup), after], [warmup]
     )
 
 

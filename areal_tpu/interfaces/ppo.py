@@ -202,8 +202,15 @@ class PPOActorInterface(ModelInterface):
                 score_seg = rows["segment_ids"] * resp_mask.astype(
                     rows["segment_ids"].dtype
                 )
+                # Bootstrap for truncated (no-EOS) sequences: V(s_{T+1}),
+                # the critic value at the *final token* position — one to
+                # the right of the last scoring position (values are
+                # token-aligned, so shift left to read position t+1 at t).
+                values_next = jnp.concatenate(
+                    [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1
+                )
                 bootstrap = (
-                    values * last_mask * no_eos
+                    values_next * last_mask * no_eos
                     if has_critic
                     else jnp.zeros_like(resp_mask)
                 )
@@ -358,6 +365,9 @@ class PPOCriticInterface(ModelInterface):
     n_minibatches: int = 4
     value_eps_clip: float = 0.2
     kl_ctl: float = 0.1
+    adaptive_kl_ctl: bool = False
+    adaptive_kl_target: float = 6.0
+    adaptive_kl_horizon: float = 10000.0
     discount: float = 1.0
     gae_lambda: float = 1.0
     max_reward_clip: float = 20.0
@@ -368,6 +378,16 @@ class PPOCriticInterface(ModelInterface):
 
     def __post_init__(self):
         self.rms = F.RunningMeanStd()
+        # Mirrors the actor's controller so returns use the same (possibly
+        # drifting) KL coefficient: both controllers see the same per-step
+        # observed KL and so stay in lockstep (reference keeps separate but
+        # identically-updated adapters on actor and critic interfaces).
+        if self.adaptive_kl_ctl:
+            self.kl_controller = F.AdaptiveKLController(
+                self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon
+            )
+        else:
+            self.kl_controller = F.FixedKLController(self.kl_ctl)
         # Returns must be computed with the SAME reward transform as the
         # actor's advantages; the helper is cached so its jitted prep
         # program survives across train steps.
@@ -395,8 +415,8 @@ class PPOCriticInterface(ModelInterface):
         # Returns are recomputed exactly like the actor does.
         batch, rows = engine._build_rows(input_)
         rows_dev = engine._device_rows(rows)
-        _, ret_rows, resp_rows, _ = self._helper._prep_fn(engine)(
-            rows_dev, jnp.asarray(self.kl_ctl, jnp.float32)
+        _, ret_rows, resp_rows, kl_sum = self._helper._prep_fn(engine)(
+            rows_dev, jnp.asarray(self.kl_controller.value, jnp.float32)
         )
         ret_flat = batch.gather_flat(np.asarray(ret_rows))
         resp_flat = batch.gather_flat(np.asarray(resp_rows))
@@ -445,6 +465,8 @@ class PPOCriticInterface(ModelInterface):
             )
             all_stats.append(st)
         model.inc_version()
+        n_resp = float(np.sum(resp_flat))
+        self.kl_controller.update(float(kl_sum) / max(n_resp, 1.0), int(n_resp))
         agg = {k: float(np.mean([s[k] for s in all_stats])) for k in all_stats[0]}
         stats_tracker.scalar(**agg)
         return agg

@@ -87,7 +87,13 @@ class SFTInterface(ModelInterface):
         from areal_tpu.models.hf import save_hf_model
 
         engine = model.module
-        family = getattr(engine, "hf_family", None) or "qwen2"
+        family = getattr(engine, "hf_family", None)
+        if family is None:
+            raise ValueError(
+                "engine has no hf_family set; pass hf_family= when building "
+                "the JaxTrainEngine so save() knows which HF weight mapping "
+                "to use (silently guessing would corrupt the checkpoint)"
+            )
         import jax
 
         save_hf_model(

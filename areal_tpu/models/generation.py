@@ -154,6 +154,10 @@ def prefill(params, cfg: TransformerConfig, input_ids, prompt_lens, cache_len: i
     return last_logits, k_cache, v_cache
 
 
+# Module-level jit so the compile cache survives across generate calls.
+_prefill_jit = jax.jit(prefill, static_argnames=("cfg", "cache_len"))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -262,9 +266,9 @@ def generate_tokens(
     if eos_token_id is not None and eos_token_id not in stop:
         stop = stop + (eos_token_id,)
 
-    first_logits, k_cache, v_cache = jax.jit(
-        prefill, static_argnames=("cfg", "cache_len")
-    )(params, cfg, jnp.asarray(input_ids), jnp.asarray(plens), cache_len=cache_len)
+    first_logits, k_cache, v_cache = _prefill_jit(
+        params, cfg, jnp.asarray(input_ids), jnp.asarray(plens), cache_len=cache_len
+    )
     out_tokens, out_logprobs, lengths, done = _decode_loop(
         params, cfg, first_logits, k_cache, v_cache, jnp.asarray(plens), rng,
         max_new_tokens=gconfig.max_new_tokens,
