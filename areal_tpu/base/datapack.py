@@ -22,12 +22,30 @@ def ffd_allocate(
     capacity: int,
     min_groups: int = 1,
 ) -> List[List[int]]:
-    """First-fit-decreasing bin packing.
+    """First-fit-decreasing bin packing (dispatches to the native C++
+    implementation in csrc/host_ops.cpp when available; this Python body is
+    the fallback and the parity reference).
 
     Partition items with the given `lengths` into bins of at most `capacity`
     total length (a single item longer than capacity gets its own bin),
     producing at least `min_groups` bins. Returns a list of index groups.
     """
+    if len(lengths) > 64:  # native pays off only past trivial sizes
+        from areal_tpu.ops import host_ops
+
+        # wait=False: never stall the dispatch hot path on a g++ compile —
+        # the first calls use the Python body while the .so builds.
+        if host_ops.native_available(wait=False):
+            return host_ops.ffd_allocate_native(lengths, capacity, min_groups)
+    return ffd_allocate_py(lengths, capacity, min_groups)
+
+
+def ffd_allocate_py(
+    lengths: Sequence[int],
+    capacity: int,
+    min_groups: int = 1,
+) -> List[List[int]]:
+    """Pure-Python FFD; parity reference for the native path."""
     lengths = np.asarray(lengths)
     order = np.argsort(-lengths, kind="stable")
     groups: List[List[int]] = [[] for _ in range(min_groups)]

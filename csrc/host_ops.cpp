@@ -1,0 +1,152 @@
+// Native host-side ops for areal_tpu.
+//
+// TPU-native counterpart of the reference's csrc/ extensions:
+//   - gae_1d_packed     <- csrc/cugae/gae.cu:10 (gae_1d_nolp_misalign).
+//     On TPU the in-jit GAE is a lax.scan (areal_tpu/ops/gae.py); this C++
+//     version is the *host* path used by the control plane (reward
+//     post-processing on CPU workers, verification) where no accelerator
+//     is attached.
+//   - merge/slice/set_intervals <- csrc/interval_op/interval_op.{cpp,cu}.
+//     On TPU live-weight resharding is jitted device_put between shardings,
+//     but the disk-mediated param-realloc path (the reference default,
+//     model_worker.py:1055) slices flattened checkpoint buffers on the
+//     host — these run that path at memcpy speed, dtype-agnostic.
+//   - ffd_allocate      <- realhf/base/datapack.py:153 (ffd_allocate).
+//     The micro-batch token-budget packer; called per dispatch on the
+//     master's hot control path, so it gets a native implementation.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this
+// toolchain). All functions are single-threaded and allocation-free
+// except ffd_allocate's scratch vectors.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// Partition items into bins of at most `capacity` total length, producing at
+// least `min_groups` bins; a single item longer than capacity gets its own
+// bin. Writes a group id per item into `group_ids` and returns the number of
+// groups. Semantics match areal_tpu.base.datapack.ffd_allocate exactly
+// (stable descending order; least-loaded candidate bin, lowest index on
+// ties; empty bins always accept).
+int64_t ffd_allocate(const int64_t* lengths, int64_t n, int64_t capacity,
+                     int64_t min_groups, int64_t* group_ids) {
+  std::vector<int64_t> order(n);
+  std::iota(order.begin(), order.end(), 0);
+  std::stable_sort(order.begin(), order.end(), [&](int64_t a, int64_t b) {
+    return lengths[a] > lengths[b];
+  });
+
+  std::vector<int64_t> sums(min_groups > 0 ? min_groups : 1, 0);
+  std::vector<int64_t> counts(sums.size(), 0);
+  if (min_groups <= 0) {
+    sums.clear();
+    counts.clear();
+  }
+
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t idx = order[oi];
+    const int64_t l = lengths[idx];
+    int64_t best = -1;
+    int64_t best_sum = 0;
+    for (size_t g = 0; g < sums.size(); ++g) {
+      if (sums[g] + l <= capacity || counts[g] == 0) {
+        if (best < 0 || sums[g] < best_sum) {
+          best = static_cast<int64_t>(g);
+          best_sum = sums[g];
+        }
+      }
+    }
+    if (best < 0) {
+      sums.push_back(0);
+      counts.push_back(0);
+      best = static_cast<int64_t>(sums.size()) - 1;
+    }
+    group_ids[idx] = best;
+    sums[best] += l;
+    counts[best] += 1;
+  }
+
+  // Compact away empty bins (possible when min_groups > n items), keeping
+  // group order, and remap ids.
+  std::vector<int64_t> remap(sums.size(), -1);
+  int64_t n_groups = 0;
+  for (size_t g = 0; g < sums.size(); ++g) {
+    if (counts[g] > 0) remap[g] = n_groups++;
+  }
+  for (int64_t i = 0; i < n; ++i) group_ids[i] = remap[group_ids[i]];
+  return n_groups;
+}
+
+// Merge overlapping/adjacent [start, end) intervals in place. Intervals must
+// be sorted by start. Returns the merged count.
+int64_t merge_intervals(int64_t* starts, int64_t* ends, int64_t n) {
+  if (n == 0) return 0;
+  int64_t w = 0;
+  for (int64_t i = 1; i < n; ++i) {
+    if (starts[i] <= ends[w]) {
+      ends[w] = std::max(ends[w], ends[i]);
+    } else {
+      ++w;
+      starts[w] = starts[i];
+      ends[w] = ends[i];
+    }
+  }
+  return w + 1;
+}
+
+// Gather n [start, end) element ranges of `src` (element size `elem` bytes)
+// contiguously into `out`.
+void slice_intervals(const char* src, int64_t elem, const int64_t* starts,
+                     const int64_t* ends, int64_t n, char* out) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t cnt = ends[i] - starts[i];
+    std::memcpy(out + off * elem, src + starts[i] * elem, cnt * elem);
+    off += cnt;
+  }
+}
+
+// Scatter a contiguous `src` into n [start, end) element ranges of `dst`.
+void set_intervals(const char* src, char* dst, int64_t elem,
+                   const int64_t* starts, const int64_t* ends, int64_t n) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t cnt = ends[i] - starts[i];
+    std::memcpy(dst + starts[i] * elem, src + off * elem, cnt * elem);
+    off += cnt;
+  }
+}
+
+// GAE over packed variable-length sequences, "misaligned values" layout
+// (reference gae_1d_nolp_misalign): rewards has total_len = cu_seqlens[n_seqs]
+// entries; values has total_len + n_seqs entries (each sequence contributes
+// len+1 values, the extra one being the bootstrap V(s_T)). `truncate[i]`
+// nonzero keeps the bootstrap value for sequence i; zero (episode done)
+// replaces it with 0.
+void gae_1d_packed(const float* rewards, const float* values,
+                   const int64_t* cu_seqlens, const uint8_t* truncate,
+                   int64_t n_seqs, float gamma, float lam, float* adv,
+                   float* ret) {
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    const int64_t r0 = cu_seqlens[s];
+    const int64_t r1 = cu_seqlens[s + 1];
+    const int64_t v0 = r0 + s;  // values are shifted by one slot per prior seq
+    const int64_t len = r1 - r0;
+    float next_adv = 0.0f;
+    float v_next = truncate[s] ? values[v0 + len] : 0.0f;
+    for (int64_t t = len - 1; t >= 0; --t) {
+      const float delta = rewards[r0 + t] + gamma * v_next - values[v0 + t];
+      next_adv = delta + gamma * lam * next_adv;
+      adv[r0 + t] = next_adv;
+      ret[r0 + t] = next_adv + values[v0 + t];
+      v_next = values[v0 + t];
+    }
+  }
+}
+
+}  // extern "C"
