@@ -378,3 +378,111 @@ def load_hf_tokenizer(path: str, fast: bool = True):
     if tok.pad_token_id is None:
         tok.pad_token_id = tok.eos_token_id
     return tok
+
+
+# ---------------------------------------------------------------------------
+# Dataset loading helpers (counterpart of the reference data_api.py:747-792)
+# ---------------------------------------------------------------------------
+
+# Task vocabulary for RL datasets; indices are shipped as `task_ids`
+# (reference data_api.py:47).
+RL_TASKS = ["math", "code", "rlhf", "stem"]
+
+
+def get_shuffle_indices(seed: int, size: int) -> np.ndarray:
+    """Deterministic permutation used for dataset shuffling."""
+    rng = np.random.RandomState(seed)
+    return rng.permutation(size)
+
+
+def load_shuffle_split_dataset(
+    util: DatasetUtility,
+    dataset_path: Optional[str] = None,
+    dataset_builder: Optional[Any] = None,
+) -> List[Dict[str, Any]]:
+    """Load a jsonl dataset (or call a builder), assign missing ids,
+    deterministically shuffle by `util.seed`, and return this DP rank's
+    near-equal contiguous slice of the shuffled order (round-robin bin
+    sizes so every rank gets data; reference data_api.py:754-792)."""
+    import json
+
+    if dataset_path is not None:
+        if not str(dataset_path).endswith(".jsonl"):
+            raise NotImplementedError(f"unknown dataset extension: {dataset_path}")
+        with open(dataset_path, "r") as f:
+            data = [json.loads(line) for line in f if line.strip()]
+    else:
+        assert dataset_builder is not None
+        data = dataset_builder()
+
+    if any("id" not in d for d in data):
+        for idx, d in enumerate(data):
+            d.setdefault("id", idx)
+
+    if len(data) < util.world_size:
+        raise ValueError(
+            f"dataset size {len(data)} smaller than DP world size {util.world_size}"
+        )
+    bins = np.zeros(util.world_size, dtype=np.int64)
+    for idx in range(len(data)):
+        bins[idx % util.world_size] += 1
+    bounds = np.pad(np.cumsum(bins), (1, 0))
+    shuffle = get_shuffle_indices(util.seed, len(data))
+    subset = shuffle[bounds[util.dp_rank] : bounds[util.dp_rank + 1]]
+    return [data[i] for i in subset]
+
+
+class PackedDataLoader:
+    """Minimal epoch-based loader over a map-style dataset of
+    `SequenceSample`s: deterministic per-epoch shuffling, `SequenceSample.
+    gather` collation, and an index cursor that can be checkpointed for
+    exactly-once recovery (reference model_worker.py:374-385 snapshots the
+    dataloader state the same way)."""
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True, seed: int = 1):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self._cursor = 0
+        self._order: Optional[np.ndarray] = None
+
+    def _ensure_order(self):
+        if self._order is None or len(self._order) != len(self.dataset):
+            n = len(self.dataset)
+            self._order = (
+                get_shuffle_indices(self.seed + self.epoch, n)
+                if self.shuffle
+                else np.arange(n)
+            )
+
+    def __len__(self) -> int:
+        return max(1, (len(self.dataset) + self.batch_size - 1) // self.batch_size)
+
+    def next_batch(self) -> Tuple["SequenceSample", bool]:
+        """Returns (batch, is_epoch_last). Advances epoch + reshuffles when
+        the dataset is exhausted."""
+        self._ensure_order()
+        n = len(self._order)
+        end = min(self._cursor + self.batch_size, n)
+        idx = self._order[self._cursor : end]
+        samples = [self.dataset[int(i)] for i in idx]
+        batch = SequenceSample.gather(samples)
+        self._cursor = end
+        epoch_last = self._cursor >= n
+        if epoch_last:
+            self.epoch += 1
+            self._cursor = 0
+            self._order = None
+        return batch, epoch_last
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"epoch": self.epoch, "cursor": self._cursor, "seed": self.seed}
+
+    def load_state_dict(self, state: Dict[str, Any]):
+        self.epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        self.seed = int(state["seed"])
+        self._order = None
+        self._ensure_order()

@@ -1,0 +1,12 @@
+"""Registry-backed jsonl datasets (counterpart of reference impl/dataset/).
+
+Importing this package registers: "prompt", "prompt_answer", "rw_pair",
+"math_code_prompt". All produce numpy-backed `SequenceSample`s.
+"""
+
+from areal_tpu.datasets import (  # noqa: F401
+    math_code_prompt,
+    prompt,
+    prompt_answer,
+    rw_paired,
+)

@@ -1,0 +1,157 @@
+"""Math/code RL prompt dataset with curriculum filtering
+(reference impl/dataset/math_code_dataset.py).
+
+jsonl rows need "prompt", "query_id", "task" in {math, stem, code} (missing
+task defaults to math); math rows carry "solutions" (list of reference
+answers), code rows carry "input_output" (JSON testcases). Rows failing
+validation are skipped with a warning, matching the reference's tolerance.
+
+Produces `packed_prompts` + per-sample `task_ids` (index into
+data_api.RL_TASKS) and optional `base_scores`. `filter(eval_scores)`
+implements score-threshold curriculum dropping (reference
+math_code_dataset.py:175-202): at most `max_filter_percentage` of active
+prompts with scores above `filter_threshold` are removed per call, highest
+scores first.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import defaultdict
+from typing import Callable, Dict, Hashable, List, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.api import data_api
+from areal_tpu.base import logging
+
+logger = logging.getLogger("math_code_dataset")
+
+
+def _validate_math(d: Dict) -> Dict:
+    assert d["task"] in ("math", "stem")
+    d["query_id"] = str(d["query_id"])
+    assert isinstance(d["prompt"], str)
+    assert isinstance(d["solutions"], list)
+    assert all(isinstance(s, str) for s in d["solutions"])
+    return d
+
+
+def _validate_code(d: Dict) -> Dict:
+    assert d["task"] == "code"
+    d["query_id"] = str(d["query_id"])
+    d.setdefault("problem_id", d["query_id"])
+    assert isinstance(d["prompt"], str)
+    io = json.loads(d["input_output"]) if isinstance(d["input_output"], str) else d["input_output"]
+    assert len(io["inputs"]) == len(io["outputs"])
+    return d
+
+
+def load_metadata(path: str) -> Tuple[Dict[str, Dict], Dict[str, int]]:
+    """id->row mapping for reward verification, with per-task counts."""
+    assert str(path).endswith(".jsonl"), path
+    with open(path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    id2info: Dict[str, Dict] = {}
+    task_cnt: Dict[str, int] = defaultdict(int)
+    omit = defaultdict(int)
+    for d in rows:
+        d.setdefault("task", "math")
+        try:
+            d = _validate_code(d) if d["task"] == "code" else _validate_math(d)
+        except Exception:
+            omit[d["task"]] += 1
+            continue
+        id2info[d["query_id"]] = d
+        task_cnt[d["task"]] += 1
+    if omit:
+        logger.warning(f"math_code dataset: ignored invalid rows {dict(omit)}")
+    return id2info, dict(task_cnt)
+
+
+class MATHCodePromptDataset:
+    def __init__(
+        self,
+        util: data_api.DatasetUtility,
+        max_length: Optional[int] = None,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+        filter_threshold: float = 1e4,
+        max_filter_percentage: float = 0.0,
+    ):
+        self.util = util
+        if dataset_path is not None:
+            id2info, _ = load_metadata(dataset_path)
+        else:
+            id2info = None
+
+        data = data_api.load_shuffle_split_dataset(util, dataset_path, dataset_builder)
+        if id2info is not None:
+            data = [d for d in data if str(d.get("query_id")) in id2info]
+
+        enc = util.tokenizer(
+            [x["prompt"] for x in data],
+            truncation=False,
+            padding=False,
+            return_attention_mask=False,
+        )
+        keep = [
+            i
+            for i, ids in enumerate(enc["input_ids"])
+            if max_length is None or len(ids) <= max_length
+        ]
+        self.prompts: List[List[int]] = [enc["input_ids"][i] for i in keep]
+        self.prompt_lengths = [len(p) for p in self.prompts]
+        # Unique per-(row, dp_rank) ids, as in the reference (:138-140).
+        self.ids = [
+            f"{data[i]['query_id']}@idx:{i}-{util.dp_rank}" for i in keep
+        ]
+        self.task_ids = [data_api.RL_TASKS.index(data[i].get("task", "math")) for i in keep]
+        self.base_scores = (
+            [float(np.mean(data[i]["scores"])) for i in keep]
+            if data and "scores" in data[0]
+            else None
+        )
+        self.active_indices = list(range(len(self.prompts)))
+        self.filter_threshold = filter_threshold
+        self.max_filter_percentage = max_filter_percentage
+        logger.info(
+            f"MATHCodePromptDataset: {len(self.prompts)} prompts (dp={util.dp_rank})"
+        )
+
+    def __len__(self):
+        return len(self.active_indices)
+
+    def __getitem__(self, idx: int) -> data_api.SequenceSample:
+        idx = self.active_indices[idx]
+        d = dict(
+            packed_prompts=np.asarray(self.prompts[idx], dtype=np.int32),
+            task_ids=np.asarray([self.task_ids[idx]], dtype=np.int64),
+        )
+        if self.base_scores is not None:
+            d["base_scores"] = np.asarray([self.base_scores[idx]], dtype=np.float32)
+        return data_api.SequenceSample.from_default(
+            ids=[self.ids[idx]],
+            seqlens=[self.prompt_lengths[idx]],
+            data=d,
+        )
+
+    def filter(self, eval_scores: Dict[Hashable, float]):
+        """Curriculum filter: drop up to max_filter_percentage of active
+        prompts whose eval score exceeds filter_threshold (highest first)."""
+        removable = {}
+        for pos, idx in enumerate(self.active_indices):
+            score = eval_scores.get(self.ids[idx])
+            if score is not None and score > self.filter_threshold:
+                removable[pos] = score
+        n = int(len(self.active_indices) * self.max_filter_percentage)
+        to_remove = sorted(removable, key=removable.__getitem__, reverse=True)[:n]
+        for pos in sorted(to_remove, reverse=True):
+            self.active_indices.pop(pos)
+        logger.info(
+            f"math_code filter: removed {len(to_remove)}, "
+            f"{len(self.active_indices)} remain"
+        )
+
+
+data_api.register_dataset("math_code_prompt", MATHCodePromptDataset)

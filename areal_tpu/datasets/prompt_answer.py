@@ -1,0 +1,75 @@
+"""Prompt+answer dataset for SFT (reference impl/dataset/prompt_answer_dataset.py).
+
+jsonl rows need "prompt" and "answer". Produces `packed_input_ids`
+(prompt+answer+eos) and a boolean `prompt_mask` (True over prompt tokens;
+the SFT loss masks these out).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api import data_api
+from areal_tpu.base import logging
+
+logger = logging.getLogger("prompt_answer_dataset")
+
+
+class PromptAnswerDataset:
+    def __init__(
+        self,
+        util: data_api.DatasetUtility,
+        max_length: int,
+        dataset_path: Optional[str] = None,
+        dataset_builder: Optional[Callable[[], List[Dict]]] = None,
+    ):
+        self.util = util
+        tok = util.tokenizer
+        data = data_api.load_shuffle_split_dataset(util, dataset_path, dataset_builder)
+        eos = tok.eos_token or ""
+        seqs = [x["prompt"] + x["answer"] + eos for x in data]
+        self.ids = [str(x["id"]) for x in data]
+        enc = tok(
+            seqs,
+            truncation=True,
+            max_length=max_length,
+            padding=False,
+            return_attention_mask=False,
+        )
+        prompt_enc = tok(
+            [x["prompt"] for x in data],
+            truncation=True,
+            max_length=max_length,
+            padding=False,
+            return_attention_mask=False,
+        )
+        self.tokens: List[List[int]] = enc["input_ids"]
+        self.prompt_masks: List[np.ndarray] = []
+        for seq_ids, prompt_ids in zip(self.tokens, prompt_enc["input_ids"]):
+            plen = min(len(prompt_ids), len(seq_ids))
+            mask = np.zeros(len(seq_ids), dtype=bool)
+            mask[:plen] = True
+            self.prompt_masks.append(mask)
+        lens = [len(t) for t in self.tokens]
+        plens = [int(m.sum()) for m in self.prompt_masks]
+        logger.info(
+            f"PromptAnswerDataset: #seqs={len(self.tokens)}, "
+            f"avg prompt len={np.mean(plens):.1f}, "
+            f"avg answer len={np.mean(lens) - np.mean(plens):.1f}"
+        )
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def __getitem__(self, idx: int) -> data_api.SequenceSample:
+        toks = np.asarray(self.tokens[idx], dtype=np.int32)
+        return data_api.SequenceSample.from_default(
+            ids=[self.ids[idx]],
+            seqlens=[len(toks)],
+            data=dict(packed_input_ids=toks, prompt_mask=self.prompt_masks[idx]),
+        )
+
+
+data_api.register_dataset("prompt_answer", PromptAnswerDataset)

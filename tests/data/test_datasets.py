@@ -1,0 +1,144 @@
+"""Dataset registry + jsonl dataset tests (counterpart of the reference's
+tests/data/test_load_data.py category)."""
+
+import numpy as np
+import pytest
+
+import areal_tpu.datasets  # noqa: F401  (registers datasets)
+from areal_tpu.api import data_api
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    rows = fixtures.make_sft_rows(50, seed=7)
+    texts = [r["prompt"] + " " + r["answer"] for r in rows]
+    return fixtures.train_tiny_tokenizer(texts, tmp_path_factory.mktemp("tok"))
+
+
+def _util(tokenizer, dp_rank=0, world_size=1, seed=1):
+    return data_api.DatasetUtility(
+        seed=seed, dp_rank=dp_rank, world_size=world_size, tokenizer=tokenizer
+    )
+
+
+def test_load_shuffle_split_partitions(tmp_path, tokenizer):
+    rows = fixtures.make_sft_rows(23)
+    path = fixtures.write_jsonl(rows, tmp_path / "d.jsonl")
+    all_ids = set()
+    sizes = []
+    for dp in range(4):
+        part = data_api.load_shuffle_split_dataset(
+            data_api.DatasetUtility(seed=3, dp_rank=dp, world_size=4, tokenizer=None),
+            path,
+        )
+        ids = {r["id"] for r in part}
+        assert not (ids & all_ids), "DP slices must be disjoint"
+        all_ids |= ids
+        sizes.append(len(part))
+    assert sum(sizes) == 23
+    assert max(sizes) - min(sizes) <= 1
+    assert all_ids == {r["id"] for r in rows}
+
+
+def test_prompt_answer_dataset(tmp_path, tokenizer):
+    rows = fixtures.make_sft_rows(12)
+    path = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+    from areal_tpu.datasets.prompt_answer import PromptAnswerDataset
+
+    ds = PromptAnswerDataset(_util(tokenizer), max_length=64, dataset_path=path)
+    assert len(ds) == 12
+    s = ds[0]
+    assert s.bs == 1
+    assert {"packed_input_ids", "prompt_mask"} <= s.keys
+    toks = s.data["packed_input_ids"]
+    mask = s.data["prompt_mask"]
+    assert len(toks) == len(mask) == s.sample_total_len(0)
+    # prompt_mask True over a prefix only
+    flips = np.diff(mask.astype(int))
+    assert (flips <= 0).all()
+    # answer region ends with EOS
+    assert toks[-1] == tokenizer.eos_token_id
+
+
+def test_prompt_dataset_and_loader(tmp_path, tokenizer):
+    rows = fixtures.make_sft_rows(10)
+    path = fixtures.write_jsonl(rows, tmp_path / "p.jsonl")
+    from areal_tpu.datasets.prompt import PromptDataset
+
+    ds = PromptDataset(_util(tokenizer), max_length=32, dataset_path=path)
+    loader = data_api.PackedDataLoader(ds, batch_size=4, seed=5)
+    seen = []
+    last_flags = []
+    for _ in range(len(loader)):
+        batch, last = loader.next_batch()
+        seen.extend(batch.ids)
+        last_flags.append(last)
+    assert sorted(seen) == sorted(str(r["id"]) for r in rows)
+    assert last_flags == [False, False, True]
+    assert loader.epoch == 1
+
+    # Recovery round trip: same cursor -> same next batch.
+    b1, _ = loader.next_batch()
+    state = loader.state_dict()
+    b2, _ = loader.next_batch()
+    loader.load_state_dict(state)
+    b3, _ = loader.next_batch()
+    assert b2.ids == b3.ids
+
+
+def test_rw_paired_dataset(tmp_path, tokenizer):
+    rows = fixtures.make_rw_rows(8)
+    path = fixtures.write_jsonl(rows, tmp_path / "rw.jsonl")
+    from areal_tpu.datasets.rw_paired import RewardModelingPairedDataset
+
+    ds = RewardModelingPairedDataset(
+        _util(tokenizer), max_length=64, max_pairs_per_prompt=2, dataset_path=path
+    )
+    s = ds[0]
+    lens = s.seqlens["packed_input_ids"][0]
+    assert len(lens) % 2 == 0  # pos/neg pairs
+    assert len(s.data["packed_input_ids"]) == sum(lens)
+    assert s.data["group_factor"][0] == pytest.approx(1.0 / (len(lens) // 2))
+
+
+def test_math_code_dataset_and_filter(tmp_path, tokenizer):
+    rows = fixtures.make_math_code_rows(15)
+    # Add one invalid row: must be skipped, not crash.
+    rows.append({"query_id": "bad", "task": "math", "prompt": "x", "solutions": "notalist"})
+    path = fixtures.write_jsonl(rows, tmp_path / "mc.jsonl")
+    from areal_tpu.datasets.math_code_prompt import MATHCodePromptDataset, load_metadata
+
+    id2info, task_cnt = load_metadata(path)
+    assert len(id2info) == 15
+    assert task_cnt["math"] == 10 and task_cnt["code"] == 5
+
+    ds = MATHCodePromptDataset(
+        _util(tokenizer),
+        max_length=64,
+        dataset_path=path,
+        filter_threshold=0.8,
+        max_filter_percentage=0.2,
+    )
+    assert len(ds) == 15
+    s = ds[0]
+    assert {"packed_prompts", "task_ids"} <= s.keys
+    assert s.data["task_ids"][0] in (0, 1, 3)
+
+    # Curriculum filter: 20% cap -> 3 of the 4 high scorers dropped.
+    ids = [ds.ids[i] for i in ds.active_indices]
+    scores = {ids[i]: 1.0 for i in range(4)}
+    ds.filter(scores)
+    assert len(ds) == 12
+
+
+def test_registry_construction(tmp_path, tokenizer):
+    from areal_tpu.api.config import DatasetAbstraction
+
+    rows = fixtures.make_sft_rows(6)
+    path = fixtures.write_jsonl(rows, tmp_path / "r.jsonl")
+    ds = data_api.make_dataset(
+        DatasetAbstraction("prompt_answer", args=dict(max_length=32, dataset_path=path)),
+        util=_util(tokenizer),
+    )
+    assert len(ds) == 6

@@ -1,0 +1,102 @@
+"""Shared test fixtures: synthetic jsonl datasets + an on-the-fly-trained
+tiny tokenizer (mirrors reference tests/fixtures.py:45-106 in spirit:
+random-sentence data, WordPiece trained on it, no downloads)."""
+
+from __future__ import annotations
+
+import json
+import random
+import uuid
+from typing import Dict, List
+
+VOCAB_SIZE = 128
+
+
+def random_sentence(rng: random.Random, lo=2, hi=10) -> str:
+    words = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "eta", "theta",
+             "one", "two", "three", "four", "x", "y", "z", "sum"]
+    return " ".join(rng.choice(words) for _ in range(rng.randint(lo, hi)))
+
+
+def make_sft_rows(n: int, seed: int = 0) -> List[Dict]:
+    rng = random.Random(seed)
+    return [
+        dict(
+            id=str(uuid.uuid4()),
+            prompt=random_sentence(rng),
+            answer=random_sentence(rng),
+        )
+        for _ in range(n)
+    ]
+
+
+def make_rw_rows(n: int, seed: int = 0) -> List[Dict]:
+    rng = random.Random(seed)
+    rows = []
+    for _ in range(n):
+        n_pairs = rng.randint(1, 4)
+        rows.append(
+            dict(
+                id=str(uuid.uuid4()),
+                prompt=random_sentence(rng),
+                pos_answers=[random_sentence(rng) for _ in range(n_pairs)],
+                neg_answers=[random_sentence(rng) for _ in range(n_pairs)],
+            )
+        )
+    return rows
+
+
+def make_math_code_rows(n: int, seed: int = 0) -> List[Dict]:
+    rng = random.Random(seed)
+    rows = []
+    for i in range(n):
+        if i % 3 == 2:
+            rows.append(
+                dict(
+                    query_id=str(uuid.uuid4()),
+                    task="code",
+                    prompt=random_sentence(rng),
+                    input_output=json.dumps(
+                        {"inputs": ["1 2\n"], "outputs": ["3\n"]}
+                    ),
+                )
+            )
+        else:
+            rows.append(
+                dict(
+                    query_id=str(uuid.uuid4()),
+                    task="math",
+                    prompt=random_sentence(rng),
+                    solutions=["\\boxed{42}"],
+                )
+            )
+    return rows
+
+
+def write_jsonl(rows: List[Dict], path) -> str:
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def train_tiny_tokenizer(texts: List[str], save_dir) -> "object":
+    """Train a WordPiece tokenizer on the given texts, wrapped as a HF
+    PreTrainedTokenizerFast with pad/eos set."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordPiece
+    from tokenizers.pre_tokenizers import Whitespace
+    from tokenizers.trainers import WordPieceTrainer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(WordPiece(unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    trainer = WordPieceTrainer(
+        vocab_size=VOCAB_SIZE - 2, min_frequency=0, special_tokens=["[UNK]", "[EOS]"]
+    )
+    tok.train_from_iterator(texts, trainer)
+    path = str(save_dir / "tokenizer.json")
+    tok.save(path)
+    return PreTrainedTokenizerFast(
+        tokenizer_file=path, eos_token="[EOS]", pad_token="[EOS]", unk_token="[UNK]"
+    )
