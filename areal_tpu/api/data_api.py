@@ -416,8 +416,15 @@ def load_shuffle_split_dataset(
         data = dataset_builder()
 
     if any("id" not in d for d in data):
+        # Backfill with ids that cannot collide with explicit integer/str ids.
         for idx, d in enumerate(data):
-            d.setdefault("id", idx)
+            d.setdefault("id", f"__auto_{idx}")
+    seen_ids = set()
+    for d in data:
+        sid = str(d["id"])
+        if sid in seen_ids:
+            raise ValueError(f"duplicate dataset id {sid!r}")
+        seen_ids.add(sid)
 
     if len(data) < util.world_size:
         raise ValueError(
@@ -448,14 +455,24 @@ class PackedDataLoader:
         self._cursor = 0
         self._order: Optional[np.ndarray] = None
 
+    def _regen_order(self, n: int):
+        self._order = (
+            get_shuffle_indices(self.seed + self.epoch, n)
+            if self.shuffle
+            else np.arange(n)
+        )
+
     def _ensure_order(self):
-        if self._order is None or len(self._order) != len(self.dataset):
-            n = len(self.dataset)
-            self._order = (
-                get_shuffle_indices(self.seed + self.epoch, n)
-                if self.shuffle
-                else np.arange(n)
-            )
+        n = len(self.dataset)
+        if self._order is not None and len(self._order) != n:
+            # The dataset changed size mid-epoch (curriculum filter): the old
+            # permutation is invalid, so start a fresh epoch over the new set
+            # rather than slicing past the end / repeating samples.
+            self.epoch += 1
+            self._cursor = 0
+            self._order = None
+        if self._order is None:
+            self._regen_order(n)
 
     def __len__(self) -> int:
         return max(1, (len(self.dataset) + self.batch_size - 1) // self.batch_size)
@@ -463,6 +480,8 @@ class PackedDataLoader:
     def next_batch(self) -> Tuple["SequenceSample", bool]:
         """Returns (batch, is_epoch_last). Advances epoch + reshuffles when
         the dataset is exhausted."""
+        if len(self.dataset) == 0:
+            raise RuntimeError("cannot draw a batch from an empty dataset")
         self._ensure_order()
         n = len(self._order)
         end = min(self._cursor + self.batch_size, n)
@@ -478,11 +497,20 @@ class PackedDataLoader:
         return batch, epoch_last
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"epoch": self.epoch, "cursor": self._cursor, "seed": self.seed}
+        return {
+            "epoch": self.epoch,
+            "cursor": self._cursor,
+            "seed": self.seed,
+            "size": len(self.dataset),
+        }
 
     def load_state_dict(self, state: Dict[str, Any]):
         self.epoch = int(state["epoch"])
         self._cursor = int(state["cursor"])
         self.seed = int(state["seed"])
-        self._order = None
-        self._ensure_order()
+        n = len(self.dataset)
+        if int(state.get("size", n)) != n:
+            # Checkpoint taken against a different dataset size: the stored
+            # cursor indexes a different permutation — restart the epoch.
+            self._cursor = 0
+        self._regen_order(n)

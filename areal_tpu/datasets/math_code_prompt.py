@@ -80,14 +80,29 @@ class MATHCodePromptDataset:
         max_filter_percentage: float = 0.0,
     ):
         self.util = util
+        # Read + validate the FULL dataset once, then split: every rank must
+        # agree on the kept row set and on whether base_scores exist (a
+        # per-slice decision would give ranks inconsistent key sets).
         if dataset_path is not None:
-            id2info, _ = load_metadata(dataset_path)
+            assert str(dataset_path).endswith(".jsonl"), dataset_path
+            with open(dataset_path) as f:
+                rows = [json.loads(line) for line in f if line.strip()]
         else:
-            id2info = None
-
-        data = data_api.load_shuffle_split_dataset(util, dataset_path, dataset_builder)
-        if id2info is not None:
-            data = [d for d in data if str(d.get("query_id")) in id2info]
+            rows = dataset_builder()
+        valid = []
+        omit: Dict[str, int] = defaultdict(int)
+        for d in rows:
+            d.setdefault("task", "math")
+            try:
+                valid.append(
+                    _validate_code(d) if d["task"] == "code" else _validate_math(d)
+                )
+            except Exception:
+                omit[d["task"]] += 1
+        if omit:
+            logger.warning(f"math_code dataset: ignored invalid rows {dict(omit)}")
+        has_base_scores = bool(valid) and all("scores" in d for d in valid)
+        data = data_api.load_shuffle_split_dataset(util, None, lambda: valid)
 
         enc = util.tokenizer(
             [x["prompt"] for x in data],
@@ -109,7 +124,7 @@ class MATHCodePromptDataset:
         self.task_ids = [data_api.RL_TASKS.index(data[i].get("task", "math")) for i in keep]
         self.base_scores = (
             [float(np.mean(data[i]["scores"])) for i in keep]
-            if data and "scores" in data[0]
+            if has_base_scores
             else None
         )
         self.active_indices = list(range(len(self.prompts)))

@@ -28,29 +28,41 @@ class PromptAnswerDataset:
         self.util = util
         tok = util.tokenizer
         data = data_api.load_shuffle_split_dataset(util, dataset_path, dataset_builder)
-        eos = tok.eos_token or ""
-        seqs = [x["prompt"] + x["answer"] + eos for x in data]
         self.ids = [str(x["id"]) for x in data]
-        enc = tok(
-            seqs,
-            truncation=True,
-            max_length=max_length,
-            padding=False,
-            return_attention_mask=False,
-        )
+        # Tokenize prompt and answer SEPARATELY and concatenate, so the
+        # prompt token span is a prefix of the sequence by construction —
+        # joint tokenization can merge tokens across the boundary, which
+        # would silently misalign the loss mask.
+        # add_special_tokens=False on both halves: a tokenizer that appends
+        # a suffix special token (T5-style trailing EOS) would otherwise
+        # plant an EOS between prompt and answer. BOS is re-added manually.
         prompt_enc = tok(
             [x["prompt"] for x in data],
             truncation=True,
             max_length=max_length,
             padding=False,
             return_attention_mask=False,
+            add_special_tokens=False,
         )
-        self.tokens: List[List[int]] = enc["input_ids"]
+        answer_enc = tok(
+            [x["answer"] for x in data],
+            truncation=True,
+            max_length=max_length,
+            padding=False,
+            return_attention_mask=False,
+            add_special_tokens=False,
+        )
+        bos_ids = [tok.bos_token_id] if tok.bos_token_id is not None else []
+        eos_ids = [tok.eos_token_id] if tok.eos_token_id is not None else []
+        self.tokens: List[List[int]] = []
         self.prompt_masks: List[np.ndarray] = []
-        for seq_ids, prompt_ids in zip(self.tokens, prompt_enc["input_ids"]):
+        for prompt_ids, answer_ids in zip(prompt_enc["input_ids"], answer_enc["input_ids"]):
+            prompt_ids = bos_ids + prompt_ids
+            seq_ids = (prompt_ids + answer_ids + eos_ids)[:max_length]
             plen = min(len(prompt_ids), len(seq_ids))
             mask = np.zeros(len(seq_ids), dtype=bool)
             mask[:plen] = True
+            self.tokens.append(seq_ids)
             self.prompt_masks.append(mask)
         lens = [len(t) for t in self.tokens]
         plens = [int(m.sum()) for m in self.prompt_masks]

@@ -34,7 +34,10 @@ class RewardModelingPairedDataset:
         data = data_api.load_shuffle_split_dataset(util, dataset_path, dataset_builder)
         self.max_pairs_per_prompt = max_pairs_per_prompt
         self.ids = [str(x["id"]) for x in data]
-        self.rng = np.random.RandomState(util.seed + util.dp_rank)
+        # Pair selection must be a pure function of (seed, dp_rank, idx):
+        # a shared stateful RNG would make re-reads and checkpoint-recovery
+        # replays return different pairs.
+        self._seed_base = (util.seed * 1_000_003 + util.dp_rank) % (2**31 - 1)
 
         eos = tok.eos_token or ""
         self.prompt_lens: List[int] = []
@@ -57,7 +60,8 @@ class RewardModelingPairedDataset:
     def __getitem__(self, idx: int) -> data_api.SequenceSample:
         n_pairs = len(self.pos_tokens[idx])
         group_size = min(self.max_pairs_per_prompt, n_pairs)
-        pair_idx = self.rng.choice(n_pairs, group_size, replace=False)
+        rng = np.random.RandomState((self._seed_base + idx * 9973) % (2**31 - 1))
+        pair_idx = rng.choice(n_pairs, group_size, replace=False)
 
         seqs: List[int] = []
         input_lens: List[int] = []

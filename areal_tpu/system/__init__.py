@@ -1,0 +1,24 @@
+"""Worker runtime: streams, buffer, workers, data plane.
+
+Counterpart of the reference's system layer (realhf/system/). The worker
+roles and the metadata-only control plane are kept; the GPU data plane is
+replaced by host-side numpy transfer + on-device resharding inside the
+JAX engines (reference: realhf/system/__init__.py:17-23).
+"""
+
+import importlib
+
+# worker type -> (module, class); grown as worker roles are implemented.
+_WORKER_CLASSES = {}
+
+WORKER_TYPES = sorted(_WORKER_CLASSES)
+
+
+def load_worker(worker_type: str):
+    """Resolve a worker type name to its class (lazy import)."""
+    if worker_type not in _WORKER_CLASSES:
+        raise ValueError(
+            f"unknown worker type {worker_type!r}; available: {WORKER_TYPES}"
+        )
+    module, cls = _WORKER_CLASSES[worker_type]
+    return getattr(importlib.import_module(module), cls)

@@ -1,0 +1,146 @@
+"""Asyncio sequence buffer: MFC ordering falls out of key readiness.
+
+Counterpart of the reference's buffer (realhf/system/buffer.py:34-408).
+The master stores metadata-only `SequenceSample`s here; each MFC's
+coroutine awaits a batch whose input keys are all ready and that the MFC
+has not consumed yet. Oldest-first selection, per-sample reuse counting
+(a sample is garbage-collected once every MFC consumed it).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import itertools
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.dfg import MFCDef
+from areal_tpu.base import logging
+
+logger = logging.getLogger("buffer")
+
+
+@dataclasses.dataclass
+class _Slot:
+    idx: int
+    sample: SequenceSample  # metadata-only on the master
+    ready_keys: Set[str]
+    consumed_by: Set[str]
+    birth: float
+    sample_id: str
+
+
+class AsyncIOSequenceBuffer:
+    """Key-availability-tracking buffer shared by all MFC coroutines.
+
+    put_batch: insert fresh samples (dataset keys ready).
+    amend_batch: merge MFC outputs into stored samples, marking new keys.
+    get_batch_for_rpc: await `rpc.n_seqs` samples with rpc.input_keys
+    ready and rpc not in consumed_by; marks consumption; GCs exhausted
+    slots. Mirrors reference buffer.py:247,308,348.
+    """
+
+    def __init__(self, rpcs: List[MFCDef], max_size: int = 16384):
+        self._rpcs = {r.name: r for r in rpcs}
+        self._n_rpcs = len(rpcs)
+        self._max_size = max_size
+        self._slots: Dict[str, _Slot] = {}  # sample_id -> slot
+        self._counter = itertools.count()
+        self._cond = asyncio.Condition()
+        # ids ever inserted, for exactly-once accounting on recovery
+        self.seen_ids: Set[str] = set()
+
+    def __len__(self):
+        return len(self._slots)
+
+    @property
+    def size(self) -> int:
+        return len(self._slots)
+
+    async def put_batch(self, samples: List[SequenceSample]) -> int:
+        """Insert samples whose dataset keys are ready. Returns #inserted."""
+        async with self._cond:
+            # Capacity-check up front so an overflow raises before any
+            # insertion (a mid-loop raise would strand inserted samples
+            # without waking consumers).
+            n_new = sum(
+                1
+                for s in samples
+                for i in range(s.bs)
+                if s.ids[i] not in self._slots and s.ids[i] not in self.seen_ids
+            )
+            if len(self._slots) + n_new > self._max_size:
+                raise RuntimeError(
+                    f"buffer overflow: {len(self._slots)} + {n_new} > "
+                    f"max_size={self._max_size}"
+                )
+            n = 0
+            for s in samples:
+                for sid in range(s.bs):
+                    sub = s._select_indices([sid]) if s.bs > 1 else s
+                    sample_id = sub.ids[0]
+                    if sample_id in self._slots or sample_id in self.seen_ids:
+                        logger.warning("duplicate sample id %s ignored", sample_id)
+                        continue
+                    self._slots[sample_id] = _Slot(
+                        idx=next(self._counter),
+                        sample=sub,
+                        ready_keys=set(sub.keys),
+                        consumed_by=set(),
+                        birth=time.monotonic(),
+                        sample_id=sample_id,
+                    )
+                    self.seen_ids.add(sample_id)
+                    n += 1
+            if n:
+                self._cond.notify_all()
+            return n
+
+    async def amend_batch(self, sample: SequenceSample):
+        """Merge MFC output keys into the stored samples."""
+        async with self._cond:
+            for sub in sample.unpack():
+                slot = self._slots.get(sub.ids[0])
+                if slot is None:
+                    logger.warning("amend for unknown sample %s", sub.ids[0])
+                    continue
+                slot.sample.update_(sub)
+                slot.ready_keys |= set(sub.keys)
+            self._cond.notify_all()
+
+    def _candidates(self, rpc: MFCDef) -> List[_Slot]:
+        need = set(rpc.input_keys)
+        return sorted(
+            (
+                s
+                for s in self._slots.values()
+                if rpc.name not in s.consumed_by and need <= s.ready_keys
+            ),
+            key=lambda s: s.idx,
+        )
+
+    async def get_batch_for_rpc(
+        self, rpc: MFCDef
+    ) -> Tuple[List[str], SequenceSample]:
+        """Await and consume a batch of rpc.n_seqs samples (oldest first)."""
+        async with self._cond:
+            while True:
+                cand = self._candidates(rpc)
+                if len(cand) >= rpc.n_seqs:
+                    chosen = cand[: rpc.n_seqs]
+                    for slot in chosen:
+                        slot.consumed_by.add(rpc.name)
+                    # GC slots every MFC has consumed.
+                    for slot in chosen:
+                        if len(slot.consumed_by) == self._n_rpcs:
+                            del self._slots[slot.sample_id]
+                    ids = [s.sample_id for s in chosen]
+                    batch = SequenceSample.gather([s.sample.meta() for s in chosen])
+                    return ids, batch
+                await self._cond.wait()
+
+    async def poll_ready_count(self, rpc: MFCDef) -> int:
+        async with self._cond:
+            return len(self._candidates(rpc))

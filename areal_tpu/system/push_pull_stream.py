@@ -1,0 +1,105 @@
+"""Rollout -> trainer trajectory transport: ZMQ PUSH/PULL of JSON dicts.
+
+Counterpart of the reference's push-pull stream
+(realhf/system/push_pull_stream.py:18-177): M rollout-worker pushers are
+deterministically grouped onto N trainer-side pullers, addresses are
+discovered via name_resolve, and messages are newline-free JSON objects
+(trajectories are token-id lists — cheap to serialize, and JSON keeps the
+stream debuggable, matching the reference's choice).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import zmq
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("push_pull_stream")
+
+
+class ZMQJsonPusher:
+    """PUSH end. Connects to a puller's bound address."""
+
+    def __init__(self, host: str, port: int, hwm: int = 1000):
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PUSH)
+        self.sock.setsockopt(zmq.SNDHWM, hwm)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        self.sock.connect(f"tcp://{host}:{port}")
+
+    def push(self, data: Dict[str, Any]):
+        self.sock.send_string(json.dumps(data, separators=(",", ":")), flags=0)
+
+    def close(self):
+        self.sock.close()
+
+
+class ZMQJsonPuller:
+    """PULL end. Binds and accepts many pushers."""
+
+    def __init__(self, host: str = "0.0.0.0", port: Optional[int] = None, hwm: int = 1000,
+                 default_timeout_ms: int = 100):
+        self.ctx = zmq.Context.instance()
+        self.sock = self.ctx.socket(zmq.PULL)
+        self.sock.setsockopt(zmq.RCVHWM, hwm)
+        self.sock.setsockopt(zmq.LINGER, 0)
+        if port is None:
+            self.port = self.sock.bind_to_random_port(f"tcp://{host}")
+        else:
+            self.sock.bind(f"tcp://{host}:{port}")
+            self.port = port
+        self.host = host
+        self.default_timeout_ms = default_timeout_ms
+
+    def pull(self, timeout_ms: Optional[int] = None) -> Dict[str, Any]:
+        """Blocking with timeout; raises queue-empty style TimeoutError."""
+        t = self.default_timeout_ms if timeout_ms is None else timeout_ms
+        if not self.sock.poll(t):
+            raise TimeoutError("no message within timeout")
+        return json.loads(self.sock.recv_string())
+
+    def close(self):
+        self.sock.close()
+
+
+def grouping(n_pushers: int, n_pullers: int) -> Dict[int, List[int]]:
+    """puller index -> pusher indices, contiguous blocks (reference
+    push_pull_stream.py:125)."""
+    assert n_pushers >= n_pullers > 0
+    base = n_pushers // n_pullers
+    rem = n_pushers % n_pullers
+    out: Dict[int, List[int]] = {}
+    start = 0
+    for i in range(n_pullers):
+        cnt = base + (1 if i < rem else 0)
+        out[i] = list(range(start, start + cnt))
+        start += cnt
+    return out
+
+
+class NameResolvingZmqPuller(ZMQJsonPuller):
+    """Puller that registers its address under the stream name."""
+
+    def __init__(self, experiment_name: str, trial_name: str, puller_index: int, **kwargs):
+        host_ip = network.gethostip()
+        super().__init__(host=host_ip, **kwargs)
+        key = names.push_pull_stream(
+            experiment_name, trial_name, f"puller{puller_index}"
+        )
+        name_resolve.add(key, f"{host_ip}:{self.port}", keepalive_ttl=60, replace=True)
+
+
+class NameResolvingZmqPusher(ZMQJsonPusher):
+    """Pusher that looks up its assigned puller by the grouping rule."""
+
+    def __init__(self, experiment_name: str, trial_name: str, pusher_index: int,
+                 n_pushers: int, n_pullers: int, **kwargs):
+        group = grouping(n_pushers, n_pullers)
+        puller_index = next(i for i, pushers in group.items() if pusher_index in pushers)
+        key = names.push_pull_stream(experiment_name, trial_name, f"puller{puller_index}")
+        addr = name_resolve.wait(key, timeout=300)
+        host, port = addr.rsplit(":", 1)
+        super().__init__(host, int(port), **kwargs)

@@ -1,0 +1,297 @@
+"""Worker lifecycle: configure -> poll loop -> exit, with a control server.
+
+Counterpart of the reference's worker base (realhf/system/worker_base.py:
+Worker:474, WorkerServer:71, WorkerServerStatus:36). A worker is a
+process-long poll loop; a controller reaches it through a small ZMQ REP
+command socket registered in name_resolve, and the worker mirrors its
+status there for discovery. AsyncWorker runs the same lifecycle around an
+asyncio `_poll_async`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import zmq
+
+from areal_tpu.base import logging, name_resolve, names, network
+
+logger = logging.getLogger("worker")
+
+
+class WorkerServerStatus(str, enum.Enum):
+    READY = "READY"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    COMPLETED = "COMPLETED"
+    ERROR = "ERROR"
+    EXITING = "EXITING"
+
+
+@dataclasses.dataclass
+class PollResult:
+    sample_count: int = 0
+    batch_count: int = 0
+
+
+class WorkerServer:
+    """ZMQ REP command socket + status mirror in name_resolve.
+
+    Commands (JSON): {"cmd": "configure"|"start"|"pause"|"exit"|"status",
+    "args": {...}}. Replies: {"ok": bool, "result": ...}.
+    """
+
+    def __init__(self, experiment_name: str, trial_name: str, worker_name: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.worker_name = worker_name
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.REP)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        host_ip = network.gethostip()
+        port = self._sock.bind_to_random_port(f"tcp://{host_ip}")
+        self.address = f"{host_ip}:{port}"
+        name_resolve.add(
+            names.worker(experiment_name, trial_name, worker_name),
+            self.address,
+            keepalive_ttl=120,
+            replace=True,
+        )
+        self.set_status(WorkerServerStatus.READY)
+        self._commands: "queue.Queue[Dict]" = queue.Queue()
+        self._replies: "queue.Queue[Dict]" = queue.Queue()
+        self._cmd_seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def set_status(self, status: WorkerServerStatus):
+        name_resolve.add(
+            names.worker_status(self.experiment_name, self.trial_name, self.worker_name),
+            status.value,
+            keepalive_ttl=240,
+            replace=True,
+        )
+
+    def _serve(self):
+        while not self._stop.is_set():
+            if not self._sock.poll(100):
+                continue
+            try:
+                msg = json.loads(self._sock.recv_string())
+            except Exception as e:  # malformed command
+                self._sock.send_string(json.dumps({"ok": False, "result": str(e)}))
+                continue
+            self._cmd_seq += 1
+            msg["_seq"] = self._cmd_seq
+            self._commands.put(msg)
+            # Replies are tagged with the command's sequence number so a
+            # late reply to a timed-out command can't be mistaken for the
+            # answer to the next one.
+            reply = None
+            deadline = time.monotonic() + 300
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    r = self._replies.get(timeout=1)
+                except queue.Empty:
+                    continue
+                if r.get("_seq") == self._cmd_seq:
+                    reply = r
+                    break
+                # stale reply from an earlier timed-out command: discard
+            if reply is None:
+                reply = {"ok": False, "result": "worker did not handle command"}
+            reply.pop("_seq", None)
+            self._sock.send_string(json.dumps(reply))
+
+    def try_receive_command(self) -> Optional[Dict]:
+        try:
+            cmd = self._commands.get_nowait()
+        except queue.Empty:
+            return None
+        self._pending_seq = cmd.get("_seq")
+        return cmd
+
+    def post_reply(self, ok: bool, result: Any = None):
+        self._replies.put(
+            {"ok": ok, "result": result, "_seq": getattr(self, "_pending_seq", None)}
+        )
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sock.close()
+
+
+class WorkerControl:
+    """Controller-side client for one worker's command socket."""
+
+    def __init__(self, experiment_name: str, trial_name: str, worker_name: str,
+                 timeout: float = 300.0):
+        self._addr = name_resolve.wait(
+            names.worker(experiment_name, trial_name, worker_name), timeout=timeout
+        )
+        self._ctx = zmq.Context.instance()
+        self._sock = self._make_socket()
+
+    def _make_socket(self) -> zmq.Socket:
+        sock = self._ctx.socket(zmq.REQ)
+        sock.setsockopt(zmq.LINGER, 0)
+        sock.connect(f"tcp://{self._addr}")
+        return sock
+
+    def command(self, cmd: str, timeout_ms: int = 300_000, **args) -> Any:
+        self._sock.send_string(json.dumps({"cmd": cmd, "args": args}))
+        if not self._sock.poll(timeout_ms):
+            # A REQ socket that never got its reply is stuck in the
+            # awaiting-reply state; recreate it so the client stays usable.
+            self._sock.close()
+            self._sock = self._make_socket()
+            raise TimeoutError(f"command {cmd!r} timed out")
+        reply = json.loads(self._sock.recv_string())
+        if not reply.get("ok"):
+            raise RuntimeError(f"command {cmd!r} failed: {reply.get('result')}")
+        return reply.get("result")
+
+    def close(self):
+        self._sock.close()
+
+
+def worker_status(experiment_name: str, trial_name: str, worker_name: str) -> Optional[WorkerServerStatus]:
+    try:
+        v = name_resolve.get(names.worker_status(experiment_name, trial_name, worker_name))
+        return WorkerServerStatus(v)
+    except name_resolve.NameEntryNotFoundError:
+        return None
+
+
+class Worker:
+    """Synchronous worker: subclass `_configure` and `_poll`."""
+
+    def __init__(self, server: Optional[WorkerServer] = None):
+        self._server = server
+        self._configured = False
+        self._running = False
+        self._exiting = False
+        self.config: Any = None
+        self.experiment_name = ""
+        self.trial_name = ""
+        self.worker_name = ""
+
+    # -- subclass API ---------------------------------------------------
+    def _configure(self, config) -> None:
+        raise NotImplementedError()
+
+    def _poll(self) -> PollResult:
+        raise NotImplementedError()
+
+    def _exit_hook(self):
+        pass
+
+    # -- lifecycle ------------------------------------------------------
+    def configure(self, config, experiment_name: str = "", trial_name: str = "",
+                  worker_name: str = ""):
+        self.config = config
+        self.experiment_name = experiment_name or getattr(config, "experiment_name", "")
+        self.trial_name = trial_name or getattr(config, "trial_name", "")
+        self.worker_name = worker_name or getattr(config, "worker_name", "")
+        self._configure(config)
+        self._configured = True
+        self._running = True
+        if self._server:
+            self._server.set_status(WorkerServerStatus.RUNNING)
+
+    def _handle_commands(self):
+        if not self._server:
+            return
+        msg = self._server.try_receive_command()
+        if msg is None:
+            return
+        cmd = msg.get("cmd")
+        try:
+            if cmd == "pause":
+                self._running = False
+                self._server.set_status(WorkerServerStatus.PAUSED)
+                self._server.post_reply(True)
+            elif cmd == "start":
+                self._running = True
+                self._server.set_status(WorkerServerStatus.RUNNING)
+                self._server.post_reply(True)
+            elif cmd == "exit":
+                self._exiting = True
+                self._server.post_reply(True)
+            elif cmd == "status":
+                self._server.post_reply(True, "RUNNING" if self._running else "PAUSED")
+            else:
+                self._server.post_reply(False, f"unknown command {cmd!r}")
+        except Exception as e:
+            self._server.post_reply(False, str(e))
+
+    def run(self):
+        """Poll until completion or exit command."""
+        assert self._configured, "configure() before run()"
+        logger.info("worker %s starts running", self.worker_name)
+        try:
+            while not self._exiting:
+                self._handle_commands()
+                if not self._running:
+                    time.sleep(0.05)
+                    continue
+                r = self._poll()
+                if r is None:
+                    # Subclass signalled completion.
+                    break
+                if r.batch_count == 0:
+                    time.sleep(0.002)
+            if self._server:
+                self._server.set_status(WorkerServerStatus.COMPLETED)
+        except Exception:
+            if self._server:
+                self._server.set_status(WorkerServerStatus.ERROR)
+            raise
+        finally:
+            self._exit_hook()
+
+    def exit(self):
+        self._exiting = True
+
+
+class AsyncWorker(Worker):
+    """Worker whose poll is an async coroutine (`_poll_async`)."""
+
+    async def _poll_async(self) -> PollResult:
+        raise NotImplementedError()
+
+    def run(self):
+        import asyncio
+
+        assert self._configured, "configure() before run()"
+
+        async def _loop():
+            while not self._exiting:
+                self._handle_commands()
+                if not self._running:
+                    await asyncio.sleep(0.05)
+                    continue
+                r = await self._poll_async()
+                if r is None:
+                    break
+                if r.batch_count == 0:
+                    await asyncio.sleep(0.002)
+
+        try:
+            asyncio.run(_loop())
+            if self._server:
+                self._server.set_status(WorkerServerStatus.COMPLETED)
+        except Exception:
+            if self._server:
+                self._server.set_status(WorkerServerStatus.ERROR)
+            raise
+        finally:
+            self._exit_hook()

@@ -142,3 +142,82 @@ def test_registry_construction(tmp_path, tokenizer):
         util=_util(tokenizer),
     )
     assert len(ds) == 6
+
+
+def test_loader_survives_mid_epoch_shrink(tmp_path, tokenizer):
+    """Curriculum filter shrinking the dataset mid-epoch must not crash or
+    repeat samples past the end (regression: cursor outliving a regenerated
+    permutation)."""
+    rows = fixtures.make_math_code_rows(10)
+    path = fixtures.write_jsonl(rows, tmp_path / "mc.jsonl")
+    from areal_tpu.datasets.math_code_prompt import MATHCodePromptDataset
+
+    ds = MATHCodePromptDataset(_util(tokenizer), dataset_path=path)
+    loader = data_api.PackedDataLoader(ds, batch_size=4, seed=3)
+    loader.next_batch()
+    loader.next_batch()  # cursor = 8 of 10
+    ds.active_indices = ds.active_indices[:5]  # simulate aggressive filter
+    batch, _ = loader.next_batch()  # must not crash
+    assert 1 <= batch.bs <= 4
+
+    # Checkpoint from the larger dataset restored onto the smaller one.
+    state = dict(epoch=0, cursor=8, seed=3, size=10)
+    loader.load_state_dict(state)
+    batch, _ = loader.next_batch()
+    assert 1 <= batch.bs <= 4
+
+
+def test_prompt_mask_is_exact_token_prefix(tmp_path, tokenizer):
+    """The masked prefix must decode back to exactly the prompt's tokens
+    (regression: joint tokenization merging across the boundary)."""
+    rows = fixtures.make_sft_rows(8, seed=11)
+    path = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+    from areal_tpu.datasets.prompt_answer import PromptAnswerDataset
+
+    ds = PromptAnswerDataset(_util(tokenizer), max_length=64, dataset_path=path)
+    prompt_encs = {
+        str(r["id"]): tokenizer(r["prompt"], add_special_tokens=True)["input_ids"]
+        for r in rows
+    }
+    for i in range(len(ds)):
+        s = ds[i]
+        toks = list(s.data["packed_input_ids"])
+        mask = s.data["prompt_mask"]
+        plen = int(mask.sum())
+        assert toks[:plen] == prompt_encs[s.ids[0]][:plen]
+
+
+def test_rw_paired_deterministic_reads(tmp_path, tokenizer):
+    rows = fixtures.make_rw_rows(6, seed=2)
+    path = fixtures.write_jsonl(rows, tmp_path / "rw.jsonl")
+    from areal_tpu.datasets.rw_paired import RewardModelingPairedDataset
+
+    ds = RewardModelingPairedDataset(
+        _util(tokenizer), max_length=64, max_pairs_per_prompt=2, dataset_path=path
+    )
+    for i in range(len(ds)):
+        a, b = ds[i], ds[i]
+        np.testing.assert_array_equal(
+            a.data["packed_input_ids"], b.data["packed_input_ids"]
+        )
+    # A rebuilt dataset returns identical data (recovery determinism).
+    ds2 = RewardModelingPairedDataset(
+        _util(tokenizer), max_length=64, max_pairs_per_prompt=2, dataset_path=path
+    )
+    np.testing.assert_array_equal(
+        ds[0].data["packed_input_ids"], ds2[0].data["packed_input_ids"]
+    )
+
+
+def test_auto_id_no_collision(tmp_path, tokenizer):
+    rows = [
+        {"prompt": "alpha beta", "id": 3},
+        {"prompt": "gamma delta"},  # missing id at index 1
+        {"prompt": "eps zeta"},
+    ]
+    path = fixtures.write_jsonl(rows, tmp_path / "p.jsonl")
+    part = data_api.load_shuffle_split_dataset(
+        data_api.DatasetUtility(seed=3, dp_rank=0, world_size=1, tokenizer=None), path
+    )
+    ids = [str(r["id"]) for r in part]
+    assert len(set(ids)) == 3

@@ -1,0 +1,102 @@
+"""AsyncIOSequenceBuffer semantics (mirrors reference buffer behavior:
+key readiness gates MFC batches, oldest-first, GC after full consumption)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import ModelName
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, build_graph
+from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+
+
+def _sample(i, keys=("packed_prompts",), seqlen=4):
+    data = {k: np.arange(seqlen, dtype=np.int32) for k in keys}
+    return SequenceSample.from_default(
+        ids=[f"s{i}"], seqlens=[seqlen], data=data
+    )
+
+
+def _rpcs():
+    gen = MFCDef(
+        name="gen",
+        model_name=ModelName("actor", 0),
+        interface_type=ModelInterfaceType.GENERATE,
+        interface_impl=None,
+        n_seqs=2,
+        input_keys=("packed_prompts",),
+        output_keys=("seq", "logp"),
+    )
+    train = MFCDef(
+        name="train",
+        model_name=ModelName("actor", 1),
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=2,
+        input_keys=("seq", "logp"),
+        output_keys=(),
+    )
+    build_graph([gen, train])
+    return gen, train
+
+
+def test_batch_waits_for_keys_and_gc():
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_sample(0), _sample(1), _sample(2)])
+
+        ids, batch = await buf.get_batch_for_rpc(gen)
+        assert ids == ["s0", "s1"]  # oldest first
+        assert batch.bs == 2
+
+        # train's keys aren't ready: it must block until gen's outputs land.
+        task = asyncio.create_task(buf.get_batch_for_rpc(train))
+        await asyncio.sleep(0.05)
+        assert not task.done()
+
+        out = SequenceSample.from_default(
+            ids=ids,
+            seqlens=[5, 5],
+            data={
+                "seq": np.zeros(10, dtype=np.int32),
+                "logp": np.zeros(10, dtype=np.float32),
+            },
+        )
+        await buf.amend_batch(out)
+        got_ids, _ = await asyncio.wait_for(task, timeout=5)
+        assert got_ids == ["s0", "s1"]
+        # Both RPCs consumed s0/s1 -> GC'd; s2 remains.
+        assert buf.size == 1
+
+    asyncio.run(main())
+
+
+def test_no_duplicate_consumption():
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_sample(i) for i in range(4)])
+        ids1, _ = await buf.get_batch_for_rpc(gen)
+        ids2, _ = await buf.get_batch_for_rpc(gen)
+        assert set(ids1) & set(ids2) == set()
+        # duplicate id is rejected
+        n = await buf.put_batch([_sample(0)])
+        assert n == 0
+
+    asyncio.run(main())
+
+
+def test_overflow_raises():
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train], max_size=2)
+
+    async def main():
+        with pytest.raises(RuntimeError):
+            await buf.put_batch([_sample(i) for i in range(3)])
+
+    asyncio.run(main())

@@ -1,0 +1,120 @@
+"""Request/reply + push/pull stream tests (mirrors reference
+tests/system/test_push_pull_stream.py and the req/rep protocol of
+realhf/system/request_reply_stream.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.system import push_pull_stream as pps
+from areal_tpu.system import request_reply_stream as rrs
+
+
+def test_request_reply_roundtrip(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    master = rrs.make_master_stream(exp, trial)
+    worker = rrs.make_worker_stream(exp, trial, "model_worker/0")
+
+    try:
+        [rid] = master.request(["model_worker/0"], "spec", [{"x": 1}])
+
+        # Worker sees the request and replies.
+        req = worker.poll(block=True, timeout_ms=5000)
+        assert req.handle_name == "spec"
+        assert req.data == {"x": 1}
+        worker.reply_to(req, data={"y": 2})
+
+        reply = master.poll(rid, block=True, timeout=10)
+        assert reply.data == {"y": 2}
+    finally:
+        master.close()
+        worker.close()
+
+
+def test_request_reply_syn_ack(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    master = rrs.make_master_stream(exp, trial)
+    worker = rrs.make_worker_stream(exp, trial, "model_worker/0")
+    try:
+        [rid] = master.request(
+            ["model_worker/0"], "train_step", [None], no_syn=False
+        )
+        req = worker.poll(block=True, timeout_ms=5000)
+        # Syn arrives before the (delayed) reply.
+        master.await_syn(rid, timeout=10)
+        worker.reply_to(req, data="done")
+        assert master.poll(rid, block=True, timeout=10).data == "done"
+    finally:
+        master.close()
+        worker.close()
+
+
+def test_request_reply_numpy_payload_compression(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    master = rrs.make_master_stream(exp, trial)
+    worker = rrs.make_worker_stream(exp, trial, "w0")
+    try:
+        big = np.zeros((1024, 64), dtype=np.float32)  # compresses well
+        [rid] = master.request(["w0"], "data", [big])
+        req = worker.poll(block=True, timeout_ms=5000)
+        np.testing.assert_array_equal(req.data, big)
+        worker.reply_to(req, data=req.data.sum())
+        assert master.poll(rid, block=True, timeout=10).data == 0.0
+    finally:
+        master.close()
+        worker.close()
+
+
+def test_call_many_workers(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    master = rrs.make_master_stream(exp, trial)
+    workers = [rrs.make_worker_stream(exp, trial, f"w{i}") for i in range(4)]
+
+    def serve(w):
+        req = w.poll(block=True, timeout_ms=10000)
+        w.reply_to(req, data=req.data * 2)
+
+    threads = [threading.Thread(target=serve, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    try:
+        out = master.call([f"w{i}" for i in range(4)], "double", [1, 2, 3, 4], timeout=15)
+        assert out == [2, 4, 6, 8]
+    finally:
+        for t in threads:
+            t.join(timeout=5)
+        master.close()
+        for w in workers:
+            w.close()
+
+
+def test_push_pull_grouping():
+    assert pps.grouping(4, 2) == {0: [0, 1], 1: [2, 3]}
+    assert pps.grouping(5, 2) == {0: [0, 1, 2], 1: [3, 4]}
+    g = pps.grouping(7, 3)
+    assert sorted(sum(g.values(), [])) == list(range(7))
+
+
+def test_push_pull_json(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    puller = pps.NameResolvingZmqPuller(exp, trial, puller_index=0)
+    pushers = [
+        pps.NameResolvingZmqPusher(exp, trial, pusher_index=i, n_pushers=2, n_pullers=1)
+        for i in range(2)
+    ]
+    try:
+        for i, p in enumerate(pushers):
+            p.push({"traj": [1, 2, 3], "src": i})
+        got = sorted(
+            (puller.pull(timeout_ms=5000) for _ in range(2)), key=lambda d: d["src"]
+        )
+        assert [g["src"] for g in got] == [0, 1]
+        assert got[0]["traj"] == [1, 2, 3]
+        with pytest.raises(TimeoutError):
+            puller.pull(timeout_ms=50)
+    finally:
+        puller.close()
+        for p in pushers:
+            p.close()

@@ -1,0 +1,61 @@
+"""Worker lifecycle: control commands pause/start/exit, status mirror."""
+
+import threading
+import time
+
+from areal_tpu.system.worker_base import (
+    PollResult,
+    Worker,
+    WorkerControl,
+    WorkerServer,
+    WorkerServerStatus,
+    worker_status,
+)
+
+
+class _CountingWorker(Worker):
+    def __init__(self, server):
+        super().__init__(server)
+        self.polls = 0
+
+    def _configure(self, config):
+        pass
+
+    def _poll(self):
+        self.polls += 1
+        time.sleep(0.005)
+        return PollResult(sample_count=1, batch_count=1)
+
+
+def test_worker_control_roundtrip(tmp_name_resolve, experiment_context):
+    exp, trial = experiment_context
+    server = WorkerServer(exp, trial, "w0")
+    w = _CountingWorker(server)
+    w.configure(object(), exp, trial, "w0")
+
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    try:
+        ctl = WorkerControl(exp, trial, "w0", timeout=10)
+        assert ctl.command("status", timeout_ms=5000) == "RUNNING"
+
+        ctl.command("pause", timeout_ms=5000)
+        time.sleep(0.05)
+        p0 = w.polls
+        time.sleep(0.1)
+        assert w.polls == p0  # paused: no progress
+        assert worker_status(exp, trial, "w0") == WorkerServerStatus.PAUSED
+
+        ctl.command("start", timeout_ms=5000)
+        time.sleep(0.1)
+        assert w.polls > p0
+
+        ctl.command("exit", timeout_ms=5000)
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert worker_status(exp, trial, "w0") == WorkerServerStatus.COMPLETED
+        ctl.close()
+    finally:
+        w.exit()
+        t.join(timeout=2)
+        server.close()
