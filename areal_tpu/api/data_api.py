@@ -496,6 +496,16 @@ class PackedDataLoader:
             self._order = None
         return batch, epoch_last
 
+    def restart_epoch(self):
+        """Rewind to the start of the current epoch (same permutation).
+
+        Used on crash recovery: the epoch replays from the beginning and the
+        master's ignore-list skips samples consumed before the checkpoint —
+        restoring the mid-epoch cursor instead would make those skips land
+        on the next epoch's legitimate deliveries.
+        """
+        self._cursor = 0
+
     def state_dict(self) -> Dict[str, Any]:
         return {
             "epoch": self.epoch,

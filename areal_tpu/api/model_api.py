@@ -80,6 +80,7 @@ class TrainEngine(abc.ABC):
         loss_weight_fn: Any,
         token_normalize_scope: str = "global",
         version_steps: int = 0,
+        loss_name: str = "loss",
     ) -> Dict[str, float]:
         """Run forward+backward+update over micro-batches; returns host stats."""
 

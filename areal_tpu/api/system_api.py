@@ -1,0 +1,187 @@
+"""Worker configuration dataclasses.
+
+Counterpart of the reference's system API (realhf/api/core/system_api.py:
+ModelWorker:95, MasterWorker:159, ExperimentConfig:190 and friends). A
+deployment here is: one master worker + N model workers (each driving its
+own jax mesh over local TPU devices = one DP rank of each model it hosts)
++ the async stack (rollout workers, gserver manager, generation servers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.data_api import MicroBatchSpec
+from areal_tpu.api.dfg import MFCDef
+from areal_tpu.api.model_api import GenerationHyperparameters
+
+
+@dataclasses.dataclass
+class ModelShardSpec:
+    """One model hosted on a model worker: how to build + wrap it.
+
+    `id.host_rank` is this worker's DP coordinate for the model;
+    `mesh_spec` describes the worker-local device mesh axes.
+    """
+
+    id: ModelShardID
+    model: ModelAbstraction = None
+    backend: ModelBackendAbstraction = None
+    interface: ModelInterfaceAbstraction = None
+    eval_dataset: Optional[DatasetAbstraction] = None
+    # initial HF checkpoint path (None = random init from model args)
+    model_path: Optional[str] = None
+
+
+@dataclasses.dataclass
+class ModelWorkerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    worker_index: int = 0
+    shards: List[ModelShardSpec] = dataclasses.field(default_factory=list)
+    # Dataset hosting (only on workers that serve the src MFC's model):
+    datasets: List[DatasetAbstraction] = dataclasses.field(default_factory=list)
+    tokenizer_path: Optional[str] = None
+    use_dataset_cache: bool = False
+    # dp coordinates for dataset sharding
+    dataset_dp_rank: int = 0
+    dataset_dp_size: int = 1
+    train_batch_size: int = 8
+    total_train_epochs: int = 1
+    seed: int = 1
+    # async mode: pull trajectories from rollout workers instead of a dataset
+    stream_dataset: bool = False
+    n_pullers: int = 1
+    shuffle_dataset: bool = True
+
+    @property
+    def worker_name(self) -> str:
+        return f"model_worker/{self.worker_index}"
+
+
+@dataclasses.dataclass
+class ExperimentSaveEvalControl:
+    """Frequency control (reference api/cli_args.py ExperimentSaveEvalControl)."""
+
+    total_train_epochs: int = 1
+    # Exactly one of *_freq_{epochs,steps,secs} may be set per action.
+    save_freq_epochs: Optional[int] = None
+    save_freq_steps: Optional[int] = None
+    save_freq_secs: Optional[int] = None
+    ckpt_freq_epochs: Optional[int] = None
+    ckpt_freq_steps: Optional[int] = None
+    ckpt_freq_secs: Optional[int] = None
+    eval_freq_epochs: Optional[int] = None
+    eval_freq_steps: Optional[int] = None
+    eval_freq_secs: Optional[int] = None
+    benchmark_steps: Optional[int] = None  # stop early after N steps
+
+
+@dataclasses.dataclass
+class MasterWorkerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    rpcs: List[MFCDef] = dataclasses.field(default_factory=list)
+    # model_name(str) -> list of model-worker names hosting it (DP order)
+    model_topos: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    # worker names hosting the dataset ("fetch" targets, DP order)
+    data_hosts: List[str] = dataclasses.field(default_factory=list)
+    n_model_workers: int = 1
+    train_batch_size: int = 8
+    dataset_size: int = 0
+    buffer_max_size: int = 16384
+    recover_mode: str = "disabled"  # disabled | auto | resume
+
+    @property
+    def worker_name(self) -> str:
+        return "master"
+
+
+@dataclasses.dataclass
+class GenerationServerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    server_index: int = 0
+    model_path: Optional[str] = None
+    model: ModelAbstraction = None
+    tokenizer_path: Optional[str] = None
+    max_concurrent_requests: int = 64
+    max_seq_len: int = 2048
+    kv_page_size: int = 128
+    decode_block_steps: int = 16
+    seed: int = 1
+
+    @property
+    def worker_name(self) -> str:
+        return f"generation_server/{self.server_index}"
+
+
+@dataclasses.dataclass
+class GserverManagerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    model_name: str = "actor"
+    n_servers: int = 1
+    schedule_policy: str = "round_robin"  # | least_requests | least_token_usage
+    max_head_offpolicyness: int = 0
+    train_batch_size: int = 8
+    flush_request_timeout: float = 120.0
+    max_concurrent_rollouts: Optional[int] = None
+
+    @property
+    def worker_name(self) -> str:
+        return "gserver_manager"
+
+
+@dataclasses.dataclass
+class RolloutWorkerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    worker_index: int = 0
+    n_rollout_workers: int = 1
+    n_pullers: int = 1
+    model_name: str = "actor"
+    agent: AgentAbstraction = None
+    env: EnvServiceAbstraction = None
+    datasets: List[DatasetAbstraction] = dataclasses.field(default_factory=list)
+    tokenizer_path: Optional[str] = None
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=GenerationHyperparameters
+    )
+    new_tokens_per_chunk: int = 1 << 30  # chunked interruptible generation
+    max_concurrent_rollouts: int = 32
+    rollout_request_timeout: float = 300.0
+    seed: int = 1
+
+    @property
+    def worker_name(self) -> str:
+        return f"rollout_worker/{self.worker_index}"
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    """Everything the controller needs to launch one trial."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    master: MasterWorkerConfig = None
+    model_workers: List[ModelWorkerConfig] = dataclasses.field(default_factory=list)
+    rollout_workers: List[RolloutWorkerConfig] = dataclasses.field(default_factory=list)
+    gserver_manager: Optional[GserverManagerConfig] = None
+    generation_servers: List[GenerationServerConfig] = dataclasses.field(
+        default_factory=list
+    )

@@ -122,6 +122,15 @@ class MATHCodePromptDataset:
             f"{data[i]['query_id']}@idx:{i}-{util.dp_rank}" for i in keep
         ]
         self.task_ids = [data_api.RL_TASKS.index(data[i].get("task", "math")) for i in keep]
+        self.tasks = [data[i]["task"] for i in keep]
+        self.query_ids = [data[i]["query_id"] for i in keep]
+        # What the reward verifier needs per prompt: reference answers for
+        # math, testcases for code (reference keeps a global id2info instead;
+        # carrying it in sample metadata keeps the reward worker stateless).
+        self.answer_infos = [
+            data[i]["input_output"] if data[i]["task"] == "code" else data[i]["solutions"]
+            for i in keep
+        ]
         self.base_scores = (
             [float(np.mean(data[i]["scores"])) for i in keep]
             if has_base_scores
@@ -149,6 +158,11 @@ class MATHCodePromptDataset:
             ids=[self.ids[idx]],
             seqlens=[self.prompt_lengths[idx]],
             data=d,
+            metadata=dict(
+                tasks=[self.tasks[idx]],
+                solutions=[self.answer_infos[idx]],
+                query_ids=[self.query_ids[idx]],
+            ),
         )
 
     def filter(self, eval_scores: Dict[Hashable, float]):

@@ -1,0 +1,66 @@
+"""Engine state checkpointing (recover checkpoints).
+
+Counterpart of the reference's backend save/load
+(realhf/impl/model/backend/megatron.py:711-760: optimizer + param state
+for fault recovery; persistent HF-format saves are a separate path via
+the interfaces). State = params pytree + optax opt state + step counter,
+written with numpy-on-host pickle. Single-host per-worker files; each
+model worker saves only its own shard's state.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("checkpoint")
+
+_STATE_FILE = "engine_state.pkl"
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_engine_state(engine, save_dir: str):
+    os.makedirs(save_dir, exist_ok=True)
+    state = {
+        "params": _to_host(engine.params),
+        "opt_state": _to_host(engine.opt_state) if engine.opt_state is not None else None,
+        "version": engine.version,
+    }
+    tmp = os.path.join(save_dir, _STATE_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, os.path.join(save_dir, _STATE_FILE))
+    logger.info(f"saved engine state to {save_dir}")
+
+
+def load_engine_state(engine, load_dir: str):
+    path = os.path.join(load_dir, _STATE_FILE)
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+    engine.set_params(state["params"])
+    if state["opt_state"] is not None and engine.opt_state is not None:
+        # Restore optimizer state with the engine's shardings.
+        ref = engine.opt_state
+        flat_new, treedef = jax.tree_util.tree_flatten(state["opt_state"])
+        flat_ref = jax.tree_util.tree_leaves(ref)
+        assert len(flat_new) == len(flat_ref), "optimizer state shape mismatch"
+        restored = [
+            jax.device_put(n, r.sharding) if hasattr(r, "sharding") else n
+            for n, r in zip(flat_new, flat_ref)
+        ]
+        engine.opt_state = jax.tree_util.tree_unflatten(treedef, restored)
+    engine.version = int(state.get("version", 0))
+    logger.info(f"loaded engine state from {load_dir}")
+
+
+def has_engine_state(load_dir: str) -> bool:
+    return os.path.exists(os.path.join(load_dir, _STATE_FILE))

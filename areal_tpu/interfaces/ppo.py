@@ -85,6 +85,8 @@ class PPOActorInterface(ModelInterface):
     )
 
     def __post_init__(self):
+        if isinstance(self.gconfig, dict):
+            self.gconfig = GenerationHyperparameters(**self.gconfig)
         if self.adaptive_kl_ctl:
             self.kl_controller = F.AdaptiveKLController(
                 self.kl_ctl, self.adaptive_kl_target, self.adaptive_kl_horizon

@@ -9,7 +9,10 @@ JAX engines (reference: realhf/system/__init__.py:17-23).
 import importlib
 
 # worker type -> (module, class); grown as worker roles are implemented.
-_WORKER_CLASSES = {}
+_WORKER_CLASSES = {
+    "master_worker": ("areal_tpu.system.master_worker", "MasterWorker"),
+    "model_worker": ("areal_tpu.system.model_worker", "ModelWorker"),
+}
 
 WORKER_TYPES = sorted(_WORKER_CLASSES)
 

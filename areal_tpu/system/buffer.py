@@ -49,8 +49,14 @@ class AsyncIOSequenceBuffer:
         self._slots: Dict[str, _Slot] = {}  # sample_id -> slot
         self._counter = itertools.count()
         self._cond = asyncio.Condition()
-        # ids ever inserted, for exactly-once accounting on recovery
-        self.seen_ids: Set[str] = set()
+        # Dedup is against RESIDENT ids only: multi-epoch training re-puts
+        # the same dataset row ids each epoch, which is legal. Exactly-once
+        # across a crash is handled by `ignore_ids` (seeded from recover
+        # info): each listed id is skipped once — its pre-crash consumption
+        # — then becomes valid again for later epochs.
+        self.ignore_ids: Set[str] = set()
+        # ids fully consumed since the last epoch boundary (recover dump).
+        self.consumed_this_epoch: Set[str] = set()
 
     def __len__(self):
         return len(self._slots)
@@ -69,7 +75,7 @@ class AsyncIOSequenceBuffer:
                 1
                 for s in samples
                 for i in range(s.bs)
-                if s.ids[i] not in self._slots and s.ids[i] not in self.seen_ids
+                if s.ids[i] not in self._slots and s.ids[i] not in self.ignore_ids
             )
             if len(self._slots) + n_new > self._max_size:
                 raise RuntimeError(
@@ -81,8 +87,12 @@ class AsyncIOSequenceBuffer:
                 for sid in range(s.bs):
                     sub = s._select_indices([sid]) if s.bs > 1 else s
                     sample_id = sub.ids[0]
-                    if sample_id in self._slots or sample_id in self.seen_ids:
-                        logger.warning("duplicate sample id %s ignored", sample_id)
+                    if sample_id in self.ignore_ids:
+                        # consumed before a crash; skip exactly once
+                        self.ignore_ids.discard(sample_id)
+                        continue
+                    if sample_id in self._slots:
+                        logger.warning("duplicate resident id %s ignored", sample_id)
                         continue
                     self._slots[sample_id] = _Slot(
                         idx=next(self._counter),
@@ -92,7 +102,6 @@ class AsyncIOSequenceBuffer:
                         birth=time.monotonic(),
                         sample_id=sample_id,
                     )
-                    self.seen_ids.add(sample_id)
                     n += 1
             if n:
                 self._cond.notify_all()
@@ -136,11 +145,23 @@ class AsyncIOSequenceBuffer:
                     for slot in chosen:
                         if len(slot.consumed_by) == self._n_rpcs:
                             del self._slots[slot.sample_id]
+                            self.consumed_this_epoch.add(slot.sample_id)
                     ids = [s.sample_id for s in chosen]
-                    batch = SequenceSample.gather([s.sample.meta() for s in chosen])
+                    # Restrict to the rpc's input keys: candidates may have
+                    # heterogeneous extra keys (amended at different times),
+                    # and gather requires a common key set.
+                    keys = list(rpc.input_keys) or None
+                    batch = SequenceSample.gather(
+                        [s.sample.meta() for s in chosen], keys=keys
+                    )
                     return ids, batch
                 await self._cond.wait()
 
     async def poll_ready_count(self, rpc: MFCDef) -> int:
         async with self._cond:
             return len(self._candidates(rpc))
+
+    def on_epoch_boundary(self):
+        """Epoch rolled over: prior consumptions are no longer 'this epoch'
+        for recovery accounting."""
+        self.consumed_this_epoch.clear()

@@ -1,0 +1,191 @@
+"""Experiment controller: spawn workers, run the master, reap results.
+
+Counterpart of the reference's controller (realhf/system/controller.py:
+98-689) in its "local" form: every worker is a separate OS process
+(multiprocessing spawn so each gets a clean JAX runtime), the master runs
+inline in the controller process, and worker health is watched while the
+master drives the experiment. This is also the in-process e2e test
+harness (reference tests/experiments/utils.py:22-52).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing as mp
+import os
+import sys
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.system_api import ExperimentConfig
+from areal_tpu.base import constants, logging, name_resolve, names
+
+logger = logging.getLogger("controller")
+
+
+def _run_worker_proc(
+    worker_type: str,
+    config: Any,
+    name_resolve_cfg: Dict,
+    env: Dict[str, str],
+    error_queue,
+):
+    """Subprocess entry: reconfigure name_resolve, build + run the worker."""
+    try:
+        os.environ.update(env)
+        # Force CPU platform if requested before jax initializes devices.
+        if env.get("JAX_PLATFORMS"):
+            import jax
+
+            jax.config.update("jax_platforms", env["JAX_PLATFORMS"])
+        name_resolve.reconfigure(**name_resolve_cfg)
+        from areal_tpu.system import load_worker
+
+        cls = load_worker(worker_type)
+        w = cls()
+        w.configure(
+            config,
+            experiment_name=config.experiment_name,
+            trial_name=config.trial_name,
+            worker_name=config.worker_name,
+        )
+        w.run()
+    except Exception:
+        error_queue.put(
+            f"{worker_type}/{getattr(config, 'worker_index', '?')}: "
+            + traceback.format_exc()
+        )
+        raise
+
+
+class LocalController:
+    """Run one trial on this host: subprocess workers + inline master."""
+
+    def __init__(
+        self,
+        exp_cfg: ExperimentConfig,
+        name_resolve_cfg: Optional[Dict] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.exp_cfg = exp_cfg
+        self.name_resolve_cfg = name_resolve_cfg or {"backend": "nfs"}
+        self.worker_env = worker_env or {}
+        self._procs: List[mp.Process] = []
+        self._ctx = mp.get_context("spawn")
+        self._errors = self._ctx.Queue()
+
+    def _spawn(self, worker_type: str, config):
+        # Spawned children must be able to import areal_tpu before the
+        # target function runs (unpickling imports this module), so the
+        # repo root has to be on PYTHONPATH at process start.
+        import areal_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(areal_tpu.__file__)))
+        existing = os.environ.get("PYTHONPATH", "")
+        if repo_root not in existing.split(os.pathsep):
+            os.environ["PYTHONPATH"] = (
+                repo_root + (os.pathsep + existing if existing else "")
+            )
+        p = self._ctx.Process(
+            target=_run_worker_proc,
+            args=(
+                worker_type,
+                config,
+                self.name_resolve_cfg,
+                self.worker_env,
+                self._errors,
+            ),
+            daemon=True,
+        )
+        p.start()
+        self._procs.append(p)
+        return p
+
+    def start_workers(self):
+        from areal_tpu.system import _WORKER_CLASSES
+
+        async_types = ["generation_server", "gserver_manager", "rollout_worker"]
+        wants_async = bool(
+            self.exp_cfg.generation_servers
+            or self.exp_cfg.gserver_manager
+            or self.exp_cfg.rollout_workers
+        )
+        missing = [t for t in async_types if t not in _WORKER_CLASSES]
+        if wants_async and missing:
+            raise NotImplementedError(
+                f"async worker roles not available yet: {missing}"
+            )
+        for cfg in self.exp_cfg.model_workers:
+            self._spawn("model_worker", cfg)
+        for cfg in self.exp_cfg.generation_servers:
+            self._spawn("generation_server", cfg)
+        if self.exp_cfg.gserver_manager is not None:
+            self._spawn("gserver_manager", self.exp_cfg.gserver_manager)
+        for cfg in self.exp_cfg.rollout_workers:
+            self._spawn("rollout_worker", cfg)
+
+    def check_worker_errors(self):
+        try:
+            err = self._errors.get_nowait()
+        except Exception:
+            return
+        raise RuntimeError(f"worker failed:\n{err}")
+
+    def _watchdog(self, stop_event):
+        """Interrupt the inline master as soon as any worker dies, so its
+        real traceback surfaces instead of a later stream timeout."""
+        import _thread
+
+        while not stop_event.wait(0.5):
+            failed = not self._errors.empty() or any(
+                (not p.is_alive()) and p.exitcode not in (0, None)
+                for p in self._procs
+            )
+            if failed:
+                logger.error("worker failure detected; interrupting master")
+                _thread.interrupt_main()
+                return
+
+    def run(self, timeout: Optional[float] = None) -> Dict:
+        """Blocking: start workers, run master inline, join everything."""
+        import threading
+
+        name_resolve.reconfigure(**self.name_resolve_cfg)
+        self.start_workers()
+        stop_watchdog = threading.Event()
+        watchdog = threading.Thread(
+            target=self._watchdog, args=(stop_watchdog,), daemon=True
+        )
+        watchdog.start()
+
+        from areal_tpu.system.master_worker import MasterWorker
+
+        master = MasterWorker()
+        try:
+            master.configure(
+                self.exp_cfg.master,
+                experiment_name=self.exp_cfg.experiment_name,
+                trial_name=self.exp_cfg.trial_name,
+                worker_name="master",
+            )
+            master.run()
+        except KeyboardInterrupt:
+            # Likely the watchdog; surface the worker's traceback if any.
+            self.check_worker_errors()
+            raise RuntimeError("a worker process died (no traceback captured)")
+        finally:
+            stop_watchdog.set()
+            self.check_worker_errors()
+            self.join(timeout=30)
+        return {"global_step": master.step_info.global_step}
+
+    def join(self, timeout: float = 30):
+        deadline = time.monotonic() + timeout
+        for p in self._procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+        for p in self._procs:
+            if p.is_alive():
+                logger.warning(f"terminating straggler worker pid={p.pid}")
+                p.terminate()
+        self._procs.clear()

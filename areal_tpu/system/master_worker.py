@@ -1,0 +1,205 @@
+"""Master worker: drives one DFG traversal per train step.
+
+Counterpart of the reference's MasterWorker
+(realhf/system/master_worker.py:49-606): configure streams + buffer +
+executor, then per poll run a step, manage save/eval/ckpt frequency
+control, publish step/experiment status, and dump recover info.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.api.dfg import build_graph
+from areal_tpu.api.system_api import MasterWorkerConfig
+from areal_tpu.base import constants, logging, name_resolve, names, recover, timeutil
+from areal_tpu.base.recover import RecoverInfo, StepInfo
+from areal_tpu.system import request_reply_stream as rrs
+from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+from areal_tpu.system.function_executor import FunctionExecutor
+from areal_tpu.system.model_function_call import RPCCorountineControl
+from areal_tpu.system.worker_base import PollResult, Worker
+
+logger = logging.getLogger("master_worker")
+
+
+class MasterWorker(Worker):
+    def _configure(self, config: MasterWorkerConfig):
+        self.cfg = config
+        constants.set_experiment_trial_names(
+            config.experiment_name, config.trial_name
+        )
+        self.stream = rrs.make_master_stream(
+            config.experiment_name, config.trial_name
+        )
+        self.graph = build_graph(config.rpcs)
+        self.buffer = AsyncIOSequenceBuffer(
+            config.rpcs, max_size=config.buffer_max_size
+        )
+        self.ctrl = RPCCorountineControl()
+        self.executor = FunctionExecutor(
+            graph=self.graph,
+            stream=self.stream,
+            buffer=self.buffer,
+            model_topos=config.model_topos,
+            data_hosts=config.data_hosts,
+            ctrl=self.ctrl,
+            experiment_name=config.experiment_name,
+            trial_name=config.trial_name,
+        )
+
+        ctl = config.exp_ctrl
+        self.save_ctl = timeutil.FrequencyControl(
+            frequency_epoch=ctl.save_freq_epochs,
+            frequency_step=ctl.save_freq_steps,
+            frequency_sec=ctl.save_freq_secs,
+        )
+        self.ckpt_ctl = timeutil.FrequencyControl(
+            frequency_epoch=ctl.ckpt_freq_epochs,
+            frequency_step=ctl.ckpt_freq_steps,
+            frequency_sec=ctl.ckpt_freq_secs,
+        )
+        self.eval_ctl = timeutil.FrequencyControl(
+            frequency_epoch=ctl.eval_freq_epochs,
+            frequency_step=ctl.eval_freq_steps,
+            frequency_sec=ctl.eval_freq_secs,
+        )
+
+        self.step_info = StepInfo()
+        self._steps_per_epoch = max(
+            1, config.dataset_size // max(1, config.train_batch_size)
+        ) if config.dataset_size else None
+        self._total_steps_cap = ctl.benchmark_steps
+        self._start_time = time.monotonic()
+
+        # Wait for every model worker to finish its lazy setup.
+        handlers = [f"model_worker/{i}" for i in range(config.n_model_workers)]
+        specs = self.stream.call(handlers, "spec", timeout=600)
+        self._dataset_size = sum(
+            s.get("dataset_size", 0) for s in specs if isinstance(s, dict)
+        )
+        if self._dataset_size and not self._steps_per_epoch:
+            self._steps_per_epoch = max(
+                1, self._dataset_size // max(1, config.train_batch_size)
+            )
+        logger.info(
+            f"master configured: {len(config.rpcs)} MFCs, "
+            f"{config.n_model_workers} model workers, "
+            f"dataset size {self._dataset_size}"
+        )
+
+        if config.recover_mode in ("auto", "resume"):
+            self._maybe_recover()
+
+        name_resolve.add(
+            names.experiment_status(config.experiment_name, config.trial_name),
+            "RUNNING",
+            replace=True,
+        )
+
+    # ------------------------------------------------------------------
+
+    def _maybe_recover(self):
+        try:
+            info = recover.load(self.cfg.experiment_name, self.cfg.trial_name)
+        except FileNotFoundError:
+            logger.info("no recover info found; fresh start")
+            return
+        self.step_info = info.last_step_info.next()
+        self.save_ctl.load_state_dict(info.save_ctl_info)
+        self.ckpt_ctl.load_state_dict(info.ckpt_ctl_info)
+        self.eval_ctl.load_state_dict(info.eval_ctl_info)
+        self.buffer.ignore_ids |= set(info.hash_vals_to_ignore)
+        req = self.stream.request(
+            self.cfg.data_hosts + self._all_model_workers(),
+            "restore",
+            [None] * (len(self.cfg.data_hosts) + len(self._all_model_workers())),
+        )
+        self.stream.gather(req, timeout=600)
+        logger.info(f"recovered at step {self.step_info.global_step}")
+
+    def _all_model_workers(self) -> List[str]:
+        return [f"model_worker/{i}" for i in range(self.cfg.n_model_workers)]
+
+    def _recover_save(self):
+        info = RecoverInfo(
+            recover_start=self.step_info,
+            last_step_info=self.step_info,
+            save_ctl_info=self.save_ctl.state_dict(),
+            ckpt_ctl_info=self.ckpt_ctl.state_dict(),
+            eval_ctl_info=self.eval_ctl.state_dict(),
+            hash_vals_to_ignore=sorted(self.buffer.consumed_this_epoch),
+        )
+        recover.dump(info, self.cfg.experiment_name, self.cfg.trial_name)
+
+    def _broadcast(self, handle: str, timeout: float = 3600):
+        workers = self._all_model_workers()
+        return self.stream.call(workers, handle, timeout=timeout)
+
+    # ------------------------------------------------------------------
+
+    def _poll(self) -> Optional[PollResult]:
+        t0 = time.monotonic()
+        epoch_before = self.step_info.epoch
+
+        stats = self.executor.execute_step_sync()
+
+        epoch_boundary = self.executor.epoch_done
+        self.step_info.epoch_step += 1
+        self.step_info.global_step += 1
+        if epoch_boundary:
+            self.step_info.epoch += 1
+            self.step_info.epoch_step = 0
+            self.buffer.on_epoch_boundary()
+
+        e2e = time.monotonic() - t0
+        logger.info(
+            f"step {self.step_info.global_step} "
+            f"(epoch {self.step_info.epoch}.{self.step_info.epoch_step}) "
+            f"e2e={e2e:.3f}s stats={ {k: {kk: round(vv, 5) for kk, vv in v.items()} for k, v in stats.items()} }"
+        )
+
+        epochs_inc = self.step_info.epoch - epoch_before
+        if self.save_ctl.check(steps=1, epochs=epochs_inc):
+            self._broadcast("save")
+        if self.ckpt_ctl.check(steps=1, epochs=epochs_inc):
+            self._broadcast("ckpt")
+            self._recover_save()
+        if self.eval_ctl.check(steps=1, epochs=epochs_inc):
+            self._broadcast("evaluate")
+
+        done = False
+        if self._total_steps_cap is not None:
+            done = self.step_info.global_step >= self._total_steps_cap
+        elif self.step_info.epoch >= self.cfg.exp_ctrl.total_train_epochs:
+            done = True
+        if done:
+            self.experiment_complete_exit()
+            return None
+        return PollResult(sample_count=1, batch_count=1)
+
+    def experiment_complete_exit(self):
+        """Signal completion + tell workers to exit (reference
+        master_worker.py:538)."""
+        logger.info(
+            f"experiment complete after {self.step_info.global_step} steps "
+            f"({time.monotonic() - self._start_time:.1f}s)"
+        )
+        name_resolve.add(
+            names.experiment_status(
+                self.cfg.experiment_name, self.cfg.trial_name
+            ),
+            "COMPLETE",
+            replace=True,
+        )
+        try:
+            self._broadcast("exit", timeout=60)
+        except Exception:
+            logger.warning("some workers did not ack exit", exc_info=True)
+
+    def _exit_hook(self):
+        try:
+            self.stream.close()
+        except Exception:
+            pass

@@ -1,0 +1,197 @@
+"""Master-side per-MFC coroutine.
+
+Counterpart of the reference's ModelFunctionCall
+(realhf/system/model_function_call.py:54-509): acquire a batch from the
+buffer once its input keys are ready, split it across the model's DP
+workers (token-balanced FFD, or by sequence count for generation),
+derive a data-transfer plan, ship requests with hooks, gather replies,
+and amend the buffer with output metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, OffloadHook, ParamReallocHook, SaveHook, EvaluateHook
+from areal_tpu.base import datapack, logging, stats_tracker
+from areal_tpu.system import request_reply_stream as rrs
+from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+from areal_tpu.system.redistributor import GlobalStorageTracker, RedistribPlanner
+
+logger = logging.getLogger("mfc")
+
+
+@dataclasses.dataclass
+class RPCCorountineControl:
+    """Shared step state (reference model_function_call.py:32)."""
+
+    step_info: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {"epoch": 0, "epoch_step": 0, "global_step": 0}
+    )
+    train_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    used_ids: set = dataclasses.field(default_factory=set)
+
+
+async def async_poll(stream, request_id: str, timeout: Optional[float] = None):
+    """Await one reply on a synchronous request client without blocking the
+    event loop."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        try:
+            return stream.poll(request_id, block=False)
+        except rrs.NoMessage:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no reply for {request_id}")
+            await asyncio.sleep(0.002)
+
+
+def _hook_dict(h) -> Dict:
+    if isinstance(h, SaveHook):
+        return {"type": "save"}
+    if isinstance(h, EvaluateHook):
+        return {"type": "evaluate"}
+    if isinstance(h, OffloadHook):
+        return {"type": "offload"}
+    if isinstance(h, ParamReallocHook):
+        return {
+            "type": "param_realloc",
+            "source": str(h.source) if h.source else None,
+            "target": str(h.target) if h.target else None,
+            "eta": h.eta,
+        }
+    if isinstance(h, dict):
+        return h
+    raise ValueError(f"unknown hook {h!r}")
+
+
+class ModelFunctionCall:
+    def __init__(
+        self,
+        rpc: MFCDef,
+        stream,  # NameResolvingRequestClient
+        buffer: AsyncIOSequenceBuffer,
+        tracker: GlobalStorageTracker,
+        planner: RedistribPlanner,
+        workers: List[str],  # DP-ordered model worker names for rpc's model
+        ctrl: RPCCorountineControl,
+    ):
+        self.rpc = rpc
+        self.stream = stream
+        self.buffer = buffer
+        self.tracker = tracker
+        self.planner = planner
+        self.workers = workers
+        self.ctrl = ctrl
+
+    # ------------------------------------------------------------------
+
+    def data_parallel_dispatch(self, ids: List[str], batch: SequenceSample):
+        """Partition sample ids across DP workers.
+
+        Generation balances by sequence count (decode steps dominate);
+        everything else balances by token count via FFD bin packing
+        (reference model_function_call.py:276-290).
+        """
+        n_dp = len(self.workers)
+        if self.rpc.balanced_dp or self.rpc.interface_type == ModelInterfaceType.GENERATE:
+            lens = [1] * len(ids)
+        else:
+            lens = [batch.sample_total_len(i) for i in range(batch.bs)]
+        parts = datapack.balanced_partition(lens, n_dp)
+        return [[ids[i] for i in p] for p in parts]
+
+    async def run_step(self) -> Optional[Dict]:
+        rpc = self.rpc
+        ids, batch = await self.buffer.get_batch_for_rpc(rpc)
+        self.ctrl.used_ids |= set(ids)
+
+        assignments = self.data_parallel_dispatch(ids, batch)
+        dests = {
+            w: part for w, part in zip(self.workers, assignments) if part
+        }
+        plan = self.planner.derive_plan(dests, list(rpc.input_keys))
+
+        handlers, datas, pre_hooks, post_hooks = [], [], [], []
+        for w, part in dests.items():
+            worker_steps = [
+                dataclasses.asdict(s) for s in plan if s.dst == w
+            ]
+            handlers.append(w)
+            datas.append(
+                dict(
+                    mfc_name=rpc.name,
+                    model_name=str(rpc.model_name),
+                    interface_type=rpc.interface_type.value,
+                    ids=part,
+                    input_keys=list(rpc.input_keys),
+                    input_key_remap=dict(rpc.input_key_remap),
+                    output_key_remap=dict(rpc.output_key_remap),
+                    mb_spec=dataclasses.asdict(rpc.mb_spec),
+                    plan=worker_steps,
+                    step_info=dict(self.ctrl.step_info),
+                )
+            )
+            pre_hooks.append([_hook_dict(h) for h in rpc.pre_hooks])
+            post_hooks.append([_hook_dict(h) for h in rpc.post_hooks])
+
+        req_ids = self.stream.request(
+            handlers,
+            "mfc",
+            datas,
+            pre_hooks=pre_hooks,
+            post_hooks=post_hooks,
+        )
+        t0 = time.monotonic()
+        replies = await asyncio.gather(
+            *[async_poll(self.stream, rid) for rid in req_ids]
+        )
+        elapsed = time.monotonic() - t0
+
+        # Collect outputs / stats.
+        stats_list: List[Dict] = []
+        out_metas: List[SequenceSample] = []
+        for p in replies:
+            if isinstance(p.data, dict) and p.data.get("error"):
+                raise RuntimeError(
+                    f"MFC {rpc.name} failed on {p.sender}: {p.data['error']}"
+                )
+            if p.data.get("output_meta") is not None:
+                out_metas.append(p.data["output_meta"])
+            if p.data.get("stats"):
+                stats_list.append(p.data["stats"])
+        stats: Dict[str, Any] = {}
+        _ADDITIVE = ("n_tokens", "n_mbs", "n_seqs", "count")
+        if stats_list:
+            for k in stats_list[0]:
+                vals = [s[k] for s in stats_list if k in s and s[k] is not None]
+                if vals and isinstance(vals[0], (int, float)):
+                    # Additive counters sum across DP workers; everything
+                    # else (losses, norms) is averaged.
+                    if k.endswith(_ADDITIVE):
+                        stats[k] = float(np.sum(vals))
+                    else:
+                        stats[k] = float(np.mean(vals))
+
+        if out_metas:
+            merged = SequenceSample.gather(out_metas)
+            # Track new data locations.
+            for p in replies:
+                om = p.data.get("output_meta")
+                if om is not None:
+                    self.tracker.add_batch(list(om.ids), list(om.keys), p.sender)
+            if not rpc.is_dst:
+                await self.buffer.amend_batch(merged)
+
+        logger.debug(
+            f"MFC {rpc.name}: {len(ids)} seqs on {len(dests)} workers "
+            f"in {elapsed:.3f}s"
+        )
+        if rpc.interface_type == ModelInterfaceType.TRAIN_STEP:
+            self.ctrl.train_stats[rpc.name] = stats
+        return stats

@@ -1,0 +1,399 @@
+"""Model worker: hosts model shards + datasets, executes MFCs.
+
+Counterpart of the reference's ModelWorker
+(realhf/system/model_worker.py:101-1610). One model worker drives one
+jax mesh (its local TPU devices) and acts as one DP rank of every model
+it hosts. Request handlers:
+
+- "spec": dataset size + readiness handshake
+- "fetch": next dataloader batch -> DataManager, reply metadata
+- "mfc": execute pre-hooks (data_transfer pulls, param_realloc, ...),
+  assemble the input batch, run the interface method under
+  `constants.model_scope`, store outputs, reply meta + stats
+- "save"/"ckpt"/"evaluate"/"restore": persistence + recovery
+- "clear_data_cache": per-step sample GC
+- "exit": leave the poll loop
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from areal_tpu.api import data_api
+from areal_tpu.api.config import ModelName
+from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.api.model_api import (
+    FinetuneSpec,
+    Model,
+    make_backend,
+    make_interface,
+    make_model,
+)
+from areal_tpu.api.system_api import ModelWorkerConfig
+from areal_tpu.base import constants, logging, name_resolve, names, seeding, timeutil
+from areal_tpu.system import request_reply_stream as rrs
+from areal_tpu.system.data_manager import DataManager
+from areal_tpu.system.redistributor import RedistribStep
+from areal_tpu.system.worker_base import PollResult, Worker
+
+logger = logging.getLogger("model_worker")
+
+
+class ModelWorker(Worker):
+    def _configure(self, config: ModelWorkerConfig):
+        self.cfg = config
+        constants.set_experiment_trial_names(
+            config.experiment_name, config.trial_name
+        )
+        seeding.set_random_seed(config.seed, config.worker_name)
+        # Import factories/interfaces so registries are populated.
+        import areal_tpu.engine.factories  # noqa: F401
+        import areal_tpu.interfaces  # noqa: F401
+        import areal_tpu.datasets  # noqa: F401
+
+        self.stream = rrs.make_worker_stream(
+            config.experiment_name, config.trial_name, config.worker_name
+        )
+        self.data_manager = DataManager(
+            config.experiment_name, config.trial_name, config.worker_name
+        )
+
+        # Datasets (only on data-hosting workers).
+        self.dataloader = None
+        self._dataset = None
+        if config.stream_dataset:
+            from areal_tpu.system.stream_dataset import PullerStreamDataset
+
+            self._dataset = PullerStreamDataset(
+                config.experiment_name,
+                config.trial_name,
+                puller_index=config.dataset_dp_rank,
+            )
+            self.dataloader = None
+        elif config.datasets:
+            tokenizer = (
+                data_api.load_hf_tokenizer(config.tokenizer_path)
+                if config.tokenizer_path
+                else None
+            )
+            util = data_api.DatasetUtility(
+                seed=config.seed,
+                dp_rank=config.dataset_dp_rank,
+                world_size=config.dataset_dp_size,
+                tokenizer=tokenizer,
+            )
+            datasets = [
+                data_api.make_dataset(d, util) for d in config.datasets
+            ]
+            self._dataset = (
+                datasets[0]
+                if len(datasets) == 1
+                else data_api.ConcatDataset(datasets)
+                if hasattr(data_api, "ConcatDataset")
+                else datasets[0]
+            )
+            self.dataloader = data_api.PackedDataLoader(
+                self._dataset,
+                batch_size=max(
+                    1, config.train_batch_size // config.dataset_dp_size
+                ),
+                shuffle=config.shuffle_dataset,
+                seed=config.seed,
+            )
+
+        # Models.
+        self.models: Dict[str, Model] = {}
+        self.interfaces: Dict[str, Any] = {}
+        self.backends: Dict[str, Any] = {}
+        dataset_size = len(self._dataset) * config.dataset_dp_size if self._dataset is not None else 0
+        for shard in config.shards:
+            mn = shard.id.model_name
+            ft_spec = FinetuneSpec(
+                total_train_epochs=config.total_train_epochs,
+                dataset_size=dataset_size,
+                train_batch_size=config.train_batch_size,
+            )
+            model = make_model(shard.model, name=mn)
+            backend = make_backend(shard.backend)
+            model = backend.initialize(model, ft_spec)
+            self.models[str(mn)] = model
+            self.backends[str(mn)] = backend
+            self.interfaces[str(mn)] = make_interface(shard.interface)
+        logger.info(
+            f"{config.worker_name} configured: models={list(self.models)}, "
+            f"dataset_size={dataset_size}"
+        )
+
+    # ------------------------------------------------------------------
+    # Handlers
+    # ------------------------------------------------------------------
+
+    def _handle_spec(self, req):
+        # LOCAL size only; the master sums across data hosts.
+        local = len(self._dataset) if self._dataset is not None else 0
+        return {"dataset_size": local, "models": list(self.models)}
+
+    def _handle_fetch(self, req):
+        if self.dataloader is None and self._dataset is None:
+            return {"meta": None, "epoch_done": False}
+        if self.dataloader is not None:
+            batch, epoch_done = self.dataloader.next_batch()
+        else:
+            batch = self._dataset.poll_batch()
+            epoch_done = False
+            if batch is None:
+                return {"meta": None, "epoch_done": False}
+        self.data_manager.store(batch)
+        return {"meta": batch.meta(), "epoch_done": epoch_done}
+
+    def _exec_hook(self, hook: Dict, model_name: str, step: int = 0):
+        htype = hook.get("type")
+        if htype == "data_transfer":
+            steps = [RedistribStep(**s) for s in hook["plan"]]
+            self.data_manager.redistribute(steps)
+        elif htype == "save":
+            self._save_model(model_name)
+        elif htype == "evaluate":
+            self._evaluate_model(model_name)
+        elif htype == "offload":
+            logger.debug("offload hook: params stay sharded on TPU; no-op")
+        elif htype == "param_realloc":
+            self._param_realloc(hook, step)
+        else:
+            raise ValueError(f"unknown hook {hook!r}")
+
+    def _handle_mfc(self, req) -> Dict:
+        d = req.data
+        model_name = d["model_name"]
+        model = self.models[model_name]
+        interface = self.interfaces[model_name]
+
+        step = int(d.get("step_info", {}).get("global_step", 0))
+        # Pre-hooks: data transfer plan is embedded in the request.
+        if d.get("plan"):
+            self.data_manager.redistribute(
+                [RedistribStep(**s) for s in d["plan"]]
+            )
+        for hook in req.pre_hooks:
+            self._exec_hook(hook, model_name, step)
+
+        input_ = self.data_manager.gather(d["ids"], d["input_keys"])
+        if d.get("input_key_remap"):
+            input_.remap_keys_(d["input_key_remap"])
+        mb_spec = MicroBatchSpec(**d["mb_spec"])
+
+        itype = d["interface_type"]
+        mn = ModelName.parse(model_name)
+        with constants.model_scope(mn):
+            if itype == "generate":
+                out = interface.generate(model, input_, mb_spec)
+                stats = {}
+            elif itype == "inference":
+                out = interface.inference(model, input_, mb_spec)
+                stats = {}
+            elif itype == "train_step":
+                res = interface.train_step(model, input_, mb_spec)
+                out = None
+                stats = res[-1] if isinstance(res, list) else res
+                # Interfaces own model.inc_version(); the worker only
+                # publishes the new version for the staleness gate.
+                self._publish_version(mn)
+            else:
+                raise ValueError(f"bad interface_type {itype!r}")
+
+        output_meta = None
+        if out is not None:
+            if d.get("output_key_remap"):
+                out.remap_keys_(d["output_key_remap"])
+            self.data_manager.store(out)
+            output_meta = out.meta()
+
+        for hook in req.post_hooks:
+            self._exec_hook(hook, model_name, step)
+
+        return {"stats": stats, "output_meta": output_meta}
+
+    def _publish_version(self, model_name: ModelName):
+        model = self.models[str(model_name)]
+        name_resolve.add(
+            names.model_version(
+                self.cfg.experiment_name, self.cfg.trial_name, model_name.role
+            ),
+            str(model.version),
+            replace=True,
+        )
+
+    def _save_model(self, model_name: Optional[str] = None):
+        for mn, model in self.models.items():
+            if model_name is not None and mn != model_name:
+                continue
+            iface = self.interfaces[mn]
+            save_dir = os.path.join(
+                constants.get_save_path(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                ),
+                ModelName.parse(mn).role,
+                f"step{model.version}",
+                f"dp{self.cfg.worker_index}",
+            )
+            iface.save(model, save_dir)
+
+    def _ckpt_dir(self, mn: str) -> str:
+        return os.path.join(
+            constants.get_recover_path(
+                self.cfg.experiment_name, self.cfg.trial_name
+            ),
+            ModelName.parse(mn).role,
+            f"dp{self.cfg.worker_index}",
+        )
+
+    def _handle_ckpt(self, req):
+        for mn, model in self.models.items():
+            self.backends[mn].save(model, self._ckpt_dir(mn))
+        if self.dataloader is not None:
+            import json
+
+            state_path = os.path.join(
+                constants.get_recover_path(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                ),
+                f"dataloader_{self.cfg.worker_index}.json",
+            )
+            os.makedirs(os.path.dirname(state_path), exist_ok=True)
+            with open(state_path, "w") as f:
+                json.dump(self.dataloader.state_dict(), f)
+        return {"ok": True}
+
+    def _handle_restore(self, req):
+        from areal_tpu.engine.checkpoint import has_engine_state
+
+        for mn, model in self.models.items():
+            d = self._ckpt_dir(mn)
+            if has_engine_state(d):
+                self.backends[mn].load(model, d)
+        if self.dataloader is not None:
+            import json
+
+            state_path = os.path.join(
+                constants.get_recover_path(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                ),
+                f"dataloader_{self.cfg.worker_index}.json",
+            )
+            if os.path.exists(state_path):
+                with open(state_path) as f:
+                    self.dataloader.load_state_dict(json.load(f))
+                self.dataloader.restart_epoch()
+        return {"ok": True}
+
+    def _evaluate_model(self, model_name: Optional[str] = None):
+        stats = {}
+        for mn, model in self.models.items():
+            if model_name is not None and mn != model_name:
+                continue
+            iface = self.interfaces[mn]
+            stats[mn] = iface.evaluate(model, None)
+        return stats
+
+    # ------------------------------------------------------------------
+
+    def _poll(self) -> Optional[PollResult]:
+        try:
+            req = self.stream.poll(block=True, timeout_ms=50)
+        except rrs.NoMessage:
+            return PollResult(batch_count=0)
+        try:
+            h = req.handle_name
+            if h == "spec":
+                resp = self._handle_spec(req)
+            elif h == "fetch":
+                resp = self._handle_fetch(req)
+            elif h == "mfc":
+                resp = self._handle_mfc(req)
+            elif h == "save":
+                self._save_model()
+                resp = {"ok": True}
+            elif h == "ckpt":
+                resp = self._handle_ckpt(req)
+            elif h == "restore":
+                resp = self._handle_restore(req)
+            elif h == "evaluate":
+                resp = self._evaluate_model()
+            elif h == "clear_data_cache":
+                self.data_manager.clear(req.data)
+                resp = {"ok": True}
+            elif h == "exit":
+                self.stream.reply_to(req, {"ok": True})
+                self.exit()
+                return PollResult(batch_count=1)
+            else:
+                resp = {"error": f"unknown handle {h!r}"}
+        except Exception as e:
+            logger.exception(f"error handling {req.handle_name}")
+            resp = {"error": repr(e)}
+        self.stream.reply_to(req, resp)
+        return PollResult(batch_count=1)
+
+    def _exit_hook(self):
+        try:
+            self.stream.close()
+            self.data_manager.close()
+            if self._dataset is not None and hasattr(self._dataset, "close"):
+                self._dataset.close()
+        except Exception:
+            pass
+
+    def _param_realloc(self, hook: Dict, step: int = 0):
+        """Disk-mediated weight sync between model replicas (reference
+        __param_realloc, model_worker.py:1046; DISK impl is the reference
+        default). The source stamps the dump with the global step; the
+        target WAITS for a stamp >= its step, so a cross-worker load can
+        never silently pick up stale (or missing) weights."""
+        import time as _time
+
+        src, dst = hook.get("source"), hook.get("target")
+        realloc_root = constants.get_param_realloc_path(
+            self.cfg.experiment_name, self.cfg.trial_name
+        )
+        if src is not None and src in self.models:
+            model = self.models[src]
+            d = os.path.join(realloc_root, ModelName.parse(src).role)
+            from areal_tpu.engine.checkpoint import save_engine_state
+
+            save_engine_state(model.module, d)
+            tmp = os.path.join(d, "step.txt.tmp")
+            with open(tmp, "w") as f:
+                f.write(str(step))
+            os.replace(tmp, os.path.join(d, "step.txt"))
+        if dst is not None and dst in self.models:
+            model = self.models[dst]
+            role = ModelName.parse(dst).role
+            # The source role's dump is what we load from.
+            src_role = ModelName.parse(src).role if src else role
+            d = os.path.join(realloc_root, src_role)
+            stamp = os.path.join(d, "step.txt")
+            deadline = _time.monotonic() + 300
+            while True:
+                try:
+                    with open(stamp) as f:
+                        if int(f.read().strip() or -1) >= step:
+                            break
+                except (FileNotFoundError, ValueError):
+                    pass
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"param_realloc: no fresh dump for {src_role} "
+                        f"(step {step}) within 300s"
+                    )
+                _time.sleep(0.05)
+            # Only params move; optimizer state stays local.
+            import pickle
+
+            with open(os.path.join(d, "engine_state.pkl"), "rb") as f:
+                state = pickle.load(f)
+            model.module.set_params(state["params"])

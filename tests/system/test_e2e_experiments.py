@@ -1,0 +1,361 @@
+"""In-process end-to-end experiments on CPU (mirrors reference
+tests/experiments/test_math_ppo.py and test_sft.py): master inline +
+model workers as spawned subprocesses, mock or tiny-real engines."""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    DatasetAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.data_api import MicroBatchSpec
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    ExperimentSaveEvalControl,
+    MasterWorkerConfig,
+    ModelShardSpec,
+    ModelWorkerConfig,
+)
+from areal_tpu.system.controller import LocalController
+from tests import fixtures
+
+TINY_CFG = dict(
+    vocab_size=128,
+    hidden_dim=32,
+    n_layers=2,
+    n_q_heads=2,
+    n_kv_heads=1,
+    head_dim=16,
+    intermediate_dim=64,
+    max_position_embeddings=256,
+    compute_dtype="float32",
+)
+
+
+def _mk_tokenizer_files(tmp_path):
+    rows = fixtures.make_sft_rows(32, seed=3)
+    texts = [r["prompt"] + " " + r["answer"] for r in rows]
+    tok = fixtures.train_tiny_tokenizer(texts, tmp_path)
+    tok_dir = str(tmp_path / "tok_full")
+    tok.save_pretrained(tok_dir)
+    return rows, tok_dir
+
+
+def _worker_env(tmp_path):
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "AREAL_FILEROOT": str(tmp_path / "fileroot"),
+    }
+
+
+@pytest.mark.parametrize("n_workers", [1, 2])
+def test_sft_e2e_mock(tmp_path, n_workers):
+    """SFT DFG on the mock engine: control plane, dataset hosting, DP
+    dispatch, data plane pulls, save/ckpt/exit."""
+    exp, trial = f"e2e-sft-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    data_path = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+
+    sft = MFCDef(
+        name="sft_train",
+        model_name=ModelName("default", 0),
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=8,
+        input_keys=("packed_input_ids", "prompt_mask"),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+    )
+    workers = [f"model_worker/{i}" for i in range(n_workers)]
+    model_workers = []
+    for i in range(n_workers):
+        model_workers.append(
+            ModelWorkerConfig(
+                experiment_name=exp,
+                trial_name=trial,
+                worker_index=i,
+                shards=[
+                    ModelShardSpec(
+                        id=ModelShardID(ModelName("default", 0), host_rank=i, n_hosts=n_workers),
+                        model=ModelAbstraction(
+                            "tpu_transformer",
+                            args=dict(config=TINY_CFG, tokenizer_path=tok_dir),
+                        ),
+                        backend=ModelBackendAbstraction("mock_train"),
+                        interface=ModelInterfaceAbstraction("sft"),
+                    )
+                ],
+                datasets=[
+                    DatasetAbstraction(
+                        "prompt_answer",
+                        args=dict(max_length=64, dataset_path=data_path),
+                    )
+                ],
+                tokenizer_path=tok_dir,
+                dataset_dp_rank=i,
+                dataset_dp_size=n_workers,
+                train_batch_size=8,
+                total_train_epochs=2,
+            )
+        )
+    master = MasterWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=2, ckpt_freq_steps=2, benchmark_steps=6
+        ),
+        rpcs=[sft],
+        model_topos={str(ModelName("default", 0)): workers},
+        data_hosts=workers,
+        n_model_workers=n_workers,
+        train_batch_size=8,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        master=master,
+        model_workers=model_workers,
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={
+            "backend": "nfs",
+            "record_root": str(tmp_path / "name_resolve"),
+        },
+        worker_env=_worker_env(tmp_path),
+    )
+    result = ctl.run()
+    assert result["global_step"] == 6
+
+
+def test_sync_ppo_e2e_tiny_real(tmp_path):
+    """Sync PPO DFG (gen -> {rew, ref} -> train) with the real JAX engine
+    on a tiny model, single worker hosting actor+ref+reward."""
+    exp, trial = f"e2e-ppo-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    mc_rows = fixtures.make_math_code_rows(16, seed=5)
+    # keep only math rows (code exec is slow in CI-style runs)
+    mc_rows = [r for r in mc_rows if r["task"] == "math"]
+    data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
+
+    actor = ModelName("actor", 0)
+    ref = ModelName("ref", 0)
+    rew = ModelName("reward", 0)
+    n_seqs = 4
+
+    rpcs = [
+        MFCDef(
+            name="actor_gen",
+            model_name=actor,
+            interface_type=ModelInterfaceType.GENERATE,
+            interface_impl=None,
+            n_seqs=n_seqs,
+            input_keys=("packed_prompts",),
+            output_keys=(
+                "packed_input_ids",
+                "prompt_mask",
+                "packed_logprobs",
+                "seq_no_eos_mask",
+            ),
+        ),
+        MFCDef(
+            name="rew_inf",
+            model_name=rew,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=None,
+            n_seqs=n_seqs,
+            input_keys=("packed_input_ids", "prompt_mask"),
+            output_keys=("rewards",),
+        ),
+        MFCDef(
+            name="ref_inf",
+            model_name=ref,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=None,
+            n_seqs=n_seqs,
+            input_keys=("packed_input_ids", "prompt_mask"),
+            output_keys=("logprobs",),
+            output_key_remap={"logprobs": "ref_logprobs"},
+        ),
+        MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=None,
+            n_seqs=n_seqs,
+            input_keys=(
+                "packed_input_ids",
+                "prompt_mask",
+                "packed_logprobs",
+                "ref_logprobs",
+                "rewards",
+                "seq_no_eos_mask",
+            ),
+        ),
+    ]
+
+    gconfig = dict(n=2, max_new_tokens=8, greedy=False, temperature=1.0)
+    shards = [
+        ModelShardSpec(
+            id=ModelShardID(actor),
+            model=ModelAbstraction(
+                "tpu_transformer",
+                args=dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32"),
+            ),
+            backend=ModelBackendAbstraction(
+                "jax_train", args=dict(optimizer=dict(lr=1e-4), remat=False,
+                                       row_len_multiple=8)
+            ),
+            interface=ModelInterfaceAbstraction(
+                "ppo_actor", args=dict(gconfig=gconfig, kl_ctl=0.1)
+            ),
+        ),
+        ModelShardSpec(
+            id=ModelShardID(ref),
+            model=ModelAbstraction(
+                "tpu_transformer",
+                args=dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32"),
+            ),
+            backend=ModelBackendAbstraction(
+                "jax_inference", args=dict(row_len_multiple=8)
+            ),
+            interface=ModelInterfaceAbstraction(
+                "ppo_actor", args=dict(gconfig=gconfig)
+            ),
+        ),
+        ModelShardSpec(
+            id=ModelShardID(rew),
+            model=ModelAbstraction(
+                "tpu_transformer",
+                args=dict(config=TINY_CFG, tokenizer_path=tok_dir),
+            ),
+            backend=ModelBackendAbstraction("mock_inference"),
+            interface=ModelInterfaceAbstraction("rw-math-code"),
+        ),
+    ]
+    mw = ModelWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        shards=shards,
+        datasets=[
+            DatasetAbstraction(
+                "math_code_prompt", args=dict(dataset_path=data_path)
+            )
+        ],
+        tokenizer_path=tok_dir,
+        train_batch_size=n_seqs,
+        total_train_epochs=1,
+    )
+    master = MasterWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
+        rpcs=rpcs,
+        model_topos={
+            str(actor): ["model_worker/0"],
+            str(ref): ["model_worker/0"],
+            str(rew): ["model_worker/0"],
+        },
+        data_hosts=["model_worker/0"],
+        n_model_workers=1,
+        train_batch_size=n_seqs,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp, trial_name=trial, master=master, model_workers=[mw]
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={
+            "backend": "nfs",
+            "record_root": str(tmp_path / "name_resolve"),
+        },
+        worker_env=_worker_env(tmp_path),
+    )
+    result = ctl.run()
+    assert result["global_step"] == 2
+
+
+def test_recovery_e2e_mock(tmp_path):
+    """Checkpoint -> relaunch -> resume: the second run continues from the
+    recover info instead of restarting (mirrors reference
+    test_buffer_recover.py + apps/main.py relaunch loop)."""
+    exp, trial = f"e2e-rec-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    data_path = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+
+    def build_cfg(benchmark_steps, recover_mode):
+        sft = MFCDef(
+            name="sft_train",
+            model_name=ModelName("default", 0),
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=None,
+            n_seqs=8,
+            input_keys=("packed_input_ids", "prompt_mask"),
+        )
+        mw = ModelWorkerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            worker_index=0,
+            shards=[
+                ModelShardSpec(
+                    id=ModelShardID(ModelName("default", 0)),
+                    model=ModelAbstraction(
+                        "tpu_transformer",
+                        args=dict(config=TINY_CFG, tokenizer_path=tok_dir),
+                    ),
+                    backend=ModelBackendAbstraction("mock_train"),
+                    interface=ModelInterfaceAbstraction("sft"),
+                )
+            ],
+            datasets=[
+                DatasetAbstraction(
+                    "prompt_answer", args=dict(max_length=64, dataset_path=data_path)
+                )
+            ],
+            tokenizer_path=tok_dir,
+            train_batch_size=8,
+            total_train_epochs=10,
+        )
+        master = MasterWorkerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            exp_ctrl=ExperimentSaveEvalControl(
+                total_train_epochs=10,
+                ckpt_freq_steps=2,
+                benchmark_steps=benchmark_steps,
+            ),
+            rpcs=[sft],
+            model_topos={str(ModelName("default", 0)): ["model_worker/0"]},
+            data_hosts=["model_worker/0"],
+            n_model_workers=1,
+            train_batch_size=8,
+            recover_mode=recover_mode,
+        )
+        return ExperimentConfig(
+            experiment_name=exp, trial_name=trial, master=master, model_workers=[mw]
+        )
+
+    nr = {"backend": "nfs", "record_root": str(tmp_path / "name_resolve")}
+    env = _worker_env(tmp_path)
+
+    r1 = LocalController(build_cfg(4, "disabled"), name_resolve_cfg=nr, worker_env=env).run()
+    assert r1["global_step"] == 4
+
+    # Second launch resumes at step 5 (ckpt was dumped at step 4).
+    r2 = LocalController(build_cfg(6, "auto"), name_resolve_cfg=nr, worker_env=env).run()
+    assert r2["global_step"] == 6
+
+    from areal_tpu.base import recover
+
+    info = recover.load(exp, trial)
+    assert info.last_step_info.global_step >= 4
