@@ -1,0 +1,2 @@
+from areal_tpu.agents import math_single_step  # noqa: F401  (registers)
+from areal_tpu.agents import envs  # noqa: F401

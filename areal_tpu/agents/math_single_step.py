@@ -1,0 +1,141 @@
+"""Single-step math/code RL agent.
+
+Counterpart of the reference's math single-step agent
+(realhf/impl/agent/math_single_step_agent.py:44-248): one prompt -> one
+group of generations -> verifier rewards -> one trajectory sample. The
+obs/act queue protocol is kept: the agent never talks HTTP itself.
+Degenerate groups (success rate outside [lb, ub]) are dropped
+(reference :95-103).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, List, Optional
+
+import numpy as np
+
+from areal_tpu.api.agent_api import Agent, register_agent
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.env_api import EnvironmentService
+from areal_tpu.api.model_api import BundledGenerationOutputs, GenerationHyperparameters
+from areal_tpu.base import logging
+
+logger = logging.getLogger("math_agent")
+
+
+class MathSingleStepAgent(Agent):
+    def __init__(
+        self,
+        gconfig: Optional[GenerationHyperparameters] = None,
+        tokenizer: Any = None,
+        reward_scaling: float = 1.0,
+        reward_bias: float = 0.0,
+        correct_reward: float = 5.0,
+        wrong_reward: float = -5.0,
+        success_rate_lb: float = 0.0,
+        success_rate_ub: float = 1.0,
+        **gconfig_kwargs,
+    ):
+        if gconfig is None:
+            gconfig = GenerationHyperparameters(**gconfig_kwargs)
+        elif isinstance(gconfig, dict):
+            gconfig = GenerationHyperparameters(**gconfig)
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.reward_scaling = reward_scaling
+        self.reward_bias = reward_bias
+        self.correct_reward = correct_reward
+        self.wrong_reward = wrong_reward
+        self.success_rate_lb = success_rate_lb
+        self.success_rate_ub = success_rate_ub
+
+    async def collect_trajectory(
+        self,
+        prompt: SequenceSample,
+        env: EnvironmentService,
+        obs_queue: asyncio.Queue,
+        act_queue: asyncio.Queue,
+    ) -> List[SequenceSample]:
+        assert prompt.bs == 1
+        qid = prompt.ids[0]
+        prompt_ids = np.asarray(prompt.data["packed_prompts"]).tolist()
+        await obs_queue.put((qid, prompt_ids, self.gconfig))
+        bundle: BundledGenerationOutputs = await act_queue.get()
+
+        task = (prompt.metadata.get("tasks") or ["math"])[0]
+        answer_info = (prompt.metadata.get("solutions") or [None])[0]
+        answers = [
+            self.tokenizer.decode(seq[bundle.prompt_len:])
+            for seq in bundle.seqs
+        ]
+        successes, *_ = await env.step((qid, answers, task, answer_info))
+
+        sr = float(np.mean(successes)) if successes else 0.0
+        if not (self.success_rate_lb <= sr <= self.success_rate_ub):
+            logger.debug(f"{qid}: degenerate group (sr={sr:.2f}), dropped")
+            return []
+
+        rewards = np.asarray(
+            [
+                (self.correct_reward if ok else self.wrong_reward)
+                * self.reward_scaling
+                + self.reward_bias
+                for ok in successes
+            ],
+            np.float32,
+        )
+        n = len(bundle.seqs)
+        seq_lens = [len(s) for s in bundle.seqs]
+        plen = bundle.prompt_len
+        pmask = np.concatenate(
+            [
+                np.concatenate(
+                    [np.ones(plen, np.int64), np.zeros(l - plen, np.int64)]
+                )
+                for l in seq_lens
+            ]
+        )
+        # Shifted frame (PPO convention, reference ppo generate): the
+        # logprob of generated token at abs position p is stored at p-1.
+        shifted_lps = []
+        for seq, lp in zip(bundle.seqs, bundle.logprobs):
+            out_lp = np.asarray(lp[plen:], np.float32)  # behind-prompt lps
+            full = np.zeros(len(seq), np.float32)
+            full[plen - 1 : len(seq) - 1] = out_lp
+            shifted_lps.append(full)
+        sample = SequenceSample(
+            ids=[qid],
+            keys={
+                "packed_input_ids", "prompt_mask", "packed_logprobs",
+                "seq_no_eos_mask", "rewards",
+            },
+            data={
+                "packed_input_ids": np.concatenate(
+                    [np.asarray(s, np.int32) for s in bundle.seqs]
+                ),
+                "prompt_mask": pmask,
+                "packed_logprobs": np.concatenate(shifted_lps),
+                "seq_no_eos_mask": np.asarray(
+                    [1.0 if x else 0.0 for x in bundle.no_eos], np.float32
+                ),
+                "rewards": rewards,
+            },
+            seqlens={
+                "packed_input_ids": [seq_lens],
+                "prompt_mask": [seq_lens],
+                "packed_logprobs": [seq_lens],
+                "seq_no_eos_mask": [[1] * n],
+                "rewards": [[1] * n],
+            },
+            metadata={
+                "version_start": [min(bundle.version_start)],
+                "version_end": [max(bundle.version_end)],
+                "scores": [sr],
+                "birth_time": [0],
+            },
+        )
+        return [sample]
+
+
+register_agent("math-single-step", MathSingleStepAgent)

@@ -524,3 +524,56 @@ class PackedDataLoader:
             # cursor indexes a different permutation — restart the epoch.
             self._cursor = 0
         self._regen_order(n)
+
+
+# ---------------------------------------------------------------------------
+# JSON wire format (rollout -> trainer trajectories over the push stream)
+# ---------------------------------------------------------------------------
+
+
+def sample_to_json(s: "SequenceSample") -> Dict[str, Any]:
+    """Lossless JSON encoding of a SequenceSample (token-scale arrays)."""
+    return {
+        "ids": list(s.ids),
+        "keys": sorted(s.keys),
+        "data": {
+            k: (None if s.data.get(k) is None else np.asarray(s.data[k]).tolist())
+            for k in s.keys
+        },
+        "seqlens": {k: s.seqlens[k] for k in s.keys},
+        "dtypes": {
+            k: (None if s.dtypes.get(k) is None else np.dtype(s.dtypes[k]).name)
+            for k in s.keys
+        },
+        "trailing_shapes": {
+            k: (None if s.trailing_shapes.get(k) is None else list(s.trailing_shapes[k]))
+            for k in s.keys
+        },
+        "metadata": s.metadata,
+    }
+
+
+def sample_from_json(d: Dict[str, Any]) -> "SequenceSample":
+    data = {}
+    for k in d["keys"]:
+        v = d["data"].get(k)
+        if v is None:
+            data[k] = None
+        else:
+            dt = d["dtypes"].get(k) or "float32"
+            data[k] = np.asarray(v, dtype=np.dtype(dt))
+    return SequenceSample(
+        ids=list(d["ids"]),
+        keys=set(d["keys"]),
+        data=data,
+        seqlens={k: [list(map(int, sl)) for sl in v] for k, v in d["seqlens"].items()},
+        dtypes={
+            k: (None if v is None else np.dtype(v))
+            for k, v in d.get("dtypes", {}).items()
+        },
+        trailing_shapes={
+            k: (None if v is None else tuple(v))
+            for k, v in d.get("trailing_shapes", {}).items()
+        },
+        metadata=d.get("metadata", {}),
+    )

@@ -1,0 +1,484 @@
+"""Continuous-batching generation engine for the JAX generation server.
+
+TPU-native replacement for the reference's patched-SGLang server stack
+(realhf/impl/model/backend/sglang.py + patch/sglang/v0.4.6.post2.patch):
+a fixed pool of B sequence slots over a static [L, B, S] KV cache, a
+jitted multi-step decode block, per-slot sampling params, and
+interruption BETWEEN blocks — which is what makes weight updates cheap:
+the loop drains at a block boundary, partial outputs return to the
+clients (who resubmit with the concatenated prefix, recomputing KV under
+the new weights), and the new params are swapped in.
+
+Static shapes throughout: prompt lengths are bucketed for prefill, the
+decode block is one compiled program reused for the server's lifetime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.base import logging
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.generation import decode_step, prefill
+from areal_tpu.ops.sampling import NEG_INF, apply_top_k, apply_top_p
+
+logger = logging.getLogger("serving")
+
+
+@dataclasses.dataclass
+class GenRequest:
+    qid: str
+    input_ids: List[int]
+    max_new_tokens: int = 256
+    min_new_tokens: int = 0
+    greedy: bool = False
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1
+    stop_token_ids: Tuple[int, ...] = ()
+    # resolved by the engine loop:
+    done_cb: Optional[Callable[["GenResult"], None]] = None
+    submit_time: float = 0.0
+
+
+@dataclasses.dataclass
+class GenResult:
+    qid: str
+    output_ids: List[int]
+    output_logprobs: List[float]
+    no_eos: bool  # True if stopped for a non-EOS reason (budget/interrupt)
+    interrupted: bool
+    version_start: int
+    version_end: int
+    latency: float = 0.0
+
+
+def _pad_bucket(n: int, multiple: int) -> int:
+    return max(multiple, -(-n // multiple) * multiple)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "n_steps"),
+    donate_argnames=("k_cache", "v_cache"),
+)
+def _decode_block(
+    params,
+    cfg: TransformerConfig,
+    k_cache,
+    v_cache,
+    lengths,  # [B] cache fill per slot (incl. already-emitted tokens)
+    next_input,  # [B] last sampled token, to feed
+    active,  # [B] bool
+    remaining,  # [B] int32 budget left
+    min_remaining,  # [B] int32 forbid-EOS countdown
+    temps,  # [B]
+    top_ps,  # [B]
+    top_ks,  # [B] int32 (<=0 disables)
+    greedy_mask,  # [B] bool
+    eos_mask,  # [V] bool — True at stop-token columns
+    rng,
+    n_steps: int,
+):
+    """Run up to n_steps decode steps for every active slot.
+
+    Returns (out_tokens [B, n], out_logprobs [B, n], emitted_mask [B, n],
+    state...) — slots that finish (EOS or budget) flip inactive mid-block;
+    `no_eos` is derivable on host from which stop fired.
+    """
+    B = lengths.shape[0]
+
+    def body(i, carry):
+        (kc, vc, lengths, next_input, active, remaining, min_remaining,
+         rng, out_t, out_lp, out_m, hit_eos) = carry
+        logits, kc, vc = decode_step(params, cfg, next_input, kc, vc, lengths)
+        rng, sub = jax.random.split(rng)
+        logits = logits.astype(jnp.float32)
+        V = logits.shape[-1]
+        # forbid stop tokens while min_new_tokens not reached
+        forbid = (min_remaining > 0)[:, None] & eos_mask[None, :]
+        logits = jnp.where(forbid, NEG_INF, logits)
+        base_logp = jax.nn.log_softmax(logits, axis=-1)
+        warped = logits / jnp.maximum(temps[:, None], 1e-6)
+        # per-row top-k: kth-largest threshold via a sorted copy
+        sorted_desc = jnp.sort(warped, axis=-1)[:, ::-1]
+        k_eff = jnp.where(top_ks <= 0, V, jnp.minimum(top_ks, V))
+        kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+        warped = jnp.where(warped < kth, NEG_INF, warped)
+        warped = apply_top_p(warped, top_ps[:, None])
+        sampled = jax.random.categorical(sub, warped, axis=-1)
+        argmax = jnp.argmax(logits, axis=-1)
+        tokens = jnp.where(greedy_mask, argmax, sampled).astype(jnp.int32)
+        logprobs = jnp.take_along_axis(base_logp, tokens[:, None], axis=-1)[:, 0]
+
+        emit = active
+        tokens = jnp.where(emit, tokens, 0)
+        logprobs = jnp.where(emit, logprobs, 0.0)
+        out_t = out_t.at[:, i].set(tokens)
+        out_lp = out_lp.at[:, i].set(logprobs)
+        out_m = out_m.at[:, i].set(emit)
+
+        is_eos = eos_mask[tokens] & emit
+        remaining = remaining - emit.astype(jnp.int32)
+        min_remaining = jnp.maximum(min_remaining - emit.astype(jnp.int32), 0)
+        exhausted = (remaining <= 0) & emit
+        hit_eos = hit_eos | is_eos
+        active = active & ~is_eos & ~exhausted
+        lengths = lengths + emit.astype(lengths.dtype)
+        next_input = tokens
+        return (kc, vc, lengths, next_input, active, remaining, min_remaining,
+                rng, out_t, out_lp, out_m, hit_eos)
+
+    out_t = jnp.zeros((B, n_steps), jnp.int32)
+    out_lp = jnp.zeros((B, n_steps), jnp.float32)
+    out_m = jnp.zeros((B, n_steps), bool)
+    hit_eos = jnp.zeros((B,), bool)
+    carry = (k_cache, v_cache, lengths, next_input, active, remaining,
+             min_remaining, rng, out_t, out_lp, out_m, hit_eos)
+    carry = jax.lax.fori_loop(0, n_steps, body, carry)
+    (k_cache, v_cache, lengths, next_input, active, remaining, min_remaining,
+     rng, out_t, out_lp, out_m, hit_eos) = carry
+    return (out_t, out_lp, out_m, hit_eos, k_cache, v_cache, lengths,
+            next_input, active, remaining, min_remaining, rng)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "pad_len"))
+def _prefill_one(params, cfg: TransformerConfig, input_ids, length, pad_len: int):
+    """Prefill a single sequence (batch of 1) at a bucketed length.
+
+    Returns (last_logits [V], (k_pref, v_pref) each [L, pad_len, Hkv, hd])."""
+    from areal_tpu.models.transformer import forward as packed_forward
+
+    ids = input_ids[None, :]  # [1, P]
+    pos = jnp.arange(pad_len)[None, :]
+    seg = (pos < length).astype(jnp.int32)
+    positions = jnp.where(seg > 0, pos, 0).astype(jnp.int32)
+    logits, (k, v) = packed_forward(params, cfg, ids, seg, positions, return_kv=True)
+    last = jnp.take_along_axis(
+        logits, jnp.maximum(length - 1, 0)[None, None, None], axis=1
+    )[0, 0]
+    return last, (k[:, 0], v[:, 0])
+
+
+class ServingEngine:
+    """Slot-pool continuous-batching engine driven by a background thread."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params,
+        max_batch_size: int = 8,
+        max_seq_len: int = 2048,
+        decode_block_steps: int = 16,
+        prompt_bucket: int = 64,
+        eos_token_id: Optional[int] = None,
+        seed: int = 1,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.B = max_batch_size
+        self.S = max_seq_len
+        self.block_steps = decode_block_steps
+        self.prompt_bucket = prompt_bucket
+        self.eos_token_id = eos_token_id
+        self.version = 0
+
+        self._k_cache = None
+        self._v_cache = None
+        self._lengths = jnp.zeros((self.B,), jnp.int32)
+        self._next_input = jnp.zeros((self.B,), jnp.int32)
+        self._active = jnp.zeros((self.B,), bool)
+        self._remaining = jnp.zeros((self.B,), jnp.int32)
+        self._min_remaining = jnp.zeros((self.B,), jnp.int32)
+        self._temps = jnp.ones((self.B,), jnp.float32)
+        self._top_ps = jnp.ones((self.B,), jnp.float32)
+        self._top_ks = jnp.full((self.B,), -1, jnp.int32)
+        self._greedy = jnp.zeros((self.B,), bool)
+        self._rng = jax.random.PRNGKey(seed)
+
+        # host-side slot bookkeeping
+        self._slot_req: List[Optional[GenRequest]] = [None] * self.B
+        self._slot_out: List[List[int]] = [[] for _ in range(self.B)]
+        self._slot_lp: List[List[float]] = [[] for _ in range(self.B)]
+        self._slot_vstart: List[int] = [0] * self.B
+
+        self._queue: "queue.Queue[GenRequest]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._interrupt = threading.Event()
+        self._pending_params = None
+        self._pending_version: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # metrics
+        self.n_running = 0
+        self.n_used_tokens = 0
+        self.total_generated = 0
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def submit(self, req: GenRequest):
+        req.submit_time = time.monotonic()
+        self._queue.put(req)
+
+    def update_params(self, params, allow_interrupt: bool = True,
+                      version: Optional[int] = None):
+        """Swap weights at the next block boundary. With allow_interrupt,
+        running requests are interrupted and returned partially (the AReaL
+        protocol); without it, admission pauses and the swap happens once
+        running requests drain. `version` pins the new weight version to
+        the trainer's published one (self-incrementing would drift when
+        the trainer publishes faster than the manager flushes)."""
+        with self._lock:
+            self._pending_params = params
+            self._pending_version = version
+        if allow_interrupt:
+            self._interrupt.set()
+
+    def metrics(self) -> Dict[str, float]:
+        return {
+            "num_running_reqs": float(self.n_running),
+            "num_used_tokens": float(self.n_used_tokens),
+            "total_generated": float(self.total_generated),
+            "queue_depth": float(self._queue.qsize()),
+        }
+
+    # ------------------------------------------------------------------
+    # Engine loop
+    # ------------------------------------------------------------------
+
+    def _ensure_cache(self):
+        if self._k_cache is not None:
+            return
+        # shape probe via a 1-token prefill
+        c = self.cfg
+        n_layers = c.n_layers
+        cdt = jnp.dtype(c.compute_dtype)
+        self._k_cache = jnp.zeros(
+            (n_layers, self.B, self.S, c.n_kv_heads, c.head_dim), cdt
+        )
+        self._v_cache = jnp.zeros_like(self._k_cache)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.B) if self._slot_req[i] is None]
+
+    def _admit(self):
+        """Fill free slots from the queue (prefill each)."""
+        # Drain semantics for non-interrupting weight updates: stop
+        # admitting so running requests finish and the swap can land.
+        if self._pending_params is not None:
+            return
+        free = self._free_slots()
+        while free and not self._queue.empty():
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop(0)
+            plen = len(req.input_ids)
+            if plen + req.max_new_tokens > self.S:
+                # Trim budget to fit the static cache.
+                req.max_new_tokens = max(0, self.S - plen)
+            if plen >= self.S or req.max_new_tokens == 0:
+                self._finish_host(req, [], [], no_eos=True, interrupted=False,
+                                  vstart=self.version)
+                continue
+            pad = _pad_bucket(plen, self.prompt_bucket)
+            pad = min(pad, self.S)
+            ids = np.zeros((pad,), np.int32)
+            ids[:plen] = req.input_ids
+            last_logits, (k_pref, v_pref) = _prefill_one(
+                self.params, self.cfg, jnp.asarray(ids),
+                jnp.asarray(plen, jnp.int32), pad_len=pad,
+            )
+            # Sample the first token on host-side jit (scalar batch).
+            self._rng, sub = jax.random.split(self._rng)
+            tok, lp = _sample_first(
+                last_logits, sub, req.greedy, req.temperature, req.top_p,
+                req.top_k, jnp.asarray(self._eos_mask_np(req)),
+                req.min_new_tokens > 0,
+            )
+            tok_i, lp_f = int(tok), float(lp)
+            self._k_cache = self._k_cache.at[:, slot, :pad].set(k_pref)
+            self._v_cache = self._v_cache.at[:, slot, :pad].set(v_pref)
+            # host bookkeeping
+            self._slot_req[slot] = req
+            self._slot_out[slot] = [tok_i]
+            self._slot_lp[slot] = [lp_f]
+            self._slot_vstart[slot] = self.version
+            is_eos = tok_i in self._eos_set(req)
+            budget_left = req.max_new_tokens - 1
+            if (is_eos and req.min_new_tokens <= 1) or budget_left <= 0:
+                self._finish_slot(slot, hit_eos=is_eos)
+                continue
+            # device state. `lengths` counts cache fill EXCLUDING the pending
+            # next_input token: the first decode step writes the sampled
+            # first token's k/v at position plen, then advances.
+            self._lengths = self._lengths.at[slot].set(plen)
+            self._next_input = self._next_input.at[slot].set(tok_i)
+            self._active = self._active.at[slot].set(True)
+            self._remaining = self._remaining.at[slot].set(budget_left)
+            self._min_remaining = self._min_remaining.at[slot].set(
+                max(0, req.min_new_tokens - 1)
+            )
+            self._temps = self._temps.at[slot].set(req.temperature)
+            self._top_ps = self._top_ps.at[slot].set(req.top_p)
+            self._top_ks = self._top_ks.at[slot].set(req.top_k)
+            self._greedy = self._greedy.at[slot].set(req.greedy)
+
+    def _eos_set(self, req: Optional[GenRequest]) -> set:
+        s = set(req.stop_token_ids) if req is not None else set()
+        if self.eos_token_id is not None:
+            s.add(self.eos_token_id)
+        return s
+
+    def _eos_mask_np(self, req: Optional[GenRequest] = None) -> np.ndarray:
+        """[V] bool mask of stop-token columns (empty set -> all False;
+        an index-based encoding would need a pad index, and any pad value
+        lands on a real vocab column)."""
+        mask = np.zeros((self.cfg.vocab_size,), bool)
+        for t in self._eos_set(req):
+            if 0 <= t < self.cfg.vocab_size:
+                mask[t] = True
+        return mask
+
+    def _finish_host(self, req, out, lps, no_eos, interrupted, vstart):
+        res = GenResult(
+            qid=req.qid,
+            output_ids=list(out),
+            output_logprobs=list(lps),
+            no_eos=no_eos,
+            interrupted=interrupted,
+            version_start=vstart,
+            version_end=self.version,
+            latency=time.monotonic() - req.submit_time,
+        )
+        self.total_generated += len(out)
+        if req.done_cb:
+            req.done_cb(res)
+
+    def _finish_slot(self, slot: int, hit_eos: bool, interrupted: bool = False):
+        req = self._slot_req[slot]
+        self._finish_host(
+            req, self._slot_out[slot], self._slot_lp[slot],
+            no_eos=not hit_eos, interrupted=interrupted,
+            vstart=self._slot_vstart[slot],
+        )
+        self._slot_req[slot] = None
+        self._slot_out[slot] = []
+        self._slot_lp[slot] = []
+        self._active = self._active.at[slot].set(False)
+        self._lengths = self._lengths.at[slot].set(0)
+
+    def _interrupt_all(self):
+        for slot in range(self.B):
+            if self._slot_req[slot] is not None:
+                self._finish_slot(slot, hit_eos=False, interrupted=True)
+
+    def _apply_pending_params(self):
+        with self._lock:
+            pending = self._pending_params
+            version = self._pending_version
+            self._pending_params = None
+            self._pending_version = None
+        if pending is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, pending)
+            self.version = version if version is not None else self.version + 1
+            logger.info(f"serving engine weights updated to v{self.version}")
+        self._interrupt.clear()
+
+    def _loop(self):
+        self._ensure_cache()
+        eos_global = jnp.asarray(self._eos_mask_np())
+        while not self._stop.is_set():
+            if self._interrupt.is_set():
+                self._interrupt_all()
+                self._apply_pending_params()
+            self._admit()
+            if not any(r is not None for r in self._slot_req):
+                # idle: apply updates immediately, then wait for work
+                if self._pending_params is not None:
+                    self._apply_pending_params()
+                time.sleep(0.002)
+                self.n_running = 0
+                continue
+            self.n_running = sum(r is not None for r in self._slot_req)
+            self.n_used_tokens = int(jnp.sum(self._lengths))
+
+            self._rng, sub = jax.random.split(self._rng)
+            (out_t, out_lp, out_m, hit_eos, self._k_cache, self._v_cache,
+             self._lengths, self._next_input, self._active, self._remaining,
+             self._min_remaining, _) = _decode_block(
+                self.params, self.cfg, self._k_cache, self._v_cache,
+                self._lengths, self._next_input, self._active,
+                self._remaining, self._min_remaining, self._temps,
+                self._top_ps, self._top_ks, self._greedy, eos_global, sub,
+                n_steps=self.block_steps,
+            )
+            out_t = np.asarray(out_t)
+            out_lp_h = np.asarray(out_lp)
+            out_m_h = np.asarray(out_m)
+            hit_eos_h = np.asarray(hit_eos)
+            active_h = np.asarray(self._active)
+            for slot in range(self.B):
+                req = self._slot_req[slot]
+                if req is None:
+                    continue
+                emitted = out_m_h[slot]
+                n = int(emitted.sum())
+                if n:
+                    self._slot_out[slot].extend(out_t[slot, :][emitted].tolist())
+                    self._slot_lp[slot].extend(out_lp_h[slot, :][emitted].tolist())
+                # Per-request extra stop tokens (beyond the global EOS set)
+                # are enforced on host: trim at the first occurrence.
+                extra = set(req.stop_token_ids) - self._eos_set(None)
+                if extra:
+                    for j, t in enumerate(self._slot_out[slot]):
+                        if t in extra:
+                            self._slot_out[slot] = self._slot_out[slot][: j + 1]
+                            self._slot_lp[slot] = self._slot_lp[slot][: j + 1]
+                            self._finish_slot(slot, hit_eos=True)
+                            break
+                    if self._slot_req[slot] is None:
+                        continue
+                if not active_h[slot]:
+                    self._finish_slot(slot, hit_eos=bool(hit_eos_h[slot]))
+        # drain on stop
+        self._interrupt_all()
+
+
+@functools.partial(jax.jit, static_argnames=("greedy", "top_k", "forbid"))
+def _sample_first(logits, rng, greedy: bool, temperature, top_p, top_k: int,
+                  eos_mask, forbid: bool):
+    logits = logits.astype(jnp.float32)[None, :]
+    if forbid:
+        logits = jnp.where(eos_mask[None, :], NEG_INF, logits)
+    base_logp = jax.nn.log_softmax(logits, axis=-1)
+    if greedy:
+        tok = jnp.argmax(logits, axis=-1)
+    else:
+        warped = logits / jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+        warped = apply_top_k(warped, top_k)
+        warped = apply_top_p(warped, jnp.asarray(top_p, jnp.float32))
+        tok = jax.random.categorical(rng, warped, axis=-1)
+    lp = jnp.take_along_axis(base_logp, tok[:, None], axis=-1)[0, 0]
+    return tok[0].astype(jnp.int32), lp

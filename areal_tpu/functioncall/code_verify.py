@@ -57,12 +57,26 @@ def _normalize_output(s: str) -> List[str]:
     return [line.rstrip() for line in s.rstrip().splitlines()]
 
 
+def normalize_test_cases(obj) -> List[Dict[str, str]]:
+    """Accept either the dataset wire format {"inputs": [...], "outputs":
+    [...]} (reference math_code_dataset rows) or an explicit list of
+    {input, output} dicts."""
+    if isinstance(obj, dict) and "inputs" in obj:
+        return [
+            {"input": i, "output": o}
+            for i, o in zip(obj["inputs"], obj["outputs"])
+        ]
+    return list(obj)
+
+
 def code_verify(
     solution_text: str,
-    test_cases: List[Dict[str, str]],
+    test_cases,
     timeout: float = DEFAULT_TIMEOUT,
 ) -> bool:
-    """True if the extracted program passes every {input, output} case."""
+    """True if the extracted program passes every {input, output} case.
+    `test_cases` may be either supported format (see normalize_test_cases)."""
+    test_cases = normalize_test_cases(test_cases)
     code = extract_code_block(solution_text)
     if code is None:
         return False

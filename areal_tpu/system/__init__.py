@@ -12,6 +12,9 @@ import importlib
 _WORKER_CLASSES = {
     "master_worker": ("areal_tpu.system.master_worker", "MasterWorker"),
     "model_worker": ("areal_tpu.system.model_worker", "ModelWorker"),
+    "rollout_worker": ("areal_tpu.system.rollout_worker", "RolloutWorker"),
+    "gserver_manager": ("areal_tpu.system.gserver_manager", "GserverManager"),
+    "generation_server": ("areal_tpu.system.generation_server", "GenerationServer"),
 }
 
 WORKER_TYPES = sorted(_WORKER_CLASSES)

@@ -1,0 +1,224 @@
+"""JAX generation server worker: the ServingEngine behind HTTP.
+
+Counterpart of the reference's GenerationServer + patched SGLang
+(realhf/system/generation_server.py:121, realhf/api/cli_args.py:323-391):
+instead of launching an SGLang subprocess, the engine runs in-process on
+this worker's TPU devices. The HTTP surface mirrors what the rest of the
+stack expects (SURVEY §8 "SGLang server contract"):
+
+- POST /generate {qid, input_ids, gconfig...} -> token-in/token-out with
+  logprobs and version stamps
+- POST /update_weights_from_disk {model_path, allow_interrupt}
+- GET  /metrics  (areal:num_used_tokens / areal:num_running_reqs)
+- GET  /health
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from areal_tpu.api import data_api
+from areal_tpu.api.system_api import GenerationServerConfig
+from areal_tpu.base import constants, logging, name_resolve, names, network, seeding
+from areal_tpu.engine.serving import GenRequest, ServingEngine
+from areal_tpu.system.worker_base import PollResult, Worker
+
+logger = logging.getLogger("generation_server")
+
+
+class GenerationServer(Worker):
+    def _configure(self, config: GenerationServerConfig):
+        self.cfg = config
+        constants.set_experiment_trial_names(
+            config.experiment_name, config.trial_name
+        )
+        seeding.set_random_seed(config.seed, config.worker_name)
+        import areal_tpu.engine.factories  # noqa: F401  (registry)
+        from areal_tpu.api.model_api import make_model
+
+        kwargs: Dict[str, Any] = {"name": f"gen{config.server_index}"}
+        if config.model_path is not None:
+            kwargs["model_path"] = config.model_path
+        if config.tokenizer_path is not None:
+            kwargs["tokenizer_path"] = config.tokenizer_path
+        model = make_model(config.model, **kwargs)
+        raw = model._raw
+        self.tokenizer = model.tokenizer
+        eos = self.tokenizer.eos_token_id if self.tokenizer else None
+        self.engine = ServingEngine(
+            cfg=raw["cfg"],
+            params=raw["params"],
+            max_batch_size=config.max_concurrent_requests,
+            max_seq_len=config.max_seq_len,
+            decode_block_steps=config.decode_block_steps,
+            eos_token_id=eos,
+            seed=config.seed + config.server_index,
+        )
+        self.engine.start()
+        self._n_interrupted = 0
+
+        # HTTP server on its own thread + loop.
+        self._http_loop = asyncio.new_event_loop()
+        self._http_ready = threading.Event()
+        self._http_thread = threading.Thread(target=self._serve_http, daemon=True)
+        self._http_thread.start()
+        if not self._http_ready.wait(30):
+            raise RuntimeError("generation server HTTP failed to start")
+
+        # Register for discovery.
+        name_resolve.add_subentry(
+            names.gen_servers(config.experiment_name, config.trial_name),
+            self.address,
+        )
+        name_resolve.add(
+            names.gen_server_url(
+                config.experiment_name, config.trial_name, str(config.server_index)
+            ),
+            self.address,
+            keepalive_ttl=60,
+            replace=True,
+        )
+        logger.info(f"generation server {config.server_index} at {self.address}")
+
+    # ------------------------------------------------------------------
+    # HTTP
+    # ------------------------------------------------------------------
+
+    def _serve_http(self):
+        asyncio.set_event_loop(self._http_loop)
+        app = web.Application()
+        app.router.add_post("/generate", self._h_generate)
+        app.router.add_post("/update_weights_from_disk", self._h_update_weights)
+        app.router.add_get("/metrics", self._h_metrics)
+        app.router.add_get("/health", self._h_health)
+        runner = web.AppRunner(app)
+        self._http_loop.run_until_complete(runner.setup())
+        host = network.gethostip()
+        port = network.find_free_port()
+        site = web.TCPSite(runner, host, port)
+        self._http_loop.run_until_complete(site.start())
+        self.address = f"http://{host}:{port}"
+        self._http_ready.set()
+        self._http_loop.run_forever()
+
+    async def _h_generate(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        g = d.get("gconfig", {})
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def done_cb(res):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(res) if not fut.done() else None
+            )
+
+        req = GenRequest(
+            qid=str(d["qid"]),
+            input_ids=[int(t) for t in d["input_ids"]],
+            max_new_tokens=int(g.get("max_new_tokens", 256)),
+            min_new_tokens=int(g.get("min_new_tokens", 0)),
+            greedy=bool(g.get("greedy", False)),
+            temperature=float(g.get("temperature", 1.0)),
+            top_p=float(g.get("top_p", 1.0)),
+            top_k=int(g.get("top_k", -1)),
+            stop_token_ids=tuple(g.get("stop_token_ids", [])),
+            done_cb=done_cb,
+        )
+        self.engine.submit(req)
+        res = await fut
+        if res.interrupted:
+            self._n_interrupted += 1
+        return web.json_response(
+            {
+                "qid": res.qid,
+                "output_ids": res.output_ids,
+                "output_logprobs": res.output_logprobs,
+                "no_eos": res.no_eos,
+                "interrupted": res.interrupted,
+                "version_start": res.version_start,
+                "version_end": res.version_end,
+                "latency": res.latency,
+            }
+        )
+
+    async def _h_update_weights(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        model_path = d["model_path"]
+        allow_interrupt = bool(d.get("allow_interrupt", True))
+        try:
+            params = await asyncio.get_running_loop().run_in_executor(
+                None, self._load_params, model_path
+            )
+        except Exception as e:
+            logger.exception("weight update load failed")
+            return web.json_response({"success": False, "error": repr(e)}, status=500)
+        n_running = self.engine.n_running
+        version = d.get("version")
+        self.engine.update_params(
+            params,
+            allow_interrupt=allow_interrupt,
+            version=None if version is None else int(version),
+        )
+        return web.json_response(
+            {"success": True, "num_paused_requests": n_running}
+        )
+
+    @staticmethod
+    def _load_params(model_path: str):
+        state_file = os.path.join(model_path, "engine_state.pkl")
+        if os.path.exists(state_file):
+            with open(state_file, "rb") as f:
+                return pickle.load(f)["params"]
+        # Fall back to an HF checkpoint directory.
+        from areal_tpu.models.hf import load_hf_model
+
+        _, params = load_hf_model(model_path)
+        return params
+
+    async def _h_metrics(self, request: web.Request) -> web.Response:
+        m = self.engine.metrics()
+        lines = [
+            f"areal:num_running_reqs {m['num_running_reqs']}",
+            f"areal:num_used_tokens {m['num_used_tokens']}",
+            f"areal:total_generated_tokens {m['total_generated']}",
+            f"areal:queue_depth {m['queue_depth']}",
+            f"areal:num_interrupted_reqs {float(self._n_interrupted)}",
+            f"areal:weight_version {float(self.engine.version)}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def _h_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "version": self.engine.version})
+
+    # ------------------------------------------------------------------
+
+    def _poll(self) -> Optional[PollResult]:
+        # Exit when the experiment completes (reference
+        # generation_server.py:209-222 watches experiment status).
+        try:
+            status = name_resolve.get(
+                names.experiment_status(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                )
+            )
+            if status in ("COMPLETE", "ABORT"):
+                return None
+        except name_resolve.NameEntryNotFoundError:
+            pass
+        time.sleep(0.2)
+        return PollResult(batch_count=0)
+
+    def _exit_hook(self):
+        try:
+            self.engine.stop()
+            self._http_loop.call_soon_threadsafe(self._http_loop.stop)
+            self._http_thread.join(timeout=5)
+        except Exception:
+            pass

@@ -1,0 +1,312 @@
+"""Generation-server manager: router + staleness controller + weight updater.
+
+Counterpart of the reference's GserverManager
+(realhf/system/gserver_manager.py:32-496). Singleton worker that:
+
+- routes generation requests across servers (/schedule_request) with
+  round_robin / least_requests / least_token_usage policies
+- gates new rollouts by capacity and staleness (/allocate_rollout):
+  a rollout may start only if (expected model version when it trains) -
+  (current weight version) <= max_head_offpolicyness
+- watches the trainer's published model version and fans out
+  /update_weights_from_disk (interrupting running requests) to servers
+- GCs old param-realloc dumps
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from areal_tpu.api.system_api import GserverManagerConfig
+from areal_tpu.base import constants, logging, name_resolve, names, network
+from areal_tpu.system.worker_base import PollResult, Worker
+
+logger = logging.getLogger("gserver_manager")
+
+
+class RolloutStat:
+    def __init__(self):
+        self.submitted = 0
+        self.running = 0
+        self.accepted = 0
+
+    def as_dict(self):
+        return dict(
+            submitted=self.submitted, running=self.running, accepted=self.accepted
+        )
+
+
+class GserverManager(Worker):
+    def _configure(self, config: GserverManagerConfig):
+        self.cfg = config
+        constants.set_experiment_trial_names(
+            config.experiment_name, config.trial_name
+        )
+        # Wait for all generation servers to register.
+        key = names.gen_servers(config.experiment_name, config.trial_name)
+        deadline = time.monotonic() + 300
+        while True:
+            urls = name_resolve.get_subtree(key)
+            if len(urls) >= config.n_servers:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"only {len(urls)}/{config.n_servers} generation servers up"
+                )
+            time.sleep(0.2)
+        self.server_urls: List[str] = sorted(urls)
+        self._rr = 0
+        self._server_reqs = {u: 0 for u in self.server_urls}  # in-flight est.
+        self._server_tokens = {u: 0.0 for u in self.server_urls}
+        self.weight_version = 0
+        self.rollout_stat = RolloutStat()
+        self._lock = threading.Lock()
+        self._last_metrics_poll = 0.0
+
+        self._http_loop = asyncio.new_event_loop()
+        self._http_ready = threading.Event()
+        self._http_thread = threading.Thread(target=self._serve_http, daemon=True)
+        self._http_thread.start()
+        if not self._http_ready.wait(30):
+            raise RuntimeError("gserver manager HTTP failed to start")
+        name_resolve.add(
+            names.gen_server_manager(config.experiment_name, config.trial_name),
+            self.address,
+            keepalive_ttl=60,
+            replace=True,
+        )
+        logger.info(
+            f"gserver manager at {self.address}, servers={self.server_urls}"
+        )
+
+    # ------------------------------------------------------------------
+    # Scheduling / staleness
+    # ------------------------------------------------------------------
+
+    def _choose_server(self, meta: Dict) -> str:
+        prev = meta.get("previous_server_url") or ""
+        prev_version = int(meta.get("previous_version", -1))
+        # Sticky routing while the version is unchanged (KV prefix reuse).
+        if prev in self.server_urls and prev_version == self.weight_version:
+            return prev
+        policy = self.cfg.schedule_policy
+        if policy == "least_requests":
+            return min(self.server_urls, key=lambda u: self._server_reqs[u])
+        if policy == "least_token_usage":
+            return min(self.server_urls, key=lambda u: self._server_tokens[u])
+        url = self.server_urls[self._rr % len(self.server_urls)]
+        self._rr += 1
+        return url
+
+    def _training_samples(self) -> int:
+        try:
+            return int(
+                name_resolve.get(
+                    names.training_samples(
+                        self.cfg.experiment_name, self.cfg.trial_name
+                    )
+                )
+            )
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return 0
+
+    def is_staled(self) -> bool:
+        """Staleness gate (reference gserver_manager.py:351-366): if this
+        rollout trained at the version implied by samples already produced,
+        would it be more than max_head_offpolicyness behind?"""
+        global_samples = max(
+            self._training_samples(),
+            self.rollout_stat.submitted,
+        )
+        expected_version = global_samples // self.cfg.train_batch_size
+        return (
+            expected_version - self.weight_version
+            > self.cfg.max_head_offpolicyness
+        )
+
+    # ------------------------------------------------------------------
+    # HTTP endpoints
+    # ------------------------------------------------------------------
+
+    def _serve_http(self):
+        asyncio.set_event_loop(self._http_loop)
+        app = web.Application()
+        app.router.add_post("/schedule_request", self._h_schedule)
+        app.router.add_post("/allocate_rollout", self._h_allocate)
+        app.router.add_post("/finish_rollout", self._h_finish)
+        app.router.add_get("/status", self._h_status)
+        runner = web.AppRunner(app)
+        self._http_loop.run_until_complete(runner.setup())
+        host = network.gethostip()
+        port = network.find_free_port()
+        self._http_loop.run_until_complete(web.TCPSite(runner, host, port).start())
+        self.address = f"http://{host}:{port}"
+        self._http_ready.set()
+        self._http_loop.run_forever()
+
+    async def _h_schedule(self, request: web.Request) -> web.Response:
+        meta = await request.json()
+        with self._lock:
+            url = self._choose_server(meta)
+            self._server_reqs[url] += 1
+        return web.json_response({"url": url, "version": self.weight_version})
+
+    async def _h_allocate(self, request: web.Request) -> web.Response:
+        await request.json()
+        with self._lock:
+            cap = self.cfg.max_concurrent_rollouts or (1 << 30)
+            if self.rollout_stat.running >= cap:
+                return web.json_response(
+                    {"success": False, "reason": "capacity"}
+                )
+            if self.is_staled():
+                return web.json_response(
+                    {"success": False, "reason": "staled",
+                     "version": self.weight_version}
+                )
+            self.rollout_stat.submitted += 1
+            self.rollout_stat.running += 1
+        return web.json_response({"success": True, "version": self.weight_version})
+
+    async def _h_finish(self, request: web.Request) -> web.Response:
+        d = await request.json()
+        with self._lock:
+            self.rollout_stat.running -= 1
+            if d.get("accepted", True):
+                self.rollout_stat.accepted += 1
+            else:
+                # Rejected rollouts give their staleness budget back.
+                self.rollout_stat.submitted -= 1
+        return web.json_response({"success": True})
+
+    async def _h_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "weight_version": self.weight_version,
+                "rollout_stat": self.rollout_stat.as_dict(),
+                "servers": self.server_urls,
+            }
+        )
+
+    # ------------------------------------------------------------------
+    # Weight-update fanout (runs on the worker poll loop)
+    # ------------------------------------------------------------------
+
+    def check_new_params(self) -> Optional[str]:
+        try:
+            v = int(
+                name_resolve.get(
+                    names.model_version(
+                        self.cfg.experiment_name,
+                        self.cfg.trial_name,
+                        self.cfg.model_name,
+                    )
+                )
+            )
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None
+        if v <= self.weight_version:
+            return None
+        path = os.path.join(
+            constants.get_param_realloc_path(
+                self.cfg.experiment_name, self.cfg.trial_name
+            ),
+            self.cfg.model_name,
+        )
+        if not os.path.exists(os.path.join(path, "engine_state.pkl")):
+            return None
+        self._new_version = v
+        return path
+
+    def flush_requests_and_update_weights(self, path: str):
+        async def _update():
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.cfg.flush_request_timeout)
+            ) as sess:
+                tasks = [
+                    sess.post(
+                        f"{u}/update_weights_from_disk",
+                        json={
+                            "model_path": path,
+                            "allow_interrupt": True,
+                            # Pin the engines to the trainer's published
+                            # version so routing/staleness accounting agree.
+                            "version": self._new_version,
+                        },
+                    )
+                    for u in self.server_urls
+                ]
+                resps = await asyncio.gather(*tasks, return_exceptions=True)
+                for u, r in zip(self.server_urls, resps):
+                    if isinstance(r, Exception):
+                        raise RuntimeError(f"weight update to {u} failed: {r!r}")
+                    body = await r.json()
+                    if not body.get("success"):
+                        raise RuntimeError(
+                            f"weight update to {u} rejected: {body}"
+                        )
+
+        fut = asyncio.run_coroutine_threadsafe(_update(), self._http_loop)
+        fut.result(timeout=self.cfg.flush_request_timeout + 10)
+        with self._lock:
+            self.weight_version = self._new_version
+        logger.info(f"all servers updated to weight version {self.weight_version}")
+
+    async def _poll_metrics(self):
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=5)
+        ) as sess:
+            for u in list(self.server_urls):
+                try:
+                    async with sess.get(f"{u}/metrics") as r:
+                        text = await r.text()
+                    for line in text.splitlines():
+                        if line.startswith("areal:num_used_tokens"):
+                            self._server_tokens[u] = float(line.split()[-1])
+                        elif line.startswith("areal:num_running_reqs"):
+                            self._server_reqs[u] = int(float(line.split()[-1]))
+                except Exception:
+                    logger.warning(f"metrics poll failed for {u}")
+
+    def _poll(self) -> Optional[PollResult]:
+        try:
+            status = name_resolve.get(
+                names.experiment_status(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                )
+            )
+            if status in ("COMPLETE", "ABORT"):
+                return None
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+        path = self.check_new_params()
+        if path is not None:
+            self.flush_requests_and_update_weights(path)
+            return PollResult(batch_count=1)
+        if time.monotonic() - self._last_metrics_poll > 2.0:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._poll_metrics(), self._http_loop
+            )
+            try:
+                fut.result(timeout=10)
+            except Exception:
+                pass
+            self._last_metrics_poll = time.monotonic()
+        time.sleep(0.05)
+        return PollResult(batch_count=0)
+
+    def _exit_hook(self):
+        try:
+            self._http_loop.call_soon_threadsafe(self._http_loop.stop)
+            self._http_thread.join(timeout=5)
+        except Exception:
+            pass

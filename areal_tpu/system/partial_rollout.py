@@ -1,0 +1,155 @@
+"""Chunked, interruptible generation client.
+
+Counterpart of the reference's PartialRolloutManager
+(realhf/system/partial_rollout.py:29-290): generation is issued in
+chunks of at most `new_tokens_per_chunk` tokens so a weight update only
+ever discards one chunk of work; unfinished (interrupted or chunk-
+exhausted) requests are re-scheduled — possibly onto a different server
+with newer weights — with the concatenated prefix, whose KV the server
+recomputes under the new weights. Groups of n samples per prompt are
+gathered into `BundledGenerationOutputs`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from areal_tpu.api.model_api import (
+    APIGenerateInput,
+    APIGenerateOutput,
+    BundledGenerationOutputs,
+    GenerationHyperparameters,
+)
+from areal_tpu.base import logging
+
+logger = logging.getLogger("partial_rollout")
+
+
+class PartialRolloutManager:
+    def __init__(
+        self,
+        manager_addr: str,
+        new_tokens_per_chunk: int = 1 << 30,
+        request_timeout: float = 300.0,
+    ):
+        self.manager_addr = manager_addr
+        self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
+        self.request_timeout = request_timeout
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.request_timeout)
+            )
+        return self._session
+
+    async def close(self):
+        if self._session and not self._session.closed:
+            await self._session.close()
+
+    async def _schedule(self, meta: Dict) -> Dict:
+        sess = await self._sess()
+        async with sess.post(
+            f"{self.manager_addr}/schedule_request", json=meta
+        ) as r:
+            return await r.json()
+
+    async def _generate_one(
+        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+    ) -> APIGenerateOutput:
+        """Generate one sample, chunk by chunk, resubmitting with the
+        accumulated prefix after interrupts (reference _run_gen:92,
+        refresh_generation:181)."""
+        acc_out: List[int] = []
+        acc_lp: List[float] = []
+        version_start = -1
+        version_end = -1
+        no_eos = True
+        prev_url, prev_version = "", -1
+        budget = gconfig.max_new_tokens
+        sess = await self._sess()
+        while budget > 0:
+            sched = await self._schedule(
+                dict(
+                    prompt_len=len(prompt_ids) + len(acc_out),
+                    group_size=1,
+                    new_token_budget=budget,
+                    previous_server_url=prev_url,
+                    previous_version=prev_version,
+                )
+            )
+            url, server_version = sched["url"], int(sched.get("version", -1))
+            chunk = min(budget, self.new_tokens_per_chunk)
+            payload = dict(
+                qid=qid,
+                input_ids=list(prompt_ids) + acc_out,
+                gconfig=dict(
+                    max_new_tokens=chunk,
+                    min_new_tokens=max(
+                        0, gconfig.min_new_tokens - len(acc_out)
+                    ),
+                    greedy=gconfig.greedy,
+                    temperature=gconfig.temperature,
+                    top_p=gconfig.top_p,
+                    top_k=gconfig.top_k,
+                    stop_token_ids=list(gconfig.stop_token_ids),
+                ),
+            )
+            async with sess.post(f"{url}/generate", json=payload) as r:
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"generate failed on {url}: {r.status} {await r.text()}"
+                    )
+                out = await r.json()
+            if version_start < 0:
+                version_start = int(out.get("version_start", server_version))
+            version_end = int(out.get("version_end", server_version))
+            made_progress = len(out["output_ids"]) > 0
+            acc_out.extend(int(t) for t in out["output_ids"])
+            acc_lp.extend(float(x) for x in out["output_logprobs"])
+            budget = gconfig.max_new_tokens - len(acc_out)
+            prev_url, prev_version = url, version_end
+            if not out.get("no_eos", True):
+                no_eos = False
+                break
+            if not made_progress and not out.get("interrupted", False):
+                # The server cannot extend this sequence (e.g. the prefix
+                # hit its cache limit): stop instead of resubmitting the
+                # identical request forever.
+                logger.warning(
+                    f"{qid}: server returned no progress at len "
+                    f"{len(prompt_ids) + len(acc_out)}; truncating"
+                )
+                break
+            # no_eos: either interrupted (resubmit under new weights) or the
+            # chunk budget ran out (continue with the next chunk).
+            if budget <= 0:
+                break
+        return APIGenerateOutput(
+            qid=qid,
+            prompt_ids=list(prompt_ids),
+            input_ids=list(prompt_ids),
+            output_ids=acc_out,
+            output_logprobs=acc_lp,
+            no_eos=no_eos,
+            version_start=version_start,
+            version_end=version_end,
+        )
+
+    async def generate_group(
+        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+    ) -> BundledGenerationOutputs:
+        """n samples for one prompt, concurrently."""
+        outs = await asyncio.gather(
+            *[
+                self._generate_one(f"{qid}/{i}", prompt_ids, gconfig)
+                for i in range(gconfig.n)
+            ]
+        )
+        for o in outs:
+            o.qid = qid  # group members share the prompt's qid
+        return BundledGenerationOutputs.from_api_outputs(list(outs))

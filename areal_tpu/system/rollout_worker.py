@@ -1,0 +1,203 @@
+"""Rollout worker: prompts -> agent episodes -> trajectory push.
+
+Counterpart of the reference's RolloutWorker
+(realhf/system/rollout_worker.py:43-372): an async loop that loads the
+next prompt, asks the gserver manager for quota (/allocate_rollout —
+capacity + staleness gated), runs the agent's episode coroutine with the
+PartialRolloutManager servicing its obs queue, reports /finish_rollout,
+and pushes accepted trajectories to the trainer over the ZMQ push
+stream as JSON.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from areal_tpu.api import data_api
+from areal_tpu.api.agent_api import make_agent
+from areal_tpu.api.env_api import make_env
+from areal_tpu.api.system_api import RolloutWorkerConfig
+from areal_tpu.base import constants, logging, name_resolve, names, seeding
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
+from areal_tpu.system.worker_base import AsyncWorker, PollResult
+
+logger = logging.getLogger("rollout_worker")
+
+
+class RolloutWorker(AsyncWorker):
+    def _configure(self, config: RolloutWorkerConfig):
+        self.cfg = config
+        constants.set_experiment_trial_names(
+            config.experiment_name, config.trial_name
+        )
+        seeding.set_random_seed(config.seed, config.worker_name)
+        import areal_tpu.agents  # noqa: F401  (registers agents/envs)
+        import areal_tpu.datasets  # noqa: F401
+
+        tokenizer = (
+            data_api.load_hf_tokenizer(config.tokenizer_path)
+            if config.tokenizer_path
+            else None
+        )
+        self.tokenizer = tokenizer
+        util = data_api.DatasetUtility(
+            seed=config.seed,
+            dp_rank=config.worker_index,
+            world_size=config.n_rollout_workers,
+            tokenizer=tokenizer,
+        )
+        if len(config.datasets) != 1:
+            raise NotImplementedError(
+                f"rollout worker supports exactly one dataset, got "
+                f"{len(config.datasets)}"
+            )
+        self.dataset = data_api.make_dataset(config.datasets[0], util)
+        self.dataloader = data_api.PackedDataLoader(
+            self.dataset, batch_size=1, shuffle=True, seed=config.seed
+        )
+        agent_kwargs = {"tokenizer": tokenizer}
+        if "gconfig" not in (config.agent.args or {}):
+            import dataclasses as _dc
+
+            agent_kwargs["gconfig"] = _dc.asdict(config.gconfig)
+        self.agent = make_agent(config.agent, **agent_kwargs)
+        self.env = make_env(config.env)
+
+        self.manager_addr = name_resolve.wait(
+            names.gen_server_manager(config.experiment_name, config.trial_name),
+            timeout=300,
+        )
+        self.prm = PartialRolloutManager(
+            self.manager_addr,
+            new_tokens_per_chunk=config.new_tokens_per_chunk,
+            request_timeout=config.rollout_request_timeout,
+        )
+        self.pusher = NameResolvingZmqPusher(
+            config.experiment_name,
+            config.trial_name,
+            pusher_index=config.worker_index,
+            n_pushers=config.n_rollout_workers,
+            n_pullers=config.n_pullers,
+        )
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._tasks: Dict[str, asyncio.Task] = {}
+        self._push_count = 0
+        self._episode_counter = itertools.count()
+        logger.info(
+            f"{config.worker_name} configured; manager at {self.manager_addr}"
+        )
+
+    async def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60)
+            )
+        return self._session
+
+    async def _allocate(self) -> bool:
+        sess = await self._http()
+        async with sess.post(
+            f"{self.manager_addr}/allocate_rollout", json={}
+        ) as r:
+            d = await r.json()
+        return bool(d.get("success"))
+
+    async def _finish(self, accepted: bool):
+        sess = await self._http()
+        async with sess.post(
+            f"{self.manager_addr}/finish_rollout", json={"accepted": accepted}
+        ) as r:
+            await r.json()
+
+    async def rollout_task(self, prompt):
+        """One episode: agent coroutine + generation servicing
+        (reference rollout_task:330)."""
+        obs_queue: asyncio.Queue = asyncio.Queue()
+        act_queue: asyncio.Queue = asyncio.Queue()
+
+        async def service_gen():
+            qid, prompt_ids, gconfig = await obs_queue.get()
+            bundle = await self.prm.generate_group(str(qid), prompt_ids, gconfig)
+            await act_queue.put(bundle)
+
+        accepted = False
+        gen_task = None
+        try:
+            gen_task = asyncio.create_task(service_gen())
+            agent_task = asyncio.create_task(
+                self.agent.collect_trajectory(
+                    prompt, self.env, obs_queue, act_queue
+                )
+            )
+            # If generation fails, the agent would block on act_queue
+            # forever — watch both and cancel the agent on gen failure.
+            done, _ = await asyncio.wait(
+                {gen_task, agent_task}, return_when=asyncio.FIRST_EXCEPTION
+            )
+            if gen_task in done and gen_task.exception() is not None:
+                agent_task.cancel()
+                try:
+                    await agent_task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                raise gen_task.exception()
+            trajs = await agent_task
+            await gen_task
+            for t in trajs:
+                self.pusher.push(data_api.sample_to_json(t))
+                self._push_count += 1
+            accepted = bool(trajs)
+        except Exception:
+            logger.exception("rollout episode failed")
+        finally:
+            if gen_task is not None and not gen_task.done():
+                gen_task.cancel()
+            await self._finish(accepted)
+
+    async def _poll_async(self) -> Optional[PollResult]:
+        # Experiment status gate (reference rollout_worker.py:216-228).
+        try:
+            status = name_resolve.get(
+                names.experiment_status(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                )
+            )
+            if status in ("COMPLETE", "ABORT"):
+                for t in self._tasks.values():
+                    t.cancel()
+                return None
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+        # Reap finished episode tasks.
+        self._tasks = {k: t for k, t in self._tasks.items() if not t.done()}
+
+        if len(self._tasks) >= self.cfg.max_concurrent_rollouts:
+            await asyncio.sleep(0.02)
+            return PollResult(batch_count=0)
+
+        try:
+            ok = await self._allocate()
+        except Exception:
+            logger.warning("allocate_rollout failed; retrying", exc_info=True)
+            await asyncio.sleep(0.5)
+            return PollResult(batch_count=0)
+        if not ok:
+            await asyncio.sleep(0.1)
+            return PollResult(batch_count=0)
+
+        batch, _ = self.dataloader.next_batch()
+        eid = next(self._episode_counter)
+        self._tasks[f"ep{eid}"] = asyncio.create_task(self.rollout_task(batch))
+        return PollResult(sample_count=1, batch_count=1)
+
+    def _exit_hook(self):
+        try:
+            self.pusher.close()
+        except Exception:
+            pass

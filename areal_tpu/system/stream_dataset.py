@@ -1,0 +1,83 @@
+"""Stream dataset: makes async rollouts look like a dataset to the trainer.
+
+Counterpart of the reference's PullerStreamDataset
+(realhf/system/stream_dataset.py:23-106): a background thread pulls JSON
+trajectories from the rollout workers' push stream into a queue; the
+model worker's "fetch" handler drains it into `SequenceSample` batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+from areal_tpu.api import data_api
+from areal_tpu.base import logging
+from areal_tpu.system.push_pull_stream import NameResolvingZmqPuller
+
+logger = logging.getLogger("stream_dataset")
+
+
+class PullerStreamDataset:
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        puller_index: int = 0,
+        max_queue_size: int = 4096,
+        pull_timeout_ms: int = 100,
+    ):
+        self.puller = NameResolvingZmqPuller(
+            experiment_name, trial_name, puller_index=puller_index
+        )
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_queue_size)
+        self._stop = threading.Event()
+        self._pull_timeout_ms = pull_timeout_ms
+        self._thread = threading.Thread(target=self._pull_worker, daemon=True)
+        self._thread.start()
+        self.n_pulled = 0
+
+    def _pull_worker(self):
+        while not self._stop.is_set():
+            try:
+                d = self.puller.pull(timeout_ms=self._pull_timeout_ms)
+            except TimeoutError:
+                continue
+            except Exception:
+                logger.exception("puller error")
+                continue
+            try:
+                sample = data_api.sample_from_json(d)
+            except Exception:
+                logger.exception("bad trajectory json dropped")
+                continue
+            self.n_pulled += 1
+            try:
+                self._queue.put(sample, timeout=5)
+            except queue.Full:
+                logger.warning("stream dataset queue full; dropping trajectory")
+
+    def qsize(self) -> int:
+        return self._queue.qsize()
+
+    def poll_batch(self, max_samples: int = 64) -> Optional["data_api.SequenceSample"]:
+        """Drain up to max_samples pulled trajectories into one batch."""
+        samples: List[data_api.SequenceSample] = []
+        while len(samples) < max_samples:
+            try:
+                samples.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not samples:
+            return None
+        return data_api.SequenceSample.gather(samples)
+
+    def __len__(self):
+        # Unknown a priori; reference returns the configured dataset size.
+        return self.qsize()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=3)
+        self.puller.close()

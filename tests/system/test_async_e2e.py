@@ -1,0 +1,149 @@
+"""Async RL e2e on CPU: generation server + gserver manager + rollout
+worker (math agent/env) + stream-dataset trainer + master, all real
+components on a tiny model (mirrors reference async PPO tests +
+SURVEY §3.4/3.5 data/weight paths)."""
+
+import uuid
+
+import pytest
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, ParamReallocHook
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    ExperimentSaveEvalControl,
+    GenerationServerConfig,
+    GserverManagerConfig,
+    MasterWorkerConfig,
+    ModelShardSpec,
+    ModelWorkerConfig,
+    RolloutWorkerConfig,
+)
+from areal_tpu.system.controller import LocalController
+from tests import fixtures
+from tests.system.test_e2e_experiments import TINY_CFG, _mk_tokenizer_files, _worker_env
+
+
+@pytest.mark.slow
+def test_async_ppo_e2e(tmp_path):
+    exp, trial = f"e2e-async-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    mc_rows = [r for r in fixtures.make_math_code_rows(12, seed=9) if r["task"] == "math"]
+    data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
+
+    actor = ModelName("actor", 0)
+    n_seqs = 2
+
+    train = MFCDef(
+        name="actor_train",
+        model_name=actor,
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=n_seqs,
+        input_keys=(
+            "packed_input_ids",
+            "prompt_mask",
+            "packed_logprobs",
+            "rewards",
+            "seq_no_eos_mask",
+        ),
+        post_hooks=[ParamReallocHook(source=str(actor))],
+    )
+
+    model_args = dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32")
+    mw = ModelWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        shards=[
+            ModelShardSpec(
+                id=ModelShardID(actor),
+                model=ModelAbstraction("tpu_transformer", args=model_args),
+                backend=ModelBackendAbstraction(
+                    "jax_train",
+                    args=dict(optimizer=dict(lr=1e-4), remat=False, row_len_multiple=8),
+                ),
+                interface=ModelInterfaceAbstraction(
+                    "ppo_actor", args=dict(kl_ctl=0.0)
+                ),
+            )
+        ],
+        tokenizer_path=tok_dir,
+        train_batch_size=n_seqs,
+        total_train_epochs=1,
+        stream_dataset=True,
+        n_pullers=1,
+    )
+    master = MasterWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
+        rpcs=[train],
+        model_topos={str(actor): ["model_worker/0"]},
+        data_hosts=["model_worker/0"],
+        n_model_workers=1,
+        train_batch_size=n_seqs,
+    )
+    gen_server = GenerationServerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        server_index=0,
+        model=ModelAbstraction("tpu_transformer", args=model_args),
+        tokenizer_path=tok_dir,
+        max_concurrent_requests=4,
+        max_seq_len=256,
+        decode_block_steps=4,
+    )
+    gserver_mgr = GserverManagerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        model_name="actor",
+        n_servers=1,
+        train_batch_size=n_seqs,
+        max_head_offpolicyness=100,  # don't gate in this tiny test
+    )
+    rollout = RolloutWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        n_rollout_workers=1,
+        n_pullers=1,
+        agent=AgentAbstraction(
+            "math-single-step",
+            args=dict(gconfig=dict(n=2, max_new_tokens=8)),
+        ),
+        env=EnvServiceAbstraction("math-code-single-step"),
+        datasets=[
+            DatasetAbstraction("math_code_prompt", args=dict(dataset_path=data_path))
+        ],
+        tokenizer_path=tok_dir,
+        max_concurrent_rollouts=4,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        master=master,
+        model_workers=[mw],
+        rollout_workers=[rollout],
+        gserver_manager=gserver_mgr,
+        generation_servers=[gen_server],
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={
+            "backend": "nfs",
+            "record_root": str(tmp_path / "name_resolve"),
+        },
+        worker_env=_worker_env(tmp_path),
+    )
+    result = ctl.run()
+    assert result["global_step"] == 2
