@@ -1,0 +1,221 @@
+"""User-facing experiment option dataclasses + `key=value` override CLI.
+
+Counterpart of the reference's cli args module (realhf/api/cli_args.py,
+1558 LoC of Hydra structured configs). Hydra/OmegaConf are not available
+in this environment, so the same pattern is realized with plain
+dataclasses plus a dotted-path `key=value` override parser
+(`apply_overrides`) — the experiment classes remain *properties over the
+dataclass* exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.api.system_api import ExperimentSaveEvalControl
+from areal_tpu.engine.optimizer import OptimizerConfig
+
+
+@dataclasses.dataclass
+class ModelTrainEvalConfig:
+    """One model's build + engine options (reference ModelTrainEvalConfig)."""
+
+    path: Optional[str] = None  # HF checkpoint dir; None = random init
+    init_from_scratch: bool = False
+    config: Optional[Dict[str, Any]] = None  # TransformerConfig kwargs
+    is_critic: bool = False
+    dtype: str = "bfloat16"
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    backend: str = "jax_train"  # jax_train | jax_inference | mock_train
+    remat: bool = True
+    mesh_spec: Optional[str] = None  # worker-local mesh, e.g. "d1f4t2"
+    row_len_multiple: int = 128
+    max_row_len: Optional[int] = None
+
+
+@dataclasses.dataclass
+class PPOHyperparameters:
+    """Mirrors reference PPOHyperparameters (api/cli_args.py)."""
+
+    gconfig: GenerationHyperparameters = dataclasses.field(
+        default_factory=lambda: GenerationHyperparameters(
+            max_new_tokens=512, top_p=1.0, temperature=1.0
+        )
+    )
+    group_size: int = 1
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    c_clip: Optional[float] = None
+    value_eps_clip: float = 0.2
+    disable_value: bool = True  # group-reward baseline by default (GRPO-style)
+    reward_output_scaling: float = 1.0
+    reward_output_bias: float = 0.0
+    max_reward_clip: float = 20.0
+    mask_no_eos_with_zero: bool = False
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: bool = True
+    group_adv_norm: bool = False
+    kl_ctl: float = 0.1
+    use_adaptive_kl_ctl: bool = False
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: Optional[float] = None
+    recompute_logprob: bool = True
+    fuse_rew_ref: bool = False
+    success_rate_lb: float = 0.0
+    success_rate_ub: float = 1.0
+    # async controls
+    max_head_offpolicyness: int = 0
+    new_tokens_per_chunk: int = 1 << 30
+    max_concurrent_rollouts: int = 32
+
+
+@dataclasses.dataclass
+class DatasetConfig:
+    path: Optional[str] = None
+    max_length: Optional[int] = 1024
+    type_: str = "math_code_prompt"
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BaseExperimentConfig:
+    """Fields shared by every experiment (reference CommonExperimentConfig,
+    experiments/common/common.py:72)."""
+
+    experiment_name: str = "exp"
+    trial_name: str = "trial"
+    seed: int = 1
+    total_train_epochs: int = 1
+    train_batch_size: int = 8
+    tokenizer_path: Optional[str] = None
+    dataset: DatasetConfig = dataclasses.field(default_factory=DatasetConfig)
+    exp_ctrl: ExperimentSaveEvalControl = dataclasses.field(
+        default_factory=ExperimentSaveEvalControl
+    )
+    # "d2t4" or decoupled "gen.d1t1+d1t1"; data axis -> #model workers for
+    # the single-host local launcher.
+    allocation_mode: str = "d1"
+    n_model_workers: int = 1
+    recover_mode: str = "disabled"  # disabled | auto | resume
+    recover_retries: int = 1
+    name_resolve_backend: str = "nfs"
+    name_resolve_root: Optional[str] = None
+    mb_spec_n_mbs: int = 1
+    mb_spec_max_tokens: Optional[int] = None
+
+
+@dataclasses.dataclass
+class SFTExpConfig(BaseExperimentConfig):
+    model: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=ModelTrainEvalConfig
+    )
+
+    def __post_init__(self):
+        if self.dataset.type_ == "math_code_prompt":
+            self.dataset.type_ = "prompt_answer"
+
+
+@dataclasses.dataclass
+class PPOMATHExpConfig(BaseExperimentConfig):
+    """Sync PPO on math/code prompts (reference PPOMATHConfig)."""
+
+    actor: ModelTrainEvalConfig = dataclasses.field(
+        default_factory=ModelTrainEvalConfig
+    )
+    ref: Optional[ModelTrainEvalConfig] = None  # default: copy of actor path
+    critic: Optional[ModelTrainEvalConfig] = None  # None when disable_value
+    ppo: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
+    group_size: int = 1
+
+    def __post_init__(self):
+        if self.group_size > 1:
+            self.ppo.group_size = self.group_size
+
+
+@dataclasses.dataclass
+class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
+    """Async PPO: decoupled generation + streaming rollouts
+    (reference AsyncPPOMATHConfig)."""
+
+    n_rollout_workers: int = 1
+    n_generation_servers: int = 1
+    gen_max_concurrent_requests: int = 32
+    gen_max_seq_len: int = 4096
+    gen_decode_block_steps: int = 16
+    schedule_policy: str = "round_robin"
+
+
+# ---------------------------------------------------------------------------
+# key=value override parsing
+# ---------------------------------------------------------------------------
+
+
+def _coerce(value: str, typ) -> Any:
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if value.lower() in ("none", "null"):
+            return None
+        return _coerce(value, args[0]) if args else value
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ in (dict, Dict, Any) or origin in (dict, list) or typ is list:
+        return json.loads(value)
+    return value
+
+
+def apply_overrides(cfg: Any, overrides: List[str]) -> Any:
+    """Apply `a.b.c=value` overrides in place onto nested dataclasses."""
+    hints_cache: Dict[type, Dict[str, Any]] = {}
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override {ov!r} is not key=value")
+        path, value = ov.split("=", 1)
+        obj = cfg
+        parts = path.split(".")
+        for p in parts[:-1]:
+            if not hasattr(obj, p):
+                raise AttributeError(f"no field {p!r} on {type(obj).__name__}")
+            nxt = getattr(obj, p)
+            if nxt is None and dataclasses.is_dataclass(obj):
+                # Instantiate Optional nested dataclasses on demand so
+                # e.g. `critic.path=/ckpt` works when critic defaults None.
+                cls = type(obj)
+                if cls not in hints_cache:
+                    hints_cache[cls] = typing.get_type_hints(cls)
+                typ = hints_cache[cls].get(p)
+                inner = None
+                for cand in typing.get_args(typ) or (typ,):
+                    if dataclasses.is_dataclass(cand):
+                        inner = cand
+                        break
+                if inner is None:
+                    raise AttributeError(
+                        f"field {p!r} is None and not a dataclass "
+                        f"(declared type: {typ})"
+                    )
+                nxt = inner()
+                setattr(obj, p, nxt)
+            obj = nxt
+        leaf = parts[-1]
+        if dataclasses.is_dataclass(obj):
+            cls = type(obj)
+            if cls not in hints_cache:
+                hints_cache[cls] = typing.get_type_hints(cls)
+            if leaf not in hints_cache[cls]:
+                raise AttributeError(f"no field {leaf!r} on {cls.__name__}")
+            setattr(obj, leaf, _coerce(value, hints_cache[cls][leaf]))
+        elif isinstance(obj, dict):
+            obj[leaf] = json.loads(value) if value[:1] in "[{" else value
+        else:
+            raise AttributeError(f"cannot set {leaf!r} on {type(obj)}")
+    return cfg

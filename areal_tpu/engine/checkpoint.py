@@ -35,7 +35,7 @@ def save_engine_state(engine, save_dir: str):
         "opt_state": _to_host(engine.opt_state) if engine.opt_state is not None else None,
         "version": engine.version,
     }
-    tmp = os.path.join(save_dir, _STATE_FILE + ".tmp")
+    tmp = os.path.join(save_dir, f"{_STATE_FILE}.tmp.{os.getpid()}")
     with open(tmp, "wb") as f:
         pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, os.path.join(save_dir, _STATE_FILE))

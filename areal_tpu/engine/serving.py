@@ -109,12 +109,18 @@ def _decode_block(
         logits = jnp.where(forbid, NEG_INF, logits)
         base_logp = jax.nn.log_softmax(logits, axis=-1)
         warped = logits / jnp.maximum(temps[:, None], 1e-6)
-        # per-row top-k: kth-largest threshold via a sorted copy
+        # ONE descending sort serves both warps: the per-row top-k threshold
+        # and the top-p nucleus cutoff (two independent sorts would double
+        # the dominant per-step sampling cost at real vocab sizes).
         sorted_desc = jnp.sort(warped, axis=-1)[:, ::-1]
         k_eff = jnp.where(top_ks <= 0, V, jnp.minimum(top_ks, V))
         kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
-        warped = jnp.where(warped < kth, NEG_INF, warped)
-        warped = apply_top_p(warped, top_ps[:, None])
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_ps[:, None]
+        cutoff_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+        p_cut = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+        warped = jnp.where(warped < jnp.maximum(kth, p_cut), NEG_INF, warped)
         sampled = jax.random.categorical(sub, warped, axis=-1)
         argmax = jnp.argmax(logits, axis=-1)
         tokens = jnp.where(greedy_mask, argmax, sampled).astype(jnp.int32)
@@ -449,10 +455,14 @@ class ServingEngine:
                     self._slot_out[slot].extend(out_t[slot, :][emitted].tolist())
                     self._slot_lp[slot].extend(out_lp_h[slot, :][emitted].tolist())
                 # Per-request extra stop tokens (beyond the global EOS set)
-                # are enforced on host: trim at the first occurrence.
+                # are enforced on host: trim at the first occurrence AFTER
+                # the min_new_tokens floor (the device forbid mask only
+                # covers the global EOS set).
                 extra = set(req.stop_token_ids) - self._eos_set(None)
                 if extra:
                     for j, t in enumerate(self._slot_out[slot]):
+                        if j < req.min_new_tokens:
+                            continue
                         if t in extra:
                             self._slot_out[slot] = self._slot_out[slot][: j + 1]
                             self._slot_lp[slot] = self._slot_lp[slot][: j + 1]

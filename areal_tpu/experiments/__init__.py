@@ -1,0 +1,21 @@
+"""Experiment definitions: option dataclasses -> worker configs + MFC graph.
+
+Counterpart of the reference's experiments layer (realhf/experiments/):
+each experiment class is a pure function from its cli_args dataclass to
+an `ExperimentConfig` (worker configs + DFG), registered by name.
+"""
+
+from areal_tpu.api.config import Registry
+
+EXPERIMENT_REGISTRY = Registry("experiment")
+
+
+def register_experiment(name: str, builder):
+    EXPERIMENT_REGISTRY.register(name, builder)
+
+
+def make_experiment(name: str, cfg):
+    return EXPERIMENT_REGISTRY.make(name, cfg)
+
+
+from areal_tpu.experiments import sft_exp, ppo_math_exp, async_ppo_math_exp  # noqa: E402,F401

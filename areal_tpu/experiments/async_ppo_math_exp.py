@@ -1,0 +1,174 @@
+"""Async PPO math experiment (reference experiments/async_exp/
+async_ppo_math_exp.py): decoupled generation servers + rollout workers
+stream trajectories to stream-dataset trainers; the train-side DFG is
+{ref_inf?} -> actor_train with a post-hook param-realloc dump that the
+gserver manager fans out to the servers."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from areal_tpu.api.cli_args import AsyncPPOMATHExpConfig
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    EnvServiceAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, ParamReallocHook
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    GenerationServerConfig,
+    GserverManagerConfig,
+    ModelShardSpec,
+    RolloutWorkerConfig,
+)
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+from areal_tpu.experiments.ppo_math_exp import actor_interface_args
+
+
+def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentConfig:
+    n_workers = C.resolve_n_workers(cfg)
+    actor = ModelName("actor", 0)
+    ref = ModelName("ref", 0)
+    use_ref = cfg.ref is not None or (
+        cfg.actor.path is not None and cfg.ppo.kl_ctl != 0.0
+    )
+    mbs = C.mb_spec(cfg)
+    n_seqs = cfg.train_batch_size
+    iface_args = actor_interface_args(cfg)
+
+    train_input_keys = [
+        "packed_input_ids", "prompt_mask", "packed_logprobs",
+        "rewards", "seq_no_eos_mask",
+    ]
+    rpcs = []
+    if use_ref:
+        rpcs.append(
+            MFCDef(
+                name="ref_inf",
+                model_name=ref,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                n_seqs=n_seqs,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("logprobs",),
+                output_key_remap={"logprobs": "ref_logprobs"},
+                mb_spec=mbs,
+            )
+        )
+        train_input_keys.append("ref_logprobs")
+    rpcs.append(
+        MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            n_seqs=n_seqs,
+            input_keys=tuple(train_input_keys),
+            mb_spec=mbs,
+            post_hooks=[ParamReallocHook(source=str(actor))],
+        )
+    )
+
+    workers = []
+    for i in range(n_workers):
+        shards = [
+            ModelShardSpec(
+                id=ModelShardID(actor, host_rank=i, n_hosts=n_workers),
+                model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+                backend=C.backend_abstraction(cfg.actor, train=True),
+                interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
+            )
+        ]
+        if use_ref:
+            ref_cfg = cfg.ref or cfg.actor
+            shards.append(
+                ModelShardSpec(
+                    id=ModelShardID(ref, host_rank=i, n_hosts=n_workers),
+                    model=C.model_abstraction(ref_cfg, cfg.tokenizer_path),
+                    backend=C.backend_abstraction(ref_cfg, train=False),
+                    interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
+                )
+            )
+        workers.append(
+            C.base_model_worker(
+                cfg, i, n_workers, shards, with_dataset=False, stream_dataset=True
+            )
+        )
+
+    names_ = C.worker_names(n_workers)
+    model_topos = {str(actor): names_}
+    if use_ref:
+        model_topos[str(ref)] = names_
+    master = C.base_master(cfg, rpcs, model_topos, n_workers)
+
+    gen_servers = [
+        GenerationServerConfig(
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+            server_index=i,
+            model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+            tokenizer_path=cfg.tokenizer_path or cfg.actor.path,
+            max_concurrent_requests=cfg.gen_max_concurrent_requests,
+            max_seq_len=cfg.gen_max_seq_len,
+            decode_block_steps=cfg.gen_decode_block_steps,
+            seed=cfg.seed,
+        )
+        for i in range(cfg.n_generation_servers)
+    ]
+    manager = GserverManagerConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        model_name=actor.role,
+        n_servers=cfg.n_generation_servers,
+        schedule_policy=cfg.schedule_policy,
+        max_head_offpolicyness=cfg.ppo.max_head_offpolicyness,
+        train_batch_size=cfg.train_batch_size,
+        max_concurrent_rollouts=cfg.ppo.max_concurrent_rollouts,
+    )
+    rollouts = [
+        RolloutWorkerConfig(
+            experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name,
+            worker_index=i,
+            n_rollout_workers=cfg.n_rollout_workers,
+            n_pullers=n_workers,
+            model_name=actor.role,
+            agent=AgentAbstraction(
+                "math-single-step",
+                args=dict(
+                    gconfig=dataclasses.asdict(
+                        cfg.ppo.gconfig.new(n=cfg.ppo.group_size)
+                    ),
+                    success_rate_lb=cfg.ppo.success_rate_lb,
+                    success_rate_ub=cfg.ppo.success_rate_ub,
+                    reward_scaling=cfg.ppo.reward_output_scaling,
+                    reward_bias=cfg.ppo.reward_output_bias,
+                ),
+            ),
+            env=EnvServiceAbstraction("math-code-single-step"),
+            datasets=[C.dataset_abstraction(cfg.dataset)],
+            tokenizer_path=cfg.tokenizer_path or cfg.actor.path,
+            new_tokens_per_chunk=cfg.ppo.new_tokens_per_chunk,
+            max_concurrent_rollouts=max(
+                1, cfg.ppo.max_concurrent_rollouts // cfg.n_rollout_workers
+            ),
+            seed=cfg.seed,
+        )
+        for i in range(cfg.n_rollout_workers)
+    ]
+    return ExperimentConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        master=master,
+        model_workers=workers,
+        rollout_workers=rollouts,
+        gserver_manager=manager,
+        generation_servers=gen_servers,
+    )
+
+
+register_experiment("async-ppo-math", build_async_ppo_math_experiment)

@@ -1,0 +1,127 @@
+"""Shared experiment-building helpers (reference experiments/common/)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from areal_tpu.api.cli_args import (
+    BaseExperimentConfig,
+    DatasetConfig,
+    ModelTrainEvalConfig,
+)
+from areal_tpu.api.config import (
+    DatasetAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.data_api import MicroBatchSpec
+from areal_tpu.api.system_api import (
+    MasterWorkerConfig,
+    ModelShardSpec,
+    ModelWorkerConfig,
+)
+from areal_tpu.parallel.mesh import AllocationMode
+
+
+def model_abstraction(m: ModelTrainEvalConfig, tokenizer_path: Optional[str],
+                      is_critic: bool = False) -> ModelAbstraction:
+    args: Dict = dict(
+        tokenizer_path=tokenizer_path or m.path,
+        is_critic=is_critic or m.is_critic,
+        dtype=m.dtype,
+        mesh_spec=m.mesh_spec,
+    )
+    if m.path and not m.init_from_scratch:
+        args["model_path"] = m.path
+    else:
+        assert m.config is not None, "need model config for scratch init"
+        args["config"] = dict(m.config)
+    return ModelAbstraction("tpu_transformer", args=args)
+
+
+def backend_abstraction(m: ModelTrainEvalConfig, train: bool = True) -> ModelBackendAbstraction:
+    if m.backend.startswith("mock"):
+        return ModelBackendAbstraction(m.backend)
+    name = "jax_train" if train else "jax_inference"
+    args = dict(
+        remat=m.remat,
+        row_len_multiple=m.row_len_multiple,
+        max_row_len=m.max_row_len,
+    )
+    if train:
+        args["optimizer"] = dataclasses.asdict(m.optimizer)
+    return ModelBackendAbstraction(name, args=args)
+
+
+def dataset_abstraction(d: DatasetConfig) -> DatasetAbstraction:
+    args = dict(d.args)
+    if d.path is not None:
+        args.setdefault("dataset_path", d.path)
+    if d.max_length is not None and d.type_ in ("prompt_answer", "prompt", "rw_pair"):
+        args.setdefault("max_length", d.max_length)
+    return DatasetAbstraction(d.type_, args=args)
+
+
+def mb_spec(cfg: BaseExperimentConfig) -> MicroBatchSpec:
+    return MicroBatchSpec(
+        n_mbs=cfg.mb_spec_n_mbs, max_tokens_per_mb=cfg.mb_spec_max_tokens
+    )
+
+
+def worker_names(n: int) -> List[str]:
+    return [f"model_worker/{i}" for i in range(n)]
+
+
+def resolve_n_workers(cfg: BaseExperimentConfig) -> int:
+    """The local single-host launcher maps the allocation's train data axis
+    onto model workers when n_model_workers is left at default."""
+    if cfg.n_model_workers > 1:
+        return cfg.n_model_workers
+    try:
+        alloc = AllocationMode.parse(cfg.allocation_mode)
+        return max(1, alloc.train_spec.data)
+    except Exception:
+        return cfg.n_model_workers
+
+
+def base_model_worker(
+    cfg: BaseExperimentConfig,
+    index: int,
+    n_workers: int,
+    shards: List[ModelShardSpec],
+    with_dataset: bool = True,
+    stream_dataset: bool = False,
+) -> ModelWorkerConfig:
+    return ModelWorkerConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        worker_index=index,
+        shards=shards,
+        datasets=[dataset_abstraction(cfg.dataset)] if with_dataset else [],
+        tokenizer_path=cfg.tokenizer_path,
+        dataset_dp_rank=index,
+        dataset_dp_size=n_workers,
+        train_batch_size=cfg.train_batch_size,
+        total_train_epochs=cfg.total_train_epochs,
+        seed=cfg.seed,
+        stream_dataset=stream_dataset,
+        n_pullers=n_workers if stream_dataset else 1,
+    )
+
+
+def base_master(cfg: BaseExperimentConfig, rpcs, model_topos, n_workers: int) -> MasterWorkerConfig:
+    return MasterWorkerConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        exp_ctrl=cfg.exp_ctrl,
+        rpcs=rpcs,
+        model_topos=model_topos,
+        data_hosts=worker_names(n_workers),
+        n_model_workers=n_workers,
+        train_batch_size=cfg.train_batch_size,
+        recover_mode=cfg.recover_mode,
+    )

@@ -1,0 +1,209 @@
+"""Sync PPO math experiment (reference experiments/common/ppo_math_exp.py).
+
+DFG: actor_gen -> {rew_inf, ref_inf[, critic_inf]} ->
+{actor_train[, critic_train]} with all models colocated on every model
+worker (the reference's "global hybrid" allocation); generation runs
+in-framework on the trainer mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from areal_tpu.api.cli_args import PPOMATHExpConfig
+from areal_tpu.api.config import (
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType
+from areal_tpu.api.system_api import ExperimentConfig, ModelShardSpec
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+
+
+def actor_interface_args(cfg: PPOMATHExpConfig) -> dict:
+    p = cfg.ppo
+    # group_size may be set at top level after construction (CLI override),
+    # so resolve here instead of __post_init__.
+    group = cfg.group_size if cfg.group_size > 1 else p.group_size
+    p.group_size = group
+    return dict(
+        n_minibatches=p.ppo_n_minibatches,
+        eps_clip=p.eps_clip,
+        c_clip=p.c_clip,
+        kl_ctl=p.kl_ctl,
+        adaptive_kl_ctl=p.use_adaptive_kl_ctl,
+        discount=p.discount,
+        gae_lambda=p.gae_lambda,
+        max_reward_clip=p.max_reward_clip,
+        reward_output_scaling=p.reward_output_scaling,
+        reward_output_bias=p.reward_output_bias,
+        adv_norm=p.adv_norm,
+        group_adv_norm=p.group_adv_norm,
+        mask_no_eos_with_zero=p.mask_no_eos_with_zero,
+        use_decoupled_loss=p.use_decoupled_loss,
+        behav_imp_weight_cap=p.behav_imp_weight_cap,
+        gconfig=dataclasses.asdict(p.gconfig.new(n=p.group_size)),
+    )
+
+
+def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
+    n_workers = C.resolve_n_workers(cfg)
+    actor = ModelName("actor", 0)
+    ref = ModelName("ref", 0)
+    rew = ModelName("reward", 0)
+    critic = ModelName("critic", 0)
+    use_critic = not cfg.ppo.disable_value and cfg.critic is not None
+    use_ref = cfg.ref is not None or (cfg.actor.path is not None)
+
+    mbs = C.mb_spec(cfg)
+    n_seqs = cfg.train_batch_size
+    rpcs: List[MFCDef] = [
+        MFCDef(
+            name="actor_gen",
+            model_name=actor,
+            interface_type=ModelInterfaceType.GENERATE,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            n_seqs=n_seqs,
+            input_keys=("packed_prompts",),
+            output_keys=(
+                "packed_input_ids", "prompt_mask", "packed_logprobs",
+                "seq_no_eos_mask",
+            ),
+            balanced_dp=True,
+            mb_spec=mbs,
+        ),
+        MFCDef(
+            name="rew_inf",
+            model_name=rew,
+            interface_type=ModelInterfaceType.INFERENCE,
+            interface_impl=ModelInterfaceAbstraction("rw-math-code"),
+            n_seqs=n_seqs,
+            input_keys=("packed_input_ids", "prompt_mask"),
+            output_keys=("rewards",),
+            mb_spec=mbs,
+        ),
+    ]
+    train_input_keys = [
+        "packed_input_ids", "prompt_mask", "packed_logprobs",
+        "rewards", "seq_no_eos_mask",
+    ]
+    if use_ref:
+        rpcs.append(
+            MFCDef(
+                name="ref_inf",
+                model_name=ref,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+                n_seqs=n_seqs,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("logprobs",),
+                output_key_remap={"logprobs": "ref_logprobs"},
+                mb_spec=mbs,
+            )
+        )
+        train_input_keys.append("ref_logprobs")
+    if use_critic:
+        rpcs.append(
+            MFCDef(
+                name="critic_inf",
+                model_name=critic,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                n_seqs=n_seqs,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("values",),
+                mb_spec=mbs,
+            )
+        )
+        train_input_keys.append("values")
+        rpcs.append(
+            MFCDef(
+                name="critic_train",
+                model_name=ModelName("critic", 1),
+                interface_type=ModelInterfaceType.TRAIN_STEP,
+                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                n_seqs=n_seqs,
+                input_keys=tuple(train_input_keys),
+                mb_spec=mbs,
+            )
+        )
+    rpcs.append(
+        MFCDef(
+            name="actor_train",
+            model_name=actor,
+            interface_type=ModelInterfaceType.TRAIN_STEP,
+            interface_impl=ModelInterfaceAbstraction("ppo_actor"),
+            n_seqs=n_seqs,
+            input_keys=tuple(train_input_keys),
+            mb_spec=mbs,
+        )
+    )
+
+    iface_args = actor_interface_args(cfg)
+    workers = []
+    for i in range(n_workers):
+        shards = [
+            ModelShardSpec(
+                id=ModelShardID(actor, host_rank=i, n_hosts=n_workers),
+                model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+                backend=C.backend_abstraction(cfg.actor, train=True),
+                interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
+            ),
+            ModelShardSpec(
+                id=ModelShardID(rew, host_rank=i, n_hosts=n_workers),
+                model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+                backend=ModelBackendAbstraction("mock_inference"),
+                interface=ModelInterfaceAbstraction("rw-math-code"),
+            ),
+        ]
+        if use_ref:
+            ref_cfg = cfg.ref or cfg.actor
+            shards.append(
+                ModelShardSpec(
+                    id=ModelShardID(ref, host_rank=i, n_hosts=n_workers),
+                    model=C.model_abstraction(ref_cfg, cfg.tokenizer_path),
+                    backend=C.backend_abstraction(ref_cfg, train=False),
+                    interface=ModelInterfaceAbstraction(
+                        "ppo_actor", args=iface_args
+                    ),
+                )
+            )
+        if use_critic:
+            for replica in (0, 1):
+                shards.append(
+                    ModelShardSpec(
+                        id=ModelShardID(
+                            ModelName("critic", replica), host_rank=i, n_hosts=n_workers
+                        ),
+                        model=C.model_abstraction(
+                            cfg.critic, cfg.tokenizer_path, is_critic=True
+                        ),
+                        backend=C.backend_abstraction(
+                            cfg.critic, train=(replica == 1)
+                        ),
+                        interface=ModelInterfaceAbstraction("ppo_critic"),
+                    )
+                )
+        workers.append(C.base_model_worker(cfg, i, n_workers, shards))
+
+    names = C.worker_names(n_workers)
+    model_topos = {str(actor): names, str(rew): names}
+    if use_ref:
+        model_topos[str(ref)] = names
+    if use_critic:
+        model_topos[str(ModelName("critic", 0))] = names
+        model_topos[str(ModelName("critic", 1))] = names
+    master = C.base_master(cfg, rpcs, model_topos, n_workers)
+    return ExperimentConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        master=master,
+        model_workers=workers,
+    )
+
+
+register_experiment("ppo-math", build_ppo_math_experiment)

@@ -1,0 +1,51 @@
+"""SFT experiment (reference experiments/common/sft_exp.py)."""
+
+from __future__ import annotations
+
+from areal_tpu.api.cli_args import SFTExpConfig
+from areal_tpu.api.config import (
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType
+from areal_tpu.api.system_api import ExperimentConfig, ModelShardSpec
+from areal_tpu.experiments import register_experiment
+from areal_tpu.experiments import common as C
+
+
+def build_sft_experiment(cfg: SFTExpConfig) -> ExperimentConfig:
+    n_workers = C.resolve_n_workers(cfg)
+    model_name = ModelName("default", 0)
+    train = MFCDef(
+        name="trainDefault",
+        model_name=model_name,
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=ModelInterfaceAbstraction("sft"),
+        n_seqs=cfg.train_batch_size,
+        input_keys=("packed_input_ids", "prompt_mask"),
+        mb_spec=C.mb_spec(cfg),
+    )
+    workers = []
+    for i in range(n_workers):
+        shards = [
+            ModelShardSpec(
+                id=ModelShardID(model_name, host_rank=i, n_hosts=n_workers),
+                model=C.model_abstraction(cfg.model, cfg.tokenizer_path),
+                backend=C.backend_abstraction(cfg.model, train=True),
+                interface=ModelInterfaceAbstraction("sft"),
+            )
+        ]
+        workers.append(C.base_model_worker(cfg, i, n_workers, shards))
+    master = C.base_master(
+        cfg, [train], {str(model_name): C.worker_names(n_workers)}, n_workers
+    )
+    return ExperimentConfig(
+        experiment_name=cfg.experiment_name,
+        trial_name=cfg.trial_name,
+        master=master,
+        model_workers=workers,
+    )
+
+
+register_experiment("sft", build_sft_experiment)

@@ -290,7 +290,14 @@ class GserverManager(Worker):
 
         path = self.check_new_params()
         if path is not None:
-            self.flush_requests_and_update_weights(path)
+            try:
+                self.flush_requests_and_update_weights(path)
+            except Exception:
+                # Transient server failure: weight_version stays put, so the
+                # next poll retries the (idempotent, version-pinned) fanout.
+                logger.warning("weight-update fanout failed; will retry",
+                               exc_info=True)
+                time.sleep(1.0)
             return PollResult(batch_count=1)
         if time.monotonic() - self._last_metrics_poll > 2.0:
             fut = asyncio.run_coroutine_threadsafe(

@@ -111,8 +111,10 @@ class ModelWorker(Worker):
         self.interfaces: Dict[str, Any] = {}
         self.backends: Dict[str, Any] = {}
         dataset_size = len(self._dataset) * config.dataset_dp_size if self._dataset is not None else 0
+        self._host_rank: Dict[str, int] = {}
         for shard in config.shards:
             mn = shard.id.model_name
+            self._host_rank[str(mn)] = shard.id.host_rank
             ft_spec = FinetuneSpec(
                 total_train_epochs=config.total_train_epochs,
                 dataset_size=dataset_size,
@@ -200,9 +202,6 @@ class ModelWorker(Worker):
                 res = interface.train_step(model, input_, mb_spec)
                 out = None
                 stats = res[-1] if isinstance(res, list) else res
-                # Interfaces own model.inc_version(); the worker only
-                # publishes the new version for the staleness gate.
-                self._publish_version(mn)
             else:
                 raise ValueError(f"bad interface_type {itype!r}")
 
@@ -215,6 +214,13 @@ class ModelWorker(Worker):
 
         for hook in req.post_hooks:
             self._exec_hook(hook, model_name, step)
+
+        if itype == "train_step" and self._host_rank.get(model_name, 0) == 0:
+            # Publish AFTER post-hooks: the param-realloc dump the gserver
+            # manager fans out must be on disk before the version appears,
+            # or servers would load the previous step's weights under the
+            # new version number. Only DP rank 0 publishes (and dumps).
+            self._publish_version(mn)
 
         return {"stats": stats, "output_meta": output_meta}
 
@@ -360,7 +366,13 @@ class ModelWorker(Worker):
         realloc_root = constants.get_param_realloc_path(
             self.cfg.experiment_name, self.cfg.trial_name
         )
-        if src is not None and src in self.models:
+        if (
+            src is not None
+            and src in self.models
+            and self._host_rank.get(src, 0) == 0
+        ):
+            # Single writer: DP replicas hold identical logical params, so
+            # only rank 0 dumps (concurrent writers would tear the pickle).
             model = self.models[src]
             d = os.path.join(realloc_root, ModelName.parse(src).role)
             from areal_tpu.engine.checkpoint import save_engine_state

@@ -157,7 +157,13 @@ class RolloutWorker(AsyncWorker):
         finally:
             if gen_task is not None and not gen_task.done():
                 gen_task.cancel()
-            await self._finish(accepted)
+            try:
+                await self._finish(accepted)
+            except Exception:
+                # Best effort: a transient manager failure must not leave an
+                # unretrieved task exception (the quota slot does leak until
+                # the manager resyncs, but the worker keeps running).
+                logger.warning("finish_rollout failed", exc_info=True)
 
     async def _poll_async(self) -> Optional[PollResult]:
         # Experiment status gate (reference rollout_worker.py:216-228).

@@ -53,10 +53,16 @@ class PullerStreamDataset:
                 logger.exception("bad trajectory json dropped")
                 continue
             self.n_pulled += 1
-            try:
-                self._queue.put(sample, timeout=5)
-            except queue.Full:
-                logger.warning("stream dataset queue full; dropping trajectory")
+            # Block (with stop checks) rather than drop: the manager already
+            # counted this trajectory as submitted, so dropping it would
+            # desync the staleness accounting. Blocking applies backpressure
+            # through the ZMQ high-water mark to the rollout workers.
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(sample, timeout=1)
+                    break
+                except queue.Full:
+                    continue
 
     def qsize(self) -> int:
         return self._queue.qsize()

@@ -1,0 +1,160 @@
+"""Experiment builders + cli overrides + entry-point e2e (mirrors the
+reference tests/experiments category at the config level)."""
+
+import json
+import subprocess
+import sys
+import uuid
+
+import pytest
+
+from areal_tpu.api.cli_args import (
+    AsyncPPOMATHExpConfig,
+    PPOMATHExpConfig,
+    SFTExpConfig,
+    apply_overrides,
+)
+from areal_tpu.api.dfg import build_graph
+from areal_tpu.experiments import make_experiment
+from tests import fixtures
+from tests.system.test_e2e_experiments import TINY_CFG
+
+
+def test_apply_overrides_types():
+    cfg = SFTExpConfig()
+    apply_overrides(
+        cfg,
+        [
+            "experiment_name=abc",
+            "train_batch_size=32",
+            "model.optimizer.lr=0.001",
+            "model.remat=false",
+            "exp_ctrl.benchmark_steps=5",
+            f"model.config={json.dumps(TINY_CFG)}",
+            "dataset.max_length=none",
+        ],
+    )
+    assert cfg.experiment_name == "abc"
+    assert cfg.train_batch_size == 32
+    assert cfg.model.optimizer.lr == 0.001
+    assert cfg.model.remat is False
+    assert cfg.exp_ctrl.benchmark_steps == 5
+    assert cfg.model.config["hidden_dim"] == 32
+    assert cfg.dataset.max_length is None
+    with pytest.raises(AttributeError):
+        apply_overrides(cfg, ["nonexistent_field=1"])
+
+
+def _sft_cfg(tmp_path):
+    rows = fixtures.make_sft_rows(16, seed=3)
+    texts = [r["prompt"] + " " + r["answer"] for r in rows]
+    tok = fixtures.train_tiny_tokenizer(texts, tmp_path)
+    tok_dir = str(tmp_path / "tok")
+    tok.save_pretrained(tok_dir)
+    data = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+    cfg = SFTExpConfig()
+    apply_overrides(
+        cfg,
+        [
+            f"experiment_name=sft-{uuid.uuid4().hex[:6]}",
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            "dataset.max_length=64",
+            "train_batch_size=4",
+            "model.backend=mock_train",
+            f"model.config={json.dumps(TINY_CFG)}",
+            "exp_ctrl.benchmark_steps=3",
+            f"name_resolve_root={tmp_path / 'nr'}",
+        ],
+    )
+    return cfg, tok_dir, data
+
+
+def test_build_sft_and_ppo_experiments(tmp_path):
+    cfg, tok_dir, data = _sft_cfg(tmp_path)
+    exp = make_experiment("sft", cfg)
+    assert len(exp.model_workers) == 1
+    assert exp.master.rpcs[0].name == "trainDefault"
+    build_graph(exp.master.rpcs)
+
+    pcfg = PPOMATHExpConfig()
+    apply_overrides(
+        pcfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+            "group_size=2",
+        ],
+    )
+    exp = make_experiment("ppo-math", pcfg)
+    g = build_graph(exp.master.rpcs)
+    names = set(g.rpcs)
+    assert {"actor_gen", "rew_inf", "actor_train"} <= names
+    # scratch init without a path: no ref model
+    assert "ref_inf" not in names
+    # group size propagated into the generate interface
+    gen = g.rpcs["actor_gen"]
+    actor_shard = exp.model_workers[0].shards[0]
+    assert actor_shard.interface.args["gconfig"]["n"] == 2
+
+    acfg = AsyncPPOMATHExpConfig()
+    apply_overrides(
+        acfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+            "n_rollout_workers=2",
+            "ppo.max_head_offpolicyness=4",
+        ],
+    )
+    exp = make_experiment("async-ppo-math", acfg)
+    assert len(exp.rollout_workers) == 2
+    assert exp.gserver_manager.max_head_offpolicyness == 4
+    assert exp.generation_servers[0].tokenizer_path == tok_dir
+    assert exp.model_workers[0].stream_dataset
+    build_graph(exp.master.rpcs)
+
+
+@pytest.mark.slow
+def test_main_sft_entrypoint(tmp_path):
+    """Run the real CLI entry point in a subprocess (mock engine)."""
+    cfg, tok_dir, data = _sft_cfg(tmp_path)
+    cmd = [
+        sys.executable,
+        "training/main_sft.py",
+        f"experiment_name={cfg.experiment_name}",
+        f"tokenizer_path={tok_dir}",
+        f"dataset.path={data}",
+        "dataset.max_length=64",
+        "train_batch_size=4",
+        "model.backend=mock_train",
+        f"model.config={json.dumps(TINY_CFG)}",
+        "exp_ctrl.benchmark_steps=3",
+        f"name_resolve_root={tmp_path / 'nr2'}",
+    ]
+    import os
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        AREAL_FILEROOT=str(tmp_path / "fileroot"),
+    )
+    out = subprocess.run(
+        cmd, cwd="/root/repo", env=env, capture_output=True, text=True, timeout=300
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "experiment finished" in (out.stderr + out.stdout)
+
+
+def test_optional_nested_dataclass_override():
+    cfg = PPOMATHExpConfig()
+    assert cfg.critic is None
+    apply_overrides(cfg, ["critic.path=/some/ckpt", "critic.is_critic=true",
+                          "ppo.disable_value=false"])
+    assert cfg.critic is not None
+    assert cfg.critic.path == "/some/ckpt"
+    assert cfg.ppo.disable_value is False

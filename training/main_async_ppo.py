@@ -1,0 +1,19 @@
+"""Async PPO entry point (reference training/main_async_ppo.py).
+
+Usage:
+    python training/main_async_ppo.py \
+        experiment_name=async-ppo actor.path=/ckpts/qwen \
+        dataset.path=/data/math.jsonl ppo.max_head_offpolicyness=4 \
+        n_generation_servers=1 n_rollout_workers=2
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.api.cli_args import AsyncPPOMATHExpConfig
+from training.utils import main
+
+if __name__ == "__main__":
+    main("async-ppo-math", AsyncPPOMATHExpConfig)

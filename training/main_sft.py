@@ -1,0 +1,18 @@
+"""SFT entry point (reference training/main_sft.py).
+
+Usage:
+    python training/main_sft.py \
+        experiment_name=my-sft model.path=/ckpts/qwen2.5-1.5b \
+        dataset.path=/data/sft.jsonl train_batch_size=64
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.api.cli_args import SFTExpConfig
+from training.utils import main
+
+if __name__ == "__main__":
+    main("sft", SFTExpConfig)

@@ -1,0 +1,18 @@
+"""Sync PPO entry point (reference training/main_sync_ppo.py).
+
+Usage:
+    python training/main_sync_ppo.py \
+        experiment_name=ppo actor.path=/ckpts/qwen dataset.path=/data/math.jsonl \
+        ppo.gconfig.max_new_tokens=1024 group_size=8
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.api.cli_args import PPOMATHExpConfig
+from training.utils import main
+
+if __name__ == "__main__":
+    main("ppo-math", PPOMATHExpConfig)

@@ -1,0 +1,69 @@
+"""Experiment runner with a fault-tolerant relaunch loop.
+
+Counterpart of the reference's launcher (realhf/apps/main.py:77-289 +
+training/utils.py): run the experiment via the LocalController; on
+worker/master failure, relaunch with recover_mode=auto up to
+`recover_retries` times, resuming from the last recover checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+from typing import Optional, Type
+
+from areal_tpu.api.cli_args import apply_overrides
+from areal_tpu.base import constants, logging, name_resolve
+from areal_tpu.experiments import make_experiment
+from areal_tpu.system.controller import LocalController
+
+logger = logging.getLogger("launcher")
+
+
+def parse_args(cfg_cls: Type, argv=None):
+    parser = argparse.ArgumentParser(
+        description=f"areal_tpu launcher ({cfg_cls.__name__}). "
+        "Overrides: dotted key=value pairs, e.g. actor.path=/ckpt lr=1e-5",
+    )
+    parser.add_argument("overrides", nargs="*", help="a.b.c=value overrides")
+    args = parser.parse_args(argv)
+    cfg = cfg_cls()
+    apply_overrides(cfg, args.overrides)
+    return cfg
+
+
+def run_experiment(experiment_type: str, cfg, worker_env: Optional[dict] = None) -> dict:
+    """Build + run, relaunching with recovery on failure
+    (reference apps/main.py:236-289)."""
+    name_resolve_cfg = {"backend": cfg.name_resolve_backend}
+    if cfg.name_resolve_root:
+        name_resolve_cfg["record_root"] = cfg.name_resolve_root
+    constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
+
+    attempt = 0
+    while True:
+        exp_cfg = make_experiment(experiment_type, cfg)
+        ctl = LocalController(
+            exp_cfg, name_resolve_cfg=name_resolve_cfg, worker_env=worker_env
+        )
+        try:
+            return ctl.run()
+        except Exception:
+            attempt += 1
+            if cfg.recover_mode == "disabled" or attempt > cfg.recover_retries:
+                raise
+            logger.exception(
+                f"experiment failed; relaunching with recovery "
+                f"(attempt {attempt}/{cfg.recover_retries})"
+            )
+            cfg.recover_mode = "auto"
+            time.sleep(2)
+
+
+def main(experiment_type: str, cfg_cls: Type, argv=None):
+    cfg = parse_args(cfg_cls, argv)
+    result = run_experiment(experiment_type, cfg)
+    logger.info(f"experiment finished: {result}")
+    return result
