@@ -197,8 +197,24 @@ class JaxTrainEngine(TrainEngine):
                         p, self.model_cfg,
                         rows["input_ids"], rows["segment_ids"], rows["positions"],
                         attn_impl=self.attn_impl, remat=self.remat,
+                        return_aux=self.model_cfg.moe is not None,
                     )
+                    if self.model_cfg.moe is not None:
+                        logits, moe_aux = logits
                     loss_sum, aux = loss_fn(logits, rows)
+                    if self.model_cfg.moe is not None:
+                        # MoE aux losses scale with token count so they
+                        # survive the 1/global_denom normalization applied
+                        # at the optimizer step.
+                        n_tok = jnp.sum(rows["segment_ids"] > 0).astype(jnp.float32)
+                        moe_cfg = self.model_cfg.moe
+                        loss_sum = loss_sum + n_tok * (
+                            moe_cfg.aux_loss_coef * moe_aux["load_balance_loss"]
+                            + moe_cfg.z_loss_coef * moe_aux["z_loss"]
+                        )
+                        aux = dict(aux)
+                        aux["moe_load_balance"] = n_tok * moe_aux["load_balance_loss"]
+                        aux["moe_z_loss"] = n_tok * moe_aux["z_loss"]
                     return loss_sum, aux
 
                 (loss_sum, aux), grads = jax.value_and_grad(compute, has_aux=True)(params)

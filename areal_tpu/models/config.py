@@ -16,6 +16,10 @@ from typing import Optional
 class MoEConfig:
     num_experts: int = 8
     top_k: int = 2
+    # Expert capacity = capacity_factor * T * top_k / num_experts; tokens
+    # beyond it are dropped (standard einsum-MoE training approximation;
+    # >= num_experts / top_k guarantees no drops).
+    capacity_factor: float = 1.25
     routed_scaling_factor: float = 1.0
     aux_loss_coef: float = 1e-3
     z_loss_coef: float = 0.0
@@ -41,6 +45,9 @@ class TransformerConfig:
     norm_type: str = "rms"  # rms | layer
     norm_eps: float = 1e-6
 
+    # Position encoding: "rotary" (default) or "learned" absolute
+    # embeddings (gpt2).
+    pos_emb: str = "rotary"
     rotary_base: float = 10000.0
     rotary_scaling: Optional[float] = None
     rotary_scaling_type: Optional[str] = None  # linear | llama3 | None
@@ -50,6 +57,7 @@ class TransformerConfig:
     rotary_interleaved: bool = False
 
     attn_bias: bool = False  # qwen2 uses qkv bias
+    attn_out_bias: bool = False  # gpt2 also biases the output projection
     mlp_bias: bool = False
     qk_norm: bool = False  # qwen3 per-head RMSNorm on q/k
     tied_embeddings: bool = False

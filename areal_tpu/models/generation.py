@@ -78,15 +78,26 @@ def _decode_layer(x, lp, cfg, cos, sin, k_cache_l, v_cache_l, lengths, cdt):
     if cfg.qk_norm:
         q = rms_norm(q, a["q_norm"], cfg.norm_eps)
         k = rms_norm(k, a["k_norm"], cfg.norm_eps)
-    # cos/sin: [B, hd/2] at the current position of each row.
-    q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
-    k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+    if cos is not None:
+        # cos/sin: [B, hd/2] at the current position of each row.
+        q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
+        k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
     b_idx = jnp.arange(B)
     k_cache_l = k_cache_l.at[b_idx, lengths].set(k)
     v_cache_l = v_cache_l.at[b_idx, lengths].set(v)
     out = decode_attention(q, k_cache_l, v_cache_l, lengths + 1)
-    x = x + out.reshape(B, cfg.q_dim) @ a["wo"].astype(cdt)
-    x = x + _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg, cdt)
+    attn_out = out.reshape(B, cfg.q_dim) @ a["wo"].astype(cdt)
+    if "bo" in a:
+        attn_out = attn_out + a["bo"].astype(cdt)
+    x = x + attn_out
+    h = _norm(x, lp["ln2"], cfg)
+    if cfg.moe is not None:
+        from areal_tpu.models.moe import moe_mlp
+
+        m, _ = moe_mlp(h, lp["mlp"], cfg, cdt)
+    else:
+        m = _mlp(h, lp["mlp"], cfg, cdt)
+    x = x + m
     return x, k_cache_l, v_cache_l
 
 
@@ -100,13 +111,17 @@ def decode_step(params, cfg: TransformerConfig, tokens, k_cache, v_cache, length
     x = params["embedding"]["weight"][tokens].astype(cdt)  # [B, D]
     if cfg.embedding_multiplier:
         x = x * jnp.asarray(cfg.embedding_multiplier, cdt)
-    inv_freq = jnp.asarray(
-        rotary_inv_freq(
-            cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling,
-            cfg.rotary_scaling_type, cfg.rotary_scaling_params,
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_embedding"]["weight"][lengths].astype(cdt)
+        cos = sin = None
+    else:
+        inv_freq = jnp.asarray(
+            rotary_inv_freq(
+                cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling,
+                cfg.rotary_scaling_type, cfg.rotary_scaling_params,
+            )
         )
-    )
-    cos, sin = rotary_cos_sin(lengths, inv_freq)  # [B, hd/2]
+        cos, sin = rotary_cos_sin(lengths, inv_freq)  # [B, hd/2]
 
     def body(x, layer):
         lp, kc, vc = layer

@@ -149,3 +149,7 @@ def unstack_layers(stacked: Dict, n_layers: int) -> list:
 from areal_tpu.models.hf import llama as _llama  # noqa: E402,F401
 from areal_tpu.models.hf import qwen2 as _qwen2  # noqa: E402,F401
 from areal_tpu.models.hf import qwen3 as _qwen3  # noqa: E402,F401
+from areal_tpu.models.hf import mistral as _mistral  # noqa: E402,F401
+from areal_tpu.models.hf import mixtral as _mixtral  # noqa: E402,F401
+from areal_tpu.models.hf import gemma as _gemma  # noqa: E402,F401
+from areal_tpu.models.hf import gpt2 as _gpt2  # noqa: E402,F401

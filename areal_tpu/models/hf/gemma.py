@@ -1,0 +1,106 @@
+"""Gemma HF conversion. Reference parity: realhf/api/from_hf/gemma.py.
+
+Gemma quirks handled here:
+- RMSNorm computes x * (1 + w): the +1 offset is folded into the weights
+  at import (and removed at export) so the shared rms_norm op applies.
+- Embeddings are scaled by sqrt(hidden_dim) (embedding_multiplier).
+- gelu activation, tied embeddings, explicit head_dim.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import numpy as np
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf import HFFamily
+from areal_tpu.models.hf.llama import (
+    params_from_hf_llama_style,
+    params_to_hf_llama_style,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    return TransformerConfig(
+        n_layers=hf["num_hidden_layers"],
+        hidden_dim=hf["hidden_size"],
+        n_q_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf["head_dim"],
+        intermediate_dim=hf["intermediate_size"],
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("max_position_embeddings", 8192),
+        activation="gelu",
+        mlp_type="gated",
+        norm_type="rms",
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rotary_base=hf.get("rope_theta", 10000.0),
+        tied_embeddings=True,
+        embedding_multiplier=math.sqrt(hf["hidden_size"]),
+        is_critic=is_critic,
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    return {
+        "architectures": ["GemmaForCausalLM"],
+        "model_type": "gemma",
+        "num_hidden_layers": cfg.n_layers,
+        "hidden_size": cfg.hidden_dim,
+        "num_attention_heads": cfg.n_q_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "hidden_act": "gelu_pytorch_tanh",
+        "rms_norm_eps": cfg.norm_eps,
+        "rope_theta": cfg.rotary_base,
+        "tie_word_embeddings": True,
+        "torch_dtype": "bfloat16",
+    }
+
+
+def _shift_norms(params: Dict, offset: float) -> Dict:
+    layers = params["layers"]
+    for key in ("ln1", "ln2"):
+        layers[key]["weight"] = layers[key]["weight"] + offset
+    params["final_norm"]["weight"] = params["final_norm"]["weight"] + offset
+    return params
+
+
+def _params_from_hf(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    return _shift_norms(params_from_hf_llama_style(sd, cfg), +1.0)
+
+
+def _params_to_hf(params: Dict, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    import jax
+
+    shifted = jax.tree_util.tree_map(np.asarray, params)
+    shifted = {
+        "embedding": dict(shifted["embedding"]),
+        "layers": {
+            k: dict(v) if isinstance(v, dict) else v
+            for k, v in shifted["layers"].items()
+        },
+        "final_norm": dict(shifted["final_norm"]),
+        **({"head": dict(shifted["head"])} if "head" in shifted else {}),
+    }
+    _shift_norms(shifted, -1.0)
+    return params_to_hf_llama_style(shifted, cfg)
+
+
+register_hf_family(
+    "gemma",
+    HFFamily(
+        name="gemma",
+        hf_model_type="gemma",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    ),
+)

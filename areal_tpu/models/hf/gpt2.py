@@ -1,0 +1,157 @@
+"""GPT-2 HF conversion. Reference parity: realhf/api/from_hf/gpt2.py.
+
+GPT-2 specifics: learned absolute position embeddings (pos_emb="learned"),
+LayerNorm with bias, plain (non-gated) gelu MLP, fused c_attn QKV split
+into wq/wk/wv, biases everywhere, tied embeddings. HF's Conv1D stores
+weights already in [in, out] layout — no transpose (unlike llama).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf import HFFamily
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    D = hf["n_embd"]
+    H = hf["n_head"]
+    return TransformerConfig(
+        n_layers=hf["n_layer"],
+        hidden_dim=D,
+        n_q_heads=H,
+        n_kv_heads=H,
+        head_dim=D // H,
+        intermediate_dim=hf.get("n_inner") or 4 * D,
+        vocab_size=hf["vocab_size"],
+        max_position_embeddings=hf.get("n_positions", 1024),
+        activation="gelu",
+        mlp_type="plain",
+        norm_type="layer",
+        norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+        pos_emb="learned",
+        attn_bias=True,
+        attn_out_bias=True,
+        mlp_bias=True,
+        tied_embeddings=True,
+        is_critic=is_critic,
+    )
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    return {
+        "architectures": ["GPT2LMHeadModel"],
+        "model_type": "gpt2",
+        "n_layer": cfg.n_layers,
+        "n_embd": cfg.hidden_dim,
+        "n_head": cfg.n_q_heads,
+        "n_inner": cfg.intermediate_dim,
+        "vocab_size": cfg.vocab_size,
+        "n_positions": cfg.max_position_embeddings,
+        "activation_function": "gelu_new",
+        "layer_norm_epsilon": cfg.norm_eps,
+        "tie_word_embeddings": True,
+        "torch_dtype": "float32",
+    }
+
+
+def _params_from_hf(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    L, D = cfg.n_layers, cfg.hidden_dim
+
+    def w(name):
+        key = name if name in sd else f"transformer.{name}"
+        return sd[key].astype(np.float32)
+
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in range(L):
+        c_attn = w(f"h.{i}.attn.c_attn.weight")  # [D, 3D], already [in, out]
+        c_bias = w(f"h.{i}.attn.c_attn.bias")  # [3D]
+        qs.append(c_attn[:, :D])
+        ks.append(c_attn[:, D : 2 * D])
+        vs.append(c_attn[:, 2 * D :])
+        bqs.append(c_bias[:D])
+        bks.append(c_bias[D : 2 * D])
+        bvs.append(c_bias[2 * D :])
+
+    params: Dict = {
+        "embedding": {"weight": w("wte.weight")},
+        "pos_embedding": {"weight": w("wpe.weight")},
+        "layers": {
+            "ln1": {
+                "weight": np.stack([w(f"h.{i}.ln_1.weight") for i in range(L)]),
+                "bias": np.stack([w(f"h.{i}.ln_1.bias") for i in range(L)]),
+            },
+            "ln2": {
+                "weight": np.stack([w(f"h.{i}.ln_2.weight") for i in range(L)]),
+                "bias": np.stack([w(f"h.{i}.ln_2.bias") for i in range(L)]),
+            },
+            "attn": {
+                "wq": np.stack(qs),
+                "wk": np.stack(ks),
+                "wv": np.stack(vs),
+                "bq": np.stack(bqs),
+                "bk": np.stack(bks),
+                "bv": np.stack(bvs),
+                "wo": np.stack([w(f"h.{i}.attn.c_proj.weight") for i in range(L)]),
+                "bo": np.stack([w(f"h.{i}.attn.c_proj.bias") for i in range(L)]),
+            },
+            "mlp": {
+                "w_in": np.stack([w(f"h.{i}.mlp.c_fc.weight") for i in range(L)]),
+                "b_in": np.stack([w(f"h.{i}.mlp.c_fc.bias") for i in range(L)]),
+                "w_out": np.stack([w(f"h.{i}.mlp.c_proj.weight") for i in range(L)]),
+                "b_out": np.stack([w(f"h.{i}.mlp.c_proj.bias") for i in range(L)]),
+            },
+        },
+        "final_norm": {"weight": w("ln_f.weight"), "bias": w("ln_f.bias")},
+    }
+    if cfg.is_critic:
+        params["head"] = {"weight": np.zeros((D, 1), np.float32)}
+    return params
+
+
+def _params_to_hf(params: Dict, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    L = cfg.n_layers
+    layers = params["layers"]
+    a, m = layers["attn"], layers["mlp"]
+    sd: Dict[str, np.ndarray] = {
+        "wte.weight": np.asarray(params["embedding"]["weight"]),
+        "wpe.weight": np.asarray(params["pos_embedding"]["weight"]),
+        "ln_f.weight": np.asarray(params["final_norm"]["weight"]),
+        "ln_f.bias": np.asarray(params["final_norm"]["bias"]),
+    }
+    for i in range(L):
+        sd[f"h.{i}.ln_1.weight"] = np.asarray(layers["ln1"]["weight"][i])
+        sd[f"h.{i}.ln_1.bias"] = np.asarray(layers["ln1"]["bias"][i])
+        sd[f"h.{i}.ln_2.weight"] = np.asarray(layers["ln2"]["weight"][i])
+        sd[f"h.{i}.ln_2.bias"] = np.asarray(layers["ln2"]["bias"][i])
+        sd[f"h.{i}.attn.c_attn.weight"] = np.concatenate(
+            [np.asarray(a["wq"][i]), np.asarray(a["wk"][i]), np.asarray(a["wv"][i])],
+            axis=1,
+        )
+        sd[f"h.{i}.attn.c_attn.bias"] = np.concatenate(
+            [np.asarray(a["bq"][i]), np.asarray(a["bk"][i]), np.asarray(a["bv"][i])]
+        )
+        sd[f"h.{i}.attn.c_proj.weight"] = np.asarray(a["wo"][i])
+        sd[f"h.{i}.attn.c_proj.bias"] = np.asarray(a["bo"][i])
+        sd[f"h.{i}.mlp.c_fc.weight"] = np.asarray(m["w_in"][i])
+        sd[f"h.{i}.mlp.c_fc.bias"] = np.asarray(m["b_in"][i])
+        sd[f"h.{i}.mlp.c_proj.weight"] = np.asarray(m["w_out"][i])
+        sd[f"h.{i}.mlp.c_proj.bias"] = np.asarray(m["b_out"][i])
+    return sd
+
+
+register_hf_family(
+    "gpt2",
+    HFFamily(
+        name="gpt2",
+        hf_model_type="gpt2",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    ),
+)

@@ -1,0 +1,47 @@
+"""Mistral HF conversion: llama layout, silu, GQA.
+Reference parity: realhf/api/from_hf/mistral.py.
+
+Sliding-window attention is intentionally NOT replicated: the TPU build
+always attends over the full (packed) context — a superset of the
+sliding window, matching how the reference treats mistral weights in its
+own flash-attn path for training.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.hf import HFFamily
+from areal_tpu.models.hf.llama import (
+    _config_from_hf as llama_config_from_hf,
+    _config_to_hf as llama_config_to_hf,
+    params_from_hf_llama_style,
+    params_to_hf_llama_style,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    return llama_config_from_hf(hf, is_critic)
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    hf = llama_config_to_hf(cfg)
+    hf["architectures"] = ["MistralForCausalLM"]
+    hf["model_type"] = "mistral"
+    hf.pop("attention_bias", None)
+    return hf
+
+
+register_hf_family(
+    "mistral",
+    HFFamily(
+        name="mistral",
+        hf_model_type="mistral",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=lambda sd, cfg: params_from_hf_llama_style(sd, cfg),
+        params_to_hf=lambda p, cfg: params_to_hf_llama_style(p, cfg),
+    ),
+)

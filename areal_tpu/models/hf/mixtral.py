@@ -1,0 +1,138 @@
+"""Mixtral HF conversion: mistral attention + MoE FFN.
+Reference parity: realhf/api/from_hf/mixtral.py.
+
+HF layout: per-layer `block_sparse_moe.gate.weight` [E, D] router and
+`block_sparse_moe.experts.{e}.w1/w3/w2` (gate/up/down, each [F, D] or
+[D, F]); stacked here into router [L, D, E] and expert weights
+[L, E, D, F] / [L, E, F, D] matching `areal_tpu.models.moe`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from areal_tpu.api.model_api import register_hf_family
+from areal_tpu.models.config import MoEConfig, TransformerConfig
+from areal_tpu.models.hf import HFFamily
+from areal_tpu.models.hf.llama import (
+    _config_from_hf as llama_config_from_hf,
+    _config_to_hf as llama_config_to_hf,
+)
+
+
+def _config_from_hf(hf: Dict[str, Any], is_critic: bool = False) -> TransformerConfig:
+    cfg = llama_config_from_hf(hf, is_critic)
+    E = hf.get("num_local_experts", 8)
+    k = hf.get("num_experts_per_tok", 2)
+    cfg.moe = MoEConfig(
+        num_experts=E,
+        top_k=k,
+        # HF Mixtral routes exactly (no capacity drops); E/k guarantees the
+        # einsum dispatch never drops either, so logits match. Users can
+        # lower this for speed once drops are acceptable.
+        capacity_factor=float(E) / k,
+        aux_loss_coef=hf.get("router_aux_loss_coef", 1e-2),
+    )
+    return cfg
+
+
+def _config_to_hf(cfg: TransformerConfig) -> Dict[str, Any]:
+    hf = llama_config_to_hf(cfg)
+    hf["architectures"] = ["MixtralForCausalLM"]
+    hf["model_type"] = "mixtral"
+    hf["num_local_experts"] = cfg.moe.num_experts
+    hf["num_experts_per_tok"] = cfg.moe.top_k
+    hf["router_aux_loss_coef"] = cfg.moe.aux_loss_coef
+    hf.pop("attention_bias", None)
+    return hf
+
+
+def _params_from_hf(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    from areal_tpu.models.hf.llama import params_from_hf_llama_style
+
+    E, L = cfg.moe.num_experts, cfg.n_layers
+
+    # Reuse the llama importer for everything but the FFN by aliasing the
+    # expert-0 weights into the dense keys it expects, then overwrite.
+    alias = dict(sd)
+    for i in range(L):
+        base = f"model.layers.{i}.block_sparse_moe"
+        alias[f"model.layers.{i}.mlp.gate_proj.weight"] = sd[f"{base}.experts.0.w1.weight"]
+        alias[f"model.layers.{i}.mlp.up_proj.weight"] = sd[f"{base}.experts.0.w3.weight"]
+        alias[f"model.layers.{i}.mlp.down_proj.weight"] = sd[f"{base}.experts.0.w2.weight"]
+    params = params_from_hf_llama_style(alias, cfg)
+
+    def t(name):
+        return np.ascontiguousarray(sd[name].astype(np.float32).T)
+
+    params["layers"]["mlp"] = {
+        "router": np.stack(
+            [t(f"model.layers.{i}.block_sparse_moe.gate.weight") for i in range(L)]
+        ),  # [L, D, E]
+        "w_gate": np.stack(
+            [
+                np.stack(
+                    [t(f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight") for e in range(E)]
+                )
+                for i in range(L)
+            ]
+        ),  # [L, E, D, F]
+        "w_up": np.stack(
+            [
+                np.stack(
+                    [t(f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight") for e in range(E)]
+                )
+                for i in range(L)
+            ]
+        ),
+        "w_down": np.stack(
+            [
+                np.stack(
+                    [t(f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight") for e in range(E)]
+                )
+                for i in range(L)
+            ]
+        ),  # [L, E, F, D]
+    }
+    return params
+
+
+def _params_to_hf(params: Dict, cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    from areal_tpu.models.hf.llama import params_to_hf_llama_style
+
+    E, L = cfg.moe.num_experts, cfg.n_layers
+    m = params["layers"]["mlp"]
+    # Give the llama exporter dense-shaped placeholders, then replace.
+    dense_view = dict(params)
+    dense_view["layers"] = dict(params["layers"])
+    dense_view["layers"]["mlp"] = {
+        "w_gate": np.asarray(m["w_gate"])[:, 0],
+        "w_up": np.asarray(m["w_up"])[:, 0],
+        "w_down": np.asarray(m["w_down"])[:, 0],
+    }
+    sd = params_to_hf_llama_style(dense_view, cfg)
+    for i in range(L):
+        base = f"model.layers.{i}.block_sparse_moe"
+        for k in ("mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight"):
+            sd.pop(f"model.layers.{i}.{k}", None)
+        sd[f"{base}.gate.weight"] = np.asarray(m["router"][i]).T
+        for e in range(E):
+            sd[f"{base}.experts.{e}.w1.weight"] = np.asarray(m["w_gate"][i, e]).T
+            sd[f"{base}.experts.{e}.w3.weight"] = np.asarray(m["w_up"][i, e]).T
+            sd[f"{base}.experts.{e}.w2.weight"] = np.asarray(m["w_down"][i, e]).T
+    return sd
+
+
+register_hf_family(
+    "mixtral",
+    HFFamily(
+        name="mixtral",
+        hf_model_type="mixtral",
+        config_from_hf=_config_from_hf,
+        config_to_hf=_config_to_hf,
+        params_from_hf=_params_from_hf,
+        params_to_hf=_params_to_hf,
+    ),
+)

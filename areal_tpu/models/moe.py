@@ -1,0 +1,106 @@
+"""Mixture-of-experts layer: top-k router + capacity-based dispatch.
+
+Counterpart of the reference's MoE modules (realhf/impl/model/modules/moe/
+router.py:242, token_dispatcher.py, experts.py) rebuilt TPU-first: instead
+of the reference's permute/unpermute token dispatcher + grouped GEMM, the
+classic GShard/Switch einsum formulation — dispatch/combine tensors of
+shape [T, E, C] contracted against stacked expert weights [E, D, F] — so
+the whole layer is three large einsums that XLA tiles onto the MXU, and
+an `expert` mesh axis can shard E without any custom collectives.
+
+Load-balance aux loss and router z-loss follow the Switch/ST-MoE
+formulas (reference router.py aux_loss/z_loss). Tokens beyond an
+expert's capacity are dropped (contribute zero), standard for the
+einsum formulation; capacity_factor controls the drop rate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.models.config import TransformerConfig
+
+
+def moe_mlp(
+    x: jnp.ndarray,  # [..., D]
+    mp: Dict[str, Any],  # router [D, E], w_gate/w_up [E, D, F], w_down [E, F, D]
+    cfg: TransformerConfig,
+    cdt,
+    capacity_factor: float = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Returns (y with x's shape, {"load_balance_loss", "z_loss"})."""
+    moe = cfg.moe
+    if capacity_factor is None:
+        capacity_factor = moe.capacity_factor
+    E, k = moe.num_experts, moe.top_k
+    lead_shape = x.shape[:-1]
+    D = x.shape[-1]
+    xt = x.reshape(-1, D)
+    T = xt.shape[0]
+
+    # Router in fp32 for stable softmax (reference router.py casts too).
+    logits = (xt.astype(jnp.float32) @ mp["router"].astype(jnp.float32))  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    # top-k expert choice per token.
+    top_p, top_e = jax.lax.top_k(probs, k)  # [T, k]
+    if moe.routed_scaling_factor != 1.0:
+        top_p = top_p * moe.routed_scaling_factor
+    # renormalize the selected gates (mixtral convention)
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+
+    C = max(1, int(capacity_factor * T * k / E))
+    # Position of each (token, choice) within its expert's capacity buffer:
+    # one-hot over experts -> exclusive cumsum over the flattened (k, T)
+    # priority order (choice 0 of every token first).
+    choice_e = top_e.T.reshape(-1)  # [k*T] expert ids, choice-major
+    onehot = jax.nn.one_hot(choice_e, E, dtype=jnp.int32)  # [kT, E]
+    pos_in_e = jnp.cumsum(onehot, axis=0) - onehot  # exclusive
+    pos = jnp.sum(pos_in_e * onehot, axis=-1)  # [kT]
+    keep = pos < C
+
+    gate = top_p.T.reshape(-1)  # [kT], aligned with choice_e
+    tok_idx = jnp.tile(jnp.arange(T), k)
+
+    # dispatch [T, E, C] / combine [T, E, C]
+    disp = jnp.zeros((T, E, C), bool)
+    disp = disp.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].max(keep)
+    comb = jnp.zeros((T, E, C), jnp.float32)
+    comb = comb.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].add(
+        jnp.where(keep, gate, 0.0)
+    )
+
+    xe = jnp.einsum("tec,td->ecd", disp.astype(cdt), xt.astype(cdt))  # [E, C, D]
+    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    h = act(jnp.einsum("ecd,edf->ecf", xe, mp["w_gate"].astype(cdt)))
+    h = h * jnp.einsum("ecd,edf->ecf", xe, mp["w_up"].astype(cdt))
+    ye = jnp.einsum("ecf,efd->ecd", h, mp["w_down"].astype(cdt))  # [E, C, D]
+    y = jnp.einsum("tec,ecd->td", comb.astype(cdt), ye)  # [T, D]
+
+    # Switch load-balance loss: E * sum_e f_e * P_e, where f_e is the
+    # fraction of (token, choice) routings to e and P_e the mean prob.
+    f_e = jnp.mean(jax.nn.one_hot(top_e, E, dtype=jnp.float32), axis=(0, 1))
+    P_e = jnp.mean(probs, axis=0)
+    load_balance = E * jnp.sum(f_e * P_e)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+
+    return y.reshape(*lead_shape, D), {
+        "load_balance_loss": load_balance,
+        "z_loss": z,
+    }
+
+
+def init_moe_params(cfg: TransformerConfig, dense_fn, keys) -> Dict[str, Any]:
+    """Stacked per-layer MoE params (L leading dim, matching the scan)."""
+    moe = cfg.moe
+    L, D, E = cfg.n_layers, cfg.hidden_dim, moe.num_experts
+    F = moe.expert_intermediate_dim or cfg.intermediate_dim
+    return {
+        "router": dense_fn(keys[0], (L, D, E)),
+        "w_gate": dense_fn(keys[1], (L, E, D, F)),
+        "w_up": dense_fn(keys[2], (L, E, D, F)),
+        "w_down": dense_fn(keys[3], (L, E, F, D)),
+    }

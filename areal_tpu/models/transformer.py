@@ -63,11 +63,22 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
         attn["bq"] = jnp.zeros((L, cfg.q_dim), pdt)
         attn["bk"] = jnp.zeros((L, cfg.kv_dim), pdt)
         attn["bv"] = jnp.zeros((L, cfg.kv_dim), pdt)
+    if cfg.attn_out_bias:
+        attn["bo"] = jnp.zeros((L, D), pdt)
     if cfg.qk_norm:
         attn["q_norm"] = jnp.ones((L, cfg.head_dim), pdt)
         attn["k_norm"] = jnp.ones((L, cfg.head_dim), pdt)
 
-    if cfg.mlp_type == "gated":
+    if cfg.moe is not None:
+        from areal_tpu.models.moe import init_moe_params
+
+        if cfg.moe.first_k_dense:
+            raise NotImplementedError(
+                "first_k_dense breaks the homogeneous layer scan; "
+                "interleaved dense layers are not supported yet"
+            )
+        mlp = init_moe_params(cfg, dense, jax.random.split(keys[4], 4))
+    elif cfg.mlp_type == "gated":
         mlp = {
             "w_gate": dense(keys[4], (L, D, F)),
             "w_up": dense(keys[5], (L, D, F)),
@@ -78,7 +89,7 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
             "w_in": dense(keys[4], (L, D, F)),
             "w_out": dense(keys[6], (L, F, D)),
         }
-    if cfg.mlp_bias:
+    if cfg.mlp_bias and cfg.moe is None:
         if cfg.mlp_type == "gated":
             mlp["b_gate"] = jnp.zeros((L, F), pdt)
             mlp["b_up"] = jnp.zeros((L, F), pdt)
@@ -102,6 +113,10 @@ def init_params(cfg: TransformerConfig, rng: jax.Array) -> Params:
         "layers": layers,
         "final_norm": {"weight": jnp.ones((D,), pdt)},
     }
+    if cfg.pos_emb == "learned":
+        params["pos_embedding"] = {
+            "weight": dense(keys[9], (cfg.max_position_embeddings, D), scale=0.02)
+        }
     if cfg.norm_type == "layer":
         params["final_norm"]["bias"] = jnp.zeros((D,), pdt)
     if cfg.is_critic:
@@ -165,14 +180,17 @@ def _attention_block(
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.norm_eps)
-    q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
-    k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
+    if cos is not None:  # rotary position encoding (None = learned pos emb)
+        q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
+        k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
 
     attn_fn = lambda q1, k1, v1, s1, p1: packed_attention(
         q1, k1, v1, s1, p1, impl=attn_impl
     )
     out = jax.vmap(attn_fn)(q, k, v, segment_ids, positions)  # [R, T, Hq, hd]
     out = out.reshape(R, T, cfg.q_dim) @ lp["wo"].astype(cdt)
+    if "bo" in lp:
+        out = out + lp["bo"].astype(cdt)
     return out, (k, v)
 
 
@@ -185,6 +203,7 @@ def forward(
     attn_impl: str = "auto",
     output: str = "logits",  # logits | hidden
     return_kv: bool = False,
+    return_aux: bool = False,  # also return MoE aux losses (zeros if dense)
     remat: bool = False,
 ) -> Any:
     """Packed-rows forward pass.
@@ -199,27 +218,44 @@ def forward(
     if cfg.embedding_multiplier:
         x = x * jnp.asarray(cfg.embedding_multiplier, cdt)
 
-    inv_freq = jnp.asarray(
-        rotary_inv_freq(
-            cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling,
-            cfg.rotary_scaling_type, cfg.rotary_scaling_params,
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_embedding"]["weight"][positions].astype(cdt)
+        cos = sin = None
+    else:
+        inv_freq = jnp.asarray(
+            rotary_inv_freq(
+                cfg.head_dim, cfg.rotary_base, cfg.rotary_scaling,
+                cfg.rotary_scaling_type, cfg.rotary_scaling_params,
+            )
         )
-    )
-    cos, sin = rotary_cos_sin(positions, inv_freq)  # [R, T, hd/2]
+        cos, sin = rotary_cos_sin(positions, inv_freq)  # [R, T, hd/2]
+
+    use_moe = cfg.moe is not None
 
     def layer_body(carry, lp):
-        x = carry
+        x, aux_acc = carry
         a, kv = _attention_block(
             _norm(x, lp["ln1"], cfg), lp["attn"], cfg, cos, sin,
             segment_ids, positions, attn_impl, cdt,
         )
         x = x + a
-        m = _mlp(_norm(x, lp["ln2"], cfg), lp["mlp"], cfg, cdt)
-        x = x + m
-        return x, kv if return_kv else None
+        h = _norm(x, lp["ln2"], cfg)
+        if use_moe:
+            from areal_tpu.models.moe import moe_mlp
 
+            m, aux = moe_mlp(h, lp["mlp"], cfg, cdt)
+            aux_acc = {k: aux_acc[k] + aux[k] for k in aux_acc}
+        else:
+            m = _mlp(h, lp["mlp"], cfg, cdt)
+        x = x + m
+        return (x, aux_acc), kv if return_kv else None
+
+    aux0 = {
+        "load_balance_loss": jnp.zeros((), jnp.float32),
+        "z_loss": jnp.zeros((), jnp.float32),
+    }
     body = jax.checkpoint(layer_body) if remat else layer_body
-    x, kvs = jax.lax.scan(body, x, params["layers"])
+    (x, moe_aux), kvs = jax.lax.scan(body, (x, aux0), params["layers"])
     x = _norm(x, params["final_norm"], cfg)
 
     if output == "hidden":
@@ -235,6 +271,10 @@ def forward(
                 else params["head"]["weight"]
             )
             out = (x @ head_w.astype(cdt)).astype(jnp.float32)  # [R, T, V]
+    if return_kv and return_aux:
+        return out, kvs, moe_aux
     if return_kv:
         return out, kvs
+    if return_aux:
+        return out, moe_aux
     return out

@@ -1,0 +1,167 @@
+"""Job scheduler clients.
+
+Counterpart of the reference's scheduler layer (realhf/scheduler/
+client.py:52-154 + slurm/): `SchedulerClient` submits job arrays, waits
+on states, and stops everything. The local client manages OS
+subprocesses; TPU-pod deployments submit the same specs through an
+external scheduler (GKE/XPK/Ray), for which `make_scheduler` exposes the
+registry hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import signal
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("scheduler")
+
+
+class JobState(str, enum.Enum):
+    NOT_FOUND = "NOT_FOUND"
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    COMPLETED = "COMPLETED"
+    FAILED = "FAILED"
+    CANCELLED = "CANCELLED"
+
+
+@dataclasses.dataclass
+class JobInfo:
+    name: str
+    state: JobState
+    host: str = "localhost"
+    exit_code: Optional[int] = None
+
+
+class JobException(Exception):
+    def __init__(self, job: JobInfo, msg: str = ""):
+        self.job = job
+        super().__init__(f"job {job.name} -> {job.state} {msg}")
+
+
+class SchedulerClient:
+    def submit(self, name: str, cmd: List[str], env: Optional[Dict[str, str]] = None,
+               cwd: Optional[str] = None, **kwargs) -> str:
+        raise NotImplementedError()
+
+    def submit_array(self, name: str, cmd_list: List[List[str]], **kwargs) -> List[str]:
+        return [self.submit(f"{name}/{i}", c, **kwargs) for i, c in enumerate(cmd_list)]
+
+    def find(self, name: str) -> JobInfo:
+        raise NotImplementedError()
+
+    def wait(self, names: Optional[List[str]] = None, timeout: Optional[float] = None,
+             raise_on_failure: bool = True) -> List[JobInfo]:
+        raise NotImplementedError()
+
+    def stop_all(self):
+        raise NotImplementedError()
+
+
+class LocalSchedulerClient(SchedulerClient):
+    """Subprocess-backed scheduler (reference local scheduler)."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._log_files: Dict[str, object] = {}
+        self.log_dir = log_dir
+
+    def submit(self, name: str, cmd: List[str], env: Optional[Dict[str, str]] = None,
+               cwd: Optional[str] = None, **kwargs) -> str:
+        if name in self._procs and self._procs[name].poll() is None:
+            raise ValueError(f"job {name!r} already running")
+        stdout = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(self.log_dir, name.replace("/", "_") + ".log"), "w"
+            )
+            self._log_files[name] = stdout
+        full_env = dict(os.environ)
+        if env:
+            full_env.update(env)
+        proc = subprocess.Popen(
+            cmd, env=full_env, cwd=cwd, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None,
+            start_new_session=True,
+        )
+        self._procs[name] = proc
+        logger.info(f"submitted job {name}: pid={proc.pid}")
+        return name
+
+    def find(self, name: str) -> JobInfo:
+        proc = self._procs.get(name)
+        if proc is None:
+            return JobInfo(name, JobState.NOT_FOUND)
+        rc = proc.poll()
+        if rc is None:
+            return JobInfo(name, JobState.RUNNING)
+        state = JobState.COMPLETED if rc == 0 else JobState.FAILED
+        return JobInfo(name, state, exit_code=rc)
+
+    def wait(self, names: Optional[List[str]] = None, timeout: Optional[float] = None,
+             raise_on_failure: bool = True) -> List[JobInfo]:
+        names = list(names) if names is not None else list(self._procs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            infos = [self.find(n) for n in names]
+            if raise_on_failure:
+                for i in infos:
+                    if i.state in (JobState.FAILED, JobState.CANCELLED):
+                        raise JobException(i)
+            if all(
+                i.state in (JobState.COMPLETED, JobState.FAILED,
+                            JobState.CANCELLED, JobState.NOT_FOUND)
+                for i in infos
+            ):
+                return infos
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"jobs still running: "
+                                   f"{[i.name for i in infos if i.state == JobState.RUNNING]}")
+            time.sleep(0.2)
+
+    def stop(self, name: str):
+        proc = self._procs.get(name)
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+
+    def stop_all(self):
+        for name in list(self._procs):
+            self.stop(name)
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for f in self._log_files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+
+
+_SCHEDULERS = {"local": LocalSchedulerClient}
+
+
+def register_scheduler(name: str, cls):
+    _SCHEDULERS[name] = cls
+
+
+def make_scheduler(mode: str = "local", **kwargs) -> SchedulerClient:
+    if mode not in _SCHEDULERS:
+        raise ValueError(
+            f"unknown scheduler {mode!r}; available: {sorted(_SCHEDULERS)} "
+            "(TPU pod deployments: register a client for your cluster "
+            "scheduler, e.g. XPK/GKE/Ray)"
+        )
+    return _SCHEDULERS[mode](**kwargs)

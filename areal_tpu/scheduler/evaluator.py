@@ -1,0 +1,147 @@
+"""Automatic evaluator: eval every new checkpoint as it appears.
+
+Counterpart of the reference's AutomaticEvaluator
+(realhf/scheduler/evaluator.py:160-348): watch the save directory for
+new `step{N}` checkpoints, submit one eval job per checkpoint through
+the scheduler client (capped concurrency), parse each results.json, and
+log the accuracy curve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import constants, logging
+from areal_tpu.scheduler.client import JobState, SchedulerClient, make_scheduler
+
+logger = logging.getLogger("evaluator")
+
+
+@dataclasses.dataclass
+class EvaluationStep:
+    global_step: int
+    ckpt_dir: str
+    job_name: Optional[str] = None
+    output_path: str = ""
+    done: bool = False
+    result: Optional[dict] = None
+
+
+class AutomaticEvaluator:
+    def __init__(
+        self,
+        save_root: str,  # .../save/<role>/ containing step{N}/dp0 dirs
+        data_path: str,
+        output_root: str,
+        scheduler: Optional[SchedulerClient] = None,
+        max_concurrent_jobs: int = 1,
+        eval_args: Optional[Dict] = None,
+    ):
+        self.save_root = save_root
+        self.data_path = data_path
+        self.output_root = output_root
+        self.scheduler = scheduler or make_scheduler("local")
+        self.max_concurrent_jobs = max_concurrent_jobs
+        self.eval_args = eval_args or {}
+        self.steps: Dict[int, EvaluationStep] = {}
+
+    def discover_new_ckpts(self) -> List[EvaluationStep]:
+        if not os.path.isdir(self.save_root):
+            return []
+        new = []
+        for name in sorted(os.listdir(self.save_root)):
+            m = re.fullmatch(r"step(\d+)", name)
+            if not m:
+                continue
+            step = int(m.group(1))
+            if step in self.steps:
+                continue
+            d = os.path.join(self.save_root, name)
+            # saved per DP rank; rank 0 is the canonical copy
+            dp0 = os.path.join(d, "dp0")
+            ckpt = dp0 if os.path.isdir(dp0) else d
+            if not os.path.exists(os.path.join(ckpt, "config.json")):
+                continue  # still being written
+            es = EvaluationStep(
+                global_step=step,
+                ckpt_dir=ckpt,
+                output_path=os.path.join(self.output_root, f"step{step}.json"),
+            )
+            self.steps[step] = es
+            new.append(es)
+        return new
+
+    def _n_running(self) -> int:
+        return sum(
+            1
+            for es in self.steps.values()
+            if es.job_name and not es.done
+            and self.scheduler.find(es.job_name).state == JobState.RUNNING
+        )
+
+    def _maybe_submit(self):
+        for step in sorted(self.steps):
+            es = self.steps[step]
+            if es.job_name is not None or es.done:
+                continue
+            if self._n_running() >= self.max_concurrent_jobs:
+                return
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            )
+            cmd = [
+                sys.executable,
+                os.path.join(repo_root, "evaluation", "math_eval.py"),
+                f"ckpt={es.ckpt_dir}",
+                f"data={self.data_path}",
+                f"output={es.output_path}",
+            ] + [f"{k}={v}" for k, v in self.eval_args.items()]
+            es.job_name = self.scheduler.submit(f"eval_step{step}", cmd)
+
+    def _collect(self):
+        for es in self.steps.values():
+            if es.done or es.job_name is None:
+                continue
+            info = self.scheduler.find(es.job_name)
+            if info.state == JobState.COMPLETED and os.path.exists(es.output_path):
+                with open(es.output_path) as f:
+                    es.result = json.load(f)
+                es.done = True
+                logger.info(
+                    f"eval step {es.global_step}: "
+                    f"accuracy={es.result['accuracy']:.4f}"
+                )
+            elif info.state in (JobState.FAILED, JobState.CANCELLED):
+                es.done = True
+                logger.warning(f"eval job for step {es.global_step} failed")
+
+    def step(self):
+        """One poll: discover, submit, collect."""
+        self.discover_new_ckpts()
+        self._maybe_submit()
+        self._collect()
+
+    def run_until_idle(self, timeout: float = 3600):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.step()
+            pending = [
+                es for es in self.steps.values() if not es.done
+            ]
+            if not pending:
+                return
+            time.sleep(1.0)
+        raise TimeoutError("evaluator still has pending jobs")
+
+    def results(self) -> Dict[int, float]:
+        return {
+            s: es.result["accuracy"]
+            for s, es in self.steps.items()
+            if es.done and es.result
+        }

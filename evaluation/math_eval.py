@@ -1,0 +1,104 @@
+"""Offline math evaluation harness.
+
+Counterpart of the reference's evaluation/math_eval.py: load a saved
+checkpoint, greedy/sampled generation over a benchmark jsonl
+(prompt + solutions rows), grade with the math verifier, write
+results.json with pass@1-style accuracy. Invoked standalone or by the
+AutomaticEvaluator per saved checkpoint.
+
+Usage:
+    python evaluation/math_eval.py ckpt=/save/actor/step10/dp0 \
+        data=/data/aime.jsonl output=/tmp/results.json max_new_tokens=512
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def evaluate_checkpoint(
+    ckpt: str,
+    data: str,
+    output: str = "",
+    max_new_tokens: int = 512,
+    greedy: bool = True,
+    temperature: float = 1.0,
+    n_samples: int = 1,
+    max_prompts: int = 0,
+    seed: int = 1,
+) -> dict:
+    import jax
+
+    from areal_tpu.api import data_api
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.functioncall.math_grader import grade_answer
+    from areal_tpu.models.generation import generate_tokens
+    from areal_tpu.models.hf import load_hf_model
+
+    cfg, params = load_hf_model(ckpt)
+    tokenizer = data_api.load_hf_tokenizer(ckpt)
+
+    with open(data) as f:
+        rows = [json.loads(l) for l in f if l.strip()]
+    if max_prompts:
+        rows = rows[:max_prompts]
+
+    g = GenerationHyperparameters(
+        max_new_tokens=max_new_tokens, greedy=greedy, temperature=temperature
+    )
+    prompts = [tokenizer(r["prompt"])["input_ids"] for r in rows]
+
+    n_correct, per_prompt = 0, []
+    batch = 8
+    for s in range(n_samples):
+        rng = jax.random.PRNGKey(seed + s)
+        for i in range(0, len(prompts), batch):
+            chunk = prompts[i : i + batch]
+            outs = generate_tokens(
+                params, cfg, chunk, g, jax.random.fold_in(rng, i),
+                eos_token_id=tokenizer.eos_token_id,
+            )
+            for j, o in enumerate(outs):
+                row = rows[i + j]
+                text = tokenizer.decode(o["output_ids"])
+                ok = grade_answer(text, row.get("solutions") or row.get("answers"))
+                n_correct += bool(ok)
+                per_prompt.append(
+                    {"query_id": str(row.get("query_id", i + j)), "correct": bool(ok)}
+                )
+
+    total = len(prompts) * n_samples
+    result = {
+        "ckpt": ckpt,
+        "data": data,
+        "n_prompts": len(prompts),
+        "n_samples": n_samples,
+        "accuracy": n_correct / max(1, total),
+        "details": per_prompt,
+    }
+    if output:
+        os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
+        with open(output, "w") as f:
+            json.dump(result, f)
+    print(json.dumps({k: v for k, v in result.items() if k != "details"}))
+    return result
+
+
+if __name__ == "__main__":
+    kwargs = {}
+    for arg in sys.argv[1:]:
+        k, v = arg.split("=", 1)
+        if k in ("max_new_tokens", "n_samples", "max_prompts", "seed"):
+            v = int(v)
+        elif k in ("greedy",):
+            v = v.lower() in ("1", "true")
+        elif k in ("temperature",):
+            v = float(v)
+        kwargs[k] = v
+    evaluate_checkpoint(**kwargs)

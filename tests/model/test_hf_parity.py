@@ -43,10 +43,41 @@ def tiny_hf_model(family: str):
             head_dim=16, max_position_embeddings=256, tie_word_embeddings=False,
         )
         return transformers.Qwen3ForCausalLM(cfg)
+    if family == "mistral":
+        cfg = transformers.MistralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, tie_word_embeddings=False,
+            sliding_window=None,
+        )
+        return transformers.MistralForCausalLM(cfg)
+    if family == "mixtral":
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, tie_word_embeddings=False,
+            num_local_experts=4, num_experts_per_tok=2, sliding_window=None,
+        )
+        return transformers.MixtralForCausalLM(cfg)
+    if family == "gemma":
+        cfg = transformers.GemmaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, max_position_embeddings=256,
+            hidden_act="gelu_pytorch_tanh",
+        )
+        return transformers.GemmaForCausalLM(cfg)
+    if family == "gpt2":
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=256,
+        )
+        return transformers.GPT2LMHeadModel(cfg)
     raise ValueError(family)
 
 
-@pytest.mark.parametrize("family", ["llama", "qwen2", "qwen3"])
+@pytest.mark.parametrize(
+    "family", ["llama", "qwen2", "qwen3", "mistral", "mixtral", "gemma", "gpt2"]
+)
 def test_logits_match_hf(family):
     torch.manual_seed(0)
     hf_model = tiny_hf_model(family).eval()
