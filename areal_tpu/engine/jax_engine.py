@@ -35,7 +35,7 @@ from areal_tpu.models.transformer import forward as model_forward
 from areal_tpu.ops.loss import next_token_logprobs
 from areal_tpu.engine.optimizer import OptimizerConfig, make_optimizer
 from areal_tpu.parallel.mesh import single_device_mesh
-from areal_tpu.parallel.sharding import batch_sharding, param_shardings, shard_params
+from areal_tpu.parallel.sharding import batch_sharding, param_shardings
 
 logger = areal_logging.getLogger("jax_engine")
 
@@ -107,19 +107,31 @@ class JaxTrainEngine(TrainEngine):
         self.max_row_len = max_row_len
         self._is_train = optimizer_config is not None
 
-        self.params = shard_params(params, self.mesh)
+        self._param_shardings = param_shardings(params, self.mesh)
+        self.params = jax.device_put(params, self._param_shardings)
         self._batch_sharding = batch_sharding(self.mesh)
         self._n_row_multiple = int(np.prod(self.mesh.devices.shape[:2]))  # data*fsdp
+        # XLA's in-process CPU collectives mismatch rendezvous when two
+        # collective-bearing executables are in flight (async dispatch lets
+        # e.g. the next step's program overlap the previous one); serialize
+        # dispatch on the CPU platform. Real TPUs order collectives per
+        # device stream, no sync needed.
+        self._serial_dispatch = (
+            self.mesh.size > 1 and self.mesh.devices.flat[0].platform == "cpu"
+        )
 
         self.optimizer = None
         self.opt_state = None
+        self._opt_shardings = None
         if optimizer_config is not None:
             self.optimizer = make_optimizer(optimizer_config, total_train_steps)
             opt_shape = jax.eval_shape(self.optimizer.init, self.params)
-            shardings = opt_state_shardings(opt_shape, self.params, self.mesh)
+            self._opt_shardings = opt_state_shardings(opt_shape, self.params, self.mesh)
             self.opt_state = jax.jit(
-                self.optimizer.init, out_shardings=shardings
+                self.optimizer.init, out_shardings=self._opt_shardings
             )(self.params)
+            if self._serial_dispatch:
+                jax.block_until_ready(self.opt_state)
         # jit caches keyed by (kind, loss name, row shape, extra)
         self._jit_cache: Dict[Any, Any] = {}
         self.version = 0
@@ -187,65 +199,112 @@ class JaxTrainEngine(TrainEngine):
     # Train
     # ------------------------------------------------------------------
 
-    def _grad_step_fn(self, loss_name: str, loss_fn: PackedLossFn, row_keys: Tuple[str, ...]):
-        key = ("grad", loss_name, row_keys)
-        if key not in self._jit_cache:
+    def _mb_loss_fn(self, loss_fn: PackedLossFn):
+        """loss over one micro-batch's rows: (params, rows) -> (loss_sum, aux)."""
 
-            def step(params, rows):
-                def compute(p):
-                    logits = model_forward(
-                        p, self.model_cfg,
-                        rows["input_ids"], rows["segment_ids"], rows["positions"],
-                        attn_impl=self.attn_impl, remat=self.remat,
-                        return_aux=self.model_cfg.moe is not None,
+        def compute(p, rows):
+            logits = model_forward(
+                p, self.model_cfg,
+                rows["input_ids"], rows["segment_ids"], rows["positions"],
+                attn_impl=self.attn_impl, remat=self.remat,
+                return_aux=self.model_cfg.moe is not None,
+                mesh=self.mesh if self.mesh.size > 1 else None,
+            )
+            if self.model_cfg.moe is not None:
+                logits, moe_aux = logits
+            loss_sum, aux = loss_fn(logits, rows)
+            if self.model_cfg.moe is not None:
+                # MoE aux losses scale with token count so they
+                # survive the 1/global_denom normalization applied
+                # at the optimizer step.
+                n_tok = jnp.sum(rows["segment_ids"] > 0).astype(jnp.float32)
+                moe_cfg = self.model_cfg.moe
+                loss_sum = loss_sum + n_tok * (
+                    moe_cfg.aux_loss_coef * moe_aux["load_balance_loss"]
+                    + moe_cfg.z_loss_coef * moe_aux["z_loss"]
+                )
+                aux = dict(aux)
+                aux["moe_load_balance"] = n_tok * moe_aux["load_balance_loss"]
+                aux["moe_z_loss"] = n_tok * moe_aux["z_loss"]
+            return loss_sum, aux
+
+        return compute
+
+    def _train_step_fn(self, loss_name: str, loss_fn: PackedLossFn,
+                       row_keys: Tuple[str, ...], n_mbs: int):
+        """One fused jitted program for the whole train step: micro-batch
+        gradient accumulation (lax.scan over stacked rows), global-denom
+        normalization, grad norm, optimizer update — with params and
+        optimizer state donated.
+
+        One executable per step (vs the reference's per-microbatch
+        fwd/bwd launches + separate optimizer step) keeps XLA free to
+        overlap collectives and avoids any host round-trip inside a step.
+        """
+        key = ("train", loss_name, row_keys, n_mbs > 1)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+
+        mb_loss = self._mb_loss_fn(loss_fn)
+
+        def step(params, opt_state, rows, inv_denom):
+            if n_mbs > 1:
+                # rows: [n_mbs, R, T]; accumulate grads in fp32.
+                def body(grads_acc, mb_rows):
+                    (loss, aux), g = jax.value_and_grad(mb_loss, has_aux=True)(
+                        params, mb_rows
                     )
-                    if self.model_cfg.moe is not None:
-                        logits, moe_aux = logits
-                    loss_sum, aux = loss_fn(logits, rows)
-                    if self.model_cfg.moe is not None:
-                        # MoE aux losses scale with token count so they
-                        # survive the 1/global_denom normalization applied
-                        # at the optimizer step.
-                        n_tok = jnp.sum(rows["segment_ids"] > 0).astype(jnp.float32)
-                        moe_cfg = self.model_cfg.moe
-                        loss_sum = loss_sum + n_tok * (
-                            moe_cfg.aux_loss_coef * moe_aux["load_balance_loss"]
-                            + moe_cfg.z_loss_coef * moe_aux["z_loss"]
-                        )
-                        aux = dict(aux)
-                        aux["moe_load_balance"] = n_tok * moe_aux["load_balance_loss"]
-                        aux["moe_z_loss"] = n_tok * moe_aux["z_loss"]
-                    return loss_sum, aux
+                    grads_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b.astype(jnp.float32), grads_acc, g
+                    )
+                    return grads_acc, (loss, aux)
 
-                (loss_sum, aux), grads = jax.value_and_grad(compute, has_aux=True)(params)
-                return loss_sum, aux, grads
+                grads0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
+                grads, (losses, auxs) = jax.lax.scan(body, grads0, rows)
+                loss_sum = jnp.sum(losses)
+                aux = jax.tree_util.tree_map(jnp.sum, auxs)
+            else:
+                (loss_sum, aux), grads = jax.value_and_grad(mb_loss, has_aux=True)(
+                    params, rows
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
+                )
 
-            self._jit_cache[key] = jax.jit(step)
+            grads = jax.tree_util.tree_map(lambda g: g * inv_denom, grads)
+            gnorm = optax_global_norm(grads)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
+            params = jax.lax.with_sharding_constraint(params, self._param_shardings)
+            opt_state = jax.lax.with_sharding_constraint(
+                opt_state, self._opt_shardings
+            )
+            return params, opt_state, loss_sum, gnorm, aux
+
+        self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 1))
         return self._jit_cache[key]
 
-    def _accum_fn(self):
-        if "accum" not in self._jit_cache:
-            self._jit_cache["accum"] = jax.jit(
-                lambda a, b: jax.tree_util.tree_map(jnp.add, a, b)
-            )
-        return self._jit_cache["accum"]
-
-    def _apply_fn(self):
-        if "apply" not in self._jit_cache:
-
-            def apply(params, opt_state, grads, scale):
-                grads = jax.tree_util.tree_map(
-                    lambda g: (g.astype(jnp.float32) * scale), grads
-                )
-                gnorm = optax_global_norm(grads)
-                updates, opt_state = self.optimizer.update(grads, opt_state, params)
-                params = jax.tree_util.tree_map(
-                    lambda p, u: (p + u.astype(p.dtype)), params, updates
-                )
-                return params, opt_state, gnorm
-
-            self._jit_cache["apply"] = jax.jit(apply, donate_argnums=(0, 1))
-        return self._jit_cache["apply"]
+    def _stack_mb_rows(
+        self, mbs_rows: List[Dict[str, np.ndarray]]
+    ) -> Dict[str, np.ndarray]:
+        """Stack per-microbatch row dicts into [n_mbs, R_max, T_max] (pad
+        rows/tails with zeros = segment id 0 = ignored)."""
+        r_max = max(r["input_ids"].shape[0] for r in mbs_rows)
+        t_max = max(r["input_ids"].shape[1] for r in mbs_rows)
+        stacked: Dict[str, np.ndarray] = {}
+        for k in mbs_rows[0]:
+            arrs = []
+            for r in mbs_rows:
+                a = r[k]
+                pad = [(0, r_max - a.shape[0]), (0, t_max - a.shape[1])]
+                pad += [(0, 0)] * (a.ndim - 2)
+                arrs.append(np.pad(a, pad))
+            stacked[k] = np.stack(arrs, axis=0)
+        return stacked
 
     def train_batch(
         self,
@@ -257,48 +316,69 @@ class JaxTrainEngine(TrainEngine):
         version_steps: int = 0,
         loss_name: str = "loss",
     ) -> Dict[str, float]:
-        """Forward+backward over micro-batches, one optimizer step.
+        """Forward+backward over micro-batches, one optimizer step — all
+        inside a single donated jitted program (no host sync until the
+        stats fetch at the end).
 
         `version_steps` is accepted for TrainEngine API parity but the LR
         schedule position is tracked by the optimizer's own step count.
+        `token_normalize_scope='dp'` (the reference's per-rank
+        normalization: mean over ranks of grad_r/tokens_r) is accepted but
+        executed as 'global' (sum_r grad_r / sum_r tokens_r): under GSPMD
+        there are no per-rank loss programs to normalize separately. The
+        two differ when shards carry unequal token counts, so a warning is
+        logged once.
         """
         assert self.optimizer is not None, "engine built without optimizer"
-        if token_normalize_scope != "global":
-            # Under GSPMD the batch is global by construction; there is no
-            # per-DP-rank loss normalization to implement.
-            raise NotImplementedError(
-                "only token_normalize_scope='global' is meaningful on a "
-                "GSPMD mesh (the reference's 'dp' scope has no TPU analogue)"
+        if token_normalize_scope == "dp":
+            if not getattr(self, "_warned_dp_scope", False):
+                self._warned_dp_scope = True
+                logger.warning(
+                    "token_normalize_scope='dp' is executed as 'global' on a "
+                    "GSPMD mesh (one global program, no per-rank denominators); "
+                    "gradients differ from the reference's 'dp' when shards "
+                    "have unequal token counts"
+                )
+        elif token_normalize_scope != "global":
+            raise ValueError(
+                f"unknown token_normalize_scope {token_normalize_scope!r}"
             )
         mbs, _, _ = input_.split(mb_spec)
         global_denom = float(sum(loss_weight_fn(mb) for mb in mbs))
         global_denom = max(global_denom, 1.0)
 
-        grads_acc = None
-        loss_acc = 0.0
-        aux_acc: Dict[str, float] = {}
-        for mb in mbs:
-            batch, rows = self._build_rows(mb)
-            rows_dev = self._device_rows(rows)
-            step = self._grad_step_fn(loss_name, loss_fn, tuple(sorted(rows.keys())))
-            loss_sum, aux, grads = step(self.params, rows_dev)
-            grads_acc = grads if grads_acc is None else self._accum_fn()(grads_acc, grads)
-            loss_acc += float(loss_sum)
-            for k, v in aux.items():
-                aux_acc[k] = aux_acc.get(k, 0.0) + float(v)
+        all_rows = [self._build_rows(mb)[1] for mb in mbs]
+        if len(mbs) > 1:
+            rows_np = self._stack_mb_rows(all_rows)
+            sharding = jax.sharding.NamedSharding(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, ("data", "fsdp"), "seq"),
+            )
+        else:
+            rows_np = all_rows[0]
+            sharding = self._batch_sharding
+        rows_dev = {
+            k: jax.device_put(np.asarray(v), sharding) for k, v in rows_np.items()
+        }
 
-        self.params, self.opt_state, gnorm = self._apply_fn()(
-            self.params, self.opt_state, grads_acc,
+        step = self._train_step_fn(
+            loss_name, loss_fn, tuple(sorted(rows_np.keys())), len(mbs)
+        )
+        self.params, self.opt_state, loss_sum, gnorm, aux = step(
+            self.params, self.opt_state, rows_dev,
             jnp.asarray(1.0 / global_denom, jnp.float32),
         )
+        if self._serial_dispatch:
+            jax.block_until_ready(self.params)
+
         stats = {
-            f"{loss_name}/loss": loss_acc / global_denom,
+            f"{loss_name}/loss": float(loss_sum) / global_denom,
             f"{loss_name}/grad_norm": float(gnorm),
             f"{loss_name}/n_tokens": global_denom,
             f"{loss_name}/n_mbs": float(len(mbs)),
         }
-        for k, v in aux_acc.items():
-            stats[f"{loss_name}/{k}"] = v / global_denom
+        for k, v in aux.items():
+            stats[f"{loss_name}/{k}"] = float(v) / global_denom
         return stats
 
     # ------------------------------------------------------------------
@@ -314,6 +394,7 @@ class JaxTrainEngine(TrainEngine):
                     params, self.model_cfg,
                     rows["input_ids"], rows["segment_ids"], rows["positions"],
                     attn_impl=self.attn_impl,
+                    mesh=self.mesh if self.mesh.size > 1 else None,
                 )
                 if self.model_cfg.is_critic or output == "values":
                     return logits_or_values  # [R, T]

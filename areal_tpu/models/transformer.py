@@ -205,16 +205,34 @@ def forward(
     return_kv: bool = False,
     return_aux: bool = False,  # also return MoE aux losses (zeros if dense)
     remat: bool = False,
+    mesh=None,  # jax.sharding.Mesh: anchor activation/logits shardings
 ) -> Any:
     """Packed-rows forward pass.
 
     Returns logits [R, T, V] (fp32), critic values [R, T] when
     cfg.is_critic, or hidden states; optionally also per-layer (k, v)
     stacked as [L, R, T, Hkv, hd] for generation prefill.
+
+    When `mesh` is given, activations are pinned to
+    P((data, fsdp), seq, None) and logits to P((data, fsdp), seq, tensor)
+    between layers (the megatron-SP/CP activation layout,
+    areal_tpu/parallel/sharding.py) so GSPMD keeps a consistent layout
+    instead of re-deriving one per op.
     """
+    if mesh is not None:
+        from areal_tpu.parallel.sharding import (
+            activation_constraint,
+            logits_constraint,
+        )
+
+        act_c = lambda h: activation_constraint(h, mesh)
+        log_c = lambda h: logits_constraint(h, mesh)
+    else:
+        act_c = log_c = lambda h: h
+
     cdt = jnp.dtype(cfg.compute_dtype)
     emb = params["embedding"]["weight"]
-    x = emb[input_ids].astype(cdt)
+    x = act_c(emb[input_ids].astype(cdt))
     if cfg.embedding_multiplier:
         x = x * jnp.asarray(cfg.embedding_multiplier, cdt)
 
@@ -247,7 +265,7 @@ def forward(
             aux_acc = {k: aux_acc[k] + aux[k] for k in aux_acc}
         else:
             m = _mlp(h, lp["mlp"], cfg, cdt)
-        x = x + m
+        x = act_c(x + m)
         return (x, aux_acc), kv if return_kv else None
 
     aux0 = {
@@ -270,7 +288,7 @@ def forward(
                 if cfg.tied_embeddings
                 else params["head"]["weight"]
             )
-            out = (x @ head_w.astype(cdt)).astype(jnp.float32)  # [R, T, V]
+            out = log_c((x @ head_w.astype(cdt)).astype(jnp.float32))  # [R, T, V]
     if return_kv and return_aux:
         return out, kvs, moe_aux
     if return_kv:
