@@ -10,7 +10,7 @@ TFLOP/s-per-chip.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -23,7 +23,7 @@ class RolloutStat:
     gen_tokens: int = 0
 
 
-def caculuate_llama_forward_flops(
+def calculate_llama_forward_flops(
     batch_size: int,
     seqlens: Sequence[int],
     hidden_size: int,
@@ -54,7 +54,63 @@ def caculuate_llama_forward_flops(
 
 def calculate_llama_train_flops(*args, **kwargs) -> int:
     """Training = forward + backward ~= 3x forward."""
-    return 3 * caculuate_llama_forward_flops(*args, **kwargs)
+    return 3 * calculate_llama_forward_flops(*args, **kwargs)
+
+
+def transformer_forward_flops(cfg, seqlens: Sequence[int]) -> int:
+    """Forward FLOPs from an areal_tpu TransformerConfig over packed
+    sequences (matmul-only, MoE-aware: only the top-k routed experts'
+    FLOPs count per token).
+
+    Unlike the llama formula above (API parity with the reference's
+    hidden_size/num_heads signature, realhf/base/monitor.py:307), this
+    uses the config's true q/kv/head dims, so GQA and decoupled head_dim
+    models are counted exactly.
+    """
+    total_tokens = int(sum(seqlens))
+    D = cfg.hidden_dim
+    q_dim = cfg.n_q_heads * cfg.head_dim
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    attn_proj = 2 * total_tokens * D * (2 * q_dim + 2 * kv_dim)
+    attn_quad = 4 * sum(int(l) ** 2 for l in seqlens) * q_dim
+    if cfg.moe is not None:
+        e_dim = cfg.moe.expert_intermediate_dim or cfg.intermediate_dim
+        mlp = 2 * total_tokens * D * (cfg.moe.top_k * e_dim) * 3
+        mlp += 2 * total_tokens * D * cfg.moe.num_experts  # router
+    else:
+        n_in = 2 if cfg.mlp_type == "gated" else 1
+        mlp = 2 * total_tokens * D * cfg.intermediate_dim * (n_in + 1)
+    head = 2 * total_tokens * D * cfg.vocab_size
+    return cfg.n_layers * (attn_proj + attn_quad + mlp) + head
+
+
+def mfc_flops(
+    cfg,
+    interface_type: str,
+    input_seqlens: Sequence[int],
+    output_seqlens: Optional[Sequence[int]] = None,
+) -> int:
+    """Analytic FLOPs of one model function call, from the model's
+    TransformerConfig and the packed batch shape (counterpart of the
+    reference's FlopsCounter, realhf/system/flops_counter.py — computed
+    worker-side here because the worker knows the true config+shapes).
+
+    - train_step: 3x forward (fwd + bwd)
+    - inference:  1x forward
+    - generate:   prefill over prompts + per-token decode; approximated
+      as one forward over the FULL (prompt+generated) sequences, which
+      counts each decode step's matmuls once and the attention context
+      quadratically — the same accounting the reference's gen formula
+      reaches in closed form.
+    """
+    if interface_type == "train_step":
+        return 3 * transformer_forward_flops(cfg, input_seqlens)
+    if interface_type == "inference":
+        return transformer_forward_flops(cfg, input_seqlens)
+    if interface_type == "generate":
+        full = output_seqlens if output_seqlens else input_seqlens
+        return transformer_forward_flops(cfg, full)
+    return 0
 
 
 def calculate_llama_gen_flops(
@@ -69,7 +125,7 @@ def calculate_llama_gen_flops(
     num_kv_heads: int,
 ) -> int:
     """Generation FLOPs: one prefill over prompts plus `gen_len` decode steps."""
-    flops = caculuate_llama_forward_flops(
+    flops = calculate_llama_forward_flops(
         batch_size,
         prompt_lens,
         hidden_size,

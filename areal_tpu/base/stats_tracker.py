@@ -104,16 +104,33 @@ class DistributedStatsTracker:
         # "train/loss" but not "train_eval/acc".
         return key is None or k == key or k.startswith(key.rstrip("/") + "/")
 
-    def export(self, key: Optional[str] = None, reset: bool = True) -> Dict[str, float]:
+    def export(
+        self,
+        key: Optional[str] = None,
+        reset: bool = True,
+        return_types: bool = False,
+    ):
+        """Reduce recorded stats to floats.
+
+        With `return_types=True` also returns {key: "sum"|"avg"|...} so a
+        cross-process aggregator (the master merging DP-worker replies,
+        system/model_function_call.merge_worker_stats) can reduce with the
+        declared semantics instead of guessing — the control-plane
+        equivalent of the reference's process-group reduce
+        (realhf/base/stats_tracker.py:105).
+        """
         out: Dict[str, float] = {}
+        types: Dict[str, str] = {}
         for k, masks in self._denominators.items():
             if not self._match(key, k):
                 continue
             out[k] = float(sum(m.sum() for m in masks))
+            types[k] = "sum"
         for k, pairs in self._stats.items():
             if not self._match(key, k):
                 continue
             rt = self._reduce_types[k]
+            types[k] = rt.value
             masked = [v[m] for v, m in pairs]
             flat = np.concatenate(masked) if masked else np.array([])
             if flat.size == 0:
@@ -130,6 +147,7 @@ class DistributedStatsTracker:
             if not self._match(key, k):
                 continue
             out[k] = float(np.mean(vals))
+            types.setdefault(k, "avg")
         if reset:
             for k in [k for k in self._denominators if self._match(key, k)]:
                 del self._denominators[k]
@@ -138,6 +156,8 @@ class DistributedStatsTracker:
                 self._reduce_types.pop(k, None)
             for k in [k for k in self._scalars if self._match(key, k)]:
                 del self._scalars[k]
+        if return_types:
+            return out, types
         return out
 
 

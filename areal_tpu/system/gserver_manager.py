@@ -70,6 +70,10 @@ class GserverManager(Worker):
         self.rollout_stat = RolloutStat()
         self._lock = threading.Lock()
         self._last_metrics_poll = 0.0
+        self._server_gen_totals = {u: 0.0 for u in self.server_urls}
+        self._last_gen_total = 0.0
+        self._last_throughput_log = time.monotonic()
+        self._throughput_log_interval = 10.0
 
         self._http_loop = asyncio.new_event_loop()
         self._http_ready = threading.Event()
@@ -273,6 +277,8 @@ class GserverManager(Worker):
                             self._server_tokens[u] = float(line.split()[-1])
                         elif line.startswith("areal:num_running_reqs"):
                             self._server_reqs[u] = int(float(line.split()[-1]))
+                        elif line.startswith("areal:total_generated_tokens"):
+                            self._server_gen_totals[u] = float(line.split()[-1])
                 except Exception:
                     logger.warning(f"metrics poll failed for {u}")
 
@@ -308,6 +314,23 @@ class GserverManager(Worker):
             except Exception:
                 pass
             self._last_metrics_poll = time.monotonic()
+        # Periodic generation-throughput log (reference
+        # gserver_manager.py:279-285): interval tokens/s over all servers
+        # plus the rollout counters.
+        now = time.monotonic()
+        if now - self._last_throughput_log > self._throughput_log_interval:
+            total_gen = sum(self._server_gen_totals.values())
+            dt = now - self._last_throughput_log
+            tps = (total_gen - self._last_gen_total) / dt
+            with self._lock:
+                rs = self.rollout_stat.as_dict()
+            logger.info(
+                f"generation throughput: {tps:.0f} tokens/s "
+                f"(total {total_gen:.0f}) rollouts={rs} "
+                f"weight_version={self.weight_version}"
+            )
+            self._last_gen_total = total_gen
+            self._last_throughput_log = now
         time.sleep(0.05)
         return PollResult(batch_count=0)
 

@@ -72,6 +72,7 @@ class MasterWorker(Worker):
         ) if config.dataset_size else None
         self._total_steps_cap = ctl.benchmark_steps
         self._start_time = time.monotonic()
+        self._init_metric_trackers()
 
         # Wait for every model worker to finish its lazy setup.
         handlers = [f"model_worker/{i}" for i in range(config.n_model_workers)]
@@ -99,6 +100,34 @@ class MasterWorker(Worker):
         )
 
     # ------------------------------------------------------------------
+
+    def _init_metric_trackers(self):
+        """Tensorboard (always, under the trial log path) + wandb (only
+        when the user configured credentials) — reference
+        master_worker.py:291-350 initializes the same sinks."""
+        self._summary_writer = None
+        self._wandb_run = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._summary_writer = SummaryWriter(
+                log_dir=constants.get_log_path() + "/tb"
+            )
+        except Exception:
+            pass
+        import os
+
+        if os.environ.get("WANDB_API_KEY") or os.environ.get("WANDB_MODE"):
+            try:
+                import wandb
+
+                self._wandb_run = wandb.init(
+                    project=os.environ.get("WANDB_PROJECT", "areal_tpu"),
+                    name=f"{self.cfg.experiment_name}/{self.cfg.trial_name}",
+                    resume="allow",
+                )
+            except Exception:
+                logger.warning("wandb unavailable; metrics go to tensorboard only")
 
     def _maybe_recover(self):
         try:
@@ -159,6 +188,7 @@ class MasterWorker(Worker):
             f"(epoch {self.step_info.epoch}.{self.step_info.epoch_step}) "
             f"e2e={e2e:.3f}s stats={ {k: {kk: round(vv, 5) for kk, vv in v.items()} for k, v in stats.items()} }"
         )
+        self._log_step_perf(e2e)
 
         epochs_inc = self.step_info.epoch - epoch_before
         if self.save_ctl.check(steps=1, epochs=epochs_inc):
@@ -178,6 +208,45 @@ class MasterWorker(Worker):
             self.experiment_complete_exit()
             return None
         return PollResult(sample_count=1, batch_count=1)
+
+    def _log_step_perf(self, e2e: float):
+        """Per-step performance telemetry (reference master_worker.py:497-533:
+        `timeperf/e2e`, per-MFC wall time, analytic TFLOP/s) mirrored to
+        tensorboard/wandb."""
+        mfc_stats = dict(self.executor.ctrl.mfc_stats)
+        self.executor.ctrl.mfc_stats = {}
+        scalars = {"timeperf/e2e": e2e}
+        total_flops = 0.0
+        for name, st in mfc_stats.items():
+            for k, v in st.items():
+                if not isinstance(v, (int, float)):
+                    continue
+                if k == "perf/elapsed":
+                    scalars[f"timeperf/{name}"] = v
+                elif k == "perf/tflops":
+                    scalars[f"tflops/{name}"] = v
+                elif k == "perf/flops":
+                    total_flops += v
+                elif k == "perf/gen_tokens_per_sec":
+                    scalars[f"gen_tokens_per_sec/{name}"] = v
+                elif not k.startswith("perf/"):
+                    scalars[k] = v
+        if total_flops:
+            scalars["tflops/e2e"] = total_flops / e2e / 1e12
+        perf_keys = [
+            k for k in sorted(scalars)
+            if k.startswith(("timeperf/", "tflops/", "gen_tokens_per_sec/"))
+        ]
+        logger.info(
+            "benchmark: "
+            + " ".join(f"{k}={scalars[k]:.4g}" for k in perf_keys)
+        )
+        logging.log_scalars_to_trackers(
+            scalars,
+            step=self.step_info.global_step,
+            summary_writer=self._summary_writer,
+            wandb_run=self._wandb_run,
+        )
 
     def experiment_complete_exit(self):
         """Signal completion + tell workers to exit (reference
@@ -203,3 +272,8 @@ class MasterWorker(Worker):
             self.stream.close()
         except Exception:
             pass
+        if getattr(self, "_summary_writer", None) is not None:
+            try:
+                self._summary_writer.close()
+            except Exception:
+                pass

@@ -35,6 +35,9 @@ class RPCCorountineControl:
         default_factory=lambda: {"epoch": 0, "epoch_step": 0, "global_step": 0}
     )
     train_stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Per-MFC stats for ALL interface types this step (perf telemetry:
+    # timeperf/tflops per MFC, reference master_worker.py:497-533).
+    mfc_stats: Dict[str, Dict] = dataclasses.field(default_factory=dict)
     used_ids: set = dataclasses.field(default_factory=set)
 
 
@@ -165,18 +168,16 @@ class ModelFunctionCall:
                 out_metas.append(p.data["output_meta"])
             if p.data.get("stats"):
                 stats_list.append(p.data["stats"])
-        stats: Dict[str, Any] = {}
-        _ADDITIVE = ("n_tokens", "n_mbs", "n_seqs", "count")
-        if stats_list:
-            for k in stats_list[0]:
-                vals = [s[k] for s in stats_list if k in s and s[k] is not None]
-                if vals and isinstance(vals[0], (int, float)):
-                    # Additive counters sum across DP workers; everything
-                    # else (losses, norms) is averaged.
-                    if k.endswith(_ADDITIVE):
-                        stats[k] = float(np.sum(vals))
-                    else:
-                        stats[k] = float(np.mean(vals))
+        stats = merge_worker_stats(stats_list)
+        # DP workers run concurrently: wall time is the max, flops add,
+        # so MFC TFLOP/s is aggregate-over-workers per wall second.
+        if stats.get("perf/flops") and stats.get("perf/sec"):
+            stats["perf/tflops"] = stats["perf/flops"] / elapsed / 1e12
+        if stats.get("perf/gen_tokens"):
+            stats["perf/gen_tokens_per_sec"] = (
+                stats["perf/gen_tokens"] / elapsed
+            )
+        stats["perf/elapsed"] = elapsed
 
         if out_metas:
             merged = SequenceSample.gather(out_metas)
@@ -192,6 +193,55 @@ class ModelFunctionCall:
             f"MFC {rpc.name}: {len(ids)} seqs on {len(dests)} workers "
             f"in {elapsed:.3f}s"
         )
+        self.ctrl.mfc_stats[rpc.name] = stats
         if rpc.interface_type == ModelInterfaceType.TRAIN_STEP:
             self.ctrl.train_stats[rpc.name] = stats
         return stats
+
+
+# Reduce-type resolution for merging per-DP-worker stats: explicit types
+# shipped by the worker (stats_tracker declared ReduceTypes) win; the
+# suffix heuristic covers plain dicts.
+_ADDITIVE_SUFFIXES = ("n_tokens", "n_mbs", "n_seqs", "count")
+_ADDITIVE_KEYS = ("perf/flops", "perf/gen_tokens")
+_MAX_KEYS = ("perf/sec",)
+
+
+def merge_worker_stats(stats_list: List[Dict]) -> Dict[str, Any]:
+    """Merge stats dicts from concurrent DP workers into one.
+
+    Counterpart of the reference's cross-rank stats_tracker reduce
+    (realhf/base/stats_tracker.py:105 reduces over the process group);
+    here the master is the reduction point, so no collective is needed —
+    multi-host runs reduce through the control plane. Workers may embed
+    `__reduce_types__` (from stats_tracker.export(..., return_types=True))
+    to pin per-key semantics.
+    """
+    stats: Dict[str, Any] = {}
+    if not stats_list:
+        return stats
+    types: Dict[str, str] = {}
+    for s in stats_list:
+        types.update(s.get("__reduce_types__") or {})
+    keys = [k for k in stats_list[0] if k != "__reduce_types__"]
+    for k in keys:
+        vals = [s[k] for s in stats_list if k in s and s[k] is not None]
+        if not vals or not isinstance(vals[0], (int, float)):
+            continue
+        rt = types.get(k)
+        if rt is None:
+            if k in _MAX_KEYS:
+                rt = "max"
+            elif k in _ADDITIVE_KEYS or k.endswith(_ADDITIVE_SUFFIXES):
+                rt = "sum"
+            else:
+                rt = "avg"
+        if rt == "sum":
+            stats[k] = float(np.sum(vals))
+        elif rt == "min":
+            stats[k] = float(np.min(vals))
+        elif rt == "max":
+            stats[k] = float(np.max(vals))
+        else:
+            stats[k] = float(np.mean(vals))
+    return stats

@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -27,6 +28,8 @@ import numpy as np
 from areal_tpu.api import data_api
 from areal_tpu.api.config import ModelName
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+from areal_tpu.base import monitor
+from areal_tpu.utils import profiling
 from areal_tpu.api.model_api import (
     FinetuneSpec,
     Model,
@@ -35,7 +38,7 @@ from areal_tpu.api.model_api import (
     make_model,
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, seeding, timeutil
+from areal_tpu.base import constants, logging, name_resolve, names, seeding, stats_tracker, timeutil
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
 from areal_tpu.system.redistributor import RedistribStep
@@ -191,7 +194,10 @@ class ModelWorker(Worker):
 
         itype = d["interface_type"]
         mn = ModelName.parse(model_name)
-        with constants.model_scope(mn):
+        t0 = time.monotonic()
+        with constants.model_scope(mn), profiling.maybe_profile(
+            d.get("mfc_name", itype), step
+        ):
             if itype == "generate":
                 out = interface.generate(model, input_, mb_spec)
                 stats = {}
@@ -204,6 +210,46 @@ class ModelWorker(Worker):
                 stats = res[-1] if isinstance(res, list) else res
             else:
                 raise ValueError(f"bad interface_type {itype!r}")
+        # Per-MFC perf accounting shipped back to the master (counterpart
+        # of the reference's FlopsCounter + time_record,
+        # realhf/system/flops_counter.py, model_function_call.py:460-472).
+        # Worker-side because only the worker knows the model config and
+        # the true packed shapes.
+        stats = dict(stats or {})
+        # Stats recorded through the tracker during the interface call ship
+        # with their declared reduce types so the master merges MIN/MAX/SUM
+        # stats correctly across DP workers (merge_worker_stats).
+        tracked, ttypes = stats_tracker.export(return_types=True)
+        stats.update(tracked)
+        if ttypes:
+            stats["__reduce_types__"] = ttypes
+        stats["perf/sec"] = time.monotonic() - t0
+        cfg = getattr(model.module, "model_cfg", None)
+        if cfg is not None:
+            in_lens = [
+                l for sl in input_.seqlens[input_._main_key()] for l in sl
+            ]
+            out_lens = None
+            if out is not None and itype == "generate":
+                try:
+                    ok = out._main_key()
+                    out_lens = [l for sl in out.seqlens[ok] for l in sl]
+                except Exception:
+                    out_lens = None
+            stats["perf/flops"] = float(
+                monitor.mfc_flops(cfg, itype, in_lens, out_lens)
+            )
+            if itype == "generate" and out_lens:
+                # Group sampling replicates each prompt gconfig.n times in
+                # the output, so subtract each prompt once per replica.
+                group = (
+                    len(out_lens) // len(in_lens)
+                    if in_lens and len(out_lens) % len(in_lens) == 0
+                    else 1
+                )
+                stats["perf/gen_tokens"] = float(
+                    sum(out_lens) - group * sum(in_lens)
+                )
 
         output_meta = None
         if out is not None:
