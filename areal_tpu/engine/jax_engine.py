@@ -10,9 +10,12 @@ matching PipelinableEngine.train_batch semantics
 (realhf/api/core/model_api.py:514).
 
 Loss functions are pure jit-able callables
-`loss_fn(logits, rows) -> (loss_sum, aux_dict)` where `rows` carries the
-packed [R, T] arrays for every data key (token-aligned keys scattered,
-per-sequence scalars broadcast across their span).
+`loss_fn(model_out, rows) -> (loss_sum, aux_dict)` where `model_out` is
+the per-token next-token logprobs [R, T] (LM models; computed by the
+fused chunked-vocab op so [R, T, V] logits are never materialized) or
+values [R, T] (critics), and `rows` carries the packed [R, T] arrays for
+every data key (token-aligned keys scattered, per-sequence scalars
+broadcast across their span).
 """
 
 from __future__ import annotations
@@ -32,7 +35,7 @@ from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.generation import generate_tokens
 from areal_tpu.models.packing import PackedBatch, pack_sequences
 from areal_tpu.models.transformer import forward as model_forward
-from areal_tpu.ops.loss import next_token_logprobs
+from areal_tpu.ops.loss import fused_next_token_logprobs
 from areal_tpu.engine.optimizer import OptimizerConfig, make_optimizer
 from areal_tpu.parallel.mesh import single_device_mesh
 from areal_tpu.parallel.sharding import batch_sharding, param_shardings
@@ -91,7 +94,7 @@ class JaxTrainEngine(TrainEngine):
         optimizer_config: Optional[OptimizerConfig] = None,
         total_train_steps: int = 1000,
         attn_impl: str = "auto",
-        remat: bool = True,
+        remat: Any = "full",  # "full" | "save_attn" | "mlp" | "none" (bools ok)
         row_len_multiple: int = 128,
         max_row_len: Optional[int] = None,
         hf_family: Optional[str] = None,
@@ -199,20 +202,40 @@ class JaxTrainEngine(TrainEngine):
     # Train
     # ------------------------------------------------------------------
 
+    def _head_weight(self, p):
+        if self.model_cfg.is_critic:
+            return None
+        if self.model_cfg.tied_embeddings:
+            return p["embedding"]["weight"].T
+        return p["head"]["weight"]
+
     def _mb_loss_fn(self, loss_fn: PackedLossFn):
-        """loss over one micro-batch's rows: (params, rows) -> (loss_sum, aux)."""
+        """loss over one micro-batch's rows: (params, rows) -> (loss_sum, aux).
+
+        Non-critic models run the forward to hidden states only and feed
+        the loss the fused next-token logprobs; the [R, T, V] logits are
+        never materialized (reference analogue: vocab-parallel fused CE,
+        realhf/impl/model/parallelism/tensor_parallel/modules.py:1180).
+        """
+        is_critic = self.model_cfg.is_critic
 
         def compute(p, rows):
-            logits = model_forward(
+            out = model_forward(
                 p, self.model_cfg,
                 rows["input_ids"], rows["segment_ids"], rows["positions"],
                 attn_impl=self.attn_impl, remat=self.remat,
+                output="logits" if is_critic else "hidden",
                 return_aux=self.model_cfg.moe is not None,
                 mesh=self.mesh if self.mesh.size > 1 else None,
             )
             if self.model_cfg.moe is not None:
-                logits, moe_aux = logits
-            loss_sum, aux = loss_fn(logits, rows)
+                out, moe_aux = out
+            if not is_critic:
+                out = fused_next_token_logprobs(
+                    out, self._head_weight(p),
+                    rows["input_ids"], rows["segment_ids"],
+                )
+            loss_sum, aux = loss_fn(out, rows)
             if self.model_cfg.moe is not None:
                 # MoE aux losses scale with token count so they
                 # survive the 1/global_denom normalization applied
@@ -371,6 +394,9 @@ class JaxTrainEngine(TrainEngine):
         if self._serial_dispatch:
             jax.block_until_ready(self.params)
 
+        # One host transfer for all scalars (each float() would be its own
+        # device round trip — expensive on remote-tunneled TPUs).
+        loss_sum, gnorm, aux = jax.device_get((loss_sum, gnorm, aux))
         stats = {
             f"{loss_name}/loss": float(loss_sum) / global_denom,
             f"{loss_name}/grad_norm": float(gnorm),
@@ -390,19 +416,25 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._jit_cache:
 
             def fwd(params, rows):
-                logits_or_values = model_forward(
+                want_hidden = not (self.model_cfg.is_critic or output == "values")
+                out = model_forward(
                     params, self.model_cfg,
                     rows["input_ids"], rows["segment_ids"], rows["positions"],
                     attn_impl=self.attn_impl,
+                    output="hidden" if want_hidden else "logits",
                     mesh=self.mesh if self.mesh.size > 1 else None,
                 )
-                if self.model_cfg.is_critic or output == "values":
-                    return logits_or_values  # [R, T]
+                if not want_hidden:
+                    return out  # [R, T] values
                 if output == "logprobs":
-                    return next_token_logprobs(
-                        logits_or_values, rows["input_ids"], rows["segment_ids"]
+                    return fused_next_token_logprobs(
+                        out, self._head_weight(params),
+                        rows["input_ids"], rows["segment_ids"],
                     )
-                return logits_or_values
+                # raw logits still available for callers that need them
+                return (
+                    out @ self._head_weight(params).astype(out.dtype)
+                ).astype(jnp.float32)
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]

@@ -284,10 +284,9 @@ class PPOActorInterface(ModelInterface):
         )
         use_decoupled = self.use_decoupled_loss and "logprobs" in train_sample.keys
 
-        def actor_loss(logits, rows):
-            from areal_tpu.ops.loss import next_token_logprobs
-
-            lp = next_token_logprobs(logits, rows["input_ids"], rows["segment_ids"])
+        def actor_loss(lp, rows):
+            # `lp` is the fused next-token logprobs [R, T] computed by the
+            # engine (logits never materialized).
             mask = response_scoring_mask(rows["segment_ids"], rows["prompt_mask"])
             prox = rows["logprobs"] if use_decoupled else None
             loss_sum, st = F.actor_loss_fn(

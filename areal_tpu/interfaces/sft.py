@@ -11,17 +11,17 @@ import numpy as np
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import Model, ModelInterface, register_interface
 from areal_tpu.base import stats_tracker
-from areal_tpu.ops.loss import next_token_logprobs
 
 
-def sft_row_loss(logits, rows):
-    """Next-token CE over response tokens (prompt_mask == 1 marks prompts)."""
+def sft_row_loss(lp, rows):
+    """Next-token CE over response tokens (prompt_mask == 1 marks prompts).
+
+    `lp` is the engine-supplied fused next-token logprobs [R, T]."""
     seg = rows["segment_ids"]
     pm = rows["prompt_mask"]
     next_seg = jnp.concatenate([seg[:, 1:], jnp.zeros_like(seg[:, :1])], axis=1)
     next_pm = jnp.concatenate([pm[:, 1:], jnp.ones_like(pm[:, :1])], axis=1)
     mask = ((next_seg == seg) & (seg > 0) & (next_pm == 0)).astype(jnp.float32)
-    lp = next_token_logprobs(logits, rows["input_ids"], seg)
     loss_sum = -jnp.sum(lp * mask)
     return loss_sum, {"n_response_tokens": jnp.sum(mask)}
 

@@ -204,7 +204,7 @@ def forward(
     output: str = "logits",  # logits | hidden
     return_kv: bool = False,
     return_aux: bool = False,  # also return MoE aux losses (zeros if dense)
-    remat: bool = False,
+    remat: Any = False,  # False/"none" | True/"full" | "save_attn" | "mlp"
     mesh=None,  # jax.sharding.Mesh: anchor activation/logits shardings
 ) -> Any:
     """Packed-rows forward pass.
@@ -249,6 +249,36 @@ def forward(
         cos, sin = rotary_cos_sin(positions, inv_freq)  # [R, T, hd/2]
 
     use_moe = cfg.moe is not None
+    # remat policy: "full" recomputes the whole layer in backward (least
+    # memory, ~+33% FLOPs); "save_attn" is "full" but pins the attention
+    # kernel's residuals (q/k/v/out/lse) so the backward runs the flash
+    # bwd kernel without re-running the fwd kernel — the fwd kernel is
+    # the most expensive single op in the layer; "mlp" recomputes only
+    # the MLP block; "none" saves everything (fastest when HBM allows).
+    remat_mode = {True: "full", False: "none"}.get(remat, remat)
+    if remat_mode not in ("full", "save_attn", "mlp", "none"):
+        raise ValueError(f"unknown remat mode {remat!r}")
+    if remat_mode == "save_attn":
+        from areal_tpu.ops.attention import resolve_attn_impl
+
+        resolved = resolve_attn_impl(
+            attn_impl, input_ids.shape[1], cfg.n_q_heads, cfg.n_kv_heads
+        )
+        if resolved != "splash":
+            # Only the splash kernel tags its residuals; with other impls
+            # the policy saves nothing and "save_attn" would silently be
+            # "full" — make that explicit.
+            import warnings
+
+            warnings.warn(
+                f"remat='save_attn' requires the splash attention impl "
+                f"(resolved {resolved!r}); falling back to remat='full'",
+                stacklevel=2,
+            )
+            remat_mode = "full"
+    mlp_fn = lambda h, mp: _mlp(h, mp, cfg, cdt)
+    if remat_mode == "mlp":
+        mlp_fn = jax.checkpoint(mlp_fn)
 
     def layer_body(carry, lp):
         x, aux_acc = carry
@@ -264,7 +294,7 @@ def forward(
             m, aux = moe_mlp(h, lp["mlp"], cfg, cdt)
             aux_acc = {k: aux_acc[k] + aux[k] for k in aux_acc}
         else:
-            m = _mlp(h, lp["mlp"], cfg, cdt)
+            m = mlp_fn(h, lp["mlp"])
         x = act_c(x + m)
         return (x, aux_acc), kv if return_kv else None
 
@@ -272,7 +302,19 @@ def forward(
         "load_balance_loss": jnp.zeros((), jnp.float32),
         "z_loss": jnp.zeros((), jnp.float32),
     }
-    body = jax.checkpoint(layer_body) if remat else layer_body
+    if remat_mode == "full":
+        body = jax.checkpoint(layer_body)
+    elif remat_mode == "save_attn":
+        from areal_tpu.ops.attention import SPLASH_RESIDUAL_NAME
+
+        body = jax.checkpoint(
+            layer_body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                SPLASH_RESIDUAL_NAME
+            ),
+        )
+    else:
+        body = layer_body
     (x, moe_aux), kvs = jax.lax.scan(body, (x, aux0), params["layers"])
     x = _norm(x, params["final_norm"], cfg)
 

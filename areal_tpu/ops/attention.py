@@ -22,6 +22,10 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -2.0**30
+LANES = 128  # TPU lane width; splash blocks must be lane-aligned
+
+# checkpoint_name tag for splash-attention residuals (see _splash_kernel).
+SPLASH_RESIDUAL_NAME = "splash_attn_residuals"
 
 
 def segment_causal_mask(
@@ -82,14 +86,119 @@ def decode_attention(
     return out.reshape(B, Hq, hd).astype(q.dtype)
 
 
+_SPLASH_KERNEL_CACHE = {}
+
+
+def _largest_block(n: int, cap: int) -> int:
+    """Largest multiple of 128 that divides n and is <= cap (splash
+    requires lane-aligned blocks that divide the sequence length)."""
+    if n % LANES:
+        raise ValueError(
+            f"splash attention needs seq len a multiple of {LANES}, got {n}"
+        )
+    d = (min(cap, n) // LANES) * LANES
+    while n % d:
+        d -= LANES
+    return d
+
+
+def _splash_kernel(t: int, group: int, interpret: bool = False):
+    """Build (and cache) a tuned splash-attention kernel for seq len `t`.
+
+    jax's splash attention (jax.experimental.pallas.ops.tpu.splash_attention,
+    the production TPU flash kernel — same role as the flash-attn package
+    the reference installs, realhf Dockerfile) is used as an MQA problem
+    per kv head: q carries the GQA group as its head axis. Global causal
+    mask + segment ids equals our (same segment) & (position causal) mask
+    because packed segments are contiguous with ascending positions.
+    Block sizes were tuned on v5e (fused bwd, 512/1024 tiles).
+    """
+    key = (t, group, interpret)
+    if key not in _SPLASH_KERNEL_CACHE:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as sm,
+        )
+
+        # Block sizes must divide the sequence length (packed rows are
+        # padded to multiples of 128, so t is often e.g. 640 or 1536).
+        bq = _largest_block(t, 512)
+        bkv = _largest_block(t, 1024)
+        bkvc = _largest_block(bkv, 512)
+        mask = sm.MultiHeadMask([sm.CausalMask((t, t)) for _ in range(group)])
+        bs = sk.BlockSizes(
+            block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
+            block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+            use_fused_bwd_kernel=True,
+        )
+        # Residuals are checkpoint-named so the "save_attn" remat policy
+        # (models/transformer.py) can pin them: backward then runs the
+        # flash bwd kernel without re-running the fwd kernel.
+        _SPLASH_KERNEL_CACHE[key] = sk.make_splash_mqa_single_device(
+            mask=mask, block_sizes=bs,
+            residual_checkpoint_name=SPLASH_RESIDUAL_NAME,
+            interpret=interpret,
+        )
+    return _SPLASH_KERNEL_CACHE[key]
+
+
+def splash_packed_attention(
+    q: jnp.ndarray,  # [T, Hq, hd]
+    k: jnp.ndarray,  # [T, Hkv, hd]
+    v: jnp.ndarray,  # [T, Hkv, hd]
+    segment_ids: jnp.ndarray,  # [T] int32, 0 = pad
+    positions: jnp.ndarray,  # [T] int32 (unused: causality via stream order)
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Packed GQA attention on jax's splash kernel (one MQA call per kv
+    head, GQA group as the q-head axis). Pad tokens (segment 0) attend
+    only among themselves, so outputs there are finite garbage — masked
+    by downstream losses exactly like the other impls."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+    )
+
+    t, hq, hd = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    scale = float(softmax_scale) if softmax_scale is not None else hd ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    kernel = _splash_kernel(t, group, interpret=bool(interpret))
+
+    # [T, Hq, hd] -> [Hkv, group, T, hd]; k/v -> [Hkv, T, hd]
+    qh = (q * jnp.asarray(scale, q.dtype)).transpose(1, 0, 2).reshape(
+        hkv, group, t, hd
+    )
+    kh = k.transpose(1, 0, 2)
+    vh = v.transpose(1, 0, 2)
+    ids = sk.SegmentIds(q=segment_ids, kv=segment_ids)
+    out = jax.vmap(lambda qq, kk, vv: kernel(qq, kk, vv, ids))(qh, kh, vh)
+    # [Hkv, group, T, hd] -> [T, Hq, hd]
+    return out.reshape(hq, t, hd).transpose(1, 0, 2).astype(q.dtype)
+
+
+def resolve_attn_impl(impl: str, t: int, hq: int, hkv: int) -> str:
+    """Resolve 'auto' to a concrete impl for the given shape (trace-time
+    static decision): splash on TPU backends when shapes allow, reference
+    otherwise."""
+    if impl != "auto":
+        return impl
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    splash_ok = t >= 128 and t % 128 == 0 and hq % hkv == 0
+    return "splash" if (on_tpu and splash_ok) else "reference"
+
+
 def packed_attention(q, k, v, segment_ids, positions, softmax_scale=None, impl="auto"):
     """Dispatch between implementations. Static decision (trace-time): `impl`
-    is 'reference', 'flash', or 'auto' (flash on TPU backends when T is a
-    multiple of the kernel block, reference otherwise)."""
-    T = q.shape[0]
-    if impl == "auto":
-        on_tpu = jax.default_backend() in ("tpu", "axon")
-        impl = "flash" if (on_tpu and T >= 128 and T % 128 == 0) else "reference"
+    is 'reference', 'flash' (our Pallas kernel), 'splash' (jax's tuned TPU
+    kernel), or 'auto' (see resolve_attn_impl)."""
+    impl = resolve_attn_impl(impl, q.shape[0], q.shape[1], k.shape[1])
+    if impl == "splash":
+        return splash_packed_attention(
+            q, k, v, segment_ids, positions, softmax_scale=softmax_scale
+        )
     if impl == "flash":
         from areal_tpu.ops.pallas.flash_attn import flash_packed_attention
 

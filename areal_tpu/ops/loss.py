@@ -34,13 +34,7 @@ def next_token_logprobs(
     Matches the reference convention where packed logprobs are shifted so
     position t scores the token emitted *at* t+1.
     """
-    next_ids = jnp.concatenate(
-        [input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])], axis=1
-    )
-    next_seg = jnp.concatenate(
-        [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
-    )
-    valid = (segment_ids > 0) & (next_seg == segment_ids)
+    next_ids, valid = _next_token_targets(input_ids, segment_ids)
     logp = gather_logprobs(logits, next_ids)
     return jnp.where(valid, logp, 0.0)
 
@@ -53,6 +47,74 @@ def next_token_entropy(
     logp = jax.nn.log_softmax(logits, axis=-1)
     ent = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
     return jnp.where(segment_ids > 0, ent, 0.0)
+
+
+def _next_token_targets(input_ids: jnp.ndarray, segment_ids: jnp.ndarray):
+    """(next_ids, valid) in the shifted frame shared by all logprob ops."""
+    next_ids = jnp.concatenate(
+        [input_ids[:, 1:], jnp.zeros_like(input_ids[:, :1])], axis=1
+    )
+    next_seg = jnp.concatenate(
+        [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+    )
+    valid = (segment_ids > 0) & (next_seg == segment_ids)
+    return next_ids, valid
+
+
+def _pick_chunk(n_tokens: int, target: int = 4096) -> int:
+    """Largest divisor of n_tokens that is <= target (>=1)."""
+    c = min(target, n_tokens)
+    while n_tokens % c:
+        c -= 1
+    return c
+
+
+def fused_next_token_logprobs(
+    hidden: jnp.ndarray,  # [R, T, D] compute dtype
+    head_w: jnp.ndarray,  # [D, V]
+    input_ids: jnp.ndarray,  # [R, T]
+    segment_ids: jnp.ndarray,  # [R, T]
+    chunk_size: int = 4096,
+) -> jnp.ndarray:
+    """next_token_logprobs computed straight from hidden states without
+    ever materializing the [R, T, V] logits tensor.
+
+    The token axis is flattened and scanned in chunks; each chunk computes
+    its [C, V] logits tile, reduces to (picked - logsumexp), and discards
+    the tile. `jax.checkpoint` on the chunk body makes the backward pass
+    recompute the tile instead of storing softmax residuals, so peak
+    memory is O(C * V) rather than O(R * T * V) in both directions —
+    the TPU-shaped equivalent of the reference's vocab-parallel fused
+    cross entropy (realhf/impl/model/parallelism/tensor_parallel/
+    modules.py:1180), which shards V to avoid the same materialization.
+
+    Returns [R, T] fp32, zeros at invalid (sequence-final / pad) slots.
+    """
+    R, T, D = hidden.shape
+    next_ids, valid = _next_token_targets(input_ids, segment_ids)
+    n = R * T
+    c = _pick_chunk(n, chunk_size)
+    flat_h = hidden.reshape(n // c, c, D)
+    flat_y = next_ids.reshape(n // c, c)
+
+    def chunk(carry, hy):
+        h_c, y_c = hy
+        logits = (h_c @ head_w.astype(h_c.dtype)).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, y_c[:, None], axis=-1)[:, 0]
+        return carry, picked - lse
+
+    _, logp = jax.lax.scan(jax.checkpoint(chunk), None, (flat_h, flat_y))
+    return jnp.where(valid, logp.reshape(R, T), 0.0)
+
+
+def sft_loss_from_logprobs(
+    logp: jnp.ndarray,  # [R, T] next-token logprobs (zeros at invalid)
+    loss_mask: jnp.ndarray,  # [R, T]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked next-token NLL from precomputed logprobs."""
+    mask = loss_mask.astype(jnp.float32)
+    return -jnp.sum(logp * mask), jnp.sum(mask)
 
 
 def sft_loss(

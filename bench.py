@@ -51,7 +51,7 @@ def main():
     from areal_tpu.engine.optimizer import OptimizerConfig
     from areal_tpu.models.config import TransformerConfig
     from areal_tpu.models.transformer import count_params, init_params
-    from areal_tpu.ops.loss import sft_loss
+    from areal_tpu.ops.loss import sft_loss_from_logprobs
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -81,6 +81,10 @@ def main():
         cfg, params,
         optimizer_config=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
         total_train_steps=1000, row_len_multiple=seqlen, max_row_len=seqlen,
+        # save_attn: keep the flash kernel's residuals, recompute the rest
+        # in backward — the best single-chip throughput/memory point for
+        # this model size (see scripts/perf_probe.py measurements).
+        remat="save_attn" if on_tpu else "full",
     )
 
     rng = np.random.RandomState(0)
@@ -95,8 +99,8 @@ def main():
         },
     )
 
-    def packed_loss(logits, rows):
-        tot, n = sft_loss(logits, rows["input_ids"], rows["segment_ids"], rows["loss_mask"])
+    def packed_loss(lp, rows):
+        tot, n = sft_loss_from_logprobs(lp, rows["loss_mask"])
         return tot, {}
 
     def weight(mb):

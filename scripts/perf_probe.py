@@ -1,0 +1,118 @@
+"""Single-chip perf probe: time train-step components in isolation.
+
+Used to diagnose the bench.py bottleneck (VERDICT r2 weak #1). Run on the
+real TPU chip; prints a component timing table to stderr.
+
+  python scripts/perf_probe.py [--trace]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import count_params, forward, init_params
+from areal_tpu.ops.loss import sft_loss
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def timeit(fn, *args, n=5, warmup=2):
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    trace = "--trace" in sys.argv
+    cfg = TransformerConfig(
+        n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
+        intermediate_dim=4864, vocab_size=32768, attn_bias=True,
+        compute_dtype="bfloat16",
+    )
+    R, T = 16, 2048
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    log(f"probe: n_params={n_params/1e6:.1f}M R={R} T={T}")
+
+    rng = np.random.RandomState(0)
+    input_ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(R, T)), jnp.int32)
+    segment_ids = jnp.ones((R, T), jnp.int32)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (R, 1))
+    loss_mask = jnp.ones((R, T), jnp.float32)
+
+    total_tokens = R * T
+    fwd_flops = 2.0 * n_params * total_tokens + 2.0 * cfg.n_layers * (
+        cfg.n_q_heads * cfg.head_dim) * T * T * R * 0.5 * 2
+    train_flops = 3.0 * fwd_flops  # fwd + 2x bwd
+
+    # --- forward only, per attention impl ---
+    for impl in ("flash", "reference"):
+        f = jax.jit(lambda p, impl=impl: forward(
+            p, cfg, input_ids, segment_ids, positions, attn_impl=impl))
+        dt = timeit(f, params)
+        log(f"probe: fwd  attn={impl:9s}              {dt*1e3:7.1f} ms "
+            f"{fwd_flops/dt/1e12:6.1f} TFLOP/s")
+
+    # --- forward returning hidden only (no LM head) ---
+    f_hidden = jax.jit(lambda p: forward(
+        p, cfg, input_ids, segment_ids, positions, attn_impl="flash",
+        output="hidden"))
+    dt = timeit(f_hidden, params)
+    log(f"probe: fwd  hidden-only (no head)       {dt*1e3:7.1f} ms")
+
+    # --- full grad step, remat x attn ---
+    def loss_of(p, impl, remat):
+        logits = forward(p, cfg, input_ids, segment_ids, positions,
+                         attn_impl=impl, remat=remat)
+        tot, n = sft_loss(logits, input_ids, segment_ids, loss_mask)
+        return tot / n
+
+    for impl in ("flash", "reference"):
+        for remat in (True, False):
+            g = jax.jit(jax.grad(lambda p: loss_of(p, impl, remat)))
+            try:
+                dt = timeit(g, params)
+            except Exception as e:  # noqa: BLE001
+                log(f"probe: grad attn={impl:9s} remat={int(remat)}  FAILED {type(e).__name__}")
+                continue
+            log(f"probe: grad attn={impl:9s} remat={int(remat)}      {dt*1e3:7.1f} ms "
+                f"{train_flops/dt/1e12:6.1f} TFLOP/s")
+
+    # --- loss tail in isolation: logits materialization + CE ---
+    hidden = jax.block_until_ready(f_hidden(params))
+
+    def ce_materialized(p, h):
+        head_w = p["embedding"]["weight"] if cfg.tied_embeddings else p["head"]["weight"]
+        logits = (h @ head_w.astype(h.dtype)).astype(jnp.float32)
+        tot, n = sft_loss(logits, input_ids, segment_ids, loss_mask)
+        return tot / n
+
+    g_ce = jax.jit(jax.grad(ce_materialized, argnums=(0, 1)))
+    dt = timeit(g_ce, params, hidden)
+    log(f"probe: grad(head+CE) materialized       {dt*1e3:7.1f} ms")
+
+    if trace:
+        import os
+        path = "/tmp/areal_tpu/probe_trace"
+        os.makedirs(path, exist_ok=True)
+        g = jax.jit(jax.grad(lambda p: loss_of(p, "flash", True)))
+        jax.block_until_ready(g(params))
+        with jax.profiler.trace(path):
+            jax.block_until_ready(g(params))
+        log(f"probe: trace -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,7 +12,7 @@ from areal_tpu.engine.jax_engine import JaxTrainEngine
 from areal_tpu.engine.optimizer import OptimizerConfig
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import init_params
-from areal_tpu.ops.loss import sft_loss
+from areal_tpu.ops.loss import sft_loss_from_logprobs
 from areal_tpu.parallel.mesh import make_mesh
 
 
@@ -43,10 +43,9 @@ def make_batch(n=8, seed=0, vocab=64):
     )
 
 
-def sft_packed_loss(logits, rows):
-    total, n = sft_loss(
-        logits, rows["input_ids"], rows["segment_ids"], rows["loss_mask"]
-    )
+def sft_packed_loss(lp, rows):
+    # `lp` = engine-fused next-token logprobs [R, T].
+    total, n = sft_loss_from_logprobs(lp, rows["loss_mask"])
     return total, {"n_valid_tokens": n}
 
 

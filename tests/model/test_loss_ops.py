@@ -69,3 +69,54 @@ def test_masked_normalization():
     assert abs(vals.mean()) < 1e-5
     assert out[0, 3] == 0.0
     np.testing.assert_allclose(np.std(vals, ddof=1), 1.0, atol=0.05)
+
+
+def test_fused_next_token_logprobs_matches_unfused():
+    from areal_tpu.ops.loss import fused_next_token_logprobs
+
+    rng = np.random.RandomState(3)
+    R, T, D, V = 2, 32, 16, 64
+    hidden = rng.randn(R, T, D).astype(np.float32)
+    head_w = (rng.randn(D, V) * 0.1).astype(np.float32)
+    input_ids = rng.randint(0, V, size=(R, T)).astype(np.int32)
+    seg = np.zeros((R, T), np.int32)
+    seg[0, :20] = 1
+    seg[0, 20:29] = 2
+    seg[1, :15] = 1
+    logits = hidden @ head_w
+    ref = np.asarray(
+        next_token_logprobs(jnp.asarray(logits), jnp.asarray(input_ids), jnp.asarray(seg))
+    )
+    for chunk in (4096, 16, 7):
+        out = np.asarray(
+            fused_next_token_logprobs(
+                jnp.asarray(hidden), jnp.asarray(head_w),
+                jnp.asarray(input_ids), jnp.asarray(seg), chunk_size=chunk,
+            )
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_fused_next_token_logprobs_grads_match():
+    import jax
+
+    from areal_tpu.ops.loss import fused_next_token_logprobs
+
+    rng = np.random.RandomState(4)
+    R, T, D, V = 2, 16, 8, 32
+    hidden = jnp.asarray(rng.randn(R, T, D), jnp.float32)
+    head_w = jnp.asarray(rng.randn(D, V) * 0.1, jnp.float32)
+    input_ids = jnp.asarray(rng.randint(0, V, size=(R, T)), jnp.int32)
+    seg = jnp.ones((R, T), jnp.int32)
+
+    def loss_fused(h, w):
+        return -jnp.sum(fused_next_token_logprobs(h, w, input_ids, seg, chunk_size=8))
+
+    def loss_ref(h, w):
+        logits = (h @ w).astype(jnp.float32)
+        return -jnp.sum(next_token_logprobs(logits, input_ids, seg))
+
+    gh1, gw1 = jax.grad(loss_fused, argnums=(0, 1))(hidden, head_w)
+    gh2, gw2 = jax.grad(loss_ref, argnums=(0, 1))(hidden, head_w)
+    np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh2), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2), atol=1e-4)
