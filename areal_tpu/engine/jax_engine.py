@@ -432,9 +432,14 @@ class JaxTrainEngine(TrainEngine):
                         rows["input_ids"], rows["segment_ids"],
                     )
                 # raw logits still available for callers that need them
-                return (
+                logits = (
                     out @ self._head_weight(params).astype(out.dtype)
                 ).astype(jnp.float32)
+                if self.mesh.size > 1:
+                    from areal_tpu.parallel.sharding import logits_constraint
+
+                    logits = logits_constraint(logits, self.mesh)
+                return logits
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]

@@ -276,7 +276,12 @@ def forward(
                 stacklevel=2,
             )
             remat_mode = "full"
-    mlp_fn = lambda h, mp: _mlp(h, mp, cfg, cdt)
+    if use_moe:
+        from areal_tpu.models.moe import moe_mlp
+
+        mlp_fn = lambda h, mp: moe_mlp(h, mp, cfg, cdt)
+    else:
+        mlp_fn = lambda h, mp: _mlp(h, mp, cfg, cdt)
     if remat_mode == "mlp":
         mlp_fn = jax.checkpoint(mlp_fn)
 
@@ -289,9 +294,7 @@ def forward(
         x = x + a
         h = _norm(x, lp["ln2"], cfg)
         if use_moe:
-            from areal_tpu.models.moe import moe_mlp
-
-            m, aux = moe_mlp(h, lp["mlp"], cfg, cdt)
+            m, aux = mlp_fn(h, lp["mlp"])
             aux_acc = {k: aux_acc[k] + aux[k] for k in aux_acc}
         else:
             m = mlp_fn(h, lp["mlp"])

@@ -113,33 +113,39 @@ def _splash_kernel(t: int, group: int, interpret: bool = False):
     because packed segments are contiguous with ascending positions.
     Block sizes were tuned on v5e (fused bwd, 512/1024 tiles).
     """
-    key = (t, group, interpret)
-    if key not in _SPLASH_KERNEL_CACHE:
-        from jax.experimental.pallas.ops.tpu.splash_attention import (
-            splash_attention_kernel as sk,
-            splash_attention_mask as sm,
-        )
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
 
-        # Block sizes must divide the sequence length (packed rows are
-        # padded to multiples of 128, so t is often e.g. 640 or 1536).
-        bq = _largest_block(t, 512)
-        bkv = _largest_block(t, 1024)
-        bkvc = _largest_block(bkv, 512)
+    # Only the mask object is cached: the built kernel holds per-trace
+    # mask-info buffers, and reusing it across jit traces leaks tracers
+    # (UnexpectedTracerError). Rebuilding per trace is cheap — tracing
+    # happens once per compiled program, not per step.
+    key = (t, group)
+    mask = _SPLASH_KERNEL_CACHE.get(key)
+    if mask is None:
         mask = sm.MultiHeadMask([sm.CausalMask((t, t)) for _ in range(group)])
-        bs = sk.BlockSizes(
-            block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
-            block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
-            use_fused_bwd_kernel=True,
-        )
-        # Residuals are checkpoint-named so the "save_attn" remat policy
-        # (models/transformer.py) can pin them: backward then runs the
-        # flash bwd kernel without re-running the fwd kernel.
-        _SPLASH_KERNEL_CACHE[key] = sk.make_splash_mqa_single_device(
-            mask=mask, block_sizes=bs,
-            residual_checkpoint_name=SPLASH_RESIDUAL_NAME,
-            interpret=interpret,
-        )
-    return _SPLASH_KERNEL_CACHE[key]
+        _SPLASH_KERNEL_CACHE[key] = mask
+
+    # Block sizes must divide the sequence length (packed rows are
+    # padded to multiples of 128, so t is often e.g. 640 or 1536).
+    bq = _largest_block(t, 512)
+    bkv = _largest_block(t, 1024)
+    bkvc = _largest_block(bkv, 512)
+    bs = sk.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
+        block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,
+        use_fused_bwd_kernel=True,
+    )
+    # Residuals are checkpoint-named so the "save_attn" remat policy
+    # (models/transformer.py) can pin them: backward then runs the
+    # flash bwd kernel without re-running the fwd kernel.
+    return sk.make_splash_mqa_single_device(
+        mask=mask, block_sizes=bs,
+        residual_checkpoint_name=SPLASH_RESIDUAL_NAME,
+        interpret=interpret,
+    )
 
 
 def splash_packed_attention(

@@ -74,7 +74,7 @@ def fused_next_token_logprobs(
     head_w: jnp.ndarray,  # [D, V]
     input_ids: jnp.ndarray,  # [R, T]
     segment_ids: jnp.ndarray,  # [R, T]
-    chunk_size: int = 4096,
+    chunk_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """next_token_logprobs computed straight from hidden states without
     ever materializing the [R, T, V] logits tensor.
@@ -91,6 +91,11 @@ def fused_next_token_logprobs(
     Returns [R, T] fp32, zeros at invalid (sequence-final / pad) slots.
     """
     R, T, D = hidden.shape
+    V = head_w.shape[-1]
+    if chunk_size is None:
+        # Byte-budgeted: keep the per-chunk fp32 logits tile ~512 MB
+        # regardless of vocab size (C*V elements), floor 256 tokens.
+        chunk_size = max(256, (1 << 27) // V)
     next_ids, valid = _next_token_targets(input_ids, segment_ids)
     n = R * T
     c = _pick_chunk(n, chunk_size)

@@ -58,11 +58,19 @@ def main():
     log(f"bench: platform={platform} n_devices={len(jax.devices())}")
 
     if on_tpu:
-        # ~0.5B-class Qwen2.5-style model, 2k packed context, bf16.
+        # R1-Distill-Qwen-1.5B-shape layers (hidden 1536, 12 q / 2 kv heads,
+        # head_dim 128, ffn 8960) — the model family the reference's
+        # headline benchmark trains (benchmark/verl_v0_3_0_post1_76084d3/
+        # README.md:38-44). Depth (16 vs 28 layers) and vocab (32k) are
+        # trimmed so the model + fp32 Adam moments + activations fit one
+        # v5e chip's 16 GB HBM; per-chip TFLOP/s is shape-, not
+        # depth-sensitive. Params in bf16 with fp32 optimizer moments
+        # (weights stream at half the bytes; update math stays fp32 —
+        # measured +18 TFLOP/s over fp32 params, scripts/perf_probe.py).
         cfg = TransformerConfig(
-            n_layers=24, hidden_dim=896, n_q_heads=14, n_kv_heads=2, head_dim=64,
-            intermediate_dim=4864, vocab_size=32768, attn_bias=True,
-            compute_dtype="bfloat16",
+            n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
+            head_dim=128, intermediate_dim=8960, vocab_size=32768,
+            attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
         )
         seqlen, n_seqs, n_warmup, n_steps = 2048, 16, 2, 5
     else:
