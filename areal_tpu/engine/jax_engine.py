@@ -416,30 +416,22 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._jit_cache:
 
             def fwd(params, rows):
-                want_hidden = not (self.model_cfg.is_critic or output == "values")
+                # "logprobs" uses the fused chunked-vocab path over hidden
+                # states; values/raw logits come straight from the model.
+                fuse = output == "logprobs" and not self.model_cfg.is_critic
                 out = model_forward(
                     params, self.model_cfg,
                     rows["input_ids"], rows["segment_ids"], rows["positions"],
                     attn_impl=self.attn_impl,
-                    output="hidden" if want_hidden else "logits",
+                    output="hidden" if fuse else "logits",
                     mesh=self.mesh if self.mesh.size > 1 else None,
                 )
-                if not want_hidden:
-                    return out  # [R, T] values
-                if output == "logprobs":
+                if fuse:
                     return fused_next_token_logprobs(
                         out, self._head_weight(params),
                         rows["input_ids"], rows["segment_ids"],
                     )
-                # raw logits still available for callers that need them
-                logits = (
-                    out @ self._head_weight(params).astype(out.dtype)
-                ).astype(jnp.float32)
-                if self.mesh.size > 1:
-                    from areal_tpu.parallel.sharding import logits_constraint
-
-                    logits = logits_constraint(logits, self.mesh)
-                return logits
+                return out  # [R, T] values or [R, T, V] logits
 
             self._jit_cache[key] = jax.jit(fwd)
         return self._jit_cache[key]

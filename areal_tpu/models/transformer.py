@@ -163,9 +163,15 @@ def _mlp(h, lp, cfg, cdt):
 
 
 def _attention_block(
-    x, lp, cfg, cos, sin, segment_ids, positions, attn_impl, cdt
+    x, lp, cfg, cos, sin, segment_ids, positions, attn_impl, cdt, mesh=None
 ):
     """x: [R, T, D] -> attention output [R, T, D]."""
+    from areal_tpu.ops.attention import (
+        resolve_attn_impl,
+        sharded_splash_attention,
+        sharded_splash_ok,
+    )
+
     R, T, D = x.shape
     q = x @ lp["wq"].astype(cdt)
     k = x @ lp["wk"].astype(cdt)
@@ -184,10 +190,26 @@ def _attention_block(
         q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
         k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
 
-    attn_fn = lambda q1, k1, v1, s1, p1: packed_attention(
-        q1, k1, v1, s1, p1, impl=attn_impl
-    )
-    out = jax.vmap(attn_fn)(q, k, v, segment_ids, positions)  # [R, T, Hq, hd]
+    impl = resolve_attn_impl(attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads)
+    sharded = mesh is not None and mesh.size > 1
+    if sharded and impl == "splash" and not sharded_splash_ok(
+        mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads
+    ):
+        # Never run a bare pallas_call inside a sharded jit — GSPMD
+        # cannot partition it (it replicates or fails); the einsum
+        # reference partitions cleanly.
+        impl = "reference"
+    if sharded and impl == "splash":
+        # pallas_call is opaque to GSPMD: run the kernel per shard under
+        # shard_map with the megatron-equivalent layout.
+        out = sharded_splash_attention(
+            q, k, v, segment_ids, positions, mesh
+        )  # [R, T, Hq, hd]
+    else:
+        attn_fn = lambda q1, k1, v1, s1, p1: packed_attention(
+            q1, k1, v1, s1, p1, impl=impl
+        )
+        out = jax.vmap(attn_fn)(q, k, v, segment_ids, positions)
     out = out.reshape(R, T, cfg.q_dim) @ lp["wo"].astype(cdt)
     if "bo" in lp:
         out = out + lp["bo"].astype(cdt)
@@ -232,6 +254,17 @@ def forward(
 
     cdt = jnp.dtype(cfg.compute_dtype)
     emb = params["embedding"]["weight"]
+    if mesh is not None:
+        # ZeRO-style gather-before-use: the table is stored (vocab ->
+        # tensor, D -> fsdp)-sharded, but a token gather from a sharded
+        # table cannot transition to the (data,fsdp)-row activation layout
+        # — the SPMD partitioner falls back to "involuntary full
+        # rematerialization" (replicating the gather OUTPUT per step).
+        # All-gathering the table first is one clean collective and makes
+        # the gather fully local.
+        emb = jax.lax.with_sharding_constraint(
+            emb, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        )
     x = act_c(emb[input_ids].astype(cdt))
     if cfg.embedding_multiplier:
         x = x * jnp.asarray(cfg.embedding_multiplier, cdt)
@@ -289,7 +322,7 @@ def forward(
         x, aux_acc = carry
         a, kv = _attention_block(
             _norm(x, lp["ln1"], cfg), lp["attn"], cfg, cos, sin,
-            segment_ids, positions, attn_impl, cdt,
+            segment_ids, positions, attn_impl, cdt, mesh=mesh,
         )
         x = x + a
         h = _norm(x, lp["ln2"], cfg)

@@ -86,7 +86,7 @@ def decode_attention(
     return out.reshape(B, Hq, hd).astype(q.dtype)
 
 
-_SPLASH_KERNEL_CACHE = {}
+_SPLASH_MASK_CACHE = {}
 
 
 def _largest_block(n: int, cap: int) -> int:
@@ -103,7 +103,8 @@ def _largest_block(n: int, cap: int) -> int:
 
 
 def _splash_kernel(t: int, group: int, interpret: bool = False):
-    """Build (and cache) a tuned splash-attention kernel for seq len `t`.
+    """Build a tuned splash-attention kernel for seq len `t` (the mask
+    object is cached; the kernel itself is rebuilt per trace).
 
     jax's splash attention (jax.experimental.pallas.ops.tpu.splash_attention,
     the production TPU flash kernel — same role as the flash-attn package
@@ -123,10 +124,10 @@ def _splash_kernel(t: int, group: int, interpret: bool = False):
     # (UnexpectedTracerError). Rebuilding per trace is cheap — tracing
     # happens once per compiled program, not per step.
     key = (t, group)
-    mask = _SPLASH_KERNEL_CACHE.get(key)
+    mask = _SPLASH_MASK_CACHE.get(key)
     if mask is None:
         mask = sm.MultiHeadMask([sm.CausalMask((t, t)) for _ in range(group)])
-        _SPLASH_KERNEL_CACHE[key] = mask
+        _SPLASH_MASK_CACHE[key] = mask
 
     # Block sizes must divide the sequence length (packed rows are
     # padded to multiples of 128, so t is often e.g. 640 or 1536).
@@ -183,6 +184,77 @@ def splash_packed_attention(
     out = jax.vmap(lambda qq, kk, vv: kernel(qq, kk, vv, ids))(qh, kh, vh)
     # [Hkv, group, T, hd] -> [T, Hq, hd]
     return out.reshape(hq, t, hd).transpose(1, 0, 2).astype(q.dtype)
+
+
+def sharded_splash_attention(
+    q: jnp.ndarray,  # [R, T, Hq, hd]
+    k: jnp.ndarray,  # [R, T, Hkv, hd]
+    v: jnp.ndarray,  # [R, T, Hkv, hd]
+    segment_ids: jnp.ndarray,  # [R, T]
+    positions: jnp.ndarray,  # [R, T]
+    mesh,
+    softmax_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """splash attention under `shard_map` for GSPMD programs.
+
+    pallas_call is opaque to the SPMD partitioner — inside a sharded jit
+    it would replicate or fail (reference's analogue runs flash-attn under
+    megatron TP, realhf/impl/model/modules/attn.py:272-289). Here the
+    kernel runs per shard with an explicit layout:
+
+    - rows on (data, fsdp) — fully data-parallel,
+    - q heads on `tensor` (column-parallel qkv makes them local already),
+      kv heads likewise (requires tensor | Hkv),
+    - sequence gathered: in_specs leave T unsharded, so jit all-gathers
+      seq-sharded activations into each shard before the kernel — the
+      same collective GSPMD inserts for the einsum path's [T, T] scores.
+
+    Callers must check `sharded_splash_ok` first.
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def local_attn(q, k, v, seg, pos):
+        return jax.vmap(
+            lambda q1, k1, v1, s1, p1: splash_packed_attention(
+                q1, k1, v1, s1, p1,
+                softmax_scale=softmax_scale, interpret=interpret,
+            )
+        )(q, k, v, seg, pos)
+
+    rows = ("data", "fsdp")
+    return shard_map(
+        local_attn,
+        mesh=mesh,
+        in_specs=(
+            P(rows, None, "tensor", None),
+            P(rows, None, "tensor", None),
+            P(rows, None, "tensor", None),
+            P(rows, None),
+            P(rows, None),
+        ),
+        out_specs=P(rows, None, "tensor", None),
+        check_vma=False,
+    )(q, k, v, segment_ids, positions)
+
+
+def sharded_splash_ok(mesh, r: int, t: int, hq: int, hkv: int) -> bool:
+    """Shapes/mesh divisibility for sharded_splash_attention."""
+    names = mesh.shape
+    rows = names.get("data", 1) * names.get("fsdp", 1)
+    tensor = names.get("tensor", 1)
+    return (
+        t >= 128
+        and t % 128 == 0
+        and r % rows == 0
+        and hq % tensor == 0
+        and hkv % tensor == 0
+        and (hq // tensor) % (hkv // tensor) == 0
+    )
 
 
 def resolve_attn_impl(impl: str, t: int, hq: int, hkv: int) -> str:
