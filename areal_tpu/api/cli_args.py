@@ -148,6 +148,10 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     gen_max_seq_len: int = 4096
     gen_decode_block_steps: int = 16
     schedule_policy: str = "round_robin"
+    # rollout agent: "math-single-step" | "math-multi-turn"
+    agent_type: str = "math-single-step"
+    agent_num_turns: int = 4
+    agent_turn_discount: float = 1.0
 
 
 # ---------------------------------------------------------------------------

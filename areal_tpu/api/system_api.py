@@ -74,7 +74,9 @@ class ModelWorkerConfig:
 class ExperimentSaveEvalControl:
     """Frequency control (reference api/cli_args.py ExperimentSaveEvalControl)."""
 
-    total_train_epochs: int = 1
+    # None = inherit the experiment's top-level total_train_epochs (the
+    # documented knob); set explicitly to override it.
+    total_train_epochs: Optional[int] = None
     # Exactly one of *_freq_{epochs,steps,secs} may be set per action.
     save_freq_epochs: Optional[int] = None
     save_freq_steps: Optional[int] = None

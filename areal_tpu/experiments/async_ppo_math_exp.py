@@ -29,6 +29,35 @@ from areal_tpu.experiments import common as C
 from areal_tpu.experiments.ppo_math_exp import actor_interface_args
 
 
+def _agent_abstraction(cfg: AsyncPPOMATHExpConfig) -> AgentAbstraction:
+    """Rollout agent from config: `agent_type` picks "math-single-step"
+    (default; one group per prompt) or "math-multi-turn" (feedback loop,
+    reference math_multi_turn_agent.py)."""
+    if cfg.agent_type == "math-multi-turn":
+        return AgentAbstraction(
+            "math-multi-turn",
+            args=dict(
+                gconfig=dataclasses.asdict(cfg.ppo.gconfig.new(n=1)),
+                num_turns=cfg.agent_num_turns,
+                turn_level_discount=cfg.agent_turn_discount,
+                reward_scaling=cfg.ppo.reward_output_scaling,
+                reward_bias=cfg.ppo.reward_output_bias,
+            ),
+        )
+    return AgentAbstraction(
+        "math-single-step",
+        args=dict(
+            gconfig=dataclasses.asdict(
+                cfg.ppo.gconfig.new(n=cfg.ppo.group_size)
+            ),
+            success_rate_lb=cfg.ppo.success_rate_lb,
+            success_rate_ub=cfg.ppo.success_rate_ub,
+            reward_scaling=cfg.ppo.reward_output_scaling,
+            reward_bias=cfg.ppo.reward_output_bias,
+        ),
+    )
+
+
 def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentConfig:
     n_workers = C.resolve_n_workers(cfg)
     actor = ModelName("actor", 0)
@@ -104,6 +133,11 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
     if use_ref:
         model_topos[str(ref)] = names_
     master = C.base_master(cfg, rpcs, model_topos, n_workers)
+    # The prompt dataset lives in the rollout workers, so the master's
+    # stream dataset never reports epoch boundaries; give it the prompt
+    # count so it can derive steps-per-epoch (and terminate on
+    # total_train_epochs without benchmark_steps).
+    master.dataset_size = C.dataset_line_count(cfg.dataset)
 
     gen_servers = [
         GenerationServerConfig(
@@ -137,18 +171,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             n_rollout_workers=cfg.n_rollout_workers,
             n_pullers=n_workers,
             model_name=actor.role,
-            agent=AgentAbstraction(
-                "math-single-step",
-                args=dict(
-                    gconfig=dataclasses.asdict(
-                        cfg.ppo.gconfig.new(n=cfg.ppo.group_size)
-                    ),
-                    success_rate_lb=cfg.ppo.success_rate_lb,
-                    success_rate_ub=cfg.ppo.success_rate_ub,
-                    reward_scaling=cfg.ppo.reward_output_scaling,
-                    reward_bias=cfg.ppo.reward_output_bias,
-                ),
-            ),
+            agent=_agent_abstraction(cfg),
             env=EnvServiceAbstraction("math-code-single-step"),
             datasets=[C.dataset_abstraction(cfg.dataset)],
             tokenizer_path=cfg.tokenizer_path or cfg.actor.path,

@@ -106,18 +106,56 @@ def base_model_worker(
         dataset_dp_rank=index,
         dataset_dp_size=n_workers,
         train_batch_size=cfg.train_batch_size,
-        total_train_epochs=cfg.total_train_epochs,
+        total_train_epochs=resolved_total_train_epochs(cfg),
         seed=cfg.seed,
         stream_dataset=stream_dataset,
         n_pullers=n_workers if stream_dataset else 1,
     )
 
 
+def dataset_line_count(dataset_cfg) -> int:
+    """Number of usable samples in a jsonl prompt dataset (0 if unknown);
+    used by async experiments to size epochs master-side. math_code_prompt
+    datasets are counted through their own validator (invalid rows are
+    dropped at load, so a raw line count would overstate the epoch)."""
+    path = getattr(dataset_cfg, "path", None)
+    if not path:
+        return 0
+    try:
+        if getattr(dataset_cfg, "type_", None) == "math_code_prompt":
+            from areal_tpu.datasets.math_code_prompt import load_metadata
+
+            id2info, _ = load_metadata(path)
+            return len(id2info)
+        with open(path, "rb") as f:
+            return sum(1 for line in f if line.strip())
+    except (OSError, AssertionError):
+        return 0
+
+
+def resolved_total_train_epochs(cfg: BaseExperimentConfig) -> int:
+    """One source of truth for the epoch budget. `cfg.total_train_epochs`
+    is the documented knob (it already drives the LR schedule via
+    FinetuneSpec); `exp_ctrl.total_train_epochs` defaults to None =
+    inherit, and wins when set explicitly (including an explicit 1).
+    Previously the master stopped on the exp_ctrl copy (default 1)
+    regardless of the top-level field, so `total_train_epochs=3` trained
+    one epoch with a 3-epoch LR schedule (ADVICE r1 finding a)."""
+    if cfg.exp_ctrl.total_train_epochs is not None:
+        return cfg.exp_ctrl.total_train_epochs
+    return cfg.total_train_epochs
+
+
 def base_master(cfg: BaseExperimentConfig, rpcs, model_topos, n_workers: int) -> MasterWorkerConfig:
+    import dataclasses as _dc
+
+    exp_ctrl = _dc.replace(
+        cfg.exp_ctrl, total_train_epochs=resolved_total_train_epochs(cfg)
+    )
     return MasterWorkerConfig(
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
-        exp_ctrl=cfg.exp_ctrl,
+        exp_ctrl=exp_ctrl,
         rpcs=rpcs,
         model_topos=model_topos,
         data_hosts=worker_names(n_workers),

@@ -192,13 +192,15 @@ def _attention_block(
 
     impl = resolve_attn_impl(attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads)
     sharded = mesh is not None and mesh.size > 1
-    if sharded and impl == "splash" and not sharded_splash_ok(
-        mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads
-    ):
+    if sharded and impl != "reference":
         # Never run a bare pallas_call inside a sharded jit — GSPMD
-        # cannot partition it (it replicates or fails); the einsum
-        # reference partitions cleanly.
-        impl = "reference"
+        # cannot partition it (it replicates or fails). Only splash has a
+        # shard_map wrapping; anything else falls back to the einsum
+        # reference, which partitions cleanly.
+        if impl != "splash" or not sharded_splash_ok(
+            mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads
+        ):
+            impl = "reference"
     if sharded and impl == "splash":
         # pallas_call is opaque to GSPMD: run the kernel per shard under
         # shard_map with the megatron-equivalent layout.

@@ -57,6 +57,9 @@ class AsyncIOSequenceBuffer:
         self.ignore_ids: Set[str] = set()
         # ids fully consumed since the last epoch boundary (recover dump).
         self.consumed_this_epoch: Set[str] = set()
+        # resident duplicates skipped on put (epoch carryover); surfaced
+        # in logs so silent data-accounting drift stays visible.
+        self.n_dropped_duplicates = 0
 
     def __len__(self):
         return len(self._slots)
@@ -68,18 +71,47 @@ class AsyncIOSequenceBuffer:
     async def put_batch(self, samples: List[SequenceSample]) -> int:
         """Insert samples whose dataset keys are ready. Returns #inserted."""
         async with self._cond:
-            # Capacity-check up front so an overflow raises before any
-            # insertion (a mid-loop raise would strand inserted samples
-            # without waking consumers).
-            n_new = sum(
-                1
-                for s in samples
-                for i in range(s.bs)
-                if s.ids[i] not in self._slots and s.ids[i] not in self.ignore_ids
-            )
-            if len(self._slots) + n_new > self._max_size:
+            # Validate up front so any raise happens before insertion (a
+            # mid-loop raise would strand inserted samples without waking
+            # consumers). A duplicate id WITHIN one call is always a
+            # producer bug and raises; a duplicate of a RESIDENT id is
+            # skipped with a warning — multi-epoch training legitimately
+            # re-puts row ids whose previous-epoch copy may still await
+            # consumption (class contract above), but the skip is counted
+            # (`n_dropped_duplicates`) so accounting bugs stay visible.
+            new_ids = set()
+            resident_dups = set()
+            ignored_seen = set()
+            for s in samples:
+                for i in range(s.bs):
+                    sample_id = s.ids[i]
+                    if (
+                        sample_id in self.ignore_ids
+                        and sample_id not in ignored_seen
+                    ):
+                        # first occurrence consumes the ignore entry
+                        ignored_seen.add(sample_id)
+                        continue
+                    if sample_id in self._slots:
+                        resident_dups.add(sample_id)
+                        continue
+                    if sample_id in new_ids:
+                        raise ValueError(
+                            f"duplicate sample id {sample_id!r} within one "
+                            f"put_batch call"
+                        )
+                    new_ids.add(sample_id)
+            if resident_dups:
+                self.n_dropped_duplicates += len(resident_dups)
+                logger.warning(
+                    "skipping %d resident duplicate id(s) (epoch carryover), "
+                    "e.g. %r; total skipped: %d",
+                    len(resident_dups), next(iter(resident_dups)),
+                    self.n_dropped_duplicates,
+                )
+            if len(self._slots) + len(new_ids) > self._max_size:
                 raise RuntimeError(
-                    f"buffer overflow: {len(self._slots)} + {n_new} > "
+                    f"buffer overflow: {len(self._slots)} + {len(new_ids)} > "
                     f"max_size={self._max_size}"
                 )
             n = 0
@@ -91,8 +123,7 @@ class AsyncIOSequenceBuffer:
                         # consumed before a crash; skip exactly once
                         self.ignore_ids.discard(sample_id)
                         continue
-                    if sample_id in self._slots:
-                        logger.warning("duplicate resident id %s ignored", sample_id)
+                    if sample_id in resident_dups:
                         continue
                     self._slots[sample_id] = _Slot(
                         idx=next(self._counter),

@@ -70,6 +70,12 @@ class MasterWorker(Worker):
         self._steps_per_epoch = max(
             1, config.dataset_size // max(1, config.train_batch_size)
         ) if config.dataset_size else None
+        # Derive epoch boundaries from _steps_per_epoch only when the
+        # dataset size was configured explicitly (async experiments: the
+        # prompt dataset lives in rollout workers and the stream dataset
+        # never reports epoch_done). Sync runs get real boundaries from
+        # the dataloader; deriving there too would double-count.
+        self._derive_epoch_boundary = bool(config.dataset_size)
         self._total_steps_cap = ctl.benchmark_steps
         self._start_time = time.monotonic()
         self._init_metric_trackers()
@@ -175,6 +181,17 @@ class MasterWorker(Worker):
         stats = self.executor.execute_step_sync()
 
         epoch_boundary = self.executor.epoch_done
+        if (
+            not epoch_boundary
+            and self._derive_epoch_boundary
+            and self._steps_per_epoch
+        ):
+            # Async runs: derive the boundary from the configured prompt
+            # dataset size so epoch-based save/eval frequencies and
+            # total_train_epochs terminate them too (ADVICE r1 finding b).
+            epoch_boundary = (
+                self.step_info.epoch_step + 1 >= self._steps_per_epoch
+            )
         self.step_info.epoch_step += 1
         self.step_info.global_step += 1
         if epoch_boundary:
@@ -202,7 +219,7 @@ class MasterWorker(Worker):
         done = False
         if self._total_steps_cap is not None:
             done = self.step_info.global_step >= self._total_steps_cap
-        elif self.step_info.epoch >= self.cfg.exp_ctrl.total_train_epochs:
+        elif self.step_info.epoch >= (self.cfg.exp_ctrl.total_train_epochs or 1):
             done = True
         if done:
             self.experiment_complete_exit()

@@ -121,9 +121,16 @@ class RolloutWorker(AsyncWorker):
         act_queue: asyncio.Queue = asyncio.Queue()
 
         async def service_gen():
-            qid, prompt_ids, gconfig = await obs_queue.get()
-            bundle = await self.prm.generate_group(str(qid), prompt_ids, gconfig)
-            await act_queue.put(bundle)
+            # Serve generation requests until the agent finishes — an
+            # agent may issue any number of them (multi-turn agents issue
+            # one per turn; reference rollout_worker.py:330 loops the
+            # same way). The task is cancelled once the agent returns.
+            while True:
+                qid, prompt_ids, gconfig = await obs_queue.get()
+                bundle = await self.prm.generate_group(
+                    str(qid), prompt_ids, gconfig
+                )
+                await act_queue.put(bundle)
 
         accepted = False
         gen_task = None
@@ -134,20 +141,22 @@ class RolloutWorker(AsyncWorker):
                     prompt, self.env, obs_queue, act_queue
                 )
             )
-            # If generation fails, the agent would block on act_queue
-            # forever — watch both and cancel the agent on gen failure.
+            # service_gen never completes normally; if it finishes first
+            # it raised, and the agent would block on act_queue forever —
+            # watch both and cancel the agent on gen failure.
             done, _ = await asyncio.wait(
-                {gen_task, agent_task}, return_when=asyncio.FIRST_EXCEPTION
+                {gen_task, agent_task}, return_when=asyncio.FIRST_COMPLETED
             )
-            if gen_task in done and gen_task.exception() is not None:
+            if gen_task in done:
                 agent_task.cancel()
                 try:
                     await agent_task
                 except (asyncio.CancelledError, Exception):
                     pass
-                raise gen_task.exception()
+                raise gen_task.exception() or RuntimeError(
+                    "generation servicing exited unexpectedly"
+                )
             trajs = await agent_task
-            await gen_task
             for t in trajs:
                 self.pusher.push(data_api.sample_to_json(t))
                 self._push_count += 1

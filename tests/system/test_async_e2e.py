@@ -34,7 +34,20 @@ from tests.system.test_e2e_experiments import TINY_CFG, _mk_tokenizer_files, _wo
 
 
 @pytest.mark.slow
-def test_async_ppo_e2e(tmp_path):
+@pytest.mark.parametrize(
+    "agent_abs",
+    [
+        AgentAbstraction(
+            "math-single-step", args=dict(gconfig=dict(n=2, max_new_tokens=8))
+        ),
+        AgentAbstraction(
+            "math-multi-turn",
+            args=dict(gconfig=dict(max_new_tokens=8), num_turns=2),
+        ),
+    ],
+    ids=["single-step", "multi-turn"],
+)
+def test_async_ppo_e2e(tmp_path, agent_abs):
     exp, trial = f"e2e-async-{uuid.uuid4().hex[:6]}", "t0"
     rows, tok_dir = _mk_tokenizer_files(tmp_path)
     mc_rows = [r for r in fixtures.make_math_code_rows(12, seed=9) if r["task"] == "math"]
@@ -117,10 +130,7 @@ def test_async_ppo_e2e(tmp_path):
         worker_index=0,
         n_rollout_workers=1,
         n_pullers=1,
-        agent=AgentAbstraction(
-            "math-single-step",
-            args=dict(gconfig=dict(n=2, max_new_tokens=8)),
-        ),
+        agent=agent_abs,
         env=EnvServiceAbstraction("math-code-single-step"),
         datasets=[
             DatasetAbstraction("math_code_prompt", args=dict(dataset_path=data_path))

@@ -84,9 +84,11 @@ def test_no_duplicate_consumption():
         ids1, _ = await buf.get_batch_for_rpc(gen)
         ids2, _ = await buf.get_batch_for_rpc(gen)
         assert set(ids1) & set(ids2) == set()
-        # duplicate id is rejected
+        # resident duplicate (epoch carryover) is skipped but COUNTED
+        # (ADVICE r1 d: no silent drop)
         n = await buf.put_batch([_sample(0)])
         assert n == 0
+        assert buf.n_dropped_duplicates == 1
 
     asyncio.run(main())
 
@@ -100,3 +102,36 @@ def test_overflow_raises():
             await buf.put_batch([_sample(i) for i in range(3)])
 
     asyncio.run(main())
+
+
+def test_duplicate_id_semantics():
+    """ADVICE r1 (d): no silent drops. Resident duplicates (legal epoch
+    carryover) are skipped but counted; duplicates WITHIN one call are a
+    producer bug and raise before anything is inserted."""
+    buf = AsyncIOSequenceBuffer(_rpcs(), max_size=8)
+
+    async def run():
+        await buf.put_batch([_sample(1)])
+        n = await buf.put_batch([_sample(1)])  # resident duplicate
+        assert n == 0
+        assert buf.n_dropped_duplicates == 1
+        with pytest.raises(ValueError, match="duplicate"):
+            await buf.put_batch([_sample(2), _sample(2)])  # in-call dup
+        # the failed call must not have inserted s2
+        assert buf.size == 1
+
+    asyncio.run(run())
+
+
+def test_overflow_precheck_counts_unique_ids():
+    """ADVICE r1 (e): the capacity precheck must not overcount — filling
+    to exactly max_size succeeds."""
+    buf = AsyncIOSequenceBuffer(_rpcs(), max_size=3)
+
+    async def run():
+        await buf.put_batch([_sample(1), _sample(2), _sample(3)])
+        assert buf.size == 3
+        with pytest.raises(RuntimeError, match="overflow"):
+            await buf.put_batch([_sample(4)])
+
+    asyncio.run(run())

@@ -158,3 +158,73 @@ def test_optional_nested_dataclass_override():
     assert cfg.critic is not None
     assert cfg.critic.path == "/some/ckpt"
     assert cfg.ppo.disable_value is False
+
+
+def test_total_train_epochs_single_source_of_truth(tmp_path):
+    """ADVICE r1 (a): the top-level total_train_epochs must drive BOTH the
+    master's stop condition (exp_ctrl) and the LR schedule (FinetuneSpec),
+    not just the latter."""
+    cfg, *_ = _sft_cfg(tmp_path)
+    apply_overrides(cfg, ["total_train_epochs=3"])
+    exp = make_experiment("sft", cfg)
+    assert exp.master.exp_ctrl.total_train_epochs == 3
+    assert exp.model_workers[0].total_train_epochs == 3
+
+    # an explicitly-set exp_ctrl value wins (backward compat)
+    cfg2, *_ = _sft_cfg(tmp_path)
+    apply_overrides(
+        cfg2, ["total_train_epochs=3", "exp_ctrl.total_train_epochs=5"]
+    )
+    exp2 = make_experiment("sft", cfg2)
+    assert exp2.master.exp_ctrl.total_train_epochs == 5
+
+
+def test_async_master_gets_prompt_dataset_size(tmp_path):
+    """ADVICE r1 (b): async experiments must give the master the prompt
+    dataset size so it can derive epoch boundaries (the stream dataset
+    never reports epoch_done)."""
+    rows = fixtures.make_math_code_rows(16, seed=3)
+    texts = [r["prompt"] for r in rows]
+    tok = fixtures.train_tiny_tokenizer(texts, tmp_path)
+    tok_dir = str(tmp_path / "tok")
+    tok.save_pretrained(tok_dir)
+    data = fixtures.write_jsonl(rows, tmp_path / "prompts.jsonl")
+    acfg = AsyncPPOMATHExpConfig()
+    apply_overrides(
+        acfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+        ],
+    )
+    exp = make_experiment("async-ppo-math", acfg)
+    assert exp.master.dataset_size == 16
+
+
+def test_async_multi_turn_agent_selection(tmp_path):
+    rows = fixtures.make_sft_rows(8, seed=4)
+    texts = [r["prompt"] + " " + r["answer"] for r in rows]
+    tok = fixtures.train_tiny_tokenizer(texts, tmp_path)
+    tok_dir = str(tmp_path / "tok2")
+    tok.save_pretrained(tok_dir)
+    data = fixtures.write_jsonl(rows, tmp_path / "p2.jsonl")
+    acfg = AsyncPPOMATHExpConfig()
+    apply_overrides(
+        acfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+            "agent_type=math-multi-turn",
+            "agent_num_turns=3",
+            "agent_turn_discount=0.9",
+        ],
+    )
+    exp = make_experiment("async-ppo-math", acfg)
+    agent = exp.rollout_workers[0].agent
+    assert agent.type_ == "math-multi-turn"
+    assert agent.args["num_turns"] == 3
+    assert agent.args["turn_level_discount"] == 0.9
