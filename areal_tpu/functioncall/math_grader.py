@@ -1,16 +1,37 @@
 """Math answer verification: extraction + normalization + equivalence.
 
 Counterpart of the reference's local math grader
-(functioncall/math/function/grader.py, realhf/impl/dataset/math_parser.py)
-built from scratch: extract the final answer (\\boxed{...} or last line),
-normalize LaTeX-ish syntax, then test equivalence by exact string match,
-numeric comparison, and sympy simplification when available.
+(functioncall/math/function/grader.py:73-260 `math_equal`, and
+realhf/impl/dataset/math_parser.py) built from scratch with the same
+judging behavior:
+
+- final-answer extraction (\\boxed{...}, "the answer is ...", last number)
+- LaTeX normalization (fractions, roots, powers, text/units, spacing)
+- multiple-choice cleaning (trailing "...the answer is (C)" -> "c")
+- numeric equality at 1e-4 relative tolerance, with percentage
+  equivalence (x == y, x/100 == y, x*100 == y)
+- element-wise tuples/sets, interval answers incl. \\cup unions
+  (bracket kinds must match, endpoints compared recursively)
+- matrix answers (\\begin{pmatrix}/bmatrix), element-wise
+- equation answers ("x = 5" vs "5"), \\pm expansion
+- sympy symbolic equivalence as the last resort, run in a separate
+  process with a hard timeout (sympy.simplify can hang; reference
+  grader.py:337 call_with_timeout does the same)
 """
 
 from __future__ import annotations
 
+import multiprocessing
 import re
-from typing import List, Optional
+from typing import Any, List, Optional, Tuple
+
+SYMPY_TIMEOUT_S = 3.0
+REL_TOL = 1e-4
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
 
 
 def extract_boxed(text: str) -> Optional[str]:
@@ -46,20 +67,39 @@ def extract_answer(text: str) -> Optional[str]:
     return nums[-1] if nums else None
 
 
+def choice_clean(ans: str) -> Optional[str]:
+    """Reduce a multiple-choice answer to its letter: "(C)", "C.", "c )"
+    and trailing-choice phrasings all become "c"; None if not a choice."""
+    s = ans.strip().rstrip(".").strip()
+    m = re.fullmatch(r"\(?\s*([A-Ea-e])\s*\)?", s)
+    if m:
+        return m.group(1).lower()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
 _LATEX_STRIP = [
     (r"\\left\s*", ""), (r"\\right\s*", ""), (r"\\!", ""), (r"\\,", ""),
     (r"\\;", ""), (r"\\:", ""), (r"~", ""), (r"\\\$", ""), (r"\$", ""),
-    (r"\\%", ""), (r"%", ""), (r"\\text\{([^}]*)\}", r"\1"),
+    (r"\\text\{([^}]*)\}", r"\1"),
     (r"\\mathrm\{([^}]*)\}", r"\1"), (r"\\mbox\{([^}]*)\}", r"\1"),
     (r"\\mathbf\{([^}]*)\}", r"\1"), (r"\\operatorname\{([^}]*)\}", r"\1"),
+    (r"\\%", "%"),  # keep a bare % — _to_number reads it as a percentage
     (r"\\cdot", "*"), (r"\\times", "*"), (r"\\div", "/"),
-    (r"\\pi", "pi"), (r"\\infty", "oo"), (r"dollars?", ""), (r"degrees?", ""),
-    (r"\\circ", ""), (r"\^\{\\circ\}", ""), (r"\\ ", " "),
+    (r"\\pi", "pi"), (r"\\infty", "oo"), (r"\\infinity", "oo"),
+    (r"dollars?", ""), (r"degrees?", ""),
+    (r"\^\{\\circ\}", ""), (r"\^\\circ", ""), (r"\\circ", ""),
+    (r"\\ ", " "),
 ]
 
 
 def normalize_answer(ans: str) -> str:
     s = ans.strip()
+    # Protect matrix row separators (\\) from the single-backslash rules.
+    s = s.replace("\\\\", "\x00ROW\x00")
     for pat, rep in _LATEX_STRIP:
         s = re.sub(pat, rep, s)
     # \frac{a}{b} -> (a)/(b); \sqrt{a} -> sqrt(a); x^{y} -> x**(y)
@@ -70,30 +110,127 @@ def normalize_answer(ans: str) -> str:
         s = re.sub(r"\\sqrt(\d)", r"sqrt(\1)", s)
         s = re.sub(r"\^\{([^{}]*)\}", r"**(\1)", s)
     s = s.replace("^", "**")
-    s = s.replace("{", "(").replace("}", ")")
-    s = re.sub(r"\\([a-zA-Z]+)", r"\1", s)  # remaining latex commands
+    # keep matrix markers; everything else: braces -> parens
+    parts = re.split(r"(\\(?:begin|end)\{(?:p|b)matrix\})", s)
+    parts = [
+        p if p.startswith("\\begin") or p.startswith("\\end")
+        else p.replace("{", "(").replace("}", ")")
+        for p in parts
+    ]
+    s = "".join(parts)
+    s = re.sub(r"\\(?!(begin|end|cup|pm)\b)([a-zA-Z]+)", r"\2", s)
+    s = s.replace("\x00ROW\x00", "\\\\")
     s = re.sub(r"\s+", "", s)
     s = s.rstrip(".").lstrip("+")
-    # 1,234 -> 1234 (but keep tuple-like "(1,2)")
+    # thousands separators: 1,234 / 1,000,000 -> digits (comma followed by
+    # exactly three digits); bare pairs like "1,2" stay tuples
     if "(" not in s and "[" not in s:
-        s = re.sub(r"(\d),(\d)", r"\1\2", s)
+        while re.search(r"\d,\d{3}(\D|$)", s):
+            s = re.sub(r"(\d),(\d{3})(\D|$)", r"\1\2\3", s)
     return s.lower()
 
 
+# ---------------------------------------------------------------------------
+# Structured comparisons
+# ---------------------------------------------------------------------------
+
+
 def _to_number(s: str) -> Optional[float]:
+    s = s.strip()
+    pct = False
+    if s.endswith("%"):
+        pct = True
+        s = s[:-1]
     try:
-        return float(s)
+        v = float(s)
+        return v / 100.0 if pct else v
     except ValueError:
         pass
     m = re.fullmatch(r"\(?\(?(-?\d+(?:\.\d+)?)\)?/\(?(-?\d+(?:\.\d+)?)\)?\)?", s)
     if m:
         denom = float(m.group(2))
         if denom != 0:
-            return float(m.group(1)) / denom
+            v = float(m.group(1)) / denom
+            return v / 100.0 if pct else v
     return None
 
 
-def _sympy_equal(a: str, b: str) -> bool:
+def _numeric_equal(a: float, b: float) -> bool:
+    return abs(a - b) <= REL_TOL * max(1.0, abs(a), abs(b))
+
+
+def _numeric_equal_with_percent(a: float, b: float) -> bool:
+    """Reference grader.py:101: a answer may be given as a percentage of
+    the reference (or vice versa)."""
+    return any(
+        _numeric_equal(a, c) for c in (b, b / 100.0, b * 100.0)
+    )
+
+
+def _split_top_level_commas(s: str) -> List[str]:
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _parse_intervals(s: str) -> Optional[List[Tuple[str, str, str, str]]]:
+    """"[2,5)\\cup(7,oo)" -> [("[", "2", "5", ")"), ...]; None if not an
+    interval expression. Endpoints may contain balanced parens (e.g.
+    normalized fractions)."""
+    parts = re.split(r"\\cup|∪", s)
+    out = []
+    for p in parts:
+        p = p.strip()
+        if len(p) < 4 or p[0] not in "([" or p[-1] not in ")]":
+            return None
+        inner = _split_top_level_commas(p[1:-1])
+        if len(inner) != 2 or not inner[0] or not inner[1]:
+            return None
+        out.append((p[0], inner[0], inner[1], p[-1]))
+    return out if out else None
+
+
+def _parse_matrix(s: str) -> Optional[List[List[str]]]:
+    m = re.match(
+        r"^\\begin\{(?:p|b)matrix\}(.*)\\end\{(?:p|b)matrix\}$", s, re.DOTALL
+    )
+    if not m:
+        return None
+    body = m.group(1)
+    rows = [r for r in re.split(r"\\\\", body) if r.strip()]
+    return [[c.strip() for c in row.split("&")] for row in rows]
+
+
+def _strip_equation_lhs(s: str) -> str:
+    """"x=5" -> "5" when the LHS is a bare variable."""
+    m = re.match(r"^[a-z][a-z0-9_]{0,3}=(.+)$", s)
+    return m.group(1) if m else s
+
+
+def _expand_pm(s: str) -> Optional[Tuple[str, str]]:
+    if "\\pm" in s:
+        return s.replace("\\pm", "+", 1), s.replace("\\pm", "-", 1)
+    if "±" in s:
+        return s.replace("±", "+", 1), s.replace("±", "-", 1)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Sympy symbolic equivalence (timeout-guarded subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _sympy_equal_raw(a: str, b: str) -> bool:
     try:
         import sympy
         from sympy.parsing.sympy_parser import (
@@ -105,35 +242,147 @@ def _sympy_equal(a: str, b: str) -> bool:
         tf = standard_transformations + (implicit_multiplication_application,)
         ea = parse_expr(a, transformations=tf, evaluate=True)
         eb = parse_expr(b, transformations=tf, evaluate=True)
+        if ea == eb:
+            return True
+        # numeric fallback before the expensive simplify
+        try:
+            if abs(float(ea.evalf()) - float(eb.evalf())) < 1e-6:
+                return True
+        except Exception:
+            pass
         return bool(sympy.simplify(ea - eb) == 0)
     except Exception:
         return False
 
 
-def answers_equal(given: str, reference: str, tol: float = 1e-6) -> bool:
-    ng, nr = normalize_answer(given), normalize_answer(reference)
+def _sympy_worker(a: str, b: str, q) -> None:
+    q.put(_sympy_equal_raw(a, b))
+
+
+def _sympy_equal(a: str, b: str, timeout: float = SYMPY_TIMEOUT_S) -> bool:
+    """sympy equivalence in a child process with a hard timeout —
+    simplify() can hang on adversarial model outputs, and a stuck reward
+    stalls the whole rollout pipeline (reference grader.py:337)."""
+    if len(a) > 400 or len(b) > 400:  # refuse adversarially long inputs
+        return False
+    ctx = multiprocessing.get_context("fork")
+    q = ctx.Queue(1)
+    p = ctx.Process(target=_sympy_worker, args=(a, b, q), daemon=True)
+    try:
+        p.start()
+        p.join(timeout)
+        if p.is_alive():
+            p.terminate()
+            p.join(1.0)
+            return False
+        return bool(q.get_nowait()) if not q.empty() else False
+    except Exception:
+        return False
+    finally:
+        if p.is_alive():
+            p.kill()
+
+
+# ---------------------------------------------------------------------------
+# Top-level equivalence
+# ---------------------------------------------------------------------------
+
+
+def answers_equal(given: str, reference: str, tol: float = REL_TOL,
+                  _depth: int = 0) -> bool:
+    if _depth > 4:
+        return False
+    ng, nr = normalize_answer(str(given)), normalize_answer(str(reference))
     if not ng and not nr:
         return True
     if ng == nr:
         return True
+
+    # multiple choice: compare letters when the reference is a choice
+    cg, cr = choice_clean(str(given)), choice_clean(str(reference))
+    if cr is not None and cg is not None:
+        return cg == cr
+
+    # numbers (incl. percentage equivalence)
     fg, fr = _to_number(ng), _to_number(nr)
     if fg is not None and fr is not None:
-        return abs(fg - fr) <= tol * max(1.0, abs(fr))
-    # Tuple/set-like answers: compare element-wise.
+        return _numeric_equal_with_percent(fg, fr)
+
+    # \pm expands to an unordered pair
+    for s, other, flip in ((str(given), nr, False), (str(reference), ng, True)):
+        pm = _expand_pm(s)
+        if pm is not None:
+            plus, minus = pm
+            both = "(" + plus + "," + minus + ")"
+            return answers_equal(
+                both if not flip else other,
+                other if not flip else both,
+                tol, _depth + 1,
+            )
+
+    # intervals (bracket kinds must match; endpoints compared recursively).
+    # Only binding when BOTH sides parse as intervals — "(1,2)" is also a
+    # valid tuple, and a bare "1,2" reference must still match it below.
+    ig, ir = _parse_intervals(ng), _parse_intervals(nr)
+    if ig is not None and ir is not None:
+        if len(ig) != len(ir):
+            return False
+        return all(
+            lg == lr and hg == hr
+            and answers_equal(ag, ar, tol, _depth + 1)
+            and answers_equal(bg, br, tol, _depth + 1)
+            for (lg, ag, bg, hg), (lr, ar, br, hr) in zip(ig, ir)
+        )
+
+    # matrices, element-wise; a matrix vs a tuple/list compares flattened
+    # (reference grader.py:60 str_to_pmatrix upgrades "{1,2}" answers)
+    mg, mr = _parse_matrix(ng), _parse_matrix(nr)
+    if mg is not None and mr is not None:
+        if len(mg) != len(mr) or any(
+            len(a) != len(b) for a, b in zip(mg, mr)
+        ):
+            return False
+        return all(
+            answers_equal(a, b, tol, _depth + 1)
+            for ra, rb in zip(mg, mr)
+            for a, b in zip(ra, rb)
+        )
+    if (mg is None) != (mr is None):
+        flat_m = [c for row in (mg or mr) for c in row]
+        other = ng if mg is None else nr
+        parts = [p for p in re.split(r"[(),\[\]]", other) if p]
+        if len(parts) == len(flat_m):
+            return all(
+                answers_equal(a, b, tol, _depth + 1)
+                for a, b in zip(flat_m, parts)
+            )
+        return False
+
+    # equations: strip a bare-variable LHS from either side
+    sg, sr = _strip_equation_lhs(ng), _strip_equation_lhs(nr)
+    if (sg, sr) != (ng, nr):
+        return answers_equal(sg, sr, tol, _depth + 1)
+
+    # tuple/set-like answers: compare element-wise
     if ("," in ng) and ("," in nr):
         pg = [p for p in re.split(r"[(),\[\]]", ng) if p]
         pr = [p for p in re.split(r"[(),\[\]]", nr) if p]
-        if len(pg) == len(pr):
-            return all(answers_equal(x, y, tol) for x, y in zip(pg, pr))
+        if len(pg) == len(pr) and pg:
+            return all(
+                answers_equal(x, y, tol, _depth + 1)
+                for x, y in zip(pg, pr)
+            )
+
     return _sympy_equal(ng, nr)
 
 
-def grade_answer(solution_text: str, reference_answer: str) -> bool:
+def grade_answer(solution_text: str, reference_answer: Any) -> bool:
     """True if the final answer in `solution_text` matches the reference."""
-    ans = extract_answer(solution_text)
+    ans = extract_answer(str(solution_text))
     if ans is None:
         return False
-    refs: List[str] = (
-        [reference_answer] if isinstance(reference_answer, str) else list(reference_answer)
-    )
+    if isinstance(reference_answer, (list, tuple, set)):
+        refs = list(reference_answer)
+    else:  # str, int, float, ... — answers_equal str()s its inputs
+        refs = [reference_answer]
     return any(answers_equal(ans, r) for r in refs)

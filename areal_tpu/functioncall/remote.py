@@ -1,0 +1,137 @@
+"""Remote verifier-service client: batched async HTTP with retries.
+
+Counterpart of the reference's remote functioncall client
+(functioncall/base/call.py:81-240 — async_invoke_function with
+exponential backoff, batch_function_call_async with a concurrency
+semaphore, and the FUNCTIONCALL_SERVICE_DOMAIN switch in
+math_rw_interface.py:37-39), built from scratch.
+
+Service contract (same as the reference's verifier service): POST
+`{domain}/{task}_verify` with a JSON list of payloads
+`{"uid", "solution", "answer"/"test_cases"}`, response is a JSON list of
+`{"uid", "success": bool}` in any order. A payload whose verification
+ultimately fails (exhausted retries, malformed response) scores False —
+a reward must never take the trainer down.
+
+Enable by setting FUNCTIONCALL_SERVICE_DOMAIN (e.g.
+"http://verifier.internal:8080"); when unset, `remote_enabled()` is
+False and callers use the local verifiers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import logging as areal_logging
+
+logger = areal_logging.getLogger("functioncall.remote")
+
+ENV_DOMAIN = "FUNCTIONCALL_SERVICE_DOMAIN"
+DEFAULT_TIMEOUT_S = 60.0
+MAX_RETRIES = 3
+INITIAL_RETRY_S = 0.5
+MAX_RETRY_S = 10.0
+DEFAULT_CONCURRENCY = 256
+DEFAULT_BATCH_SIZE = 64
+
+
+def service_domain() -> Optional[str]:
+    return os.environ.get(ENV_DOMAIN) or None
+
+
+def remote_enabled() -> bool:
+    return service_domain() is not None
+
+
+async def _post_with_retries(
+    session, url: str, batch: List[Dict], timeout_s: float
+) -> List[Dict]:
+    import aiohttp
+
+    delay = INITIAL_RETRY_S
+    last_err: Optional[BaseException] = None
+    for attempt in range(MAX_RETRIES + 1):
+        try:
+            async with session.post(
+                url, json=batch,
+                timeout=aiohttp.ClientTimeout(total=timeout_s),
+            ) as resp:
+                if resp.status >= 500:
+                    raise RuntimeError(f"server error {resp.status}")
+                resp.raise_for_status()
+                out = await resp.json()
+                if not isinstance(out, list):
+                    raise ValueError(f"malformed response: {type(out)}")
+                return out
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — every failure retries
+            last_err = e
+            if attempt == MAX_RETRIES:
+                break
+            sleep_s = min(delay * (2 ** attempt) + random.uniform(0, 0.5),
+                          MAX_RETRY_S)
+            logger.warning(
+                f"verifier call failed (attempt {attempt + 1}/"
+                f"{MAX_RETRIES + 1}): {e!r}; retrying in {sleep_s:.1f}s"
+            )
+            await asyncio.sleep(sleep_s)
+    logger.error(f"verifier batch failed permanently: {last_err!r}")
+    return []
+
+
+async def batch_verify_async(
+    payloads: List[Dict[str, Any]],
+    task: str,
+    domain: Optional[str] = None,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+    concurrency: int = DEFAULT_CONCURRENCY,
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> List[bool]:
+    """Verify payloads against `{domain}/{task}_verify`, split into
+    batches under a concurrency cap. Returns per-payload success aligned
+    with the input order; failed/missing entries are False."""
+    import aiohttp
+
+    domain = domain or service_domain()
+    assert domain, f"{ENV_DOMAIN} not configured"
+    url = f"{domain.rstrip('/')}/{task}_verify"
+    for i, p in enumerate(payloads):
+        p.setdefault("uid", str(i))
+
+    sem = asyncio.Semaphore(concurrency)
+    results: Dict[str, bool] = {}
+
+    async with aiohttp.ClientSession(
+        connector=aiohttp.TCPConnector(limit=concurrency)
+    ) as session:
+
+        async def one_batch(batch: List[Dict]):
+            async with sem:
+                out = await _post_with_retries(session, url, batch, timeout_s)
+            for entry in out:
+                if isinstance(entry, dict) and "uid" in entry:
+                    results[str(entry["uid"])] = bool(entry.get("success"))
+
+        batches = [
+            payloads[i : i + batch_size]
+            for i in range(0, len(payloads), batch_size)
+        ]
+        await asyncio.gather(*[one_batch(b) for b in batches])
+
+    return [results.get(str(p["uid"]), False) for p in payloads]
+
+
+def batch_verify(
+    payloads: List[Dict[str, Any]],
+    task: str,
+    domain: Optional[str] = None,
+    timeout_s: float = DEFAULT_TIMEOUT_S,
+) -> List[bool]:
+    """Sync wrapper (used from the reward interface's thread pool)."""
+    return asyncio.run(
+        batch_verify_async(payloads, task, domain=domain, timeout_s=timeout_s)
+    )

@@ -40,6 +40,32 @@ class MultiTaskRewardInterface(ModelInterface):
             return code_verify(text, cases)
         return grade_answer(text, answer_info)
 
+    def _verify_all(self, jobs: List[tuple]) -> List[bool]:
+        """Verify (task, text, answer) jobs — against the remote verifier
+        service when FUNCTIONCALL_SERVICE_DOMAIN is set (batched, with
+        retries; reference math_rw_interface.py:37-39), local verifiers
+        otherwise."""
+        from areal_tpu.functioncall import remote
+
+        if remote.remote_enabled():
+            oks: List[bool] = [False] * len(jobs)
+            by_task: Dict[str, List[int]] = {}
+            for i, (task, _, _) in enumerate(jobs):
+                by_task.setdefault(task, []).append(i)
+            for task, idxs in by_task.items():
+                payloads = []
+                for i in idxs:
+                    _, text, answer = jobs[i]
+                    key = "test_cases" if task == "code" else "answer"
+                    payloads.append({"uid": str(i), "solution": text, key: answer})
+                results = remote.batch_verify(payloads, task)
+                for i, ok in zip(idxs, results):
+                    oks[i] = ok
+            return oks
+
+        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
+            return list(ex.map(lambda args: self._verify_one(*args), jobs))
+
     def inference(
         self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
     ) -> SequenceSample:
@@ -64,16 +90,11 @@ class MultiTaskRewardInterface(ModelInterface):
         if answers is None:
             raise ValueError("reward interface needs 'solutions'/'answers' metadata")
 
-        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
-            oks = list(
-                ex.map(
-                    lambda args: self._verify_one(*args),
-                    [
-                        (tasks[pi], texts[si], answers[pi])
-                        for si, pi in enumerate(seq_prompt_ids)
-                    ],
-                )
-            )
+        jobs = [
+            (tasks[pi], texts[si], answers[pi])
+            for si, pi in enumerate(seq_prompt_ids)
+        ]
+        oks = self._verify_all(jobs)
         rewards = np.where(
             np.asarray(oks), self.correct_reward, self.wrong_reward
         ).astype(np.float32)

@@ -1,0 +1,94 @@
+"""Code verifier tests (reference functioncall/code/testing_util.py
+behaviors: stdin/stdout + call-based styles, per-case limits, sandboxing)."""
+
+import json
+import time
+
+import pytest
+
+from areal_tpu.functioncall.code_verify import (
+    code_verify,
+    extract_code_block,
+    run_test_cases,
+)
+
+STDIN_SOLUTION = """Here is my solution:
+```python
+n = int(input())
+print(n * 2)
+```
+"""
+
+CALL_SOLUTION = """```python
+def add(a, b):
+    return a + b
+```"""
+
+CLASS_SOLUTION = """```python
+class Solution:
+    def twice(self, x):
+        return [v * 2 for v in x]
+```"""
+
+
+def test_stdin_style_pass_and_fail():
+    cases = {"inputs": ["3\n", "10\n"], "outputs": ["6\n", "20\n"]}
+    assert code_verify(STDIN_SOLUTION, cases)
+    bad = {"inputs": ["3\n"], "outputs": ["7\n"]}
+    assert not code_verify(STDIN_SOLUTION, bad)
+
+
+def test_stdin_wire_format_as_string():
+    cases = json.dumps({"inputs": ["4\n"], "outputs": ["8\n"]})
+    assert code_verify(STDIN_SOLUTION, cases)
+
+
+def test_float_tolerant_stdout():
+    sol = "```python\nprint(0.1 + 0.2)\n```"
+    assert code_verify(sol, [{"input": "", "output": "0.3\n"}])
+
+
+def test_call_based_function():
+    cases = {"inputs": [[1, 2], [5, -3]], "outputs": [3, 2], "fn_name": "add"}
+    assert code_verify(CALL_SOLUTION, cases)
+    bad = {"inputs": [[1, 2]], "outputs": [4], "fn_name": "add"}
+    assert not code_verify(CALL_SOLUTION, bad)
+
+
+def test_call_based_solution_class():
+    cases = {
+        "inputs": [[[1, 2, 3]]],
+        "outputs": [[2, 4, 6]],
+        "fn_name": "twice",
+    }
+    assert code_verify(CLASS_SOLUTION, cases)
+
+
+def test_per_case_results_and_cap():
+    cases = {"inputs": ["1\n", "2\n", "3\n"], "outputs": ["2\n", "5\n", "6\n"]}
+    res = run_test_cases(STDIN_SOLUTION, cases)
+    assert res == [True, False, True]
+    assert len(run_test_cases(STDIN_SOLUTION, cases, max_cases=2)) == 2
+
+
+def test_timeout_kills_infinite_loop():
+    sol = "```python\nwhile True:\n    pass\n```"
+    t0 = time.monotonic()
+    assert not code_verify(sol, [{"input": "", "output": ""}], timeout=2.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_no_code_block_fails_all():
+    res = run_test_cases("no code here", {"inputs": ["1"], "outputs": ["1"]})
+    assert res == [False]
+
+
+def test_sandbox_blocks_os_system():
+    sol = "```python\nimport os\nos.system('echo pwned')\nprint('done')\n```"
+    # os.system is None'd by the guard preamble -> TypeError -> case fails
+    assert not code_verify(sol, [{"input": "", "output": "done\n"}])
+
+
+def test_extract_code_block_picks_last():
+    text = "```python\nprint(1)\n```\nand\n```python\nprint(2)\n```"
+    assert extract_code_block(text) == "print(2)\n"

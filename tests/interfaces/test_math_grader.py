@@ -62,3 +62,106 @@ def test_grade_answer_end_to_end():
 
 def test_grade_multiple_refs():
     assert grade_answer(r"\boxed{2}", ["1", "2"])
+
+
+# ---------------------------------------------------------------------------
+# Hardened grader vectors (behavior parity with the reference's
+# functioncall/math/function/grader.py math_equal)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        # percentages
+        ("50%", "0.5"),
+        ("0.5", "50%"),
+        ("12.5%", "1/8"),
+        ("150", "1.5"),  # x*100 == y form
+        # intervals
+        ("[2,5)", "[2, 5)"),
+        (r"[1,\infty)", r"[1, \infty)"),
+        (r"(-\infty,3]\cup(7,9)", r"(-\infty, 3] \cup (7, 9)"),
+        ("[0.5,1)", r"[\frac{1}{2}, 1)"),
+        # matrices
+        (
+            r"\begin{pmatrix}1&2\\3&4\end{pmatrix}",
+            r"\begin{pmatrix} 1 & 2 \\ 3 & 4 \end{pmatrix}",
+        ),
+        (
+            r"\begin{bmatrix}1/2&0\\0&1\end{bmatrix}",
+            r"\begin{pmatrix}0.5&0\\0&1\end{pmatrix}",
+        ),
+        # equations
+        ("x=5", "5"),
+        ("y = 2x + 3", "2x+3"),
+        # plus-minus
+        (r"2\pm\sqrt{3}", r"2 \pm \sqrt{3}"),
+        # choices
+        ("(C)", "C"),
+        ("b.", "B"),
+    ],
+)
+def test_answers_equal_hardened(a, b):
+    assert answers_equal(a, b)
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        ("[2,5)", "(2,5)"),      # bracket kind differs
+        ("[2,5)", "[2,6)"),      # endpoint differs
+        (r"\begin{pmatrix}1&2\end{pmatrix}",
+         r"\begin{pmatrix}1&3\end{pmatrix}"),
+        ("x=5", "6"),
+        ("(A)", "B"),
+        ("50%", "0.6"),
+    ],
+)
+def test_answers_not_equal_hardened(a, b):
+    assert not answers_equal(a, b)
+
+
+def test_sympy_timeout_on_adversarial_input():
+    """A pathological expression must return (False) within the timeout
+    budget, not hang the reward pipeline."""
+    import time
+
+    t0 = time.monotonic()
+    # deeply nested powers: sympy.simplify may take extremely long
+    bad = "(x+1)**(x**(x**(x**9)))" + "+1" * 120
+    result = answers_equal(bad, "q+z")
+    assert result is False
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_pm_expansion_matches_pair():
+    assert answers_equal(r"1\pm2", "(3,-1)")
+    assert not answers_equal(r"1\pm2", "(3,0)")
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        (r"50\%", "50"),        # latex percent vs plain
+        (r"50\%", "0.5"),
+        ("(1,2)", "1,2"),       # tuple vs bare pair
+        (r"\begin{pmatrix}1\\2\end{pmatrix}", "(1,2)"),  # vector vs tuple
+    ],
+)
+def test_review_regressions_equal(a, b):
+    assert answers_equal(a, b)
+
+
+def test_grade_numeric_reference():
+    assert grade_answer(r"\boxed{42}", 42)
+    assert not grade_answer(r"\boxed{41}", 42)
+
+
+def test_code_verify_stops_on_first_failure():
+    from areal_tpu.functioncall.code_verify import run_test_cases
+
+    sol = "```python\nn=int(input())\nprint(n)\n```"
+    cases = {"inputs": ["1\n", "2\n", "3\n"], "outputs": ["9\n", "2\n", "3\n"]}
+    res = run_test_cases(sol, cases, stop_on_first_failure=True)
+    assert res == [False, False, False]

@@ -1,0 +1,111 @@
+"""Remote verifier client: batching, retries, failure handling
+(reference functioncall/base/call.py behaviors)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from areal_tpu.functioncall import remote
+
+
+class StubVerifier(BaseHTTPRequestHandler):
+    fail_first = 0  # class-level: number of requests to 500 first
+    seen_batches = []
+
+    def do_POST(self):
+        cls = type(self)
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        cls.seen_batches.append(body)
+        if cls.fail_first > 0:
+            cls.fail_first -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        out = [
+            {"uid": p["uid"], "success": p["solution"] == "good"} for p in body
+        ]
+        payload = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def stub_server():
+    StubVerifier.fail_first = 0
+    StubVerifier.seen_batches = []
+    srv = HTTPServer(("127.0.0.1", 0), StubVerifier)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_batch_verify_order_and_batching(stub_server):
+    payloads = [
+        {"solution": "good" if i % 3 else "bad"} for i in range(10)
+    ]
+    res = remote.batch_verify(payloads, "math", domain=stub_server)
+    assert res == [bool(i % 3) for i in range(10)]
+
+
+def test_batch_verify_splits_batches(stub_server, monkeypatch):
+    monkeypatch.setattr(remote, "DEFAULT_BATCH_SIZE", 4)
+    payloads = [{"solution": "good"} for _ in range(10)]
+    import asyncio
+    res = asyncio.run(
+        remote.batch_verify_async(
+            payloads, "math", domain=stub_server, batch_size=4
+        )
+    )
+    assert res == [True] * 10
+    assert len(StubVerifier.seen_batches) == 3  # 4 + 4 + 2
+
+
+def test_batch_verify_retries_on_500(stub_server, monkeypatch):
+    monkeypatch.setattr(remote, "INITIAL_RETRY_S", 0.01)
+    StubVerifier.fail_first = 1
+    res = remote.batch_verify(
+        [{"solution": "good"}], "math", domain=stub_server
+    )
+    assert res == [True]
+    assert len(StubVerifier.seen_batches) == 2  # the 500 + the retry
+
+
+def test_unreachable_service_scores_false(monkeypatch):
+    monkeypatch.setattr(remote, "INITIAL_RETRY_S", 0.01)
+    monkeypatch.setattr(remote, "MAX_RETRIES", 1)
+    res = remote.batch_verify(
+        [{"solution": "x"}], "math",
+        domain="http://127.0.0.1:1", timeout_s=1.0,
+    )
+    assert res == [False]
+
+
+def test_env_switch(monkeypatch):
+    monkeypatch.delenv(remote.ENV_DOMAIN, raising=False)
+    assert not remote.remote_enabled()
+    monkeypatch.setenv(remote.ENV_DOMAIN, "http://x")
+    assert remote.remote_enabled()
+
+
+def test_reward_interface_uses_remote_when_enabled(stub_server, monkeypatch):
+    """MultiTaskRewardInterface._verify_all dispatches to the remote
+    service when FUNCTIONCALL_SERVICE_DOMAIN is set."""
+    from areal_tpu.interfaces.reward import MultiTaskRewardInterface
+
+    monkeypatch.setenv(remote.ENV_DOMAIN, stub_server)
+    iface = MultiTaskRewardInterface()
+    oks = iface._verify_all(
+        [("math", "good", "1"), ("math", "bad", "2"), ("code", "good", "[]")]
+    )
+    assert oks == [True, False, True]
+    # one batch per task family
+    assert len(StubVerifier.seen_batches) == 2
