@@ -306,7 +306,17 @@ class JaxTrainEngine(TrainEngine):
             opt_state = jax.lax.with_sharding_constraint(
                 opt_state, self._opt_shardings
             )
-            return params, opt_state, loss_sum, gnorm, aux
+            # Pack every scalar stat into ONE f32 vector: the host then
+            # needs a single device fetch per step (per-leaf fetches are
+            # serial round trips — ~75 ms each on tunneled devices). The
+            # raw aux pytree is also returned — never fetched — purely so
+            # the host can read its key structure.
+            aux_leaves = jax.tree_util.tree_leaves(aux)
+            packed = jnp.stack(
+                [loss_sum.astype(jnp.float32), gnorm.astype(jnp.float32)]
+                + [a.astype(jnp.float32) for a in aux_leaves]
+            )
+            return params, opt_state, packed, aux
 
         self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 1))
         return self._jit_cache[key]
@@ -329,6 +339,73 @@ class JaxTrainEngine(TrainEngine):
             stacked[k] = np.stack(arrs, axis=0)
         return stacked
 
+    @staticmethod
+    def _dp_token_weights(rows_np: Dict[str, np.ndarray]) -> np.ndarray:
+        """Host-side per-token loss weights used to build the per-shard
+        denominators for 'dp' normalization. Mirrors what the standard
+        losses weight by: the shifted response mask for SFT/PPO batches
+        (interfaces/ppo.response_scoring_mask), or an explicit loss_mask."""
+        seg = np.asarray(rows_np["segment_ids"])
+        pm = rows_np.get("prompt_mask")
+        if pm is not None:
+            pm = np.asarray(pm)
+            next_seg = np.concatenate(
+                [seg[..., 1:], np.zeros_like(seg[..., :1])], axis=-1
+            )
+            next_pm = np.concatenate(
+                [pm[..., 1:], np.ones_like(pm[..., :1])], axis=-1
+            )
+            return ((next_seg == seg) & (seg > 0) & (next_pm == 0)).astype(
+                np.float32
+            )
+        lm = rows_np.get("loss_mask")
+        if lm is None:
+            raise ValueError(
+                "token_normalize_scope='dp' needs per-token loss weights: "
+                "rows must carry 'prompt_mask' or 'loss_mask', or pass "
+                "dp_token_weights_fn to train_batch"
+            )
+        return np.asarray(lm, np.float32)
+
+    def _apply_dp_token_scale(
+        self,
+        rows_np: Dict[str, np.ndarray],
+        global_denom: float,
+        dp_token_weights_fn=None,
+    ) -> Dict[str, np.ndarray]:
+        """Inject a 'dp_loss_scale' rows key so global normalization equals
+        per-dp-shard normalization (see train_batch docstring). Rows are
+        sharded over (data, fsdp) in contiguous chunks; shard s's
+        denominator D_s sums its loss weights across every micro-batch
+        (the reference's per-rank denominator spans the rank's whole
+        step). Losses multiply this scale into their token mask."""
+        n = self._n_row_multiple
+        if n <= 1:
+            return rows_np  # one shard: 'dp' == 'global'
+        w = (
+            dp_token_weights_fn(rows_np)
+            if dp_token_weights_fn is not None
+            else self._dp_token_weights(rows_np)
+        ).astype(np.float32)
+        r_axis = w.ndim - 2  # [R, T] or [n_mbs, R, T]
+        R = w.shape[r_axis]
+        per_shard = w.reshape(
+            w.shape[:r_axis] + (n, R // n) + w.shape[r_axis + 1:]
+        )
+        # D_s: sum over everything except the shard axis.
+        axes = tuple(i for i in range(per_shard.ndim) if i != r_axis)
+        d_s = np.maximum(per_shard.sum(axis=axes), 1.0)  # [n]
+        scale = global_denom / (n * d_s)  # [n]
+        shape = [1] * per_shard.ndim
+        shape[r_axis] = n
+        scale_rows = np.broadcast_to(
+            scale.reshape(shape),
+            per_shard.shape,
+        ).reshape(w.shape).astype(np.float32)
+        out = dict(rows_np)
+        out["dp_loss_scale"] = np.ascontiguousarray(scale_rows)
+        return out
+
     def train_batch(
         self,
         input_: SequenceSample,
@@ -338,6 +415,7 @@ class JaxTrainEngine(TrainEngine):
         token_normalize_scope: str = "global",
         version_steps: int = 0,
         loss_name: str = "loss",
+        dp_token_weights_fn=None,
     ) -> Dict[str, float]:
         """Forward+backward over micro-batches, one optimizer step — all
         inside a single donated jitted program (no host sync until the
@@ -345,24 +423,21 @@ class JaxTrainEngine(TrainEngine):
 
         `version_steps` is accepted for TrainEngine API parity but the LR
         schedule position is tracked by the optimizer's own step count.
-        `token_normalize_scope='dp'` (the reference's per-rank
-        normalization: mean over ranks of grad_r/tokens_r) is accepted but
-        executed as 'global' (sum_r grad_r / sum_r tokens_r): under GSPMD
-        there are no per-rank loss programs to normalize separately. The
-        two differ when shards carry unequal token counts, so a warning is
-        logged once.
+
+        `token_normalize_scope='dp'` reproduces the reference's per-rank
+        normalization (mean over dp ranks of grad_r / tokens_r,
+        realhf/impl/model/interface/ppo_interface.py:253) under GSPMD:
+        there is one global program, so instead of per-rank programs the
+        engine injects a `dp_loss_scale` rows key — a token in row-shard
+        s gets scale D_global / (n_shards * D_s) — which loss_fns
+        multiply into their per-token mask; the global 1/D_global
+        normalization then equals mean_s(grad_s / D_s) exactly (valid
+        because every loss is linear in its per-token weights). D_s comes
+        from `dp_token_weights_fn(rows)` when given, else from the
+        standard response mask / loss_mask (_dp_token_weights).
         """
         assert self.optimizer is not None, "engine built without optimizer"
-        if token_normalize_scope == "dp":
-            if not getattr(self, "_warned_dp_scope", False):
-                self._warned_dp_scope = True
-                logger.warning(
-                    "token_normalize_scope='dp' is executed as 'global' on a "
-                    "GSPMD mesh (one global program, no per-rank denominators); "
-                    "gradients differ from the reference's 'dp' when shards "
-                    "have unequal token counts"
-                )
-        elif token_normalize_scope != "global":
+        if token_normalize_scope not in ("global", "dp"):
             raise ValueError(
                 f"unknown token_normalize_scope {token_normalize_scope!r}"
             )
@@ -380,6 +455,10 @@ class JaxTrainEngine(TrainEngine):
         else:
             rows_np = all_rows[0]
             sharding = self._batch_sharding
+        if token_normalize_scope == "dp":
+            rows_np = self._apply_dp_token_scale(
+                rows_np, global_denom, dp_token_weights_fn
+            )
         rows_dev = {
             k: jax.device_put(np.asarray(v), sharding) for k, v in rows_np.items()
         }
@@ -387,23 +466,28 @@ class JaxTrainEngine(TrainEngine):
         step = self._train_step_fn(
             loss_name, loss_fn, tuple(sorted(rows_np.keys())), len(mbs)
         )
-        self.params, self.opt_state, loss_sum, gnorm, aux = step(
+        self.params, self.opt_state, packed, aux = step(
             self.params, self.opt_state, rows_dev,
             jnp.asarray(1.0 / global_denom, jnp.float32),
         )
         if self._serial_dispatch:
             jax.block_until_ready(self.params)
 
-        # One host transfer for all scalars (each float() would be its own
-        # device round trip — expensive on remote-tunneled TPUs).
-        loss_sum, gnorm, aux = jax.device_get((loss_sum, gnorm, aux))
+        # ONE host transfer for all scalars (each float() would be its own
+        # device round trip — expensive on remote-tunneled TPUs). `aux`
+        # stays on device; only its key structure is read.
+        aux_leaves, aux_treedef = jax.tree_util.tree_flatten(aux)
+        del aux_leaves
+        p = np.asarray(packed)
+        loss_sum, gnorm = float(p[0]), float(p[1])
+        aux_vals = jax.tree_util.tree_unflatten(aux_treedef, p[2:].tolist())
         stats = {
-            f"{loss_name}/loss": float(loss_sum) / global_denom,
-            f"{loss_name}/grad_norm": float(gnorm),
+            f"{loss_name}/loss": loss_sum / global_denom,
+            f"{loss_name}/grad_norm": gnorm,
             f"{loss_name}/n_tokens": global_denom,
             f"{loss_name}/n_mbs": float(len(mbs)),
         }
-        for k, v in aux.items():
+        for k, v in aux_vals.items():
             stats[f"{loss_name}/{k}"] = float(v) / global_denom
         return stats
 

@@ -82,6 +82,7 @@ def actor_loss_fn(
     c_clip: Optional[float] = None,
     proximal_logprobs: Optional[jnp.ndarray] = None,
     behav_imp_weight_cap: Optional[float] = None,
+    stats_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Decoupled-PPO clipped surrogate (sum over masked tokens).
 
@@ -90,8 +91,14 @@ def actor_loss_fn(
     exp(prox - old) multiplies the loss, optionally capped — the decoupled
     objective that keeps stale rollouts usable (AReaL blog v0.3 staleness
     ablation). Without it, plain PPO (prox == old). Dual-clip via c_clip.
+
+    `stats_mask` decouples monitoring from the loss weighting: when the
+    engine injects dp normalization scales into `loss_mask`, stats keep
+    the raw response mask so monitored ratios don't drift with shard
+    token imbalance.
     """
     mask = loss_mask.astype(jnp.float32)
+    smask = mask if stats_mask is None else stats_mask.astype(jnp.float32)
     denom_prox = proximal_logprobs if proximal_logprobs is not None else old_logprobs
     ratio = jnp.exp((logprobs - denom_prox) * (mask > 0))
     clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + eps_clip)
@@ -113,13 +120,14 @@ def actor_loss_fn(
             # Tokens whose behavior weight exceeds the cap are dropped.
             keep = behav_w <= behav_imp_weight_cap
             mask = mask * keep.astype(jnp.float32)
+            smask = smask * keep.astype(jnp.float32)
         loss = loss * behav_w
     loss_sum = jnp.sum(loss * mask)
     stats = {
-        "importance_weight": jnp.sum(ratio * mask),
-        "clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * mask),
-        "dual_clip_ratio": jnp.sum(dual_mask.astype(jnp.float32) * mask),
-        "actor_denom": jnp.sum(mask),
+        "importance_weight": jnp.sum(ratio * smask),
+        "clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * smask),
+        "dual_clip_ratio": jnp.sum(dual_mask.astype(jnp.float32) * smask),
+        "actor_denom": jnp.sum(smask),
     }
     return loss_sum, stats
 
@@ -135,9 +143,12 @@ def critic_loss_fn(
     target_value: jnp.ndarray,  # [R, T] returns
     value_eps_clip: float,
     loss_mask: jnp.ndarray,
+    stats_mask: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Clipped value loss (sum over masked tokens)."""
+    """Clipped value loss (sum over masked tokens). `stats_mask`: see
+    actor_loss_fn — keeps monitoring on the raw mask under dp scaling."""
     mask = loss_mask.astype(jnp.float32)
+    smask = mask if stats_mask is None else stats_mask.astype(jnp.float32)
     value = value.astype(jnp.float32)
     clipped = old_value + jnp.clip(
         value - old_value, -value_eps_clip, value_eps_clip
@@ -147,7 +158,7 @@ def critic_loss_fn(
     loss = 0.5 * jnp.maximum(l1, l2)
     clip_mask = l2 > l1
     return jnp.sum(loss * mask), {
-        "value_clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * mask),
+        "value_clip_ratio": jnp.sum(clip_mask.astype(jnp.float32) * smask),
     }
 
 

@@ -63,6 +63,9 @@ def last_response_position_mask(resp_mask):
 @dataclasses.dataclass
 class PPOActorInterface(ModelInterface):
     n_minibatches: int = 4
+    # 'global' | 'dp' — per-dp-shard gradient normalization (reference
+    # ppo_interface.py:253; engine implements it via loss_mask reweight).
+    token_normalize_scope: str = "global"
     eps_clip: float = 0.2
     c_clip: Optional[float] = None
     kl_ctl: float = 0.1
@@ -288,16 +291,22 @@ class PPOActorInterface(ModelInterface):
             # `lp` is the fused next-token logprobs [R, T] computed by the
             # engine (logits never materialized).
             mask = response_scoring_mask(rows["segment_ids"], rows["prompt_mask"])
+            # Engine-injected per-shard normalization scale applies to the
+            # LOSS weighting only (monitoring stats keep the raw mask).
+            loss_w = (
+                mask * rows["dp_loss_scale"] if "dp_loss_scale" in rows else mask
+            )
             prox = rows["logprobs"] if use_decoupled else None
             loss_sum, st = F.actor_loss_fn(
                 logprobs=lp,
                 old_logprobs=rows["packed_logprobs"],
                 advantages=rows["advantages"],
                 eps_clip=self.eps_clip,
-                loss_mask=mask,
+                loss_mask=loss_w,
                 c_clip=self.c_clip,
                 proximal_logprobs=prox,
                 behav_imp_weight_cap=self.behav_imp_weight_cap if use_decoupled else None,
+                stats_mask=mask,
             )
             # Approx KL(new || behavior) for monitoring.
             st["approx_kl"] = jnp.sum((rows["packed_logprobs"] - lp) * mask)
@@ -311,6 +320,7 @@ class PPOActorInterface(ModelInterface):
             st = engine.train_batch(
                 mb, MicroBatchSpec(n_mbs=1, max_tokens_per_mb=mb_spec.max_tokens_per_mb),
                 loss_fn=actor_loss, loss_weight_fn=weight_fn,
+                token_normalize_scope=self.token_normalize_scope,
                 version_steps=model.version, loss_name="ppo_actor",
             )
             all_stats.append(st)
@@ -364,6 +374,7 @@ def _n_response_tokens(mb: SequenceSample) -> float:
 @dataclasses.dataclass
 class PPOCriticInterface(ModelInterface):
     n_minibatches: int = 4
+    token_normalize_scope: str = "global"
     value_eps_clip: float = 0.2
     kl_ctl: float = 0.1
     adaptive_kl_ctl: bool = False
@@ -447,12 +458,16 @@ class PPOCriticInterface(ModelInterface):
 
         def critic_loss(values, rows):
             mask = response_scoring_mask(rows["segment_ids"], rows["prompt_mask"])
+            loss_w = (
+                mask * rows["dp_loss_scale"] if "dp_loss_scale" in rows else mask
+            )
             loss_sum, st = F.critic_loss_fn(
                 value=values,
                 old_value=rows["old_values_norm"],
                 target_value=rows["returns"],
                 value_eps_clip=self.value_eps_clip,
-                loss_mask=mask,
+                loss_mask=loss_w,
+                stats_mask=mask,
             )
             return loss_sum, st
 
@@ -462,6 +477,7 @@ class PPOCriticInterface(ModelInterface):
             st = engine.train_batch(
                 mb, MicroBatchSpec(n_mbs=1, max_tokens_per_mb=mb_spec.max_tokens_per_mb),
                 loss_fn=critic_loss, loss_weight_fn=_n_response_tokens,
+                token_normalize_scope=self.token_normalize_scope,
                 version_steps=model.version, loss_name="ppo_critic",
             )
             all_stats.append(st)

@@ -22,8 +22,13 @@ def sft_row_loss(lp, rows):
     next_seg = jnp.concatenate([seg[:, 1:], jnp.zeros_like(seg[:, :1])], axis=1)
     next_pm = jnp.concatenate([pm[:, 1:], jnp.ones_like(pm[:, :1])], axis=1)
     mask = ((next_seg == seg) & (seg > 0) & (next_pm == 0)).astype(jnp.float32)
+    n_tokens = jnp.sum(mask)
+    if "dp_loss_scale" in rows:
+        # Engine-injected per-shard normalization scale
+        # (token_normalize_scope='dp', jax_engine._apply_dp_token_scale).
+        mask = mask * rows["dp_loss_scale"]
     loss_sum = -jnp.sum(lp * mask)
-    return loss_sum, {"n_response_tokens": jnp.sum(mask)}
+    return loss_sum, {"n_response_tokens": n_tokens}
 
 
 def sft_loss_weight(mb: SequenceSample) -> float:

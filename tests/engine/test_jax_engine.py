@@ -100,6 +100,125 @@ def test_microbatching_invariance():
     np.testing.assert_allclose(results[0][1], results[1][1], rtol=1e-3)
 
 
+def dp_scaled_sft_loss(lp, rows):
+    """Test loss honoring the engine-injected dp_loss_scale (the contract
+    every interface loss follows for token_normalize_scope='dp')."""
+    mask = rows["loss_mask"]
+    if "dp_loss_scale" in rows:
+        mask = mask * rows["dp_loss_scale"]
+    total, n = sft_loss_from_logprobs(lp, mask)
+    return total, {}
+
+
+def test_dp_token_normalize_scope():
+    """token_normalize_scope='dp' reproduces the reference's per-rank
+    normalization (ppo_interface.py:253): loss = mean over dp shards of
+    (shard loss sum / shard token count), and it differs from 'global'
+    when shards carry unequal token counts."""
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    # Two sequences of 24 and 20 tokens -> one row each (max_row_len=32),
+    # row0 -> dp shard 0, row1 -> dp shard 1: unequal denominators.
+    seqlens = [24, 20]
+    rng = np.random.RandomState(11)
+    total = sum(seqlens)
+    batch = SequenceSample.from_default(
+        ids=["a", "b"],
+        seqlens=seqlens,
+        data={
+            "packed_input_ids": rng.randint(0, 64, size=total),
+            "loss_mask": np.ones(total, np.float32),
+        },
+    )
+    # Expected per-shard-normalized loss from the same params' logprobs.
+    inf = JaxTrainEngine(
+        cfg, jax.tree_util.tree_map(jnp.copy, params),
+        row_len_multiple=32, max_row_len=32,
+    )
+    lp = np.asarray(
+        inf.forward(batch, MicroBatchSpec(n_mbs=1), output_key="logprobs")
+        .data["logprobs"]
+    )
+    nll0 = -lp[:24].sum() / 24
+    nll1 = -lp[24:].sum() / 20
+    expected_dp = 0.5 * (nll0 + nll1)
+    expected_global = -lp.sum() / total
+
+    stats = {}
+    for scope in ("dp", "global"):
+        eng = JaxTrainEngine(
+            cfg, jax.tree_util.tree_map(jnp.copy, params),
+            mesh=make_mesh(MeshSpec.parse("d2"), devices=jax.devices()[:2]),
+            optimizer_config=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+            total_train_steps=10, row_len_multiple=32, max_row_len=32,
+        )
+        stats[scope] = eng.train_batch(
+            batch, MicroBatchSpec(n_mbs=1), dp_scaled_sft_loss, loss_weight,
+            token_normalize_scope=scope, loss_name="sft",
+        )
+    np.testing.assert_allclose(stats["dp"]["sft/loss"], expected_dp, rtol=1e-4)
+    np.testing.assert_allclose(
+        stats["global"]["sft/loss"], expected_global, rtol=1e-4
+    )
+    assert abs(expected_dp - expected_global) > 1e-6  # scopes genuinely differ
+
+
+def test_dp_scope_requires_token_weights():
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(12))
+    eng = JaxTrainEngine(
+        cfg, params,
+        mesh=make_mesh(MeshSpec.parse("d2"), devices=jax.devices()[:2]),
+        optimizer_config=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        total_train_steps=10, row_len_multiple=32,
+    )
+    rng = np.random.RandomState(13)
+    batch = SequenceSample.from_default(
+        ids=["x", "y"], seqlens=[12, 12],
+        data={"packed_input_ids": rng.randint(0, 64, size=24)},
+    )
+    with pytest.raises(ValueError, match="loss weights"):
+        eng.train_batch(
+            batch, MicroBatchSpec(n_mbs=1),
+            lambda lp, rows: (jnp.sum(-lp), {}), lambda mb: 24.0,
+            token_normalize_scope="dp",
+        )
+
+
+def test_dp_scope_with_sft_interface_loss():
+    """The REAL SFT loss path (prompt_mask rows, sft_row_loss) under
+    'dp' on a 2-shard mesh: weights derive from the response mask, no
+    loss_mask key needed (the review-found crash)."""
+    from areal_tpu.interfaces.sft import sft_loss_weight, sft_row_loss
+
+    cfg = small_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(14))
+    seqlens = [24, 20]
+    rng = np.random.RandomState(14)
+    total = sum(seqlens)
+    pm = np.zeros(total, np.int32)
+    pm[:8] = 1  # seq a: 8 prompt tokens
+    pm[24:24 + 4] = 1  # seq b: 4 prompt tokens
+    batch = SequenceSample.from_default(
+        ids=["a", "b"], seqlens=seqlens,
+        data={
+            "packed_input_ids": rng.randint(0, 64, size=total),
+            "prompt_mask": pm,
+        },
+    )
+    eng = JaxTrainEngine(
+        cfg, params,
+        mesh=make_mesh(MeshSpec.parse("d2"), devices=jax.devices()[:2]),
+        optimizer_config=OptimizerConfig(lr=1e-3, warmup_steps_proportion=0.0),
+        total_train_steps=10, row_len_multiple=32, max_row_len=32,
+    )
+    st = eng.train_batch(
+        batch, MicroBatchSpec(n_mbs=1), sft_row_loss, sft_loss_weight,
+        token_normalize_scope="dp", loss_name="sft",
+    )
+    assert np.isfinite(st["sft/loss"]) and np.isfinite(st["sft/grad_norm"])
+
+
 @pytest.mark.parametrize("mesh_spec", ["d1f2s2t2", "d2f2t2"])
 def test_forward_parity_across_meshes(mesh_spec):
     """forward() on a sharded mesh matches the single-device result."""
