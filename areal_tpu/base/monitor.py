@@ -148,3 +148,70 @@ def calculate_llama_gen_flops(
     flops += gen_len * (n_layers * (attn_proj + mlp) + head)
     flops += n_layers * 4 * total_ctx * hidden_size
     return flops
+
+
+# ---------------------------------------------------------------------------
+# Device memory telemetry + OOM guard
+# ---------------------------------------------------------------------------
+
+# Fraction of HBM beyond which the worker self-terminates so the relaunch
+# loop can recover it (reference REAL_GPU_MEMORY_KILL_THRESHOLD,
+# realhf/system/model_worker.py:1507-1610).
+MEMORY_KILL_THRESHOLD_ENV = "AREAL_TPU_MEMORY_KILL_THRESHOLD"
+
+
+class DeviceOOMGuardError(RuntimeError):
+    """Raised when device memory use crosses the kill threshold."""
+
+
+def device_memory_stats(devices=None) -> dict:
+    """Aggregate HBM usage over the local devices.
+
+    Uses `Device.memory_stats()` (populated on real TPU/GPU backends;
+    None on CPU and on tunneled devices) — absent stats yield zeros so
+    callers can log unconditionally."""
+    import jax
+
+    devices = devices if devices is not None else jax.local_devices()
+    in_use = limit = peak = 0
+    n_reporting = 0
+    for d in devices:
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            pass
+        if not stats:
+            continue
+        n_reporting += 1
+        in_use += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit", 0) or stats.get("bytes_reservable_limit", 0))
+        peak += int(stats.get("peak_bytes_in_use", 0))
+    frac = (in_use / limit) if limit else 0.0
+    return {
+        "mem_bytes_in_use": float(in_use),
+        "mem_bytes_limit": float(limit),
+        "mem_peak_bytes_in_use": float(peak),
+        "mem_frac_in_use": float(frac),
+        "mem_devices_reporting": float(n_reporting),
+    }
+
+
+def check_memory_kill_threshold(stats: Optional[dict] = None, devices=None):
+    """Raise DeviceOOMGuardError when usage exceeds the env threshold.
+
+    No-op when the env var is unset or the backend reports no stats."""
+    import os
+
+    raw = os.environ.get(MEMORY_KILL_THRESHOLD_ENV)
+    if not raw:
+        return
+    threshold = float(raw)
+    stats = stats if stats is not None else device_memory_stats(devices)
+    if stats["mem_bytes_limit"] and stats["mem_frac_in_use"] > threshold:
+        raise DeviceOOMGuardError(
+            f"device memory {stats['mem_frac_in_use']:.3f} of HBM exceeds "
+            f"kill threshold {threshold} "
+            f"({stats['mem_bytes_in_use']:.0f}/{stats['mem_bytes_limit']:.0f} "
+            f"bytes); terminating for relaunch-recovery"
+        )

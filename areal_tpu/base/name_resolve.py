@@ -362,11 +362,17 @@ _default = _DefaultRepo()
 
 
 def reconfigure(backend: str = "nfs", **kwargs):
-    """Switch the process-global repository backend ('memory' or 'nfs')."""
+    """Switch the process-global repository backend: 'memory', 'nfs', or
+    'kv' (the networked lease service, name_resolve_kv.py — the etcd3
+    equivalent for real clusters; kwargs: address="host:port")."""
     if backend == "memory":
         _default.repo = MemoryNameRecordRepository()
     elif backend == "nfs":
         _default.repo = NfsNameRecordRepository(**kwargs)
+    elif backend == "kv":
+        from areal_tpu.base.name_resolve_kv import KvNameRecordRepository
+
+        _default.repo = KvNameRecordRepository(**kwargs)
     else:
         raise NotImplementedError(f"name_resolve backend: {backend}")
     return _default.repo

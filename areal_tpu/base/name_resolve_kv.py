@@ -1,0 +1,327 @@
+"""Networked KV name-resolve backend: a lease-based TCP service.
+
+Counterpart of the reference's production backends — etcd3 with leases +
+keepalive (realhf/base/name_resolve.py:560) and the Ray-actor KV
+(:1031). Those exist because NFS polling doesn't give reliable liveness
+on real clusters; the same holds for TPU pods, where there is typically
+no etcd — so the service itself ships with the framework:
+
+- `KvStoreServer`: a threaded TCP server holding the name table with
+  per-key TTL leases. Keys with a lease expire unless refreshed; expiry
+  is enforced on read and by a background sweeper (so watchers see
+  dead workers disappear, the etcd lease semantic). Runs standalone
+  (`python -m areal_tpu.base.name_resolve_kv --port 2379`) — typically
+  next to the experiment controller — or in-process for tests.
+- `KvNameRecordRepository`: the client, implementing NameRecordRepository
+  over a persistent connection with newline-JSON framing, automatic
+  reconnect, and a keepalive thread that refreshes this process's leases
+  every ttl/3 (the etcd lease-refresh loop).
+
+Protocol: one JSON object per line; request {"op", "name", ...} ->
+response {"ok": true, ...} | {"ok": false, "err": "exists"|"not_found"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from areal_tpu.base import logging
+from areal_tpu.base.name_resolve import (
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NameRecordRepository,
+)
+
+logger = logging.getLogger("name_resolve_kv")
+
+
+# ----------------------------------------------------------------------
+# Server
+# ----------------------------------------------------------------------
+
+
+class _Store:
+    def __init__(self):
+        # name -> (value, ttl seconds or None, expire_at monotonic or None)
+        self._d: Dict[str, Tuple[str, Optional[float], Optional[float]]] = {}
+        self._lock = threading.Lock()
+
+    def _expired(self, rec, now) -> bool:
+        return rec[2] is not None and now > rec[2]
+
+    def _sweep_locked(self, now):
+        dead = [k for k, rec in self._d.items() if self._expired(rec, now)]
+        for k in dead:
+            del self._d[k]
+
+    def handle(self, req: Dict) -> Dict:
+        op = req.get("op")
+        name = (req.get("name") or "").rstrip("/")
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            if op == "add":
+                if name in self._d and not req.get("replace"):
+                    return {"ok": False, "err": "exists"}
+                ttl = req.get("ttl")
+                self._d[name] = (
+                    str(req["value"]), ttl, now + 3 * ttl if ttl else None
+                )
+                return {"ok": True}
+            if op == "get":
+                rec = self._d.get(name)
+                if rec is None:
+                    return {"ok": False, "err": "not_found"}
+                return {"ok": True, "value": rec[0]}
+            if op == "delete":
+                if name not in self._d:
+                    return {"ok": False, "err": "not_found"}
+                del self._d[name]
+                return {"ok": True}
+            if op == "clear_subtree":
+                for k in [k for k in self._d
+                          if k == name or k.startswith(name + "/")]:
+                    del self._d[k]
+                return {"ok": True}
+            if op == "find_subtree":
+                keys = sorted(k for k in self._d
+                              if k == name or k.startswith(name + "/"))
+                return {"ok": True, "keys": keys}
+            if op == "get_subtree":
+                keys = sorted(k for k in self._d
+                              if k == name or k.startswith(name + "/"))
+                return {"ok": True, "values": [self._d[k][0] for k in keys]}
+            if op == "keepalive":
+                refreshed = []
+                for k in req.get("names", []):
+                    rec = self._d.get(k)
+                    if rec is not None and rec[1]:
+                        self._d[k] = (rec[0], rec[1], now + 3 * rec[1])
+                        refreshed.append(k)
+                return {"ok": True, "refreshed": refreshed}
+            if op == "ping":
+                return {"ok": True, "n_keys": len(self._d)}
+        return {"ok": False, "err": f"bad op {op!r}"}
+
+
+class KvStoreServer:
+    """Threaded TCP server around a _Store (one thread per connection,
+    keys swept lazily under the store lock)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        store = self._store = _Store()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        resp = store.handle(json.loads(line))
+                    except Exception as e:  # malformed request
+                        resp = {"ok": False, "err": repr(e)}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = (
+            f"{self._server.server_address[0]}:{self._server.server_address[1]}"
+        )
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self._server.serve_forever()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ----------------------------------------------------------------------
+# Client repository
+# ----------------------------------------------------------------------
+
+
+class KvNameRecordRepository(NameRecordRepository):
+    """NameRecordRepository over the KV service (etcd-equivalent client)."""
+
+    def __init__(self, address: str, connect_timeout: float = 10.0):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._connect_timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+        self._sock_file = None
+        self._lock = threading.Lock()
+        self._my_keys: set = set()
+        self._leased: Dict[str, float] = {}  # name -> ttl
+        self._stop = threading.Event()
+        self._kick = threading.Event()
+        self._keepalive_thread: Optional[threading.Thread] = None
+
+    def _connect(self):
+        deadline = time.monotonic() + self._connect_timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection(self._addr, timeout=5.0)
+                s.settimeout(10.0)
+                self._sock = s
+                self._sock_file = s.makefile("rb")
+                return
+            except OSError as e:
+                last = e
+                time.sleep(0.2)
+        raise ConnectionError(f"cannot reach KV service at {self._addr}: {last!r}")
+
+    def _call(self, req: Dict) -> Dict:
+        with self._lock:
+            for attempt in (0, 1):  # one transparent reconnect
+                if self._sock is None:
+                    self._connect()
+                try:
+                    self._sock.sendall((json.dumps(req) + "\n").encode())
+                    line = self._sock_file.readline()
+                    if not line:
+                        raise ConnectionError("KV service closed connection")
+                    return json.loads(line)
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    self._close_socket()
+                    if attempt:
+                        raise
+        raise AssertionError("unreachable")
+
+    def _close_socket(self):
+        try:
+            if self._sock_file is not None:
+                self._sock_file.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._sock_file = None
+
+    def _ensure_keepalive(self):
+        # Wake the refresher so it re-derives its period: a new lease with
+        # a smaller TTL than the current period would otherwise expire
+        # before the next tick.
+        self._kick.set()
+        if self._keepalive_thread is not None:
+            return
+
+        def _loop():
+            while True:
+                ttls = list(self._leased.values())
+                period = max(min(ttls) / 3, 0.2) if ttls else 1.0
+                kicked = self._kick.wait(period)
+                if self._stop.is_set():
+                    return
+                if kicked:
+                    self._kick.clear()
+                names = list(self._leased)
+                if not names:
+                    continue
+                try:
+                    self._call({"op": "keepalive", "names": names})
+                except (ConnectionError, OSError):
+                    pass  # reconnect happens on the next call
+
+        self._keepalive_thread = threading.Thread(target=_loop, daemon=True)
+        self._keepalive_thread.start()
+
+    # -- NameRecordRepository ------------------------------------------
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None,
+            replace=False):
+        name = name.rstrip("/")
+        req = {"op": "add", "name": name, "value": str(value),
+               "replace": bool(replace)}
+        if keepalive_ttl is not None:
+            req["ttl"] = float(keepalive_ttl)
+        resp = self._call(req)
+        if not resp["ok"]:
+            # _call transparently retries once after a dropped connection;
+            # if the FIRST send landed, the retry of this non-idempotent
+            # add sees its own key. Confirm by value before treating a
+            # successful registration as a conflict.
+            try:
+                if self.get(name) == str(value):
+                    resp = {"ok": True}
+            except NameEntryNotFoundError:
+                pass
+            if not resp["ok"]:
+                raise NameEntryExistsError(name)
+        if delete_on_exit:
+            self._my_keys.add(name)
+        if keepalive_ttl is not None:
+            self._leased[name] = float(keepalive_ttl)
+            self._ensure_keepalive()
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        resp = self._call({"op": "delete", "name": name})
+        self._my_keys.discard(name)
+        self._leased.pop(name, None)
+        if not resp["ok"]:
+            raise NameEntryNotFoundError(name)
+
+    def clear_subtree(self, name_root):
+        self._call({"op": "clear_subtree", "name": name_root.rstrip("/")})
+
+    def get(self, name):
+        resp = self._call({"op": "get", "name": name.rstrip("/")})
+        if not resp["ok"]:
+            raise NameEntryNotFoundError(name)
+        return resp["value"]
+
+    def get_subtree(self, name_root):
+        return self._call(
+            {"op": "get_subtree", "name": name_root.rstrip("/")}
+        )["values"]
+
+    def find_subtree(self, name_root):
+        return self._call(
+            {"op": "find_subtree", "name": name_root.rstrip("/")}
+        )["keys"]
+
+    def reset(self):
+        self._stop.set()
+        for name in list(self._my_keys):
+            try:
+                self.delete(name)
+            except (NameEntryNotFoundError, ConnectionError, OSError):
+                pass
+        self._my_keys.clear()
+        self._leased.clear()
+        self._close_socket()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description="areal_tpu name-resolve KV service")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=2379)
+    args = ap.parse_args()
+    srv = KvStoreServer(args.host, args.port)
+    logger.info(f"name-resolve KV service on {srv.address}")
+    srv.serve_forever()

@@ -42,10 +42,16 @@ class AutomaticEvaluator:
         scheduler: Optional[SchedulerClient] = None,
         max_concurrent_jobs: int = 1,
         eval_args: Optional[Dict] = None,
+        task: str = "math",  # math | code: picks the eval harness
+        job_env: Optional[Dict[str, str]] = None,  # extra env for eval jobs
     ):
+        if task not in ("math", "code"):
+            raise ValueError(f"unknown eval task {task!r}")
+        self.job_env = job_env
         self.save_root = save_root
         self.data_path = data_path
         self.output_root = output_root
+        self.task = task
         self.scheduler = scheduler or make_scheduler("local")
         self.max_concurrent_jobs = max_concurrent_jobs
         self.eval_args = eval_args or {}
@@ -97,12 +103,14 @@ class AutomaticEvaluator:
             )
             cmd = [
                 sys.executable,
-                os.path.join(repo_root, "evaluation", "math_eval.py"),
+                os.path.join(repo_root, "evaluation", f"{self.task}_eval.py"),
                 f"ckpt={es.ckpt_dir}",
                 f"data={self.data_path}",
                 f"output={es.output_path}",
             ] + [f"{k}={v}" for k, v in self.eval_args.items()]
-            es.job_name = self.scheduler.submit(f"eval_step{step}", cmd)
+            es.job_name = self.scheduler.submit(
+                f"eval_step{step}", cmd, env=self.job_env
+            )
 
     def _collect(self):
         for es in self.steps.values():

@@ -67,6 +67,7 @@ class GserverManager(Worker):
         self._server_reqs = {u: 0 for u in self.server_urls}  # in-flight est.
         self._server_tokens = {u: 0.0 for u in self.server_urls}
         self.weight_version = 0
+        self.last_weight_sync_s = 0.0
         self.rollout_stat = RolloutStat()
         self._lock = threading.Lock()
         self._last_metrics_poll = 0.0
@@ -231,6 +232,9 @@ class GserverManager(Worker):
         return path
 
     def flush_requests_and_update_weights(self, path: str):
+        t_start = time.monotonic()
+        load_stats: list = []
+
         async def _update():
             async with aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.cfg.flush_request_timeout)
@@ -257,12 +261,22 @@ class GserverManager(Worker):
                         raise RuntimeError(
                             f"weight update to {u} rejected: {body}"
                         )
+                    load_stats.append(
+                        (body.get("source", "?"), float(body.get("load_s", 0.0)))
+                    )
 
         fut = asyncio.run_coroutine_threadsafe(_update(), self._http_loop)
         fut.result(timeout=self.cfg.flush_request_timeout + 10)
         with self._lock:
             self.weight_version = self._new_version
-        logger.info(f"all servers updated to weight version {self.weight_version}")
+            self.last_weight_sync_s = time.monotonic() - t_start
+        # Sync latency is the async-RL staleness floor (reference bar:
+        # <3 s/transfer, blog/AReaL_v0_2.md:52-54) — always logged.
+        logger.info(
+            f"all servers updated to weight version {self.weight_version} "
+            f"in {self.last_weight_sync_s:.3f}s "
+            f"(loads: {', '.join(f'{s} {t:.3f}s' for s, t in load_stats)})"
+        )
 
     async def _poll_metrics(self):
         async with aiohttp.ClientSession(

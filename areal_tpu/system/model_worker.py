@@ -224,6 +224,12 @@ class ModelWorker(Worker):
         if ttypes:
             stats["__reduce_types__"] = ttypes
         stats["perf/sec"] = time.monotonic() - t0
+        # HBM telemetry + OOM guard after every MFC (reference
+        # model_worker.py:1507-1610 GPU-memory watch + kill threshold):
+        # zeros on backends without memory_stats, so always logged.
+        mem = monitor.device_memory_stats()
+        stats.update({f"perf/{k}": v for k, v in mem.items()})
+        monitor.check_memory_kill_threshold(mem)
         cfg = getattr(model.module, "model_cfg", None)
         if cfg is not None:
             in_lens = [
@@ -420,10 +426,31 @@ class ModelWorker(Worker):
             # Single writer: DP replicas hold identical logical params, so
             # only rank 0 dumps (concurrent writers would tear the pickle).
             model = self.models[src]
-            d = os.path.join(realloc_root, ModelName.parse(src).role)
+            role = ModelName.parse(src).role
+            d = os.path.join(realloc_root, role)
             from areal_tpu.engine.checkpoint import save_engine_state
+            from areal_tpu.system.weight_transfer import (
+                dump_raw_params, shm_transfer_dir,
+            )
+
+            import jax
 
             save_engine_state(model.module, d)
+            # Raw mmap-able dumps for the generation servers: tmpfs
+            # same-host fast path + disk fallback (weight_transfer.py).
+            params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x), model.module.get_params()
+            )
+            dump_s = dump_raw_params(params, d, version=step)
+            shm = shm_transfer_dir(
+                self.cfg.experiment_name, self.cfg.trial_name, role
+            )
+            if shm is not None:
+                dump_s += dump_raw_params(params, shm, version=step)
+            logger.info(
+                f"param_realloc dump for {role} step {step}: raw dump "
+                f"{dump_s:.3f}s (shm={'yes' if shm else 'no'})"
+            )
             tmp = os.path.join(d, "step.txt.tmp")
             with open(tmp, "w") as f:
                 f.write(str(step))

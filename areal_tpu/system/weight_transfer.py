@@ -1,0 +1,184 @@
+"""Trainer -> generation-server weight transfer with a same-host fast path.
+
+Counterpart of the reference's param-realloc transfer stack
+(realhf/system/model_worker.py:1046-1148 — disk-mediated by default, with
+NCCL/GDRDMA fast paths keeping it under the <3 s bar of
+blog/AReaL_v0_2.md:52-54). The TPU single-host equivalent of the CUDA-IPC
+path is raw parameter bytes in tmpfs (/dev/shm) read back with mmap: no
+pickle serialize/deserialize copies, no disk IO, and `jax.device_put`
+streams straight from the mapped pages. The pickle-on-NFS dump
+(engine/checkpoint.py) remains the cross-host fallback.
+
+Format (per dump directory):
+- ``params-v{N}.bin``  — every leaf's contiguous bytes, concatenated.
+- ``params.json``      — manifest: schema version, dump version N, bin
+  filename, and per-leaf (path, dtype, shape, offset). Written via
+  tmp+rename AFTER the bin, so a reader that sees a manifest always sees
+  its complete bin. Older bins are garbage-collected down to the last 2;
+  a reader racing the GC gets FileNotFoundError and falls back.
+
+The tree is assumed to be nested dicts of arrays (what
+models/transformer.init_params builds); list/tuple nodes are rejected at
+dump time rather than silently mis-rebuilt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("weight_transfer")
+
+_MANIFEST = "params.json"
+_SCHEMA = 1
+
+
+def shm_transfer_dir(experiment_name: str, trial_name: str, role: str) -> Optional[str]:
+    """tmpfs dump directory for the same-host fast path, or None when
+    /dev/shm is unavailable (then only the disk path is used)."""
+    base = "/dev/shm"
+    if not os.path.isdir(base) or not os.access(base, os.W_OK):
+        return None
+    return os.path.join(base, "areal_tpu", experiment_name, trial_name, role)
+
+
+def _flatten(params: Any, prefix: Tuple[str, ...] = ()) -> list:
+    out = []
+    if isinstance(params, dict):
+        for k in sorted(params.keys()):
+            out.extend(_flatten(params[k], prefix + (str(k),)))
+        return out
+    if isinstance(params, (list, tuple)):
+        raise TypeError(
+            f"weight_transfer supports dict-of-array trees only; found "
+            f"{type(params).__name__} at {'/'.join(prefix)}"
+        )
+    return [("/".join(prefix), params)]
+
+
+def dump_raw_params(params: Any, dump_dir: str, version: int) -> float:
+    """Write the raw dump; returns seconds spent. Safe against concurrent
+    readers (see module docstring); single writer assumed (the dp-rank-0
+    dump rule, system/model_worker._param_realloc)."""
+    t0 = time.monotonic()
+    os.makedirs(dump_dir, exist_ok=True)
+    leaves = _flatten(params)
+    bin_name = f"params-v{version}.bin"
+    manifest: Dict[str, Any] = {
+        "schema": _SCHEMA, "version": int(version), "bin": bin_name,
+        "leaves": [],
+    }
+    offset = 0
+    tmp_bin = os.path.join(dump_dir, bin_name + f".tmp.{os.getpid()}")
+    with open(tmp_bin, "wb") as f:
+        for path, leaf in leaves:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            f.write(arr.tobytes())
+            # dtype.name (not .str): ml_dtypes types like bfloat16 have
+            # .str '<V2' which round-trips to a raw void type.
+            manifest["leaves"].append(
+                {"path": path, "dtype": arr.dtype.name,
+                 "shape": list(arr.shape), "offset": offset}
+            )
+            offset += arr.nbytes
+    manifest["total_bytes"] = offset
+    os.replace(tmp_bin, os.path.join(dump_dir, bin_name))
+    tmp_man = os.path.join(dump_dir, _MANIFEST + f".tmp.{os.getpid()}")
+    with open(tmp_man, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp_man, os.path.join(dump_dir, _MANIFEST))
+    # GC old bins (keep the newest 2 so an in-flight reader can finish).
+    bins = sorted(
+        (b for b in os.listdir(dump_dir)
+         if b.startswith("params-v") and b.endswith(".bin")),
+        key=lambda b: int(b[len("params-v"):-len(".bin")]),
+    )
+    for b in bins[:-2]:
+        try:
+            os.unlink(os.path.join(dump_dir, b))
+        except OSError:
+            pass
+    return time.monotonic() - t0
+
+
+def _unflatten(leaves: Dict[str, np.ndarray]) -> Any:
+    root: Dict[str, Any] = {}
+    for path, arr in leaves.items():
+        node = root
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def load_raw_params(dump_dir: str) -> Optional[Tuple[Any, int]]:
+    """mmap the latest raw dump: (params pytree of memory-mapped arrays,
+    dump version), or None if absent/torn (caller falls back)."""
+    try:
+        import ml_dtypes  # noqa: F401  registers bfloat16 et al. by name
+
+        with open(os.path.join(dump_dir, _MANIFEST)) as f:
+            manifest = json.load(f)
+        if manifest.get("schema") != _SCHEMA:
+            return None
+        mm = np.memmap(
+            os.path.join(dump_dir, manifest["bin"]), mode="r", dtype=np.uint8
+        )
+        if mm.size != manifest["total_bytes"]:
+            return None  # torn write
+        leaves = {}
+        for e in manifest["leaves"]:
+            dt = np.dtype(e["dtype"])
+            n = int(np.prod(e["shape"])) * dt.itemsize
+            leaves[e["path"]] = (
+                mm[e["offset"]: e["offset"] + n].view(dt).reshape(e["shape"])
+            )
+        return _unflatten(leaves), int(manifest["version"])
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+
+
+def load_for_serving(
+    model_path: str, shm_dir: Optional[str] = None
+) -> Tuple[Any, Dict[str, Any]]:
+    """Load params for a generation server's weight update, fastest source
+    first. Returns (params, info) where info records the source and load
+    seconds for the /metrics surface:
+
+    1. ``shm_dir`` raw dump      — same-host tmpfs fast path
+    2. ``model_path`` raw dump   — mmap from page cache / NFS
+    3. ``model_path`` pickle     — engine_state.pkl (checkpoint fallback)
+    4. ``model_path`` HF dir     — cold start from an HF checkpoint
+    """
+    t0 = time.monotonic()
+    if shm_dir is not None:
+        got = load_raw_params(shm_dir)
+        if got is not None:
+            params, v = got
+            return params, {"source": "shm_raw", "version": v,
+                            "load_s": time.monotonic() - t0}
+    got = load_raw_params(model_path)
+    if got is not None:
+        params, v = got
+        return params, {"source": "disk_raw", "version": v,
+                        "load_s": time.monotonic() - t0}
+    state_file = os.path.join(model_path, "engine_state.pkl")
+    if os.path.exists(state_file):
+        import pickle
+
+        with open(state_file, "rb") as f:
+            params = pickle.load(f)["params"]
+        return params, {"source": "pickle", "version": -1,
+                        "load_s": time.monotonic() - t0}
+    from areal_tpu.models.hf import load_hf_model
+
+    _, params = load_hf_model(model_path)
+    return params, {"source": "hf", "version": -1,
+                    "load_s": time.monotonic() - t0}

@@ -1,0 +1,156 @@
+"""Evaluate checkpoints over multiple benchmarks and aggregate results.
+
+Counterpart of the reference's evaluation/eval_and_aggregate.py (356 LoC:
+launch math/code evals per benchmark per checkpoint, then merge pass@1 and
+response-length stats into one table). Here each benchmark is a jsonl with
+a declared task family; the right harness (math_eval / code_eval) runs per
+(checkpoint, benchmark) and results merge into aggregate.json plus a
+printed table.
+
+Usage:
+    python evaluation/eval_and_aggregate.py save_root=/save/actor \
+        benchmarks=aime:/data/aime.jsonl:math,lcb:/data/lcb.jsonl:code \
+        output_root=/tmp/evals max_new_tokens=512
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class Benchmark:
+    name: str
+    data_path: str
+    task: str  # math | code
+
+    @staticmethod
+    def parse_many(spec: str) -> List["Benchmark"]:
+        """"name:path:task,name:path:task" (task defaults to math)."""
+        out = []
+        for part in spec.split(","):
+            bits = part.split(":")
+            if len(bits) == 2:
+                bits.append("math")
+            name, path, task = bits
+            if task not in ("math", "code"):
+                raise ValueError(f"unknown task {task!r} for benchmark {name}")
+            out.append(Benchmark(name, path, task))
+        return out
+
+
+def discover_checkpoints(save_root: str) -> Dict[int, str]:
+    """step -> checkpoint dir (dp0 preferred), completed saves only."""
+    found: Dict[int, str] = {}
+    if not os.path.isdir(save_root):
+        return found
+    for name in sorted(os.listdir(save_root)):
+        m = re.fullmatch(r"step(\d+)", name)
+        if not m:
+            continue
+        d = os.path.join(save_root, name)
+        dp0 = os.path.join(d, "dp0")
+        ckpt = dp0 if os.path.isdir(dp0) else d
+        if os.path.exists(os.path.join(ckpt, "config.json")):
+            found[int(m.group(1))] = ckpt
+    return found
+
+
+def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
+    if bench.task == "code":
+        from evaluation.code_eval import evaluate_checkpoint
+    else:
+        from evaluation.math_eval import evaluate_checkpoint
+    # The harnesses accept different knobs (e.g. case_timeout is
+    # code-only); in a mixed run forward each only what it understands.
+    import inspect
+
+    accepted = set(inspect.signature(evaluate_checkpoint).parameters)
+    return evaluate_checkpoint(
+        ckpt=ckpt, data=bench.data_path, output=output,
+        **{k: v for k, v in eval_args.items() if k in accepted},
+    )
+
+
+def eval_and_aggregate(
+    save_root: str,
+    benchmarks: List[Benchmark],
+    output_root: str,
+    steps: Optional[List[int]] = None,
+    **eval_args,
+) -> dict:
+    """Run every (checkpoint, benchmark) pair, reusing results.json files
+    already on disk (idempotent reruns), then aggregate."""
+    ckpts = discover_checkpoints(save_root)
+    if steps:
+        ckpts = {s: d for s, d in ckpts.items() if s in steps}
+    table: Dict[str, Dict[str, float]] = {}
+    for step in sorted(ckpts):
+        row: Dict[str, float] = {}
+        for bench in benchmarks:
+            out_path = os.path.join(
+                output_root, f"step{step}", f"{bench.name}.json"
+            )
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    res = json.load(f)
+            else:
+                res = run_eval(ckpts[step], bench, out_path, **eval_args)
+            row[bench.name] = res["accuracy"]
+        row["avg"] = sum(row.values()) / max(1, len(row))
+        table[f"step{step}"] = row
+
+    agg = {
+        "save_root": save_root,
+        "benchmarks": [dataclasses.asdict(b) for b in benchmarks],
+        "table": table,
+    }
+    os.makedirs(output_root, exist_ok=True)
+    with open(os.path.join(output_root, "aggregate.json"), "w") as f:
+        json.dump(agg, f, indent=2)
+
+    # Human-readable table on stdout.
+    names = [b.name for b in benchmarks] + ["avg"]
+    header = "ckpt".ljust(12) + "".join(n.rjust(12) for n in names)
+    print(header)
+    for step_name in sorted(table, key=lambda s: int(s[4:])):
+        row = table[step_name]
+        print(step_name.ljust(12)
+              + "".join(f"{row[n]:.4f}".rjust(12) for n in names))
+    return agg
+
+
+if __name__ == "__main__":
+    kwargs = {}
+    benchmarks: List[Benchmark] = []
+    save_root = output_root = None
+    for arg in sys.argv[1:]:
+        k, v = arg.split("=", 1)
+        if k == "benchmarks":
+            benchmarks = Benchmark.parse_many(v)
+        elif k == "save_root":
+            save_root = v
+        elif k == "output_root":
+            output_root = v
+        elif k == "steps":
+            kwargs["steps"] = [int(s) for s in v.split(",")]
+        elif k in ("max_new_tokens", "n_samples", "max_prompts", "max_cases",
+                   "seed"):
+            kwargs[k] = int(v)
+        elif k in ("greedy",):
+            kwargs[k] = v.lower() in ("1", "true")
+        elif k in ("temperature", "case_timeout"):
+            kwargs[k] = float(v)
+        else:
+            kwargs[k] = v
+    assert save_root and output_root and benchmarks, (
+        "need save_root=, output_root=, benchmarks="
+    )
+    eval_and_aggregate(save_root, benchmarks, output_root, **kwargs)

@@ -111,3 +111,54 @@ def test_time_marks():
     out = tm.export()
     assert "timeperf/fwd" in out and out["timeperf/fwd"] >= 0.0
     assert tm.export() == {}
+
+
+# ----------------------------------------------------------------------
+# Device memory telemetry + OOM guard (reference model_worker.py:1507-1610)
+# ----------------------------------------------------------------------
+
+
+class _FakeDev:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_device_memory_stats_aggregates():
+    devs = [
+        _FakeDev({"bytes_in_use": 100, "bytes_limit": 1000,
+                  "peak_bytes_in_use": 300}),
+        _FakeDev({"bytes_in_use": 200, "bytes_limit": 1000,
+                  "peak_bytes_in_use": 400}),
+        _FakeDev(None),  # backend without stats
+    ]
+    s = monitor.device_memory_stats(devs)
+    assert s["mem_bytes_in_use"] == 300
+    assert s["mem_bytes_limit"] == 2000
+    assert s["mem_peak_bytes_in_use"] == 700
+    assert s["mem_frac_in_use"] == pytest.approx(0.15)
+    assert s["mem_devices_reporting"] == 2
+
+
+def test_device_memory_stats_no_backend_support():
+    s = monitor.device_memory_stats([_FakeDev(None)])
+    assert s["mem_bytes_limit"] == 0 and s["mem_frac_in_use"] == 0.0
+
+
+def test_memory_kill_threshold(monkeypatch):
+    devs = [_FakeDev({"bytes_in_use": 950, "bytes_limit": 1000})]
+    # Unset env: never raises.
+    monkeypatch.delenv(monitor.MEMORY_KILL_THRESHOLD_ENV, raising=False)
+    monitor.check_memory_kill_threshold(devices=devs)
+    # Over threshold: raises for relaunch-recovery.
+    monkeypatch.setenv(monitor.MEMORY_KILL_THRESHOLD_ENV, "0.9")
+    with pytest.raises(monitor.DeviceOOMGuardError, match="kill threshold"):
+        monitor.check_memory_kill_threshold(devices=devs)
+    # Under threshold: fine.
+    monkeypatch.setenv(monitor.MEMORY_KILL_THRESHOLD_ENV, "0.99")
+    monitor.check_memory_kill_threshold(devices=devs)
+    # No stats reported: guard is a no-op even with env set.
+    monkeypatch.setenv(monitor.MEMORY_KILL_THRESHOLD_ENV, "0.1")
+    monitor.check_memory_kill_threshold(devices=[_FakeDev(None)])

@@ -14,10 +14,23 @@ from areal_tpu.base.name_resolve import (
 )
 
 
-@pytest.fixture(params=["memory", "nfs"])
-def repo(request, tmp_path):
+@pytest.fixture(scope="module")
+def kv_server():
+    from areal_tpu.base.name_resolve_kv import KvStoreServer
+
+    srv = KvStoreServer("127.0.0.1", 0).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(params=["memory", "nfs", "kv"])
+def repo(request, tmp_path, kv_server):
     if request.param == "memory":
         r = MemoryNameRecordRepository()
+    elif request.param == "kv":
+        from areal_tpu.base.name_resolve_kv import KvNameRecordRepository
+
+        r = KvNameRecordRepository(kv_server.address.replace("0.0.0.0", "127.0.0.1"))
     else:
         r = NfsNameRecordRepository(record_root=str(tmp_path / "nr"))
     yield r
@@ -83,3 +96,52 @@ def test_nfs_cross_instance(tmp_path):
     r1.add("peer/0", "addr0")
     assert r2.get("peer/0") == "addr0"
     r1.reset()
+
+
+def test_kv_lease_expiry_and_keepalive(kv_server):
+    """etcd lease semantics: a TTL key vanishes when its owner stops
+    refreshing (here: owner repo closed), but survives while the owner's
+    keepalive loop runs."""
+    from areal_tpu.base.name_resolve_kv import KvNameRecordRepository
+
+    addr = kv_server.address.replace("0.0.0.0", "127.0.0.1")
+    owner = KvNameRecordRepository(addr)
+    reader = KvNameRecordRepository(addr)
+    owner.add("lease/worker0", "alive", keepalive_ttl=0.3)
+    # Lease held: survives well past 3*ttl thanks to the keepalive loop.
+    time.sleep(1.2)
+    assert reader.get("lease/worker0") == "alive"
+    # Owner dies (stops refreshing without deleting): key expires.
+    owner._stop.set()
+    owner._close_socket()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            reader.get("lease/worker0")
+            time.sleep(0.1)
+        except NameEntryNotFoundError:
+            break
+    else:
+        raise AssertionError("leased key never expired after owner death")
+    reader.reset()
+
+
+def test_kv_reconnect(kv_server):
+    """Client transparently reconnects after a dropped connection."""
+    from areal_tpu.base.name_resolve_kv import KvNameRecordRepository
+
+    addr = kv_server.address.replace("0.0.0.0", "127.0.0.1")
+    r = KvNameRecordRepository(addr)
+    r.add("rc/a", "1")
+    r._close_socket()  # simulate a network drop
+    assert r.get("rc/a") == "1"
+    r.reset()
+
+
+def test_kv_module_facade(kv_server):
+    addr = kv_server.address.replace("0.0.0.0", "127.0.0.1")
+    repo = name_resolve.reconfigure("kv", address=addr)
+    name_resolve.add("facade/k", "v")
+    assert name_resolve.get("facade/k") == "v"
+    repo.reset()
+    name_resolve.reconfigure("nfs")

@@ -1,0 +1,123 @@
+"""parallel/distributed.py exercised for real (VERDICT r2 item 7): two OS
+processes form one jax.distributed world through the NFS name_resolve
+rendezvous, and the multi-host SPMD SFT path trains in lockstep over a
+cross-process global mesh."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.fixtures import make_sft_rows, train_tiny_tokenizer
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = r"""
+import os, sys
+rank, n, nr_root, out = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+import jax
+jax.config.update("jax_platforms", "cpu")
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=nr_root)
+from areal_tpu.parallel.distributed import setup_host_group
+info = setup_host_group("exp-dist", "t0", "g0", rank, n)
+import numpy as np, jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()).reshape(jax.device_count()), ("data",))
+x = jax.device_put(np.ones((jax.device_count(), 2)), NamedSharding(mesh, P("data", None)))
+s = jax.jit(lambda a: jnp.sum(a))(x)  # cross-process reduction
+jax.block_until_ready(s)
+import json
+with open(out, "w") as f:
+    json.dump({
+        "rank": rank,
+        "process_id": info.process_id,
+        "coordinator": info.coordinator_address,
+        "n_processes": jax.process_count(),
+        "n_devices": jax.device_count(),
+        "sum": float(np.asarray(s.addressable_data(0))),
+    }, f)
+"""
+
+
+def _child_env(n_local_devices: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_local_devices}"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_setup_host_group_two_processes(tmp_path):
+    nr_root = str(tmp_path / "nr")
+    outs = [str(tmp_path / f"out{r}.json") for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", CHILD, str(r), "2", nr_root, outs[r]],
+            env=_child_env(2), cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-3000:]
+    results = [json.load(open(o)) for o in outs]
+    for r, res in enumerate(results):
+        assert res["process_id"] == r
+        assert res["n_processes"] == 2
+        assert res["n_devices"] == 4  # 2 hosts x 2 local devices
+        assert res["sum"] == 8.0  # global reduction saw all shards
+    # Both ranks agreed on the elected coordinator.
+    assert results[0]["coordinator"] == results[1]["coordinator"]
+
+
+def test_setup_host_group_single_host_noop():
+    from areal_tpu.parallel.distributed import setup_host_group
+
+    info = setup_host_group("e", "t", "g", 0, 1)
+    assert (info.process_id, info.num_processes) == (0, 1)
+
+
+@pytest.mark.timeout(900)
+def test_multihost_sft_end_to_end(tmp_path):
+    """training/multihost.py: 2 simulated hosts x 2 devices, d2f2 global
+    mesh, lockstep SFT steps; rank 0 reports decreasing loss."""
+    from training.multihost import launch_multihost
+
+    data = tmp_path / "sft.jsonl"
+    rows = make_sft_rows(8, seed=0)
+    with open(data, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    tok_dir = tmp_path / "tok"
+    tok_dir.mkdir()
+    train_tiny_tokenizer(
+        [r["prompt"] + " " + r["answer"] for r in rows], tok_dir
+    ).save_pretrained(str(tok_dir))
+
+    out = str(tmp_path / "result.json")
+    overrides = [
+        "experiment_name=mh-test", "trial_name=t0", "seed=3",
+        f"name_resolve_root={tmp_path / 'nr'}",
+        f"dataset.path={data}", "dataset.type_=prompt_answer",
+        f"tokenizer_path={tok_dir}",
+        "train_batch_size=8",
+        ('model.config={"n_layers":2,"hidden_dim":32,"n_q_heads":2,'
+         '"n_kv_heads":1,"head_dim":16,"intermediate_dim":64,'
+         '"vocab_size":192,"compute_dtype":"float32",'
+         '"param_dtype":"float32"}'),
+        "model.optimizer.lr=2e-3", "model.optimizer.warmup_steps_proportion=0",
+        "model.row_len_multiple=32", "model.remat=false",
+    ]
+    result = launch_multihost(
+        n_hosts=2, overrides=overrides, mesh_spec="d2f2", steps=5,
+        out_path=out, host_env=_child_env(2), timeout=600,
+    )
+    assert result["n_processes"] == 2
+    assert result["n_devices"] == 4
+    assert result["mesh"] == {"data": 2, "fsdp": 2, "seq": 1, "tensor": 1}
+    assert len(result["losses"]) == 5
+    assert result["losses"][-1] < result["losses"][0]

@@ -1,0 +1,226 @@
+"""Scheduler client + AutomaticEvaluator + offline eval harnesses
+(VERDICT r2 weak #6: these previously had zero tests).
+
+The end-to-end tests build a REAL tiny HF checkpoint (qwen2 family) plus
+a trained WordPiece tokenizer, let the evaluator discover it, submit the
+eval job through the local scheduler, and assert a score JSON lands —
+the full reference flow (realhf/scheduler/evaluator.py:160-348).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.scheduler.client import (
+    JobException,
+    JobState,
+    LocalSchedulerClient,
+    make_scheduler,
+)
+from areal_tpu.scheduler.evaluator import AutomaticEvaluator
+
+# Eval subprocesses must not grab the real TPU (or the axon platform this
+# environment injects); they are tiny CPU jobs.
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+# ----------------------------------------------------------------------
+# Scheduler client
+# ----------------------------------------------------------------------
+
+
+def test_local_scheduler_lifecycle(tmp_path):
+    sched = LocalSchedulerClient(log_dir=str(tmp_path / "logs"))
+    try:
+        ok = sched.submit("ok", [sys.executable, "-c", "print('hi')"])
+        bad = sched.submit("bad", [sys.executable, "-c", "raise SystemExit(3)"])
+        infos = {i.name: i for i in sched.wait(
+            ["ok", "bad"], timeout=60, raise_on_failure=False
+        )}
+        assert infos["ok"].state == JobState.COMPLETED
+        assert infos["bad"].state == JobState.FAILED
+        assert infos["bad"].exit_code == 3
+        # Logs captured.
+        assert "hi" in open(tmp_path / "logs" / "ok.log").read()
+        # wait(raise_on_failure=True) surfaces the failure.
+        with pytest.raises(JobException):
+            sched.wait(["bad"], timeout=10)
+        assert sched.find("nope").state == JobState.NOT_FOUND
+    finally:
+        sched.stop_all()
+
+
+def test_local_scheduler_stop(tmp_path):
+    sched = LocalSchedulerClient()
+    try:
+        sched.submit("sleep", [sys.executable, "-c", "import time; time.sleep(60)"])
+        assert sched.find("sleep").state == JobState.RUNNING
+        sched.stop("sleep")
+        deadline = time.monotonic() + 10
+        while sched.find("sleep").state == JobState.RUNNING:
+            assert time.monotonic() < deadline, "job did not stop"
+            time.sleep(0.1)
+        assert sched.find("sleep").state == JobState.FAILED  # SIGTERM exit
+    finally:
+        sched.stop_all()
+
+
+def test_make_scheduler_registry():
+    assert isinstance(make_scheduler("local"), LocalSchedulerClient)
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        make_scheduler("definitely-not-registered")
+
+
+# ----------------------------------------------------------------------
+# Checkpoint + data fixtures for the end-to-end evaluator flow
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    """save_root/step2/dp0 with a real qwen2-format checkpoint + tokenizer."""
+    import jax
+
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.hf import save_hf_model
+    from areal_tpu.models.transformer import init_params
+    from tests.fixtures import random_sentence, train_tiny_tokenizer
+
+    root = tmp_path_factory.mktemp("save_root")
+    ckpt = root / "step2" / "dp0"
+    ckpt.mkdir(parents=True)
+
+    import random as _random
+
+    rng = _random.Random(0)
+    texts = [random_sentence(rng) for _ in range(50)] + ["12 boxed"]
+    tokenizer = train_tiny_tokenizer(texts, ckpt)
+
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=1, head_dim=16,
+        intermediate_dim=64, vocab_size=tokenizer.vocab_size + 8,
+        max_position_embeddings=256, attn_bias=True,  # qwen2 has qkv bias
+        compute_dtype="float32", param_dtype="float32",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    save_hf_model(str(ckpt), cfg, params, "qwen2")
+    tokenizer.save_pretrained(str(ckpt))
+    return str(root), str(ckpt)
+
+
+@pytest.fixture(scope="module")
+def math_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data") / "math.jsonl"
+    rows = [
+        {"query_id": "m0", "prompt": "one two three", "solutions": ["\\boxed{12}"]},
+        {"query_id": "m1", "prompt": "alpha beta", "solutions": ["\\boxed{7}"]},
+    ]
+    with open(d, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def code_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data") / "code.jsonl"
+    rows = [
+        {
+            "query_id": "c0",
+            "prompt": "sum two ints",
+            "input_output": {"inputs": ["1 2\n"], "outputs": ["3\n"]},
+        },
+    ]
+    with open(d, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(d)
+
+
+# ----------------------------------------------------------------------
+# AutomaticEvaluator end-to-end
+# ----------------------------------------------------------------------
+
+
+def test_evaluator_math_end_to_end(tiny_ckpt, math_data, tmp_path):
+    save_root, _ = tiny_ckpt
+    ev = AutomaticEvaluator(
+        save_root=save_root,
+        data_path=math_data,
+        output_root=str(tmp_path / "out"),
+        eval_args={"max_new_tokens": 8, "greedy": True},
+        job_env=CPU_ENV,
+    )
+    try:
+        ev.run_until_idle(timeout=600)
+    finally:
+        ev.scheduler.stop_all()
+    results = ev.results()
+    assert 2 in results
+    assert 0.0 <= results[2] <= 1.0
+    out = json.load(open(tmp_path / "out" / "step2.json"))
+    assert out["n_prompts"] == 2 and len(out["details"]) == 2
+
+
+def test_evaluator_code_end_to_end(tiny_ckpt, code_data, tmp_path):
+    """A code checkpoint eval produces a score JSON (VERDICT r2 item 10)."""
+    save_root, _ = tiny_ckpt
+    ev = AutomaticEvaluator(
+        save_root=save_root,
+        data_path=code_data,
+        output_root=str(tmp_path / "out"),
+        eval_args={"max_new_tokens": 8, "greedy": True, "case_timeout": 10.0},
+        task="code",
+        job_env=CPU_ENV,
+    )
+    try:
+        ev.run_until_idle(timeout=600)
+    finally:
+        ev.scheduler.stop_all()
+    out = json.load(open(tmp_path / "out" / "step2.json"))
+    assert out["task"] == "code"
+    assert out["n_prompts"] == 1
+    # A random model doesn't emit valid code; accuracy must be graded 0.
+    assert out["accuracy"] == 0.0
+
+
+def test_evaluator_rejects_unknown_task(tmp_path):
+    with pytest.raises(ValueError, match="unknown eval task"):
+        AutomaticEvaluator(
+            save_root=str(tmp_path), data_path="x", output_root=str(tmp_path),
+            task="vision",
+        )
+
+
+# ----------------------------------------------------------------------
+# eval_and_aggregate over both families
+# ----------------------------------------------------------------------
+
+
+def test_eval_and_aggregate(tiny_ckpt, math_data, code_data, tmp_path):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from evaluation.eval_and_aggregate import Benchmark, eval_and_aggregate
+
+    save_root, _ = tiny_ckpt
+    benches = Benchmark.parse_many(
+        f"math:{math_data}:math,code:{code_data}:code"
+    )
+    agg = eval_and_aggregate(
+        save_root, benches, str(tmp_path / "agg"),
+        max_new_tokens=8, greedy=True,
+    )
+    assert "step2" in agg["table"]
+    row = agg["table"]["step2"]
+    assert set(row) == {"math", "code", "avg"}
+    assert os.path.exists(tmp_path / "agg" / "aggregate.json")
+    # Idempotent rerun reuses results.json files.
+    agg2 = eval_and_aggregate(
+        save_root, benches, str(tmp_path / "agg"),
+        max_new_tokens=8, greedy=True,
+    )
+    assert agg2["table"] == agg["table"]

@@ -1,0 +1,121 @@
+"""weight_transfer: raw dump/mmap-load round trip, versioned GC, torn-write
+rejection, and the serving load-path priority (shm raw -> disk raw ->
+pickle)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from areal_tpu.system.weight_transfer import (
+    dump_raw_params,
+    load_for_serving,
+    load_raw_params,
+    shm_transfer_dir,
+)
+
+
+def _params(seed=0):
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    return {
+        "embedding": {"weight": rng.standard_normal((16, 8)).astype(np.float32)},
+        "layers": {
+            # bfloat16 leaf: the flagship dumps bf16 params, and the
+            # manifest must round-trip ml_dtypes names.
+            "attn": {"wq": rng.standard_normal((2, 8, 8)).astype(ml_dtypes.bfloat16)},
+            "ln": {"scale": np.ones((2, 8), np.float32)},
+        },
+    }
+
+
+def test_bf16_dtype_roundtrip(tmp_path):
+    import ml_dtypes
+
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(0), d, version=1)
+    got, _ = load_raw_params(d)
+    assert got["layers"]["attn"]["wq"].dtype == ml_dtypes.bfloat16
+
+
+def _assert_tree_equal(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    for k in a:
+        if isinstance(a[k], dict):
+            _assert_tree_equal(a[k], b[k])
+        else:
+            np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+def test_roundtrip_and_versions(tmp_path):
+    d = str(tmp_path / "dump")
+    p1 = _params(1)
+    dt = dump_raw_params(p1, d, version=1)
+    assert dt >= 0
+    got, v = load_raw_params(d)
+    assert v == 1
+    _assert_tree_equal(p1, got)
+
+    p2 = _params(2)
+    dump_raw_params(p2, d, version=2)
+    got2, v2 = load_raw_params(d)
+    assert v2 == 2
+    _assert_tree_equal(p2, got2)
+
+    # GC keeps the newest 2 bins.
+    for ver in (3, 4, 5):
+        dump_raw_params(_params(ver), d, version=ver)
+    bins = [b for b in os.listdir(d) if b.endswith(".bin")]
+    assert sorted(bins) == ["params-v4.bin", "params-v5.bin"]
+
+
+def test_torn_write_rejected(tmp_path):
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(0), d, version=1)
+    # Truncate the bin: manifest's total_bytes no longer matches.
+    bin_path = os.path.join(d, "params-v1.bin")
+    with open(bin_path, "r+b") as f:
+        f.truncate(os.path.getsize(bin_path) - 8)
+    assert load_raw_params(d) is None
+
+
+def test_rejects_non_dict_trees(tmp_path):
+    with pytest.raises(TypeError, match="dict-of-array"):
+        dump_raw_params({"a": [np.zeros(2)]}, str(tmp_path), version=1)
+
+
+def test_load_for_serving_priority(tmp_path):
+    model_path = str(tmp_path / "realloc")
+    shm = str(tmp_path / "shm")
+    os.makedirs(model_path)
+
+    # Only pickle present -> pickle source.
+    p_pkl = _params(10)
+    with open(os.path.join(model_path, "engine_state.pkl"), "wb") as f:
+        pickle.dump({"params": p_pkl}, f)
+    params, info = load_for_serving(model_path, shm_dir=shm)
+    assert info["source"] == "pickle"
+    _assert_tree_equal(p_pkl, params)
+
+    # Disk raw beats pickle.
+    p_disk = _params(11)
+    dump_raw_params(p_disk, model_path, version=7)
+    params, info = load_for_serving(model_path, shm_dir=shm)
+    assert info["source"] == "disk_raw" and info["version"] == 7
+    _assert_tree_equal(p_disk, params)
+
+    # shm raw beats disk raw.
+    p_shm = _params(12)
+    dump_raw_params(p_shm, shm, version=8)
+    params, info = load_for_serving(model_path, shm_dir=shm)
+    assert info["source"] == "shm_raw" and info["version"] == 8
+    _assert_tree_equal(p_shm, params)
+    assert info["load_s"] >= 0
+
+
+def test_shm_dir_shape():
+    d = shm_transfer_dir("exp", "trial", "actor")
+    if d is not None:  # machines without /dev/shm skip the path check
+        assert d.endswith("areal_tpu/exp/trial/actor")

@@ -15,4 +15,20 @@ from areal_tpu.api.cli_args import SFTExpConfig
 from training.utils import main
 
 if __name__ == "__main__":
-    main("sft", SFTExpConfig)
+    if any(a.startswith("n_hosts=") for a in sys.argv[1:]):
+        # Pod-scale path: one SPMD process per host over a global mesh
+        # (training/multihost.py) instead of the single-host controller.
+        from training.multihost import _HOST_ENV, _parse_argv, host_main, launch_multihost
+
+        meta, cfg, overrides = _parse_argv(sys.argv[1:])
+        rank_env = os.environ.get(_HOST_ENV)
+        if rank_env is None:
+            launch_multihost(
+                meta["n_hosts"], overrides, meta["mesh_spec"],
+                meta["steps"], meta["out"],
+            )
+        else:
+            host_main(cfg, int(rank_env), meta["n_hosts"],
+                      meta["mesh_spec"], meta["steps"], meta["out"])
+    else:
+        main("sft", SFTExpConfig)
