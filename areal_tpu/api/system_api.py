@@ -124,7 +124,14 @@ class GenerationServerConfig:
     max_concurrent_requests: int = 64
     max_seq_len: int = 2048
     kv_page_size: int = 128
+    # Token capacity of the paged KV pool (None -> B * max_seq_len, i.e.
+    # no memory pressure). Sizing it below that serves long contexts in
+    # bounded HBM with preempt-and-resubmit under pressure.
+    kv_pool_tokens: Optional[int] = None
     decode_block_steps: int = 16
+    # Shard the engine over this many local devices (megatron-style TP
+    # via GSPMD; see engine/serving.serving_mesh).
+    tensor_parallel: int = 1
     seed: int = 1
 
     @property

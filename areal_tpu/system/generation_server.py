@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import asyncio
 import os
-import pickle
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -52,6 +51,13 @@ class GenerationServer(Worker):
         raw = model._raw
         self.tokenizer = model.tokenizer
         eos = self.tokenizer.eos_token_id if self.tokenizer else None
+        from areal_tpu.engine.serving import serving_mesh
+
+        mesh = (
+            serving_mesh(config.tensor_parallel)
+            if config.tensor_parallel > 1
+            else None
+        )
         self.engine = ServingEngine(
             cfg=raw["cfg"],
             params=raw["params"],
@@ -60,9 +66,13 @@ class GenerationServer(Worker):
             decode_block_steps=config.decode_block_steps,
             eos_token_id=eos,
             seed=config.seed + config.server_index,
+            page_size=config.kv_page_size,
+            kv_pool_tokens=config.kv_pool_tokens,
+            mesh=mesh,
         )
         self.engine.start()
         self._n_interrupted = 0
+        self._last_load_info = None
 
         # HTTP server on its own thread + loop.
         self._http_loop = asyncio.new_event_loop()
@@ -153,12 +163,13 @@ class GenerationServer(Worker):
         model_path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
         try:
-            params = await asyncio.get_running_loop().run_in_executor(
+            params, info = await asyncio.get_running_loop().run_in_executor(
                 None, self._load_params, model_path
             )
         except Exception as e:
             logger.exception("weight update load failed")
             return web.json_response({"success": False, "error": repr(e)}, status=500)
+        self._last_load_info = info
         n_running = self.engine.n_running
         version = d.get("version")
         self.engine.update_params(
@@ -166,21 +177,34 @@ class GenerationServer(Worker):
             allow_interrupt=allow_interrupt,
             version=None if version is None else int(version),
         )
+        logger.info(
+            f"weight update: source={info['source']} "
+            f"load={info['load_s']:.3f}s dump_version={info['version']}"
+        )
         return web.json_response(
-            {"success": True, "num_paused_requests": n_running}
+            {
+                "success": True,
+                "num_paused_requests": n_running,
+                "load_s": info["load_s"],
+                "source": info["source"],
+            }
         )
 
-    @staticmethod
-    def _load_params(model_path: str):
-        state_file = os.path.join(model_path, "engine_state.pkl")
-        if os.path.exists(state_file):
-            with open(state_file, "rb") as f:
-                return pickle.load(f)["params"]
-        # Fall back to an HF checkpoint directory.
-        from areal_tpu.models.hf import load_hf_model
+    def _load_params(self, model_path: str):
+        """Fastest source first: tmpfs raw -> disk raw -> pickle -> HF
+        (system/weight_transfer.load_for_serving)."""
+        from areal_tpu.system.weight_transfer import (
+            load_for_serving, shm_transfer_dir,
+        )
 
-        _, params = load_hf_model(model_path)
-        return params
+        # The realloc dump dir is .../param_realloc/<role>; the tmpfs
+        # fast-path dump (model_worker._param_realloc) is keyed by the
+        # same role name.
+        role = os.path.basename(model_path.rstrip("/"))
+        shm = shm_transfer_dir(
+            self.cfg.experiment_name, self.cfg.trial_name, role
+        )
+        return load_for_serving(model_path, shm_dir=shm)
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
         m = self.engine.metrics()
@@ -191,6 +215,14 @@ class GenerationServer(Worker):
             f"areal:queue_depth {m['queue_depth']}",
             f"areal:num_interrupted_reqs {float(self._n_interrupted)}",
             f"areal:weight_version {float(self.engine.version)}",
+            f"areal:kv_pages_free {m['kv_pages_free']}",
+            f"areal:kv_pages_total {m['kv_pages_total']}",
+            f"areal:num_preempted_reqs {m['num_preempted_reqs']}",
+            f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
+            f"areal:last_weight_load_s "
+            f"{self._last_load_info['load_s'] if self._last_load_info else 0.0}",
+            f"areal:weight_load_fast_path "
+            f"{1.0 if (self._last_load_info or {}).get('source') == 'shm_raw' else 0.0}",
         ]
         return web.Response(text="\n".join(lines) + "\n")
 

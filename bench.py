@@ -43,6 +43,79 @@ def train_step_flops(cfg, n_params: int, seqlens) -> float:
     return total
 
 
+def gen_bench(on_tpu: bool) -> float:
+    """Generation throughput on the ServingEngine (paged KV, batched
+    prefill, jitted decode blocks): sustained output tokens/sec/chip at a
+    realistic batch + context. The reference's headline gains are
+    generation-side (async RL is generation-bound, blog/AReaL_v0_3.md:125)
+    but it publishes only relative deltas, so this is reported as an
+    absolute alongside the train metric."""
+    import threading
+
+    import jax
+
+    from areal_tpu.engine.serving import GenRequest, ServingEngine
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
+            head_dim=128, intermediate_dim=8960, vocab_size=32768,
+            attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
+        )
+        n_reqs, plen, max_new, page, block = 32, 512, 512, 128, 32
+    else:
+        cfg = TransformerConfig(
+            n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
+            intermediate_dim=128, vocab_size=256, compute_dtype="float32",
+        )
+        n_reqs, plen, max_new, page, block = 2, 16, 8, 8, 4
+
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    eng = ServingEngine(
+        cfg, params,
+        max_batch_size=n_reqs,
+        max_seq_len=plen + max_new + page,
+        decode_block_steps=block,
+        prompt_bucket=page,
+        eos_token_id=None,  # budget-bound: every request emits max_new
+        page_size=page,
+        kv_pool_tokens=n_reqs * (plen + max_new + page),
+    )
+    eng.start()
+    rng = np.random.RandomState(1)
+
+    def run(n, new_tokens, tag):
+        done = threading.Event()
+        got = []
+
+        def cb(res):
+            got.append(len(res.output_ids))
+            if len(got) == n:
+                done.set()
+
+        t0 = time.perf_counter()
+        for i in range(n):
+            eng.submit(GenRequest(
+                qid=f"{tag}{i}",
+                input_ids=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
+                max_new_tokens=new_tokens,
+                done_cb=cb,
+            ))
+        assert done.wait(1800), f"gen bench stalled: {len(got)}/{n}"
+        return sum(got), time.perf_counter() - t0
+
+    # Warmup compiles prefill buckets + the decode block.
+    _, wdt = run(min(n_reqs, 8), 2 * block, "w")
+    log(f"bench: gen warmup {wdt:.2f}s")
+    toks, dt = run(n_reqs, max_new, "g")
+    eng.stop()
+    tps = toks / dt
+    log(f"bench: gen {toks} tokens in {dt:.2f}s -> {tps:.0f} tok/s/chip")
+    return tps
+
+
 def main():
     import jax
 
@@ -134,11 +207,16 @@ def main():
     tokens_per_sec = total / dt
     log(f"bench: {dt:.3f}s/step {tokens_per_sec:.0f} tok/s {tflops:.1f} TFLOP/s")
 
+    # Release the train engine's device buffers before the gen phase.
+    del eng, params
+    gen_tps = gen_bench(on_tpu)
+
     print(json.dumps({
         "metric": "train_tflops_per_chip",
         "value": round(tflops, 2),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
+        "gen_tokens_per_sec_per_chip": round(gen_tps, 1),
     }))
 
 
