@@ -64,6 +64,9 @@ class PPOHyperparameters:
     use_adaptive_kl_ctl: bool = False
     use_decoupled_loss: bool = False
     behav_imp_weight_cap: Optional[float] = None
+    # 'global' | 'dp': gradient token-normalization scope (reference
+    # ppo_interface.py:253; see JaxTrainEngine.train_batch).
+    token_normalize_scope: str = "global"
     recompute_logprob: bool = True
     fuse_rew_ref: bool = False
     success_rate_lb: float = 0.0
@@ -147,6 +150,13 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     gen_max_concurrent_requests: int = 32
     gen_max_seq_len: int = 4096
     gen_decode_block_steps: int = 16
+    gen_kv_page_size: int = 128
+    # Paged KV pool capacity in tokens (None = B * max_seq_len); sizing it
+    # below that serves long contexts in bounded HBM with
+    # preempt-and-resubmit under pressure (engine/serving.py).
+    gen_kv_pool_tokens: Optional[int] = None
+    # Shard each generation server over this many devices (GSPMD TP).
+    gen_tensor_parallel: int = 1
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

@@ -137,12 +137,23 @@ def paged_decode_attention(
     Hkv, _, pg, _ = k_pages.shape
     P = page_indices.shape[1]
     scale = float(softmax_scale) if softmax_scale is not None else hd**-0.5
+    tensor_size = mesh.shape.get("tensor", 1) if mesh is not None else 1
+    # Under tensor parallelism the kernel runs per shard with heads split
+    # on `tensor` — impossible when the head counts don't divide (the
+    # pool then replicates, ServingEngine._ensure_pool); the GSPMD-
+    # partitionable einsum path handles that layout instead.
+    tp_ok = Hkv % tensor_size == 0 and Hq % tensor_size == 0
     if impl == "auto":
         on_tpu = jax.default_backend() in ("tpu", "axon")
         impl = (
             "kernel"
-            if on_tpu and paged_attention_kernel_ok(pg, hd, P)
+            if on_tpu and paged_attention_kernel_ok(pg, hd, P) and tp_ok
             else "xla"
+        )
+    elif impl == "kernel" and not tp_ok:
+        raise ValueError(
+            f"paged-attention kernel under tensor={tensor_size} needs head "
+            f"counts divisible by it (Hq={Hq}, Hkv={Hkv}); use impl='xla'"
         )
     if impl == "xla":
         return _paged_attention_xla(q, k_pages, v_pages, lengths, page_indices, scale)

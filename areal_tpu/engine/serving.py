@@ -561,6 +561,13 @@ class ServingEngine:
             else:
                 self.params = jax.tree_util.tree_map(jnp.asarray, pending)
             jax.block_until_ready(self.params)
+            # block_until_ready does NOT wait on tunneled devices (see
+            # docs/perf_notes.md); fetch one element of the last leaf —
+            # transfers execute in order on the device stream, so its
+            # completion bounds the swap. Approximate, but two orders of
+            # magnitude better than timing dispatch.
+            last_leaf = jax.tree_util.tree_leaves(self.params)[-1]
+            jax.device_get(last_leaf.ravel()[:1])
             self.last_weight_swap_s = time.monotonic() - t0
             self.version = version if version is not None else self.version + 1
             logger.info(

@@ -149,6 +149,9 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             max_concurrent_requests=cfg.gen_max_concurrent_requests,
             max_seq_len=cfg.gen_max_seq_len,
             decode_block_steps=cfg.gen_decode_block_steps,
+            kv_page_size=cfg.gen_kv_page_size,
+            kv_pool_tokens=cfg.gen_kv_pool_tokens,
+            tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,
         )
         for i in range(cfg.n_generation_servers)

@@ -46,6 +46,7 @@ def actor_interface_args(cfg: PPOMATHExpConfig) -> dict:
         mask_no_eos_with_zero=p.mask_no_eos_with_zero,
         use_decoupled_loss=p.use_decoupled_loss,
         behav_imp_weight_cap=p.behav_imp_weight_cap,
+        token_normalize_scope=p.token_normalize_scope,
         gconfig=dataclasses.asdict(p.gconfig.new(n=p.group_size)),
     )
 

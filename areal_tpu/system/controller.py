@@ -34,11 +34,9 @@ def _run_worker_proc(
     """Subprocess entry: reconfigure name_resolve, build + run the worker."""
     try:
         os.environ.update(env)
-        # Force CPU platform if requested before jax initializes devices.
-        if env.get("JAX_PLATFORMS"):
-            import jax
+        from areal_tpu.utils.jaxenv import apply_jax_platform_override
 
-            jax.config.update("jax_platforms", env["JAX_PLATFORMS"])
+        apply_jax_platform_override()
         name_resolve.reconfigure(**name_resolve_cfg)
         from areal_tpu.system import load_worker
 
@@ -171,9 +169,12 @@ class LocalController:
             )
             master.run()
         except KeyboardInterrupt:
-            # Likely the watchdog; surface the worker's traceback if any.
+            # The watchdog interrupts on worker failure; surface the
+            # worker's traceback. A genuine Ctrl-C (no failed worker)
+            # must propagate as-is, or fault-tolerant relaunch loops
+            # would restart the run the user just tried to stop.
             self.check_worker_errors()
-            raise RuntimeError("a worker process died (no traceback captured)")
+            raise
         finally:
             stop_watchdog.set()
             self.check_worker_errors()
@@ -189,3 +190,165 @@ class LocalController:
                 logger.warning(f"terminating straggler worker pid={p.pid}")
                 p.terminate()
         self._procs.clear()
+
+
+class ClusterController:
+    """Scheduler-submitted workers + inline master: the multi-host control
+    plane (reference counterpart: realhf/apps/main.py submitting
+    `apps.remote worker` lines through the SLURM scheduler,
+    scheduler/slurm/utils.py).
+
+    Differences from LocalController: workers are launched through a
+    `SchedulerClient` (local subprocesses for one machine; a registered
+    cluster scheduler for pods) with their configs spooled as pickles to
+    `spool_dir` (a shared filesystem on real clusters), and discovery
+    runs over any name_resolve backend — typically the 'kv' TCP service
+    (base/name_resolve_kv.py), which needs no shared FS at all. When
+    `kv_address` is omitted a KvStoreServer is started in-process next to
+    the master (the usual topology: control plane on the launch host).
+    """
+
+    def __init__(
+        self,
+        exp_cfg: ExperimentConfig,
+        spool_dir: str,
+        scheduler_mode: str = "local",
+        kv_address: Optional[str] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.exp_cfg = exp_cfg
+        self.spool_dir = spool_dir
+        self.scheduler_mode = scheduler_mode
+        self.worker_env = worker_env or {}
+        self._kv_server = None
+        if kv_address is None:
+            from areal_tpu.base.name_resolve_kv import KvStoreServer
+            from areal_tpu.base import network
+
+            self._kv_server = KvStoreServer(network.gethostip(), 0).start()
+            kv_address = self._kv_server.address
+        self.kv_address = kv_address
+        self.name_resolve_cfg = {"backend": "kv", "address": kv_address}
+        from areal_tpu.scheduler.client import make_scheduler
+
+        self._sched = make_scheduler(
+            scheduler_mode, log_dir=os.path.join(spool_dir, "logs")
+        )
+        self._job_names: List[str] = []
+
+    def _submit(self, worker_type: str, config) -> str:
+        import json as _json
+        import pickle
+
+        os.makedirs(self.spool_dir, exist_ok=True)
+        cfg_path = os.path.join(
+            self.spool_dir, f"{config.worker_name.replace('/', '_')}.pkl"
+        )
+        with open(cfg_path, "wb") as f:
+            pickle.dump(config, f)
+        import areal_tpu
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(areal_tpu.__file__))
+        )
+        env = dict(self.worker_env)
+        env["PYTHONPATH"] = (
+            repo_root + os.pathsep + env.get(
+                "PYTHONPATH", os.environ.get("PYTHONPATH", "")
+            )
+        ).rstrip(os.pathsep)
+        name = self._sched.submit(
+            config.worker_name,
+            [
+                sys.executable, "-m", "areal_tpu.system.worker_main",
+                "--worker-type", worker_type,
+                "--config", cfg_path,
+                "--name-resolve", _json.dumps(self.name_resolve_cfg),
+            ],
+            env=env,
+            cwd=repo_root,
+        )
+        self._job_names.append(name)
+        return name
+
+    def start_workers(self):
+        for cfg in self.exp_cfg.model_workers:
+            self._submit("model_worker", cfg)
+        for cfg in self.exp_cfg.generation_servers:
+            self._submit("generation_server", cfg)
+        if self.exp_cfg.gserver_manager is not None:
+            self._submit("gserver_manager", self.exp_cfg.gserver_manager)
+        for cfg in self.exp_cfg.rollout_workers:
+            self._submit("rollout_worker", cfg)
+
+    def check_worker_errors(self):
+        from areal_tpu.scheduler.client import JobState
+
+        for n in self._job_names:
+            info = self._sched.find(n)
+            if info.state in (JobState.FAILED, JobState.CANCELLED):
+                log = os.path.join(
+                    self.spool_dir, "logs", n.replace("/", "_") + ".log"
+                )
+                tail = ""
+                try:
+                    with open(log) as f:
+                        tail = f.read()[-3000:]
+                except OSError:
+                    pass
+                raise RuntimeError(f"worker {n} -> {info.state}:\n{tail}")
+
+    def _watchdog(self, stop_event):
+        import _thread
+
+        from areal_tpu.scheduler.client import JobState
+
+        while not stop_event.wait(0.5):
+            for n in self._job_names:
+                if self._sched.find(n).state in (
+                    JobState.FAILED, JobState.CANCELLED
+                ):
+                    logger.error(
+                        f"worker {n} failed; interrupting master"
+                    )
+                    _thread.interrupt_main()
+                    return
+
+    def run(self) -> Dict:
+        """Blocking: start workers via the scheduler, run master inline."""
+        import threading
+
+        name_resolve.reconfigure(**self.name_resolve_cfg)
+        self.start_workers()
+        stop_watchdog = threading.Event()
+        watchdog = threading.Thread(
+            target=self._watchdog, args=(stop_watchdog,), daemon=True
+        )
+        watchdog.start()
+
+        from areal_tpu.system.master_worker import MasterWorker
+
+        master = MasterWorker()
+        try:
+            master.configure(
+                self.exp_cfg.master,
+                experiment_name=self.exp_cfg.experiment_name,
+                trial_name=self.exp_cfg.trial_name,
+                worker_name="master",
+            )
+            master.run()
+        except KeyboardInterrupt:
+            # See LocalController.run: re-raise genuine Ctrl-C.
+            self.check_worker_errors()
+            raise
+        finally:
+            stop_watchdog.set()
+            self.check_worker_errors()
+            self.stop()
+        return {"global_step": master.step_info.global_step}
+
+    def stop(self):
+        self._sched.stop_all()
+        if self._kv_server is not None:
+            self._kv_server.stop()
+            self._kv_server = None

@@ -1,0 +1,21 @@
+"""JAX environment helpers shared by every process entry point."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platform_override():
+    """Honor a JAX_PLATFORMS env override even when an early jax import
+    already happened.
+
+    This environment's sitecustomize imports jax (and its TPU plugin) at
+    interpreter startup, so setting the env var alone doesn't stick — but
+    backends initialize lazily, so a `jax.config.update` before first
+    device use wins. Every spawned entry point (workers, eval jobs,
+    multihost SPMD hosts) calls this first."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -1,0 +1,164 @@
+"""ClusterController: scheduler-submitted workers + KV-service discovery
+(no shared-FS name_resolve) running a full mock-SFT experiment e2e — the
+multi-host control-plane topology (reference apps/main.py + SLURM
+scheduler) simulated on one machine."""
+
+import uuid
+
+import pytest
+
+from areal_tpu.api.config import (
+    DatasetAbstraction,
+    ModelAbstraction,
+    ModelBackendAbstraction,
+    ModelInterfaceAbstraction,
+    ModelName,
+    ModelShardID,
+)
+from areal_tpu.api.data_api import MicroBatchSpec
+from areal_tpu.api.dfg import MFCDef, ModelInterfaceType
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    ExperimentSaveEvalControl,
+    MasterWorkerConfig,
+    ModelShardSpec,
+    ModelWorkerConfig,
+)
+from areal_tpu.system.controller import ClusterController
+from tests import fixtures
+
+TINY_CFG = dict(
+    vocab_size=128, hidden_dim=32, n_layers=2, n_q_heads=2, n_kv_heads=1,
+    head_dim=16, intermediate_dim=64, max_position_embeddings=256,
+    compute_dtype="float32",
+)
+
+
+def test_cluster_controller_sft_mock(tmp_path):
+    exp, trial = f"cc-sft-{uuid.uuid4().hex[:6]}", "t0"
+    rows = fixtures.make_sft_rows(32, seed=3)
+    texts = [r["prompt"] + " " + r["answer"] for r in rows]
+    tok = fixtures.train_tiny_tokenizer(texts, tmp_path)
+    tok_dir = str(tmp_path / "tok_full")
+    tok.save_pretrained(tok_dir)
+    data_path = fixtures.write_jsonl(rows, tmp_path / "sft.jsonl")
+
+    n_workers = 2
+    sft = MFCDef(
+        name="sft_train",
+        model_name=ModelName("default", 0),
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=8,
+        input_keys=("packed_input_ids", "prompt_mask"),
+        mb_spec=MicroBatchSpec(n_mbs=1),
+    )
+    workers = [f"model_worker/{i}" for i in range(n_workers)]
+    model_workers = [
+        ModelWorkerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            worker_index=i,
+            shards=[
+                ModelShardSpec(
+                    id=ModelShardID(
+                        ModelName("default", 0), host_rank=i, n_hosts=n_workers
+                    ),
+                    model=ModelAbstraction(
+                        "tpu_transformer",
+                        args=dict(config=TINY_CFG, tokenizer_path=tok_dir),
+                    ),
+                    backend=ModelBackendAbstraction("mock_train"),
+                    interface=ModelInterfaceAbstraction("sft"),
+                )
+            ],
+            datasets=[
+                DatasetAbstraction(
+                    "prompt_answer",
+                    args=dict(max_length=64, dataset_path=data_path),
+                )
+            ],
+            tokenizer_path=tok_dir,
+            dataset_dp_rank=i,
+            dataset_dp_size=n_workers,
+            train_batch_size=8,
+            total_train_epochs=2,
+        )
+        for i in range(n_workers)
+    ]
+    master = MasterWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(
+            total_train_epochs=2, benchmark_steps=4
+        ),
+        rpcs=[sft],
+        model_topos={str(ModelName("default", 0)): workers},
+        data_hosts=workers,
+        n_model_workers=n_workers,
+        train_batch_size=8,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp, trial_name=trial, master=master,
+        model_workers=model_workers,
+    )
+    ctl = ClusterController(
+        cfg,
+        spool_dir=str(tmp_path / "spool"),
+        scheduler_mode="local",
+        worker_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "AREAL_FILEROOT": str(tmp_path / "fileroot"),
+        },
+    )
+    result = ctl.run()
+    assert result["global_step"] == 4
+
+
+def test_cluster_controller_surfaces_worker_failure(tmp_path):
+    """A worker that dies must surface its log tail, not hang the master."""
+    exp, trial = f"cc-fail-{uuid.uuid4().hex[:6]}", "t0"
+    bad = ModelWorkerConfig(
+        experiment_name=exp, trial_name=trial, worker_index=0,
+        shards=[
+            ModelShardSpec(
+                id=ModelShardID(ModelName("default", 0), host_rank=0, n_hosts=1),
+                model=ModelAbstraction(
+                    "tpu_transformer", args=dict(config=dict(TINY_CFG))
+                ),
+                backend=ModelBackendAbstraction("no_such_backend"),
+                interface=ModelInterfaceAbstraction("sft"),
+            )
+        ],
+        train_batch_size=8,
+    )
+    master = MasterWorkerConfig(
+        experiment_name=exp, trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(benchmark_steps=2),
+        rpcs=[
+            MFCDef(
+                name="sft_train",
+                model_name=ModelName("default", 0),
+                interface_type=ModelInterfaceType.TRAIN_STEP,
+                interface_impl=None,
+                n_seqs=8,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                mb_spec=MicroBatchSpec(n_mbs=1),
+            )
+        ],
+        model_topos={str(ModelName("default", 0)): ["model_worker/0"]},
+        data_hosts=["model_worker/0"],
+        n_model_workers=1,
+        train_batch_size=8,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp, trial_name=trial, master=master,
+        model_workers=[bad],
+    )
+    ctl = ClusterController(
+        cfg, spool_dir=str(tmp_path / "spool"), scheduler_mode="local",
+        worker_env={"JAX_PLATFORMS": "cpu"},
+    )
+    with pytest.raises(RuntimeError, match="model_worker/0"):
+        ctl.run()

@@ -59,13 +59,9 @@ def host_main(
     determinism of the dataloader (same seed, same files) keeps the hosts
     dispatching identical programs, which is the SPMD contract.
     """
-    # Honor a JAX_PLATFORMS override even when an early jax import already
-    # happened (backends initialize lazily — same dance as
-    # system/controller._run_worker_proc).
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
+    from areal_tpu.utils.jaxenv import apply_jax_platform_override
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    apply_jax_platform_override()
 
     from areal_tpu.parallel.distributed import setup_host_group
 

@@ -42,6 +42,17 @@ def run_experiment(experiment_type: str, cfg, worker_env: Optional[dict] = None)
         name_resolve_cfg["record_root"] = cfg.name_resolve_root
     constants.set_experiment_trial_names(cfg.experiment_name, cfg.trial_name)
 
+    # Propagate a JAX platform override into the worker bootstrap: env
+    # vars alone don't stick in spawned children (this environment's
+    # sitecustomize imports jax before user env takes effect), so the
+    # controller must jax.config.update in each worker — which it only
+    # does for platforms named in worker_env.
+    worker_env = dict(worker_env or {})
+    import os as _os
+
+    if _os.environ.get("JAX_PLATFORMS") and "JAX_PLATFORMS" not in worker_env:
+        worker_env["JAX_PLATFORMS"] = _os.environ["JAX_PLATFORMS"]
+
     attempt = 0
     while True:
         exp_cfg = make_experiment(experiment_type, cfg)
