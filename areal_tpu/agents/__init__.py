@@ -1,3 +1,4 @@
 from areal_tpu.agents import math_single_step  # noqa: F401  (registers)
 from areal_tpu.agents import envs  # noqa: F401
 from areal_tpu.agents import math_multi_turn  # noqa: F401
+from areal_tpu.agents import null  # noqa: F401

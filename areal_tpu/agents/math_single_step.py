@@ -85,57 +85,9 @@ class MathSingleStepAgent(Agent):
             ],
             np.float32,
         )
-        n = len(bundle.seqs)
-        seq_lens = [len(s) for s in bundle.seqs]
-        plen = bundle.prompt_len
-        pmask = np.concatenate(
-            [
-                np.concatenate(
-                    [np.ones(plen, np.int64), np.zeros(l - plen, np.int64)]
-                )
-                for l in seq_lens
-            ]
-        )
-        # Shifted frame (PPO convention, reference ppo generate): the
-        # logprob of generated token at abs position p is stored at p-1.
-        shifted_lps = []
-        for seq, lp in zip(bundle.seqs, bundle.logprobs):
-            out_lp = np.asarray(lp[plen:], np.float32)  # behind-prompt lps
-            full = np.zeros(len(seq), np.float32)
-            full[plen - 1 : len(seq) - 1] = out_lp
-            shifted_lps.append(full)
-        sample = SequenceSample(
-            ids=[qid],
-            keys={
-                "packed_input_ids", "prompt_mask", "packed_logprobs",
-                "seq_no_eos_mask", "rewards",
-            },
-            data={
-                "packed_input_ids": np.concatenate(
-                    [np.asarray(s, np.int32) for s in bundle.seqs]
-                ),
-                "prompt_mask": pmask,
-                "packed_logprobs": np.concatenate(shifted_lps),
-                "seq_no_eos_mask": np.asarray(
-                    [1.0 if x else 0.0 for x in bundle.no_eos], np.float32
-                ),
-                "rewards": rewards,
-            },
-            seqlens={
-                "packed_input_ids": [seq_lens],
-                "prompt_mask": [seq_lens],
-                "packed_logprobs": [seq_lens],
-                "seq_no_eos_mask": [[1] * n],
-                "rewards": [[1] * n],
-            },
-            metadata={
-                "version_start": [min(bundle.version_start)],
-                "version_end": [max(bundle.version_end)],
-                "scores": [sr],
-                "birth_time": [0],
-            },
-        )
-        return [sample]
+        from areal_tpu.agents.common import bundle_to_sample
+
+        return [bundle_to_sample(qid, bundle, rewards, score=sr)]
 
 
 register_agent("math-single-step", MathSingleStepAgent)

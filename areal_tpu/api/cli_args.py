@@ -110,6 +110,19 @@ class BaseExperimentConfig:
     name_resolve_root: Optional[str] = None
     mb_spec_n_mbs: int = 1
     mb_spec_max_tokens: Optional[int] = None
+    # Automatic per-checkpoint offline evaluation (reference
+    # scheduler/evaluator.py AutomaticEvaluator, enabled via auto_eval):
+    # watches the save dir while training runs and submits one eval job
+    # per new checkpoint through the scheduler client.
+    auto_eval: bool = False
+    auto_eval_data_path: Optional[str] = None  # benchmark jsonl
+    auto_eval_task: str = "math"  # math | code
+    auto_eval_model_role: str = "default"  # "actor" for PPO experiments
+    auto_eval_max_new_tokens: int = 512
+    auto_eval_max_concurrent_jobs: int = 1
+    # JAX platform for eval jobs: "cpu" (default) keeps them off the
+    # accelerator the training workers exclusively hold.
+    auto_eval_device: str = "cpu"
 
 
 @dataclasses.dataclass

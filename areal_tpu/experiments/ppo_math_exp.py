@@ -51,6 +51,26 @@ def actor_interface_args(cfg: PPOMATHExpConfig) -> dict:
     )
 
 
+def critic_interface_args(cfg: PPOMATHExpConfig) -> dict:
+    """Critic-side hyperparameters (must stay consistent with the actor's
+    where shared: KL/GAE/reward shaping and the token-normalization
+    scope, or value and policy gradients normalize differently)."""
+    p = cfg.ppo
+    return dict(
+        n_minibatches=p.ppo_n_minibatches,
+        token_normalize_scope=p.token_normalize_scope,
+        value_eps_clip=p.value_eps_clip,
+        kl_ctl=p.kl_ctl,
+        adaptive_kl_ctl=p.use_adaptive_kl_ctl,
+        discount=p.discount,
+        gae_lambda=p.gae_lambda,
+        max_reward_clip=p.max_reward_clip,
+        reward_output_scaling=p.reward_output_scaling,
+        reward_output_bias=p.reward_output_bias,
+        mask_no_eos_with_zero=p.mask_no_eos_with_zero,
+    )
+
+
 def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
     n_workers = C.resolve_n_workers(cfg)
     actor = ModelName("actor", 0)
@@ -113,7 +133,9 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                 name="critic_inf",
                 model_name=critic,
                 interface_type=ModelInterfaceType.INFERENCE,
-                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                interface_impl=ModelInterfaceAbstraction(
+                "ppo_critic", args=critic_interface_args(cfg)
+            ),
                 n_seqs=n_seqs,
                 input_keys=("packed_input_ids", "prompt_mask"),
                 output_keys=("values",),
@@ -126,7 +148,9 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                 name="critic_train",
                 model_name=ModelName("critic", 1),
                 interface_type=ModelInterfaceType.TRAIN_STEP,
-                interface_impl=ModelInterfaceAbstraction("ppo_critic"),
+                interface_impl=ModelInterfaceAbstraction(
+                "ppo_critic", args=critic_interface_args(cfg)
+            ),
                 n_seqs=n_seqs,
                 input_keys=tuple(train_input_keys),
                 mb_spec=mbs,
@@ -186,7 +210,9 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                         backend=C.backend_abstraction(
                             cfg.critic, train=(replica == 1)
                         ),
-                        interface=ModelInterfaceAbstraction("ppo_critic"),
+                        interface=ModelInterfaceAbstraction(
+                            "ppo_critic", args=critic_interface_args(cfg)
+                        ),
                     )
                 )
         workers.append(C.base_model_worker(cfg, i, n_workers, shards))

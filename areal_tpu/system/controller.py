@@ -170,10 +170,21 @@ class LocalController:
             master.run()
         except KeyboardInterrupt:
             # The watchdog interrupts on worker failure; surface the
-            # worker's traceback. A genuine Ctrl-C (no failed worker)
-            # must propagate as-is, or fault-tolerant relaunch loops
-            # would restart the run the user just tried to stop.
+            # worker's traceback. Workers killed WITHOUT a captured
+            # traceback (SIGKILL/OOM, native crash) must still become a
+            # RuntimeError so relaunch-recovery handles them; a genuine
+            # Ctrl-C (all workers healthy) propagates as-is so the user's
+            # stop isn't "recovered" into a restart.
             self.check_worker_errors()
+            dead = [
+                p.pid for p in self._procs
+                if (not p.is_alive()) and p.exitcode not in (0, None)
+            ]
+            if dead:
+                raise RuntimeError(
+                    f"worker process(es) died without a traceback "
+                    f"(killed/native crash): pids={dead}"
+                )
             raise
         finally:
             stop_watchdog.set()
@@ -338,13 +349,18 @@ class ClusterController:
             )
             master.run()
         except KeyboardInterrupt:
-            # See LocalController.run: re-raise genuine Ctrl-C.
+            # See LocalController.run: worker failure -> RuntimeError via
+            # check_worker_errors; genuine Ctrl-C re-raises.
             self.check_worker_errors()
             raise
         finally:
             stop_watchdog.set()
-            self.check_worker_errors()
-            self.stop()
+            try:
+                self.check_worker_errors()
+            finally:
+                # Always tear down: leaking scheduler jobs + the KV
+                # server would collide with a recovery relaunch.
+                self.stop()
         return {"global_step": master.step_info.global_step}
 
     def stop(self):

@@ -19,10 +19,16 @@ chip running this framework outruns an H800 running the reference.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from areal_tpu.utils.jaxenv import apply_jax_platform_override
+
+apply_jax_platform_override()
 
 BASELINE_TFLOPS = 198.0
 

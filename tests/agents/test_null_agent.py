@@ -1,0 +1,63 @@
+"""NullAgent: generation-only trajectories, no env/verify calls
+(reference realhf/impl/agent/null_agent.py)."""
+
+import asyncio
+
+import numpy as np
+
+import areal_tpu.agents  # noqa: F401  (registers)
+from areal_tpu.agents.null import NullAgent
+from areal_tpu.api.agent_api import make_agent
+from areal_tpu.api.config import AgentAbstraction
+from areal_tpu.api.data_api import SequenceSample
+from areal_tpu.api.model_api import BundledGenerationOutputs
+
+
+def _prompt(qid="q0"):
+    return SequenceSample.from_default(
+        ids=[qid], seqlens=[3], data={"packed_prompts": np.array([5, 6, 7])},
+    )
+
+
+def _bundle():
+    seqs = [[5, 6, 7, 11, 12], [5, 6, 7, 13, 14, 15]]
+    return BundledGenerationOutputs(
+        qid="q0",
+        prompt_ids=[5, 6, 7],
+        seqs=seqs,
+        logprobs=[[0.0] * len(s) for s in seqs],
+        no_eos=[True, False],
+        version_start=[3, 3],
+        version_end=[3, 3],
+    )
+
+
+def test_null_agent_multi_episode():
+    agent = NullAgent(max_new_tokens=8, episode_length=3, reward=1.5)
+    obs_q, act_q = asyncio.Queue(), asyncio.Queue()
+
+    async def run():
+        async def feeder():
+            for _ in range(3):
+                await obs_q.get()
+                await act_q.put(_bundle())
+
+        task = asyncio.create_task(feeder())
+        out = await agent.collect_trajectory(_prompt(), None, obs_q, act_q)
+        await task
+        return out
+
+    samples = asyncio.run(run())
+    assert len(samples) == 3  # one per episode turn
+    s = samples[0]
+    assert s.data["rewards"].tolist() == [1.5, 1.5]
+    assert s.data["packed_input_ids"].shape[0] == 5 + 6
+    # prompt_mask covers exactly the prompt span of each group member
+    assert s.data["prompt_mask"].sum() == 2 * 3
+    assert s.data["seq_no_eos_mask"].tolist() == [1.0, 0.0]
+    assert s.metadata["version_start"] == [3]
+
+
+def test_null_agent_registered():
+    a = make_agent(AgentAbstraction("null", args=dict(max_new_tokens=4)))
+    assert isinstance(a, NullAgent)

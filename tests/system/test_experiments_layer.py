@@ -228,3 +228,59 @@ def test_async_multi_turn_agent_selection(tmp_path):
     assert agent.type_ == "math-multi-turn"
     assert agent.args["num_turns"] == 3
     assert agent.args["turn_level_discount"] == 0.9
+
+
+def test_auto_evaluator_wiring(tmp_path, monkeypatch):
+    """run_experiment starts/drains the AutomaticEvaluator when
+    cfg.auto_eval is set (reference master starts it under auto_eval)."""
+    import threading
+
+    import training.utils as TU
+    from areal_tpu.api.cli_args import SFTExpConfig
+
+    calls = {"init": None, "steps": 0, "drained": False}
+
+    class StubEvaluator:
+        def __init__(self, **kw):
+            calls["init"] = kw
+            self.scheduler = type(
+                "S", (), {"stop_all": staticmethod(lambda: None)}
+            )()
+
+        def step(self):
+            calls["steps"] += 1
+
+        def run_until_idle(self, timeout):
+            calls["drained"] = True
+
+        def results(self):
+            return {2: 0.5}
+
+    monkeypatch.setattr(
+        "areal_tpu.scheduler.evaluator.AutomaticEvaluator", StubEvaluator
+    )
+    cfg = SFTExpConfig(
+        experiment_name="ae", trial_name="t0",
+        auto_eval=True, auto_eval_data_path="/data/bench.jsonl",
+        auto_eval_task="code", auto_eval_model_role="actor",
+    )
+    stop = TU._start_auto_evaluator(cfg)
+    assert stop is not None
+    assert calls["init"]["task"] == "code"
+    assert calls["init"]["save_root"].endswith("/actor")
+    assert calls["init"]["data_path"] == "/data/bench.jsonl"
+    deadline = threading.Event()
+    deadline.wait(2.5)  # let the tick thread run at least once
+    stop(drain_timeout=5)
+    assert calls["drained"]
+
+    # auto_eval without a data path is a config error.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="auto_eval_data_path"):
+        TU._start_auto_evaluator(
+            SFTExpConfig(experiment_name="ae2", trial_name="t0", auto_eval=True)
+        )
+
+    # Disabled -> no evaluator.
+    assert TU._start_auto_evaluator(SFTExpConfig()) is None
