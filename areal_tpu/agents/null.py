@@ -52,7 +52,10 @@ class NullAgent(Agent):
             await obs_queue.put((qid, prompt_ids, self.gconfig))
             bundle: BundledGenerationOutputs = await act_queue.get()
             rewards = np.full((len(bundle.seqs),), self.reward, np.float32)
-            samples.append(bundle_to_sample(qid, bundle, rewards, score=0.0))
+            # Per-turn sample ids: the sequence buffer keys samples by id,
+            # so multi-episode trajectories must not collide on qid.
+            sid = qid if self.episode_length == 1 else f"{qid}-t{turn}"
+            samples.append(bundle_to_sample(sid, bundle, rewards, score=0.0))
         return samples
 
 

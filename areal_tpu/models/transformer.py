@@ -192,7 +192,7 @@ def _attention_block(
 
     impl = resolve_attn_impl(attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads)
     sharded = mesh is not None and mesh.size > 1
-    if sharded and impl != "reference":
+    if sharded and impl not in ("reference", "ring"):
         # Never run a bare pallas_call inside a sharded jit — GSPMD
         # cannot partition it (it replicates or fails). Only splash has a
         # shard_map wrapping; anything else falls back to the einsum
@@ -201,7 +201,19 @@ def _attention_block(
             mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads
         ):
             impl = "reference"
-    if sharded and impl == "splash":
+    if impl == "ring":
+        # Context parallelism: KV chunks ring-rotate over the seq axis
+        # (O(T/seq) per-device attention memory — the long-context path).
+        from areal_tpu.ops.ring_attention import ring_ok, ring_packed_attention
+
+        if not (sharded and ring_ok(mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads)):
+            raise ValueError(
+                "attn_impl='ring' needs a mesh with seq > 1 and divisible "
+                f"shapes (R={R}, T={T}, Hq={cfg.n_q_heads}, "
+                f"Hkv={cfg.n_kv_heads}, mesh={dict(mesh.shape) if mesh else None})"
+            )
+        out = ring_packed_attention(q, k, v, segment_ids, positions, mesh)
+    elif sharded and impl == "splash":
         # pallas_call is opaque to GSPMD: run the kernel per shard under
         # shard_map with the megatron-equivalent layout.
         out = sharded_splash_attention(

@@ -142,6 +142,7 @@ class LocalController:
             )
             if failed:
                 logger.error("worker failure detected; interrupting master")
+                self._watchdog_fired = True
                 _thread.interrupt_main()
                 return
 
@@ -151,6 +152,7 @@ class LocalController:
 
         name_resolve.reconfigure(**self.name_resolve_cfg)
         self.start_workers()
+        self._watchdog_fired = False
         stop_watchdog = threading.Event()
         watchdog = threading.Thread(
             target=self._watchdog, args=(stop_watchdog,), daemon=True
@@ -169,18 +171,18 @@ class LocalController:
             )
             master.run()
         except KeyboardInterrupt:
-            # The watchdog interrupts on worker failure; surface the
-            # worker's traceback. Workers killed WITHOUT a captured
-            # traceback (SIGKILL/OOM, native crash) must still become a
-            # RuntimeError so relaunch-recovery handles them; a genuine
-            # Ctrl-C (all workers healthy) propagates as-is so the user's
-            # stop isn't "recovered" into a restart.
-            self.check_worker_errors()
-            dead = [
-                p.pid for p in self._procs
-                if (not p.is_alive()) and p.exitcode not in (0, None)
-            ]
-            if dead:
+            # Distinguish the two interrupt sources by WHO fired: only
+            # the watchdog's interrupt means a worker died (traceback or
+            # not) and must become RuntimeError for relaunch-recovery. A
+            # genuine Ctrl-C propagates as-is — the terminal delivers
+            # SIGINT to the whole process group, so workers also die
+            # nonzero, and exit codes alone can't tell the cases apart.
+            if self._watchdog_fired:
+                self.check_worker_errors()
+                dead = [
+                    p.pid for p in self._procs
+                    if (not p.is_alive()) and p.exitcode not in (0, None)
+                ]
                 raise RuntimeError(
                     f"worker process(es) died without a traceback "
                     f"(killed/native crash): pids={dead}"
@@ -322,6 +324,7 @@ class ClusterController:
                     logger.error(
                         f"worker {n} failed; interrupting master"
                     )
+                    self._watchdog_fired = True
                     _thread.interrupt_main()
                     return
 
@@ -331,6 +334,7 @@ class ClusterController:
 
         name_resolve.reconfigure(**self.name_resolve_cfg)
         self.start_workers()
+        self._watchdog_fired = False
         stop_watchdog = threading.Event()
         watchdog = threading.Thread(
             target=self._watchdog, args=(stop_watchdog,), daemon=True
@@ -349,14 +353,19 @@ class ClusterController:
             )
             master.run()
         except KeyboardInterrupt:
-            # See LocalController.run: worker failure -> RuntimeError via
-            # check_worker_errors; genuine Ctrl-C re-raises.
-            self.check_worker_errors()
+            # See LocalController.run: only the watchdog's interrupt is a
+            # worker failure; genuine Ctrl-C re-raises untouched.
+            if self._watchdog_fired:
+                self.check_worker_errors()
+                raise RuntimeError(
+                    "a worker job failed (state captured by scheduler)"
+                )
             raise
         finally:
             stop_watchdog.set()
             try:
-                self.check_worker_errors()
+                if self._watchdog_fired:
+                    self.check_worker_errors()
             finally:
                 # Always tear down: leaking scheduler jobs + the KV
                 # server would collide with a recovery relaunch.

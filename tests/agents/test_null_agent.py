@@ -49,6 +49,8 @@ def test_null_agent_multi_episode():
 
     samples = asyncio.run(run())
     assert len(samples) == 3  # one per episode turn
+    # Per-turn ids: the sequence buffer keys by id, so turns must not collide.
+    assert [x.ids[0] for x in samples] == ["q0-t0", "q0-t1", "q0-t2"]
     s = samples[0]
     assert s.data["rewards"].tolist() == [1.5, 1.5]
     assert s.data["packed_input_ids"].shape[0] == 5 + 6

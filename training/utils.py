@@ -133,10 +133,14 @@ def _start_auto_evaluator(cfg):
             except Exception:
                 logger.warning("auto-eval step failed", exc_info=True)
 
-    threading.Thread(target=_tick, daemon=True).start()
+    tick_thread = threading.Thread(target=_tick, daemon=True)
+    tick_thread.start()
 
     def stop(drain_timeout: float = 600.0, drain: bool = True):
         stop_event.set()
+        # The evaluator is not thread-safe: an in-flight tick must finish
+        # before the drain touches evaluator state from this thread.
+        tick_thread.join(timeout=60)
         try:
             if drain:
                 # One final discovery pass + drain so the last checkpoint
