@@ -30,9 +30,18 @@ def _to_host(tree: Any) -> Any:
 
 def save_engine_state(engine, save_dir: str):
     os.makedirs(save_dir, exist_ok=True)
+    # Accessors, not attributes: an offloaded engine keeps params on host
+    # (engine.params is None) and get_params/get_opt_state return the
+    # host copies without re-occupying HBM.
+    params = engine.get_params() if hasattr(engine, "get_params") else engine.params
+    opt = (
+        engine.get_opt_state()
+        if hasattr(engine, "get_opt_state")
+        else engine.opt_state
+    )
     state = {
-        "params": _to_host(engine.params),
-        "opt_state": _to_host(engine.opt_state) if engine.opt_state is not None else None,
+        "params": _to_host(params),
+        "opt_state": _to_host(opt) if opt is not None else None,
         "version": engine.version,
     }
     tmp = os.path.join(save_dir, f"{_STATE_FILE}.tmp.{os.getpid()}")
@@ -46,6 +55,8 @@ def load_engine_state(engine, load_dir: str):
     path = os.path.join(load_dir, _STATE_FILE)
     with open(path, "rb") as f:
         state = pickle.load(f)
+    if hasattr(engine, "_ensure_loaded"):
+        engine._ensure_loaded()  # restoring over an offloaded engine
     engine.set_params(state["params"])
     if state["opt_state"] is not None and engine.opt_state is not None:
         # Restore optimizer state with the engine's shardings.

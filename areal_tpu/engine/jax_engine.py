@@ -139,6 +139,9 @@ class JaxTrainEngine(TrainEngine):
         self._jit_cache: Dict[Any, Any] = {}
         self.version = 0
         self._gen_calls = 0
+        self._offloaded = False
+        self._host_params = None
+        self._host_opt_state = None
 
     # ------------------------------------------------------------------
     # Batch building
@@ -437,6 +440,7 @@ class JaxTrainEngine(TrainEngine):
         standard response mask / loss_mask (_dp_token_weights).
         """
         assert self.optimizer is not None, "engine built without optimizer"
+        self._ensure_loaded()
         if token_normalize_scope not in ("global", "dp"):
             raise ValueError(
                 f"unknown token_normalize_scope {token_normalize_scope!r}"
@@ -531,6 +535,7 @@ class JaxTrainEngine(TrainEngine):
         """Gradient-free forward; returns a SequenceSample keyed
         `output_key` with per-token arrays aligned to the main key."""
         output = output or ("values" if self.model_cfg.is_critic else "logprobs")
+        self._ensure_loaded()
         mbs, _, bwd_indices = input_.split(mb_spec)
         main_key = input_._main_key()
         per_mb_flat: List[np.ndarray] = []
@@ -584,6 +589,7 @@ class JaxTrainEngine(TrainEngine):
         # Default RNG: fold in a per-call counter so repeated generate
         # calls draw independent sampling streams.
         self._gen_calls += 1
+        self._ensure_loaded()
         rng = rng if rng is not None else jax.random.PRNGKey(self._gen_calls)
         eos = getattr(tokenizer, "eos_token_id", None) if tokenizer is not None else None
         with jax.sharding.set_mesh(self.mesh):
@@ -595,10 +601,65 @@ class JaxTrainEngine(TrainEngine):
     # State
     # ------------------------------------------------------------------
 
+    def offload(self):
+        """Move params + optimizer state to host memory, freeing HBM for
+        other models colocated on this worker (reference
+        ReaLModel.async_offload, real_llm_api.py:307 — pinned-memory +
+        side-stream there; here a host fetch, restored lazily by the
+        next engine call)."""
+        if self._offloaded:
+            return
+        leaves = jax.tree_util.tree_leaves(self.params)
+        if leaves and not leaves[0].is_fully_addressable:
+            # Multi-host GSPMD arrays can't be fetched from one process;
+            # offload would need a per-shard protocol. Stay resident.
+            logger.warning(
+                "offload skipped: params span multiple hosts "
+                "(not fully addressable)"
+            )
+            return
+        self._host_params = jax.device_get(self.params)
+        self._host_opt_state = (
+            jax.device_get(self.opt_state) if self.opt_state is not None else None
+        )
+        self.params = None
+        self.opt_state = None
+        self._offloaded = True
+        logger.info("engine params offloaded to host")
+
+    def _ensure_loaded(self):
+        if not getattr(self, "_offloaded", False):
+            return
+        self.params = jax.device_put(self._host_params, self._param_shardings)
+        if self._host_opt_state is not None:
+            self.opt_state = jax.device_put(
+                self._host_opt_state, self._opt_shardings
+            )
+        self._host_params = None
+        self._host_opt_state = None
+        self._offloaded = False
+        logger.info("engine params restored to device")
+
     def get_params(self):
+        """Current params; while offloaded, the HOST copy is returned
+        directly — every caller (checkpoint dump, HF export, weight
+        transfer) copies to host anyway, and restoring to HBM here could
+        OOM the colocated model the offload made room for."""
+        if self._offloaded:
+            return self._host_params
         return self.params
 
+    def get_opt_state(self):
+        """Optimizer state under the same offload-transparency contract
+        as get_params."""
+        if self._offloaded:
+            return self._host_opt_state
+        return self.opt_state
+
     def set_params(self, params):
+        self._offloaded = False
+        self._host_params = None
+        self._host_opt_state = None
         self.params = jax.device_put(params, param_shardings(params, self.mesh))
 
 

@@ -190,7 +190,11 @@ class LocalController:
             raise
         finally:
             stop_watchdog.set()
-            self.check_worker_errors()
+            if self._watchdog_fired:
+                # Only surface worker errors the watchdog saw: teardown
+                # noise from a Ctrl-C'd worker must not convert the
+                # user's stop into a relaunch-triggering RuntimeError.
+                self.check_worker_errors()
             self.join(timeout=30)
         return {"global_step": master.step_info.global_step}
 

@@ -166,7 +166,13 @@ class ModelWorker(Worker):
         elif htype == "evaluate":
             self._evaluate_model(model_name)
         elif htype == "offload":
-            logger.debug("offload hook: params stay sharded on TPU; no-op")
+            model = self.models.get(model_name)
+            if model is not None and hasattr(model.module, "offload"):
+                # Free the idle model's HBM; the engine restores lazily
+                # on its next call (jax_engine.offload).
+                model.module.offload()
+            else:
+                logger.debug("offload hook: engine has no offload; no-op")
         elif htype == "param_realloc":
             self._param_realloc(hook, step)
         else:

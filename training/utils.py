@@ -141,6 +141,12 @@ def _start_auto_evaluator(cfg):
         # The evaluator is not thread-safe: an in-flight tick must finish
         # before the drain touches evaluator state from this thread.
         tick_thread.join(timeout=60)
+        if tick_thread.is_alive():
+            logger.warning(
+                "auto-eval tick thread still busy after 60s; skipping the "
+                "final drain to avoid racing it"
+            )
+            drain = False
         try:
             if drain:
                 # One final discovery pass + drain so the last checkpoint
