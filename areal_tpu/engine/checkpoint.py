@@ -55,19 +55,31 @@ def load_engine_state(engine, load_dir: str):
     path = os.path.join(load_dir, _STATE_FILE)
     with open(path, "rb") as f:
         state = pickle.load(f)
-    if hasattr(engine, "_ensure_loaded"):
-        engine._ensure_loaded()  # restoring over an offloaded engine
+    if hasattr(engine, "drop_offloaded_state"):
+        # About to overwrite both params and optimizer state: discard any
+        # offloaded host copies instead of restoring them to HBM first.
+        engine.drop_offloaded_state()
     engine.set_params(state["params"])
-    if state["opt_state"] is not None and engine.opt_state is not None:
-        # Restore optimizer state with the engine's shardings.
-        ref = engine.opt_state
+    opt_shardings = getattr(engine, "_opt_shardings", None)
+    if state["opt_state"] is not None and (
+        engine.opt_state is not None or opt_shardings is not None
+    ):
+        # Restore optimizer state with the engine's shardings (prefer the
+        # sharding pytree: valid even when opt_state itself is None).
         flat_new, treedef = jax.tree_util.tree_flatten(state["opt_state"])
-        flat_ref = jax.tree_util.tree_leaves(ref)
-        assert len(flat_new) == len(flat_ref), "optimizer state shape mismatch"
-        restored = [
-            jax.device_put(n, r.sharding) if hasattr(r, "sharding") else n
-            for n, r in zip(flat_new, flat_ref)
-        ]
+        if opt_shardings is not None:
+            flat_ref = jax.tree_util.tree_leaves(opt_shardings)
+            assert len(flat_new) == len(flat_ref), "optimizer state mismatch"
+            restored = [
+                jax.device_put(n, s) for n, s in zip(flat_new, flat_ref)
+            ]
+        else:
+            flat_ref = jax.tree_util.tree_leaves(engine.opt_state)
+            assert len(flat_new) == len(flat_ref), "optimizer state mismatch"
+            restored = [
+                jax.device_put(n, r.sharding) if hasattr(r, "sharding") else n
+                for n, r in zip(flat_new, flat_ref)
+            ]
         engine.opt_state = jax.tree_util.tree_unflatten(treedef, restored)
     engine.version = int(state.get("version", 0))
     logger.info(f"loaded engine state from {load_dir}")

@@ -656,10 +656,23 @@ class JaxTrainEngine(TrainEngine):
             return self._host_opt_state
         return self.opt_state
 
-    def set_params(self, params):
+    def drop_offloaded_state(self):
+        """Discard offloaded host copies WITHOUT restoring them — for
+        callers about to overwrite both params and optimizer state
+        (checkpoint load), where restoring first would double-occupy HBM."""
         self._offloaded = False
         self._host_params = None
         self._host_opt_state = None
+
+    def set_params(self, params):
+        if self._offloaded and self._host_opt_state is not None:
+            # Param realloc swaps weights but 'optimizer state stays
+            # local' (model_worker._param_realloc): the offloaded moments
+            # must come back, not be dropped.
+            self.opt_state = jax.device_put(
+                self._host_opt_state, self._opt_shardings
+            )
+        self.drop_offloaded_state()
         self.params = jax.device_put(params, param_shardings(params, self.mesh))
 
 

@@ -153,6 +153,7 @@ class LocalController:
         name_resolve.reconfigure(**self.name_resolve_cfg)
         self.start_workers()
         self._watchdog_fired = False
+        user_interrupt = False
         stop_watchdog = threading.Event()
         watchdog = threading.Thread(
             target=self._watchdog, args=(stop_watchdog,), daemon=True
@@ -187,13 +188,15 @@ class LocalController:
                     f"worker process(es) died without a traceback "
                     f"(killed/native crash): pids={dead}"
                 )
+            user_interrupt = True
             raise
         finally:
             stop_watchdog.set()
-            if self._watchdog_fired:
-                # Only surface worker errors the watchdog saw: teardown
-                # noise from a Ctrl-C'd worker must not convert the
-                # user's stop into a relaunch-triggering RuntimeError.
+            if not user_interrupt:
+                # Surface worker failures the watchdog hadn't polled yet
+                # (died in its 0.5s window as the master finished). Only
+                # a genuine Ctrl-C suppresses this — teardown noise from
+                # interrupted workers must not override the user's stop.
                 self.check_worker_errors()
             self.join(timeout=30)
         return {"global_step": master.step_info.global_step}
@@ -339,6 +342,7 @@ class ClusterController:
         name_resolve.reconfigure(**self.name_resolve_cfg)
         self.start_workers()
         self._watchdog_fired = False
+        user_interrupt = False
         stop_watchdog = threading.Event()
         watchdog = threading.Thread(
             target=self._watchdog, args=(stop_watchdog,), daemon=True
@@ -364,11 +368,12 @@ class ClusterController:
                 raise RuntimeError(
                     "a worker job failed (state captured by scheduler)"
                 )
+            user_interrupt = True
             raise
         finally:
             stop_watchdog.set()
             try:
-                if self._watchdog_fired:
+                if not user_interrupt:
                     self.check_worker_errors()
             finally:
                 # Always tear down: leaking scheduler jobs + the KV

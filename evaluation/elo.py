@@ -1,0 +1,137 @@
+"""Codeforces-style Elo rating estimation from per-problem outcomes.
+
+Counterpart of the reference's evaluation/cf_elo_caculator.py, which
+replays cached Codeforces contest standings to place the model in the
+human rating ladder. That flow needs a contest-standings cache; this
+TPU-repo equivalent estimates the rating directly by maximum likelihood
+under the standard Elo solve model
+
+    P(solve | rating r, difficulty d) = 1 / (1 + 10^((d - r) / 400))
+
+over the model's per-problem pass/fail outcomes (the same logistic the
+CF rating system induces), then reports the percentile against a human
+ratings distribution ({rating: count} JSON, the same file format the
+reference consumes).
+
+Usage:
+    python evaluation/elo.py results=/evals/step10/lcb.json \
+        difficulties=/data/lcb_difficulty.jsonl \
+        [ratings=/data/cf_ratings.json] [output=/evals/step10/elo.json]
+
+`results` is a results.json from code_eval.py (details: query_id ->
+correct); `difficulties` is a jsonl of {"query_id", "rating"}.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def solve_probability(rating: float, difficulty: float) -> float:
+    return 1.0 / (1.0 + 10.0 ** ((difficulty - rating) / 400.0))
+
+
+def log_likelihood(rating: float, outcomes: Sequence[Tuple[float, bool]]) -> float:
+    ll = 0.0
+    for difficulty, solved in outcomes:
+        p = min(max(solve_probability(rating, difficulty), 1e-12), 1 - 1e-12)
+        ll += math.log(p if solved else 1.0 - p)
+    return ll
+
+
+def estimate_rating(
+    outcomes: Sequence[Tuple[float, bool]],
+    lo: float = 0.0,
+    hi: float = 4000.0,
+    tol: float = 0.5,
+) -> float:
+    """MLE rating via ternary search (the log-likelihood is strictly
+    concave in r for the logistic model). All-solved/none-solved degenerate
+    cases clamp to the search bounds."""
+    if not outcomes:
+        raise ValueError("no outcomes to rate")
+    if all(s for _, s in outcomes):
+        return hi
+    if not any(s for _, s in outcomes):
+        return lo
+    while hi - lo > tol:
+        m1 = lo + (hi - lo) / 3
+        m2 = hi - (hi - lo) / 3
+        if log_likelihood(m1, outcomes) < log_likelihood(m2, outcomes):
+            lo = m1
+        else:
+            hi = m2
+    return (lo + hi) / 2
+
+
+def read_ratings(path: str) -> List[float]:
+    """{rating: count} JSON -> sorted flat list (reference file format)."""
+    with open(path) as f:
+        dist = json.load(f)
+    out: List[float] = []
+    for rating, count in dist.items():
+        out.extend([float(rating)] * int(count))
+    return sorted(out)
+
+
+def get_percentile(rating: float, sorted_ratings: List[float]) -> float:
+    idx = bisect.bisect_left(sorted_ratings, float(rating))
+    return round(idx / len(sorted_ratings) * 100, 1)
+
+
+def rate_results(
+    results: Dict,
+    difficulties: Dict[str, float],
+    sorted_ratings: Optional[List[float]] = None,
+) -> Dict:
+    """Join a code_eval results.json with per-problem difficulties and
+    estimate the rating (+ percentile when a distribution is given).
+    Problems without a known difficulty are skipped (counted)."""
+    outcomes: List[Tuple[float, bool]] = []
+    skipped = 0
+    for row in results.get("details", []):
+        d = difficulties.get(str(row["query_id"]))
+        if d is None:
+            skipped += 1
+            continue
+        outcomes.append((float(d), bool(row["correct"])))
+    rating = estimate_rating(outcomes)
+    out = {
+        "rating": round(rating, 1),
+        "n_problems": len(outcomes),
+        "n_skipped_no_difficulty": skipped,
+        "n_solved": sum(1 for _, s in outcomes if s),
+    }
+    if sorted_ratings:
+        out["percentile"] = get_percentile(rating, sorted_ratings)
+    return out
+
+
+def _load_difficulties(path: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            out[str(row["query_id"])] = float(row["rating"])
+    return out
+
+
+if __name__ == "__main__":
+    kwargs = dict(arg.split("=", 1) for arg in sys.argv[1:])
+    with open(kwargs["results"]) as f:
+        results = json.load(f)
+    difficulties = _load_difficulties(kwargs["difficulties"])
+    ratings = read_ratings(kwargs["ratings"]) if "ratings" in kwargs else None
+    report = rate_results(results, difficulties, ratings)
+    if kwargs.get("output"):
+        os.makedirs(os.path.dirname(kwargs["output"]) or ".", exist_ok=True)
+        with open(kwargs["output"], "w") as f:
+            json.dump(report, f)
+    print(json.dumps(report))

@@ -43,7 +43,11 @@ def evaluate_checkpoint(
 
     from areal_tpu.api import data_api
     from areal_tpu.api.model_api import GenerationHyperparameters
-    from areal_tpu.functioncall.math_grader import grade_answer
+    from areal_tpu.functioncall.math_grader import (
+        extract_answer,
+        grade_answer,
+        normalize_answer,
+    )
     from areal_tpu.models.generation import generate_tokens
     from areal_tpu.models.hf import load_hf_model
 
@@ -61,6 +65,9 @@ def evaluate_checkpoint(
     prompts = [tokenizer(r["prompt"])["input_ids"] for r in rows]
 
     n_correct, per_prompt = 0, []
+    # Per-prompt sample records for multi-sample metrics (pass@k +
+    # majority vote, reference evaluation/rm_maj_eval.py).
+    by_prompt: dict = {}
     batch = 8
     for s in range(n_samples):
         rng = jax.random.PRNGKey(seed + s)
@@ -75,8 +82,11 @@ def evaluate_checkpoint(
                 text = tokenizer.decode(o["output_ids"])
                 ok = grade_answer(text, row.get("solutions") or row.get("answers"))
                 n_correct += bool(ok)
-                per_prompt.append(
-                    {"query_id": str(row.get("query_id", i + j)), "correct": bool(ok)}
+                qid = str(row.get("query_id", i + j))
+                per_prompt.append({"query_id": qid, "correct": bool(ok)})
+                ans = extract_answer(text)
+                by_prompt.setdefault(qid, []).append(
+                    (normalize_answer(ans) if ans else None, bool(ok))
                 )
 
     total = len(prompts) * n_samples
@@ -88,6 +98,20 @@ def evaluate_checkpoint(
         "accuracy": n_correct / max(1, total),
         "details": per_prompt,
     }
+    if n_samples > 1:
+        # pass@k: any sample correct; maj@k: the most common extracted
+        # answer is correct (unextractable answers never win the vote).
+        from collections import Counter
+
+        pass_k = maj_k = 0
+        for samples in by_prompt.values():
+            pass_k += any(ok for _, ok in samples)
+            counts = Counter(a for a, _ in samples if a is not None)
+            if counts:
+                top_ans, _ = counts.most_common(1)[0]
+                maj_k += any(ok for a, ok in samples if a == top_ans)
+        result["pass_at_k"] = pass_k / max(1, len(by_prompt))
+        result["maj_at_k"] = maj_k / max(1, len(by_prompt))
     if output:
         os.makedirs(os.path.dirname(output) or ".", exist_ok=True)
         with open(output, "w") as f:

@@ -1,0 +1,79 @@
+"""Elo rating estimator: MLE recovery, degenerate cases, percentile, and
+the results-join CLI surface (reference evaluation/cf_elo_caculator.py)."""
+
+import json
+import math
+import random
+
+import pytest
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+from evaluation.elo import (
+    estimate_rating,
+    get_percentile,
+    rate_results,
+    read_ratings,
+    solve_probability,
+)
+
+
+def _simulate(true_rating, difficulties, seed=0):
+    rng = random.Random(seed)
+    return [
+        (d, rng.random() < solve_probability(true_rating, d))
+        for d in difficulties
+    ]
+
+
+def test_mle_recovers_true_rating():
+    rng = random.Random(1)
+    difficulties = [rng.uniform(800, 3000) for _ in range(400)]
+    for true in (1200.0, 1900.0, 2600.0):
+        outcomes = _simulate(true, difficulties, seed=int(true))
+        est = estimate_rating(outcomes)
+        assert abs(est - true) < 120, (true, est)
+
+
+def test_degenerate_outcomes_clamp():
+    assert estimate_rating([(1500, True), (2000, True)]) == 4000.0
+    assert estimate_rating([(1500, False)]) == 0.0
+    with pytest.raises(ValueError):
+        estimate_rating([])
+
+
+def test_monotonic_in_solves():
+    diffs = [1000.0, 1500.0, 2000.0, 2500.0]
+    r1 = estimate_rating([(d, d <= 1000) for d in diffs])
+    r2 = estimate_rating([(d, d <= 2000) for d in diffs])
+    assert r2 > r1
+
+
+def test_percentile_and_ratings_format(tmp_path):
+    path = tmp_path / "ratings.json"
+    path.write_text(json.dumps({"1000": 2, "1500": 2, "2000": 1}))
+    ratings = read_ratings(str(path))
+    assert ratings == [1000.0, 1000.0, 1500.0, 1500.0, 2000.0]
+    assert get_percentile(1600, ratings) == 80.0
+    assert get_percentile(500, ratings) == 0.0
+
+
+def test_rate_results_join():
+    results = {
+        "details": [
+            {"query_id": "a", "correct": True},
+            {"query_id": "b", "correct": False},
+            {"query_id": "missing", "correct": True},
+        ]
+    }
+    difficulties = {"a": 1200.0, "b": 2400.0}
+    out = rate_results(results, difficulties, sorted_ratings=[1000.0, 2000.0])
+    assert out["n_problems"] == 2
+    assert out["n_skipped_no_difficulty"] == 1
+    assert out["n_solved"] == 1
+    assert 1200.0 < out["rating"] < 2400.0
+    assert "percentile" in out
+    assert math.isfinite(out["rating"])

@@ -224,3 +224,18 @@ def test_eval_and_aggregate(tiny_ckpt, math_data, code_data, tmp_path):
         max_new_tokens=8, greedy=True,
     )
     assert agg2["table"] == agg["table"]
+
+
+def test_math_eval_multisample_metrics(tiny_ckpt, math_data):
+    """n_samples > 1 reports pass@k and majority-vote accuracy
+    (reference evaluation/rm_maj_eval.py)."""
+    from evaluation.math_eval import evaluate_checkpoint
+
+    _, ckpt = tiny_ckpt
+    res = evaluate_checkpoint(
+        ckpt=ckpt, data=math_data, n_samples=2, greedy=False,
+        temperature=1.0, max_new_tokens=8,
+    )
+    assert 0.0 <= res["maj_at_k"] <= res["pass_at_k"] <= 1.0
+    assert res["n_samples"] == 2
+    assert len(res["details"]) == 2 * res["n_prompts"]
