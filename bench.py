@@ -219,13 +219,39 @@ def train_bench() -> tuple:
     return tflops, on_tpu
 
 
+def _arm_deadline(seconds: float):
+    """If the result line hasn't printed by the deadline, emit an honest
+    error JSON and hard-exit. A wedged device tunnel otherwise hangs the
+    whole bench at jax.devices() with NOTHING recorded for the round."""
+    import threading
+
+    def fire():
+        log(f"bench: deadline {seconds:.0f}s exceeded; device/tunnel stuck")
+        print(json.dumps({
+            "metric": "train_tflops_per_chip",
+            "value": 0.0,
+            "unit": "TFLOP/s",
+            "vs_baseline": 0.0,
+            "error": f"bench deadline {seconds:.0f}s exceeded "
+                     "(device init or compile hung)",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
+    deadline = _arm_deadline(float(os.environ.get("AREAL_BENCH_DEADLINE_S", 2700)))
     tflops, on_tpu = train_bench()
     import gc
 
     gc.collect()  # drop the train frame's device buffers before gen
     gen_tps = gen_bench(on_tpu)
 
+    deadline.cancel()
     print(json.dumps({
         "metric": "train_tflops_per_chip",
         "value": round(tflops, 2),
