@@ -338,30 +338,46 @@ def scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat_page_ids):
 
 
 def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
-                eos_mask):
+                eos_mask, active_rows=None):
     """Per-row warped sampling: temperature, top-k, top-p, greedy rows,
     and EOS-forbid rows — all as [B] arrays so one compiled program serves
     every mix of per-request params. Returns (tokens [B], logprobs [B] of
     the unwarped distribution, PPO convention — ops/sampling.sample_token).
+
+    When no row actually uses top-k/top-p, the [B, V] descending sort —
+    the single most expensive sampling op at real vocab sizes — is
+    skipped via lax.cond (the common RL rollout config is
+    temperature-only sampling).
     """
     logits = logits.astype(jnp.float32)
-    V = logits.shape[-1]
     em = eos_mask if eos_mask.ndim == 2 else eos_mask[None, :]
     forbid = forbid_rows[:, None] & em
     logits = jnp.where(forbid, NEG_INF, logits)
     base_logp = jax.nn.log_softmax(logits, axis=-1)
     warped = logits / jnp.maximum(temps[:, None], 1e-6)
-    # ONE descending sort serves both warps (top-k threshold + top-p
-    # nucleus cutoff); two sorts would double the per-step sampling cost.
-    sorted_desc = jnp.sort(warped, axis=-1)[:, ::-1]
-    k_eff = jnp.where(top_ks <= 0, V, jnp.minimum(top_ks, V))
-    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
-    probs = jax.nn.softmax(sorted_desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = (cum - probs) < top_ps[:, None]
-    cutoff_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
-    p_cut = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
-    warped = jnp.where(warped < jnp.maximum(kth, p_cut), NEG_INF, warped)
+
+    def with_cutoffs(warped):
+        V = warped.shape[-1]
+        # ONE descending sort serves both warps (top-k threshold + top-p
+        # nucleus cutoff); two sorts would double the per-step cost.
+        sorted_desc = jnp.sort(warped, axis=-1)[:, ::-1]
+        k_eff = jnp.where(top_ks <= 0, V, jnp.minimum(top_ks, V))
+        kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=-1)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = (cum - probs) < top_ps[:, None]
+        cutoff_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+        p_cut = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+        return jnp.where(warped < jnp.maximum(kth, p_cut), NEG_INF, warped)
+
+    # Only ACTIVE rows count: finished slots keep their stale top-k/top-p
+    # until the next admission overwrites them, and must not re-enable
+    # the sort for temperature-only batches.
+    row_warp = (top_ks > 0) | (top_ps < 1.0 - 1e-6)
+    if active_rows is not None:
+        row_warp = row_warp & active_rows
+    any_warp = jnp.any(row_warp)
+    warped = jax.lax.cond(any_warp, with_cutoffs, lambda w: w, warped)
     sampled = jax.random.categorical(rng, warped, axis=-1)
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy_mask, argmax, sampled).astype(jnp.int32)
@@ -484,7 +500,7 @@ def paged_decode_block(
         rng, sub = jax.random.split(rng)
         tokens, logprobs = warp_sample(
             logits, sub, temps, top_ps, top_ks, greedy_mask,
-            min_remaining > 0, eos_mask,
+            min_remaining > 0, eos_mask, active_rows=active,
         )
         emit = active
         tokens = jnp.where(emit, tokens, 0)

@@ -219,21 +219,28 @@ def train_bench() -> tuple:
     return tflops, on_tpu
 
 
+# Partial results the deadline handler can still report: a gen-phase
+# hang must not discard an already-measured train number.
+_PARTIAL = {"train_tflops": None}
+
+
 def _arm_deadline(seconds: float):
     """If the result line hasn't printed by the deadline, emit an honest
-    error JSON and hard-exit. A wedged device tunnel otherwise hangs the
-    whole bench at jax.devices() with NOTHING recorded for the round."""
+    JSON (with whatever phases DID complete) and hard-exit. A wedged
+    device tunnel otherwise hangs the whole bench at jax.devices() with
+    NOTHING recorded for the round."""
     import threading
 
     def fire():
         log(f"bench: deadline {seconds:.0f}s exceeded; device/tunnel stuck")
+        train = _PARTIAL["train_tflops"]
         print(json.dumps({
             "metric": "train_tflops_per_chip",
-            "value": 0.0,
+            "value": round(train, 2) if train is not None else 0.0,
             "unit": "TFLOP/s",
-            "vs_baseline": 0.0,
-            "error": f"bench deadline {seconds:.0f}s exceeded "
-                     "(device init or compile hung)",
+            "vs_baseline": round(train / BASELINE_TFLOPS, 3) if train is not None else 0.0,
+            "error": f"bench deadline {seconds:.0f}s exceeded in the "
+                     f"{'generation' if train is not None else 'train'} phase",
         }), flush=True)
         os._exit(3)
 
@@ -246,6 +253,7 @@ def _arm_deadline(seconds: float):
 def main():
     deadline = _arm_deadline(float(os.environ.get("AREAL_BENCH_DEADLINE_S", 2700)))
     tflops, on_tpu = train_bench()
+    _PARTIAL["train_tflops"] = tflops
     import gc
 
     gc.collect()  # drop the train frame's device buffers before gen

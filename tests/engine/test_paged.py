@@ -286,3 +286,32 @@ def test_mesh_sharded_engine(params):
         )
     finally:
         eng.stop()
+
+
+def test_topk_topp_requests(params):
+    """The sort-cutoff branch (lax.cond) actually masks: a top_k=1
+    SAMPLED request must reproduce the greedy request's tokens exactly
+    (only the argmax survives the cutoff), mixed with a plain request in
+    the same batch so both cond branches run in one engine."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=64,
+        decode_block_steps=4, prompt_bucket=8, eos_token_id=None, seed=0,
+        page_size=8,
+    )
+    eng.start()
+    try:
+        results = _run(eng, [
+            GenRequest(qid="k1", input_ids=[9, 10, 11], max_new_tokens=8,
+                       top_k=1, temperature=0.8),  # sampled, but only argmax survives
+            GenRequest(qid="plain", input_ids=[12, 13], max_new_tokens=8),
+        ])
+        greedy = _run(eng, [
+            GenRequest(qid="g", input_ids=[9, 10, 11], max_new_tokens=8,
+                       greedy=True),
+        ])["g"]
+        assert results["k1"].output_ids == greedy.output_ids
+        for r in results.values():
+            assert len(r.output_ids) == 8
+            assert all(lp <= 0 for lp in r.output_logprobs)
+    finally:
+        eng.stop()
