@@ -239,14 +239,6 @@ def test_forward_parity_across_meshes(mesh_spec):
     )
 
 
-def test_graft_entry_dryrun_multichip():
-    """The driver's multi-chip gate, run in CI: full train step + forward
-    over the 8-device (data,fsdp,seq,tensor) mesh."""
-    import __graft_entry__
-
-    __graft_entry__.dryrun_multichip(8)
-
-
 def test_forward_logprobs_and_values():
     cfg = small_cfg()
     params = init_params(cfg, jax.random.PRNGKey(2))

@@ -121,3 +121,30 @@ def test_multihost_sft_end_to_end(tmp_path):
     assert result["mesh"] == {"data": 2, "fsdp": 2, "seq": 1, "tensor": 1}
     assert len(result["losses"]) == 5
     assert result["losses"][-1] < result["losses"][0]
+
+
+@pytest.mark.timeout(900)
+def test_dryrun_free_of_involuntary_remat(tmp_path):
+    """VERDICT r2 weak #3 regression gate: the compiled multichip program
+    must not contain GSPMD 'Involuntary full rematerialization' fallbacks
+    (sharding-transition bounces that replicate tensors on real chips).
+
+    Subsumes the old in-process dryrun test (asserts returncode AND the
+    warning absence)."""
+    env = _child_env(8)
+    # The warning is emitted at XLA log level WARNING; an inherited
+    # TF_CPP_MIN_LOG_LEVEL>=2 would silence it and make the gate vacuous.
+    env["TF_CPP_MIN_LOG_LEVEL"] = "1"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu'); "
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=800,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "Involuntary full rematerialization" not in out.stderr, (
+        "sharding annotations regressed: XLA fell back to replication\n"
+        + "\n".join(
+            l for l in out.stderr.splitlines() if "rematerial" in l
+        )[:2000]
+    )
