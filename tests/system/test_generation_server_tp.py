@@ -1,0 +1,133 @@
+"""GenerationServer worker over HTTP with tensor_parallel=2: the
+mesh-sharded ServingEngine (GSPMD param + KV-pool sharding) behind the
+SGLang-contract endpoints, plus the tmpfs weight-update fast path —
+end-to-end across two processes."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = '''
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax; jax.config.update("jax_platforms", "cpu")
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=%(nr)r)
+from areal_tpu.api.system_api import GenerationServerConfig
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.system.generation_server import GenerationServer
+import areal_tpu.engine.factories  # registry
+cfg = GenerationServerConfig(
+    experiment_name=%(exp)r, trial_name=%(trial)r, server_index=0,
+    model=ModelAbstraction("tpu_transformer", args=dict(config=dict(
+        n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+        intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+        param_dtype="float32"))),
+    max_concurrent_requests=2, max_seq_len=128, kv_page_size=8,
+    decode_block_steps=4, tensor_parallel=2, seed=0,
+)
+w = GenerationServer()
+w.configure(cfg, experiment_name=cfg.experiment_name, trial_name=cfg.trial_name,
+            worker_name=cfg.worker_name)
+w.run()
+'''
+
+
+@pytest.mark.timeout(600)
+def test_generation_server_tensor_parallel(tmp_path):
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system.weight_transfer import dump_raw_params, shm_transfer_dir
+
+    nr = str(tmp_path / "nr")
+    # Unique experiment name: the shm fast path is keyed by it globally
+    # (/dev/shm/areal_tpu/<exp>/...), so concurrent runs must not collide.
+    exp, trial = f"tpserve-{uuid.uuid4().hex[:6]}", "t0"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Child output to a file: an unread PIPE deadlocks the server once
+    # its logs exceed the pipe buffer, and hides the traceback on crash.
+    log_path = tmp_path / "server.log"
+    log_f = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         CHILD % dict(repo=REPO, nr=nr, exp=exp, trial=trial)],
+        env=env, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+    )
+    try:
+        name_resolve.reconfigure("nfs", record_root=nr)
+        deadline = time.monotonic() + 240
+        url = None
+        while url is None:
+            assert proc.poll() is None, (
+                "server died during startup:\n" + log_path.read_text()[-3000:]
+            )
+            try:
+                url = name_resolve.get(names.gen_server_url(exp, trial, "0"))
+            except name_resolve.NameEntryNotFoundError:
+                assert time.monotonic() < deadline, "server never registered"
+                time.sleep(0.2)
+
+        def post(path, payload):
+            r = urllib.request.urlopen(urllib.request.Request(
+                url + path, json.dumps(payload).encode(),
+                {"Content-Type": "application/json"}), timeout=240)
+            return json.loads(r.read())
+
+        out = post("/generate", {"qid": "q1", "input_ids": [5, 6, 7],
+                                 "gconfig": {"max_new_tokens": 6, "greedy": True}})
+        assert len(out["output_ids"]) >= 1
+        assert all(lp <= 0 for lp in out["output_logprobs"])
+
+        # Weight update via the tmpfs raw fast path; role name = the
+        # basename of model_path (generation_server._load_params).
+        import jax as j
+
+        cfg = TransformerConfig(
+            n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+            intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+            param_dtype="float32",
+        )
+        new_params = j.tree_util.tree_map(
+            lambda x: np.asarray(x), init_params(cfg, j.random.PRNGKey(9))
+        )
+        role_dir = str(tmp_path / "realloc" / "actor")
+        os.makedirs(role_dir, exist_ok=True)
+        dump_raw_params(new_params, role_dir, version=5)
+        shm = shm_transfer_dir(exp, trial, "actor")
+        if shm is not None:
+            dump_raw_params(new_params, shm, version=5)
+        res = post("/update_weights_from_disk",
+                   {"model_path": role_dir, "allow_interrupt": True, "version": 5})
+        assert res["success"]
+        assert res["source"] == ("shm_raw" if shm is not None else "disk_raw")
+
+        out2 = post("/generate", {"qid": "q2", "input_ids": [9, 10],
+                                  "gconfig": {"max_new_tokens": 4, "greedy": True}})
+        assert out2["version_start"] == 5
+
+        metrics = urllib.request.urlopen(url + "/metrics", timeout=60).read().decode()
+        assert "areal:kv_pages_total" in metrics
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log_f.close()
+        # tmpfs dumps are keyed by experiment name; clean up.
+        import shutil
+
+        shutil.rmtree(f"/dev/shm/areal_tpu/{exp}", ignore_errors=True)
