@@ -70,8 +70,12 @@ class _Store:
                 if name in self._d and not req.get("replace"):
                     return {"ok": False, "err": "exists"}
                 ttl = req.get("ttl")
+                # Expire at now + ttl (etcd lease semantics): clients
+                # refresh every ttl/3, so a live holder gets ~3 refresh
+                # attempts before its lease lapses, while a dead one
+                # disappears within one ttl instead of three.
                 self._d[name] = (
-                    str(req["value"]), ttl, now + 3 * ttl if ttl else None
+                    str(req["value"]), ttl, now + ttl if ttl else None
                 )
                 return {"ok": True}
             if op == "get":
@@ -102,7 +106,7 @@ class _Store:
                 for k in req.get("names", []):
                     rec = self._d.get(k)
                     if rec is not None and rec[1]:
-                        self._d[k] = (rec[0], rec[1], now + 3 * rec[1])
+                        self._d[k] = (rec[0], rec[1], now + rec[1])
                         refreshed.append(k)
                 return {"ok": True, "refreshed": refreshed}
             if op == "ping":

@@ -55,9 +55,11 @@ def load_engine_state(engine, load_dir: str):
     path = os.path.join(load_dir, _STATE_FILE)
     with open(path, "rb") as f:
         state = pickle.load(f)
-    if hasattr(engine, "drop_offloaded_state"):
+    if hasattr(engine, "drop_offloaded_state") and state["opt_state"] is not None:
         # About to overwrite both params and optimizer state: discard any
         # offloaded host copies instead of restoring them to HBM first.
+        # A params-only checkpoint must NOT drop offloaded Adam moments —
+        # set_params alone keeps the host opt-state copy intact.
         engine.drop_offloaded_state()
     engine.set_params(state["params"])
     opt_shardings = getattr(engine, "_opt_shardings", None)

@@ -166,6 +166,12 @@ class ServingEngine:
         prefill_max_batch: int = 8,
     ):
         self.cfg = cfg
+        # Sampled token ids round-trip through float32 in the packed
+        # single-fetch decode result (paged.py); exact only below 2^24.
+        assert cfg.vocab_size < 2**24, (
+            f"vocab_size {cfg.vocab_size} >= 2^24 would corrupt token ids "
+            "in the packed float32 decode fetch"
+        )
         self.mesh = mesh
         if mesh is not None:
             from areal_tpu.parallel.sharding import shard_params
@@ -349,7 +355,13 @@ class ServingEngine:
                 self._finish_host(req, [], [], no_eos=True, interrupted=False,
                                   vstart=self.version)
                 continue
-            pages = self._allocator.alloc(n_need)
+            # Reserve through the first decode block, not just the prompt:
+            # a prompt-only reservation can be preempted by _ensure_pages
+            # before producing a single block, cycling admit -> preempt ->
+            # resubmit with a full batched prefill each lap.
+            n_reserve = pages_needed(plen + self.block_steps, self.page_size)
+            n_reserve = min(n_reserve, self.max_pages, self.n_pages - 1)
+            pages = self._allocator.alloc(n_reserve)
             if pages is None:
                 break  # pool pressure: wait for frees
             self._backlog.pop(0)
@@ -372,8 +384,12 @@ class ServingEngine:
         # (prompt-bucket padding) and dummy rows land on the trash page.
         n_chunks = pad // self.page_size
         flat = np.full((n_b, n_chunks), TRASH_PAGE, np.int32)
-        for i, (_, _, _, pages) in enumerate(batch):
-            flat[i, : len(pages)] = pages
+        for i, (_, _, plen_i, pages) in enumerate(batch):
+            # Only the prompt's chunks carry prefill KV; pages reserved
+            # beyond the prompt (first-decode-block headroom) receive
+            # decode writes later.
+            n_p = pages_needed(plen_i, self.page_size)
+            flat[i, :n_p] = pages[:n_p]
         self._ensure_pool()
         self._k_pages, self._v_pages = scatter_prefill(
             self._k_pages, self._v_pages, k_pref, v_pref,
