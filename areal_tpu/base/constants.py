@@ -21,13 +21,22 @@ from typing import Any, Dict, Optional
 _experiment_name: Optional[str] = None
 _trial_name: Optional[str] = None
 
-# Filesystem root for logs/checkpoints/realloc params. Overridable by env.
-FILEROOT = os.environ.get("AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}")
+# Filesystem root for logs/checkpoints/realloc params. AREAL_FILEROOT is
+# resolved at CALL time, not import time: spawned workers import this
+# module while unpickling their config (before the controller-provided
+# env lands in os.environ), so an import-time snapshot would silently
+# pin every worker to the default root. The module-level names below
+# stay as explicit overrides (tests monkeypatch them).
+MODEL_SAVE_ROOT: Optional[str] = None
+LOG_ROOT: Optional[str] = None
+RECOVER_ROOT: Optional[str] = None
+PARAM_REALLOC_ROOT: Optional[str] = None
 
-MODEL_SAVE_ROOT = os.path.join(FILEROOT, "checkpoints")
-LOG_ROOT = os.path.join(FILEROOT, "logs")
-RECOVER_ROOT = os.path.join(FILEROOT, "recover")
-PARAM_REALLOC_ROOT = os.path.join(FILEROOT, "param_realloc")
+
+def get_fileroot() -> str:
+    return os.environ.get(
+        "AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}"
+    )
 
 # Mirrors the reference's NCCL timeout role: how long collective setup /
 # barrier operations may block before we declare a peer dead.
@@ -62,19 +71,22 @@ def has_experiment_trial_names() -> bool:
 
 
 def get_log_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    p = os.path.join(LOG_ROOT, experiment or experiment_name(), trial or trial_name())
+    root = LOG_ROOT or os.path.join(get_fileroot(), "logs")
+    p = os.path.join(root, experiment or experiment_name(), trial or trial_name())
     os.makedirs(p, exist_ok=True)
     return p
 
 
 def get_save_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    p = os.path.join(MODEL_SAVE_ROOT, experiment or experiment_name(), trial or trial_name())
+    root = MODEL_SAVE_ROOT or os.path.join(get_fileroot(), "checkpoints")
+    p = os.path.join(root, experiment or experiment_name(), trial or trial_name())
     os.makedirs(p, exist_ok=True)
     return p
 
 
 def get_recover_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
-    p = os.path.join(RECOVER_ROOT, experiment or experiment_name(), trial or trial_name())
+    root = RECOVER_ROOT or os.path.join(get_fileroot(), "recover")
+    p = os.path.join(root, experiment or experiment_name(), trial or trial_name())
     os.makedirs(p, exist_ok=True)
     return p
 
@@ -82,8 +94,9 @@ def get_recover_path(experiment: Optional[str] = None, trial: Optional[str] = No
 def get_param_realloc_path(
     experiment: Optional[str] = None, trial: Optional[str] = None
 ) -> str:
+    root = PARAM_REALLOC_ROOT or os.path.join(get_fileroot(), "param_realloc")
     p = os.path.join(
-        PARAM_REALLOC_ROOT, experiment or experiment_name(), trial or trial_name()
+        root, experiment or experiment_name(), trial or trial_name()
     )
     os.makedirs(p, exist_ok=True)
     return p

@@ -385,4 +385,10 @@ def grade_answer(solution_text: str, reference_answer: Any) -> bool:
         refs = list(reference_answer)
     else:  # str, int, float, ... — answers_equal str()s its inputs
         refs = [reference_answer]
+    # Datasets often store ground truth in solution form ("\boxed{42}"):
+    # unbox it so it compares against the extracted answer, not the
+    # literal \boxed markup.
+    refs = [
+        b if (b := extract_boxed(str(r))) is not None else r for r in refs
+    ]
     return any(answers_equal(ans, r) for r in refs)

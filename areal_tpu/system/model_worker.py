@@ -39,6 +39,7 @@ from areal_tpu.api.model_api import (
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, seeding, stats_tracker, timeutil
+from areal_tpu.system import eval_scores
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
 from areal_tpu.system.redistributor import RedistribStep
@@ -148,6 +149,20 @@ class ModelWorker(Worker):
             return {"meta": None, "epoch_done": False}
         if self.dataloader is not None:
             batch, epoch_done = self.dataloader.next_batch()
+            if epoch_done:
+                # Curriculum step at the epoch boundary (reference
+                # model_worker.py:576-618 filters on dataloader
+                # StopIteration): drop prompts the policy already solves;
+                # the dataloader detects the size change and reshuffles.
+                eval_scores.apply_filter(
+                    self._dataset,
+                    self.cfg.experiment_name,
+                    self.cfg.trial_name,
+                    tag=f"data{self.cfg.worker_index}",
+                    # Floor at the per-rank fetch batch: dropping below it
+                    # would starve the master's batch assembly forever.
+                    min_size=self.dataloader.batch_size,
+                )
         else:
             batch = self._dataset.poll_batch()
             epoch_done = False
@@ -265,6 +280,19 @@ class ModelWorker(Worker):
 
         output_meta = None
         if out is not None:
+            # Per-prompt eval scores from the reward MFC feed the dataset
+            # curriculum filter (reference model_worker.py:956-994; the
+            # all-gather is replaced by a locked file merge). Popped so
+            # scores don't ride along into downstream MFC inputs. EVERY
+            # worker writes: DP ranks hold disjoint id slices, so skipping
+            # non-zero ranks would leave their prompts unscorable.
+            scores = out.metadata.pop("scores", None)
+            if scores:
+                eval_scores.merge_scores(
+                    self.cfg.experiment_name,
+                    self.cfg.trial_name,
+                    dict(zip(out.ids, scores)),
+                )
             if d.get("output_key_remap"):
                 out.remap_keys_(d["output_key_remap"])
             self.data_manager.store(out)
@@ -343,6 +371,16 @@ class ModelWorker(Worker):
         if self.dataloader is not None:
             import json
 
+            # Curriculum state first: the dataloader snapshot records the
+            # FILTERED dataset size, so indices must be restored before
+            # load_state_dict's size check (reference
+            # model_worker.py:368-385 does the same at model setup).
+            eval_scores.restore_indices(
+                self._dataset,
+                self.cfg.experiment_name,
+                self.cfg.trial_name,
+                tag=f"data{self.cfg.worker_index}",
+            )
             state_path = os.path.join(
                 constants.get_recover_path(
                     self.cfg.experiment_name, self.cfg.trial_name

@@ -22,6 +22,7 @@ from areal_tpu.api.agent_api import make_agent
 from areal_tpu.api.env_api import make_env
 from areal_tpu.api.system_api import RolloutWorkerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, seeding
+from areal_tpu.system import eval_scores
 from areal_tpu.system.partial_rollout import PartialRolloutManager
 from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
 from areal_tpu.system.worker_base import AsyncWorker, PollResult
@@ -30,6 +31,15 @@ logger = logging.getLogger("rollout_worker")
 
 
 class RolloutWorker(AsyncWorker):
+    @property
+    def pending_scores(self) -> Dict[str, float]:
+        """Per-episode success rates accumulated locally, merged into the
+        shared score file at epoch boundaries (one locked write per epoch,
+        not per episode). Lazy so harness-built partial workers work."""
+        if not hasattr(self, "_pending_scores"):
+            self._pending_scores: Dict[str, float] = {}
+        return self._pending_scores
+
     def _configure(self, config: RolloutWorkerConfig):
         self.cfg = config
         constants.set_experiment_trial_names(
@@ -57,6 +67,14 @@ class RolloutWorker(AsyncWorker):
                 f"{len(config.datasets)}"
             )
         self.dataset = data_api.make_dataset(config.datasets[0], util)
+        # Recovery: resume the curriculum where the previous incarnation
+        # left it (reference rollout_worker.py:122-134).
+        eval_scores.restore_indices(
+            self.dataset,
+            config.experiment_name,
+            config.trial_name,
+            tag=f"rollout{config.worker_index}",
+        )
         self.dataloader = data_api.PackedDataLoader(
             self.dataset, batch_size=1, shuffle=True, seed=config.seed
         )
@@ -158,6 +176,11 @@ class RolloutWorker(AsyncWorker):
                 )
             trajs = await agent_task
             for t in trajs:
+                # Group success rates feed the curriculum filter
+                # (degenerate groups the agent drops are never scored —
+                # the reference's async path behaves the same way).
+                for sid, sc in zip(t.ids, t.metadata.get("scores") or []):
+                    self.pending_scores[str(sid)] = float(sc)
                 self.pusher.push(data_api.sample_to_json(t))
                 self._push_count += 1
             accepted = bool(trajs)
@@ -206,12 +229,41 @@ class RolloutWorker(AsyncWorker):
             await asyncio.sleep(0.1)
             return PollResult(batch_count=0)
 
-        batch, _ = self.dataloader.next_batch()
+        batch, epoch_last = self.dataloader.next_batch()
+        if epoch_last:
+            # Epoch boundary: publish this worker's scores and run the
+            # curriculum filter over the merged file (reference
+            # rollout_worker.py:147-176). In-flight episodes from the old
+            # epoch still complete; their scores publish next epoch.
+            eval_scores.merge_scores(
+                self.cfg.experiment_name,
+                self.cfg.trial_name,
+                self.pending_scores,
+            )
+            self._pending_scores = {}
+            eval_scores.apply_filter(
+                self.dataset,
+                self.cfg.experiment_name,
+                self.cfg.trial_name,
+                tag=f"rollout{self.cfg.worker_index}",
+                min_size=1,
+            )
         eid = next(self._episode_counter)
         self._tasks[f"ep{eid}"] = asyncio.create_task(self.rollout_task(batch))
         return PollResult(sample_count=1, batch_count=1)
 
     def _exit_hook(self):
+        try:
+            # Scores gathered since the last epoch boundary must survive a
+            # shutdown/restart — they inform the post-recovery filter.
+            eval_scores.merge_scores(
+                self.cfg.experiment_name,
+                self.cfg.trial_name,
+                self.pending_scores,
+            )
+            self._pending_scores = {}
+        except Exception:
+            pass
         try:
             self.pusher.close()
         except Exception:

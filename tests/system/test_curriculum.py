@@ -1,0 +1,301 @@
+"""Curriculum-filter feedback loop: reward scores -> shared score file ->
+dataset.filter at epoch boundaries -> filtered-index snapshots -> recovery
+(reference realhf/system/model_worker.py:956-994, :576-618, :368-385 and
+rollout_worker.py:115-176)."""
+
+import json
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+from areal_tpu.api import data_api
+from areal_tpu.base import constants
+from areal_tpu.datasets.math_code_prompt import MATHCodePromptDataset
+from areal_tpu.system import eval_scores
+from tests import fixtures
+
+
+@pytest.fixture(scope="module")
+def tokenizer(tmp_path_factory):
+    rows = fixtures.make_math_code_rows(16, seed=5)
+    texts = [r["prompt"] for r in rows]
+    return fixtures.train_tiny_tokenizer(texts, tmp_path_factory.mktemp("tok"))
+
+
+def _mk_dataset(tokenizer, tmp_path, n=8, **kwargs):
+    rows = [r for r in fixtures.make_math_code_rows(24, seed=5) if r["task"] == "math"][:n]
+    path = fixtures.write_jsonl(rows, tmp_path / "mc.jsonl")
+    util = data_api.DatasetUtility(
+        seed=1, dp_rank=0, world_size=1, tokenizer=tokenizer
+    )
+    return MATHCodePromptDataset(util, dataset_path=path, **kwargs)
+
+
+@pytest.fixture()
+def save_root(tmp_path, monkeypatch):
+    monkeypatch.setattr(constants, "MODEL_SAVE_ROOT", str(tmp_path / "save"))
+    return tmp_path / "save"
+
+
+def test_score_store_merge_and_filter(tmp_path, tokenizer, save_root):
+    """Two workers merge disjoint score slices; apply_filter drops the
+    high scorers and snapshots indices; a fresh dataset restores them."""
+    exp, trial = "cur-unit", "t0"
+    ds = _mk_dataset(
+        tokenizer, tmp_path, filter_threshold=0.5, max_filter_percentage=0.5
+    )
+    n = len(ds)
+    # Worker A scores the first half high, worker B the second half low.
+    half = n // 2
+    eval_scores.merge_scores(exp, trial, {ds.ids[i]: 1.0 for i in range(half)})
+    eval_scores.merge_scores(
+        exp, trial, {ds.ids[i]: 0.0 for i in range(half, n)}
+    )
+    merged = eval_scores.load_scores(exp, trial)
+    assert len(merged) == n  # both slices survived the locked merge
+
+    assert eval_scores.apply_filter(ds, exp, trial, tag="data0")
+    assert len(ds) == n - half  # every high scorer dropped (cap = 50%)
+    kept_ids = {ds.ids[i] for i in ds.active_indices}
+    assert all(merged[i] < 0.5 for i in kept_ids)
+
+    # Recovery: fresh (full-size) dataset adopts the snapshot.
+    ds2 = _mk_dataset(
+        tokenizer, tmp_path, filter_threshold=0.5, max_filter_percentage=0.5
+    )
+    assert len(ds2) == n
+    assert eval_scores.restore_indices(ds2, exp, trial, tag="data0")
+    assert ds2.active_indices == ds.active_indices
+
+
+def test_no_filter_without_scores(tmp_path, tokenizer, save_root):
+    ds = _mk_dataset(tokenizer, tmp_path, max_filter_percentage=0.5)
+    assert not eval_scores.apply_filter(ds, "cur-none", "t0", tag="data0")
+    assert len(ds) == 8
+    assert not eval_scores.restore_indices(ds, "cur-none", "t0", tag="data0")
+
+
+def test_corrupt_score_file_recovers(tmp_path, tokenizer, save_root):
+    exp, trial = "cur-corrupt", "t0"
+    path = eval_scores.scores_path(exp, trial)
+    with open(path, "w") as f:
+        f.write("{truncated")
+    eval_scores.merge_scores(exp, trial, {"a": 1.0})
+    assert eval_scores.load_scores(exp, trial) == {"a": 1.0}
+
+
+def test_restore_ordering_preserves_dataloader_cursor(
+    tmp_path, tokenizer, save_root
+):
+    """The dataloader checkpoint records the FILTERED size; restoring
+    indices before load_state_dict keeps the mid-epoch cursor instead of
+    tripping the size-mismatch reset."""
+    exp, trial = "cur-order", "t0"
+    ds = _mk_dataset(
+        tokenizer, tmp_path, filter_threshold=-1.0, max_filter_percentage=0.5
+    )
+    eval_scores.merge_scores(exp, trial, {i: 0.0 for i in ds.ids})
+    eval_scores.apply_filter(ds, exp, trial, tag="data0")
+    assert len(ds) == 4
+    loader = data_api.PackedDataLoader(ds, batch_size=2, seed=1)
+    loader.next_batch()
+    state = loader.state_dict()
+    assert state["size"] == 4 and state["cursor"] == 2
+
+    ds2 = _mk_dataset(
+        tokenizer, tmp_path, filter_threshold=-1.0, max_filter_percentage=0.5
+    )
+    loader2 = data_api.PackedDataLoader(ds2, batch_size=2, seed=1)
+    eval_scores.restore_indices(ds2, exp, trial, tag="data0")
+    loader2.load_state_dict(state)
+    assert loader2._cursor == 2  # sizes matched; cursor survived
+
+
+def test_curriculum_sync_ppo_e2e(tmp_path, tokenizer):
+    """E2E: reward-MFC scores flow to the shared file, epoch boundaries
+    shrink the dataset, and a recovery relaunch resumes with the filtered
+    curriculum (VERDICT r3 missing #2 done-criterion)."""
+    from areal_tpu.api.config import (
+        DatasetAbstraction,
+        ModelAbstraction,
+        ModelBackendAbstraction,
+        ModelInterfaceAbstraction,
+        ModelName,
+        ModelShardID,
+    )
+    from areal_tpu.api.dfg import MFCDef, ModelInterfaceType
+    from areal_tpu.api.system_api import (
+        ExperimentConfig,
+        ExperimentSaveEvalControl,
+        MasterWorkerConfig,
+        ModelShardSpec,
+        ModelWorkerConfig,
+    )
+    from areal_tpu.system.controller import LocalController
+
+    exp, trial = f"e2e-cur-{uuid.uuid4().hex[:6]}", "t0"
+    rows = [r for r in fixtures.make_math_code_rows(24, seed=5) if r["task"] == "math"][:8]
+    data_path = fixtures.write_jsonl(rows, tmp_path / "mc.jsonl")
+    tok_dir = str(tmp_path / "tok_full")
+    tokenizer.save_pretrained(tok_dir)
+
+    tiny_cfg = dict(
+        vocab_size=128,
+        hidden_dim=32,
+        n_layers=2,
+        n_q_heads=2,
+        n_kv_heads=1,
+        head_dim=16,
+        intermediate_dim=64,
+        max_position_embeddings=256,
+        compute_dtype="float32",
+    )
+    actor, rew = ModelName("actor", 0), ModelName("reward", 0)
+    n_seqs = 4
+    gconfig = dict(n=2, max_new_tokens=8, greedy=False, temperature=1.0)
+
+    def build_cfg(benchmark_steps, recover_mode):
+        rpcs = [
+            MFCDef(
+                name="actor_gen",
+                model_name=actor,
+                interface_type=ModelInterfaceType.GENERATE,
+                interface_impl=None,
+                n_seqs=n_seqs,
+                input_keys=("packed_prompts",),
+                output_keys=(
+                    "packed_input_ids",
+                    "prompt_mask",
+                    "packed_logprobs",
+                    "seq_no_eos_mask",
+                ),
+            ),
+            MFCDef(
+                name="rew_inf",
+                model_name=rew,
+                interface_type=ModelInterfaceType.INFERENCE,
+                interface_impl=None,
+                n_seqs=n_seqs,
+                input_keys=("packed_input_ids", "prompt_mask"),
+                output_keys=("rewards",),
+            ),
+            MFCDef(
+                name="actor_train",
+                model_name=actor,
+                interface_type=ModelInterfaceType.TRAIN_STEP,
+                interface_impl=None,
+                n_seqs=n_seqs,
+                input_keys=(
+                    "packed_input_ids",
+                    "prompt_mask",
+                    "packed_logprobs",
+                    "rewards",
+                    "seq_no_eos_mask",
+                ),
+            ),
+        ]
+        shards = [
+            ModelShardSpec(
+                id=ModelShardID(actor),
+                model=ModelAbstraction(
+                    "tpu_transformer",
+                    args=dict(
+                        config=tiny_cfg, tokenizer_path=tok_dir, dtype="float32"
+                    ),
+                ),
+                backend=ModelBackendAbstraction(
+                    "jax_train",
+                    args=dict(optimizer=dict(lr=1e-4), remat=False,
+                              row_len_multiple=8),
+                ),
+                interface=ModelInterfaceAbstraction(
+                    "ppo_actor", args=dict(gconfig=gconfig, kl_ctl=0.0)
+                ),
+            ),
+            ModelShardSpec(
+                id=ModelShardID(rew),
+                model=ModelAbstraction(
+                    "tpu_transformer",
+                    args=dict(config=tiny_cfg, tokenizer_path=tok_dir),
+                ),
+                backend=ModelBackendAbstraction("mock_inference"),
+                interface=ModelInterfaceAbstraction("rw-math-code"),
+            ),
+        ]
+        mw = ModelWorkerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            worker_index=0,
+            shards=shards,
+            datasets=[
+                DatasetAbstraction(
+                    "math_code_prompt",
+                    # Scores are success rates in [0, 1]; threshold -1
+                    # makes every scored prompt a drop candidate, capped
+                    # at 50% per epoch.
+                    args=dict(
+                        dataset_path=data_path,
+                        filter_threshold=-1.0,
+                        max_filter_percentage=0.5,
+                    ),
+                )
+            ],
+            tokenizer_path=tok_dir,
+            train_batch_size=n_seqs,
+            total_train_epochs=10,
+        )
+        master = MasterWorkerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            exp_ctrl=ExperimentSaveEvalControl(
+                total_train_epochs=10,
+                ckpt_freq_steps=2,
+                benchmark_steps=benchmark_steps,
+            ),
+            rpcs=rpcs,
+            model_topos={
+                str(actor): ["model_worker/0"],
+                str(rew): ["model_worker/0"],
+            },
+            data_hosts=["model_worker/0"],
+            n_model_workers=1,
+            train_batch_size=n_seqs,
+            recover_mode=recover_mode,
+        )
+        return ExperimentConfig(
+            experiment_name=exp, trial_name=trial, master=master,
+            model_workers=[mw],
+        )
+
+    nr = {"backend": "nfs", "record_root": str(tmp_path / "name_resolve")}
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "AREAL_FILEROOT": str(tmp_path / "fileroot"),
+    }
+    save_dir = tmp_path / "fileroot" / "checkpoints" / exp / trial
+
+    # 8 prompts / 4 per step = 2 steps per epoch; the first epoch
+    # boundary filters 8 -> 4, where the per-rank-batch floor stops
+    # further shrinking (a smaller active set could never fill a batch).
+    r1 = LocalController(
+        build_cfg(5, "disabled"), name_resolve_cfg=nr, worker_env=env
+    ).run()
+    assert r1["global_step"] == 5
+
+    with open(save_dir / "dataset_eval_scores.json") as f:
+        scores = json.load(f)
+    assert len(scores) == 8  # every prompt scored during epoch 1
+    snap = np.load(save_dir / "dataset_indices" / "data0.npy")
+    assert len(snap) == 4  # 8 -> 4, floored at the fetch batch size
+
+    # Recovery relaunch: the worker restores the filtered indices (size
+    # matches the dataloader checkpoint) and training continues.
+    r2 = LocalController(
+        build_cfg(7, "auto"), name_resolve_cfg=nr, worker_env=env
+    ).run()
+    assert r2["global_step"] == 7
+    snap2 = np.load(save_dir / "dataset_indices" / "data0.npy")
+    assert len(snap2) == 4  # curriculum survived the restart
