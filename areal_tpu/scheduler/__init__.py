@@ -1,0 +1,1 @@
+from areal_tpu.scheduler import gke  # noqa: F401  (registers "gke" mode)

@@ -1,0 +1,263 @@
+"""GKE/XPK-style Kubernetes scheduler client.
+
+The TPU-native counterpart of the reference's SLURM backend
+(realhf/scheduler/slurm/client.py:78 submits sbatch/srun-multiprog worker
+arrays with container images; slurm/utils.py renders the scripts): on
+Google Cloud, TPU pod workloads are Kubernetes Jobs on GKE node pools
+(what the XPK tool generates), so this client renders one k8s Job per
+worker and drives it through `kubectl` — submit = `kubectl apply`,
+find = `kubectl get job -o json`, stop = `kubectl delete job`.
+
+Design notes:
+- One Job per worker (completions=1, backoffLimit=0, restartPolicy=Never).
+  Pod-level retry is deliberately OFF: the framework's own relaunch loop
+  (training/utils.py:run_experiment) owns failure recovery, because a
+  worker restart without the master's recover protocol would desync the
+  experiment (same reason the reference passes SLURM `--no-requeue`).
+- TPU placement follows GKE's conventions: `google.com/tpu` resource
+  requests plus `cloud.google.com/gke-tpu-accelerator` /
+  `cloud.google.com/gke-tpu-topology` node selectors.
+- `kubectl_cmd` is injectable so tests fake the cluster at the
+  subprocess boundary (the same place the reference's tests fake sbatch).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+from areal_tpu.base import logging
+from areal_tpu.scheduler.client import (
+    JobException,
+    JobInfo,
+    JobState,
+    SchedulerClient,
+    register_scheduler,
+)
+
+logger = logging.getLogger("gke_scheduler")
+
+
+def k8s_name(name: str) -> str:
+    """RFC 1123 DNS label: lowercase alphanumerics and '-', max 63 chars.
+    Worker names like 'model_worker/3' become 'model-worker-3'."""
+    s = re.sub(r"[^a-z0-9-]+", "-", name.lower()).strip("-")
+    return s[:63].rstrip("-") or "job"
+
+
+class KubernetesSchedulerClient(SchedulerClient):
+    def __init__(
+        self,
+        namespace: str = "default",
+        container_image: str = "python:3.12-slim",
+        tpu_type: Optional[str] = None,
+        tpu_topology: Optional[str] = None,
+        tpu_chips_per_pod: int = 0,
+        host_network: bool = True,
+        kubectl_cmd: str = "kubectl",
+        name_prefix: str = "",
+        labels: Optional[Dict[str, str]] = None,
+        volumes: Optional[List[Dict]] = None,
+        volume_mounts: Optional[List[Dict]] = None,
+        log_dir: Optional[str] = None,  # accepted for registry parity
+    ):
+        self.namespace = namespace
+        self.container_image = container_image
+        self.tpu_type = tpu_type
+        self.tpu_topology = tpu_topology
+        self.tpu_chips_per_pod = tpu_chips_per_pod
+        self.host_network = host_network
+        self.kubectl_cmd = kubectl_cmd
+        # Scopes job names per experiment/trial (the reference's SLURM
+        # job names embed experiment+trial the same way) so concurrent
+        # trials in one namespace can't collide — submit()'s stale-job
+        # cleanup would otherwise delete another trial's live workers.
+        self.name_prefix = name_prefix
+        self.labels = dict(labels or {})
+        self.volumes = volumes or []
+        self.volume_mounts = volume_mounts or []
+        # logical name -> k8s job name
+        self._jobs: Dict[str, str] = {}
+
+    # -- kubectl plumbing ------------------------------------------------
+
+    def _job_name(self, name: str) -> str:
+        scoped = f"{self.name_prefix}-{name}" if self.name_prefix else name
+        return k8s_name(scoped)
+
+    def _kubectl(
+        self, args: List[str], stdin: Optional[str] = None
+    ) -> subprocess.CompletedProcess:
+        cmd = [self.kubectl_cmd, "-n", self.namespace, *args]
+        return subprocess.run(
+            cmd, input=stdin, capture_output=True, text=True, timeout=120
+        )
+
+    # -- manifest --------------------------------------------------------
+
+    def _manifest(
+        self,
+        job_name: str,
+        logical_name: str,
+        cmd: List[str],
+        env: Optional[Dict[str, str]],
+        cwd: Optional[str],
+    ) -> Dict:
+        container: Dict = {
+            "name": "worker",
+            "image": self.container_image,
+            "command": list(cmd),
+            "env": [
+                {"name": k, "value": str(v)} for k, v in (env or {}).items()
+            ],
+        }
+        if cwd:
+            container["workingDir"] = cwd
+        if self.volume_mounts:
+            container["volumeMounts"] = self.volume_mounts
+        if self.tpu_chips_per_pod:
+            container["resources"] = {
+                "requests": {"google.com/tpu": self.tpu_chips_per_pod},
+                "limits": {"google.com/tpu": self.tpu_chips_per_pod},
+            }
+        pod_spec: Dict = {
+            "restartPolicy": "Never",
+            "containers": [container],
+        }
+        if self.host_network:
+            # Workers discover each other by host ip:port through the KV
+            # name service; host networking keeps those addresses stable.
+            pod_spec["hostNetwork"] = True
+            pod_spec["dnsPolicy"] = "ClusterFirstWithHostNet"
+        selector = {}
+        if self.tpu_type:
+            selector["cloud.google.com/gke-tpu-accelerator"] = self.tpu_type
+        if self.tpu_topology:
+            selector["cloud.google.com/gke-tpu-topology"] = self.tpu_topology
+        if selector:
+            pod_spec["nodeSelector"] = selector
+        if self.volumes:
+            pod_spec["volumes"] = self.volumes
+        labels = {
+            **self.labels,
+            "app.kubernetes.io/managed-by": "areal-tpu",
+            "areal-tpu/worker": k8s_name(logical_name),
+        }
+        return {
+            "apiVersion": "batch/v1",
+            "kind": "Job",
+            "metadata": {
+                "name": job_name,
+                "namespace": self.namespace,
+                "labels": labels,
+            },
+            "spec": {
+                "completions": 1,
+                "parallelism": 1,
+                "backoffLimit": 0,
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    # -- SchedulerClient API ---------------------------------------------
+
+    def submit(
+        self,
+        name: str,
+        cmd: List[str],
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        **kwargs,
+    ) -> str:
+        job_name = self._job_name(name)
+        if name in self._jobs:
+            state = self.find(name).state
+            if state in (JobState.PENDING, JobState.RUNNING):
+                raise ValueError(f"job {name!r} already running")
+        # A stale same-name Job from a previous (failed) attempt blocks
+        # `apply` on immutable pod-template fields — recovery relaunches
+        # reuse worker names, so clear it first (k8s Jobs are one-shot).
+        self._kubectl(
+            ["delete", "job", job_name, "--ignore-not-found", "--wait=true"]
+        )
+        manifest = self._manifest(job_name, name, cmd, env, cwd)
+        r = self._kubectl(["apply", "-f", "-"], stdin=json.dumps(manifest))
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"kubectl apply failed for {name}: {r.stderr.strip()}"
+            )
+        self._jobs[name] = job_name
+        logger.info(f"submitted k8s job {job_name} for worker {name}")
+        return name
+
+    def find(self, name: str) -> JobInfo:
+        job_name = self._jobs.get(name, self._job_name(name))
+        r = self._kubectl(["get", "job", job_name, "-o", "json"])
+        if r.returncode != 0:
+            if "NotFound" in r.stderr or "not found" in r.stderr:
+                return JobInfo(name, JobState.NOT_FOUND)
+            raise RuntimeError(
+                f"kubectl get failed for {name}: {r.stderr.strip()}"
+            )
+        status = json.loads(r.stdout).get("status", {})
+        if status.get("succeeded", 0) >= 1:
+            return JobInfo(name, JobState.COMPLETED, exit_code=0)
+        if status.get("failed", 0) >= 1:
+            return JobInfo(name, JobState.FAILED, exit_code=1)
+        if status.get("active", 0) >= 1:
+            return JobInfo(name, JobState.RUNNING)
+        return JobInfo(name, JobState.PENDING)
+
+    def wait(
+        self,
+        names: Optional[List[str]] = None,
+        timeout: Optional[float] = None,
+        raise_on_failure: bool = True,
+        poll_interval: float = 2.0,
+    ) -> List[JobInfo]:
+        names = list(names) if names is not None else list(self._jobs)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        terminal = (
+            JobState.COMPLETED,
+            JobState.FAILED,
+            JobState.CANCELLED,
+            JobState.NOT_FOUND,
+        )
+        while True:
+            infos = [self.find(n) for n in names]
+            if raise_on_failure:
+                for i in infos:
+                    if i.state in (JobState.FAILED, JobState.CANCELLED):
+                        raise JobException(i)
+            if all(i.state in terminal for i in infos):
+                return infos
+            if deadline is not None and time.monotonic() > deadline:
+                running = [
+                    i.name for i in infos if i.state not in terminal
+                ]
+                raise TimeoutError(f"jobs still running: {running}")
+            time.sleep(poll_interval)
+
+    def stop(self, name: str):
+        job_name = self._jobs.get(name, self._job_name(name))
+        r = self._kubectl(
+            ["delete", "job", job_name, "--ignore-not-found", "--wait=false"]
+        )
+        if r.returncode != 0:
+            logger.warning(
+                f"kubectl delete failed for {name}: {r.stderr.strip()}"
+            )
+
+    def stop_all(self):
+        for name in list(self._jobs):
+            self.stop(name)
+        self._jobs.clear()
+
+
+register_scheduler("gke", KubernetesSchedulerClient)

@@ -235,6 +235,7 @@ class ClusterController:
         scheduler_mode: str = "local",
         kv_address: Optional[str] = None,
         worker_env: Optional[Dict[str, str]] = None,
+        scheduler_kwargs: Optional[Dict] = None,
     ):
         self.exp_cfg = exp_cfg
         self.spool_dir = spool_dir
@@ -249,10 +250,24 @@ class ClusterController:
             kv_address = self._kv_server.address
         self.kv_address = kv_address
         self.name_resolve_cfg = {"backend": "kv", "address": kv_address}
+        # Importing the client initializes the scheduler package, whose
+        # __init__ registers the cluster backends (gke).
         from areal_tpu.scheduler.client import make_scheduler
 
+        kwargs = dict(scheduler_kwargs or {})
+        if scheduler_mode != "local":
+            # Cluster job names must be scoped per trial: two experiments
+            # sharing a namespace would otherwise collide on worker names
+            # (and submit()'s stale-job cleanup would delete the other
+            # trial's live workers).
+            kwargs.setdefault(
+                "name_prefix",
+                f"{exp_cfg.experiment_name}-{exp_cfg.trial_name}",
+            )
         self._sched = make_scheduler(
-            scheduler_mode, log_dir=os.path.join(spool_dir, "logs")
+            scheduler_mode,
+            log_dir=os.path.join(spool_dir, "logs"),
+            **kwargs,
         )
         self._job_names: List[str] = []
 

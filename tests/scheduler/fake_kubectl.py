@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Fake `kubectl` for scheduler tests: emulates a k8s cluster at the
+subprocess boundary (the same seam the reference's tests fake sbatch at).
+
+Jobs are real local processes: `apply` launches the manifest's container
+command under a supervisor that records the exit code; `get job -o json`
+reports active/succeeded/failed the way the Job controller would; pods
+can be SIGKILLed out-of-band (pid in the state record) to simulate a
+lost node — a dead supervisor with no exit record reads as failed=1.
+
+State lives under $FAKE_K8S_STATE:
+  <job>.json  {"pid": ..., "manifest": ...}
+  <job>.exit  container exit code (written on normal completion)
+  <job>.log   combined stdout/stderr
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+
+def main() -> int:
+    state = os.environ["FAKE_K8S_STATE"]
+    os.makedirs(state, exist_ok=True)
+    args = sys.argv[1:]
+    if args[:1] == ["-n"]:
+        args = args[2:]
+    op = args[0]
+
+    def rec_path(name):
+        return os.path.join(state, name + ".json")
+
+    def exit_path(name):
+        return os.path.join(state, name + ".exit")
+
+    if op == "apply":
+        manifest = json.load(sys.stdin)
+        name = manifest["metadata"]["name"]
+        c = manifest["spec"]["template"]["spec"]["containers"][0]
+        env = dict(os.environ)
+        for e in c.get("env", []):
+            env[e["name"]] = e["value"]
+        log = open(os.path.join(state, name + ".log"), "ab")
+        sup = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "import subprocess, sys\n"
+                "rc = subprocess.call(sys.argv[2:])\n"
+                "open(sys.argv[1], 'w').write(str(rc))\n",
+                exit_path(name),
+                *c["command"],
+            ],
+            env=env,
+            cwd=c.get("workingDir") or None,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        with open(rec_path(name), "w") as f:
+            json.dump({"pid": sup.pid, "manifest": manifest}, f)
+        print(f"job.batch/{name} created")
+        return 0
+
+    if op == "get":
+        name = args[2]
+        if not os.path.exists(rec_path(name)):
+            print(
+                f'Error from server (NotFound): jobs.batch "{name}" not found',
+                file=sys.stderr,
+            )
+            return 1
+        with open(rec_path(name)) as f:
+            rec = json.load(f)
+        if os.path.exists(exit_path(name)):
+            with open(exit_path(name)) as f:
+                rc = int(f.read().strip() or 1)
+            status = {"succeeded": 1} if rc == 0 else {"failed": 1}
+        else:
+            try:
+                os.kill(rec["pid"], 0)
+                status = {"active": 1}
+            except (ProcessLookupError, PermissionError):
+                # Supervisor died without writing an exit record: the pod
+                # was killed (lost node / OOM-kill) -> Job sees a failure.
+                status = {"failed": 1}
+        print(
+            json.dumps(
+                {"metadata": {"name": name}, "status": status}
+            )
+        )
+        return 0
+
+    if op == "delete":
+        name = args[2]
+        if os.path.exists(rec_path(name)):
+            with open(rec_path(name)) as f:
+                rec = json.load(f)
+            try:
+                os.killpg(rec["pid"], signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            for p in (rec_path(name), exit_path(name)):
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+            print(f'job.batch "{name}" deleted')
+        elif "--ignore-not-found" not in args:
+            print(
+                f'Error from server (NotFound): jobs.batch "{name}" not found',
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    print(f"fake kubectl: unknown op {op!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
