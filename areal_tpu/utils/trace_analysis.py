@@ -1,0 +1,249 @@
+"""XLA trace post-processing: device-op time by kernel category.
+
+TPU counterpart of the reference's chrome-trace kernel-time analysis
+(realhf/base/monitor.py:404-610: CUDAKernelTimeCategory classification +
+interval-union accounting per category, incl. idle time): parses the
+`*.trace.json(.gz)` Chrome-format dump that `jax.profiler.trace` writes
+next to the xplane.pb, classifies each device-lane op by its HLO name
+into attention / gemm / collective / memory / fusion / misc, and computes
+per-device interval-union time so overlapping ops on parallel lanes are
+not double-counted. Idle = profile span minus the union of all op time.
+
+Used by `scripts/analyze_trace.py` on the per-MFC dumps produced by
+`areal_tpu.utils.profiling.maybe_profile` (AREAL_DUMP_TRACE=1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Order matters: first match wins (e.g. a "fusion" whose name mentions
+# attention is attention, not generic fusion).
+CATEGORY_KEYS: List[Tuple[str, Tuple[str, ...]]] = [
+    (
+        "attention",
+        ("flash_attention", "splash", "attention", "mha", "paged_attn"),
+    ),
+    (
+        "collective",
+        (
+            "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+            "collective-permute", "collective-broadcast", "psum",
+            "ppermute", "send", "recv",
+        ),
+    ),
+    ("gemm", ("dot", "conv", "matmul", "einsum", "megacore_fusion")),
+    (
+        "memory",
+        (
+            "copy", "transpose", "dynamic-update-slice", "dynamic-slice",
+            "broadcast", "concatenate", "reshape", "pad", "slice",
+            "gather", "scatter", "convert", "bitcast", "memset",
+            "infeed", "outfeed", "tuple", "iota",
+        ),
+    ),
+    ("fusion", ("fusion", "custom-call", "custom_call", "loop", "while")),
+]
+CATEGORIES = [c for c, _ in CATEGORY_KEYS] + ["misc", "idle"]
+
+
+def categorize(name: str, long_name: str = "") -> str:
+    """Map an HLO/kernel op name to a category. `long_name` (the
+    `args.long_name`/`args.hlo_op` xprof attaches) is consulted too, so
+    `fusion.123` whose expression contains a dot lands in gemm."""
+    s = f"{name} {long_name}".lower()
+    for cat, keys in CATEGORY_KEYS:
+        if any(k in s for k in keys):
+            return cat
+    return "misc"
+
+
+@dataclasses.dataclass
+class DeviceOpStats:
+    """Interval-union op time per category (microseconds) for one device."""
+
+    device: str
+    times_us: Dict[str, float]
+    span_us: float
+    n_ops: int
+
+    @property
+    def busy_us(self) -> float:
+        return sum(
+            v for k, v in self.times_us.items() if k != "idle"
+        )
+
+
+def _union_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) intervals."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total = 0.0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def resolve_trace_file(path: str) -> str:
+    """Accept a trace file, a jax.profiler dump dir, or an
+    AREAL_TRACE_DIR root; return the newest *.trace.json(.gz) under it."""
+    if os.path.isfile(path):
+        return path
+    cands = sorted(
+        glob.glob(
+            os.path.join(path, "**", "*.trace.json*"), recursive=True
+        ),
+        key=os.path.getmtime,
+    )
+    if not cands:
+        raise FileNotFoundError(f"no *.trace.json(.gz) under {path}")
+    return cands[-1]
+
+
+def load_trace(path: str) -> Dict:
+    path = resolve_trace_file(path)
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def device_lanes(trace: Dict) -> Dict[int, str]:
+    """pid -> device name for accelerator processes in the trace.
+
+    xprof names device processes '/device:TPU:0' (and the op rows live on
+    threads named 'XLA Ops...'); host processes are '/host:CPU'."""
+    out = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pname = (e.get("args") or {}).get("name", "")
+            if "/device:" in pname:
+                out[e["pid"]] = pname
+    return out
+
+
+def analyze(
+    trace: Dict, include_host: bool = False
+) -> List[DeviceOpStats]:
+    """Per-device category breakdown. Falls back to host lanes when the
+    trace has no device processes (CPU-only runs) and `include_host`."""
+    lanes = device_lanes(trace)
+    events = [
+        e
+        for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and "dur" in e and "ts" in e
+    ]
+    if not lanes and include_host:
+        lanes = {
+            e.get("pid"): f"host:{e.get('pid')}"
+            for e in events
+        }
+    stats = []
+    for pid, dev in sorted(lanes.items(), key=lambda kv: kv[1]):
+        by_cat: Dict[str, List[Tuple[float, float]]] = {}
+        all_iv: List[Tuple[float, float]] = []
+        t0, t1 = float("inf"), float("-inf")
+        n = 0
+        for e in events:
+            if e.get("pid") != pid:
+                continue
+            args = e.get("args") or {}
+            cat = categorize(
+                e.get("name", ""),
+                str(args.get("long_name", "")) + str(args.get("hlo_op", "")),
+            )
+            s, d = float(e["ts"]), float(e["dur"])
+            by_cat.setdefault(cat, []).append((s, s + d))
+            all_iv.append((s, s + d))
+            t0, t1 = min(t0, s), max(t1, s + d)
+            n += 1
+        if not n:
+            continue
+        span = t1 - t0
+        times = {c: 0.0 for c in CATEGORIES}
+        for cat, ivs in by_cat.items():
+            times[cat] = _union_us(ivs)
+        times["idle"] = max(0.0, span - _union_us(all_iv))
+        stats.append(
+            DeviceOpStats(device=dev, times_us=times, span_us=span, n_ops=n)
+        )
+    return stats
+
+
+def aggregate(stats: List[DeviceOpStats]) -> Dict:
+    """Summary dict: summed + per-device-average category times and
+    percentages (the reference's CUDAKernelTimeStat.gpu_average)."""
+    n = len(stats)
+    total = {c: sum(s.times_us.get(c, 0.0) for s in stats) for c in CATEGORIES}
+    span = sum(s.span_us for s in stats)
+    return {
+        "n_devices": n,
+        "span_us": span,
+        "total_us": total,
+        "avg_us": {c: (v / n if n else 0.0) for c, v in total.items()},
+        "pct": {
+            c: (v / span if span > 0 else 0.0) for c, v in total.items()
+        },
+        "n_ops": sum(s.n_ops for s in stats),
+    }
+
+
+def top_ops(
+    trace: Dict, pids: Optional[Iterable[int]] = None, k: int = 15
+) -> List[Tuple[str, str, float, int]]:
+    """(name, category, total_us, count), heaviest first — the quick
+    'which kernel is eating the step' view."""
+    if pids is None:
+        pids = set(device_lanes(trace))
+    else:
+        pids = set(pids)
+    acc: Dict[str, List[float]] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if pids and e.get("pid") not in pids:
+            continue
+        acc.setdefault(e.get("name", "?"), []).append(float(e["dur"]))
+    rows = [
+        (name, categorize(name), sum(durs), len(durs))
+        for name, durs in acc.items()
+    ]
+    rows.sort(key=lambda r: -r[2])
+    return rows[:k]
+
+
+def format_report(stats: List[DeviceOpStats], agg: Dict, top: List) -> str:
+    lines = []
+    lines.append(
+        f"devices: {agg['n_devices']}   ops: {agg['n_ops']}   "
+        f"span: {agg['span_us'] / 1e3:.3f} ms (summed)"
+    )
+    lines.append(
+        f"{'category':<12}{'total ms':>12}{'avg/dev ms':>14}{'%':>8}"
+    )
+    for c in CATEGORIES:
+        lines.append(
+            f"{c:<12}{agg['total_us'][c] / 1e3:>12.3f}"
+            f"{agg['avg_us'][c] / 1e3:>14.3f}"
+            f"{agg['pct'][c] * 100:>7.1f}%"
+        )
+    if top:
+        lines.append("")
+        lines.append(f"top ops ({len(top)}):")
+        for name, cat, us, cnt in top:
+            lines.append(
+                f"  {us / 1e3:>10.3f} ms  x{cnt:<5} [{cat}] {name[:80]}"
+            )
+    return "\n".join(lines)

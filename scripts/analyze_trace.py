@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Summarize a jax.profiler trace into kernel-category stats.
+
+Usage:
+  python scripts/analyze_trace.py <trace.json[.gz] | profile dir> [--json]
+  python scripts/analyze_trace.py /tmp/areal_tpu/traces/actor_train/step4
+
+Reference counterpart: realhf/base/monitor.py:404-610 (CUDA kernel time
+categories); see areal_tpu/utils/trace_analysis.py for the classifier.
+"""
+
+import argparse
+import json
+import sys
+
+from areal_tpu.utils import trace_analysis as ta
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="trace file or dump directory")
+    p.add_argument(
+        "--json", action="store_true", help="emit machine-readable JSON"
+    )
+    p.add_argument(
+        "--include-host",
+        action="store_true",
+        help="fall back to host lanes when the trace has no device lanes "
+        "(CPU-only runs)",
+    )
+    p.add_argument("--top", type=int, default=15, help="top-k op listing")
+    args = p.parse_args(argv)
+
+    trace = ta.load_trace(args.path)
+    stats = ta.analyze(trace, include_host=args.include_host)
+    if not stats:
+        print(
+            "no device lanes found (CPU trace? try --include-host)",
+            file=sys.stderr,
+        )
+        return 1
+    agg = ta.aggregate(stats)
+    pids = None if ta.device_lanes(trace) else []
+    top = ta.top_ops(trace, pids=pids, k=args.top)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "aggregate": agg,
+                    "per_device": [
+                        {
+                            "device": s.device,
+                            "times_us": s.times_us,
+                            "span_us": s.span_us,
+                            "n_ops": s.n_ops,
+                        }
+                        for s in stats
+                    ],
+                    "top_ops": [
+                        {
+                            "name": n,
+                            "category": c,
+                            "total_us": us,
+                            "count": cnt,
+                        }
+                        for n, c, us, cnt in top
+                    ],
+                }
+            )
+        )
+    else:
+        print(ta.format_report(stats, agg, top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

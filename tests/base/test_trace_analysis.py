@@ -1,0 +1,105 @@
+"""Trace post-processing into kernel-category stats (reference
+realhf/base/monitor.py:404-610), on a checked-in tiny device trace."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from areal_tpu.utils import trace_analysis as ta
+
+TRACE = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)),
+    "testdata",
+    "tiny_device_trace.json",
+)
+
+
+@pytest.fixture(scope="module")
+def trace():
+    return ta.load_trace(TRACE)
+
+
+def test_categorize():
+    assert ta.categorize("dot.42") == "gemm"
+    assert ta.categorize("all-reduce.1") == "collective"
+    assert ta.categorize("collective-permute.2") == "collective"
+    assert ta.categorize("copy.5") == "memory"
+    assert ta.categorize("fusion.7") == "fusion"
+    # long_name promotes a fusion wrapping a dot into gemm
+    assert ta.categorize("fusion.8", "fusion.8 = dot(bf16...)") == "gemm"
+    # pallas attention kernels are attention, not generic custom-call
+    assert (
+        ta.categorize("custom-call.3", "tpu_custom_call splash_attention_kernel")
+        == "attention"
+    )
+    assert ta.categorize("frobnicate.1") == "misc"
+
+
+def test_device_lanes_excludes_host(trace):
+    lanes = ta.device_lanes(trace)
+    assert lanes == {10: "/device:TPU:0", 11: "/device:TPU:1"}
+
+
+def test_analyze_union_and_idle(trace):
+    stats = ta.analyze(trace)
+    assert [s.device for s in stats] == ["/device:TPU:0", "/device:TPU:1"]
+    d0 = stats[0]
+    # The two overlapping all-reduce lanes [300,340)+[320,360) union to 60.
+    assert d0.times_us["collective"] == pytest.approx(60.0)
+    assert d0.times_us["gemm"] == pytest.approx(130.0)  # dot.42 + dot-fusion
+    assert d0.times_us["attention"] == pytest.approx(80.0)
+    assert d0.times_us["fusion"] == pytest.approx(50.0)
+    assert d0.times_us["memory"] == pytest.approx(20.0)
+    # span [0, 420): busy = 100+50+30+80+60+20 = 340 -> idle 80.
+    assert d0.span_us == pytest.approx(420.0)
+    assert d0.times_us["idle"] == pytest.approx(80.0)
+    d1 = stats[1]
+    assert d1.times_us["misc"] == pytest.approx(10.0)
+    assert d1.times_us["idle"] == pytest.approx(20.0)  # gap [180, 200)
+
+
+def test_aggregate(trace):
+    agg = ta.aggregate(ta.analyze(trace))
+    assert agg["n_devices"] == 2
+    assert agg["total_us"]["gemm"] == pytest.approx(130.0 + 120.0)
+    assert agg["avg_us"]["gemm"] == pytest.approx((130.0 + 120.0) / 2)
+    assert 0 < agg["pct"]["gemm"] < 1
+
+
+def test_top_ops(trace):
+    top = ta.top_ops(trace)
+    names = [t[0] for t in top]
+    assert names[0] == "dot.42"  # 100 + 120 us across devices
+    name, cat, us, cnt = top[0]
+    assert cat == "gemm" and us == pytest.approx(220.0) and cnt == 2
+    # host events excluded from the default device view
+    assert all("host" not in n for n in names)
+
+
+def test_cli_json(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/analyze_trace.py", TRACE, "--json"],
+        capture_output=True,
+        text=True,
+        cwd=repo,
+        env={**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["aggregate"]["n_devices"] == 2
+    assert out["top_ops"][0]["name"] == "dot.42"
+
+
+def test_resolve_trace_dir_layout(tmp_path):
+    """AREAL_TRACE_DIR layout: newest plugins/profile/<run>/*.trace.json."""
+    d = tmp_path / "mfc" / "step3" / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with open(TRACE) as f:
+        content = f.read()
+    (d / "host.trace.json").write_text(content)
+    trace = ta.load_trace(str(tmp_path))
+    assert ta.device_lanes(trace)
