@@ -38,6 +38,26 @@ class ModelTrainEvalConfig:
 
 
 @dataclasses.dataclass
+class MFCConfig:
+    """Per-MFC micro-batching override (reference MFCConfig,
+    api/cli_args.py: each model function call carries its own
+    MicroBatchSpec + allocation). None fields inherit the experiment's
+    global `mb_spec_n_mbs` / `mb_spec_max_tokens`."""
+
+    n_mbs: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={"help": "split this MFC's batch into n micro-batches"},
+    )
+    max_tokens_per_mb: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "cap tokens per micro-batch for this MFC "
+            "(balanced-packing split)"
+        },
+    )
+
+
+@dataclasses.dataclass
 class PPOHyperparameters:
     """Mirrors reference PPOHyperparameters (api/cli_args.py)."""
 
@@ -47,6 +67,16 @@ class PPOHyperparameters:
         )
     )
     group_size: int = 1
+    # Best-of-k: sample this many responses per prompt, verify, train on
+    # the top `group_size` (None disables; reference
+    # ppo_interface.py:376-408).
+    generation_size: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "sample-then-select: candidates per prompt before "
+            "keeping the best group_size"
+        },
+    )
     ppo_n_minibatches: int = 4
     eps_clip: float = 0.2
     c_clip: Optional[float] = None
@@ -147,6 +177,15 @@ class PPOMATHExpConfig(BaseExperimentConfig):
     critic: Optional[ModelTrainEvalConfig] = None  # None when disable_value
     ppo: PPOHyperparameters = dataclasses.field(default_factory=PPOHyperparameters)
     group_size: int = 1
+    # Per-MFC micro-batch overrides (reference PPOMATHConfig exposes one
+    # MFCConfig per function call; e.g. `actor_train.n_mbs=8
+    # actor_gen.max_tokens_per_mb=65536`).
+    actor_gen: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    actor_train: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    rew_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    ref_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    critic_inf: MFCConfig = dataclasses.field(default_factory=MFCConfig)
+    critic_train: MFCConfig = dataclasses.field(default_factory=MFCConfig)
 
     def __post_init__(self):
         if self.group_size > 1:
@@ -170,11 +209,79 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     gen_kv_pool_tokens: Optional[int] = None
     # Shard each generation server over this many devices (GSPMD TP).
     gen_tensor_parallel: int = 1
+    # Prefill shape buckets: prompts are padded up to a multiple of this,
+    # bounding the number of compiled prefill programs.
+    gen_prompt_bucket: int = 64
+    # Max prompts admitted into one batched prefill.
+    gen_prefill_max_batch: int = 8
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"
     agent_num_turns: int = 4
     agent_turn_discount: float = 1.0
+
+
+# ---------------------------------------------------------------------------
+# Option discovery (`--help-config`)
+# ---------------------------------------------------------------------------
+
+
+def describe_options(cfg: Any, prefix: str = "") -> List[Dict[str, Any]]:
+    """Walk a (possibly nested) config dataclass and return one row per
+    reachable dotted override path: {path, type, default, help}. This is
+    the counterpart of the reference's Hydra `--help` surface — every row
+    is directly usable as a `key=value` CLI override."""
+    rows: List[Dict[str, Any]] = []
+    cls = type(cfg) if not isinstance(cfg, type) else cfg
+    obj = cfg if not isinstance(cfg, type) else None
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        path = f"{prefix}{f.name}"
+        val = getattr(obj, f.name) if obj is not None else (
+            f.default
+            if f.default is not dataclasses.MISSING
+            else (
+                f.default_factory()
+                if f.default_factory is not dataclasses.MISSING
+                else None
+            )
+        )
+        typ = hints.get(f.name, f.type)
+        nested = val if dataclasses.is_dataclass(val) else None
+        if nested is None:
+            # Optional[dataclass] fields defaulting to None still expose
+            # their subtree (apply_overrides instantiates on demand).
+            for cand in typing.get_args(typ) or ():
+                if dataclasses.is_dataclass(cand):
+                    nested = cand()
+                    break
+        if nested is not None:
+            rows.extend(describe_options(nested, prefix=f"{path}."))
+            continue
+        rows.append(
+            {
+                "path": path,
+                "type": getattr(typ, "__name__", str(typ)),
+                "default": val,
+                "help": f.metadata.get("help", ""),
+            }
+        )
+    return rows
+
+
+def format_options(cfg: Any) -> str:
+    rows = describe_options(cfg)
+    width = max(len(r["path"]) for r in rows) + 2
+    lines = [
+        f"{type(cfg).__name__ if not isinstance(cfg, type) else cfg.__name__}"
+        f" options (override with dotted key=value):"
+    ]
+    for r in rows:
+        help_txt = f"  # {r['help']}" if r["help"] else ""
+        lines.append(
+            f"  {r['path']:<{width}}= {r['default']!r}{help_txt}"
+        )
+    return "\n".join(lines)
 
 
 # ---------------------------------------------------------------------------

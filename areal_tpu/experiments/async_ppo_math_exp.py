@@ -65,7 +65,6 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
     use_ref = cfg.ref is not None or (
         cfg.actor.path is not None and cfg.ppo.kl_ctl != 0.0
     )
-    mbs = C.mb_spec(cfg)
     n_seqs = cfg.train_batch_size
     iface_args = actor_interface_args(cfg)
 
@@ -85,7 +84,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
                 input_keys=("packed_input_ids", "prompt_mask"),
                 output_keys=("logprobs",),
                 output_key_remap={"logprobs": "ref_logprobs"},
-                mb_spec=mbs,
+                mb_spec=C.mb_spec(cfg, cfg.ref_inf),
             )
         )
         train_input_keys.append("ref_logprobs")
@@ -97,7 +96,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             interface_impl=ModelInterfaceAbstraction("ppo_actor"),
             n_seqs=n_seqs,
             input_keys=tuple(train_input_keys),
-            mb_spec=mbs,
+            mb_spec=C.mb_spec(cfg, cfg.actor_train),
             post_hooks=[ParamReallocHook(source=str(actor))],
         )
     )
@@ -151,6 +150,8 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             decode_block_steps=cfg.gen_decode_block_steps,
             kv_page_size=cfg.gen_kv_page_size,
             kv_pool_tokens=cfg.gen_kv_pool_tokens,
+            prompt_bucket=cfg.gen_prompt_bucket,
+            prefill_max_batch=cfg.gen_prefill_max_batch,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,
         )

@@ -66,10 +66,17 @@ def dataset_abstraction(d: DatasetConfig) -> DatasetAbstraction:
     return DatasetAbstraction(d.type_, args=args)
 
 
-def mb_spec(cfg: BaseExperimentConfig) -> MicroBatchSpec:
-    return MicroBatchSpec(
-        n_mbs=cfg.mb_spec_n_mbs, max_tokens_per_mb=cfg.mb_spec_max_tokens
-    )
+def mb_spec(cfg: BaseExperimentConfig, mfc=None) -> MicroBatchSpec:
+    """Global micro-batch spec, optionally overridden per MFC
+    (reference: each MFCConfig carries its own MicroBatchSpec)."""
+    n_mbs = cfg.mb_spec_n_mbs
+    max_tokens = cfg.mb_spec_max_tokens
+    if mfc is not None:
+        if mfc.n_mbs is not None:
+            n_mbs = mfc.n_mbs
+        if mfc.max_tokens_per_mb is not None:
+            max_tokens = mfc.max_tokens_per_mb
+    return MicroBatchSpec(n_mbs=n_mbs, max_tokens_per_mb=max_tokens)
 
 
 def worker_names(n: int) -> List[str]:

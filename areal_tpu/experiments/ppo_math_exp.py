@@ -47,6 +47,7 @@ def actor_interface_args(cfg: PPOMATHExpConfig) -> dict:
         use_decoupled_loss=p.use_decoupled_loss,
         behav_imp_weight_cap=p.behav_imp_weight_cap,
         token_normalize_scope=p.token_normalize_scope,
+        generation_size=p.generation_size,
         gconfig=dataclasses.asdict(p.gconfig.new(n=p.group_size)),
     )
 
@@ -80,7 +81,6 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
     use_critic = not cfg.ppo.disable_value and cfg.critic is not None
     use_ref = cfg.ref is not None or (cfg.actor.path is not None)
 
-    mbs = C.mb_spec(cfg)
     n_seqs = cfg.train_batch_size
     rpcs: List[MFCDef] = [
         MFCDef(
@@ -95,7 +95,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                 "seq_no_eos_mask",
             ),
             balanced_dp=True,
-            mb_spec=mbs,
+            mb_spec=C.mb_spec(cfg, cfg.actor_gen),
         ),
         MFCDef(
             name="rew_inf",
@@ -105,7 +105,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
             n_seqs=n_seqs,
             input_keys=("packed_input_ids", "prompt_mask"),
             output_keys=("rewards",),
-            mb_spec=mbs,
+            mb_spec=C.mb_spec(cfg, cfg.rew_inf),
         ),
     ]
     train_input_keys = [
@@ -123,7 +123,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                 input_keys=("packed_input_ids", "prompt_mask"),
                 output_keys=("logprobs",),
                 output_key_remap={"logprobs": "ref_logprobs"},
-                mb_spec=mbs,
+                mb_spec=C.mb_spec(cfg, cfg.ref_inf),
             )
         )
         train_input_keys.append("ref_logprobs")
@@ -139,7 +139,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                 n_seqs=n_seqs,
                 input_keys=("packed_input_ids", "prompt_mask"),
                 output_keys=("values",),
-                mb_spec=mbs,
+                mb_spec=C.mb_spec(cfg, cfg.critic_inf),
             )
         )
         train_input_keys.append("values")
@@ -153,7 +153,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
             ),
                 n_seqs=n_seqs,
                 input_keys=tuple(train_input_keys),
-                mb_spec=mbs,
+                mb_spec=C.mb_spec(cfg, cfg.critic_train),
             )
         )
     rpcs.append(
@@ -164,7 +164,7 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
             interface_impl=ModelInterfaceAbstraction("ppo_actor"),
             n_seqs=n_seqs,
             input_keys=tuple(train_input_keys),
-            mb_spec=mbs,
+            mb_spec=C.mb_spec(cfg, cfg.actor_train),
         )
     )
 

@@ -83,6 +83,10 @@ class PPOActorInterface(ModelInterface):
     use_decoupled_loss: bool = False
     behav_imp_weight_cap: Optional[float] = None
     temperature: float = 1.0
+    # Best-of-k: sample `generation_size` responses per prompt, verify
+    # them, and keep only the top `gconfig.n` (by score, longer-first on
+    # ties) for training (reference ppo_interface.py:376-408).
+    generation_size: Optional[int] = None
     gconfig: GenerationHyperparameters = dataclasses.field(
         default_factory=GenerationHyperparameters
     )
@@ -101,12 +105,62 @@ class PPOActorInterface(ModelInterface):
     # Generate (sync PPO path; async uses the rollout workers instead)
     # ------------------------------------------------------------------
 
+    def _best_of_k(
+        self, model: Model, input_: SequenceSample, outs: List[Dict], k: int
+    ) -> List[Dict]:
+        """Sample-then-select (reference ppo_interface.py:376-408 get_score
+        + topk): verify all `generation_size` candidates per prompt and
+        keep the k best, scores descending with longer generations
+        breaking ties. The reference looks answers up in a global id2info
+        table; here they ride in the sample metadata ('solutions').
+        Verification goes through verify_all (thread pool / remote batch
+        verifier) — bs * generation_size gradings would crawl serially."""
+        from areal_tpu.interfaces.reward import verify_all
+
+        g = self.generation_size
+        tasks = input_.metadata.get("tasks") or ["math"] * input_.bs
+        answers = input_.metadata.get("solutions") or input_.metadata.get(
+            "answers"
+        )
+        if answers is None:
+            raise ValueError(
+                "generation_size > gconfig.n needs 'solutions'/'answers' "
+                "metadata to score candidates"
+            )
+        jobs = [
+            (
+                tasks[pi],
+                model.tokenizer.decode(outs[pi * g + ci]["output_ids"]),
+                answers[pi],
+            )
+            for pi in range(input_.bs)
+            for ci in range(g)
+        ]
+        oks = verify_all(jobs)
+        selected: List[Dict] = []
+        for pi in range(input_.bs):
+            cand = outs[pi * g : (pi + 1) * g]
+            scored = [
+                (1.0 if oks[pi * g + ci] else 0.0, len(o["output_ids"]), ci)
+                for ci, o in enumerate(cand)
+            ]
+            scored.sort(key=lambda t: (t[0], t[1]), reverse=True)
+            selected.extend(cand[ci] for _, _, ci in scored[:k])
+        return selected
+
     def generate(
         self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec
     ) -> SequenceSample:
         engine = model.module
-        outs = engine.generate(input_, mb_spec, model.tokenizer, self.gconfig)
         n = self.gconfig.n
+        if self.generation_size is not None and self.generation_size > n:
+            gcfg = dataclasses.replace(self.gconfig, n=self.generation_size)
+            outs = engine.generate(input_, mb_spec, model.tokenizer, gcfg)
+            outs = self._best_of_k(model, input_, outs, n)
+        else:
+            outs = engine.generate(
+                input_, mb_spec, model.tokenizer, self.gconfig
+            )
         prompt_key = "packed_prompts" if "packed_prompts" in input_.keys else input_._main_key()
         flat_prompts = np.asarray(input_.data[prompt_key])
         plens = [sum(sl) for sl in input_.seqlens[prompt_key]]

@@ -25,6 +25,45 @@ from areal_tpu.functioncall.math_grader import grade_answer
 logger = areal_logging.getLogger("reward")
 
 
+def verify_one(task: str, text: str, answer_info: Any) -> bool:
+    """Grade one generated answer against its reference (math grader /
+    code testcases). Shared by the reward MFC and the PPO interface's
+    best-of-k selection."""
+    if task == "code":
+        cases = answer_info
+        if isinstance(cases, str):
+            cases = json.loads(cases)
+        return code_verify(text, cases)
+    return grade_answer(text, answer_info)
+
+
+def verify_all(jobs: List[tuple], max_workers: int = 8) -> List[bool]:
+    """Verify (task, text, answer) jobs — against the remote verifier
+    service when FUNCTIONCALL_SERVICE_DOMAIN is set (batched, with
+    retries; reference math_rw_interface.py:37-39), a local thread pool
+    otherwise. Shared by the reward MFC and best-of-k selection."""
+    from areal_tpu.functioncall import remote
+
+    if remote.remote_enabled():
+        oks: List[bool] = [False] * len(jobs)
+        by_task: Dict[str, List[int]] = {}
+        for i, (task, _, _) in enumerate(jobs):
+            by_task.setdefault(task, []).append(i)
+        for task, idxs in by_task.items():
+            payloads = []
+            for i in idxs:
+                _, text, answer = jobs[i]
+                key = "test_cases" if task == "code" else "answer"
+                payloads.append({"uid": str(i), "solution": text, key: answer})
+            results = remote.batch_verify(payloads, task)
+            for i, ok in zip(idxs, results):
+                oks[i] = ok
+        return oks
+
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        return list(ex.map(lambda args: verify_one(*args), jobs))
+
+
 @dataclasses.dataclass
 class MultiTaskRewardInterface(ModelInterface):
     correct_reward: float = 5.0
@@ -33,38 +72,10 @@ class MultiTaskRewardInterface(ModelInterface):
     check_verifier_status: bool = False
 
     def _verify_one(self, task: str, text: str, answer_info: Any) -> bool:
-        if task == "code":
-            cases = answer_info
-            if isinstance(cases, str):
-                cases = json.loads(cases)
-            return code_verify(text, cases)
-        return grade_answer(text, answer_info)
+        return verify_one(task, text, answer_info)
 
     def _verify_all(self, jobs: List[tuple]) -> List[bool]:
-        """Verify (task, text, answer) jobs — against the remote verifier
-        service when FUNCTIONCALL_SERVICE_DOMAIN is set (batched, with
-        retries; reference math_rw_interface.py:37-39), local verifiers
-        otherwise."""
-        from areal_tpu.functioncall import remote
-
-        if remote.remote_enabled():
-            oks: List[bool] = [False] * len(jobs)
-            by_task: Dict[str, List[int]] = {}
-            for i, (task, _, _) in enumerate(jobs):
-                by_task.setdefault(task, []).append(i)
-            for task, idxs in by_task.items():
-                payloads = []
-                for i in idxs:
-                    _, text, answer = jobs[i]
-                    key = "test_cases" if task == "code" else "answer"
-                    payloads.append({"uid": str(i), "solution": text, key: answer})
-                results = remote.batch_verify(payloads, task)
-                for i, ok in zip(idxs, results):
-                    oks[i] = ok
-            return oks
-
-        with ThreadPoolExecutor(max_workers=self.max_workers) as ex:
-            return list(ex.map(lambda args: self._verify_one(*args), jobs))
+        return verify_all(jobs, max_workers=self.max_workers)
 
     def inference(
         self, model: Model, input_: SequenceSample, mb_spec: MicroBatchSpec

@@ -152,3 +152,113 @@ def test_critic_interface_roundtrip(rollout):
     _attach_rewards_and_logps(cmodel, sample, seed=5)
     stats = citf.train_step(cmodel, sample, MicroBatchSpec())
     assert np.isfinite(stats["ppo_critic/loss"])
+
+
+# ---------------------------------------------------------------------------
+# Best-of-k selection (generation_size > group_size), reference
+# ppo_interface.py:376-408
+# ---------------------------------------------------------------------------
+
+
+class _StubGenEngine:
+    """Returns handcrafted candidates so selection is fully determined."""
+
+    def __init__(self, outs):
+        self.outs = outs
+        self.last_gconfig = None
+
+    def generate(self, input_, mb_spec, tokenizer, gconfig):
+        self.last_gconfig = gconfig
+        return self.outs
+
+
+class _StubTokenizer:
+    def __init__(self, mapping):
+        self.mapping = {tuple(k): v for k, v in mapping}
+
+    def decode(self, ids):
+        return self.mapping[tuple(ids)]
+
+
+def _cand(ids, text):
+    return (
+        dict(
+            output_ids=list(ids),
+            output_logprobs=np.full(len(ids), -0.5, np.float32),
+            no_eos=False,
+        ),
+        (list(ids), text),
+    )
+
+
+def test_best_of_k_selects_verified_candidates():
+    """With generation_size=4 and n=2, only the two verified-correct
+    candidates survive into the training sample (longer first)."""
+    cands = [
+        _cand([1, 2, 3], "the answer is \\boxed{41}"),      # wrong, len 3
+        _cand([4, 5], "\\boxed{42}"),                        # right, len 2
+        _cand([6, 7, 8, 9, 10], "no answer here at all"),    # wrong, len 5
+        _cand([11, 12, 13, 14], "so \\boxed{42} indeed"),    # right, len 4
+    ]
+    outs = [c[0] for c in cands]
+    eng = _StubGenEngine(outs)
+    tok = _StubTokenizer([c[1] for c in cands])
+    model = Model(name=ModelName("actor"), module=eng, tokenizer=tok)
+    itf = PPOActorInterface(
+        gconfig=GenerationHyperparameters(n=2, max_new_tokens=8),
+        generation_size=4,
+    )
+    prompts = SequenceSample.from_default(
+        ids=["p0"],
+        seqlens=[3],
+        data={"packed_prompts": np.asarray([50, 51, 52])},
+        metadata=dict(tasks=["math"], solutions=[["\\boxed{42}"]]),
+    )
+    sample = itf.generate(model, prompts, MicroBatchSpec())
+
+    # The engine was asked for generation_size candidates...
+    assert eng.last_gconfig.n == 4
+    # ...but the sample holds only n=2 groups.
+    group_lens = sample.seqlens["packed_input_ids"][0]
+    assert len(group_lens) == 2
+    flat = np.asarray(sample.data["packed_input_ids"])
+    seqs = np.split(flat, np.cumsum(group_lens))[:-1]
+    # Correct candidates only, longer one first (score desc, length desc).
+    assert seqs[0].tolist() == [50, 51, 52, 11, 12, 13, 14]
+    assert seqs[1].tolist() == [50, 51, 52, 4, 5]
+
+
+def test_best_of_k_all_wrong_falls_back_to_longest():
+    cands = [
+        _cand([1], "nope"),
+        _cand([2, 3, 4], "still nope"),
+        _cand([5, 6], "wrong"),
+    ]
+    eng = _StubGenEngine([c[0] for c in cands])
+    tok = _StubTokenizer([c[1] for c in cands])
+    model = Model(name=ModelName("actor"), module=eng, tokenizer=tok)
+    itf = PPOActorInterface(
+        gconfig=GenerationHyperparameters(n=1, max_new_tokens=8),
+        generation_size=3,
+    )
+    prompts = SequenceSample.from_default(
+        ids=["p0"],
+        seqlens=[2],
+        data={"packed_prompts": np.asarray([50, 51])},
+        metadata=dict(tasks=["math"], solutions=[["\\boxed{42}"]]),
+    )
+    sample = itf.generate(model, prompts, MicroBatchSpec())
+    flat = np.asarray(sample.data["packed_input_ids"])
+    # Tie on score=0 -> longest generation wins.
+    assert flat.tolist() == [50, 51, 2, 3, 4]
+
+
+def test_best_of_k_requires_solutions_metadata():
+    eng = _StubGenEngine([])
+    model = Model(name=ModelName("actor"), module=eng, tokenizer=_StubTokenizer([]))
+    itf = PPOActorInterface(
+        gconfig=GenerationHyperparameters(n=1), generation_size=2
+    )
+    prompts = make_prompts(n=1)
+    with pytest.raises(ValueError, match="solutions"):
+        itf.generate(model, prompts, MicroBatchSpec())
