@@ -129,6 +129,10 @@ class GenerationServerConfig:
     # bounded HBM with preempt-and-resubmit under pressure.
     kv_pool_tokens: Optional[int] = None
     decode_block_steps: int = 16
+    # Prompts pad up to a multiple of this (bounds compiled prefill
+    # shapes); prefill_max_batch caps prompts per batched prefill.
+    prompt_bucket: int = 64
+    prefill_max_batch: int = 8
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

@@ -68,6 +68,8 @@ class GenerationServer(Worker):
             seed=config.seed + config.server_index,
             page_size=config.kv_page_size,
             kv_pool_tokens=config.kv_pool_tokens,
+            prompt_bucket=config.prompt_bucket,
+            prefill_max_batch=config.prefill_max_batch,
             mesh=mesh,
         )
         self.engine.start()

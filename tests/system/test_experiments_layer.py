@@ -284,3 +284,95 @@ def test_auto_evaluator_wiring(tmp_path, monkeypatch):
 
     # Disabled -> no evaluator.
     assert TU._start_auto_evaluator(SFTExpConfig()) is None
+
+
+def test_per_mfc_microbatch_overrides(tmp_path):
+    """Per-MFC MicroBatchSpec reachable as dotted overrides (reference:
+    one MFCConfig per function call in PPOMATHConfig)."""
+    _, tok_dir, data = _sft_cfg(tmp_path)
+    pcfg = PPOMATHExpConfig()
+    apply_overrides(
+        pcfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+            "mb_spec_n_mbs=2",
+            "actor_train.n_mbs=8",
+            "actor_gen.max_tokens_per_mb=4096",
+        ],
+    )
+    exp = make_experiment("ppo-math", pcfg)
+    by_name = {r.name: r for r in exp.master.rpcs}
+    assert by_name["actor_train"].mb_spec.n_mbs == 8  # per-MFC override
+    assert by_name["actor_gen"].mb_spec.max_tokens_per_mb == 4096
+    assert by_name["actor_gen"].mb_spec.n_mbs == 2  # inherits global
+    assert by_name["rew_inf"].mb_spec.n_mbs == 2
+
+
+def test_serving_engine_knobs_reachable(tmp_path):
+    _, tok_dir, data = _sft_cfg(tmp_path)
+    acfg = AsyncPPOMATHExpConfig()
+    apply_overrides(
+        acfg,
+        [
+            f"tokenizer_path={tok_dir}",
+            f"dataset.path={data}",
+            f"actor.config={json.dumps(TINY_CFG)}",
+            "actor.init_from_scratch=true",
+            "gen_prompt_bucket=128",
+            "gen_prefill_max_batch=4",
+            "gen_kv_pool_tokens=65536",
+            "exp_ctrl.save_freq_steps=50",
+            "exp_ctrl.eval_freq_epochs=1",
+        ],
+    )
+    exp = make_experiment("async-ppo-math", acfg)
+    gs = exp.generation_servers[0]
+    assert gs.prompt_bucket == 128
+    assert gs.prefill_max_batch == 4
+    assert gs.kv_pool_tokens == 65536
+    assert exp.master.exp_ctrl.save_freq_steps == 50
+    assert exp.master.exp_ctrl.eval_freq_epochs == 1
+
+
+def test_describe_options_surface():
+    """Every dotted override path is discoverable with type/default/help
+    (the reference's Hydra --help surface)."""
+    from areal_tpu.api.cli_args import describe_options, format_options
+
+    rows = describe_options(AsyncPPOMATHExpConfig())
+    paths = {r["path"] for r in rows}
+    # nested dataclasses expand ...
+    assert "ppo.gconfig.max_new_tokens" in paths
+    assert "actor.optimizer.lr" in paths
+    assert "actor_train.n_mbs" in paths
+    assert "exp_ctrl.save_freq_steps" in paths
+    assert "gen_prompt_bucket" in paths
+    # ... including Optional[dataclass] fields defaulting to None
+    assert "critic.optimizer.lr" in paths
+    # help metadata rides along
+    per_mfc = next(r for r in rows if r["path"] == "actor_train.n_mbs")
+    assert "micro-batches" in per_mfc["help"]
+    txt = format_options(AsyncPPOMATHExpConfig())
+    assert "ppo.gconfig.max_new_tokens" in txt
+
+
+def test_help_config_flag(tmp_path):
+    """`training/main_*.py --help-config` prints the full option surface."""
+    repo = fixtures.REPO_ROOT if hasattr(fixtures, "REPO_ROOT") else None
+    import os
+
+    repo = repo or os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    r = subprocess.run(
+        [sys.executable, "training/main_sync_ppo.py", "--help-config"],
+        capture_output=True,
+        text=True,
+        cwd=repo,
+        env={**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"},
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "actor.optimizer.lr" in r.stdout
+    assert "exp_ctrl.save_freq_steps" in r.stdout

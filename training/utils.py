@@ -28,8 +28,19 @@ def parse_args(cfg_cls: Type, argv=None):
         "Overrides: dotted key=value pairs, e.g. actor.path=/ckpt lr=1e-5",
     )
     parser.add_argument("overrides", nargs="*", help="a.b.c=value overrides")
+    parser.add_argument(
+        "--help-config",
+        action="store_true",
+        help="list every dotted override path with type/default/help "
+        "(the Hydra --help surface of the reference)",
+    )
     args = parser.parse_args(argv)
     cfg = cfg_cls()
+    if args.help_config:
+        from areal_tpu.api.cli_args import format_options
+
+        print(format_options(cfg))
+        sys.exit(0)
     apply_overrides(cfg, args.overrides)
     return cfg
 
