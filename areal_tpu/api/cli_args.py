@@ -214,6 +214,14 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     gen_prompt_bucket: int = 64
     # Max prompts admitted into one batched prefill.
     gen_prefill_max_batch: int = 8
+    # Chunked prefill threshold/size for long prompts (None disables).
+    gen_prefill_chunk: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "prompts longer than this prefill in fixed-size "
+            "chunks through one compiled program (16-32k contexts)"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

@@ -133,6 +133,10 @@ class GenerationServerConfig:
     # shapes); prefill_max_batch caps prompts per batched prefill.
     prompt_bucket: int = 64
     prefill_max_batch: int = 8
+    # Prompts longer than this prefill chunk-by-chunk through one
+    # fixed-shape program (None disables; essential for 16-32k prompts
+    # where each new length bucket is a fresh multi-second compile).
+    prefill_chunk: Optional[int] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

@@ -303,6 +303,57 @@ def paged_decode_step(
 
 
 # ----------------------------------------------------------------------
+# Chunked prefill (long prompts)
+# ----------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "mesh"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def paged_chunk_prefill(
+    params,
+    cfg: TransformerConfig,
+    tokens,  # [C] chunk token ids, right-padded to the chunk size
+    k_pages,
+    v_pages,
+    page_row,  # [P] the request's page-table row
+    start,  # scalar int32: absolute position of tokens[0]
+    valid_len,  # scalar int32: valid tokens in this chunk
+    attn_impl: str = "auto",
+    mesh=None,
+):
+    """One chunk of ONE long prompt through the paged pool.
+
+    A chunk of C tokens at positions start..start+C-1 is exactly C decode
+    rows of the same request with staggered lengths sharing one
+    page-table row: every row's K/V scatters into its (page, offset)
+    first, then row i's attention masks gathered keys at flat positions
+    < start+i+1 — full prefix (earlier chunks, already in the pool) plus
+    intra-chunk causal. So this reuses paged_decode_step verbatim, which
+    keeps ONE compiled program for any prompt length (the batched
+    prefill path compiles per length bucket — ruinous for 16-32k prompts
+    with varied lengths; the reference's serving backend chunk-prefills
+    long prompts for the same reason).
+
+    Returns (last_logits [V] — the final valid row's, for first-token
+    sampling; meaningful only on the prompt's last chunk — k_pages,
+    v_pages)."""
+    C = tokens.shape[0]
+    rows = jnp.arange(C, dtype=jnp.int32)
+    lengths = start + rows
+    active = rows < valid_len
+    page_indices = jnp.broadcast_to(page_row, (C, page_row.shape[0]))
+    logits, k_pages, v_pages = paged_decode_step(
+        params, cfg, tokens, k_pages, v_pages, page_indices, lengths,
+        active, mesh=mesh, attn_impl=attn_impl,
+    )
+    last = logits[jnp.maximum(valid_len - 1, 0)]
+    return last, k_pages, v_pages
+
+
+# ----------------------------------------------------------------------
 # Prefill scatter
 # ----------------------------------------------------------------------
 

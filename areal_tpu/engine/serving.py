@@ -54,6 +54,7 @@ from areal_tpu.engine.paged import (
     PageAllocator,
     apply_admits,
     apply_deactivations,
+    paged_chunk_prefill,
     paged_decode_block,
     pages_needed,
     scatter_prefill,
@@ -164,6 +165,7 @@ class ServingEngine:
         mesh=None,
         attn_impl: str = "auto",
         prefill_max_batch: int = 8,
+        prefill_chunk: Optional[int] = None,
     ):
         self.cfg = cfg
         # Sampled token ids round-trip through float32 in the packed
@@ -185,6 +187,15 @@ class ServingEngine:
         self.block_steps = decode_block_steps
         self.prompt_bucket = prompt_bucket
         self.prefill_max_batch = prefill_max_batch
+        # Prompts longer than this prefill chunk-by-chunk through ONE
+        # fixed-shape program (paged.paged_chunk_prefill) instead of the
+        # per-length-bucket batched path — essential at 16-32k contexts
+        # where every new bucket is a fresh multi-second XLA compile.
+        assert prefill_chunk is None or prefill_chunk > 0, (
+            f"prefill_chunk must be a positive chunk size or None, "
+            f"got {prefill_chunk}"
+        )
+        self.prefill_chunk = prefill_chunk
         self.eos_token_id = eos_token_id
         self.attn_impl = attn_impl
         self.version = 0
@@ -320,6 +331,30 @@ class ServingEngine:
             except queue.Empty:
                 return
 
+    def _chunked_prefill_one(self, input_ids: List[int], pages: List[int]):
+        """Prefill one long prompt chunk-by-chunk into its allocated
+        pages; returns the device [V] logits row of the final token (for
+        first-token sampling). One compiled program total — chunk size,
+        page-table width, and pool shapes are all static."""
+        C = self.prefill_chunk
+        self._ensure_pool()
+        prow = np.full((self.max_pages,), TRASH_PAGE, np.int32)
+        prow[: len(pages)] = pages
+        prow_dev = jnp.asarray(prow)
+        last = None
+        for s0 in range(0, len(input_ids), C):
+            seg = input_ids[s0 : s0 + C]
+            valid = len(seg)
+            toks = np.zeros((C,), np.int32)
+            toks[:valid] = seg
+            last, self._k_pages, self._v_pages = paged_chunk_prefill(
+                self.params, self.cfg, jnp.asarray(toks), self._k_pages,
+                self._v_pages, prow_dev, jnp.asarray(s0, jnp.int32),
+                jnp.asarray(valid, jnp.int32), attn_impl=self.attn_impl,
+                mesh=self.mesh,
+            )
+        return last
+
     def _admit(self):
         """Fill free slots from the backlog with ONE batched prefill and
         ONE fused device state update."""
@@ -368,33 +403,61 @@ class ServingEngine:
             batch.append((free.pop(0), req, plen, pages))
         if not batch:
             return
-        pad = _round_up(max(p for _, _, p, _ in batch), self.prompt_bucket)
-        pad = _round_up(min(pad, self.S), self.page_size)
+        # Long prompts go through the fixed-shape chunked prefill (one
+        # compiled program regardless of length); short ones keep the
+        # batched bucketed path. Chunked entries first so logits rows
+        # stay aligned with `batch` order.
+        chunk = self.prefill_chunk
+        long = [e for e in batch if chunk and e[2] > chunk]
+        short = [e for e in batch if not (chunk and e[2] > chunk)]
+        batch = long + short
+        logits_rows = [
+            self._chunked_prefill_one(req.input_ids, pages)
+            for _, req, _, pages in long
+        ]
+        if short:
+            pad = _round_up(max(p for _, _, p, _ in short), self.prompt_bucket)
+            pad = _round_up(min(pad, self.S), self.page_size)
+            n_s = _pow2_at_least(len(short), self.prefill_max_batch)
+            ids = np.zeros((n_s, pad), np.int32)
+            lens = np.ones((n_s,), np.int32)  # dummy rows: 1-token prompts
+            for i, (_, req, plen, _) in enumerate(short):
+                ids[i, :plen] = req.input_ids
+                lens[i] = plen
+            short_logits, k_pref, v_pref = _prefill_batch(
+                self.params, self.cfg, jnp.asarray(ids), jnp.asarray(lens),
+                pad_len=pad, mesh=self.mesh,
+            )
+            # Scatter prefill KV into the pool. Chunks past a row's
+            # allocation (prompt-bucket padding) and dummy rows land on
+            # the trash page.
+            n_chunks = pad // self.page_size
+            flat = np.full((n_s, n_chunks), TRASH_PAGE, np.int32)
+            for i, (_, _, plen_i, pages) in enumerate(short):
+                # Only the prompt's chunks carry prefill KV; pages
+                # reserved beyond the prompt (first-decode-block
+                # headroom) receive decode writes later.
+                n_p = pages_needed(plen_i, self.page_size)
+                flat[i, :n_p] = pages[:n_p]
+            self._ensure_pool()
+            self._k_pages, self._v_pages = scatter_prefill(
+                self._k_pages, self._v_pages, k_pref, v_pref,
+                jnp.asarray(flat.reshape(-1)),
+            )
+            if long:
+                # Only the mixed case pays for per-row slicing; the
+                # all-short fast path below uses short_logits whole.
+                logits_rows.extend(
+                    short_logits[i] for i in range(len(short))
+                )
         n_b = _pow2_at_least(len(batch), self.prefill_max_batch)
-        ids = np.zeros((n_b, pad), np.int32)
-        lens = np.ones((n_b,), np.int32)  # dummy rows: 1-token prompts
-        for i, (_, req, plen, _) in enumerate(batch):
-            ids[i, :plen] = req.input_ids
-            lens[i] = plen
-        last_logits, k_pref, v_pref = _prefill_batch(
-            self.params, self.cfg, jnp.asarray(ids), jnp.asarray(lens),
-            pad_len=pad, mesh=self.mesh,
-        )
-        # Scatter prefill KV into the pool. Chunks past a row's allocation
-        # (prompt-bucket padding) and dummy rows land on the trash page.
-        n_chunks = pad // self.page_size
-        flat = np.full((n_b, n_chunks), TRASH_PAGE, np.int32)
-        for i, (_, _, plen_i, pages) in enumerate(batch):
-            # Only the prompt's chunks carry prefill KV; pages reserved
-            # beyond the prompt (first-decode-block headroom) receive
-            # decode writes later.
-            n_p = pages_needed(plen_i, self.page_size)
-            flat[i, :n_p] = pages[:n_p]
-        self._ensure_pool()
-        self._k_pages, self._v_pages = scatter_prefill(
-            self._k_pages, self._v_pages, k_pref, v_pref,
-            jnp.asarray(flat.reshape(-1)),
-        )
+        if not long:
+            last_logits = short_logits  # already [n_b, V]: fast path
+        else:
+            last_logits = jnp.stack(
+                logits_rows
+                + [jnp.zeros_like(logits_rows[0])] * (n_b - len(batch))
+            )
         # Sample each row's first token (same warp as the decode block).
         self._rng, sub = jax.random.split(self._rng)
         eos_rows = np.stack(

@@ -152,6 +152,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             kv_pool_tokens=cfg.gen_kv_pool_tokens,
             prompt_bucket=cfg.gen_prompt_bucket,
             prefill_max_batch=cfg.gen_prefill_max_batch,
+            prefill_chunk=cfg.gen_prefill_chunk,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,
         )

@@ -70,6 +70,7 @@ class GenerationServer(Worker):
             kv_pool_tokens=config.kv_pool_tokens,
             prompt_bucket=config.prompt_bucket,
             prefill_max_batch=config.prefill_max_batch,
+            prefill_chunk=config.prefill_chunk,
             mesh=mesh,
         )
         self.engine.start()

@@ -21,7 +21,8 @@ PAGE = 128
 
 
 @pytest.mark.slow
-def test_serving_16k_context_reduced_pool():
+@pytest.mark.parametrize("prefill_chunk", [2048, None])
+def test_serving_16k_context_reduced_pool(prefill_chunk):
     cfg = TransformerConfig(
         n_layers=1,
         hidden_dim=32,
@@ -47,6 +48,10 @@ def test_serving_16k_context_reduced_pool():
         eos_token_id=None,
         page_size=PAGE,
         kv_pool_tokens=PLEN + MAX_NEW + 2 * PAGE,
+        # Both long-context paths stay pinned: fixed-shape chunked
+        # prefill (the recommended one — one compile for any prompt
+        # length) and the batched bucketed path (still the default).
+        prefill_chunk=prefill_chunk,
     )
     eng.start()
     try:
