@@ -222,6 +222,14 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "chunks through one compiled program (16-32k contexts)"
         },
     )
+    # Prefix KV reuse budget for partial-rollout resubmissions.
+    gen_prefix_cache_tokens: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "token budget for qid-keyed prefix KV reuse; "
+            "resubmissions prefill only the delta (None disables)"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

@@ -137,6 +137,10 @@ class GenerationServerConfig:
     # fixed-shape program (None disables; essential for 16-32k prompts
     # where each new length bucket is a fresh multi-second compile).
     prefill_chunk: Optional[int] = None
+    # qid-keyed prefix KV reuse budget in tokens (None disables): a
+    # resubmission extending a parked sequence prefills only the delta —
+    # the radix-cache role for partial-rollout chunking.
+    prefix_cache_tokens: Optional[int] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

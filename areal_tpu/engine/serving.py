@@ -37,6 +37,7 @@ reused for the server's lifetime.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import queue
@@ -166,6 +167,7 @@ class ServingEngine:
         attn_impl: str = "auto",
         prefill_max_batch: int = 8,
         prefill_chunk: Optional[int] = None,
+        prefix_cache_tokens: Optional[int] = None,
     ):
         self.cfg = cfg
         # Sampled token ids round-trip through float32 in the packed
@@ -196,6 +198,23 @@ class ServingEngine:
             f"got {prefill_chunk}"
         )
         self.prefill_chunk = prefill_chunk
+        # qid-keyed prefix KV reuse (the radix-cache role of the
+        # reference's serving backend): finished/interrupted requests
+        # park their pages here; a resubmission with the same qid whose
+        # prompt extends the cached tokens prefills only the delta
+        # (partial rollouts resubmit prompt+generated with one qid per
+        # sample, system/partial_rollout.py:88 — the whole-prefix
+        # recompute was their dominant cost). Budget-bounded in tokens;
+        # evicted LRU-first under any pool pressure; flushed on weight
+        # swaps (old-weight KV is invalid). None disables.
+        assert prefix_cache_tokens is None or prefix_cache_tokens >= 0
+        self.prefix_cache_tokens = prefix_cache_tokens or 0
+        self._prefix_cache: "collections.OrderedDict[str, Tuple[List[int], List[int]]]" = (
+            collections.OrderedDict()
+        )
+        self._cached_tokens = 0
+        self.prefix_cache_hits = 0
+        self.prefix_tokens_reused = 0
         self.eos_token_id = eos_token_id
         self.attn_impl = attn_impl
         self.version = 0
@@ -292,6 +311,9 @@ class ServingEngine:
             "kv_pages_total": float(self.n_pages - 1),
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
+            "prefix_cache_hits": float(self.prefix_cache_hits),
+            "prefix_tokens_reused": float(self.prefix_tokens_reused),
+            "prefix_cached_tokens": float(self._cached_tokens),
         }
 
     # ------------------------------------------------------------------
@@ -331,18 +353,24 @@ class ServingEngine:
             except queue.Empty:
                 return
 
-    def _chunked_prefill_one(self, input_ids: List[int], pages: List[int]):
-        """Prefill one long prompt chunk-by-chunk into its allocated
-        pages; returns the device [V] logits row of the final token (for
+    def _chunked_prefill_one(
+        self, input_ids: List[int], pages: List[int], start: int = 0
+    ):
+        """Prefill one prompt chunk-by-chunk into its allocated pages,
+        beginning at position `start` (nonzero for prefix-cache hits:
+        positions below `start` already hold valid KV in `pages`).
+        Returns the device [V] logits row of the final token (for
         first-token sampling). One compiled program total — chunk size,
         page-table width, and pool shapes are all static."""
-        C = self.prefill_chunk
+        # Cache-hit deltas run even when chunked prefill is not
+        # configured; the prompt bucket doubles as the chunk size then.
+        C = self.prefill_chunk or self.prompt_bucket
         self._ensure_pool()
         prow = np.full((self.max_pages,), TRASH_PAGE, np.int32)
         prow[: len(pages)] = pages
         prow_dev = jnp.asarray(prow)
         last = None
-        for s0 in range(0, len(input_ids), C):
+        for s0 in range(start, len(input_ids), C):
             seg = input_ids[s0 : s0 + C]
             valid = len(seg)
             toks = np.zeros((C,), np.int32)
@@ -364,7 +392,7 @@ class ServingEngine:
             return
         self._drain_queue()
         free = self._free_slots()
-        batch: List[Tuple[int, GenRequest, int, List[int]]] = []
+        batch: List[Tuple[int, GenRequest, int, List[int], int]] = []
         while free and self._backlog and len(batch) < self.prefill_max_batch:
             req = self._backlog[0]
             plen = len(req.input_ids)
@@ -396,11 +424,41 @@ class ServingEngine:
             # resubmit with a full batched prefill each lap.
             n_reserve = pages_needed(plen + self.block_steps, self.page_size)
             n_reserve = min(n_reserve, self.max_pages, self.n_pages - 1)
-            pages = self._allocator.alloc(n_reserve)
+            # Prefix-cache lookup: a resubmission whose prompt extends
+            # the cached tokens keeps those pages and prefills only the
+            # delta (positions cached_use..plen-1).
+            pages = None
+            cached_use = 0
+            ent = self._prefix_cache.pop(req.qid, None)
+            if ent is not None:
+                ctoks, cpages = ent
+                self._cached_tokens -= len(ctoks)
+                use = min(len(ctoks), plen - 1)
+                if (
+                    use >= self.page_size
+                    and ctoks[:use] == req.input_ids[:use]
+                ):
+                    if len(cpages) < n_reserve:
+                        got = self._alloc_pages(n_reserve - len(cpages))
+                        if got is None:
+                            # Pool pressure mid-extension: re-park the
+                            # entry and stop admitting.
+                            self._prefix_cache[req.qid] = ent
+                            self._cached_tokens += len(ctoks)
+                            break
+                        cpages = cpages + got
+                    pages = cpages
+                    cached_use = use
+                    self.prefix_cache_hits += 1
+                    self.prefix_tokens_reused += use
+                else:
+                    self._allocator.free(cpages)
             if pages is None:
-                break  # pool pressure: wait for frees
+                pages = self._alloc_pages(n_reserve)
+                if pages is None:
+                    break  # pool pressure: wait for frees
             self._backlog.pop(0)
-            batch.append((free.pop(0), req, plen, pages))
+            batch.append((free.pop(0), req, plen, pages, cached_use))
         if not batch:
             return
         # Long prompts go through the fixed-shape chunked prefill (one
@@ -408,20 +466,27 @@ class ServingEngine:
         # batched bucketed path. Chunked entries first so logits rows
         # stay aligned with `batch` order.
         chunk = self.prefill_chunk
-        long = [e for e in batch if chunk and e[2] > chunk]
-        short = [e for e in batch if not (chunk and e[2] > chunk)]
+
+        def _is_chunked(e):
+            # Cache hits ALWAYS take the chunked path (only the delta
+            # past cached_use needs compute); fresh prompts chunk when
+            # longer than the configured threshold.
+            return e[4] > 0 or (chunk and e[2] > chunk)
+
+        long = [e for e in batch if _is_chunked(e)]
+        short = [e for e in batch if not _is_chunked(e)]
         batch = long + short
         logits_rows = [
-            self._chunked_prefill_one(req.input_ids, pages)
-            for _, req, _, pages in long
+            self._chunked_prefill_one(req.input_ids, pages, start=cu)
+            for _, req, _, pages, cu in long
         ]
         if short:
-            pad = _round_up(max(p for _, _, p, _ in short), self.prompt_bucket)
+            pad = _round_up(max(p for _, _, p, _, _ in short), self.prompt_bucket)
             pad = _round_up(min(pad, self.S), self.page_size)
             n_s = _pow2_at_least(len(short), self.prefill_max_batch)
             ids = np.zeros((n_s, pad), np.int32)
             lens = np.ones((n_s,), np.int32)  # dummy rows: 1-token prompts
-            for i, (_, req, plen, _) in enumerate(short):
+            for i, (_, req, plen, _, _) in enumerate(short):
                 ids[i, :plen] = req.input_ids
                 lens[i] = plen
             short_logits, k_pref, v_pref = _prefill_batch(
@@ -433,7 +498,7 @@ class ServingEngine:
             # the trash page.
             n_chunks = pad // self.page_size
             flat = np.full((n_s, n_chunks), TRASH_PAGE, np.int32)
-            for i, (_, _, plen_i, pages) in enumerate(short):
+            for i, (_, _, plen_i, pages, _) in enumerate(short):
                 # Only the prompt's chunks carry prefill KV; pages
                 # reserved beyond the prompt (first-decode-block
                 # headroom) receive decode writes later.
@@ -461,13 +526,13 @@ class ServingEngine:
         # Sample each row's first token (same warp as the decode block).
         self._rng, sub = jax.random.split(self._rng)
         eos_rows = np.stack(
-            [self._eos_mask_np(req) for _, req, _, _ in batch]
+            [self._eos_mask_np(req) for _, req, *_ in batch]
             + [self._eos_mask_np(None)] * (n_b - len(batch))
         )
 
         def col(fn, dtype, fill):
             return np.asarray(
-                [fn(r) for _, r, _, _ in batch]
+                [fn(r) for _, r, *_ in batch]
                 + [fill] * (n_b - len(batch)), dtype,
             )
 
@@ -486,7 +551,7 @@ class ServingEngine:
         adm_slots, adm_valid = [], []
         adm_plens, adm_toks, adm_budget, adm_minr = [], [], [], []
         adm_t, adm_tp, adm_tk, adm_g = [], [], [], []
-        for i, (slot, req, plen, pages) in enumerate(batch):
+        for i, (slot, req, plen, pages, _) in enumerate(batch):
             tok_i, lp_f = int(packed[i, 0]), float(packed[i, 1])
             # A stale deactivation from this slot's PREVIOUS request must
             # not clobber the fresh activation (apply_admits fully
@@ -503,6 +568,11 @@ class ServingEngine:
             is_eos = tok_i in self._eos_set(req)
             budget_left = req.max_new_tokens - 1
             if (is_eos and req.min_new_tokens <= 1) or budget_left <= 0:
+                # The prompt's KV is fully in the pool even though no
+                # decode step ran; record it so _finish_slot can park
+                # the pages for a same-qid extension instead of freeing
+                # a fresh (possibly 16-32k-token) prefill.
+                self._len[slot] = plen
                 self._finish_slot(slot, hit_eos=is_eos)
                 continue
             # `self._len` counts cache fill EXCLUDING the pending
@@ -538,6 +608,28 @@ class ServingEngine:
             n_slots=self.B,
         )
 
+    def _evict_one_prefix(self) -> bool:
+        """Free the least-recently-used cached prefix's pages."""
+        if not self._prefix_cache:
+            return False
+        qid, (toks, pages) = self._prefix_cache.popitem(last=False)
+        self._allocator.free(pages)
+        self._cached_tokens -= len(toks)
+        return True
+
+    def _flush_prefix_cache(self):
+        while self._evict_one_prefix():
+            pass
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocate, evicting cached prefixes under pressure: speculative
+        cache pages must never cost an active request its admission or
+        its next decode block."""
+        got = self._allocator.alloc(n)
+        while got is None and self._evict_one_prefix():
+            got = self._allocator.alloc(n)
+        return got
+
     def _ensure_pages(self):
         """Grow each active slot's page allocation to cover the next
         decode block; preempt (interrupt-partial) the slot itself on pool
@@ -559,7 +651,7 @@ class ServingEngine:
             cur = len(self._slot_pages[slot])
             if need <= cur:
                 continue
-            got = self._allocator.alloc(need - cur)
+            got = self._alloc_pages(need - cur)
             if got is None:
                 self.n_preempted += 1
                 self._finish_slot(slot, hit_eos=False, interrupted=True)
@@ -606,12 +698,40 @@ class ServingEngine:
             no_eos=not hit_eos, interrupted=interrupted,
             vstart=self._slot_vstart[slot],
         )
+        pages = self._slot_pages[slot]
+        if pages:
+            # Park the sequence's KV for qid resubmission instead of
+            # freeing (budget permitting): the covered tokens are the
+            # prompt plus emitted tokens whose K/V actually landed in
+            # the pool (self._len excludes the pending next-input token).
+            covered = (list(req.input_ids) + self._slot_out[slot])[
+                : int(self._len[slot])
+            ]
+            if (
+                self.prefix_cache_tokens
+                and len(covered) >= self.page_size
+                # A pending weight swap invalidates this KV the moment it
+                # lands — parking it would only churn the eviction loop
+                # before _apply_pending_params flushes everything.
+                and self._pending_params is None
+            ):
+                old = self._prefix_cache.pop(req.qid, None)
+                if old is not None:
+                    self._allocator.free(old[1])
+                    self._cached_tokens -= len(old[0])
+                self._prefix_cache[req.qid] = (covered, pages)
+                self._cached_tokens += len(covered)
+                while (
+                    self._cached_tokens > self.prefix_cache_tokens
+                    and self._evict_one_prefix()
+                ):
+                    pass
+            else:
+                self._allocator.free(pages)
         self._slot_req[slot] = None
         self._slot_out[slot] = []
         self._slot_lp[slot] = []
-        if self._slot_pages[slot]:
-            self._allocator.free(self._slot_pages[slot])
-            self._slot_pages[slot] = []
+        self._slot_pages[slot] = []
         self._page_table[slot, :] = TRASH_PAGE
         self._pt_dirty = True
         # The device active mask may still have this slot on (host-side
@@ -632,6 +752,10 @@ class ServingEngine:
             self._pending_params = None
             self._pending_version = None
         if pending is not None:
+            # Cached prefixes hold KV computed under the OLD weights:
+            # reusing them after the swap would decode against a stale
+            # attention state. Flush before the new version goes live.
+            self._flush_prefix_cache()
             t0 = time.monotonic()
             if self.mesh is not None:
                 from areal_tpu.parallel.sharding import shard_params

@@ -71,6 +71,7 @@ class GenerationServer(Worker):
             prompt_bucket=config.prompt_bucket,
             prefill_max_batch=config.prefill_max_batch,
             prefill_chunk=config.prefill_chunk,
+            prefix_cache_tokens=config.prefix_cache_tokens,
             mesh=mesh,
         )
         self.engine.start()
@@ -221,6 +222,9 @@ class GenerationServer(Worker):
             f"areal:kv_pages_free {m['kv_pages_free']}",
             f"areal:kv_pages_total {m['kv_pages_total']}",
             f"areal:num_preempted_reqs {m['num_preempted_reqs']}",
+            f"areal:prefix_cache_hits {m['prefix_cache_hits']}",
+            f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
+            f"areal:prefix_cached_tokens {m['prefix_cached_tokens']}",
             f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
             f"areal:last_weight_load_s "
             f"{self._last_load_info['load_s'] if self._last_load_info else 0.0}",

@@ -1,0 +1,182 @@
+"""qid-keyed prefix KV reuse (the radix-cache role of the reference's
+serving backend): a resubmission whose prompt extends a parked sequence
+prefills only the delta. Partial rollouts resubmit prompt+generated with
+one qid per sample (system/partial_rollout.py:88), so this removes the
+whole-prefix recompute from every chunk boundary."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.engine.serving import GenRequest, ServingEngine
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import init_params
+
+
+def small_cfg():
+    return TransformerConfig(
+        n_layers=2,
+        hidden_dim=64,
+        n_q_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        intermediate_dim=128,
+        vocab_size=256,
+        max_position_embeddings=512,
+        compute_dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = small_cfg()
+    return cfg, init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(cfg, params, prefix_cache_tokens, **kw):
+    eng = ServingEngine(
+        cfg,
+        params,
+        max_batch_size=4,
+        max_seq_len=256,
+        decode_block_steps=4,
+        prompt_bucket=16,
+        eos_token_id=None,
+        page_size=16,
+        prefix_cache_tokens=prefix_cache_tokens,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+def _gen(eng, qid, ids, max_new):
+    done = threading.Event()
+    holder = {}
+
+    def cb(res):
+        holder["res"] = res
+        done.set()
+
+    eng.submit(
+        GenRequest(
+            qid=qid,
+            input_ids=list(ids),
+            max_new_tokens=max_new,
+            greedy=True,
+            done_cb=cb,
+        )
+    )
+    assert done.wait(300)
+    return holder["res"]
+
+
+def test_resubmission_reuses_prefix_and_matches_uncached(model):
+    """Chunked generation through resubmission (the partial-rollout
+    pattern) hits the cache and produces exactly the tokens an
+    uninterrupted run would."""
+    cfg, params = model
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, cfg.vocab_size, size=40).tolist()
+
+    ref_eng = _engine(cfg, params, prefix_cache_tokens=None)
+    try:
+        full = _gen(ref_eng, "ref", prompt, max_new=16).output_ids
+    finally:
+        ref_eng.stop()
+
+    eng = _engine(cfg, params, prefix_cache_tokens=4096)
+    try:
+        out1 = _gen(eng, "s/0", prompt, max_new=8).output_ids
+        assert eng.prefix_cache_hits == 0
+        out2 = _gen(eng, "s/0", prompt + out1, max_new=8).output_ids
+        assert eng.prefix_cache_hits == 1
+        # Reused at least the pages-aligned part of prompt + out1.
+        assert eng.prefix_tokens_reused >= len(prompt)
+        assert out1 + out2 == full
+    finally:
+        eng.stop()
+
+
+def test_cache_disabled_frees_pages(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_tokens=None)
+    try:
+        free0 = eng._allocator.n_free
+        _gen(eng, "a", list(range(30)), max_new=4)
+        assert eng._allocator.n_free == free0  # everything returned
+        assert eng._cached_tokens == 0
+    finally:
+        eng.stop()
+
+
+def test_budget_eviction_lru(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_tokens=64)
+    try:
+        free0 = eng._allocator.n_free + 0
+        _gen(eng, "a", list(range(40)), max_new=4)  # ~44 tokens cached
+        assert "a" in eng._prefix_cache
+        _gen(eng, "b", list(range(40, 80)), max_new=4)
+        # 2 x ~44 > 64: the older entry was evicted.
+        assert "a" not in eng._prefix_cache and "b" in eng._prefix_cache
+        eng._flush_prefix_cache()
+        assert eng._cached_tokens == 0
+        assert eng._allocator.n_free == free0  # no page leaked
+    finally:
+        eng.stop()
+
+
+def test_weight_update_flushes_cache(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_tokens=4096)
+    try:
+        prompt = list(range(30))
+        out1 = _gen(eng, "w", prompt, max_new=4).output_ids
+        assert eng._cached_tokens > 0
+        eng.update_params(
+            jax.tree_util.tree_map(np.asarray, params), allow_interrupt=True
+        )
+        _gen(eng, "warm", [1, 2, 3], max_new=2)  # lets the swap land
+        assert eng._cached_tokens == 0  # old-weight KV flushed
+        out2 = _gen(eng, "w", prompt + out1, max_new=4).output_ids
+        assert eng.prefix_cache_hits == 0  # no stale reuse
+        assert len(out2) == 4
+    finally:
+        eng.stop()
+
+
+def test_pool_pressure_evicts_cache_before_preempting(model):
+    """Speculative cache pages yield to real admissions: a request that
+    needs more pages than are free succeeds by evicting the cache."""
+    cfg, params = model
+    # Pool of 12 usable pages (16 tokens each).
+    eng = _engine(
+        cfg, params, prefix_cache_tokens=100000, kv_pool_tokens=12 * 16
+    )
+    try:
+        _gen(eng, "old", list(range(80)), max_new=8)  # caches ~6 pages
+        assert eng._cached_tokens > 0
+        res = _gen(eng, "new", list(range(100, 200)), max_new=8)
+        assert len(res.output_ids) == 8
+        assert eng.n_preempted == 0  # served by eviction, not preemption
+    finally:
+        eng.stop()
+
+
+def test_first_token_finish_still_parks_prompt(model):
+    """A request finishing at admission (budget 1) must still park its
+    freshly prefilled prompt KV for a same-qid extension."""
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_tokens=4096)
+    try:
+        prompt = list(range(40))
+        out1 = _gen(eng, "f/0", prompt, max_new=1).output_ids
+        assert len(out1) == 1 and eng._cached_tokens >= len(prompt)
+        out2 = _gen(eng, "f/0", prompt + out1, max_new=4).output_ids
+        assert eng.prefix_cache_hits == 1
+        assert len(out2) == 4
+    finally:
+        eng.stop()
