@@ -6,7 +6,10 @@ of the reference's permute/unpermute token dispatcher + grouped GEMM, the
 classic GShard/Switch einsum formulation — dispatch/combine tensors of
 shape [T, E, C] contracted against stacked expert weights [E, D, F] — so
 the whole layer is three large einsums that XLA tiles onto the MXU, and
-an `expert` mesh axis can shard E without any custom collectives.
+expert parallelism falls out of sharding E over the `fsdp` mesh axis
+(parallel/sharding.py: dispatch contracts token-sharded activations
+against expert-sharded weights, so GSPMD inserts the token all-to-all —
+the reference has no EP at all).
 
 Load-balance aux loss and router z-loss follow the Switch/ST-MoE
 formulas (reference router.py aux_loss/z_loss). Tokens beyond an

@@ -54,13 +54,38 @@ def param_partition_spec(path: str, ndim: int) -> P:
     if path.startswith("head") or "/head/" in path or path == "head/weight":
         return P("fsdp", "tensor")  # [D, V] or [D, 1]
     if name in ("wq", "wk", "wv", "w_gate", "w_up", "w_in"):
+        if ndim == 4:
+            # MoE stacked experts [L, E, D, F]: expert parallelism —
+            # E shards over the ZeRO/fsdp axis (the einsum dispatch
+            # "tec,td->ecd" with tokens on (data,fsdp) and experts on
+            # fsdp makes XLA emit the token all-to-all; DeepSeek-style
+            # EP-over-DP without custom collectives), F stays
+            # column-parallel on tensor.
+            return P(None, "fsdp", None, "tensor")
         return P(None, "fsdp", "tensor")  # [L, D, out]: column parallel
     if name in ("wo", "w_down", "w_out"):
+        if ndim == 4:
+            return P(None, "fsdp", "tensor", None)  # [L, E, F, D]
         return P(None, "tensor", "fsdp")  # [L, in, D]: row parallel
     if name in ("bq", "bk", "bv", "b_gate", "b_up", "b_in"):
         return P(None, "tensor")  # [L, out]
-    # norms, small biases (b_down/b_out [L, D]), q_norm/k_norm: replicated.
+    # norms, small biases (b_down/b_out [L, D]), router [L, D, E],
+    # q_norm/k_norm: replicated.
     return P(*([None] * ndim))
+
+
+def _moe_fsdp_fallback(name: str, ndim: int) -> Optional[P]:
+    """When num_experts doesn't divide the fsdp axis, EP is impossible —
+    but the expert weights are the bulk of model memory, so ZeRO-3 must
+    not silently degrade to full replication: shard the hidden dim on
+    fsdp instead."""
+    if ndim != 4:
+        return None
+    if name in ("w_gate", "w_up"):
+        return P(None, None, "fsdp", "tensor")  # [L, E, D, F]
+    if name == "w_down":
+        return P(None, None, "tensor", "fsdp")  # [L, E, F, D]
+    return None
 
 
 def _axis_size(mesh: Mesh, entry) -> int:
@@ -87,8 +112,16 @@ def param_shardings(params: Params, mesh: Mesh) -> Params:
     """Pytree of NamedShardings matching `params`' structure."""
 
     def one(path, leaf):
-        spec = param_partition_spec(_path_str(path), leaf.ndim)
-        return NamedSharding(mesh, fit_spec_to_shape(spec, leaf.shape, mesh))
+        ps = _path_str(path)
+        spec = param_partition_spec(ps, leaf.ndim)
+        fitted = fit_spec_to_shape(spec, leaf.shape, mesh)
+        if len(spec) > 1 and spec[1] == "fsdp" and fitted[1] is None:
+            # Expert dim indivisible by fsdp: fall back to hidden-dim
+            # ZeRO sharding rather than replicating the expert weights.
+            alt = _moe_fsdp_fallback(ps.split("/")[-1], leaf.ndim)
+            if alt is not None:
+                fitted = fit_spec_to_shape(alt, leaf.shape, mesh)
+        return NamedSharding(mesh, fitted)
 
     return jax.tree_util.tree_map_with_path(one, params)
 

@@ -1,0 +1,134 @@
+"""Expert parallelism: MoE expert weights shard E over the fsdp mesh
+axis (parallel/sharding.py), the GShard-style einsum dispatch makes XLA
+insert the token all-to-all, and sharded results match single-device
+bit-for-near (the reference has no expert parallelism — this exceeds
+parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from areal_tpu.base.topology import MeshSpec
+from areal_tpu.models.config import MoEConfig, TransformerConfig
+from areal_tpu.models.transformer import forward, init_params
+from areal_tpu.parallel.mesh import make_mesh
+from areal_tpu.parallel.sharding import param_shardings, shard_params
+
+CFG = TransformerConfig(
+    n_layers=2,
+    hidden_dim=32,
+    n_q_heads=4,
+    n_kv_heads=2,
+    head_dim=8,
+    intermediate_dim=64,
+    vocab_size=64,
+    compute_dtype="float32",
+    param_dtype="float32",
+    moe=MoEConfig(
+        num_experts=8, top_k=2, expert_intermediate_dim=32,
+        capacity_factor=2.0,
+    ),
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_expert_weights_shard_over_fsdp(params):
+    mesh = make_mesh(MeshSpec.parse("d1f4t2"))
+    sh = param_shardings(params, mesh)
+    mlp = sh["layers"]["mlp"]
+    assert mlp["w_gate"].spec == P(None, "fsdp", None, "tensor")
+    assert mlp["w_up"].spec == P(None, "fsdp", None, "tensor")
+    assert mlp["w_down"].spec == P(None, "fsdp", "tensor", None)
+    assert mlp["router"].spec == P(None, None, None)
+    # 8 experts / fsdp=4 -> 2 experts per shard.
+    shard_shape = mlp["w_gate"].shard_shape(
+        params["layers"]["mlp"]["w_gate"].shape
+    )
+    assert shard_shape[1] == 2
+
+
+@pytest.mark.parametrize("spec_str", ["d1f4t2", "d2f2s1t2", "f8"])
+def test_moe_forward_matches_single_device(params, spec_str):
+    rng = np.random.RandomState(0)
+    R, T = 2, 32
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(R, T)))
+    seg = jnp.ones((R, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T), (R, T)).astype(jnp.int32)
+
+    ref = forward(params, CFG, ids, seg, pos, attn_impl="reference")
+
+    mesh = make_mesh(MeshSpec.parse(spec_str))
+    sharded = shard_params(params, mesh)
+
+    @jax.jit
+    def f(p, i, s, po):
+        return forward(p, CFG, i, s, po, attn_impl="reference")
+
+    with jax.sharding.use_mesh(mesh) if hasattr(jax.sharding, "use_mesh") else mesh:
+        out = f(sharded, ids, seg, pos)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_moe_ep_gradients_match(params):
+    """Grad parity: expert-sharded backward (all-to-all transposes) ==
+    single-device backward."""
+    rng = np.random.RandomState(1)
+    R, T = 2, 16
+    ids = jnp.asarray(rng.randint(0, CFG.vocab_size, size=(R, T)))
+    seg = jnp.ones((R, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T), (R, T)).astype(jnp.int32)
+
+    def loss(p):
+        lg = forward(p, CFG, ids, seg, pos, attn_impl="reference")
+        return jnp.mean(lg.astype(jnp.float32) ** 2)
+
+    g_ref = jax.grad(loss)(params)
+
+    mesh = make_mesh(MeshSpec.parse("d1f4t2"))
+    sharded = shard_params(params, mesh)
+    g_sh = jax.jit(jax.grad(loss))(sharded)
+
+    ref_leaf = g_ref["layers"]["mlp"]["w_gate"]
+    sh_leaf = g_sh["layers"]["mlp"]["w_gate"]
+    np.testing.assert_allclose(
+        np.asarray(sh_leaf), np.asarray(ref_leaf), rtol=2e-3, atol=2e-4
+    )
+
+
+def test_indivisible_experts_fall_back_to_zero_sharding():
+    """E=6 on fsdp=4 can't shard experts — the hidden dim takes the fsdp
+    axis instead, so ZeRO-3 never silently degrades to replication."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        CFG,
+        moe=dataclasses.replace(CFG.moe, num_experts=6),
+    )
+    p6 = init_params(cfg, jax.random.PRNGKey(3))
+    mesh = make_mesh(MeshSpec.parse("d1f4t2"))
+    sh = param_shardings(p6, mesh)
+    mlp = sh["layers"]["mlp"]
+    assert mlp["w_gate"].spec == P(None, None, "fsdp", "tensor")
+    assert mlp["w_down"].spec == P(None, None, "tensor", "fsdp")
+    # And the fallback numerics still match single-device.
+    rng = np.random.RandomState(2)
+    R, T = 2, 16
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, size=(R, T)))
+    seg = jnp.ones((R, T), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(T), (R, T)).astype(jnp.int32)
+    ref = forward(p6, cfg, ids, seg, pos, attn_impl="reference")
+    sharded = shard_params(p6, mesh)
+    out = jax.jit(
+        lambda p, i, s, po: forward(p, cfg, i, s, po, attn_impl="reference")
+    )(sharded, ids, seg, pos)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
