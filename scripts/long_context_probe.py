@@ -1,0 +1,258 @@
+"""Long-context on-chip probe (VERDICT r3 missing #4 + #6).
+
+Measures, on the real TPU chip, with the bench flagship shape
+(R1-Distill-Qwen-1.5B layers, bench.py):
+
+  A. packed train step at 16k and 32k tokens (remat=save_attn) -> TFLOP/s
+     (the reference's headline workload trains 27-32k packed tokens,
+     benchmark/verl_v0_3_0_post1_76084d3/README.md:38-44)
+  B. >=16k-token generation through the paged engine with chunked
+     prefill: prefill seconds + sustained decode tok/s, and the
+     prefix-cache resubmission delta (chunk boundary cost with/without
+     KV reuse)
+  C. decode sampling sort-skip A/B: block time with all-greedy requests
+     (sort skipped) vs top-k/top-p active (full-vocab sort) — replaces
+     the "expected ~15%" estimate in docs/perf_notes.md with a measured
+     number.
+
+Prints one JSON line per measurement to stdout; human detail on stderr.
+Timing forces a device fetch per step — block_until_ready does not wait
+on the tunneled device (docs/perf_notes.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import count_params, init_params
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def flagship_cfg(max_pos=40960):
+    return TransformerConfig(
+        n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
+        head_dim=128, intermediate_dim=8960, vocab_size=32768,
+        attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
+        max_position_embeddings=max_pos,
+    )
+
+
+def train_step_flops(cfg, n_params, seqlens):
+    total = 0.0
+    q_dim = cfg.n_q_heads * cfg.head_dim
+    for l in seqlens:
+        total += 6.0 * n_params * l
+        total += 6.0 * cfg.n_layers * q_dim * float(l) * l
+    return total
+
+
+def probe_train(seq_tokens: int):
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
+    from areal_tpu.engine.jax_engine import JaxTrainEngine
+    from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.ops.loss import sft_loss_from_logprobs
+
+    cfg = flagship_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = count_params(params)
+    eng = JaxTrainEngine(
+        cfg, params,
+        optimizer_config=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
+        total_train_steps=1000,
+        row_len_multiple=seq_tokens, max_row_len=seq_tokens,
+        remat="save_attn",
+    )
+    rng = np.random.RandomState(0)
+    batch = SequenceSample.from_default(
+        ids=["b0"],
+        seqlens=[seq_tokens],
+        data={
+            "packed_input_ids": rng.randint(0, cfg.vocab_size, size=seq_tokens),
+            "loss_mask": np.ones(seq_tokens, np.float32),
+        },
+    )
+
+    def packed_loss(lp, rows):
+        tot, _ = sft_loss_from_logprobs(lp, rows["loss_mask"])
+        return tot, {}
+
+    def weight(mb):
+        return float(np.sum(mb.data["loss_mask"]))
+
+    def one(i):
+        st = eng.train_batch(batch, MicroBatchSpec(n_mbs=1), packed_loss,
+                             weight, version_steps=i, loss_name="lc")
+        return st
+
+    for i in range(2):
+        t = time.perf_counter()
+        one(i)
+        log(f"train {seq_tokens}: warmup {i} {time.perf_counter()-t:.2f}s")
+    n = 3
+    t0 = time.perf_counter()
+    for i in range(n):
+        one(2 + i)
+    # engine stats fetch inside train_batch forces the sync
+    dt = (time.perf_counter() - t0) / n
+    tflops = train_step_flops(cfg, n_params, [seq_tokens]) / dt / 1e12
+    emit(metric=f"train_{seq_tokens//1024}k_tflops_per_chip",
+         value=round(tflops, 2), unit="TFLOP/s",
+         step_s=round(dt, 3))
+    log(f"train {seq_tokens}: {dt:.3f}s/step {tflops:.1f} TFLOP/s")
+    del eng
+    import gc
+
+    gc.collect()
+
+
+def probe_gen(plen=16384, max_new=512):
+    import threading
+
+    from areal_tpu.engine.serving import GenRequest, ServingEngine
+
+    cfg = flagship_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    eng = ServingEngine(
+        cfg, params,
+        max_batch_size=4,
+        max_seq_len=plen + 2 * max_new + 256,
+        decode_block_steps=32,
+        prompt_bucket=256,
+        eos_token_id=None,
+        page_size=128,
+        kv_pool_tokens=2 * (plen + 2 * max_new + 256),
+        prefill_chunk=2048,
+        prefix_cache_tokens=2 * (plen + max_new),
+    )
+    eng.start()
+    rng = np.random.RandomState(1)
+
+    def run_one(qid, ids, new):
+        done = threading.Event()
+        holder = {}
+
+        def cb(res):
+            holder["r"] = res
+            done.set()
+
+        t0 = time.perf_counter()
+        eng.submit(GenRequest(qid=qid, input_ids=list(ids),
+                              max_new_tokens=new, done_cb=cb))
+        assert done.wait(1800)
+        return holder["r"], time.perf_counter() - t0
+
+    prompt = rng.randint(0, cfg.vocab_size, size=plen).tolist()
+    # warmup compiles (chunk prefill + decode block)
+    run_one("w", prompt[:4096], 2 * 32)
+    r1, dt1 = run_one("lc/0", prompt, max_new)
+    tps = len(r1.output_ids) / dt1
+    emit(metric="gen_16k_tokens_per_sec", value=round(tps, 1),
+         unit="tok/s", total_s=round(dt1, 2), new_tokens=len(r1.output_ids))
+    log(f"gen 16k: {dt1:.2f}s for {len(r1.output_ids)} tokens -> {tps:.1f} tok/s")
+
+    # prefix-cache resubmission (partial-rollout chunk boundary): delta
+    # prefill only vs the cold full-prefix cost above.
+    r2, dt2 = run_one("lc/0", prompt + r1.output_ids, max_new)
+    emit(metric="gen_16k_resubmit_s", value=round(dt2, 2), unit="s",
+         cold_s=round(dt1, 2),
+         prefix_cache_hits=eng.prefix_cache_hits,
+         prefix_tokens_reused=eng.prefix_tokens_reused)
+    log(f"gen 16k resubmit: {dt2:.2f}s (cold {dt1:.2f}s), "
+        f"hits={eng.prefix_cache_hits} reused={eng.prefix_tokens_reused}")
+    eng.stop()
+
+
+def probe_sort_skip(B=32, plen=512, new=256):
+    """Decode block throughput: greedy-only (sampling sort skipped) vs
+    top-k/top-p active (full-vocab sort per step)."""
+    import threading
+
+    from areal_tpu.engine.serving import GenRequest, ServingEngine
+
+    cfg = flagship_cfg(max_pos=4096)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    rng = np.random.RandomState(2)
+
+    def run(label, **sample_kw):
+        eng = ServingEngine(
+            cfg, params,
+            max_batch_size=B,
+            max_seq_len=plen + new + 128,
+            decode_block_steps=32,
+            prompt_bucket=128,
+            eos_token_id=None,
+            page_size=128,
+            kv_pool_tokens=B * (plen + new + 128),
+        )
+        eng.start()
+        done = threading.Event()
+        got = []
+
+        def cb(res):
+            got.append(len(res.output_ids))
+            if len(got) == B:
+                done.set()
+
+        # warmup
+        wd = threading.Event()
+        eng.submit(GenRequest(qid="w", input_ids=rng.randint(
+            0, cfg.vocab_size, size=plen).tolist(), max_new_tokens=64,
+            done_cb=lambda r: wd.set(), **sample_kw))
+        assert wd.wait(1800)
+        t0 = time.perf_counter()
+        for i in range(B):
+            eng.submit(GenRequest(
+                qid=f"{label}{i}",
+                input_ids=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
+                max_new_tokens=new, done_cb=cb, **sample_kw))
+        assert done.wait(1800)
+        dt = time.perf_counter() - t0
+        eng.stop()
+        return sum(got) / dt
+
+    tps_greedy = run("g", greedy=True)
+    tps_sorted = run("s", top_k=50, top_p=0.95, temperature=1.0)
+    emit(metric="decode_sort_skip_ab",
+         greedy_tok_s=round(tps_greedy, 1),
+         topk_topp_tok_s=round(tps_sorted, 1),
+         speedup=round(tps_greedy / tps_sorted, 3))
+    log(f"sort-skip A/B: greedy {tps_greedy:.0f} tok/s vs "
+        f"top-k/p {tps_sorted:.0f} tok/s "
+        f"({tps_greedy / tps_sorted:.2f}x)")
+
+
+def main():
+    platform = jax.devices()[0].platform
+    log(f"platform={platform}")
+    if platform != "tpu":
+        log("WARNING: not on TPU; numbers are not meaningful")
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "train16k"):
+        probe_train(16384)
+    if which in ("all", "train32k"):
+        probe_train(32768)
+    if which in ("all", "gen"):
+        probe_gen()
+    if which in ("all", "sortskip"):
+        probe_sort_skip()
+
+
+if __name__ == "__main__":
+    main()
