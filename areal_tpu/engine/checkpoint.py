@@ -3,16 +3,29 @@
 Counterpart of the reference's backend save/load
 (realhf/impl/model/backend/megatron.py:711-760: optimizer + param state
 for fault recovery; persistent HF-format saves are a separate path via
-the interfaces). State = params pytree + optax opt state + step counter,
-written with numpy-on-host pickle. Single-host per-worker files; each
-model worker saves only its own shard's state.
+the interfaces). State = params pytree + optax opt state + step counter.
+
+Two storage backends, selected by AREAL_CKPT_BACKEND (or the `backend`
+argument):
+
+- "pickle" (default): numpy-on-host single file per worker. Simple and
+  exactly round-trippable, but np.asarray on a GSPMD-sharded array
+  gathers the FULL global value to this host — fine single-host, wrong
+  at pod scale.
+- "orbax": orbax.checkpoint StandardCheckpointer — each host writes only
+  its own shards (OCDBT), and restore places shards directly onto the
+  engine's NamedShardings without a host gather. The TPU-native path
+  for multi-host models.
+
+Loading auto-detects which backend wrote a directory, so the flag only
+matters for new saves.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -22,14 +35,14 @@ from areal_tpu.base import logging
 logger = logging.getLogger("checkpoint")
 
 _STATE_FILE = "engine_state.pkl"
+_ORBAX_DIR = "engine_state_orbax"
 
 
 def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
 
 
-def save_engine_state(engine, save_dir: str):
-    os.makedirs(save_dir, exist_ok=True)
+def _engine_state(engine):
     # Accessors, not attributes: an offloaded engine keeps params on host
     # (engine.params is None) and get_params/get_opt_state return the
     # host copies without re-occupying HBM.
@@ -39,6 +52,41 @@ def save_engine_state(engine, save_dir: str):
         if hasattr(engine, "get_opt_state")
         else engine.opt_state
     )
+    return params, opt
+
+
+def _ckpt_backend(backend: Optional[str]) -> str:
+    return backend or os.environ.get("AREAL_CKPT_BACKEND", "pickle")
+
+
+def save_engine_state(engine, save_dir: str, backend: Optional[str] = None):
+    os.makedirs(save_dir, exist_ok=True)
+    params, opt = _engine_state(engine)
+    if _ckpt_backend(backend) == "orbax":
+        import orbax.checkpoint as ocp
+
+        # Version rides inside the checkpoint so it commits atomically
+        # with the weights (a side file could be torn by a preemption,
+        # silently resetting step counters / LR schedule on recovery).
+        state = {
+            "params": params,
+            "opt_state": opt,
+            "version": np.asarray(engine.version, dtype=np.int64),
+        }
+        path = os.path.join(os.path.abspath(save_dir), _ORBAX_DIR)
+        with ocp.StandardCheckpointer() as ck:
+            # Orbax refuses to overwrite; recover checkpoints are
+            # overwritable by contract (reference recover ckpts likewise
+            # replace the previous one).
+            ck.save(path, state, force=True)
+        # Each save leaves exactly ONE backend's artifact behind —
+        # loading prefers orbax, so a stale dir next to a newer pkl
+        # would silently shadow it.
+        stale = os.path.join(save_dir, _STATE_FILE)
+        if os.path.exists(stale):
+            os.remove(stale)
+        logger.info(f"saved engine state (orbax) to {save_dir}")
+        return
     state = {
         "params": _to_host(params),
         "opt_state": _to_host(opt) if opt is not None else None,
@@ -48,13 +96,81 @@ def save_engine_state(engine, save_dir: str):
     with open(tmp, "wb") as f:
         pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, os.path.join(save_dir, _STATE_FILE))
+    stale_dir = os.path.join(save_dir, _ORBAX_DIR)
+    if os.path.isdir(stale_dir):
+        import shutil
+
+        shutil.rmtree(stale_dir, ignore_errors=True)
     logger.info(f"saved engine state to {save_dir}")
 
 
+def _load_orbax(engine, path: str) -> dict:
+    """Restore directly onto the engine's shardings (no host gather):
+    the abstract target carries each leaf's shape/dtype/sharding.
+
+    Multi-host caveat: orbax save/restore of GSPMD-sharded arrays is a
+    COLLECTIVE — every process of the jax.distributed world must call
+    with the same directory. An offloaded engine (host numpy copies, no
+    shardings to target) can only restore single-process."""
+    import orbax.checkpoint as ocp
+
+    params, opt = _engine_state(engine)
+    shardingless = False
+
+    def absify(x):
+        nonlocal shardingless
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        shardingless = True
+        return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+
+    with ocp.StandardCheckpointer() as ck:
+        # Target follows what the CHECKPOINT contains, not what this
+        # engine has: a params-only checkpoint (gradient-free engine)
+        # must load into a training engine and vice versa (the pickle
+        # path supports both by construction).
+        meta = ck.metadata(path)
+        meta_tree = getattr(meta, "item_metadata", None) or meta
+        has_opt = False
+        try:
+            has_opt = (
+                meta_tree["opt_state"] is not None
+                and len(jax.tree_util.tree_leaves(meta_tree["opt_state"])) > 0
+            )
+        except (KeyError, TypeError):
+            pass
+        target = {
+            "params": jax.tree_util.tree_map(absify, params),
+            "opt_state": (
+                jax.tree_util.tree_map(absify, opt)
+                if (opt is not None and has_opt)
+                else None
+            ),
+            "version": np.zeros((), dtype=np.int64),
+        }
+        if shardingless and jax.process_count() > 1:
+            raise NotImplementedError(
+                "orbax restore into an offloaded engine (host copies, no "
+                "shardings) is single-process only; restore to device "
+                "first or use the pickle backend"
+            )
+        state = ck.restore(path, target)
+    return {
+        "params": state["params"],
+        "opt_state": state.get("opt_state"),
+        "version": int(state.get("version", 0)),
+    }
+
+
 def load_engine_state(engine, load_dir: str):
-    path = os.path.join(load_dir, _STATE_FILE)
-    with open(path, "rb") as f:
-        state = pickle.load(f)
+    orbax_path = os.path.join(os.path.abspath(load_dir), _ORBAX_DIR)
+    if os.path.isdir(orbax_path):
+        state = _load_orbax(engine, orbax_path)
+    else:
+        path = os.path.join(load_dir, _STATE_FILE)
+        with open(path, "rb") as f:
+            state = pickle.load(f)
     if hasattr(engine, "drop_offloaded_state") and state["opt_state"] is not None:
         # About to overwrite both params and optimizer state: discard any
         # offloaded host copies instead of restoring them to HBM first.
@@ -88,4 +204,6 @@ def load_engine_state(engine, load_dir: str):
 
 
 def has_engine_state(load_dir: str) -> bool:
-    return os.path.exists(os.path.join(load_dir, _STATE_FILE))
+    return os.path.exists(os.path.join(load_dir, _STATE_FILE)) or os.path.isdir(
+        os.path.join(load_dir, _ORBAX_DIR)
+    )

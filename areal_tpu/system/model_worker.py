@@ -479,7 +479,12 @@ class ModelWorker(Worker):
 
             import jax
 
-            save_engine_state(model.module, d)
+            # The realloc dump is a TRANSFER format, not a recover
+            # checkpoint: the destination reads engine_state.pkl
+            # directly (below) and this is a rank-0-only call — an
+            # orbax (collective, shard-wise) save here would deadlock
+            # multi-host and break the reader. Always pickle.
+            save_engine_state(model.module, d, backend="pickle")
             # Raw mmap-able dumps for the generation servers: tmpfs
             # same-host fast path + disk fallback (weight_transfer.py).
             params = jax.tree_util.tree_map(
