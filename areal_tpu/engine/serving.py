@@ -269,6 +269,7 @@ class ServingEngine:
         self.total_generated = 0
         self.n_preempted = 0
         self.last_weight_swap_s = 0.0
+        self.last_weight_stage_s = 0.0
 
     # ------------------------------------------------------------------
     # Public API
@@ -294,9 +295,36 @@ class ServingEngine:
         protocol); without it, admission pauses and the swap happens once
         running requests drain. `version` pins the new weight version to
         the trainer's published one (self-incrementing would drift when
-        the trainer publishes faster than the manager flushes)."""
+        the trainer publishes faster than the manager flushes).
+
+        The host->device transfer is staged HERE, on the caller's
+        thread, so decoding continues while the weights stream in; the
+        serve loop's swap is then just a pointer flip + sync. Peak HBM
+        holds two weight copies during staging (live + staged) — same
+        as the old swap-time peak, just for longer. Staging seconds
+        (dispatch + transfer completion) land in last_weight_stage_s."""
         with self._lock:
-            self._pending_params = params
+            # A faster publisher must not stack staged copies: drop any
+            # not-yet-applied pending weights BEFORE staging, or HBM
+            # would briefly hold three copies (live + old staged + new).
+            self._pending_params = None
+            self._pending_version = None
+        t0 = time.monotonic()
+        if self.mesh is not None:
+            from areal_tpu.parallel.sharding import shard_params
+
+            staged = shard_params(params, self.mesh)
+        else:
+            staged = jax.tree_util.tree_map(jnp.asarray, params)
+        # Bound transfer completion (safe here: we're off the serve
+        # loop): block_until_ready doesn't wait on tunneled devices, so
+        # fetch one element of the last-dispatched leaf instead.
+        jax.block_until_ready(staged)
+        last_leaf = jax.tree_util.tree_leaves(staged)[-1]
+        jax.device_get(last_leaf.ravel()[:1])
+        self.last_weight_stage_s = time.monotonic() - t0
+        with self._lock:
+            self._pending_params = staged
             self._pending_version = version
         if allow_interrupt:
             self._interrupt.set()
@@ -311,6 +339,7 @@ class ServingEngine:
             "kv_pages_total": float(self.n_pages - 1),
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
+            "last_weight_stage_s": float(self.last_weight_stage_s),
             "prefix_cache_hits": float(self.prefix_cache_hits),
             "prefix_tokens_reused": float(self.prefix_tokens_reused),
             "prefix_cached_tokens": float(self._cached_tokens),
@@ -757,12 +786,9 @@ class ServingEngine:
             # attention state. Flush before the new version goes live.
             self._flush_prefix_cache()
             t0 = time.monotonic()
-            if self.mesh is not None:
-                from areal_tpu.parallel.sharding import shard_params
-
-                self.params = shard_params(pending, self.mesh)
-            else:
-                self.params = jax.tree_util.tree_map(jnp.asarray, pending)
+            # Transfers were staged on the updater's thread
+            # (update_params); this is a pointer flip + completion sync.
+            self.params = pending
             jax.block_until_ready(self.params)
             # block_until_ready does NOT wait on tunneled devices (see
             # docs/perf_notes.md); fetch one element of the last leaf —

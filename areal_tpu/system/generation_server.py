@@ -176,10 +176,16 @@ class GenerationServer(Worker):
         self._last_load_info = info
         n_running = self.engine.n_running
         version = d.get("version")
-        self.engine.update_params(
-            params,
-            allow_interrupt=allow_interrupt,
-            version=None if version is None else int(version),
+        # update_params stages the full host->device transfer on the
+        # calling thread — keep it off the event loop like the load, or
+        # every in-flight HTTP response stalls behind it.
+        await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: self.engine.update_params(
+                params,
+                allow_interrupt=allow_interrupt,
+                version=None if version is None else int(version),
+            ),
         )
         logger.info(
             f"weight update: source={info['source']} "
@@ -226,6 +232,7 @@ class GenerationServer(Worker):
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
             f"areal:prefix_cached_tokens {m['prefix_cached_tokens']}",
             f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
+            f"areal:last_weight_stage_s {m['last_weight_stage_s']}",
             f"areal:last_weight_load_s "
             f"{self._last_load_info['load_s'] if self._last_load_info else 0.0}",
             f"areal:weight_load_fast_path "
