@@ -31,6 +31,14 @@ class ModelTrainEvalConfig:
     dtype: str = "bfloat16"
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     backend: str = "jax_train"  # jax_train | jax_inference | mock_train
+    attn_impl: str = dataclasses.field(
+        default="auto",
+        metadata={
+            "help": "attention impl: auto | splash | flash | reference | "
+            "ring | ulysses (ring/ulysses = context parallelism over the "
+            "seq mesh axis)"
+        },
+    )
     remat: bool = True
     mesh_spec: Optional[str] = None  # worker-local mesh, e.g. "d1f4t2"
     row_len_multiple: int = 128

@@ -49,6 +49,7 @@ def backend_abstraction(m: ModelTrainEvalConfig, train: bool = True) -> ModelBac
     name = "jax_train" if train else "jax_inference"
     args = dict(
         remat=m.remat,
+        attn_impl=m.attn_impl,
         row_len_multiple=m.row_len_multiple,
         max_row_len=m.max_row_len,
     )

@@ -192,7 +192,7 @@ def _attention_block(
 
     impl = resolve_attn_impl(attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads)
     sharded = mesh is not None and mesh.size > 1
-    if sharded and impl not in ("reference", "ring"):
+    if sharded and impl not in ("reference", "ring", "ulysses"):
         # Never run a bare pallas_call inside a sharded jit — GSPMD
         # cannot partition it (it replicates or fails). Only splash has a
         # shard_map wrapping; anything else falls back to the einsum
@@ -213,6 +213,26 @@ def _attention_block(
                 f"Hkv={cfg.n_kv_heads}, mesh={dict(mesh.shape) if mesh else None})"
             )
         out = ring_packed_attention(q, k, v, segment_ids, positions, mesh)
+    elif impl == "ulysses":
+        # Context parallelism via all-to-alls (seq shard swaps onto
+        # heads; 4 a2a + 2 small gathers per layer vs ring's S ppermute
+        # steps) with a splash local kernel on TPU; pick ring vs ulysses
+        # by measurement per context length (ops/ulysses_attention.py).
+        from areal_tpu.ops.ulysses_attention import (
+            ulysses_ok,
+            ulysses_packed_attention,
+        )
+
+        if not (
+            sharded and ulysses_ok(mesh, R, T, cfg.n_q_heads, cfg.n_kv_heads)
+        ):
+            raise ValueError(
+                "attn_impl='ulysses' needs a mesh with seq > 1 and head "
+                f"counts divisible by seq*tensor (R={R}, T={T}, "
+                f"Hq={cfg.n_q_heads}, Hkv={cfg.n_kv_heads}, "
+                f"mesh={dict(mesh.shape) if mesh else None})"
+            )
+        out = ulysses_packed_attention(q, k, v, segment_ids, positions, mesh)
     elif sharded and impl == "splash":
         # pallas_call is opaque to GSPMD: run the kernel per shard under
         # shard_map with the megatron-equivalent layout.

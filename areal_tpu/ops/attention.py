@@ -242,11 +242,17 @@ def sharded_splash_attention(
     )(q, k, v, segment_ids, positions)
 
 
-def sharded_splash_ok(mesh, r: int, t: int, hq: int, hkv: int) -> bool:
-    """Shapes/mesh divisibility for sharded_splash_attention."""
+def cp_axes(mesh) -> tuple:
+    """(rows, seq, tensor) sizes of the canonical activation mesh axes —
+    the shared prologue of every sharded-attention shape checker."""
     names = mesh.shape
     rows = names.get("data", 1) * names.get("fsdp", 1)
-    tensor = names.get("tensor", 1)
+    return rows, names.get("seq", 1), names.get("tensor", 1)
+
+
+def sharded_splash_ok(mesh, r: int, t: int, hq: int, hkv: int) -> bool:
+    """Shapes/mesh divisibility for sharded_splash_attention."""
+    rows, _, tensor = cp_axes(mesh)
     return (
         t >= 128
         and t % 128 == 0

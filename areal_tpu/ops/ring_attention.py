@@ -126,15 +126,14 @@ def ring_packed_attention(
 
 def ring_ok(mesh, r: int, t: int, hq: int, hkv: int) -> bool:
     """Shape/mesh divisibility for ring_packed_attention."""
-    names = mesh.shape
-    rows = names.get("data", 1) * names.get("fsdp", 1)
-    seq = names.get("seq", 1)
-    tensor = names.get("tensor", 1)
+    from areal_tpu.ops.attention import cp_axes
+
+    rows, seq, tensor = cp_axes(mesh)
     return (
         seq > 1
         and r % rows == 0
         and t % seq == 0
         and hq % tensor == 0
         and hkv % tensor == 0
-        and (hq // tensor) % max(hkv // tensor, 1) == 0
+        and (hq // tensor) % (hkv // tensor) == 0
     )
