@@ -37,6 +37,9 @@ from areal_tpu.ops.rotary import apply_rotary, rotary_cos_sin, rotary_inv_freq
 from areal_tpu.ops.sampling import NEG_INF
 
 TRASH_PAGE = 0  # reserved sink page, never allocated
+# top-k requests at or below this threshold sample through lax.top_k
+# instead of a full-vocab sort (warp_sample tier 1).
+TOPK_FAST_MAX = 128
 
 
 def pages_needed(n_tokens: int, page_size: int) -> int:
@@ -395,10 +398,13 @@ def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
     every mix of per-request params. Returns (tokens [B], logprobs [B] of
     the unwarped distribution, PPO convention — ops/sampling.sample_token).
 
-    When no row actually uses top-k/top-p, the [B, V] descending sort —
-    the single most expensive sampling op at real vocab sizes — is
-    skipped via lax.cond (the common RL rollout config is
-    temperature-only sampling).
+    Three tiers, picked at runtime by the active rows' settings:
+    temperature-only skips warping entirely; top-k-only (all active k <=
+    TOPK_FAST_MAX, no top-p) thresholds via `lax.top_k` — far cheaper
+    than sorting 32k+ vocab; any top-p (or huge k) pays the full [B, V]
+    descending sort (one sort serves both warps). The tiers produce
+    identical warped logits for the rows they share, so the sampled
+    token for a given rng is tier-invariant.
     """
     logits = logits.astype(jnp.float32)
     em = eos_mask if eos_mask.ndim == 2 else eos_mask[None, :]
@@ -421,14 +427,35 @@ def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
         p_cut = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
         return jnp.where(warped < jnp.maximum(kth, p_cut), NEG_INF, warped)
 
+    kmax = min(TOPK_FAST_MAX, logits.shape[-1])
+
+    def with_topk_only(warped):
+        # k-th largest via lax.top_k: same threshold the sort path
+        # gathers at sorted[k-1], without ordering the other V-k logits.
+        vals = jax.lax.top_k(warped, kmax)[0]  # [B, kmax] desc
+        k_eff = jnp.clip(top_ks, 1, kmax)
+        kth = jnp.take_along_axis(vals, (k_eff - 1)[:, None], axis=-1)
+        kth = jnp.where((top_ks > 0)[:, None], kth, NEG_INF)
+        return jnp.where(warped < kth, NEG_INF, warped)
+
     # Only ACTIVE rows count: finished slots keep their stale top-k/top-p
     # until the next admission overwrites them, and must not re-enable
     # the sort for temperature-only batches.
-    row_warp = (top_ks > 0) | (top_ps < 1.0 - 1e-6)
+    row_topk = top_ks > 0
+    row_topp = top_ps < 1.0 - 1e-6
     if active_rows is not None:
-        row_warp = row_warp & active_rows
-    any_warp = jnp.any(row_warp)
-    warped = jax.lax.cond(any_warp, with_cutoffs, lambda w: w, warped)
+        row_topk = row_topk & active_rows
+        row_topp = row_topp & active_rows
+    any_warp = jnp.any(row_topk | row_topp)
+    need_sort = jnp.any(row_topp) | jnp.any(
+        jnp.where(row_topk, top_ks, 0) > kmax
+    )
+    warped = jax.lax.cond(
+        any_warp,
+        lambda w: jax.lax.cond(need_sort, with_cutoffs, with_topk_only, w),
+        lambda w: w,
+        warped,
+    )
     sampled = jax.random.categorical(rng, warped, axis=-1)
     argmax = jnp.argmax(logits, axis=-1)
     tokens = jnp.where(greedy_mask, argmax, sampled).astype(jnp.int32)
