@@ -72,6 +72,8 @@ class GserverManager(Worker):
         self._lock = threading.Lock()
         self._last_metrics_poll = 0.0
         self._server_gen_totals = {u: 0.0 for u in self.server_urls}
+        self._server_prefix_hits = {u: 0.0 for u in self.server_urls}
+        self._server_prefix_reused = {u: 0.0 for u in self.server_urls}
         self._last_gen_total = 0.0
         self._last_throughput_log = time.monotonic()
         self._throughput_log_interval = 10.0
@@ -293,6 +295,14 @@ class GserverManager(Worker):
                             self._server_reqs[u] = int(float(line.split()[-1]))
                         elif line.startswith("areal:total_generated_tokens"):
                             self._server_gen_totals[u] = float(line.split()[-1])
+                        elif line.startswith("areal:prefix_cache_hits"):
+                            self._server_prefix_hits[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:prefix_tokens_reused"):
+                            self._server_prefix_reused[u] = float(
+                                line.split()[-1]
+                            )
                 except Exception:
                     logger.warning(f"metrics poll failed for {u}")
 
@@ -341,7 +351,10 @@ class GserverManager(Worker):
             logger.info(
                 f"generation throughput: {tps:.0f} tokens/s "
                 f"(total {total_gen:.0f}) rollouts={rs} "
-                f"weight_version={self.weight_version}"
+                f"weight_version={self.weight_version} "
+                f"prefix_cache_hits={sum(self._server_prefix_hits.values()):.0f} "
+                f"prefix_tokens_reused="
+                f"{sum(self._server_prefix_reused.values()):.0f}"
             )
             self._last_gen_total = total_gen
             self._last_throughput_log = now
