@@ -230,6 +230,15 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "chunks through one compiled program (16-32k contexts)"
         },
     )
+    # Cap on chunked/cache-hit prefills admitted per serve-loop lap
+    # (they run sequentially and stall decode for running slots).
+    gen_chunked_prefill_per_lap: int = dataclasses.field(
+        default=2,
+        metadata={
+            "help": "max one-at-a-time chunked prefills admitted per "
+            "serve-loop lap; bounds decode-latency jitter"
+        },
+    )
     # Prefix KV reuse budget for partial-rollout resubmissions.
     gen_prefix_cache_tokens: Optional[int] = dataclasses.field(
         default=None,

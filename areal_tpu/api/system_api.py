@@ -137,6 +137,10 @@ class GenerationServerConfig:
     # fixed-shape program (None disables; essential for 16-32k prompts
     # where each new length bucket is a fresh multi-second compile).
     prefill_chunk: Optional[int] = None
+    # Chunked / cache-hit prefills run one prompt at a time on the serve
+    # loop; this caps how many are admitted per lap so decode latency
+    # jitter for running slots stays bounded.
+    chunked_prefill_per_lap: int = 2
     # qid-keyed prefix KV reuse budget in tokens (None disables): a
     # resubmission extending a parked sequence prefills only the delta —
     # the radix-cache role for partial-rollout chunking.

@@ -74,6 +74,19 @@ def save_engine_state(engine, save_dir: str, backend: Optional[str] = None):
             "version": np.asarray(engine.version, dtype=np.int64),
         }
         path = os.path.join(os.path.abspath(save_dir), _ORBAX_DIR)
+        # Orbax save is a collective for multi-host GSPMD arrays, but
+        # recover checkpoints go to per-worker directories (the model
+        # worker's _ckpt_dir embeds the dp rank) — each process saving
+        # a collective checkpoint to a DIFFERENT directory hangs or
+        # corrupts it. Mirror the _load_orbax guard on the save side.
+        for leaf in jax.tree_util.tree_leaves(state):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                raise NotImplementedError(
+                    "orbax save of non-fully-addressable (multi-host) "
+                    "arrays requires all processes to agree on one "
+                    "checkpoint directory; per-worker recover dirs do "
+                    "not. Use the pickle backend or a shared directory."
+                )
         with ocp.StandardCheckpointer() as ck:
             # Orbax refuses to overwrite; recover checkpoints are
             # overwritable by contract (reference recover ckpts likewise
@@ -155,6 +168,19 @@ def _load_orbax(engine, path: str) -> dict:
                 "shardings) is single-process only; restore to device "
                 "first or use the pickle backend"
             )
+        # Same guard as the save side: restoring non-fully-addressable
+        # (multi-host) arrays is a collective needing ONE shared
+        # directory, but recover checkpoints live in per-dp-rank dirs —
+        # a mismatched-directory collective hangs or corrupts state.
+        for leaf in jax.tree_util.tree_leaves(target):
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and not sh.is_fully_addressable:
+                raise NotImplementedError(
+                    "orbax restore of non-fully-addressable (multi-host) "
+                    "arrays requires all processes to agree on one "
+                    "checkpoint directory; per-worker recover dirs do "
+                    "not. Use the pickle backend or a shared directory."
+                )
         state = ck.restore(path, target)
     return {
         "params": state["params"],

@@ -167,6 +167,7 @@ class ServingEngine:
         attn_impl: str = "auto",
         prefill_max_batch: int = 8,
         prefill_chunk: Optional[int] = None,
+        chunked_prefill_per_lap: int = 2,
         prefix_cache_tokens: Optional[int] = None,
     ):
         self.cfg = cfg
@@ -198,6 +199,11 @@ class ServingEngine:
             f"got {prefill_chunk}"
         )
         self.prefill_chunk = prefill_chunk
+        assert chunked_prefill_per_lap >= 1, (
+            f"chunked_prefill_per_lap must be >= 1, got "
+            f"{chunked_prefill_per_lap}"
+        )
+        self.chunked_prefill_per_lap = chunked_prefill_per_lap
         # qid-keyed prefix KV reuse (the radix-cache role of the
         # reference's serving backend): finished/interrupted requests
         # park their pages here; a resubmission with the same qid whose
@@ -261,6 +267,19 @@ class ServingEngine:
         self._interrupt = threading.Event()
         self._pending_params = None
         self._pending_version: Optional[int] = None
+        # Serializes concurrent update_params callers (e.g. a manager
+        # retry racing the original request after a flush timeout): an
+        # older staging finishing last must not overwrite a newer one,
+        # and HBM must never hold three weight copies at once.
+        self._stage_lock = threading.Lock()
+        # Pinned-version history lives in its OWN namespace, never mixed
+        # with self.version: unversioned updates bump self.version too,
+        # and comparing a trainer-pinned version against that counter
+        # would silently blackhole a genuine update (e.g. unversioned
+        # apply bumps live to v10, then the trainer's real v10 arrives
+        # and would compare stale).
+        self._highest_pinned = -1   # highest pinned version staged (not cancelled)
+        self._applied_pinned = -1   # highest pinned version actually applied
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # metrics
@@ -288,6 +307,24 @@ class ServingEngine:
         req.submit_time = time.monotonic()
         self._queue.put(req)
 
+    def is_stale_update(self, version: Optional[int]) -> bool:
+        """True iff update_params(version=version) would drop the update
+        as stale. Lets callers skip the (potentially multi-GB) weight
+        load on a retry of a version that already landed."""
+        if version is None:
+            return False
+        with self._stage_lock:
+            return version <= self._highest_pinned
+
+    def escalate_pending_interrupt(self):
+        """Interrupt running requests iff a staged update is waiting to
+        apply — the allow_interrupt side of a retry whose reload was
+        skipped as stale (see is_stale_update). A bare interrupt with
+        nothing pending would kill running requests for nothing."""
+        with self._lock:
+            if self._pending_params is not None:
+                self._interrupt.set()
+
     def update_params(self, params, allow_interrupt: bool = True,
                       version: Optional[int] = None):
         """Swap weights at the next block boundary. With allow_interrupt,
@@ -302,30 +339,65 @@ class ServingEngine:
         serve loop's swap is then just a pointer flip + sync. Peak HBM
         holds two weight copies during staging (live + staged) — same
         as the old swap-time peak, just for longer. Staging seconds
-        (dispatch + transfer completion) land in last_weight_stage_s."""
-        with self._lock:
-            # A faster publisher must not stack staged copies: drop any
-            # not-yet-applied pending weights BEFORE staging, or HBM
-            # would briefly hold three copies (live + old staged + new).
-            self._pending_params = None
-            self._pending_version = None
-        t0 = time.monotonic()
-        if self.mesh is not None:
-            from areal_tpu.parallel.sharding import shard_params
+        (dispatch + transfer completion) land in last_weight_stage_s.
 
-            staged = shard_params(params, self.mesh)
-        else:
-            staged = jax.tree_util.tree_map(jnp.asarray, params)
-        # Bound transfer completion (safe here: we're off the serve
-        # loop): block_until_ready doesn't wait on tunneled devices, so
-        # fetch one element of the last-dispatched leaf instead.
-        jax.block_until_ready(staged)
-        last_leaf = jax.tree_util.tree_leaves(staged)[-1]
-        jax.device_get(last_leaf.ravel()[:1])
-        self.last_weight_stage_s = time.monotonic() - t0
-        with self._lock:
-            self._pending_params = staged
-            self._pending_version = version
+        Concurrent callers (manager retry after a flush timeout) are
+        serialized under _stage_lock, and a pinned update that is not
+        newer than the highest pinned version already staged (and not
+        since cancelled) is dropped — an older staging finishing last
+        must never overwrite newer weights with stale ones. Unversioned
+        updates are never dropped and never consume a pinned version."""
+        with self._stage_lock:
+            if version is not None and version <= self._highest_pinned:
+                logger.info(
+                    f"dropping stale weight update v{version} "
+                    f"(highest pinned v{self._highest_pinned}, "
+                    f"live v{self.version})"
+                )
+                # Still honor interrupt escalation: a retry of a version
+                # staged with allow_interrupt=False may be the manager
+                # asking to stop waiting for the drain. Only when an
+                # update is actually pending — a bare interrupt would
+                # kill running requests for nothing.
+                if allow_interrupt and self._pending_params is not None:
+                    self._interrupt.set()
+                return
+            with self._lock:
+                # A faster publisher must not stack staged copies: drop
+                # any not-yet-applied pending weights BEFORE staging, or
+                # HBM would briefly hold three copies (live + old staged
+                # + new). A cancelled pinned staging never went live, so
+                # its version must not block a later retry of the same
+                # version (roll back to the last APPLIED pinned version;
+                # _apply_pending_params removes pending under this same
+                # lock, so a concurrently-applying update is never
+                # rolled back here).
+                if (
+                    self._pending_params is not None
+                    and self._pending_version is not None
+                ):
+                    self._highest_pinned = self._applied_pinned
+                self._pending_params = None
+                self._pending_version = None
+            t0 = time.monotonic()
+            if self.mesh is not None:
+                from areal_tpu.parallel.sharding import shard_params
+
+                staged = shard_params(params, self.mesh)
+            else:
+                staged = jax.tree_util.tree_map(jnp.asarray, params)
+            # Bound transfer completion (safe here: we're off the serve
+            # loop): block_until_ready doesn't wait on tunneled devices,
+            # so fetch one element of the last-dispatched leaf instead.
+            jax.block_until_ready(staged)
+            last_leaf = jax.tree_util.tree_leaves(staged)[-1]
+            jax.device_get(last_leaf.ravel()[:1])
+            self.last_weight_stage_s = time.monotonic() - t0
+            with self._lock:
+                self._pending_params = staged
+                self._pending_version = version
+                if version is not None:
+                    self._highest_pinned = max(self._highest_pinned, version)
         if allow_interrupt:
             self._interrupt.set()
 
@@ -412,6 +484,23 @@ class ServingEngine:
             )
         return last
 
+    def _takes_chunked_path(
+        self, req: "GenRequest", plen: int,
+        cached_use: Optional[int] = None,
+    ) -> bool:
+        """Single source of truth for which prompts run the one-at-a-time
+        chunked prefill (vs the batched bucketed path): cache hits always
+        (only the delta past cached_use needs compute), fresh prompts when
+        longer than the configured chunk. With cached_use=None this is the
+        pre-validation PREDICTION used by the per-lap admission cap — any
+        parked cache entry counts, conservatively, since prefix validation
+        happens later; a mispredicted entry just defers to the next lap."""
+        if cached_use is None:
+            hit = req.qid in self._prefix_cache
+        else:
+            hit = cached_use > 0
+        return hit or bool(self.prefill_chunk and plen > self.prefill_chunk)
+
     def _admit(self):
         """Fill free slots from the backlog with ONE batched prefill and
         ONE fused device state update."""
@@ -422,9 +511,20 @@ class ServingEngine:
         self._drain_queue()
         free = self._free_slots()
         batch: List[Tuple[int, GenRequest, int, List[int], int]] = []
+        # Chunked / cache-hit prefills run one prompt at a time on the
+        # serve loop; admitting many long prompts in one lap would stall
+        # decode for every running slot for the full sequential prefill.
+        # Cap them per lap (the rest stay in the backlog for the next
+        # lap, after a decode block has run).
+        n_chunked = 0
         while free and self._backlog and len(batch) < self.prefill_max_batch:
             req = self._backlog[0]
             plen = len(req.input_ids)
+            if (
+                self._takes_chunked_path(req, plen)
+                and n_chunked >= self.chunked_prefill_per_lap
+            ):
+                break
             if plen + req.max_new_tokens > self.S:
                 req.max_new_tokens = max(0, self.S - plen)
             if plen >= self.S or req.max_new_tokens == 0:
@@ -488,19 +588,16 @@ class ServingEngine:
                     break  # pool pressure: wait for frees
             self._backlog.pop(0)
             batch.append((free.pop(0), req, plen, pages, cached_use))
+            if self._takes_chunked_path(req, plen, cached_use):
+                n_chunked += 1
         if not batch:
             return
         # Long prompts go through the fixed-shape chunked prefill (one
         # compiled program regardless of length); short ones keep the
         # batched bucketed path. Chunked entries first so logits rows
         # stay aligned with `batch` order.
-        chunk = self.prefill_chunk
-
         def _is_chunked(e):
-            # Cache hits ALWAYS take the chunked path (only the delta
-            # past cached_use needs compute); fresh prompts chunk when
-            # longer than the configured threshold.
-            return e[4] > 0 or (chunk and e[2] > chunk)
+            return self._takes_chunked_path(e[1], e[2], e[4])
 
         long = [e for e in batch if _is_chunked(e)]
         short = [e for e in batch if not _is_chunked(e)]
@@ -799,6 +896,8 @@ class ServingEngine:
             jax.device_get(last_leaf.ravel()[:1])
             self.last_weight_swap_s = time.monotonic() - t0
             self.version = version if version is not None else self.version + 1
+            if version is not None:
+                self._applied_pinned = max(self._applied_pinned, version)
             logger.info(
                 f"serving engine weights updated to v{self.version} "
                 f"in {self.last_weight_swap_s:.3f}s"

@@ -153,6 +153,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             prompt_bucket=cfg.gen_prompt_bucket,
             prefill_max_batch=cfg.gen_prefill_max_batch,
             prefill_chunk=cfg.gen_prefill_chunk,
+            chunked_prefill_per_lap=cfg.gen_chunked_prefill_per_lap,
             prefix_cache_tokens=cfg.gen_prefix_cache_tokens,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,

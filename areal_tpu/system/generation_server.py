@@ -71,6 +71,7 @@ class GenerationServer(Worker):
             prompt_bucket=config.prompt_bucket,
             prefill_max_batch=config.prefill_max_batch,
             prefill_chunk=config.prefill_chunk,
+            chunked_prefill_per_lap=config.chunked_prefill_per_lap,
             prefix_cache_tokens=config.prefix_cache_tokens,
             mesh=mesh,
         )
@@ -166,6 +167,21 @@ class GenerationServer(Worker):
         d = await request.json()
         model_path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
+        version = d.get("version")
+        if self.engine.is_stale_update(
+            None if version is None else int(version)
+        ):
+            # Retry of a version that already staged/landed (manager
+            # flush timeout): skip the multi-GB reload entirely, but
+            # still honor the interrupt escalation — the retry may be
+            # asking a drain-blocked staging to stop waiting.
+            if allow_interrupt:
+                self.engine.escalate_pending_interrupt()
+            logger.info(f"skipping stale weight update v{version}")
+            return web.json_response(
+                {"success": True, "stale": True,
+                 "num_paused_requests": self.engine.n_running}
+            )
         try:
             params, info = await asyncio.get_running_loop().run_in_executor(
                 None, self._load_params, model_path
@@ -175,7 +191,6 @@ class GenerationServer(Worker):
             return web.json_response({"success": False, "error": repr(e)}, status=500)
         self._last_load_info = info
         n_running = self.engine.n_running
-        version = d.get("version")
         # update_params stages the full host->device transfer on the
         # calling thread — keep it off the event loop like the load, or
         # every in-flight HTTP response stalls behind it.

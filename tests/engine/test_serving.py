@@ -137,3 +137,97 @@ def test_interrupt_and_weight_update(params):
         assert res2["after"].version_start == 1
     finally:
         eng.stop()
+
+
+def test_stale_pinned_version_dropped(params):
+    """A pinned update not newer than the highest pinned version already
+    staged is dropped; unversioned updates are never dropped and never
+    consume a pinned version (a genuine trainer version arriving after an
+    unversioned bump must still land)."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=128,
+        decode_block_steps=2, prompt_bucket=8, eos_token_id=None, seed=0,
+    )
+    eng.start()
+    try:
+        p2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+
+        def _settle(expect_version):
+            deadline = time.monotonic() + 15
+            while eng.version != expect_version and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert eng.version == expect_version, (
+                f"live v{eng.version}, expected v{expect_version}"
+            )
+
+        eng.update_params(p2, version=7)
+        _settle(7)
+
+        # Stale pinned retry: dropped outright, nothing staged.
+        eng.update_params(params, version=5)
+        assert eng._pending_params is None
+        _settle(7)
+
+        # Unversioned update bumps the live counter past a future pinned
+        # version...
+        eng.update_params(params)
+        _settle(8)
+        # ...but the trainer's genuine v8 must NOT be blackholed by it.
+        eng.update_params(p2, version=8)
+        deadline = time.monotonic() + 15
+        while eng._applied_pinned != 8 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng._applied_pinned == 8
+
+        # Equal-version retry after apply is stale.
+        eng.update_params(params, version=8)
+        assert eng._pending_params is None
+    finally:
+        eng.stop()
+
+
+def test_cancelled_pinned_staging_allows_retry(params):
+    """Clearing a staged-but-unapplied pinned update must roll its version
+    back out of the pinned history, so a retry of that same version is
+    accepted (the staging never went live)."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=128,
+        decode_block_steps=2, prompt_bucket=8, eos_token_id=None, seed=0,
+    )
+    # NOT started: pending updates are never applied, so stagings stack.
+    p2 = jax.tree_util.tree_map(lambda x: x * 1.01, params)
+    eng.update_params(p2, version=9, allow_interrupt=False)
+    assert eng._pending_version == 9
+    # An unversioned update cancels the staged v9 before it applied.
+    eng.update_params(params, allow_interrupt=False)
+    assert eng._pending_version is None
+    # The v9 retry must be accepted, not dropped against dead history.
+    eng.update_params(p2, version=9, allow_interrupt=False)
+    assert eng._pending_version == 9
+
+
+def test_chunked_prefill_per_lap_cap(params):
+    """More long prompts than the per-lap cap still all finish — the
+    excess defers to later admit laps instead of stalling decode for one
+    giant sequential prefill (and never strands in the backlog)."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=8, max_seq_len=256,
+        decode_block_steps=4, prompt_bucket=8, eos_token_id=None, seed=0,
+        prefill_chunk=16, chunked_prefill_per_lap=1,
+    )
+    eng.start()
+    try:
+        rng = np.random.RandomState(0)
+        reqs = [
+            GenRequest(
+                qid=f"long{i}",
+                input_ids=[int(t) for t in rng.randint(6, 60, 40)],
+                max_new_tokens=8,
+            )
+            for i in range(6)
+        ]
+        results = _run(eng, reqs, timeout=240)
+        assert len(results) == 6
+        assert all(len(r.output_ids) == 8 for r in results.values())
+    finally:
+        eng.stop()
