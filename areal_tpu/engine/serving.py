@@ -356,11 +356,13 @@ class ServingEngine:
                 )
                 # Still honor interrupt escalation: a retry of a version
                 # staged with allow_interrupt=False may be the manager
-                # asking to stop waiting for the drain. Only when an
-                # update is actually pending — a bare interrupt would
-                # kill running requests for nothing.
-                if allow_interrupt and self._pending_params is not None:
-                    self._interrupt.set()
+                # asking to stop waiting for the drain. The helper takes
+                # _lock so the pending check-and-set is atomic against
+                # _apply_pending_params' pop — a bare interrupt with
+                # nothing pending would kill running requests for
+                # nothing.
+                if allow_interrupt:
+                    self.escalate_pending_interrupt()
                 return
             with self._lock:
                 # A faster publisher must not stack staged copies: drop
@@ -877,6 +879,13 @@ class ServingEngine:
             version = self._pending_version
             self._pending_params = None
             self._pending_version = None
+            # Commit the pinned version HERE, atomically with the pop: a
+            # popped update always applies, and recording it only after
+            # the (multi-second) swap would let update_params' cancel
+            # -rollback read a not-yet-bumped _applied_pinned and regress
+            # _highest_pinned below a version that is about to go live.
+            if pending is not None and version is not None:
+                self._applied_pinned = max(self._applied_pinned, version)
         if pending is not None:
             # Cached prefixes hold KV computed under the OLD weights:
             # reusing them after the swap would decode against a stale
@@ -896,8 +905,6 @@ class ServingEngine:
             jax.device_get(last_leaf.ravel()[:1])
             self.last_weight_swap_s = time.monotonic() - t0
             self.version = version if version is not None else self.version + 1
-            if version is not None:
-                self._applied_pinned = max(self._applied_pinned, version)
             logger.info(
                 f"serving engine weights updated to v{self.version} "
                 f"in {self.last_weight_swap_s:.3f}s"

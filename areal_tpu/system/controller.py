@@ -199,7 +199,8 @@ class LocalController:
                 # interrupted workers must not override the user's stop.
                 self.check_worker_errors()
             self.join(timeout=30)
-        return {"global_step": master.step_info.global_step}
+        return {"global_step": master.step_info.global_step,
+                "perf_summary": dict(master.perf_summary)}
 
     def join(self, timeout: float = 30):
         deadline = time.monotonic() + timeout
@@ -394,7 +395,8 @@ class ClusterController:
                 # Always tear down: leaking scheduler jobs + the KV
                 # server would collide with a recovery relaunch.
                 self.stop()
-        return {"global_step": master.step_info.global_step}
+        return {"global_step": master.step_info.global_step,
+                "perf_summary": dict(master.perf_summary)}
 
     def stop(self):
         self._sched.stop_all()

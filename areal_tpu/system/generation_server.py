@@ -168,9 +168,16 @@ class GenerationServer(Worker):
         model_path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
         version = d.get("version")
-        if self.engine.is_stale_update(
-            None if version is None else int(version)
-        ):
+        # is_stale_update takes the engine's stage lock, which an
+        # in-flight update_params holds for the whole multi-second
+        # staging — run it in the executor like everything else that can
+        # block, or every in-flight HTTP response stalls behind it.
+        stale = await asyncio.get_running_loop().run_in_executor(
+            None,
+            self.engine.is_stale_update,
+            None if version is None else int(version),
+        )
+        if stale:
             # Retry of a version that already staged/landed (manager
             # flush timeout): skip the multi-GB reload entirely, but
             # still honor the interrupt escalation — the retry may be

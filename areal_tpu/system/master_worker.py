@@ -78,6 +78,17 @@ class MasterWorker(Worker):
         self._derive_epoch_boundary = bool(config.dataset_size)
         self._total_steps_cap = ctl.benchmark_steps
         self._start_time = time.monotonic()
+        # Cumulative throughput accounting for the async-vs-sync speedup
+        # benchmark (reference benchmark/.../README.md:26-36: effective
+        # trained tokens / end-to-end seconds). Filled by _log_step_perf;
+        # returned through the controller's run() result.
+        self.perf_summary = {
+            "steps": 0, "total_e2e_s": 0.0, "train_tokens": 0.0,
+            "wall_s": 0.0,
+            # Per-step [e2e_s, train_tokens] so benchmark consumers can
+            # drop compile-dominated warmup steps from the rate.
+            "history": [],
+        }
         self._init_metric_trackers()
 
         # Wait for every model worker to finish its lazy setup.
@@ -250,6 +261,17 @@ class MasterWorker(Worker):
                     scalars[k] = v
         if total_flops:
             scalars["tflops/e2e"] = total_flops / e2e / 1e12
+        self.perf_summary["steps"] += 1
+        self.perf_summary["total_e2e_s"] += e2e
+        self.perf_summary["wall_s"] = time.monotonic() - self._start_time
+        # Effective trained tokens: every train interface reports an
+        # additive <name>/n_tokens (e.g. ppo_actor/n_tokens).
+        step_tokens = sum(
+            v for k, v in scalars.items()
+            if k.endswith("/n_tokens") and isinstance(v, (int, float))
+        )
+        self.perf_summary["train_tokens"] += step_tokens
+        self.perf_summary["history"].append([e2e, step_tokens])
         perf_keys = [
             k for k in sorted(scalars)
             if k.startswith(("timeperf/", "tflops/", "gen_tokens_per_sec/"))
