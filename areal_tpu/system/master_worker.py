@@ -271,7 +271,11 @@ class MasterWorker(Worker):
             if k.endswith("/n_tokens") and isinstance(v, (int, float))
         )
         self.perf_summary["train_tokens"] += step_tokens
-        self.perf_summary["history"].append([e2e, step_tokens])
+        # Per-step history only for bounded benchmark runs (its consumer
+        # is the speedup benchmark's warmup-drop); an open-ended RL run
+        # would grow it for the process lifetime.
+        if self._total_steps_cap is not None:
+            self.perf_summary["history"].append([e2e, step_tokens])
         perf_keys = [
             k for k in sorted(scalars)
             if k.startswith(("timeperf/", "tflops/", "gen_tokens_per_sec/"))

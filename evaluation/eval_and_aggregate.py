@@ -73,6 +73,14 @@ def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
     import inspect
 
     accepted = set(inspect.signature(evaluate_checkpoint).parameters)
+    if bench.task == "math":
+        # A benchmark named after a preset (aime24/math500/gsm8k/...)
+        # gets that preset's prompt template, few-shot demos, and
+        # sampling defaults (evaluation/presets.py).
+        from evaluation.presets import BENCHMARKS
+
+        if bench.name in BENCHMARKS:
+            eval_args = {"benchmark": bench.name, **eval_args}
     return evaluate_checkpoint(
         ckpt=ckpt, data=bench.data_path, output=output,
         **{k: v for k, v in eval_args.items() if k in accepted},
@@ -142,7 +150,7 @@ if __name__ == "__main__":
         elif k == "steps":
             kwargs["steps"] = [int(s) for s in v.split(",")]
         elif k in ("max_new_tokens", "n_samples", "max_prompts", "max_cases",
-                   "seed"):
+                   "seed", "num_shots"):
             kwargs[k] = int(v)
         elif k in ("greedy",):
             kwargs[k] = v.lower() in ("1", "true")

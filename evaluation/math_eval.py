@@ -8,7 +8,12 @@ AutomaticEvaluator per saved checkpoint.
 
 Usage:
     python evaluation/math_eval.py ckpt=/save/actor/step10/dp0 \
-        data=/data/aime.jsonl output=/tmp/results.json max_new_tokens=512
+        data=/data/aime24.jsonl benchmark=aime24 output=/tmp/results.json
+    # benchmark= selects a preset (aime24/aime25/amc23/math500/gsm8k:
+    # field mapping + prompt template + few-shot demos + sampling
+    # defaults, evaluation/presets.py); prompt_type=/num_shots=/
+    # n_samples=/max_new_tokens= override it. Without benchmark=, rows
+    # are the repo's prompt/solutions schema taken verbatim.
 """
 
 from __future__ import annotations
@@ -32,13 +37,22 @@ def evaluate_checkpoint(
     ckpt: str,
     data: str,
     output: str = "",
-    max_new_tokens: int = 512,
+    benchmark: str = "",
+    prompt_type: str = "",
+    num_shots: int = -1,
+    max_new_tokens: int = 0,
     greedy: bool = True,
-    temperature: float = 1.0,
-    n_samples: int = 1,
+    temperature: float = 0.0,
+    n_samples: int = 0,
     max_prompts: int = 0,
     seed: int = 1,
 ) -> dict:
+    """benchmark= selects a preset (aime24/aime25/amc23/math500/gsm8k,
+    see evaluation/presets.py) carrying the field mapping, prompt
+    template, few-shot count, and sampling defaults; prompt_type=,
+    num_shots=, max_new_tokens=, n_samples= override it. Without
+    benchmark=, rows use the repo's prompt/solutions schema with the
+    prompt taken verbatim (the pre-round-5 behavior)."""
     import jax
 
     from areal_tpu.api import data_api
@@ -51,13 +65,50 @@ def evaluate_checkpoint(
     from areal_tpu.models.generation import generate_tokens
     from areal_tpu.models.hf import load_hf_model
 
+    from evaluation.presets import BENCHMARKS, build_prompt, load_benchmark
+
+    # Validate the benchmark name BEFORE the (multi-GB) checkpoint load:
+    # a typo should fail instantly with the valid names.
+    if benchmark and benchmark not in BENCHMARKS:
+        raise ValueError(
+            f"unknown benchmark {benchmark!r}; available: "
+            f"{sorted(BENCHMARKS)}"
+        )
+
     cfg, params = load_hf_model(ckpt)
     tokenizer = data_api.load_hf_tokenizer(ckpt)
 
-    with open(data) as f:
-        rows = [json.loads(l) for l in f if l.strip()]
-    if max_prompts:
-        rows = rows[:max_prompts]
+    preset = BENCHMARKS[benchmark] if benchmark else None
+    if preset is not None:
+        # Explicit args override the preset's defaults.
+        prompt_type = prompt_type or preset.prompt_type
+        num_shots = preset.num_shots if num_shots < 0 else num_shots
+        max_new_tokens = max_new_tokens or preset.max_new_tokens
+        n_samples = n_samples or preset.n_samples
+        temperature = temperature or preset.temperature
+        if n_samples > 1:
+            greedy = False  # pass@k/maj@k need sample diversity
+        bench_rows = load_benchmark(data, preset)
+        if max_prompts:
+            bench_rows = bench_rows[:max_prompts]
+        rows = [
+            # gt may already be a list (e.g. a 'solutions' field):
+            # wrapping it again would make grade_answer compare against
+            # the list's repr and score everything wrong.
+            {"query_id": r["query_id"],
+             "solutions": (r["gt"] if isinstance(r["gt"], (list, tuple))
+                           else [r["gt"]]),
+             "prompt": build_prompt(r["question"], prompt_type, num_shots)}
+            for r in bench_rows
+        ]
+    else:
+        max_new_tokens = max_new_tokens or 512
+        n_samples = n_samples or 1
+        temperature = temperature or 1.0
+        with open(data) as f:
+            rows = [json.loads(l) for l in f if l.strip()]
+        if max_prompts:
+            rows = rows[:max_prompts]
 
     g = GenerationHyperparameters(
         max_new_tokens=max_new_tokens, greedy=greedy, temperature=temperature
@@ -93,6 +144,9 @@ def evaluate_checkpoint(
     result = {
         "ckpt": ckpt,
         "data": data,
+        "benchmark": benchmark or "default",
+        "prompt_type": prompt_type or "verbatim",
+        "num_shots": max(0, num_shots),
         "n_prompts": len(prompts),
         "n_samples": n_samples,
         "accuracy": n_correct / max(1, total),
@@ -124,7 +178,8 @@ if __name__ == "__main__":
     kwargs = {}
     for arg in sys.argv[1:]:
         k, v = arg.split("=", 1)
-        if k in ("max_new_tokens", "n_samples", "max_prompts", "seed"):
+        if k in ("max_new_tokens", "n_samples", "max_prompts", "seed",
+                 "num_shots"):
             v = int(v)
         elif k in ("greedy",):
             v = v.lower() in ("1", "true")

@@ -239,3 +239,52 @@ def test_math_eval_multisample_metrics(tiny_ckpt, math_data):
     assert 0.0 <= res["maj_at_k"] <= res["pass_at_k"] <= 1.0
     assert res["n_samples"] == 2
     assert len(res["details"]) == 2 * res["n_prompts"]
+
+
+def test_math_eval_named_benchmark_preset(tiny_ckpt, tmp_path):
+    """benchmark= drives the full preset path e2e: field mapping (problem/
+    answer rows), prompt template + few-shot demos, multi-sample metrics
+    (VERDICT r4 missing #2 / next-round #5)."""
+    from evaluation.math_eval import evaluate_checkpoint
+
+    _, ckpt = tiny_ckpt
+    rows = [
+        {"problem": "What is 20 + 22?", "answer": "42", "query_id": "p0"},
+        {"problem": "What is 5 * 5?", "answer": "25", "query_id": "p1"},
+    ]
+    data = tmp_path / "math500.jsonl"
+    data.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    res = evaluate_checkpoint(
+        ckpt=ckpt, data=str(data), benchmark="math500",
+        # Tiny-model overrides: the preset's 4096 new tokens would crawl.
+        max_new_tokens=8, n_samples=2,
+        output=str(tmp_path / "res.json"),
+    )
+    assert res["benchmark"] == "math500"
+    assert res["prompt_type"] == "boxed"
+    assert res["n_prompts"] == 2
+    assert res["n_samples"] == 2
+    assert len(res["details"]) == 4
+    assert "pass_at_k" in res and "maj_at_k" in res
+    saved = json.loads((tmp_path / "res.json").read_text())
+    assert saved["benchmark"] == "math500"
+
+
+def test_eval_and_aggregate_applies_preset(tiny_ckpt, tmp_path):
+    """A benchmark whose NAME matches a preset routes through it (prompt
+    template + defaults) inside the aggregation driver."""
+    from evaluation.eval_and_aggregate import Benchmark, run_eval
+
+    _, ckpt = tiny_ckpt
+    rows = [{"problem": "What is 1 + 1?", "answer": "2"}]
+    data = tmp_path / "amc.jsonl"
+    data.write_text("".join(json.dumps(r) + "\n" for r in rows))
+
+    res = run_eval(
+        ckpt, Benchmark("amc23", str(data), "math"),
+        str(tmp_path / "out.json"),
+        max_new_tokens=8, n_samples=1, greedy=True,
+    )
+    assert res["benchmark"] == "amc23"
+    assert res["prompt_type"] == "boxed"
