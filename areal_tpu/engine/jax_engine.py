@@ -110,6 +110,25 @@ class JaxTrainEngine(TrainEngine):
         self.max_row_len = max_row_len
         self._is_train = optimizer_config is not None
 
+        if (
+            model_cfg.moe is not None
+            and model_cfg.moe.dispatch == "dropless"
+            and self.mesh.shape.get("fsdp", 1) > 1
+            # EP only applies when E divides the fsdp axis; otherwise
+            # sharding.py's fallback shards the hidden dim instead and
+            # ragged_dot contracts an unsharded expert axis — legal.
+            and model_cfg.moe.num_experts % self.mesh.shape["fsdp"] == 0
+        ):
+            # Expert weights shard E over fsdp (parallel/sharding.py),
+            # but lax.ragged_dot cannot contract a sharded expert axis:
+            # GSPMD would all-gather the full stacked expert weights
+            # every layer every step — silently losing exactly the HBM
+            # the EP sharding protects. Fail at config time instead.
+            raise NotImplementedError(
+                "dispatch='dropless' does not shard over the expert "
+                "(fsdp) axis; use dispatch='capacity' for expert-"
+                "parallel meshes or run with fsdp=1"
+            )
         self._param_shardings = param_shardings(params, self.mesh)
         self.params = jax.device_put(params, self._param_shardings)
         self._batch_sharding = batch_sharding(self.mesh)
@@ -252,6 +271,16 @@ class JaxTrainEngine(TrainEngine):
                 aux = dict(aux)
                 aux["moe_load_balance"] = n_tok * moe_aux["load_balance_loss"]
                 aux["moe_z_loss"] = n_tok * moe_aux["z_loss"]
+                # Per-layer-mean capacity-overflow drop rate over REAL
+                # tokens (0 under dropless dispatch). "mean:" stats are
+                # averaged over micro-batches at surfacing instead of
+                # 1/global_denom-normalized — n_tok counts all non-pad
+                # tokens while global_denom counts loss-weight (response)
+                # tokens, so the n_tok scaling used by the loss-like
+                # stats would inflate a fraction.
+                aux["mean:moe_drop_rate"] = (
+                    moe_aux["drop_rate"] / self.model_cfg.n_layers
+                )
             return loss_sum, aux
 
         return compute
@@ -492,7 +521,13 @@ class JaxTrainEngine(TrainEngine):
             f"{loss_name}/n_mbs": float(len(mbs)),
         }
         for k, v in aux_vals.items():
-            stats[f"{loss_name}/{k}"] = float(v) / global_denom
+            if k.startswith("mean:"):
+                # Micro-batch-mean stats (fractions/rates): aux values
+                # sum across the accumulation scan, so dividing by the
+                # micro-batch count recovers the mean.
+                stats[f"{loss_name}/{k[len('mean:'):]}"] = float(v) / len(mbs)
+            else:
+                stats[f"{loss_name}/{k}"] = float(v) / global_denom
         return stats
 
     # ------------------------------------------------------------------

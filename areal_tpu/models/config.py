@@ -25,8 +25,26 @@ class MoEConfig:
     z_loss_coef: float = 0.0
     # Size of each expert's hidden dim; defaults to intermediate_dim.
     expert_intermediate_dim: Optional[int] = None
+    # "capacity": GShard einsum dispatch, [T,E,C] tensors — three large
+    #   MXU einsums, shards cleanly for expert parallelism, DROPS tokens
+    #   beyond capacity (drop rate surfaced in train stats as
+    #   moe_drop_rate). "dropless": sort-by-expert + lax.ragged_dot
+    #   grouped matmuls — zero drops at any router skew (the reference
+    #   dispatcher's guarantee, token_dispatcher.py), static shapes, but
+    #   no EP sharding of the ragged grouped matmul yet. Tradeoff
+    #   documented in docs/perf_notes.md.
+    dispatch: str = "capacity"
     # Dense layers interleaved with MoE (e.g. first k layers dense).
     first_k_dense: int = 0
+
+    def __post_init__(self):
+        if self.dispatch not in ("capacity", "dropless"):
+            # A typo here would silently fall through to capacity
+            # dispatch — the exact drop risk "dropless" exists to remove.
+            raise ValueError(
+                f"MoEConfig.dispatch must be 'capacity' or 'dropless', "
+                f"got {self.dispatch!r}"
+            )
 
 
 @dataclasses.dataclass(eq=False)  # eq=False keeps it hashable (by id) for jit static args

@@ -14,7 +14,17 @@ the reference has no EP at all).
 Load-balance aux loss and router z-loss follow the Switch/ST-MoE
 formulas (reference router.py aux_loss/z_loss). Tokens beyond an
 expert's capacity are dropped (contribute zero), standard for the
-einsum formulation; capacity_factor controls the drop rate.
+einsum formulation; capacity_factor controls the drop rate, and the
+realized drop rate is returned in the aux dict (surfaced in train stats
+as moe_drop_rate).
+
+The alternative `dispatch="dropless"` path matches the reference
+dispatcher's zero-drop guarantee (token_dispatcher.py) the TPU way:
+tokens sort by expert id and the expert FFN runs as `lax.ragged_dot`
+grouped matmuls with per-expert group sizes — static shapes, no
+capacity buffer, exact at any router skew. Tradeoff: the grouped
+matmul does not yet shard over the expert axis (no EP), so capacity
+dispatch remains the default for expert-parallel runs.
 """
 
 from __future__ import annotations
@@ -33,8 +43,12 @@ def moe_mlp(
     cfg: TransformerConfig,
     cdt,
     capacity_factor: float = None,
+    token_mask: jnp.ndarray = None,  # [...] bool, True = real token
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Returns (y with x's shape, {"load_balance_loss", "z_loss"})."""
+    """Returns (y with x's shape, {"load_balance_loss", "z_loss",
+    "drop_rate"}). token_mask marks real (non-padding) tokens: the
+    reported drop_rate then counts only real routings — padding rows
+    route too (static shapes) and would otherwise dilute the rate."""
     moe = cfg.moe
     if capacity_factor is None:
         capacity_factor = moe.capacity_factor
@@ -55,33 +69,69 @@ def moe_mlp(
     # renormalize the selected gates (mixtral convention)
     top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
 
-    C = max(1, int(capacity_factor * T * k / E))
-    # Position of each (token, choice) within its expert's capacity buffer:
-    # one-hot over experts -> exclusive cumsum over the flattened (k, T)
-    # priority order (choice 0 of every token first).
     choice_e = top_e.T.reshape(-1)  # [k*T] expert ids, choice-major
-    onehot = jax.nn.one_hot(choice_e, E, dtype=jnp.int32)  # [kT, E]
-    pos_in_e = jnp.cumsum(onehot, axis=0) - onehot  # exclusive
-    pos = jnp.sum(pos_in_e * onehot, axis=-1)  # [kT]
-    keep = pos < C
-
     gate = top_p.T.reshape(-1)  # [kT], aligned with choice_e
     tok_idx = jnp.tile(jnp.arange(T), k)
-
-    # dispatch [T, E, C] / combine [T, E, C]
-    disp = jnp.zeros((T, E, C), bool)
-    disp = disp.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].max(keep)
-    comb = jnp.zeros((T, E, C), jnp.float32)
-    comb = comb.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].add(
-        jnp.where(keep, gate, 0.0)
-    )
-
-    xe = jnp.einsum("tec,td->ecd", disp.astype(cdt), xt.astype(cdt))  # [E, C, D]
     act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
-    h = act(jnp.einsum("ecd,edf->ecf", xe, mp["w_gate"].astype(cdt)))
-    h = h * jnp.einsum("ecd,edf->ecf", xe, mp["w_up"].astype(cdt))
-    ye = jnp.einsum("ecf,efd->ecd", h, mp["w_down"].astype(cdt))  # [E, C, D]
-    y = jnp.einsum("tec,ecd->td", comb.astype(cdt), ye)  # [T, D]
+
+    if moe.dispatch == "dropless":
+        # Sort (token, choice) pairs by expert; the expert FFN becomes
+        # ragged grouped matmuls with per-expert group sizes. Static
+        # shapes (kT rows regardless of skew), zero drops.
+        order = jnp.argsort(choice_e)  # stable: keeps priority order
+        group_sizes = jnp.bincount(choice_e, length=E)
+        xs = xt[tok_idx[order]].astype(cdt)  # [kT, D] sorted by expert
+        wg = mp["w_gate"].astype(cdt)
+        wu = mp["w_up"].astype(cdt)
+        wd = mp["w_down"].astype(cdt)
+        h = act(jax.lax.ragged_dot(xs, wg, group_sizes))
+        h = h * jax.lax.ragged_dot(xs, wu, group_sizes)
+        ys = jax.lax.ragged_dot(h, wd, group_sizes)  # [kT, D]
+        y = (
+            jnp.zeros((T, D), cdt)
+            .at[tok_idx[order]]
+            .add(gate[order].astype(cdt)[:, None] * ys)
+        )
+        drop_rate = jnp.zeros((), jnp.float32)
+    else:
+        C = max(1, int(capacity_factor * T * k / E))
+        # Position of each (token, choice) within its expert's capacity
+        # buffer: one-hot over experts -> exclusive cumsum over the
+        # flattened (k, T) priority order (choice 0 of every token
+        # first).
+        onehot = jax.nn.one_hot(choice_e, E, dtype=jnp.int32)  # [kT, E]
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot  # exclusive
+        pos = jnp.sum(pos_in_e * onehot, axis=-1)  # [kT]
+        keep = pos < C
+
+        # dispatch [T, E, C] / combine [T, E, C]
+        disp = jnp.zeros((T, E, C), bool)
+        disp = disp.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].max(keep)
+        comb = jnp.zeros((T, E, C), jnp.float32)
+        comb = comb.at[tok_idx, choice_e, jnp.minimum(pos, C - 1)].add(
+            jnp.where(keep, gate, 0.0)
+        )
+
+        xe = jnp.einsum("tec,td->ecd", disp.astype(cdt), xt.astype(cdt))  # [E, C, D]
+        h = act(jnp.einsum("ecd,edf->ecf", xe, mp["w_gate"].astype(cdt)))
+        h = h * jnp.einsum("ecd,edf->ecf", xe, mp["w_up"].astype(cdt))
+        ye = jnp.einsum("ecf,efd->ecd", h, mp["w_down"].astype(cdt))  # [E, C, D]
+        y = jnp.einsum("tec,ecd->td", comb.astype(cdt), ye)  # [T, D]
+        # Realized drop rate: fraction of REAL (token, choice) routings
+        # that exceeded their expert's capacity this step. The quality
+        # risk of the einsum formulation under router skew — surfaced in
+        # train stats so it is measured, not assumed.
+        if token_mask is not None:
+            mask_k = jnp.tile(token_mask.reshape(-1), k)  # aligns choice_e
+            real = jnp.sum(mask_k.astype(jnp.float32))
+            dropped = jnp.sum((~keep & mask_k).astype(jnp.float32))
+            drop_rate = dropped / jnp.maximum(real, 1.0)
+        else:
+            # Clamp: XLA's mean (sum * approx-reciprocal) can round an
+            # exact 1.0 to 1.0000000419, making this ~-4e-8.
+            drop_rate = jnp.maximum(
+                1.0 - jnp.mean(keep.astype(jnp.float32)), 0.0
+            )
 
     # Switch load-balance loss: E * sum_e f_e * P_e, where f_e is the
     # fraction of (token, choice) routings to e and P_e the mean prob.
@@ -93,6 +143,7 @@ def moe_mlp(
     return y.reshape(*lead_shape, D), {
         "load_balance_loss": load_balance,
         "z_loss": z,
+        "drop_rate": drop_rate,
     }
 
 

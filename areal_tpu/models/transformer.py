@@ -346,7 +346,10 @@ def forward(
     if use_moe:
         from areal_tpu.models.moe import moe_mlp
 
-        mlp_fn = lambda h, mp: moe_mlp(h, mp, cfg, cdt)
+        moe_token_mask = segment_ids > 0  # real-token drop accounting
+        mlp_fn = lambda h, mp: moe_mlp(
+            h, mp, cfg, cdt, token_mask=moe_token_mask
+        )
     else:
         mlp_fn = lambda h, mp: _mlp(h, mp, cfg, cdt)
     if remat_mode == "mlp":
@@ -371,6 +374,7 @@ def forward(
     aux0 = {
         "load_balance_loss": jnp.zeros((), jnp.float32),
         "z_loss": jnp.zeros((), jnp.float32),
+        "drop_rate": jnp.zeros((), jnp.float32),  # summed; /n_layers = mean
     }
     if remat_mode == "full":
         body = jax.checkpoint(layer_body)

@@ -65,19 +65,19 @@ def evaluate_checkpoint(
     from areal_tpu.models.generation import generate_tokens
     from areal_tpu.models.hf import load_hf_model
 
-    from evaluation.presets import BENCHMARKS, build_prompt, load_benchmark
+    from evaluation.presets import (
+        BENCHMARKS, MATH_FEW_SHOT, PROMPT_TEMPLATES, build_prompt,
+        load_benchmark,
+    )
 
-    # Validate the benchmark name BEFORE the (multi-GB) checkpoint load:
-    # a typo should fail instantly with the valid names.
+    # Validate EVERYTHING and build the prompt rows BEFORE the (multi-GB)
+    # checkpoint load: a typo'd benchmark/prompt_type or an over-asked
+    # num_shots should fail instantly, not after minutes of loading.
     if benchmark and benchmark not in BENCHMARKS:
         raise ValueError(
             f"unknown benchmark {benchmark!r}; available: "
             f"{sorted(BENCHMARKS)}"
         )
-
-    cfg, params = load_hf_model(ckpt)
-    tokenizer = data_api.load_hf_tokenizer(ckpt)
-
     preset = BENCHMARKS[benchmark] if benchmark else None
     if preset is not None:
         # Explicit args override the preset's defaults.
@@ -88,6 +88,16 @@ def evaluate_checkpoint(
         temperature = temperature or preset.temperature
         if n_samples > 1:
             greedy = False  # pass@k/maj@k need sample diversity
+        if prompt_type not in PROMPT_TEMPLATES:
+            raise ValueError(
+                f"unknown prompt_type {prompt_type!r}; available: "
+                f"{sorted(PROMPT_TEMPLATES)}"
+            )
+        if num_shots > len(MATH_FEW_SHOT):
+            raise ValueError(
+                f"num_shots={num_shots} but only {len(MATH_FEW_SHOT)} "
+                f"few-shot demos are available"
+            )
         bench_rows = load_benchmark(data, preset)
         if max_prompts:
             bench_rows = bench_rows[:max_prompts]
@@ -102,6 +112,16 @@ def evaluate_checkpoint(
             for r in bench_rows
         ]
     else:
+        # No preset = prompts taken verbatim; prompt args would be
+        # silently ignored, so refuse them rather than record a
+        # methodology that never ran.
+        if prompt_type or num_shots >= 0:
+            raise ValueError(
+                "prompt_type=/num_shots= require benchmark=<preset>; "
+                "without one, prompts are used verbatim (the 'generic' "
+                "preset wraps prompt/solutions rows in the boxed "
+                "template)"
+            )
         max_new_tokens = max_new_tokens or 512
         n_samples = n_samples or 1
         temperature = temperature or 1.0
@@ -109,6 +129,9 @@ def evaluate_checkpoint(
             rows = [json.loads(l) for l in f if l.strip()]
         if max_prompts:
             rows = rows[:max_prompts]
+
+    cfg, params = load_hf_model(ckpt)
+    tokenizer = data_api.load_hf_tokenizer(ckpt)
 
     g = GenerationHyperparameters(
         max_new_tokens=max_new_tokens, greedy=greedy, temperature=temperature
@@ -144,7 +167,7 @@ def evaluate_checkpoint(
     result = {
         "ckpt": ckpt,
         "data": data,
-        "benchmark": benchmark or "default",
+        "benchmark": benchmark or "none",
         "prompt_type": prompt_type or "verbatim",
         "num_shots": max(0, num_shots),
         "n_prompts": len(prompts),

@@ -40,21 +40,25 @@ BOXED_INSTRUCTION = (
 class PromptTemplate:
     """Renders (few-shot demos +) a question into a model-ready prompt.
 
-    question_format receives the question text; demo_format receives
-    (question, full worked answer) pairs; demos join with demo_sep and
-    the final question appends after it."""
+    prefix (optional) emits ONCE at the very start — chat formats put
+    the system turn there so demos cannot appear before it; demo_format
+    receives (question, full worked answer) pairs joined by demo_sep;
+    the final question renders via question_format after the demos."""
 
     name: str
     question_format: str
     demo_format: str = "{question}\n{answer}"
     demo_sep: str = "\n\n"
+    prefix: str = ""
 
     def wrap(self, question: str,
              shots: Sequence[Tuple[str, str]] = ()) -> str:
         parts = [self.demo_format.format(question=q, answer=a)
                  for q, a in shots]
         parts.append(self.question_format.format(question=question))
-        return self.demo_sep.join(parts)
+        # prefix goes through .format() too: every template string gets
+        # exactly one format pass (escaped {{}} in BOXED_INSTRUCTION).
+        return self.prefix.format() + self.demo_sep.join(parts)
 
 
 PROMPT_TEMPLATES = {
@@ -80,8 +84,10 @@ PROMPT_TEMPLATES = {
     # (the format the reference's RL-trained Qwen checkpoints expect).
     "chatml-boxed": PromptTemplate(
         name="chatml-boxed",
-        question_format=(
+        prefix=(
             "<|im_start|>system\n" + BOXED_INSTRUCTION + "<|im_end|>\n"
+        ),
+        question_format=(
             "<|im_start|>user\n{question}<|im_end|>\n"
             "<|im_start|>assistant\n"
         ),
@@ -197,16 +203,22 @@ class BenchmarkPreset:
 
     def ground_truth(self, row: dict):
         if self.answer_fn is not None:
-            return self.answer_fn(row)
-        for k in self.answer_keys:
-            if row.get(k) is not None:
-                return row[k]
-        # Raise like question() does: a silent None would grade every
-        # sample wrong and report a plausible-looking 0.0 accuracy.
-        raise KeyError(
-            f"benchmark {self.name}: no answer field among "
-            f"{self.answer_keys} in row keys {sorted(row)}"
-        )
+            val = self.answer_fn(row)
+        else:
+            val = next(
+                (row[k] for k in self.answer_keys
+                 if row.get(k) is not None),
+                None,
+            )
+        if val is None:
+            # Raise like question() does — on BOTH paths: a silent None
+            # would grade every sample wrong against the string 'None'
+            # and report a plausible-looking 0.0 accuracy.
+            raise KeyError(
+                f"benchmark {self.name}: no ground-truth answer found "
+                f"in row keys {sorted(row)}"
+            )
+        return val
 
 
 BENCHMARKS = {
@@ -229,9 +241,12 @@ BENCHMARKS = {
         num_shots=4,
         max_new_tokens=512,
     ),
-    # Generic fallback: the repo's own prompt/solutions jsonl schema
-    # (datasets/math_code_prompt.py), zero-shot boxed.
-    "default": BenchmarkPreset(name="default"),
+    # Generic preset for the repo's own prompt/solutions jsonl schema
+    # (datasets/math_code_prompt.py), zero-shot boxed. Named "generic",
+    # NOT "default": math_eval's no-preset path labels results
+    # differently ("none"/"verbatim"), and one label must never cover
+    # two prompt behaviors.
+    "generic": BenchmarkPreset(name="generic"),
 }
 
 
