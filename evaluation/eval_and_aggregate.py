@@ -81,6 +81,24 @@ def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
 
         if bench.name in BENCHMARKS:
             eval_args = {"benchmark": bench.name, **eval_args}
+        else:
+            # No preset: prompts run verbatim. Shared kwargs may carry
+            # preset-only args meant for the OTHER benchmarks in a
+            # mixed list — drop them here instead of letting math_eval
+            # reject the whole job (it raises to prevent recording a
+            # methodology that never ran).
+            dropped = {
+                k for k in ("prompt_type", "num_shots") if k in eval_args
+            }
+            if dropped:
+                print(
+                    f"[eval_and_aggregate] benchmark {bench.name!r} has "
+                    f"no preset; prompts run verbatim and {sorted(dropped)} "
+                    f"do not apply to it"
+                )
+            eval_args = {
+                k: v for k, v in eval_args.items() if k not in dropped
+            }
     return evaluate_checkpoint(
         ckpt=ckpt, data=bench.data_path, output=output,
         **{k: v for k, v in eval_args.items() if k in accepted},

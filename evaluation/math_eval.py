@@ -66,8 +66,7 @@ def evaluate_checkpoint(
     from areal_tpu.models.hf import load_hf_model
 
     from evaluation.presets import (
-        BENCHMARKS, MATH_FEW_SHOT, PROMPT_TEMPLATES, build_prompt,
-        load_benchmark,
+        BENCHMARKS, PROMPT_TEMPLATES, build_prompt, load_benchmark,
     )
 
     # Validate EVERYTHING and build the prompt rows BEFORE the (multi-GB)
@@ -93,11 +92,8 @@ def evaluate_checkpoint(
                 f"unknown prompt_type {prompt_type!r}; available: "
                 f"{sorted(PROMPT_TEMPLATES)}"
             )
-        if num_shots > len(MATH_FEW_SHOT):
-            raise ValueError(
-                f"num_shots={num_shots} but only {len(MATH_FEW_SHOT)} "
-                f"few-shot demos are available"
-            )
+        # (num_shots bounds are enforced by build_prompt below, which
+        # also runs before the checkpoint load.)
         bench_rows = load_benchmark(data, preset)
         if max_prompts:
             bench_rows = bench_rows[:max_prompts]
