@@ -63,7 +63,14 @@ def discover_checkpoints(save_root: str) -> Dict[int, str]:
     return found
 
 
-def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
+def run_eval(ckpt: str, bench: Benchmark, output: str,
+             has_preset_peer: bool = False, **eval_args) -> dict:
+    """has_preset_peer: True when ANOTHER math benchmark in the same run
+    resolves to a preset — only then may shared preset-only kwargs
+    (prompt_type/num_shots) be dropped for this non-preset benchmark;
+    otherwise they were clearly meant for THIS one and math_eval's hard
+    error must fire rather than silently recording a methodology that
+    never ran."""
     if bench.task == "code":
         from evaluation.code_eval import evaluate_checkpoint
     else:
@@ -81,12 +88,7 @@ def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
 
         if bench.name in BENCHMARKS:
             eval_args = {"benchmark": bench.name, **eval_args}
-        else:
-            # No preset: prompts run verbatim. Shared kwargs may carry
-            # preset-only args meant for the OTHER benchmarks in a
-            # mixed list — drop them here instead of letting math_eval
-            # reject the whole job (it raises to prevent recording a
-            # methodology that never ran).
+        elif has_preset_peer:
             dropped = {
                 k for k in ("prompt_type", "num_shots") if k in eval_args
             }
@@ -94,7 +96,7 @@ def run_eval(ckpt: str, bench: Benchmark, output: str, **eval_args) -> dict:
                 print(
                     f"[eval_and_aggregate] benchmark {bench.name!r} has "
                     f"no preset; prompts run verbatim and {sorted(dropped)} "
-                    f"do not apply to it"
+                    f"apply only to the preset benchmarks in this run"
                 )
             eval_args = {
                 k: v for k, v in eval_args.items() if k not in dropped
@@ -114,9 +116,14 @@ def eval_and_aggregate(
 ) -> dict:
     """Run every (checkpoint, benchmark) pair, reusing results.json files
     already on disk (idempotent reruns), then aggregate."""
+    from evaluation.presets import BENCHMARKS
+
     ckpts = discover_checkpoints(save_root)
     if steps:
         ckpts = {s: d for s, d in ckpts.items() if s in steps}
+    has_preset = any(
+        b.task == "math" and b.name in BENCHMARKS for b in benchmarks
+    )
     table: Dict[str, Dict[str, float]] = {}
     for step in sorted(ckpts):
         row: Dict[str, float] = {}
@@ -128,7 +135,8 @@ def eval_and_aggregate(
                 with open(out_path) as f:
                     res = json.load(f)
             else:
-                res = run_eval(ckpts[step], bench, out_path, **eval_args)
+                res = run_eval(ckpts[step], bench, out_path,
+                               has_preset_peer=has_preset, **eval_args)
             row[bench.name] = res["accuracy"]
         row["avg"] = sum(row.values()) / max(1, len(row))
         table[f"step{step}"] = row

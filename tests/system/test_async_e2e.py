@@ -33,6 +33,67 @@ from tests import fixtures
 from tests.system.test_e2e_experiments import TINY_CFG, _mk_tokenizer_files, _worker_env
 
 
+N_SEQS = 2
+
+
+def _trainer_parts(exp, trial, tok_dir):
+    """The trainer side shared by every async e2e variant: train MFC
+    (with the weight-publish hook), stream-dataset model worker, and a
+    2-step benchmark master."""
+    actor = ModelName("actor", 0)
+    train = MFCDef(
+        name="actor_train",
+        model_name=actor,
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=N_SEQS,
+        input_keys=(
+            "packed_input_ids",
+            "prompt_mask",
+            "packed_logprobs",
+            "rewards",
+            "seq_no_eos_mask",
+        ),
+        post_hooks=[ParamReallocHook(source=str(actor))],
+    )
+    model_args = dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32")
+    mw = ModelWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        shards=[
+            ModelShardSpec(
+                id=ModelShardID(actor),
+                model=ModelAbstraction("tpu_transformer", args=model_args),
+                backend=ModelBackendAbstraction(
+                    "jax_train",
+                    args=dict(optimizer=dict(lr=1e-4), remat=False,
+                              row_len_multiple=8),
+                ),
+                interface=ModelInterfaceAbstraction(
+                    "ppo_actor", args=dict(kl_ctl=0.0)
+                ),
+            )
+        ],
+        tokenizer_path=tok_dir,
+        train_batch_size=N_SEQS,
+        total_train_epochs=1,
+        stream_dataset=True,
+        n_pullers=1,
+    )
+    master = MasterWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        exp_ctrl=ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
+        rpcs=[train],
+        model_topos={str(actor): ["model_worker/0"]},
+        data_hosts=["model_worker/0"],
+        n_model_workers=1,
+        train_batch_size=N_SEQS,
+    )
+    return model_args, mw, master
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize(
     "agent_abs",
@@ -53,59 +114,7 @@ def test_async_ppo_e2e(tmp_path, agent_abs):
     mc_rows = [r for r in fixtures.make_math_code_rows(12, seed=9) if r["task"] == "math"]
     data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
 
-    actor = ModelName("actor", 0)
-    n_seqs = 2
-
-    train = MFCDef(
-        name="actor_train",
-        model_name=actor,
-        interface_type=ModelInterfaceType.TRAIN_STEP,
-        interface_impl=None,
-        n_seqs=n_seqs,
-        input_keys=(
-            "packed_input_ids",
-            "prompt_mask",
-            "packed_logprobs",
-            "rewards",
-            "seq_no_eos_mask",
-        ),
-        post_hooks=[ParamReallocHook(source=str(actor))],
-    )
-
-    model_args = dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32")
-    mw = ModelWorkerConfig(
-        experiment_name=exp,
-        trial_name=trial,
-        worker_index=0,
-        shards=[
-            ModelShardSpec(
-                id=ModelShardID(actor),
-                model=ModelAbstraction("tpu_transformer", args=model_args),
-                backend=ModelBackendAbstraction(
-                    "jax_train",
-                    args=dict(optimizer=dict(lr=1e-4), remat=False, row_len_multiple=8),
-                ),
-                interface=ModelInterfaceAbstraction(
-                    "ppo_actor", args=dict(kl_ctl=0.0)
-                ),
-            )
-        ],
-        tokenizer_path=tok_dir,
-        train_batch_size=n_seqs,
-        total_train_epochs=1,
-        stream_dataset=True,
-        n_pullers=1,
-    )
-    master = MasterWorkerConfig(
-        experiment_name=exp,
-        trial_name=trial,
-        exp_ctrl=ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
-        rpcs=[train],
-        model_topos={str(actor): ["model_worker/0"]},
-        data_hosts=["model_worker/0"],
-        n_model_workers=1,
-        train_batch_size=n_seqs,
-    )
+    model_args, mw, master = _trainer_parts(exp, trial, tok_dir)
     gen_server = GenerationServerConfig(
         experiment_name=exp,
         trial_name=trial,
@@ -121,7 +130,7 @@ def test_async_ppo_e2e(tmp_path, agent_abs):
         trial_name=trial,
         model_name="actor",
         n_servers=1,
-        train_batch_size=n_seqs,
+        train_batch_size=N_SEQS,
         max_head_offpolicyness=100,  # don't gate in this tiny test
     )
     rollout = RolloutWorkerConfig(
@@ -175,60 +184,7 @@ def test_async_ppo_e2e_multi_server(tmp_path, capfd):
     ]
     data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
 
-    actor = ModelName("actor", 0)
-    n_seqs = 2
-
-    train = MFCDef(
-        name="actor_train",
-        model_name=actor,
-        interface_type=ModelInterfaceType.TRAIN_STEP,
-        interface_impl=None,
-        n_seqs=n_seqs,
-        input_keys=(
-            "packed_input_ids",
-            "prompt_mask",
-            "packed_logprobs",
-            "rewards",
-            "seq_no_eos_mask",
-        ),
-        post_hooks=[ParamReallocHook(source=str(actor))],
-    )
-
-    model_args = dict(config=TINY_CFG, tokenizer_path=tok_dir, dtype="float32")
-    mw = ModelWorkerConfig(
-        experiment_name=exp,
-        trial_name=trial,
-        worker_index=0,
-        shards=[
-            ModelShardSpec(
-                id=ModelShardID(actor),
-                model=ModelAbstraction("tpu_transformer", args=model_args),
-                backend=ModelBackendAbstraction(
-                    "jax_train",
-                    args=dict(optimizer=dict(lr=1e-4), remat=False,
-                              row_len_multiple=8),
-                ),
-                interface=ModelInterfaceAbstraction(
-                    "ppo_actor", args=dict(kl_ctl=0.0)
-                ),
-            )
-        ],
-        tokenizer_path=tok_dir,
-        train_batch_size=n_seqs,
-        total_train_epochs=1,
-        stream_dataset=True,
-        n_pullers=1,
-    )
-    master = MasterWorkerConfig(
-        experiment_name=exp,
-        trial_name=trial,
-        exp_ctrl=ExperimentSaveEvalControl(total_train_epochs=1, benchmark_steps=2),
-        rpcs=[train],
-        model_topos={str(actor): ["model_worker/0"]},
-        data_hosts=["model_worker/0"],
-        n_model_workers=1,
-        train_batch_size=n_seqs,
-    )
+    model_args, mw, master = _trainer_parts(exp, trial, tok_dir)
     gen_servers = [
         GenerationServerConfig(
             experiment_name=exp,
@@ -250,7 +206,7 @@ def test_async_ppo_e2e_multi_server(tmp_path, capfd):
         model_name="actor",
         n_servers=2,
         schedule_policy="least_token_usage",
-        train_batch_size=n_seqs,
+        train_batch_size=N_SEQS,
         # Tight staleness gate: the gate blocks when expected_version
         # - weight_version > this, so 0 makes step-2 rollouts BLOCK
         # until the v1 fanout lands on every server — the fanout
