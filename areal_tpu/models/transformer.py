@@ -190,7 +190,12 @@ def _attention_block(
         q = apply_rotary(q, cos, sin, cfg.rotary_interleaved)
         k = apply_rotary(k, cos, sin, cfg.rotary_interleaved)
 
-    impl = resolve_attn_impl(attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads)
+    # 'auto' resolution is mesh-aware: a seq>1 mesh picks a CP scheme
+    # (Ulysses when heads divide the seq axis, ring otherwise) before
+    # the local-kernel choice. Explicit values pass through.
+    impl = resolve_attn_impl(
+        attn_impl, T, cfg.n_q_heads, cfg.n_kv_heads, mesh=mesh, r=R
+    )
     sharded = mesh is not None and mesh.size > 1
     if sharded and impl not in ("reference", "ring", "ulysses"):
         # Never run a bare pallas_call inside a sharded jit — GSPMD
@@ -329,7 +334,8 @@ def forward(
         from areal_tpu.ops.attention import resolve_attn_impl
 
         resolved = resolve_attn_impl(
-            attn_impl, input_ids.shape[1], cfg.n_q_heads, cfg.n_kv_heads
+            attn_impl, input_ids.shape[1], cfg.n_q_heads, cfg.n_kv_heads,
+            mesh=mesh, r=input_ids.shape[0],
         )
         if resolved != "splash":
             # Only the splash kernel tags its residuals; with other impls

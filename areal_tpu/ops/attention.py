@@ -263,12 +263,50 @@ def sharded_splash_ok(mesh, r: int, t: int, hq: int, hkv: int) -> bool:
     )
 
 
-def resolve_attn_impl(impl: str, t: int, hq: int, hkv: int) -> str:
+def resolve_cp_impl(mesh, r: int, t: int, hq: int, hkv: int) -> Optional[str]:
+    """Default context-parallel scheme for an 'auto' impl on a seq>1
+    mesh (trace-time static decision).
+
+    Policy (analytic default, pending on-ICI measurement — see
+    docs/perf_notes.md "ring vs Ulysses" and
+    scripts/long_context_probe.py cp mode, which A/Bs this choice):
+    prefer Ulysses when the head counts divide the seq axis — its
+    per-layer communication is 4 all-to-alls + 2 small gathers
+    regardless of the seq size, each moving 1/seq of the activations,
+    while ring pays seq pipelined ppermute steps whose overlap with the
+    per-chunk kernel is hard to sustain at small chunk sizes. Fall back
+    to ring when heads don't divide (GQA with few KV heads on a wide
+    seq axis) — ring only needs t % seq == 0. Returns None when neither
+    scheme fits (caller keeps its non-CP path)."""
+    from areal_tpu.ops.ring_attention import ring_ok
+    from areal_tpu.ops.ulysses_attention import ulysses_ok
+
+    if ulysses_ok(mesh, r, t, hq, hkv):
+        return "ulysses"
+    if ring_ok(mesh, r, t, hq, hkv):
+        return "ring"
+    return None
+
+
+def resolve_attn_impl(
+    impl: str, t: int, hq: int, hkv: int, mesh=None, r: Optional[int] = None,
+) -> str:
     """Resolve 'auto' to a concrete impl for the given shape (trace-time
-    static decision): splash on TPU backends when shapes allow, reference
-    otherwise."""
+    static decision). With a seq>1 mesh (and r given), a context-parallel
+    scheme is chosen first (resolve_cp_impl); otherwise splash on TPU
+    backends when shapes allow, reference as the fallback. Explicit impl
+    values pass through untouched."""
     if impl != "auto":
         return impl
+    if (
+        mesh is not None
+        and r is not None
+        and mesh.size > 1
+        and mesh.shape.get("seq", 1) > 1
+    ):
+        cp = resolve_cp_impl(mesh, r, t, hq, hkv)
+        if cp is not None:
+            return cp
     on_tpu = jax.default_backend() in ("tpu", "axon")
     splash_ok = t >= 128 and t % 128 == 0 and hq % hkv == 0
     return "splash" if (on_tpu and splash_ok) else "reference"

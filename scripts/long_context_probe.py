@@ -29,6 +29,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from areal_tpu.utils.jaxenv import apply_jax_platform_override
+
+apply_jax_platform_override()  # honor JAX_PLATFORMS despite sitecustomize
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,6 +50,16 @@ def emit(**kw):
 
 
 def flagship_cfg(max_pos=40960):
+    if os.environ.get("AREAL_PROBE_TINY"):
+        # Harness-validation shape (CI / virtual CPU mesh): same head
+        # divisibility structure as the flagship (hq/hkv divide seq*tp
+        # meshes the same way), tiny everything else.
+        return TransformerConfig(
+            n_layers=2, hidden_dim=128, n_q_heads=12, n_kv_heads=2,
+            head_dim=16, intermediate_dim=256, vocab_size=512,
+            compute_dtype="float32", param_dtype="float32",
+            max_position_embeddings=max_pos,
+        )
     return TransformerConfig(
         n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
         head_dim=128, intermediate_dim=8960, vocab_size=32768,
@@ -238,9 +252,73 @@ def probe_sort_skip(B=32, plen=512, new=256):
         f"({tps_greedy / tps_sorted:.2f}x)")
 
 
+def probe_cp(seq_tokens: int, mesh_spec: str):
+    """Ring vs Ulysses vs seq-sharded-reference A/B at one context length
+    (VERDICT r4 next-round #4): the SAME packed forward+backward on the
+    SAME seq>1 mesh under each attn_impl, timed per step. Needs more
+    than one device (real ICI for meaningful numbers; runs on the
+    virtual CPU mesh too, but only to validate the harness). The winner
+    should be wired as the 'auto' default in ops/attention.py
+    resolve_cp_impl — today's default (Ulysses when heads divide) is
+    analytic, pending this measurement."""
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.parallel.mesh import make_mesh
+    from areal_tpu.parallel.sharding import batch_sharding, shard_params
+
+    spec = MeshSpec.parse(mesh_spec)
+    if spec.size > len(jax.devices()):
+        log(f"cp {mesh_spec}: needs {spec.size} devices, "
+            f"have {len(jax.devices())} — skipping")
+        emit(metric=f"cp_ab_{seq_tokens//1024}k", mesh=mesh_spec,
+             step_seconds={"error": "not enough devices"})
+        return
+    mesh = make_mesh(spec, devices=jax.devices()[: spec.size])
+    cfg = flagship_cfg()
+    params = shard_params(init_params(cfg, jax.random.PRNGKey(0)), mesh)
+    n_params = count_params(params)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(1, seq_tokens)).astype(np.int32)
+    seg = np.ones((1, seq_tokens), np.int32)
+    pos = np.arange(seq_tokens, dtype=np.int32)[None, :]
+    sh = batch_sharding(mesh)
+    ids, seg, pos = (jax.device_put(a, sh) for a in (ids, seg, pos))
+
+    from areal_tpu.models.transformer import forward as model_forward
+
+    results = {}
+    for impl in ("reference", "ring", "ulysses"):
+        def loss(p):
+            h = model_forward(
+                p, cfg, ids, seg, pos, attn_impl=impl, remat="full",
+                output="hidden", mesh=mesh,
+            )
+            return jnp.sum(h.astype(jnp.float32) ** 2)
+
+        try:
+            step = jax.jit(jax.value_and_grad(loss))
+            t = time.perf_counter()
+            v, g = step(params)
+            float(v)  # force the fetch (tunnel: block_until_ready lies)
+            compile_s = time.perf_counter() - t
+            n, t0 = 3, time.perf_counter()
+            for _ in range(n):
+                v, g = step(params)
+                float(v)
+            dt = (time.perf_counter() - t0) / n
+            tflops = train_step_flops(cfg, n_params, [seq_tokens]) / dt / 1e12
+            results[impl] = round(dt, 3)
+            log(f"cp {impl} @{seq_tokens}: {dt:.3f}s/fwdbwd "
+                f"{tflops:.1f} TFLOP/s (compile {compile_s:.1f}s)")
+        except Exception as e:  # shape/mesh mismatch: record and move on
+            results[impl] = f"error: {type(e).__name__}"
+            log(f"cp {impl} @{seq_tokens}: {e}")
+    emit(metric=f"cp_ab_{seq_tokens//1024}k", mesh=mesh_spec,
+         step_seconds=results)
+
+
 def main():
     platform = jax.devices()[0].platform
-    log(f"platform={platform}")
+    log(f"platform={platform} n_devices={len(jax.devices())}")
     if platform != "tpu":
         log("WARNING: not on TPU; numbers are not meaningful")
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
@@ -252,6 +330,22 @@ def main():
         probe_gen()
     if which in ("all", "sortskip"):
         probe_sort_skip()
+    if which == "cp":
+        # Needs a multi-device allotment: run e.g.
+        #   python scripts/long_context_probe.py cp d1f1s2t1,d1f1s4t1 16384
+        # The default sweeps BOTH a seq=2 and a seq=4 mesh: the flagship's
+        # 2 KV heads divide only seq=2, so the Ulysses arm exists only
+        # there — a single s4 run would silently yield ring-vs-reference.
+        # (CPU harness check: AREAL_PROBE_TINY=1
+        #  XLA_FLAGS=--xla_force_host_platform_device_count=4
+        #  JAX_PLATFORMS=cpu python scripts/long_context_probe.py cp
+        #  d1f1s2t1 512)
+        mesh_specs = (
+            sys.argv[2] if len(sys.argv) > 2 else "d1f1s2t1,d1f1s4t1"
+        ).split(",")
+        seq_tokens = int(sys.argv[3]) if len(sys.argv) > 3 else 16384
+        for spec in mesh_specs:
+            probe_cp(seq_tokens, spec)
 
 
 if __name__ == "__main__":

@@ -152,3 +152,50 @@ def test_ulysses_splash_local_parity():
     np.testing.assert_allclose(
         np.asarray(got) * m, np.asarray(want) * m, rtol=2e-3, atol=2e-3
     )
+
+
+def test_resolve_cp_impl_policy():
+    """'auto' on a seq>1 mesh prefers Ulysses when the head counts
+    divide the seq axis, falls back to ring when they don't, and stays
+    out of the way (None) when neither scheme fits."""
+    from areal_tpu.ops.attention import resolve_cp_impl
+
+    # Hq=8/Hkv=4 divide seq=2 (per tensor shard) -> ulysses.
+    assert resolve_cp_impl(_mesh("d1f2s4t1"), 4, 64, 8, 4) == "ulysses"
+    # Flagship GQA shape Hkv=2 with seq=4: 2 % 4 != 0 -> ring.
+    assert resolve_cp_impl(_mesh("d1f1s4t1"), 4, 64, 12, 2) == "ring"
+    # T not divisible by seq -> neither.
+    assert resolve_cp_impl(_mesh("d1f1s4t1"), 4, 63, 12, 2) is None
+
+
+def test_auto_attn_impl_uses_cp_on_seq_mesh():
+    """forward(attn_impl='auto') on a seq>1 mesh routes through a CP
+    scheme and matches the single-device forward."""
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import forward, init_params
+    from areal_tpu.parallel.sharding import batch_sharding, shard_params
+
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        intermediate_dim=64, vocab_size=64, max_position_embeddings=128,
+        compute_dtype="float32", param_dtype="float32",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (2, 64)), jnp.int32)
+    seg = jnp.ones_like(ids)
+    pos = jnp.tile(jnp.arange(64)[None, :], (2, 1))
+
+    ref = forward(params, cfg, ids, seg, pos, attn_impl="reference")
+
+    mesh = _mesh("d1f1s2t1")  # Hq=4/Hkv=2 divide seq=2 -> auto -> ulysses
+    sh = batch_sharding(mesh)
+    sharded = forward(
+        shard_params(params, mesh), cfg,
+        jax.device_put(ids, sh), jax.device_put(seg, sh),
+        jax.device_put(pos, sh),
+        attn_impl="auto", mesh=mesh,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
