@@ -1,0 +1,109 @@
+"""PAL-style python answer execution for the offline eval harness.
+
+Role counterpart of the reference's evaluation/python_executor.py
+(GenericRuntime/PythonExecutor: run model-generated programs and take
+the return value / printed output as the answer, used by the 'pal' and
+'tora' prompt styles). Rebuilt on this repo's sandboxed-subprocess
+machinery instead of the reference's in-process exec() + ProcessPool:
+every candidate runs in a fresh subprocess under the same rlimit +
+os-neutering guard the code verifier uses (code_verify.py), so a
+malicious or runaway program cannot touch the evaluator process.
+
+Contract: extract the LAST fenced code block from the model output;
+if it defines `solution()`, call it and use the repr of the return
+value (PAL convention); otherwise run the block and use the last
+non-empty stdout line (tora convention). Returns None when there is no
+code block, execution fails, or nothing is produced.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from areal_tpu.functioncall.code_verify import (
+    extract_code_block,
+    run_one_case,
+)
+
+_SOLUTION_DRIVER = """
+if __name__ == "__main__":
+    _fn = globals().get("solution")
+    if _fn is not None:
+        _res = _fn()
+        print("\\n___PY_ANSWER___")
+        print(repr(_res) if not isinstance(_res, str) else _res)
+"""
+
+_MARKER = "___PY_ANSWER___"
+
+
+def _extract_candidate_code(text: str) -> Optional[str]:
+    """The program to run: the last COMPLETE fenced block when one
+    exists; otherwise the continuation of a fence the PROMPT opened —
+    the 'pal' template ends with '```python\\n', so a compliant
+    completion is bare code (optionally ending in a closing fence) with
+    no opening fence of its own. Prose-only text returns None."""
+    block = extract_code_block(text)
+    if block is not None:
+        return block
+    if "```" in text:
+        # Closing fence only: everything before it is the program.
+        return text.split("```", 1)[0]
+    # No fence at all (generation hit the token budget before closing):
+    # only accept it when it plausibly IS the program — a bare
+    # solution() definition — never arbitrary prose.
+    if "def solution" in text:
+        return text
+    return None
+
+
+def execute_python_answer(
+    text: str, timeout: float = 6.0,
+) -> Optional[str]:
+    """Run the candidate program in `text` (see
+    _extract_candidate_code); return its answer string or None."""
+    code = _extract_candidate_code(text)
+    if code is None:
+        return None
+    has_solution = "def solution" in code
+    if has_solution:
+        code = code + _SOLUTION_DRIVER
+    ok, stdout, _err = run_one_case(code, stdin_data="", timeout=timeout)
+    if not ok:
+        return None
+    if has_solution and _MARKER in stdout:
+        tail = stdout.rsplit(_MARKER, 1)[1].strip()
+        return tail.splitlines()[0].strip() if tail else None
+    lines = [ln.strip() for ln in stdout.splitlines() if ln.strip()]
+    return lines[-1] if lines else None
+
+
+def compare_python_answer(ans: Optional[str], reference) -> bool:
+    """Grade an already-executed answer against the reference(s) with
+    the math grader's rules, including \\boxed{} unboxing of solution-
+    form ground truth — the SAME reference normalization grade_answer
+    applies, so text and python modes score identically-stored data
+    identically."""
+    from areal_tpu.functioncall.math_grader import (
+        answers_equal,
+        extract_boxed,
+    )
+
+    if ans is None:
+        return False
+    refs = (
+        list(reference)
+        if isinstance(reference, (list, tuple, set))
+        else [reference]
+    )
+    refs = [
+        b if (b := extract_boxed(str(r))) is not None else r for r in refs
+    ]
+    return any(answers_equal(ans, str(r)) for r in refs)
+
+
+def grade_python_answer(text: str, reference, timeout: float = 6.0) -> bool:
+    """Execute the candidate program and grade its answer."""
+    return compare_python_answer(
+        execute_python_answer(text, timeout=timeout), reference
+    )

@@ -46,13 +46,19 @@ def evaluate_checkpoint(
     n_samples: int = 0,
     max_prompts: int = 0,
     seed: int = 1,
+    answer_mode: str = "text",
 ) -> dict:
     """benchmark= selects a preset (aime24/aime25/amc23/math500/gsm8k,
     see evaluation/presets.py) carrying the field mapping, prompt
     template, few-shot count, and sampling defaults; prompt_type=,
     num_shots=, max_new_tokens=, n_samples= override it. Without
     benchmark=, rows use the repo's prompt/solutions schema with the
-    prompt taken verbatim (the pre-round-5 behavior)."""
+    prompt taken verbatim (the pre-round-5 behavior).
+
+    answer_mode='text' extracts the answer from the generated text
+    (boxed / "answer is" / last number); answer_mode='python' executes
+    the generated program in a sandboxed subprocess and grades its
+    output (PAL style; pairs with prompt_type='pal')."""
     import jax
 
     from areal_tpu.api import data_api
@@ -76,6 +82,10 @@ def evaluate_checkpoint(
         raise ValueError(
             f"unknown benchmark {benchmark!r}; available: "
             f"{sorted(BENCHMARKS)}"
+        )
+    if answer_mode not in ("text", "python"):
+        raise ValueError(
+            f"answer_mode must be 'text' or 'python', got {answer_mode!r}"
         )
     preset = BENCHMARKS[benchmark] if benchmark else None
     if preset is not None:
@@ -147,14 +157,39 @@ def evaluate_checkpoint(
                 params, cfg, chunk, g, jax.random.fold_in(rng, i),
                 eos_token_id=tokenizer.eos_token_id,
             )
-            for j, o in enumerate(outs):
+            texts = [tokenizer.decode(o["output_ids"]) for o in outs]
+            if answer_mode == "python":
+                # PAL: run each generated program ONCE in its sandbox
+                # subprocess; the executed output is graded AND is the
+                # vote for maj@k. Candidates run concurrently — each
+                # non-terminating program burns its full timeout, and
+                # serializing those would dominate eval wall-clock.
+                from concurrent.futures import ThreadPoolExecutor
+
+                from areal_tpu.functioncall.python_answer import (
+                    execute_python_answer,
+                )
+
+                with ThreadPoolExecutor(max_workers=len(texts)) as pool:
+                    answers = list(pool.map(execute_python_answer, texts))
+            else:
+                answers = [None] * len(texts)
+            for j, text in enumerate(texts):
                 row = rows[i + j]
-                text = tokenizer.decode(o["output_ids"])
-                ok = grade_answer(text, row.get("solutions") or row.get("answers"))
+                refs = row.get("solutions") or row.get("answers")
+                if answer_mode == "python":
+                    from areal_tpu.functioncall.python_answer import (
+                        compare_python_answer,
+                    )
+
+                    ans = answers[j]
+                    ok = compare_python_answer(ans, refs)
+                else:
+                    ok = grade_answer(text, refs)
+                    ans = extract_answer(text)
                 n_correct += bool(ok)
                 qid = str(row.get("query_id", i + j))
                 per_prompt.append({"query_id": qid, "correct": bool(ok)})
-                ans = extract_answer(text)
                 by_prompt.setdefault(qid, []).append(
                     (normalize_answer(ans) if ans else None, bool(ok))
                 )
@@ -165,6 +200,7 @@ def evaluate_checkpoint(
         "data": data,
         "benchmark": benchmark or "none",
         "prompt_type": prompt_type or "verbatim",
+        "answer_mode": answer_mode,
         "num_shots": max(0, num_shots),
         "n_prompts": len(prompts),
         "n_samples": n_samples,

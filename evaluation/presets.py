@@ -97,6 +97,20 @@ PROMPT_TEMPLATES = {
         ),
         demo_sep="",
     ),
+    # PAL: the model writes a python program whose solution() returns
+    # the answer; math_eval answer_mode='python' executes it in the
+    # sandboxed subprocess (functioncall/python_answer.py — the role of
+    # the reference's evaluation/python_executor.py).
+    "pal": PromptTemplate(
+        name="pal",
+        question_format=(
+            "Question: {question}\n"
+            "Write a Python program that computes the answer; define "
+            "solution() returning it.\n\n```python\n"
+        ),
+        demo_format="Question: {question}\n{answer}",
+        demo_sep="\n---\n",
+    ),
     # DeepSeek-R1-Distill family markup with an opened think block (the
     # flagship bench model family; see docs/perf_notes.md).
     "r1-distill": PromptTemplate(
@@ -142,6 +156,28 @@ MATH_FEW_SHOT: List[Tuple[str, str]] = [
         "he plant?",
         "Dividing the seeds into rows of 14 gives 126 / 14 = 9 rows. "
         "The answer is 9.",
+    ),
+]
+
+
+# PAL-style demos: programs whose solution() returns the answer.
+PAL_FEW_SHOT: List[Tuple[str, str]] = [
+    (
+        "A bookshelf holds 4 rows of 9 books. If 7 books are checked "
+        "out, how many books remain on the shelf?",
+        "```python\n"
+        "def solution():\n"
+        "    total = 4 * 9\n"
+        "    return total - 7\n"
+        "```",
+    ),
+    (
+        "Tickets cost $12 for adults and $5 for children. What do 2 "
+        "adults and 3 children pay in total?",
+        "```python\n"
+        "def solution():\n"
+        "    return 2 * 12 + 3 * 5\n"
+        "```",
     ),
 ]
 
@@ -270,15 +306,17 @@ def load_benchmark(data_path: str, preset: BenchmarkPreset) -> List[dict]:
 
 def build_prompt(question: str, prompt_type: str, num_shots: int) -> str:
     template = PROMPT_TEMPLATES[prompt_type]
-    if num_shots > len(MATH_FEW_SHOT):
+    pool = PAL_FEW_SHOT if prompt_type == "pal" else MATH_FEW_SHOT
+    if num_shots > len(pool):
         # Refuse rather than silently truncate: the result metadata
         # records the REQUESTED shot count, and a published "8-shot"
         # number that actually ran 4-shot would misstate methodology.
         raise ValueError(
-            f"num_shots={num_shots} but only {len(MATH_FEW_SHOT)} "
-            f"few-shot demos are available (evaluation/presets.py)"
+            f"num_shots={num_shots} but only {len(pool)} few-shot "
+            f"demos are available for {prompt_type!r} "
+            f"(evaluation/presets.py)"
         )
-    shots = MATH_FEW_SHOT[:num_shots]
+    shots = pool[:num_shots]
     if "boxed" in prompt_type or prompt_type == "r1-distill":
         shots = boxed_shots(shots)
     return template.wrap(question, shots)

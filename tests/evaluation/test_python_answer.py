@@ -1,0 +1,93 @@
+"""PAL-style python answer execution (role of the reference's
+evaluation/python_executor.py): sandboxed run of model-written programs,
+answer extraction, grading, and the 'pal' prompt template."""
+
+import pytest
+
+from areal_tpu.functioncall.python_answer import (
+    execute_python_answer,
+    grade_python_answer,
+)
+
+
+def test_solution_function_return_value():
+    text = (
+        "Let me compute this.\n"
+        "```python\n"
+        "def solution():\n"
+        "    return 4 * 9 - 7\n"
+        "```"
+    )
+    assert execute_python_answer(text) == "29"
+    assert grade_python_answer(text, ["29"])
+    assert not grade_python_answer(text, ["28"])
+
+
+def test_print_style_last_line():
+    text = "```python\nx = 2 * 12 + 3 * 5\nprint('total:')\nprint(x)\n```"
+    assert execute_python_answer(text) == "39"
+
+
+def test_last_code_block_wins():
+    text = (
+        "First try:\n```python\nprint(1)\n```\n"
+        "Corrected:\n```python\nprint(2)\n```"
+    )
+    assert execute_python_answer(text) == "2"
+
+
+def test_no_code_block_and_failures():
+    assert execute_python_answer("The answer is 42.") is None
+    assert execute_python_answer("```python\n1/0\n```") is None
+    assert execute_python_answer("```python\npass\n```") is None
+    assert not grade_python_answer("no code here", ["1"])
+
+
+def test_runaway_program_times_out():
+    text = "```python\nwhile True:\n    pass\n```"
+    assert execute_python_answer(text, timeout=2.0) is None
+
+
+def test_fractional_and_expression_answers():
+    text = "```python\ndef solution():\n    return 15 * 2.5\n```"
+    assert grade_python_answer(text, ["37.5"])
+
+
+def test_pal_prompt_template_and_demos():
+    from evaluation.presets import PAL_FEW_SHOT, build_prompt
+
+    p = build_prompt("What is 6 * 7?", "pal", num_shots=2)
+    assert p.rstrip().endswith("```python")
+    assert PAL_FEW_SHOT[0][0] in p
+    # The demo programs themselves execute to the right answers.
+    assert execute_python_answer(PAL_FEW_SHOT[0][1]) == "29"
+    assert execute_python_answer(PAL_FEW_SHOT[1][1]) == "39"
+    # Over-asking demos fails loudly (pal pool has 2).
+    with pytest.raises(ValueError, match="few-shot"):
+        build_prompt("q", "pal", num_shots=3)
+
+
+def test_open_fence_continuation_extracted():
+    """The 'pal' template OPENS the fence in the prompt, so a compliant
+    completion is bare code ending with a closing fence — it must
+    execute, not fall through as 'no code block'."""
+    # Model continuation with closing fence only.
+    cont = "def solution():\n    return 4 * 9 - 7\n```\nThe answer is 29."
+    assert execute_python_answer(cont) == "29"
+    # Budget-truncated continuation: no fence at all.
+    cont2 = "def solution():\n    return 2 + 2\n"
+    assert execute_python_answer(cont2) == "4"
+    # Prose with no fence and no solution() stays rejected.
+    assert execute_python_answer("I think the answer is 4.") is None
+
+
+def test_boxed_reference_unboxed_in_python_mode():
+    """Solution-form ground truth ('\\boxed{4}') must grade the same in
+    python mode as grade_answer does in text mode."""
+    from areal_tpu.functioncall.python_answer import compare_python_answer
+
+    text = "```python\ndef solution():\n    return 4\n```"
+    assert grade_python_answer(text, ["\\boxed{4}"])
+    assert compare_python_answer("4", ["\\boxed{4}"])
+    assert not compare_python_answer("5", ["\\boxed{4}"])
+    assert not compare_python_answer(None, ["\\boxed{4}"])

@@ -288,3 +288,29 @@ def test_eval_and_aggregate_applies_preset(tiny_ckpt, tmp_path):
     )
     assert res["benchmark"] == "amc23"
     assert res["prompt_type"] == "boxed"
+
+
+def test_math_eval_python_answer_mode(tiny_ckpt, tmp_path):
+    """answer_mode='python' drives the PAL grading path e2e (the tiny
+    model emits no code block, so accuracy is 0 — the pipeline must
+    handle that gracefully, not crash)."""
+    from evaluation.math_eval import evaluate_checkpoint
+
+    _, ckpt = tiny_ckpt
+    rows = [{"problem": "What is 2 + 2?", "answer": "4"}]
+    data = tmp_path / "pal.jsonl"
+    data.write_text(json.dumps(rows[0]) + "\n")
+    res = evaluate_checkpoint(
+        ckpt=ckpt, data=str(data), benchmark="math500",
+        prompt_type="pal", num_shots=1, answer_mode="python",
+        max_new_tokens=8, n_samples=1,
+    )
+    assert res["answer_mode"] == "python"
+    assert res["prompt_type"] == "pal"
+    assert res["accuracy"] == 0.0
+
+    with pytest.raises(ValueError, match="answer_mode"):
+        evaluate_checkpoint(
+            ckpt=ckpt, data=str(data), benchmark="math500",
+            answer_mode="exec",
+        )
