@@ -376,9 +376,13 @@ def answers_equal(given: str, reference: str, tol: float = REL_TOL,
     return _sympy_equal(ng, nr)
 
 
-def grade_answer(solution_text: str, reference_answer: Any) -> bool:
-    """True if the final answer in `solution_text` matches the reference."""
-    ans = extract_answer(str(solution_text))
+def compare_answers(ans: Optional[str], reference_answer: Any) -> bool:
+    """Compare an already-extracted answer against the reference
+    answer(s): list coercion, \\boxed{} unboxing of solution-form
+    ground truth, and the equivalence rules of answers_equal. The ONE
+    reference-normalization rule — every grading mode (text, PAL
+    python execution) must route through it so identically-stored
+    ground truth scores identically."""
     if ans is None:
         return False
     if isinstance(reference_answer, (list, tuple, set)):
@@ -392,3 +396,10 @@ def grade_answer(solution_text: str, reference_answer: Any) -> bool:
         b if (b := extract_boxed(str(r))) is not None else r for r in refs
     ]
     return any(answers_equal(ans, r) for r in refs)
+
+
+def grade_answer(solution_text: str, reference_answer: Any) -> bool:
+    """True if the final answer in `solution_text` matches the reference."""
+    return compare_answers(
+        extract_answer(str(solution_text)), reference_answer
+    )

@@ -43,12 +43,24 @@ def _extract_candidate_code(text: str) -> Optional[str]:
     the 'pal' template ends with '```python\\n', so a compliant
     completion is bare code (optionally ending in a closing fence) with
     no opening fence of its own. Prose-only text returns None."""
+    import re
+
     block = extract_code_block(text)
     if block is not None:
         return block
-    if "```" in text:
-        # Closing fence only: everything before it is the program.
-        return text.split("```", 1)[0]
+    m = re.search(r"```(?:python|py)?[ \t]*\n?", text)
+    if m is not None:
+        # One unterminated fence (complete blocks were handled above).
+        # Opening or closing? A language tag, or nothing before it,
+        # means the model OPENED a fence and was truncated — the code
+        # is after. Otherwise the prompt opened the fence and this one
+        # closes it — the code is before.
+        tagged = text[m.start():m.end()].rstrip("\n \t") != "```"
+        before = text[: m.start()]
+        after = text[m.end():]
+        if (tagged or not before.strip()) and after.strip():
+            return after
+        return before
     # No fence at all (generation hit the token budget before closing):
     # only accept it when it plausibly IS the program — a bare
     # solution() definition — never arbitrary prose.
@@ -79,27 +91,12 @@ def execute_python_answer(
 
 
 def compare_python_answer(ans: Optional[str], reference) -> bool:
-    """Grade an already-executed answer against the reference(s) with
-    the math grader's rules, including \\boxed{} unboxing of solution-
-    form ground truth — the SAME reference normalization grade_answer
-    applies, so text and python modes score identically-stored data
-    identically."""
-    from areal_tpu.functioncall.math_grader import (
-        answers_equal,
-        extract_boxed,
-    )
+    """Grade an already-executed answer with the math grader's shared
+    reference-normalization rule (compare_answers), so text and python
+    modes score identically-stored ground truth identically."""
+    from areal_tpu.functioncall.math_grader import compare_answers
 
-    if ans is None:
-        return False
-    refs = (
-        list(reference)
-        if isinstance(reference, (list, tuple, set))
-        else [reference]
-    )
-    refs = [
-        b if (b := extract_boxed(str(r))) is not None else r for r in refs
-    ]
-    return any(answers_equal(ans, str(r)) for r in refs)
+    return compare_answers(ans, reference)
 
 
 def grade_python_answer(text: str, reference, timeout: float = 6.0) -> bool:

@@ -167,6 +167,7 @@ def evaluate_checkpoint(
                 from concurrent.futures import ThreadPoolExecutor
 
                 from areal_tpu.functioncall.python_answer import (
+                    compare_python_answer,
                     execute_python_answer,
                 )
 
@@ -178,10 +179,6 @@ def evaluate_checkpoint(
                 row = rows[i + j]
                 refs = row.get("solutions") or row.get("answers")
                 if answer_mode == "python":
-                    from areal_tpu.functioncall.python_answer import (
-                        compare_python_answer,
-                    )
-
                     ans = answers[j]
                     ok = compare_python_answer(ans, refs)
                 else:

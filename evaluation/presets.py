@@ -97,6 +97,15 @@ PROMPT_TEMPLATES = {
         ),
         demo_sep="",
     ),
+    # Multiple-choice (GPQA/MMLU-style rows whose question text already
+    # carries the lettered options): the boxed answer is the LETTER.
+    "boxed-choice": PromptTemplate(
+        name="boxed-choice",
+        question_format=(
+            "{question}\nPlease reason step by step, and put the letter "
+            "of the correct option within \\boxed{{}}.\n"
+        ),
+    ),
     # PAL: the model writes a python program whose solution() returns
     # the answer; math_eval answer_mode='python' executes it in the
     # sandboxed subprocess (functioncall/python_answer.py — the role of
@@ -270,6 +279,15 @@ BENCHMARKS = {
     "math500": BenchmarkPreset(
         name="math500", max_new_tokens=4096,
     ),
+    # GPQA-diamond-style multiple choice: the question field already
+    # carries the lettered options; ground truth is the letter.
+    "gpqa_diamond": BenchmarkPreset(
+        name="gpqa_diamond",
+        question_keys=("question", "problem", "prompt"),
+        answer_keys=("answer",),
+        prompt_type="boxed-choice",
+        max_new_tokens=2048,
+    ),
     "gsm8k": BenchmarkPreset(
         name="gsm8k",
         answer_fn=_gsm8k_gt,
@@ -306,7 +324,15 @@ def load_benchmark(data_path: str, preset: BenchmarkPreset) -> List[dict]:
 
 def build_prompt(question: str, prompt_type: str, num_shots: int) -> str:
     template = PROMPT_TEMPLATES[prompt_type]
-    pool = PAL_FEW_SHOT if prompt_type == "pal" else MATH_FEW_SHOT
+    if prompt_type == "pal":
+        pool = PAL_FEW_SHOT
+    elif prompt_type == "boxed-choice":
+        # No letter-answer demos exist; numeric math demos would
+        # contradict the boxed-LETTER instruction and bias the model —
+        # num_shots > 0 fails loudly via the length check below.
+        pool = []
+    else:
+        pool = MATH_FEW_SHOT
     if num_shots > len(pool):
         # Refuse rather than silently truncate: the result metadata
         # records the REQUESTED shot count, and a published "8-shot"

@@ -85,3 +85,18 @@ def test_preset_defaults_shape():
     assert BENCHMARKS["gsm8k"].prompt_type == "cot"
     for b in BENCHMARKS.values():
         assert b.prompt_type in PROMPT_TEMPLATES
+
+
+def test_gpqa_choice_preset():
+    """Multiple-choice preset: lettered options live in the question
+    text, ground truth is the letter, and a boxed letter grades true."""
+    from areal_tpu.functioncall.math_grader import grade_answer
+
+    preset = BENCHMARKS["gpqa_diamond"]
+    row = {"question": "Which is even?\n\nA. 3\nB. 4\nC. 5\nD. 7",
+           "answer": "B"}
+    assert preset.ground_truth(row) == "B"
+    p = build_prompt(preset.question(row), preset.prompt_type, 0)
+    assert "letter" in p
+    assert grade_answer("The even number is 4, so \\boxed{B}.", ["B"])
+    assert not grade_answer("\\boxed{A}", ["B"])

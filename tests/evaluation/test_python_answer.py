@@ -91,3 +91,19 @@ def test_boxed_reference_unboxed_in_python_mode():
     assert compare_python_answer("4", ["\\boxed{4}"])
     assert not compare_python_answer("5", ["\\boxed{4}"])
     assert not compare_python_answer(None, ["\\boxed{4}"])
+
+
+def test_model_opened_fence_truncated():
+    """A completion that opens its OWN tagged fence and is truncated
+    before closing it still yields the code (not the prose before)."""
+    text = "Here is the code:\n```python\ndef solution():\n    return 42"
+    assert execute_python_answer(text) == "42"
+    # Bare unterminated fence with nothing before it: code follows.
+    assert execute_python_answer("```\nprint(7)") == "7"
+
+
+def test_boxed_choice_rejects_few_shot():
+    from evaluation.presets import build_prompt
+
+    with pytest.raises(ValueError, match="few-shot"):
+        build_prompt("q", "boxed-choice", num_shots=1)
