@@ -25,6 +25,7 @@ decode block are compiled once per engine lifetime.
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional
 
 import jax
@@ -342,17 +343,55 @@ def paged_chunk_prefill(
 
     Returns (last_logits [V] — the final valid row's, for first-token
     sampling; meaningful only on the prompt's last chunk — k_pages,
-    v_pages)."""
+    v_pages).
+
+    The C rows run through paged_decode_step in sub-chunks: the TPU
+    paged-attention kernel prefetches its [rows, P] page_indices operand
+    into SMEM (~1 MB), so rows*P*4 bytes must stay well under that — at
+    C=2048 and a 16k-context pool (P~138) a single call is a guaranteed
+    compile-time SMEM overflow (measured on v5e: 1,130,496 B > 1,048,576).
+    Sub-chunks also keep logits at [sub, V] instead of [C, V] (268 MB at
+    C=2048, V=32k): only the selected last-valid row's logits leave the
+    scan."""
     C = tokens.shape[0]
-    rows = jnp.arange(C, dtype=jnp.int32)
-    lengths = start + rows
-    active = rows < valid_len
-    page_indices = jnp.broadcast_to(page_row, (C, page_row.shape[0]))
-    logits, k_pages, v_pages = paged_decode_step(
-        params, cfg, tokens, k_pages, v_pages, page_indices, lengths,
-        active, mesh=mesh, attn_impl=attn_impl,
+    P = page_row.shape[0]
+    # Half the 1 MB SMEM for the page-index operand; the rest holds the
+    # kernel's other prefetched scalars. AREAL_CHUNK_SMEM_BUDGET overrides
+    # for tests (forcing n_sub > 1 on CPU pools too small to need it);
+    # read at trace time, so set it before the first call in a process.
+    smem_budget = int(os.environ.get("AREAL_CHUNK_SMEM_BUDGET", 512 * 1024))
+    rows_cap = max(8, smem_budget // (P * 4))
+    # Balanced ceil-division with a padded tail, NOT a divisor search:
+    # any chunk size (prime included) splits into n_sub equal sub-chunks;
+    # pad rows sit past valid_len, so `active` masks them like any ragged
+    # tail. Balancing (n_sub first, then sub) minimizes the padding —
+    # sub=min(C,rows_cap) at C=2048/cap=949 would pad 799 wasted rows.
+    n_sub = -(-C // min(C, rows_cap))
+    sub = -(-C // n_sub)
+    pad = n_sub * sub - C
+    tokens = jnp.pad(tokens, (0, pad)) if pad else tokens
+    target = jnp.maximum(valid_len - 1, 0)
+
+    def body(carry, xs):
+        k_pages, v_pages, acc = carry
+        toks_s, base = xs
+        rows = base + jnp.arange(sub, dtype=jnp.int32)
+        lengths = start + rows
+        active = rows < valid_len
+        page_indices = jnp.broadcast_to(page_row, (sub, P))
+        logits, k_pages, v_pages = paged_decode_step(
+            params, cfg, toks_s, k_pages, v_pages, page_indices, lengths,
+            active, mesh=mesh, attn_impl=attn_impl,
+        )
+        sel = (rows == target).astype(logits.dtype)
+        acc = acc + jnp.einsum("r,rv->v", sel, logits)
+        return (k_pages, v_pages, acc), None
+
+    acc0 = jnp.zeros((cfg.vocab_size,), jnp.float32)
+    bases = (jnp.arange(n_sub, dtype=jnp.int32) * sub)
+    (k_pages, v_pages, last), _ = jax.lax.scan(
+        body, (k_pages, v_pages, acc0), (tokens.reshape(n_sub, sub), bases)
     )
-    last = logits[jnp.maximum(valid_len - 1, 0)]
     return last, k_pages, v_pages
 
 

@@ -92,6 +92,10 @@ class GenResult:
     version_start: int
     version_end: int
     latency: float = 0.0
+    # Set iff the engine's serve loop died before this request finished
+    # (e.g. an XLA compile error): outputs are empty/partial and the
+    # engine accepts no further submits.
+    error: Optional[str] = None
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -263,6 +267,9 @@ class ServingEngine:
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._backlog: List[GenRequest] = []  # engine-thread only
+        # Admit entries (slot, req, plen, pages, cached_use) currently
+        # inside _admit_impl — reachable by _fail_all on mid-admit death.
+        self._admit_inflight: List[Tuple[int, GenRequest, int, List[int], int]] = []
         self._lock = threading.Lock()
         self._interrupt = threading.Event()
         self._pending_params = None
@@ -282,6 +289,8 @@ class ServingEngine:
         self._applied_pinned = -1   # highest pinned version actually applied
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.fatal_error: Optional[BaseException] = None
+        self._fatal_lock = threading.Lock()
         # metrics
         self.n_running = 0
         self.n_used_tokens = 0
@@ -304,8 +313,16 @@ class ServingEngine:
             self._thread.join(timeout=10)
 
     def submit(self, req: GenRequest):
-        req.submit_time = time.monotonic()
-        self._queue.put(req)
+        # _fatal_lock closes the submit-vs-_fail_all race: without it a
+        # request enqueued between the fatal check and the queue drain
+        # would sit in the dead queue with no one to fire its done_cb.
+        with self._fatal_lock:
+            if self.fatal_error is not None:
+                raise RuntimeError(
+                    f"serving engine loop died: {self.fatal_error!r}"
+                ) from self.fatal_error
+            req.submit_time = time.monotonic()
+            self._queue.put(req)
 
     def is_stale_update(self, version: Optional[int]) -> bool:
         """True iff update_params(version=version) would drop the update
@@ -505,14 +522,22 @@ class ServingEngine:
 
     def _admit(self):
         """Fill free slots from the backlog with ONE batched prefill and
-        ONE fused device state update."""
+        ONE fused device state update. Thin wrapper: the in-flight admit
+        batch lives on the engine so _fail_all can reach requests that a
+        mid-admit prefill failure (e.g. an XLA compile error) would
+        otherwise strand in a dead stack frame."""
+        batch = self._admit_inflight
+        batch.clear()
+        self._admit_impl(batch)
+        batch.clear()  # normal completion: requests now live in _slot_req
+
+    def _admit_impl(self, batch):
         # Drain semantics for non-interrupting weight updates: stop
         # admitting so running requests finish and the swap can land.
         if self._pending_params is not None:
             return
         self._drain_queue()
         free = self._free_slots()
-        batch: List[Tuple[int, GenRequest, int, List[int], int]] = []
         # Chunked / cache-hit prefills run one prompt at a time on the
         # serve loop; admitting many long prompts in one lap would stall
         # decode for every running slot for the full sequential prefill.
@@ -603,7 +628,7 @@ class ServingEngine:
 
         long = [e for e in batch if _is_chunked(e)]
         short = [e for e in batch if not _is_chunked(e)]
-        batch = long + short
+        batch[:] = long + short  # in place: _admit_inflight keeps tracking
         logits_rows = [
             self._chunked_prefill_one(req.input_ids, pages, start=cu)
             for _, req, _, pages, cu in long
@@ -928,6 +953,53 @@ class ServingEngine:
             self._pt_dirty = False
 
     def _loop(self):
+        try:
+            self._serve()
+        except Exception as e:  # serve-loop death must not strand clients
+            self.fatal_error = e
+            logger.exception("serving engine loop died: %s", e)
+            self._fail_all(e)
+
+    def _fail_all(self, exc: BaseException):
+        """Deliver an error GenResult to every running + queued request so
+        callers blocked on done_cb unwind instead of hanging (measured
+        failure mode: a chunk-prefill XLA compile error left the 16k gen
+        probe waiting out its full 1800 s timeout)."""
+        msg = f"{type(exc).__name__}: {exc}"
+        reqs = [r for r in self._slot_req if r is not None]
+        self._slot_req = [None] * len(self._slot_req)
+        # _backlog holds requests _drain_queue accepted but couldn't admit
+        # yet (pool pressure / per-lap caps); _admit_inflight holds the
+        # batch a mid-admit prefill failure abandoned — both are engine-
+        # thread-only state, and the engine thread is dead by now. Dedup
+        # by identity: a request can be in _admit_inflight AND _slot_req
+        # if the failure hit partway through the slotting loop.
+        reqs.extend(self._backlog)
+        self._backlog.clear()
+        seen = {id(r) for r in reqs}
+        reqs.extend(e[1] for e in self._admit_inflight
+                    if id(e[1]) not in seen)
+        self._admit_inflight.clear()
+        with self._fatal_lock:  # no submit can interleave with the drain
+            while True:
+                try:
+                    reqs.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+        for req in reqs:
+            if req.done_cb:
+                try:
+                    req.done_cb(GenResult(
+                        qid=req.qid, output_ids=[], output_logprobs=[],
+                        no_eos=True, interrupted=True,
+                        version_start=self.version, version_end=self.version,
+                        latency=time.monotonic() - req.submit_time,
+                        error=msg,
+                    ))
+                except Exception:
+                    logger.exception("done_cb failed during _fail_all")
+
+    def _serve(self):
         self._ensure_pool()
         eos_global = jnp.asarray(self._eos_mask_np())
         n = self.block_steps

@@ -18,6 +18,7 @@ code block, execution fails, or nothing is produced.
 
 from __future__ import annotations
 
+import re
 from typing import Optional
 
 from areal_tpu.functioncall.code_verify import (
@@ -43,8 +44,6 @@ def _extract_candidate_code(text: str) -> Optional[str]:
     the 'pal' template ends with '```python\\n', so a compliant
     completion is bare code (optionally ending in a closing fence) with
     no opening fence of its own. Prose-only text returns None."""
-    import re
-
     block = extract_code_block(text)
     if block is not None:
         return block

@@ -148,6 +148,12 @@ class GenerationServer(Worker):
         )
         self.engine.submit(req)
         res = await fut
+        if res.error is not None:
+            # Serve-loop death: surface as a 500 so clients retry against
+            # another server instead of treating it as an empty completion.
+            return web.json_response(
+                {"qid": res.qid, "error": res.error}, status=500
+            )
         if res.interrupted:
             self._n_interrupted += 1
         return web.json_response(

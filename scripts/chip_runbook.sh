@@ -21,8 +21,8 @@
 
 set -u
 OUT="${OUT:-chip_results}"
-mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
+mkdir -p "$OUT"   # after the cd: relative OUT lands in the repo root
 
 echo "== 0. device probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
@@ -38,7 +38,8 @@ timeout 3000 python scripts/long_context_probe.py all \
 cat "$OUT/longctx.json" || true
 
 echo "== 3. on-chip flash-attn kernel parity =="
-timeout 1200 python -m pytest tests/model/test_flash_attn.py -q \
+AREAL_ONCHIP_TESTS=1 timeout 1200 python -m pytest \
+    tests/model/test_flash_attn.py -q \
     > "$OUT/flash_parity.log" 2>&1
 tail -2 "$OUT/flash_parity.log" || true
 

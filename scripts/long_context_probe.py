@@ -77,7 +77,7 @@ def train_step_flops(cfg, n_params, seqlens):
     return total
 
 
-def probe_train(seq_tokens: int):
+def probe_train(seq_tokens: int, remat: str = "save_attn"):
     from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
     from areal_tpu.engine.jax_engine import JaxTrainEngine
     from areal_tpu.engine.optimizer import OptimizerConfig
@@ -91,7 +91,7 @@ def probe_train(seq_tokens: int):
         optimizer_config=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
         total_train_steps=1000,
         row_len_multiple=seq_tokens, max_row_len=seq_tokens,
-        remat="save_attn",
+        remat=remat,
     )
     rng = np.random.RandomState(0)
     batch = SequenceSample.from_default(
@@ -128,7 +128,7 @@ def probe_train(seq_tokens: int):
     tflops = train_step_flops(cfg, n_params, [seq_tokens]) / dt / 1e12
     emit(metric=f"train_{seq_tokens//1024}k_tflops_per_chip",
          value=round(tflops, 2), unit="TFLOP/s",
-         step_s=round(dt, 3))
+         step_s=round(dt, 3), remat=remat)
     log(f"train {seq_tokens}: {dt:.3f}s/step {tflops:.1f} TFLOP/s")
     del eng
     import gc
@@ -170,7 +170,12 @@ def probe_gen(plen=16384, max_new=512):
         eng.submit(GenRequest(qid=qid, input_ids=list(ids),
                               max_new_tokens=new, done_cb=cb))
         assert done.wait(1800)
-        return holder["r"], time.perf_counter() - t0
+        res = holder["r"]
+        if res.error is not None:
+            # Engine crash delivered via _fail_all: surface it as a
+            # phase failure, never as a 0.0 tok/s "measurement".
+            raise RuntimeError(f"gen engine died: {res.error}")
+        return res, time.perf_counter() - t0
 
     prompt = rng.randint(0, cfg.vocab_size, size=plen).tolist()
     # warmup compiles (chunk prefill + decode block)
@@ -216,30 +221,39 @@ def probe_sort_skip(B=32, plen=512, new=256):
             kv_pool_tokens=B * (plen + new + 128),
         )
         eng.start()
-        done = threading.Event()
-        got = []
 
-        def cb(res):
-            got.append(len(res.output_ids))
-            if len(got) == B:
-                done.set()
+        def one_pass(tag):
+            ev = threading.Event()
+            results = []
 
-        # warmup
-        wd = threading.Event()
-        eng.submit(GenRequest(qid="w", input_ids=rng.randint(
-            0, cfg.vocab_size, size=plen).tolist(), max_new_tokens=64,
-            done_cb=lambda r: wd.set(), **sample_kw))
-        assert wd.wait(1800)
-        t0 = time.perf_counter()
-        for i in range(B):
-            eng.submit(GenRequest(
-                qid=f"{label}{i}",
-                input_ids=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
-                max_new_tokens=new, done_cb=cb, **sample_kw))
-        assert done.wait(1800)
-        dt = time.perf_counter() - t0
+            def cb(res):
+                results.append(res)
+                if len(results) == B:
+                    ev.set()
+
+            t0 = time.perf_counter()
+            for i in range(B):
+                eng.submit(GenRequest(
+                    qid=f"{tag}{i}",
+                    input_ids=rng.randint(
+                        0, cfg.vocab_size, size=plen).tolist(),
+                    max_new_tokens=new,
+                    done_cb=cb, **sample_kw))
+            assert ev.wait(1800)
+            errs = [r.error for r in results if r.error is not None]
+            if errs:
+                raise RuntimeError(f"gen engine died: {errs[0]}")
+            dt = time.perf_counter() - t0
+            return sum(len(r.output_ids) for r in results), dt
+
+        # Full-shape warmup pass: the FIRST engine in the process pays
+        # every batched-prefill/admit compile the second gets from the
+        # in-process jit cache — a single-request warmup left ~18 s of
+        # compile inside the first timed pass (measured: greedy "0.16x").
+        one_pass("w")
+        toks, dt = one_pass(label)
         eng.stop()
-        return sum(got) / dt
+        return toks / dt
 
     tps_greedy = run("g", greedy=True)
     tps_sorted = run("s", top_k=50, top_p=0.95, temperature=1.0)
@@ -322,14 +336,41 @@ def main():
     if platform != "tpu":
         log("WARNING: not on TPU; numbers are not meaningful")
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+
+    def guarded(name, fn, *a, **kw):
+        """One phase OOMing (32k on a 16 GB v5e) must not cost the rest
+        of the run its banked numbers."""
+        try:
+            fn(*a, **kw)
+        except Exception as e:
+            log(f"{name}: FAILED {type(e).__name__}: {e}")
+            emit(metric=name, error=f"{type(e).__name__}: {e}"[:200])
+            # The failed phase's engine/optimizer buffers sit in
+            # reference cycles; reclaim their HBM before the next phase
+            # compiles, or the OOM cascades into it.
+            import gc
+
+            gc.collect()
+
     if which in ("all", "train16k"):
-        probe_train(16384)
+        guarded("train16k", probe_train, 16384)
     if which in ("all", "train32k"):
-        probe_train(32768)
+        # save_attn at 32k does not fit one v5e (16 GB) next to fp32 Adam
+        # state; full remat trades ~30% step time for the activation HBM.
+        remat = sys.argv[2] if which == "train32k" and len(sys.argv) > 2 \
+            else "full"
+        guarded("train32k", probe_train, 32768, remat=remat)
+    if (which.startswith("train")
+            and which not in ("train16k", "train32k")
+            and which[len("train"):].isdigit()):
+        # e.g. `train24576 full` — largest-context search on one chip.
+        toks = int(which[len("train"):])
+        remat = sys.argv[2] if len(sys.argv) > 2 else "full"
+        guarded(which, probe_train, toks, remat=remat)
     if which in ("all", "gen"):
-        probe_gen()
+        guarded("gen16k", probe_gen)
     if which in ("all", "sortskip"):
-        probe_sort_skip()
+        guarded("sortskip", probe_sort_skip)
     if which == "cp":
         # Needs a multi-device allotment: run e.g.
         #   python scripts/long_context_probe.py cp d1f1s2t1,d1f1s4t1 16384

@@ -18,7 +18,11 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("AREAL_ONCHIP_TESTS"):
+    # AREAL_ONCHIP_TESTS=1 keeps the real platform so the compiled-kernel
+    # parity gates (e.g. test_splash_compiled_matches_reference_on_tpu)
+    # can run on hardware; everything else pins the virtual CPU mesh.
+    jax.config.update("jax_platforms", "cpu")
 
 import uuid
 

@@ -132,3 +132,52 @@ def test_chunked_engine_matches_batched_engine(model):
     assert base.keys() == chunked.keys()
     for q in base:
         assert base[q] == chunked[q], f"{q} diverged"
+
+
+def test_chunk_prefill_multi_subchunk_matches_batched(model, monkeypatch):
+    """Force the SMEM-cap scan path (n_sub > 1): CPU pools are far too
+    small to trip the real 512 KB budget, so shrink it until a 16-row
+    chunk splits into two 8-row sub-chunks and pin the same last-logits
+    identity as the single-call path (the on-chip failure this guards:
+    [C, P] page-index prefetch overflowing the 1 MB SMEM at 16k ctx)."""
+    cfg, params = model
+    monkeypatch.setenv("AREAL_CHUNK_SMEM_BUDGET", "256")  # rows_cap -> 8
+    paged_chunk_prefill.clear_cache()  # env is read at trace time
+    try:
+        rng = np.random.RandomState(7)
+        plen = 50
+        ids = rng.randint(0, cfg.vocab_size, size=plen)
+
+        pad = 64
+        row = np.zeros((1, pad), np.int32)
+        row[0, :plen] = ids
+        ref_last, _, _ = _prefill_batch(
+            params, cfg, jnp.asarray(row), jnp.asarray([plen], np.int32),
+            pad_len=pad,
+        )
+
+        page = 16
+        n_pages_needed = (plen + page - 1) // page
+        n_pool = n_pages_needed + 2
+        kp = jnp.zeros(
+            (cfg.n_layers, cfg.n_kv_heads, n_pool, page, cfg.head_dim),
+            jnp.float32,
+        )
+        vp = jnp.zeros_like(kp)
+        prow = np.zeros((8,), np.int32)
+        prow[:n_pages_needed] = 1 + np.arange(n_pages_needed)
+        C = 16  # splits into 2 sub-chunks of 8 under the tiny budget
+        last = None
+        for s0 in range(0, plen, C):
+            seg = ids[s0 : s0 + C]
+            toks = np.zeros((C,), np.int32)
+            toks[: len(seg)] = seg
+            last, kp, vp = paged_chunk_prefill(
+                params, cfg, jnp.asarray(toks), kp, vp, jnp.asarray(prow),
+                jnp.asarray(s0, jnp.int32), jnp.asarray(len(seg), jnp.int32),
+            )
+        np.testing.assert_allclose(
+            np.asarray(last), np.asarray(ref_last[0]), rtol=2e-4, atol=2e-4
+        )
+    finally:
+        paged_chunk_prefill.clear_cache()  # don't leak the tiny-budget trace

@@ -231,3 +231,105 @@ def test_chunked_prefill_per_lap_cap(params):
         assert all(len(r.output_ids) == 8 for r in results.values())
     finally:
         eng.stop()
+
+
+def test_serve_loop_death_fails_pending_requests(params):
+    """A serve-loop crash (e.g. an XLA compile error on chip) must deliver
+    error results to blocked clients and reject new submits — not strand
+    callers until their timeout (serving.ServingEngine._fail_all)."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=128,
+        decode_block_steps=4, prompt_bucket=8, eos_token_id=None, seed=0,
+    )
+    boom = RuntimeError("injected serve-loop failure")
+
+    def exploding_admit():
+        raise boom
+
+    eng._admit = exploding_admit
+    try:
+        done = threading.Event()
+        holder = {}
+
+        def cb(res):
+            holder["r"] = res
+            done.set()
+
+        # Submit BEFORE start: once the loop starts it dies within
+        # milliseconds, and a post-start submit would race it (raising
+        # the fatal-error RuntimeError instead of receiving the error
+        # callback — both are valid client outcomes, but only this
+        # ordering deterministically exercises the callback path).
+        eng.submit(GenRequest(qid="dead", input_ids=[7, 11, 13],
+                              max_new_tokens=8, done_cb=cb))
+        eng.start()
+        assert done.wait(30), "client hung after serve-loop death"
+        res = holder["r"]
+        assert res.error is not None and "injected" in res.error
+        assert res.output_ids == [] and res.interrupted and res.no_eos
+        assert eng.fatal_error is boom
+        with pytest.raises(RuntimeError, match="serving engine loop died"):
+            eng.submit(GenRequest(qid="after", input_ids=[7],
+                                  max_new_tokens=1))
+    finally:
+        eng.stop()
+
+
+def test_fail_all_drains_backlog(params):
+    """_fail_all must fail backlogged requests too (accepted by
+    _drain_queue but not yet admitted — e.g. under pool pressure), not
+    just slot-resident and still-queued ones."""
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=128,
+        decode_block_steps=4, prompt_bucket=8, eos_token_id=None, seed=0,
+    )
+    got = {}
+    req = GenRequest(qid="bk", input_ids=[7, 11], max_new_tokens=4,
+                     done_cb=lambda r: got.update({r.qid: r}))
+    req.submit_time = time.monotonic()
+    eng._backlog.append(req)
+    eng._fail_all(RuntimeError("dead"))
+    assert "bk" in got and got["bk"].error is not None
+    assert eng._backlog == []
+
+
+def test_fail_all_reaches_mid_admit_requests(params):
+    """A prefill failure INSIDE _admit (the XLA-compile-error window)
+    must fail the very request being admitted — it lives only in the
+    in-flight admit batch at that point, not in _slot_req/_backlog/_queue
+    (serving.ServingEngine._admit_inflight)."""
+    import queue as _q
+
+    eng = ServingEngine(
+        CFG, params, max_batch_size=2, max_seq_len=128,
+        decode_block_steps=4, prompt_bucket=8, eos_token_id=None, seed=0,
+    )
+    boom = RuntimeError("mid-admit prefill failure")
+
+    def exploding_impl(batch):
+        while True:
+            try:
+                r = eng._queue.get_nowait()
+            except _q.Empty:
+                break
+            batch.append((0, r, len(r.input_ids), [], 0))
+        if batch:
+            raise boom
+
+    eng._admit_impl = exploding_impl
+    eng.start()
+    try:
+        done = threading.Event()
+        holder = {}
+
+        def cb(res):
+            holder["r"] = res
+            done.set()
+
+        eng.submit(GenRequest(qid="mid", input_ids=[7, 11, 13],
+                              max_new_tokens=8, done_cb=cb))
+        assert done.wait(30), "mid-admit request stranded after loop death"
+        assert holder["r"].error is not None
+        assert eng._admit_inflight == []
+    finally:
+        eng.stop()

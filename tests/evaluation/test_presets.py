@@ -100,3 +100,10 @@ def test_gpqa_choice_preset():
     assert "letter" in p
     assert grade_answer("The even number is 4, so \\boxed{B}.", ["B"])
     assert not grade_answer("\\boxed{A}", ["B"])
+
+
+def test_boxed_choice_rejects_few_shot():
+    from evaluation.presets import build_prompt
+
+    with pytest.raises(ValueError, match="few-shot"):
+        build_prompt("q", "boxed-choice", num_shots=1)

@@ -100,10 +100,3 @@ def test_model_opened_fence_truncated():
     assert execute_python_answer(text) == "42"
     # Bare unterminated fence with nothing before it: code follows.
     assert execute_python_answer("```\nprint(7)") == "7"
-
-
-def test_boxed_choice_rejects_few_shot():
-    from evaluation.presets import build_prompt
-
-    with pytest.raises(ValueError, match="few-shot"):
-        build_prompt("q", "boxed-choice", num_shots=1)
