@@ -16,6 +16,7 @@ and per-token positions; attention is masked to (same segment) AND
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -131,9 +132,18 @@ def _splash_kernel(t: int, group: int, interpret: bool = False):
 
     # Block sizes must divide the sequence length (packed rows are
     # padded to multiples of 128, so t is often e.g. 640 or 1536).
-    bq = _largest_block(t, 512)
-    bkv = _largest_block(t, 1024)
-    bkvc = _largest_block(bkv, 512)
+    # Targets are overridable for on-chip tuning (scripts/mfu_sweep.py);
+    # read at trace time, so a fresh jit per setting picks them up.
+    def target(name, default):
+        v = int(os.environ.get(name, default))
+        if v < LANES:
+            raise ValueError(f"{name}={v}: splash block targets must be "
+                             f">= {LANES}")
+        return v
+
+    bq = _largest_block(t, target("AREAL_SPLASH_BQ", 512))
+    bkv = _largest_block(t, target("AREAL_SPLASH_BKV", 1024))
+    bkvc = _largest_block(bkv, target("AREAL_SPLASH_BKVC", 512))
     bs = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,

@@ -9,6 +9,7 @@ collectives the hand-written vocab-parallel CE performs.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -93,9 +94,17 @@ def fused_next_token_logprobs(
     R, T, D = hidden.shape
     V = head_w.shape[-1]
     if chunk_size is None:
-        # Byte-budgeted: keep the per-chunk fp32 logits tile ~512 MB
-        # regardless of vocab size (C*V elements), floor 256 tokens.
-        chunk_size = max(256, (1 << 27) // V)
+        env = os.environ.get("AREAL_CE_CHUNK")
+        if env:
+            # Sweep override (scripts/mfu_sweep.py): read at trace time,
+            # so a fresh engine/jit per setting picks it up.
+            chunk_size = int(env)
+            if chunk_size <= 0:
+                raise ValueError(f"AREAL_CE_CHUNK={env}: must be positive")
+        else:
+            # Byte-budgeted: keep the per-chunk fp32 logits tile ~512 MB
+            # regardless of vocab size (C*V elements), floor 256 tokens.
+            chunk_size = max(256, (1 << 27) // V)
     next_ids, valid = _next_token_targets(input_ids, segment_ids)
     n = R * T
     c = _pick_chunk(n, chunk_size)

@@ -146,7 +146,14 @@ class GenerationServer(Worker):
             stop_token_ids=tuple(g.get("stop_token_ids", [])),
             done_cb=done_cb,
         )
-        self.engine.submit(req)
+        try:
+            self.engine.submit(req)
+        except RuntimeError as e:
+            # Fail-fast path: the serve loop already died; keep the same
+            # JSON error contract as the in-flight res.error branch below.
+            return web.json_response(
+                {"qid": req.qid, "error": str(e)}, status=500
+            )
         res = await fut
         if res.error is not None:
             # Serve-loop death: surface as a 500 so clients retry against

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Tunnel watcher: probe the TPU tunnel every 2 minutes; when it answers,
+# run the banked-perf sequence (bench + MFU sweep + long-context probes +
+# on-chip kernel parity) and record everything under /tmp/r5_chip/.
+# The tunnel flaps, so each step re-probes and the bench gets one retry.
+# Exits after the full sequence completes once, or after MAX_WAIT_S.
+set -u
+OUT=/tmp/r5_chip
+mkdir -p "$OUT"
+MAX_WAIT_S=${MAX_WAIT_S:-36000}
+START=$(date +%s)
+probe() {
+  timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1
+}
+log() { echo "[$(date +%H:%M:%S)] $*" >> "$OUT/watch.log"; }
+wait_up() {
+  while true; do
+    now=$(date +%s)
+    if (( now - START > MAX_WAIT_S )); then
+      log "gave up after ${MAX_WAIT_S}s"
+      exit 1
+    fi
+    if probe; then log "tunnel UP"; return 0; fi
+    log "tunnel down"
+    sleep 120
+  done
+}
+run_step() {  # name, timeout_s, cmd...
+  local name=$1 tmo=$2; shift 2
+  log "step $name: $*"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  log "step $name done rc=$rc"
+  return $rc
+}
+log "watcher started"
+cd /root/repo
+wait_up
+run_step bench 3000 python bench.py || { wait_up; run_step bench2 3000 python bench.py; }
+wait_up; run_step sweep_blocks 3000 python scripts/mfu_sweep.py blocks
+wait_up; run_step sweep_ce 2400 python scripts/mfu_sweep.py ce
+wait_up; run_step probe_t16k 1800 python scripts/long_context_probe.py train16k
+wait_up; run_step probe_t32k 2400 python scripts/long_context_probe.py train32k
+wait_up; run_step probe_gen 2400 python scripts/long_context_probe.py gen
+wait_up; run_step probe_sortskip 2400 python scripts/long_context_probe.py sortskip
+wait_up; run_step flash_parity 1800 python -m pytest tests/model/test_flash_attn.py -q --no-header
+wait_up; run_step sweep_mbs 2400 python scripts/mfu_sweep.py mbs
+log "sequence complete"
