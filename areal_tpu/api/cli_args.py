@@ -247,6 +247,15 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "resubmissions prefill only the delta (None disables)"
         },
     )
+    # KV pool precision on the generation servers.
+    gen_kv_cache_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "KV pool precision: None/'model' stores the compute "
+            "dtype; 'int8' stores quantized pages (half the decode HBM "
+            "traffic, double the tokens per pool budget)"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

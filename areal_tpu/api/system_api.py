@@ -145,6 +145,10 @@ class GenerationServerConfig:
     # resubmission extending a parked sequence prefills only the delta —
     # the radix-cache role for partial-rollout chunking.
     prefix_cache_tokens: Optional[int] = None
+    # KV pool precision: None/"model" stores the compute dtype; "int8"
+    # stores quantized (data, scales) pages — half the decode HBM
+    # traffic, double the tokens per pool budget (engine/paged.py).
+    kv_cache_dtype: Optional[str] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

@@ -47,6 +47,50 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
     return max(1, -(-n_tokens // page_size))
 
 
+# ----------------------------------------------------------------------
+# int8 KV pools
+# ----------------------------------------------------------------------
+#
+# With kv_cache_dtype="int8" a pool is a (data, scales) pair instead of a
+# bare array: data [L, Hkv, N, pg, hd] int8, scales [L, Hkv, N, pg, 1]
+# f32 — per-token-per-head absmax over the head dim (the QuantizedTensor
+# layout of jax's paged-attention kernel). Decode is HBM-bandwidth-bound
+# streaming KV pages, so int8 halves the pool's resident bytes — double
+# the tokens-in-flight a pool budget holds (fewer preempt/resubmit
+# cycles at 16-32k contexts) — and halves the gathered bytes on the XLA
+# attention path. NOTE the stock Pallas kernel is NOT the fast path for
+# int8: it broadcasts the scales to full head_dim in f32 before
+# pallas_call (paged_attention_kernel.py:421-431), materializing 2x the
+# bf16 pool per call, so 'auto' keeps quantized pools on the XLA path
+# (see paged_decode_attention). A from-scratch kernel streaming
+# [.., pg, 1] scales is the follow-up. The reference's serving backend
+# has no KV quantization (realhf/impl/model/backend/sglang.py). Pools
+# stay plain arrays when not quantized; every helper accepts both.
+
+KV_INT8_MAX = 127.5  # kernel dequant is x * scale / 127.5
+
+
+def kv_pool_data(pool) -> jnp.ndarray:
+    """The data leaf of a pool (bare array, or (data, scales) pair)."""
+    return pool[0] if isinstance(pool, tuple) else pool
+
+
+def quantize_kv(x: jnp.ndarray):
+    """[..., hd] float -> (int8 [..., hd], f32 scales [..., 1]).
+
+    Matches the kernel's from_int8 dequant (w * s / 127.5). The exact-max
+    element clips to 127 (~0.4% error on that single element) instead of
+    wrapping at rint(127.5) = 128."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1, keepdims=True), 1e-6)
+    w = jnp.clip(jnp.rint(x32 * (KV_INT8_MAX / s)), -127, 127)
+    return w.astype(jnp.int8), s
+
+
+def dequantize_kv(w: jnp.ndarray, s: jnp.ndarray, dtype) -> jnp.ndarray:
+    return (w.astype(jnp.float32) * (s / KV_INT8_MAX)).astype(dtype)
+
+
 class PageAllocator:
     """Host-side free-list allocator over the pool's page indices.
 
@@ -101,15 +145,27 @@ def _pages_per_compute_block(pages_per_seq: int, cap: int = 8) -> int:
 def _paged_attention_xla(q, k_pages, v_pages, lengths, page_indices, scale):
     """Gather + masked softmax oracle/fallback.
 
-    q: [B, Hq, hd]; k/v_pages: [Hkv, N, pg, hd]; lengths: [B] valid tokens
-    (INCLUDING the one written this step); page_indices: [B, P]."""
+    q: [B, Hq, hd]; k/v_pages: [Hkv, N, pg, hd] (or int8 (data, scales)
+    pairs — gathered quantized, dequantized after the gather so the bytes
+    moved stay halved); lengths: [B] valid tokens (INCLUDING the one
+    written this step); page_indices: [B, P]."""
     B, Hq, hd = q.shape
-    Hkv, _, pg, _ = k_pages.shape
+    Hkv, _, pg, _ = kv_pool_data(k_pages).shape
     P = page_indices.shape[1]
     group = Hq // Hkv
-    # [Hkv, B, P, pg, hd] -> [B, P*pg, Hkv, hd]
-    k = k_pages[:, page_indices].transpose(1, 2, 3, 0, 4).reshape(B, P * pg, Hkv, hd)
-    v = v_pages[:, page_indices].transpose(1, 2, 3, 0, 4).reshape(B, P * pg, Hkv, hd)
+
+    def gather(pool):
+        # [Hkv, B, P, pg, hd] -> [B, P*pg, Hkv, hd]
+        if isinstance(pool, tuple):
+            d, s = pool
+            g = dequantize_kv(d[:, page_indices], s[:, page_indices],
+                              jnp.float32)
+        else:
+            g = pool[:, page_indices]
+        return g.transpose(1, 2, 3, 0, 4).reshape(B, P * pg, Hkv, hd)
+
+    k = gather(k_pages)
+    v = gather(v_pages)
     qg = q.reshape(B, Hkv, group, hd).astype(jnp.float32)
     scores = jnp.einsum("bhgd,bshd->bhgs", qg, k.astype(jnp.float32)) * scale
     pos = jnp.arange(P * pg)[None, :]
@@ -136,9 +192,12 @@ def paged_decode_attention(
     allow). With a mesh whose `tensor` axis is >1, the Pallas kernel runs
     under shard_map with heads sharded on `tensor` (pallas_call is opaque
     to the SPMD partitioner — same treatment as sharded_splash_attention,
-    ops/attention.py)."""
+    ops/attention.py). int8 (data, scales) pools flow to the kernel as
+    QuantizedTensor (fused dequant in VMEM) and to the XLA path as a
+    gather-then-dequantize."""
     B, Hq, hd = q.shape
-    Hkv, _, pg, _ = k_pages.shape
+    quantized = isinstance(k_pages, tuple)
+    Hkv, _, pg, _ = kv_pool_data(k_pages).shape
     P = page_indices.shape[1]
     scale = float(softmax_scale) if softmax_scale is not None else hd**-0.5
     tensor_size = mesh.shape.get("tensor", 1) if mesh is not None else 1
@@ -149,9 +208,18 @@ def paged_decode_attention(
     tp_ok = Hkv % tensor_size == 0 and Hq % tensor_size == 0
     if impl == "auto":
         on_tpu = jax.default_backend() in ("tpu", "axon")
+        # int8 pools do NOT auto-pick the stock kernel: upstream
+        # paged_attention broadcasts the [.., pg, 1] scales to full
+        # head_dim in f32 before pallas_call (jax .../paged_attention_
+        # kernel.py:421-431), materializing 2x the bf16 pool's bytes in
+        # HBM per call and streaming 4 B/elem of scales — inverting the
+        # bandwidth win. The XLA path gathers int8 (half the gathered
+        # bytes) and dequantizes after. impl='kernel' stays available
+        # for an explicit A/B.
         impl = (
             "kernel"
             if on_tpu and paged_attention_kernel_ok(pg, hd, P) and tp_ok
+            and not quantized
             else "xla"
         )
     elif impl == "kernel" and not tp_ok:
@@ -164,12 +232,20 @@ def paged_decode_attention(
 
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention_kernel as pak,
+        quantization_utils as pqu,
     )
 
     ppcb = _pages_per_compute_block(P)
-    qs = (q * jnp.asarray(scale, q.dtype)).astype(k_pages.dtype)
+    # int8 pools: q stays in its float dtype (the kernel dequantizes KV
+    # to bf16 in VMEM); otherwise match the pool dtype as before.
+    qs = q * jnp.asarray(scale, q.dtype)
+    if not quantized:
+        qs = qs.astype(k_pages.dtype)
 
     def kernel(qq, kk, vv, ll, pi):
+        if isinstance(kk, tuple):
+            kk = pqu.QuantizedTensor(*kk)
+            vv = pqu.QuantizedTensor(*vv)
         return pak.paged_attention(
             qq, kk, vv, ll, pi, pages_per_compute_block=ppcb
         )
@@ -179,13 +255,16 @@ def paged_decode_attention(
         from jax.sharding import PartitionSpec as Pt
         from jax import shard_map
 
+        pool_spec = Pt("tensor", None, None, None)
+        if quantized:  # spec subtree mirrors the (data, scales) pair
+            pool_spec = (pool_spec, Pt("tensor", None, None, None))
         out = shard_map(
             kernel,
             mesh=mesh,
             in_specs=(
                 Pt(None, "tensor", None),
-                Pt("tensor", None, None, None),
-                Pt("tensor", None, None, None),
+                pool_spec,
+                pool_spec,
                 Pt(None),
                 Pt(None, None),
             ),
@@ -233,8 +312,15 @@ def _paged_decode_layer(
     # Scatter the new token's K/V into its page. [Hkv, B, hd] values at
     # (page w_pidx[b], offset w_off[b]) per slot; allocator guarantees
     # active slots' pages are distinct, trash collisions are harmless.
-    kp_l = kp_l.at[:, w_pidx, w_off].set(k.transpose(1, 0, 2).astype(kp_l.dtype))
-    vp_l = vp_l.at[:, w_pidx, w_off].set(v.transpose(1, 0, 2).astype(vp_l.dtype))
+    def scatter(pool, val_t):  # val_t: [Hkv, B, hd]
+        if isinstance(pool, tuple):
+            w, s = quantize_kv(val_t)
+            return (pool[0].at[:, w_pidx, w_off].set(w),
+                    pool[1].at[:, w_pidx, w_off].set(s))
+        return pool.at[:, w_pidx, w_off].set(val_t.astype(pool.dtype))
+
+    kp_l = scatter(kp_l, k.transpose(1, 0, 2))
+    vp_l = scatter(vp_l, v.transpose(1, 0, 2))
     out = paged_decode_attention(
         q, kp_l, vp_l, lengths + 1, page_indices, mesh=mesh, impl=attn_impl
     )
@@ -261,7 +347,7 @@ def paged_decode_step(
     lengths: [B] fill BEFORE this token; active: [B] bool (inactive slots'
     writes are routed to the trash page). Returns (logits, pools)."""
     cdt = jnp.dtype(cfg.compute_dtype)
-    pg = k_pages.shape[3]
+    pg = kv_pool_data(k_pages).shape[3]
     B = tokens.shape[0]
     w_pidx = jnp.where(
         active,
@@ -406,23 +492,30 @@ def scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat_page_ids):
 
     k_pref/v_pref: [L, n, pad, Hkv, hd] from the packed forward;
     flat_page_ids: [n * pad//pg] pool pages in row-major (row, chunk)
-    order, TRASH_PAGE for chunks past a row's allocation."""
+    order, TRASH_PAGE for chunks past a row's allocation. int8 pools
+    quantize each token's head vector before the scatter."""
     L, n, pad, Hkv, hd = k_pref.shape
-    pg = k_pages.shape[3]
+    pg = kv_pool_data(k_pages).shape[3]
     n_chunks = pad // pg
 
     def to_chunks(pref):
-        # [L, n, pad, Hkv, hd] -> [L, Hkv, n*chunks, pg, hd]
-        x = pref.transpose(0, 3, 1, 2, 4).reshape(L, Hkv, n, n_chunks, pg, hd)
-        return x.reshape(L, Hkv, n * n_chunks, pg, hd)
+        # [L, n, pad, Hkv, x] -> [L, Hkv, n*chunks, pg, x]
+        x = pref.shape[-1]
+        out = pref.transpose(0, 3, 1, 2, 4).reshape(
+            L, Hkv, n, n_chunks, pg, x
+        )
+        return out.reshape(L, Hkv, n * n_chunks, pg, x)
 
-    k_pages = k_pages.at[:, :, flat_page_ids].set(
-        to_chunks(k_pref).astype(k_pages.dtype)
-    )
-    v_pages = v_pages.at[:, :, flat_page_ids].set(
-        to_chunks(v_pref).astype(v_pages.dtype)
-    )
-    return k_pages, v_pages
+    def write(pool, pref):
+        if isinstance(pool, tuple):
+            w, s = quantize_kv(pref)
+            return (pool[0].at[:, :, flat_page_ids].set(to_chunks(w)),
+                    pool[1].at[:, :, flat_page_ids].set(to_chunks(s)))
+        return pool.at[:, :, flat_page_ids].set(
+            to_chunks(pref).astype(pool.dtype)
+        )
+
+    return write(k_pages, k_pref), write(v_pages, v_pref)
 
 
 # ----------------------------------------------------------------------

@@ -40,6 +40,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import os
 import queue
 import threading
 import time
@@ -173,6 +174,7 @@ class ServingEngine:
         prefill_chunk: Optional[int] = None,
         chunked_prefill_per_lap: int = 2,
         prefix_cache_tokens: Optional[int] = None,
+        kv_cache_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
         # Sampled token ids round-trip through float32 in the packed
@@ -229,6 +231,19 @@ class ServingEngine:
         self.attn_impl = attn_impl
         self.version = 0
 
+        # KV pool precision: None/"model" stores the compute dtype;
+        # "int8" stores (data, scales) pairs — half the decode-side HBM
+        # traffic and double the tokens a pool budget holds (paged.py
+        # "int8 KV pools"). AREAL_KV_CACHE_DTYPE flips the default so
+        # bench/probe A/Bs need no plumbing.
+        if kv_cache_dtype is None:
+            kv_cache_dtype = os.environ.get("AREAL_KV_CACHE_DTYPE") or None
+        if kv_cache_dtype not in (None, "model", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={kv_cache_dtype!r}: expected None, "
+                f"'model', or 'int8'"
+            )
+        self.kv_cache_dtype = kv_cache_dtype
         pool_tokens = kv_pool_tokens or max_batch_size * self.S
         self.n_pages = pages_needed(pool_tokens, page_size) + 1  # + trash
         self._allocator = PageAllocator(self.n_pages)
@@ -447,6 +462,13 @@ class ServingEngine:
         cdt = jnp.dtype(c.compute_dtype)
         shape = (c.n_layers, c.n_kv_heads, self.n_pages, self.page_size,
                  c.head_dim)
+
+        def fresh_pool():
+            if self.kv_cache_dtype == "int8":
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros((*shape[:-1], 1), jnp.float32))
+            return jnp.zeros(shape, cdt)
+
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -456,12 +478,14 @@ class ServingEngine:
                 if c.n_kv_heads % tensor == 0
                 else P()
             )
+            # One sharding serves both leaves of an int8 pool: the
+            # scales' trailing dim is 1 and every sharded axis matches.
             sh = NamedSharding(self.mesh, spec)
-            self._k_pages = jax.device_put(jnp.zeros(shape, cdt), sh)
-            self._v_pages = jax.device_put(jnp.zeros(shape, cdt), sh)
+            self._k_pages = jax.device_put(fresh_pool(), sh)
+            self._v_pages = jax.device_put(fresh_pool(), sh)
         else:
-            self._k_pages = jnp.zeros(shape, cdt)
-            self._v_pages = jnp.zeros_like(self._k_pages)
+            self._k_pages = fresh_pool()
+            self._v_pages = fresh_pool()
 
     def _free_slots(self) -> List[int]:
         return [i for i in range(self.B) if self._slot_req[i] is None]

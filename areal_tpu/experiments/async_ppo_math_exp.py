@@ -155,6 +155,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             prefill_chunk=cfg.gen_prefill_chunk,
             chunked_prefill_per_lap=cfg.gen_chunked_prefill_per_lap,
             prefix_cache_tokens=cfg.gen_prefix_cache_tokens,
+            kv_cache_dtype=cfg.gen_kv_cache_dtype,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,
         )

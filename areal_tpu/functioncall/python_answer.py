@@ -18,6 +18,7 @@ code block, execution fails, or nothing is produced.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Optional
 
@@ -68,11 +69,20 @@ def _extract_candidate_code(text: str) -> Optional[str]:
     return None
 
 
+def _default_timeout() -> float:
+    # Wall-time per program INCLUDING interpreter spawn; on a loaded CI
+    # machine the spawn alone can take seconds, so tests raise this via
+    # AREAL_PYEXEC_TIMEOUT rather than loosening the eval-time default.
+    return float(os.environ.get("AREAL_PYEXEC_TIMEOUT", 6.0))
+
+
 def execute_python_answer(
-    text: str, timeout: float = 6.0,
+    text: str, timeout: Optional[float] = None,
 ) -> Optional[str]:
     """Run the candidate program in `text` (see
     _extract_candidate_code); return its answer string or None."""
+    if timeout is None:
+        timeout = _default_timeout()
     code = _extract_candidate_code(text)
     if code is None:
         return None
@@ -98,7 +108,9 @@ def compare_python_answer(ans: Optional[str], reference) -> bool:
     return compare_answers(ans, reference)
 
 
-def grade_python_answer(text: str, reference, timeout: float = 6.0) -> bool:
+def grade_python_answer(
+    text: str, reference, timeout: Optional[float] = None,
+) -> bool:
     """Execute the candidate program and grade its answer."""
     return compare_python_answer(
         execute_python_answer(text, timeout=timeout), reference

@@ -73,6 +73,7 @@ class GenerationServer(Worker):
             prefill_chunk=config.prefill_chunk,
             chunked_prefill_per_lap=config.chunked_prefill_per_lap,
             prefix_cache_tokens=config.prefix_cache_tokens,
+            kv_cache_dtype=config.kv_cache_dtype,
             mesh=mesh,
         )
         self.engine.start()

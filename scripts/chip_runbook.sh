@@ -48,7 +48,20 @@ timeout 2400 python scripts/long_context_probe.py cp d1f1s2t1,d1f1s4t1 16384 \
     > "$OUT/cp_ab.json" 2> "$OUT/cp_ab.log"
 cat "$OUT/cp_ab.json" || true
 
-echo "== 5. async-vs-sync speedup (chip mode) =="
+echo "== 5. int8 KV cache A/B (gen phases only) =="
+AREAL_KV_CACHE_DTYPE=int8 timeout 2400 \
+    python scripts/long_context_probe.py gen \
+    > "$OUT/gen_int8.json" 2> "$OUT/gen_int8.log"
+cat "$OUT/gen_int8.json" || true
+
+echo "== 6. MFU sweep (CE chunk + splash blocks) =="
+timeout 3000 python scripts/mfu_sweep.py blocks > "$OUT/sweep_blocks.json" \
+    2> "$OUT/sweep_blocks.log"
+timeout 2400 python scripts/mfu_sweep.py ce > "$OUT/sweep_ce.json" \
+    2> "$OUT/sweep_ce.log"
+tail -1 "$OUT/sweep_blocks.json" "$OUT/sweep_ce.json" || true
+
+echo "== 7. async-vs-sync speedup (chip mode) =="
 echo "needs real paths; run:"
 echo "  python scripts/async_speedup_bench.py --mode chip \\"
 echo "      --tokenizer <hf-tokenizer-dir> --dataset <math.jsonl> \\"

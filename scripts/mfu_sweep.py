@@ -158,6 +158,8 @@ def sweep(name, configs):
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("all", "ce", "blocks", "mbs"):
+        sys.exit(f"unknown sweep {which!r}: expected all|ce|blocks|mbs")
     platform = jax.devices()[0].platform
     log(f"mfu_sweep: platform={platform} which={which}")
     if platform != "tpu" and not TINY:

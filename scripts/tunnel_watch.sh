@@ -43,6 +43,10 @@ wait_up; run_step sweep_ce 2400 python scripts/mfu_sweep.py ce
 wait_up; run_step probe_t16k 1800 python scripts/long_context_probe.py train16k
 wait_up; run_step probe_t32k 2400 python scripts/long_context_probe.py train32k
 wait_up; run_step probe_gen 2400 python scripts/long_context_probe.py gen
+# int8 KV A/B (chip_runbook.sh step 5): same gen probe with quantized
+# pool — the measurement that gates flipping the int8 default.
+wait_up; run_step probe_gen_int8 2400 env AREAL_KV_CACHE_DTYPE=int8 \
+    python scripts/long_context_probe.py gen
 wait_up; run_step probe_sortskip 2400 python scripts/long_context_probe.py sortskip
 wait_up; run_step flash_parity 1800 python -m pytest tests/model/test_flash_attn.py -q --no-header
 wait_up; run_step sweep_mbs 2400 python scripts/mfu_sweep.py mbs

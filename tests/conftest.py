@@ -15,6 +15,10 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# Sandboxed python-answer programs get generous wall time under CI load
+# (interpreter spawn alone can take seconds on a busy machine); the
+# runaway-program test passes its own tight timeout explicitly.
+os.environ.setdefault("AREAL_PYEXEC_TIMEOUT", "30")
 
 import jax
 

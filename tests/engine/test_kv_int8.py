@@ -1,0 +1,242 @@
+"""int8 paged KV cache: quantization round-trip, attention parity
+against the dequantized oracle, and ServingEngine e2e (batched, chunked,
+and prefix-cache-hit prefill paths writing quantized pages).
+
+The reference's serving backend has no KV quantization
+(realhf/impl/model/backend/sglang.py) — this is a TPU-side extension:
+decode is HBM-bandwidth-bound streaming KV pages, so int8 halves the
+bytes per token and doubles the tokens a pool budget holds."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.engine.paged import (
+    TRASH_PAGE,
+    dequantize_kv,
+    kv_pool_data,
+    paged_decode_attention,
+    quantize_kv,
+    scatter_prefill,
+)
+from areal_tpu.engine.serving import GenRequest, ServingEngine
+from areal_tpu.models.config import TransformerConfig
+from areal_tpu.models.transformer import init_params
+
+CFG = TransformerConfig(
+    n_layers=2,
+    hidden_dim=32,
+    n_q_heads=2,
+    n_kv_heads=1,
+    head_dim=16,
+    intermediate_dim=64,
+    vocab_size=64,
+    max_position_embeddings=512,
+    compute_dtype="float32",
+    param_dtype="float32",
+)
+EOS = 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_quantize_roundtrip_bound():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 7, 16).astype(np.float32) * 4.0)
+    w, s = quantize_kv(x)
+    assert w.dtype == jnp.int8 and s.shape == (3, 7, 1)
+    back = dequantize_kv(w, s, jnp.float32)
+    # Error per element is bounded by half a quantization step:
+    # scale/127.5 per unit, plus the clip of the exact-max element.
+    step = np.asarray(s) / 127.5
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert np.all(err <= 0.51 * step + 1e-6), err.max()
+
+
+def test_quantize_zero_rows_finite():
+    w, s = quantize_kv(jnp.zeros((2, 4)))
+    back = dequantize_kv(w, s, jnp.float32)
+    assert np.all(np.asarray(back) == 0.0)
+
+
+def test_paged_attention_quantized_matches_dequantized_oracle():
+    """XLA paged attention on an int8 pool must equal the same attention
+    on a dense pool holding the dequantized values exactly (identical
+    math on identical inputs once dequantization is applied)."""
+    rng = np.random.RandomState(1)
+    Hkv, N, pg, hd = 2, 6, 4, 16
+    B, Hq, P = 3, 4, 2
+    kd = jnp.asarray(rng.randn(Hkv, N, pg, hd).astype(np.float32))
+    vd = jnp.asarray(rng.randn(Hkv, N, pg, hd).astype(np.float32))
+    kq, ks = quantize_kv(kd)
+    vq, vs = quantize_kv(vd)
+    k_deq = dequantize_kv(kq, ks, jnp.float32)
+    v_deq = dequantize_kv(vq, vs, jnp.float32)
+    q = jnp.asarray(rng.randn(B, Hq, hd).astype(np.float32))
+    lengths = jnp.asarray([3, 8, 5], jnp.int32)
+    page_indices = jnp.asarray(rng.randint(1, N, size=(B, P)), jnp.int32)
+
+    out_q = paged_decode_attention(
+        q, (kq, ks), (vq, vs), lengths, page_indices, impl="xla"
+    )
+    out_ref = paged_decode_attention(
+        q, k_deq, v_deq, lengths, page_indices, impl="xla"
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_scatter_prefill_quantized_roundtrip():
+    L, n, pad, Hkv, hd = 2, 1, 8, 1, 16
+    pg = 4
+    N = 4
+    pool_shape = (L, Hkv, N, pg, hd)
+    k_pages = (jnp.zeros(pool_shape, jnp.int8),
+               jnp.zeros((*pool_shape[:-1], 1), jnp.float32))
+    v_pages = (jnp.zeros(pool_shape, jnp.int8),
+               jnp.zeros((*pool_shape[:-1], 1), jnp.float32))
+    rng = np.random.RandomState(2)
+    k_pref = jnp.asarray(rng.randn(L, n, pad, Hkv, hd).astype(np.float32))
+    v_pref = jnp.asarray(rng.randn(L, n, pad, Hkv, hd).astype(np.float32))
+    flat = jnp.asarray([1, 2], jnp.int32)  # pad//pg = 2 chunks
+    k_pages, v_pages = scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat)
+    got = dequantize_kv(k_pages[0][:, :, 1:3], k_pages[1][:, :, 1:3],
+                        jnp.float32)
+    # [L, Hkv, 2, pg, hd] -> [L, n, pad, Hkv, hd] layout inverse
+    want = np.asarray(k_pref).reshape(L, 2, pg, Hkv, hd).transpose(
+        0, 3, 1, 2, 4
+    )
+    err = np.abs(np.asarray(got) - want)
+    assert err.max() < np.abs(want).max() / 100, err.max()
+
+
+def _run(engine, reqs, timeout=120):
+    results = {}
+    done = threading.Event()
+
+    def cb(res):
+        results[res.qid] = res
+        if len(results) == len(reqs):
+            done.set()
+
+    for r in reqs:
+        r.done_cb = cb
+        engine.submit(r)
+    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
+    return results
+
+
+def test_serving_engine_int8_e2e(params):
+    """Both prefill paths (batched bucketed + fixed-shape chunked) and
+    decode write int8 pages; generation completes with sane outputs and
+    greedy decode stays close to the bf16-pool engine."""
+    kw = dict(
+        max_batch_size=2, max_seq_len=128, decode_block_steps=4,
+        prompt_bucket=8, eos_token_id=EOS, seed=0, page_size=8,
+        prefill_chunk=8,
+    )
+    prompt = [9, 21, 33, 4, 17, 2, 40, 8, 12, 30, 7]  # > chunk: chunked path
+    short = [7, 11, 13]  # batched path
+    eng = ServingEngine(CFG, params, kv_cache_dtype="int8", **kw)
+    eng.start()
+    try:
+        res = _run(eng, [
+            GenRequest(qid="long", input_ids=list(prompt),
+                       max_new_tokens=12, greedy=True),
+            GenRequest(qid="short", input_ids=list(short),
+                       max_new_tokens=12, greedy=True),
+        ])
+        for r in res.values():
+            assert r.error is None
+            assert 1 <= len(r.output_ids) <= 12
+            assert all(np.isfinite(r.output_logprobs))
+    finally:
+        eng.stop()
+
+    # Greedy parity vs the unquantized engine: with a real softmax the
+    # <1% KV perturbation rarely flips an argmax on step one; assert the
+    # FIRST token matches (deterministic given greedy) for both paths.
+    eng16 = ServingEngine(CFG, params, **kw)
+    eng16.start()
+    try:
+        res16 = _run(eng16, [
+            GenRequest(qid="long", input_ids=list(prompt),
+                       max_new_tokens=1, greedy=True),
+            GenRequest(qid="short", input_ids=list(short),
+                       max_new_tokens=1, greedy=True),
+        ])
+    finally:
+        eng16.stop()
+    assert res["long"].output_ids[0] == res16["long"].output_ids[0]
+    assert res["short"].output_ids[0] == res16["short"].output_ids[0]
+
+
+def test_serving_engine_int8_prefix_cache(params):
+    """Resubmission with the same qid reuses parked int8 pages and
+    prefills only the delta."""
+    eng = ServingEngine(
+        CFG, params, kv_cache_dtype="int8",
+        max_batch_size=2, max_seq_len=128, decode_block_steps=4,
+        prompt_bucket=8, eos_token_id=None, seed=0, page_size=8,
+        prefill_chunk=8, prefix_cache_tokens=256,
+    )
+    eng.start()
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        r1 = _run(eng, [GenRequest(qid="pc", input_ids=list(prompt),
+                                   max_new_tokens=6, greedy=True)])["pc"]
+        assert len(r1.output_ids) == 6
+        r2 = _run(eng, [GenRequest(
+            qid="pc", input_ids=list(prompt) + list(r1.output_ids),
+            max_new_tokens=4, greedy=True)])["pc"]
+        assert len(r2.output_ids) == 4
+        assert eng.prefix_cache_hits == 1
+        assert eng.prefix_tokens_reused >= 8
+    finally:
+        eng.stop()
+
+
+def test_serving_engine_int8_tensor_parallel():
+    """int8 tuple pools under a tensor>1 mesh: both leaves take the
+    NamedSharding (kv heads divide -> sharded spec) and the XLA decode
+    path partitions the dequantizing gather."""
+    from areal_tpu.engine.serving import serving_mesh
+
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        intermediate_dim=64, vocab_size=64, max_position_embeddings=256,
+        compute_dtype="float32", param_dtype="float32",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    eng = ServingEngine(
+        cfg, params, kv_cache_dtype="int8", mesh=serving_mesh(2),
+        max_batch_size=2, max_seq_len=64, decode_block_steps=4,
+        prompt_bucket=8, eos_token_id=None, seed=0, page_size=8,
+    )
+    eng.start()
+    try:
+        res = _run(eng, [GenRequest(qid="tp", input_ids=[5, 6, 7],
+                                    max_new_tokens=8, greedy=True)])
+        assert res["tp"].error is None
+        assert len(res["tp"].output_ids) == 8
+    finally:
+        eng.stop()
+
+
+def test_kv_cache_dtype_validation(params):
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        ServingEngine(CFG, params, kv_cache_dtype="fp8")
+
+
+def test_kv_pool_data_helper():
+    a = jnp.zeros((2, 2))
+    assert kv_pool_data(a) is a
+    assert kv_pool_data((a, None)) is a
+    assert TRASH_PAGE == 0
