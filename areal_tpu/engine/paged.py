@@ -52,9 +52,11 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 # ----------------------------------------------------------------------
 #
 # With kv_cache_dtype="int8" a pool is a (data, scales) pair instead of a
-# bare array: data [L, Hkv, N, pg, hd] int8, scales [L, Hkv, N, pg, 1]
-# f32 — per-token-per-head absmax over the head dim (the QuantizedTensor
-# layout of jax's paged-attention kernel). Decode is HBM-bandwidth-bound
+# bare array: data [L, Hkv, N, pg, hd] int8, scales [L, Hkv, N, pg] f32 —
+# per-token-per-head absmax over the head dim, stored WITHOUT a trailing
+# size-1 dim (TPU tiled layouts pad the minor dim to 128 lanes, so a
+# [.., pg, 1] f32 array can physically occupy 128x its logical bytes;
+# squeezed, pg=128 IS the lane dim). Decode is HBM-bandwidth-bound
 # streaming KV pages, so int8 halves the pool's resident bytes — double
 # the tokens-in-flight a pool budget holds (fewer preempt/resubmit
 # cycles at 16-32k contexts) — and halves the gathered bytes on the XLA
@@ -67,7 +69,12 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 # has no KV quantization (realhf/impl/model/backend/sglang.py). Pools
 # stay plain arrays when not quantized; every helper accepts both.
 
-KV_INT8_MAX = 127.5  # kernel dequant is x * scale / 127.5
+# Dequant convention: x ~= int8 * scale / 127.5. Duplicated from
+# ops/pallas/paged_decode_int8.KV_INT8_MAX (equality pinned in
+# tests/engine/test_kv_int8.py) so importing this module doesn't pull
+# the Pallas stack — all kernel imports here are lazy, at the branches
+# that dispatch to them.
+KV_INT8_MAX = 127.5
 
 
 def kv_pool_data(pool) -> jnp.ndarray:
@@ -157,9 +164,9 @@ def _paged_attention_xla(q, k_pages, v_pages, lengths, page_indices, scale):
     def gather(pool):
         # [Hkv, B, P, pg, hd] -> [B, P*pg, Hkv, hd]
         if isinstance(pool, tuple):
-            d, s = pool
-            g = dequantize_kv(d[:, page_indices], s[:, page_indices],
-                              jnp.float32)
+            d, s = pool  # s: [Hkv, N, pg] squeezed
+            g = dequantize_kv(d[:, page_indices],
+                              s[:, page_indices][..., None], jnp.float32)
         else:
             g = pool[:, page_indices]
         return g.transpose(1, 2, 3, 0, 4).reshape(B, P * pg, Hkv, hd)
@@ -208,27 +215,66 @@ def paged_decode_attention(
     tp_ok = Hkv % tensor_size == 0 and Hq % tensor_size == 0
     if impl == "auto":
         on_tpu = jax.default_backend() in ("tpu", "axon")
-        # int8 pools do NOT auto-pick the stock kernel: upstream
-        # paged_attention broadcasts the [.., pg, 1] scales to full
-        # head_dim in f32 before pallas_call (jax .../paged_attention_
-        # kernel.py:421-431), materializing 2x the bf16 pool's bytes in
-        # HBM per call and streaming 4 B/elem of scales — inverting the
-        # bandwidth win. The XLA path gathers int8 (half the gathered
-        # bytes) and dequantizes after. impl='kernel' stays available
-        # for an explicit A/B.
-        impl = (
-            "kernel"
-            if on_tpu and paged_attention_kernel_ok(pg, hd, P) and tp_ok
-            and not quantized
-            else "xla"
-        )
-    elif impl == "kernel" and not tp_ok:
+        if quantized:
+            # int8 pools use OUR kernel (ops/pallas/paged_decode_int8):
+            # the stock kernel broadcasts the scales to full head_dim in
+            # f32 before pallas_call (jax .../paged_attention_kernel.py:
+            # 421-431), materializing 2x the bf16 pool per call.
+            # impl='kernel' stays available for an explicit A/B.
+            # (Import inside the on_tpu arm: keeps the Pallas stack off
+            # CPU-only import paths.)
+            impl = "xla"
+            if on_tpu and tp_ok:
+                from areal_tpu.ops.pallas.paged_decode_int8 import (
+                    int8_paged_kernel_ok,
+                )
+
+                if int8_paged_kernel_ok(pg, hd):
+                    impl = "int8_kernel"
+        else:
+            impl = (
+                "kernel"
+                if on_tpu and paged_attention_kernel_ok(pg, hd, P) and tp_ok
+                else "xla"
+            )
+    elif impl in ("kernel", "int8_kernel") and not tp_ok:
         raise ValueError(
             f"paged-attention kernel under tensor={tensor_size} needs head "
             f"counts divisible by it (Hq={Hq}, Hkv={Hkv}); use impl='xla'"
         )
     if impl == "xla":
         return _paged_attention_xla(q, k_pages, v_pages, lengths, page_indices, scale)
+    if impl == "int8_kernel":
+        if not quantized:
+            raise ValueError("impl='int8_kernel' needs an int8 (data, "
+                             "scales) pool; got a plain array")
+        from areal_tpu.ops.pallas.paged_decode_int8 import (
+            int8_paged_decode_attention,
+        )
+
+        qs = q * jnp.asarray(scale, q.dtype)
+        interp = jax.default_backend() not in ("tpu", "axon")
+        if tensor_size > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as Pt
+
+            pool_spec = (Pt("tensor", None, None, None),
+                         Pt("tensor", None, None))
+            out = shard_map(
+                functools.partial(int8_paged_decode_attention,
+                                  interpret=interp),
+                mesh=mesh,
+                in_specs=(Pt(None, "tensor", None), pool_spec, pool_spec,
+                          Pt(None), Pt(None, None)),
+                out_specs=Pt(None, "tensor", None),
+                check_vma=False,
+            )(qs, k_pages, v_pages, lengths, page_indices)
+        else:
+            out = int8_paged_decode_attention(
+                qs, k_pages, v_pages, lengths, page_indices,
+                interpret=interp,
+            )
+        return out.astype(q.dtype)
 
     from jax.experimental.pallas.ops.tpu.paged_attention import (
         paged_attention_kernel as pak,
@@ -244,8 +290,9 @@ def paged_decode_attention(
 
     def kernel(qq, kk, vv, ll, pi):
         if isinstance(kk, tuple):
-            kk = pqu.QuantizedTensor(*kk)
-            vv = pqu.QuantizedTensor(*vv)
+            # Stock kernel wants [.., pg, 1] scales; ours are squeezed.
+            kk = pqu.QuantizedTensor(kk[0], kk[1][..., None])
+            vv = pqu.QuantizedTensor(vv[0], vv[1][..., None])
         return pak.paged_attention(
             qq, kk, vv, ll, pi, pages_per_compute_block=ppcb
         )
@@ -256,8 +303,8 @@ def paged_decode_attention(
         from jax import shard_map
 
         pool_spec = Pt("tensor", None, None, None)
-        if quantized:  # spec subtree mirrors the (data, scales) pair
-            pool_spec = (pool_spec, Pt("tensor", None, None, None))
+        if quantized:  # spec subtree mirrors (data 4-D, scales 3-D)
+            pool_spec = (pool_spec, Pt("tensor", None, None))
         out = shard_map(
             kernel,
             mesh=mesh,
@@ -316,7 +363,7 @@ def _paged_decode_layer(
         if isinstance(pool, tuple):
             w, s = quantize_kv(val_t)
             return (pool[0].at[:, w_pidx, w_off].set(w),
-                    pool[1].at[:, w_pidx, w_off].set(s))
+                    pool[1].at[:, w_pidx, w_off].set(s[..., 0]))
         return pool.at[:, w_pidx, w_off].set(val_t.astype(pool.dtype))
 
     kp_l = scatter(kp_l, k.transpose(1, 0, 2))
@@ -510,7 +557,8 @@ def scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat_page_ids):
         if isinstance(pool, tuple):
             w, s = quantize_kv(pref)
             return (pool[0].at[:, :, flat_page_ids].set(to_chunks(w)),
-                    pool[1].at[:, :, flat_page_ids].set(to_chunks(s)))
+                    pool[1].at[:, :, flat_page_ids].set(
+                        to_chunks(s)[..., 0]))
         return pool.at[:, :, flat_page_ids].set(
             to_chunks(pref).astype(pool.dtype)
         )

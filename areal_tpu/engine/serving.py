@@ -465,24 +465,35 @@ class ServingEngine:
 
         def fresh_pool():
             if self.kv_cache_dtype == "int8":
+                # Scales squeezed to [L, Hkv, N, pg]: pg is the lane dim
+                # (a trailing size-1 dim would pad 128x under TPU tiled
+                # layouts — see paged.py "int8 KV pools").
                 return (jnp.zeros(shape, jnp.int8),
-                        jnp.zeros((*shape[:-1], 1), jnp.float32))
+                        jnp.zeros(shape[:-1], jnp.float32))
             return jnp.zeros(shape, cdt)
 
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             tensor = self.mesh.shape.get("tensor", 1)
-            spec = (
-                P(None, "tensor", None, None, None)
-                if c.n_kv_heads % tensor == 0
-                else P()
-            )
-            # One sharding serves both leaves of an int8 pool: the
-            # scales' trailing dim is 1 and every sharded axis matches.
-            sh = NamedSharding(self.mesh, spec)
-            self._k_pages = jax.device_put(fresh_pool(), sh)
-            self._v_pages = jax.device_put(fresh_pool(), sh)
+            if c.n_kv_heads % tensor == 0:
+                spec_d = P(None, "tensor", None, None, None)
+                spec_s = P(None, "tensor", None, None)  # squeezed scales
+            else:
+                spec_d = spec_s = P()
+
+            def put(pool):
+                if isinstance(pool, tuple):
+                    return (
+                        jax.device_put(
+                            pool[0], NamedSharding(self.mesh, spec_d)),
+                        jax.device_put(
+                            pool[1], NamedSharding(self.mesh, spec_s)),
+                    )
+                return jax.device_put(pool, NamedSharding(self.mesh, spec_d))
+
+            self._k_pages = put(fresh_pool())
+            self._v_pages = put(fresh_pool())
         else:
             self._k_pages = fresh_pool()
             self._v_pages = fresh_pool()

@@ -82,8 +82,10 @@ def test_paged_attention_quantized_matches_dequantized_oracle():
     lengths = jnp.asarray([3, 8, 5], jnp.int32)
     page_indices = jnp.asarray(rng.randint(1, N, size=(B, P)), jnp.int32)
 
+    # Pools store scales squeezed: [Hkv, N, pg].
     out_q = paged_decode_attention(
-        q, (kq, ks), (vq, vs), lengths, page_indices, impl="xla"
+        q, (kq, ks[..., 0]), (vq, vs[..., 0]), lengths, page_indices,
+        impl="xla"
     )
     out_ref = paged_decode_attention(
         q, k_deq, v_deq, lengths, page_indices, impl="xla"
@@ -93,22 +95,93 @@ def test_paged_attention_quantized_matches_dequantized_oracle():
     )
 
 
+def _quantized_pools(rng, Hkv, N, pg, hd):
+    kd = jnp.asarray(rng.randn(Hkv, N, pg, hd).astype(np.float32))
+    vd = jnp.asarray(rng.randn(Hkv, N, pg, hd).astype(np.float32))
+    kq, ks = quantize_kv(kd)
+    vq, vs = quantize_kv(vd)
+    return (kq, ks[..., 0]), (vq, vs[..., 0])
+
+
+@pytest.mark.parametrize("lengths", [[3, 8, 5], [1, 16, 9]])
+def test_int8_kernel_matches_xla_path(lengths):
+    """The from-scratch Pallas kernel (interpret mode on CPU) must match
+    the XLA gather-dequant path bit-for-tolerance: same dequantized
+    values, same online-softmax math, including partial final pages and
+    GQA groups."""
+    rng = np.random.RandomState(7)
+    Hkv, N, pg, hd = 2, 6, 8, 16
+    B, Hq, P = 3, 4, 2
+    k_pool, v_pool = _quantized_pools(rng, Hkv, N, pg, hd)
+    q = jnp.asarray(rng.randn(B, Hq, hd).astype(np.float32))
+    lens = jnp.asarray(lengths, jnp.int32)
+    page_indices = jnp.asarray(rng.randint(1, N, size=(B, P)), jnp.int32)
+
+    out_kernel = paged_decode_attention(
+        q, k_pool, v_pool, lens, page_indices, impl="int8_kernel"
+    )
+    out_xla = paged_decode_attention(
+        q, k_pool, v_pool, lens, page_indices, impl="xla"
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_kernel), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_int8_kernel_flagship_block_shapes():
+    """Lane-aligned shapes the real chip runs (pg=128, hd=128) through
+    the kernel in interpret mode, against the XLA path."""
+    rng = np.random.RandomState(8)
+    Hkv, N, pg, hd = 1, 3, 128, 128
+    B, Hq, P = 2, 2, 2
+    k_pool, v_pool = _quantized_pools(rng, Hkv, N, pg, hd)
+    q = jnp.asarray(rng.randn(B, Hq, hd).astype(np.float32))
+    lens = jnp.asarray([150, 77], jnp.int32)
+    page_indices = jnp.asarray([[1, 2], [2, 1]], jnp.int32)
+    out_kernel = paged_decode_attention(
+        q, k_pool, v_pool, lens, page_indices, impl="int8_kernel"
+    )
+    out_xla = paged_decode_attention(
+        q, k_pool, v_pool, lens, page_indices, impl="xla"
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_kernel), np.asarray(out_xla), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_int8_kernel_gate():
+    from areal_tpu.ops.pallas.paged_decode_int8 import int8_paged_kernel_ok
+
+    assert int8_paged_kernel_ok(128, 128)
+    assert not int8_paged_kernel_ok(8, 128)
+    assert not int8_paged_kernel_ok(128, 64)
+
+
+def test_kv_int8_max_constants_agree():
+    """engine/paged duplicates the dequant constant to keep Pallas off
+    its import path; the two must never drift."""
+    from areal_tpu.engine.paged import KV_INT8_MAX as a
+    from areal_tpu.ops.pallas.paged_decode_int8 import KV_INT8_MAX as b
+
+    assert a == b
+
+
 def test_scatter_prefill_quantized_roundtrip():
     L, n, pad, Hkv, hd = 2, 1, 8, 1, 16
     pg = 4
     N = 4
     pool_shape = (L, Hkv, N, pg, hd)
     k_pages = (jnp.zeros(pool_shape, jnp.int8),
-               jnp.zeros((*pool_shape[:-1], 1), jnp.float32))
+               jnp.zeros(pool_shape[:-1], jnp.float32))
     v_pages = (jnp.zeros(pool_shape, jnp.int8),
-               jnp.zeros((*pool_shape[:-1], 1), jnp.float32))
+               jnp.zeros(pool_shape[:-1], jnp.float32))
     rng = np.random.RandomState(2)
     k_pref = jnp.asarray(rng.randn(L, n, pad, Hkv, hd).astype(np.float32))
     v_pref = jnp.asarray(rng.randn(L, n, pad, Hkv, hd).astype(np.float32))
     flat = jnp.asarray([1, 2], jnp.int32)  # pad//pg = 2 chunks
     k_pages, v_pages = scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat)
-    got = dequantize_kv(k_pages[0][:, :, 1:3], k_pages[1][:, :, 1:3],
-                        jnp.float32)
+    got = dequantize_kv(k_pages[0][:, :, 1:3],
+                        k_pages[1][:, :, 1:3][..., None], jnp.float32)
     # [L, Hkv, 2, pg, hd] -> [L, n, pad, Hkv, hd] layout inverse
     want = np.asarray(k_pref).reshape(L, 2, pg, Hkv, hd).transpose(
         0, 3, 1, 2, 4
