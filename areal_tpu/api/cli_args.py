@@ -256,6 +256,18 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "traffic, double the tokens per pool budget)"
         },
     )
+    # N-gram (prompt-lookup) speculative decoding on the gen servers.
+    gen_speculative_draft_len: int = dataclasses.field(
+        default=0,
+        metadata={
+            "help": "tokens drafted per decode step via n-gram prompt "
+            "lookup; verified prefix kept (lossless). 0 disables"
+        },
+    )
+    gen_speculative_ngram: int = dataclasses.field(
+        default=2,
+        metadata={"help": "n-gram length for the draft lookup match"},
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

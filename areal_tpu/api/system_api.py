@@ -149,6 +149,11 @@ class GenerationServerConfig:
     # stores quantized (data, scales) pages — half the decode HBM
     # traffic, double the tokens per pool budget (engine/paged.py).
     kv_cache_dtype: Optional[str] = None
+    # N-gram (prompt-lookup) speculative decoding: >0 drafts that many
+    # tokens per decode step and keeps the verified prefix — lossless,
+    # device-resident (engine/spec_decode.py). 0 disables.
+    speculative_draft_len: int = 0
+    speculative_ngram: int = 2
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

@@ -571,12 +571,14 @@ def scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat_page_ids):
 # ----------------------------------------------------------------------
 
 
-def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
-                eos_mask, active_rows=None):
-    """Per-row warped sampling: temperature, top-k, top-p, greedy rows,
-    and EOS-forbid rows — all as [B] arrays so one compiled program serves
-    every mix of per-request params. Returns (tokens [B], logprobs [B] of
-    the unwarped distribution, PPO convention — ops/sampling.sample_token).
+def warp_logits(logits, temps, top_ps, top_ks, forbid_rows, eos_mask,
+                active_rows=None):
+    """The warping half of warp_sample: per-row temperature / top-k /
+    top-p / EOS-forbid applied to [B, V] logits. Returns (warped [B, V],
+    base_logp [B, V] — log-softmax of the UNWARPED, forbid-masked
+    logits, the distribution PPO logprobs are reported under). Shared by
+    the decode block's sampling and speculative verification (which
+    needs the whole warped distribution, not just a sample).
 
     Three tiers, picked at runtime by the active rows' settings:
     temperature-only skips warping entirely; top-k-only (all active k <=
@@ -636,8 +638,22 @@ def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
         lambda w: w,
         warped,
     )
+    return warped, base_logp
+
+
+def warp_sample(logits, rng, temps, top_ps, top_ks, greedy_mask, forbid_rows,
+                eos_mask, active_rows=None):
+    """Per-row warped sampling: temperature, top-k, top-p, greedy rows,
+    and EOS-forbid rows — all as [B] arrays so one compiled program serves
+    every mix of per-request params. Returns (tokens [B], logprobs [B] of
+    the unwarped distribution, PPO convention — ops/sampling.sample_token).
+    Warping tiers documented on warp_logits."""
+    warped, base_logp = warp_logits(
+        logits, temps, top_ps, top_ks, forbid_rows, eos_mask,
+        active_rows=active_rows,
+    )
     sampled = jax.random.categorical(rng, warped, axis=-1)
-    argmax = jnp.argmax(logits, axis=-1)
+    argmax = jnp.argmax(base_logp, axis=-1)
     tokens = jnp.where(greedy_mask, argmax, sampled).astype(jnp.int32)
     logprobs = jnp.take_along_axis(base_logp, tokens[:, None], axis=-1)[:, 0]
     return tokens, logprobs

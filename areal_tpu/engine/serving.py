@@ -175,6 +175,8 @@ class ServingEngine:
         chunked_prefill_per_lap: int = 2,
         prefix_cache_tokens: Optional[int] = None,
         kv_cache_dtype: Optional[str] = None,
+        speculative_draft_len: int = 0,
+        speculative_ngram: int = 2,
     ):
         self.cfg = cfg
         # Sampled token ids round-trip through float32 in the packed
@@ -244,6 +246,27 @@ class ServingEngine:
                 f"'model', or 'int8'"
             )
         self.kv_cache_dtype = kv_cache_dtype
+        # N-gram (prompt-lookup) speculative decoding (engine/
+        # spec_decode.py): draft_len > 0 feeds 1+draft_len rows per slot
+        # per step and keeps the verified prefix — lossless (greedy
+        # bit-identical; sampled distribution-exact) and device-resident.
+        if speculative_draft_len == 0:
+            # A/B hook, like AREAL_KV_CACHE_DTYPE: flips the default
+            # without plumbing (bench/probe runs).
+            speculative_draft_len = int(os.environ.get("AREAL_SPEC_DRAFT", 0))
+        assert speculative_draft_len >= 0 and speculative_ngram >= 1, (
+            f"bad speculative config: draft_len={speculative_draft_len}, "
+            f"ngram={speculative_ngram}"
+        )
+        self.spec_draft_len = speculative_draft_len
+        self.spec_ngram = speculative_ngram
+        # Token history per slot (prompt + emitted; one scratch column
+        # for masked scatter writes). int32 [B, S+1]: tiny next to KV.
+        self._history = (
+            jnp.zeros((max_batch_size, self.S + 1), jnp.int32)
+            if speculative_draft_len > 0
+            else None
+        )
         pool_tokens = kv_pool_tokens or max_batch_size * self.S
         self.n_pages = pages_needed(pool_tokens, page_size) + 1  # + trash
         self._allocator = PageAllocator(self.n_pages)
@@ -795,6 +818,21 @@ class ServingEngine:
             jnp.asarray(adm_g + [False] * pad_n),
             n_slots=self.B,
         )
+        if self._history is not None:
+            from areal_tpu.engine.spec_decode import set_history
+
+            rows = np.zeros((m, self.S + 1), np.int32)
+            for i, slot in enumerate(adm_slots):
+                req = self._slot_req[slot]
+                plen = min(len(req.input_ids), self.S)
+                rows[i, :plen] = req.input_ids[:plen]
+                rows[i, plen] = self._slot_out[slot][0]
+            self._history = set_history(
+                self._history,
+                jnp.asarray(adm_slots + [0] * pad_n, jnp.int32),
+                jnp.asarray(adm_valid + [False] * pad_n),
+                jnp.asarray(rows),
+            )
 
     def _evict_one_prefix(self) -> bool:
         """Free the least-recently-used cached prefix's pages."""
@@ -830,9 +868,12 @@ class ServingEngine:
             # budget within the block, and overflow writes are
             # trash-routed on device, so capping is safe — not capping
             # would overrun the page-table row and kill the loop thread.
+            # Speculative blocks feed 1+draft_len rows per step; every
+            # fed row writes KV, so reservation covers the worst case.
+            block_tokens = self.block_steps * (1 + self.spec_draft_len)
             need = min(
                 pages_needed(
-                    int(self._len[slot]) + self.block_steps, self.page_size
+                    int(self._len[slot]) + block_tokens, self.page_size
                 ),
                 self.max_pages,
             )
@@ -1037,7 +1078,9 @@ class ServingEngine:
     def _serve(self):
         self._ensure_pool()
         eos_global = jnp.asarray(self._eos_mask_np())
-        n = self.block_steps
+        # Column count of the packed block result: the spec block emits
+        # up to (1 + draft_len) tokens per step.
+        n = self.block_steps * (1 + self.spec_draft_len)
         while not self._stop.is_set():
             if self._interrupt.is_set():
                 self._interrupt_all()
@@ -1059,14 +1102,33 @@ class ServingEngine:
 
             (lengths, next_input, active, remaining, min_remaining,
              temps, top_ps, top_ks, greedy) = self._dstate
-            (packed, self._k_pages, self._v_pages, lengths, next_input,
-             active, remaining, min_remaining, self._rng) = paged_decode_block(
-                self.params, self.cfg, self._k_pages, self._v_pages,
-                self._pt_dev, lengths, next_input, active, remaining,
-                min_remaining, temps, top_ps, top_ks, greedy,
-                eos_global, self._rng,
-                n_steps=n, attn_impl=self.attn_impl, mesh=self.mesh,
-            )
+            if self.spec_draft_len > 0:
+                from areal_tpu.engine.spec_decode import (
+                    paged_spec_decode_block,
+                )
+
+                (packed, self._k_pages, self._v_pages, lengths,
+                 next_input, active, remaining, min_remaining, self._rng,
+                 self._history) = paged_spec_decode_block(
+                    self.params, self.cfg, self._k_pages, self._v_pages,
+                    self._pt_dev, lengths, next_input, active, remaining,
+                    min_remaining, temps, top_ps, top_ks, greedy,
+                    eos_global, self._rng, self._history,
+                    n_steps=self.block_steps,
+                    draft_len=self.spec_draft_len,
+                    ngram=self.spec_ngram,
+                    attn_impl=self.attn_impl, mesh=self.mesh,
+                )
+            else:
+                (packed, self._k_pages, self._v_pages, lengths, next_input,
+                 active, remaining, min_remaining,
+                 self._rng) = paged_decode_block(
+                    self.params, self.cfg, self._k_pages, self._v_pages,
+                    self._pt_dev, lengths, next_input, active, remaining,
+                    min_remaining, temps, top_ps, top_ks, greedy,
+                    eos_global, self._rng,
+                    n_steps=n, attn_impl=self.attn_impl, mesh=self.mesh,
+                )
             self._dstate = (lengths, next_input, active, remaining,
                             min_remaining, temps, top_ps, top_ks, greedy)
             p = np.asarray(packed)  # the block's single device fetch

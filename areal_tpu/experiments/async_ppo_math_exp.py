@@ -156,6 +156,8 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             chunked_prefill_per_lap=cfg.gen_chunked_prefill_per_lap,
             prefix_cache_tokens=cfg.gen_prefix_cache_tokens,
             kv_cache_dtype=cfg.gen_kv_cache_dtype,
+            speculative_draft_len=cfg.gen_speculative_draft_len,
+            speculative_ngram=cfg.gen_speculative_ngram,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,
         )

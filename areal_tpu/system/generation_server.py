@@ -74,6 +74,8 @@ class GenerationServer(Worker):
             chunked_prefill_per_lap=config.chunked_prefill_per_lap,
             prefix_cache_tokens=config.prefix_cache_tokens,
             kv_cache_dtype=config.kv_cache_dtype,
+            speculative_draft_len=config.speculative_draft_len,
+            speculative_ngram=config.speculative_ngram,
             mesh=mesh,
         )
         self.engine.start()
