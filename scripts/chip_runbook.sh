@@ -54,11 +54,14 @@ AREAL_KV_CACHE_DTYPE=int8 timeout 2400 \
     > "$OUT/gen_int8.json" 2> "$OUT/gen_int8.log"
 cat "$OUT/gen_int8.json" || true
 
-echo "== 5b. speculative decoding A/B (gen phases) =="
-AREAL_SPEC_DRAFT=4 timeout 2400 \
+echo "== 5b. speculative decoding A/B (greedy baseline vs greedy+spec) =="
+AREAL_PROBE_GREEDY=1 timeout 2400 \
+    python scripts/long_context_probe.py gen \
+    > "$OUT/gen_greedy.json" 2> "$OUT/gen_greedy.log"
+AREAL_PROBE_GREEDY=1 AREAL_SPEC_DRAFT=4 timeout 2400 \
     python scripts/long_context_probe.py gen \
     > "$OUT/gen_spec.json" 2> "$OUT/gen_spec.log"
-cat "$OUT/gen_spec.json" || true
+cat "$OUT/gen_greedy.json" "$OUT/gen_spec.json" || true
 
 echo "== 6. MFU sweep (CE chunk + splash blocks) =="
 timeout 3000 python scripts/mfu_sweep.py blocks > "$OUT/sweep_blocks.json" \

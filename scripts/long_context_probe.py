@@ -167,8 +167,13 @@ def probe_gen(plen=16384, max_new=512):
             done.set()
 
         t0 = time.perf_counter()
+        # AREAL_PROBE_GREEDY=1: greedy decode — the regime where the
+        # speculative A/B (AREAL_SPEC_DRAFT) is meaningful; sampled-at-
+        # temp-1 acceptance of point-mass drafts is ~p(t) per token.
         eng.submit(GenRequest(qid=qid, input_ids=list(ids),
-                              max_new_tokens=new, done_cb=cb))
+                              max_new_tokens=new, done_cb=cb,
+                              greedy=bool(os.environ.get(
+                                  "AREAL_PROBE_GREEDY"))))
         assert done.wait(1800)
         res = holder["r"]
         if res.error is not None:

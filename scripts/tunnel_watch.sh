@@ -47,9 +47,12 @@ wait_up; run_step probe_gen 2400 python scripts/long_context_probe.py gen
 # pool — the measurement that gates flipping the int8 default.
 wait_up; run_step probe_gen_int8 2400 env AREAL_KV_CACHE_DTYPE=int8 \
     python scripts/long_context_probe.py gen
-# speculative decoding A/B (runbook step 5b): gates the spec default.
-wait_up; run_step probe_gen_spec 2400 env AREAL_SPEC_DRAFT=4 \
+# speculative decoding A/B (runbook step 5b): greedy baseline vs
+# greedy+spec — the regime where prompt-lookup drafts are meaningful.
+wait_up; run_step probe_gen_greedy 2400 env AREAL_PROBE_GREEDY=1 \
     python scripts/long_context_probe.py gen
+wait_up; run_step probe_gen_spec 2400 env AREAL_PROBE_GREEDY=1 \
+    AREAL_SPEC_DRAFT=4 python scripts/long_context_probe.py gen
 wait_up; run_step probe_sortskip 2400 python scripts/long_context_probe.py sortskip
 wait_up; run_step flash_parity 1800 python -m pytest tests/model/test_flash_attn.py -q --no-header
 wait_up; run_step sweep_mbs 2400 python scripts/mfu_sweep.py mbs
