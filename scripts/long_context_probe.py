@@ -172,8 +172,8 @@ def probe_gen(plen=16384, max_new=512):
         # temp-1 acceptance of point-mass drafts is ~p(t) per token.
         eng.submit(GenRequest(qid=qid, input_ids=list(ids),
                               max_new_tokens=new, done_cb=cb,
-                              greedy=bool(os.environ.get(
-                                  "AREAL_PROBE_GREEDY"))))
+                              greedy=os.environ.get("AREAL_PROBE_GREEDY",
+                                                    "0") not in ("", "0")))
         assert done.wait(1800)
         res = holder["r"]
         if res.error is not None:
