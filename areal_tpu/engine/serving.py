@@ -260,6 +260,10 @@ class ServingEngine:
         )
         self.spec_draft_len = speculative_draft_len
         self.spec_ngram = speculative_ngram
+        # Acceptance telemetry: tokens emitted / (block steps * active
+        # slots) — the realized speculation yield.
+        self._spec_emitted = 0
+        self._spec_steps = 0
         # Token history per slot (prompt + emitted; one scratch column
         # for masked scatter writes). int32 [B, S+1]: tiny next to KV.
         self._history = (
@@ -472,6 +476,13 @@ class ServingEngine:
             "prefix_cache_hits": float(self.prefix_cache_hits),
             "prefix_tokens_reused": float(self.prefix_tokens_reused),
             "prefix_cached_tokens": float(self._cached_tokens),
+            # Speculative decoding yield: emitted tokens per decode STEP
+            # across slots that were active (1.0 = no speculation value;
+            # the ceiling is 1 + draft_len). The number that decides
+            # whether AREAL_SPEC_DRAFT stays on.
+            "spec_tokens_per_step": float(
+                self._spec_emitted / self._spec_steps
+            ) if self._spec_steps else 0.0,
         }
 
     # ------------------------------------------------------------------
@@ -1135,6 +1146,12 @@ class ServingEngine:
             toks_h = p[:, :n]
             lps_h = p[:, n:2 * n]
             n_emitted = p[:, 2 * n].astype(np.int64)
+            if self.spec_draft_len > 0:
+                # Spec block appends a per-slot active-steps column: the
+                # exact yield denominator (early-finishing slots charge
+                # only the steps they actually ran).
+                self._spec_emitted += int(n_emitted.sum())
+                self._spec_steps += int(p[:, 2 * n + 4].sum())
             hit_eos_h = p[:, 2 * n + 1] > 0.5
             active_h = p[:, 2 * n + 2] > 0.5
             # Mirror lengths for occupied slots only: the device array is

@@ -237,7 +237,9 @@ def paged_spec_decode_block(
     def body(i, carry):
         del i
         (kp, vp, lengths, next_input, active, remaining, min_remaining,
-         rng, history, total, out_t, out_lp, out_m, hit_eos) = carry
+         rng, history, total, steps_act, out_t, out_lp, out_m,
+         hit_eos) = carry
+        steps_act = steps_act + active.astype(jnp.int32)
         # Drafting is disabled while the EOS-forbid floor is live (the
         # per-position forbid interaction isn't worth the complexity)
         # and for inactive slots.
@@ -312,8 +314,8 @@ def paged_spec_decode_block(
         out_m = out_m.at[brow, wcol].set(emit_mask)
         total = total + n_emit
         return (kp, vp, lengths, next_input, new_active, remaining,
-                min_remaining, rng, history, total, out_t, out_lp, out_m,
-                hit_eos)
+                min_remaining, rng, history, total, steps_act, out_t,
+                out_lp, out_m, hit_eos)
 
     # One scratch column (n_out) absorbs masked scatter writes.
     out_t = jnp.zeros((B, n_out + 1), jnp.int32)
@@ -321,13 +323,17 @@ def paged_spec_decode_block(
     out_m = jnp.zeros((B, n_out + 1), bool)
     hit_eos = jnp.zeros((B,), bool)
     total0 = jnp.zeros((B,), jnp.int32)
+    steps0 = jnp.zeros((B,), jnp.int32)
     carry = (k_pages, v_pages, lengths, next_input, active, remaining,
-             min_remaining, rng, history, total0, out_t, out_lp, out_m,
-             hit_eos)
+             min_remaining, rng, history, total0, steps0, out_t, out_lp,
+             out_m, hit_eos)
     carry = jax.lax.fori_loop(0, n_steps, body, carry)
     (k_pages, v_pages, lengths, next_input, active, remaining, min_remaining,
-     rng, history, _total, out_t, out_lp, out_m, hit_eos) = carry
+     rng, history, _total, steps_act, out_t, out_lp, out_m, hit_eos) = carry
     out_t, out_lp, out_m = out_t[:, :n_out], out_lp[:, :n_out], out_m[:, :n_out]
+    # One extra column vs the plain block: per-slot steps the slot was
+    # ACTIVE for — the exact denominator for the speculation yield
+    # (charging full blocks to early-finishing slots would deflate it).
     packed = jnp.concatenate(
         [
             out_t.astype(jnp.float32),
@@ -336,6 +342,7 @@ def paged_spec_decode_block(
             hit_eos[:, None].astype(jnp.float32),
             active[:, None].astype(jnp.float32),
             lengths[:, None].astype(jnp.float32),
+            steps_act[:, None].astype(jnp.float32),
         ],
         axis=1,
     )

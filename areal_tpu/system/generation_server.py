@@ -269,6 +269,7 @@ class GenerationServer(Worker):
             f"areal:prefix_cache_hits {m['prefix_cache_hits']}",
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
             f"areal:prefix_cached_tokens {m['prefix_cached_tokens']}",
+            f"areal:spec_tokens_per_step {m['spec_tokens_per_step']}",
             f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
             f"areal:last_weight_stage_s {m['last_weight_stage_s']}",
             f"areal:last_weight_load_s "

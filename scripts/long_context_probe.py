@@ -172,8 +172,9 @@ def probe_gen(plen=16384, max_new=512):
         # temp-1 acceptance of point-mass drafts is ~p(t) per token.
         eng.submit(GenRequest(qid=qid, input_ids=list(ids),
                               max_new_tokens=new, done_cb=cb,
-                              greedy=os.environ.get("AREAL_PROBE_GREEDY",
-                                                    "0") not in ("", "0")))
+                              greedy=os.environ.get(
+                                  "AREAL_PROBE_GREEDY", "0"
+                              ) not in ("", "0", "false")))
         assert done.wait(1800)
         res = holder["r"]
         if res.error is not None:
@@ -200,6 +201,13 @@ def probe_gen(plen=16384, max_new=512):
          prefix_tokens_reused=eng.prefix_tokens_reused)
     log(f"gen 16k resubmit: {dt2:.2f}s (cold {dt1:.2f}s), "
         f"hits={eng.prefix_cache_hits} reused={eng.prefix_tokens_reused}")
+    if eng.spec_draft_len > 0:
+        # The decision signal for AREAL_SPEC_DRAFT: realized tokens per
+        # active decode step (1.0 = speculation added nothing).
+        y = eng.metrics()["spec_tokens_per_step"]
+        emit(metric="gen_spec_tokens_per_step", value=round(y, 3),
+             draft_len=eng.spec_draft_len)
+        log(f"spec yield: {y:.3f} tokens/step (draft {eng.spec_draft_len})")
     eng.stop()
 
 

@@ -294,6 +294,22 @@ def test_spec_respects_min_new_tokens(params):
         eng.stop()
 
 
+def test_spec_yield_metric(params):
+    """metrics() surfaces the realized speculation yield (tokens per
+    decode step over active slots) — the number the chip A/B reads."""
+    eng = _engine(params, speculative_draft_len=3, eos_token_id=None)
+    eng.start()
+    try:
+        _run(eng, [GenRequest(qid="y", input_ids=[2, 3, 2, 3, 2, 3],
+                              max_new_tokens=16, greedy=True)])
+        m = eng.metrics()
+        # Exact accounting (active-steps denominator): an active slot
+        # emits >= 1 token per step, so the yield floor is 1.0.
+        assert m["spec_tokens_per_step"] >= 1.0
+    finally:
+        eng.stop()
+
+
 def test_spec_budget_exact(params):
     eng = _engine(params, speculative_draft_len=4, eos_token_id=None)
     eng.start()
