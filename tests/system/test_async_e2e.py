@@ -96,19 +96,35 @@ def _trainer_parts(exp, trial, tok_dir):
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "agent_abs",
+    "agent_abs,gen_extra",
     [
-        AgentAbstraction(
-            "math-single-step", args=dict(gconfig=dict(n=2, max_new_tokens=8))
+        (
+            AgentAbstraction(
+                "math-single-step",
+                args=dict(gconfig=dict(n=2, max_new_tokens=8)),
+            ),
+            {},
         ),
-        AgentAbstraction(
-            "math-multi-turn",
-            args=dict(gconfig=dict(max_new_tokens=8), num_turns=2),
+        (
+            AgentAbstraction(
+                "math-multi-turn",
+                args=dict(gconfig=dict(max_new_tokens=8), num_turns=2),
+            ),
+            {},
+        ),
+        (
+            # The round-5 serving extensions through the FULL async RL
+            # loop: int8 KV pool + n-gram speculative decoding.
+            AgentAbstraction(
+                "math-single-step",
+                args=dict(gconfig=dict(n=2, max_new_tokens=8)),
+            ),
+            dict(kv_cache_dtype="int8", speculative_draft_len=3),
         ),
     ],
-    ids=["single-step", "multi-turn"],
+    ids=["single-step", "multi-turn", "spec-int8"],
 )
-def test_async_ppo_e2e(tmp_path, agent_abs):
+def test_async_ppo_e2e(tmp_path, agent_abs, gen_extra):
     exp, trial = f"e2e-async-{uuid.uuid4().hex[:6]}", "t0"
     rows, tok_dir = _mk_tokenizer_files(tmp_path)
     mc_rows = [r for r in fixtures.make_math_code_rows(12, seed=9) if r["task"] == "math"]
@@ -124,6 +140,7 @@ def test_async_ppo_e2e(tmp_path, agent_abs):
         max_concurrent_requests=4,
         max_seq_len=256,
         decode_block_steps=4,
+        **gen_extra,
     )
     gserver_mgr = GserverManagerConfig(
         experiment_name=exp,
