@@ -268,6 +268,13 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
         default=2,
         metadata={"help": "n-gram length for the draft lookup match"},
     )
+    gen_decode_weight_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "decode-path weight precision: 'int8' halves the "
+            "per-step weight stream (prefill stays bf16); None disables"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

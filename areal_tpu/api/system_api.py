@@ -154,6 +154,9 @@ class GenerationServerConfig:
     # device-resident (engine/spec_decode.py). 0 disables.
     speculative_draft_len: int = 0
     speculative_ngram: int = 2
+    # int8 DECODE weights (W8A16, ops/wquant.py): halves the per-step
+    # weight stream; prefill stays bf16. None/"model" disables.
+    decode_weight_dtype: Optional[str] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1

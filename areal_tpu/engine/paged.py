@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import _mlp, _norm
+from areal_tpu.ops.wquant import qmat
 from areal_tpu.ops.norms import rms_norm
 from areal_tpu.ops.rotary import apply_rotary, rotary_cos_sin, rotary_inv_freq
 from areal_tpu.ops.sampling import NEG_INF
@@ -340,9 +341,9 @@ def _paged_decode_layer(
     B, _ = x.shape
     h = _norm(x, lp["ln1"], cfg)
     a = lp["attn"]
-    q = h @ a["wq"].astype(cdt)
-    k = h @ a["wk"].astype(cdt)
-    v = h @ a["wv"].astype(cdt)
+    q = qmat(h, a["wq"], cdt)
+    k = qmat(h, a["wk"], cdt)
+    v = qmat(h, a["wv"], cdt)
     if "bq" in a:
         q = q + a["bq"].astype(cdt)
         k = k + a["bk"].astype(cdt)
@@ -371,7 +372,7 @@ def _paged_decode_layer(
     out = paged_decode_attention(
         q, kp_l, vp_l, lengths + 1, page_indices, mesh=mesh, impl=attn_impl
     )
-    attn_out = out.reshape(B, cfg.q_dim) @ a["wo"].astype(cdt)
+    attn_out = qmat(out.reshape(B, cfg.q_dim), a["wo"], cdt)
     if "bo" in a:
         attn_out = attn_out + a["bo"].astype(cdt)
     x = x + attn_out
@@ -430,12 +431,15 @@ def paged_decode_step(
         body, x, (params["layers"], k_pages, v_pages)
     )
     x = _norm(x, params["final_norm"], cfg)
-    head_w = (
-        params["embedding"]["weight"].T
-        if cfg.tied_embeddings
-        else params["head"]["weight"]
-    )
-    logits = (x @ head_w.astype(cdt)).astype(jnp.float32)
+    if "head_q" in params:  # int8 decode weights (ops/wquant.py)
+        logits = qmat(x, params["head_q"], cdt).astype(jnp.float32)
+    else:
+        head_w = (
+            params["embedding"]["weight"].T
+            if cfg.tied_embeddings
+            else params["head"]["weight"]
+        )
+        logits = (x @ head_w.astype(cdt)).astype(jnp.float32)
     return logits, k_pages, v_pages
 
 

@@ -177,6 +177,7 @@ class ServingEngine:
         kv_cache_dtype: Optional[str] = None,
         speculative_draft_len: int = 0,
         speculative_ngram: int = 2,
+        decode_weight_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
         # Sampled token ids round-trip through float32 in the packed
@@ -264,6 +265,21 @@ class ServingEngine:
         # slots) — the realized speculation yield.
         self._spec_emitted = 0
         self._spec_steps = 0
+        # int8 DECODE weights (W8A16, ops/wquant.py): halves the weight
+        # stream per decode step; prefill keeps the bf16 params, so
+        # prompt processing is identical to the unquantized engine.
+        if decode_weight_dtype is None:
+            decode_weight_dtype = (
+                os.environ.get("AREAL_DECODE_WEIGHT_DTYPE") or None
+            )
+        if decode_weight_dtype not in (None, "model") and mesh is not None:
+            raise ValueError(
+                "decode_weight_dtype with a TP mesh is not supported yet "
+                "(quantized-scale shardings unverified); drop one"
+            )
+        self.decode_weight_dtype = decode_weight_dtype
+        self._qparams = None
+        self._refresh_qparams()
         # Token history per slot (prompt + emitted; one scratch column
         # for masked scatter writes). int32 [B, S+1]: tiny next to KV.
         self._history = (
@@ -488,6 +504,23 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # Engine loop
     # ------------------------------------------------------------------
+
+    def _refresh_qparams(self):
+        """(Re)build the int8 decode-weight tree from the live params —
+        at init and after every weight swap."""
+        if self.decode_weight_dtype is None:
+            return
+        from areal_tpu.ops.wquant import maybe_quantize_decode_weights
+
+        self._qparams = maybe_quantize_decode_weights(
+            self.params, self.cfg.tied_embeddings, self.decode_weight_dtype
+        )
+
+    @property
+    def _decode_params(self):
+        """Param tree the DECODE blocks run on (quantized when
+        decode_weight_dtype is set); prefill always uses self.params."""
+        return self._qparams if self._qparams is not None else self.params
 
     def _ensure_pool(self):
         if self._k_pages is not None:
@@ -1007,6 +1040,7 @@ class ServingEngine:
             # Transfers were staged on the updater's thread
             # (update_params); this is a pointer flip + completion sync.
             self.params = pending
+            self._refresh_qparams()
             jax.block_until_ready(self.params)
             # block_until_ready does NOT wait on tunneled devices (see
             # docs/perf_notes.md); fetch one element of the last leaf —
@@ -1121,7 +1155,8 @@ class ServingEngine:
                 (packed, self._k_pages, self._v_pages, lengths,
                  next_input, active, remaining, min_remaining, self._rng,
                  self._history) = paged_spec_decode_block(
-                    self.params, self.cfg, self._k_pages, self._v_pages,
+                    self._decode_params, self.cfg, self._k_pages,
+                    self._v_pages,
                     self._pt_dev, lengths, next_input, active, remaining,
                     min_remaining, temps, top_ps, top_ks, greedy,
                     eos_global, self._rng, self._history,
@@ -1134,7 +1169,8 @@ class ServingEngine:
                 (packed, self._k_pages, self._v_pages, lengths, next_input,
                  active, remaining, min_remaining,
                  self._rng) = paged_decode_block(
-                    self.params, self.cfg, self._k_pages, self._v_pages,
+                    self._decode_params, self.cfg, self._k_pages,
+                    self._v_pages,
                     self._pt_dev, lengths, next_input, active, remaining,
                     min_remaining, temps, top_ps, top_ks, greedy,
                     eos_global, self._rng,

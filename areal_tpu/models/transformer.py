@@ -142,21 +142,25 @@ def _norm(x, p, cfg):
 
 
 def _mlp(h, lp, cfg, cdt):
+    # qmat == `h @ w.astype(cdt)` for plain weights; the serving decode
+    # path may pass (int8, scale) pairs instead (ops/wquant.py).
+    from areal_tpu.ops.wquant import qmat
+
     act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
     if cfg.mlp_type == "gated":
-        g = h @ lp["w_gate"].astype(cdt)
-        u = h @ lp["w_up"].astype(cdt)
+        g = qmat(h, lp["w_gate"], cdt)
+        u = qmat(h, lp["w_up"], cdt)
         if "b_gate" in lp:
             g = g + lp["b_gate"].astype(cdt)
             u = u + lp["b_up"].astype(cdt)
-        out = (act(g) * u) @ lp["w_down"].astype(cdt)
+        out = qmat(act(g) * u, lp["w_down"], cdt)
         if "b_down" in lp:
             out = out + lp["b_down"].astype(cdt)
     else:
-        u = h @ lp["w_in"].astype(cdt)
+        u = qmat(h, lp["w_in"], cdt)
         if "b_in" in lp:
             u = u + lp["b_in"].astype(cdt)
-        out = act(u) @ lp["w_out"].astype(cdt)
+        out = qmat(act(u), lp["w_out"], cdt)
         if "b_out" in lp:
             out = out + lp["b_out"].astype(cdt)
     return out

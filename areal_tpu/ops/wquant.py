@@ -1,0 +1,112 @@
+"""Per-channel int8 weight quantization for the serving DECODE path
+(W8A16).
+
+Decode at small batch is weight-streaming-bound: every decode step reads
+the full parameter set from HBM. An int8 copy of the decode-path matmul
+weights halves that stream; activations, norms, biases, the embedding
+lookup, and every PREFILL path stay bf16 (prefill is compute-bound and
+runs the unquantized params, so prompt processing is bit-identical to
+the unquantized engine). The reference's serving backend has no weight
+quantization (realhf/impl/model/backend/sglang.py) — TPU-side
+extension, opt-in via ServingEngine(decode_weight_dtype="int8").
+
+Convention: w ≈ w_q * scale with scale = absmax(w, input_dim) / 127
+per output channel (symmetric int8, no rint(127.5) wrap), so the
+dequant commutes with the matmul:
+(h @ (w_q * s)) == (h @ w_q) * s — qmat scales the OUTPUT, keeping the
+int8->bf16 convert adjacent to the dot for XLA to fuse into the operand
+read (whether it does is exactly what the staged chip A/B measures).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_Q = 127.0  # symmetric int8 range used for weights (no rint(127.5) wrap)
+
+# Decode-path matmul weight names (attention projections + dense MLP).
+_QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "w_in", "w_out"}
+)
+
+
+def quantize_weight(w: jnp.ndarray):
+    """[..., in, out] float -> (int8 [..., in, out], scale [..., out])."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(w32), axis=-2), 1e-8) / _Q
+    q = jnp.clip(jnp.round(w32 / s[..., None, :]), -_Q, _Q).astype(jnp.int8)
+    return q, s
+
+
+def qmat(h: jnp.ndarray, w, cdt) -> jnp.ndarray:
+    """h @ w for a plain weight, or (h @ w_q) * scale for a quantized
+    (int8, scale) pair. The plain branch is byte-identical to the
+    expression it replaced (`h @ w.astype(cdt)`)."""
+    if isinstance(w, tuple):
+        wq, s = w
+        return (h @ wq.astype(cdt)) * s.astype(cdt)
+    return h @ w.astype(cdt)
+
+
+def quantize_decode_weights(params, tied_embeddings: bool):
+    """Build the decode-path int8 param tree from a served param tree.
+
+    Returns a NEW dict sharing every unquantized leaf with `params`
+    (embedding for the token lookup, norms, biases, MoE experts — the
+    ragged/einsum dispatch stays bf16), with:
+      - layers/attn wq|wk|wv|wo and dense-MLP weights -> (int8, scale)
+      - "head_q": quantized LM head ((embedding.T) for tied weights)
+    Leaves keep their leading stacked-layer dim; scales reduce the
+    input dim only, so per-layer scan slices stay aligned."""
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            if k in _QUANT_KEYS and not isinstance(v, dict):
+                out[k] = quantize_weight(v)
+            else:
+                out[k] = walk(v)
+        return out
+
+    q = dict(params)
+    q["layers"] = {}
+    for k, v in params["layers"].items():
+        if k == "mlp" and "router" in v:
+            # MoE block: expert weights keep bf16 (the grouped/einsum
+            # dispatch is not a plain h @ w; documented skip).
+            q["layers"][k] = v
+        else:
+            q["layers"][k] = walk(v)
+    head_w = (
+        params["embedding"]["weight"].T
+        if tied_embeddings
+        else params["head"]["weight"]
+    )
+    q["head_q"] = quantize_weight(head_w)
+    return q
+
+
+# Module-level jit: one compile per (tree structure, tied) — a fresh
+# jit per weight swap would retrace and recompile the whole transform
+# on the serve loop every async-RL update.
+_quantize_jit = jax.jit(
+    quantize_decode_weights, static_argnames=("tied_embeddings",)
+)
+
+
+def maybe_quantize_decode_weights(
+    params, tied_embeddings: bool, dtype: Optional[str]
+):
+    if dtype is None or dtype == "model":
+        return None
+    if dtype != "int8":
+        raise ValueError(
+            f"decode_weight_dtype={dtype!r}: expected None, 'model', or "
+            f"'int8'"
+        )
+    return _quantize_jit(params, tied_embeddings=tied_embeddings)

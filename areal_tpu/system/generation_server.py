@@ -76,6 +76,7 @@ class GenerationServer(Worker):
             kv_cache_dtype=config.kv_cache_dtype,
             speculative_draft_len=config.speculative_draft_len,
             speculative_ngram=config.speculative_ngram,
+            decode_weight_dtype=config.decode_weight_dtype,
             mesh=mesh,
         )
         self.engine.start()

@@ -63,6 +63,12 @@ AREAL_PROBE_GREEDY=1 AREAL_SPEC_DRAFT=4 timeout 2400 \
     > "$OUT/gen_spec.json" 2> "$OUT/gen_spec.log"
 cat "$OUT/gen_greedy.json" "$OUT/gen_spec.json" || true
 
+echo "== 5c. int8 decode weights A/B (gen phases) =="
+AREAL_DECODE_WEIGHT_DTYPE=int8 timeout 2400 \
+    python scripts/long_context_probe.py gen \
+    > "$OUT/gen_w8.json" 2> "$OUT/gen_w8.log"
+cat "$OUT/gen_w8.json" || true
+
 echo "== 6. MFU sweep (CE chunk + splash blocks) =="
 timeout 3000 python scripts/mfu_sweep.py blocks > "$OUT/sweep_blocks.json" \
     2> "$OUT/sweep_blocks.log"

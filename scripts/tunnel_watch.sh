@@ -53,6 +53,9 @@ wait_up; run_step probe_gen_greedy 2400 env AREAL_PROBE_GREEDY=1 \
     python scripts/long_context_probe.py gen
 wait_up; run_step probe_gen_spec 2400 env AREAL_PROBE_GREEDY=1 \
     AREAL_SPEC_DRAFT=4 python scripts/long_context_probe.py gen
+# int8 decode weights A/B (runbook step 5c).
+wait_up; run_step probe_gen_w8 2400 env AREAL_DECODE_WEIGHT_DTYPE=int8 \
+    python scripts/long_context_probe.py gen
 wait_up; run_step probe_sortskip 2400 python scripts/long_context_probe.py sortskip
 wait_up; run_step flash_parity 1800 python -m pytest tests/model/test_flash_attn.py -q --no-header
 wait_up; run_step sweep_mbs 2400 python scripts/mfu_sweep.py mbs

@@ -1,0 +1,186 @@
+"""int8 decode weights (W8A16, ops/wquant.py): quantization error
+bounds, qmat semantics, transform structure, and ServingEngine e2e —
+prefill runs the bf16 params so the FIRST sampled token is identical to
+the unquantized engine; decode runs the int8 copy."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from areal_tpu.engine.serving import GenRequest, ServingEngine
+from areal_tpu.models.config import MoEConfig, TransformerConfig
+from areal_tpu.models.transformer import init_params
+from areal_tpu.ops.wquant import (
+    qmat,
+    quantize_decode_weights,
+    quantize_weight,
+)
+
+CFG = TransformerConfig(
+    n_layers=2,
+    hidden_dim=32,
+    n_q_heads=2,
+    n_kv_heads=1,
+    head_dim=16,
+    intermediate_dim=64,
+    vocab_size=64,
+    max_position_embeddings=512,
+    compute_dtype="float32",
+    param_dtype="float32",
+)
+EOS = 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_quantize_weight_roundtrip_bound():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    q, s = quantize_weight(w)
+    assert q.dtype == jnp.int8 and s.shape == (16,)
+    back = np.asarray(q, np.float32) * np.asarray(s)[None, :]
+    # error per element <= half a step (scale itself)
+    assert np.abs(back - np.asarray(w)).max() <= np.asarray(s).max() * 0.51
+
+
+def test_qmat_plain_is_identity_expression():
+    rng = np.random.RandomState(1)
+    h = jnp.asarray(rng.randn(3, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(qmat(h, w, jnp.float32)),
+        np.asarray(h @ w.astype(jnp.float32)),
+    )
+
+
+def test_qmat_quantized_matches_dequantized_matmul():
+    rng = np.random.RandomState(2)
+    h = jnp.asarray(rng.randn(3, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+    q, s = quantize_weight(w)
+    got = np.asarray(qmat(h, (q, s), jnp.float32))
+    want = np.asarray(h @ (q.astype(jnp.float32) * s[None, :]))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    # and close to the true matmul (quantization-bounded)
+    true = np.asarray(h @ w)
+    assert np.abs(got - true).max() < 0.1 * np.abs(true).max() + 0.1
+
+
+def test_transform_structure(params):
+    q = quantize_decode_weights(params, CFG.tied_embeddings)
+    assert isinstance(q["layers"]["attn"]["wq"], tuple)
+    assert isinstance(q["layers"]["mlp"]["w_down"], tuple)
+    assert "head_q" in q
+    # unquantized leaves are SHARED, not copied
+    assert q["embedding"]["weight"] is params["embedding"]["weight"]
+    assert q["layers"]["ln1"] is not None
+    # leading layer dim preserved on both members
+    wq, s = q["layers"]["attn"]["wq"]
+    assert wq.shape[0] == CFG.n_layers and s.shape[0] == CFG.n_layers
+
+
+def test_transform_skips_moe_experts():
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=1, head_dim=16,
+        intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+        param_dtype="float32",
+        moe=MoEConfig(num_experts=2, top_k=1, expert_intermediate_dim=32),
+    )
+    p = init_params(cfg, jax.random.PRNGKey(1))
+    q = quantize_decode_weights(p, cfg.tied_embeddings)
+    # MoE mlp subtree untouched (shared), attn still quantized.
+    assert q["layers"]["mlp"] is p["layers"]["mlp"]
+    assert isinstance(q["layers"]["attn"]["wq"], tuple)
+
+
+def _run(engine, reqs, timeout=120):
+    results = {}
+    done = threading.Event()
+
+    def cb(res):
+        results[res.qid] = res
+        if len(results) == len(reqs):
+            done.set()
+
+    for r in reqs:
+        r.done_cb = cb
+        engine.submit(r)
+    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
+    return results
+
+
+def _engine(params, **kw):
+    base = dict(
+        max_batch_size=2, max_seq_len=128, decode_block_steps=4,
+        prompt_bucket=8, eos_token_id=EOS, seed=0, page_size=8,
+    )
+    base.update(kw)
+    return ServingEngine(CFG, params, **base)
+
+
+def test_engine_int8_weights_e2e(params):
+    reqs = lambda: [  # noqa: E731
+        GenRequest(qid="a", input_ids=[9, 21, 33, 4], max_new_tokens=12,
+                   greedy=True),
+        GenRequest(qid="b", input_ids=[7, 11, 13], max_new_tokens=12,
+                   greedy=True),
+    ]
+    eng16 = _engine(params)
+    eng16.start()
+    try:
+        plain = _run(eng16, reqs())
+    finally:
+        eng16.stop()
+
+    eng8 = _engine(params, decode_weight_dtype="int8")
+    eng8.start()
+    try:
+        q = _run(eng8, reqs())
+        for qid, r in q.items():
+            assert r.error is None
+            assert 1 <= len(r.output_ids) <= 12
+            # Prefill is unquantized, so the FIRST token (sampled from
+            # the prefill logits) matches the bf16 engine exactly.
+            assert r.output_ids[0] == plain[qid].output_ids[0], qid
+            assert all(np.isfinite(r.output_logprobs))
+    finally:
+        eng8.stop()
+
+
+def test_engine_all_three_serving_extensions(params):
+    """int8 KV pool + speculative decoding + int8 decode weights, one
+    engine: the full W8A16+KV8+spec stack completes with sane outputs."""
+    eng = _engine(
+        params, kv_cache_dtype="int8", speculative_draft_len=3,
+        decode_weight_dtype="int8",
+    )
+    eng.start()
+    try:
+        res = _run(eng, [GenRequest(
+            qid="x", input_ids=[2, 3, 2, 3, 2, 3], max_new_tokens=16,
+            greedy=True,
+        )])
+        r = res["x"]
+        assert r.error is None and 1 <= len(r.output_ids) <= 16
+        assert eng.metrics()["spec_tokens_per_step"] >= 1.0
+    finally:
+        eng.stop()
+
+
+def test_engine_rejects_tp_with_int8_weights(params):
+    from areal_tpu.engine.serving import serving_mesh
+
+    with pytest.raises(ValueError, match="decode_weight_dtype"):
+        ServingEngine(CFG, params, decode_weight_dtype="int8",
+                      mesh=serving_mesh(2))
+
+
+def test_bad_dtype_rejected(params):
+    with pytest.raises(ValueError, match="decode_weight_dtype"):
+        ServingEngine(CFG, params, decode_weight_dtype="fp4")
