@@ -34,6 +34,9 @@ from areal_tpu.models.config import TransformerConfig
 from areal_tpu.ops.attention import packed_attention, reference_packed_attention
 from areal_tpu.ops.norms import layer_norm, rms_norm
 from areal_tpu.ops.rotary import apply_rotary, rotary_cos_sin, rotary_inv_freq
+# qmat == `h @ w.astype(cdt)` for plain weights; the serving decode path
+# may pass (int8, scale) pairs instead (ops/wquant.py W8A16).
+from areal_tpu.ops.wquant import qmat
 
 Params = Dict[str, Any]
 
@@ -142,10 +145,6 @@ def _norm(x, p, cfg):
 
 
 def _mlp(h, lp, cfg, cdt):
-    # qmat == `h @ w.astype(cdt)` for plain weights; the serving decode
-    # path may pass (int8, scale) pairs instead (ops/wquant.py).
-    from areal_tpu.ops.wquant import qmat
-
     act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
     if cfg.mlp_type == "gated":
         g = qmat(h, lp["w_gate"], cdt)

@@ -33,6 +33,25 @@ apply_jax_platform_override()
 BASELINE_TFLOPS = 198.0
 
 
+def flagship_cfg(max_pos: int = 40960, attn_bias: bool = True):
+    """The benchmark model shape: R1-Distill-Qwen-1.5B-class layers
+    (hidden 1536, 12 q / 2 kv heads, head_dim 128, ffn 8960 — the family
+    the reference's headline benchmark trains,
+    benchmark/verl_v0_3_0_post1_76084d3/README.md:38-44), trimmed to 16
+    layers / 32k vocab so params + fp32 Adam moments + activations fit
+    one v5e chip's 16 GB HBM. Shared by bench.py and the perf scripts
+    (mfu_sweep, long_context_probe) so every banked number measures the
+    SAME model."""
+    from areal_tpu.models.config import TransformerConfig
+
+    return TransformerConfig(
+        n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
+        head_dim=128, intermediate_dim=8960, vocab_size=32768,
+        attn_bias=attn_bias, compute_dtype="bfloat16",
+        param_dtype="bfloat16", max_position_embeddings=max_pos,
+    )
+
+
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
@@ -71,11 +90,7 @@ def gen_bench(on_tpu: bool, long_form: bool = False) -> float:
     from areal_tpu.models.transformer import init_params
 
     if on_tpu:
-        cfg = TransformerConfig(
-            n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
-            head_dim=128, intermediate_dim=8960, vocab_size=32768,
-            attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
-        )
+        cfg = flagship_cfg()
         if long_form:
             # ~1.2 GB of paged KV at bf16 alongside the 3.5 GB params.
             n_reqs, plen, max_new, page, block = 8, 1024, 8192, 128, 32
@@ -160,20 +175,10 @@ def train_bench() -> tuple:
     log(f"bench: platform={platform} n_devices={len(jax.devices())}")
 
     if on_tpu:
-        # R1-Distill-Qwen-1.5B-shape layers (hidden 1536, 12 q / 2 kv heads,
-        # head_dim 128, ffn 8960) — the model family the reference's
-        # headline benchmark trains (benchmark/verl_v0_3_0_post1_76084d3/
-        # README.md:38-44). Depth (16 vs 28 layers) and vocab (32k) are
-        # trimmed so the model + fp32 Adam moments + activations fit one
-        # v5e chip's 16 GB HBM; per-chip TFLOP/s is shape-, not
-        # depth-sensitive. Params in bf16 with fp32 optimizer moments
+        # flagship_cfg: params in bf16 with fp32 optimizer moments
         # (weights stream at half the bytes; update math stays fp32 —
         # measured +18 TFLOP/s over fp32 params, scripts/perf_probe.py).
-        cfg = TransformerConfig(
-            n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
-            head_dim=128, intermediate_dim=8960, vocab_size=32768,
-            attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
-        )
+        cfg = flagship_cfg()
         seqlen, n_seqs, n_warmup, n_steps = 2048, 16, 2, 5
     else:
         # CPU smoke mode so dev runs terminate quickly.

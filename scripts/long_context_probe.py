@@ -60,21 +60,12 @@ def flagship_cfg(max_pos=40960):
             compute_dtype="float32", param_dtype="float32",
             max_position_embeddings=max_pos,
         )
-    return TransformerConfig(
-        n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
-        head_dim=128, intermediate_dim=8960, vocab_size=32768,
-        attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
-        max_position_embeddings=max_pos,
-    )
+    from bench import flagship_cfg as bench_flagship
+
+    return bench_flagship(max_pos=max_pos)
 
 
-def train_step_flops(cfg, n_params, seqlens):
-    total = 0.0
-    q_dim = cfg.n_q_heads * cfg.head_dim
-    for l in seqlens:
-        total += 6.0 * n_params * l
-        total += 6.0 * cfg.n_layers * q_dim * float(l) * l
-    return total
+from bench import train_step_flops  # shared formula with bench.py  # noqa: E402
 
 
 def probe_train(seq_tokens: int, remat: str = "save_attn"):

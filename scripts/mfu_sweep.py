@@ -34,7 +34,10 @@ apply_jax_platform_override()
 import jax
 import numpy as np
 
-from bench import train_step_flops  # shared formula: rows stay comparable
+from bench import (  # shared shape + formula: rows stay comparable
+    flagship_cfg,
+    train_step_flops,
+)
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.engine.jax_engine import JaxTrainEngine
 from areal_tpu.engine.optimizer import OptimizerConfig
@@ -62,12 +65,7 @@ def cfg_and_shape():
             compute_dtype="float32",
         )
         return cfg, 128, 4, 1, 2
-    cfg = TransformerConfig(
-        n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
-        head_dim=128, intermediate_dim=8960, vocab_size=32768,
-        attn_bias=True, compute_dtype="bfloat16", param_dtype="bfloat16",
-    )
-    return cfg, 2048, 16, 2, 4
+    return flagship_cfg(), 2048, 16, 2, 4
 
 
 def measure(env: dict, n_mbs: int = 1) -> float:

@@ -1,0 +1,12 @@
+import jax
+import pytest
+
+from areal_tpu.models.transformer import init_params
+from tests.engine.serving_utils import TINY_SERVING_CFG
+
+
+@pytest.fixture(scope="package")
+def params():
+    """Params for serving_utils.TINY_SERVING_CFG, shared package-wide.
+    Modules that need a different model define their own `params`."""
+    return init_params(TINY_SERVING_CFG, jax.random.PRNGKey(0))

@@ -1,0 +1,37 @@
+"""Shared serving-engine test harness: the tiny 2-layer model and the
+submit-and-wait runner the engine test modules were each copying."""
+
+import threading
+
+from areal_tpu.models.config import TransformerConfig
+
+TINY_SERVING_CFG = TransformerConfig(
+    n_layers=2,
+    hidden_dim=32,
+    n_q_heads=2,
+    n_kv_heads=1,
+    head_dim=16,
+    intermediate_dim=64,
+    vocab_size=64,
+    max_position_embeddings=512,
+    compute_dtype="float32",
+    param_dtype="float32",
+)
+TINY_EOS = 5
+
+
+def run_requests(engine, reqs, timeout=120):
+    """Submit all requests, wait for every callback, return {qid: result}."""
+    results = {}
+    done = threading.Event()
+
+    def cb(res):
+        results[res.qid] = res
+        if len(results) == len(reqs):
+            done.set()
+
+    for r in reqs:
+        r.done_cb = cb
+        engine.submit(r)
+    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
+    return results

@@ -7,8 +7,6 @@ The reference's serving backend has no KV quantization
 decode is HBM-bandwidth-bound streaming KV pages, so int8 halves the
 bytes per token and doubles the tokens a pool budget holds."""
 
-import threading
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -25,25 +23,11 @@ from areal_tpu.engine.paged import (
 from areal_tpu.engine.serving import GenRequest, ServingEngine
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import init_params
-
-CFG = TransformerConfig(
-    n_layers=2,
-    hidden_dim=32,
-    n_q_heads=2,
-    n_kv_heads=1,
-    head_dim=16,
-    intermediate_dim=64,
-    vocab_size=64,
-    max_position_embeddings=512,
-    compute_dtype="float32",
-    param_dtype="float32",
+from tests.engine.serving_utils import (
+    TINY_EOS as EOS,
+    TINY_SERVING_CFG as CFG,
+    run_requests as _run,
 )
-EOS = 5
-
-
-@pytest.fixture(scope="module")
-def params():
-    return init_params(CFG, jax.random.PRNGKey(0))
 
 
 def test_quantize_roundtrip_bound():
@@ -188,22 +172,6 @@ def test_scatter_prefill_quantized_roundtrip():
     )
     err = np.abs(np.asarray(got) - want)
     assert err.max() < np.abs(want).max() / 100, err.max()
-
-
-def _run(engine, reqs, timeout=120):
-    results = {}
-    done = threading.Event()
-
-    def cb(res):
-        results[res.qid] = res
-        if len(results) == len(reqs):
-            done.set()
-
-    for r in reqs:
-        r.done_cb = cb
-        engine.submit(r)
-    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
-    return results
 
 
 def test_serving_engine_int8_e2e(params):

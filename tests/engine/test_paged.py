@@ -2,7 +2,6 @@
 dense decode oracle, prefill scatter round-trip, pool-pressure
 preemption, long (8k) context service, and the mesh-sharded engine."""
 
-import threading
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +19,7 @@ from areal_tpu.engine.serving import GenRequest, ServingEngine, serving_mesh
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import init_params
 from areal_tpu.ops.attention import decode_attention
+from tests.engine.serving_utils import run_requests as _run
 
 CFG = TransformerConfig(
     n_layers=2,
@@ -41,20 +41,9 @@ def params():
     return init_params(CFG, jax.random.PRNGKey(0))
 
 
-def _run(engine, reqs, timeout=120):
-    results = {}
-    done = threading.Event()
-
-    def cb(res):
-        results[res.qid] = res
-        if len(results) == len(reqs):
-            done.set()
-
-    for r in reqs:
-        r.done_cb = cb
-        engine.submit(r)
-    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
-    return results
+# CFG here differs from serving_utils.TINY_SERVING_CFG (16k positions
+# for the long-context test), so the module keeps its own params
+# fixture; the runner is shared.
 
 
 # ----------------------------------------------------------------------

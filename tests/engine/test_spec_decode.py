@@ -4,8 +4,6 @@ guarantee — a spec-decoding engine's GREEDY output is bit-identical to
 the plain engine's (the reference's serving stack has no speculative
 decoding; this is a TPU-side extension)."""
 
-import threading
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -13,27 +11,11 @@ import pytest
 
 from areal_tpu.engine.serving import GenRequest, ServingEngine
 from areal_tpu.engine.spec_decode import propose_ngram_drafts, spec_verify
-from areal_tpu.models.config import TransformerConfig
-from areal_tpu.models.transformer import init_params
-
-CFG = TransformerConfig(
-    n_layers=2,
-    hidden_dim=32,
-    n_q_heads=2,
-    n_kv_heads=1,
-    head_dim=16,
-    intermediate_dim=64,
-    vocab_size=64,
-    max_position_embeddings=512,
-    compute_dtype="float32",
-    param_dtype="float32",
+from tests.engine.serving_utils import (
+    TINY_EOS as EOS,
+    TINY_SERVING_CFG as CFG,
+    run_requests as _run,
 )
-EOS = 5
-
-
-@pytest.fixture(scope="module")
-def params():
-    return init_params(CFG, jax.random.PRNGKey(0))
 
 
 # ----------------------------------------------------------------------
@@ -195,22 +177,6 @@ def test_verify_eff_zero_reduces_to_plain_sample():
 # ----------------------------------------------------------------------
 
 
-def _run(engine, reqs, timeout=120):
-    results = {}
-    done = threading.Event()
-
-    def cb(res):
-        results[res.qid] = res
-        if len(results) == len(reqs):
-            done.set()
-
-    for r in reqs:
-        r.done_cb = cb
-        engine.submit(r)
-    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
-    return results
-
-
 def _greedy_reqs():
     return [
         GenRequest(qid="a", input_ids=[9, 21, 33, 4, 9, 21], max_new_tokens=24,
@@ -306,6 +272,44 @@ def test_spec_yield_metric(params):
         # Exact accounting (active-steps denominator): an active slot
         # emits >= 1 token per step, so the yield floor is 1.0.
         assert m["spec_tokens_per_step"] >= 1.0
+    finally:
+        eng.stop()
+
+
+def test_spec_with_prefix_cache_resubmission(params):
+    """Partial-rollout resubmission under speculation: the cache-hit
+    admit prefills only the delta but the history row must hold the FULL
+    prompt (drafts match against cached-prefix content too)."""
+    eng = _engine(params, speculative_draft_len=3, eos_token_id=None,
+                  prefill_chunk=8, prefix_cache_tokens=256)
+    eng.start()
+    try:
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        r1 = _run(eng, [GenRequest(qid="pc", input_ids=list(prompt),
+                                   max_new_tokens=6, greedy=True)])["pc"]
+        assert len(r1.output_ids) == 6
+        r2 = _run(eng, [GenRequest(
+            qid="pc", input_ids=list(prompt) + list(r1.output_ids),
+            max_new_tokens=5, greedy=True)])["pc"]
+        assert len(r2.output_ids) == 5
+        assert eng.prefix_cache_hits == 1
+
+        # Same continuation as a spec-less engine run end-to-end
+        # (lossless under greedy, even across the resubmission).
+        eng0 = _engine(params, eos_token_id=None, prefill_chunk=8,
+                       prefix_cache_tokens=256)
+        eng0.start()
+        try:
+            p1 = _run(eng0, [GenRequest(qid="pc", input_ids=list(prompt),
+                                        max_new_tokens=6,
+                                        greedy=True)])["pc"]
+            p2 = _run(eng0, [GenRequest(
+                qid="pc", input_ids=list(prompt) + list(p1.output_ids),
+                max_new_tokens=5, greedy=True)])["pc"]
+        finally:
+            eng0.stop()
+        assert r1.output_ids == p1.output_ids
+        assert r2.output_ids == p2.output_ids
     finally:
         eng.stop()
 

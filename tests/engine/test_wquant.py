@@ -3,8 +3,6 @@ bounds, qmat semantics, transform structure, and ServingEngine e2e —
 prefill runs the bf16 params so the FIRST sampled token is identical to
 the unquantized engine; decode runs the int8 copy."""
 
-import threading
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,25 +16,11 @@ from areal_tpu.ops.wquant import (
     quantize_decode_weights,
     quantize_weight,
 )
-
-CFG = TransformerConfig(
-    n_layers=2,
-    hidden_dim=32,
-    n_q_heads=2,
-    n_kv_heads=1,
-    head_dim=16,
-    intermediate_dim=64,
-    vocab_size=64,
-    max_position_embeddings=512,
-    compute_dtype="float32",
-    param_dtype="float32",
+from tests.engine.serving_utils import (
+    TINY_EOS as EOS,
+    TINY_SERVING_CFG as CFG,
+    run_requests as _run,
 )
-EOS = 5
-
-
-@pytest.fixture(scope="module")
-def params():
-    return init_params(CFG, jax.random.PRNGKey(0))
 
 
 def test_quantize_weight_roundtrip_bound():
@@ -97,22 +81,6 @@ def test_transform_skips_moe_experts():
     # MoE mlp subtree untouched (shared), attn still quantized.
     assert q["layers"]["mlp"] is p["layers"]["mlp"]
     assert isinstance(q["layers"]["attn"]["wq"], tuple)
-
-
-def _run(engine, reqs, timeout=120):
-    results = {}
-    done = threading.Event()
-
-    def cb(res):
-        results[res.qid] = res
-        if len(results) == len(reqs):
-            done.set()
-
-    for r in reqs:
-        r.done_cb = cb
-        engine.submit(r)
-    assert done.wait(timeout), f"only {len(results)}/{len(reqs)} finished"
-    return results
 
 
 def _engine(params, **kw):
