@@ -76,8 +76,10 @@ timeout 2400 python scripts/mfu_sweep.py ce > "$OUT/sweep_ce.json" \
     2> "$OUT/sweep_ce.log"
 tail -1 "$OUT/sweep_blocks.json" "$OUT/sweep_ce.json" || true
 
-echo "== 7. async-vs-sync speedup (chip mode) =="
-echo "needs real paths; run:"
+echo "== 7. async-vs-sync speedup (chip mode; needs >= 2 chips) =="
+echo "gen server + trainer are separate processes and a TPU chip is"
+echo "single-process-exclusive, so this cannot run on the one tunneled"
+echo "chip (docs/perf_notes.md). On a 2+ chip allotment run:"
 echo "  python scripts/async_speedup_bench.py --mode chip \\"
 echo "      --tokenizer <hf-tokenizer-dir> --dataset <math.jsonl> \\"
 echo "      --steps 6 --warmup-steps 2 --out $OUT/speedup.json"
