@@ -314,6 +314,34 @@ def test_spec_with_prefix_cache_resubmission(params):
         eng.stop()
 
 
+def test_spec_under_tensor_parallel_mesh():
+    """Speculation on a TP=2 mesh: the multi-row decode step partitions
+    like the plain one; history stays replicated."""
+    from areal_tpu.engine.serving import serving_mesh
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=4, n_kv_heads=2, head_dim=8,
+        intermediate_dim=64, vocab_size=64, max_position_embeddings=256,
+        compute_dtype="float32", param_dtype="float32",
+    )
+    p = init_params(cfg, jax.random.PRNGKey(5))
+    eng = ServingEngine(
+        cfg, p, mesh=serving_mesh(2), speculative_draft_len=3,
+        max_batch_size=2, max_seq_len=64, decode_block_steps=4,
+        prompt_bucket=8, eos_token_id=None, seed=0, page_size=8,
+    )
+    eng.start()
+    try:
+        res = _run(eng, [GenRequest(qid="tp", input_ids=[5, 6, 5, 6],
+                                    max_new_tokens=10, greedy=True)])
+        assert res["tp"].error is None
+        assert len(res["tp"].output_ids) == 10
+    finally:
+        eng.stop()
+
+
 def test_spec_budget_exact(params):
     eng = _engine(params, speculative_draft_len=4, eos_token_id=None)
     eng.start()

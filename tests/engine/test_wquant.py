@@ -152,3 +152,37 @@ def test_engine_rejects_tp_with_int8_weights(params):
 def test_bad_dtype_rejected(params):
     with pytest.raises(ValueError, match="decode_weight_dtype"):
         ServingEngine(CFG, params, decode_weight_dtype="fp4")
+
+
+def test_qparams_rebuilt_on_weight_update(params):
+    """A weight swap must rebuild the int8 decode copy (stale quantized
+    weights would silently serve the OLD policy after an async update)."""
+    import time
+
+    eng = _engine(params, decode_weight_dtype="int8", eos_token_id=None)
+    eng.start()
+    try:
+        _run(eng, [GenRequest(qid="a", input_ids=[3, 4, 5],
+                              max_new_tokens=4, greedy=True)])
+        old_q, old_s = eng._qparams["layers"]["attn"]["wq"]
+        new_params = jax.tree_util.tree_map(lambda x: x * 1.5, params)
+        eng.update_params(new_params, allow_interrupt=True, version=7)
+        for _ in range(200):
+            if eng.version == 7:
+                break
+            time.sleep(0.1)
+        assert eng.version == 7
+        new_q = eng._qparams["layers"]["attn"]["wq"][0]
+        assert new_q is not old_q
+        # int8 codes are scale-invariant under uniform scaling, but the
+        # SCALES must reflect the new magnitudes.
+        np.testing.assert_allclose(
+            np.asarray(eng._qparams["layers"]["attn"]["wq"][1]),
+            np.asarray(old_s) * 1.5, rtol=1e-5,
+        )
+        r = _run(eng, [GenRequest(qid="b", input_ids=[3, 4, 5],
+                                  max_new_tokens=4, greedy=True)])["b"]
+        assert r.error is None and len(r.output_ids) == 4
+        assert r.version_start == 7
+    finally:
+        eng.stop()
