@@ -74,6 +74,7 @@ class GserverManager(Worker):
         self._server_gen_totals = {u: 0.0 for u in self.server_urls}
         self._server_prefix_hits = {u: 0.0 for u in self.server_urls}
         self._server_prefix_reused = {u: 0.0 for u in self.server_urls}
+        self._server_spec_yield = {u: 0.0 for u in self.server_urls}
         self._last_gen_total = 0.0
         self._last_throughput_log = time.monotonic()
         self._throughput_log_interval = 10.0
@@ -303,6 +304,10 @@ class GserverManager(Worker):
                             self._server_prefix_reused[u] = float(
                                 line.split()[-1]
                             )
+                        elif line.startswith("areal:spec_tokens_per_step"):
+                            self._server_spec_yield[u] = float(
+                                line.split()[-1]
+                            )
                 except Exception:
                     logger.warning(f"metrics poll failed for {u}")
 
@@ -355,6 +360,15 @@ class GserverManager(Worker):
                 f"prefix_cache_hits={sum(self._server_prefix_hits.values()):.0f} "
                 f"prefix_tokens_reused="
                 f"{sum(self._server_prefix_reused.values()):.0f}"
+                + (
+                    # Realized speculation yield (mean over servers
+                    # reporting >0; 0 means speculation is off fleet-wide).
+                    f" spec_tokens_per_step="
+                    f"{sum(y) / len(y):.2f}"
+                    if (y := [v for v in self._server_spec_yield.values()
+                              if v > 0])
+                    else ""
+                )
             )
             self._last_gen_total = total_gen
             self._last_throughput_log = now
