@@ -253,8 +253,10 @@ class ServingEngine:
         # bit-identical; sampled distribution-exact) and device-resident.
         if speculative_draft_len == 0:
             # A/B hook, like AREAL_KV_CACHE_DTYPE: flips the default
-            # without plumbing (bench/probe runs).
-            speculative_draft_len = int(os.environ.get("AREAL_SPEC_DRAFT", 0))
+            # without plumbing (bench/probe runs). Empty string == unset.
+            speculative_draft_len = int(
+                os.environ.get("AREAL_SPEC_DRAFT") or 0
+            )
         assert speculative_draft_len >= 0 and speculative_ngram >= 1, (
             f"bad speculative config: draft_len={speculative_draft_len}, "
             f"ngram={speculative_ngram}"
@@ -913,11 +915,21 @@ class ServingEngine:
             # trash-routed on device, so capping is safe — not capping
             # would overrun the page-table row and kill the loop thread.
             # Speculative blocks feed 1+draft_len rows per step; every
-            # fed row writes KV, so reservation covers the worst case.
+            # fed row writes KV, so reservation covers the worst case —
+            # clamped by the slot's remaining budget: the device never
+            # writes past len + remaining (eff <= remaining - 1 and the
+            # len+remaining sum is invariant across steps), so a
+            # nearly-done slot must not over-reserve 5x and trip
+            # pool-pressure preemption it doesn't need.
             block_tokens = self.block_steps * (1 + self.spec_draft_len)
+            req = self._slot_req[slot]
+            remaining = max(
+                1, req.max_new_tokens - len(self._slot_out[slot])
+            )
             need = min(
                 pages_needed(
-                    int(self._len[slot]) + block_tokens, self.page_size
+                    int(self._len[slot]) + min(block_tokens, remaining),
+                    self.page_size,
                 ),
                 self.max_pages,
             )

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -42,7 +43,9 @@ def evaluate_checkpoint(
     num_shots: int = -1,
     max_new_tokens: int = 0,
     greedy: bool = True,
-    temperature: float = 0.0,
+    # None = take the preset's (or 1.0); 0.0 is a VALID explicit value
+    # (temperature-0 sampling), not a sentinel.
+    temperature: Optional[float] = None,
     n_samples: int = 0,
     max_prompts: int = 0,
     seed: int = 1,
@@ -94,7 +97,8 @@ def evaluate_checkpoint(
         num_shots = preset.num_shots if num_shots < 0 else num_shots
         max_new_tokens = max_new_tokens or preset.max_new_tokens
         n_samples = n_samples or preset.n_samples
-        temperature = temperature or preset.temperature
+        if temperature is None:
+            temperature = preset.temperature
         if n_samples > 1:
             greedy = False  # pass@k/maj@k need sample diversity
         if prompt_type not in PROMPT_TEMPLATES:
@@ -130,7 +134,8 @@ def evaluate_checkpoint(
             )
         max_new_tokens = max_new_tokens or 512
         n_samples = n_samples or 1
-        temperature = temperature or 1.0
+        if temperature is None:
+            temperature = 1.0
         with open(data) as f:
             rows = [json.loads(l) for l in f if l.strip()]
         if max_prompts:
