@@ -202,6 +202,37 @@ def probe_gen(plen=16384, max_new=512):
     eng.stop()
 
 
+def probe_dense_gen(B=32, plen=512, new=512):
+    """Dense-decode anchor (VERDICT r4 weak #5): the in-mesh batch
+    generator (models/generation.generate_tokens — dense [B, S] cache,
+    whole batch in lockstep, the sync-PPO path) on the SAME shape as
+    bench.py's short gen phase, so the paged engine's banked tok/s has
+    an on-chip dense comparison instead of standing alone."""
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.models.generation import generate_tokens
+
+    cfg = flagship_cfg(max_pos=4096)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, size=plen).tolist()
+               for _ in range(B)]
+    g = GenerationHyperparameters(
+        max_new_tokens=new, greedy=False, temperature=1.0,
+    )
+    # Full-shape warmup: _prefill_jit/_decode_loop are shape-specialized,
+    # so anything smaller leaves the real compiles inside the timed pass
+    # (same trap probe_sort_skip documents).
+    generate_tokens(params, cfg, prompts, g, jax.random.PRNGKey(0))
+    t0 = time.perf_counter()
+    outs = generate_tokens(params, cfg, prompts, g, jax.random.PRNGKey(1))
+    toks = sum(len(o["output_ids"]) for o in outs)
+    dt = time.perf_counter() - t0
+    emit(metric="dense_gen_tokens_per_sec", value=round(toks / dt, 1),
+         unit="tok/s", B=B, plen=plen, new=new, total_s=round(dt, 2))
+    log(f"dense gen: {toks} tokens in {dt:.2f}s -> {toks/dt:.0f} tok/s "
+        f"(paged-engine comparison: bench.py gen phase, same shape)")
+
+
 def probe_sort_skip(B=32, plen=512, new=256):
     """Decode block throughput: greedy-only (sampling sort skipped) vs
     top-k/top-p active (full-vocab sort per step)."""
@@ -375,6 +406,8 @@ def main():
         guarded("gen16k", probe_gen)
     if which in ("all", "sortskip"):
         guarded("sortskip", probe_sort_skip)
+    if which in ("all", "densegen"):
+        guarded("densegen", probe_dense_gen)
     if which == "cp":
         # Needs a multi-device allotment: run e.g.
         #   python scripts/long_context_probe.py cp d1f1s2t1,d1f1s4t1 16384

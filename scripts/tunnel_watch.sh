@@ -57,6 +57,8 @@ wait_up; run_step probe_gen_spec 2400 env AREAL_PROBE_GREEDY=1 \
 wait_up; run_step probe_gen_w8 2400 env AREAL_DECODE_WEIGHT_DTYPE=int8 \
     python scripts/long_context_probe.py gen
 wait_up; run_step probe_sortskip 2400 python scripts/long_context_probe.py sortskip
+# dense-decode anchor for the paged-engine tok/s (VERDICT r4 weak #5).
+wait_up; run_step probe_densegen 2400 python scripts/long_context_probe.py densegen
 # AREAL_ONCHIP_TESTS=1: without it tests/conftest.py pins jax to CPU and
 # the compiled-kernel parity gate silently skips instead of running.
 wait_up; run_step flash_parity 1800 env AREAL_ONCHIP_TESTS=1 \
