@@ -68,13 +68,19 @@ def cfg_and_shape():
     return flagship_cfg(), 2048, 16, 2, 4
 
 
-def measure(env: dict, n_mbs: int = 1) -> float:
+def measure(env: dict, n_mbs: int = 1, seqlen: int = 0) -> float:
     """TFLOP/s for one config. Fresh engine per call: the env overrides
-    are trace-time, so a new jit (new engine) picks them up."""
+    are trace-time, so a new jit (new engine) picks them up. seqlen > 0
+    overrides the row length, holding total tokens constant."""
     saved = {k: os.environ.get(k) for k in env}
     os.environ.update({k: str(v) for k, v in env.items()})
     try:
-        cfg, seqlen, n_seqs, n_warm, n_steps = cfg_and_shape()
+        cfg, d_seqlen, d_n_seqs, n_warm, n_steps = cfg_and_shape()
+        if seqlen:
+            total_tokens = d_seqlen * d_n_seqs
+            n_seqs = max(1, total_tokens // seqlen)
+        else:
+            seqlen, n_seqs = d_seqlen, d_n_seqs
         params = init_params(cfg, jax.random.PRNGKey(0))
         n_params = count_params(params)
         eng = JaxTrainEngine(
@@ -132,13 +138,14 @@ def measure(env: dict, n_mbs: int = 1) -> float:
 
 
 def sweep(name, configs):
-    """configs: list of (label, env, n_mbs). Emits one JSON row each and
-    a winner row at the end."""
+    """configs: list of (label, env, n_mbs[, seqlen]). Emits one JSON row
+    each and a winner row at the end."""
     best = None
-    for label, env, n_mbs in configs:
+    for label, env, n_mbs, *rest in configs:
         log(f"sweep {name}: {label} ...")
         try:
-            tflops = measure(env, n_mbs=n_mbs)
+            tflops = measure(env, n_mbs=n_mbs,
+                             seqlen=rest[0] if rest else 0)
         except Exception as e:  # OOM on one config must not kill the rest
             log(f"sweep {name}: {label} FAILED {type(e).__name__}: {e}")
             emit(sweep=name, config=label,
@@ -156,8 +163,10 @@ def sweep(name, configs):
 
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
-    if which not in ("all", "ce", "blocks", "mbs"):
-        sys.exit(f"unknown sweep {which!r}: expected all|ce|blocks|mbs")
+    if which not in ("all", "ce", "blocks", "mbs", "seqlen"):
+        sys.exit(
+            f"unknown sweep {which!r}: expected all|ce|blocks|mbs|seqlen"
+        )
     platform = jax.devices()[0].platform
     log(f"mfu_sweep: platform={platform} which={which}")
     if platform != "tpu" and not TINY:
@@ -188,6 +197,14 @@ def main():
     if which in ("all", "mbs"):
         sweep("n_mbs", [
             (f"n_mbs={m}", {}, m) for m in ((1, 2) if TINY else (1, 2, 4))
+        ])
+    if which in ("all", "seqlen"):
+        # Row length at constant total tokens: longer rows raise the
+        # attention-FLOPs fraction (higher arithmetic intensity in the
+        # splash kernel) but deepen remat recompute; measure, don't guess.
+        sweep("seqlen", [
+            (f"seqlen={s}", {}, 1, s)
+            for s in ((64, 128) if TINY else (1024, 2048, 4096, 8192))
         ])
 
 

@@ -40,6 +40,7 @@ wait_up
 run_step bench 3000 python bench.py || { wait_up; run_step bench2 3000 python bench.py; }
 wait_up; run_step sweep_blocks 3000 python scripts/mfu_sweep.py blocks
 wait_up; run_step sweep_ce 2400 python scripts/mfu_sweep.py ce
+wait_up; run_step sweep_seqlen 2400 python scripts/mfu_sweep.py seqlen
 wait_up; run_step probe_t16k 1800 python scripts/long_context_probe.py train16k
 wait_up; run_step probe_t32k 2400 python scripts/long_context_probe.py train32k
 wait_up; run_step probe_gen 2400 python scripts/long_context_probe.py gen
