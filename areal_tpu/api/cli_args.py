@@ -144,6 +144,11 @@ class BaseExperimentConfig:
     n_model_workers: int = 1
     recover_mode: str = "disabled"  # disabled | auto | resume
     recover_retries: int = 1
+    # Per-worker fault domain: serving-plane workers (generation server /
+    # rollout worker / gserver manager) that die or hang are restarted in
+    # place this many times each before the failure escalates to the
+    # whole-experiment relaunch above.
+    worker_restarts: int = 2
     name_resolve_backend: str = "nfs"
     name_resolve_root: Optional[str] = None
     mb_spec_n_mbs: int = 1

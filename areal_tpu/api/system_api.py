@@ -178,6 +178,10 @@ class GserverManagerConfig:
     train_batch_size: int = 8
     flush_request_timeout: float = 120.0
     max_concurrent_rollouts: Optional[int] = None
+    # Cadence of the health-registry fold (eviction of dead servers,
+    # re-sync + readmission of returning ones). Chaos tests shrink it
+    # together with AREAL_HEALTH_TTL for sub-second failover.
+    health_check_interval: float = 2.0
 
     @property
     def worker_name(self) -> str:
@@ -202,6 +206,9 @@ class RolloutWorkerConfig:
     new_tokens_per_chunk: int = 1 << 30  # chunked interruptible generation
     max_concurrent_rollouts: int = 32
     rollout_request_timeout: float = 300.0
+    # Per-sample failover budget: dead-server resubmissions + no-healthy-
+    # server backoff rounds before the episode errors (and is dropped).
+    rollout_max_retries: int = 8
     seed: int = 1
 
     @property

@@ -1,0 +1,208 @@
+"""Deterministic chaos-injection harness.
+
+Production code declares *named injection points* — one-line calls like
+``faults.maybe_fail("gserver.generate")`` — that are free no-ops until a
+test arms them. An armed point fires a chosen action on its k-th hit:
+
+- ``raise``: raise ``FaultInjected`` (a transient software failure)
+- ``die``:   ``os._exit(1)`` (a killed process / native crash)
+- ``delay``: sleep ``delay_s`` seconds, then proceed (a slow peer)
+- ``hang``:  sleep effectively forever (a dropped request / wedged peer)
+
+Arming is either in-process (``faults.arm(...)``, unit/integration
+tests in one process) or via the ``AREAL_FAULTS`` environment variable
+for workers spawned as subprocesses by the controller. The env spec is a
+semicolon-separated list of entries::
+
+    <point>[@<scope>]=<action>[:k=<int>][:n=<int>][:delay=<float>]
+
+e.g. ``AREAL_FAULTS="gserver.generate@generation_server/1=die:k=3"``
+kills generation server 1 on the third generate request it serves.
+``k`` is the first hit that fires (default 1), ``n`` how many
+consecutive hits fire from there (default 1; ``n=0`` means every hit
+from k on). A ``@scope`` entry only arms in the process whose
+``set_scope()`` matches — workers set their worker_name as scope during
+configure, so one env var can target one worker role out of a fleet.
+
+Everything is counted deterministically (no randomness): a chaos test
+states exactly which hit of which point fails, so failures reproduce.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import logging
+
+logger = logging.getLogger("fault_injection")
+
+_HANG_SECONDS = 3600.0
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed injection point (action='raise')."""
+
+
+class _Arm:
+    __slots__ = ("action", "at_hit", "times", "delay_s", "scope",
+                 "on_trigger", "fired")
+
+    def __init__(self, action: str, at_hit: int = 1, times: int = 1,
+                 delay_s: float = 0.0, scope: Optional[str] = None,
+                 on_trigger: Optional[Callable[[], None]] = None):
+        if action not in ("raise", "die", "delay", "hang"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.action = action
+        self.at_hit = max(1, int(at_hit))
+        self.times = int(times)  # 0 = every hit from at_hit on
+        self.delay_s = float(delay_s)
+        self.scope = scope
+        self.on_trigger = on_trigger
+        self.fired = 0
+
+    def should_fire(self, hit: int, scope: Optional[str]) -> bool:
+        if self.scope is not None and self.scope != scope:
+            return False
+        if hit < self.at_hit:
+            return False
+        return self.times == 0 or self.fired < self.times
+
+
+class FaultInjector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._arms: Dict[str, List[_Arm]] = {}
+        self._hits: Dict[str, int] = {}
+        self._scope: Optional[str] = None
+        self._env_loaded = False
+
+    # -- configuration --------------------------------------------------
+
+    def set_scope(self, scope: str):
+        """Identify this process (worker_name) for @scope-filtered arms."""
+        with self._lock:
+            self._scope = scope
+
+    def arm(self, point: str, action: str = "raise", at_hit: int = 1,
+            times: int = 1, delay_s: float = 0.0,
+            scope: Optional[str] = None,
+            on_trigger: Optional[Callable[[], None]] = None):
+        """Arm `point` to fire `action` on its at_hit-th hit (then for
+        `times` consecutive hits; times=0 = forever). `on_trigger` runs
+        right before the action — chaos tests use it to flip auxiliary
+        state (e.g. stop a fake server's heartbeat) atomically with the
+        injected failure."""
+        with self._lock:
+            self._arms.setdefault(point, []).append(
+                _Arm(action, at_hit, times, delay_s, scope, on_trigger)
+            )
+
+    def reset(self):
+        with self._lock:
+            self._arms.clear()
+            self._hits.clear()
+            self._env_loaded = False
+
+    def _ensure_env_loaded(self):
+        with self._lock:
+            if self._env_loaded:
+                return
+            self._env_loaded = True
+        self.load_env()
+
+    def load_env(self, spec: Optional[str] = None):
+        """Parse AREAL_FAULTS (or an explicit spec) into arms. Called
+        lazily on the first maybe_fail so spawned workers pick the spec
+        up without any bootstrap wiring."""
+        if spec is None:
+            spec = os.environ.get("AREAL_FAULTS", "")
+        with self._lock:
+            self._env_loaded = True
+        for entry in filter(None, (e.strip() for e in spec.split(";"))):
+            try:
+                target, _, rhs = entry.partition("=")
+                point, _, scope = target.partition("@")
+                parts = rhs.split(":")
+                action = parts[0]
+                kwargs: Dict[str, float] = {}
+                for p in parts[1:]:
+                    key, _, val = p.partition("=")
+                    if key == "k":
+                        kwargs["at_hit"] = int(val)
+                    elif key == "n":
+                        kwargs["times"] = int(val)
+                    elif key == "delay":
+                        kwargs["delay_s"] = float(val)
+                    else:
+                        raise ValueError(f"unknown fault option {key!r}")
+                self.arm(point.strip(), action=action,
+                         scope=scope.strip() or None if scope else None,
+                         **kwargs)
+            except Exception:
+                logger.error(f"bad AREAL_FAULTS entry {entry!r}; ignored",
+                             exc_info=True)
+
+    # -- introspection --------------------------------------------------
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def armed_points(self) -> List[str]:
+        with self._lock:
+            return sorted(self._arms)
+
+    # -- injection points -----------------------------------------------
+
+    def _step(self, point: str) -> Optional[_Arm]:
+        """Count a hit; return the arm to fire, if any."""
+        self._ensure_env_loaded()
+        with self._lock:
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit
+            for arm in self._arms.get(point, ()):
+                if arm.should_fire(hit, self._scope):
+                    arm.fired += 1
+                    return arm
+        return None
+
+    def _fire(self, arm: _Arm, point: str) -> float:
+        """Run the non-blocking part of the action; returns seconds the
+        caller must sleep (sync and async paths sleep differently)."""
+        logger.warning(
+            f"fault injection: firing {arm.action!r} at {point!r} "
+            f"(hit {self._hits.get(point)})"
+        )
+        if arm.on_trigger is not None:
+            arm.on_trigger()
+        if arm.action == "die":
+            # Mimic a hard kill: no cleanup, no exit hooks, nonzero code.
+            os._exit(1)
+        if arm.action == "raise":
+            raise FaultInjected(f"injected fault at {point!r}")
+        if arm.action == "delay":
+            return arm.delay_s
+        return _HANG_SECONDS  # hang
+
+    def maybe_fail(self, point: str):
+        """Synchronous injection point. A no-op unless armed."""
+        arm = self._step(point)
+        if arm is not None:
+            time.sleep(self._fire(arm, point))
+
+    async def maybe_fail_async(self, point: str):
+        """Async injection point: delay/hang sleep on the event loop so
+        the faulted coroutine stalls without blocking its peers."""
+        arm = self._step(point)
+        if arm is not None:
+            import asyncio
+
+            await asyncio.sleep(self._fire(arm, point))
+
+
+# Process-global injector: production code imports this singleton so
+# tests arm points without plumbing an injector through constructors.
+faults = FaultInjector()

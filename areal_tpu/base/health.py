@@ -1,0 +1,243 @@
+"""Lease-based worker health registry on top of name_resolve.
+
+The fault-domain isolation layer's discovery primitive: every worker
+(and generation server) periodically rewrites a small JSON record under
+``names.health(exp, trial, member)`` carrying its own wall-clock
+timestamp and TTL. Consumers read the subtree and classify members as
+alive (fresh timestamp) or dead (stale by more than ``STALE_FACTOR``
+TTLs), with alive->dead / dead->alive transition callbacks.
+
+Liveness is encoded in the record VALUE, not in backend TTL machinery,
+for two reasons:
+
+- it works identically across every name_resolve backend (the memory
+  backend has no TTL at all; the NFS backend's keepalive toucher is a
+  daemon thread that keeps touching even when the worker's poll loop is
+  wedged — exactly the hang this registry must detect);
+- a beat is one atomic ``add(replace=True)``, so a hung worker stops
+  beating the moment its loop stops, and readmission is just the next
+  beat.
+
+Records are written with ``delete_on_exit=False``: a clean worker exit
+calls ``Heartbeat.stop()`` (which deletes the record), while a killed
+worker leaves a stale record behind — that staleness IS the death
+signal consumers key off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from areal_tpu.base import logging, name_resolve, names
+
+logger = logging.getLogger("health")
+
+# A member is dead once its last beat is older than STALE_FACTOR * ttl.
+# 3x tolerates one missed beat + clock jitter without flapping, matching
+# the NFS backend's own expiry slack (name_resolve.py:_is_expired).
+STALE_FACTOR = 3.0
+
+
+def default_ttl() -> float:
+    """Heartbeat TTL (seconds). AREAL_HEALTH_TTL overrides for tests and
+    chaos drills that need sub-second failure detection."""
+    return float(os.environ.get("AREAL_HEALTH_TTL", 10.0))
+
+
+class Heartbeat:
+    """Producer side: one member's periodic lease renewal.
+
+    ``beat()`` is cheap and rate-limited (ttl/3), so callers just invoke
+    it from their poll loop every iteration. There is deliberately NO
+    background thread: a beat only happens while the owning loop is
+    actually making progress, which is what makes hung-worker detection
+    possible.
+    """
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        member: str,
+        payload: Optional[Dict] = None,
+        ttl: Optional[float] = None,
+    ):
+        self.member = member
+        self.ttl = ttl if ttl is not None else default_ttl()
+        self._key = names.health(experiment_name, trial_name, member)
+        self._payload = dict(payload or {})
+        self._last_beat = 0.0
+        self._stopped = False
+        self.beat(force=True)
+
+    def update_payload(self, **kwargs):
+        self._payload.update(kwargs)
+        self.beat(force=True)
+
+    def beat(self, force: bool = False):
+        """Renew the lease (no-op within ttl/3 of the previous beat)."""
+        if self._stopped:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_beat < self.ttl / 3:
+            return
+        record = dict(self._payload)
+        record["ts"] = time.time()
+        record["ttl"] = self.ttl
+        try:
+            name_resolve.add(
+                self._key,
+                json.dumps(record, separators=(",", ":")),
+                delete_on_exit=False,
+                replace=True,
+            )
+            self._last_beat = now
+        except Exception:
+            # A flaky KV write must never take down the worker it is
+            # supposed to protect; the next beat retries.
+            logger.warning(f"heartbeat write failed for {self.member}",
+                           exc_info=True)
+
+    def stop(self):
+        """Clean shutdown: rewrite the record with a `stopped` marker so
+        consumers can tell a graceful departure (leaves the live set, no
+        death handling) from a crash/hang (stale record, death
+        handling)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        record = dict(self._payload)
+        record["ts"] = time.time()
+        record["ttl"] = self.ttl
+        record["stopped"] = True
+        try:
+            name_resolve.add(
+                self._key,
+                json.dumps(record, separators=(",", ":")),
+                delete_on_exit=False,
+                replace=True,
+            )
+        except Exception:
+            try:
+                name_resolve.delete(self._key)
+            except Exception:
+                pass
+
+
+class HealthRegistry:
+    """Consumer side: live-set view + alive/dead transition callbacks.
+
+    ``poll()`` is pull-based so consumers fold it into their own loops
+    (the gserver manager and controller both already have one);
+    ``start_watch()`` wraps it in a daemon thread for callers that
+    don't.
+    """
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        prefix: str = "",
+        on_dead: Optional[Callable[[str, Dict], None]] = None,
+        on_alive: Optional[Callable[[str, Dict], None]] = None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.prefix = prefix
+        self.on_dead = on_dead
+        self.on_alive = on_alive
+        self._known_alive: Dict[str, Dict] = {}
+        self._lock = threading.Lock()
+        self._watch_stop: Optional[threading.Event] = None
+
+    def _root(self) -> str:
+        root = names.health_root(self.experiment_name, self.trial_name)
+        return root.rstrip("/") + ("/" + self.prefix if self.prefix else "")
+
+    def _records(self) -> Dict[str, Dict]:
+        root = self._root().rstrip("/")
+        out: Dict[str, Dict] = {}
+        for key in name_resolve.find_subtree(root):
+            try:
+                record = json.loads(name_resolve.get(key))
+            except (name_resolve.NameEntryNotFoundError, ValueError):
+                continue
+            member = key[len(root):].strip("/")
+            if self.prefix:
+                member = f"{self.prefix}/{member}" if member else self.prefix
+            out[member] = record
+        return out
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """member -> record for every member whose last beat is fresh and
+        that has not gracefully stopped. Members with stale beats are
+        omitted (they show up via poll()'s dead-transition callback
+        instead)."""
+        now = time.time()
+        return {
+            m: r for m, r in self._records().items()
+            if not r.get("stopped")
+            and now - float(r.get("ts", 0))
+            <= float(r.get("ttl", default_ttl())) * STALE_FACTOR
+        }
+
+    def stopped_members(self) -> Dict[str, Dict]:
+        """Members that announced a graceful shutdown (Heartbeat.stop).
+        Consumers treat these as departed, NOT dead — no failure
+        handling."""
+        return {
+            m: r for m, r in self._records().items() if r.get("stopped")
+        }
+
+    def alive(self) -> Dict[str, Dict]:
+        return self.snapshot()
+
+    def poll(self):
+        """Recompute the live set; fire on_dead for members that were
+        alive and are now stale/deleted, on_alive for new or returning
+        members. Callbacks run on the caller's thread."""
+        now_alive = self.snapshot()
+        with self._lock:
+            appeared = {
+                m: r for m, r in now_alive.items()
+                if m not in self._known_alive
+            }
+            died = {
+                m: r for m, r in self._known_alive.items()
+                if m not in now_alive
+            }
+            self._known_alive = now_alive
+        for member, record in died.items():
+            logger.warning(f"health: {member} went dead")
+            if self.on_dead is not None:
+                self.on_dead(member, record)
+        for member, record in appeared.items():
+            logger.info(f"health: {member} alive")
+            if self.on_alive is not None:
+                self.on_alive(member, record)
+        return now_alive
+
+    def start_watch(self, interval: float = 1.0) -> threading.Thread:
+        """Run poll() on a daemon thread every `interval` seconds."""
+        self._watch_stop = threading.Event()
+        stop = self._watch_stop
+
+        def _loop():
+            while not stop.wait(interval):
+                try:
+                    self.poll()
+                except Exception:
+                    logger.warning("health watch poll failed", exc_info=True)
+
+        t = threading.Thread(target=_loop, daemon=True)
+        t.start()
+        return t
+
+    def stop_watch(self):
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+            self._watch_stop = None

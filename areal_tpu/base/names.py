@@ -91,3 +91,11 @@ def metric_server(experiment_name: str, trial_name: str) -> str:
 
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
+
+
+def health(experiment_name: str, trial_name: str, member: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/health/{member}"
+
+
+def health_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/health/"

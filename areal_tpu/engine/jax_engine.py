@@ -100,6 +100,11 @@ class JaxTrainEngine(TrainEngine):
         hf_family: Optional[str] = None,
     ):
         self.model_cfg = model_cfg
+        # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
+        # not mix tuning settings, and bad values must fail at init.
+        from areal_tpu.ops import snapshot_env_tuning
+
+        snapshot_env_tuning()
         # HF model family ("qwen2", "llama", ...) used by interface.save
         # to pick the weight-export mapping; None = not HF-exportable.
         self.hf_family = hf_family

@@ -180,6 +180,11 @@ class ServingEngine:
         decode_weight_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
+        # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
+        # not mix tuning settings, and bad values must fail at init.
+        from areal_tpu.ops import snapshot_env_tuning
+
+        snapshot_env_tuning()
         # Sampled token ids round-trip through float32 in the packed
         # single-fetch decode result (paged.py); exact only below 2^24.
         assert cfg.vocab_size < 2**24, (
@@ -501,6 +506,9 @@ class ServingEngine:
             "spec_tokens_per_step": float(
                 self._spec_emitted / self._spec_steps
             ) if self._spec_steps else 0.0,
+            # Raw numerator/denominator for fleet-level aggregation.
+            "spec_emitted_tokens": float(self._spec_emitted),
+            "spec_active_steps": float(self._spec_steps),
         }
 
     # ------------------------------------------------------------------

@@ -130,7 +130,11 @@ def spec_verify(
     p_draft = jnp.take_along_axis(
         probs[:, :d], draft[:, :, None], axis=2
     )[:, :, 0]  # [B, d]: p_j(draft_j)
-    argmax_d = jnp.argmax(warped[:, :d], axis=2)  # [B, d]
+    # Greedy acceptance is judged on the BASE distribution — the same
+    # argmax the plain decode path emits (paged.warp_sample) — so greedy
+    # speculative decoding is bit-identical to plain greedy by
+    # construction, not merely when warping preserves the argmax.
+    argmax_d = jnp.argmax(base_logp[:, :d], axis=2)  # [B, d]
     ok_greedy = argmax_d == draft
     ok_sample = u < p_draft
     ok = jnp.where(greedy_mask[:, None], ok_greedy, ok_sample)
@@ -150,12 +154,19 @@ def spec_verify(
         draft, jnp.minimum(a, d - 1)[:, None], axis=1
     )[:, 0] if d > 0 else jnp.zeros((B,), jnp.int32)
     remove = (a < eff)
-    w_final = jnp.where(
-        remove[:, None] & (jnp.arange(V)[None, :] == rej_tok[:, None]),
-        NEG_INF, w_a,
+    remove_mask = remove[:, None] & (
+        jnp.arange(V)[None, :] == rej_tok[:, None]
     )
+    w_final = jnp.where(remove_mask, NEG_INF, w_a)
     sampled = jax.random.categorical(rng_cat, w_final, axis=-1)
-    greedy_tok = jnp.argmax(w_final, axis=-1)
+    # Greedy final token from the BASE distribution (matching
+    # warp_sample's greedy path); the rejected-token mask is a no-op for
+    # greedy rows (a rejected draft is never the base argmax) but keeps
+    # the row semantics uniform.
+    b_a = jnp.take_along_axis(
+        base_logp, a[:, None, None], axis=1
+    )[:, 0]  # [B, V]
+    greedy_tok = jnp.argmax(jnp.where(remove_mask, NEG_INF, b_a), axis=-1)
     final = jnp.where(greedy_mask, greedy_tok, sampled).astype(jnp.int32)
 
     # emitted[j] = draft[j] for j < a, final at j == a, zeros after.

@@ -89,6 +89,39 @@ def decode_attention(
 
 _SPLASH_MASK_CACHE = {}
 
+# AREAL_SPLASH_* snapshot: (bq, bkv, bkvc) targets once taken.
+_SPLASH_SNAP = None
+
+
+def snapshot_splash_blocks():
+    """Parse + validate the AREAL_SPLASH_BQ/BKV/BKVC block-size targets
+    and pin them for subsequent traces. Called at engine construction so
+    a mid-run retrace can't silently mix tuning settings and a bad value
+    fails at init instead of inside a jit trace; sweeps re-pin by
+    constructing a fresh engine per setting (scripts/mfu_sweep.py)."""
+    global _SPLASH_SNAP
+
+    def target(name, default):
+        v = int(os.environ.get(name, default))
+        if v < LANES:
+            raise ValueError(f"{name}={v}: splash block targets must be "
+                             f">= {LANES}")
+        return v
+
+    _SPLASH_SNAP = (
+        target("AREAL_SPLASH_BQ", 512),
+        target("AREAL_SPLASH_BKV", 1024),
+        target("AREAL_SPLASH_BKVC", 512),
+    )
+    return _SPLASH_SNAP
+
+
+def _splash_block_targets():
+    if _SPLASH_SNAP is None:
+        # Direct ops use without an engine: snapshot lazily on first use.
+        return snapshot_splash_blocks()
+    return _SPLASH_SNAP
+
 
 def _largest_block(n: int, cap: int) -> int:
     """Largest multiple of 128 that divides n and is <= cap (splash
@@ -132,18 +165,14 @@ def _splash_kernel(t: int, group: int, interpret: bool = False):
 
     # Block sizes must divide the sequence length (packed rows are
     # padded to multiples of 128, so t is often e.g. 640 or 1536).
-    # Targets are overridable for on-chip tuning (scripts/mfu_sweep.py);
-    # read at trace time, so a fresh jit per setting picks them up.
-    def target(name, default):
-        v = int(os.environ.get(name, default))
-        if v < LANES:
-            raise ValueError(f"{name}={v}: splash block targets must be "
-                             f">= {LANES}")
-        return v
-
-    bq = _largest_block(t, target("AREAL_SPLASH_BQ", 512))
-    bkv = _largest_block(t, target("AREAL_SPLASH_BKV", 1024))
-    bkvc = _largest_block(bkv, target("AREAL_SPLASH_BKVC", 512))
+    # Targets are overridable for on-chip tuning (scripts/mfu_sweep.py),
+    # validated + pinned at engine construction (snapshot_splash_blocks)
+    # so a mid-run retrace cannot mix settings; sweeps re-pin by
+    # constructing a fresh engine per setting.
+    tq, tkv, tkvc = _splash_block_targets()
+    bq = _largest_block(t, tq)
+    bkv = _largest_block(t, tkv)
+    bkvc = _largest_block(bkv, tkvc)
     bs = sk.BlockSizes(
         block_q=bq, block_kv=bkv, block_kv_compute=bkvc,
         block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkvc,

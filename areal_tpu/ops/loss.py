@@ -70,6 +70,38 @@ def _pick_chunk(n_tokens: int, target: int = 4096) -> int:
     return c
 
 
+# AREAL_CE_CHUNK snapshot: (value,) once taken, None before. The tuple
+# wrapper distinguishes "snapshotted as unset" from "never snapshotted".
+_CE_CHUNK_SNAP: Optional[Tuple[Optional[int]]] = None
+
+
+def snapshot_ce_chunk() -> Optional[int]:
+    """Parse + validate AREAL_CE_CHUNK and pin it for subsequent traces.
+
+    Called at engine construction (engine/jax_engine.py): a mid-run
+    retrace then reuses the pinned value instead of silently picking up
+    a mutated environment, and an unparseable value fails HERE — at
+    init — rather than deep inside a jit trace. Sweeps that mutate the
+    env between settings (scripts/mfu_sweep.py) re-pin simply by
+    constructing a fresh engine."""
+    global _CE_CHUNK_SNAP
+    env = os.environ.get("AREAL_CE_CHUNK")
+    val: Optional[int] = None
+    if env:
+        val = int(env)  # ValueError surfaces at snapshot time
+        if val <= 0:
+            raise ValueError(f"AREAL_CE_CHUNK={env}: must be positive")
+    _CE_CHUNK_SNAP = (val,)
+    return val
+
+
+def _ce_chunk_setting() -> Optional[int]:
+    if _CE_CHUNK_SNAP is None:
+        # Direct ops use without an engine: snapshot lazily on first use.
+        return snapshot_ce_chunk()
+    return _CE_CHUNK_SNAP[0]
+
+
 def fused_next_token_logprobs(
     hidden: jnp.ndarray,  # [R, T, D] compute dtype
     head_w: jnp.ndarray,  # [D, V]
@@ -94,14 +126,11 @@ def fused_next_token_logprobs(
     R, T, D = hidden.shape
     V = head_w.shape[-1]
     if chunk_size is None:
-        env = os.environ.get("AREAL_CE_CHUNK")
-        if env:
-            # Sweep override (scripts/mfu_sweep.py): read at trace time,
-            # so a fresh engine/jit per setting picks it up.
-            chunk_size = int(env)
-            if chunk_size <= 0:
-                raise ValueError(f"AREAL_CE_CHUNK={env}: must be positive")
-        else:
+        # Sweep override (scripts/mfu_sweep.py), validated + pinned at
+        # engine construction (snapshot_ce_chunk) so retraces can't mix
+        # settings mid-run.
+        chunk_size = _ce_chunk_setting()
+        if chunk_size is None:
             # Byte-budgeted: keep the per-chunk fp32 logits tile ~512 MB
             # regardless of vocab size (C*V elements), floor 256 tokens.
             chunk_size = max(256, (1 << 27) // V)

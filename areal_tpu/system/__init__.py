@@ -21,7 +21,15 @@ WORKER_TYPES = sorted(_WORKER_CLASSES)
 
 
 def load_worker(worker_type: str):
-    """Resolve a worker type name to its class (lazy import)."""
+    """Resolve a worker type name to its class (lazy import).
+
+    Accepts either a registered role name or a fully-qualified
+    "module.path:ClassName" spec — the latter lets harnesses (e.g. the
+    chaos suite) run custom Worker subclasses under the real controller
+    without registering a production role."""
+    if ":" in worker_type:
+        module, cls = worker_type.split(":", 1)
+        return getattr(importlib.import_module(module), cls)
     if worker_type not in _WORKER_CLASSES:
         raise ValueError(
             f"unknown worker type {worker_type!r}; available: {WORKER_TYPES}"

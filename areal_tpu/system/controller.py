@@ -16,12 +16,22 @@ import os
 import sys
 import time
 import traceback
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from areal_tpu.api.system_api import ExperimentConfig
-from areal_tpu.base import constants, logging, name_resolve, names
+from areal_tpu.base import constants, health, logging, name_resolve, names
 
 logger = logging.getLogger("controller")
+
+# Worker roles the supervisor restarts in place on death/hang. The
+# trainer plane (model workers, master) holds in-flight step state the
+# request/reply stream can't rebuild mid-step, so those still escalate
+# to the whole-experiment relaunch in training/utils.run_experiment;
+# the serving plane is designed to re-register, re-sync weights, and
+# re-enter rotation.
+RESTARTABLE_ROLES = frozenset(
+    {"generation_server", "rollout_worker", "gserver_manager"}
+)
 
 
 def _run_worker_proc(
@@ -32,6 +42,7 @@ def _run_worker_proc(
     error_queue,
 ):
     """Subprocess entry: reconfigure name_resolve, build + run the worker."""
+    worker_name = getattr(config, "worker_name", worker_type)
     try:
         os.environ.update(env)
         from areal_tpu.utils.jaxenv import apply_jax_platform_override
@@ -46,15 +57,22 @@ def _run_worker_proc(
             config,
             experiment_name=config.experiment_name,
             trial_name=config.trial_name,
-            worker_name=config.worker_name,
+            worker_name=worker_name,
         )
         w.run()
     except Exception:
-        error_queue.put(
-            f"{worker_type}/{getattr(config, 'worker_index', '?')}: "
-            + traceback.format_exc()
-        )
+        error_queue.put(f"{worker_name}: " + traceback.format_exc())
         raise
+
+
+@dataclasses.dataclass
+class _WorkerRecord:
+    worker_type: str
+    config: Any
+    proc: mp.Process
+    restarts: int = 0
+    last_restart: float = 0.0
+    last_seen_alive: float = 0.0  # last fresh heartbeat (0 = never beat)
 
 
 class LocalController:
@@ -65,15 +83,35 @@ class LocalController:
         exp_cfg: ExperimentConfig,
         name_resolve_cfg: Optional[Dict] = None,
         worker_env: Optional[Dict[str, str]] = None,
+        max_worker_restarts: int = 2,
+        restartable_roles: Optional[Set[str]] = None,
     ):
         self.exp_cfg = exp_cfg
         self.name_resolve_cfg = name_resolve_cfg or {"backend": "nfs"}
         self.worker_env = worker_env or {}
-        self._procs: List[mp.Process] = []
+        # Per-worker fault domain: how many times one worker role may be
+        # restarted in place before the failure escalates to the
+        # whole-experiment relaunch loop.
+        self.max_worker_restarts = max_worker_restarts
+        self.restartable_roles = (
+            RESTARTABLE_ROLES if restartable_roles is None
+            else frozenset(restartable_roles)
+        )
+        self._workers: Dict[str, _WorkerRecord] = {}
+        # Guarded by _err_lock: appended by the supervisor thread while
+        # the main thread drains/raises in run()'s teardown.
+        self._pending_errors: List[str] = []
+        import threading
+
+        self._err_lock = threading.Lock()
         self._ctx = mp.get_context("spawn")
         self._errors = self._ctx.Queue()
 
-    def _spawn(self, worker_type: str, config):
+    @property
+    def _procs(self) -> List[mp.Process]:
+        return [r.proc for r in self._workers.values()]
+
+    def _spawn(self, worker_type: str, config) -> mp.Process:
         # Spawned children must be able to import areal_tpu before the
         # target function runs (unpickling imports this module), so the
         # repo root has to be on PYTHONPATH at process start.
@@ -97,7 +135,12 @@ class LocalController:
             daemon=True,
         )
         p.start()
-        self._procs.append(p)
+        name = getattr(config, "worker_name", worker_type)
+        rec = self._workers.get(name)
+        if rec is None:
+            self._workers[name] = _WorkerRecord(worker_type, config, p)
+        else:  # restart: keep the record's history
+            rec.proc = p
         return p
 
     def start_workers(self):
@@ -123,27 +166,142 @@ class LocalController:
         for cfg in self.exp_cfg.rollout_workers:
             self._spawn("rollout_worker", cfg)
 
-    def check_worker_errors(self):
-        try:
-            err = self._errors.get_nowait()
-        except Exception:
-            return
-        raise RuntimeError(f"worker failed:\n{err}")
+    def _drain_errors(self):
+        while True:
+            try:
+                err = self._errors.get_nowait()
+            except Exception:
+                return
+            with self._err_lock:
+                self._pending_errors.append(err)
 
-    def _watchdog(self, stop_event):
-        """Interrupt the inline master as soon as any worker dies, so its
-        real traceback surfaces instead of a later stream timeout."""
+    def _discard_errors_for(self, worker_name: str):
+        """Drop queued tracebacks attributed to a worker the supervisor
+        is restarting — a handled failure must not fail the run later."""
+        with self._err_lock:
+            kept, dropped = [], []
+            for err in self._pending_errors:
+                (dropped if err.startswith(f"{worker_name}: ")
+                 else kept).append(err)
+            self._pending_errors = kept
+        for err in dropped:
+            logger.warning(
+                f"restarting {worker_name}; absorbed its failure:\n{err}"
+            )
+        return len(dropped)
+
+    def check_worker_errors(self):
+        self._drain_errors()
+        with self._err_lock:
+            if self._pending_errors:
+                raise RuntimeError(
+                    f"worker failed:\n{self._pending_errors[0]}"
+                )
+
+    # ------------------------------------------------------------------
+    # Supervision: per-worker restart, heartbeat hang detection,
+    # escalation to the whole-experiment relaunch
+    # ------------------------------------------------------------------
+
+    def _escalate(self, why: str):
         import _thread
 
-        while not stop_event.wait(0.5):
-            failed = not self._errors.empty() or any(
-                (not p.is_alive()) and p.exitcode not in (0, None)
-                for p in self._procs
+        logger.error(f"{why}; interrupting master")
+        self._watchdog_fired = True
+        _thread.interrupt_main()
+
+    def _restart_worker(self, name: str, rec: _WorkerRecord, why: str) -> bool:
+        """Restart one worker role in place. Returns False when the
+        failure must escalate instead (role not restartable / budget
+        spent)."""
+        if (
+            rec.worker_type not in self.restartable_roles
+            or rec.restarts >= self.max_worker_restarts
+        ):
+            return False
+        if rec.proc.is_alive():
+            # Hung, not dead: kill the wedged process first.
+            rec.proc.kill()
+            rec.proc.join(timeout=10)
+        rec.restarts += 1
+        rec.last_restart = time.monotonic()
+        self._discard_errors_for(name)
+        logger.warning(
+            f"restarting {name} ({why}; "
+            f"attempt {rec.restarts}/{self.max_worker_restarts})"
+        )
+        self._spawn(rec.worker_type, rec.config)
+        return True
+
+    def supervise_once(self, registry: Optional[health.HealthRegistry] = None) -> bool:
+        """One supervision pass. Returns False once a failure escalated
+        (supervision should stop); True to keep supervising."""
+        self._drain_errors()
+        alive_members = registry.snapshot() if registry is not None else {}
+        stopped = registry.stopped_members() if registry is not None else {}
+        now = time.monotonic()
+        for name, rec in list(self._workers.items()):
+            # Only THIS incarnation's beats count: a dead worker's record
+            # stays fresh for up to 3*TTL, and crediting it to the
+            # replacement would end its startup grace before its first
+            # beat (and hang-kill it mid model load).
+            if (
+                name in alive_members
+                and alive_members[name].get("pid") == rec.proc.pid
+            ):
+                rec.last_seen_alive = now
+            dead = (not rec.proc.is_alive()) and rec.proc.exitcode not in (0, None)
+            # Hang: the process is up but its poll loop stopped beating
+            # AFTER this incarnation was last seen healthy (never-beat
+            # workers get startup grace; freshly restarted ones too), and
+            # it did not announce a graceful shutdown. Only judged for
+            # restartable (serving-plane) roles: trainer-plane poll loops
+            # legitimately block for minutes inside jit compiles.
+            hung = (
+                rec.worker_type in self.restartable_roles
+                and rec.proc.is_alive()
+                and rec.last_seen_alive > rec.last_restart
+                and name not in alive_members
+                and name not in stopped
             )
-            if failed:
-                logger.error("worker failure detected; interrupting master")
-                self._watchdog_fired = True
-                _thread.interrupt_main()
+            if not dead and not hung:
+                continue
+            why = "process died" if dead else "heartbeat went stale"
+            if not self._restart_worker(name, rec, why):
+                self._escalate(f"{name} failed ({why})")
+                return False
+        # Queued tracebacks. A traceback whose process is still alive is
+        # either in-flight death (handled as a proc exit on a later pass)
+        # or a leftover from an incarnation we already replaced.
+        with self._err_lock:
+            pending_snapshot = list(self._pending_errors)
+        for err in pending_snapshot:
+            name = err.split(": ", 1)[0]
+            rec = self._workers.get(name)
+            if rec is not None and rec.proc.is_alive():
+                if rec.restarts > 0:
+                    self._discard_errors_for(name)
+                continue
+            if rec is not None and self._restart_worker(name, rec, "raised"):
+                continue
+            self._escalate(f"worker failure: {name}")
+            return False
+        return True
+
+    def _watchdog(self, stop_event):
+        """Supervise workers while the inline master runs: restart failed
+        serving-plane workers in place; interrupt the master (so its
+        relaunch-recovery path runs) for anything non-recoverable."""
+        registry = health.HealthRegistry(
+            self.exp_cfg.experiment_name, self.exp_cfg.trial_name
+        )
+        while not stop_event.wait(0.5):
+            try:
+                keep_going = self.supervise_once(registry)
+            except Exception:
+                logger.warning("supervision pass failed", exc_info=True)
+                continue
+            if not keep_going:
                 return
 
     def run(self, timeout: Optional[float] = None) -> Dict:
@@ -210,7 +368,7 @@ class LocalController:
             if p.is_alive():
                 logger.warning(f"terminating straggler worker pid={p.pid}")
                 p.terminate()
-        self._procs.clear()
+        self._workers.clear()
 
 
 class ClusterController:

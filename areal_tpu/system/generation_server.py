@@ -26,6 +26,7 @@ from aiohttp import web
 from areal_tpu.api import data_api
 from areal_tpu.api.system_api import GenerationServerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, network, seeding
+from areal_tpu.base.fault_injection import faults
 from areal_tpu.engine.serving import GenRequest, ServingEngine
 from areal_tpu.system.worker_base import PollResult, Worker
 
@@ -106,6 +107,15 @@ class GenerationServer(Worker):
         )
         logger.info(f"generation server {config.server_index} at {self.address}")
 
+    def _heartbeat_payload(self):
+        # The gserver manager maps health members -> routing-table URLs
+        # through this field (eviction on missed beats, readmission +
+        # weight re-sync on return).
+        payload = super()._heartbeat_payload()
+        payload["url"] = self.address
+        payload["server_index"] = self.cfg.server_index
+        return payload
+
     # ------------------------------------------------------------------
     # HTTP
     # ------------------------------------------------------------------
@@ -128,6 +138,9 @@ class GenerationServer(Worker):
         self._http_loop.run_forever()
 
     async def _h_generate(self, request: web.Request) -> web.Response:
+        # Chaos injection point: tests arm this to kill/fail/stall THIS
+        # server mid-rollout and prove clients fail over.
+        await faults.maybe_fail_async("gserver.generate")
         d = await request.json()
         g = d.get("gconfig", {})
         loop = asyncio.get_running_loop()
@@ -181,6 +194,7 @@ class GenerationServer(Worker):
         )
 
     async def _h_update_weights(self, request: web.Request) -> web.Response:
+        await faults.maybe_fail_async("gserver.update_weights")
         d = await request.json()
         model_path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
@@ -271,6 +285,11 @@ class GenerationServer(Worker):
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
             f"areal:prefix_cached_tokens {m['prefix_cached_tokens']}",
             f"areal:spec_tokens_per_step {m['spec_tokens_per_step']}",
+            # Raw sums behind the ratio, so the manager can aggregate the
+            # fleet yield as sum(emitted)/sum(steps) instead of averaging
+            # per-server ratios.
+            f"areal:spec_emitted_tokens {m['spec_emitted_tokens']}",
+            f"areal:spec_active_steps {m['spec_active_steps']}",
             f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
             f"areal:last_weight_stage_s {m['last_weight_stage_s']}",
             f"areal:last_weight_load_s "

@@ -11,6 +11,16 @@ Counterpart of the reference's GserverManager
 - watches the trainer's published model version and fans out
   /update_weights_from_disk (interrupting running requests) to servers
 - GCs old param-realloc dumps
+
+Fault-domain isolation: servers are tracked through the health registry
+(base/health.py) and a healthy/evicted split. Unhealthy servers — dead
+heartbeats, client-reported request failures, or failed weight updates —
+are evicted from every routing policy; the weight-update fanout is
+quorum-based (>= 1 healthy server suffices, so one dead server degrades
+throughput instead of aborting the step); an evicted server whose
+heartbeat returns is first re-synced to the current weight version and
+only then readmitted to rotation, so `is_staled` accounting stays
+correct across the outage.
 """
 
 from __future__ import annotations
@@ -26,7 +36,8 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.api.system_api import GserverManagerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, network
+from areal_tpu.base import constants, health, logging, name_resolve, names, network
+from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.worker_base import PollResult, Worker
 
 logger = logging.getLogger("gserver_manager")
@@ -74,10 +85,39 @@ class GserverManager(Worker):
         self._server_gen_totals = {u: 0.0 for u in self.server_urls}
         self._server_prefix_hits = {u: 0.0 for u in self.server_urls}
         self._server_prefix_reused = {u: 0.0 for u in self.server_urls}
-        self._server_spec_yield = {u: 0.0 for u in self.server_urls}
+        # Fleet speculation yield as a ratio of SUMS: per-server emitted
+        # tokens and active decode steps, not per-server ratios (an
+        # unweighted mean of ratios overweights idle servers).
+        self._server_spec_emitted = {u: 0.0 for u in self.server_urls}
+        self._server_spec_steps = {u: 0.0 for u in self.server_urls}
         self._last_gen_total = 0.0
         self._last_throughput_log = time.monotonic()
         self._throughput_log_interval = 10.0
+
+        # Fault-domain state. Servers start healthy; the health registry
+        # (+ client failure reports + fanout failures) evicts, heartbeat
+        # return + weight re-sync readmits. A server that never
+        # heartbeats (legacy topologies, harness-built tests) is simply
+        # never evicted by the registry path.
+        self._healthy = set(self.server_urls)
+        self._evicted: Dict[str, str] = {}  # url -> reason
+        self._server_versions = {u: 0 for u in self.server_urls}
+        self._member_urls: Dict[str, str] = {}  # health member -> url
+        self._registry = health.HealthRegistry(
+            config.experiment_name, config.trial_name,
+            prefix="generation_server",
+        )
+        # Rollout-worker quota reconciliation: outstanding slots per
+        # worker, reclaimed when that worker's heartbeat dies — a killed
+        # worker's episodes can never call /finish_rollout, and without
+        # reclamation the capacity gate would wedge shut forever.
+        self._worker_slots: Dict[str, int] = {}
+        self._rollout_registry = health.HealthRegistry(
+            config.experiment_name, config.trial_name,
+            prefix="rollout_worker",
+        )
+        self._rollout_seen: set = set()
+        self._last_health_poll = 0.0
 
         self._http_loop = asyncio.new_event_loop()
         self._http_ready = threading.Event()
@@ -95,24 +135,222 @@ class GserverManager(Worker):
             f"gserver manager at {self.address}, servers={self.server_urls}"
         )
 
+    def _heartbeat_ttl(self) -> float:
+        # The fanout blocks this worker's poll loop (no beats) for up to
+        # flush_request_timeout; the lease must outlive a healthy fanout
+        # or the controller would hang-kill the manager mid-update.
+        return max(health.default_ttl(), self.cfg.flush_request_timeout / 2)
+
     # ------------------------------------------------------------------
     # Scheduling / staleness
     # ------------------------------------------------------------------
 
-    def _choose_server(self, meta: Dict) -> str:
+    def _healthy_urls(self) -> List[str]:
+        return [u for u in self.server_urls if u in self._healthy]
+
+    def _choose_server(self, meta: Dict) -> Optional[str]:
+        """Pick a healthy server under the configured policy; None when
+        the whole fleet is unhealthy (clients back off and retry)."""
+        candidates = self._healthy_urls()
+        if not candidates:
+            return None
         prev = meta.get("previous_server_url") or ""
         prev_version = int(meta.get("previous_version", -1))
         # Sticky routing while the version is unchanged (KV prefix reuse).
-        if prev in self.server_urls and prev_version == self.weight_version:
+        if prev in candidates and prev_version == self.weight_version:
             return prev
         policy = self.cfg.schedule_policy
         if policy == "least_requests":
-            return min(self.server_urls, key=lambda u: self._server_reqs[u])
+            return min(candidates, key=lambda u: self._server_reqs[u])
         if policy == "least_token_usage":
-            return min(self.server_urls, key=lambda u: self._server_tokens[u])
-        url = self.server_urls[self._rr % len(self.server_urls)]
+            return min(candidates, key=lambda u: self._server_tokens[u])
+        url = candidates[self._rr % len(candidates)]
         self._rr += 1
         return url
+
+    # ------------------------------------------------------------------
+    # Fault-domain isolation: eviction + readmission
+    # ------------------------------------------------------------------
+
+    def _mark_unhealthy(self, url: str, reason: str):
+        if url not in self.server_urls:
+            return
+        with self._lock:
+            if url not in self._healthy:
+                return
+            self._healthy.discard(url)
+            self._evicted[url] = reason
+            # In-flight estimates for a dead server are meaningless; a
+            # readmitted server starts from a clean routing slate.
+            self._server_reqs[url] = 0
+            self._server_tokens[url] = 0.0
+        logger.warning(
+            f"evicted generation server {url}: {reason} "
+            f"({len(self._healthy_urls())}/{len(self.server_urls)} healthy)"
+        )
+
+    def _readmit(self, url: str):
+        with self._lock:
+            self._evicted.pop(url, None)
+            self._healthy.add(url)
+        logger.info(
+            f"readmitted generation server {url} at weight version "
+            f"{self._server_versions.get(url, 0)} "
+            f"({len(self._healthy_urls())}/{len(self.server_urls)} healthy)"
+        )
+
+    def _current_param_path(self) -> Optional[str]:
+        path = os.path.join(
+            constants.get_param_realloc_path(
+                self.cfg.experiment_name, self.cfg.trial_name
+            ),
+            self.cfg.model_name,
+        )
+        if os.path.exists(os.path.join(path, "engine_state.pkl")):
+            return path
+        return None
+
+    def _resync_server(self, url: str) -> bool:
+        """Push the current weight version to a returning server before
+        it re-enters rotation (server-side is_stale_update makes this a
+        cheap no-op when it already has the version)."""
+        if self.weight_version <= 0:
+            return True
+        path = self._current_param_path()
+        if path is None:
+            # Dump GC'd / not yet written: can't prove the server is
+            # current, keep it out of rotation until the next fanout.
+            return False
+
+        async def _push():
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.cfg.flush_request_timeout)
+            ) as sess:
+                async with sess.post(
+                    f"{url}/update_weights_from_disk",
+                    json={"model_path": path, "allow_interrupt": True,
+                          "version": self.weight_version},
+                ) as r:
+                    body = await r.json()
+                    return bool(body.get("success"))
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_push(), self._http_loop)
+            ok = fut.result(timeout=self.cfg.flush_request_timeout + 10)
+        except Exception:
+            logger.warning(f"re-sync of {url} failed; staying evicted",
+                           exc_info=True)
+            return False
+        if ok:
+            with self._lock:
+                self._server_versions[url] = self.weight_version
+        return ok
+
+    def _replace_server_url(self, old: str, new: str):
+        """A restarted generation server re-registers the SAME health
+        member at a NEW address: migrate every routing-table entry. The
+        new incarnation starts evicted at version 0, so the normal
+        readmission path re-syncs it before it serves."""
+        with self._lock:
+            self.server_urls = sorted(
+                [new if u == old else u for u in self.server_urls]
+            )
+            # The dead incarnation's cumulative tokens leave the fleet
+            # sum; shift the throughput baseline down with them or the
+            # next tokens/s log goes negative.
+            self._last_gen_total = max(
+                0.0,
+                self._last_gen_total - self._server_gen_totals.get(old, 0.0),
+            )
+            for d in (
+                self._server_tokens, self._server_gen_totals,
+                self._server_prefix_hits, self._server_prefix_reused,
+                self._server_spec_emitted, self._server_spec_steps,
+            ):
+                d.pop(old, None)
+                d[new] = 0.0
+            self._server_reqs.pop(old, None)
+            self._server_reqs[new] = 0
+            self._server_versions.pop(old, None)
+            self._server_versions[new] = 0
+            self._healthy.discard(old)
+            self._evicted.pop(old, None)
+            self._evicted[new] = "restarted at new address"
+        logger.info(f"generation server moved {old} -> {new}")
+
+    def _poll_health(self):
+        """Fold the health registry into the healthy/evicted split:
+        heartbeat loss evicts, heartbeat return (after a weight re-sync)
+        readmits; a member returning at a new address migrates the
+        routing table first."""
+        snapshot = self._registry.snapshot()
+        alive_urls = set()
+        unknown = []
+        for member, record in sorted(snapshot.items()):
+            url = record.get("url")
+            if not url:
+                continue
+            old = self._member_urls.get(member)
+            if old is not None and old != url and old in self.server_urls:
+                self._replace_server_url(old, url)
+            elif url not in self.server_urls:
+                if old is None:
+                    unknown.append((member, url))
+                continue
+            self._member_urls[member] = url
+            alive_urls.add(url)
+        # Adoption: a member we have NEVER seen, beating at an address
+        # outside the table — its previous incarnation died before we
+        # observed it. It must be the restarted owner of some evicted
+        # url no live member claims; replace the (deterministically
+        # first) such dead-weight entry.
+        for member, url in unknown:
+            claimed = set(self._member_urls.values())
+            dead_weight = sorted(
+                u for u in self.server_urls
+                if u in self._evicted and u not in claimed
+            )
+            if not dead_weight:
+                continue  # converges once a client report evicts the old url
+            self._replace_server_url(dead_weight[0], url)
+            self._member_urls[member] = url
+            alive_urls.add(url)
+        # Death: a server we have seen heartbeat before is now stale.
+        for member, url in list(self._member_urls.items()):
+            if member not in snapshot and url in self._healthy:
+                self._mark_unhealthy(url, f"missed heartbeats ({member})")
+        # Readmission: evicted servers whose heartbeat is back.
+        for url in [u for u in list(self._evicted) if u in alive_urls]:
+            # Each re-sync can block up to the flush timeout; renew this
+            # worker's own lease between them so recovering several
+            # servers can't make the supervisor hang-kill the manager.
+            self._beat()
+            if (
+                self._server_versions.get(url, 0) >= self.weight_version
+                or self._resync_server(url)
+            ):
+                self._readmit(url)
+        # Rollout-worker quota reconciliation: a worker whose heartbeat
+        # died (or gracefully departed) can never finish its episodes —
+        # give its outstanding slots (and their staleness budget) back.
+        rollout_alive = self._rollout_registry.snapshot()
+        self._rollout_seen |= set(rollout_alive)
+        for member in [m for m in self._rollout_seen if m not in rollout_alive]:
+            self._rollout_seen.discard(member)
+            with self._lock:
+                n = self._worker_slots.pop(member, 0)
+                if n:
+                    self.rollout_stat.running = max(
+                        0, self.rollout_stat.running - n
+                    )
+                    self.rollout_stat.submitted = max(
+                        0, self.rollout_stat.submitted - n
+                    )
+            if n:
+                logger.warning(
+                    f"reclaimed {n} quota slot(s) from dead/departed "
+                    f"rollout worker {member}"
+                )
 
     def _training_samples(self) -> int:
         try:
@@ -162,13 +400,26 @@ class GserverManager(Worker):
 
     async def _h_schedule(self, request: web.Request) -> web.Response:
         meta = await request.json()
+        # Clients report the server a request just failed on; that server
+        # leaves rotation immediately (the health registry readmits it
+        # once its heartbeat proves it alive and it re-syncs weights).
+        failed = meta.get("failed_server_url")
+        if failed:
+            self._mark_unhealthy(failed, "client-reported request failure")
         with self._lock:
             url = self._choose_server(meta)
-            self._server_reqs[url] += 1
+            if url is not None:
+                self._server_reqs[url] += 1
+        if url is None:
+            return web.json_response(
+                {"error": "no healthy generation servers", "retry_after": 0.5},
+                status=503,
+            )
         return web.json_response({"url": url, "version": self.weight_version})
 
     async def _h_allocate(self, request: web.Request) -> web.Response:
-        await request.json()
+        d = await request.json()
+        worker = str(d.get("worker", "?"))
         with self._lock:
             cap = self.cfg.max_concurrent_rollouts or (1 << 30)
             if self.rollout_stat.running >= cap:
@@ -182,25 +433,45 @@ class GserverManager(Worker):
                 )
             self.rollout_stat.submitted += 1
             self.rollout_stat.running += 1
+            self._worker_slots[worker] = self._worker_slots.get(worker, 0) + 1
         return web.json_response({"success": True, "version": self.weight_version})
 
     async def _h_finish(self, request: web.Request) -> web.Response:
         d = await request.json()
+        worker = str(d.get("worker", "?"))
         with self._lock:
-            self.rollout_stat.running -= 1
+            # max(0, ...): a restarted manager starts the counters at
+            # zero while pre-restart episodes still report their
+            # finishes; going negative would over-admit past capacity
+            # and corrupt the staleness gate.
+            self.rollout_stat.running = max(0, self.rollout_stat.running - 1)
+            n = self._worker_slots.get(worker, 0)
+            if n > 1:
+                self._worker_slots[worker] = n - 1
+            else:
+                self._worker_slots.pop(worker, None)
             if d.get("accepted", True):
                 self.rollout_stat.accepted += 1
             else:
                 # Rejected rollouts give their staleness budget back.
-                self.rollout_stat.submitted -= 1
+                self.rollout_stat.submitted = max(
+                    0, self.rollout_stat.submitted - 1
+                )
         return web.json_response({"success": True})
 
     async def _h_status(self, request: web.Request) -> web.Response:
+        with self._lock:
+            healthy = self._healthy_urls()
+            evicted = dict(self._evicted)
+            versions = dict(self._server_versions)
         return web.json_response(
             {
                 "weight_version": self.weight_version,
                 "rollout_stat": self.rollout_stat.as_dict(),
                 "servers": self.server_urls,
+                "healthy_servers": healthy,
+                "evicted_servers": evicted,
+                "server_versions": versions,
             }
         )
 
@@ -223,22 +494,29 @@ class GserverManager(Worker):
             return None
         if v <= self.weight_version:
             return None
-        path = os.path.join(
-            constants.get_param_realloc_path(
-                self.cfg.experiment_name, self.cfg.trial_name
-            ),
-            self.cfg.model_name,
-        )
-        if not os.path.exists(os.path.join(path, "engine_state.pkl")):
+        path = self._current_param_path()
+        if path is None:
             return None
         self._new_version = v
         return path
 
     def flush_requests_and_update_weights(self, path: str):
+        """Quorum-based fanout: push the new version to every HEALTHY
+        server; the step proceeds when at least one succeeds. Failed
+        servers are evicted (they re-sync on readmission), so a single
+        dead server degrades throughput instead of aborting training."""
         t_start = time.monotonic()
+        targets = self._healthy_urls()
+        if not targets:
+            raise RuntimeError(
+                "weight-update fanout: no healthy generation servers"
+            )
         load_stats: list = []
+        successes: List[str] = []
+        failures: Dict[str, str] = {}
 
         async def _update():
+            await faults.maybe_fail_async("manager.fanout")
             async with aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=self.cfg.flush_request_timeout)
             ) as sess:
@@ -253,39 +531,61 @@ class GserverManager(Worker):
                             "version": self._new_version,
                         },
                     )
-                    for u in self.server_urls
+                    for u in targets
                 ]
                 resps = await asyncio.gather(*tasks, return_exceptions=True)
-                for u, r in zip(self.server_urls, resps):
+                for u, r in zip(targets, resps):
                     if isinstance(r, Exception):
-                        raise RuntimeError(f"weight update to {u} failed: {r!r}")
+                        failures[u] = repr(r)
+                        continue
                     body = await r.json()
                     if not body.get("success"):
-                        raise RuntimeError(
-                            f"weight update to {u} rejected: {body}"
-                        )
+                        failures[u] = f"rejected: {body}"
+                        continue
+                    successes.append(u)
                     load_stats.append(
                         (body.get("source", "?"), float(body.get("load_s", 0.0)))
                     )
 
         fut = asyncio.run_coroutine_threadsafe(_update(), self._http_loop)
         fut.result(timeout=self.cfg.flush_request_timeout + 10)
+        if not successes:
+            # No quorum: weight_version stays put so the next poll
+            # retries the (idempotent, version-pinned) fanout.
+            raise RuntimeError(
+                f"weight update v{self._new_version} reached no server: "
+                f"{failures}"
+            )
+        for u, reason in failures.items():
+            self._mark_unhealthy(u, f"weight update failed: {reason}")
         with self._lock:
             self.weight_version = self._new_version
+            for u in successes:
+                self._server_versions[u] = self._new_version
             self.last_weight_sync_s = time.monotonic() - t_start
         # Sync latency is the async-RL staleness floor (reference bar:
         # <3 s/transfer, blog/AReaL_v0_2.md:52-54) — always logged.
-        logger.info(
-            f"all servers updated to weight version {self.weight_version} "
-            f"in {self.last_weight_sync_s:.3f}s "
-            f"(loads: {', '.join(f'{s} {t:.3f}s' for s, t in load_stats)})"
-        )
+        if failures:
+            logger.warning(
+                f"degraded weight-update fanout to v{self.weight_version}: "
+                f"{len(successes)}/{len(targets)} servers in "
+                f"{self.last_weight_sync_s:.3f}s; evicted {sorted(failures)}"
+            )
+        else:
+            logger.info(
+                f"all servers updated to weight version {self.weight_version} "
+                f"in {self.last_weight_sync_s:.3f}s "
+                f"(loads: {', '.join(f'{s} {t:.3f}s' for s, t in load_stats)})"
+            )
 
     async def _poll_metrics(self):
         async with aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=5)
         ) as sess:
-            for u in list(self.server_urls):
+            # Evicted servers are skipped: polling a dead endpoint costs a
+            # 5s timeout per tick and the health registry already owns
+            # their lifecycle.
+            for u in self._healthy_urls():
                 try:
                     async with sess.get(f"{u}/metrics") as r:
                         text = await r.text()
@@ -304,8 +604,12 @@ class GserverManager(Worker):
                             self._server_prefix_reused[u] = float(
                                 line.split()[-1]
                             )
-                        elif line.startswith("areal:spec_tokens_per_step"):
-                            self._server_spec_yield[u] = float(
+                        elif line.startswith("areal:spec_emitted_tokens"):
+                            self._server_spec_emitted[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:spec_active_steps"):
+                            self._server_spec_steps[u] = float(
                                 line.split()[-1]
                             )
                 except Exception:
@@ -322,6 +626,14 @@ class GserverManager(Worker):
                 return None
         except name_resolve.NameEntryNotFoundError:
             pass
+
+        # Health registry: evict dead servers, readmit returning ones.
+        if time.monotonic() - self._last_health_poll > self.cfg.health_check_interval:
+            try:
+                self._poll_health()
+            except Exception:
+                logger.warning("health poll failed", exc_info=True)
+            self._last_health_poll = time.monotonic()
 
         path = self.check_new_params()
         if path is not None:
@@ -350,7 +662,9 @@ class GserverManager(Worker):
         if now - self._last_throughput_log > self._throughput_log_interval:
             total_gen = sum(self._server_gen_totals.values())
             dt = now - self._last_throughput_log
-            tps = (total_gen - self._last_gen_total) / dt
+            # Clamped: a server restarting in place (counters reset to 0
+            # at the same url) can briefly shrink the fleet sum.
+            tps = max(0.0, total_gen - self._last_gen_total) / dt
             with self._lock:
                 rs = self.rollout_stat.as_dict()
             logger.info(
@@ -361,12 +675,13 @@ class GserverManager(Worker):
                 f"prefix_tokens_reused="
                 f"{sum(self._server_prefix_reused.values()):.0f}"
                 + (
-                    # Realized speculation yield (mean over servers
-                    # reporting >0; 0 means speculation is off fleet-wide).
+                    # Realized fleet speculation yield: ratio of SUMS
+                    # (total emitted tokens / total active decode steps),
+                    # so busy servers weigh in proportionally; absent
+                    # when speculation is off fleet-wide.
                     f" spec_tokens_per_step="
-                    f"{sum(y) / len(y):.2f}"
-                    if (y := [v for v in self._server_spec_yield.values()
-                              if v > 0])
+                    f"{sum(self._server_spec_emitted.values()) / steps:.2f}"
+                    if (steps := sum(self._server_spec_steps.values())) > 0
                     else ""
                 )
             )

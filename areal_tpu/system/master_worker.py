@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 from areal_tpu.api.dfg import build_graph
 from areal_tpu.api.system_api import MasterWorkerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, recover, timeutil
+from areal_tpu.base.fault_injection import faults
 from areal_tpu.base.recover import RecoverInfo, StepInfo
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.buffer import AsyncIOSequenceBuffer
@@ -186,6 +187,10 @@ class MasterWorker(Worker):
     # ------------------------------------------------------------------
 
     def _poll(self) -> Optional[PollResult]:
+        # Chaos injection point: arming this simulates a master-plane
+        # failure, which must escalate to the whole-experiment relaunch
+        # (the master is NOT a restartable fault domain).
+        faults.maybe_fail("master.step")
         t0 = time.monotonic()
         epoch_before = self.step_info.epoch
 

@@ -28,17 +28,53 @@ from areal_tpu.base import logging
 logger = logging.getLogger("partial_rollout")
 
 
+class ServerFailure(RuntimeError):
+    """A generation server failed a request (connection error or 5xx).
+
+    Retryable: the accumulated prefix is resubmitted through the manager,
+    which routes around the failed server after the client reports it."""
+
+    def __init__(self, url: str, detail: str):
+        super().__init__(f"generate failed on {url}: {detail}")
+        self.url = url
+
+
 class PartialRolloutManager:
     def __init__(
         self,
         manager_addr: str,
         new_tokens_per_chunk: int = 1 << 30,
         request_timeout: float = 300.0,
+        max_retries: int = 8,
+        retry_backoff_s: float = 0.05,
+        addr_resolver=None,
     ):
         self.manager_addr = manager_addr
         self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
         self.request_timeout = request_timeout
+        # Failover budget per sample: a dead server costs one retry (the
+        # resubmission lands on a healthy one); the cap only aborts when
+        # the fleet stays unroutable through the whole backoff ramp.
+        self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
+        # Optional () -> current manager address. A restarted gserver
+        # manager re-registers at a NEW address; in-flight samples follow
+        # it instead of dying with their accumulated tokens.
+        self._addr_resolver = addr_resolver
         self._session: Optional[aiohttp.ClientSession] = None
+
+    def _refresh_manager_addr(self):
+        if self._addr_resolver is None:
+            return
+        try:
+            addr = self._addr_resolver()
+        except Exception:
+            return
+        if addr and addr != self.manager_addr:
+            logger.warning(
+                f"gserver manager moved {self.manager_addr} -> {addr}"
+            )
+            self.manager_addr = addr
 
     async def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -50,6 +86,14 @@ class PartialRolloutManager:
     async def close(self):
         if self._session and not self._session.closed:
             await self._session.close()
+
+    def _backoff(self, attempt: int, sched: Optional[Dict] = None) -> float:
+        """Exponential backoff, capped at 2s; a 503's retry_after hint
+        floors the wait."""
+        delay = min(2.0, self.retry_backoff_s * (2 ** (attempt - 1)))
+        if sched:
+            delay = max(delay, float(sched.get("retry_after", 0.0)))
+        return delay
 
     async def _schedule(self, meta: Dict) -> Dict:
         sess = await self._sess()
@@ -70,18 +114,52 @@ class PartialRolloutManager:
         version_end = -1
         no_eos = True
         prev_url, prev_version = "", -1
+        failed_url: Optional[str] = None
+        retries = 0
         budget = gconfig.max_new_tokens
         sess = await self._sess()
         while budget > 0:
-            sched = await self._schedule(
-                dict(
-                    prompt_len=len(prompt_ids) + len(acc_out),
-                    group_size=1,
-                    new_token_budget=budget,
-                    previous_server_url=prev_url,
-                    previous_version=prev_version,
+            try:
+                sched = await self._schedule(
+                    dict(
+                        prompt_len=len(prompt_ids) + len(acc_out),
+                        group_size=1,
+                        new_token_budget=budget,
+                        previous_server_url=prev_url,
+                        previous_version=prev_version,
+                        # Report the server the previous attempt died on,
+                        # so the manager evicts it before routing this
+                        # retry.
+                        failed_server_url=failed_url,
+                    )
                 )
-            )
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                # The manager itself blipped (or was restarted at a new
+                # address): accumulated tokens must survive a control-
+                # plane failure too.
+                retries += 1
+                if retries > self.max_retries:
+                    raise
+                logger.warning(
+                    f"{qid}: schedule_request failed ({e!r}); "
+                    f"retry {retries}/{self.max_retries}"
+                )
+                self._refresh_manager_addr()
+                await asyncio.sleep(self._backoff(retries))
+                continue
+            failed_url = None
+            if "url" not in sched:
+                # 503: no healthy servers right now. Back off and retry —
+                # the watchdog restarting a server or the health registry
+                # readmitting one unblocks us.
+                retries += 1
+                if retries > self.max_retries:
+                    raise RuntimeError(
+                        f"{qid}: no healthy generation servers after "
+                        f"{self.max_retries} retries: {sched}"
+                    )
+                await asyncio.sleep(self._backoff(retries, sched))
+                continue
             url, server_version = sched["url"], int(sched.get("version", -1))
             chunk = min(budget, self.new_tokens_per_chunk)
             payload = dict(
@@ -99,12 +177,31 @@ class PartialRolloutManager:
                     stop_token_ids=list(gconfig.stop_token_ids),
                 ),
             )
-            async with sess.post(f"{url}/generate", json=payload) as r:
-                if r.status != 200:
-                    raise RuntimeError(
-                        f"generate failed on {url}: {r.status} {await r.text()}"
-                    )
-                out = await r.json()
+            try:
+                async with sess.post(f"{url}/generate", json=payload) as r:
+                    if r.status != 200:
+                        raise ServerFailure(
+                            url, f"{r.status} {await r.text()}"
+                        )
+                    out = await r.json()
+            except (
+                ServerFailure, aiohttp.ClientError, asyncio.TimeoutError,
+            ) as e:
+                # Server died mid-request. Work already accumulated in
+                # acc_out is NOT lost: the retry resubmits the full
+                # prefix to whichever healthy server the manager picks
+                # (same path as a weight-update interrupt).
+                retries += 1
+                if retries > self.max_retries:
+                    raise
+                failed_url = url
+                prev_url, prev_version = "", -1  # sticky hint is dead
+                logger.warning(
+                    f"{qid}: generate attempt failed on {url} ({e!r}); "
+                    f"retry {retries}/{self.max_retries}"
+                )
+                await asyncio.sleep(self._backoff(retries))
+                continue
             if version_start < 0:
                 version_start = int(out.get("version_start", server_version))
             version_end = int(out.get("version_end", server_version))

@@ -22,6 +22,7 @@ from areal_tpu.api.agent_api import make_agent
 from areal_tpu.api.env_api import make_env
 from areal_tpu.api.system_api import RolloutWorkerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, seeding
+from areal_tpu.base.fault_injection import faults
 from areal_tpu.system import eval_scores
 from areal_tpu.system.partial_rollout import PartialRolloutManager
 from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
@@ -94,6 +95,12 @@ class RolloutWorker(AsyncWorker):
             self.manager_addr,
             new_tokens_per_chunk=config.new_tokens_per_chunk,
             request_timeout=config.rollout_request_timeout,
+            max_retries=config.rollout_max_retries,
+            addr_resolver=lambda: name_resolve.get(
+                names.gen_server_manager(
+                    config.experiment_name, config.trial_name
+                )
+            ),
         )
         self.pusher = NameResolvingZmqPusher(
             config.experiment_name,
@@ -110,6 +117,22 @@ class RolloutWorker(AsyncWorker):
             f"{config.worker_name} configured; manager at {self.manager_addr}"
         )
 
+    def _rediscover_manager(self):
+        try:
+            addr = name_resolve.get(
+                names.gen_server_manager(
+                    self.cfg.experiment_name, self.cfg.trial_name
+                )
+            )
+        except name_resolve.NameEntryNotFoundError:
+            return
+        if addr != self.manager_addr:
+            logger.warning(
+                f"gserver manager moved {self.manager_addr} -> {addr}"
+            )
+            self.manager_addr = addr
+            self.prm.manager_addr = addr
+
     async def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
             self._session = aiohttp.ClientSession(
@@ -120,7 +143,10 @@ class RolloutWorker(AsyncWorker):
     async def _allocate(self) -> bool:
         sess = await self._http()
         async with sess.post(
-            f"{self.manager_addr}/allocate_rollout", json={}
+            f"{self.manager_addr}/allocate_rollout",
+            # Slot ownership: the manager reclaims this worker's
+            # outstanding slots if its heartbeat dies.
+            json={"worker": self.cfg.worker_name},
         ) as r:
             d = await r.json()
         return bool(d.get("success"))
@@ -128,9 +154,27 @@ class RolloutWorker(AsyncWorker):
     async def _finish(self, accepted: bool):
         sess = await self._http()
         async with sess.post(
-            f"{self.manager_addr}/finish_rollout", json={"accepted": accepted}
+            f"{self.manager_addr}/finish_rollout",
+            json={"accepted": accepted, "worker": self.cfg.worker_name},
         ) as r:
             await r.json()
+
+    async def _release_quota(self, accepted: bool):
+        """Release this episode's quota slot, retrying through transient
+        manager failures — a leaked slot would permanently shrink the
+        rollout quota (and enough of them starve it entirely)."""
+        for attempt in range(3):
+            try:
+                await self._finish(accepted)
+                return
+            except Exception:
+                if attempt == 2:
+                    logger.warning(
+                        "finish_rollout failed; quota slot leaks until "
+                        "the manager resyncs", exc_info=True,
+                    )
+                else:
+                    await asyncio.sleep(0.2 * (attempt + 1))
 
     async def rollout_task(self, prompt):
         """One episode: agent coroutine + generation servicing
@@ -153,6 +197,7 @@ class RolloutWorker(AsyncWorker):
         accepted = False
         gen_task = None
         try:
+            faults.maybe_fail("rollout.episode")
             gen_task = asyncio.create_task(service_gen())
             agent_task = asyncio.create_task(
                 self.agent.collect_trajectory(
@@ -187,15 +232,16 @@ class RolloutWorker(AsyncWorker):
         except Exception:
             logger.exception("rollout episode failed")
         finally:
+            # The quota slot is released on EVERY exit path — normal,
+            # crashing agent, or cancellation — so a dying episode can't
+            # starve the rollout quota. Shielded so cancellation of this
+            # task doesn't also cancel the release mid-flight.
             if gen_task is not None and not gen_task.done():
                 gen_task.cancel()
             try:
-                await self._finish(accepted)
-            except Exception:
-                # Best effort: a transient manager failure must not leave an
-                # unretrieved task exception (the quota slot does leak until
-                # the manager resyncs, but the worker keeps running).
-                logger.warning("finish_rollout failed", exc_info=True)
+                await asyncio.shield(self._release_quota(accepted))
+            except asyncio.CancelledError:
+                pass
 
     async def _poll_async(self) -> Optional[PollResult]:
         # Experiment status gate (reference rollout_worker.py:216-228).
@@ -212,8 +258,17 @@ class RolloutWorker(AsyncWorker):
         except name_resolve.NameEntryNotFoundError:
             pass
 
-        # Reap finished episode tasks.
-        self._tasks = {k: t for k, t in self._tasks.items() if not t.done()}
+        # Reap finished episode tasks, retrieving their exceptions so a
+        # crashed episode can't emit "Task exception was never retrieved"
+        # at GC time (rollout_task handles its own errors; anything that
+        # still escapes is a harness bug worth logging, not crashing on).
+        live = {}
+        for k, t in self._tasks.items():
+            if not t.done():
+                live[k] = t
+            elif not t.cancelled() and t.exception() is not None:
+                logger.error(f"episode task {k} died", exc_info=t.exception())
+        self._tasks = live
 
         if len(self._tasks) >= self.cfg.max_concurrent_rollouts:
             await asyncio.sleep(0.02)
@@ -223,33 +278,46 @@ class RolloutWorker(AsyncWorker):
             ok = await self._allocate()
         except Exception:
             logger.warning("allocate_rollout failed; retrying", exc_info=True)
+            # A restarted gserver manager re-registers at a NEW address;
+            # re-resolve so this worker follows it instead of hammering
+            # the dead endpoint forever.
+            self._rediscover_manager()
             await asyncio.sleep(0.5)
             return PollResult(batch_count=0)
         if not ok:
             await asyncio.sleep(0.1)
             return PollResult(batch_count=0)
 
-        batch, epoch_last = self.dataloader.next_batch()
-        if epoch_last:
-            # Epoch boundary: publish this worker's scores and run the
-            # curriculum filter over the merged file (reference
-            # rollout_worker.py:147-176). In-flight episodes from the old
-            # epoch still complete; their scores publish next epoch.
-            eval_scores.merge_scores(
-                self.cfg.experiment_name,
-                self.cfg.trial_name,
-                self.pending_scores,
+        try:
+            batch, epoch_last = self.dataloader.next_batch()
+            if epoch_last:
+                # Epoch boundary: publish this worker's scores and run the
+                # curriculum filter over the merged file (reference
+                # rollout_worker.py:147-176). In-flight episodes from the old
+                # epoch still complete; their scores publish next epoch.
+                eval_scores.merge_scores(
+                    self.cfg.experiment_name,
+                    self.cfg.trial_name,
+                    self.pending_scores,
+                )
+                self._pending_scores = {}
+                eval_scores.apply_filter(
+                    self.dataset,
+                    self.cfg.experiment_name,
+                    self.cfg.trial_name,
+                    tag=f"rollout{self.cfg.worker_index}",
+                    min_size=1,
+                )
+            eid = next(self._episode_counter)
+            self._tasks[f"ep{eid}"] = asyncio.create_task(
+                self.rollout_task(batch)
             )
-            self._pending_scores = {}
-            eval_scores.apply_filter(
-                self.dataset,
-                self.cfg.experiment_name,
-                self.cfg.trial_name,
-                tag=f"rollout{self.cfg.worker_index}",
-                min_size=1,
-            )
-        eid = next(self._episode_counter)
-        self._tasks[f"ep{eid}"] = asyncio.create_task(self.rollout_task(batch))
+        except Exception:
+            # The slot was allocated but no episode task owns it yet: a
+            # failure in this window (dataloader, curriculum filter, task
+            # spawn) must give the slot back or the quota leaks.
+            await self._release_quota(False)
+            raise
         return PollResult(sample_count=1, batch_count=1)
 
     def _exit_hook(self):

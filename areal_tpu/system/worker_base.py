@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import json
+import os
 import queue
 import threading
 import time
@@ -20,7 +21,8 @@ from typing import Any, Dict, Optional
 
 import zmq
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base import health, logging, name_resolve, names, network
+from areal_tpu.base.fault_injection import faults
 
 logger = logging.getLogger("worker")
 
@@ -201,11 +203,51 @@ class Worker:
         self.experiment_name = experiment_name or getattr(config, "experiment_name", "")
         self.trial_name = trial_name or getattr(config, "trial_name", "")
         self.worker_name = worker_name or getattr(config, "worker_name", "")
+        if self.worker_name:
+            # Scope env-armed chaos faults (AREAL_FAULTS "@worker" specs)
+            # to this worker before any injection point can be hit.
+            faults.set_scope(self.worker_name)
         self._configure(config)
         self._configured = True
         self._running = True
+        if self.experiment_name and self.trial_name and self.worker_name:
+            # Fault-domain lease: beaten from the poll loop, so a hung
+            # worker (not just a dead one) goes stale and the watchdog /
+            # gserver manager can isolate it.
+            try:
+                self._heartbeat = health.Heartbeat(
+                    self.experiment_name,
+                    self.trial_name,
+                    self.worker_name,
+                    payload=self._heartbeat_payload(),
+                    ttl=self._heartbeat_ttl(),
+                )
+            except Exception:
+                logger.warning("heartbeat registration failed", exc_info=True)
         if self._server:
             self._server.set_status(WorkerServerStatus.RUNNING)
+
+    def _heartbeat_payload(self) -> Dict[str, Any]:
+        """Extra fields for this worker's health record (subclasses add
+        e.g. their HTTP address so consumers can map member -> endpoint)."""
+        return {"pid": os.getpid()}
+
+    def _heartbeat_ttl(self) -> Optional[float]:
+        """Per-role TTL override (None = default_ttl / AREAL_HEALTH_TTL).
+        Roles whose poll loop can legitimately block for long stretches
+        return a TTL covering that stretch, so the supervisor's stale-
+        heartbeat hang detection doesn't fire on healthy blocking."""
+        return None
+
+    def _beat(self):
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.beat()
+
+    def _stop_heartbeat(self):
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.stop()
 
     def _handle_commands(self):
         if not self._server:
@@ -240,6 +282,8 @@ class Worker:
         try:
             while not self._exiting:
                 self._handle_commands()
+                self._beat()
+                faults.maybe_fail("worker.poll")
                 if not self._running:
                     time.sleep(0.05)
                     continue
@@ -256,6 +300,7 @@ class Worker:
                 self._server.set_status(WorkerServerStatus.ERROR)
             raise
         finally:
+            self._stop_heartbeat()
             self._exit_hook()
 
     def exit(self):
@@ -276,6 +321,8 @@ class AsyncWorker(Worker):
         async def _loop():
             while not self._exiting:
                 self._handle_commands()
+                self._beat()
+                await faults.maybe_fail_async("worker.poll")
                 if not self._running:
                     await asyncio.sleep(0.05)
                     continue
@@ -294,4 +341,5 @@ class AsyncWorker(Worker):
                 self._server.set_status(WorkerServerStatus.ERROR)
             raise
         finally:
+            self._stop_heartbeat()
             self._exit_hook()

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -31,6 +32,79 @@ from areal_tpu.utils.jaxenv import apply_jax_platform_override
 apply_jax_platform_override()
 
 BASELINE_TFLOPS = 198.0
+
+
+# ----------------------------------------------------------------------
+# Flap tolerance: persistent XLA compilation cache + per-phase resume.
+# A remote-tunneled TPU run that dies mid-compile (VERDICT r5: one lost
+# tunnel window killed an entire bench) restarts with (a) warm compiled
+# programs and (b) every already-measured phase loaded from disk, so
+# only the interrupted phase re-runs.
+# ----------------------------------------------------------------------
+
+
+def enable_compilation_cache():
+    """Point JAX's persistent compilation cache at a stable directory
+    (min-compile-time floors dropped so every bench program caches)."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "AREAL_XLA_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "areal_xla_cache"),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        log(f"bench: persistent compilation cache at {cache_dir}")
+    except Exception as e:  # older jax: cache flags absent — bench still runs
+        log(f"bench: compilation cache unavailable ({e!r})")
+
+
+def state_path() -> str:
+    return os.environ.get(
+        "AREAL_BENCH_STATE",
+        os.path.join(tempfile.gettempdir(), "areal_bench_state.json"),
+    )
+
+
+def load_state(platform: str, max_age_s: float = None) -> dict:
+    """Previously-measured phase results, if fresh and from the same
+    platform; {} otherwise (stale results from an old round must not be
+    reported as this round's)."""
+    if max_age_s is None:
+        max_age_s = float(os.environ.get("AREAL_BENCH_STATE_TTL_S", 6 * 3600))
+    try:
+        with open(state_path()) as f:
+            st = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if st.get("platform") != platform:
+        return {}
+    if time.time() - float(st.get("saved_at", 0)) > max_age_s:
+        return {}
+    return st
+
+
+def save_phase(state: dict, platform: str, key: str, value) -> dict:
+    state = dict(state)
+    state[key] = value
+    state["platform"] = platform
+    state["saved_at"] = time.time()
+    path = state_path()
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+    return state
+
+
+def clear_state():
+    try:
+        os.remove(state_path())
+    except OSError:
+        pass
 
 
 def flagship_cfg(max_pos: int = 40960, attn_bias: bool = True):
@@ -280,12 +354,31 @@ def _arm_deadline(seconds: float):
 
 def main():
     deadline = _arm_deadline(float(os.environ.get("AREAL_BENCH_DEADLINE_S", 2700)))
-    tflops, on_tpu = train_bench()
-    _PARTIAL["train_tflops"] = tflops
+    enable_compilation_cache()
     import gc
 
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    state = load_state(platform)
+
+    if state.get("train_tflops") is not None:
+        tflops = float(state["train_tflops"])
+        log(f"bench: resuming train phase from checkpoint "
+            f"({tflops:.1f} TFLOP/s)")
+    else:
+        tflops, on_tpu = train_bench()
+        state = save_phase(state, platform, "train_tflops", tflops)
+    _PARTIAL["train_tflops"] = tflops
+
     gc.collect()  # drop the train frame's device buffers before gen
-    gen_tps = gen_bench(on_tpu)
+    if state.get("gen_tps") is not None:
+        gen_tps = float(state["gen_tps"])
+        log(f"bench: resuming gen phase from checkpoint ({gen_tps:.0f} tok/s)")
+    else:
+        gen_tps = gen_bench(on_tpu)
+        state = save_phase(state, platform, "gen_tps", gen_tps)
     _PARTIAL["gen_tps"] = gen_tps
     gc.collect()
     # Re-arm for the long-form phase: it compiles its own chunked
@@ -295,9 +388,17 @@ def main():
     deadline = _arm_deadline(
         float(os.environ.get("AREAL_BENCH_LONG_DEADLINE_S", 1200))
     )
-    gen_long_tps = gen_bench(on_tpu, long_form=True)
+    if state.get("gen_long_tps") is not None:
+        gen_long_tps = float(state["gen_long_tps"])
+        log(f"bench: resuming gen-long phase from checkpoint "
+            f"({gen_long_tps:.0f} tok/s)")
+    else:
+        gen_long_tps = gen_bench(on_tpu, long_form=True)
+        state = save_phase(state, platform, "gen_long_tps", gen_long_tps)
 
     deadline.cancel()
+    # Completed: the next invocation is a fresh round, not a resume.
+    clear_state()
     print(json.dumps({
         "metric": "train_tflops_per_chip",
         "value": round(tflops, 2),

@@ -1,0 +1,88 @@
+"""Health registry: lease freshness, transitions, graceful stop."""
+
+import time
+
+import pytest
+
+from areal_tpu.base import name_resolve
+from areal_tpu.base.health import Heartbeat, HealthRegistry, STALE_FACTOR
+
+
+@pytest.fixture()
+def kv(tmp_path):
+    repo = name_resolve.reconfigure(
+        "nfs", record_root=str(tmp_path / "name_resolve")
+    )
+    yield repo
+    repo.reset()
+
+
+EXP, TRIAL = "health-test", "t0"
+
+
+def test_beat_keeps_member_alive(kv):
+    hb = Heartbeat(EXP, TRIAL, "worker/0", payload={"url": "http://x"}, ttl=0.2)
+    reg = HealthRegistry(EXP, TRIAL)
+    assert "worker/0" in reg.snapshot()
+    assert reg.snapshot()["worker/0"]["url"] == "http://x"
+    # Keep beating past several TTLs: stays alive.
+    for _ in range(4):
+        time.sleep(0.1)
+        hb.beat()
+    assert "worker/0" in reg.snapshot()
+    hb.stop()
+
+
+def test_missed_beats_go_stale(kv):
+    hb = Heartbeat(EXP, TRIAL, "worker/1", ttl=0.1)
+    reg = HealthRegistry(EXP, TRIAL)
+    assert "worker/1" in reg.snapshot()
+    time.sleep(0.1 * STALE_FACTOR + 0.15)  # no beats
+    assert "worker/1" not in reg.snapshot()
+    # The record still exists (no TTL deletion) — staleness is judged
+    # from the value, so any backend behaves identically.
+    hb.beat(force=True)
+    assert "worker/1" in reg.snapshot()
+    hb.stop()
+
+
+def test_transition_callbacks(kv):
+    dead, alive = [], []
+    reg = HealthRegistry(
+        EXP, TRIAL,
+        on_dead=lambda m, r: dead.append(m),
+        on_alive=lambda m, r: alive.append(m),
+    )
+    hb = Heartbeat(EXP, TRIAL, "worker/2", ttl=0.1)
+    reg.poll()
+    assert alive == ["worker/2"] and dead == []
+    time.sleep(0.1 * STALE_FACTOR + 0.15)
+    reg.poll()
+    assert dead == ["worker/2"]
+    hb.beat(force=True)
+    reg.poll()
+    assert alive == ["worker/2", "worker/2"]
+    hb.stop()
+
+
+def test_graceful_stop_is_departure_not_death(kv):
+    hb = Heartbeat(EXP, TRIAL, "worker/3", ttl=10.0)
+    reg = HealthRegistry(EXP, TRIAL)
+    assert "worker/3" in reg.snapshot()
+    hb.stop()
+    # Leaves the live set immediately, but is flagged as stopped so
+    # supervisors don't treat it as a crash.
+    assert "worker/3" not in reg.snapshot()
+    assert "worker/3" in reg.stopped_members()
+
+
+def test_prefix_scopes_the_view(kv):
+    a = Heartbeat(EXP, TRIAL, "generation_server/0",
+                  payload={"url": "http://a"}, ttl=5.0)
+    b = Heartbeat(EXP, TRIAL, "rollout_worker/0", ttl=5.0)
+    scoped = HealthRegistry(EXP, TRIAL, prefix="generation_server")
+    assert set(scoped.snapshot()) == {"generation_server/0"}
+    full = HealthRegistry(EXP, TRIAL)
+    assert set(full.snapshot()) == {"generation_server/0", "rollout_worker/0"}
+    a.stop()
+    b.stop()

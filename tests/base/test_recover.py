@@ -1,0 +1,63 @@
+"""Recovery metadata round-trip + latest-checkpoint discovery."""
+
+import os
+
+import pytest
+
+from areal_tpu.base import constants, recover
+from areal_tpu.base.recover import RecoverInfo, StepInfo
+
+
+@pytest.fixture()
+def recover_root(tmp_path, monkeypatch):
+    monkeypatch.setattr(constants, "RECOVER_ROOT", str(tmp_path / "recover"))
+    yield tmp_path
+
+
+EXP, TRIAL = "recover-test", "t0"
+
+
+def test_dump_load_roundtrip(recover_root):
+    info = RecoverInfo(
+        recover_start=StepInfo(epoch=1, epoch_step=2, global_step=12),
+        last_step_info=StepInfo(epoch=1, epoch_step=3, global_step=13),
+        save_ctl_info={"freq_sec": 60, "last": 123.0},
+        ckpt_ctl_info={"freq_step": 5},
+        eval_ctl_info={},
+        data_loading_dp_idx=3,
+        hash_vals_to_ignore=[11, 7, 5],
+    )
+    recover.dump(info, EXP, TRIAL)
+    loaded = recover.load(EXP, TRIAL)
+    assert loaded == info
+    # Atomic write: no .tmp litter left behind.
+    d = os.path.dirname(recover.dump_path(EXP, TRIAL))
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+def test_load_without_dump_raises(recover_root):
+    with pytest.raises(FileNotFoundError):
+        recover.load(EXP, "no-such-trial")
+
+
+def test_step_info_next():
+    s = StepInfo(epoch=2, epoch_step=4, global_step=9)
+    n = s.next()
+    assert (n.epoch, n.epoch_step, n.global_step) == (2, 5, 10)
+
+
+def test_discover_ckpt_picks_latest_step(recover_root):
+    root = os.path.join(constants.get_recover_path(EXP, TRIAL), "ckpt", "actor")
+    # Numeric ordering, not lexicographic: 100 > 99 > 9.
+    for step in ("9", "99", "100"):
+        os.makedirs(os.path.join(root, step))
+    # Non-numeric entries are ignored.
+    os.makedirs(os.path.join(root, "tmp-partial"))
+    assert recover.discover_ckpt("actor", EXP, TRIAL) == os.path.join(root, "100")
+
+
+def test_discover_ckpt_empty_cases(recover_root):
+    assert recover.discover_ckpt("nonexistent-role", EXP, TRIAL) is None
+    root = os.path.join(constants.get_recover_path(EXP, TRIAL), "ckpt", "critic")
+    os.makedirs(root)
+    assert recover.discover_ckpt("critic", EXP, TRIAL) is None

@@ -1,0 +1,35 @@
+"""Minimal heartbeat-only workers for controller-supervision chaos tests.
+
+Spawned through LocalController via the "module:Class" worker spec
+(system.load_worker), so the real subprocess + supervision machinery is
+exercised without booting a model."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from areal_tpu.system.worker_base import PollResult, Worker
+
+
+@dataclasses.dataclass
+class SleeperConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    worker_index: int = 0
+
+    @property
+    def worker_name(self) -> str:
+        return f"sleeper/{self.worker_index}"
+
+
+class SleeperWorker(Worker):
+    """Polls forever; its only observable behavior is the heartbeat the
+    Worker base class maintains (plus the worker.poll injection point)."""
+
+    def _configure(self, config: SleeperConfig):
+        self.cfg = config
+
+    def _poll(self):
+        time.sleep(0.02)
+        return PollResult(batch_count=0)

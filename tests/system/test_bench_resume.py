@@ -1,0 +1,45 @@
+"""bench.py flap tolerance: per-phase checkpoint state (a run killed
+mid-compile resumes finished phases instead of losing the round)."""
+
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def state_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_BENCH_STATE", str(tmp_path / "bench_state.json"))
+    yield
+
+
+def test_save_then_load_roundtrip():
+    st = bench.save_phase({}, "cpu", "train_tflops", 12.5)
+    st = bench.save_phase(st, "cpu", "gen_tps", 340.0)
+    loaded = bench.load_state("cpu")
+    assert loaded["train_tflops"] == 12.5
+    assert loaded["gen_tps"] == 340.0
+
+
+def test_platform_mismatch_discards():
+    bench.save_phase({}, "tpu", "train_tflops", 99.0)
+    assert bench.load_state("cpu") == {}
+
+
+def test_stale_state_discards():
+    bench.save_phase({}, "cpu", "train_tflops", 1.0)
+    assert bench.load_state("cpu", max_age_s=0.0) == {}
+    assert bench.load_state("cpu", max_age_s=3600.0) != {}
+
+
+def test_clear_state():
+    bench.save_phase({}, "cpu", "train_tflops", 1.0)
+    bench.clear_state()
+    assert bench.load_state("cpu") == {}
+    bench.clear_state()  # idempotent
+
+
+def test_corrupt_state_discards(tmp_path, monkeypatch):
+    path = tmp_path / "bench_state.json"
+    monkeypatch.setenv("AREAL_BENCH_STATE", str(path))
+    path.write_text("{not json")
+    assert bench.load_state("cpu") == {}

@@ -1,0 +1,557 @@
+"""Chaos suite: fault-domain isolation under deterministic injected
+failures (ISSUE 1 tentpole). Fast, CPU-only, tier-1.
+
+The serving fleet is faked at the HTTP contract level (tiny aiohttp
+servers speaking the generation-server protocol, heartbeating through
+the real health registry) while everything under test is real: the
+GserverManager worker (routing, eviction, quorum fanout, readmission),
+the PartialRolloutManager failover client, and a RolloutWorker episode
+loop pushing trajectories over the real ZMQ stream."""
+
+import asyncio
+import itertools
+import json
+import os
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from areal_tpu.api import data_api
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.api.system_api import GserverManagerConfig, RolloutWorkerConfig
+from areal_tpu.base import constants, health, name_resolve, names
+from areal_tpu.base.fault_injection import faults
+from areal_tpu.system.gserver_manager import GserverManager
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+from areal_tpu.system.push_pull_stream import ZMQJsonPuller, ZMQJsonPusher
+from areal_tpu.system.rollout_worker import RolloutWorker
+
+pytestmark = pytest.mark.chaos
+
+HB_TTL = 0.25
+
+
+# ----------------------------------------------------------------------
+# Harness
+# ----------------------------------------------------------------------
+
+
+class FakeGenServer:
+    """Speaks the generation-server HTTP contract; heartbeats through the
+    real health registry. `kill()` = crash (stop beating + 500s);
+    `revive()` = restarted process (beats resume, serves again)."""
+
+    def __init__(self, exp: str, trial: str, idx: int, beating: bool = True):
+        self.exp, self.trial, self.idx = exp, trial, idx
+        self.dead = False
+        self.beating = beating
+        self.versions = []  # weight versions received, in order
+        self.n_generate = 0
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+        # beating=False defers Heartbeat creation to the first beat, so
+        # the member has truly NEVER appeared in the registry until
+        # revived; beating=True registers eagerly (like a real worker's
+        # configure()).
+        self.hb = self._mk_heartbeat() if beating else None
+        self._beat_thread = threading.Thread(target=self._beat_loop, daemon=True)
+        self._beat_thread.start()
+
+    def _mk_heartbeat(self):
+        return health.Heartbeat(
+            self.exp, self.trial, f"generation_server/{self.idx}",
+            payload={"url": self.address}, ttl=HB_TTL,
+        )
+
+    def _beat_loop(self):
+        while not self._stop.wait(HB_TTL / 3):
+            if not self.beating:
+                continue
+            if self.hb is None:
+                self.hb = self._mk_heartbeat()
+            else:
+                self.hb.beat(force=True)
+
+    def _serve(self):
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        app.router.add_post("/generate", self._h_generate)
+        app.router.add_post("/update_weights_from_disk", self._h_update)
+        app.router.add_get("/metrics", self._h_metrics)
+        runner = web.AppRunner(app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        self._loop.run_until_complete(site.start())
+        port = site._server.sockets[0].getsockname()[1]
+        self.address = f"http://127.0.0.1:{port}"
+        self._ready.set()
+        self._loop.run_forever()
+
+    async def _h_generate(self, request):
+        self.n_generate += 1
+        if self.dead:
+            return web.json_response({"error": "dead"}, status=500)
+        await faults.maybe_fail_async(f"fake{self.idx}.generate")
+        d = await request.json()
+        n = int(d["gconfig"]["max_new_tokens"])
+        return web.json_response({
+            "qid": d["qid"],
+            "output_ids": [self.idx + 1] * n,
+            "output_logprobs": [-0.1] * n,
+            "no_eos": False,
+            "interrupted": False,
+            "version_start": self.versions[-1] if self.versions else 0,
+            "version_end": self.versions[-1] if self.versions else 0,
+        })
+
+    async def _h_update(self, request):
+        if self.dead:
+            return web.json_response({"error": "dead"}, status=500)
+        d = await request.json()
+        self.versions.append(int(d["version"]))
+        return web.json_response(
+            {"success": True, "load_s": 0.0, "source": "fake"}
+        )
+
+    async def _h_metrics(self, request):
+        return web.Response(text="areal:num_running_reqs 0\n")
+
+    def kill(self):
+        self.dead = True
+        self.beating = False
+
+    def revive(self):
+        self.dead = False
+        self.beating = True
+
+    def close(self):
+        self._stop.set()
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+def _wait_until(cond, timeout=10.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def chaos_env(tmp_path, monkeypatch):
+    """nfs name_resolve + tmp filesystem roots + fast heartbeats + a
+    clean injector, torn down in order."""
+    monkeypatch.setenv("AREAL_HEALTH_TTL", str(HB_TTL))
+    monkeypatch.setattr(
+        constants, "PARAM_REALLOC_ROOT", str(tmp_path / "realloc")
+    )
+    repo = name_resolve.reconfigure(
+        "nfs", record_root=str(tmp_path / "name_resolve")
+    )
+    faults.reset()
+    exp, trial = f"chaos-{uuid.uuid4().hex[:6]}", "t0"
+    state = {"exp": exp, "trial": trial, "cleanup": []}
+    yield state
+    # Tell workers/manager to exit, then close fakes.
+    try:
+        name_resolve.add(
+            names.experiment_status(exp, trial), "COMPLETE", replace=True
+        )
+    except Exception:
+        pass
+    for fn in state["cleanup"]:
+        try:
+            fn()
+        except Exception:
+            pass
+    faults.reset()
+    repo.reset()
+
+
+def _start_manager(env, n_servers, policy="round_robin"):
+    cfg = GserverManagerConfig(
+        experiment_name=env["exp"],
+        trial_name=env["trial"],
+        model_name="actor",
+        n_servers=n_servers,
+        schedule_policy=policy,
+        train_batch_size=4,
+        max_head_offpolicyness=1000,
+        flush_request_timeout=5.0,
+        health_check_interval=0.1,
+    )
+    m = GserverManager()
+    m.configure(cfg)
+    t = threading.Thread(target=m.run, daemon=True)
+    t.start()
+    env["cleanup"].append(lambda: t.join(timeout=10))
+    return m
+
+
+def _mk_rollout_worker(env, manager_addr, pusher_port):
+    """Harness-built partial RolloutWorker (the established idiom for
+    unit-level worker tests): real episode loop, real failover client,
+    real ZMQ push — no dataset/tokenizer bootstrapping."""
+
+    class _OnePromptLoader:
+        def next_batch(self):
+            return (
+                data_api.SequenceSample.from_default(
+                    ids=[f"p{uuid.uuid4().hex[:4]}"],
+                    seqlens=[3],
+                    data={"packed_prompts": np.array([5, 6, 7], np.int32)},
+                ),
+                False,
+            )
+
+    from areal_tpu.agents.null import NullAgent
+
+    w = RolloutWorker.__new__(RolloutWorker)
+    w.cfg = RolloutWorkerConfig(
+        experiment_name=env["exp"],
+        trial_name=env["trial"],
+        max_concurrent_rollouts=2,
+        rollout_max_retries=8,
+    )
+    w.manager_addr = manager_addr
+    w.prm = PartialRolloutManager(
+        manager_addr, request_timeout=5.0, max_retries=8,
+        retry_backoff_s=0.02,
+    )
+    w.agent = NullAgent(gconfig=dict(n=1, max_new_tokens=4))
+    w.env = None
+    w.dataset = None
+    w.dataloader = _OnePromptLoader()
+    w.pusher = ZMQJsonPusher("127.0.0.1", pusher_port)
+    w._session = None
+    w._tasks = {}
+    w._push_count = 0
+    w._episode_counter = itertools.count()
+    return w
+
+
+async def _drive_episodes(w, n):
+    """Run the worker's poll loop until n episodes were launched, then
+    await them (and close its HTTP session)."""
+    seen = set()
+    deadline = time.monotonic() + 20
+    while len(seen) < n:
+        assert time.monotonic() < deadline, "episode launch stalled"
+        await w._poll_async()
+        seen |= set(w._tasks)
+    await asyncio.gather(*w._tasks.values())
+    if w._session is not None:
+        await w._session.close()
+    await w.prm.close()
+
+
+# ----------------------------------------------------------------------
+# Acceptance: degraded-mode serving fleet
+# ----------------------------------------------------------------------
+
+
+def test_server_death_mid_rollout_degrades_then_recovers(chaos_env):
+    """With 2 generation servers, killing one mid-rollout (1) lets the
+    in-flight rollout retry to the survivor and complete its training
+    step input, (2) evicts the dead server from all three routing
+    policies, (3) lets the weight-update fanout proceed on the survivor
+    alone, and (4) re-syncs the dead server to the latest weights on
+    readmission before it re-enters rotation."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    servers = [FakeGenServer(exp, trial, i) for i in range(2)]
+    env["cleanup"].extend(s.close for s in servers)
+    for s in servers:
+        name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=2)
+
+    # Round-robin from sorted urls: the FIRST generate lands on the
+    # lexicographically-first server — kill exactly that one, mid-rollout.
+    victim, survivor = sorted(servers, key=lambda s: s.address)
+    faults.arm(
+        f"fake{victim.idx}.generate", action="raise", at_hit=1,
+        on_trigger=victim.kill,
+    )
+
+    # --- (1) the in-flight rollout retries to the survivor and the
+    # trajectory reaches the trainer stream.
+    puller = ZMQJsonPuller(host="127.0.0.1")
+    env["cleanup"].append(puller.close)
+    w = _mk_rollout_worker(env, m.address, puller.port)
+    asyncio.run(_drive_episodes(w, 1))
+    traj = puller.pull(timeout_ms=5000)
+    sample = data_api.sample_from_json(traj)
+    # NullAgent seq = prompt + 4 generated tokens; the survivor stamps
+    # its idx+1 into every generated token.
+    ids = np.asarray(sample.data["packed_input_ids"]).tolist()
+    assert ids[:3] == [5, 6, 7] and ids[3:] == [survivor.idx + 1] * 4
+    assert victim.n_generate >= 1  # the fault really hit mid-rollout
+    # Quota slot released despite the failover.
+    _wait_until(lambda: m.rollout_stat.running == 0, msg="quota release")
+    assert m.rollout_stat.accepted == 1
+
+    # --- (2) evicted from every routing policy.
+    _wait_until(lambda: victim.address in m._evicted, msg="eviction")
+    for policy in ("round_robin", "least_requests", "least_token_usage"):
+        m.cfg.schedule_policy = policy
+        with m._lock:
+            choices = {m._choose_server({}) for _ in range(4)}
+        assert choices == {survivor.address}, policy
+
+    # --- (3) quorum fanout: publish v1; it must land on the survivor
+    # and advance weight_version without the dead server aborting it.
+    dump_dir = os.path.join(
+        constants.get_param_realloc_path(exp, trial), "actor"
+    )
+    os.makedirs(dump_dir, exist_ok=True)
+    with open(os.path.join(dump_dir, "engine_state.pkl"), "wb") as f:
+        f.write(b"fake")
+    name_resolve.add(names.model_version(exp, trial, "actor"), "1", replace=True)
+    _wait_until(lambda: m.weight_version == 1, msg="quorum fanout")
+    assert survivor.versions == [1]
+    assert victim.versions == []
+
+    # --- (4) readmission: heartbeat returns -> re-synced to v1 FIRST,
+    # then back in rotation.
+    victim.revive()
+    _wait_until(
+        lambda: victim.address in m._healthy, timeout=15, msg="readmission"
+    )
+    assert victim.versions == [1]  # re-synced before re-entering rotation
+    assert m._server_versions[victim.address] == 1
+    m.cfg.schedule_policy = "round_robin"
+    with m._lock:
+        routed = {m._choose_server({}) for _ in range(4)}
+    assert routed == {victim.address, survivor.address}
+
+    m.exit()
+
+
+def test_restarted_server_at_new_address_migrates_routing(chaos_env):
+    """A controller-restarted generation server re-registers the SAME
+    health member at a NEW port: the manager migrates its routing-table
+    entry, re-syncs the new incarnation to the current weights, and
+    readmits it."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    servers = [FakeGenServer(exp, trial, i) for i in range(2)]
+    env["cleanup"].extend(s.close for s in servers)
+    for s in servers:
+        name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=2)
+    old, keeper = servers
+
+    # Give the manager one healthy fanout first, so re-sync has a
+    # version to push.
+    dump_dir = os.path.join(
+        constants.get_param_realloc_path(exp, trial), "actor"
+    )
+    os.makedirs(dump_dir, exist_ok=True)
+    with open(os.path.join(dump_dir, "engine_state.pkl"), "wb") as f:
+        f.write(b"fake")
+    name_resolve.add(names.model_version(exp, trial, "actor"), "1", replace=True)
+    _wait_until(lambda: m.weight_version == 1, msg="initial fanout")
+    # Let the manager observe the original member->url mapping.
+    _wait_until(
+        lambda: m._member_urls.get("generation_server/0") == old.address,
+        msg="member mapping",
+    )
+
+    old.kill()
+    _wait_until(lambda: old.address in m._evicted, timeout=15, msg="eviction")
+
+    # "Restart": same member (idx 0), fresh port.
+    replacement = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(replacement.close)
+    _wait_until(
+        lambda: replacement.address in m._healthy, timeout=15,
+        msg="migration + readmission",
+    )
+    assert old.address not in m.server_urls
+    assert replacement.address in m.server_urls
+    assert replacement.versions == [1]  # re-synced before rotation
+    with m._lock:
+        routed = {m._choose_server({}) for _ in range(4)}
+    assert routed == {replacement.address, keeper.address}
+    m.exit()
+
+
+def test_never_seen_member_adopted_after_eviction(chaos_env):
+    """A server that crashed before the manager ever saw it heartbeat and
+    came back at a new address: once the stale url is evicted (client
+    report), the unknown member's new address replaces it."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    silent = FakeGenServer(exp, trial, 0, beating=False)
+    keeper = FakeGenServer(exp, trial, 1)
+    env["cleanup"].extend([silent.close, keeper.close])
+    for s in (silent, keeper):
+        name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=2)
+
+    # The silent server dies without one beat on record; a client
+    # reports the failure (the real eviction path for never-beat urls).
+    silent.kill()
+    m._mark_unhealthy(silent.address, "client-reported request failure")
+
+    # Its "restarted" incarnation beats at a brand-new port.
+    replacement = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(replacement.close)
+    _wait_until(
+        lambda: replacement.address in m._healthy, timeout=15,
+        msg="adoption of never-seen member",
+    )
+    assert silent.address not in m.server_urls
+    assert replacement.address in m.server_urls
+    with m._lock:
+        routed = {m._choose_server({}) for _ in range(4)}
+    assert routed == {replacement.address, keeper.address}
+    m.exit()
+
+
+def test_whole_fleet_down_backs_off_then_succeeds(chaos_env):
+    """503 (no healthy servers) makes the client back off and retry, not
+    fail: once the server returns, the pending sample completes."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    s = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(s.close)
+    name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=1)
+
+    s.kill()
+    _wait_until(lambda: s.address in m._evicted, msg="eviction")
+
+    prm = PartialRolloutManager(
+        m.address, request_timeout=5.0, max_retries=30, retry_backoff_s=0.05
+    )
+
+    async def gen():
+        out = await prm._generate_one(
+            "q0", [1, 2], GenerationHyperparameters(max_new_tokens=2)
+        )
+        await prm.close()
+        return out
+
+    result = {}
+
+    def run():
+        result["out"] = asyncio.run(gen())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    time.sleep(0.5)  # let it hit the 503 path
+    s.revive()
+    t.join(timeout=20)
+    assert not t.is_alive()
+    assert result["out"].output_ids == [1, 1]
+    m.exit()
+
+
+def test_crashing_episode_releases_quota_slot(chaos_env):
+    """A rollout episode that dies (armed rollout.episode fault) must
+    release its quota slot — N crashes in a row cannot starve the
+    manager's rollout quota."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    s = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(s.close)
+    name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=1)
+
+    puller = ZMQJsonPuller(host="127.0.0.1")
+    env["cleanup"].append(puller.close)
+    w = _mk_rollout_worker(env, m.address, puller.port)
+    # Crash the first 3 episodes; the 4th succeeds.
+    faults.arm("rollout.episode", action="raise", at_hit=1, times=3)
+    asyncio.run(_drive_episodes(w, 4))
+    _wait_until(lambda: m.rollout_stat.running == 0, msg="quota release")
+    assert m.rollout_stat.accepted == 1
+    # Rejected episodes gave their staleness budget back too.
+    assert m.rollout_stat.submitted == 1
+    m.exit()
+
+
+def test_dead_rollout_worker_slots_reclaimed(chaos_env):
+    """A killed rollout worker can never /finish_rollout its episodes:
+    once its heartbeat goes stale, the manager reclaims the outstanding
+    slots so the capacity gate doesn't wedge shut."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    s = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(s.close)
+    name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=1)
+    m.cfg.max_concurrent_rollouts = 2
+
+    # The worker heartbeats once (registration) and then "crashes":
+    # no further beats, no graceful stop marker.
+    health.Heartbeat(exp, trial, "rollout_worker/0", ttl=HB_TTL)
+    _wait_until(
+        lambda: "rollout_worker/0" in m._rollout_seen,
+        msg="manager observed the rollout worker",
+    )
+
+    async def allocate():
+        async with __import__("aiohttp").ClientSession() as sess:
+            async with sess.post(
+                f"{m.address}/allocate_rollout",
+                json={"worker": "rollout_worker/0"},
+            ) as r:
+                return await r.json()
+
+    assert asyncio.run(allocate())["success"]
+    assert asyncio.run(allocate())["success"]
+    third = asyncio.run(allocate())
+    assert not third["success"] and third["reason"] == "capacity"
+
+    # Heartbeat stale -> slots reclaimed -> the gate reopens.
+    _wait_until(
+        lambda: m.rollout_stat.running == 0, timeout=15, msg="reclamation"
+    )
+    assert m.rollout_stat.submitted == 0
+    assert asyncio.run(allocate())["success"]
+    m.exit()
+
+
+def test_allocate_window_failure_releases_quota_slot(chaos_env):
+    """A failure AFTER quota allocation but BEFORE the episode task owns
+    the slot (e.g. the dataloader raising) must release the slot."""
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    s = FakeGenServer(exp, trial, 0)
+    env["cleanup"].append(s.close)
+    name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+    m = _start_manager(env, n_servers=1)
+
+    puller = ZMQJsonPuller(host="127.0.0.1")
+    env["cleanup"].append(puller.close)
+    w = _mk_rollout_worker(env, m.address, puller.port)
+
+    class _ExplodingLoader:
+        def next_batch(self):
+            raise RuntimeError("dataset exploded")
+
+    w.dataloader = _ExplodingLoader()
+
+    async def drive():
+        with pytest.raises(RuntimeError, match="dataset exploded"):
+            await w._poll_async()
+        if w._session is not None:
+            await w._session.close()
+        await w.prm.close()
+
+    asyncio.run(drive())
+    _wait_until(lambda: m.rollout_stat.running == 0, msg="quota release")
+    assert m.rollout_stat.submitted == 0
+    m.exit()

@@ -1,0 +1,134 @@
+"""Controller watchdog: restart a single failed worker role in place
+(observed via the health registry) without touching the others; escalate
+once the per-worker budget is spent."""
+
+import os
+import signal
+import time
+import uuid
+
+import pytest
+
+from areal_tpu.api.system_api import ExperimentConfig
+from areal_tpu.base import name_resolve
+from areal_tpu.base.health import HealthRegistry
+from areal_tpu.system.controller import LocalController
+from tests.system.chaos_workers import SleeperConfig
+
+pytestmark = pytest.mark.chaos
+
+SLEEPER = "tests.system.chaos_workers:SleeperWorker"
+
+
+def _wait_until(cond, timeout=20.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _controller(tmp_path, exp, trial, extra_env=None, max_restarts=1):
+    cfg = ExperimentConfig(experiment_name=exp, trial_name=trial, master=None)
+    env = {"JAX_PLATFORMS": "cpu", "AREAL_HEALTH_TTL": "0.3"}
+    env.update(extra_env or {})
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={
+            "backend": "nfs",
+            "record_root": str(tmp_path / "name_resolve"),
+        },
+        worker_env=env,
+        max_worker_restarts=max_restarts,
+        restartable_roles={SLEEPER},
+    )
+    name_resolve.reconfigure(**ctl.name_resolve_cfg)
+    return ctl
+
+
+def test_watchdog_restarts_single_killed_worker(tmp_path):
+    exp, trial = f"restart-{uuid.uuid4().hex[:6]}", "t0"
+    ctl = _controller(tmp_path, exp, trial, max_restarts=1)
+    escalations = []
+    ctl._escalate = lambda why: escalations.append(why)
+    try:
+        ctl._spawn(SLEEPER, SleeperConfig(exp, trial, 0))
+        ctl._spawn(SLEEPER, SleeperConfig(exp, trial, 1))
+        registry = HealthRegistry(exp, trial)
+        _wait_until(
+            lambda: {"sleeper/0", "sleeper/1"} <= set(registry.snapshot()),
+            msg="both workers heartbeating",
+        )
+        pid0 = ctl._workers["sleeper/0"].proc.pid
+        pid1 = ctl._workers["sleeper/1"].proc.pid
+
+        os.kill(pid0, signal.SIGKILL)
+
+        def supervise_and_restarted():
+            ctl.supervise_once(registry)
+            return ctl._workers["sleeper/0"].restarts == 1
+
+        _wait_until(supervise_and_restarted, msg="restart of sleeper/0")
+        rec0 = ctl._workers["sleeper/0"]
+        assert rec0.proc.pid != pid0 and rec0.proc.is_alive()
+        # The sibling fault domain was never touched.
+        rec1 = ctl._workers["sleeper/1"]
+        assert rec1.proc.pid == pid1 and rec1.proc.is_alive()
+        assert escalations == []
+        # The replacement re-registers in the health registry.
+        _wait_until(
+            lambda: "sleeper/0" in registry.snapshot(),
+            msg="restarted worker heartbeating",
+        )
+
+        # Budget spent: the next death escalates instead of restarting.
+        os.kill(rec0.proc.pid, signal.SIGKILL)
+
+        def supervise_and_escalated():
+            ctl.supervise_once(registry)
+            return bool(escalations)
+
+        _wait_until(supervise_and_escalated, msg="escalation")
+        assert "sleeper/0" in escalations[0]
+        # The sibling STILL was not torn down by supervision itself.
+        assert rec1.proc.is_alive()
+    finally:
+        ctl.join(timeout=10)
+
+
+def test_watchdog_restarts_hung_worker_via_heartbeat(tmp_path):
+    """A worker whose process is alive but whose poll loop wedged (armed
+    worker.poll hang) stops beating; the supervisor kills and restarts
+    it off the stale heartbeat."""
+    exp, trial = f"hang-{uuid.uuid4().hex[:6]}", "t0"
+    ctl = _controller(
+        tmp_path, exp, trial,
+        # Hang sleeper/0's poll loop on its 5th iteration.
+        extra_env={"AREAL_FAULTS": "worker.poll@sleeper/0=hang:k=5"},
+        max_restarts=1,
+    )
+    escalations = []
+    ctl._escalate = lambda why: escalations.append(why)
+    try:
+        ctl._spawn(SLEEPER, SleeperConfig(exp, trial, 0))
+        registry = HealthRegistry(exp, trial)
+        _wait_until(
+            lambda: "sleeper/0" in registry.snapshot(),
+            msg="worker heartbeating",
+        )
+
+        def supervise_and_restarted():
+            ctl.supervise_once(registry)
+            return ctl._workers["sleeper/0"].restarts == 1
+
+        _wait_until(supervise_and_restarted, msg="hang-triggered restart")
+        assert escalations == []
+        # The replacement (same AREAL_FAULTS, fresh hit counter) beats
+        # again before its own injected hang.
+        _wait_until(
+            lambda: "sleeper/0" in registry.snapshot(),
+            msg="restarted worker heartbeating",
+        )
+    finally:
+        ctl.join(timeout=10)

@@ -73,6 +73,10 @@ def run_experiment(experiment_type: str, cfg, worker_env: Optional[dict] = None)
             ctl = LocalController(
                 exp_cfg, name_resolve_cfg=name_resolve_cfg,
                 worker_env=worker_env,
+                # Inner fault domain: individual serving-plane workers
+                # restart in place; only escalations reach the relaunch
+                # loop below.
+                max_worker_restarts=getattr(cfg, "worker_restarts", 2),
             )
             try:
                 result = ctl.run()
