@@ -43,6 +43,24 @@ class ModelTrainEvalConfig:
     mesh_spec: Optional[str] = None  # worker-local mesh, e.g. "d1f4t2"
     row_len_multiple: int = 128
     max_row_len: Optional[int] = None
+    prefetch_depth: int = dataclasses.field(
+        default=2,
+        metadata={
+            "help": "overlapped input pipeline depth: a background "
+            "thread packs + device_puts micro-batch i+1 while step i "
+            "runs on device, bounded to this many staged micro-batches; "
+            "0 = fully eager (engine/prefetch.py)"
+        },
+    )
+    stats_fetch_interval: int = dataclasses.field(
+        default=1,
+        metadata={
+            "help": "fetch the packed train stats from device every Nth "
+            "train_batch only (each fetch is a host round trip, ~75 ms "
+            "on tunneled devices); skipped calls return the last values "
+            "tagged <loss>/stats_stale=1"
+        },
+    )
 
 
 @dataclasses.dataclass
@@ -272,6 +290,16 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     gen_speculative_ngram: int = dataclasses.field(
         default=2,
         metadata={"help": "n-gram length for the draft lookup match"},
+    )
+    gen_speculative_window: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "backward search window (tokens) for the n-gram "
+            "draft lookup: only the most recent W candidate positions "
+            "are matched, so draft cost stops scaling with max_seq_len "
+            "at 16-32k contexts. None = engine default (1024); 0 = "
+            "unbounded full-history scan"
+        },
     )
     gen_decode_weight_dtype: Optional[str] = dataclasses.field(
         default=None,

@@ -274,14 +274,31 @@ class SequenceSample:
         the concatenated micro-batch order; `backward_indices` inverts it,
         for `reorder_output`.
         """
+        mb_iter, _, forward_indices, backward_indices = self.split_lazy(spec)
+        return list(mb_iter), forward_indices, backward_indices
+
+    def split_lazy(
+        self, spec: MicroBatchSpec
+    ) -> Tuple["Iterator[SequenceSample]", List[List[int]], List[int], List[int]]:
+        """`split()` with lazily materialized micro-batches, for feeding a
+        prefetch pipeline: the FFD plan (cheap — lengths only) is computed
+        up front, but each micro-batch's packed-array copies happen only
+        when the iterator yields it, so at most `prefetch depth` copies
+        exist at once instead of all of them.
+
+        Returns (mb_iterator, groups, forward_indices, backward_indices);
+        `groups[j]` holds micro-batch j's sample indices, so callers can
+        do per-mb pad-waste accounting (`datapack.packing_density` over
+        the group's lengths) before the data is ever touched.
+        """
         lens = self.seqlens_of()
         cap = spec.max_tokens_per_mb or int(np.sum(lens)) + 1
         groups = datapack.ffd_allocate(lens, capacity=cap, min_groups=spec.n_mbs)
         groups = [sorted(g) for g in groups]
         forward_indices = datapack.flat2d(groups)
         backward_indices = np.argsort(forward_indices).tolist()
-        mbs = self.split_with_partitions(groups)
-        return mbs, forward_indices, backward_indices
+        mb_iter = (self._select_indices(g) for g in groups)
+        return mb_iter, groups, forward_indices, backward_indices
 
     @staticmethod
     def reorder_output(

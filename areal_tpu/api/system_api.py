@@ -154,6 +154,10 @@ class GenerationServerConfig:
     # device-resident (engine/spec_decode.py). 0 disables.
     speculative_draft_len: int = 0
     speculative_ngram: int = 2
+    # Backward search window (tokens) for the draft lookup; bounds the
+    # per-step match cost at long contexts. None = engine default (1024);
+    # 0 = unbounded full-history scan.
+    speculative_window: Optional[int] = None
     # int8 DECODE weights (W8A16, ops/wquant.py): halves the per-step
     # weight stream; prefill stays bf16. None/"model" disables.
     decode_weight_dtype: Optional[str] = None

@@ -68,6 +68,54 @@ def ffd_allocate_py(
     return out
 
 
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def pack_shape(
+    lengths: Sequence[int],
+    row_len_multiple: int = 128,
+    n_rows_multiple: int = 1,
+    max_row_len: int = None,
+) -> tuple:
+    """(n_rows, row_len) that `models.packing.pack_sequences` will
+    allocate for these sequence lengths — the padded [R, T] footprint,
+    computable without materializing the pack (mirrors its row_len
+    bucketing + FFD row grouping). One divergence: where pack_sequences
+    RAISES (a sequence longer than max_row_len), the estimator widens
+    the rows to fit it — it is used on inputs the caller may not control
+    (telemetry fallback), and must always return a footprint the data
+    actually fits, never a >1.0 density."""
+    lengths = [int(l) for l in lengths]
+    if not lengths:
+        raise ValueError("cannot compute pack shape of zero sequences")
+    longest = max(lengths)
+    row_len = _round_up(max(longest, row_len_multiple), row_len_multiple)
+    if max_row_len is not None:
+        row_len = min(row_len, _round_up(max_row_len, row_len_multiple))
+        row_len = max(row_len, _round_up(longest, row_len_multiple))
+    groups = ffd_allocate(lengths, capacity=row_len, min_groups=1)
+    n_rows = _round_up(len(groups), n_rows_multiple)
+    return n_rows, row_len
+
+
+def packing_density(
+    lengths: Sequence[int],
+    row_len_multiple: int = 128,
+    n_rows_multiple: int = 1,
+    max_row_len: int = None,
+) -> float:
+    """Tokens per padded token of the FFD pack of `lengths`: real tokens
+    divided by the [R, T] cells shipped to the device. 1.0 = no pad
+    waste; every (1 - density) fraction of the step's FLOPs is spent on
+    padding. This is the `packing_efficiency` series surfaced in the
+    master's perf history and bench.py output."""
+    n_rows, row_len = pack_shape(
+        lengths, row_len_multiple, n_rows_multiple, max_row_len
+    )
+    return float(sum(int(l) for l in lengths)) / float(n_rows * row_len)
+
+
 def min_abs_diff_partition(nums: Sequence[int], k: int) -> List[List[int]]:
     """Split `nums` into k *contiguous* groups with balanced sums.
 

@@ -47,6 +47,7 @@ class DistributedStatsTracker:
         self._stats: Dict[str, List[tuple]] = {}
         self._reduce_types: Dict[str, ReduceType] = {}
         self._scalars: Dict[str, List[float]] = {}
+        self._scalar_types: Dict[str, ReduceType] = {}
 
     def _key(self, name: str) -> str:
         return "/".join(self._scopes + [name])
@@ -86,10 +87,16 @@ class DistributedStatsTracker:
             self._stats.setdefault(key, []).append((value, mask))
             self._reduce_types[key] = reduce_type
 
-    def scalar(self, **kwargs):
+    def scalar(self, reduce_type: ReduceType = ReduceType.AVG, **kwargs):
+        """Record scalar stats. `reduce_type` declares the CROSS-WORKER
+        merge semantics shipped to the master (within-process records are
+        always mean-reduced at export): AVG for rates/means, MAX for
+        worst-case latencies (e.g. `perf/h2d_wait_ms` — the step blocks
+        on the slowest DP worker, so averaging would understate it)."""
         for name, value in kwargs.items():
             key = self._key(name)
             self._scalars.setdefault(key, []).append(float(value))
+            self._scalar_types[key] = reduce_type
 
     def moe_aux_losses(self):
         """Fold MoE aux losses recorded during forward into scalar stats."""
@@ -147,7 +154,9 @@ class DistributedStatsTracker:
             if not self._match(key, k):
                 continue
             out[k] = float(np.mean(vals))
-            types.setdefault(k, "avg")
+            types.setdefault(
+                k, self._scalar_types.get(k, ReduceType.AVG).value
+            )
         if reset:
             for k in [k for k in self._denominators if self._match(key, k)]:
                 del self._denominators[k]
@@ -156,6 +165,7 @@ class DistributedStatsTracker:
                 self._reduce_types.pop(k, None)
             for k in [k for k in self._scalars if self._match(key, k)]:
                 del self._scalars[k]
+                self._scalar_types.pop(k, None)
         if return_types:
             return out, types
         return out

@@ -106,6 +106,10 @@ class JaxTrainBackend(ModelBackend):
     remat: bool = True
     row_len_multiple: int = 128
     max_row_len: Optional[int] = None
+    # Overlapped input pipeline depth (0 = eager) and packed-stats fetch
+    # cadence — see JaxTrainEngine.
+    prefetch_depth: int = 2
+    stats_fetch_interval: int = 1
 
     def __post_init__(self):
         if isinstance(self.optimizer, dict):
@@ -124,6 +128,8 @@ class JaxTrainBackend(ModelBackend):
             row_len_multiple=self.row_len_multiple,
             max_row_len=self.max_row_len,
             hf_family=raw.get("hf_family"),
+            prefetch_depth=self.prefetch_depth,
+            stats_fetch_interval=self.stats_fetch_interval,
         )
         model.ft_spec = spec
         return model
@@ -156,6 +162,8 @@ class JaxInferenceBackend(JaxTrainBackend):
             row_len_multiple=self.row_len_multiple,
             max_row_len=self.max_row_len,
             hf_family=raw.get("hf_family"),
+            prefetch_depth=self.prefetch_depth,
+            stats_fetch_interval=self.stats_fetch_interval,
         )
         model.ft_spec = spec
         return model

@@ -256,7 +256,7 @@ def paged_decode_attention(
         qs = q * jnp.asarray(scale, q.dtype)
         interp = jax.default_backend() not in ("tpu", "axon")
         if tensor_size > 1:
-            from jax import shard_map
+            from areal_tpu.utils.jax_compat import shard_map
             from jax.sharding import PartitionSpec as Pt
 
             pool_spec = (Pt("tensor", None, None, None),
@@ -301,7 +301,7 @@ def paged_decode_attention(
     tensor = mesh.shape.get("tensor", 1) if mesh is not None else 1
     if tensor > 1:
         from jax.sharding import PartitionSpec as Pt
-        from jax import shard_map
+        from areal_tpu.utils.jax_compat import shard_map
 
         pool_spec = Pt("tensor", None, None, None)
         if quantized:  # spec subtree mirrors (data 4-D, scales 3-D)

@@ -177,6 +177,7 @@ class ServingEngine:
         kv_cache_dtype: Optional[str] = None,
         speculative_draft_len: int = 0,
         speculative_ngram: int = 2,
+        speculative_window: Optional[int] = None,
         decode_weight_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
@@ -268,6 +269,19 @@ class ServingEngine:
         )
         self.spec_draft_len = speculative_draft_len
         self.spec_ngram = speculative_ngram
+        # Backward search window for the draft lookup (ADVICE r5 #4): the
+        # n-gram match otherwise scans all max_seq_len positions per step,
+        # so draft cost scales with the CONFIGURED context, not the live
+        # one. Default 1k recent tokens — where math-RL repeats live.
+        # None = default/env; 0 = unbounded full-history scan.
+        if speculative_window is None:
+            env_w = os.environ.get("AREAL_SPEC_WINDOW")
+            speculative_window = int(env_w) if env_w else 1024
+        assert speculative_window >= 0, (
+            f"speculative_window must be >= 0 (0 = unbounded), got "
+            f"{speculative_window}"
+        )
+        self.spec_window = speculative_window
         # Acceptance telemetry: tokens emitted / (block steps * active
         # slots) — the realized speculation yield.
         self._spec_emitted = 0
@@ -1183,6 +1197,7 @@ class ServingEngine:
                     n_steps=self.block_steps,
                     draft_len=self.spec_draft_len,
                     ngram=self.spec_ngram,
+                    ngram_window=self.spec_window,
                     attn_impl=self.attn_impl, mesh=self.mesh,
                 )
             else:

@@ -49,6 +49,7 @@ def propose_ngram_drafts(
     lengths: jnp.ndarray,  # [B] int32: position of the PENDING token
     ngram: int,
     draft_len: int,
+    window: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Match the n-gram ending at the pending token against earlier
     history; return (draft [B, draft_len] int32, eff [B] int32 — number
@@ -57,22 +58,46 @@ def propose_ngram_drafts(
     history[b, 0..lengths[b]] are known tokens (prompt + emitted, the
     last one pending, its KV not yet written). The draft is the
     continuation after the MOST RECENT earlier occurrence of the
-    window; continuation tokens must themselves be known history."""
+    window; continuation tokens must themselves be known history.
+
+    `window > 0` bounds the backward search to each slot's last `window`
+    candidate match positions instead of the full max_seq_len: the
+    [B, S, g] sliding-window compare is the one spec-decode term that
+    scales with the CONFIGURED S rather than the live lengths, so at
+    16-32k contexts an unbounded scan dominates draft cost. A bounded
+    window only ever drops matches older than `window` tokens — the
+    most-recent-match-within-window semantics are otherwise identical
+    (verification is unchanged, so the output is still lossless)."""
     B, S1 = history.shape
     S = S1 - 1
     g, d = ngram, draft_len
-    # Sliding windows [B, S, g] (clip keeps the tail in-bounds; those
-    # positions are excluded by the validity mask below).
-    win_idx = jnp.minimum(
-        jnp.arange(S)[:, None] + jnp.arange(g)[None, :], S - 1
-    )
-    windows = history[:, win_idx]  # [B, S, g]
     last_idx = jnp.clip(
         lengths[:, None] - (g - 1) + jnp.arange(g)[None, :], 0, S - 1
     )
     lastgram = jnp.take_along_axis(history, last_idx, axis=1)  # [B, g]
-    eq = jnp.all(windows == lastgram[:, None, :], axis=2)  # [B, S]
-    s_pos = jnp.arange(S)[None, :]
+    if window and window < S:
+        # Candidate match starts: the last W positions whose n-gram can
+        # end strictly before the pending token (latest legal start is
+        # lengths - g). Per-slot absolute positions, gathered instead of
+        # scanned, so the compare is [B, W, g] independent of S.
+        W = int(window)
+        base = jnp.maximum(lengths[:, None] - g - W + 1, 0)  # [B, 1]
+        s_pos = base + jnp.arange(W)[None, :]  # [B, W] absolute starts
+        win_idx = jnp.minimum(
+            s_pos[:, :, None] + jnp.arange(g)[None, None, :], S - 1
+        )  # [B, W, g]
+        windows = jnp.take_along_axis(
+            history, win_idx.reshape(B, W * g), axis=1
+        ).reshape(B, W, g)
+    else:
+        # Unbounded: sliding windows [B, S, g] (clip keeps the tail
+        # in-bounds; those positions are excluded by the validity mask).
+        win_idx = jnp.minimum(
+            jnp.arange(S)[:, None] + jnp.arange(g)[None, :], S - 1
+        )
+        windows = history[:, win_idx]  # [B, S, g]
+        s_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    eq = jnp.all(windows == lastgram[:, None, :], axis=2)  # [B, S or W]
     # The earlier occurrence must end strictly before the pending
     # position, and there must be at least g tokens of history.
     valid = eq & (s_pos + g - 1 < lengths[:, None]) & (lengths[:, None] + 1 >= g)
@@ -200,8 +225,8 @@ def set_history(history, slots, valid, rows):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "n_steps", "draft_len", "ngram", "attn_impl",
-                     "mesh"),
+    static_argnames=("cfg", "n_steps", "draft_len", "ngram", "ngram_window",
+                     "attn_impl", "mesh"),
     donate_argnames=(
         "k_pages", "v_pages", "lengths", "next_input", "active",
         "remaining", "min_remaining", "rng", "history",
@@ -228,6 +253,7 @@ def paged_spec_decode_block(
     n_steps: int,
     draft_len: int,
     ngram: int = 2,
+    ngram_window: int = 0,
     attn_impl: str = "auto",
     mesh=None,
 ):
@@ -255,7 +281,7 @@ def paged_spec_decode_block(
         # per-position forbid interaction isn't worth the complexity)
         # and for inactive slots.
         draft, eff = propose_ngram_drafts(history, lengths, ngram,
-                                          draft_len)
+                                          draft_len, window=ngram_window)
         eff = jnp.where(active & (min_remaining <= 0), eff, 0)
         # Also never propose past the remaining budget: tokens beyond it
         # would be dropped anyway; skipping them keeps n_emit <= budget.

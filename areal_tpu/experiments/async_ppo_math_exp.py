@@ -158,6 +158,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             kv_cache_dtype=cfg.gen_kv_cache_dtype,
             speculative_draft_len=cfg.gen_speculative_draft_len,
             speculative_ngram=cfg.gen_speculative_ngram,
+            speculative_window=cfg.gen_speculative_window,
             decode_weight_dtype=cfg.gen_decode_weight_dtype,
             tensor_parallel=cfg.gen_tensor_parallel,
             seed=cfg.seed,

@@ -52,6 +52,8 @@ def backend_abstraction(m: ModelTrainEvalConfig, train: bool = True) -> ModelBac
         attn_impl=m.attn_impl,
         row_len_multiple=m.row_len_multiple,
         max_row_len=m.max_row_len,
+        prefetch_depth=m.prefetch_depth,
+        stats_fetch_interval=m.stats_fetch_interval,
     )
     if train:
         args["optimizer"] = dataclasses.asdict(m.optimizer)

@@ -51,6 +51,13 @@ class PackedBatch:
     def total_tokens(self) -> int:
         return int(sum(self.seq_lens))
 
+    @property
+    def density(self) -> float:
+        """Tokens per padded token: real tokens / the [R, T] cells this
+        pack ships to the device (the realized packing efficiency; the
+        estimator counterpart is `base.datapack.packing_density`)."""
+        return self.total_tokens / float(self.n_rows * self.row_len)
+
     def scatter_per_token(self, values: Sequence[np.ndarray]) -> np.ndarray:
         """Place per-sequence 1D arrays (flat-list order) into [R, T] rows."""
         first = np.asarray(values[0])

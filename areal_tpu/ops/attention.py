@@ -252,7 +252,7 @@ def sharded_splash_attention(
     Callers must check `sharded_splash_ok` first.
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from areal_tpu.utils.jax_compat import shard_map
 
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu", "axon")

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from areal_tpu.utils.jax_compat import tpu_compiler_params
+
 NEG_INF = -1e30
 LANES = 128
 
@@ -146,7 +148,7 @@ def _fwd(scale, interpret, group, q, k, v, seg, pos):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -278,7 +280,7 @@ def _bwd(scale, interpret, group, q, k, v, seg, pos, out, lse, dout):
         out_specs=pl.BlockSpec((1, bq, dp), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((hq, t, dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -317,7 +319,7 @@ def _bwd(scale, interpret, group, q, k, v, seg, pos, out, lse, dout):
             pltpu.VMEM((bk, dp), jnp.float32),
             pltpu.VMEM((bk, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

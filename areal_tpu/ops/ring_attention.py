@@ -70,7 +70,7 @@ def ring_packed_attention(
 ) -> jnp.ndarray:
     """Packed GQA attention with the KV stream ring-rotated over the
     mesh's `seq` axis. Callers must check `ring_ok` first."""
-    from jax import shard_map
+    from areal_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     hd = q.shape[-1]

@@ -62,7 +62,7 @@ def ulysses_packed_attention(
     scores over the FULL gathered sequence, defeating CP exactly at the
     context lengths it exists for), 'reference', or 'auto' (splash on
     TPU when shapes allow)."""
-    from jax import shard_map
+    from areal_tpu.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     rows = ("data", "fsdp")

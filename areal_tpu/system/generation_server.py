@@ -77,6 +77,7 @@ class GenerationServer(Worker):
             kv_cache_dtype=config.kv_cache_dtype,
             speculative_draft_len=config.speculative_draft_len,
             speculative_ngram=config.speculative_ngram,
+            speculative_window=config.speculative_window,
             decode_weight_dtype=config.decode_weight_dtype,
             mesh=mesh,
         )

@@ -89,7 +89,16 @@ class MasterWorker(Worker):
             # Per-step [e2e_s, train_tokens] so benchmark consumers can
             # drop compile-dominated warmup steps from the rate.
             "history": [],
+            # Input-pipeline health (running means over steps that
+            # reported): how dense the packed batches are and how much
+            # of the step the host blocked on pack/H2D vs dispatch gaps
+            # (jax_engine overlap telemetry; definitions in
+            # docs/perf_notes.md "overlap pipeline").
+            "overlap": {},
         }
+        # metric -> [sum, count] (running, NOT a per-step list: an
+        # open-ended RL run must not grow it for the process lifetime).
+        self._overlap_acc: Dict[str, List[float]] = {}
         self._init_metric_trackers()
 
         # Wait for every model worker to finish its lazy setup.
@@ -262,6 +271,18 @@ class MasterWorker(Worker):
                     total_flops += v
                 elif k == "perf/gen_tokens_per_sec":
                     scalars[f"gen_tokens_per_sec/{name}"] = v
+                elif k in (
+                    "perf/packing_efficiency",
+                    "perf/h2d_wait_ms",
+                    "perf/dispatch_gap_ms",
+                ):
+                    # Input-pipeline telemetry: per-MFC series + running
+                    # mean in perf_summary["overlap"].
+                    metric = k[len("perf/"):]
+                    scalars[f"{metric}/{name}"] = v
+                    acc = self._overlap_acc.setdefault(metric, [0.0, 0])
+                    acc[0] += v
+                    acc[1] += 1
                 elif not k.startswith("perf/"):
                     scalars[k] = v
         if total_flops:
@@ -281,9 +302,15 @@ class MasterWorker(Worker):
         # would grow it for the process lifetime.
         if self._total_steps_cap is not None:
             self.perf_summary["history"].append([e2e, step_tokens])
+        self.perf_summary["overlap"] = {
+            m: float(s / n) for m, (s, n) in self._overlap_acc.items() if n
+        }
         perf_keys = [
             k for k in sorted(scalars)
-            if k.startswith(("timeperf/", "tflops/", "gen_tokens_per_sec/"))
+            if k.startswith((
+                "timeperf/", "tflops/", "gen_tokens_per_sec/",
+                "packing_efficiency/", "h2d_wait_ms/", "dispatch_gap_ms/",
+            ))
         ]
         logger.info(
             "benchmark: "

@@ -256,6 +256,28 @@ class ModelWorker(Worker):
             in_lens = [
                 l for sl in input_.seqlens[input_._main_key()] for l in sl
             ]
+            # Packing-density accounting for train/inference MFCs: the
+            # engine records the REALIZED density of what it shipped to
+            # HBM (tracked export above); when it did not run a packed
+            # path (mock engines, custom interfaces) fall back to the
+            # analytic FFD estimate over this MFC's input lengths.
+            # Generate MFCs are deliberately excluded — the serving
+            # engine admits prompts into a paged pool, so a row-pack
+            # density over its inputs would be a made-up number.
+            row_mult = getattr(model.module, "row_len_multiple", None)
+            if (
+                itype in ("train_step", "inference")
+                and in_lens
+                and row_mult
+                and "perf/packing_efficiency" not in stats
+            ):
+                from areal_tpu.base import datapack
+
+                stats["perf/packing_efficiency"] = datapack.packing_density(
+                    in_lens,
+                    row_len_multiple=row_mult,
+                    max_row_len=getattr(model.module, "max_row_len", None),
+                )
             out_lens = None
             if out is not None and itype == "generate":
                 try:

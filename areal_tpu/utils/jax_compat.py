@@ -1,0 +1,55 @@
+"""Tolerance shims for jax APIs that moved or were renamed across
+releases.
+
+The codebase targets current jax; driver/CI containers sometimes pin an
+older release (observed: 0.4.37) where:
+
+- `jax.shard_map` still lives at `jax.experimental.shard_map.shard_map`
+  and takes `check_rep` instead of `check_vma`;
+- `jax.experimental.pallas.tpu.CompilerParams` is still named
+  `TPUCompilerParams` (same fields);
+- `jax.sharding.set_mesh` does not exist; entering the `Mesh` as a
+  context manager sets the ambient mesh, which is what the generate
+  path's sharding constraints need.
+
+Each shim prefers the current API and only falls back when it is
+absent, so on an up-to-date jax these are pass-throughs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool = True, **kwargs):
+    """`jax.shard_map` with fallback to the pre-promotion
+    `jax.experimental.shard_map.shard_map` (where `check_vma` was called
+    `check_rep`)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma, **kwargs)
+
+
+def set_mesh(mesh):
+    """Context manager: `jax.sharding.set_mesh` where available, else the
+    Mesh's own context-manager entry (which installs it as the ambient
+    mesh for sharding constraints on pre-set_mesh releases)."""
+    sm = getattr(jax.sharding, "set_mesh", None)
+    if sm is not None:
+        return sm(mesh)
+    return mesh
+
+
+def tpu_compiler_params(**kwargs):
+    """`pltpu.CompilerParams` across its `TPUCompilerParams` rename
+    (identical fields)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

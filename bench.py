@@ -62,11 +62,92 @@ def enable_compilation_cache():
         log(f"bench: compilation cache unavailable ({e!r})")
 
 
+def init_devices(max_tries: int = None, backoff_s: float = None):
+    """`jax.devices()` with bounded retry + exponential backoff: a TPU
+    tunnel flap at backend init previously killed the whole bench
+    instantly (VERDICT r5: bench must bank numbers inside flap windows).
+    Each retry clears cached backends so the next attempt re-dials the
+    device rather than replaying the cached failure. Raises the last
+    error once the retry budget is spent."""
+    import jax
+
+    if max_tries is None:
+        max_tries = int(os.environ.get("AREAL_BENCH_INIT_RETRIES", 5))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("AREAL_BENCH_INIT_BACKOFF_S", 15.0))
+    delay = backoff_s
+    last = None
+    for attempt in range(max(1, max_tries)):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init failed (tunnel down?)
+            last = e
+            log(f"bench: backend init failed (attempt {attempt + 1}/"
+                f"{max_tries}): {e!r}")
+            if attempt + 1 >= max_tries:
+                break
+            try:
+                jax.clear_backends()
+            except Exception:
+                pass  # older jax / partial init: retry cold
+            time.sleep(delay)
+            delay = min(delay * 2, 120.0)
+    raise last
+
+
 def state_path() -> str:
     return os.environ.get(
         "AREAL_BENCH_STATE",
         os.path.join(tempfile.gettempdir(), "areal_bench_state.json"),
     )
+
+
+def bench_json_path() -> str:
+    return os.environ.get(
+        "AREAL_BENCH_JSON",
+        os.path.join(tempfile.gettempdir(), "areal_bench_result.json"),
+    )
+
+
+def result_json(state: dict, partial: bool = False, error: str = None) -> dict:
+    """The bench's JSON result assembled from whatever phases completed.
+    Written to bench_json_path() after EVERY phase (a mid-run tunnel drop
+    still banks completed phases on disk) and printed at the end."""
+    train = state.get("train_tflops")
+    out = {
+        "metric": "train_tflops_per_chip",
+        "value": round(train, 2) if train is not None else 0.0,
+        "unit": "TFLOP/s",
+        "vs_baseline": (
+            round(train / BASELINE_TFLOPS, 3) if train is not None else 0.0
+        ),
+    }
+    ov = state.get("train_overlap") or {}
+    for k in ("packing_efficiency", "h2d_wait_ms", "dispatch_gap_ms"):
+        if k in ov:
+            out[f"train_{k}"] = round(float(ov[k]), 4)
+    if state.get("gen_tps") is not None:
+        out["gen_tokens_per_sec_per_chip"] = round(float(state["gen_tps"]), 1)
+    if state.get("gen_long_tps") is not None:
+        out["gen_long_tokens_per_sec_per_chip"] = round(
+            float(state["gen_long_tps"]), 1
+        )
+    if partial:
+        out["partial"] = True
+    if error:
+        out["error"] = error
+    return out
+
+
+def flush_result(state: dict, partial: bool = True):
+    path = bench_json_path()
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(result_json(state, partial=partial), f)
+        os.replace(tmp, path)
+    except OSError as e:
+        log(f"bench: result flush failed ({e!r})")
 
 
 def load_state(platform: str, max_age_s: float = None) -> dict:
@@ -244,9 +325,10 @@ def train_bench() -> tuple:
     from areal_tpu.models.transformer import count_params, init_params
     from areal_tpu.ops.loss import sft_loss_from_logprobs
 
-    platform = jax.devices()[0].platform
+    devices = init_devices()
+    platform = devices[0].platform
     on_tpu = platform == "tpu"
-    log(f"bench: platform={platform} n_devices={len(jax.devices())}")
+    log(f"bench: platform={platform} n_devices={len(devices)}")
 
     if on_tpu:
         # flagship_cfg: params in bf16 with fp32 optimizer moments
@@ -304,6 +386,12 @@ def train_bench() -> tuple:
         one_step(i)
         log(f"bench: warmup step {i} {time.perf_counter() - t:.2f}s")
 
+    # Drain warmup-recorded pipeline stats so the exported overlap
+    # telemetry below covers ONLY the timed steps.
+    from areal_tpu.base import stats_tracker
+
+    stats_tracker.export(key="perf")
+
     t0 = time.perf_counter()
     for i in range(n_steps):
         one_step(n_warmup + i)
@@ -314,13 +402,23 @@ def train_bench() -> tuple:
     tflops = flops / dt / 1e12
     tokens_per_sec = total / dt
     log(f"bench: {dt:.3f}s/step {tokens_per_sec:.0f} tok/s {tflops:.1f} TFLOP/s")
+    # Input-pipeline health of the timed loop (jax_engine overlap
+    # telemetry): packing density of what shipped to HBM + how much of
+    # each step the host was blocked packing/transferring.
+    perf = stats_tracker.export(key="perf")
+    overlap = {
+        k[len("perf/"):]: v for k, v in perf.items()
+        if k in ("perf/packing_efficiency", "perf/h2d_wait_ms",
+                 "perf/dispatch_gap_ms")
+    }
+    log(f"bench: overlap telemetry {overlap}")
 
-    return tflops, on_tpu
+    return tflops, on_tpu, overlap
 
 
-# Partial results the deadline handler can still report: a gen-phase
+# Phases completed so far, mirrored for the deadline handler: a gen-phase
 # hang must not discard an already-measured train number.
-_PARTIAL = {"train_tflops": None, "gen_tps": None}
+_PARTIAL = {}
 
 
 def _arm_deadline(seconds: float):
@@ -332,17 +430,12 @@ def _arm_deadline(seconds: float):
 
     def fire():
         log(f"bench: deadline {seconds:.0f}s exceeded; device/tunnel stuck")
-        train = _PARTIAL["train_tflops"]
-        out = {
-            "metric": "train_tflops_per_chip",
-            "value": round(train, 2) if train is not None else 0.0,
-            "unit": "TFLOP/s",
-            "vs_baseline": round(train / BASELINE_TFLOPS, 3) if train is not None else 0.0,
-            "error": f"bench deadline {seconds:.0f}s exceeded in the "
-                     f"{'generation' if train is not None else 'train'} phase",
-        }
-        if _PARTIAL["gen_tps"] is not None:
-            out["gen_tokens_per_sec_per_chip"] = round(_PARTIAL["gen_tps"], 1)
+        phase = "train" if _PARTIAL.get("train_tflops") is None else "generation"
+        out = result_json(
+            _PARTIAL, partial=True,
+            error=f"bench deadline {seconds:.0f}s exceeded in the "
+                  f"{phase} phase",
+        )
         print(json.dumps(out), flush=True)
         os._exit(3)
 
@@ -357,20 +450,23 @@ def main():
     enable_compilation_cache()
     import gc
 
-    import jax
-
-    platform = jax.devices()[0].platform
+    devices = init_devices()
+    platform = devices[0].platform
     on_tpu = platform == "tpu"
     state = load_state(platform)
+    _PARTIAL.update(state)
 
     if state.get("train_tflops") is not None:
         tflops = float(state["train_tflops"])
         log(f"bench: resuming train phase from checkpoint "
             f"({tflops:.1f} TFLOP/s)")
     else:
-        tflops, on_tpu = train_bench()
+        tflops, on_tpu, overlap = train_bench()
         state = save_phase(state, platform, "train_tflops", tflops)
-    _PARTIAL["train_tflops"] = tflops
+        state = save_phase(state, platform, "train_overlap", overlap)
+        _PARTIAL.update(state)
+        flush_result(state)  # bank the phase NOW; a tunnel drop later
+        # in the run must not lose an already-measured number.
 
     gc.collect()  # drop the train frame's device buffers before gen
     if state.get("gen_tps") is not None:
@@ -379,7 +475,8 @@ def main():
     else:
         gen_tps = gen_bench(on_tpu)
         state = save_phase(state, platform, "gen_tps", gen_tps)
-    _PARTIAL["gen_tps"] = gen_tps
+        _PARTIAL.update(state)
+        flush_result(state)
     gc.collect()
     # Re-arm for the long-form phase: it compiles its own chunked
     # program and decodes 8x8192 tokens — a healthy run must not be
@@ -389,24 +486,18 @@ def main():
         float(os.environ.get("AREAL_BENCH_LONG_DEADLINE_S", 1200))
     )
     if state.get("gen_long_tps") is not None:
-        gen_long_tps = float(state["gen_long_tps"])
         log(f"bench: resuming gen-long phase from checkpoint "
-            f"({gen_long_tps:.0f} tok/s)")
+            f"({float(state['gen_long_tps']):.0f} tok/s)")
     else:
         gen_long_tps = gen_bench(on_tpu, long_form=True)
         state = save_phase(state, platform, "gen_long_tps", gen_long_tps)
+        _PARTIAL.update(state)
 
     deadline.cancel()
+    flush_result(state, partial=False)
     # Completed: the next invocation is a fresh round, not a resume.
     clear_state()
-    print(json.dumps({
-        "metric": "train_tflops_per_chip",
-        "value": round(tflops, 2),
-        "unit": "TFLOP/s",
-        "vs_baseline": round(tflops / BASELINE_TFLOPS, 3),
-        "gen_tokens_per_sec_per_chip": round(gen_tps, 1),
-        "gen_long_tokens_per_sec_per_chip": round(gen_long_tps, 1),
-    }))
+    print(json.dumps(result_json(state)))
 
 
 if __name__ == "__main__":

@@ -28,6 +28,26 @@ if not os.environ.get("AREAL_ONCHIP_TESTS"):
     # can run on hardware; everything else pins the virtual CPU mesh.
     jax.config.update("jax_platforms", "cpu")
 
+if not os.environ.get("AREAL_TEST_NO_XLA_CACHE"):
+    # Persistent XLA compilation cache for the suite (same discipline as
+    # bench.py): the tier-1 run is compile-dominated on a loaded CPU
+    # machine, and repeated runs re-trace identical tiny programs.
+    # Correctness-neutral — the cache is keyed by computation hash.
+    # AREAL_TEST_NO_XLA_CACHE=1 opts out (e.g. compile-time measurements).
+    import tempfile
+
+    _cache_dir = os.environ.get(
+        "AREAL_XLA_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "areal_xla_cache"),
+    )
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older jax: flags absent; tests still run, just colder
+
 import uuid
 
 import pytest

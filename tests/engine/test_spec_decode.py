@@ -75,6 +75,72 @@ def test_propose_continuation_capped_at_known():
     assert int(draft[0, 0]) == 4
 
 
+def test_propose_windowed_matches_full_scan_for_recent_match():
+    """A match inside the backward window proposes the same draft as the
+    unbounded scan (the window only bounds how far back we look)."""
+    toks = [1, 2, 3, 9, 1, 2, 3]
+    full = propose_ngram_drafts(
+        _hist(toks, 64), jnp.asarray([6], jnp.int32), ngram=2, draft_len=4
+    )
+    win = propose_ngram_drafts(
+        _hist(toks, 64), jnp.asarray([6], jnp.int32), ngram=2, draft_len=4,
+        window=8,
+    )
+    assert int(win[1][0]) == int(full[1][0]) == 4
+    np.testing.assert_array_equal(np.asarray(win[0]), np.asarray(full[0]))
+
+
+def test_propose_window_drops_stale_match():
+    """A match older than the window is not proposed (eff=0) while the
+    unbounded scan still finds it — the cost/recall tradeoff the window
+    knob buys at long contexts."""
+    # (5, 6) occurs only at position 0; pending n-gram is (5, 6).
+    toks = [5, 6] + [10 + i for i in range(20)] + [5, 6]
+    pend = len(toks) - 1  # pending token = the trailing 6
+    full = propose_ngram_drafts(
+        _hist(toks, 64), jnp.asarray([pend], jnp.int32), ngram=2, draft_len=3
+    )
+    assert int(full[1][0]) >= 1  # unbounded scan finds the old match
+    win = propose_ngram_drafts(
+        _hist(toks, 64), jnp.asarray([pend], jnp.int32), ngram=2, draft_len=3,
+        window=4,
+    )
+    assert int(win[1][0]) == 0  # match is ~20 tokens back, window is 4
+
+
+def test_propose_window_most_recent_still_wins():
+    toks = [7, 8, 1, 9, 7, 8, 2, 9, 7, 8]
+    draft, eff = propose_ngram_drafts(
+        _hist(toks, 32), jnp.asarray([9], jnp.int32), ngram=2, draft_len=3,
+        window=8,
+    )
+    assert int(eff[0]) >= 1
+    assert int(draft[0, 0]) == 2
+
+
+def test_spec_windowed_greedy_bit_identical_to_plain(params):
+    """Losslessness holds with a bounded window (the window changes WHAT
+    gets drafted, never what gets emitted): greedy output with a window
+    smaller than max_seq_len is still bit-identical to plain decode."""
+    eng_plain = _engine(params)
+    eng_plain.start()
+    try:
+        plain = _run(eng_plain, _greedy_reqs())
+    finally:
+        eng_plain.stop()
+    # window=16 < S=128 exercises the windowed gather branch.
+    eng_spec = _engine(params, speculative_draft_len=3,
+                       speculative_window=16)
+    assert eng_spec.spec_window == 16
+    eng_spec.start()
+    try:
+        spec = _run(eng_spec, _greedy_reqs())
+    finally:
+        eng_spec.stop()
+    for qid in plain:
+        assert spec[qid].output_ids == plain[qid].output_ids, qid
+
+
 # ----------------------------------------------------------------------
 # spec_verify vs a scalar reference
 # ----------------------------------------------------------------------

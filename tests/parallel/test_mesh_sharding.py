@@ -67,7 +67,9 @@ def test_sharded_forward_matches_single_device(spec_str):
     def f(p, i, s, pos):
         return forward(p, cfg, i, s, pos, attn_impl="reference")
 
-    with jax.sharding.set_mesh(mesh):
+    from areal_tpu.utils.jax_compat import set_mesh
+
+    with set_mesh(mesh):
         out = f(sharded, *args)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
 

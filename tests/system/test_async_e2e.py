@@ -3,6 +3,7 @@ worker (math agent/env) + stream-dataset trainer + master, all real
 components on a tiny model (mirrors reference async PPO tests +
 SURVEY §3.4/3.5 data/weight paths)."""
 
+import os
 import uuid
 
 import pytest
@@ -34,6 +35,25 @@ from tests.system.test_e2e_experiments import TINY_CFG, _mk_tokenizer_files, _wo
 
 
 N_SEQS = 2
+
+# Health-lease TTL for these e2e runs (seconds; overridable for even
+# slower CI). The 10s production default is tuned for real fault
+# detection latency; under a PARALLEL test run a healthy worker's poll
+# loop can easily be descheduled past it, and the supervisor then
+# restarts live workers mid-test (VERDICT r5: multi-server e2e passes in
+# isolation, fails under load). A fat TTL keeps the fault machinery
+# exercised while making "slow" != "dead".
+E2E_HEALTH_TTL = os.environ.get("AREAL_TEST_E2E_HEALTH_TTL", "60")
+
+
+def _deflaked_env(tmp_path, monkeypatch):
+    """Worker env + parent-process env with the load-tolerant TTL (the
+    master and LocalController supervisor run in-process, so the parent
+    needs it too)."""
+    monkeypatch.setenv("AREAL_HEALTH_TTL", E2E_HEALTH_TTL)
+    env = _worker_env(tmp_path)
+    env["AREAL_HEALTH_TTL"] = E2E_HEALTH_TTL
+    return env
 
 
 def _trainer_parts(exp, trial, tok_dir):
@@ -124,7 +144,7 @@ def _trainer_parts(exp, trial, tok_dir):
     ],
     ids=["single-step", "multi-turn", "spec-int8"],
 )
-def test_async_ppo_e2e(tmp_path, agent_abs, gen_extra):
+def test_async_ppo_e2e(tmp_path, monkeypatch, agent_abs, gen_extra):
     exp, trial = f"e2e-async-{uuid.uuid4().hex[:6]}", "t0"
     rows, tok_dir = _mk_tokenizer_files(tmp_path)
     mc_rows = [r for r in fixtures.make_math_code_rows(12, seed=9) if r["task"] == "math"]
@@ -179,14 +199,14 @@ def test_async_ppo_e2e(tmp_path, agent_abs, gen_extra):
             "backend": "nfs",
             "record_root": str(tmp_path / "name_resolve"),
         },
-        worker_env=_worker_env(tmp_path),
+        worker_env=_deflaked_env(tmp_path, monkeypatch),
     )
     result = ctl.run()
     assert result["global_step"] == 2
 
 
 @pytest.mark.slow
-def test_async_ppo_e2e_multi_server(tmp_path, capfd):
+def test_async_ppo_e2e_multi_server(tmp_path, monkeypatch, capfd):
     """The n>1 async topology (VERDICT r4 next-round #7): 2 generation
     servers + 2 rollout workers + 1 trainer, with a non-default routing
     policy (least_token_usage), weight-update fanout reaching BOTH
@@ -272,7 +292,7 @@ def test_async_ppo_e2e_multi_server(tmp_path, capfd):
             "backend": "nfs",
             "record_root": str(tmp_path / "name_resolve"),
         },
-        worker_env=_worker_env(tmp_path),
+        worker_env=_deflaked_env(tmp_path, monkeypatch),
     )
     result = ctl.run()
     assert result["global_step"] == 2
