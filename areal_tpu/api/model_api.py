@@ -207,6 +207,10 @@ class APIGenerateOutput:
     version_start: int = -1
     version_end: int = -1
     latency: float = 0.0
+    # Tokens resubmitted for prefill after interrupts/chunk boundaries —
+    # the measured cost of interruptible generation (tracing + telemetry).
+    reprefill_tokens: int = 0
+    n_interruptions: int = 0
 
     @classmethod
     def from_input(cls, inp: APIGenerateInput) -> "APIGenerateOutput":
@@ -233,6 +237,8 @@ class BundledGenerationOutputs:
     no_eos: List[bool]
     version_start: List[int]
     version_end: List[int]
+    reprefill_tokens: List[int] = dataclasses.field(default_factory=list)
+    n_interruptions: List[int] = dataclasses.field(default_factory=list)
 
     @classmethod
     def from_api_outputs(
@@ -248,6 +254,8 @@ class BundledGenerationOutputs:
             no_eos=[o.no_eos for o in outputs],
             version_start=[o.version_start for o in outputs],
             version_end=[o.version_end for o in outputs],
+            reprefill_tokens=[o.reprefill_tokens for o in outputs],
+            n_interruptions=[o.n_interruptions for o in outputs],
         )
 
     @property

@@ -50,7 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracing
 from areal_tpu.engine.paged import (
     TRASH_PAGE,
     PageAllocator,
@@ -236,6 +236,9 @@ class ServingEngine:
         self._cached_tokens = 0
         self.prefix_cache_hits = 0
         self.prefix_tokens_reused = 0
+        # Cumulative admissions: fleet hit-rate denominator (the manager
+        # aggregates sum(hits)/sum(requests) across servers).
+        self.total_requests = 0
         self.eos_token_id = eos_token_id
         self.attn_impl = attn_impl
         self.version = 0
@@ -401,6 +404,7 @@ class ServingEngine:
                     f"serving engine loop died: {self.fatal_error!r}"
                 ) from self.fatal_error
             req.submit_time = time.monotonic()
+            self.total_requests += 1
             self._queue.put(req)
 
     def is_stale_update(self, version: Optional[int]) -> bool:
@@ -513,6 +517,7 @@ class ServingEngine:
             "prefix_cache_hits": float(self.prefix_cache_hits),
             "prefix_tokens_reused": float(self.prefix_tokens_reused),
             "prefix_cached_tokens": float(self._cached_tokens),
+            "total_requests": float(self.total_requests),
             # Speculative decoding yield: emitted tokens per decode STEP
             # across slots that were active (1.0 = no speculation value;
             # the ceiling is 1 + draft_len). The number that decides
@@ -654,7 +659,14 @@ class ServingEngine:
         otherwise strand in a dead stack frame."""
         batch = self._admit_inflight
         batch.clear()
+        t0 = tracing.now_ns() if tracing.enabled() else 0
         self._admit_impl(batch)
+        if batch and tracing.enabled():
+            # Generation-busy evidence for the merged RL timeline (the
+            # overlap score unions these with decode blocks).
+            tracing.record_span(
+                "server.prefill", t0, n_prompts=len(batch),
+            )
         batch.clear()  # normal completion: requests now live in _slot_req
 
     def _admit_impl(self, batch):
@@ -1181,6 +1193,7 @@ class ServingEngine:
 
             (lengths, next_input, active, remaining, min_remaining,
              temps, top_ps, top_ks, greedy) = self._dstate
+            decode_t0 = tracing.now_ns() if tracing.enabled() else 0
             if self.spec_draft_len > 0:
                 from areal_tpu.engine.spec_decode import (
                     paged_spec_decode_block,
@@ -1214,6 +1227,11 @@ class ServingEngine:
             self._dstate = (lengths, next_input, active, remaining,
                             min_remaining, temps, top_ps, top_ks, greedy)
             p = np.asarray(packed)  # the block's single device fetch
+            if tracing.enabled():
+                tracing.record_span(
+                    "server.decode_block", decode_t0,
+                    n_running=self.n_running,
+                )
             toks_h = p[:, :n]
             lps_h = p[:, n:2 * n]
             n_emitted = p[:, 2 * n].astype(np.int64)

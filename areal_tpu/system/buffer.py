@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from areal_tpu.api.data_api import SequenceSample
 from areal_tpu.api.dfg import MFCDef
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracing
 
 logger = logging.getLogger("buffer")
 
@@ -30,6 +30,7 @@ class _Slot:
     consumed_by: Set[str]
     birth: float
     sample_id: str
+    birth_ns: int = 0  # monotonic-ns enqueue time for residency tracing
 
 
 class AsyncIOSequenceBuffer:
@@ -60,6 +61,10 @@ class AsyncIOSequenceBuffer:
         # resident duplicates skipped on put (epoch carryover); surfaced
         # in logs so silent data-accounting drift stays visible.
         self.n_dropped_duplicates = 0
+        # Advanced by the master each step; stamped on buffer.wait spans
+        # so the trace report can derive staleness (train step minus the
+        # policy version that STARTED the sample's generation).
+        self.current_train_step = 0
 
     def __len__(self):
         return len(self._slots)
@@ -132,6 +137,9 @@ class AsyncIOSequenceBuffer:
                         consumed_by=set(),
                         birth=time.monotonic(),
                         sample_id=sample_id,
+                        birth_ns=(
+                            tracing.now_ns() if tracing.enabled() else 0
+                        ),
                     )
                     n += 1
             if n:
@@ -172,6 +180,34 @@ class AsyncIOSequenceBuffer:
                     chosen = cand[: rpc.n_seqs]
                     for slot in chosen:
                         slot.consumed_by.add(rpc.name)
+                        if tracing.enabled() and slot.birth_ns:
+                            # Residency span: enqueue -> this consumption,
+                            # parented under the rollout's episode span
+                            # with the staleness facts as attributes.
+                            # Best-effort: malformed metadata must never
+                            # take down batch assembly.
+                            try:
+                                md = slot.sample.metadata
+                                ctx = (md.get("trace_ctx") or [None])[0]
+                                v0 = (md.get("version_start") or [-1])[0]
+                                v1 = (md.get("version_end") or [-1])[0]
+                                tracing.record_span(
+                                    "buffer.wait", slot.birth_ns,
+                                    ctx=tracing.extract(ctx),
+                                    rpc=rpc.name,
+                                    # One span per CONSUMING MFC (each
+                                    # wait is real); sample_id lets the
+                                    # staleness report count each sample
+                                    # once despite multi-MFC graphs.
+                                    sample_id=str(slot.sample_id),
+                                    version_start=int(v0 if v0 is not None else -1),
+                                    version_end=int(v1 if v1 is not None else -1),
+                                    train_step=int(self.current_train_step),
+                                )
+                            except Exception:
+                                logger.debug(
+                                    "buffer.wait span failed", exc_info=True
+                                )
                     # GC slots every MFC has consumed.
                     for slot in chosen:
                         if len(slot.consumed_by) == self._n_rpcs:

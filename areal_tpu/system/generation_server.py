@@ -25,7 +25,7 @@ from aiohttp import web
 
 from areal_tpu.api import data_api
 from areal_tpu.api.system_api import GenerationServerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, network, seeding
+from areal_tpu.base import constants, logging, name_resolve, names, network, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.engine.serving import GenRequest, ServingEngine
 from areal_tpu.system.worker_base import PollResult, Worker
@@ -143,6 +143,15 @@ class GenerationServer(Worker):
         # server mid-rollout and prove clients fail over.
         await faults.maybe_fail_async("gserver.generate")
         d = await request.json()
+        # Request-scoped tracing: the client's chunk span is this span's
+        # parent, so the merged timeline shows queue+compute time on the
+        # server track inside the client's chunk.
+        gen_span = tracing.start_span(
+            "server.generate",
+            ctx=tracing.extract_from(d),
+            qid=str(d.get("qid", "")),
+            prompt_len=len(d.get("input_ids") or []),
+        )
         g = d.get("gconfig", {})
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -169,10 +178,20 @@ class GenerationServer(Worker):
         except RuntimeError as e:
             # Fail-fast path: the serve loop already died; keep the same
             # JSON error contract as the in-flight res.error branch below.
+            if gen_span is not None:
+                gen_span.end(error=str(e))
             return web.json_response(
                 {"qid": req.qid, "error": str(e)}, status=500
             )
         res = await fut
+        if gen_span is not None:
+            gen_span.end(
+                n_tokens=len(res.output_ids),
+                interrupted=res.interrupted,
+                version_start=res.version_start,
+                version_end=res.version_end,
+                error=res.error or "",
+            )
         if res.error is not None:
             # Serve-loop death: surface as a 500 so clients retry against
             # another server instead of treating it as an empty completion.
@@ -197,6 +216,12 @@ class GenerationServer(Worker):
     async def _h_update_weights(self, request: web.Request) -> web.Response:
         await faults.maybe_fail_async("gserver.update_weights")
         d = await request.json()
+        upd_span = tracing.start_span(
+            "server.weight_update",
+            ctx=tracing.extract_from(d),
+            version=d.get("version"),
+            n_running=self.engine.n_running,
+        )
         model_path = d["model_path"]
         allow_interrupt = bool(d.get("allow_interrupt", True))
         version = d.get("version")
@@ -217,6 +242,8 @@ class GenerationServer(Worker):
             if allow_interrupt:
                 self.engine.escalate_pending_interrupt()
             logger.info(f"skipping stale weight update v{version}")
+            if upd_span is not None:
+                upd_span.end(stale=True)
             return web.json_response(
                 {"success": True, "stale": True,
                  "num_paused_requests": self.engine.n_running}
@@ -227,6 +254,8 @@ class GenerationServer(Worker):
             )
         except Exception as e:
             logger.exception("weight update load failed")
+            if upd_span is not None:
+                upd_span.end(error=repr(e))
             return web.json_response({"success": False, "error": repr(e)}, status=500)
         self._last_load_info = info
         n_running = self.engine.n_running
@@ -245,6 +274,11 @@ class GenerationServer(Worker):
             f"weight update: source={info['source']} "
             f"load={info['load_s']:.3f}s dump_version={info['version']}"
         )
+        if upd_span is not None:
+            upd_span.end(
+                source=info["source"], load_s=info["load_s"],
+                n_paused=n_running,
+            )
         return web.json_response(
             {
                 "success": True,
@@ -285,6 +319,9 @@ class GenerationServer(Worker):
             f"areal:prefix_cache_hits {m['prefix_cache_hits']}",
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
             f"areal:prefix_cached_tokens {m['prefix_cached_tokens']}",
+            # Fleet hit-rate denominator (manager aggregates ratio of
+            # sums across servers, not an average of per-server rates).
+            f"areal:total_requests {m['total_requests']}",
             f"areal:spec_tokens_per_step {m['spec_tokens_per_step']}",
             # Raw sums behind the ratio, so the manager can aggregate the
             # fleet yield as sum(emitted)/sum(steps) instead of averaging

@@ -36,7 +36,7 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.api.system_api import GserverManagerConfig
-from areal_tpu.base import constants, health, logging, name_resolve, names, network
+from areal_tpu.base import constants, health, logging, name_resolve, names, network, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.worker_base import PollResult, Worker
 
@@ -85,6 +85,10 @@ class GserverManager(Worker):
         self._server_gen_totals = {u: 0.0 for u in self.server_urls}
         self._server_prefix_hits = {u: 0.0 for u in self.server_urls}
         self._server_prefix_reused = {u: 0.0 for u in self.server_urls}
+        # Per-server request counts for the fleet hit-rate denominator
+        # (ratio of SUMS, like spec_tokens_per_step: averaging per-server
+        # hit rates would overweight idle servers).
+        self._server_gen_reqs = {u: 0.0 for u in self.server_urls}
         # Fleet speculation yield as a ratio of SUMS: per-server emitted
         # tokens and active decode steps, not per-server ratios (an
         # unweighted mean of ratios overweights idle servers).
@@ -265,6 +269,7 @@ class GserverManager(Worker):
             for d in (
                 self._server_tokens, self._server_gen_totals,
                 self._server_prefix_hits, self._server_prefix_reused,
+                self._server_gen_reqs,
                 self._server_spec_emitted, self._server_spec_steps,
             ):
                 d.pop(old, None)
@@ -364,6 +369,22 @@ class GserverManager(Worker):
         except (name_resolve.NameEntryNotFoundError, ValueError):
             return 0
 
+    def prefix_cache_fleet(self) -> Dict[str, float]:
+        """Fleet prefix-cache effectiveness as ratios of SUMS (the
+        spec_tokens_per_step fix shape): per-server counters summed
+        first, divided once — an unweighted mean of per-server rates
+        would overweight idle servers."""
+        hits = sum(self._server_prefix_hits.values())
+        reused = sum(self._server_prefix_reused.values())
+        reqs = sum(self._server_gen_reqs.values())
+        return {
+            "prefix_cache_hits": hits,
+            "prefix_tokens_reused": reused,
+            "total_requests": reqs,
+            "prefix_cache_hit_rate": hits / reqs if reqs > 0 else 0.0,
+            "prefix_tokens_reused_per_hit": reused / hits if hits > 0 else 0.0,
+        }
+
     def is_staled(self) -> bool:
         """Staleness gate (reference gserver_manager.py:351-366): if this
         rollout trained at the version implied by samples already produced,
@@ -400,6 +421,7 @@ class GserverManager(Worker):
 
     async def _h_schedule(self, request: web.Request) -> web.Response:
         meta = await request.json()
+        trace_ctx = tracing.extract_from(meta)
         # Clients report the server a request just failed on; that server
         # leaves rotation immediately (the health registry readmits it
         # once its heartbeat proves it alive and it re-syncs weights).
@@ -410,6 +432,10 @@ class GserverManager(Worker):
             url = self._choose_server(meta)
             if url is not None:
                 self._server_reqs[url] += 1
+        tracing.event(
+            "manager.schedule", ctx=trace_ctx,
+            server=url or "", routed=url is not None,
+        )
         if url is None:
             return web.json_response(
                 {"error": "no healthy generation servers", "retry_after": 0.5},
@@ -419,21 +445,31 @@ class GserverManager(Worker):
 
     async def _h_allocate(self, request: web.Request) -> web.Response:
         d = await request.json()
+        trace_ctx = tracing.extract_from(d)
         worker = str(d.get("worker", "?"))
+        reason = None
         with self._lock:
             cap = self.cfg.max_concurrent_rollouts or (1 << 30)
             if self.rollout_stat.running >= cap:
-                return web.json_response(
-                    {"success": False, "reason": "capacity"}
+                reason = "capacity"
+            elif self.is_staled():
+                reason = "staled"
+            else:
+                self.rollout_stat.submitted += 1
+                self.rollout_stat.running += 1
+                self._worker_slots[worker] = (
+                    self._worker_slots.get(worker, 0) + 1
                 )
-            if self.is_staled():
-                return web.json_response(
-                    {"success": False, "reason": "staled",
-                     "version": self.weight_version}
-                )
-            self.rollout_stat.submitted += 1
-            self.rollout_stat.running += 1
-            self._worker_slots[worker] = self._worker_slots.get(worker, 0) + 1
+        tracing.event(
+            "manager.allocate", ctx=trace_ctx,
+            admitted=reason is None, reason=reason or "",
+            version=self.weight_version,
+        )
+        if reason is not None:
+            resp = {"success": False, "reason": reason}
+            if reason == "staled":
+                resp["version"] = self.weight_version
+            return web.json_response(resp)
         return web.json_response({"success": True, "version": self.weight_version})
 
     async def _h_finish(self, request: web.Request) -> web.Response:
@@ -472,6 +508,7 @@ class GserverManager(Worker):
                 "healthy_servers": healthy,
                 "evicted_servers": evicted,
                 "server_versions": versions,
+                "prefix_cache": self.prefix_cache_fleet(),
             }
         )
 
@@ -514,6 +551,10 @@ class GserverManager(Worker):
         load_stats: list = []
         successes: List[str] = []
         failures: Dict[str, str] = {}
+        fanout_span = tracing.start_span(
+            "manager.weight_update", version=self._new_version,
+            n_targets=len(targets),
+        )
 
         async def _update():
             await faults.maybe_fail_async("manager.fanout")
@@ -523,13 +564,17 @@ class GserverManager(Worker):
                 tasks = [
                     sess.post(
                         f"{u}/update_weights_from_disk",
-                        json={
-                            "model_path": path,
-                            "allow_interrupt": True,
-                            # Pin the engines to the trainer's published
-                            # version so routing/staleness accounting agree.
-                            "version": self._new_version,
-                        },
+                        json=tracing.inject_ctx_into(
+                            {
+                                "model_path": path,
+                                "allow_interrupt": True,
+                                # Pin the engines to the trainer's
+                                # published version so routing/staleness
+                                # accounting agree.
+                                "version": self._new_version,
+                            },
+                            fanout_span.ctx if fanout_span else None,
+                        ),
                     )
                     for u in targets
                 ]
@@ -547,8 +592,14 @@ class GserverManager(Worker):
                         (body.get("source", "?"), float(body.get("load_s", 0.0)))
                     )
 
-        fut = asyncio.run_coroutine_threadsafe(_update(), self._http_loop)
-        fut.result(timeout=self.cfg.flush_request_timeout + 10)
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_update(), self._http_loop)
+            fut.result(timeout=self.cfg.flush_request_timeout + 10)
+        finally:
+            if fanout_span is not None:
+                fanout_span.end(
+                    n_success=len(successes), n_failed=len(failures)
+                )
         if not successes:
             # No quorum: weight_version stays put so the next poll
             # retries the (idempotent, version-pinned) fanout.
@@ -602,6 +653,10 @@ class GserverManager(Worker):
                             )
                         elif line.startswith("areal:prefix_tokens_reused"):
                             self._server_prefix_reused[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:total_requests"):
+                            self._server_gen_reqs[u] = float(
                                 line.split()[-1]
                             )
                         elif line.startswith("areal:spec_emitted_tokens"):
@@ -667,13 +722,16 @@ class GserverManager(Worker):
             tps = max(0.0, total_gen - self._last_gen_total) / dt
             with self._lock:
                 rs = self.rollout_stat.as_dict()
+            pc = self.prefix_cache_fleet()
             logger.info(
                 f"generation throughput: {tps:.0f} tokens/s "
                 f"(total {total_gen:.0f}) rollouts={rs} "
                 f"weight_version={self.weight_version} "
-                f"prefix_cache_hits={sum(self._server_prefix_hits.values()):.0f} "
-                f"prefix_tokens_reused="
-                f"{sum(self._server_prefix_reused.values()):.0f}"
+                f"prefix_cache_hits={pc['prefix_cache_hits']:.0f} "
+                f"prefix_tokens_reused={pc['prefix_tokens_reused']:.0f} "
+                f"prefix_cache_hit_rate={pc['prefix_cache_hit_rate']:.3f} "
+                f"prefix_tokens_reused_per_hit="
+                f"{pc['prefix_tokens_reused_per_hit']:.1f}"
                 + (
                     # Realized fleet speculation yield: ratio of SUMS
                     # (total emitted tokens / total active decode steps),

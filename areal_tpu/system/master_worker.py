@@ -13,7 +13,7 @@ from typing import Dict, List, Optional
 
 from areal_tpu.api.dfg import build_graph
 from areal_tpu.api.system_api import MasterWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, recover, timeutil
+from areal_tpu.base import constants, logging, name_resolve, names, recover, timeutil, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.base.recover import RecoverInfo, StepInfo
 from areal_tpu.system import request_reply_stream as rrs
@@ -203,7 +203,19 @@ class MasterWorker(Worker):
         t0 = time.monotonic()
         epoch_before = self.step_info.epoch
 
-        stats = self.executor.execute_step_sync()
+        # Keep the shared coroutine-control step info (shipped in every
+        # MFC request: param-realloc stamps, trace attributes) in sync
+        # with the authoritative counter.
+        self.ctrl.step_info.update(
+            epoch=self.step_info.epoch,
+            epoch_step=self.step_info.epoch_step,
+            global_step=self.step_info.global_step,
+        )
+        self.buffer.current_train_step = self.step_info.global_step
+        with tracing.span(
+            "master.step", step=self.step_info.global_step
+        ):
+            stats = self.executor.execute_step_sync()
 
         epoch_boundary = self.executor.epoch_done
         if (
@@ -275,6 +287,12 @@ class MasterWorker(Worker):
                     "perf/packing_efficiency",
                     "perf/h2d_wait_ms",
                     "perf/dispatch_gap_ms",
+                    # Rollout-pipeline series (PR 3): episode e2e latency
+                    # percentiles + interruption re-prefill tokens, from
+                    # trajectory metadata (async runs only).
+                    "perf/rollout_e2e_p50_ms",
+                    "perf/rollout_e2e_p95_ms",
+                    "perf/reprefill_tokens",
                 ):
                     # Input-pipeline telemetry: per-MFC series + running
                     # mean in perf_summary["overlap"].
@@ -310,6 +328,8 @@ class MasterWorker(Worker):
             if k.startswith((
                 "timeperf/", "tflops/", "gen_tokens_per_sec/",
                 "packing_efficiency/", "h2d_wait_ms/", "dispatch_gap_ms/",
+                "rollout_e2e_p50_ms/", "rollout_e2e_p95_ms/",
+                "reprefill_tokens/",
             ))
         ]
         logger.info(
@@ -341,6 +361,35 @@ class MasterWorker(Worker):
             self._broadcast("exit", timeout=60)
         except Exception:
             logger.warning("some workers did not ack exit", exc_info=True)
+        self._collect_rl_trace_summary()
+
+    def _collect_rl_trace_summary(self):
+        """With AREAL_RL_TRACE=1, fold the merged-trace verdict (overlap
+        score, staleness histogram, phase latencies) into perf_summary —
+        the run's timeline evidence next to its throughput numbers.
+
+        Best-effort by construction: workers ack the exit broadcast
+        BEFORE their run-loop finally flushes their shard, so this reads
+        a short grace period later and may still miss a worker's last
+        batch. The authoritative artifact is scripts/merge_rl_trace.py
+        over the shard dir after every process has exited."""
+        if not tracing.enabled():
+            return
+        time.sleep(1.0)
+        tracing.flush()
+        try:
+            from areal_tpu.utils import rl_trace
+
+            self.perf_summary["rl_trace"] = rl_trace.summarize(
+                tracing.trace_dir()
+            )
+            logger.info(
+                "rl_trace summary: overlap_score="
+                f"{self.perf_summary['rl_trace'].get('overlap_score', 0):.3f} "
+                f"staleness={self.perf_summary['rl_trace'].get('staleness_hist')}"
+            )
+        except Exception:
+            logger.warning("rl_trace summary failed", exc_info=True)
 
     def _exit_hook(self):
         try:

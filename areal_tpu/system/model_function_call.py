@@ -19,7 +19,7 @@ import numpy as np
 
 from areal_tpu.api.data_api import SequenceSample
 from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, OffloadHook, ParamReallocHook, SaveHook, EvaluateHook
-from areal_tpu.base import datapack, logging, stats_tracker
+from areal_tpu.base import datapack, logging, stats_tracker, tracing
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.buffer import AsyncIOSequenceBuffer
 from areal_tpu.system.redistributor import GlobalStorageTracker, RedistribPlanner
@@ -114,46 +114,80 @@ class ModelFunctionCall:
         ids, batch = await self.buffer.get_batch_for_rpc(rpc)
         self.ctrl.used_ids |= set(ids)
 
-        assignments = self.data_parallel_dispatch(ids, batch)
-        dests = {
-            w: part for w, part in zip(self.workers, assignments) if part
-        }
-        plan = self.planner.derive_plan(dests, list(rpc.input_keys))
-
-        handlers, datas, pre_hooks, post_hooks = [], [], [], []
-        for w, part in dests.items():
-            worker_steps = [
-                dataclasses.asdict(s) for s in plan if s.dst == w
-            ]
-            handlers.append(w)
-            datas.append(
-                dict(
-                    mfc_name=rpc.name,
-                    model_name=str(rpc.model_name),
-                    interface_type=rpc.interface_type.value,
-                    ids=part,
-                    input_keys=list(rpc.input_keys),
-                    input_key_remap=dict(rpc.input_key_remap),
-                    output_key_remap=dict(rpc.output_key_remap),
-                    mb_spec=dataclasses.asdict(rpc.mb_spec),
-                    plan=worker_steps,
-                    step_info=dict(self.ctrl.step_info),
-                )
-            )
-            pre_hooks.append([_hook_dict(h) for h in rpc.pre_hooks])
-            post_hooks.append([_hook_dict(h) for h in rpc.post_hooks])
-
-        req_ids = self.stream.request(
-            handlers,
-            "mfc",
-            datas,
-            pre_hooks=pre_hooks,
-            post_hooks=post_hooks,
+        # Master-side MFC span under the step's trace. A train MFC also
+        # records which rollout traces it consumed, giving the merger the
+        # rollout -> train-step flow links (capped: the attr is evidence,
+        # not a database).
+        consumed_traces: List[str] = []
+        if tracing.enabled():
+            for c in batch.metadata.get("trace_ctx") or []:
+                if isinstance(c, dict) and c.get("trace_id"):
+                    consumed_traces.append(str(c["trace_id"]))
+            # Group sampling stamps bs copies of one episode ctx: dedup
+            # (order-preserving) before the cap or duplicates eat it.
+            consumed_traces = list(dict.fromkeys(consumed_traces))
+        mfc_span = tracing.start_span(
+            f"master.mfc.{rpc.name}",
+            itype=rpc.interface_type.value,
+            n_seqs=len(ids),
+            **(
+                {"consumed_traces": consumed_traces[:256]}
+                if consumed_traces
+                else {}
+            ),
         )
+        if mfc_span is not None:
+            tracing.set_current(mfc_span.ctx)
+
         t0 = time.monotonic()
-        replies = await asyncio.gather(
-            *[async_poll(self.stream, rid) for rid in req_ids]
-        )
+        # The try covers dispatch building and posting too: once
+        # set_current is active, any posted request parents worker spans
+        # under this span id — it must be recorded on EVERY exit path or
+        # the validator sees a zero-drop dangling parent.
+        try:
+            assignments = self.data_parallel_dispatch(ids, batch)
+            dests = {
+                w: part for w, part in zip(self.workers, assignments) if part
+            }
+            plan = self.planner.derive_plan(dests, list(rpc.input_keys))
+
+            handlers, datas, pre_hooks, post_hooks = [], [], [], []
+            for w, part in dests.items():
+                worker_steps = [
+                    dataclasses.asdict(s) for s in plan if s.dst == w
+                ]
+                handlers.append(w)
+                datas.append(
+                    dict(
+                        mfc_name=rpc.name,
+                        model_name=str(rpc.model_name),
+                        interface_type=rpc.interface_type.value,
+                        ids=part,
+                        input_keys=list(rpc.input_keys),
+                        input_key_remap=dict(rpc.input_key_remap),
+                        output_key_remap=dict(rpc.output_key_remap),
+                        mb_spec=dataclasses.asdict(rpc.mb_spec),
+                        plan=worker_steps,
+                        step_info=dict(self.ctrl.step_info),
+                    )
+                )
+                pre_hooks.append([_hook_dict(h) for h in rpc.pre_hooks])
+                post_hooks.append([_hook_dict(h) for h in rpc.post_hooks])
+
+            req_ids = self.stream.request(
+                handlers,
+                "mfc",
+                datas,
+                pre_hooks=pre_hooks,
+                post_hooks=post_hooks,
+            )
+            t0 = time.monotonic()
+            replies = await asyncio.gather(
+                *[async_poll(self.stream, rid) for rid in req_ids]
+            )
+        finally:
+            if mfc_span is not None:
+                mfc_span.end()
         elapsed = time.monotonic() - t0
 
         # Collect outputs / stats.
@@ -169,6 +203,31 @@ class ModelFunctionCall:
             if p.data.get("stats"):
                 stats_list.append(p.data["stats"])
         stats = merge_worker_stats(stats_list)
+        if rpc.interface_type == ModelInterfaceType.TRAIN_STEP:
+            # Rollout-pipeline telemetry riding the consumed samples'
+            # metadata (stamped by the rollout worker; absent on sync
+            # runs): end-to-end episode latency percentiles and the
+            # interruption re-prefill cost of this batch. Works with
+            # tracing OFF — metadata is always stamped.
+            e2e = [
+                float(v)
+                for v in batch.metadata.get("rollout_e2e_s") or []
+                if isinstance(v, (int, float))
+            ]
+            if e2e:
+                stats["perf/rollout_e2e_p50_ms"] = float(
+                    np.percentile(e2e, 50) * 1e3
+                )
+                stats["perf/rollout_e2e_p95_ms"] = float(
+                    np.percentile(e2e, 95) * 1e3
+                )
+            reprefill = [
+                float(v)
+                for v in batch.metadata.get("reprefill_tokens") or []
+                if isinstance(v, (int, float))
+            ]
+            if reprefill:
+                stats["perf/reprefill_tokens"] = float(np.sum(reprefill))
         # DP workers run concurrently: wall time is the max, flops add,
         # so MFC TFLOP/s is aggregate-over-workers per wall second.
         if stats.get("perf/flops") and stats.get("perf/sec"):

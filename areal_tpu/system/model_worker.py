@@ -38,7 +38,7 @@ from areal_tpu.api.model_api import (
     make_model,
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, seeding, stats_tracker, timeutil
+from areal_tpu.base import constants, logging, name_resolve, names, seeding, stats_tracker, timeutil, tracing
 from areal_tpu.system import eval_scores
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
@@ -216,7 +216,18 @@ class ModelWorker(Worker):
         itype = d["interface_type"]
         mn = ModelName.parse(model_name)
         t0 = time.monotonic()
-        with constants.model_scope(mn), profiling.maybe_profile(
+        # Worker-side MFC execution span, parented under the master's
+        # MFC span (trace_ctx rides the request payload). The train-step
+        # spans are the "training busy" track of the merged timeline's
+        # overlap score.
+        with constants.model_scope(mn), tracing.span(
+            f"mfc.{d.get('mfc_name', itype)}",
+            ctx=tracing.extract(req.trace_ctx),
+            itype=itype,
+            model=model_name,
+            step=step,
+            n_seqs=len(d["ids"]),
+        ), profiling.maybe_profile(
             d.get("mfc_name", itype), step
         ):
             if itype == "generate":

@@ -23,7 +23,7 @@ from areal_tpu.api.model_api import (
     BundledGenerationOutputs,
     GenerationHyperparameters,
 )
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracing
 
 logger = logging.getLogger("partial_rollout")
 
@@ -108,6 +108,12 @@ class PartialRolloutManager:
         """Generate one sample, chunk by chunk, resubmitting with the
         accumulated prefix after interrupts (reference _run_gen:92,
         refresh_generation:181)."""
+        with tracing.span("gen.sample", qid=qid, prompt_len=len(prompt_ids)):
+            return await self._generate_one_impl(qid, prompt_ids, gconfig)
+
+    async def _generate_one_impl(
+        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+    ) -> APIGenerateOutput:
         acc_out: List[int] = []
         acc_lp: List[float] = []
         version_start = -1
@@ -116,21 +122,30 @@ class PartialRolloutManager:
         prev_url, prev_version = "", -1
         failed_url: Optional[str] = None
         retries = 0
+        # Interruption-cost accounting: any submission carrying an
+        # already-accumulated prefix makes the server (re-)prefill
+        # prompt+prefix under (possibly new) weights; prefix caching may
+        # discount it server-side, so this is the upper bound the
+        # re-prefill report quantifies.
+        reprefill_tokens = 0
+        n_interruptions = 0
         budget = gconfig.max_new_tokens
         sess = await self._sess()
         while budget > 0:
             try:
                 sched = await self._schedule(
-                    dict(
-                        prompt_len=len(prompt_ids) + len(acc_out),
-                        group_size=1,
-                        new_token_budget=budget,
-                        previous_server_url=prev_url,
-                        previous_version=prev_version,
-                        # Report the server the previous attempt died on,
-                        # so the manager evicts it before routing this
-                        # retry.
-                        failed_server_url=failed_url,
+                    tracing.inject_into(
+                        dict(
+                            prompt_len=len(prompt_ids) + len(acc_out),
+                            group_size=1,
+                            new_token_budget=budget,
+                            previous_server_url=prev_url,
+                            previous_version=prev_version,
+                            # Report the server the previous attempt died
+                            # on, so the manager evicts it before routing
+                            # this retry.
+                            failed_server_url=failed_url,
+                        )
                     )
                 )
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
@@ -162,20 +177,35 @@ class PartialRolloutManager:
                 continue
             url, server_version = sched["url"], int(sched.get("version", -1))
             chunk = min(budget, self.new_tokens_per_chunk)
-            payload = dict(
-                qid=qid,
-                input_ids=list(prompt_ids) + acc_out,
-                gconfig=dict(
-                    max_new_tokens=chunk,
-                    min_new_tokens=max(
-                        0, gconfig.min_new_tokens - len(acc_out)
+            # A resubmission carries the accumulated prefix: every token
+            # of prompt+prefix is prefill work the server repeats.
+            chunk_reprefill = (
+                len(prompt_ids) + len(acc_out) if acc_out else 0
+            )
+            # Manual span: reprefill_tokens is stamped only on the
+            # SUCCESSFUL attempt, so the trace-derived re-prefill total
+            # matches the client accounting below even when failed
+            # attempts are retried. Created before the payload so the
+            # server's span parents under THIS chunk (per-chunk server
+            # attribution in the merged timeline), not the whole sample.
+            chunk_span = tracing.start_span("gen.chunk", qid=qid, server=url)
+            payload = tracing.inject_ctx_into(
+                dict(
+                    qid=qid,
+                    input_ids=list(prompt_ids) + acc_out,
+                    gconfig=dict(
+                        max_new_tokens=chunk,
+                        min_new_tokens=max(
+                            0, gconfig.min_new_tokens - len(acc_out)
+                        ),
+                        greedy=gconfig.greedy,
+                        temperature=gconfig.temperature,
+                        top_p=gconfig.top_p,
+                        top_k=gconfig.top_k,
+                        stop_token_ids=list(gconfig.stop_token_ids),
                     ),
-                    greedy=gconfig.greedy,
-                    temperature=gconfig.temperature,
-                    top_p=gconfig.top_p,
-                    top_k=gconfig.top_k,
-                    stop_token_ids=list(gconfig.stop_token_ids),
                 ),
+                chunk_span.ctx if chunk_span is not None else None,
             )
             try:
                 async with sess.post(f"{url}/generate", json=payload) as r:
@@ -184,6 +214,13 @@ class PartialRolloutManager:
                             url, f"{r.status} {await r.text()}"
                         )
                     out = await r.json()
+                # Success end INSIDE the try: the finally's failed=True
+                # end is then a no-op (ManualSpan.end is idempotent).
+                if chunk_span is not None:
+                    chunk_span.end(
+                        reprefill_tokens=chunk_reprefill,
+                        n_tokens=len(out.get("output_ids") or []),
+                    )
             except (
                 ServerFailure, aiohttp.ClientError, asyncio.TimeoutError,
             ) as e:
@@ -202,9 +239,23 @@ class PartialRolloutManager:
                 )
                 await asyncio.sleep(self._backoff(retries))
                 continue
+            finally:
+                # Covers BaseException paths too (task cancellation mid
+                # POST): the server may already have recorded a child
+                # span under this id, so leaving it unrecorded would be
+                # a zero-drop dangling parent — fatal to the validator.
+                if chunk_span is not None:
+                    chunk_span.end(failed=True)
             if version_start < 0:
                 version_start = int(out.get("version_start", server_version))
             version_end = int(out.get("version_end", server_version))
+            reprefill_tokens += chunk_reprefill
+            if out.get("interrupted", False):
+                n_interruptions += 1
+                tracing.event(
+                    "gen.interrupted", qid=qid, server=url,
+                    acc_len=len(prompt_ids) + len(acc_out),
+                )
             made_progress = len(out["output_ids"]) > 0
             acc_out.extend(int(t) for t in out["output_ids"])
             acc_lp.extend(float(x) for x in out["output_logprobs"])
@@ -235,6 +286,8 @@ class PartialRolloutManager:
             no_eos=no_eos,
             version_start=version_start,
             version_end=version_end,
+            reprefill_tokens=reprefill_tokens,
+            n_interruptions=n_interruptions,
         )
 
     async def generate_group(

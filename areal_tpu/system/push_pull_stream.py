@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional
 
 import zmq
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base import logging, name_resolve, names, network, tracing
 
 logger = logging.getLogger("push_pull_stream")
 
@@ -31,6 +31,10 @@ class ZMQJsonPusher:
         self.sock.connect(f"tcp://{host}:{port}")
 
     def push(self, data: Dict[str, Any]):
+        # Best-effort RL-trace propagation: the current span context rides
+        # the JSON under a reserved key the puller strips back off (one
+        # no-op branch when tracing is disabled).
+        data = tracing.inject_into(data)
         self.sock.send_string(json.dumps(data, separators=(",", ":")), flags=0)
 
     def close(self):
@@ -39,6 +43,10 @@ class ZMQJsonPusher:
 
 class ZMQJsonPuller:
     """PULL end. Binds and accepts many pushers."""
+
+    # RL-trace context of the most recent message (None before the first
+    # pull, when absent, or when tracing is disabled).
+    last_trace_ctx = None
 
     def __init__(self, host: str = "0.0.0.0", port: Optional[int] = None, hwm: int = 1000,
                  default_timeout_ms: int = 100):
@@ -55,11 +63,20 @@ class ZMQJsonPuller:
         self.default_timeout_ms = default_timeout_ms
 
     def pull(self, timeout_ms: Optional[int] = None) -> Dict[str, Any]:
-        """Blocking with timeout; raises queue-empty style TimeoutError."""
+        """Blocking with timeout; raises queue-empty style TimeoutError.
+
+        Strips the pusher's RL-trace context off the payload and exposes
+        it as `last_trace_ctx` (None when absent/disabled) so consumers
+        can parent their spans without the key leaking into the data."""
         t = self.default_timeout_ms if timeout_ms is None else timeout_ms
+        # Reset first: a timeout must not leave a previous message's
+        # context attributed to whatever the caller reads next.
+        self.last_trace_ctx = None
         if not self.sock.poll(t):
             raise TimeoutError("no message within timeout")
-        return json.loads(self.sock.recv_string())
+        d = json.loads(self.sock.recv_string())
+        self.last_trace_ctx = tracing.extract_from(d)
+        return d
 
     def close(self):
         self.sock.close()

@@ -24,7 +24,7 @@ from typing import Any, Dict, Hashable, List, Optional
 
 import zmq
 
-from areal_tpu.base import logging, name_resolve, names, network
+from areal_tpu.base import logging, name_resolve, names, network, tracing
 
 logger = logging.getLogger("request_reply_stream")
 
@@ -62,6 +62,10 @@ class Payload:
     post_hooks: List[Dict] = dataclasses.field(default_factory=list)
     no_syn: bool = True
     send_time: float = 0.0
+    # RL-trace context (base/tracing.inject()): stamped by post() when
+    # tracing is on so receivers parent their spans under the sender's
+    # (e.g. an MFC request under the master's train-step span).
+    trace_ctx: Optional[Dict] = None
 
     def __post_init__(self):
         if not self.request_id:
@@ -120,6 +124,8 @@ class _Peer:
     def post(self, payload: Payload) -> str:
         payload.sender = self.peer_name
         payload.send_time = time.monotonic()
+        if payload.trace_ctx is None:
+            payload.trace_ctx = tracing.inject()
         self._send_socket(payload.handler).send_multipart(_encode(payload))
         return payload.request_id
 

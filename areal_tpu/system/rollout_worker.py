@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import time
 from typing import Dict, List, Optional
 
 import aiohttp
@@ -21,7 +22,7 @@ from areal_tpu.api import data_api
 from areal_tpu.api.agent_api import make_agent
 from areal_tpu.api.env_api import make_env
 from areal_tpu.api.system_api import RolloutWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, seeding
+from areal_tpu.base import constants, logging, name_resolve, names, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system import eval_scores
 from areal_tpu.system.partial_rollout import PartialRolloutManager
@@ -29,6 +30,22 @@ from areal_tpu.system.push_pull_stream import NameResolvingZmqPusher
 from areal_tpu.system.worker_base import AsyncWorker, PollResult
 
 logger = logging.getLogger("rollout_worker")
+
+
+class _TracedEnv:
+    """Wraps an EnvironmentService so every step (= reward/functioncall
+    verification for the single-step envs) records a `reward.verify`
+    span under the episode's trace — without touching each agent."""
+
+    def __init__(self, env):
+        self._env = env
+
+    def __getattr__(self, name):
+        return getattr(self._env, name)
+
+    async def step(self, action):
+        with tracing.span("reward.verify"):
+            return await self._env.step(action)
 
 
 class RolloutWorker(AsyncWorker):
@@ -85,7 +102,7 @@ class RolloutWorker(AsyncWorker):
 
             agent_kwargs["gconfig"] = _dc.asdict(config.gconfig)
         self.agent = make_agent(config.agent, **agent_kwargs)
-        self.env = make_env(config.env)
+        self.env = _TracedEnv(make_env(config.env))
 
         self.manager_addr = name_resolve.wait(
             names.gen_server_manager(config.experiment_name, config.trial_name),
@@ -146,7 +163,7 @@ class RolloutWorker(AsyncWorker):
             f"{self.manager_addr}/allocate_rollout",
             # Slot ownership: the manager reclaims this worker's
             # outstanding slots if its heartbeat dies.
-            json={"worker": self.cfg.worker_name},
+            json=tracing.inject_into({"worker": self.cfg.worker_name}),
         ) as r:
             d = await r.json()
         return bool(d.get("success"))
@@ -176,11 +193,30 @@ class RolloutWorker(AsyncWorker):
                 else:
                     await asyncio.sleep(0.2 * (attempt + 1))
 
-    async def rollout_task(self, prompt):
+    async def rollout_task(self, prompt, trace_parent=None):
         """One episode: agent coroutine + generation servicing
         (reference rollout_task:330)."""
         obs_queue: asyncio.Queue = asyncio.Queue()
         act_queue: asyncio.Queue = asyncio.Queue()
+        t_start = time.monotonic()
+        # Episode span: the rollout's trace root for everything from the
+        # first generation chunk to the trainer's buffer.wait (the
+        # allocate span that admitted it is its parent). ep is None when
+        # tracing is off.
+        ep = tracing.start_span(
+            "rollout.episode",
+            ctx=trace_parent,
+            qid=str(prompt.ids[0]) if prompt.ids else "",
+            # getattr: harness-built partial workers (agent tests) have
+            # no cfg, and span kwargs evaluate even when tracing is off.
+            worker=getattr(getattr(self, "cfg", None), "worker_name", ""),
+        )
+        ep_gen = {"reprefill_tokens": 0, "interruptions": 0}
+        # Task-local: this coroutine runs in its own asyncio Task, so the
+        # context needs no reset; tasks created below (generation
+        # servicing, the agent) inherit it at create_task time.
+        if ep is not None:
+            tracing.set_current(ep.ctx)
 
         async def service_gen():
             # Serve generation requests until the agent finishes — an
@@ -192,6 +228,8 @@ class RolloutWorker(AsyncWorker):
                 bundle = await self.prm.generate_group(
                     str(qid), prompt_ids, gconfig
                 )
+                ep_gen["reprefill_tokens"] += sum(bundle.reprefill_tokens)
+                ep_gen["interruptions"] += sum(bundle.n_interruptions)
                 await act_queue.put(bundle)
 
         accepted = False
@@ -220,18 +258,41 @@ class RolloutWorker(AsyncWorker):
                     "generation servicing exited unexpectedly"
                 )
             trajs = await agent_task
+            e2e_s = time.monotonic() - t_start
+            # Per-row share across ALL of the episode's trajectories
+            # (multi-turn agents return several): the consumer sums over
+            # batch rows, so the shares must add back to the episode
+            # total exactly once.
+            ep_rows = sum(t.bs for t in trajs) or 1
             for t in trajs:
                 # Group success rates feed the curriculum filter
                 # (degenerate groups the agent drops are never scored —
                 # the reference's async path behaves the same way).
                 for sid, sc in zip(t.ids, t.metadata.get("scores") or []):
                     self.pending_scores[str(sid)] = float(sc)
+                # Episode telemetry rides the trajectory metadata to the
+                # trainer: e2e latency + interruption re-prefill cost
+                # feed the master's perf scalars, the trace context
+                # parents the buffer-residency spans. Lists align with
+                # ids (SequenceSample contract).
+                t.metadata["rollout_e2e_s"] = [e2e_s] * t.bs
+                t.metadata["reprefill_tokens"] = (
+                    [ep_gen["reprefill_tokens"] / ep_rows] * t.bs
+                )
+                if ep is not None:
+                    t.metadata["trace_ctx"] = [ep.ctx.to_dict()] * t.bs
                 self.pusher.push(data_api.sample_to_json(t))
                 self._push_count += 1
             accepted = bool(trajs)
         except Exception:
             logger.exception("rollout episode failed")
         finally:
+            if ep is not None:
+                ep.end(
+                    accepted=accepted,
+                    reprefill_tokens=ep_gen["reprefill_tokens"],
+                    interruptions=ep_gen["interruptions"],
+                )
             # The quota slot is released on EVERY exit path — normal,
             # crashing agent, or cancellation — so a dying episode can't
             # starve the rollout quota. Shielded so cancellation of this
@@ -274,8 +335,15 @@ class RolloutWorker(AsyncWorker):
             await asyncio.sleep(0.02)
             return PollResult(batch_count=0)
 
+        # The allocate span roots the episode's trace: the admission
+        # request (and the manager's child span) is the first thing that
+        # happens to a rollout, so queue-wait shows up on its timeline.
+        alloc_ctx = None
         try:
-            ok = await self._allocate()
+            with tracing.span(
+                "rollout.allocate", worker=self.cfg.worker_name
+            ) as alloc_ctx:
+                ok = await self._allocate()
         except Exception:
             logger.warning("allocate_rollout failed; retrying", exc_info=True)
             # A restarted gserver manager re-registers at a NEW address;
@@ -310,7 +378,7 @@ class RolloutWorker(AsyncWorker):
                 )
             eid = next(self._episode_counter)
             self._tasks[f"ep{eid}"] = asyncio.create_task(
-                self.rollout_task(batch)
+                self.rollout_task(batch, trace_parent=alloc_ctx)
             )
         except Exception:
             # The slot was allocated but no episode task owns it yet: a

@@ -13,7 +13,7 @@ import threading
 from typing import List, Optional
 
 from areal_tpu.api import data_api
-from areal_tpu.base import logging
+from areal_tpu.base import logging, tracing
 from areal_tpu.system.push_pull_stream import NameResolvingZmqPuller
 
 logger = logging.getLogger("stream_dataset")
@@ -53,13 +53,18 @@ class PullerStreamDataset:
                 logger.exception("bad trajectory json dropped")
                 continue
             self.n_pulled += 1
+            # Queue residency is traced per sample: span from arrival on
+            # this host to the fetch that drains it, parented under the
+            # rollout's episode span (trace ctx rides the sample
+            # metadata; 0 when tracing is off — never allocated).
+            recv_ns = tracing.now_ns() if tracing.enabled() else 0
             # Block (with stop checks) rather than drop: the manager already
             # counted this trajectory as submitted, so dropping it would
             # desync the staleness accounting. Blocking applies backpressure
             # through the ZMQ high-water mark to the rollout workers.
             while not self._stop.is_set():
                 try:
-                    self._queue.put(sample, timeout=1)
+                    self._queue.put((recv_ns, sample), timeout=1)
                     break
                 except queue.Full:
                     continue
@@ -72,9 +77,17 @@ class PullerStreamDataset:
         samples: List[data_api.SequenceSample] = []
         while len(samples) < max_samples:
             try:
-                samples.append(self._queue.get_nowait())
+                recv_ns, sample = self._queue.get_nowait()
             except queue.Empty:
                 break
+            if tracing.enabled() and recv_ns:
+                ctx = (sample.metadata.get("trace_ctx") or [None])[0]
+                tracing.record_span(
+                    "stream.recv", recv_ns,
+                    ctx=tracing.extract(ctx),
+                    qid=str(sample.ids[0]) if sample.ids else "",
+                )
+            samples.append(sample)
         if not samples:
             return None
         return data_api.SequenceSample.gather(samples)

@@ -21,7 +21,7 @@ from typing import Any, Dict, Optional
 
 import zmq
 
-from areal_tpu.base import health, logging, name_resolve, names, network
+from areal_tpu.base import health, logging, name_resolve, names, network, tracing
 from areal_tpu.base.fault_injection import faults
 
 logger = logging.getLogger("worker")
@@ -207,6 +207,12 @@ class Worker:
             # Scope env-armed chaos faults (AREAL_FAULTS "@worker" specs)
             # to this worker before any injection point can be hit.
             faults.set_scope(self.worker_name)
+            # Label this process's RL-trace shard and scope the default
+            # shard dir per experiment/trial (no-op unless
+            # AREAL_RL_TRACE=1).
+            tracing.configure_worker(
+                self.worker_name, self.experiment_name, self.trial_name
+            )
         self._configure(config)
         self._configured = True
         self._running = True
@@ -302,6 +308,7 @@ class Worker:
         finally:
             self._stop_heartbeat()
             self._exit_hook()
+            tracing.flush()
 
     def exit(self):
         self._exiting = True
@@ -343,3 +350,4 @@ class AsyncWorker(Worker):
         finally:
             self._stop_heartbeat()
             self._exit_hook()
+            tracing.flush()

@@ -1,0 +1,530 @@
+"""RL-trace aggregation: merge per-worker span shards into one timeline.
+
+Counterpart of nothing in the reference — realhf has per-worker logs and
+XLA profiles only. This module consumes the JSONL shards written by
+`areal_tpu/base/tracing.py` (one per worker process under
+AREAL_RL_TRACE_DIR) and produces:
+
+- one Chrome-trace/Perfetto JSON: a process track per worker, an X slice
+  per span, and flow events stitching each rollout's spans across
+  processes (plus train-consumption links from every rollout trace into
+  the train-step MFC slice that consumed it);
+- derived reports: a staleness histogram (policy-version lag at
+  consumption), a per-phase latency breakdown (queue-wait / prefill /
+  decode / interrupted-re-prefill / reward / buffer-wait / train), and
+  an overlap score — the fraction of the run's wall span during which a
+  generation track and a training track are simultaneously busy, i.e.
+  the direct evidence for (or against) rollout/train overlap.
+
+Shards record monotonic-ns timestamps plus one (wall, monotonic) anchor
+pair in the header; merging maps every span onto the shared wall clock,
+so cross-worker alignment is as good as host clock sync.
+
+CLI: scripts/merge_rl_trace.py. Span model: docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Span names whose wall time counts as "generation busy" for the overlap
+# score. Engine-level batch spans are preferred (true device busy); the
+# per-request server.generate span is the fallback when the engine was
+# not instrumented (fake servers in harness tests).
+GEN_BUSY_NAMES = ("server.prefill", "server.decode_block")
+GEN_BUSY_FALLBACK = ("server.generate",)
+
+# Friendly phase -> span names feeding it, in report order. queue_wait
+# is the client-side admission span (the manager's allocate/schedule
+# records are zero-duration events — counts, not latencies).
+PHASE_NAMES: List[Tuple[str, Tuple[str, ...]]] = [
+    ("rollout_e2e", ("rollout.episode",)),
+    ("queue_wait", ("rollout.allocate",)),
+    ("generate", ("gen.sample",)),
+    ("gen_chunk", ("gen.chunk",)),
+    ("prefill", ("server.prefill",)),
+    ("decode", ("server.decode_block",)),
+    ("server_generate", ("server.generate",)),
+    ("reward", ("reward.verify",)),
+    ("stream_recv", ("stream.recv",)),
+    ("buffer_wait", ("buffer.wait",)),
+    # Kept separate: the fanout span CONTAINS the per-server spans, so
+    # one merged phase would double-count the same wall interval.
+    ("weight_update_fanout", ("manager.weight_update",)),
+    ("weight_update_server", ("server.weight_update",)),
+    ("train", ()),  # resolved by _is_train below
+]
+
+
+@dataclasses.dataclass
+class Shard:
+    path: str
+    header: Dict[str, Any]
+    spans: List[Dict[str, Any]]
+    n_dropped: int = 0
+    problems: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def worker(self) -> str:
+        return str(self.header.get("worker", os.path.basename(self.path)))
+
+
+_SPAN_REQUIRED = ("name", "trace", "span", "start_ns", "end_ns")
+
+
+def load_shard(path: str) -> Shard:
+    """Parse one shard, collecting (not raising on) well-formedness
+    problems so a single corrupt line doesn't hide the rest."""
+    header: Dict[str, Any] = {}
+    spans: List[Dict[str, Any]] = []
+    problems: List[str] = []
+    n_dropped = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                problems.append(f"{path}:{lineno}: bad json ({e})")
+                continue
+            kind = rec.get("kind")
+            if kind == "header":
+                header = rec
+            elif kind == "dropped":
+                n_dropped += int(rec.get("count", 0))
+            elif kind == "span":
+                missing = [k for k in _SPAN_REQUIRED if k not in rec]
+                if missing:
+                    problems.append(
+                        f"{path}:{lineno}: span missing {missing}"
+                    )
+                    continue
+                if rec["end_ns"] < rec["start_ns"]:
+                    problems.append(
+                        f"{path}:{lineno}: span {rec['span']} ends before "
+                        f"it starts"
+                    )
+                    continue
+                spans.append(rec)
+            else:
+                problems.append(f"{path}:{lineno}: unknown kind {kind!r}")
+    if not header:
+        problems.append(f"{path}: missing header line")
+    return Shard(
+        path=path, header=header, spans=spans, n_dropped=n_dropped,
+        problems=problems,
+    )
+
+
+def load_shards(trace_dir: str) -> List[Shard]:
+    paths = sorted(glob.glob(os.path.join(trace_dir, "*.jsonl")))
+    if not paths:
+        raise FileNotFoundError(f"no trace shards (*.jsonl) under {trace_dir}")
+    return [load_shard(p) for p in paths]
+
+
+WAIVED_PREFIX = "waived (ring overflow recorded): "
+
+
+def validate(shards: List[Shard]) -> List[str]:
+    """Global well-formedness: per-shard parse problems plus dangling
+    span references — a parent id that no span in the SAME trace defines
+    anywhere across the fleet (parents legitimately live in other
+    shards: a buffer.wait span's parent is the rollout worker's episode
+    span).
+
+    When any shard recorded ring-buffer drops, dangling parents are the
+    EXPECTED consequence of the by-design overflow policy, so those
+    findings are prefixed with WAIVED_PREFIX — consumers (the merge
+    script) report them without failing the run. A dangling parent with
+    zero recorded drops is a genuine emitter bug and stays fatal."""
+    problems = [p for s in shards for p in s.problems]
+    overflowed = any(s.n_dropped > 0 for s in shards)
+    by_trace: Dict[str, set] = {}
+    for s in shards:
+        for sp in s.spans:
+            by_trace.setdefault(sp["trace"], set()).add(sp["span"])
+    for s in shards:
+        for sp in s.spans:
+            parent = sp.get("parent")
+            if parent and parent not in by_trace.get(sp["trace"], ()):
+                problems.append(
+                    (WAIVED_PREFIX if overflowed else "")
+                    + f"{s.path}: span {sp['span']} ({sp['name']}) "
+                    f"references dangling parent {parent} in trace "
+                    f"{sp['trace']}"
+                )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Wall-clock mapping + merge
+# ---------------------------------------------------------------------------
+
+
+def _to_wall_us(shard: Shard, mono_ns: int) -> float:
+    h = shard.header
+    wall = int(h.get("anchor_wall_ns", 0))
+    mono = int(h.get("anchor_mono_ns", 0))
+    return (wall + (int(mono_ns) - mono)) / 1e3
+
+
+def _is_train(span: Dict[str, Any]) -> bool:
+    """Any train-step MFC span, master- or worker-side (consumption
+    links: the master-side span carries `consumed_traces`)."""
+    attrs = span.get("attrs") or {}
+    return (
+        span["name"].startswith(("mfc.", "master.mfc."))
+        and attrs.get("itype") == "train_step"
+    )
+
+
+def _is_train_exec(span: Dict[str, Any]) -> bool:
+    """Worker-side train EXECUTION only (`mfc.*`, not `master.mfc.*`):
+    the master span additionally covers dispatch/transport wait and
+    duplicates every worker span's interval, so latency and overlap
+    accounting must not mix the two."""
+    attrs = span.get("attrs") or {}
+    return (
+        span["name"].startswith("mfc.")
+        and attrs.get("itype") == "train_step"
+    )
+
+
+def _flow_id(trace_id: str) -> int:
+    try:
+        return int(str(trace_id)[:12], 16) & 0x7FFFFFFF
+    except ValueError:
+        return abs(hash(trace_id)) & 0x7FFFFFFF
+
+
+def merge_to_chrome(shards: List[Shard]) -> Dict[str, Any]:
+    """One Chrome-trace JSON: process track per worker, X slice per span,
+    `s`/`t` flow steps per trace id (in start order, across processes),
+    and extra flow steps from each consumed rollout trace into the train
+    slice that consumed it (attr `consumed_traces`)."""
+    events: List[Dict[str, Any]] = []
+    # Deterministic pid assignment: sorted worker names.
+    order = sorted(range(len(shards)), key=lambda i: shards[i].worker)
+    located: Dict[str, List[Tuple[float, Dict, int, int]]] = {}
+    for pid, i in enumerate(order):
+        shard = shards[i]
+        events.append(
+            {
+                "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                "args": {"name": shard.worker},
+            }
+        )
+        for sp in shard.spans:
+            ts = _to_wall_us(shard, sp["start_ns"])
+            dur = max(0.001, (sp["end_ns"] - sp["start_ns"]) / 1e3)
+            tid = int(sp.get("tid", 0))
+            args = dict(sp.get("attrs") or {})
+            args["trace_id"] = sp["trace"]
+            args["span_id"] = sp["span"]
+            if sp.get("parent"):
+                args["parent_id"] = sp["parent"]
+            events.append(
+                {
+                    "ph": "X", "pid": pid, "tid": tid, "ts": ts, "dur": dur,
+                    "name": sp["name"], "cat": "rl",
+                    "args": args,
+                }
+            )
+            located.setdefault(sp["trace"], []).append((ts, sp, pid, tid))
+
+    # Consumption links join each consumed rollout's chain as an extra
+    # step ON the train slice BEFORE the chain is emitted — Chrome's
+    # flow contract forbids steps after the finish event, so the train
+    # slice must become part of the ts-ordered chain, not a late `t`.
+    for pid, i in enumerate(order):
+        shard = shards[i]
+        for sp in shard.spans:
+            if not _is_train(sp):
+                continue
+            consumed = (sp.get("attrs") or {}).get("consumed_traces") or []
+            ts = _to_wall_us(shard, sp["start_ns"])
+            tid = int(sp.get("tid", 0))
+            for tr in consumed:
+                if tr in located:
+                    located[tr].append((ts + 0.001, None, pid, tid))
+
+    # Flow events: one chain per trace in step start order (`s` at the
+    # first step, `t` between, `f` at the last).
+    for trace_id, items in located.items():
+        if len(items) < 2:
+            continue
+        items.sort(key=lambda t: t[0])
+        fid = _flow_id(trace_id)
+        for j, (ts, _sp, pid, tid) in enumerate(items):
+            events.append(
+                {
+                    "ph": "s" if j == 0 else ("f" if j == len(items) - 1 else "t"),
+                    "id": fid, "pid": pid, "tid": tid, "ts": ts,
+                    "name": "rollout", "cat": "rl.flow",
+                    **({"bp": "e"} if j == len(items) - 1 else {}),
+                }
+            )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# Derived reports
+# ---------------------------------------------------------------------------
+
+
+def _wall_intervals(
+    shards: List[Shard], names: Tuple[str, ...]
+) -> List[Tuple[float, float]]:
+    out = []
+    for s in shards:
+        for sp in s.spans:
+            if sp["name"] in names:
+                t0 = _to_wall_us(s, sp["start_ns"])
+                out.append((t0, t0 + (sp["end_ns"] - sp["start_ns"]) / 1e3))
+    return out
+
+
+def _union(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    merged = [list(intervals[0])]
+    for s, e in intervals[1:]:
+        if s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return [(s, e) for s, e in merged]
+
+
+def _total(intervals: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _intersect(
+    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
+) -> float:
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_score(shards: List[Shard]) -> Dict[str, float]:
+    """Fraction of the run's wall span where generation and training are
+    simultaneously busy (interval-union per side, so parallel servers /
+    DP workers don't double-count)."""
+    gen_iv = _wall_intervals(shards, GEN_BUSY_NAMES)
+    if not gen_iv:
+        gen_iv = _wall_intervals(shards, GEN_BUSY_FALLBACK)
+
+    def _train_iv(pred):
+        return [
+            (
+                _to_wall_us(s, sp["start_ns"]),
+                _to_wall_us(s, sp["start_ns"])
+                + (sp["end_ns"] - sp["start_ns"]) / 1e3,
+            )
+            for s in shards
+            for sp in s.spans
+            if pred(sp)
+        ]
+
+    # Worker-side execution spans; master-side dispatch spans only as a
+    # fallback when no worker instrumented the run.
+    train_iv = _train_iv(_is_train_exec) or _train_iv(_is_train)
+    gen_u, train_u = _union(gen_iv), _union(train_iv)
+    all_iv = _union(gen_u + train_u)
+    wall = (all_iv[-1][1] - all_iv[0][0]) if all_iv else 0.0
+    both = _intersect(gen_u, train_u)
+    return {
+        "overlap_score": both / wall if wall > 0 else 0.0,
+        "gen_busy_frac": _total(gen_u) / wall if wall > 0 else 0.0,
+        "train_busy_frac": _total(train_u) / wall if wall > 0 else 0.0,
+        "both_busy_s": both / 1e6,
+        "wall_s": wall / 1e6,
+    }
+
+
+def staleness_histogram(shards: List[Shard]) -> Dict[int, int]:
+    """Policy-version lag at consumption: train_step − version_start over
+    buffer.wait spans (generation started `k` published versions before
+    the step that trained on it). Buckets are exact integer lags.
+
+    Multi-MFC graphs record one buffer.wait per consuming MFC; samples
+    are counted ONCE each — by their LAST consumption (the span ending
+    latest), which is the step that exhausted them."""
+    last_per_sample: Dict[str, Tuple[int, int]] = {}  # sid -> (end, lag)
+    for s in shards:
+        for sp in s.spans:
+            if sp["name"] != "buffer.wait":
+                continue
+            attrs = sp.get("attrs") or {}
+            v0 = attrs.get("version_start")
+            step = attrs.get("train_step")
+            if v0 is None or step is None or int(v0) < 0:
+                continue
+            lag = max(0, int(step) - int(v0))
+            sid = str(attrs.get("sample_id") or sp["span"])
+            prev = last_per_sample.get(sid)
+            if prev is None or sp["end_ns"] > prev[0]:
+                last_per_sample[sid] = (sp["end_ns"], lag)
+    hist: Dict[int, int] = {}
+    for _end, lag in last_per_sample.values():
+        hist[lag] = hist.get(lag, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+def phase_latency(shards: List[Shard]) -> Dict[str, Dict[str, float]]:
+    """Per-phase latency stats (ms): count / p50 / p95 / total, keyed by
+    the friendly phase names in PHASE_NAMES, plus the re-prefill cost of
+    interruption (tokens resubmitted for prefill after an interrupt or
+    chunk boundary) as `interrupted_reprefill`."""
+    durs: Dict[str, List[float]] = {}
+    reprefill_tokens = 0.0
+    n_interrupted = 0
+    for s in shards:
+        for sp in s.spans:
+            ms = (sp["end_ns"] - sp["start_ns"]) / 1e6
+            attrs = sp.get("attrs") or {}
+            if sp["name"] == "gen.chunk":
+                reprefill_tokens += float(attrs.get("reprefill_tokens", 0))
+            elif sp["name"] == "gen.interrupted":
+                n_interrupted += 1
+            if _is_train(sp):
+                # Worker-side execution only; the master-side span over
+                # the same step would double-count and fold transport
+                # wait into "train".
+                if _is_train_exec(sp):
+                    durs.setdefault("train", []).append(ms)
+                continue
+            for phase, names in PHASE_NAMES:
+                if sp["name"] in names:
+                    durs.setdefault(phase, []).append(ms)
+                    break
+    out: Dict[str, Dict[str, float]] = {}
+    for phase, _ in PHASE_NAMES:
+        vals = durs.get(phase)
+        if not vals:
+            continue
+        arr = np.asarray(vals)
+        out[phase] = {
+            "count": float(arr.size),
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p95_ms": float(np.percentile(arr, 95)),
+            "total_ms": float(arr.sum()),
+        }
+    out["interrupted_reprefill"] = {
+        "count": float(n_interrupted),
+        "tokens": reprefill_tokens,
+    }
+    return out
+
+
+def rollout_latency_stats(shards: List[Shard]) -> Dict[str, float]:
+    """Rollout end-to-end latency percentiles over rollout.episode spans."""
+    vals = [
+        (sp["end_ns"] - sp["start_ns"]) / 1e6
+        for s in shards
+        for sp in s.spans
+        if sp["name"] == "rollout.episode"
+    ]
+    if not vals:
+        return {}
+    arr = np.asarray(vals)
+    return {
+        "rollout_e2e_p50_ms": float(np.percentile(arr, 50)),
+        "rollout_e2e_p95_ms": float(np.percentile(arr, 95)),
+        "rollout_count": float(arr.size),
+    }
+
+
+def summarize(trace_dir: str) -> Dict[str, Any]:
+    """Everything a perf consumer wants in one dict (master perf_summary
+    / bench JSON): overlap score, staleness histogram, phase breakdown,
+    rollout latency percentiles."""
+    return summarize_shards(load_shards(trace_dir))
+
+
+def summarize_shards(shards: List[Shard]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "n_shards": len(shards),
+        "n_spans": sum(len(s.spans) for s in shards),
+        "n_dropped": sum(s.n_dropped for s in shards),
+    }
+    out.update(overlap_score(shards))
+    out.update(rollout_latency_stats(shards))
+    out["staleness_hist"] = {
+        str(k): v for k, v in staleness_histogram(shards).items()
+    }
+    out["phases"] = phase_latency(shards)
+    reprefill = out["phases"].get("interrupted_reprefill", {})
+    out["reprefill_tokens"] = float(reprefill.get("tokens", 0.0))
+    return out
+
+
+def format_report(shards: List[Shard]) -> str:
+    ov = overlap_score(shards)
+    hist = staleness_histogram(shards)
+    phases = phase_latency(shards)
+    roll = rollout_latency_stats(shards)
+    lines = [
+        f"shards: {len(shards)}   spans: "
+        f"{sum(len(s.spans) for s in shards)}   dropped: "
+        f"{sum(s.n_dropped for s in shards)}",
+        "",
+        f"overlap score: {ov['overlap_score']:.3f}  "
+        f"(gen busy {ov['gen_busy_frac']:.3f}, "
+        f"train busy {ov['train_busy_frac']:.3f}, "
+        f"wall {ov['wall_s']:.2f}s)",
+    ]
+    if roll:
+        lines.append(
+            f"rollout e2e: p50 {roll['rollout_e2e_p50_ms']:.1f} ms  "
+            f"p95 {roll['rollout_e2e_p95_ms']:.1f} ms  "
+            f"(n={int(roll['rollout_count'])})"
+        )
+    lines.append("")
+    lines.append("staleness histogram (train_step - version_start):")
+    if hist:
+        width = max(hist.values())
+        for lag, n in hist.items():
+            bar = "#" * max(1, round(30 * n / width))
+            lines.append(f"  lag {lag:>3}: {n:>6}  {bar}")
+    else:
+        lines.append("  (no buffer.wait spans with version attrs)")
+    lines.append("")
+    lines.append("per-phase latency breakdown:")
+    lines.append(
+        f"  {'phase':<22}{'count':>8}{'p50 ms':>12}{'p95 ms':>12}"
+        f"{'total ms':>12}"
+    )
+    for phase, st in phases.items():
+        if phase == "interrupted_reprefill":
+            continue
+        lines.append(
+            f"  {phase:<22}{int(st['count']):>8}{st['p50_ms']:>12.2f}"
+            f"{st['p95_ms']:>12.2f}{st['total_ms']:>12.1f}"
+        )
+    rp = phases.get("interrupted_reprefill", {})
+    lines.append(
+        f"  interrupted re-prefill: {int(rp.get('count', 0))} interrupt(s), "
+        f"{rp.get('tokens', 0):.0f} tokens resubmitted"
+    )
+    return "\n".join(lines)

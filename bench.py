@@ -126,6 +126,18 @@ def result_json(state: dict, partial: bool = False, error: str = None) -> dict:
     for k in ("packing_efficiency", "h2d_wait_ms", "dispatch_gap_ms"):
         if k in ov:
             out[f"train_{k}"] = round(float(ov[k]), 4)
+    # RL-trace verdict (AREAL_RL_TRACE=1 during an async phase / run in
+    # this process tree): timeline-derived scalars next to the overlap
+    # pipeline series. See docs/observability.md.
+    rl = state.get("rl_trace") or {}
+    for k in (
+        "overlap_score", "rollout_e2e_p50_ms", "rollout_e2e_p95_ms",
+        "reprefill_tokens",
+    ):
+        if k in rl:
+            out[f"rl_{k}"] = round(float(rl[k]), 4)
+    if rl.get("staleness_hist"):
+        out["rl_staleness_hist"] = rl["staleness_hist"]
     if state.get("gen_tps") is not None:
         out["gen_tokens_per_sec_per_chip"] = round(float(state["gen_tps"]), 1)
     if state.get("gen_long_tps") is not None:
@@ -494,10 +506,30 @@ def main():
         _PARTIAL.update(state)
 
     deadline.cancel()
+    state = maybe_collect_rl_trace(state, platform)
     flush_result(state, partial=False)
     # Completed: the next invocation is a fresh round, not a resume.
     clear_state()
     print(json.dumps(result_json(state)))
+
+
+def maybe_collect_rl_trace(state: dict, platform: str) -> dict:
+    """With AREAL_RL_TRACE=1, fold the RL-trace verdict (overlap score,
+    rollout latency, staleness) into the bench JSON — shards come from
+    whatever traced run wrote AREAL_RL_TRACE_DIR (e.g. an async e2e
+    launched alongside the bench)."""
+    from areal_tpu.base import tracing
+
+    if not tracing.enabled():
+        return state
+    try:
+        from areal_tpu.utils import rl_trace
+
+        summary = rl_trace.summarize(tracing.trace_dir())
+    except Exception as e:
+        log(f"bench: rl_trace summary unavailable ({e!r})")
+        return state
+    return save_phase(state, platform, "rl_trace", summary)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+#!/usr/bin/env python3
+"""Merge RL-trace shards into one Perfetto timeline + derived reports.
+
+Usage:
+  python scripts/merge_rl_trace.py <trace_dir> [-o merged.json] [--report]
+  python scripts/merge_rl_trace.py /tmp/areal_tpu/rl_trace -o /tmp/rl.json
+
+<trace_dir> is the AREAL_RL_TRACE_DIR a traced run (AREAL_RL_TRACE=1)
+wrote its per-worker *.jsonl shards into. The merged JSON opens in
+Perfetto (ui.perfetto.dev) or chrome://tracing: one track per worker,
+flow arrows following each rollout across processes into the train step
+that consumed it.
+
+Validation runs first and is strict by default: malformed shard lines,
+spans that end before they start, missing headers, and DANGLING SPAN
+REFERENCES (a parent id no span in the trace defines, in any shard) all
+exit nonzero — a broken emitter fails CI, not a debugging session.
+Use --lenient to emit anyway (problems still print to stderr).
+
+See docs/observability.md for the span model and how to read the
+overlap score / staleness histogram.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# Runnable as `python scripts/merge_rl_trace.py` from anywhere: the repo
+# root may not be on sys.path when invoked by path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.utils import rl_trace  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_dir", help="AREAL_RL_TRACE_DIR with *.jsonl shards")
+    p.add_argument(
+        "-o", "--output", default=None,
+        help="write merged Chrome-trace JSON here (default: "
+        "<trace_dir>/merged_trace.json)",
+    )
+    p.add_argument(
+        "--report", action="store_true",
+        help="print the derived report (staleness histogram, per-phase "
+        "latency, overlap score)",
+    )
+    p.add_argument(
+        "--json-report", action="store_true",
+        help="print the derived report as machine-readable JSON",
+    )
+    p.add_argument(
+        "--lenient", action="store_true",
+        help="emit the merged trace even when validation finds problems",
+    )
+    args = p.parse_args(argv)
+
+    try:
+        shards = rl_trace.load_shards(args.trace_dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    problems = rl_trace.validate(shards)
+    for prob in problems:
+        print(f"VALIDATION: {prob}", file=sys.stderr)
+    # Waived findings (dangling parents explained by recorded ring
+    # overflow) are reported but never fatal — a long healthy run must
+    # not fail CI for dropping its oldest spans by design.
+    fatal = [p for p in problems if not p.startswith(rl_trace.WAIVED_PREFIX)]
+    if fatal and not args.lenient:
+        print(
+            f"{len(fatal)} validation problem(s); refusing to merge "
+            f"(--lenient overrides)",
+            file=sys.stderr,
+        )
+        return 1
+
+    out_path = args.output or f"{args.trace_dir.rstrip('/')}/merged_trace.json"
+    merged = rl_trace.merge_to_chrome(shards)
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    print(
+        f"merged {sum(len(s.spans) for s in shards)} spans from "
+        f"{len(shards)} shard(s) -> {out_path}",
+        file=sys.stderr,
+    )
+
+    if args.json_report:
+        print(json.dumps(rl_trace.summarize_shards(shards), indent=2))
+    elif args.report:
+        print(rl_trace.format_report(shards))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

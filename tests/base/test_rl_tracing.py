@@ -1,0 +1,352 @@
+"""RL-trace recorder unit tests (ISSUE 3 tentpole).
+
+Pins the two hard contracts:
+
+- DISABLED is a true no-op: span calls cost one branch, no recorder is
+  ever allocated, no shard files appear (the acceptance criterion).
+- ENABLED records parent-linked spans into per-worker JSONL shards that
+  the aggregator merges with intact flow links, and the trace context
+  survives both transports' metadata (request_reply_stream Payload,
+  push/pull JSON).
+"""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.base import tracing
+from areal_tpu.system import push_pull_stream as pps
+from areal_tpu.system import request_reply_stream as rrs
+from areal_tpu.utils import rl_trace
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Tracing ON into a fresh shard dir; restored + reset afterwards."""
+    d = str(tmp_path / "rl_trace")
+    monkeypatch.setenv("AREAL_RL_TRACE", "1")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", d)
+    tracing.reconfigure()
+    tracing.configure_worker("test_worker/0")
+    yield d
+    tracing.reconfigure()
+
+
+@pytest.fixture
+def untraced(tmp_path, monkeypatch):
+    d = str(tmp_path / "rl_trace_off")
+    monkeypatch.setenv("AREAL_RL_TRACE", "0")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", d)
+    tracing.reconfigure()
+    yield d
+    tracing.reconfigure()
+
+
+def _load_spans(trace_dir):
+    spans = []
+    for name in os.listdir(trace_dir):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(trace_dir, name)) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "span":
+                    spans.append(rec)
+    return spans
+
+
+# ---------------------------------------------------------------------------
+# No-op fast path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_true_noop(untraced):
+    with tracing.span("a", attr=1) as ctx:
+        assert ctx is None
+        tracing.event("b")
+        tracing.record_span("c", tracing.now_ns())
+        assert tracing.start_span("d") is None
+        assert tracing.inject() is None
+        assert tracing.current() is None
+    tracing.flush()
+    # The acceptance pin: no recorder allocation, no shard files.
+    assert tracing.recorder() is None
+    assert not os.path.exists(untraced) or not os.listdir(untraced)
+
+
+def test_disabled_inject_into_returns_same_dict(untraced):
+    d = {"x": 1}
+    assert tracing.inject_into(d) is d
+    assert tracing.extract_from({"x": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# Recording + shard format
+# ---------------------------------------------------------------------------
+
+
+def test_nested_spans_share_trace_and_parent_link(traced):
+    with tracing.span("outer") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+    tracing.flush()
+    spans = {s["name"]: s for s in _load_spans(traced)}
+    assert spans["inner"]["trace"] == spans["outer"]["trace"]
+    assert spans["inner"]["parent"] == spans["outer"]["span"]
+    assert spans["outer"]["parent"] is None
+    assert spans["inner"]["start_ns"] >= spans["outer"]["start_ns"]
+
+
+def test_manual_span_and_explicit_record(traced):
+    ms = tracing.start_span("episode", qid="q0")
+    t0 = tracing.now_ns()
+    tracing.record_span("residency", t0, t0 + 1000, ctx=ms.ctx, version_start=3)
+    ms.end(accepted=True)
+    ms.end(accepted=False)  # idempotent: second end is a no-op
+    tracing.flush()
+    spans = {s["name"]: s for s in _load_spans(traced)}
+    assert spans["episode"]["attrs"]["accepted"] is True
+    assert spans["residency"]["parent"] == spans["episode"]["span"]
+    assert spans["residency"]["attrs"]["version_start"] == 3
+    header = [
+        json.loads(line)
+        for line in open(
+            os.path.join(traced, os.listdir(traced)[0])
+        )
+    ][0]
+    assert header["kind"] == "header"
+    assert header["worker"] == "test_worker/0"
+    assert header["anchor_wall_ns"] > 0 and header["anchor_mono_ns"] > 0
+
+
+def test_inject_extract_roundtrip(traced):
+    with tracing.span("root") as ctx:
+        d = tracing.inject_into({"payload": 1})
+        assert d["payload"] == 1
+        got = tracing.extract_from(d)
+        assert got == ctx
+        assert "__rl_trace__" not in d  # extract_from pops the key
+
+
+def test_ring_buffer_overflow_drops_oldest(tmp_path, monkeypatch):
+    d = str(tmp_path / "ring")
+    monkeypatch.setenv("AREAL_RL_TRACE", "1")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", d)
+    monkeypatch.setenv("AREAL_RL_TRACE_RING", "8")
+    tracing.reconfigure()
+    try:
+        # Below the flush batch size but above the ring capacity: the
+        # ring must drop oldest instead of growing.
+        for i in range(20):
+            tracing.event(f"e{i}")
+        rec = tracing.recorder()
+        assert rec is not None
+        tracing.flush()
+        shard = rl_trace.load_shard(
+            os.path.join(d, os.listdir(d)[0])
+        )
+        assert shard.n_dropped > 0
+        assert len(shard.spans) <= 8
+    finally:
+        tracing.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# Transport metadata propagation
+# ---------------------------------------------------------------------------
+
+
+def test_request_reply_stream_propagates_ctx(
+    traced, tmp_name_resolve, experiment_context
+):
+    exp, trial = experiment_context
+    master = rrs.make_master_stream(exp, trial)
+    worker = rrs.make_worker_stream(exp, trial, "model_worker/0")
+    try:
+        with tracing.span("master.step") as ctx:
+            [rid] = master.request(["model_worker/0"], "mfc", [{"x": 1}])
+        req = worker.poll(block=True, timeout_ms=5000)
+        got = tracing.extract(req.trace_ctx)
+        assert got is not None
+        assert got.trace_id == ctx.trace_id
+        assert got.span_id == ctx.span_id
+        worker.reply_to(req, data=None)
+        master.poll(rid, block=True, timeout=10)
+    finally:
+        master.close()
+        worker.close()
+
+
+def test_push_pull_stream_propagates_and_strips_ctx(traced):
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port)
+    try:
+        with tracing.span("episode") as ctx:
+            pusher.push({"ids": ["a"], "v": 2})
+        got = puller.pull(timeout_ms=5000)
+        # Payload intact, reserved key stripped, ctx surfaced.
+        assert got == {"ids": ["a"], "v": 2}
+        assert puller.last_trace_ctx is not None
+        assert puller.last_trace_ctx.trace_id == ctx.trace_id
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_push_pull_disabled_has_no_ctx(untraced):
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port)
+    try:
+        pusher.push({"k": 1})
+        got = puller.pull(timeout_ms=5000)
+        assert got == {"k": 1}
+        assert puller.last_trace_ctx is None
+    finally:
+        pusher.close()
+        puller.close()
+
+
+# ---------------------------------------------------------------------------
+# Aggregation + validation
+# ---------------------------------------------------------------------------
+
+
+def test_validate_catches_dangling_parent(tmp_path):
+    shard_path = tmp_path / "w0.1.jsonl"
+    shard_path.write_text(
+        "\n".join(
+            [
+                json.dumps(
+                    {
+                        "kind": "header", "worker": "w0", "pid": 1,
+                        "anchor_wall_ns": 10**18, "anchor_mono_ns": 10**9,
+                    }
+                ),
+                json.dumps(
+                    {
+                        "kind": "span", "name": "orphan", "trace": "t1",
+                        "span": "s1", "parent": "NO_SUCH_SPAN",
+                        "start_ns": 10**9, "end_ns": 10**9 + 100,
+                    }
+                ),
+            ]
+        )
+        + "\n"
+    )
+    shards = rl_trace.load_shards(str(tmp_path))
+    problems = rl_trace.validate(shards)
+    assert any("dangling parent" in p for p in problems)
+
+
+def test_dangling_parent_waived_when_ring_overflowed(tmp_path):
+    """A shard that RECORDED ring-buffer drops may legitimately have
+    dangling parents (the oldest spans were dropped by design): validate
+    marks them waived and the merge script exits 0."""
+    import subprocess
+    import sys
+
+    shard_path = tmp_path / "w0.1.jsonl"
+    shard_path.write_text(
+        "\n".join(
+            [
+                json.dumps(
+                    {
+                        "kind": "header", "worker": "w0", "pid": 1,
+                        "anchor_wall_ns": 10**18, "anchor_mono_ns": 10**9,
+                    }
+                ),
+                json.dumps({"kind": "dropped", "count": 5}),
+                json.dumps(
+                    {
+                        "kind": "span", "name": "orphan", "trace": "t1",
+                        "span": "s1", "parent": "DROPPED_SPAN",
+                        "start_ns": 10**9, "end_ns": 10**9 + 100,
+                    }
+                ),
+            ]
+        )
+        + "\n"
+    )
+    shards = rl_trace.load_shards(str(tmp_path))
+    problems = rl_trace.validate(shards)
+    assert problems and all(
+        p.startswith(rl_trace.WAIVED_PREFIX) for p in problems
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/merge_rl_trace.py", str(tmp_path)],
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_merge_script_exits_nonzero_on_dangling_ref(tmp_path):
+    import subprocess
+    import sys
+
+    shard_path = tmp_path / "w0.1.jsonl"
+    shard_path.write_text(
+        json.dumps(
+            {
+                "kind": "span", "name": "x", "trace": "t", "span": "s",
+                "parent": "missing", "start_ns": 1, "end_ns": 2,
+            }
+        )
+        + "\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "scripts/merge_rl_trace.py", str(tmp_path)],
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 1
+    assert "dangling parent" in r.stderr
+
+
+def test_merge_and_reports_end_to_end(traced):
+    # A miniature rollout timeline recorded in-process: episode ->
+    # chunk -> buffer residency -> train step consuming the trace.
+    ep = tracing.start_span("rollout.episode", qid="q0")
+    with tracing.use_ctx(ep.ctx):
+        with tracing.span("gen.chunk", server="s0", reprefill_tokens=12):
+            pass
+        tracing.event("gen.interrupted", qid="q0")
+    t0 = tracing.now_ns()
+    tracing.record_span(
+        "buffer.wait", t0, t0 + 5_000_000, ctx=ep.ctx,
+        version_start=1, version_end=2, train_step=3, rpc="actor_train",
+    )
+    ep.end(accepted=True)
+    with tracing.span(
+        "master.mfc.actor_train", itype="train_step",
+        consumed_traces=[ep.ctx.trace_id],
+    ):
+        pass
+    tracing.flush()
+
+    shards = rl_trace.load_shards(traced)
+    assert rl_trace.validate(shards) == []
+    merged = rl_trace.merge_to_chrome(shards)
+    events = merged["traceEvents"]
+    slices = [e for e in events if e.get("ph") == "X"]
+    flows = [e for e in events if e.get("ph") in ("s", "t", "f")]
+    assert {e["name"] for e in slices} >= {
+        "rollout.episode", "gen.chunk", "buffer.wait", "master.mfc.actor_train",
+    }
+    assert flows, "expected flow events stitching the rollout trace"
+    # Derived reports.
+    hist = rl_trace.staleness_histogram(shards)
+    assert hist == {2: 1}  # train_step 3 - version_start 1
+    phases = rl_trace.phase_latency(shards)
+    assert phases["interrupted_reprefill"]["tokens"] == 12
+    assert phases["buffer_wait"]["count"] == 1
+    summary = rl_trace.summarize(traced)
+    assert "overlap_score" in summary
+    report = rl_trace.format_report(shards)
+    assert "staleness histogram" in report
+    assert "overlap score" in report

@@ -52,6 +52,31 @@ import uuid
 
 import pytest
 
+# One env knob scales every CPU-contention-sensitive timeout in the
+# suite (tests opt in via tests.fixtures.scale_timeout); the sandboxed
+# python-exec budget above participates too.
+from tests.fixtures import scale_timeout as _scale_timeout
+
+if not os.environ.get("_AREAL_PYEXEC_TIMEOUT_SCALED"):
+    # Sentinel: xdist workers inherit the parent's env, so scaling must
+    # apply exactly once, not compound per worker.
+    os.environ["AREAL_PYEXEC_TIMEOUT"] = str(
+        _scale_timeout(float(os.environ.get("AREAL_PYEXEC_TIMEOUT", "30")))
+    )
+    os.environ["_AREAL_PYEXEC_TIMEOUT_SCALED"] = "1"
+
+
+def pytest_collection_modifyitems(config, items):
+    """Under pytest-xdist, pin every `serial`-marked test onto ONE
+    worker (xdist_group + --dist loadgroup) so the heavyweight e2e runs
+    never stack on top of each other; without xdist the marker is
+    purely documentary."""
+    if not config.pluginmanager.hasplugin("xdist"):
+        return
+    for item in items:
+        if "serial" in item.keywords:
+            item.add_marker(pytest.mark.xdist_group("serial-e2e"))
+
 
 @pytest.fixture
 def tmp_name_resolve(tmp_path):

@@ -5,11 +5,27 @@ random-sentence data, WordPiece trained on it, no downloads)."""
 from __future__ import annotations
 
 import json
+import os
 import random
 import uuid
 from typing import Dict, List
 
 VOCAB_SIZE = 128
+
+
+def scale_timeout(seconds: float) -> float:
+    """Scale a test timeout by AREAL_TEST_TIMEOUT_SCALE (>= 1; default 1).
+
+    One knob for all CPU-contention-sensitive system tests: under a
+    parallel suite run or a loaded CI machine, export
+    AREAL_TEST_TIMEOUT_SCALE=3 instead of hand-tuning per-test margins
+    (VERDICT r5: three e2e tests pass in isolation, time out under
+    3-way parallel load)."""
+    try:
+        scale = float(os.environ.get("AREAL_TEST_TIMEOUT_SCALE", "1") or 1)
+    except ValueError:
+        scale = 1.0
+    return seconds * max(1.0, scale)
 
 
 def random_sentence(rng: random.Random, lo=2, hi=10) -> str:

@@ -19,7 +19,10 @@ from areal_tpu.functioncall.code_verify import (
 # scheduling alone (VERDICT r5: these pass in isolation, fail under
 # load). Generous here — a healthy case finishes in well under a second,
 # so the slack only ever buys deflaking, never hides a real hang.
-T = float(os.environ.get("AREAL_TEST_VERIFY_TIMEOUT", 30.0))
+# AREAL_TEST_TIMEOUT_SCALE stretches it further on loaded CI.
+from tests.fixtures import scale_timeout
+
+T = scale_timeout(float(os.environ.get("AREAL_TEST_VERIFY_TIMEOUT", 30.0)))
 
 STDIN_SOLUTION = """Here is my solution:
 ```python

@@ -33,6 +33,10 @@ from areal_tpu.system.controller import LocalController
 from tests import fixtures
 from tests.system.test_e2e_experiments import TINY_CFG, _mk_tokenizer_files, _worker_env
 
+# Heaviest e2e in the suite: multi-process, compile-bound, and
+# timing-margin sensitive — never co-scheduled with other heavy e2e runs
+# (see the `serial` marker in pytest.ini).
+pytestmark = pytest.mark.serial
 
 N_SEQS = 2
 
@@ -49,11 +53,55 @@ E2E_HEALTH_TTL = os.environ.get("AREAL_TEST_E2E_HEALTH_TTL", "60")
 def _deflaked_env(tmp_path, monkeypatch):
     """Worker env + parent-process env with the load-tolerant TTL (the
     master and LocalController supervisor run in-process, so the parent
-    needs it too)."""
+    needs it too). RL-level tracing is armed for every worker AND the
+    in-process master so the run produces a mergeable cross-worker
+    timeline (asserted by _assert_rl_trace)."""
+    from areal_tpu.base import tracing
+
     monkeypatch.setenv("AREAL_HEALTH_TTL", E2E_HEALTH_TTL)
+    trace_dir = str(tmp_path / "rl_trace")
+    monkeypatch.setenv("AREAL_RL_TRACE", "1")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", trace_dir)
+    tracing.reconfigure()
     env = _worker_env(tmp_path)
     env["AREAL_HEALTH_TTL"] = E2E_HEALTH_TTL
+    env["AREAL_RL_TRACE"] = "1"
+    env["AREAL_RL_TRACE_DIR"] = trace_dir
     return env
+
+
+def _assert_rl_trace(tmp_path, result):
+    """The ISSUE 3 acceptance shape: a merged Chrome-trace JSON with one
+    rollout's spans on >= 3 worker tracks connected by flow events, and
+    a derived report with a staleness histogram + overlap score."""
+    from areal_tpu.base import tracing
+    from areal_tpu.utils import rl_trace
+
+    tracing.flush()
+    trace_dir = str(tmp_path / "rl_trace")
+    shards = rl_trace.load_shards(trace_dir)
+    assert rl_trace.validate(shards) == []
+    by_trace = {}
+    for s in shards:
+        for sp in s.spans:
+            by_trace.setdefault(sp["trace"], set()).add(s.worker)
+    assert any(len(w) >= 3 for w in by_trace.values()), (
+        f"no rollout trace spanned 3 worker roles: "
+        f"{ {t: sorted(w) for t, w in by_trace.items() if len(w) > 1} }"
+    )
+    merged = rl_trace.merge_to_chrome(shards)
+    fid_pids = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") in ("s", "t", "f"):
+            fid_pids.setdefault(e["id"], set()).add(e["pid"])
+    assert any(len(p) >= 3 for p in fid_pids.values()), (
+        "no flow chain crossed 3 process tracks"
+    )
+    report = rl_trace.format_report(shards)
+    assert "staleness histogram" in report and "overlap score" in report
+    # The master folded the same verdict into its perf summary.
+    rl = result["perf_summary"].get("rl_trace") or {}
+    assert "overlap_score" in rl
 
 
 def _trainer_parts(exp, trial, tok_dir):
@@ -201,8 +249,16 @@ def test_async_ppo_e2e(tmp_path, monkeypatch, agent_abs, gen_extra):
         },
         worker_env=_deflaked_env(tmp_path, monkeypatch),
     )
-    result = ctl.run()
-    assert result["global_step"] == 2
+    try:
+        result = ctl.run()
+        assert result["global_step"] == 2
+        _assert_rl_trace(tmp_path, result)
+    finally:
+        # Un-cache process-global tracing state on EVERY exit path —
+        # monkeypatch restores the env but not tracing's cached flag.
+        from areal_tpu.base import tracing
+
+        tracing.reconfigure()
 
 
 @pytest.mark.slow
@@ -294,8 +350,14 @@ def test_async_ppo_e2e_multi_server(tmp_path, monkeypatch, capfd):
         },
         worker_env=_deflaked_env(tmp_path, monkeypatch),
     )
-    result = ctl.run()
-    assert result["global_step"] == 2
+    try:
+        result = ctl.run()
+        assert result["global_step"] == 2
+        _assert_rl_trace(tmp_path, result)
+    finally:
+        from areal_tpu.base import tracing
+
+        tracing.reconfigure()
     # Worker subprocesses share these fds. The manager logs "all servers
     # updated to weight version N" only after EVERY server confirmed the
     # update (it raises on any failure), so one line proves the fanout

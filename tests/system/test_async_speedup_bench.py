@@ -11,6 +11,9 @@ import pytest
 
 from scripts.async_speedup_bench import main as bench_main
 
+# Runs BOTH experiment shapes back to back: the single heaviest test.
+pytestmark = pytest.mark.serial
+
 
 @pytest.mark.slow
 def test_tiny_speedup_bench_e2e(tmp_path):

@@ -29,6 +29,7 @@ from areal_tpu.system.gserver_manager import GserverManager
 from areal_tpu.system.partial_rollout import PartialRolloutManager
 from areal_tpu.system.push_pull_stream import ZMQJsonPuller, ZMQJsonPusher
 from areal_tpu.system.rollout_worker import RolloutWorker
+from tests import fixtures
 
 pytestmark = pytest.mark.chaos
 
@@ -139,6 +140,7 @@ class FakeGenServer:
 
 
 def _wait_until(cond, timeout=10.0, interval=0.05, msg="condition"):
+    timeout = fixtures.scale_timeout(timeout)
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if cond():
@@ -555,3 +557,70 @@ def test_allocate_window_failure_releases_quota_slot(chaos_env):
     _wait_until(lambda: m.rollout_stat.running == 0, msg="quota release")
     assert m.rollout_stat.submitted == 0
     m.exit()
+
+
+# ----------------------------------------------------------------------
+# RL-trace emitter well-formedness under failover (ISSUE 3 CI satellite)
+# ----------------------------------------------------------------------
+
+
+def test_rl_trace_emitters_wellformed_under_failover(
+    chaos_env, tmp_path, monkeypatch
+):
+    """Tier-1 canary for the RL-trace emitters on their hardest path: a
+    server killed mid-rollout forces the retry/failover emitters
+    (gen.chunk resubmission, manager.schedule with failure report) to
+    fire, and the resulting shards must still validate — a malformed
+    emitter fails here, not in a debugging session."""
+    from areal_tpu.base import tracing
+    from areal_tpu.utils import rl_trace
+
+    monkeypatch.setenv("AREAL_RL_TRACE", "1")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", str(tmp_path / "rl_trace"))
+    tracing.reconfigure()
+    env = chaos_env
+    exp, trial = env["exp"], env["trial"]
+    try:
+        servers = [FakeGenServer(exp, trial, i) for i in range(2)]
+        env["cleanup"].extend(s.close for s in servers)
+        for s in servers:
+            name_resolve.add_subentry(names.gen_servers(exp, trial), s.address)
+        m = _start_manager(env, n_servers=2)
+        victim, _ = sorted(servers, key=lambda s: s.address)
+        faults.arm(
+            f"fake{victim.idx}.generate", action="raise", at_hit=1,
+            on_trigger=victim.kill,
+        )
+
+        puller = ZMQJsonPuller(host="127.0.0.1")
+        env["cleanup"].append(puller.close)
+        w = _mk_rollout_worker(env, m.address, puller.port)
+        asyncio.run(_drive_episodes(w, 2))
+        _wait_until(lambda: m.rollout_stat.running == 0, msg="quota release")
+        m.exit()
+        tracing.flush()
+
+        shards = rl_trace.load_shards(str(tmp_path / "rl_trace"))
+        assert rl_trace.validate(shards) == [], (
+            "RL-trace emitters produced malformed shards under failover"
+        )
+        names_seen = {sp["name"] for s in shards for sp in s.spans}
+        # The full client-side chain plus the manager's admission/routing
+        # events (everything runs in this process, so one shard).
+        assert {
+            "rollout.allocate", "rollout.episode", "gen.sample",
+            "gen.chunk", "manager.allocate", "manager.schedule",
+        } <= names_seen, names_seen
+        # Episode spans parent correctly under their allocate span.
+        spans = [sp for s in shards for sp in s.spans]
+        by_id = {sp["span"]: sp for sp in spans}
+        for ep in (sp for sp in spans if sp["name"] == "rollout.episode"):
+            assert ep["parent"] in by_id
+            assert by_id[ep["parent"]]["name"] == "rollout.allocate"
+        # The trajectory pushed through ZMQ carried the episode ctx.
+        traj = puller.pull(timeout_ms=5000)
+        sample = data_api.sample_from_json(traj)
+        ctx = (sample.metadata.get("trace_ctx") or [None])[0]
+        assert ctx and ctx.get("trace_id"), sample.metadata
+    finally:
+        tracing.reconfigure()

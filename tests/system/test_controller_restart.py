@@ -14,6 +14,7 @@ from areal_tpu.base import name_resolve
 from areal_tpu.base.health import HealthRegistry
 from areal_tpu.system.controller import LocalController
 from tests.system.chaos_workers import SleeperConfig
+from tests import fixtures
 
 pytestmark = pytest.mark.chaos
 
@@ -21,6 +22,7 @@ SLEEPER = "tests.system.chaos_workers:SleeperWorker"
 
 
 def _wait_until(cond, timeout=20.0, interval=0.1, msg="condition"):
+    timeout = fixtures.scale_timeout(timeout)
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if cond():

@@ -14,6 +14,9 @@ import uuid
 import numpy as np
 import pytest
 
+# Two-process TP e2e with a 600s ceiling: keep it off shared workers.
+pytestmark = pytest.mark.serial
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CHILD = '''
@@ -68,7 +71,9 @@ def test_generation_server_tensor_parallel(tmp_path):
     )
     try:
         name_resolve.reconfigure("nfs", record_root=nr)
-        deadline = time.monotonic() + 240
+        from tests.fixtures import scale_timeout
+
+        deadline = time.monotonic() + scale_timeout(240)
         url = None
         while url is None:
             assert proc.poll() is None, (

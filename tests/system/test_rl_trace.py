@@ -1,0 +1,163 @@
+"""Tier-1 cross-process RL-trace e2e (ISSUE 3 tentpole + CI satellite).
+
+Three real OS processes play three worker roles (rollout worker ->
+generation server -> trainer), propagating one rollout's trace context
+through files the way the system threads it through transport metadata.
+The parent then merges the shards and asserts the acceptance shape: one
+trace's spans on >= 3 worker tracks, parent/flow links intact, and the
+derived report producing a staleness histogram and an overlap score.
+
+The merge SCRIPT runs here too (exit-0 smoke + report), so a malformed
+emitter or a broken validator fails tier-1, not a debugging session.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from areal_tpu.utils import rl_trace
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Each role script reads/writes small JSON handoff files, mimicking the
+# transport-metadata propagation (inject -> send -> extract -> child
+# span) across real process boundaries with real per-process shards.
+ROLLOUT_ROLE = """
+import json, os, sys, time
+from areal_tpu.base import tracing
+tracing.configure_worker("rollout_worker/0")
+ep = tracing.start_span("rollout.episode", qid="q0")
+tracing.set_current(ep.ctx)
+with tracing.span("gen.chunk", server="s0", reprefill_tokens=7):
+    time.sleep(0.02)
+with open(sys.argv[1], "w") as f:
+    json.dump({"ctx": tracing.inject(), "trace": ep.ctx.trace_id}, f)
+time.sleep(0.03)
+ep.end(accepted=True)
+tracing.flush()
+"""
+
+SERVER_ROLE = """
+import json, sys, time
+from areal_tpu.base import tracing
+tracing.configure_worker("generation_server/0")
+with open(sys.argv[1]) as f:
+    handoff = json.load(f)
+ctx = tracing.extract(handoff["ctx"])
+with tracing.span("server.generate", ctx=ctx, qid="q0", n_tokens=8):
+    time.sleep(0.05)
+t0 = tracing.now_ns()
+time.sleep(0.02)
+tracing.record_span("server.decode_block", t0, n_running=1)
+tracing.flush()
+"""
+
+TRAINER_ROLE = """
+import json, sys, time
+from areal_tpu.base import tracing
+tracing.configure_worker("model_worker/0")
+with open(sys.argv[1]) as f:
+    handoff = json.load(f)
+ctx = tracing.extract(handoff["ctx"])
+t0 = tracing.now_ns()
+time.sleep(0.02)
+tracing.record_span(
+    "buffer.wait", t0, ctx=ctx, rpc="actor_train",
+    version_start=1, version_end=1, train_step=4,
+)
+with tracing.span(
+    "mfc.actor_train", itype="train_step",
+    consumed_traces=[handoff["trace"]],
+):
+    time.sleep(0.05)
+tracing.flush()
+"""
+
+
+def _run_role(script, handoff, trace_dir):
+    env = dict(os.environ)
+    env["AREAL_RL_TRACE"] = "1"
+    env["AREAL_RL_TRACE_DIR"] = trace_dir
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # Keep the child interpreters light: no jax, no sitecustomize device
+    # init beyond what the env forces.
+    r = subprocess.run(
+        [sys.executable, "-c", script, handoff],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, f"role failed:\n{r.stdout}\n{r.stderr}"
+
+
+def test_three_roles_merge_with_flow_links(tmp_path):
+    trace_dir = str(tmp_path / "rl_trace")
+    handoff = str(tmp_path / "handoff.json")
+    _run_role(ROLLOUT_ROLE, handoff, trace_dir)
+    _run_role(SERVER_ROLE, handoff, trace_dir)
+    _run_role(TRAINER_ROLE, handoff, trace_dir)
+
+    shards = rl_trace.load_shards(trace_dir)
+    assert len(shards) == 3
+    assert rl_trace.validate(shards) == []
+
+    # One rollout's spans across >= 3 worker roles, with intact parents.
+    by_trace = {}
+    for s in shards:
+        for sp in s.spans:
+            by_trace.setdefault(sp["trace"], set()).add(s.worker)
+    rollout_traces = [t for t, w in by_trace.items() if len(w) >= 3]
+    assert rollout_traces, f"no trace spanned 3 roles: {by_trace}"
+
+    merged = rl_trace.merge_to_chrome(shards)
+    events = merged["traceEvents"]
+    procs = {
+        e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert procs == {
+        "rollout_worker/0", "generation_server/0", "model_worker/0"
+    }
+    # Flow events stitch the rollout across >= 3 pids.
+    fid_pids = {}
+    for e in events:
+        if e.get("ph") in ("s", "t", "f"):
+            fid_pids.setdefault(e["id"], set()).add(e["pid"])
+    assert any(len(p) >= 3 for p in fid_pids.values()), fid_pids
+
+    # Derived reports: staleness histogram (4 - 1 = 3) + overlap score
+    # (server busy and train busy overlap was arranged by the sleeps).
+    assert rl_trace.staleness_histogram(shards) == {3: 1}
+    ov = rl_trace.overlap_score(shards)
+    assert ov["wall_s"] > 0
+    assert ov["gen_busy_frac"] > 0 and ov["train_busy_frac"] > 0
+    report = rl_trace.format_report(shards)
+    assert "staleness histogram" in report and "overlap score" in report
+    phases = rl_trace.phase_latency(shards)
+    assert phases["interrupted_reprefill"]["tokens"] == 7
+
+
+def test_merge_script_smoke(tmp_path):
+    """The CI wiring: the script validates, merges, and reports with exit
+    code 0 on a well-formed shard set."""
+    trace_dir = str(tmp_path / "rl_trace")
+    handoff = str(tmp_path / "handoff.json")
+    _run_role(ROLLOUT_ROLE, handoff, trace_dir)
+    _run_role(TRAINER_ROLE, handoff, trace_dir)
+
+    out_json = str(tmp_path / "merged.json")
+    r = subprocess.run(
+        [
+            sys.executable, "scripts/merge_rl_trace.py", trace_dir,
+            "-o", out_json, "--report",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "overlap score" in r.stdout
+    assert "staleness histogram" in r.stdout
+    with open(out_json) as f:
+        merged = json.load(f)
+    assert any(e.get("ph") == "X" for e in merged["traceEvents"])
