@@ -164,6 +164,12 @@ class GenerationServerConfig:
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1
+    # Pre-compile the serving programs (prefill bucket + decode block,
+    # ServingEngine.warm) BEFORE the server registers for discovery:
+    # the first real rollout request then never eats a multi-second XLA
+    # compile. Costs startup latency; pays off whenever a persistent
+    # compilation cache is configured.
+    warm_on_start: bool = False
     seed: int = 1
 
     @property

@@ -1,0 +1,18 @@
+"""Shared helpers for the bench package."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def repo_root() -> str:
+    """Absolute path of the repository root (this file lives at
+    <root>/areal_tpu/bench/_util.py — keep the depth in sync if the
+    package ever moves)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

@@ -1,0 +1,310 @@
+"""Opportunistic scheduler: spend every tunnel window on the most
+valuable unbanked phase that fits it.
+
+The daemon polls device availability with exponential backoff (each
+probe is its own subprocess so a *wedged* probe — the 03:18 failure
+mode — costs a timeout, not the daemon). Failures are classified
+(:mod:`areal_tpu.bench.devices`): tunnel-down keeps polling, a
+driver/version error aborts the daemon immediately — no amount of
+waiting fixes a jaxlib mismatch.
+
+The moment a window opens it dispatches, in priority order, the first
+phase action that fits the *observed* window length:
+
+- a phase whose compile record is banked but measure is not runs its
+  measure pass (cache-warm, cheap);
+- a phase with no compile record runs its compile pass first — banked
+  as ``compile``, so even a window too short to measure anything still
+  moves the round forward;
+- estimates come from the phase registry; the observed window estimate
+  is the median of recently completed up-windows (first window: the
+  ``AREAL_BENCH_WINDOW_HINT_S`` optimistic default).
+
+Every dispatch goes through :mod:`areal_tpu.bench.runner`, so a phase
+that wedges mid-window is killed at its deadline and the daemon goes
+back to polling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.bench import bank, phases, runner
+from areal_tpu.bench._util import log, repo_root
+from areal_tpu.bench.devices import classify_device_error
+
+
+@dataclasses.dataclass
+class ProbeResult:
+    status: str  # "up" | "tunnel" | "driver" | "wedged"
+    platform: Optional[str] = None
+    n_devices: int = 0
+    device_kind: Optional[str] = None
+    detail: str = ""
+
+
+_PROBE_SNIPPET = """\
+import json, sys
+from areal_tpu.utils.jaxenv import apply_jax_platform_override
+apply_jax_platform_override()
+try:
+    import jax
+    devs = jax.devices()
+    print(json.dumps({
+        "ok": True, "platform": devs[0].platform, "n": len(devs),
+        "kind": getattr(devs[0], "device_kind", None),
+    }))
+except Exception as e:
+    print(json.dumps({"ok": False, "error": repr(e)}))
+"""
+
+
+def probe_devices(timeout_s: float = 60.0) -> ProbeResult:
+    """Ask a throwaway subprocess what `jax.devices()` says right now.
+    A probe that neither answers nor dies within `timeout_s` is reported
+    as 'wedged' (half-up tunnels hang device init indefinitely — that
+    must never hang the daemon)."""
+    repo = repo_root()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SNIPPET], env=env, cwd=repo,
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return ProbeResult("wedged", detail=f"probe exceeded {timeout_s:.0f}s")
+    try:
+        payload = json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        # The snippet never reached its print — a native abort (SIGABRT
+        # in the PJRT plugin, import-time jaxlib mismatch) looks exactly
+        # like this. Classify the captured output before defaulting to
+        # tunnel, or a version skew polls for the whole runtime budget.
+        text = (out.stderr or "") + (out.stdout or "")
+        kind = classify_device_error(text)
+        return ProbeResult(
+            "driver" if kind == "driver" else "tunnel",
+            detail=f"probe rc={out.returncode}: {text[-500:]}",
+        )
+    if payload.get("ok"):
+        return ProbeResult(
+            "up", platform=payload["platform"], n_devices=payload["n"],
+            device_kind=payload.get("kind"),
+        )
+    kind = classify_device_error(payload.get("error", ""))
+    return ProbeResult(
+        "driver" if kind == "driver" else "tunnel",
+        detail=payload.get("error", ""),
+    )
+
+
+class BenchDaemon:
+    """Poll-classify-dispatch loop. All timing/IO seams are injectable
+    so the scheduling policy is unit-testable without devices."""
+
+    def __init__(
+        self,
+        bank_path: Optional[str] = None,
+        phase_list: Optional[List[phases.PhaseSpec]] = None,
+        probe_fn: Callable[[], ProbeResult] = None,
+        dispatch_fn: Callable[[str, str, str], Dict] = None,
+        poll_interval_s: Optional[float] = None,
+        max_poll_interval_s: float = 120.0,
+        window_hint_s: Optional[float] = None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self.bank_path = bank.bank_dir(bank_path)
+        self.phase_list = (
+            phase_list if phase_list is not None else phases.default_phases()
+        )
+        self.probe_fn = probe_fn or probe_devices
+        self.dispatch_fn = dispatch_fn or (
+            lambda name, pass_, b: runner.run_phase(name, pass_, bank_path=b)
+        )
+        self.poll_interval_s = (
+            poll_interval_s
+            if poll_interval_s is not None
+            else float(os.environ.get("AREAL_BENCH_POLL_S", 10.0))
+        )
+        self.max_poll_interval_s = max_poll_interval_s
+        self.window_hint_s = (
+            window_hint_s
+            if window_hint_s is not None
+            else float(os.environ.get("AREAL_BENCH_WINDOW_HINT_S", 90.0))
+        )
+        self.clock = clock
+        self.sleep = sleep
+        # Completed up-window durations, most recent last.
+        self.window_history: List[float] = []
+        self._window_opened_at: Optional[float] = None
+        # In-memory failure counts per (phase, pass): a deterministically
+        # crashing phase must not eat every window the tunnel offers.
+        self.max_attempts = int(os.environ.get("AREAL_BENCH_MAX_ATTEMPTS", 3))
+        self._attempts: Dict[Tuple[str, str], int] = {}
+
+    # -- window accounting ---------------------------------------------
+
+    def window_estimate_s(self) -> float:
+        """Median of recently completed up-windows — floored by the AGE
+        of the current window: a device that has already stayed up
+        longer than the historical estimate is evidently in a longer
+        window, so min_window-gated phases must not livelock on stale
+        history."""
+        if not self.window_history:
+            est = self.window_hint_s
+        else:
+            est = statistics.median(self.window_history[-5:])
+        if self._window_opened_at is not None:
+            est = max(est, self.clock() - self._window_opened_at)
+        return est
+
+    def _note_up(self):
+        if self._window_opened_at is None:
+            self._window_opened_at = self.clock()
+
+    def _note_down(self):
+        if self._window_opened_at is not None:
+            self.window_history.append(self.clock() - self._window_opened_at)
+            self._window_opened_at = None
+
+    # -- phase selection -----------------------------------------------
+
+    def pending_actions(self, platform: str) -> List[Tuple[phases.PhaseSpec, str]]:
+        """(spec, pass) pairs still unbanked, priority order. A proxy
+        phase banks on any platform; a driver phase's records only count
+        on the platform the daemon is currently facing."""
+        out = []
+        for spec in self.phase_list:
+            plat = "cpu" if spec.proxy else platform
+            if bank.is_banked(self.bank_path, spec.name, "measure", plat):
+                continue
+            if spec.est_compile_s > 0 and not bank.is_banked(
+                    self.bank_path, spec.name, "compile", plat):
+                action = (spec, "compile")
+            else:
+                action = (spec, "measure")
+            if self._attempts.get((spec.name, action[1]), 0) \
+                    >= self.max_attempts:
+                continue
+            out.append(action)
+        return out
+
+    def _all_measured(self, platform: str) -> bool:
+        return all(
+            bank.is_banked(self.bank_path, s.name, "measure",
+                           "cpu" if s.proxy else platform)
+            for s in self.phase_list
+        )
+
+    def select_action(
+        self, platform: str
+    ) -> Optional[Tuple[phases.PhaseSpec, str]]:
+        """Highest-priority pending action whose estimated cost fits the
+        observed window; if nothing fits, the cheapest pending action —
+        trying beats idling inside an open window."""
+        pending = self.pending_actions(platform)
+        if not pending:
+            return None
+        if platform == "cpu":
+            return pending[0]  # no tunnel to flap: just go in order
+        window = self.window_estimate_s()
+        # min_window is a hard gate: dispatching a measure pass into a
+        # window known to be too short burns an attempt for nothing.
+        eligible = [
+            (spec, pass_) for spec, pass_ in pending
+            if not (pass_ == "measure" and spec.min_window_s > window)
+        ]
+        if not eligible:
+            # Wait: window_estimate_s grows with the current window's
+            # age, so a genuinely long window unlocks these eventually.
+            return None
+        for spec, pass_ in eligible:
+            if spec.cost(pass_) <= window:
+                return spec, pass_
+        return min(eligible, key=lambda sp: sp[0].cost(sp[1]))
+
+    # -- main loop ------------------------------------------------------
+
+    def step(self) -> str:
+        """One poll-or-dispatch iteration. Returns the daemon state:
+        'complete' | 'gave_up' | 'driver_error' | 'dispatched' |
+        'waiting' (up, but every eligible action is window-gated) |
+        'down'."""
+        probe = self.probe_fn()
+        if probe.status == "driver":
+            self._note_down()
+            log(f"bench-daemon: driver/version error, aborting: "
+                f"{probe.detail[:300]}")
+            return "driver_error"
+        if probe.status in ("tunnel", "wedged"):
+            self._note_down()
+            return "down"
+        self._note_up()
+        action = self.select_action(probe.platform)
+        if action is None:
+            if self._all_measured(probe.platform):
+                return "complete"
+            if self.pending_actions(probe.platform):
+                # Work remains but every eligible action is min_window-
+                # gated: hold on — the estimate grows with this window's
+                # age, so a long window unlocks them without burning an
+                # attempt.
+                return "waiting"
+            # Pending work exists but every action exhausted its attempt
+            # budget: that is giving up, not completing — the caller must
+            # not publish (or clear) this round as done.
+            log("bench-daemon: unbanked phases exhausted "
+                f"{self.max_attempts} attempts; giving up")
+            return "gave_up"
+        spec, pass_ = action
+        log(f"bench-daemon: window open (est {self.window_estimate_s():.0f}s) "
+            f"-> {spec.name}/{pass_} (est {spec.cost(pass_):.0f}s)")
+        rec = self.dispatch_fn(spec.name, pass_, self.bank_path)
+        log(f"bench-daemon: {spec.name}/{pass_} -> {rec['status']}")
+        if rec["status"] != "ok":
+            key = (spec.name, pass_)
+            self._attempts[key] = self._attempts.get(key, 0) + 1
+            # Mid-phase device loss closes the window for estimation
+            # purposes; a plain phase bug should not.
+            tail = (rec.get("tail") or "") + (rec.get("error") or "")
+            if rec["status"] == "timeout" or \
+                    classify_device_error(tail) == "tunnel":
+                self._note_down()
+        return "dispatched"
+
+    def run(self, max_runtime_s: Optional[float] = None) -> str:
+        """Loop until every phase is banked, a driver error aborts, or
+        the runtime budget expires. Returns the final state."""
+        deadline = (
+            self.clock() + max_runtime_s if max_runtime_s is not None else None
+        )
+        delay = self.poll_interval_s
+        while True:
+            state = self.step()
+            if state in ("complete", "gave_up", "driver_error"):
+                return state
+            # Budget check on EVERY non-terminal state — a dispatch can
+            # burn a whole phase deadline, and repeated dispatches must
+            # not overrun the caller's budget unchecked.
+            if deadline is not None and self.clock() >= deadline:
+                return "budget_exhausted"
+            if state == "dispatched":
+                delay = self.poll_interval_s  # device was just up: stay hot
+                continue
+            if state == "waiting":
+                # Up but window-gated: re-check at the base cadence (no
+                # backoff — the estimate grows as this window ages).
+                delay = self.poll_interval_s
+                self.sleep(delay)
+                continue
+            self.sleep(delay)
+            delay = min(delay * 2, self.max_poll_interval_s)

@@ -1,0 +1,128 @@
+"""Device acquisition for a flaky accelerator tunnel.
+
+Two failure families look identical at `jax.devices()` but demand
+opposite reactions:
+
+- **tunnel-down** (UNAVAILABLE, connection refused/reset, deadline
+  exceeded, device busy): the hardware is fine, the path to it flaps.
+  Poll with backoff until the wall-clock budget is spent — a window may
+  open any second.
+- **driver/version** (jaxlib mismatch, incompatible libtpu,
+  INVALID_ARGUMENT, plugin not found): retrying replays the same
+  failure forever. Abort fast and surface the error — 9 hours of
+  watcher probes against a version skew bank nothing.
+
+`get_devices_with_retry` replaces the old bench retry loop that treated
+both identically with a fixed attempt count.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, List, Optional
+
+from areal_tpu.bench._util import log
+
+
+# Matched against the lowered stringified exception. Driver markers are
+# checked FIRST: they are the more specific diagnosis, and several
+# driver failures also contain generic "failed to initialize" text.
+DRIVER_MARKERS = (
+    "version mismatch",
+    "incompatible",
+    "invalid_argument",
+    "jaxlib is version",
+    "libtpu version",
+    "plugin not found",
+    "no tpu library",
+    "permission denied",
+)
+TUNNEL_MARKERS = (
+    "unavailable",
+    "connection refused",
+    "connection reset",
+    "connect",
+    "tunnel",
+    "socket",
+    "deadline exceeded",
+    "timed out",
+    "device or resource busy",
+    "already in use",
+    "backend setup/compile error",
+    "unable to initialize backend",
+)
+
+
+def classify_device_error(err) -> str:
+    """'driver' (abort fast), 'tunnel' (poll/backoff), or 'unknown'
+    (treated like tunnel, but the caller may cap retries)."""
+    text = str(err).lower()
+    if any(m in text for m in DRIVER_MARKERS):
+        return "driver"
+    if any(m in text for m in TUNNEL_MARKERS):
+        return "tunnel"
+    return "unknown"
+
+
+class DriverError(RuntimeError):
+    """A device failure classified as non-transient: do not retry."""
+
+
+def get_devices_with_retry(
+    budget_s: Optional[float] = None,
+    backoff_s: Optional[float] = None,
+    max_backoff_s: float = 60.0,
+    devices_fn: Optional[Callable[[], List]] = None,
+    sleep=time.sleep,
+    clock=time.monotonic,
+):
+    """`jax.devices()` under a total wall-clock budget.
+
+    Tunnel-class failures poll with exponential backoff until the budget
+    is spent (each retry clears cached backends so the next attempt
+    re-dials instead of replaying the cached failure); driver-class
+    failures raise :class:`DriverError` immediately. Raises the last
+    tunnel error once the budget runs out.
+
+    `devices_fn`/`sleep`/`clock` are injectable for tests."""
+    if budget_s is None:
+        budget_s = float(os.environ.get("AREAL_BENCH_DEVICE_BUDGET_S", 300.0))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("AREAL_BENCH_INIT_BACKOFF_S", 5.0))
+
+    if devices_fn is None:
+        import jax
+
+        devices_fn = jax.devices
+    deadline = clock() + budget_s
+    delay = backoff_s
+    attempt = 0
+    last = None
+    while True:
+        attempt += 1
+        try:
+            return devices_fn()
+        except Exception as e:
+            kind = classify_device_error(e)
+            if kind == "driver":
+                raise DriverError(
+                    f"device init failed with a driver/version error "
+                    f"(not retrying): {e!r}"
+                ) from e
+            last = e
+            remaining = deadline - clock()
+            log(f"bench: device init failed ({kind}, attempt {attempt}, "
+                f"{remaining:.0f}s budget left): {e!r}")
+            if remaining <= 0:
+                break
+            try:
+                import jax
+
+                jax.clear_backends()
+            except Exception:
+                pass  # older jax / partial init: retry cold
+            sleep(min(delay, max(remaining, 0.0)))
+            delay = min(delay * 2, max_backoff_s)
+    raise last

@@ -1,0 +1,196 @@
+"""Phase registry: what the bench can measure, what each phase costs.
+
+A phase is the unit of banking. Each one declares:
+
+- ``priority``       lower runs first — headline evidence (train
+                     TFLOP/s, gen tok/s) outranks secondary probes, so
+                     a short flap window is spent on what the round is
+                     actually gated on
+- ``est_compile_s``  estimated on-chip cost of the *compile pass*:
+                     trace + XLA-compile every program the phase needs,
+                     populating the persistent compilation cache. Banked
+                     as a ``compile`` record — a later window never
+                     re-pays it.
+- ``est_measure_s``  estimated on-chip cost of the *measure pass*
+                     (warm re-compile from cache + timed steady state)
+- ``min_window_s``   the smallest window in which the measure pass can
+                     still produce a steady-state number worth banking
+- ``headline``       this phase backs a top-level report number and so
+                     must be driver-verified to count as evidence
+- ``proxy``          CPU/virtual-mesh evidence by construction; the
+                     runner pins its subprocess to JAX_PLATFORMS=cpu
+                     and the report labels it non-driver-verified
+- ``entrypoint``     ``"module:function"``; the function takes the pass
+                     name (``"compile"`` | ``"measure"``) and returns
+                     the record's value dict
+
+Phase bodies live in :mod:`areal_tpu.bench.workloads`; tests register
+their own cheap phases (``AREAL_BENCH_PHASE_MODULES`` makes the runner
+subprocess import them too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import os
+from typing import Callable, Dict, List, Optional
+
+# How far a phase may overrun its estimate before the runner kills it.
+DEADLINE_FACTOR = 3.0
+MIN_DEADLINE_S = 120.0
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseSpec:
+    name: str
+    entrypoint: str
+    priority: int = 100
+    est_compile_s: float = 60.0
+    est_measure_s: float = 60.0
+    min_window_s: float = 30.0
+    headline: bool = False
+    proxy: bool = False
+    # Included in a bare `python bench.py` run (non-default phases run
+    # only when asked for by name or picked up by the daemon).
+    default: bool = True
+    description: str = ""
+
+    def resolve(self) -> Callable[[str], Dict]:
+        mod, _, fn = self.entrypoint.partition(":")
+        return getattr(importlib.import_module(mod), fn)
+
+    def cost(self, pass_: str) -> float:
+        return self.est_compile_s if pass_ == "compile" else self.est_measure_s
+
+    def deadline_s(self, pass_: str) -> float:
+        env = os.environ.get("AREAL_BENCH_PHASE_DEADLINE_S")
+        if env:
+            return float(env)
+        return max(self.cost(pass_) * DEADLINE_FACTOR, MIN_DEADLINE_S)
+
+
+_REGISTRY: Dict[str, PhaseSpec] = {}
+
+
+def register(spec: PhaseSpec) -> PhaseSpec:
+    if spec.name in _REGISTRY:
+        raise ValueError(f"phase {spec.name!r} already registered")
+    _REGISTRY[spec.name] = spec
+    return spec
+
+
+def get(name: str) -> PhaseSpec:
+    load_extra_modules()
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown phase {name!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name]
+
+
+def all_phases() -> List[PhaseSpec]:
+    """Every registered phase, priority order (ties by name)."""
+    load_extra_modules()
+    return sorted(_REGISTRY.values(), key=lambda s: (s.priority, s.name))
+
+
+def default_phases() -> List[PhaseSpec]:
+    return [s for s in all_phases() if s.default]
+
+
+_EXTRA_LOADED: Optional[str] = None
+
+
+def load_extra_modules(spec: Optional[str] = None) -> None:
+    """Import extra phase modules (comma-separated module names from
+    AREAL_BENCH_PHASE_MODULES). The runner child calls this too, so a
+    phase registered by a test exists in the subprocess that executes
+    it."""
+    global _EXTRA_LOADED
+    if spec is None:
+        spec = os.environ.get("AREAL_BENCH_PHASE_MODULES", "")
+    if spec == _EXTRA_LOADED:
+        return
+    _EXTRA_LOADED = spec
+    for mod in filter(None, (m.strip() for m in spec.split(","))):
+        importlib.import_module(mod)
+
+
+# ----------------------------------------------------------------------
+# Built-in phases. On-chip estimates come from the banked rounds: r2's
+# cold train warmup was ~13.5s/step with multi-minute XLA compiles on a
+# tunneled device, and the one lost r5 window died inside a compile that
+# a persistent cache would have made free.
+# ----------------------------------------------------------------------
+
+register(PhaseSpec(
+    name="train_tflops",
+    entrypoint="areal_tpu.bench.workloads:train_phase",
+    priority=0,
+    est_compile_s=180.0,
+    est_measure_s=45.0,
+    min_window_s=25.0,
+    headline=True,
+    description="Full train step (fwd+bwd+sharded optimizer) TFLOP/s per "
+                "chip on the flagship packed-varlen model",
+))
+
+register(PhaseSpec(
+    name="gen_tps",
+    entrypoint="areal_tpu.bench.workloads:gen_phase",
+    priority=1,
+    est_compile_s=120.0,
+    est_measure_s=60.0,
+    min_window_s=40.0,
+    headline=True,
+    description="ServingEngine sustained output tok/s/chip, 32x512+512",
+))
+
+register(PhaseSpec(
+    name="gen_long_tps",
+    entrypoint="areal_tpu.bench.workloads:gen_long_phase",
+    priority=2,
+    est_compile_s=120.0,
+    est_measure_s=420.0,
+    min_window_s=180.0,
+    description="Long-form serving: 8 requests x 8192 new tokens through "
+                "chunked prefill + the paged pool",
+))
+
+register(PhaseSpec(
+    name="serving_http",
+    entrypoint="areal_tpu.bench.workloads:serving_http_phase",
+    priority=3,
+    est_compile_s=120.0,
+    est_measure_s=90.0,
+    min_window_s=60.0,
+    default=False,
+    description="System-layer serving: GenerationServer worker behind "
+                "HTTP (the SGLang-contract path the RL system drives)",
+))
+
+register(PhaseSpec(
+    name="pack_density",
+    entrypoint="areal_tpu.bench.workloads:pack_density_phase",
+    priority=10,
+    est_compile_s=0.0,  # host-only: nothing to compile, no compile pass
+    est_measure_s=20.0,
+    min_window_s=0.0,
+    proxy=True,
+    description="FFD packing density on realistic length mixes "
+                "(host-side; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
+    name="prefetch_overlap",
+    entrypoint="areal_tpu.bench.workloads:prefetch_overlap_phase",
+    priority=11,
+    est_compile_s=30.0,
+    est_measure_s=40.0,
+    min_window_s=0.0,
+    proxy=True,
+    description="Input-pipeline overlap telemetry (packing_efficiency / "
+                "h2d_wait / dispatch_gap) on the virtual-mesh engine "
+                "(CPU-proxy evidence)",
+))

@@ -1,0 +1,217 @@
+"""Report assembly: a BENCH_rNN-style JSON from the bank.
+
+The report (``areal-bench-report/v1``) has three evidence strata, kept
+apart by construction:
+
+- ``headline``     driver-verified claims (train TFLOP/s, gen tok/s).
+                   A headline entry whose backing record is NOT
+                   driver-verified is stamped ``"evidence": "proxy"``
+                   and forces top-level ``driver_verified: false`` —
+                   CPU smoke numbers can flow through the same pipe
+                   but can never masquerade as chip results.
+- ``phases``       the full banked records (measure + compile), each
+                   with its attestation block.
+- ``proxy``        CPU/virtual-mesh evidence: proxy phase records plus
+                   the 8-device dryrun passthrough from the newest
+                   MULTICHIP json, all labeled non-driver-verified.
+
+The top-level ``metric/value/unit/vs_baseline`` keys keep the driver
+contract the previous rounds' BENCH artifacts parsed.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+from areal_tpu.bench import bank, phases
+from areal_tpu.bench._util import repo_root
+from areal_tpu.bench.workloads import BASELINE_TFLOPS
+
+HEADLINE_KEYS = {
+    # phase -> (value key inside the record, report key)
+    "train_tflops": ("train_tflops", "train_tflops_per_chip"),
+    "gen_tps": ("gen_tps", "gen_tokens_per_sec_per_chip"),
+    "gen_long_tps": ("gen_long_tps", "gen_long_tokens_per_sec_per_chip"),
+    "serving_http": ("serving_http_tps", "serving_http_tokens_per_sec"),
+}
+
+
+def find_latest_multichip(repo_root_override: Optional[str] = None) -> Optional[str]:
+    root = repo_root_override or repo_root()
+    paths = sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+    return paths[-1] if paths else None
+
+
+def build_report(
+    bank_path: Optional[str] = None,
+    multichip_path: Optional[str] = None,
+    round_tag: Optional[str] = None,
+) -> Dict:
+    # Freshness gate mirrors is_banked's resume TTL: an ok record left
+    # over from an old interrupted round must never be published as this
+    # round's evidence (it becomes a missing phase -> partial instead).
+    max_age_s = float(os.environ.get("AREAL_BENCH_STATE_TTL_S", 6 * 3600))
+    records = bank.load_bank(bank_path, max_age_s=max_age_s)
+    measures = {p: r for (p, ps), r in records.items() if ps == "measure"}
+    compiles = {p: r for (p, ps), r in records.items() if ps == "compile"}
+
+    report: Dict = {
+        "schema": bank.REPORT_SCHEMA,
+        "generated_at": time.time(),
+        "metric": "train_tflops_per_chip",
+        "value": 0.0,
+        "unit": "TFLOP/s",
+        "vs_baseline": 0.0,
+        "driver_verified": False,
+        "partial": False,
+        "headline": {},
+        "phases": {},
+        "compiled": {},
+        "proxy": {},
+        "errors": {},
+    }
+    if round_tag:
+        report["round"] = round_tag
+
+    spec_by_name = {s.name: s for s in phases.all_phases()}
+    for name, rec in measures.items():
+        spec = spec_by_name.get(name)
+        proxy = bool(spec.proxy) if spec is not None else (
+            not rec["attestation"].get("driver_verified", False)
+        )
+        section = "proxy" if proxy else "phases"
+        report[section][name] = rec
+        if rec["status"] != "ok":
+            report["errors"][name] = rec.get("error")
+            continue
+        if proxy or name not in HEADLINE_KEYS:
+            continue
+        value_key, report_key = HEADLINE_KEYS[name]
+        if value_key not in rec["value"]:
+            continue
+        dv = bool(rec["attestation"].get("driver_verified", False))
+        entry = {
+            "value": round(float(rec["value"][value_key]), 2),
+            "driver_verified": dv,
+        }
+        if not dv:
+            entry["evidence"] = "proxy"
+        report["headline"][report_key] = entry
+    for name, rec in compiles.items():
+        report["compiled"][name] = rec
+
+    # Driver-contract top-level keys from the train record.
+    train = report["headline"].get("train_tflops_per_chip")
+    if train is not None:
+        report["value"] = train["value"]
+        report["vs_baseline"] = round(train["value"] / BASELINE_TFLOPS, 3)
+        report["driver_verified"] = train["driver_verified"]
+    tr = measures.get("train_tflops")
+    if tr is not None and tr["status"] == "ok":
+        for k, v in (tr["value"].get("overlap") or {}).items():
+            report[f"train_{k}"] = round(float(v), 4)
+
+    # Default driver phases that never banked an ok measure -> partial.
+    for spec in phases.default_phases():
+        if spec.proxy:
+            continue
+        rec = measures.get(spec.name)
+        if rec is None or rec["status"] != "ok":
+            report["partial"] = True
+
+    rl = collect_rl_trace()
+    if rl is not None:
+        report["rl_trace"] = rl
+
+    mc = multichip_path or find_latest_multichip()
+    if mc and os.path.exists(mc):
+        try:
+            with open(mc) as f:
+                payload = json.load(f)
+            report["proxy"]["multichip_dryrun"] = {
+                "source": os.path.basename(mc),
+                "driver_verified": False,
+                "evidence": "proxy",
+                "result": payload,
+            }
+        except (OSError, ValueError) as e:
+            report["errors"]["multichip_dryrun"] = repr(e)
+    return report
+
+
+def collect_rl_trace() -> Optional[Dict]:
+    """With AREAL_RL_TRACE=1, fold the RL-trace verdict (overlap score,
+    rollout latency, staleness) into the report — shards come from
+    whatever traced run wrote AREAL_RL_TRACE_DIR (e.g. an async e2e
+    launched alongside the bench). See docs/observability.md."""
+    from areal_tpu.base import tracing
+
+    if not tracing.enabled():
+        return None
+    try:
+        from areal_tpu.utils import rl_trace
+
+        return rl_trace.summarize(tracing.trace_dir())
+    except Exception as e:
+        print(f"bench: rl_trace summary unavailable ({e!r})",
+              file=sys.stderr, flush=True)
+        return None
+
+
+def result_line(report: Dict) -> Dict:
+    """The one-line JSON the bench driver parses — same keys the old
+    monolithic bench printed, derived from the report."""
+    out = {
+        "metric": report["metric"],
+        "value": report["value"],
+        "unit": report["unit"],
+        "vs_baseline": report["vs_baseline"],
+        "driver_verified": report["driver_verified"],
+    }
+    for key in ("gen_tokens_per_sec_per_chip",
+                "gen_long_tokens_per_sec_per_chip"):
+        entry = report["headline"].get(key)
+        if entry is not None:
+            out[key] = round(float(entry["value"]), 1)
+    for k, v in report.items():
+        if k.startswith("train_") and k != "train_tflops_per_chip":
+            out[k] = v
+    rl = report.get("rl_trace") or {}
+    for k in ("overlap_score", "rollout_e2e_p50_ms", "rollout_e2e_p95_ms",
+              "reprefill_tokens"):
+        if k in rl:
+            out[f"rl_{k}"] = round(float(rl[k]), 4)
+    if rl.get("staleness_hist"):
+        out["rl_staleness_hist"] = rl["staleness_hist"]
+    if report.get("partial"):
+        out["partial"] = True
+        # "error" on the one-line contract means the ROUND is impaired
+        # (old bench: deadline/abort only). A lingering non-default
+        # failure or a corrupt MULTICHIP passthrough stays visible in
+        # the full report's errors section without flagging a clean run.
+        if report.get("errors"):
+            out["error"] = "; ".join(
+                f"{k}: {str(v)[:120]}" for k, v in report["errors"].items()
+            )
+    return out
+
+
+def write_report(report: Dict, path: str) -> str:
+    import threading
+
+    # pid AND thread id: the global-deadline timer thread may flush
+    # concurrently with the main thread in the same process — two
+    # writers on one tmp file would os.replace() interleaved JSON over
+    # the round's artifact.
+    tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path

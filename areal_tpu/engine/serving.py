@@ -407,6 +407,58 @@ class ServingEngine:
             self.total_requests += 1
             self._queue.put(req)
 
+    def warm(
+        self,
+        prompt_lens: List[int],
+        max_new_tokens: Optional[int] = None,
+        timeout_s: float = 1800.0,
+    ) -> float:
+        """AOT warm hook: compile every program serving these prompt
+        lengths needs — the bucketed (or chunked) prefill, the jitted
+        decode block, first-token sampling — by running one throwaway
+        greedy request per length through the live loop. Serving has no
+        trainable state, so executing is the honest way to cover the
+        whole dispatch surface; with a persistent compilation cache the
+        XLA work outlives this process (the bench compile pass banks it,
+        production servers use `warm_on_start` to pre-compile before
+        registering for traffic). Returns seconds spent.
+
+        Must be called after start(). Raises on timeout — a warm that
+        cannot finish means the engine cannot serve."""
+        assert self._thread is not None, "warm() requires start()"
+        if max_new_tokens is None:
+            max_new_tokens = 2 * self.block_steps
+        done = threading.Event()
+        got: List[GenResult] = []
+        n = len(prompt_lens)
+
+        def cb(res):
+            got.append(res)
+            if len(got) == n:
+                done.set()
+
+        t0 = time.perf_counter()
+        for i, plen in enumerate(prompt_lens):
+            # Token 1 everywhere: content is irrelevant, shapes compile.
+            self.submit(GenRequest(
+                qid=f"__warm{i}",
+                input_ids=[1] * max(1, int(plen)),
+                max_new_tokens=max_new_tokens,
+                min_new_tokens=max_new_tokens,  # don't let EOS cut the
+                greedy=True,                    # decode block short
+                done_cb=cb,
+            ))
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"serving warm stalled: {len(got)}/{n} within {timeout_s:.0f}s"
+            )
+        errs = [r.error for r in got if r.error]
+        if errs:
+            raise RuntimeError(f"serving warm failed: {errs[0]}")
+        dt = time.perf_counter() - t0
+        logger.info(f"serving warm: {n} request(s), {dt:.1f}s")
+        return dt
+
     def is_stale_update(self, version: Optional[int]) -> bool:
         """True iff update_params(version=version) would drop the update
         as stale. Lets callers skip the (potentially multi-GB) weight

@@ -82,6 +82,11 @@ class GenerationServer(Worker):
             mesh=mesh,
         )
         self.engine.start()
+        if config.warm_on_start:
+            # Compile the serving programs before taking traffic (and
+            # before discovery registration below): one bucket's worth
+            # of prompt + the decode block covers the hot path.
+            self.engine.warm([config.prompt_bucket])
         self._n_interrupted = 0
         self._last_load_info = None
 

@@ -1,105 +1,63 @@
-"""Benchmark: training throughput per chip on the flagship architecture.
+"""Benchmark CLI: thin front-end over areal_tpu/bench/.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Metric: achieved model TFLOP/s per chip for the full training step
 (fwd + bwd + sharded optimizer) on a Qwen2.5-style packed-varlen model in
-bfloat16. FLOPs are computed analytically from the model dims (the
-reference does the same for its TFLOP/s logs — realhf/base/monitor.py:288
-llama formulas, realhf/system/flops_counter.py).
+bfloat16, plus serving tok/s phases. FLOPs are computed analytically from
+the model dims (the reference does the same for its TFLOP/s logs —
+realhf/base/monitor.py:288 llama formulas).
 
 vs_baseline: ratio against 198 TFLOP/s/GPU — the reference's efficiency
 class on its H800 benchmark hardware (~40% MFU of H800 dense bf16
 ~495 TFLOP/s; its headline runs are throughput-bound on exactly this
 train path, benchmark/verl_v0_3_0_post1_76084d3/README.md). >1.0 means a
 chip running this framework outruns an H800 running the reference.
+
+Modes:
+  python bench.py                 one-shot: run every unbanked default
+                                  phase (compile pass, then measure),
+                                  each in its own deadline-guarded
+                                  subprocess; assemble + print the report
+  python bench.py --daemon        opportunistic: poll for a device
+                                  window, spend each one on the highest-
+                                  value unbanked phase that fits it
+  python bench.py --phases a,b    restrict to named phases
+  python bench.py --fresh         drop banked records first (new round)
+
+This process NEVER touches jax itself: device probes and phases run in
+subprocesses, so a wedged tunnel can hang a phase (killed at its
+deadline) but not the bench. Every phase result is flushed atomically to
+the bank the moment it exists — a tunnel drop mid-run loses at most the
+phase in flight, and the next invocation resumes from banked phases.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
 import tempfile
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from areal_tpu.utils.jaxenv import apply_jax_platform_override
 
-apply_jax_platform_override()
+from areal_tpu.bench import bank, phases, report, runner  # noqa: E402
+from areal_tpu.bench.daemon import BenchDaemon, probe_devices  # noqa: E402
 
-BASELINE_TFLOPS = 198.0
-
-
-# ----------------------------------------------------------------------
-# Flap tolerance: persistent XLA compilation cache + per-phase resume.
-# A remote-tunneled TPU run that dies mid-compile (VERDICT r5: one lost
-# tunnel window killed an entire bench) restarts with (a) warm compiled
-# programs and (b) every already-measured phase loaded from disk, so
-# only the interrupted phase re-runs.
-# ----------------------------------------------------------------------
+# Shared with scripts/mfu_sweep.py and scripts/long_context_probe.py so
+# every probe measures the SAME model and formula as the banked numbers.
+from areal_tpu.bench.workloads import (  # noqa: E402,F401
+    BASELINE_TFLOPS,
+    flagship_cfg,
+    train_step_flops,
+)
 
 
-def enable_compilation_cache():
-    """Point JAX's persistent compilation cache at a stable directory
-    (min-compile-time floors dropped so every bench program caches)."""
-    import jax
-
-    cache_dir = os.environ.get(
-        "AREAL_XLA_CACHE_DIR",
-        os.path.join(tempfile.gettempdir(), "areal_xla_cache"),
-    )
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        log(f"bench: persistent compilation cache at {cache_dir}")
-    except Exception as e:  # older jax: cache flags absent — bench still runs
-        log(f"bench: compilation cache unavailable ({e!r})")
-
-
-def init_devices(max_tries: int = None, backoff_s: float = None):
-    """`jax.devices()` with bounded retry + exponential backoff: a TPU
-    tunnel flap at backend init previously killed the whole bench
-    instantly (VERDICT r5: bench must bank numbers inside flap windows).
-    Each retry clears cached backends so the next attempt re-dials the
-    device rather than replaying the cached failure. Raises the last
-    error once the retry budget is spent."""
-    import jax
-
-    if max_tries is None:
-        max_tries = int(os.environ.get("AREAL_BENCH_INIT_RETRIES", 5))
-    if backoff_s is None:
-        backoff_s = float(os.environ.get("AREAL_BENCH_INIT_BACKOFF_S", 15.0))
-    delay = backoff_s
-    last = None
-    for attempt in range(max(1, max_tries)):
-        try:
-            return jax.devices()
-        except Exception as e:  # backend init failed (tunnel down?)
-            last = e
-            log(f"bench: backend init failed (attempt {attempt + 1}/"
-                f"{max_tries}): {e!r}")
-            if attempt + 1 >= max_tries:
-                break
-            try:
-                jax.clear_backends()
-            except Exception:
-                pass  # older jax / partial init: retry cold
-            time.sleep(delay)
-            delay = min(delay * 2, 120.0)
-    raise last
-
-
-def state_path() -> str:
-    return os.environ.get(
-        "AREAL_BENCH_STATE",
-        os.path.join(tempfile.gettempdir(), "areal_bench_state.json"),
-    )
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
 
 
 def bench_json_path() -> str:
@@ -109,347 +67,39 @@ def bench_json_path() -> str:
     )
 
 
-def result_json(state: dict, partial: bool = False, error: str = None) -> dict:
-    """The bench's JSON result assembled from whatever phases completed.
-    Written to bench_json_path() after EVERY phase (a mid-run tunnel drop
-    still banks completed phases on disk) and printed at the end."""
-    train = state.get("train_tflops")
-    out = {
-        "metric": "train_tflops_per_chip",
-        "value": round(train, 2) if train is not None else 0.0,
-        "unit": "TFLOP/s",
-        "vs_baseline": (
-            round(train / BASELINE_TFLOPS, 3) if train is not None else 0.0
-        ),
-    }
-    ov = state.get("train_overlap") or {}
-    for k in ("packing_efficiency", "h2d_wait_ms", "dispatch_gap_ms"):
-        if k in ov:
-            out[f"train_{k}"] = round(float(ov[k]), 4)
-    # RL-trace verdict (AREAL_RL_TRACE=1 during an async phase / run in
-    # this process tree): timeline-derived scalars next to the overlap
-    # pipeline series. See docs/observability.md.
-    rl = state.get("rl_trace") or {}
-    for k in (
-        "overlap_score", "rollout_e2e_p50_ms", "rollout_e2e_p95_ms",
-        "reprefill_tokens",
-    ):
-        if k in rl:
-            out[f"rl_{k}"] = round(float(rl[k]), 4)
-    if rl.get("staleness_hist"):
-        out["rl_staleness_hist"] = rl["staleness_hist"]
-    if state.get("gen_tps") is not None:
-        out["gen_tokens_per_sec_per_chip"] = round(float(state["gen_tps"]), 1)
-    if state.get("gen_long_tps") is not None:
-        out["gen_long_tokens_per_sec_per_chip"] = round(
-            float(state["gen_long_tps"]), 1
-        )
-    if partial:
-        out["partial"] = True
+def flush_report(bank_path: str) -> dict:
+    """Rebuild the report from the bank and persist it — called after
+    EVERY phase so a mid-run tunnel drop still leaves the newest full
+    artifact on disk."""
+    rep = report.build_report(bank_path)
+    report.write_report(rep, bench_json_path())
+    return rep
+
+
+def emit_and_exit(bank_path: str, code: int, error: str = None):
+    rep = flush_report(bank_path)
+    line = report.result_line(rep)
     if error:
-        out["error"] = error
-    return out
+        line["error"] = (line.get("error", "") + "; " + error).strip("; ")
+        line["partial"] = True
+    print(json.dumps(line), flush=True)
+    # os._exit: the deadline path fires on a timer thread while the main
+    # thread may be blocked on a wedged subprocess wait.
+    os._exit(code) if code == 3 else sys.exit(code)
 
 
-def flush_result(state: dict, partial: bool = True):
-    path = bench_json_path()
-    tmp = path + ".tmp"
-    try:
-        with open(tmp, "w") as f:
-            json.dump(result_json(state, partial=partial), f)
-        os.replace(tmp, path)
-    except OSError as e:
-        log(f"bench: result flush failed ({e!r})")
-
-
-def load_state(platform: str, max_age_s: float = None) -> dict:
-    """Previously-measured phase results, if fresh and from the same
-    platform; {} otherwise (stale results from an old round must not be
-    reported as this round's)."""
-    if max_age_s is None:
-        max_age_s = float(os.environ.get("AREAL_BENCH_STATE_TTL_S", 6 * 3600))
-    try:
-        with open(state_path()) as f:
-            st = json.load(f)
-    except (OSError, ValueError):
-        return {}
-    if st.get("platform") != platform:
-        return {}
-    if time.time() - float(st.get("saved_at", 0)) > max_age_s:
-        return {}
-    return st
-
-
-def save_phase(state: dict, platform: str, key: str, value) -> dict:
-    state = dict(state)
-    state[key] = value
-    state["platform"] = platform
-    state["saved_at"] = time.time()
-    path = state_path()
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(state, f)
-    os.replace(tmp, path)
-    return state
-
-
-def clear_state():
-    try:
-        os.remove(state_path())
-    except OSError:
-        pass
-
-
-def flagship_cfg(max_pos: int = 40960, attn_bias: bool = True):
-    """The benchmark model shape: R1-Distill-Qwen-1.5B-class layers
-    (hidden 1536, 12 q / 2 kv heads, head_dim 128, ffn 8960 — the family
-    the reference's headline benchmark trains,
-    benchmark/verl_v0_3_0_post1_76084d3/README.md:38-44), trimmed to 16
-    layers / 32k vocab so params + fp32 Adam moments + activations fit
-    one v5e chip's 16 GB HBM. Shared by bench.py and the perf scripts
-    (mfu_sweep, long_context_probe) so every banked number measures the
-    SAME model."""
-    from areal_tpu.models.config import TransformerConfig
-
-    return TransformerConfig(
-        n_layers=16, hidden_dim=1536, n_q_heads=12, n_kv_heads=2,
-        head_dim=128, intermediate_dim=8960, vocab_size=32768,
-        attn_bias=attn_bias, compute_dtype="bfloat16",
-        param_dtype="bfloat16", max_position_embeddings=max_pos,
-    )
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-def train_step_flops(cfg, n_params: int, seqlens) -> float:
-    """Analytic fwd+bwd FLOPs for a packed batch (llama-formula style:
-    6*N per token for matmuls, plus causal attention score/context terms)."""
-    total = 0.0
-    q_dim = cfg.n_q_heads * cfg.head_dim
-    for l in seqlens:
-        total += 6.0 * n_params * l
-        # QK^T + AV: 2 * (2 * l^2 * q_dim) * 0.5 (causal) per layer, x3 for bwd.
-        total += 6.0 * cfg.n_layers * q_dim * float(l) * l
-    return total
-
-
-def gen_bench(on_tpu: bool, long_form: bool = False) -> float:
-    """Generation throughput on the ServingEngine (paged KV, batched
-    prefill, jitted decode blocks): sustained output tokens/sec/chip at a
-    realistic batch + context. The reference's headline gains are
-    generation-side (async RL is generation-bound, blog/AReaL_v0_3.md:125)
-    but it publishes only relative deltas, so this is reported as an
-    absolute alongside the train metric.
-
-    long_form=True is the 8k-new-tokens-class workload (the reference's
-    headline benchmark generates ~31k tokens/sample): moderate batch,
-    fixed-shape chunked prefill, and sustained long decode through the
-    paged pool — the regime the async design is supposed to win on,
-    which the 512+512 short mode does not speak to."""
-    import threading
-
-    import jax
-
-    from areal_tpu.engine.serving import GenRequest, ServingEngine
-    from areal_tpu.models.config import TransformerConfig
-    from areal_tpu.models.transformer import init_params
-
-    if on_tpu:
-        cfg = flagship_cfg()
-        if long_form:
-            # ~1.2 GB of paged KV at bf16 alongside the 3.5 GB params.
-            n_reqs, plen, max_new, page, block = 8, 1024, 8192, 128, 32
-            chunk = 512
-        else:
-            n_reqs, plen, max_new, page, block = 32, 512, 512, 128, 32
-            chunk = None
-    else:
-        cfg = TransformerConfig(
-            n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
-            intermediate_dim=128, vocab_size=256, compute_dtype="float32",
-        )
-        if long_form:
-            n_reqs, plen, max_new, page, block = 2, 32, 64, 8, 4
-            chunk = 16
-        else:
-            n_reqs, plen, max_new, page, block = 2, 16, 8, 8, 4
-            chunk = None
-
-    params = init_params(cfg, jax.random.PRNGKey(1))
-    eng = ServingEngine(
-        cfg, params,
-        max_batch_size=n_reqs,
-        max_seq_len=plen + max_new + page,
-        decode_block_steps=block,
-        prompt_bucket=page,
-        eos_token_id=None,  # budget-bound: every request emits max_new
-        page_size=page,
-        kv_pool_tokens=n_reqs * (plen + max_new + page),
-        prefill_chunk=chunk,
-    )
-    eng.start()
-    rng = np.random.RandomState(1)
-
-    def run(n, new_tokens, tag):
-        done = threading.Event()
-        got = []
-
-        def cb(res):
-            got.append(len(res.output_ids))
-            if len(got) == n:
-                done.set()
-
-        t0 = time.perf_counter()
-        for i in range(n):
-            eng.submit(GenRequest(
-                qid=f"{tag}{i}",
-                input_ids=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
-                max_new_tokens=new_tokens,
-                done_cb=cb,
-            ))
-        assert done.wait(1800), f"gen bench stalled: {len(got)}/{n}"
-        return sum(got), time.perf_counter() - t0
-
-    # Warmup compiles prefill buckets (or the one chunked program) + the
-    # decode block.
-    _, wdt = run(min(n_reqs, 8), 2 * block, "w")
-    tag = "gen-long" if long_form else "gen"
-    log(f"bench: {tag} warmup {wdt:.2f}s")
-    toks, dt = run(n_reqs, max_new, "g")
-    eng.stop()
-    tps = toks / dt
-    log(f"bench: {tag} {toks} tokens in {dt:.2f}s -> {tps:.0f} tok/s/chip")
-    return tps
-
-
-def train_bench() -> tuple:
-    """Train-throughput phase. Runs in its own frame so every reference to
-    the engine (closures included) dies on return and the ~9 GB of params
-    + Adam moments actually leave HBM before the generation phase."""
-    import jax
-
-    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
-    from areal_tpu.engine.jax_engine import JaxTrainEngine
-    from areal_tpu.engine.optimizer import OptimizerConfig
-    from areal_tpu.models.config import TransformerConfig
-    from areal_tpu.models.transformer import count_params, init_params
-    from areal_tpu.ops.loss import sft_loss_from_logprobs
-
-    devices = init_devices()
-    platform = devices[0].platform
-    on_tpu = platform == "tpu"
-    log(f"bench: platform={platform} n_devices={len(devices)}")
-
-    if on_tpu:
-        # flagship_cfg: params in bf16 with fp32 optimizer moments
-        # (weights stream at half the bytes; update math stays fp32 —
-        # measured +18 TFLOP/s over fp32 params, scripts/perf_probe.py).
-        cfg = flagship_cfg()
-        seqlen, n_seqs, n_warmup, n_steps = 2048, 16, 2, 5
-    else:
-        # CPU smoke mode so dev runs terminate quickly.
-        cfg = TransformerConfig(
-            n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
-            intermediate_dim=128, vocab_size=256, compute_dtype="float32",
-        )
-        seqlen, n_seqs, n_warmup, n_steps = 128, 4, 1, 2
-
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    n_params = count_params(params)
-    log(f"bench: n_params={n_params/1e6:.1f}M")
-
-    eng = JaxTrainEngine(
-        cfg, params,
-        optimizer_config=OptimizerConfig(lr=1e-4, warmup_steps_proportion=0.0),
-        total_train_steps=1000, row_len_multiple=seqlen, max_row_len=seqlen,
-        # save_attn: keep the flash kernel's residuals, recompute the rest
-        # in backward — the best single-chip throughput/memory point for
-        # this model size (see scripts/perf_probe.py measurements).
-        remat="save_attn" if on_tpu else "full",
-    )
-
-    rng = np.random.RandomState(0)
-    seqlens = [seqlen] * n_seqs
-    total = sum(seqlens)
-    batch = SequenceSample.from_default(
-        ids=[f"b{i}" for i in range(n_seqs)],
-        seqlens=seqlens,
-        data={
-            "packed_input_ids": rng.randint(0, cfg.vocab_size, size=total),
-            "loss_mask": np.ones(total, np.float32),
-        },
-    )
-
-    def packed_loss(lp, rows):
-        tot, n = sft_loss_from_logprobs(lp, rows["loss_mask"])
-        return tot, {}
-
-    def weight(mb):
-        return float(np.sum(mb.data["loss_mask"]))
-
-    def one_step(i):
-        return eng.train_batch(batch, MicroBatchSpec(n_mbs=1), packed_loss, weight,
-                               version_steps=i, loss_name="bench")
-
-    for i in range(n_warmup):
-        t = time.perf_counter()
-        one_step(i)
-        log(f"bench: warmup step {i} {time.perf_counter() - t:.2f}s")
-
-    # Drain warmup-recorded pipeline stats so the exported overlap
-    # telemetry below covers ONLY the timed steps.
-    from areal_tpu.base import stats_tracker
-
-    stats_tracker.export(key="perf")
-
-    t0 = time.perf_counter()
-    for i in range(n_steps):
-        one_step(n_warmup + i)
-    jax.block_until_ready(eng.params)
-    dt = (time.perf_counter() - t0) / n_steps
-
-    flops = train_step_flops(cfg, n_params, seqlens)
-    tflops = flops / dt / 1e12
-    tokens_per_sec = total / dt
-    log(f"bench: {dt:.3f}s/step {tokens_per_sec:.0f} tok/s {tflops:.1f} TFLOP/s")
-    # Input-pipeline health of the timed loop (jax_engine overlap
-    # telemetry): packing density of what shipped to HBM + how much of
-    # each step the host was blocked packing/transferring.
-    perf = stats_tracker.export(key="perf")
-    overlap = {
-        k[len("perf/"):]: v for k, v in perf.items()
-        if k in ("perf/packing_efficiency", "perf/h2d_wait_ms",
-                 "perf/dispatch_gap_ms")
-    }
-    log(f"bench: overlap telemetry {overlap}")
-
-    return tflops, on_tpu, overlap
-
-
-# Phases completed so far, mirrored for the deadline handler: a gen-phase
-# hang must not discard an already-measured train number.
-_PARTIAL = {}
-
-
-def _arm_deadline(seconds: float):
-    """If the result line hasn't printed by the deadline, emit an honest
-    JSON (with whatever phases DID complete) and hard-exit. A wedged
-    device tunnel otherwise hangs the whole bench at jax.devices() with
-    NOTHING recorded for the round."""
+def _arm_deadline(bank_path: str, seconds: float):
+    """Emit an honest JSON (with whatever phases DID bank) and hard-exit
+    if the run overstays its welcome. The bank already holds every
+    completed phase, so this handler just reads disk — no mirrored
+    module state (the old bench kept a _PARTIAL global in sync by hand;
+    the atomic per-phase bank made that hack unnecessary)."""
     import threading
 
     def fire():
-        log(f"bench: deadline {seconds:.0f}s exceeded; device/tunnel stuck")
-        phase = "train" if _PARTIAL.get("train_tflops") is None else "generation"
-        out = result_json(
-            _PARTIAL, partial=True,
-            error=f"bench deadline {seconds:.0f}s exceeded in the "
-                  f"{phase} phase",
-        )
-        print(json.dumps(out), flush=True)
-        os._exit(3)
+        log(f"bench: deadline {seconds:.0f}s exceeded")
+        emit_and_exit(bank_path, 3,
+                      error=f"bench deadline {seconds:.0f}s exceeded")
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
@@ -457,80 +107,128 @@ def _arm_deadline(seconds: float):
     return t
 
 
-def main():
-    deadline = _arm_deadline(float(os.environ.get("AREAL_BENCH_DEADLINE_S", 2700)))
-    enable_compilation_cache()
-    import gc
+def wait_for_platform(budget_s: float) -> str:
+    """Probe (in subprocesses) until a backend answers; returns the
+    platform. Tunnel-class failures poll with backoff inside the budget;
+    a driver/version error aborts immediately — retrying replays it."""
+    deadline = time.monotonic() + budget_s
+    delay = float(os.environ.get("AREAL_BENCH_INIT_BACKOFF_S", 5.0))
+    while True:
+        # Each probe gets at most the REMAINING budget (floor 10s so a
+        # probe can at least import jax): a wedged probe must not push
+        # the total wait past the wall-clock budget.
+        remaining = deadline - time.monotonic()
+        p = probe_devices(timeout_s=min(120.0, max(remaining, 10.0)))
+        if p.status == "up":
+            log(f"bench: platform={p.platform} n_devices={p.n_devices}")
+            return p.platform
+        if p.status == "driver":
+            raise RuntimeError(f"driver/version error: {p.detail[:500]}")
+        remaining = deadline - time.monotonic()
+        log(f"bench: devices unavailable ({p.status}), "
+            f"{remaining:.0f}s budget left: {p.detail[:200]}")
+        if remaining <= 0:
+            raise TimeoutError(
+                f"no device within {budget_s:.0f}s ({p.status})"
+            )
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2, 60.0)
 
-    devices = init_devices()
-    platform = devices[0].platform
-    on_tpu = platform == "tpu"
-    state = load_state(platform)
-    _PARTIAL.update(state)
 
-    if state.get("train_tflops") is not None:
-        tflops = float(state["train_tflops"])
-        log(f"bench: resuming train phase from checkpoint "
-            f"({tflops:.1f} TFLOP/s)")
+def run_oneshot(phase_list, bank_path: str, platform: str) -> bool:
+    """Compile-then-measure every unbanked phase, priority order. Returns
+    True if every phase banked an ok measure record."""
+    ok = True
+    for spec in phase_list:
+        plat = "cpu" if spec.proxy else platform
+        if bank.is_banked(bank_path, spec.name, "measure", plat):
+            log(f"bench: {spec.name} already banked; skipping")
+            continue
+        if spec.est_compile_s > 0 and not bank.is_banked(
+                bank_path, spec.name, "compile", plat):
+            rec = runner.run_phase(spec.name, "compile", bank_path)
+            flush_report(bank_path)
+            if rec["status"] != "ok":
+                ok = False
+                continue  # no point measuring what cannot compile
+        rec = runner.run_phase(spec.name, "measure", bank_path)
+        flush_report(bank_path)
+        ok = ok and rec["status"] == "ok"
+    return ok
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--daemon", action="store_true",
+                        help="opportunistic mode: poll for device windows")
+    parser.add_argument("--phases", default=None,
+                        help="comma-separated phase names (default: the "
+                             "registry's default set)")
+    parser.add_argument("--bank", default=None, help="bank directory")
+    parser.add_argument("--fresh", action="store_true",
+                        help="clear banked records first (new round)")
+    parser.add_argument("--max-runtime-s", type=float, default=None,
+                        help="daemon runtime budget")
+    parser.add_argument("--list-phases", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_phases:
+        for s in phases.all_phases():
+            print(f"{s.priority:3d} {s.name:18s} compile~{s.est_compile_s:.0f}s "
+                  f"measure~{s.est_measure_s:.0f}s "
+                  f"{'proxy ' if s.proxy else ''}"
+                  f"{'headline ' if s.headline else ''}- {s.description}")
+        return 0
+
+    bank_path = bank.bank_dir(args.bank)
+    if args.fresh:
+        bank.clear_bank(bank_path)
+    if args.phases:
+        phase_list = [phases.get(n.strip())
+                      for n in args.phases.split(",") if n.strip()]
     else:
-        tflops, on_tpu, overlap = train_bench()
-        state = save_phase(state, platform, "train_tflops", tflops)
-        state = save_phase(state, platform, "train_overlap", overlap)
-        _PARTIAL.update(state)
-        flush_result(state)  # bank the phase NOW; a tunnel drop later
-        # in the run must not lose an already-measured number.
+        phase_list = phases.default_phases()
 
-    gc.collect()  # drop the train frame's device buffers before gen
-    if state.get("gen_tps") is not None:
-        gen_tps = float(state["gen_tps"])
-        log(f"bench: resuming gen phase from checkpoint ({gen_tps:.0f} tok/s)")
-    else:
-        gen_tps = gen_bench(on_tpu)
-        state = save_phase(state, platform, "gen_tps", gen_tps)
-        _PARTIAL.update(state)
-        flush_result(state)
-    gc.collect()
-    # Re-arm for the long-form phase: it compiles its own chunked
-    # program and decodes 8x8192 tokens — a healthy run must not be
-    # killed by whatever is left of the first deadline.
-    deadline.cancel()
+    if args.daemon:
+        def dispatch(name, pass_, b):
+            # Flush the report after EVERY banked pass — a daemon killed
+            # mid-round must still leave the newest artifact on disk.
+            rec = runner.run_phase(name, pass_, bank_path=b)
+            flush_report(b)
+            return rec
+
+        d = BenchDaemon(bank_path=bank_path, phase_list=phase_list,
+                        dispatch_fn=dispatch)
+        state = d.run(max_runtime_s=args.max_runtime_s)
+        log(f"bench: daemon finished: {state}")
+        rep = flush_report(bank_path)
+        print(json.dumps(report.result_line(rep)), flush=True)
+        if state == "complete" and not args.phases:
+            bank.clear_bank(bank_path)  # next invocation = fresh round
+        return 0 if state == "complete" else 2
+
     deadline = _arm_deadline(
-        float(os.environ.get("AREAL_BENCH_LONG_DEADLINE_S", 1200))
+        bank_path, float(os.environ.get("AREAL_BENCH_DEADLINE_S", 2700))
     )
-    if state.get("gen_long_tps") is not None:
-        log(f"bench: resuming gen-long phase from checkpoint "
-            f"({float(state['gen_long_tps']):.0f} tok/s)")
-    else:
-        gen_long_tps = gen_bench(on_tpu, long_form=True)
-        state = save_phase(state, platform, "gen_long_tps", gen_long_tps)
-        _PARTIAL.update(state)
-
-    deadline.cancel()
-    state = maybe_collect_rl_trace(state, platform)
-    flush_result(state, partial=False)
-    # Completed: the next invocation is a fresh round, not a resume.
-    clear_state()
-    print(json.dumps(result_json(state)))
-
-
-def maybe_collect_rl_trace(state: dict, platform: str) -> dict:
-    """With AREAL_RL_TRACE=1, fold the RL-trace verdict (overlap score,
-    rollout latency, staleness) into the bench JSON — shards come from
-    whatever traced run wrote AREAL_RL_TRACE_DIR (e.g. an async e2e
-    launched alongside the bench)."""
-    from areal_tpu.base import tracing
-
-    if not tracing.enabled():
-        return state
     try:
-        from areal_tpu.utils import rl_trace
-
-        summary = rl_trace.summarize(tracing.trace_dir())
-    except Exception as e:
-        log(f"bench: rl_trace summary unavailable ({e!r})")
-        return state
-    return save_phase(state, platform, "rl_trace", summary)
+        platform = wait_for_platform(
+            float(os.environ.get("AREAL_BENCH_DEVICE_BUDGET_S", 300.0))
+        )
+    except (RuntimeError, TimeoutError) as e:
+        log(f"bench: {e}")
+        emit_and_exit(bank_path, 2, error=str(e))
+    complete = run_oneshot(phase_list, bank_path, platform)
+    deadline.cancel()
+    rep = flush_report(bank_path)
+    print(json.dumps(report.result_line(rep)), flush=True)
+    if complete and not args.phases:
+        # The report file is the artifact; the bank is resume state for
+        # THIS round only — a completed round must not leak into the
+        # next. A --phases-restricted run keeps its records: a later
+        # full run resumes from them.
+        bank.clear_bank(bank_path)
+    return 0 if complete else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Assemble a BENCH_rNN-style JSON from the benchmark bank.
+
+    python scripts/bench_report.py --bank /tmp/areal_bench_bank \
+        --out BENCH_r06.json [--multichip MULTICHIP_r06.json] [--round r06]
+
+Merges every banked phase record (with its attestation block) plus the
+CPU/virtual-mesh proxy evidence — pack density, prefetch overlap, the
+8-device dryrun passthrough from the newest MULTICHIP json — explicitly
+labeled non-driver-verified. Run scripts/validate_bench.py on the output
+before publishing it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.bench import report  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--bank", default=None, help="bank directory "
+                        "(default: $AREAL_BENCH_BANK)")
+    parser.add_argument("--out", default=None,
+                        help="write the report here (default: stdout only)")
+    parser.add_argument("--multichip", default=None,
+                        help="MULTICHIP json to fold in as proxy evidence "
+                             "(default: newest MULTICHIP_r*.json in repo)")
+    parser.add_argument("--round", dest="round_tag", default=None)
+    parser.add_argument("--line", action="store_true",
+                        help="print the one-line driver JSON instead of "
+                             "the full report")
+    args = parser.parse_args(argv)
+
+    rep = report.build_report(
+        bank_path=args.bank, multichip_path=args.multichip,
+        round_tag=args.round_tag,
+    )
+    if args.out:
+        report.write_report(rep, args.out)
+        print(f"wrote {args.out} ({len(rep['phases'])} driver phase(s), "
+              f"{len(rep['proxy'])} proxy record(s), "
+              f"driver_verified={rep['driver_verified']})", file=sys.stderr)
+    print(json.dumps(report.result_line(rep) if args.line else rep,
+                     indent=None if args.line else 1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Schema + attestation validator for bench evidence.
+
+    python scripts/validate_bench.py BENCH_r06.json
+    python scripts/validate_bench.py --bank /tmp/areal_bench_bank
+    python scripts/validate_bench.py --require-driver-verified BENCH_r06.json
+
+Nonzero exit when:
+- any record is malformed (schema tag, pass/status enums, missing or
+  inconsistent attestation block — e.g. ``driver_verified: true`` on a
+  non-TPU platform);
+- a headline number is presented WITHOUT ``driver_verified: true`` and
+  without the explicit ``"evidence": "proxy"`` label (the round-6
+  mandate: chip numbers and CPU smoke numbers must never be conflated);
+- the report claims top-level ``driver_verified: true`` that its own
+  records do not back;
+- with ``--require-driver-verified``: any headline entry is not
+  driver-verified at all (the gate for publishing a BENCH round as chip
+  evidence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.bench import bank  # noqa: E402
+
+
+def validate_report(rep: Dict, require_driver: bool = False) -> List[str]:
+    problems: List[str] = []
+    if rep.get("schema") != bank.REPORT_SCHEMA:
+        problems.append(
+            f"report schema != {bank.REPORT_SCHEMA!r}: {rep.get('schema')!r}"
+        )
+        return problems
+
+    # Keyed per section: a phase's compile record must never shadow (or
+    # be shadowed by) its measure record — the driver_verified backing
+    # check below must see the MEASURE evidence, nothing else.
+    measures = {}
+    for section in ("phases", "compiled", "proxy"):
+        for name, rec in (rep.get(section) or {}).items():
+            if name == "multichip_dryrun":
+                if rec.get("driver_verified") is not False:
+                    problems.append(
+                        "multichip_dryrun passthrough must be labeled "
+                        "driver_verified: false"
+                    )
+                continue
+            try:
+                bank.validate_record(rec)
+            except ValueError as e:
+                problems.append(f"{section}/{name}: {e}")
+                continue
+            if section == "phases":
+                measures[name] = rec
+            if section == "proxy" and rec["attestation"].get("driver_verified"):
+                problems.append(
+                    f"proxy/{name}: proxy evidence cannot be driver_verified"
+                )
+
+    headline = rep.get("headline") or {}
+    any_unverified_headline = False
+    for key, entry in headline.items():
+        dv = entry.get("driver_verified")
+        if not isinstance(dv, bool):
+            problems.append(f"headline/{key}: missing driver_verified bool")
+            continue
+        if not dv:
+            any_unverified_headline = True
+            if entry.get("evidence") != "proxy":
+                problems.append(
+                    f"headline/{key}: number lacks driver_verified: true and "
+                    f"is not labeled evidence: proxy — refusing to conflate"
+                )
+        if require_driver and not dv:
+            problems.append(
+                f"headline/{key}: --require-driver-verified set but the "
+                f"number is not driver-verified"
+            )
+
+    if rep.get("driver_verified") and any_unverified_headline:
+        problems.append(
+            "report claims driver_verified: true but carries non-verified "
+            "headline numbers"
+        )
+    if rep.get("driver_verified"):
+        tr = measures.get("train_tflops")
+        if tr is None or not tr["attestation"].get("driver_verified"):
+            problems.append(
+                "report claims driver_verified: true but the train_tflops "
+                "record does not back it"
+            )
+    return problems
+
+
+def validate_bank_dir(path: str) -> List[str]:
+    problems: List[str] = []
+    seen = 0
+    try:
+        names = sorted(os.listdir(path))
+    except OSError as e:
+        return [f"cannot read bank dir {path!r}: {e}"]
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        seen += 1
+        full = os.path.join(path, name)
+        try:
+            with open(full) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{name}: unreadable ({e})")
+            continue
+        try:
+            bank.validate_record(rec)
+        except ValueError as e:
+            problems.append(f"{name}: {e}")
+    if seen == 0:
+        problems.append(f"bank dir {path!r} holds no records")
+    return problems
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("report", nargs="?", default=None,
+                        help="report JSON to validate")
+    parser.add_argument("--bank", default=None,
+                        help="validate every record in a bank directory")
+    parser.add_argument("--require-driver-verified", action="store_true")
+    args = parser.parse_args(argv)
+    if (args.report is None) == (args.bank is None):
+        parser.error("pass exactly one of a report path or --bank")
+
+    if args.bank:
+        problems = validate_bank_dir(args.bank)
+        target = args.bank
+    else:
+        try:
+            with open(args.report) as f:
+                rep = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"INVALID {args.report}: unreadable ({e})", file=sys.stderr)
+            return 1
+        problems = validate_report(
+            rep, require_driver=args.require_driver_verified
+        )
+        target = args.report
+
+    if problems:
+        for p in problems:
+            print(f"INVALID {target}: {p}", file=sys.stderr)
+        return 1
+    print(f"OK {target}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

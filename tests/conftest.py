@@ -19,6 +19,12 @@ os.environ["XLA_FLAGS"] = (
 # (interpreter spawn alone can take seconds on a busy machine); the
 # runaway-program test passes its own tight timeout explicitly.
 os.environ.setdefault("AREAL_PYEXEC_TIMEOUT", "30")
+# Same discipline for the math grader's sympy-equivalence subprocess:
+# under full-suite load the forked child's cold sympy import can eat
+# the whole 3s production budget and misjudge legit equivalences
+# (test_sympy_equivalence flaked exactly this way). The adversarial
+# hang test still bounds total wall clock at 30s.
+os.environ.setdefault("AREAL_SYMPY_TIMEOUT_S", "10")
 
 import jax
 

@@ -81,6 +81,8 @@ def test_setup_host_group_single_host_noop():
     assert (info.process_id, info.num_processes) == (0, 1)
 
 
+@pytest.mark.slow  # ~45s two-process SPMD run; kept out of the tier-1
+# budget (and env-sensitive: needs shard_map-era jax)
 @pytest.mark.timeout(900)
 def test_multihost_sft_end_to_end(tmp_path):
     """training/multihost.py: 2 simulated hosts x 2 devices, d2f2 global

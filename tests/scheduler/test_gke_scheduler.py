@@ -199,6 +199,8 @@ def _sft_mock_cfg(exp, trial, tmp_path, benchmark_steps, recover_mode):
     )
 
 
+@pytest.mark.slow  # ~55s kill-and-relaunch e2e; the fake-kubectl unit
+# coverage above stays in tier-1
 def test_cluster_controller_gke_e2e_failure_then_recovery(kubectl, tmp_path):
     """ClusterController on the gke scheduler: pods run the real worker
     processes; a pod killed mid-run surfaces as a scheduler failure, and

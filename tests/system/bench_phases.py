@@ -1,0 +1,84 @@
+"""Test-only bench phases: cheap, deterministic, registered off the
+default set so the real bench never runs them.
+
+The runner subprocess imports this module through
+``AREAL_BENCH_PHASE_MODULES=tests.system.bench_phases``, so a phase a
+test registers here exists in the child that executes it. Each phase
+body bumps a per-(phase, pass) call counter under
+``AREAL_BENCH_TEST_SCRATCH`` — that is how tests prove a resumed run
+re-executed ONLY the unbanked phases.
+"""
+
+import os
+import time
+
+from areal_tpu.bench import phases
+
+SCRATCH_ENV = "AREAL_BENCH_TEST_SCRATCH"
+
+
+def bump_counter(name: str) -> int:
+    d = os.environ.get(SCRATCH_ENV)
+    if not d:
+        return 0
+    path = os.path.join(d, f"{name}.calls")
+    n = 1
+    if os.path.exists(path):
+        with open(path) as f:
+            n = int(f.read()) + 1
+    with open(path, "w") as f:
+        f.write(str(n))
+    return n
+
+
+def read_counter(scratch: str, name: str) -> int:
+    path = os.path.join(scratch, f"{name}.calls")
+    if not os.path.exists(path):
+        return 0
+    with open(path) as f:
+        return int(f.read())
+
+
+def alpha(pass_: str) -> dict:
+    bump_counter(f"t_alpha.{pass_}")
+    if pass_ == "compile":
+        return {"compile_s": 0.01}
+    return {"alpha_metric": 42.0}
+
+
+def beta(pass_: str) -> dict:
+    bump_counter(f"t_beta.{pass_}")
+    if pass_ == "compile":
+        return {"compile_s": 0.01}
+    return {"beta_metric": 7.0}
+
+
+def broken(pass_: str) -> dict:
+    bump_counter(f"t_broken.{pass_}")
+    raise RuntimeError("this phase always fails (test)")
+
+
+def slow(pass_: str) -> dict:
+    bump_counter(f"t_slow.{pass_}")
+    time.sleep(float(os.environ.get("AREAL_BENCH_TEST_SLOW_S", 3600)))
+    return {"slow_metric": 1.0}
+
+
+def _reg(name, entry, **kw):
+    # Idempotent under repeated pytest imports of this module path.
+    try:
+        phases.get(name)
+        return
+    except KeyError:
+        pass
+    phases.register(phases.PhaseSpec(
+        name=name, entrypoint=f"tests.system.bench_phases:{entry}",
+        default=False, est_compile_s=1.0, est_measure_s=1.0,
+        min_window_s=0.0, **kw,
+    ))
+
+
+_reg("t_alpha", "alpha", priority=90)
+_reg("t_beta", "beta", priority=91)
+_reg("t_broken", "broken", priority=92)
+_reg("t_slow", "slow", priority=93)

@@ -1,0 +1,229 @@
+"""Opportunistic scheduler policy + device-failure classification, all
+with injected probes/clocks — no devices, no subprocesses."""
+
+import pytest
+
+from areal_tpu.bench import bank
+from areal_tpu.bench.daemon import BenchDaemon, ProbeResult
+from areal_tpu.bench.devices import (
+    DriverError,
+    classify_device_error,
+    get_devices_with_retry,
+)
+from areal_tpu.bench.phases import PhaseSpec
+
+
+# ----------------------------------------------------------------------
+# classification + get_devices_with_retry (satellite: wall-clock budget,
+# tunnel vs driver)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("text,expected", [
+    ("UNAVAILABLE: TPU backend setup/compile error (Unavailable).", "tunnel"),
+    ("Unable to initialize backend 'axon': UNAVAILABLE", "tunnel"),
+    ("ConnectionRefusedError: [Errno 111] connection refused", "tunnel"),
+    ("socket closed mid stream", "tunnel"),
+    ("DEADLINE EXCEEDED while dialing", "tunnel"),
+    ("RuntimeError: Device or resource busy", "tunnel"),
+    ("jaxlib is version 0.4.1, but this version of jax requires 0.4.30",
+     "driver"),
+    ("incompatible libtpu found", "driver"),
+    ("INVALID_ARGUMENT: bad topology flag", "driver"),
+    ("something entirely novel", "unknown"),
+])
+def test_classify_device_error(text, expected):
+    assert classify_device_error(text) == expected
+
+
+def test_retry_tunnel_until_success_within_budget():
+    calls = {"n": 0}
+    t = {"now": 0.0}
+    sleeps = []
+
+    def devices_fn():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: tunnel flap")
+        return ["dev0"]
+
+    def sleep(s):
+        sleeps.append(s)
+        t["now"] += s
+
+    out = get_devices_with_retry(
+        budget_s=100.0, backoff_s=5.0, devices_fn=devices_fn,
+        sleep=sleep, clock=lambda: t["now"],
+    )
+    assert out == ["dev0"]
+    assert calls["n"] == 3
+    assert sleeps == [5.0, 10.0]  # exponential backoff
+
+
+def test_driver_error_aborts_without_retry():
+    calls = {"n": 0}
+
+    def devices_fn():
+        calls["n"] += 1
+        raise RuntimeError("jaxlib is version 0.3, incompatible")
+
+    with pytest.raises(DriverError):
+        get_devices_with_retry(
+            budget_s=1000.0, backoff_s=1.0, devices_fn=devices_fn,
+            sleep=lambda s: None, clock=lambda: 0.0,
+        )
+    assert calls["n"] == 1  # abort fast: one attempt, no backoff
+
+
+def test_budget_exhaustion_raises_last_error():
+    t = {"now": 0.0}
+
+    def devices_fn():
+        raise RuntimeError("UNAVAILABLE: still down")
+
+    def sleep(s):
+        t["now"] += s
+
+    with pytest.raises(RuntimeError, match="still down"):
+        get_devices_with_retry(
+            budget_s=30.0, backoff_s=8.0, devices_fn=devices_fn,
+            sleep=sleep, clock=lambda: t["now"],
+        )
+    assert t["now"] <= 40.0  # stopped near the budget, not attempt-count
+
+
+# ----------------------------------------------------------------------
+# scheduler policy
+# ----------------------------------------------------------------------
+
+
+def _spec(name, priority, compile_s, measure_s, min_window=0.0, proxy=False):
+    return PhaseSpec(
+        name=name, entrypoint="unused:unused", priority=priority,
+        est_compile_s=compile_s, est_measure_s=measure_s,
+        min_window_s=min_window, proxy=proxy,
+    )
+
+
+def _bank_ok(b, phase, pass_, platform="tpu"):
+    att = bank.attestation()
+    att.update(platform=platform, driver_verified=platform == "tpu",
+               n_devices=1, device_kind="fake")
+    bank.write_record(
+        bank.make_record(phase, pass_, "ok", value={"m": 1.0}, att=att), b
+    )
+
+
+@pytest.fixture
+def daemon_env(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    yield b
+
+
+def test_select_compile_pass_before_measure(daemon_env):
+    a = _spec("a", 0, compile_s=60, measure_s=30)
+    d = BenchDaemon(bank_path=daemon_env, phase_list=[a],
+                    probe_fn=lambda: ProbeResult("up", platform="tpu"),
+                    window_hint_s=90.0)
+    assert d.select_action("tpu") == (a, "compile")
+    _bank_ok(daemon_env, "a", "compile")
+    assert d.select_action("tpu") == (a, "measure")
+    _bank_ok(daemon_env, "a", "measure")
+    assert d.select_action("tpu") is None
+
+
+def test_short_window_prefers_lower_priority_phase_that_fits(daemon_env):
+    a = _spec("a", 0, compile_s=100, measure_s=30)
+    b = _spec("b", 1, compile_s=40, measure_s=20)
+    d = BenchDaemon(bank_path=daemon_env, phase_list=[a, b],
+                    window_hint_s=50.0)
+    # a's compile (100s) does not fit the 50s window; b's (40s) does.
+    assert d.select_action("tpu") == (b, "compile")
+    _bank_ok(daemon_env, "b", "compile")
+    assert d.select_action("tpu") == (b, "measure")
+    _bank_ok(daemon_env, "b", "measure")
+    # Nothing fits now: fall back to the cheapest pending action rather
+    # than idling inside an open window.
+    assert d.select_action("tpu") == (a, "compile")
+
+
+def test_min_window_gates_measure_pass(daemon_env):
+    a = _spec("a", 0, compile_s=10, measure_s=10, min_window=300.0)
+    b = _spec("b", 1, compile_s=10, measure_s=10)
+    _bank_ok(daemon_env, "a", "compile")
+    d = BenchDaemon(bank_path=daemon_env, phase_list=[a, b],
+                    window_hint_s=60.0)
+    # a's measure is gated on a >=300s steady-state window: spend the
+    # short window on b instead.
+    assert d.select_action("tpu") == (b, "compile")
+
+
+def test_window_estimate_is_median_of_observed(daemon_env):
+    t = {"now": 0.0}
+    d = BenchDaemon(bank_path=daemon_env, phase_list=[],
+                    window_hint_s=90.0, clock=lambda: t["now"])
+    assert d.window_estimate_s() == 90.0  # optimistic default first
+    for dur in (30.0, 120.0, 60.0):
+        d._note_up()
+        t["now"] += dur
+        d._note_down()
+    assert d.window_estimate_s() == 60.0
+
+
+def test_daemon_polls_through_flaps_then_completes(daemon_env):
+    a = _spec("a", 0, compile_s=10, measure_s=10)
+    probes = [
+        ProbeResult("tunnel", detail="down"),
+        ProbeResult("wedged", detail="probe hung"),
+        ProbeResult("up", platform="tpu", n_devices=1),
+        ProbeResult("up", platform="tpu", n_devices=1),
+        ProbeResult("up", platform="tpu", n_devices=1),
+    ]
+    dispatched = []
+
+    def dispatch(name, pass_, b):
+        dispatched.append((name, pass_))
+        _bank_ok(b, name, pass_)
+        return bank.load_record(b, name, pass_)
+
+    sleeps = []
+    d = BenchDaemon(
+        bank_path=daemon_env, phase_list=[a],
+        probe_fn=lambda: probes.pop(0), dispatch_fn=dispatch,
+        poll_interval_s=5.0, sleep=sleeps.append,
+    )
+    assert d.run() == "complete"
+    assert dispatched == [("a", "compile"), ("a", "measure")]
+    assert sleeps == [5.0, 10.0]  # backoff while down, reset on dispatch
+
+
+def test_daemon_aborts_on_driver_error(daemon_env):
+    d = BenchDaemon(
+        bank_path=daemon_env, phase_list=[_spec("a", 0, 10, 10)],
+        probe_fn=lambda: ProbeResult("driver", detail="jaxlib mismatch"),
+        sleep=lambda s: None,
+    )
+    assert d.run() == "driver_error"
+
+
+def test_daemon_caps_attempts_on_deterministic_failure(daemon_env):
+    a = _spec("a", 0, compile_s=10, measure_s=10)
+    failures = {"n": 0}
+
+    def dispatch(name, pass_, b):
+        failures["n"] += 1
+        rec = bank.make_record(name, pass_, "failed", error="boom")
+        bank.write_record(rec, b)
+        return rec
+
+    d = BenchDaemon(
+        bank_path=daemon_env, phase_list=[a],
+        probe_fn=lambda: ProbeResult("up", platform="tpu", n_devices=1),
+        dispatch_fn=dispatch, sleep=lambda s: None,
+    )
+    d.max_attempts = 3
+    # Giving up on a deterministically-failing phase is NOT completion:
+    # the caller must not publish (or clear) the round as done.
+    assert d.run(max_runtime_s=1e9) == "gave_up"
+    assert failures["n"] == 3  # retried, then gave the windows back

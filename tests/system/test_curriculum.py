@@ -113,6 +113,8 @@ def test_restore_ordering_preserves_dataloader_cursor(
     assert loader2._cursor == 2  # sizes matched; cursor survived
 
 
+@pytest.mark.slow  # ~60s two-run e2e; the score-store/filter/restore
+# units above stay in tier-1
 def test_curriculum_sync_ppo_e2e(tmp_path, tokenizer):
     """E2E: reward-MFC scores flow to the shared file, epoch boundaries
     shrink the dataset, and a recovery relaunch resumes with the filtered
