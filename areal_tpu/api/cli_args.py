@@ -308,6 +308,40 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "per-step weight stream (prefill stays bf16); None disables"
         },
     )
+    # Streaming weight-distribution plane (system/weight_plane.py).
+    gen_weight_plane: bool = dataclasses.field(
+        default=False,
+        metadata={
+            "help": "distribute weight updates over a peer-fanout tree "
+            "(origin uploads each byte once; servers serve chunks to "
+            "siblings) instead of every generation server re-reading "
+            "the checkpoint from NFS; transfer overlaps serving, the "
+            "interrupt+swap cutover is measured separately"
+        },
+    )
+    gen_weight_chunk_mb: int = dataclasses.field(
+        default=8,
+        metadata={
+            "help": "weight-plane chunk size (MiB): per-chunk content "
+            "hashes + HTTP Range resume, so a torn transfer re-pays at "
+            "most one chunk"
+        },
+    )
+    gen_weight_fanout: int = dataclasses.field(
+        default=2,
+        metadata={
+            "help": "children per node in the weight-plane fanout tree; "
+            "origin egress is bounded by fanout * payload"
+        },
+    )
+    gen_weight_cutover_budget_s: float = dataclasses.field(
+        default=3.0,
+        metadata={
+            "help": "target bound for the serve-interrupting weight "
+            "cutover window (the reference's <3s weight-update bar); "
+            "overruns are surfaced in /status + logs, not fatal"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

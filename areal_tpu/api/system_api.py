@@ -64,6 +64,15 @@ class ModelWorkerConfig:
     stream_dataset: bool = False
     n_pullers: int = 1
     shuffle_dataset: bool = True
+    # Streaming weight-distribution plane: when True the dump rank
+    # serves its raw-bin dumps over chunked HTTP and registers as the
+    # fanout origin (system/weight_plane.WeightPlaneSource). Mirrors
+    # GserverManagerConfig.weight_plane; AREAL_WEIGHT_PLANE=1 also arms
+    # it for legacy launch paths that bypass the experiment builder.
+    weight_plane: bool = False
+    # Chunk size for that source (mirrors the manager-hosted fallback's
+    # GserverManagerConfig.weight_chunk_bytes).
+    weight_chunk_bytes: int = 8 << 20
 
     @property
     def worker_name(self) -> str:
@@ -192,6 +201,23 @@ class GserverManagerConfig:
     # re-sync + readmission of returning ones). Chaos tests shrink it
     # together with AREAL_HEALTH_TTL for sub-second failover.
     health_check_interval: float = 2.0
+    # Streaming weight-distribution plane (system/weight_plane.py): when
+    # True, weight updates fan out over a peer tree (origin uploads each
+    # byte once; holders serve siblings) instead of every server
+    # re-reading the full checkpoint from NFS. The origin is the
+    # trainer-side source registered in name_resolve, falling back to a
+    # manager-hosted source over the NFS dump dir.
+    weight_plane: bool = False
+    # Chunk size for the manager-hosted origin (a trainer-side source
+    # uses its own); per-chunk hashed, Range-resumable.
+    weight_chunk_bytes: int = 8 << 20
+    # Children per node in the fanout tree: origin egress is bounded by
+    # degree * payload; deeper trees trade origin egress for extra hops.
+    weight_fanout_degree: int = 2
+    # Target bound for the serve-interrupting cutover window (interrupt
+    # + device swap), measured separately from transfer. Overruns are
+    # surfaced (within_budget=false + warning), not fatal.
+    weight_cutover_budget_s: float = 3.0
 
     @property
     def worker_name(self) -> str:

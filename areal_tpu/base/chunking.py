@@ -1,0 +1,121 @@
+"""Content-addressed chunking for the weight-distribution plane.
+
+The raw-bin dump format (system/weight_transfer.py) is one contiguous
+byte blob per version. The distribution plane (system/weight_plane.py)
+moves that blob over HTTP in fixed-size chunks; every chunk is named by
+its content hash so a receiver can verify each piece independently,
+resume a torn connection mid-chunk, and safely accept bytes from ANY
+holder (trainer origin or a sibling generation server) — the hash, not
+the peer, is the authority.
+
+Kept in ``base`` (stdlib-only, no jax/numpy) so the trainer-side source,
+the engine-side fetch client, and the bench workload all share one
+definition of "a chunk".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Tuple
+
+CHUNK_SCHEMA = "areal-weight-chunks/v1"
+
+# 8 MiB default: large enough that per-chunk HTTP overhead is noise for
+# GB-scale payloads, small enough that a resumed transfer re-pays at
+# most one chunk and a fanout tree pipelines across peers quickly.
+DEFAULT_CHUNK_BYTES = 8 << 20
+
+
+def hash_chunk(data) -> str:
+    """Content hash of one chunk (sha256; full hex so a collision-forged
+    chunk is out of reach for anything short of breaking sha256)."""
+    return hashlib.sha256(bytes(data)).hexdigest()
+
+
+def chunk_spans(total_bytes: int, chunk_bytes: int) -> List[Tuple[int, int]]:
+    """[(offset, length), ...] covering [0, total_bytes). The final chunk
+    is short; a zero-byte payload has zero chunks."""
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be > 0, got {chunk_bytes}")
+    return [
+        (off, min(chunk_bytes, total_bytes - off))
+        for off in range(0, total_bytes, chunk_bytes)
+    ]
+
+
+def build_chunk_index(bin_path: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> Dict:
+    """Stream the bin once and return its chunk index:
+
+    ``{schema, chunk_bytes, total_bytes, n_chunks, hashes: [hex, ...]}``
+
+    Raises OSError if the bin vanishes mid-read (GC race — the caller
+    retries against the refreshed manifest, weight_transfer.py).
+    """
+    total = os.path.getsize(bin_path)
+    hashes: List[str] = []
+    with open(bin_path, "rb") as f:
+        for _, length in chunk_spans(total, chunk_bytes):
+            data = f.read(length)
+            if len(data) != length:
+                raise OSError(
+                    f"short read on {bin_path}: wanted {length}, "
+                    f"got {len(data)} (torn write or concurrent GC)"
+                )
+            hashes.append(hash_chunk(data))
+    return {
+        "schema": CHUNK_SCHEMA,
+        "chunk_bytes": int(chunk_bytes),
+        "total_bytes": int(total),
+        "n_chunks": len(hashes),
+        "hashes": hashes,
+    }
+
+
+class StreamChunker:
+    """Incrementally hash a byte stream into the same chunk index
+    ``build_chunk_index`` produces, without materializing the stream.
+
+    The dump path (system/weight_transfer.dump_raw_params) feeds each
+    leaf's bytes through this while writing the bin, then publishes the
+    index as a sidecar — so the weight-plane origin never has to re-read
+    and re-hash a multi-GB bin it just wrote."""
+
+    def __init__(self, chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        if chunk_bytes <= 0:
+            raise ValueError(f"chunk_bytes must be > 0, got {chunk_bytes}")
+        self.chunk_bytes = int(chunk_bytes)
+        self.total = 0
+        self.hashes: List[str] = []
+        self._h = hashlib.sha256()
+        self._fill = 0  # bytes fed into the current (open) chunk
+
+    def update(self, data) -> None:
+        mv = memoryview(data).cast("B")
+        while len(mv):
+            take = min(len(mv), self.chunk_bytes - self._fill)
+            self._h.update(mv[:take])
+            self._fill += take
+            self.total += take
+            if self._fill == self.chunk_bytes:
+                self.hashes.append(self._h.hexdigest())
+                self._h = hashlib.sha256()
+                self._fill = 0
+            mv = mv[take:]
+
+    def finish(self) -> Dict:
+        if self._fill:
+            self.hashes.append(self._h.hexdigest())
+            self._h = hashlib.sha256()
+            self._fill = 0
+        return {
+            "schema": CHUNK_SCHEMA,
+            "chunk_bytes": self.chunk_bytes,
+            "total_bytes": int(self.total),
+            "n_chunks": len(self.hashes),
+            "hashes": list(self.hashes),
+        }
+
+
+def verify_chunk(data, expected_hash: str) -> bool:
+    return hash_chunk(data) == expected_hash

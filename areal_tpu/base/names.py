@@ -89,6 +89,13 @@ def metric_server(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/metric_server"
 
 
+def weight_plane_source(experiment_name: str, trial_name: str, model_name: str) -> str:
+    """HTTP origin of the streaming weight-distribution plane for one
+    model role (system/weight_plane.py): the trainer-side dump rank (or
+    the gserver manager's NFS-backed fallback) registers its URL here."""
+    return f"{trial_root(experiment_name, trial_name)}/weight_plane/{model_name}"
+
+
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
 

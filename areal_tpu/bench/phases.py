@@ -183,6 +183,20 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="weight_update",
+    entrypoint="areal_tpu.bench.workloads:weight_update_phase",
+    priority=12,
+    est_compile_s=0.0,  # host + loopback HTTP only: no compile pass
+    est_measure_s=30.0,
+    min_window_s=0.0,
+    proxy=True,
+    description="Weight-distribution plane: origin + 3-holder peer "
+                "fanout over loopback HTTP — weight_update_ms with the "
+                "transfer/cutover split and the O(1)-origin-egress "
+                "invariant (host-side; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
     name="prefetch_overlap",
     entrypoint="areal_tpu.bench.workloads:prefetch_overlap_phase",
     priority=11,

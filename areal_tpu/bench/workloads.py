@@ -551,3 +551,73 @@ def prefetch_overlap_phase(pass_: str) -> dict:
     out["step_s"] = dt
     log(f"bench: prefetch_overlap {out}")
     return out
+
+
+def weight_update_phase(pass_: str) -> dict:
+    """Weight-distribution plane end-to-end on loopback HTTP: dump a
+    raw-bin payload, serve it from a WeightPlaneSource origin, fan it
+    out to 3 holders along a degree-1 chain (the maximum-peer-hop
+    shape), then host-assemble each holder's buffer as the cutover
+    proxy. Proxy evidence by construction (no device swap, no real
+    serving engine): what it banks is the plane's software overhead —
+    chunk/hash/HTTP cost per MB — and the O(1)-origin-egress invariant
+    (``origin_full_payloads`` must stay ~1.0; the validator refuses
+    records where peer fanout silently degraded to origin broadcast)."""
+    if pass_ == "compile":
+        return {"compile_s": 0.0}  # host + loopback only
+    import shutil
+    import tempfile
+
+    from areal_tpu.engine.weight_client import assemble_params
+    from areal_tpu.system.weight_plane import (
+        WeightPlaneSource, distribute_to_stores,
+    )
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    rng = np.random.RandomState(0)
+    # ~16 MiB payload: big enough that per-chunk overhead is amortized
+    # like production, small enough for a sub-30s proxy phase.
+    params = {
+        "layers": {
+            f"l{i:02d}": {
+                "w": rng.standard_normal((512, 256)).astype(np.float32)
+            }
+            for i in range(32)
+        }
+    }
+    n_holders, version = 3, 1
+    tmp = tempfile.mkdtemp(prefix="areal_wp_bench_")
+    holders, src = [], None
+    try:
+        dump_raw_params(params, tmp, version=version, chunk_bytes=1 << 20)
+        src = WeightPlaneSource(tmp, chunk_bytes=1 << 20).start()
+        t0 = time.perf_counter()
+        holders, stats = distribute_to_stores(
+            src.address, n_holders, degree=1, version=version
+        )
+        cutover_ms = []
+        for h in holders:
+            t1 = time.perf_counter()
+            assemble_params(h.store)
+            cutover_ms.append((time.perf_counter() - t1) * 1000.0)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        origin_eq = src.stats()["full_payload_equivalents"].get(version, 0.0)
+        out = {
+            "weight_update_ms": wall_ms,
+            "weight_transfer_ms": max(
+                s["fetch_s"] for s in stats["per_holder"].values()
+            ) * 1000.0,
+            "weight_cutover_ms": max(cutover_ms),
+            "origin_full_payloads": origin_eq,
+            "n_holders": float(n_holders),
+            "payload_mb": stats["total_bytes"] / float(1 << 20),
+            "n_chunks": float(stats["n_chunks"]),
+        }
+        log(f"bench: weight_update {out}")
+        return out
+    finally:
+        for h in holders:
+            h.close()
+        if src is not None:
+            src.close()
+        shutil.rmtree(tmp, ignore_errors=True)

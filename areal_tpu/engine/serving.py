@@ -380,6 +380,7 @@ class ServingEngine:
         self.n_preempted = 0
         self.last_weight_swap_s = 0.0
         self.last_weight_stage_s = 0.0
+        self.last_weight_cutover_s = 0.0
 
     # ------------------------------------------------------------------
     # Public API
@@ -555,6 +556,44 @@ class ServingEngine:
         if allow_interrupt:
             self._interrupt.set()
 
+    def cutover_params(
+        self,
+        params,
+        version: int,
+        allow_interrupt: bool = True,
+        timeout_s: float = 120.0,
+    ) -> float:
+        """Weight-plane cutover hook: swap to `params` (pinned to
+        `version`) and BLOCK until the serve loop has landed it — the
+        full interrupt -> device-transfer -> pointer-flip window, end to
+        end. This is the number the distribution plane bounds separately
+        from network transfer time: the bytes were already prefetched to
+        host memory, so everything timed here is cutover cost (running
+        requests interrupted via the pending-update escalation path and
+        returned partial for client-side re-prefill).
+
+        Returns seconds; recorded as ``last_weight_cutover_s``. Raises
+        TimeoutError if the version never lands (serve loop dead)."""
+        t0 = time.monotonic()
+        self.update_params(
+            params, allow_interrupt=allow_interrupt, version=int(version)
+        )
+        deadline = t0 + timeout_s
+        while self._applied_pinned < int(version):
+            if self.fatal_error is not None:
+                raise RuntimeError(
+                    f"cutover v{version}: serve loop died: "
+                    f"{self.fatal_error!r}"
+                ) from self.fatal_error
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"cutover v{version} did not land within {timeout_s}s "
+                    f"(live v{self.version})"
+                )
+            time.sleep(0.002)
+        self.last_weight_cutover_s = time.monotonic() - t0
+        return self.last_weight_cutover_s
+
     def metrics(self) -> Dict[str, float]:
         return {
             "num_running_reqs": float(self.n_running),
@@ -566,6 +605,7 @@ class ServingEngine:
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
             "last_weight_stage_s": float(self.last_weight_stage_s),
+            "last_weight_cutover_s": float(self.last_weight_cutover_s),
             "prefix_cache_hits": float(self.prefix_cache_hits),
             "prefix_tokens_reused": float(self.prefix_tokens_reused),
             "prefix_cached_tokens": float(self._cached_tokens),

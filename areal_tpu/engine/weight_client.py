@@ -1,0 +1,253 @@
+"""Fetch side of the streaming weight-distribution plane.
+
+A generation server prefetches the next weight version into HOST memory
+while it keeps serving the current one: a :class:`ChunkStore` pulls the
+raw-bin payload chunk-by-chunk over HTTP from an ordered list of
+upstreams (its fanout-tree parent first, surviving peer holders next,
+the trainer origin last), verifying every chunk's content hash and
+resuming torn connections mid-chunk via HTTP Range. Once complete, the
+store's buffer is reinterpreted zero-copy into the params pytree
+(``assemble_params``) and handed to ``ServingEngine.cutover_params`` —
+the short interrupt + device-swap window that is measured separately
+from the transfer.
+
+Synchronous stdlib HTTP on purpose: the caller runs it on an executor
+thread (generation_server) or a plain thread (bench workload), so no
+event-loop interplay and no aiohttp dependency on the fetch path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from areal_tpu.base import logging
+from areal_tpu.base.chunking import CHUNK_SCHEMA, chunk_spans, verify_chunk
+
+logger = logging.getLogger("weight_client")
+
+# Per-chunk, per-upstream (re)connection budget. Mid-chunk drops resume
+# with a Range request, so each retry re-pays at most the torn tail.
+_CHUNK_ATTEMPTS = 3
+
+
+class WeightFetchError(RuntimeError):
+    """The payload could not be completed from any upstream."""
+
+
+def http_get_json(url: str, timeout: float = 10.0) -> Dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def fetch_manifest(
+    base_url: str, version: Optional[int] = None, timeout: float = 10.0
+) -> Dict:
+    """GET ``{base_url}/weights/manifest`` (optionally pinned to a
+    version: the holder 404s until it can serve exactly that one)."""
+    url = f"{base_url}/weights/manifest"
+    if version is not None:
+        url += f"?version={int(version)}"
+    man = http_get_json(url, timeout=timeout)
+    if man.get("schema") != CHUNK_SCHEMA:
+        raise WeightFetchError(
+            f"{base_url}: manifest schema {man.get('schema')!r} != "
+            f"{CHUNK_SCHEMA!r}"
+        )
+    return man
+
+
+class ChunkStore:
+    """Host-memory staging buffer for one (version, payload).
+
+    Verified chunks are immediately servable to sibling fetchers (the
+    peer-fanout hop), so ``has``/``chunk_bytes_at`` are safe to call from
+    the HTTP thread while ``fetch`` runs on an executor thread: ``_have``
+    flips True only AFTER the chunk's bytes are fully written+verified.
+    """
+
+    def __init__(self, manifest: Dict):
+        if manifest.get("schema") != CHUNK_SCHEMA:
+            raise WeightFetchError(
+                f"bad manifest schema: {manifest.get('schema')!r}"
+            )
+        self.manifest = manifest
+        self.version = int(manifest["version"])
+        self.total_bytes = int(manifest["total_bytes"])
+        self.chunk_bytes = int(manifest["chunk_bytes"])
+        self.spans = chunk_spans(self.total_bytes, self.chunk_bytes)
+        self.n_chunks = len(self.spans)
+        assert self.n_chunks == int(manifest["n_chunks"]), (
+            f"manifest n_chunks {manifest['n_chunks']} != computed "
+            f"{self.n_chunks}"
+        )
+        self.buf = bytearray(self.total_bytes)
+        self._have = [False] * self.n_chunks
+        # Telemetry: who served us how much (origin vs peer accounting
+        # for the O(1)-egress assertion), and time split fetch vs verify.
+        self.bytes_from: Dict[str, int] = {}
+        self.fetch_s = 0.0
+        self.verify_s = 0.0
+        self.resumed_chunks = 0
+        self._lock = threading.Lock()
+
+    # -- serving side (safe during fetch) ------------------------------
+
+    def complete(self) -> bool:
+        return all(self._have)
+
+    def has(self, idx: int) -> bool:
+        return 0 <= idx < self.n_chunks and self._have[idx]
+
+    def chunk(self, idx: int) -> memoryview:
+        off, length = self.spans[idx]
+        return memoryview(self.buf)[off : off + length]
+
+    # -- fetch side ----------------------------------------------------
+
+    def _get_range(
+        self, base_url: str, idx: int, start: int, length: int,
+        timeout: float,
+    ) -> bytes:
+        url = (
+            f"{base_url}/weights/chunk?"
+            + urllib.parse.urlencode({"version": self.version, "idx": idx})
+        )
+        req = urllib.request.Request(url)
+        if start:
+            req.add_header("Range", f"bytes={start}-")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read(length - start)
+
+    def _fetch_chunk(
+        self, base_url: str, idx: int, timeout: float
+    ) -> Optional[bytes]:
+        """One chunk from one upstream, resuming torn reads mid-chunk.
+        Returns verified bytes or None (upstream failed / hash lied)."""
+        _, length = self.spans[idx]
+        expected = self.manifest["hashes"][idx]
+        part = b""
+        for attempt in range(_CHUNK_ATTEMPTS):
+            try:
+                got = self._get_range(base_url, idx, len(part), length, timeout)
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                logger.debug(
+                    f"chunk {idx} from {base_url}: attempt {attempt} "
+                    f"failed at {len(part)}/{length}: {e}"
+                )
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            if part:
+                self.resumed_chunks += 1
+            part += got
+            if len(part) < length:
+                continue  # short read: resume from the new offset
+            t0 = time.monotonic()
+            ok = verify_chunk(part, expected)
+            self.verify_s += time.monotonic() - t0
+            if ok:
+                return part
+            logger.warning(
+                f"chunk {idx} from {base_url}: content-hash mismatch; "
+                f"discarding and trying the next upstream"
+            )
+            return None
+        return None
+
+    def fetch(
+        self,
+        upstreams: List[str],
+        origin: Optional[str] = None,
+        timeout: float = 30.0,
+        deadline_s: float = 600.0,
+    ) -> Dict[str, Any]:
+        """Pull every missing chunk, trying ``upstreams`` in order per
+        chunk (sticky: the last upstream that delivered is tried first
+        for the next chunk). Raises WeightFetchError if any chunk cannot
+        be completed from any upstream before the deadline.
+
+        Returns the transfer stats dict (also kept on the store)."""
+        t_start = time.monotonic()
+        order = list(dict.fromkeys(u.rstrip("/") for u in upstreams if u))
+        if not order:
+            raise WeightFetchError("no upstreams to fetch from")
+        origin = origin.rstrip("/") if origin else None
+        preferred = 0
+        for idx in range(self.n_chunks):
+            if self._have[idx]:
+                continue
+            if time.monotonic() - t_start > deadline_s:
+                raise WeightFetchError(
+                    f"weight fetch v{self.version} deadline after "
+                    f"{idx}/{self.n_chunks} chunks"
+                )
+            got = None
+            tried = [order[preferred]] + [
+                u for i, u in enumerate(order) if i != preferred
+            ]
+            for u in tried:
+                got = self._fetch_chunk(u, idx, timeout)
+                if got is not None:
+                    preferred = order.index(u)
+                    with self._lock:
+                        self.bytes_from[u] = (
+                            self.bytes_from.get(u, 0) + len(got)
+                        )
+                    break
+            if got is None:
+                raise WeightFetchError(
+                    f"chunk {idx}/{self.n_chunks} of v{self.version} "
+                    f"unavailable from all of {tried}"
+                )
+            off, _ = self.spans[idx]
+            self.buf[off : off + len(got)] = got
+            self._have[idx] = True
+        self.fetch_s = time.monotonic() - t_start
+        return self.stats(origin)
+
+    def stats(self, origin: Optional[str] = None) -> Dict[str, Any]:
+        origin = origin.rstrip("/") if origin else None
+        from_origin = sum(
+            n for u, n in self.bytes_from.items() if u == origin
+        )
+        return {
+            "version": self.version,
+            "total_bytes": self.total_bytes,
+            "n_chunks": self.n_chunks,
+            "fetch_s": self.fetch_s,
+            "verify_s": self.verify_s,
+            "resumed_chunks": self.resumed_chunks,
+            "bytes_from": dict(self.bytes_from),
+            "bytes_from_origin": from_origin,
+            "bytes_from_peers": sum(self.bytes_from.values()) - from_origin,
+        }
+
+
+def assemble_params(store: ChunkStore) -> Tuple[Any, int]:
+    """Reinterpret a complete store's buffer as the params pytree —
+    zero-copy numpy views over the host buffer (jax.device_put during
+    cutover streams straight from these pages, exactly like the mmap
+    fast path in weight_transfer.load_raw_params)."""
+    import ml_dtypes  # noqa: F401  registers bfloat16 et al. by name
+    import numpy as np
+
+    from areal_tpu.system.weight_transfer import unflatten_leaves
+
+    if not store.complete():
+        raise WeightFetchError(
+            f"assemble on incomplete store v{store.version}"
+        )
+    base = np.frombuffer(store.buf, dtype=np.uint8)
+    leaves = {}
+    for e in store.manifest["leaves"]:
+        dt = np.dtype(e["dtype"])
+        n = int(np.prod(e["shape"], dtype=np.int64)) * dt.itemsize
+        leaves[e["path"]] = (
+            base[e["offset"] : e["offset"] + n].view(dt).reshape(e["shape"])
+        )
+    return unflatten_leaves(leaves), store.version

@@ -174,6 +174,10 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         max_head_offpolicyness=cfg.ppo.max_head_offpolicyness,
         train_batch_size=cfg.train_batch_size,
         max_concurrent_rollouts=cfg.ppo.max_concurrent_rollouts,
+        weight_plane=cfg.gen_weight_plane,
+        weight_chunk_bytes=cfg.gen_weight_chunk_mb << 20,
+        weight_fanout_degree=cfg.gen_weight_fanout,
+        weight_cutover_budget_s=cfg.gen_weight_cutover_budget_s,
     )
     rollouts = [
         RolloutWorkerConfig(

@@ -11,6 +11,16 @@ stack expects (SURVEY §8 "SGLang server contract"):
 - POST /update_weights_from_disk {model_path, allow_interrupt}
 - GET  /metrics  (areal:num_used_tokens / areal:num_running_reqs)
 - GET  /health
+
+Plus the streaming weight-distribution plane (system/weight_plane.py):
+
+- POST /distribute_weights  prefetch version-N chunks into host memory
+  from an ordered upstream list (fanout-tree parent, surviving peers,
+  origin) WHILE still serving version N-1
+- POST /cutover_weights     short interrupt + device-swap to the
+  prefetched version; duration measured separately from transfer
+- GET  /weights/manifest, /weights/chunk   serve held chunks to sibling
+  servers (the peer hop that keeps trainer egress O(1))
 """
 
 from __future__ import annotations
@@ -28,6 +38,11 @@ from areal_tpu.api.system_api import GenerationServerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, network, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.engine.serving import GenRequest, ServingEngine
+from areal_tpu.engine.weight_client import ChunkStore, assemble_params
+from areal_tpu.system.weight_plane import (
+    serve_store_chunk,
+    serve_store_manifest,
+)
 from areal_tpu.system.worker_base import PollResult, Worker
 
 logger = logging.getLogger("generation_server")
@@ -90,6 +105,21 @@ class GenerationServer(Worker):
         self._n_interrupted = 0
         self._last_load_info = None
 
+        # Weight-plane prefetch state machine: idle -> fetching -> ready
+        # (-> failed). The store outlives its own cutover so this server
+        # keeps serving chunks to later-wave siblings and to chaos
+        # re-fanouts; a new /distribute_weights replaces it.
+        self._wp_lock = threading.Lock()
+        self._wp_store: Any = None
+        self._wp_state = "idle"
+        self._wp_transfer_ms = 0.0
+        self._wp_verify_ms = 0.0
+        self._wp_cutover_ms = 0.0
+        self._wp_bytes_from_origin = 0
+        self._wp_bytes_from_peers = 0
+        self._wp_chunks_served = 0
+        self._wp_bytes_served = 0
+
         # HTTP server on its own thread + loop.
         self._http_loop = asyncio.new_event_loop()
         self._http_ready = threading.Event()
@@ -131,6 +161,10 @@ class GenerationServer(Worker):
         app = web.Application()
         app.router.add_post("/generate", self._h_generate)
         app.router.add_post("/update_weights_from_disk", self._h_update_weights)
+        app.router.add_post("/distribute_weights", self._h_distribute_weights)
+        app.router.add_post("/cutover_weights", self._h_cutover_weights)
+        app.router.add_get("/weights/manifest", self._h_weights_manifest)
+        app.router.add_get("/weights/chunk", self._h_weights_chunk)
         app.router.add_get("/metrics", self._h_metrics)
         app.router.add_get("/health", self._h_health)
         runner = web.AppRunner(app)
@@ -255,7 +289,8 @@ class GenerationServer(Worker):
             )
         try:
             params, info = await asyncio.get_running_loop().run_in_executor(
-                None, self._load_params, model_path
+                None, self._load_params, model_path,
+                None if version is None else int(version),
             )
         except Exception as e:
             logger.exception("weight update load failed")
@@ -293,9 +328,14 @@ class GenerationServer(Worker):
             }
         )
 
-    def _load_params(self, model_path: str):
+    def _load_params(self, model_path: str, want_version=None):
         """Fastest source first: tmpfs raw -> disk raw -> pickle -> HF
-        (system/weight_transfer.load_for_serving)."""
+        (system/weight_transfer.load_for_serving). With a pinned
+        want_version, a dump that doesn't hold exactly that version
+        raises WeightVersionMismatch after brief retries — the manager
+        pins the engine to its published version, so silently loading a
+        stale raw dump (or a version:-1 pickle/HF fallback) would serve
+        old weights under a new version label."""
         from areal_tpu.system.weight_transfer import (
             load_for_serving, shm_transfer_dir,
         )
@@ -307,7 +347,286 @@ class GenerationServer(Worker):
         shm = shm_transfer_dir(
             self.cfg.experiment_name, self.cfg.trial_name, role
         )
-        return load_for_serving(model_path, shm_dir=shm)
+        return load_for_serving(
+            model_path, shm_dir=shm, want_version=want_version
+        )
+
+    # ------------------------------------------------------------------
+    # Weight-distribution plane (system/weight_plane.py)
+    # ------------------------------------------------------------------
+
+    async def _h_distribute_weights(self, request: web.Request) -> web.Response:
+        """Prefetch version-N chunks into host memory while version N-1
+        keeps serving. Returns when the payload is complete+verified, so
+        the manager can use this server as a parent in the next wave."""
+        await faults.maybe_fail_async("gserver.distribute_weights")
+        d = await request.json()
+        version = int(d["version"])
+        upstreams = [u for u in (d.get("upstreams") or []) if u]
+        origin = d.get("origin")
+        fetch_span = tracing.start_span(
+            "server.weight_fetch",
+            ctx=tracing.extract_from(d),
+            version=version, n_upstreams=len(upstreams),
+        )
+        with self._wp_lock:
+            held = self._wp_store
+            joining = False
+            if held is not None and held.version > version:
+                # A stale edge (manager retry from an older fanout):
+                # reject before paying the model-sized staging
+                # allocation below.
+                if fetch_span is not None:
+                    fetch_span.end(error="superseded")
+                return web.json_response(
+                    {"success": False,
+                     "error": f"superseded by v{held.version}"},
+                    status=409,
+                )
+            if held is not None and held.version == version:
+                if self._wp_state == "ready":
+                    # Manager retry / duplicate edge: already holding it.
+                    if fetch_span is not None:
+                        fetch_span.end(already_held=True)
+                    return web.json_response(
+                        {"success": True, "already_held": True,
+                         "transfer_ms": self._wp_transfer_ms,
+                         "verify_ms": self._wp_verify_ms}
+                    )
+                if self._wp_state == "fetching":
+                    # A duplicate for an IN-FLIGHT fetch (manager retry
+                    # after a wave timeout) joins it instead of
+                    # replacing the store: restarting from byte 0 would
+                    # discard every verified chunk, and a transfer
+                    # slower than the manager's timeout could then
+                    # never complete at all.
+                    store, joining = held, True
+        if not joining:
+            # The store's host-memory staging buffer is model-sized and
+            # zero-filled at construction: allocate on an executor
+            # thread so the event loop keeps streaming in-flight
+            # /generate responses (the whole point of the overlap).
+            try:
+                store = await asyncio.get_running_loop().run_in_executor(
+                    None, ChunkStore, d["manifest"]
+                )
+            except Exception as e:
+                if fetch_span is not None:
+                    fetch_span.end(error=repr(e))
+                return web.json_response(
+                    {"success": False, "error": repr(e)}, status=400
+                )
+            with self._wp_lock:
+                held = self._wp_store
+                if held is not None and held.version > version:
+                    # A newer version landed while we allocated; this
+                    # edge is stale — publishing ours would roll the
+                    # holder back.
+                    if fetch_span is not None:
+                        fetch_span.end(error="superseded")
+                    return web.json_response(
+                        {"success": False,
+                         "error": f"superseded by v{held.version}"},
+                        status=409,
+                    )
+                if held is not None and held.version == version:
+                    if self._wp_state == "ready":
+                        if fetch_span is not None:
+                            fetch_span.end(already_held=True)
+                        return web.json_response(
+                            {"success": True, "already_held": True,
+                             "transfer_ms": self._wp_transfer_ms,
+                             "verify_ms": self._wp_verify_ms}
+                        )
+                    if self._wp_state == "fetching":
+                        # A concurrent duplicate won the publish while
+                        # we allocated: join its in-flight fetch.
+                        store, joining = held, True
+                if not joining:
+                    self._wp_store = store
+                    self._wp_state = "fetching"
+
+        if joining:
+            deadline = time.monotonic() + float(d.get("deadline_s") or 600.0)
+
+            def _await_inflight():
+                while time.monotonic() < deadline:
+                    with self._wp_lock:
+                        if self._wp_store is not store:
+                            return "superseded"
+                        if self._wp_state != "fetching":
+                            return self._wp_state
+                    time.sleep(0.05)
+                return "timeout"
+
+            state = await asyncio.get_running_loop().run_in_executor(
+                None, _await_inflight
+            )
+            with self._wp_lock:
+                body = {"success": state == "ready", "joined": True,
+                        "transfer_ms": self._wp_transfer_ms,
+                        "verify_ms": self._wp_verify_ms}
+            if state != "ready":
+                body["error"] = f"in-flight fetch ended: {state}"
+            if fetch_span is not None:
+                fetch_span.end(joined=True, state=state)
+            return web.json_response(
+                body, status=200 if state == "ready" else 500
+            )
+
+        def _fetch():
+            faults.maybe_fail("gserver.weight_fetch")
+            return store.fetch(
+                upstreams,
+                origin=origin,
+                timeout=float(d.get("chunk_timeout") or 30.0),
+                deadline_s=float(d.get("deadline_s") or 600.0),
+            )
+
+        try:
+            stats = await asyncio.get_running_loop().run_in_executor(None, _fetch)
+        except Exception as e:
+            with self._wp_lock:
+                if self._wp_store is store:
+                    self._wp_state = "failed"
+            logger.exception("weight-plane prefetch failed")
+            if fetch_span is not None:
+                fetch_span.end(error=repr(e))
+            return web.json_response(
+                {"success": False, "error": repr(e)}, status=500
+            )
+        with self._wp_lock:
+            # Both the state flip AND the telemetry are guarded: a fetch
+            # superseded by a newer /distribute_weights must not clobber
+            # the live version's transfer numbers on /metrics.
+            if self._wp_store is store:
+                self._wp_state = "ready"
+                self._wp_transfer_ms = stats["fetch_s"] * 1000.0
+                self._wp_verify_ms = stats["verify_s"] * 1000.0
+                self._wp_bytes_from_origin = stats["bytes_from_origin"]
+                self._wp_bytes_from_peers = stats["bytes_from_peers"]
+        logger.info(
+            f"weight-plane prefetch v{version}: "
+            f"{stats['total_bytes']} bytes in {stats['fetch_s']:.3f}s "
+            f"(origin {stats['bytes_from_origin']}, "
+            f"peers {stats['bytes_from_peers']}); still serving "
+            f"v{self.engine.version}"
+        )
+        if fetch_span is not None:
+            fetch_span.end(
+                fetch_s=stats["fetch_s"], verify_s=stats["verify_s"],
+                bytes_from_origin=stats["bytes_from_origin"],
+                bytes_from_peers=stats["bytes_from_peers"],
+            )
+        return web.json_response(
+            {"success": True,
+             "transfer_ms": self._wp_transfer_ms,
+             "verify_ms": self._wp_verify_ms,
+             "bytes_from_origin": stats["bytes_from_origin"],
+             "bytes_from_peers": stats["bytes_from_peers"],
+             "n_chunks": stats["n_chunks"],
+             "resumed_chunks": stats["resumed_chunks"]}
+        )
+
+    async def _h_cutover_weights(self, request: web.Request) -> web.Response:
+        """Swap to the prefetched version: interrupt in-flight requests
+        (partial results return for client re-prefill), device-put the
+        host buffer, flip. Measured end-to-end, separately from the
+        transfer, and compared against the cutover budget."""
+        await faults.maybe_fail_async("gserver.cutover_weights")
+        d = await request.json()
+        version = int(d["version"])
+        budget_s = float(d.get("budget_s") or 0.0)
+        cut_span = tracing.start_span(
+            "server.weight_cutover",
+            ctx=tracing.extract_from(d),
+            version=version, n_running=self.engine.n_running,
+        )
+        with self._wp_lock:
+            store = self._wp_store
+            if (
+                store is None or store.version != version
+                or self._wp_state != "ready"
+            ):
+                if cut_span is not None:
+                    cut_span.end(error="not holding")
+                return web.json_response(
+                    {"success": False,
+                     "error": f"not holding v{version} "
+                              f"(state={self._wp_state})"},
+                    status=409,
+                )
+        n_running = self.engine.n_running
+
+        def _cut():
+            params, v = assemble_params(store)
+            return self.engine.cutover_params(
+                params, version=v,
+                allow_interrupt=bool(d.get("allow_interrupt", True)),
+                timeout_s=max(120.0, budget_s * 10.0),
+            )
+
+        try:
+            cut_s = await asyncio.get_running_loop().run_in_executor(None, _cut)
+        except Exception as e:
+            logger.exception("weight-plane cutover failed")
+            if cut_span is not None:
+                cut_span.end(error=repr(e))
+            return web.json_response(
+                {"success": False, "error": repr(e)}, status=500
+            )
+        with self._wp_lock:
+            self._wp_cutover_ms = cut_s * 1000.0
+        self._last_load_info = {
+            "source": "weight_plane", "version": version,
+            "load_s": self._wp_transfer_ms / 1000.0,
+        }
+        within = budget_s <= 0.0 or cut_s <= budget_s
+        if not within:
+            logger.warning(
+                f"weight cutover v{version} took {cut_s:.3f}s, over the "
+                f"{budget_s:.3f}s budget"
+            )
+        logger.info(
+            f"weight-plane cutover to v{version}: {cut_s * 1000:.1f}ms "
+            f"({n_running} request(s) interrupted)"
+        )
+        if cut_span is not None:
+            cut_span.end(
+                cutover_s=cut_s, within_budget=within, n_paused=n_running
+            )
+        return web.json_response(
+            {"success": True,
+             "cutover_ms": cut_s * 1000.0,
+             "transfer_ms": self._wp_transfer_ms,
+             "within_budget": within,
+             "num_paused_requests": n_running}
+        )
+
+    async def _h_weights_manifest(self, request: web.Request) -> web.Response:
+        with self._wp_lock:
+            store = self._wp_store
+        return serve_store_manifest(store, request)
+
+    async def _h_weights_chunk(self, request: web.Request) -> web.Response:
+        """Peer hop: serve a verified chunk to a sibling. Valid during
+        an in-flight prefetch too (ChunkStore marks chunks servable the
+        moment they verify), so deeper tree levels can pipeline."""
+        await faults.maybe_fail_async("weight_plane.serve_chunk")
+        with self._wp_lock:
+            store = self._wp_store
+        # The chunk copy (up to weight_chunk_bytes) goes off the event
+        # loop: this loop also serves /generate, and a fanout wave means
+        # one request per chunk per child — blocking it would defeat the
+        # transfer-overlaps-serving design.
+        resp, served = await asyncio.get_running_loop().run_in_executor(
+            None, serve_store_chunk, store, request
+        )
+        if served:
+            with self._wp_lock:
+                self._wp_chunks_served += 1
+                self._wp_bytes_served += served
+        return resp
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
         m = self.engine.metrics()
@@ -339,6 +658,17 @@ class GenerationServer(Worker):
             f"{self._last_load_info['load_s'] if self._last_load_info else 0.0}",
             f"areal:weight_load_fast_path "
             f"{1.0 if (self._last_load_info or {}).get('source') == 'shm_raw' else 0.0}",
+            # Weight-distribution plane: network transfer vs cutover are
+            # separate numbers by design — transfer overlaps serving,
+            # cutover is the short interrupt+swap window the budget
+            # knob bounds.
+            f"areal:weight_transfer_ms {self._wp_transfer_ms}",
+            f"areal:weight_cutover_ms {self._wp_cutover_ms}",
+            f"areal:weight_verify_ms {self._wp_verify_ms}",
+            f"areal:weight_bytes_from_origin {float(self._wp_bytes_from_origin)}",
+            f"areal:weight_bytes_from_peers {float(self._wp_bytes_from_peers)}",
+            f"areal:weight_chunks_served {float(self._wp_chunks_served)}",
+            f"areal:weight_bytes_served {float(self._wp_bytes_served)}",
         ]
         return web.Response(text="\n".join(lines) + "\n")
 

@@ -8,8 +8,14 @@ Counterpart of the reference's GserverManager
 - gates new rollouts by capacity and staleness (/allocate_rollout):
   a rollout may start only if (expected model version when it trains) -
   (current weight version) <= max_head_offpolicyness
-- watches the trainer's published model version and fans out
-  /update_weights_from_disk (interrupting running requests) to servers
+- watches the trainer's published model version and fans out weight
+  updates (interrupting running requests) to servers — either the
+  legacy /update_weights_from_disk broadcast (every server re-reads the
+  checkpoint from NFS) or, with ``weight_plane`` enabled, a peer-fanout
+  tree over the streaming distribution plane (system/weight_plane.py):
+  the origin uploads each byte once, holders serve chunks to siblings,
+  and the serve-interrupting cutover is dispatched (and measured)
+  separately from the overlapped transfer
 - GCs old param-realloc dumps
 
 Fault-domain isolation: servers are tracked through the health registry
@@ -122,6 +128,12 @@ class GserverManager(Worker):
         )
         self._rollout_seen: set = set()
         self._last_health_poll = 0.0
+
+        # Weight-distribution plane: manager-hosted origin fallback (only
+        # started when weight_plane is on and no trainer-side source is
+        # registered) + the last fanout's per-server stats for /status.
+        self._own_source = None
+        self._wp_last: Dict = {}
 
         self._http_loop = asyncio.new_event_loop()
         self._http_ready = threading.Event()
@@ -500,6 +512,7 @@ class GserverManager(Worker):
             healthy = self._healthy_urls()
             evicted = dict(self._evicted)
             versions = dict(self._server_versions)
+            wp_last = dict(self._wp_last)
         return web.json_response(
             {
                 "weight_version": self.weight_version,
@@ -509,6 +522,10 @@ class GserverManager(Worker):
                 "evicted_servers": evicted,
                 "server_versions": versions,
                 "prefix_cache": self.prefix_cache_fleet(),
+                # Last tree fanout: per-server transfer vs cutover ms
+                # (separate by design), the planned tree, and any
+                # evictions it caused. Empty when the plane is off.
+                "weight_plane": wp_last,
             }
         )
 
@@ -537,11 +554,280 @@ class GserverManager(Worker):
         self._new_version = v
         return path
 
+    # ------------------------------------------------------------------
+    # Weight-distribution plane (system/weight_plane.py)
+    # ------------------------------------------------------------------
+
+    def _weight_plane_origin(self, path: str) -> Optional[str]:
+        """The plane's origin URL, or None when the plane is disabled.
+        Prefers a trainer-side source registered in name_resolve (the
+        dump rank serving its own tmpfs/disk bytes); falls back to a
+        manager-hosted source over the NFS dump dir — still O(1) NFS
+        reads per version (one streaming read here) vs the legacy
+        O(n_servers) full re-reads."""
+        if not getattr(self.cfg, "weight_plane", False):
+            return None
+        try:
+            return name_resolve.get(
+                names.weight_plane_source(
+                    self.cfg.experiment_name, self.cfg.trial_name,
+                    self.cfg.model_name,
+                )
+            )
+        except name_resolve.NameEntryNotFoundError:
+            pass
+        if self._own_source is None:
+            from areal_tpu.base import network
+            from areal_tpu.system.weight_plane import WeightPlaneSource
+
+            # Bind the routable interface, not the 127.0.0.1 default:
+            # this URL is handed to generation servers on OTHER hosts.
+            self._own_source = WeightPlaneSource(
+                path, chunk_bytes=self.cfg.weight_chunk_bytes,
+                host=network.gethostip(),
+            ).start()
+            logger.info(
+                f"weight plane: no trainer-side source registered; "
+                f"manager-hosted origin at {self._own_source.address} "
+                f"over {path}"
+            )
+        return self._own_source.address
+
+    def _fetch_plane_manifest(self, origin: str, version: int) -> Dict:
+        """Pinned-version manifest from the origin, with a short retry:
+        model_version publication can race the dump landing on disk."""
+        from areal_tpu.engine.weight_client import fetch_manifest
+
+        deadline = time.monotonic() + 15.0
+        while True:
+            try:
+                return fetch_manifest(origin, version=version, timeout=5.0)
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    async def _post_distribute(self, sess, url, parent, payload, span):
+        edge_span = tracing.start_span(
+            "manager.weight_update.fetch",
+            ctx=span.ctx if span else None,
+            server=url, parent=parent,
+        )
+        try:
+            async with sess.post(
+                f"{url}/distribute_weights",
+                json=tracing.inject_ctx_into(
+                    dict(payload),
+                    edge_span.ctx if edge_span
+                    else (span.ctx if span else None),
+                ),
+            ) as r:
+                body = await r.json()
+            ok = bool(body.get("success"))
+        except Exception as e:
+            ok, body = False, {"error": repr(e)}
+        if edge_span is not None:
+            edge_span.end(
+                ok=ok,
+                transfer_ms=float(body.get("transfer_ms") or 0.0),
+                verify_ms=float(body.get("verify_ms") or 0.0),
+            )
+        return url, ok, body
+
+    async def _post_cutover(self, sess, url, version, span):
+        cut_span = tracing.start_span(
+            "manager.weight_update.cutover",
+            ctx=span.ctx if span else None, server=url,
+        )
+        try:
+            async with sess.post(
+                f"{url}/cutover_weights",
+                json=tracing.inject_ctx_into(
+                    {"version": version, "allow_interrupt": True,
+                     "budget_s": self.cfg.weight_cutover_budget_s},
+                    cut_span.ctx if cut_span
+                    else (span.ctx if span else None),
+                ),
+            ) as r:
+                body = await r.json()
+            ok = bool(body.get("success"))
+        except Exception as e:
+            ok, body = False, {"error": repr(e)}
+        if cut_span is not None:
+            cut_span.end(
+                ok=ok, cutover_ms=float(body.get("cutover_ms") or 0.0),
+                within_budget=bool(body.get("within_budget", True)),
+            )
+        return url, ok, body
+
+    def _plane_update_weights(self, origin: str):
+        """Tree fanout over the distribution plane, wave by wave.
+
+        Re-fanout on failure: an edge whose planned parent failed (or
+        died mid-transfer, PR 1 health) is re-parented onto a surviving
+        holder — the origin only as last resort — so one dead peer
+        costs its own subtree a hop, not a full origin re-upload. After
+        the transfer completes fleet-wide, every holder cuts over
+        concurrently: one short interrupt window per server, measured
+        separately from transfer."""
+        faults.maybe_fail("manager.plane_fanout")
+        from areal_tpu.system.weight_plane import plan_fanout
+
+        t_start = time.monotonic()
+        version = self._new_version
+        targets = self._healthy_urls()
+        if not targets:
+            raise RuntimeError(
+                "weight-plane fanout: no healthy generation servers"
+            )
+        fanout_span = tracing.start_span(
+            "manager.weight_update", version=version,
+            n_targets=len(targets), plane=True,
+        )
+        successes: List[str] = []
+        failures: Dict[str, str] = {}
+        transfer_ms: Dict[str, float] = {}
+        cutover_ms: Dict[str, float] = {}
+        ready: List[str] = []
+        try:
+            man = self._fetch_plane_manifest(origin, version)
+            waves = plan_fanout(
+                origin, targets, self.cfg.weight_fanout_degree
+            )
+
+            async def _run_wave(wave):
+                async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(
+                        # Headroom over the server-side fetch deadline
+                        # (deadline_s below): a transfer that finishes
+                        # just inside its deadline must not be timed out
+                        # client-side — that would mark a READY server
+                        # 'prefetch failed' and evict it healthy.
+                        total=self.cfg.flush_request_timeout + 10
+                    )
+                ) as sess:
+                    tasks = []
+                    for url, parent in wave:
+                        # Re-parent onto a surviving holder when the
+                        # planned parent never reached READY.
+                        eff = parent
+                        if eff != origin and eff not in ready:
+                            eff = ready[0] if ready else origin
+                        upstreams = (
+                            [eff]
+                            + [u for u in ready if u != eff][:2]
+                            + ([origin] if eff != origin else [])
+                        )
+                        tasks.append(self._post_distribute(
+                            sess, url, eff,
+                            {"version": version, "manifest": man,
+                             "upstreams": upstreams, "origin": origin,
+                             "deadline_s": self.cfg.flush_request_timeout},
+                            fanout_span,
+                        ))
+                    return await asyncio.gather(*tasks)
+
+            for wave in waves:
+                # Each wave can take a full transfer; keep our lease.
+                self._beat()
+                fut = asyncio.run_coroutine_threadsafe(
+                    _run_wave(wave), self._http_loop
+                )
+                for url, ok, body in fut.result(
+                    timeout=self.cfg.flush_request_timeout + 20
+                ):
+                    if ok:
+                        ready.append(url)
+                        transfer_ms[url] = float(
+                            body.get("transfer_ms") or 0.0
+                        )
+                    else:
+                        failures[url] = f"prefetch failed: {body}"
+            if not ready:
+                raise RuntimeError(
+                    f"weight plane v{version}: no server prefetched: "
+                    f"{failures}"
+                )
+
+            # Out-wait the server-side engine cutover timeout
+            # (generation_server: max(120, budget*10)) with headroom —
+            # a client timeout below it would evict a server whose
+            # slow-but-successful cutover is already serving the new
+            # version (the hazard _run_wave's own headroom guards).
+            cut_total = max(
+                self.cfg.flush_request_timeout, 120.0,
+                self.cfg.weight_cutover_budget_s * 10.0,
+            ) + 10
+
+            async def _run_cutovers():
+                async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=cut_total)
+                ) as sess:
+                    return await asyncio.gather(*[
+                        self._post_cutover(sess, u, version, fanout_span)
+                        for u in ready
+                    ])
+
+            self._beat()
+            fut = asyncio.run_coroutine_threadsafe(
+                _run_cutovers(), self._http_loop
+            )
+            for url, ok, body in fut.result(timeout=cut_total + 10):
+                if ok:
+                    successes.append(url)
+                    cutover_ms[url] = float(body.get("cutover_ms") or 0.0)
+                else:
+                    failures[url] = f"cutover failed: {body}"
+            if not successes:
+                raise RuntimeError(
+                    f"weight plane v{version}: no server cut over: "
+                    f"{failures}"
+                )
+        finally:
+            if fanout_span is not None:
+                fanout_span.end(
+                    n_success=len(successes), n_failed=len(failures)
+                )
+        for u, reason in failures.items():
+            self._mark_unhealthy(u, f"weight plane: {reason}")
+        with self._lock:
+            self.weight_version = version
+            for u in successes:
+                self._server_versions[u] = version
+            self.last_weight_sync_s = time.monotonic() - t_start
+            self._wp_last = {
+                "version": version,
+                "origin": origin,
+                "tree": [[list(e) for e in w] for w in waves],
+                "total_bytes": int(man["total_bytes"]),
+                "n_chunks": int(man["n_chunks"]),
+                "transfer_ms": dict(transfer_ms),
+                "cutover_ms": dict(cutover_ms),
+                "failures": dict(failures),
+                "sync_s": self.last_weight_sync_s,
+            }
+        lvl = logger.warning if failures else logger.info
+        lvl(
+            f"weight plane v{version}: {len(successes)}/{len(targets)} "
+            f"servers in {self.last_weight_sync_s:.3f}s "
+            f"(transfer max {max(transfer_ms.values(), default=0):.1f}ms, "
+            f"cutover max {max(cutover_ms.values(), default=0):.1f}ms"
+            + (f"; evicted {sorted(failures)}" if failures else "")
+            + ")"
+        )
+
     def flush_requests_and_update_weights(self, path: str):
         """Quorum-based fanout: push the new version to every HEALTHY
         server; the step proceeds when at least one succeeds. Failed
         servers are evicted (they re-sync on readmission), so a single
-        dead server degrades throughput instead of aborting training."""
+        dead server degrades throughput instead of aborting training.
+
+        With the weight plane enabled this dispatches to the streaming
+        tree fanout instead; the legacy NFS broadcast below stays both
+        as the default and as the re-sync path's mechanism."""
+        origin = self._weight_plane_origin(path)
+        if origin is not None:
+            return self._plane_update_weights(origin)
         t_start = time.monotonic()
         targets = self._healthy_urls()
         if not targets:
@@ -750,6 +1036,8 @@ class GserverManager(Worker):
 
     def _exit_hook(self):
         try:
+            if self._own_source is not None:
+                self._own_source.close()
             self._http_loop.call_soon_threadsafe(self._http_loop.stop)
             self._http_thread.join(timeout=5)
         except Exception:

@@ -476,12 +476,36 @@ class ModelWorker(Worker):
 
     def _exit_hook(self):
         try:
+            for src in getattr(self, "_wp_sources", {}).values():
+                src.close()
             self.stream.close()
             self.data_manager.close()
             if self._dataset is not None and hasattr(self._dataset, "close"):
                 self._dataset.close()
         except Exception:
             pass
+
+    def _ensure_weight_plane_source(self, role: str, dump_dir: str):
+        """Start (once per role) the trainer-side origin of the weight
+        plane and register its URL for manager discovery."""
+        sources = getattr(self, "_wp_sources", None)
+        if sources is None:
+            sources = self._wp_sources = {}
+        if role in sources:
+            return
+        from areal_tpu.base import network
+        from areal_tpu.system.weight_plane import WeightPlaneSource
+
+        src = WeightPlaneSource(
+            dump_dir,
+            chunk_bytes=getattr(self.cfg, "weight_chunk_bytes", 8 << 20),
+            host=network.gethostip(),
+        ).start()
+        src.register(self.cfg.experiment_name, self.cfg.trial_name, role)
+        sources[role] = src
+        logger.info(
+            f"weight-plane source for {role} at {src.address} over {dump_dir}"
+        )
 
     def _param_realloc(self, hook: Dict, step: int = 0):
         """Disk-mediated weight sync between model replicas (reference
@@ -523,16 +547,42 @@ class ModelWorker(Worker):
             params = jax.tree_util.tree_map(
                 lambda x: np.asarray(x), model.module.get_params()
             )
-            dump_s = dump_raw_params(params, d, version=step)
+            # Stamp the dump with model.version — the exact value
+            # _publish_version later announces — NOT the global step:
+            # the two counters differ (step counts MFC dispatches from
+            # 0; version increments inside train_step), and the
+            # generation server now VERIFIES the loaded dump matches
+            # the requested version (WeightVersionMismatch otherwise).
+            # Match the sidecar's chunk size to the plane's knob so the
+            # source serves the dump-time index instead of re-hashing.
+            cb = getattr(self.cfg, "weight_chunk_bytes", 8 << 20)
+            dump_s = dump_raw_params(
+                params, d, version=model.version, chunk_bytes=cb
+            )
             shm = shm_transfer_dir(
                 self.cfg.experiment_name, self.cfg.trial_name, role
             )
             if shm is not None:
-                dump_s += dump_raw_params(params, shm, version=step)
+                dump_s += dump_raw_params(
+                    params, shm, version=model.version, chunk_bytes=cb
+                )
             logger.info(
                 f"param_realloc dump for {role} step {step}: raw dump "
-                f"{dump_s:.3f}s (shm={'yes' if shm else 'no'})"
+                f"v{model.version} {dump_s:.3f}s "
+                f"(shm={'yes' if shm is not None else 'no'})"
             )
+            # Streaming weight-distribution plane: the dump rank exposes
+            # this role's raw-bin dumps over chunked HTTP so the gserver
+            # manager can fan the bytes out through a peer tree instead
+            # of every generation server re-reading the checkpoint from
+            # NFS. The source serves the tmpfs copy when one exists
+            # (page-cache-hot either way); armed by the experiment's
+            # gen_weight_plane knob or the AREAL_WEIGHT_PLANE env gate,
+            # so legacy deployments keep zero extra listeners.
+            if getattr(self.cfg, "weight_plane", False) or os.environ.get(
+                "AREAL_WEIGHT_PLANE"
+            ):
+                self._ensure_weight_plane_source(role, shm or d)
             tmp = os.path.join(d, "step.txt.tmp")
             with open(tmp, "w") as f:
                 f.write(str(step))

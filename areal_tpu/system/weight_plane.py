@@ -1,0 +1,541 @@
+"""Streaming weight-distribution plane: network-native weight updates.
+
+The disk-mediated path (system/weight_transfer.py) makes every
+generation server re-read the full checkpoint from NFS on every version
+bump — O(N * model_size) trainer/NFS egress per update. This module is
+the network-native replacement:
+
+- :class:`WeightPlaneSource` — the trainer-side dump rank (or the
+  gserver manager's NFS-backed fallback) exposes the existing raw-bin
+  dump format (``params-v{N}.bin`` + manifest) over chunked HTTP with
+  per-chunk content hashes and Range resume (base/chunking.py).
+- :func:`plan_fanout` — the gserver manager computes a degree-bounded
+  peer-fanout tree per version: the origin uploads each byte ONCE (to
+  its direct children); servers that already hold version N serve
+  chunks to their siblings, so fleet-wide distribution costs the origin
+  O(1) full payloads plus peer hops.
+- :class:`PeerStoreServer` — a standalone holder serving a fetched
+  :class:`~areal_tpu.engine.weight_client.ChunkStore` over the same
+  ``/weights/...`` contract; generation servers mount the equivalent
+  handlers on their existing HTTP app, and the bench workload
+  (``weight_update`` phase) uses this class directly.
+
+Transfer is overlapped with serving: a server prefetches version-N
+bytes into host memory while still serving N-1; the cutover (interrupt
++ device swap, ``ServingEngine.cutover_params``) is a separate, short,
+separately-measured window. Failure handling composes with the PR 1
+health plane: a peer that dies mid-transfer is evicted and its
+children re-fanout from surviving holders or the origin.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from aiohttp import web
+
+from areal_tpu.base import logging
+from areal_tpu.base.chunking import (
+    CHUNK_SCHEMA,
+    DEFAULT_CHUNK_BYTES,
+    build_chunk_index,
+)
+from areal_tpu.base.fault_injection import faults
+
+logger = logging.getLogger("weight_plane")
+
+_MANIFEST = "params.json"  # weight_transfer's manifest name
+
+
+# ----------------------------------------------------------------------
+# Manifest: raw dump + chunk index
+# ----------------------------------------------------------------------
+
+
+def _sidecar_index(
+    dump_dir: str, bin_name: str, chunk_bytes: int
+) -> Optional[Dict]:
+    """The precomputed chunk index dump_raw_params publishes next to the
+    bin — spares the origin a full re-read + sha256 of a multi-GB bin on
+    every version bump. None when absent or built with a different chunk
+    size (then the caller hashes the bin itself)."""
+    from areal_tpu.system.weight_transfer import chunk_sidecar_name
+
+    try:
+        with open(os.path.join(dump_dir, chunk_sidecar_name(bin_name))) as f:
+            idx = json.load(f)
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+    if (
+        idx.get("schema") != CHUNK_SCHEMA
+        or idx.get("chunk_bytes") != chunk_bytes
+    ):
+        return None
+    return idx
+
+
+def chunk_manifest_for_dump(
+    dump_dir: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+) -> Optional[Dict]:
+    """Merge the dump's params.json with a content-hash chunk index.
+    Returns None when no (complete, schema-matching) raw dump is present;
+    retries once on the GC race (manifest read, bin unlinked, manifest
+    replaced). The params.json read shares weight_transfer's reader so a
+    future raw-dump schema bump is refused here exactly like the mmap
+    path refuses it — not chunked and distributed with misread layout."""
+    from areal_tpu.system.weight_transfer import _read_manifest
+
+    for _ in range(2):
+        man = _read_manifest(dump_dir)
+        if man is None:
+            return None
+        try:
+            bin_name = man["bin"]
+            idx = _sidecar_index(dump_dir, bin_name, chunk_bytes)
+            if idx is None:
+                idx = build_chunk_index(
+                    os.path.join(dump_dir, bin_name), chunk_bytes
+                )
+        except FileNotFoundError:
+            continue
+        except (OSError, ValueError, KeyError):
+            return None
+        if idx["total_bytes"] != man.get("total_bytes"):
+            return None  # torn write (or a stale sidecar)
+        return {
+            **idx,
+            "version": int(man["version"]),
+            "bin": bin_name,
+            "leaves": man["leaves"],
+        }
+    return None
+
+
+# ----------------------------------------------------------------------
+# Shared HTTP surface (origin + peers speak the same contract)
+# ----------------------------------------------------------------------
+
+
+def parse_range_start(request: web.Request) -> int:
+    """``Range: bytes=<start>-`` -> start (0 when absent/malformed):
+    the resume offset for a torn chunk download."""
+    rng = request.headers.get("Range", "")
+    if rng.startswith("bytes=") and rng.endswith("-"):
+        try:
+            return max(0, int(rng[len("bytes="):-1]))
+        except ValueError:
+            return 0
+    return 0
+
+
+def chunk_response(data: memoryview, start: int, chunk_hash: str) -> web.Response:
+    if start >= len(data):
+        return web.json_response({"error": "range start past chunk"}, status=416)
+    return web.Response(
+        body=bytes(data[start:]),
+        status=206 if start else 200,
+        headers={
+            "X-Chunk-Hash": chunk_hash,
+            "X-Chunk-Bytes": str(len(data)),
+        },
+        content_type="application/octet-stream",
+    )
+
+
+def serve_store_manifest(store, request: web.Request) -> web.Response:
+    """Shared /weights/manifest contract for ChunkStore holders
+    (PeerStoreServer and the generation server's mounted handler)."""
+    want = request.query.get("version")
+    try:
+        want_v = int(want) if want is not None else None
+    except ValueError:
+        return web.json_response({"error": "bad version"}, status=400)
+    if store is None or (want_v is not None and store.version != want_v):
+        return web.json_response({"error": "not holding"}, status=404)
+    return web.json_response(store.manifest)
+
+
+def serve_store_chunk(
+    store, request: web.Request
+) -> Tuple[web.Response, int]:
+    """Shared /weights/chunk contract for ChunkStore holders. Returns
+    ``(response, bytes_served)`` so each caller keeps its own egress
+    bookkeeping. A fetching holder 404s chunks it hasn't verified yet;
+    the child retries or falls back to the next upstream."""
+    try:
+        version = int(request.query["version"])
+        idx = int(request.query["idx"])
+    except (KeyError, ValueError):
+        return (
+            web.json_response({"error": "version/idx required"}, status=400),
+            0,
+        )
+    if store is None or store.version != version or not store.has(idx):
+        return web.json_response({"error": "chunk not held"}, status=404), 0
+    data = store.chunk(idx)
+    start = parse_range_start(request)
+    return (
+        chunk_response(data, start, store.manifest["hashes"][idx]),
+        max(0, len(data) - start),
+    )
+
+
+class _PlaneHTTP:
+    """Own-thread aiohttp server shared by the origin and peer holders."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._port = host, port
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self.address: str = ""
+
+    def routes(self, app: web.Application):
+        raise NotImplementedError
+
+    def start(self):
+        self._thread.start()
+        if not self._ready.wait(30):
+            raise RuntimeError("weight-plane HTTP failed to start")
+        return self
+
+    def _serve(self):
+        asyncio.set_event_loop(self._loop)
+        app = web.Application()
+        self.routes(app)
+        runner = web.AppRunner(app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        self._loop.run_until_complete(site.start())
+        port = site._server.sockets[0].getsockname()[1]
+        self.address = f"http://{self._host}:{port}"
+        self._ready.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            # close() stopped the loop: release the listening socket and
+            # the loop's fds here, in the owning thread — holders are
+            # created per fanout, so leaking them accumulates.
+            try:
+                self._loop.run_until_complete(runner.cleanup())
+            except Exception:
+                pass
+            self._loop.close()
+
+    def close(self):
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+        except Exception:
+            pass
+
+
+class WeightPlaneSource(_PlaneHTTP):
+    """Trainer-side origin: serves the raw-bin dump dir over chunked
+    HTTP. Lazily (re)builds the chunk index per version and counts every
+    byte it egresses — the fleet's O(1)-origin-payload property is
+    asserted straight off these counters."""
+
+    def __init__(
+        self,
+        dump_dir: str,
+        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+        host: str = "127.0.0.1",
+    ):
+        super().__init__(host=host)
+        self.dump_dir = dump_dir
+        self.chunk_bytes = chunk_bytes
+        self._man: Optional[Dict] = None
+        self._lock = threading.Lock()
+        # Serializes manifest (re)builds WITHOUT blocking chunk serving:
+        # a rebuild may sha256 the whole bin (sidecar missing), and
+        # holding self._lock for that would stall every concurrent
+        # _read_chunk counter update and stats() call.
+        self._build_lock = threading.Lock()
+        # Per-version egress counters (monotonic; survive re-dumps).
+        self.chunks_served: Dict[int, int] = {}
+        self.bytes_served: Dict[int, int] = {}
+        # Payload size per version served: full_payload_equivalents must
+        # divide each version's egress by ITS OWN total, not whichever
+        # manifest happens to be cached when stats() is read.
+        self._payload_bytes: Dict[int, int] = {}
+
+    def routes(self, app: web.Application):
+        app.router.add_get("/weights/manifest", self._h_manifest)
+        app.router.add_get("/weights/chunk", self._h_chunk)
+        app.router.add_get("/weights/stats", self._h_stats)
+
+    def register(self, experiment_name: str, trial_name: str, model_name: str):
+        """Publish this origin's URL for manager discovery."""
+        from areal_tpu.base import name_resolve, names
+
+        name_resolve.add(
+            names.weight_plane_source(experiment_name, trial_name, model_name),
+            self.address,
+            keepalive_ttl=60,
+            replace=True,
+        )
+        return self
+
+    def _dump_version(self) -> Optional[int]:
+        """The dump dir's CURRENT version, off the (tiny) params.json
+        alone — no bin hashing."""
+        try:
+            with open(os.path.join(self.dump_dir, _MANIFEST)) as f:
+                return int(json.load(f)["version"])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
+
+    def _cached_manifest(self, want_version: Optional[int]) -> Optional[Dict]:
+        """The cached chunk manifest, or None when it can't serve this
+        request (absent, wrong pinned version, or — for an unpinned
+        request, which wants the LATEST dump — lagging a newer version
+        whose predecessor's bin may already be GC'd)."""
+        with self._lock:
+            man = self._man
+        if man is None:
+            return None
+        if want_version is None:
+            cur = self._dump_version()
+            if cur is not None and cur != man["version"]:
+                return None
+            return man
+        return man if man["version"] == want_version else None
+
+    def _manifest(self, want_version: Optional[int]) -> Optional[Dict]:
+        man = self._cached_manifest(want_version)
+        if man is not None:
+            return man
+        # A rebuild may hash the full bin (sidecar missing). Check the
+        # (tiny) dump manifest first: requests pinned to a version this
+        # dir does NOT hold (e.g. retries for v N after v N+1 landed)
+        # must 404 cheaply, not re-hash per attempt.
+        if want_version is not None and self._dump_version() != want_version:
+            return None
+        with self._build_lock:
+            man = self._cached_manifest(want_version)  # built while we waited
+            if man is None:
+                man = chunk_manifest_for_dump(self.dump_dir, self.chunk_bytes)
+                if man is not None:
+                    with self._lock:
+                        self._man = man
+        if man is None:
+            return None
+        if want_version is not None and man["version"] != want_version:
+            return None
+        return man
+
+    async def _h_manifest(self, request: web.Request) -> web.Response:
+        want = request.query.get("version")
+        try:
+            want_v = int(want) if want is not None else None
+        except ValueError:
+            return web.json_response({"error": "bad version"}, status=400)
+        # A cache miss sha256-hashes the whole bin (build_chunk_index):
+        # off the event loop, so pending chunk requests keep flowing.
+        man = await asyncio.get_running_loop().run_in_executor(
+            None, self._manifest, want_v
+        )
+        if man is None:
+            return web.json_response(
+                {"error": "no dump for requested version", "retry_after": 0.2},
+                status=404,
+            )
+        return web.json_response(man)
+
+    def _read_chunk(
+        self, version: int, idx: int, start: int
+    ) -> web.Response:
+        """Blocking part of /weights/chunk (manifest build + pread),
+        run on an executor thread."""
+        man = self._manifest(version)
+        if man is None or not (0 <= idx < man["n_chunks"]):
+            return web.json_response({"error": "unknown chunk"}, status=404)
+        off = idx * man["chunk_bytes"]
+        length = min(man["chunk_bytes"], man["total_bytes"] - off)
+        # One pread per request off the page cache; the bin is mmap-hot
+        # on the dump host already (the shm/disk fast paths read it too).
+        try:
+            with open(os.path.join(self.dump_dir, man["bin"]), "rb") as f:
+                f.seek(off)
+                data = f.read(length)
+        except OSError:
+            return web.json_response({"error": "bin vanished (GC race)"}, status=404)
+        if len(data) != length:
+            return web.json_response({"error": "short read"}, status=404)
+        with self._lock:
+            self.chunks_served[version] = self.chunks_served.get(version, 0) + 1
+            self.bytes_served[version] = (
+                self.bytes_served.get(version, 0) + max(0, length - start)
+            )
+            self._payload_bytes[version] = man["total_bytes"]
+        return chunk_response(memoryview(data), start, man["hashes"][idx])
+
+    async def _h_chunk(self, request: web.Request) -> web.Response:
+        await faults.maybe_fail_async("weight_plane.serve_chunk")
+        try:
+            version = int(request.query["version"])
+            idx = int(request.query["idx"])
+        except (KeyError, ValueError):
+            return web.json_response({"error": "version/idx required"}, status=400)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._read_chunk, version, idx, parse_range_start(request)
+        )
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "chunks_served": dict(self.chunks_served),
+                "bytes_served": dict(self.bytes_served),
+                # Full-payload equivalents egressed per version: the
+                # number the O(1)-origin assertion is written against.
+                # Each version divides by its own payload size —
+                # payloads can differ across versions and the counters
+                # outlive the cached manifest.
+                "full_payload_equivalents": {
+                    v: (b / self._payload_bytes[v]
+                        if self._payload_bytes.get(v) else 0.0)
+                    for v, b in self.bytes_served.items()
+                },
+            }
+
+    async def _h_stats(self, request: web.Request) -> web.Response:
+        return web.json_response(self.stats())
+
+
+class PeerStoreServer(_PlaneHTTP):
+    """Serve a fetched ChunkStore over the same /weights contract (a
+    'holder'). The bench workload builds its fanout fleet from these;
+    generation servers mount equivalent handlers on their own app."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        super().__init__(host=host)
+        self.store = None  # engine.weight_client.ChunkStore
+        self.chunks_served = 0
+        self.bytes_served = 0
+
+    def routes(self, app: web.Application):
+        app.router.add_get("/weights/manifest", self._h_manifest)
+        app.router.add_get("/weights/chunk", self._h_chunk)
+
+    async def _h_manifest(self, request: web.Request) -> web.Response:
+        return serve_store_manifest(self.store, request)
+
+    async def _h_chunk(self, request: web.Request) -> web.Response:
+        await faults.maybe_fail_async("weight_plane.serve_chunk")
+        # Off the loop (like the origin's _h_chunk): the copy is up to a
+        # full chunk, and a holder fields one request per chunk per child.
+        resp, served = await asyncio.get_running_loop().run_in_executor(
+            None, serve_store_chunk, self.store, request
+        )
+        if served:
+            self.chunks_served += 1
+            self.bytes_served += served
+        return resp
+
+
+# ----------------------------------------------------------------------
+# Fanout planning
+# ----------------------------------------------------------------------
+
+
+def plan_fanout(
+    origin_url: str, server_urls: List[str], degree: int
+) -> List[List[Tuple[str, str]]]:
+    """Degree-bounded distribution tree as BFS waves.
+
+    Returns ``[[(server_url, parent_url), ...], ...]`` — wave k's servers
+    fetch from parents that completed in wave k-1 (wave 0's parent is the
+    origin). With the canonical k-ary layout over the sorted server list,
+    server i's parent is ``servers[i // degree - 1]`` (origin for
+    ``i < degree``), so the origin uploads at most ``degree`` copies of
+    each byte and every other hop is peer-to-peer."""
+    if degree < 1:
+        raise ValueError(f"fanout degree must be >= 1, got {degree}")
+    servers = list(server_urls)
+    waves: List[List[Tuple[str, str]]] = []
+    level: Dict[str, int] = {}
+    for i, u in enumerate(servers):
+        parent = origin_url if i < degree else servers[i // degree - 1]
+        lvl = 0 if i < degree else level[parent] + 1
+        level[u] = lvl
+        while len(waves) <= lvl:
+            waves.append([])
+        waves[lvl].append((u, parent))
+    return waves
+
+
+def fanout_edges(waves: List[List[Tuple[str, str]]]) -> List[Tuple[str, str]]:
+    return [edge for wave in waves for edge in wave]
+
+
+# ----------------------------------------------------------------------
+# Host-level convenience: run one fanout over plain holders (bench +
+# chaos tests drive this; the gserver manager has its own async variant
+# integrated with health/tracing).
+# ----------------------------------------------------------------------
+
+
+def distribute_to_stores(
+    origin_url: str,
+    n_holders: int,
+    degree: int,
+    version: Optional[int] = None,
+    timeout: float = 30.0,
+) -> Tuple[List[PeerStoreServer], Dict]:
+    """Fetch one payload from `origin_url` into `n_holders` fresh
+    PeerStoreServers along a degree-bounded tree, wave by wave. Returns
+    (holders, stats). Caller owns holder shutdown."""
+    from areal_tpu.engine.weight_client import ChunkStore, fetch_manifest
+
+    man = fetch_manifest(origin_url, version=version, timeout=timeout)
+    holders = [PeerStoreServer().start() for _ in range(n_holders)]
+    by_url = {h.address: h for h in holders}
+    waves = plan_fanout(origin_url, [h.address for h in holders], degree)
+    t0 = time.monotonic()
+    per_holder: Dict[str, Dict] = {}
+    completed: List[str] = []
+    for wave in waves:
+        threads = []
+        for url, parent in wave:
+            holder = by_url[url]
+            holder.store = ChunkStore(man)
+            # Fallback order mirrors the gserver manager's: surviving
+            # PEER holders before the origin, so a holder that dies
+            # mid-chain re-fanouts from a sibling and origin egress
+            # stays O(1) even under chaos.
+            fallbacks = [u for u in completed if u != parent][:2]
+
+            def run(h=holder, p=parent, fb=fallbacks):
+                stats = h.store.fetch(
+                    [p] + fb + [origin_url], origin=origin_url,
+                    timeout=timeout,
+                )
+                per_holder[h.address] = stats
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=timeout * max(1, man["n_chunks"]))
+        completed.extend(u for u, _ in wave if u in per_holder)
+    missing = [u for u, _ in fanout_edges(waves) if u not in per_holder]
+    if missing:
+        # This function owns the holders until it returns them: close
+        # them on the failure path (each pins an event-loop thread, a
+        # socket, and a payload-sized buffer).
+        for h in holders:
+            h.close()
+        raise RuntimeError(f"fanout incomplete: {missing} never finished")
+    return holders, {
+        "version": man["version"],
+        "total_bytes": man["total_bytes"],
+        "n_chunks": man["n_chunks"],
+        "wall_s": time.monotonic() - t0,
+        "per_holder": per_holder,
+    }

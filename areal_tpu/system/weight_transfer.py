@@ -32,11 +32,21 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from areal_tpu.base import logging
+from areal_tpu.base.chunking import DEFAULT_CHUNK_BYTES, StreamChunker
 
 logger = logging.getLogger("weight_transfer")
 
 _MANIFEST = "params.json"
 _SCHEMA = 1
+
+
+class WeightVersionMismatch(RuntimeError):
+    """load_for_serving found weights, but not the requested version.
+
+    Serving them anyway would pin a stale (or unverifiable, version -1
+    pickle/HF) dump under the new version label — the exact accounting
+    hole the staleness gate can't see. Callers fail the update instead;
+    the manager's eviction/readmission path re-syncs the server."""
 
 
 def shm_transfer_dir(experiment_name: str, trial_name: str, role: str) -> Optional[str]:
@@ -62,10 +72,26 @@ def _flatten(params: Any, prefix: Tuple[str, ...] = ()) -> list:
     return [("/".join(prefix), params)]
 
 
-def dump_raw_params(params: Any, dump_dir: str, version: int) -> float:
+def chunk_sidecar_name(bin_name: str) -> str:
+    """Chunk-index sidecar for a bin (``params-v{N}.chunks.json``)."""
+    return bin_name[: -len(".bin")] + ".chunks.json"
+
+
+def dump_raw_params(
+    params: Any, dump_dir: str, version: int,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> float:
     """Write the raw dump; returns seconds spent. Safe against concurrent
     readers (see module docstring); single writer assumed (the dp-rank-0
-    dump rule, system/model_worker._param_realloc)."""
+    dump rule, system/model_worker._param_realloc).
+
+    Also publishes a ``params-v{N}.chunks.json`` sidecar: the content
+    hashes of the bin's fixed-size chunks, computed while the bytes
+    stream through this loop anyway — the weight-plane origin serves its
+    chunk manifest from this instead of re-reading + re-hashing the
+    whole bin on every version bump (``chunk_bytes`` should match the
+    plane's ``weight_chunk_bytes`` knob; a mismatched sidecar is simply
+    ignored by the reader)."""
     t0 = time.monotonic()
     os.makedirs(dump_dir, exist_ok=True)
     leaves = _flatten(params)
@@ -75,11 +101,14 @@ def dump_raw_params(params: Any, dump_dir: str, version: int) -> float:
         "leaves": [],
     }
     offset = 0
+    chunker = StreamChunker(chunk_bytes)
     tmp_bin = os.path.join(dump_dir, bin_name + f".tmp.{os.getpid()}")
     with open(tmp_bin, "wb") as f:
         for path, leaf in leaves:
             arr = np.ascontiguousarray(np.asarray(leaf))
-            f.write(arr.tobytes())
+            data = arr.tobytes()
+            f.write(data)
+            chunker.update(data)
             # dtype.name (not .str): ml_dtypes types like bfloat16 have
             # .str '<V2' which round-trips to a raw void type.
             manifest["leaves"].append(
@@ -87,27 +116,47 @@ def dump_raw_params(params: Any, dump_dir: str, version: int) -> float:
                  "shape": list(arr.shape), "offset": offset}
             )
             offset += arr.nbytes
+        # fsync BEFORE the rename pair below: rename ordering alone is
+        # only crash-safe within one file. Without it a host crash can
+        # persist the (later-written) manifest but not the bin's data
+        # blocks — a manifest pointing at unsynced bytes that would pass
+        # the size check and serve garbage weights.
+        f.flush()
+        os.fsync(f.fileno())
     manifest["total_bytes"] = offset
     os.replace(tmp_bin, os.path.join(dump_dir, bin_name))
+    sidecar = chunk_sidecar_name(bin_name)
+    tmp_sc = os.path.join(dump_dir, sidecar + f".tmp.{os.getpid()}")
+    with open(tmp_sc, "w") as f:
+        json.dump(chunker.finish(), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp_sc, os.path.join(dump_dir, sidecar))
     tmp_man = os.path.join(dump_dir, _MANIFEST + f".tmp.{os.getpid()}")
     with open(tmp_man, "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp_man, os.path.join(dump_dir, _MANIFEST))
-    # GC old bins (keep the newest 2 so an in-flight reader can finish).
+    # GC old bins + their sidecars (keep the newest 2 so an in-flight
+    # reader can finish).
     bins = sorted(
         (b for b in os.listdir(dump_dir)
          if b.startswith("params-v") and b.endswith(".bin")),
         key=lambda b: int(b[len("params-v"):-len(".bin")]),
     )
     for b in bins[:-2]:
-        try:
-            os.unlink(os.path.join(dump_dir, b))
-        except OSError:
-            pass
+        for victim in (b, chunk_sidecar_name(b)):
+            try:
+                os.unlink(os.path.join(dump_dir, victim))
+            except OSError:
+                pass
     return time.monotonic() - t0
 
 
-def _unflatten(leaves: Dict[str, np.ndarray]) -> Any:
+def unflatten_leaves(leaves: Dict[str, np.ndarray]) -> Any:
+    """path->array mapping back into the nested-dict pytree (shared with
+    the weight plane's host-buffer assembly, engine/weight_client.py)."""
     root: Dict[str, Any] = {}
     for path, arr in leaves.items():
         node = root
@@ -118,57 +167,95 @@ def _unflatten(leaves: Dict[str, np.ndarray]) -> Any:
     return root
 
 
-def load_raw_params(dump_dir: str) -> Optional[Tuple[Any, int]]:
-    """mmap the latest raw dump: (params pytree of memory-mapped arrays,
-    dump version), or None if absent/torn (caller falls back)."""
+def _read_manifest(dump_dir: str) -> Optional[Dict[str, Any]]:
     try:
-        import ml_dtypes  # noqa: F401  registers bfloat16 et al. by name
-
         with open(os.path.join(dump_dir, _MANIFEST)) as f:
             manifest = json.load(f)
-        if manifest.get("schema") != _SCHEMA:
-            return None
-        mm = np.memmap(
-            os.path.join(dump_dir, manifest["bin"]), mode="r", dtype=np.uint8
-        )
-        if mm.size != manifest["total_bytes"]:
-            return None  # torn write
-        leaves = {}
-        for e in manifest["leaves"]:
-            dt = np.dtype(e["dtype"])
-            n = int(np.prod(e["shape"])) * dt.itemsize
-            leaves[e["path"]] = (
-                mm[e["offset"]: e["offset"] + n].view(dt).reshape(e["shape"])
-            )
-        return _unflatten(leaves), int(manifest["version"])
-    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+    except (OSError, ValueError, json.JSONDecodeError):
         return None
+    if manifest.get("schema") != _SCHEMA:
+        return None
+    return manifest
 
 
-def load_for_serving(
-    model_path: str, shm_dir: Optional[str] = None
-) -> Tuple[Any, Dict[str, Any]]:
-    """Load params for a generation server's weight update, fastest source
-    first. Returns (params, info) where info records the source and load
-    seconds for the /metrics surface:
+def load_raw_params(dump_dir: str) -> Optional[Tuple[Any, int]]:
+    """mmap the latest raw dump: (params pytree of memory-mapped arrays,
+    dump version), or None if absent/torn (caller falls back).
 
-    1. ``shm_dir`` raw dump      — same-host tmpfs fast path
-    2. ``model_path`` raw dump   — mmap from page cache / NFS
-    3. ``model_path`` pickle     — engine_state.pkl (checkpoint fallback)
-    4. ``model_path`` HF dir     — cold start from an HF checkpoint
-    """
-    t0 = time.monotonic()
+    A reader can race the dump GC: the manifest it read names a bin the
+    writer just unlinked (GC keeps only the newest 2). That race means a
+    NEWER dump exists — re-read the manifest once and retry against it
+    rather than silently falling through to a stale pickle."""
+    import ml_dtypes  # noqa: F401  registers bfloat16 et al. by name
+
+    for _attempt in range(2):
+        manifest = _read_manifest(dump_dir)
+        if manifest is None:
+            return None
+        try:
+            mm = np.memmap(
+                os.path.join(dump_dir, manifest["bin"]), mode="r",
+                dtype=np.uint8,
+            )
+        except FileNotFoundError:
+            continue  # GC race: refreshed manifest names the new bin
+        except (OSError, ValueError, KeyError):
+            return None  # malformed manifest: caller falls back
+        try:
+            if mm.size != manifest["total_bytes"]:
+                return None  # torn write
+            leaves = {}
+            for e in manifest["leaves"]:
+                dt = np.dtype(e["dtype"])
+                n = int(np.prod(e["shape"])) * dt.itemsize
+                leaves[e["path"]] = (
+                    mm[e["offset"]: e["offset"] + n].view(dt).reshape(e["shape"])
+                )
+            return unflatten_leaves(leaves), int(manifest["version"])
+        except (ValueError, KeyError):
+            return None
+    return None
+
+
+def _load_once(
+    model_path: str,
+    shm_dir: Optional[str],
+    t0: float,
+    want_version: Optional[int] = None,
+    raw_seen: Optional[Dict[str, int]] = None,
+):
+    """One pass down the fallback chain. With ``want_version`` pinned, a
+    raw dump holding the WRONG version falls through to the next source
+    instead of shadowing it — e.g. a tmpfs dump lagging one version
+    behind the NFS dump (writer crashed between the two dumps) must not
+    hide the matching disk copy. Mismatched raw versions are recorded in
+    ``raw_seen`` for the caller's error message."""
     if shm_dir is not None:
         got = load_raw_params(shm_dir)
         if got is not None:
             params, v = got
-            return params, {"source": "shm_raw", "version": v,
-                            "load_s": time.monotonic() - t0}
+            if want_version is None or v == want_version:
+                return params, {"source": "shm_raw", "version": v,
+                                "load_s": time.monotonic() - t0}
+            if raw_seen is not None:
+                raw_seen["shm_raw"] = v
     got = load_raw_params(model_path)
     if got is not None:
         params, v = got
+        if want_version is not None and v != want_version and raw_seen is not None:
+            raw_seen["disk_raw"] = v
+        # A mismatched disk raw still ends the chain: pickle/HF below
+        # are version -1 (strictly less informative), and its intact
+        # version lets the caller's retry loop wait for the right dump
+        # and report exactly what it saw.
         return params, {"source": "disk_raw", "version": v,
                         "load_s": time.monotonic() - t0}
+    if want_version is not None:
+        # pickle/HF always report version -1: they can NEVER satisfy a
+        # pinned version, so skip their multi-GB deserialization instead
+        # of paying it once per retry while waiting for the raw dump.
+        return None, {"source": "no_raw_dump", "version": -1,
+                      "load_s": time.monotonic() - t0}
     state_file = os.path.join(model_path, "engine_state.pkl")
     if os.path.exists(state_file):
         import pickle
@@ -182,3 +269,66 @@ def load_for_serving(
     _, params = load_hf_model(model_path)
     return params, {"source": "hf", "version": -1,
                     "load_s": time.monotonic() - t0}
+
+
+def load_for_serving(
+    model_path: str,
+    shm_dir: Optional[str] = None,
+    want_version: Optional[int] = None,
+    retries: Optional[int] = None,
+    retry_s: Optional[float] = None,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Load params for a generation server's weight update, fastest source
+    first. Returns (params, info) where info records the source and load
+    seconds for the /metrics surface:
+
+    1. ``shm_dir`` raw dump      — same-host tmpfs fast path
+    2. ``model_path`` raw dump   — mmap from page cache / NFS
+    3. ``model_path`` pickle     — engine_state.pkl (checkpoint fallback)
+    4. ``model_path`` HF dir     — cold start from an HF checkpoint
+
+    With ``want_version`` set, the loaded dump's version must MATCH it.
+    The pickle/HF fallbacks report version -1 and a raw dump can lag the
+    publisher; accepting either would pin stale weights under the new
+    version label, silently corrupting routing and the staleness gate.
+    The chain itself is version-aware: a raw dump holding the wrong
+    version falls through to the next source (a stale tmpfs copy must
+    not shadow the matching NFS dump). A miss is retried (the dump may
+    still be landing — cross-host NFS attribute caching can lag the
+    publisher by seconds, and a pinned retry is just a manifest read
+    since it skips the pickle/HF deserialization), then raised as
+    :class:`WeightVersionMismatch` so the caller fails the update and
+    eviction/readmission re-syncs the server instead. The default
+    budget (``AREAL_WEIGHT_LOAD_RETRIES`` x ``AREAL_WEIGHT_LOAD_RETRY_S``,
+    40 x 0.25 s = 10 s) matches the plane path's manifest-retry scale.
+    """
+    t0 = time.monotonic()
+    if retries is None:
+        retries = int(os.environ.get("AREAL_WEIGHT_LOAD_RETRIES", "40"))
+    if retry_s is None:
+        retry_s = float(os.environ.get("AREAL_WEIGHT_LOAD_RETRY_S", "0.25"))
+    attempts = max(1, retries)
+    last_info = None
+    raw_seen: Dict[str, int] = {}
+    for attempt in range(attempts):
+        params, info = _load_once(
+            model_path, shm_dir, t0,
+            want_version=want_version, raw_seen=raw_seen,
+        )
+        if want_version is None or info["version"] == want_version:
+            return params, info
+        last_info = info
+        if attempt < attempts - 1:
+            time.sleep(retry_s)
+    raise WeightVersionMismatch(
+        f"requested weight version {want_version} but "
+        + (
+            "no raw dump was available"
+            if last_info["source"] == "no_raw_dump"
+            else f"{last_info['source']} dump holds version "
+            f"{last_info['version']}"
+        )
+        + f" after {attempts} attempt(s) (model_path={model_path}"
+        + (f", mismatched raw dumps seen: {raw_seen}" if raw_seen else "")
+        + ")"
+    )

@@ -31,6 +31,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from areal_tpu.bench import bank  # noqa: E402
 
+# Per-phase value schemas: an ok MEASURE record for these phases must
+# carry every listed numeric key. Catches a phase body drifting away
+# from what the report/readers consume without anything failing loudly.
+PHASE_VALUE_KEYS: Dict[str, tuple] = {
+    "weight_update": (
+        "weight_update_ms", "weight_transfer_ms", "weight_cutover_ms",
+        "origin_full_payloads",
+    ),
+}
+
+
+def validate_phase_value(name: str, rec: Dict) -> List[str]:
+    """Schema problems for one banked record's value dict (measure/ok
+    records of phases with a declared schema only)."""
+    keys = PHASE_VALUE_KEYS.get(name)
+    if not keys or rec.get("status") != "ok" or rec.get("pass") != "measure":
+        return []
+    problems = []
+    val = rec.get("value") or {}
+    for k in keys:
+        if not isinstance(val.get(k), (int, float)) or isinstance(
+            val.get(k), bool
+        ):
+            problems.append(f"{name}: measure value missing numeric {k!r}")
+    ofp = val.get("origin_full_payloads")
+    if isinstance(ofp, (int, float)) and ofp > 1.05:
+        # The plane's whole point: each byte leaves the origin once.
+        problems.append(
+            f"{name}: origin served {ofp:.2f} full payloads — peer "
+            f"fanout silently degraded to an origin broadcast"
+        )
+    return problems
+
 
 def validate_report(rep: Dict, require_driver: bool = False) -> List[str]:
     problems: List[str] = []
@@ -58,6 +91,9 @@ def validate_report(rep: Dict, require_driver: bool = False) -> List[str]:
             except ValueError as e:
                 problems.append(f"{section}/{name}: {e}")
                 continue
+            problems.extend(
+                f"{section}/{p}" for p in validate_phase_value(name, rec)
+            )
             if section == "phases":
                 measures[name] = rec
             if section == "proxy" and rec["attestation"].get("driver_verified"):
@@ -122,6 +158,11 @@ def validate_bank_dir(path: str) -> List[str]:
             bank.validate_record(rec)
         except ValueError as e:
             problems.append(f"{name}: {e}")
+            continue
+        problems.extend(
+            f"{name}: {p}"
+            for p in validate_phase_value(str(rec.get("phase")), rec)
+        )
     if seen == 0:
         problems.append(f"bank dir {path!r} holds no records")
     return problems

@@ -1,0 +1,75 @@
+"""Content-addressed chunking (base/chunking.py): span math, index
+build, torn-write detection, and hash verification — the shared
+"what is a chunk" definition the weight plane's source, client, and
+bench workload all depend on."""
+
+import os
+
+import pytest
+
+from areal_tpu.base.chunking import (
+    CHUNK_SCHEMA,
+    build_chunk_index,
+    chunk_spans,
+    hash_chunk,
+    verify_chunk,
+)
+
+
+def test_chunk_spans_cover_exactly():
+    spans = chunk_spans(10, 4)
+    assert spans == [(0, 4), (4, 4), (8, 2)]
+    # Exact multiple: no short tail.
+    assert chunk_spans(8, 4) == [(0, 4), (4, 4)]
+    # Zero-byte payload has zero chunks.
+    assert chunk_spans(0, 4) == []
+
+
+def test_chunk_spans_rejects_bad_chunk_size():
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        chunk_spans(10, 0)
+
+
+def test_build_index_roundtrip(tmp_path):
+    payload = bytes(range(256)) * 40  # 10240 bytes
+    p = tmp_path / "params.bin"
+    p.write_bytes(payload)
+    idx = build_chunk_index(str(p), chunk_bytes=4096)
+    assert idx["schema"] == CHUNK_SCHEMA
+    assert idx["total_bytes"] == len(payload)
+    assert idx["n_chunks"] == 3
+    # Every hash verifies against the actual bytes, and a flipped byte
+    # fails exactly its own chunk.
+    for i, (off, length) in enumerate(chunk_spans(len(payload), 4096)):
+        assert verify_chunk(payload[off:off + length], idx["hashes"][i])
+    corrupt = bytearray(payload)
+    corrupt[4100] ^= 0xFF
+    assert not verify_chunk(corrupt[4096:8192], idx["hashes"][1])
+    assert verify_chunk(corrupt[:4096], idx["hashes"][0])
+
+
+def test_build_index_detects_concurrent_truncation(tmp_path):
+    """The GC/torn-write race: the bin shrinks between getsize and the
+    read — build_chunk_index must raise (callers retry on a refreshed
+    manifest), never return an index for bytes it didn't hash."""
+    p = tmp_path / "params.bin"
+    p.write_bytes(b"x" * 8192)
+
+    real_getsize = os.path.getsize
+
+    def lying_getsize(path):
+        return real_getsize(path) + 4096  # pretends the bin is longer
+
+    orig = os.path.getsize
+    os.path.getsize = lying_getsize
+    try:
+        with pytest.raises(OSError, match="short read"):
+            build_chunk_index(str(p), chunk_bytes=4096)
+    finally:
+        os.path.getsize = orig
+
+
+def test_hash_accepts_memoryview():
+    data = b"hello chunk"
+    assert hash_chunk(memoryview(data)) == hash_chunk(data)
+    assert verify_chunk(memoryview(data), hash_chunk(data))

@@ -205,3 +205,56 @@ def test_validator_rejects_tampered_evidence(bench_env):
     }
     assert validator.validate_report(ok_proxy, require_driver=False) == []
     assert validator.validate_report(ok_proxy, require_driver=True)
+
+
+def test_weight_update_phase_survives_peer_chaos_and_validates(
+    bench_env, monkeypatch
+):
+    """ISSUE 5 CI satellite: the weight_update phase, run through the
+    subprocess runner with AREAL_FAULTS killing a mid-transfer peer
+    (the middle holder of the chain dies serving its child), must
+    re-fanout from the SURVIVING peer, bank an ok record that still
+    carries the O(1)-origin-egress invariant, and leave a bank + report
+    that validate clean."""
+    import pytest as _pytest
+
+    b, _ = bench_env
+    # The phase moves a 16 MiB payload in 1 MiB chunks along a 3-holder
+    # chain; waves are strictly ordered, so /weights/chunk hits 33-48
+    # are h1 serving h2. Fire all 3 retry attempts of h2's chunk 7:
+    # h1 "dies" mid-serve and h2 must re-fanout from h0, not the origin.
+    monkeypatch.setenv(
+        "AREAL_FAULTS", "weight_plane.serve_chunk=raise:k=40:n=3"
+    )
+    rec = runner.run_phase(
+        "weight_update", "measure", b, deadline_s=scale_timeout(300)
+    )
+    monkeypatch.delenv("AREAL_FAULTS")
+    assert rec["status"] == "ok", rec
+    val = rec["value"]
+    # Re-fanout went peer-to-peer: the origin still egressed exactly
+    # one payload, and the transfer/cutover split is intact.
+    assert val["origin_full_payloads"] == _pytest.approx(1.0)
+    assert val["weight_transfer_ms"] > 0.0
+    assert val["weight_cutover_ms"] > 0.0
+    assert val["weight_update_ms"] >= val["weight_transfer_ms"]
+
+    validator = _load_validator()
+    assert validator.validate_bank_dir(b) == []
+    rep = report.build_report(b)
+    assert validator.validate_report(rep) == []
+
+    # The validator's schema coverage has teeth: strip a required key /
+    # degrade the invariant and the same record must now fail.
+    tampered = json.loads(json.dumps(rec))
+    del tampered["value"]["weight_cutover_ms"]
+    assert any(
+        "weight_cutover_ms" in p
+        for p in validator.validate_phase_value("weight_update", tampered)
+    )
+    degraded = json.loads(json.dumps(rec))
+    degraded["value"]["origin_full_payloads"] = 3.0
+    assert any(
+        "broadcast" in p
+        for p in validator.validate_phase_value("weight_update", degraded)
+    )

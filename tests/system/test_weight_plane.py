@@ -1,0 +1,550 @@
+"""Streaming weight-distribution plane, in-process (ISSUE 5 tentpole):
+origin serving + chunk-hash verification, Range resume of torn
+connections, peer-fanout planning, the O(1)-origin-egress invariant on
+a chain fanout, and re-fanout from a surviving PEER (not the origin)
+when a holder dies mid-chain. Multi-process acceptance lives in
+test_weight_plane_e2e.py."""
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from areal_tpu.base.chunking import chunk_spans, hash_chunk
+from areal_tpu.base.fault_injection import faults
+from areal_tpu.engine.weight_client import (
+    ChunkStore,
+    WeightFetchError,
+    assemble_params,
+    fetch_manifest,
+)
+from areal_tpu.system.weight_plane import (
+    PeerStoreServer,
+    WeightPlaneSource,
+    _PlaneHTTP,
+    chunk_manifest_for_dump,
+    distribute_to_stores,
+    fanout_edges,
+    parse_range_start,
+    plan_fanout,
+)
+from areal_tpu.system.weight_transfer import dump_raw_params
+
+
+def _params(seed=0):
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    return {
+        "emb": {"w": rng.standard_normal((64, 32)).astype(np.float32)},
+        "l0": {
+            "wq": rng.standard_normal((4, 32, 32)).astype(ml_dtypes.bfloat16)
+        },
+    }
+
+
+def _assert_tree_equal(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    for k in a:
+        if isinstance(a[k], dict):
+            _assert_tree_equal(a[k], b[k])
+        else:
+            assert a[k].dtype == b[k].dtype
+            np.testing.assert_array_equal(
+                np.asarray(a[k], np.float32), np.asarray(b[k], np.float32)
+            )
+
+
+@pytest.fixture
+def clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# Fanout planning
+# ----------------------------------------------------------------------
+
+
+def test_plan_fanout_degree_bounds():
+    origin = "http://o"
+    servers = [f"http://s{i}" for i in range(7)]
+    waves = plan_fanout(origin, servers, degree=2)
+    edges = fanout_edges(waves)
+    # Every server appears exactly once.
+    assert sorted(u for u, _ in edges) == sorted(servers)
+    # The origin uploads to at most `degree` children; every peer parent
+    # has at most `degree` children too.
+    children = {}
+    for u, p in edges:
+        children.setdefault(p, []).append(u)
+    assert len(children[origin]) == 2
+    assert all(len(c) <= 2 for c in children.values())
+    # A parent always completes in an earlier wave than its children.
+    wave_of = {u: i for i, w in enumerate(waves) for u, _ in w}
+    for u, p in edges:
+        if p != origin:
+            assert wave_of[p] < wave_of[u]
+
+
+def test_plan_fanout_rejects_bad_degree():
+    with pytest.raises(ValueError, match="degree"):
+        plan_fanout("http://o", ["http://s0"], degree=0)
+
+
+# ----------------------------------------------------------------------
+# Origin serving + client fetch
+# ----------------------------------------------------------------------
+
+
+def test_manifest_merges_dump_and_chunk_index(tmp_path):
+    d = str(tmp_path / "dump")
+    assert chunk_manifest_for_dump(d) is None  # no dump yet
+    dump_raw_params(_params(), d, version=5)
+    man = chunk_manifest_for_dump(d, chunk_bytes=1 << 12)
+    assert man["version"] == 5
+    assert man["n_chunks"] == len(man["hashes"]) > 1
+    assert {e["path"] for e in man["leaves"]} == {"emb/w", "l0/wq"}
+
+
+def test_manifest_uses_dump_time_sidecar(tmp_path, monkeypatch):
+    """A dump whose sidecar matches the plane's chunk size must serve the
+    precomputed index — no full bin re-read — while a mismatched chunk
+    size falls back to hashing and yields the same content hashes."""
+    import areal_tpu.system.weight_plane as wp
+
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(), d, version=3, chunk_bytes=1 << 12)
+    baseline = chunk_manifest_for_dump(d, chunk_bytes=1 << 12)
+
+    def _boom(*a, **k):
+        raise AssertionError("sidecar fast path should not hash the bin")
+
+    monkeypatch.setattr(wp, "build_chunk_index", _boom)
+    man = chunk_manifest_for_dump(d, chunk_bytes=1 << 12)
+    assert man["version"] == 3 and man["hashes"] == baseline["hashes"]
+    # Mismatched chunk size: sidecar ignored, rebuild path taken.
+    with pytest.raises(AssertionError, match="fast path"):
+        chunk_manifest_for_dump(d, chunk_bytes=1 << 13)
+    monkeypatch.undo()
+    rebuilt = chunk_manifest_for_dump(d, chunk_bytes=1 << 13)
+    assert rebuilt["total_bytes"] == man["total_bytes"]
+    assert rebuilt["n_chunks"] != man["n_chunks"]
+
+
+def test_fetch_verify_assemble_roundtrip(tmp_path):
+    d = str(tmp_path / "dump")
+    p = _params(1)
+    dump_raw_params(p, d, version=2)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    try:
+        # Pinned to a version the source doesn't hold: 404s.
+        with pytest.raises(Exception):
+            fetch_manifest(src.address, version=9)
+        man = fetch_manifest(src.address, version=2)
+        store = ChunkStore(man)
+        stats = store.fetch([src.address], origin=src.address)
+        assert store.complete()
+        assert stats["bytes_from_origin"] == man["total_bytes"]
+        assert stats["bytes_from_peers"] == 0
+        got, v = assemble_params(store)
+        assert v == 2
+        _assert_tree_equal(p, got)
+        # The origin counted exactly one full payload of egress.
+        assert src.stats()["full_payload_equivalents"][2] == pytest.approx(1.0)
+    finally:
+        src.close()
+
+
+def test_unpinned_manifest_tracks_newer_dump(tmp_path):
+    """An unpinned /weights/manifest must re-check the dump dir: the
+    cached manifest lagging a newer dump would hand out a version whose
+    bin may already be GC'd."""
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(7), d, version=1)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    try:
+        assert fetch_manifest(src.address)["version"] == 1  # cache warm
+        dump_raw_params(_params(8), d, version=2)
+        assert fetch_manifest(src.address)["version"] == 2
+        # Pinned requests still pin.
+        assert fetch_manifest(src.address, version=2)["version"] == 2
+    finally:
+        src.close()
+
+
+def test_corrupt_peer_rejected_by_content_hash(tmp_path):
+    """A peer serving tampered bytes fails per-chunk verification; the
+    client falls through to the next upstream — the hash, not the peer,
+    is the authority."""
+    d = str(tmp_path / "dump")
+    p = _params(2)
+    dump_raw_params(p, d, version=1)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    peer = PeerStoreServer().start()
+    try:
+        man = fetch_manifest(src.address, version=1)
+        peer.store = ChunkStore(man)
+        peer.store.fetch([src.address], origin=src.address)
+        # Tamper every byte the peer would serve (manifest hashes stay
+        # the honest ones).
+        for i in range(len(peer.store.buf)):
+            peer.store.buf[i] ^= 0xFF
+        src.chunks_served.clear()
+        src.bytes_served.clear()
+
+        store = ChunkStore(man)
+        stats = store.fetch([peer.address, src.address], origin=src.address)
+        assert store.complete()
+        assert stats["bytes_from_origin"] == man["total_bytes"]
+        got, _ = assemble_params(store)
+        _assert_tree_equal(p, got)
+    finally:
+        peer.close()
+        src.close()
+
+
+class _TruncatingSource(_PlaneHTTP):
+    """Serves each chunk torn in half on first contact, honoring Range
+    on the retry — a flaky network link."""
+
+    def __init__(self, manifest, payload: bytes):
+        super().__init__()
+        self.man, self.payload = manifest, payload
+        self._seen = set()
+
+    def routes(self, app):
+        app.router.add_get("/weights/manifest", self._h_man)
+        app.router.add_get("/weights/chunk", self._h_chunk)
+
+    async def _h_man(self, request):
+        return web.json_response(self.man)
+
+    async def _h_chunk(self, request):
+        idx = int(request.query["idx"])
+        off, length = chunk_spans(
+            self.man["total_bytes"], self.man["chunk_bytes"]
+        )[idx]
+        data = self.payload[off:off + length]
+        start = parse_range_start(request)
+        body = data[start:]
+        if idx not in self._seen:
+            self._seen.add(idx)
+            body = body[: max(1, len(body) // 2)]  # torn connection
+        return web.Response(
+            body=bytes(body), status=206 if start else 200,
+            content_type="application/octet-stream",
+        )
+
+
+def test_torn_chunk_resumes_with_range():
+    payload = bytes(range(256)) * 64  # 16 KiB
+    chunk_bytes = 1 << 12
+    spans = chunk_spans(len(payload), chunk_bytes)
+    man = {
+        "schema": "areal-weight-chunks/v1",
+        "version": 1,
+        "chunk_bytes": chunk_bytes,
+        "total_bytes": len(payload),
+        "n_chunks": len(spans),
+        "hashes": [hash_chunk(payload[o:o + n]) for o, n in spans],
+    }
+    src = _TruncatingSource(man, payload).start()
+    try:
+        store = ChunkStore(man)
+        stats = store.fetch([src.address])
+        assert store.complete()
+        assert bytes(store.buf) == payload
+        # Every chunk was torn once and resumed mid-chunk, not refetched
+        # from scratch.
+        assert stats["resumed_chunks"] == len(spans)
+    finally:
+        src.close()
+
+
+def test_fetch_fails_loudly_without_upstreams(tmp_path):
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(), d, version=1)
+    man = chunk_manifest_for_dump(d, chunk_bytes=1 << 12)
+    with pytest.raises(WeightFetchError, match="no upstreams"):
+        ChunkStore(man).fetch([])
+    # All-dead upstreams: a WeightFetchError naming the chunk, not a
+    # silent partial store.
+    with pytest.raises(WeightFetchError, match="unavailable"):
+        ChunkStore(man).fetch(["http://127.0.0.1:9"], timeout=0.2)
+
+
+# ----------------------------------------------------------------------
+# Fanout over live holders
+# ----------------------------------------------------------------------
+
+
+def test_chain_fanout_costs_origin_one_payload(tmp_path):
+    d = str(tmp_path / "dump")
+    p = _params(3)
+    dump_raw_params(p, d, version=4)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    holders = []
+    try:
+        holders, stats = distribute_to_stores(
+            src.address, 3, degree=1, version=4
+        )
+        # The acceptance invariant: each byte leaves the origin ONCE;
+        # wave 1+ holders are fed entirely by peers.
+        assert src.stats()["full_payload_equivalents"][4] == pytest.approx(1.0)
+        per = stats["per_holder"]
+        origin_feeds = [
+            s for s in per.values() if s["bytes_from_origin"] > 0
+        ]
+        assert len(origin_feeds) == 1
+        assert sum(s["bytes_from_peers"] for s in per.values()) == (
+            2 * stats["total_bytes"]
+        )
+        for h in holders:
+            got, v = assemble_params(h.store)
+            assert v == 4
+            _assert_tree_equal(p, got)
+    finally:
+        for h in holders:
+            h.close()
+        src.close()
+
+
+def test_dead_mid_chain_peer_refanouts_from_surviving_peer(
+    tmp_path, clean_faults
+):
+    """Chaos: the middle holder of a 3-chain fails serving mid-transfer.
+    Its child must re-fanout from the SURVIVING peer (wave-0 holder),
+    not the origin — origin egress stays exactly one payload."""
+    d = str(tmp_path / "dump")
+    p = _params(4)
+    dump_raw_params(p, d, version=1)
+    chunk_bytes = 1 << 12
+    src = WeightPlaneSource(d, chunk_bytes=chunk_bytes).start()
+    man = chunk_manifest_for_dump(d, chunk_bytes=chunk_bytes)
+    n_chunks = man["n_chunks"]
+    assert n_chunks >= 3, "payload too small for a mid-transfer kill"
+    # Shared hit counter across every /weights/chunk handler in this
+    # process, waves strictly ordered: hits [1..n] = origin -> h0,
+    # [n+1..2n] = h0 -> h1, [2n+1..3n] = h1 -> h2. Fire all 3 retry
+    # attempts of h2's SECOND chunk from h1 — a peer dying mid-serve.
+    faults.arm(
+        "weight_plane.serve_chunk", action="raise",
+        at_hit=2 * n_chunks + 2, times=3,
+    )
+    holders = []
+    try:
+        holders, stats = distribute_to_stores(
+            src.address, 3, degree=1, version=1
+        )
+        assert src.stats()["full_payload_equivalents"][1] == pytest.approx(1.0)
+        h2_stats = stats["per_holder"][holders[2].address]
+        # h2 got chunk 0 from its parent (h1), then re-fanned the rest
+        # from the surviving wave-0 holder — zero origin bytes.
+        assert h2_stats["bytes_from_origin"] == 0
+        assert set(h2_stats["bytes_from"]) == {
+            holders[0].address, holders[1].address
+        }
+        got, _ = assemble_params(holders[2].store)
+        _assert_tree_equal(p, got)
+    finally:
+        for h in holders:
+            h.close()
+        src.close()
+
+
+# ----------------------------------------------------------------------
+# /distribute_weights handler semantics (duplicate + supersede)
+# ----------------------------------------------------------------------
+
+
+class _SlowSource(WeightPlaneSource):
+    """Origin that sleeps per chunk, holding a fetch in flight long
+    enough for a duplicate/superseding request to land mid-transfer."""
+
+    def __init__(self, dump_dir, delay: float, **kw):
+        super().__init__(dump_dir, **kw)
+        self._delay = delay
+
+    async def _h_chunk(self, request):
+        import asyncio
+
+        await asyncio.sleep(self._delay)
+        return await super()._h_chunk(request)
+
+
+class _DistributeHarness(_PlaneHTTP):
+    """A real GenerationServer's /distribute_weights handler mounted on
+    a bare HTTP server — the prefetch state machine without the engine
+    (cutover paths are covered by test_weight_plane_e2e.py)."""
+
+    def __init__(self):
+        super().__init__()
+        import threading
+        import types
+
+        from areal_tpu.system.generation_server import GenerationServer
+
+        srv = object.__new__(GenerationServer)
+        srv._wp_lock = threading.Lock()
+        srv._wp_store = None
+        srv._wp_state = "idle"
+        srv._wp_transfer_ms = 0.0
+        srv._wp_verify_ms = 0.0
+        srv._wp_cutover_ms = 0.0
+        srv._wp_bytes_from_origin = 0
+        srv._wp_bytes_from_peers = 0
+        srv._wp_chunks_served = 0
+        srv._wp_bytes_served = 0
+        srv.engine = types.SimpleNamespace(version=0, n_running=0)
+        self.srv = srv
+
+    def routes(self, app):
+        app.router.add_post(
+            "/distribute_weights", self.srv._h_distribute_weights
+        )
+
+
+def _post_json(url, payload, timeout=60.0):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=_json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return _json.loads(r.read()), r.status
+    except urllib.error.HTTPError as e:
+        return _json.loads(e.read()), e.code
+
+
+def _wait_for(cond, timeout=10.0, interval=0.005):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_duplicate_distribute_joins_inflight_fetch(tmp_path):
+    """A manager retry while the fetch is IN FLIGHT must join it, not
+    replace the store: a restart discards every verified chunk (doubling
+    origin egress) and a transfer slower than the manager's wave timeout
+    could then never complete at all."""
+    import threading
+
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(5), d, version=1)
+    src = _SlowSource(d, delay=0.25, chunk_bytes=1 << 12).start()
+    harness = _DistributeHarness().start()
+    try:
+        man = fetch_manifest(src.address, version=1)
+        assert man["n_chunks"] >= 3
+        body = {
+            "version": 1,
+            "manifest": man,
+            "upstreams": [src.address],
+            "origin": src.address,
+        }
+        first = {}
+
+        def _first():
+            first["resp"], first["status"] = _post_json(
+                f"{harness.address}/distribute_weights", body
+            )
+
+        t = threading.Thread(target=_first)
+        t.start()
+        assert _wait_for(lambda: harness.srv._wp_state == "fetching")
+        store_inflight = harness.srv._wp_store
+        dup, status = _post_json(
+            f"{harness.address}/distribute_weights", body
+        )
+        t.join(timeout=60)
+        assert first["status"] == 200 and first["resp"]["success"]
+        assert status == 200 and dup["success"] and dup["joined"]
+        assert harness.srv._wp_state == "ready"
+        # The duplicate joined the SAME store — origin egress stayed at
+        # exactly one payload (a restart would have re-pulled chunks).
+        assert harness.srv._wp_store is store_inflight
+        assert src.stats()["full_payload_equivalents"][1] == pytest.approx(1.0)
+    finally:
+        harness.close()
+        src.close()
+
+
+def test_superseded_fetch_does_not_clobber_stats(tmp_path):
+    """A NEWER /distribute_weights replaces an in-flight fetch; when the
+    superseded fetch eventually finishes it must not flip the state or
+    overwrite the live version's transfer numbers on /metrics."""
+    import threading
+
+    d1, d2 = str(tmp_path / "v1"), str(tmp_path / "v2")
+    dump_raw_params(_params(6), d1, version=1)
+    # v2's payload has a different size so a stats clobber is detectable.
+    p2 = {"only": {"w": np.arange(512, dtype=np.float32)}}
+    dump_raw_params(p2, d2, version=2)
+    slow = _SlowSource(d1, delay=0.3, chunk_bytes=1 << 12).start()
+    fast = WeightPlaneSource(d2, chunk_bytes=1 << 12).start()
+    harness = _DistributeHarness().start()
+    try:
+        man1 = fetch_manifest(slow.address, version=1)
+        man2 = fetch_manifest(fast.address, version=2)
+        assert man1["total_bytes"] != man2["total_bytes"]
+        first = {}
+
+        def _first():
+            first["resp"], first["status"] = _post_json(
+                f"{harness.address}/distribute_weights",
+                {"version": 1, "manifest": man1,
+                 "upstreams": [slow.address], "origin": slow.address},
+            )
+
+        t = threading.Thread(target=_first)
+        t.start()
+        assert _wait_for(lambda: harness.srv._wp_state == "fetching")
+        newer, status = _post_json(
+            f"{harness.address}/distribute_weights",
+            {"version": 2, "manifest": man2,
+             "upstreams": [fast.address], "origin": fast.address},
+        )
+        assert status == 200 and newer["success"]
+        assert harness.srv._wp_store.version == 2
+        t.join(timeout=60)
+        # The superseded v1 fetch completed afterwards, but v2 stays the
+        # live store: state ready, stats = v2's payload size.
+        assert first["status"] in (200, 500)
+        assert harness.srv._wp_store.version == 2
+        assert harness.srv._wp_state == "ready"
+        assert harness.srv._wp_bytes_from_origin == man2["total_bytes"]
+    finally:
+        harness.close()
+        fast.close()
+        slow.close()
+
+
+def test_peer_store_404s_chunks_it_does_not_hold(tmp_path):
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(), d, version=1)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    peer = PeerStoreServer().start()
+    try:
+        man = fetch_manifest(src.address)
+        # Not holding anything yet: manifest 404s, chunk 404s, and a
+        # fetch routed at it falls through to the origin.
+        with pytest.raises(Exception):
+            fetch_manifest(peer.address, version=1)
+        store = ChunkStore(man)
+        stats = store.fetch([peer.address, src.address], origin=src.address)
+        assert store.complete()
+        assert stats["bytes_from_origin"] == man["total_bytes"]
+    finally:
+        peer.close()
+        src.close()

@@ -1,0 +1,362 @@
+"""ISSUE 5 acceptance: the streaming weight-distribution plane across
+real process boundaries — 1 trainer-side dump + source (parent) feeding
+3 real GenerationServer processes (real ServingEngines on CPU jax)
+through a real GserverManager peer-fanout tree.
+
+Asserted end to end:
+- each full weight payload leaves the trainer-side source EXACTLY once
+  per version (peer hops serve the rest; transfer counters on the
+  source and per-server /metrics)
+- an in-flight /generate is interrupted by the cutover and resumed
+  (client re-prefill) against the new version
+- per-server weight_cutover_ms is reported separately from
+  weight_transfer_ms in /metrics and in the manager /status surface
+- chaos (AREAL_FAULTS): a peer killed mid-transfer on the next version
+  bump -> the manager re-fanouts around it, survivors cut over, the
+  dead server is evicted, and origin egress STAYS one payload.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from tests import fixtures
+
+# Multi-process, compile-bound: keep off shared workers (pytest.ini).
+pytestmark = [pytest.mark.serial, pytest.mark.chaos]
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+N_SERVERS = 3
+CHUNK_BYTES = 1 << 15
+MODEL_CFG = dict(
+    n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+    intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+    param_dtype="float32",
+)
+
+CHILD = '''
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax; jax.config.update("jax_platforms", "cpu")
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=%(nr)r)
+from areal_tpu.api.system_api import GenerationServerConfig
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.system.generation_server import GenerationServer
+import areal_tpu.engine.factories  # registry
+cfg = GenerationServerConfig(
+    experiment_name=%(exp)r, trial_name=%(trial)r, server_index=%(idx)d,
+    model=ModelAbstraction("tpu_transformer", args=dict(config=%(model_cfg)r)),
+    max_concurrent_requests=2, max_seq_len=1024, kv_page_size=8,
+    decode_block_steps=4, prompt_bucket=32, seed=0,
+)
+w = GenerationServer()
+w.configure(cfg, experiment_name=cfg.experiment_name, trial_name=cfg.trial_name,
+            worker_name=cfg.worker_name)
+w.run()
+'''
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url, path, payload, timeout=240):
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            url + path, json.dumps(payload).encode(),
+            {"Content-Type": "application/json"},
+        ),
+        timeout=timeout,
+    )
+    return json.loads(r.read())
+
+
+def _metrics(url):
+    text = urllib.request.urlopen(url + "/metrics", timeout=30).read().decode()
+    out = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            out[parts[0]] = float(parts[1])
+    return out
+
+
+def _wait_until(cond, timeout, msg, proc_check=None):
+    deadline = time.monotonic() + fixtures.scale_timeout(timeout)
+    while time.monotonic() < deadline:
+        if proc_check is not None:
+            proc_check()
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.mark.timeout(600)
+def test_fleet_fanout_interrupt_resume_and_chaos_refanout(
+    tmp_path, monkeypatch
+):
+    import jax
+
+    from areal_tpu.base import constants, name_resolve, names
+    from areal_tpu.api.system_api import GserverManagerConfig
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system.gserver_manager import GserverManager
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    nr = str(tmp_path / "nr")
+    exp, trial = f"wplane-{uuid.uuid4().hex[:6]}", "t0"
+    monkeypatch.setenv("AREAL_HEALTH_TTL", "60")
+    monkeypatch.setattr(
+        constants, "PARAM_REALLOC_ROOT", str(tmp_path / "realloc")
+    )
+    repo = name_resolve.reconfigure("nfs", record_root=nr)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["AREAL_HEALTH_TTL"] = "60"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs, logs, cleanup = [], [], []
+    try:
+        for idx in range(N_SERVERS):
+            child_env = dict(env)
+            if idx == 2:
+                # Chaos arm for phase 2: this server's SECOND weight
+                # fetch (the v2 distribute) kills the process outright —
+                # a peer dying mid-fleet-transfer.
+                child_env["AREAL_FAULTS"] = (
+                    "gserver.weight_fetch@generation_server/2=die:k=2"
+                )
+            log_path = tmp_path / f"server{idx}.log"
+            log_f = open(log_path, "w")
+            logs.append(log_path)
+            cleanup.append(log_f.close)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", CHILD % dict(
+                    repo=REPO, nr=nr, exp=exp, trial=trial, idx=idx,
+                    model_cfg=MODEL_CFG,
+                )],
+                env=child_env, cwd=REPO, stdout=log_f,
+                stderr=subprocess.STDOUT,
+            ))
+
+        def alive(indices=range(N_SERVERS)):
+            for i in indices:
+                assert procs[i].poll() is None, (
+                    f"server {i} died:\n" + logs[i].read_text()[-3000:]
+                )
+
+        urls = {}
+
+        def discovered():
+            alive()
+            for i in range(N_SERVERS):
+                if i not in urls:
+                    try:
+                        urls[i] = name_resolve.get(
+                            names.gen_server_url(exp, trial, str(i))
+                        )
+                    except name_resolve.NameEntryNotFoundError:
+                        return False
+            return True
+
+        _wait_until(discovered, 240, "server discovery")
+
+        # Trainer-side dump + weight-plane source (the dump rank).
+        role_dir = os.path.join(
+            constants.get_param_realloc_path(exp, trial), "actor"
+        )
+        os.makedirs(role_dir, exist_ok=True)
+        with open(os.path.join(role_dir, "engine_state.pkl"), "wb") as f:
+            f.write(b"gate")  # existence gate for check_new_params
+        cfg = TransformerConfig(**MODEL_CFG)
+        p1 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x), init_params(cfg, jax.random.PRNGKey(7))
+        )
+        dump_raw_params(p1, role_dir, version=1)
+        src = WeightPlaneSource(role_dir, chunk_bytes=CHUNK_BYTES).start()
+        cleanup.append(src.close)
+        src.register(exp, trial, "actor")
+
+        # Real manager, plane enabled, degree-1 chain = max peer hops.
+        m = GserverManager()
+        m.configure(GserverManagerConfig(
+            experiment_name=exp, trial_name=trial, model_name="actor",
+            n_servers=N_SERVERS, train_batch_size=4,
+            max_head_offpolicyness=1000,
+            flush_request_timeout=fixtures.scale_timeout(60.0),
+            health_check_interval=0.2,
+            weight_plane=True, weight_chunk_bytes=CHUNK_BYTES,
+            weight_fanout_degree=1,
+            weight_cutover_budget_s=fixtures.scale_timeout(10.0),
+        ))
+        mt = threading.Thread(target=m.run, daemon=True)
+        mt.start()
+        cleanup.append(lambda: mt.join(timeout=10))
+        _wait_until(
+            lambda: len(m._healthy_urls()) == N_SERVERS, 60,
+            "manager sees 3 healthy servers", proc_check=alive,
+        )
+
+        # Warm every server's serving programs (parallel: overlap the
+        # prefill/decode compiles) so the interrupt-timing below isn't
+        # dominated by first-request XLA compiles.
+        def warm(i):
+            out = _post(urls[i], "/generate", {
+                "qid": f"warm{i}", "input_ids": [5, 6, 7],
+                "gconfig": {"max_new_tokens": 4, "greedy": True},
+            })
+            assert len(out["output_ids"]) >= 1, out
+        warm_threads = [
+            threading.Thread(target=warm, args=(i,)) for i in range(N_SERVERS)
+        ]
+        for t in warm_threads:
+            t.start()
+        for t in warm_threads:
+            t.join(timeout=fixtures.scale_timeout(300))
+            assert not t.is_alive(), "warm generate wedged"
+
+        # ---- Phase 1: clean fanout. An in-flight long request on
+        # server 0 must be interrupted by the cutover and resumable.
+        long_res = {}
+
+        def long_generate():
+            long_res["out"] = _post(urls[0], "/generate", {
+                "qid": "longq", "input_ids": [5, 6, 7],
+                "gconfig": {"max_new_tokens": 900, "greedy": True},
+            }, timeout=fixtures.scale_timeout(300))
+
+        lt = threading.Thread(target=long_generate, daemon=True)
+        lt.start()
+        _wait_until(
+            lambda: _metrics(urls[0])["areal:num_running_reqs"] >= 1, 30,
+            "long request running", proc_check=alive,
+        )
+        name_resolve.add(
+            names.model_version(exp, trial, "actor"), "1", replace=True
+        )
+        _wait_until(
+            lambda: m.weight_version == 1, 120, "v1 plane fanout",
+            proc_check=alive,
+        )
+        lt.join(timeout=fixtures.scale_timeout(120))
+        assert not lt.is_alive(), "long generate never returned"
+        out = long_res["out"]
+        # Interrupted mid-decode by the cutover: partial tokens, old
+        # version, explicit interrupted flag.
+        assert out["interrupted"] is True, out
+        assert 0 < len(out["output_ids"]) < 900
+        assert out["version_start"] == 0
+        # Client-side resume (the AReaL re-prefill protocol): continue
+        # from prompt + partial output against the NEW weights.
+        resumed = _post(urls[0], "/generate", {
+            "qid": "longq", "input_ids": [5, 6, 7] + out["output_ids"],
+            "gconfig": {"max_new_tokens": 16, "greedy": True},
+        })
+        assert resumed["version_start"] == 1, resumed
+        assert len(resumed["output_ids"]) >= 1
+
+        # O(1) origin egress: each byte left the trainer-side source
+        # exactly once; the other two payload copies were peer hops.
+        stats = src.stats()
+        assert stats["full_payload_equivalents"][1] == pytest.approx(1.0)
+        total = sum(stats["bytes_served"].values())
+        per_server = [_metrics(urls[i]) for i in range(N_SERVERS)]
+        assert sum(
+            ms["areal:weight_bytes_from_origin"] for ms in per_server
+        ) == total
+        assert sum(
+            ms["areal:weight_bytes_from_peers"] for ms in per_server
+        ) == 2 * total
+        # Transfer vs cutover: separate, nonzero numbers on every server.
+        for ms in per_server:
+            assert ms["areal:weight_transfer_ms"] > 0.0
+            assert ms["areal:weight_cutover_ms"] > 0.0
+        # ... and on the manager /status surface.
+        status = _get_json(m.address + "/status")
+        wp = status["weight_plane"]
+        assert wp["version"] == 1 and wp["failures"] == {}
+        assert set(wp["transfer_ms"]) == set(urls.values())
+        assert set(wp["cutover_ms"]) == set(urls.values())
+        assert all(v > 0 for v in wp["transfer_ms"].values())
+        assert all(v > 0 for v in wp["cutover_ms"].values())
+        assert status["server_versions"] == {u: 1 for u in urls.values()}
+
+        # ---- Phase 2: chaos. Server 2's v2 fetch kills its process
+        # mid-fleet-transfer; the manager re-parents its children onto
+        # surviving holders, survivors cut over, the dead server is
+        # evicted — and the origin still egresses ONE payload.
+        p2 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x), init_params(cfg, jax.random.PRNGKey(8))
+        )
+        dump_raw_params(p2, role_dir, version=2)
+        name_resolve.add(
+            names.model_version(exp, trial, "actor"), "2", replace=True
+        )
+        _wait_until(
+            lambda: m.weight_version == 2, 180, "v2 re-fanout",
+            proc_check=lambda: alive([0, 1]),
+        )
+        _wait_until(
+            lambda: procs[2].poll() is not None, 30, "chaos kill landed"
+        )
+        survivors = [urls[0], urls[1]]
+        status = _get_json(m.address + "/status")
+        wp = status["weight_plane"]
+        assert wp["version"] == 2
+        assert set(wp["failures"]) == {urls[2]}
+        assert set(wp["transfer_ms"]) == set(survivors)
+        assert set(wp["cutover_ms"]) == set(survivors)
+        _wait_until(
+            lambda: urls[2] in m._evicted, 30, "dead server evicted"
+        )
+        # Re-fanout stayed O(1) on the origin even with the mid-transfer
+        # death (the survivor chain re-fed from peers, not the source).
+        assert src.stats()["full_payload_equivalents"][2] == pytest.approx(1.0)
+        for u in survivors:
+            check = _post(u, "/generate", {
+                "qid": f"v2check-{u[-5:]}", "input_ids": [9, 10],
+                "gconfig": {"max_new_tokens": 4, "greedy": True},
+            })
+            assert check["version_start"] == 2, check
+
+        name_resolve.add(
+            names.experiment_status(exp, trial), "COMPLETE", replace=True
+        )
+    finally:
+        try:
+            name_resolve.add(
+                names.experiment_status(exp, trial), "COMPLETE", replace=True
+            )
+        except Exception:
+            pass
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for fn in cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
+        repo.reset()

@@ -1,14 +1,18 @@
 """weight_transfer: raw dump/mmap-load round trip, versioned GC, torn-write
-rejection, and the serving load-path priority (shm raw -> disk raw ->
-pickle)."""
+rejection, the serving load-path priority (shm raw -> disk raw ->
+pickle -> HF), the GC-race retry, and the want_version accounting gate
+(ISSUE 5 satellites)."""
 
 import os
 import pickle
+import threading
+import time
 
 import numpy as np
 import pytest
 
 from areal_tpu.system.weight_transfer import (
+    WeightVersionMismatch,
     dump_raw_params,
     load_for_serving,
     load_raw_params,
@@ -113,6 +117,128 @@ def test_load_for_serving_priority(tmp_path):
     assert info["source"] == "shm_raw" and info["version"] == 8
     _assert_tree_equal(p_shm, params)
     assert info["load_s"] >= 0
+
+
+def test_gc_race_retries_refreshed_manifest(tmp_path, monkeypatch):
+    """A reader that grabbed a manifest naming a just-GC'd bin must
+    re-read the (refreshed) manifest once and succeed — not silently
+    fall through to a stale pickle."""
+    import areal_tpu.system.weight_transfer as wt
+
+    d = str(tmp_path / "dump")
+    p = _params(1)
+    dump_raw_params(p, d, version=5)
+    real_read = wt._read_manifest
+    real_man = real_read(d)
+    # The racy first read: a manifest whose bin the GC already unlinked.
+    stale_man = dict(real_man, bin="params-v3.bin", version=3)
+    calls = []
+
+    def racy_read(dump_dir):
+        calls.append(dump_dir)
+        return stale_man if len(calls) == 1 else real_read(dump_dir)
+
+    monkeypatch.setattr(wt, "_read_manifest", racy_read)
+    got, v = load_raw_params(d)
+    assert v == 5 and len(calls) == 2
+    _assert_tree_equal(p, got)
+
+
+def test_gc_race_gives_up_after_one_retry(tmp_path, monkeypatch):
+    """If the refreshed manifest STILL names a missing bin (dump dir
+    being torn down), the loader returns None for the caller's fallback
+    chain instead of spinning."""
+    import areal_tpu.system.weight_transfer as wt
+
+    d = str(tmp_path / "dump")
+    dump_raw_params(_params(1), d, version=5)
+    stale_man = dict(wt._read_manifest(d), bin="params-v3.bin")
+    calls = []
+
+    def always_stale(dump_dir):
+        calls.append(dump_dir)
+        return dict(stale_man)
+
+    monkeypatch.setattr(wt, "_read_manifest", always_stale)
+    assert load_raw_params(d) is None
+    assert len(calls) == 2
+
+
+def test_want_version_accepts_exact_match(tmp_path):
+    model_path = str(tmp_path / "realloc")
+    dump_raw_params(_params(0), model_path, version=7)
+    params, info = load_for_serving(model_path, want_version=7)
+    assert info["source"] == "disk_raw" and info["version"] == 7
+
+
+def test_want_version_mismatch_fails_update(tmp_path):
+    """The accounting hole: a raw dump lagging the published version (or
+    a version:-1 pickle fallback) must FAIL the update, not serve stale
+    weights under the new version label."""
+    model_path = str(tmp_path / "realloc")
+    dump_raw_params(_params(0), model_path, version=7)
+    with pytest.raises(WeightVersionMismatch, match="requested weight version 8"):
+        load_for_serving(model_path, want_version=8, retries=2, retry_s=0.01)
+
+    # Pickle-only dir: version is unverifiable (-1) — the pinned chain
+    # skips the deserialization entirely and reports no raw dump.
+    pkl_dir = str(tmp_path / "pkl")
+    os.makedirs(pkl_dir)
+    with open(os.path.join(pkl_dir, "engine_state.pkl"), "wb") as f:
+        pickle.dump({"params": _params(1)}, f)
+    with pytest.raises(WeightVersionMismatch, match="no raw dump"):
+        load_for_serving(pkl_dir, want_version=1, retries=1)
+    # Unpinned loads keep the legacy behavior.
+    _, info = load_for_serving(pkl_dir)
+    assert info["source"] == "pickle" and info["version"] == -1
+
+
+def test_want_version_retries_until_dump_lands(tmp_path):
+    """Version publication can race the dump hitting disk: the brief
+    retry window must pick up the landing dump."""
+    model_path = str(tmp_path / "realloc")
+    p_old, p_new = _params(2), _params(3)
+    dump_raw_params(p_old, model_path, version=1)
+
+    def late_dump():
+        time.sleep(0.2)
+        dump_raw_params(p_new, model_path, version=2)
+
+    t = threading.Thread(target=late_dump)
+    t.start()
+    try:
+        params, info = load_for_serving(
+            model_path, want_version=2, retries=20, retry_s=0.05
+        )
+    finally:
+        t.join()
+    assert info["version"] == 2
+    _assert_tree_equal(p_new, params)
+
+
+def test_load_for_serving_hf_fallback(tmp_path):
+    """The cold-start end of the fallback chain: an HF checkpoint dir
+    with no raw dump and no pickle loads with source='hf', version -1 —
+    and is refused when a specific version was requested."""
+    pytest.importorskip("torch")
+    pytest.importorskip("transformers")
+    from areal_tpu.models.hf import get_family, save_hf_model, torch_state_dict_to_numpy
+    from tests.model.test_hf_parity import tiny_hf_model
+
+    hf_model = tiny_hf_model("llama").eval()
+    fam = get_family("llama")
+    cfg = fam.config_from_hf(hf_model.config.to_dict(), False)
+    params = fam.params_from_hf(
+        torch_state_dict_to_numpy(hf_model.state_dict()), cfg
+    )
+    d = str(tmp_path / "hf_ckpt")
+    save_hf_model(d, cfg, params, family="llama")
+
+    got, info = load_for_serving(d)
+    assert info["source"] == "hf" and info["version"] == -1
+    assert got["embedding"]["weight"].shape[0] == cfg.vocab_size
+    with pytest.raises(WeightVersionMismatch, match="no raw dump"):
+        load_for_serving(d, want_version=3, retries=1)
 
 
 def test_shm_dir_shape():
