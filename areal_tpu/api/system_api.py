@@ -170,6 +170,23 @@ class GenerationServerConfig:
     # int8 DECODE weights (W8A16, ops/wquant.py): halves the per-step
     # weight stream; prefill stays bf16. None/"model" disables.
     decode_weight_dtype: Optional[str] = None
+    # Token-budget continuous batching: per-admission-round cap on
+    # UNCACHED prefill tokens (None = unbounded). Bounds how much
+    # prefill work interleaves into one scheduler iteration — the
+    # TTFT-vs-ITL knob under load (engine/serving.py, docs/serving.md).
+    prefill_token_budget: Optional[int] = None
+    # Prefill/decode interleave ratio: decode blocks run between
+    # admission rounds (1 = admit every block boundary).
+    decode_blocks_per_admit: int = 1
+    # Bounded admission queue (backpressure): beyond either watermark,
+    # /generate sheds with 429 + Retry-After instead of queueing
+    # unboundedly — the open-loop tail-latency guarantee. None disables.
+    max_queue_depth: Optional[int] = None
+    max_queued_tokens: Optional[int] = None
+    # Retry-After hint handed to shed clients (partial_rollout backs off
+    # with jitter around it; the manager routes around the server for
+    # this long).
+    shed_retry_after_s: float = 1.0
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1
@@ -193,6 +210,19 @@ class GserverManagerConfig:
     model_name: str = "actor"
     n_servers: int = 1
     schedule_policy: str = "round_robin"  # | least_requests | least_token_usage
+    # Prefix-/session-affinity routing: a rollout's next chunk/turn is
+    # routed to the server holding its KV prefix (affinity map keyed by
+    # the request qid, surviving weight-version bumps), with load-aware
+    # spill to the least-loaded server when the target is saturated or
+    # shedding. Applies on top of schedule_policy (which places the
+    # FIRST chunk of each session).
+    session_affinity: bool = True
+    # Spill threshold: an affinity target with at least this many
+    # estimated in-flight requests is considered saturated and the
+    # session spills. None = spill only on shed/unhealthy.
+    affinity_saturation_requests: Optional[int] = None
+    # LRU cap on the affinity map (entries are one url per qid).
+    affinity_map_size: int = 65536
     max_head_offpolicyness: int = 0
     train_batch_size: int = 8
     flush_request_timeout: float = 120.0

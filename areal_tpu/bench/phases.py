@@ -171,6 +171,21 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="serving_openloop",
+    entrypoint="areal_tpu.bench.workloads:serving_openloop_phase",
+    priority=4,
+    est_compile_s=60.0,
+    est_measure_s=120.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Open-loop (Poisson) fleet serving: arrival-rate sweep "
+                "-> p50/p99 TTFT + goodput, admission-control vs "
+                "no-backpressure A/B at deliberate overload "
+                "(scheduling-policy evidence; CPU-proxy)",
+))
+
+register(PhaseSpec(
     name="pack_density",
     entrypoint="areal_tpu.bench.workloads:pack_density_phase",
     priority=10,

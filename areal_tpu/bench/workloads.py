@@ -445,6 +445,218 @@ def serving_http_phase(pass_: str) -> dict:
 
 
 # ----------------------------------------------------------------------
+# serving_openloop: open-loop (Poisson-arrival) tail-latency benchmark
+# over a small in-process fleet. Closed-loop throughput (gen_tps,
+# serving_http) cannot see overload behavior — an open-loop generator
+# keeps submitting at the offered rate regardless of completions, which
+# is what "millions of users" do. Sweeps arrival rates against measured
+# capacity and A/Bs admission control (queue-depth watermark shedding)
+# against a no-backpressure baseline at deliberate overload: with
+# admission, p99 TTFT stays bounded by the watermark; without it, the
+# queue (and therefore TTFT) grows with the length of the run.
+# Scheduling-policy effects are visible on CPU; banked as CPU-proxy
+# evidence until a device window returns.
+# ----------------------------------------------------------------------
+
+
+def _openloop_point(
+    engines, rate, duration_s, watermark, rng, plen, max_new, vocab, tag,
+):
+    """One sweep point: Poisson arrivals at `rate` req/s for
+    `duration_s`, least-loaded routing across `engines`, shedding when
+    the least-loaded queue depth reaches `watermark` (None = no
+    backpressure). Drains admitted requests, then reads the engines'
+    TTFT/ITL histograms (reset per point)."""
+    from areal_tpu.base.latency import merge_counts, percentile_from_counts
+    from areal_tpu.engine.serving import GenRequest
+
+    for e in engines:
+        e.latency_snapshot(reset=True)
+    completed = []  # list.append is atomic under the GIL
+    n_arrivals = n_shed = n_admitted = 0
+    # Fixed arrival COUNT (ceil(rate * duration)): at short windows the
+    # Poisson-realized load of a time-based loop is too noisy for the
+    # overload A/B to be deterministic; realized offered_rps is still
+    # what gets recorded and bounds goodput.
+    n_target = max(2, int(-(-rate * duration_s // 1)))
+    t0 = time.monotonic()
+    t_next = t0
+    while n_arrivals < n_target:
+        now = time.monotonic()
+        if now < t_next:
+            time.sleep(t_next - now)
+        target = min(engines, key=lambda e: (e.queue_depth, e.n_running))
+        if watermark is not None and target.queue_depth >= watermark:
+            n_shed += 1
+        else:
+            n_admitted += 1
+            target.submit(GenRequest(
+                qid=f"{tag}{n_arrivals}",
+                input_ids=rng.randint(0, vocab, size=plen).tolist(),
+                max_new_tokens=max_new,
+                greedy=True,
+                done_cb=completed.append,
+            ))
+        n_arrivals += 1
+        t_next += rng.exponential(1.0 / rate)
+    arrival_window = time.monotonic() - t0
+    drain_deadline = time.monotonic() + max(60.0, duration_s * 20.0)
+    while len(completed) < n_admitted and time.monotonic() < drain_deadline:
+        time.sleep(0.01)
+    elapsed = time.monotonic() - t0
+    snaps = [e.latency_snapshot(reset=True) for e in engines]
+    ttft = merge_counts(s["ttft_counts"] for s in snaps)
+    itl = merge_counts(s["itl_counts"] for s in snaps)
+    return {
+        "nominal_rate_rps": float(rate),
+        # Realized offered load (Poisson variance makes it differ from
+        # nominal at short windows); goodput can never exceed it.
+        "offered_rps": n_arrivals / arrival_window,
+        "duration_s": arrival_window,
+        "n_arrivals": float(n_arrivals),
+        "n_admitted": float(n_admitted),
+        "n_shed": float(n_shed),
+        "n_completed": float(len(completed)),
+        "goodput_rps": len(completed) / elapsed,
+        "p50_ttft_ms": percentile_from_counts(ttft, 50.0),
+        "p99_ttft_ms": percentile_from_counts(ttft, 99.0),
+        "itl_p50_ms": percentile_from_counts(itl, 50.0),
+    }
+
+
+def serving_openloop_phase(pass_: str) -> dict:
+    import threading
+
+    import jax
+
+    from areal_tpu.engine.serving import GenRequest, ServingEngine
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+
+    n_servers = int(os.environ.get("AREAL_OPENLOOP_SERVERS") or 2)
+    point_s = float(os.environ.get("AREAL_OPENLOOP_POINT_S") or 3.0)
+    # Multiples of the CLOSED-LOOP capacity (batched admission, the
+    # engine's peak). Open-loop sustainable throughput is lower — a
+    # trickle arrival admits in singletons and loses prefill batching —
+    # so ~1.0 is already past saturation and the top multiple is deep
+    # overload.
+    rate_mults = [
+        float(x)
+        for x in (os.environ.get("AREAL_OPENLOOP_RATES") or "0.25,1.0,3.0")
+        .split(",")
+        if x
+    ]
+    watermark = int(os.environ.get("AREAL_OPENLOOP_WATERMARK") or 8)
+    # Geometry matches the engine test harness (tests/engine/
+    # test_prefix_cache.py) so an in-process tier-1 run reuses compiled
+    # programs instead of paying fresh XLA compiles.
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
+        intermediate_dim=128, vocab_size=256, max_position_embeddings=512,
+        compute_dtype="float32",
+    )
+    plen, max_new, B = 16, 16, 4
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    engines = [
+        ServingEngine(
+            cfg, params,
+            max_batch_size=B,
+            max_seq_len=256,
+            decode_block_steps=4,
+            prompt_bucket=16,
+            eos_token_id=None,  # budget-bound: deterministic service time
+            page_size=16,
+            seed=10 + i,
+            prefill_token_budget=4 * plen,
+        )
+        for i in range(n_servers)
+    ]
+    for e in engines:
+        e.start()
+    t_start = time.monotonic()
+    try:
+        if pass_ == "compile":
+            t0 = time.perf_counter()
+            engines[0].warm([plen])
+            dt = time.perf_counter() - t0
+            log(f"bench: serving_openloop compile pass {dt:.1f}s")
+            return {"compile_s": dt}
+
+        rng = np.random.RandomState(5)
+
+        def closed_loop(n, tag):
+            done = threading.Event()
+            got = []
+
+            def cb(res):
+                got.append(res)
+                if len(got) == n:
+                    done.set()
+
+            t0 = time.monotonic()
+            for i in range(n):
+                engines[i % n_servers].submit(GenRequest(
+                    qid=f"{tag}{i}",
+                    input_ids=rng.randint(0, cfg.vocab_size, size=plen).tolist(),
+                    max_new_tokens=max_new, greedy=True, done_cb=cb,
+                ))
+            assert done.wait(600), f"openloop warmup stalled {len(got)}/{n}"
+            return n / (time.monotonic() - t0)
+
+        # Warm every admit-batch shape the run can hit (pow2 prefill
+        # batches 1/2/4 + the queued-up capacity pattern): open-loop
+        # trickle arrivals admit in singletons, and an XLA compile
+        # landing inside a sweep point would masquerade as queueing
+        # delay in the TTFT histogram.
+        for k in (1, 2):
+            closed_loop(k * n_servers, f"w{k}-")
+        closed_loop(4 * B * n_servers, "w")
+        capacity = closed_loop(4 * B * n_servers, "c")
+        log(f"bench: serving_openloop capacity ~{capacity:.1f} req/s "
+            f"({n_servers} servers)")
+        for e in engines:
+            e.latency_snapshot(reset=True)
+
+        sweep = []
+        for mult in rate_mults:
+            pt = _openloop_point(
+                engines, mult * capacity, point_s, watermark, rng,
+                plen, max_new, cfg.vocab_size, f"s{mult}-",
+            )
+            pt["rate_multiple"] = float(mult)
+            sweep.append(pt)
+            log(f"bench: serving_openloop x{mult}: {pt}")
+
+        # Deliberate overload A/B at the highest sweep multiple: the
+        # admission-control point above vs a no-backpressure baseline.
+        overload_mult = max(rate_mults)
+        adm = sweep[rate_mults.index(overload_mult)]
+        base = _openloop_point(
+            engines, overload_mult * capacity, point_s, None, rng,
+            plen, max_new, cfg.vocab_size, "b-",
+        )
+        log(f"bench: serving_openloop baseline (no backpressure): {base}")
+        return {
+            # Closed-loop peak (admission batches full prefill rounds);
+            # open-loop goodput saturates below this by design.
+            "capacity_rps": capacity,
+            "n_servers": float(n_servers),
+            "watermark": float(watermark),
+            "sweep": sweep,
+            "overload_offered_rps": adm["offered_rps"],
+            "overload_admission_p99_ttft_ms": adm["p99_ttft_ms"],
+            "overload_admission_goodput_rps": adm["goodput_rps"],
+            "overload_admission_shed": adm["n_shed"],
+            "overload_baseline_p99_ttft_ms": base["p99_ttft_ms"],
+            "overload_baseline_goodput_rps": base["goodput_rps"],
+            "wall_s": time.monotonic() - t_start,
+        }
+    finally:
+        for e in engines:
+            e.stop()
+
+
+# ----------------------------------------------------------------------
 # CPU-proxy phases (never driver-verified; the runner pins them to
 # JAX_PLATFORMS=cpu and the report labels them proxy evidence).
 # ----------------------------------------------------------------------

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.base import logging, tracing
+from areal_tpu.base.latency import LatencyHistogram
 from areal_tpu.engine.paged import (
     TRASH_PAGE,
     PageAllocator,
@@ -78,9 +79,18 @@ class GenRequest:
     top_p: float = 1.0
     top_k: int = -1
     stop_token_ids: Tuple[int, ...] = ()
+    # Admission class, lower admits first: 0 = session continuation /
+    # interrupted re-prefill (the server maps these from resubmissions),
+    # 1 = fresh request. The engine additionally promotes any request
+    # whose qid holds a parked prefix to class 0 — its pages are already
+    # paid for, and finishing the session releases budget fastest.
+    priority: int = 1
     # resolved by the engine loop:
     done_cb: Optional[Callable[["GenResult"], None]] = None
     submit_time: float = 0.0
+    # Admission rounds this request sat in the backlog while higher-
+    # priority work admitted ahead of it (starvation-aging counter).
+    starved_rounds: int = 0
 
 
 @dataclasses.dataclass
@@ -179,6 +189,8 @@ class ServingEngine:
         speculative_ngram: int = 2,
         speculative_window: Optional[int] = None,
         decode_weight_dtype: Optional[str] = None,
+        prefill_token_budget: Optional[int] = None,
+        decode_blocks_per_admit: int = 1,
     ):
         self.cfg = cfg
         # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
@@ -219,6 +231,27 @@ class ServingEngine:
             f"{chunked_prefill_per_lap}"
         )
         self.chunked_prefill_per_lap = chunked_prefill_per_lap
+        # Token-budget continuous batching: each admission round admits
+        # new prompts only while their UNCACHED prefill tokens fit this
+        # budget (the first candidate always admits, so one oversized
+        # prompt can't starve). Bounds the prefill work interleaved into
+        # a scheduler iteration — the knob that trades TTFT for decode
+        # latency (ITL) under load. None = unbounded (legacy behavior).
+        assert prefill_token_budget is None or prefill_token_budget >= 1, (
+            f"prefill_token_budget must be >= 1 or None, got "
+            f"{prefill_token_budget}"
+        )
+        self.prefill_token_budget = prefill_token_budget
+        # Prefill/decode interleave ratio: run this many decode blocks
+        # between admission rounds (1 = admit every lap). Raising it
+        # favors running requests' ITL over queued requests' TTFT.
+        assert decode_blocks_per_admit >= 1, (
+            f"decode_blocks_per_admit must be >= 1, got "
+            f"{decode_blocks_per_admit}"
+        )
+        self.decode_blocks_per_admit = decode_blocks_per_admit
+        # First lap always admits (counter starts saturated).
+        self._blocks_since_admit = decode_blocks_per_admit
         # qid-keyed prefix KV reuse (the radix-cache role of the
         # reference's serving backend): finished/interrupted requests
         # park their pages here; a resubmission with the same qid whose
@@ -376,6 +409,15 @@ class ServingEngine:
         # metrics
         self.n_running = 0
         self.n_used_tokens = 0
+        # Per-request latency SLO telemetry, recorded on the engine loop:
+        # TTFT = submit -> first sampled token; ITL = decode-block wall
+        # time amortized over the tokens the block emitted for a slot.
+        self.ttft_hist = LatencyHistogram()
+        self.itl_hist = LatencyHistogram()
+        # Prompt tokens sitting in the queue + backlog (not yet admitted)
+        # — the server's admission watermark reads this. Updated under
+        # _fatal_lock on submit, on the engine thread at each pop.
+        self.queued_prompt_tokens = 0
         self.total_generated = 0
         self.n_preempted = 0
         self.last_weight_swap_s = 0.0
@@ -406,6 +448,7 @@ class ServingEngine:
                 ) from self.fatal_error
             req.submit_time = time.monotonic()
             self.total_requests += 1
+            self.queued_prompt_tokens += len(req.input_ids)
             self._queue.put(req)
 
     def warm(
@@ -594,12 +637,41 @@ class ServingEngine:
         self.last_weight_cutover_s = time.monotonic() - t0
         return self.last_weight_cutover_s
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet admitted to a slot."""
+        return self._queue.qsize() + len(self._backlog)
+
+    def latency_snapshot(self, reset: bool = False) -> Dict[str, Any]:
+        """Raw TTFT/ITL bucket counts (areal_tpu.base.latency edges) +
+        percentiles; reset=True zeroes the histograms (the open-loop
+        bench reads one snapshot per sweep point)."""
+        from areal_tpu.base.latency import percentile_from_counts
+
+        ttft = self.ttft_hist.counts(reset=reset)
+        itl = self.itl_hist.counts(reset=reset)
+        return {
+            "ttft_counts": ttft,
+            "itl_counts": itl,
+            "ttft_p50_ms": percentile_from_counts(ttft, 50.0),
+            "ttft_p99_ms": percentile_from_counts(ttft, 99.0),
+            "itl_p50_ms": percentile_from_counts(itl, 50.0),
+            "itl_p99_ms": percentile_from_counts(itl, 99.0),
+        }
+
     def metrics(self) -> Dict[str, float]:
         return {
             "num_running_reqs": float(self.n_running),
             "num_used_tokens": float(self.n_used_tokens),
             "total_generated": float(self.total_generated),
-            "queue_depth": float(self._queue.qsize() + len(self._backlog)),
+            "queue_depth": float(self.queue_depth),
+            "queued_prompt_tokens": float(self.queued_prompt_tokens),
+            "ttft_p50_ms": self.ttft_hist.percentile(50.0),
+            "ttft_p99_ms": self.ttft_hist.percentile(99.0),
+            "itl_p50_ms": self.itl_hist.percentile(50.0),
+            "itl_p99_ms": self.itl_hist.percentile(99.0),
+            "ttft_count": float(self.ttft_hist.total()),
+            "itl_count": float(self.itl_hist.total()),
             "kv_pages_free": float(self._allocator.n_free),
             "kv_pages_total": float(self.n_pages - 1),
             "num_preempted_reqs": float(self.n_preempted),
@@ -696,6 +768,39 @@ class ServingEngine:
             except queue.Empty:
                 return
 
+    def _pop_backlog(self, idx: int = 0) -> GenRequest:
+        req = self._backlog.pop(idx)
+        with self._fatal_lock:
+            self.queued_prompt_tokens = max(
+                0, self.queued_prompt_tokens - len(req.input_ids)
+            )
+        return req
+
+    # Admission rounds a class-1 request may be passed over before it
+    # is promoted to class 0. With more live sessions than slots the
+    # continuation stream never dries up, so without aging a fresh
+    # request could wait forever behind promoted continuations.
+    STARVATION_ROUNDS = 16
+
+    def _effective_priority(self, req: GenRequest) -> int:
+        if req.starved_rounds >= self.STARVATION_ROUNDS:
+            return 0
+        # A parked prefix marks a session continuation regardless of the
+        # caller-declared class: its KV is already paid for.
+        if req.qid in self._prefix_cache:
+            return 0
+        return req.priority
+
+    def _order_backlog(self):
+        """Priority-aware admission order: continuations / interrupted
+        re-prefills (class 0) ahead of fresh requests; FIFO within a
+        class (sort is stable). Fresh requests age (counter bumped in
+        _admit_impl for requests passed over by an admitting round):
+        after STARVATION_ROUNDS they join class 0, so a sustained
+        continuation stream cannot starve them."""
+        if any(self._effective_priority(r) != 0 for r in self._backlog):
+            self._backlog.sort(key=self._effective_priority)
+
     def _chunked_prefill_one(
         self, input_ids: List[int], pages: List[int], start: int = 0
     ):
@@ -764,9 +869,13 @@ class ServingEngine:
     def _admit_impl(self, batch):
         # Drain semantics for non-interrupting weight updates: stop
         # admitting so running requests finish and the swap can land.
+        # (Before the counter reset: a pending swap must not consume the
+        # interleave window — admission retries the lap after it lands.)
         if self._pending_params is not None:
             return
+        self._blocks_since_admit = 0
         self._drain_queue()
+        self._order_backlog()
         free = self._free_slots()
         # Chunked / cache-hit prefills run one prompt at a time on the
         # serve loop; admitting many long prompts in one lap would stall
@@ -774,6 +883,10 @@ class ServingEngine:
         # Cap them per lap (the rest stay in the backlog for the next
         # lap, after a decode block has run).
         n_chunked = 0
+        # Per-round prefill-token budget (token-budget continuous
+        # batching): estimated from the parked prefix BEFORE validation
+        # — a misprediction only shifts a prompt to the next round.
+        tok_budget = self.prefill_token_budget
         while free and self._backlog and len(batch) < self.prefill_max_batch:
             req = self._backlog[0]
             plen = len(req.input_ids)
@@ -782,10 +895,20 @@ class ServingEngine:
                 and n_chunked >= self.chunked_prefill_per_lap
             ):
                 break
+            est_new = plen
+            if tok_budget is not None:
+                ent = self._prefix_cache.get(req.qid)
+                if ent is not None:
+                    est_new = plen - min(len(ent[0]), plen - 1)
+                est_new = max(1, est_new)
+                # The first admission of a round always proceeds: a
+                # single over-budget prompt must not starve forever.
+                if batch and est_new > tok_budget:
+                    break
             if plen + req.max_new_tokens > self.S:
                 req.max_new_tokens = max(0, self.S - plen)
             if plen >= self.S or req.max_new_tokens == 0:
-                self._backlog.pop(0)
+                self._pop_backlog()
                 self._finish_host(req, [], [], no_eos=True, interrupted=False,
                                   vstart=self.version)
                 continue
@@ -796,7 +919,7 @@ class ServingEngine:
                 # would stall this request forever and head-of-line-block
                 # everything behind it. (Reachable via partial-rollout
                 # resubmission growing the prefix past pool capacity.)
-                self._backlog.pop(0)
+                self._pop_backlog()
                 logger.warning(
                     f"rejecting {req.qid}: prompt needs {n_need} pages, "
                     f"pool has {self.n_pages - 1}"
@@ -843,10 +966,19 @@ class ServingEngine:
                 pages = self._alloc_pages(n_reserve)
                 if pages is None:
                     break  # pool pressure: wait for frees
-            self._backlog.pop(0)
+            self._pop_backlog()
             batch.append((free.pop(0), req, plen, pages, cached_use))
+            if tok_budget is not None:
+                tok_budget = max(0, tok_budget - est_new)
             if self._takes_chunked_path(req, plen, cached_use):
                 n_chunked += 1
+        if batch:
+            # Starvation aging: only requests genuinely PASSED OVER age —
+            # someone else admitted ahead of them this round. Rounds with
+            # no admission capacity (all slots busy, pool dry) age no one,
+            # so sustained saturation can't promote the whole backlog.
+            for r in self._backlog:
+                r.starved_rounds += 1
         if not batch:
             return
         # Long prompts go through the fixed-shape chunked prefill (one
@@ -929,6 +1061,12 @@ class ServingEngine:
             jnp.asarray(col(lambda r: r.min_new_tokens > 0, bool, False)),
             jnp.asarray(eos_rows),
         ))  # one fetch: [n_b, 2]
+        # First token is on host: TTFT = submit -> now (queue wait +
+        # prefill + first sample, the SLO number the openloop bench
+        # sweeps).
+        t_first = time.monotonic()
+        for _, req_i, *_ in batch:
+            self.ttft_hist.add((t_first - req_i.submit_time) * 1000.0)
 
         # Host bookkeeping + one fused device admit.
         adm_slots, adm_valid = [], []
@@ -1245,6 +1383,7 @@ class ServingEngine:
                     reqs.append(self._queue.get_nowait())
                 except queue.Empty:
                     break
+            self.queued_prompt_tokens = 0
         for req in reqs:
             if req.done_cb:
                 try:
@@ -1268,7 +1407,18 @@ class ServingEngine:
             if self._interrupt.is_set():
                 self._interrupt_all()
                 self._apply_pending_params()
-            self._admit()
+            # Prefill/decode interleave: admission (which runs prefill on
+            # this thread) only every decode_blocks_per_admit blocks —
+            # except when idle, where admitting immediately is free.
+            if (
+                self._blocks_since_admit >= self.decode_blocks_per_admit
+                or not any(r is not None for r in self._slot_req)
+            ):
+                # _admit resets the interleave counter itself, AFTER its
+                # pending-weight-swap guard: a swap-blocked attempt keeps
+                # the counter saturated so admission retries next lap
+                # instead of waiting a fresh interleave period.
+                self._admit()
             if not any(r is not None for r in self._slot_req):
                 # idle: apply updates immediately, then wait for work
                 if self._pending_params is not None:
@@ -1286,6 +1436,7 @@ class ServingEngine:
             (lengths, next_input, active, remaining, min_remaining,
              temps, top_ps, top_ks, greedy) = self._dstate
             decode_t0 = tracing.now_ns() if tracing.enabled() else 0
+            t_blk0 = time.monotonic()
             if self.spec_draft_len > 0:
                 from areal_tpu.engine.spec_decode import (
                     paged_spec_decode_block,
@@ -1319,6 +1470,8 @@ class ServingEngine:
             self._dstate = (lengths, next_input, active, remaining,
                             min_remaining, temps, top_ps, top_ks, greedy)
             p = np.asarray(packed)  # the block's single device fetch
+            self._blocks_since_admit += 1
+            blk_ms = (time.monotonic() - t_blk0) * 1000.0
             if tracing.enabled():
                 tracing.record_span(
                     "server.decode_block", decode_t0,
@@ -1327,6 +1480,13 @@ class ServingEngine:
             toks_h = p[:, :n]
             lps_h = p[:, n:2 * n]
             n_emitted = p[:, 2 * n].astype(np.int64)
+            # Inter-token latency: block wall time amortized over each
+            # slot's emitted tokens (uniform within the block — the
+            # device doesn't timestamp individual steps).
+            for slot in range(self.B):
+                k = int(n_emitted[slot])
+                if k > 0 and self._slot_req[slot] is not None:
+                    self.itl_hist.add(blk_ms / k, count=k)
             if self.spec_draft_len > 0:
                 # Spec block appends a per-slot active-steps column: the
                 # exact yield denominator (early-finishing slots charge

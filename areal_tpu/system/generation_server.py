@@ -94,6 +94,8 @@ class GenerationServer(Worker):
             speculative_ngram=config.speculative_ngram,
             speculative_window=config.speculative_window,
             decode_weight_dtype=config.decode_weight_dtype,
+            prefill_token_budget=config.prefill_token_budget,
+            decode_blocks_per_admit=config.decode_blocks_per_admit,
             mesh=mesh,
         )
         self.engine.start()
@@ -103,6 +105,7 @@ class GenerationServer(Worker):
             # of prompt + the decode block covers the hot path.
             self.engine.warm([config.prompt_bucket])
         self._n_interrupted = 0
+        self._n_shed = 0
         self._last_load_info = None
 
         # Weight-plane prefetch state machine: idle -> fetching -> ready
@@ -177,11 +180,50 @@ class GenerationServer(Worker):
         self._http_ready.set()
         self._http_loop.run_forever()
 
+    def _admission_overloaded(self) -> Optional[float]:
+        """Backpressure watermark check: returns the Retry-After seconds
+        when /generate must shed, None when the request may queue. Reads
+        only host counters the engine maintains — no device sync."""
+        cfg = self.cfg
+        depth_wm = cfg.max_queue_depth
+        token_wm = cfg.max_queued_tokens
+        if depth_wm is None and token_wm is None:
+            return None
+        over = (
+            depth_wm is not None and self.engine.queue_depth >= depth_wm
+        ) or (
+            token_wm is not None
+            and self.engine.queued_prompt_tokens >= token_wm
+        )
+        return cfg.shed_retry_after_s if over else None
+
     async def _h_generate(self, request: web.Request) -> web.Response:
         # Chaos injection point: tests arm this to kill/fail/stall THIS
         # server mid-rollout and prove clients fail over.
         await faults.maybe_fail_async("gserver.generate")
         d = await request.json()
+        # Admission control BEFORE the engine sees the request: beyond
+        # the queue-depth/token watermark the server load-sheds with 429
+        # so open-loop tail latency stays bounded (clients back off with
+        # jitter and the manager spills the session to another server).
+        retry_after = self._admission_overloaded()
+        if retry_after is not None:
+            self._n_shed += 1
+            tracing.event(
+                "server.load_shed", ctx=tracing.extract_from(d),
+                qid=str(d.get("qid", "")),
+                queue_depth=self.engine.queue_depth,
+            )
+            return web.json_response(
+                {
+                    "qid": str(d.get("qid", "")),
+                    "error": "overloaded",
+                    "retry_after": retry_after,
+                    "queue_depth": self.engine.queue_depth,
+                },
+                status=429,
+                headers={"Retry-After": str(max(1, int(-(-retry_after // 1))))},
+            )
         # Request-scoped tracing: the client's chunk span is this span's
         # parent, so the merged timeline shows queue+compute time on the
         # server track inside the client's chunk.
@@ -210,6 +252,7 @@ class GenerationServer(Worker):
             top_p=float(g.get("top_p", 1.0)),
             top_k=int(g.get("top_k", -1)),
             stop_token_ids=tuple(g.get("stop_token_ids", [])),
+            priority=int(d.get("priority", 1)),
             done_cb=done_cb,
         )
         try:
@@ -629,12 +672,30 @@ class GenerationServer(Worker):
         return resp
 
     async def _h_metrics(self, request: web.Request) -> web.Response:
+        from areal_tpu.base.latency import encode_counts
+
         m = self.engine.metrics()
+        snap = self.engine.latency_snapshot()
         lines = [
             f"areal:num_running_reqs {m['num_running_reqs']}",
             f"areal:num_used_tokens {m['num_used_tokens']}",
             f"areal:total_generated_tokens {m['total_generated']}",
             f"areal:queue_depth {m['queue_depth']}",
+            f"areal:queued_prompt_tokens {m['queued_prompt_tokens']}",
+            # Admission control: requests shed with 429 (deliberate
+            # load-shedding, NOT failures — the manager must never count
+            # these toward eviction).
+            f"areal:load_shed_total {float(self._n_shed)}",
+            # Per-request latency SLOs from the engine loop. Percentiles
+            # for humans; raw bucket counts (base/latency.py edges,
+            # sparse i:count) for the manager's ratio-of-sums fleet
+            # aggregation — percentiles cannot be averaged.
+            f"areal:ttft_p50_ms {snap['ttft_p50_ms']}",
+            f"areal:ttft_p99_ms {snap['ttft_p99_ms']}",
+            f"areal:itl_p50_ms {snap['itl_p50_ms']}",
+            f"areal:itl_p99_ms {snap['itl_p99_ms']}",
+            f"areal:ttft_hist {encode_counts(snap['ttft_counts']) or '-'}",
+            f"areal:itl_hist {encode_counts(snap['itl_counts']) or '-'}",
             f"areal:num_interrupted_reqs {float(self._n_interrupted)}",
             f"areal:weight_version {float(self.engine.version)}",
             f"areal:kv_pages_free {m['kv_pages_free']}",

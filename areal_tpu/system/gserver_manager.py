@@ -32,11 +32,12 @@ correct across the outage.
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import shutil
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
@@ -100,6 +101,24 @@ class GserverManager(Worker):
         # unweighted mean of ratios overweights idle servers).
         self._server_spec_emitted = {u: 0.0 for u in self.server_urls}
         self._server_spec_steps = {u: 0.0 for u in self.server_urls}
+        # Prefix-/session-affinity routing + load-shed awareness:
+        # qid -> url LRU (a session's next chunk/turn goes to the server
+        # holding its KV prefix); servers that shed a client with 429
+        # are routed around until their Retry-After elapses (deliberate
+        # backpressure, never eviction); tokens scheduled since the last
+        # /metrics poll fold into least_token_usage so a burst between
+        # polls doesn't pile onto one server.
+        self._affinity: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._server_shed_until = {u: 0.0 for u in self.server_urls}
+        self._server_tokens_pending = {u: 0.0 for u in self.server_urls}
+        self._server_shed_total = {u: 0.0 for u in self.server_urls}
+        # Raw TTFT/ITL bucket counts per server (base/latency.py edges):
+        # fleet percentiles come from SUMMED buckets, the histogram
+        # analogue of the ratio-of-sums rule above.
+        self._server_ttft_hist: Dict[str, List[int]] = {}
+        self._server_itl_hist: Dict[str, List[int]] = {}
         self._last_gen_total = 0.0
         self._last_throughput_log = time.monotonic()
         self._throughput_log_interval = 10.0
@@ -164,25 +183,99 @@ class GserverManager(Worker):
     def _healthy_urls(self) -> List[str]:
         return [u for u in self.server_urls if u in self._healthy]
 
-    def _choose_server(self, meta: Dict) -> Optional[str]:
-        """Pick a healthy server under the configured policy; None when
-        the whole fleet is unhealthy (clients back off and retry)."""
+    def _load_key(self, u: str) -> Tuple[int, float]:
+        """Least-loaded order: in-flight request estimate first, then
+        token usage with the since-last-poll in-flight estimate folded
+        in (a burst between polls must not pile onto one server)."""
+        return (
+            self._server_reqs.get(u, 0),
+            self._server_tokens.get(u, 0.0)
+            + self._server_tokens_pending.get(u, 0.0),
+        )
+
+    def _choose_server(self, meta: Dict) -> Tuple[Optional[str], str]:
+        """Pick a healthy server; returns (url, policy) where policy
+        names the routing decision (recorded in the request trace):
+        'affinity' (session's prefix-holding server), 'spill' (affinity
+        target saturated/shedding -> least-loaded), 'sticky' (legacy
+        previous-server hint), or the configured base policy. (None,
+        'none') when the whole fleet is unhealthy."""
         candidates = self._healthy_urls()
         if not candidates:
-            return None
+            return None, "none"
+        now = time.monotonic()
+        open_ = [
+            u for u in candidates
+            if self._server_shed_until.get(u, 0.0) <= now
+        ]
+        # Whole fleet inside a shed window: route anyway (the client
+        # backs off on the 429 itself); a shed hint is advisory.
+        pool = open_ or candidates
+        qid = str(meta.get("qid") or "")
+        if self.cfg.session_affinity and qid:
+            aff = self._affinity.get(qid)
+            if aff is not None and aff in candidates:
+                sat = self.cfg.affinity_saturation_requests
+                shedding = self._server_shed_until.get(aff, 0.0) > now
+                saturated = (
+                    sat is not None and self._server_reqs.get(aff, 0) >= sat
+                )
+                if not shedding and not saturated:
+                    # KV-prefix reuse survives weight-version bumps: the
+                    # engine flushes stale KV on swap, so the worst case
+                    # is the same re-prefill any server would pay.
+                    return aff, "affinity"
+                spill_pool = [u for u in pool if u != aff] or pool
+                return min(spill_pool, key=self._load_key), "spill"
         prev = meta.get("previous_server_url") or ""
         prev_version = int(meta.get("previous_version", -1))
-        # Sticky routing while the version is unchanged (KV prefix reuse).
-        if prev in candidates and prev_version == self.weight_version:
-            return prev
+        # Legacy sticky hint (clients predating the affinity map, or a
+        # restarted manager with an empty map). Unlike affinity it has
+        # no saturation/shed spill, so keep the pre-affinity guard:
+        # sticky only while the weight version is unchanged — version
+        # bumps are the periodic rebalancing trigger.
+        if prev in pool and prev_version == self.weight_version:
+            return prev, "sticky"
         policy = self.cfg.schedule_policy
         if policy == "least_requests":
-            return min(candidates, key=lambda u: self._server_reqs[u])
+            return min(pool, key=lambda u: self._server_reqs[u]), policy
         if policy == "least_token_usage":
-            return min(candidates, key=lambda u: self._server_tokens[u])
-        url = candidates[self._rr % len(candidates)]
+            return min(
+                pool,
+                key=lambda u: self._server_tokens[u]
+                + self._server_tokens_pending.get(u, 0.0),
+            ), policy
+        url = pool[self._rr % len(pool)]
         self._rr += 1
-        return url
+        return url, "round_robin"
+
+    def _route(self, meta: Dict) -> Tuple[Optional[str], str]:
+        """Choose a server AND do the routing-side bookkeeping: bump the
+        in-flight request estimate, fold the scheduled tokens into the
+        load estimate until the next /metrics poll refreshes the
+        snapshot (a burst between polls must not pile onto one server),
+        and record the session's affinity."""
+        qid = str(meta.get("qid") or "")
+        with self._lock:
+            url, policy = self._choose_server(meta)
+            if url is not None:
+                self._server_reqs[url] += 1
+                self._server_tokens_pending[url] = (
+                    self._server_tokens_pending.get(url, 0.0)
+                    + float(meta.get("prompt_len") or 0)
+                    + float(meta.get("new_token_budget") or 0)
+                )
+                self._record_affinity(qid, url)
+        return url, policy
+
+    def _record_affinity(self, qid: str, url: str):
+        """LRU-bounded qid -> url map (call under self._lock)."""
+        if not qid or not self.cfg.session_affinity:
+            return
+        self._affinity.pop(qid, None)
+        self._affinity[qid] = url
+        while len(self._affinity) > max(1, self.cfg.affinity_map_size):
+            self._affinity.popitem(last=False)
 
     # ------------------------------------------------------------------
     # Fault-domain isolation: eviction + readmission
@@ -200,6 +293,8 @@ class GserverManager(Worker):
             # readmitted server starts from a clean routing slate.
             self._server_reqs[url] = 0
             self._server_tokens[url] = 0.0
+            self._server_tokens_pending[url] = 0.0
+            self._server_shed_until[url] = 0.0
         logger.warning(
             f"evicted generation server {url}: {reason} "
             f"({len(self._healthy_urls())}/{len(self.server_urls)} healthy)"
@@ -283,11 +378,20 @@ class GserverManager(Worker):
                 self._server_prefix_hits, self._server_prefix_reused,
                 self._server_gen_reqs,
                 self._server_spec_emitted, self._server_spec_steps,
+                self._server_tokens_pending, self._server_shed_until,
+                self._server_shed_total,
             ):
                 d.pop(old, None)
                 d[new] = 0.0
             self._server_reqs.pop(old, None)
             self._server_reqs[new] = 0
+            self._server_ttft_hist.pop(old, None)
+            self._server_itl_hist.pop(old, None)
+            # The new incarnation holds no KV: affinity entries pointing
+            # at the dead address would route sessions to a cold cache
+            # AND (worse) to an evicted url. Drop them.
+            for qid in [q for q, u in self._affinity.items() if u == old]:
+                self._affinity.pop(qid, None)
             self._server_versions.pop(old, None)
             self._server_versions[new] = 0
             self._healthy.discard(old)
@@ -397,6 +501,27 @@ class GserverManager(Worker):
             "prefix_tokens_reused_per_hit": reused / hits if hits > 0 else 0.0,
         }
 
+    def serving_latency_fleet(self) -> Dict[str, float]:
+        """Fleet TTFT/ITL percentiles from SUMMED per-server bucket
+        counts (the histogram form of the ratio-of-sums rule): merging
+        raw buckets yields the true fleet distribution, which averaged
+        per-server percentiles do not."""
+        from areal_tpu.base.latency import (
+            merge_counts, percentile_from_counts,
+        )
+
+        ttft = merge_counts(self._server_ttft_hist.values())
+        itl = merge_counts(self._server_itl_hist.values())
+        return {
+            "ttft_p50_ms": percentile_from_counts(ttft, 50.0),
+            "ttft_p99_ms": percentile_from_counts(ttft, 99.0),
+            "itl_p50_ms": percentile_from_counts(itl, 50.0),
+            "itl_p99_ms": percentile_from_counts(itl, 99.0),
+            "ttft_count": float(sum(ttft)),
+            "itl_count": float(sum(itl)),
+            "load_shed_total": sum(self._server_shed_total.values()),
+        }
+
     def is_staled(self) -> bool:
         """Staleness gate (reference gserver_manager.py:351-366): if this
         rollout trained at the version implied by samples already produced,
@@ -440,20 +565,33 @@ class GserverManager(Worker):
         failed = meta.get("failed_server_url")
         if failed:
             self._mark_unhealthy(failed, "client-reported request failure")
-        with self._lock:
-            url = self._choose_server(meta)
-            if url is not None:
-                self._server_reqs[url] += 1
+        # A 429 is DELIBERATE load-shedding, never a failure: route
+        # around the server for its Retry-After window (sessions with
+        # affinity there spill to the least-loaded server) and keep it
+        # healthy.
+        shed = meta.get("shed_server_url")
+        if shed and shed in self.server_urls:
+            ra = float(meta.get("shed_retry_after") or 1.0)
+            with self._lock:
+                self._server_shed_until[shed] = time.monotonic() + ra
+                self._server_shed_total[shed] = (
+                    self._server_shed_total.get(shed, 0.0) + 1.0
+                )
+        qid = str(meta.get("qid") or "")
+        url, policy = self._route(meta)
         tracing.event(
             "manager.schedule", ctx=trace_ctx,
-            server=url or "", routed=url is not None,
+            server=url or "", routed=url is not None, policy=policy,
+            qid=qid,
         )
         if url is None:
             return web.json_response(
                 {"error": "no healthy generation servers", "retry_after": 0.5},
                 status=503,
             )
-        return web.json_response({"url": url, "version": self.weight_version})
+        return web.json_response(
+            {"url": url, "version": self.weight_version, "policy": policy}
+        )
 
     async def _h_allocate(self, request: web.Request) -> web.Response:
         d = await request.json()
@@ -522,6 +660,14 @@ class GserverManager(Worker):
                 "evicted_servers": evicted,
                 "server_versions": versions,
                 "prefix_cache": self.prefix_cache_fleet(),
+                # Fleet latency SLOs (merged engine histograms) + the
+                # admission-control counters, next to prefix_cache.
+                "serving_latency": self.serving_latency_fleet(),
+                "load_shed": {
+                    "total": sum(self._server_shed_total.values()),
+                    "per_server": dict(self._server_shed_total),
+                },
+                "affinity_entries": len(self._affinity),
                 # Last tree fanout: per-server transfer vs cutover ms
                 # (separate by design), the planned tree, and any
                 # evictions it caused. Empty when the plane is off.
@@ -922,6 +1068,8 @@ class GserverManager(Worker):
             # Evicted servers are skipped: polling a dead endpoint costs a
             # 5s timeout per tick and the health registry already owns
             # their lifecycle.
+            from areal_tpu.base.latency import decode_counts
+
             for u in self._healthy_urls():
                 try:
                     async with sess.get(f"{u}/metrics") as r:
@@ -929,8 +1077,23 @@ class GserverManager(Worker):
                     for line in text.splitlines():
                         if line.startswith("areal:num_used_tokens"):
                             self._server_tokens[u] = float(line.split()[-1])
+                            # Fresh snapshot: the since-last-poll
+                            # in-flight fold restarts from zero.
+                            self._server_tokens_pending[u] = 0.0
                         elif line.startswith("areal:num_running_reqs"):
                             self._server_reqs[u] = int(float(line.split()[-1]))
+                        elif line.startswith("areal:load_shed_total"):
+                            self._server_shed_total[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:ttft_hist"):
+                            self._server_ttft_hist[u] = decode_counts(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:itl_hist"):
+                            self._server_itl_hist[u] = decode_counts(
+                                line.split()[-1]
+                            )
                         elif line.startswith("areal:total_generated_tokens"):
                             self._server_gen_totals[u] = float(line.split()[-1])
                         elif line.startswith("areal:prefix_cache_hits"):

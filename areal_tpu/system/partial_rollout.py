@@ -13,6 +13,7 @@ gathered into `BundledGenerationOutputs`.
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Dict, List, Optional
 
 import aiohttp
@@ -122,6 +123,15 @@ class PartialRolloutManager:
         prev_url, prev_version = "", -1
         failed_url: Optional[str] = None
         retries = 0
+        # 429 load-shedding is DELIBERATE backpressure, not a failure:
+        # it gets its own (generous) budget, jittered backoff around the
+        # server's Retry-After, and a shed hint to the manager (which
+        # spills the session's affinity instead of evicting the server).
+        shed_url: Optional[str] = None
+        shed_ra_hint = 0.0
+        n_shed = 0
+        consec_shed = 0
+        shed_budget = max(32, self.max_retries * 8)
         # Interruption-cost accounting: any submission carrying an
         # already-accumulated prefix makes the server (re-)prefill
         # prompt+prefix under (possibly new) weights; prefix caching may
@@ -136,6 +146,10 @@ class PartialRolloutManager:
                 sched = await self._schedule(
                     tracing.inject_into(
                         dict(
+                            # Session key for the manager's prefix-
+                            # affinity routing (the server's parked KV is
+                            # keyed by this same qid).
+                            qid=qid,
                             prompt_len=len(prompt_ids) + len(acc_out),
                             group_size=1,
                             new_token_budget=budget,
@@ -145,6 +159,11 @@ class PartialRolloutManager:
                             # on, so the manager evicts it before routing
                             # this retry.
                             failed_server_url=failed_url,
+                            # A server that shed us with 429: routed
+                            # around for its Retry-After window, NOT
+                            # evicted.
+                            shed_server_url=shed_url,
+                            shed_retry_after=shed_ra_hint,
                         )
                     )
                 )
@@ -163,6 +182,7 @@ class PartialRolloutManager:
                 await asyncio.sleep(self._backoff(retries))
                 continue
             failed_url = None
+            shed_url, shed_ra_hint = None, 0.0
             if "url" not in sched:
                 # 503: no healthy servers right now. Back off and retry —
                 # the watchdog restarting a server or the health registry
@@ -193,6 +213,10 @@ class PartialRolloutManager:
                 dict(
                     qid=qid,
                     input_ids=list(prompt_ids) + acc_out,
+                    # Continuations/re-prefills admit ahead of fresh
+                    # requests (engine priority class 0): their prefix
+                    # pages are already paid for.
+                    priority=0 if acc_out else 1,
                     gconfig=dict(
                         max_new_tokens=chunk,
                         min_new_tokens=max(
@@ -207,20 +231,39 @@ class PartialRolloutManager:
                 ),
                 chunk_span.ctx if chunk_span is not None else None,
             )
+            shed_ra: Optional[float] = None
             try:
                 async with sess.post(f"{url}/generate", json=payload) as r:
-                    if r.status != 200:
+                    if r.status == 429:
+                        # Deliberate load-shedding, not a failure: honor
+                        # Retry-After, tell the manager (shed hint, for
+                        # spill routing), and keep the retry out of the
+                        # failure budget.
+                        try:
+                            body = await r.json()
+                        except Exception:
+                            body = {}
+                        shed_ra = float(
+                            body.get("retry_after")
+                            or r.headers.get("Retry-After")
+                            or 1.0
+                        )
+                        if chunk_span is not None:
+                            chunk_span.end(shed=True)
+                    elif r.status != 200:
                         raise ServerFailure(
                             url, f"{r.status} {await r.text()}"
                         )
-                    out = await r.json()
-                # Success end INSIDE the try: the finally's failed=True
-                # end is then a no-op (ManualSpan.end is idempotent).
-                if chunk_span is not None:
-                    chunk_span.end(
-                        reprefill_tokens=chunk_reprefill,
-                        n_tokens=len(out.get("output_ids") or []),
-                    )
+                    else:
+                        out = await r.json()
+                        # Success end INSIDE the try: the finally's
+                        # failed=True end is then a no-op (ManualSpan.end
+                        # is idempotent).
+                        if chunk_span is not None:
+                            chunk_span.end(
+                                reprefill_tokens=chunk_reprefill,
+                                n_tokens=len(out.get("output_ids") or []),
+                            )
             except (
                 ServerFailure, aiohttp.ClientError, asyncio.TimeoutError,
             ) as e:
@@ -246,6 +289,27 @@ class PartialRolloutManager:
                 # a zero-drop dangling parent — fatal to the validator.
                 if chunk_span is not None:
                     chunk_span.end(failed=True)
+            if shed_ra is not None:
+                n_shed += 1
+                consec_shed += 1
+                if n_shed > shed_budget:
+                    raise RuntimeError(
+                        f"{qid}: load-shed {n_shed} times without "
+                        f"progress (last Retry-After {shed_ra:.2f}s from "
+                        f"{url}); fleet persistently overloaded"
+                    )
+                shed_url, shed_ra_hint = url, shed_ra
+                tracing.event(
+                    "gen.shed", qid=qid, server=url, retry_after=shed_ra
+                )
+                # Jittered backoff around the server's hint (plus a mild
+                # exponential ramp on consecutive sheds): synchronized
+                # retries from many workers would re-create the very
+                # burst that tripped the watermark.
+                delay = min(10.0, shed_ra * (2 ** min(consec_shed - 1, 3)))
+                await asyncio.sleep(delay * (0.5 + random.random()))
+                continue
+            consec_shed = 0
             if version_start < 0:
                 version_start = int(out.get("version_start", server_version))
             version_end = int(out.get("version_end", server_version))

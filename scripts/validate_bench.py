@@ -39,7 +39,55 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "weight_update_ms", "weight_transfer_ms", "weight_cutover_ms",
         "origin_full_payloads",
     ),
+    "serving_openloop": (
+        "capacity_rps",
+        "overload_offered_rps",
+        "overload_admission_p99_ttft_ms",
+        "overload_admission_goodput_rps",
+        "overload_baseline_p99_ttft_ms",
+        "overload_baseline_goodput_rps",
+    ),
 }
+
+# Numeric keys every serving_openloop arrival-rate sweep point must
+# carry: a record without the sweep (or with points missing p99 TTFT)
+# is not tail-latency evidence.
+OPENLOOP_POINT_KEYS = (
+    "offered_rps", "goodput_rps", "p50_ttft_ms", "p99_ttft_ms",
+)
+
+
+def _validate_openloop_sweep(val: Dict) -> List[str]:
+    problems: List[str] = []
+    sweep = val.get("sweep")
+    if not isinstance(sweep, list) or len(sweep) < 2:
+        return [
+            "serving_openloop: measure value must carry an arrival-rate "
+            "'sweep' list with >= 2 points"
+        ]
+    for i, pt in enumerate(sweep):
+        if not isinstance(pt, dict):
+            problems.append(f"serving_openloop: sweep[{i}] is not an object")
+            continue
+        for k in OPENLOOP_POINT_KEYS:
+            if not isinstance(pt.get(k), (int, float)) or isinstance(
+                pt.get(k), bool
+            ):
+                problems.append(
+                    f"serving_openloop: sweep[{i}] missing numeric {k!r}"
+                )
+        off, good = pt.get("offered_rps"), pt.get("goodput_rps")
+        if (
+            isinstance(off, (int, float))
+            and isinstance(good, (int, float))
+            and good > off * 1.001
+        ):
+            # Physically impossible: completions can't outrun arrivals.
+            problems.append(
+                f"serving_openloop: sweep[{i}] goodput {good:.2f} rps "
+                f"exceeds offered load {off:.2f} rps"
+            )
+    return problems
 
 
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
@@ -62,6 +110,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
             f"{name}: origin served {ofp:.2f} full payloads — peer "
             f"fanout silently degraded to an origin broadcast"
         )
+    if name == "serving_openloop":
+        problems.extend(_validate_openloop_sweep(val))
     return problems
 
 

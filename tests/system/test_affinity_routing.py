@@ -1,0 +1,245 @@
+"""ISSUE 6 multi-server acceptance, all real components in-process:
+2 GenerationServer workers (real ServingEngines on CPU jax, bounded
+admission queues) behind a real GserverManager, driven by the real
+PartialRolloutManager client.
+
+Asserted end to end:
+- affinity routing sends a session's follow-up chunk to the
+  prefix-holding server, measured via per-server prefix_cache_hit_rate
+  (/metrics: hits on exactly one server);
+- when the affinity target load-sheds with 429 (admission watermark),
+  the client backs off with the Retry-After hint and the manager SPILLS
+  the session to the other server — the shed server stays healthy
+  (deliberate backpressure is not a failure);
+- every routing decision is visible in the PR 3 trace
+  (manager.schedule spans with policy=affinity / spill), alongside the
+  server-side load_shed marker;
+- fleet TTFT/ITL percentiles aggregate into the manager /status next to
+  prefix_cache."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.api.system_api import (
+    GenerationServerConfig,
+    GserverManagerConfig,
+)
+from tests import fixtures
+
+pytestmark = pytest.mark.serial
+
+# tests/engine/test_prefix_cache.small_cfg as a factory dict; the engine
+# geometry below (B=4, page 16, block 4, bucket 16, max_seq 256) matches
+# that module's engines, so an in-process tier-1 run reuses the
+# already-compiled serving programs. Prefix parking needs sequences
+# >= page_size tokens, hence the 20-token prompt below.
+MODEL_CFG = dict(
+    n_layers=2, hidden_dim=64, n_q_heads=4, n_kv_heads=2, head_dim=16,
+    intermediate_dim=128, vocab_size=256, max_position_embeddings=512,
+    compute_dtype="float32",
+)
+PROMPT = list(range(20, 40))
+
+
+def _metrics(url):
+    text = urllib.request.urlopen(url + "/metrics", timeout=30).read().decode()
+    out = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                out[parts[0]] = parts[1]
+    return out
+
+
+def _wait_until(cond, timeout, msg):
+    deadline = time.monotonic() + fixtures.scale_timeout(timeout)
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.mark.timeout(600)
+def test_affinity_routing_spill_on_429_and_trace(tmp_path, monkeypatch):
+    from areal_tpu.base import name_resolve, names, tracing
+    from areal_tpu.engine.serving import GenRequest
+    from areal_tpu.system.generation_server import GenerationServer
+    from areal_tpu.system.gserver_manager import GserverManager
+    from areal_tpu.system.partial_rollout import PartialRolloutManager
+    from areal_tpu.utils import rl_trace
+
+    exp, trial = f"affinity-{uuid.uuid4().hex[:6]}", "t0"
+    trace_dir = str(tmp_path / "rl_trace")
+    monkeypatch.setenv("AREAL_HEALTH_TTL", "120")
+    monkeypatch.setenv("AREAL_RL_TRACE", "1")
+    monkeypatch.setenv("AREAL_RL_TRACE_DIR", trace_dir)
+    tracing.reconfigure()
+    name_resolve.reconfigure("nfs", record_root=str(tmp_path / "nr"))
+
+    servers = []
+    mgr = None
+    mgr_thread = None
+    prm = None
+    loop = asyncio.new_event_loop()
+    try:
+        for i in range(2):
+            cfg = GenerationServerConfig(
+                experiment_name=exp, trial_name=trial, server_index=i,
+                model=ModelAbstraction(
+                    "tpu_transformer", args=dict(config=dict(MODEL_CFG))
+                ),
+                max_concurrent_requests=4, max_seq_len=256,
+                kv_page_size=16, decode_block_steps=4, prompt_bucket=16,
+                prefix_cache_tokens=2048,
+                # Bounded admission queue: one backlogged request is
+                # already over the watermark -> 429 + Retry-After.
+                max_queue_depth=1, shed_retry_after_s=0.2,
+                seed=i,
+            )
+            w = GenerationServer()
+            w.configure(cfg, experiment_name=exp, trial_name=trial,
+                        worker_name=cfg.worker_name)
+            servers.append(w)
+
+        mgr = GserverManager()
+        mgr.configure(
+            GserverManagerConfig(
+                experiment_name=exp, trial_name=trial, model_name="actor",
+                n_servers=2, schedule_policy="least_requests",
+                train_batch_size=4, max_head_offpolicyness=1000,
+                health_check_interval=0.5,
+            ),
+            experiment_name=exp, trial_name=trial,
+            worker_name="gserver_manager",
+        )
+        mgr_thread = threading.Thread(target=mgr.run, daemon=True)
+        mgr_thread.start()
+        _wait_until(lambda: len(mgr._healthy_urls()) == 2, 60,
+                    "manager sees both servers")
+
+        prm = PartialRolloutManager(
+            mgr.address, new_tokens_per_chunk=4,
+            request_timeout=fixtures.scale_timeout(120),
+        )
+        g = GenerationHyperparameters(max_new_tokens=8, greedy=True)
+
+        # --- Phase 1: chunked session -> affinity hit on the prefix
+        # holder. new_tokens_per_chunk=4 < max_new_tokens=8 forces a
+        # resubmission carrying the accumulated prefix under one qid.
+        out = loop.run_until_complete(
+            prm._generate_one("sess/0", PROMPT, g)
+        )
+        assert len(out.output_ids) >= 4
+        by_url = {w.address: w for w in servers}
+        hits = {u: w.engine.prefix_cache_hits for u, w in by_url.items()}
+        assert sorted(hits.values()) == [0, 1], hits
+        aff_url = max(hits, key=hits.get)
+        assert mgr._affinity.get("sess/0") == aff_url
+        # Per-server hit RATE over the /metrics surface (the fleet
+        # aggregation inputs): only the prefix holder has a nonzero rate.
+        m_aff = _metrics(aff_url)
+        assert m_aff["areal:prefix_cache_hits"] == 1.0
+        assert 0.0 < (
+            m_aff["areal:prefix_cache_hits"] / m_aff["areal:total_requests"]
+        ) <= 1.0
+        other_url = next(u for u in by_url if u != aff_url)
+        assert _metrics(other_url)["areal:prefix_cache_hits"] == 0.0
+
+        # --- Phase 2: saturate the affinity target so its admission
+        # queue sheds, then continue the session: 429 -> jittered
+        # backoff -> shed hint -> manager spills to the other server.
+        aff_eng = by_url[aff_url].engine
+        for i in range(12):
+            aff_eng.submit(GenRequest(
+                qid=f"blk{i}", input_ids=[9, 10, 11], max_new_tokens=200,
+                greedy=True, done_cb=lambda r: None,
+            ))
+        _wait_until(lambda: aff_eng.queue_depth >= 1, 30,
+                    "affinity target backlogged")
+        other_reqs_before = by_url[other_url].engine.total_requests
+        out2 = loop.run_until_complete(
+            prm._generate_one("sess/0", PROMPT + out.output_ids,
+                              GenerationHyperparameters(
+                                  max_new_tokens=4, greedy=True))
+        )
+        assert len(out2.output_ids) >= 1
+        assert by_url[other_url].engine.total_requests > other_reqs_before
+        # Deliberate shedding never evicted the target...
+        assert set(mgr._healthy_urls()) == set(by_url)
+        # ...and the shed surfaced on the server's own /metrics.
+        assert _metrics(aff_url)["areal:load_shed_total"] >= 1.0
+        # The spill re-homed the session's affinity.
+        assert mgr._affinity.get("sess/0") == other_url
+
+        # --- Fleet latency aggregation: after a /metrics poll cycle the
+        # manager /status carries merged TTFT/ITL percentiles next to
+        # prefix_cache.
+        def status():
+            with urllib.request.urlopen(
+                mgr.address + "/status", timeout=30
+            ) as r:
+                return json.loads(r.read())
+
+        _wait_until(
+            lambda: status()["serving_latency"]["ttft_count"] > 0, 30,
+            "fleet latency aggregation",
+        )
+        st = status()
+        assert st["serving_latency"]["ttft_p99_ms"] >= (
+            st["serving_latency"]["ttft_p50_ms"]
+        ) > 0
+        assert st["serving_latency"]["itl_count"] > 0
+        assert st["load_shed"]["total"] >= 1.0
+        assert st["prefix_cache"]["prefix_cache_hits"] >= 1.0
+        assert st["affinity_entries"] >= 1
+
+        # --- PR 3 trace: the routing decisions are spans with a policy
+        # attribute; the server-side shed left its own marker.
+        tracing.flush()
+        shards = rl_trace.load_shards(trace_dir)
+        sched = [
+            sp for s in shards for sp in s.spans
+            if sp["name"] == "manager.schedule"
+        ]
+        policies = [sp.get("attrs", {}).get("policy") for sp in sched]
+        assert "affinity" in policies, policies
+        assert "spill" in policies, policies
+        spill_span = next(
+            sp for sp in sched
+            if sp.get("attrs", {}).get("policy") == "spill"
+        )
+        assert spill_span["attrs"]["server"] == other_url
+        assert spill_span["attrs"]["qid"] == "sess/0"
+        assert any(
+            sp["name"] == "server.load_shed"
+            for s in shards for sp in s.spans
+        )
+    finally:
+        try:
+            name_resolve.add(
+                names.experiment_status(exp, trial), "COMPLETE",
+                replace=True,
+            )
+        except Exception:
+            pass
+        if mgr_thread is not None:
+            mgr_thread.join(timeout=15)
+        for w in servers:
+            w._exit_hook()
+        if prm is not None:
+            loop.run_until_complete(prm.close())
+        loop.run_until_complete(asyncio.sleep(0))
+        loop.close()
+        tracing.reconfigure()

@@ -307,7 +307,7 @@ def test_server_death_mid_rollout_degrades_then_recovers(chaos_env):
     for policy in ("round_robin", "least_requests", "least_token_usage"):
         m.cfg.schedule_policy = policy
         with m._lock:
-            choices = {m._choose_server({}) for _ in range(4)}
+            choices = {m._choose_server({})[0] for _ in range(4)}
         assert choices == {survivor.address}, policy
 
     # --- (3) quorum fanout: publish v1; it must land on the survivor
@@ -333,7 +333,7 @@ def test_server_death_mid_rollout_degrades_then_recovers(chaos_env):
     assert m._server_versions[victim.address] == 1
     m.cfg.schedule_policy = "round_robin"
     with m._lock:
-        routed = {m._choose_server({}) for _ in range(4)}
+        routed = {m._choose_server({})[0] for _ in range(4)}
     assert routed == {victim.address, survivor.address}
 
     m.exit()
@@ -383,7 +383,7 @@ def test_restarted_server_at_new_address_migrates_routing(chaos_env):
     assert replacement.address in m.server_urls
     assert replacement.versions == [1]  # re-synced before rotation
     with m._lock:
-        routed = {m._choose_server({}) for _ in range(4)}
+        routed = {m._choose_server({})[0] for _ in range(4)}
     assert routed == {replacement.address, keeper.address}
     m.exit()
 
@@ -416,7 +416,7 @@ def test_never_seen_member_adopted_after_eviction(chaos_env):
     assert silent.address not in m.server_urls
     assert replacement.address in m.server_urls
     with m._lock:
-        routed = {m._choose_server({}) for _ in range(4)}
+        routed = {m._choose_server({})[0] for _ in range(4)}
     assert routed == {replacement.address, keeper.address}
     m.exit()
 

@@ -1,0 +1,102 @@
+"""Routing-policy units for the gserver manager's production scheduler:
+prefix-/session-affinity, shed-aware + saturation spill, and the
+in-flight fold that keeps least_token_usage honest between /metrics
+polls (ISSUE 6 satellite: a burst must not pile onto one server just
+because the snapshot is stale)."""
+
+import collections
+import threading
+import time
+
+from areal_tpu.api.system_api import GserverManagerConfig
+from areal_tpu.system.gserver_manager import GserverManager
+
+A, B = "http://a:1", "http://b:2"
+
+
+def _manager(policy="round_robin", **cfg_kw):
+    m = GserverManager.__new__(GserverManager)
+    m.cfg = GserverManagerConfig(
+        n_servers=2, schedule_policy=policy, **cfg_kw
+    )
+    m.server_urls = [A, B]
+    m._healthy = set(m.server_urls)
+    m._rr = 0
+    m._lock = threading.Lock()
+    m._server_reqs = {u: 0 for u in m.server_urls}
+    m._server_tokens = {u: 0.0 for u in m.server_urls}
+    m._server_tokens_pending = {u: 0.0 for u in m.server_urls}
+    m._server_shed_until = {u: 0.0 for u in m.server_urls}
+    m._server_shed_total = {u: 0.0 for u in m.server_urls}
+    m._affinity = collections.OrderedDict()
+    m.weight_version = 0
+    return m
+
+
+def test_least_token_usage_folds_inflight_between_polls():
+    """Equal snapshots + a burst of schedules: without the pending fold
+    every request would land on the min-snapshot server; with it they
+    alternate."""
+    m = _manager("least_token_usage")
+    placed = [
+        m._route({"prompt_len": 100, "new_token_budget": 100})[0]
+        for _ in range(6)
+    ]
+    assert placed.count(A) == 3 and placed.count(B) == 3
+
+
+def test_affinity_routes_follow_up_to_prefix_holder_across_versions():
+    m = _manager("least_requests")
+    url1, policy1 = m._route({"qid": "s/0", "prompt_len": 10})
+    assert policy1 == "least_requests"
+    # Load the affinity target heavily: affinity still wins (the prefix
+    # is there), and survives a weight-version bump.
+    m._server_reqs[url1] = 50
+    m.weight_version = 7
+    url2, policy2 = m._route({"qid": "s/0", "prompt_len": 20})
+    assert (url2, policy2) == (url1, "affinity")
+
+
+def test_affinity_spills_on_shed_window_then_returns():
+    m = _manager("round_robin")
+    url1, _ = m._route({"qid": "s/1", "prompt_len": 10})
+    other = B if url1 == A else A
+    # The server shed a client with 429: routed around for Retry-After.
+    m._server_shed_until[url1] = time.monotonic() + 30.0
+    url2, policy2 = m._route({"qid": "s/1", "prompt_len": 10})
+    assert (url2, policy2) == (other, "spill")
+    # Spill re-recorded the affinity on the server now holding the
+    # session's newest prefix.
+    m._server_shed_until[url1] = 0.0
+    url3, policy3 = m._route({"qid": "s/1", "prompt_len": 10})
+    assert (url3, policy3) == (other, "affinity")
+
+
+def test_affinity_spills_on_saturation_threshold():
+    m = _manager("least_requests", affinity_saturation_requests=4)
+    url1, _ = m._route({"qid": "s/2", "prompt_len": 10})
+    m._server_reqs[url1] = 4
+    other = B if url1 == A else A
+    m._server_reqs[other] = 0
+    url2, policy2 = m._route({"qid": "s/2", "prompt_len": 10})
+    assert (url2, policy2) == (other, "spill")
+
+
+def test_affinity_ignores_unhealthy_target_and_map_is_bounded():
+    m = _manager("round_robin", affinity_map_size=2)
+    url1, _ = m._route({"qid": "s/3", "prompt_len": 10})
+    m._healthy.discard(url1)
+    url2, policy2 = m._route({"qid": "s/3", "prompt_len": 10})
+    assert url2 != url1 and policy2 != "affinity"
+    # LRU bound: oldest entries fall out.
+    for i in range(5):
+        m._route({"qid": f"lru/{i}", "prompt_len": 1})
+    assert len(m._affinity) <= 2
+
+
+def test_whole_fleet_shedding_still_routes():
+    m = _manager("least_requests")
+    now = time.monotonic()
+    m._server_shed_until = {A: now + 30, B: now + 30}
+    url, _ = m._route({"qid": "s/4", "prompt_len": 10})
+    assert url in (A, B)

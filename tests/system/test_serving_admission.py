@@ -1,0 +1,102 @@
+"""ISSUE 6 satellite: 429 + Retry-After from a generation server is
+DELIBERATE load-shedding, not a failure. The partial-rollout client must
+back off (jittered, honoring the hint), resume against the fleet, report
+a shed hint — never a failure report (which would evict the healthy
+server) — and spend none of its failure-retry budget on sheds."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from areal_tpu.api.model_api import GenerationHyperparameters
+from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+
+async def _start_app(routes):
+    app = web.Application()
+    for method, path, handler in routes:
+        app.router.add_route(method, path, handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+async def _scenario(n_sheds: int):
+    """Stub server sheds the first `n_sheds` /generate calls with 429,
+    then serves; stub manager records every schedule meta."""
+    scheds = []
+    gen_payloads = []
+
+    async def h_gen(request):
+        d = await request.json()
+        gen_payloads.append(d)
+        if len(gen_payloads) <= n_sheds:
+            return web.json_response(
+                {"error": "overloaded", "retry_after": 0.02,
+                 "queue_depth": 9},
+                status=429, headers={"Retry-After": "1"},
+            )
+        return web.json_response({
+            "qid": d["qid"], "output_ids": [1, 2],
+            "output_logprobs": [-0.1, -0.2], "no_eos": False,
+            "interrupted": False, "version_start": 0, "version_end": 0,
+            "latency": 0.0,
+        })
+
+    srv_runner, srv_url = await _start_app([("POST", "/generate", h_gen)])
+
+    async def h_sched(request):
+        meta = await request.json()
+        scheds.append(meta)
+        return web.json_response({"url": srv_url, "version": 0,
+                                  "policy": "round_robin"})
+
+    mgr_runner, mgr_url = await _start_app(
+        [("POST", "/schedule_request", h_sched)]
+    )
+    try:
+        # max_retries=0: ANY failure-classified retry raises, so the 429
+        # path demonstrably consumes no failure budget.
+        prm = PartialRolloutManager(mgr_url, max_retries=0)
+        out = await prm._generate_one(
+            "sess/0", [5, 6, 7],
+            GenerationHyperparameters(max_new_tokens=2, greedy=True),
+        )
+        await prm.close()
+        return out, scheds, gen_payloads
+    finally:
+        await srv_runner.cleanup()
+        await mgr_runner.cleanup()
+
+
+@pytest.mark.timeout(60)
+def test_client_honors_429_with_backoff_and_shed_hint():
+    out, scheds, gens = asyncio.run(_scenario(n_sheds=2))
+    assert out.output_ids == [1, 2] and not out.no_eos
+    assert len(gens) == 3  # 2 sheds + 1 success
+    assert len(scheds) == 3
+    # Sheds never become failure reports (no eviction pressure)...
+    assert all(not m.get("failed_server_url") for m in scheds)
+    # ...but the manager IS told, so it can spill affinity routing.
+    assert not scheds[0].get("shed_server_url")
+    for m in scheds[1:]:
+        assert m["shed_server_url"]
+        assert m["shed_retry_after"] == pytest.approx(0.02)
+    # Session key + priority class ride along: fresh submissions are
+    # class 1 (no accumulated prefix yet).
+    assert all(m.get("qid") == "sess/0" for m in scheds)
+    assert all(d.get("priority") == 1 for d in gens)
+
+
+@pytest.mark.timeout(60)
+def test_client_clears_shed_hint_after_success():
+    out, scheds, _ = asyncio.run(_scenario(n_sheds=1))
+    assert out.output_ids == [1, 2]
+    assert scheds[1]["shed_server_url"]
+    # A fresh sample afterwards starts with a clean hint (per-request
+    # state, not manager-global).
+    assert not scheds[0].get("shed_server_url")

@@ -90,7 +90,11 @@ def _metrics(url):
     for line in text.splitlines():
         parts = line.split()
         if len(parts) == 2:
-            out[parts[0]] = float(parts[1])
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                # Non-scalar surfaces (latency histogram encodings).
+                out[parts[0]] = parts[1]
     return out
 
 
