@@ -342,6 +342,56 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "overruns are surfaced in /status + logs, not fatal"
         },
     )
+    # Disaggregated prefill/decode serving (docs/serving.md).
+    gen_server_roles: str = dataclasses.field(
+        default="",
+        metadata={
+            "help": "comma-separated pool role per generation server "
+            "index (prefill|decode|unified); empty/short lists pad "
+            "with 'unified'. E.g. 'prefill,decode,unified' splits a "
+            "3-server fleet with one elastic spare"
+        },
+    )
+    gen_kv_handoff_compress: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "'int8' quantizes exported KV-handoff blobs "
+            "(halves the prefill->decode hop; importer dequantizes). "
+            "None ships the pool's own precision"
+        },
+    )
+    gen_elastic_pools: bool = dataclasses.field(
+        default=False,
+        metadata={
+            "help": "let the manager re-role 'unified'-configured "
+            "servers between the prefill and decode pools from "
+            "queue-depth/free-page watermarks (drain + flip, weights "
+            "stay resident)"
+        },
+    )
+    gen_prefill_queue_high_tokens: int = dataclasses.field(
+        default=4096,
+        metadata={
+            "help": "queued-prompt-token watermark over the prefill "
+            "pool at which an elastic decode-side server flips to "
+            "prefill"
+        },
+    )
+    gen_prefill_queue_low_tokens: int = dataclasses.field(
+        default=0,
+        metadata={
+            "help": "queued-prompt-token floor at or below which a "
+            "manager-flipped prefill server returns to its original "
+            "pool"
+        },
+    )
+    gen_decode_free_page_min_frac: float = dataclasses.field(
+        default=0.1,
+        metadata={
+            "help": "decode-pool free-KV-page floor (fraction): below "
+            "it an elastic prefill-side server flips to decode"
+        },
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

@@ -187,6 +187,20 @@ class GenerationServerConfig:
     # with jitter around it; the manager routes around the server for
     # this long).
     shed_retry_after_s: float = 1.0
+    # Disaggregated prefill/decode serving (docs/serving.md): the
+    # server's starting pool role. "prefill" servers take fresh prompts,
+    # run chunked prefill to the first token, and hand the KV off to a
+    # decode server; "decode" servers import handoff blobs and run the
+    # decode stream; "unified" serves both (legacy) and is the manager's
+    # elastic re-role pool — /set_role flips the live role at runtime
+    # (drain + flip; weights stay resident). Any role still serves plain
+    # /generate: the handoff path only engages when the manager pairs a
+    # decode server into the request.
+    role: str = "unified"
+    # int8-compress exported KV handoff blobs (halves the
+    # server-to-server hop; the importer dequantizes). None ships the
+    # pool's own precision.
+    kv_handoff_compress: Optional[str] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1
@@ -248,6 +262,28 @@ class GserverManagerConfig:
     # + device swap), measured separately from transfer. Overruns are
     # surfaced (within_budget=false + warning), not fatal.
     weight_cutover_budget_s: float = 3.0
+    # Elastic prefill/decode pool sizing (docs/serving.md): when True
+    # the manager re-roles servers whose CONFIGURED role is "unified"
+    # between the prefill and decode pools from queue-depth/free-page
+    # watermarks. Re-role is drain + flip — the manager stops routing
+    # new work of the old kind first, in-flight requests finish, weights
+    # stay resident.
+    elastic_pools: bool = False
+    # Minimum seconds between re-role decisions (flapping guard).
+    rerole_cooldown_s: float = 10.0
+    # Queued-prompt-token watermarks over the prefill-capable pool: at
+    # or above `high` an elastic decode-side server flips to prefill; at
+    # or below `low` a server this manager flipped to prefill flips
+    # back.
+    prefill_queue_high_tokens: int = 4096
+    prefill_queue_low_tokens: int = 0
+    # Decode-pool free-page floor: below this fraction an elastic
+    # prefill-side server flips to decode (and blocks further
+    # prefill-ward flips).
+    decode_free_page_min_frac: float = 0.1
+    # Each pool keeps at least this many servers through re-roles.
+    pool_min_prefill: int = 1
+    pool_min_decode: int = 1
 
     @property
     def worker_name(self) -> str:

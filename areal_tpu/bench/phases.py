@@ -174,15 +174,31 @@ register(PhaseSpec(
     name="serving_openloop",
     entrypoint="areal_tpu.bench.workloads:serving_openloop_phase",
     priority=4,
-    est_compile_s=60.0,
-    est_measure_s=120.0,
+    est_compile_s=90.0,
+    est_measure_s=180.0,
     min_window_s=0.0,
     proxy=True,
     default=False,
-    description="Open-loop (Poisson) fleet serving: arrival-rate sweep "
-                "-> p50/p99 TTFT + goodput, admission-control vs "
+    description="Open-loop (Poisson) fleet serving against REAL server "
+                "processes behind the manager: arrival-rate sweep -> "
+                "p50/p99 TTFT + goodput, server-side 429 admission vs "
                 "no-backpressure A/B at deliberate overload "
                 "(scheduling-policy evidence; CPU-proxy)",
+))
+
+register(PhaseSpec(
+    name="serving_disagg",
+    entrypoint="areal_tpu.bench.workloads:serving_disagg_phase",
+    priority=5,
+    est_compile_s=90.0,
+    est_measure_s=180.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Disaggregated prefill/decode A/B: unified vs 1P+1D "
+                "real-process fleets under a mixed long-prefill/"
+                "short-decode open-loop load -> decode ITL p99 + TTFT "
+                "p99 for both arms + KV-handoff counters (CPU-proxy)",
 ))
 
 register(PhaseSpec(

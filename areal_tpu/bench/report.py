@@ -124,6 +124,25 @@ def build_report(
         if rec is None or rec["status"] != "ok":
             report["partial"] = True
 
+    # p99-TTFT SLO gating (docs/serving.md): any serving record stamped
+    # ttft_slo_violated surfaces at the report top level — a violating
+    # record can flow through the pipe but never silently, and the
+    # one-line driver contract carries the flag too (result_line).
+    slo_violations = {}
+    for section in ("phases", "proxy"):
+        for name, rec in report[section].items():
+            val = (rec or {}).get("value") or {}
+            if val.get("ttft_slo_violated"):
+                slo_violations[name] = {
+                    "ttft_slo_ms": val.get("ttft_slo_ms"),
+                    "headline_ttft_p99_ms": val.get(
+                        "headline_ttft_p99_ms",
+                        val.get("disagg_ttft_p99_ms"),
+                    ),
+                }
+    if slo_violations:
+        report["slo_violations"] = slo_violations
+
     rl = collect_rl_trace()
     if rl is not None:
         report["rl_trace"] = rl
@@ -188,6 +207,11 @@ def result_line(report: Dict) -> Dict:
             out[f"rl_{k}"] = round(float(rl[k]), 4)
     if rl.get("staleness_hist"):
         out["rl_staleness_hist"] = rl["staleness_hist"]
+    if report.get("slo_violations"):
+        # SLO breaches ride the one-line contract: the driver (and any
+        # human skimming the round) sees the stamp without opening the
+        # full report.
+        out["slo_violations"] = sorted(report["slo_violations"])
     if report.get("partial"):
         out["partial"] = True
         # "error" on the one-line contract means the ROUND is impaired

@@ -1,0 +1,183 @@
+"""Versioned KV-handoff wire format for disaggregated prefill/decode.
+
+A prefill-role engine finishes a prompt's chunked prefill, then exports
+the request's filled KV pages plus the first sampled token as a
+*handoff blob*: a JSON meta dict describing typed array segments inside
+one contiguous payload, chunk-indexed with the same content hashing the
+weight-distribution plane uses (base/chunking.py) so the decode-side
+server can pull it over HTTP with per-chunk verification and mid-chunk
+Range resume. The hash, not the peer, is the authority — exactly the
+weight-plane rule.
+
+Wire layout is page-agnostic (token-major ``[L, Hkv, n_tokens, hd]``):
+the exporting and importing engines may run different page sizes or
+even different KV pool precisions. ``kv_wire`` is either a float dtype
+name (the exporter's pool precision) or ``"int8"`` (quantized
+``data + scales`` pairs via engine/paged.quantize_kv — the exporter
+either holds an int8 pool already or compressed at export); the
+importer always reconstructs float K/V and lets ``scatter_prefill``
+re-quantize if its own pool is int8.
+
+Kept jax-free (numpy + stdlib) so the server-side transfer code and
+tests can use it without touching a device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from areal_tpu.base.chunking import chunk_spans, hash_chunk
+
+HANDOFF_SCHEMA = "areal-kv-handoff/v1"
+
+# 256 KiB: handoff blobs are MB-scale (one request's KV), so chunks are
+# small enough that a torn transfer re-pays little and large enough
+# that per-chunk HTTP overhead stays noise.
+DEFAULT_CHUNK_BYTES = 256 << 10
+
+
+class KVHandoffError(RuntimeError):
+    """Malformed / incompatible handoff blob."""
+
+
+class KVHandoffVersionMismatch(KVHandoffError):
+    """The blob's weight version differs from the importing engine's —
+    importing would decode against KV from other weights."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes  # noqa: F401  registers bfloat16 by name
+    return np.dtype(name)
+
+
+def pack_arrays(
+    arrays: List[Tuple[str, np.ndarray]],
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+) -> Tuple[List[Dict], Dict, bytes]:
+    """Serialize named arrays into (segments, chunk_index, payload).
+
+    ``segments`` records name/dtype/shape/offset per array;
+    ``chunk_index`` is the base/chunking-style hash index over the
+    whole payload ({chunk_bytes, total_bytes, n_chunks, hashes})."""
+    segments: List[Dict] = []
+    parts: List[bytes] = []
+    off = 0
+    for name, arr in arrays:
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        segments.append({
+            "name": name,
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+            "offset": off,
+            "nbytes": len(raw),
+        })
+        parts.append(raw)
+        off += len(raw)
+    payload = b"".join(parts)
+    index = {
+        "chunk_bytes": int(chunk_bytes),
+        "total_bytes": len(payload),
+        "n_chunks": -(-len(payload) // chunk_bytes) if payload else 0,
+        "hashes": [
+            hash_chunk(payload[o: o + ln])
+            for o, ln in chunk_spans(len(payload), chunk_bytes)
+        ],
+    }
+    return segments, index, payload
+
+
+def unpack_arrays(meta: Dict, payload: bytes, verify: bool = True) -> Dict[str, np.ndarray]:
+    """Segments back to named arrays (zero-copy views over ``payload``).
+
+    With ``verify`` the payload is re-hashed against the chunk index —
+    cheap relative to the device write, and it makes the blob
+    self-authenticating even when the transport already verified."""
+    if meta.get("schema") != HANDOFF_SCHEMA:
+        raise KVHandoffError(
+            f"schema {meta.get('schema')!r} != {HANDOFF_SCHEMA!r}"
+        )
+    index = meta.get("chunks") or {}
+    if len(payload) != int(index.get("total_bytes", -1)):
+        raise KVHandoffError(
+            f"payload is {len(payload)} bytes, index says "
+            f"{index.get('total_bytes')}"
+        )
+    if verify:
+        cb = int(index["chunk_bytes"])
+        for i, (off, ln) in enumerate(chunk_spans(len(payload), cb)):
+            if hash_chunk(payload[off: off + ln]) != index["hashes"][i]:
+                raise KVHandoffError(f"chunk {i} hash mismatch")
+    out: Dict[str, np.ndarray] = {}
+    for seg in meta["segments"]:
+        dt = _np_dtype(seg["dtype"])
+        off, nb = int(seg["offset"]), int(seg["nbytes"])
+        out[seg["name"]] = np.frombuffer(
+            payload, dtype=dt, count=nb // dt.itemsize, offset=off
+        ).reshape(seg["shape"])
+    return out
+
+
+def build_meta(
+    qid: str,
+    version: int,
+    tokens: List[int],
+    kv_wire: str,
+    cfg,
+    segments: List[Dict],
+    chunks: Dict,
+) -> Dict:
+    return {
+        "schema": HANDOFF_SCHEMA,
+        "qid": str(qid),
+        "version": int(version),
+        "n_tokens": len(tokens),
+        "tokens": [int(t) for t in tokens],
+        "kv_wire": kv_wire,
+        "n_layers": int(cfg.n_layers),
+        "n_kv_heads": int(cfg.n_kv_heads),
+        "head_dim": int(cfg.head_dim),
+        "segments": segments,
+        "chunks": chunks,
+    }
+
+
+def check_geometry(meta: Dict, cfg) -> None:
+    """The importing engine must share the exporter's attention geometry
+    (page size may differ — the wire is token-major — but layer count,
+    KV heads, and head dim are baked into the gathered arrays)."""
+    for field, want in (
+        ("n_layers", cfg.n_layers),
+        ("n_kv_heads", cfg.n_kv_heads),
+        ("head_dim", cfg.head_dim),
+    ):
+        got = meta.get(field)
+        if int(got) != int(want):
+            raise KVHandoffError(
+                f"geometry mismatch: blob {field}={got}, engine has {want}"
+            )
+
+
+def unpack_kv_float(meta: Dict, payload: bytes, verify: bool = True):
+    """(k, v) as float32 numpy [L, Hkv, n_tokens, hd], dequantizing an
+    int8 wire via the paged-pool convention (KV_INT8_MAX)."""
+    arrs = unpack_arrays(meta, payload, verify=verify)
+    if meta["kv_wire"] == "int8":
+        from areal_tpu.engine.paged import KV_INT8_MAX
+
+        def deq(w, s):
+            return (
+                w.astype(np.float32) * (s[..., None] / KV_INT8_MAX)
+            ).astype(np.float32)
+
+        return (
+            deq(arrs["k_data"], arrs["k_scales"]),
+            deq(arrs["v_data"], arrs["v_scales"]),
+        )
+    return (
+        np.asarray(arrs["k"], dtype=np.float32),
+        np.asarray(arrs["v"], dtype=np.float32),
+    )

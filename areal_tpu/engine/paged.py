@@ -99,6 +99,29 @@ def dequantize_kv(w: jnp.ndarray, s: jnp.ndarray, dtype) -> jnp.ndarray:
     return (w.astype(jnp.float32) * (s / KV_INT8_MAX)).astype(dtype)
 
 
+def gather_kv_tokens(pool, page_ids, n_tokens: int):
+    """Gather one sequence's KV out of the pool in token-major order
+    (the disaggregated-serving handoff export, engine/kv_handoff.py).
+
+    ``page_ids`` are the sequence's pages in order; tokens beyond
+    ``n_tokens`` (final-page padding) are dropped. Plain pools return
+    ``[L, Hkv, n_tokens, hd]``; int8 pools return the
+    ``(data, scales [L, Hkv, n_tokens])`` pair. Dispatch-only — the
+    caller device_gets the (small) result off the serve loop."""
+    idx = jnp.asarray(page_ids, jnp.int32)
+
+    def g(arr, has_hd: bool):
+        x = arr[:, :, idx]  # [L, Hkv, P, pg, (hd)]
+        L, H = x.shape[0], x.shape[1]
+        if has_hd:
+            return x.reshape(L, H, -1, x.shape[-1])[:, :, :n_tokens]
+        return x.reshape(L, H, -1)[:, :, :n_tokens]
+
+    if isinstance(pool, tuple):
+        return g(pool[0], True), g(pool[1], False)
+    return g(pool, True)
+
+
 class PageAllocator:
     """Host-side free-list allocator over the pool's page indices.
 

@@ -60,6 +60,7 @@ from areal_tpu.engine.paged import (
     paged_chunk_prefill,
     paged_decode_block,
     pages_needed,
+    quantize_kv,
     scatter_prefill,
     warp_sample,
 )
@@ -382,6 +383,15 @@ class ServingEngine:
 
         self._queue: "queue.Queue[GenRequest]" = queue.Queue()
         self._backlog: List[GenRequest] = []  # engine-thread only
+        # qid -> pending (accepted, not yet admitted) request count:
+        # the eviction pin set (_pinned_qids). Updated under _fatal_lock
+        # at submit and at backlog pop.
+        self._queued_qids: Dict[str, int] = {}
+        # Loop-thread command queue (disaggregation handoff): closures
+        # that must run between laps because they touch engine-thread
+        # state (_prefix_cache, the page allocator, the donated KV pool
+        # arrays). Drained at the top of every serve-loop lap.
+        self._cmds: "queue.Queue" = queue.Queue()
         # Admit entries (slot, req, plen, pages, cached_use) currently
         # inside _admit_impl — reachable by _fail_all on mid-admit death.
         self._admit_inflight: List[Tuple[int, GenRequest, int, List[int], int]] = []
@@ -423,6 +433,18 @@ class ServingEngine:
         self.last_weight_swap_s = 0.0
         self.last_weight_stage_s = 0.0
         self.last_weight_cutover_s = 0.0
+        # Per-slot wall time of the last token delivery: ITL samples
+        # measure now - last_emit (NOT bare decode-block wall), so
+        # admission-prefill stalls between blocks — the interference
+        # disaggregation removes — show up in the histogram.
+        self._slot_emit_t = [0.0] * self.B
+        # Disaggregated-serving handoff telemetry.
+        self.kv_exports = 0
+        self.kv_export_bytes = 0
+        self.last_kv_export_ms = 0.0
+        self.kv_imports = 0
+        self.kv_import_bytes = 0
+        self.last_kv_import_ms = 0.0
 
     # ------------------------------------------------------------------
     # Public API
@@ -449,6 +471,9 @@ class ServingEngine:
             req.submit_time = time.monotonic()
             self.total_requests += 1
             self.queued_prompt_tokens += len(req.input_ids)
+            self._queued_qids[req.qid] = (
+                self._queued_qids.get(req.qid, 0) + 1
+            )
             self._queue.put(req)
 
     def warm(
@@ -502,6 +527,204 @@ class ServingEngine:
         dt = time.perf_counter() - t0
         logger.info(f"serving warm: {n} request(s), {dt:.1f}s")
         return dt
+
+    # ------------------------------------------------------------------
+    # Disaggregated prefill/decode: KV-handoff export/import
+    # ------------------------------------------------------------------
+
+    def _run_on_loop(self, fn, timeout_s: float = 60.0):
+        """Run ``fn()`` on the engine loop thread between laps and return
+        its result. Engine-thread state (_prefix_cache, the allocator,
+        the donated pool arrays) has no locks by design — the loop owns
+        it; this is the one cross-thread door."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        done = threading.Event()
+        cell: Dict[str, Any] = {}
+        self._cmds.put((fn, done, cell))
+        deadline = time.monotonic() + timeout_s
+        while not done.wait(0.05):
+            if self.fatal_error is not None:
+                raise RuntimeError(
+                    f"serving engine loop died: {self.fatal_error!r}"
+                ) from self.fatal_error
+            if (
+                self._thread is None
+                or not self._thread.is_alive()
+                or self._stop.is_set()
+            ):
+                raise RuntimeError("serving engine loop is not running")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"engine-loop command not served within {timeout_s}s"
+                )
+        if "exc" in cell:
+            raise cell["exc"]
+        return cell.get("ret")
+
+    def _drain_cmds(self):
+        while True:
+            try:
+                fn, done, cell = self._cmds.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                cell["ret"] = fn()
+            except BaseException as e:  # delivered to the waiting caller
+                cell["exc"] = e
+            finally:
+                done.set()
+
+    def export_kv_handoff(
+        self, qid: str, compress: Optional[str] = None
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """Export the parked KV prefix for ``qid`` as a versioned
+        handoff blob (meta, payload) — the prefill side of disaggregated
+        serving (engine/kv_handoff.py wire format).
+
+        The entry is consumed: its pages transfer to the blob and are
+        freed here (the decode pool owns the sequence now). Raises
+        KeyError when ``qid`` holds no parked prefix (the request never
+        finished, pool pressure evicted it, or the prompt was shorter
+        than one page — callers fall back to serving locally).
+        ``compress="int8"`` quantizes a float pool's KV on the wire
+        (quantize_kv); int8 pools always ship their (data, scales) form.
+        """
+        from areal_tpu.engine import kv_handoff as kvh
+        from areal_tpu.engine.paged import gather_kv_tokens
+
+        t0 = time.monotonic()
+
+        def _peek_and_gather():
+            # PEEK, don't pop: if the caller's loop-door wait times out,
+            # the entry (and its pages) stay owned by the cache — a
+            # popping closure executed after the caller abandoned it
+            # would leak the pages forever (nobody left to free them).
+            ent = self._prefix_cache.get(qid)
+            if ent is None:
+                raise KeyError(f"no parked KV prefix for qid {qid!r}")
+            toks, pages = ent
+            n = len(toks)
+            n_pg = pages_needed(n, self.page_size)
+            # Dispatch the gather HERE, on the loop thread: the decode
+            # block donates the pool arrays, so a stale off-thread
+            # reference could point at a freed buffer. The gathered
+            # slices are fresh arrays, safe to device_get off-loop.
+            k = gather_kv_tokens(self._k_pages, pages[:n_pg], n)
+            v = gather_kv_tokens(self._v_pages, pages[:n_pg], n)
+            return ent, toks, pages, self.version, k, v
+
+        def _consume(ent):
+            # Self-contained pop+free (identity-checked: an admission
+            # may have consumed the entry meanwhile — ownership moved,
+            # nothing to free here). Safe to run arbitrarily late.
+            cur = self._prefix_cache.get(qid)
+            if cur is ent:
+                self._prefix_cache.pop(qid, None)
+                self._cached_tokens -= len(ent[0])
+                self._allocator.free(ent[1])
+
+        ent, toks, pages, version, k, v = self._run_on_loop(_peek_and_gather)
+        try:
+            if isinstance(k, tuple):  # int8 pool: (data, scales)
+                arrays = [
+                    ("k_data", np.asarray(k[0])),
+                    ("k_scales", np.asarray(k[1], np.float32)),
+                    ("v_data", np.asarray(v[0])),
+                    ("v_scales", np.asarray(v[1], np.float32)),
+                ]
+                wire = "int8"
+            elif compress == "int8":
+                kw, ks = quantize_kv(k)
+                vw, vs = quantize_kv(v)
+                arrays = [
+                    ("k_data", np.asarray(kw)),
+                    ("k_scales", np.asarray(ks[..., 0], np.float32)),
+                    ("v_data", np.asarray(vw)),
+                    ("v_scales", np.asarray(vs[..., 0], np.float32)),
+                ]
+                wire = "int8"
+            else:
+                kh, vh = np.asarray(k), np.asarray(v)
+                arrays = [("k", kh), ("v", vh)]
+                wire = kh.dtype.name
+            segments, chunks, payload = kvh.pack_arrays(arrays)
+            meta = kvh.build_meta(
+                qid, version, toks, wire, self.cfg, segments, chunks
+            )
+        finally:
+            self._run_on_loop(lambda: _consume(ent))
+        self.kv_exports += 1
+        self.kv_export_bytes += len(payload)
+        self.last_kv_export_ms = (time.monotonic() - t0) * 1000.0
+        return meta, payload
+
+    def import_kv_handoff(self, meta: Dict[str, Any], payload: bytes):
+        """Import a handoff blob: allocate pages, scatter the KV into the
+        pool, park it as ``qid``'s prefix — the decode side. The caller
+        then submits the continuation request (prompt + first token,
+        priority 0); admission finds the parked prefix and prefills only
+        the one-token delta.
+
+        Raises KVHandoffVersionMismatch when the blob's weight version
+        differs from the live engine's (checked ON the loop thread,
+        atomically with the park, so a concurrent weight swap can never
+        leave stale KV parked), and KVHandoffError on geometry/hash
+        problems or pool exhaustion."""
+        from areal_tpu.engine import kv_handoff as kvh
+
+        t0 = time.monotonic()
+        kvh.check_geometry(meta, self.cfg)
+        kf, vf = kvh.unpack_kv_float(meta, payload)  # [L, Hkv, n, hd]
+        qid = str(meta["qid"])
+        toks = [int(t) for t in meta["tokens"]]
+        n = len(toks)
+        if n != int(meta["n_tokens"]) or kf.shape[2] != n:
+            raise kvh.KVHandoffError(
+                f"token/KV length mismatch: {n} tokens, KV {kf.shape}"
+            )
+        n_pg = pages_needed(n, self.page_size)
+        pad = n_pg * self.page_size
+
+        def to_pref(x):
+            # [L, Hkv, n, hd] -> scatter_prefill's [L, 1, pad, Hkv, hd]
+            L, H, _, hd = x.shape
+            out = np.zeros((L, 1, pad, H, hd), np.float32)
+            out[:, 0, :n] = x.transpose(0, 2, 1, 3)
+            return out
+
+        # Stage the (small) host->device transfers off the loop thread;
+        # only the scatter dispatch runs on it.
+        k_dev = jnp.asarray(to_pref(kf))
+        v_dev = jnp.asarray(to_pref(vf))
+
+        def _write():
+            if int(meta["version"]) != self.version:
+                raise kvh.KVHandoffVersionMismatch(
+                    f"blob v{meta['version']} vs engine v{self.version}"
+                )
+            self._ensure_pool()
+            pages = self._alloc_pages(n_pg)
+            if pages is None:
+                raise kvh.KVHandoffError(
+                    f"pool exhausted: need {n_pg} pages, "
+                    f"{self._allocator.n_free} free"
+                )
+            self._k_pages, self._v_pages = scatter_prefill(
+                self._k_pages, self._v_pages, k_dev, v_dev,
+                jnp.asarray(pages, jnp.int32),
+            )
+            old = self._prefix_cache.pop(qid, None)
+            if old is not None:
+                self._allocator.free(old[1])
+                self._cached_tokens -= len(old[0])
+            self._prefix_cache[qid] = (toks, pages)
+            self._cached_tokens += n
+
+        self._run_on_loop(_write)
+        self.kv_imports += 1
+        self.kv_import_bytes += len(payload)
+        self.last_kv_import_ms = (time.monotonic() - t0) * 1000.0
 
     def is_stale_update(self, version: Optional[int]) -> bool:
         """True iff update_params(version=version) would drop the update
@@ -682,6 +905,14 @@ class ServingEngine:
             "prefix_tokens_reused": float(self.prefix_tokens_reused),
             "prefix_cached_tokens": float(self._cached_tokens),
             "total_requests": float(self.total_requests),
+            # Disaggregated-serving KV handoff (export on prefill-role
+            # engines, import on decode-role ones).
+            "kv_export_total": float(self.kv_exports),
+            "kv_export_bytes": float(self.kv_export_bytes),
+            "last_kv_export_ms": float(self.last_kv_export_ms),
+            "kv_import_total": float(self.kv_imports),
+            "kv_import_bytes": float(self.kv_import_bytes),
+            "last_kv_import_ms": float(self.last_kv_import_ms),
             # Speculative decoding yield: emitted tokens per decode STEP
             # across slots that were active (1.0 = no speculation value;
             # the ceiling is 1 + draft_len). The number that decides
@@ -774,6 +1005,11 @@ class ServingEngine:
             self.queued_prompt_tokens = max(
                 0, self.queued_prompt_tokens - len(req.input_ids)
             )
+            n = self._queued_qids.get(req.qid, 0)
+            if n > 1:
+                self._queued_qids[req.qid] = n - 1
+            else:
+                self._queued_qids.pop(req.qid, None)
         return req
 
     # Admission rounds a class-1 request may be passed over before it
@@ -1065,8 +1301,10 @@ class ServingEngine:
         # prefill + first sample, the SLO number the openloop bench
         # sweeps).
         t_first = time.monotonic()
-        for _, req_i, *_ in batch:
+        for slot_i, req_i, *_ in batch:
             self.ttft_hist.add((t_first - req_i.submit_time) * 1000.0)
+            # ITL for this slot measures from its first token's arrival.
+            self._slot_emit_t[slot_i] = t_first
 
         # Host bookkeeping + one fused device admit.
         adm_slots, adm_valid = [], []
@@ -1144,9 +1382,22 @@ class ServingEngine:
                 jnp.asarray(rows),
             )
 
-    def _evict_one_prefix(self) -> bool:
-        """Free the least-recently-used cached prefix's pages."""
+    def _evict_one_prefix(self, pinned: Optional[set] = None) -> bool:
+        """Free the least-recently-used cached prefix's pages. Entries
+        whose qid is in `pinned` (a request for them is already queued —
+        a KV-handoff import or a continuation about to admit) are
+        skipped: evicting them turns a one-token delta prefill into a
+        full re-prefill ON the serve loop, stalling every running decode
+        stream. Returns False when nothing (unpinned) is evictable."""
         if not self._prefix_cache:
+            return False
+        if pinned:
+            for qid in self._prefix_cache:  # oldest-first iteration
+                if qid not in pinned:
+                    toks, pages = self._prefix_cache.pop(qid)
+                    self._allocator.free(pages)
+                    self._cached_tokens -= len(toks)
+                    return True
             return False
         qid, (toks, pages) = self._prefix_cache.popitem(last=False)
         self._allocator.free(pages)
@@ -1157,11 +1408,25 @@ class ServingEngine:
         while self._evict_one_prefix():
             pass
 
+    def _pinned_qids(self) -> set:
+        """Qids with a pending (accepted, not yet admitted) request —
+        submit queue AND backlog: their parked KV is about to be
+        consumed."""
+        with self._fatal_lock:
+            return set(self._queued_qids)
+
     def _alloc_pages(self, n: int) -> Optional[List[int]]:
         """Allocate, evicting cached prefixes under pressure: speculative
         cache pages must never cost an active request its admission or
-        its next decode block."""
+        its next decode block. Prefixes with a queued consumer go last —
+        a hard pool need may still take them, but only after every
+        speculative park is gone."""
         got = self._allocator.alloc(n)
+        if got is not None:
+            return got
+        pinned = self._pinned_qids()
+        while got is None and self._evict_one_prefix(pinned):
+            got = self._allocator.alloc(n)
         while got is None and self._evict_one_prefix():
             got = self._allocator.alloc(n)
         return got
@@ -1270,9 +1535,14 @@ class ServingEngine:
                     self._cached_tokens -= len(old[0])
                 self._prefix_cache[req.qid] = (covered, pages)
                 self._cached_tokens += len(covered)
+                # Budget trim is SOFT: entries with a queued consumer
+                # are never trimmed for budget (only for hard pool
+                # pressure, _alloc_pages) — under a handoff-import burst
+                # the oldest parks are exactly the queued continuations.
+                trim_pinned = self._pinned_qids()
                 while (
                     self._cached_tokens > self.prefix_cache_tokens
-                    and self._evict_one_prefix()
+                    and self._evict_one_prefix(trim_pinned)
                 ):
                     pass
             else:
@@ -1384,6 +1654,7 @@ class ServingEngine:
                 except queue.Empty:
                     break
             self.queued_prompt_tokens = 0
+            self._queued_qids.clear()
         for req in reqs:
             if req.done_cb:
                 try:
@@ -1404,6 +1675,8 @@ class ServingEngine:
         # up to (1 + draft_len) tokens per step.
         n = self.block_steps * (1 + self.spec_draft_len)
         while not self._stop.is_set():
+            # Handoff export/import closures (engine-thread state only).
+            self._drain_cmds()
             if self._interrupt.is_set():
                 self._interrupt_all()
                 self._apply_pending_params()
@@ -1471,7 +1744,7 @@ class ServingEngine:
                             min_remaining, temps, top_ps, top_ks, greedy)
             p = np.asarray(packed)  # the block's single device fetch
             self._blocks_since_admit += 1
-            blk_ms = (time.monotonic() - t_blk0) * 1000.0
+            t_blk1 = time.monotonic()
             if tracing.enabled():
                 tracing.record_span(
                     "server.decode_block", decode_t0,
@@ -1480,13 +1753,22 @@ class ServingEngine:
             toks_h = p[:, :n]
             lps_h = p[:, n:2 * n]
             n_emitted = p[:, 2 * n].astype(np.int64)
-            # Inter-token latency: block wall time amortized over each
-            # slot's emitted tokens (uniform within the block — the
-            # device doesn't timestamp individual steps).
+            # Inter-token latency: wall time since the slot's PREVIOUS
+            # token delivery, amortized over the tokens this block
+            # emitted (uniform within the block — the device doesn't
+            # timestamp individual steps). Measuring from the last
+            # delivery rather than the block start charges the
+            # admission-prefill stalls between blocks to the running
+            # slots that actually waited through them — the decode-
+            # latency interference the disaggregated fleet removes.
             for slot in range(self.B):
                 k = int(n_emitted[slot])
                 if k > 0 and self._slot_req[slot] is not None:
-                    self.itl_hist.add(blk_ms / k, count=k)
+                    t_prev = self._slot_emit_t[slot] or t_blk0
+                    self.itl_hist.add(
+                        (t_blk1 - t_prev) * 1000.0 / k, count=k
+                    )
+                    self._slot_emit_t[slot] = t_blk1
             if self.spec_draft_len > 0:
                 # Spec block appends a per-slot active-steps column: the
                 # exact yield denominator (early-finishing slots charge

@@ -138,6 +138,13 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
     # total_train_epochs without benchmark_steps).
     master.dataset_size = C.dataset_line_count(cfg.dataset)
 
+    # Disaggregated prefill/decode: per-index roles from the
+    # comma-separated knob, padded with "unified" (the elastic pool).
+    roles = [
+        r.strip() or "unified"
+        for r in (cfg.gen_server_roles or "").split(",")
+    ]
+    roles += ["unified"] * (cfg.n_generation_servers - len(roles))
     gen_servers = [
         GenerationServerConfig(
             experiment_name=cfg.experiment_name,
@@ -161,6 +168,8 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             speculative_window=cfg.gen_speculative_window,
             decode_weight_dtype=cfg.gen_decode_weight_dtype,
             tensor_parallel=cfg.gen_tensor_parallel,
+            role=roles[i],
+            kv_handoff_compress=cfg.gen_kv_handoff_compress,
             seed=cfg.seed,
         )
         for i in range(cfg.n_generation_servers)
@@ -178,6 +187,10 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         weight_chunk_bytes=cfg.gen_weight_chunk_mb << 20,
         weight_fanout_degree=cfg.gen_weight_fanout,
         weight_cutover_budget_s=cfg.gen_weight_cutover_budget_s,
+        elastic_pools=cfg.gen_elastic_pools,
+        prefill_queue_high_tokens=cfg.gen_prefill_queue_high_tokens,
+        prefill_queue_low_tokens=cfg.gen_prefill_queue_low_tokens,
+        decode_free_page_min_frac=cfg.gen_decode_free_page_min_frac,
     )
     rollouts = [
         RolloutWorkerConfig(

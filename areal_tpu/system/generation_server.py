@@ -12,6 +12,19 @@ stack expects (SURVEY §8 "SGLang server contract"):
 - GET  /metrics  (areal:num_used_tokens / areal:num_running_reqs)
 - GET  /health
 
+Disaggregated prefill/decode serving (docs/serving.md): the server has
+a live ``role`` (prefill / decode / unified, starting from the config,
+flipped at runtime by the manager's elastic sizer via POST /set_role).
+When the manager pairs a decode server into a request (``decode_url``
+in the /generate body), this server runs the prompt to its FIRST
+sampled token only, exports the filled KV pages as a hash-indexed blob
+(engine/kv_handoff.py), and POSTs /kv_handoff to the decode server —
+which pulls the payload back over chunked HTTP (per-chunk sha256 +
+Range resume, the weight-plane transfer discipline), imports it, and
+runs the decode stream as a priority-0 continuation. Any handoff
+failure falls back to serving the remainder locally, so disaggregation
+can only add throughput, never lose a rollout.
+
 Plus the streaming weight-distribution plane (system/weight_plane.py):
 
 - POST /distribute_weights  prefetch version-N chunks into host memory
@@ -26,6 +39,7 @@ Plus the streaming weight-distribution plane (system/weight_plane.py):
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import threading
 import time
@@ -58,7 +72,13 @@ class GenerationServer(Worker):
         import areal_tpu.engine.factories  # noqa: F401  (registry)
         from areal_tpu.api.model_api import make_model
 
-        kwargs: Dict[str, Any] = {"name": f"gen{config.server_index}"}
+        # One shared model name across the fleet: the init rng folds the
+        # name in, so a per-index name would give every random-init
+        # server DIFFERENT weights — fatal for disaggregation, where KV
+        # prefilled on one server is decoded on another (checkpoint
+        # loads were never affected; random init is the test/bench
+        # path).
+        kwargs: Dict[str, Any] = {"name": "gserver"}
         if config.model_path is not None:
             kwargs["model_path"] = config.model_path
         if config.tokenizer_path is not None:
@@ -108,6 +128,25 @@ class GenerationServer(Worker):
         self._n_shed = 0
         self._last_load_info = None
 
+        # Disaggregated serving: live pool role (the manager's elastic
+        # sizer re-roles "unified"-configured servers at runtime) + the
+        # export stash the decode side pulls handoff payloads from.
+        if config.role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified/prefill/decode, got {config.role!r}"
+            )
+        self.role = config.role
+        self._role_lock = threading.Lock()
+        self._handoff_store: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        self._handoff_ok = 0
+        self._handoff_failed = 0
+        self._handoff_fallback = 0
+        self._last_handoff_ms = 0.0
+        self._last_kv_transfer_ms = 0.0
+        self._handoff_session = None  # lazy aiohttp session (HTTP loop)
+
         # Weight-plane prefetch state machine: idle -> fetching -> ready
         # (-> failed). The store outlives its own cutover so this server
         # keeps serving chunks to later-wave siblings and to chaos
@@ -153,6 +192,7 @@ class GenerationServer(Worker):
         payload = super()._heartbeat_payload()
         payload["url"] = self.address
         payload["server_index"] = self.cfg.server_index
+        payload["role"] = self.role
         return payload
 
     # ------------------------------------------------------------------
@@ -163,6 +203,10 @@ class GenerationServer(Worker):
         asyncio.set_event_loop(self._http_loop)
         app = web.Application()
         app.router.add_post("/generate", self._h_generate)
+        app.router.add_post("/kv_handoff", self._h_kv_handoff)
+        app.router.add_get("/kv_handoff/blob", self._h_kv_blob)
+        app.router.add_post("/set_role", self._h_set_role)
+        app.router.add_post("/configure", self._h_configure)
         app.router.add_post("/update_weights_from_disk", self._h_update_weights)
         app.router.add_post("/distribute_weights", self._h_distribute_weights)
         app.router.add_post("/cutover_weights", self._h_cutover_weights)
@@ -234,29 +278,20 @@ class GenerationServer(Worker):
             prompt_len=len(d.get("input_ids") or []),
         )
         g = d.get("gconfig", {})
-        loop = asyncio.get_running_loop()
-        fut: asyncio.Future = loop.create_future()
-
-        def done_cb(res):
-            loop.call_soon_threadsafe(
-                lambda: fut.set_result(res) if not fut.done() else None
-            )
-
-        req = GenRequest(
-            qid=str(d["qid"]),
-            input_ids=[int(t) for t in d["input_ids"]],
-            max_new_tokens=int(g.get("max_new_tokens", 256)),
-            min_new_tokens=int(g.get("min_new_tokens", 0)),
-            greedy=bool(g.get("greedy", False)),
-            temperature=float(g.get("temperature", 1.0)),
-            top_p=float(g.get("top_p", 1.0)),
-            top_k=int(g.get("top_k", -1)),
-            stop_token_ids=tuple(g.get("stop_token_ids", [])),
-            priority=int(d.get("priority", 1)),
-            done_cb=done_cb,
-        )
+        # Disaggregated path: the manager paired a decode server into
+        # this request — prefill to the first token here, hand the KV
+        # off, let the decode server run the stream. Single-token
+        # budgets and self-pairings serve locally.
+        decode_url = d.get("decode_url") or None
+        if (
+            decode_url
+            and decode_url != self.address
+            and int(g.get("max_new_tokens", 256)) > 1
+        ):
+            return await self._h_generate_disagg(d, g, decode_url, gen_span)
+        req = self._gen_request_from(d, g)
         try:
-            self.engine.submit(req)
+            res = await self._submit_and_wait(req)
         except RuntimeError as e:
             # Fail-fast path: the serve loop already died; keep the same
             # JSON error contract as the in-flight res.error branch below.
@@ -265,7 +300,6 @@ class GenerationServer(Worker):
             return web.json_response(
                 {"qid": req.qid, "error": str(e)}, status=500
             )
-        res = await fut
         if gen_span is not None:
             gen_span.end(
                 n_tokens=len(res.output_ids),
@@ -282,18 +316,460 @@ class GenerationServer(Worker):
             )
         if res.interrupted:
             self._n_interrupted += 1
-        return web.json_response(
-            {
-                "qid": res.qid,
-                "output_ids": res.output_ids,
-                "output_logprobs": res.output_logprobs,
-                "no_eos": res.no_eos,
-                "interrupted": res.interrupted,
-                "version_start": res.version_start,
-                "version_end": res.version_end,
-                "latency": res.latency,
-            }
+        return web.json_response(self._gen_response(res))
+
+    def _gen_request_from(self, d: Dict, g: Dict) -> GenRequest:
+        return GenRequest(
+            qid=str(d["qid"]),
+            input_ids=[int(t) for t in d["input_ids"]],
+            max_new_tokens=int(g.get("max_new_tokens", 256)),
+            min_new_tokens=int(g.get("min_new_tokens", 0)),
+            greedy=bool(g.get("greedy", False)),
+            temperature=float(g.get("temperature", 1.0)),
+            top_p=float(g.get("top_p", 1.0)),
+            top_k=int(g.get("top_k", -1)),
+            stop_token_ids=tuple(g.get("stop_token_ids", [])),
+            priority=int(d.get("priority", 1)),
         )
+
+    async def _submit_and_wait(self, req: GenRequest):
+        """Submit to the engine, await the result on this event loop.
+        Raises RuntimeError when the serve loop is already dead."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def done_cb(res):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(res) if not fut.done() else None
+            )
+
+        req.done_cb = done_cb
+        self.engine.submit(req)
+        return await fut
+
+    @staticmethod
+    def _gen_response(res, **extra) -> Dict:
+        out = {
+            "qid": res.qid,
+            "output_ids": res.output_ids,
+            "output_logprobs": res.output_logprobs,
+            "no_eos": res.no_eos,
+            "interrupted": res.interrupted,
+            "version_start": res.version_start,
+            "version_end": res.version_end,
+            "latency": res.latency,
+        }
+        out.update(extra)
+        return out
+
+    # ------------------------------------------------------------------
+    # Disaggregated prefill/decode (docs/serving.md)
+    # ------------------------------------------------------------------
+
+    async def _handoff_sess(self) -> "aiohttp.ClientSession":
+        import aiohttp
+
+        if self._handoff_session is None or self._handoff_session.closed:
+            self._handoff_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600)
+            )
+        return self._handoff_session
+
+    def _stash_handoff(self, qid: str, meta: Dict, payload: bytes):
+        """Park an exported blob for the decode server's chunked pull.
+        An entry lives until its /kv_handoff POST returns — which spans
+        the decode server's WHOLE decode stream, not just the pull — so
+        the cap must cover the server's full admission concurrency or
+        normal load evicts in-flight blobs (404 on the pull -> handoff
+        counted failed -> local fallback, silently un-disaggregating
+        the fleet). TTL pruning handles decode servers that died
+        mid-pull."""
+        now = time.monotonic()
+        self._handoff_store[qid] = (meta, payload, now)
+        for k in [
+            k for k, (_, _, t) in self._handoff_store.items()
+            if now - t > 600.0
+        ]:
+            self._handoff_store.pop(k, None)
+        cap = max(32, 4 * self.cfg.max_concurrent_requests)
+        while len(self._handoff_store) > cap:
+            self._handoff_store.popitem(last=False)
+
+    async def _h_generate_disagg(self, d, g, decode_url, gen_span):
+        from areal_tpu.engine.kv_handoff import KVHandoffError
+
+        qid = str(d["qid"])
+        budget = int(g.get("max_new_tokens", 256))
+        min_new = int(g.get("min_new_tokens", 0))
+        # Prefill leg: run to the first sampled token only. The finish
+        # parks the prompt's KV pages under this qid (prefix cache).
+        first_req = self._gen_request_from(d, g)
+        first_req.max_new_tokens = 1
+        first_req.min_new_tokens = min(1, min_new)
+        try:
+            res = await self._submit_and_wait(first_req)
+        except RuntimeError as e:
+            if gen_span is not None:
+                gen_span.end(error=str(e))
+            return web.json_response({"qid": qid, "error": str(e)}, status=500)
+        if res.error is not None:
+            if gen_span is not None:
+                gen_span.end(error=res.error)
+            return web.json_response(
+                {"qid": qid, "error": res.error}, status=500
+            )
+        if res.interrupted or not res.output_ids or not res.no_eos:
+            # Interrupted (client resubmits), zero-budget degenerate, or
+            # the first token already hit EOS: nothing to hand off.
+            if res.interrupted:
+                self._n_interrupted += 1
+            if gen_span is not None:
+                gen_span.end(
+                    n_tokens=len(res.output_ids),
+                    interrupted=res.interrupted, disagg="short-circuit",
+                )
+            return web.json_response(self._gen_response(res))
+        first = int(res.output_ids[0])
+        t_handoff0 = time.monotonic()
+
+        # Export the KV blob (engine-thread gather via the loop door).
+        exp_span = tracing.start_span(
+            "server.kv_export", ctx=tracing.extract_from(d),
+            qid=qid, decode_url=decode_url,
+        )
+        meta = payload = None
+        try:
+            meta, payload = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.engine.export_kv_handoff(
+                    qid, compress=self.cfg.kv_handoff_compress
+                ),
+            )
+        except (KeyError, KVHandoffError, RuntimeError, TimeoutError) as e:
+            # Short prompt (< one page), pool pressure evicted the park,
+            # or the loop door timed out: serve the remainder locally.
+            logger.warning(f"{qid}: kv export unavailable ({e!r}); "
+                           f"serving remainder locally")
+            if exp_span is not None:
+                exp_span.end(error=repr(e))
+            return await self._disagg_local_remainder(
+                d, g, res, first, gen_span, reason=f"export: {e!r}"
+            )
+        if exp_span is not None:
+            exp_span.end(
+                n_tokens=meta["n_tokens"], bytes=len(payload),
+                export_ms=self.engine.last_kv_export_ms,
+            )
+        # Mid-handoff chaos point: a prefill server dying HERE leaves
+        # the client's /generate hanging on a dead socket — the failover
+        # path (failed_server_url -> eviction -> reroute) must absorb it.
+        await faults.maybe_fail_async("gserver.kv_export")
+        self._stash_handoff(qid, meta, payload)
+        try:
+            sess = await self._handoff_sess()
+            async with sess.post(
+                f"{decode_url}/kv_handoff",
+                json=tracing.inject_ctx_into(
+                    {
+                        "qid": qid,
+                        "meta": meta,
+                        "source": self.address,
+                        "first_token": first,
+                        "gconfig": {
+                            "max_new_tokens": budget - 1,
+                            "min_new_tokens": max(0, min_new - 1),
+                            "greedy": bool(g.get("greedy", False)),
+                            "temperature": float(g.get("temperature", 1.0)),
+                            "top_p": float(g.get("top_p", 1.0)),
+                            "top_k": int(g.get("top_k", -1)),
+                            "stop_token_ids": list(g.get("stop_token_ids", [])),
+                        },
+                    },
+                    gen_span.ctx if gen_span is not None else None,
+                ),
+            ) as r:
+                body = await r.json()
+                ok = r.status == 200 and "output_ids" in body
+        except Exception as e:
+            ok, body = False, {"error": repr(e)}
+        finally:
+            self._handoff_store.pop(qid, None)
+        if not ok:
+            self._handoff_failed += 1
+            logger.warning(
+                f"{qid}: kv handoff to {decode_url} failed "
+                f"({str(body.get('error'))[:200]}); serving remainder locally"
+            )
+            return await self._disagg_local_remainder(
+                d, g, res, first, gen_span,
+                reason=f"decode: {str(body.get('error'))[:120]}",
+            )
+        self._handoff_ok += 1
+        self._last_handoff_ms = (time.monotonic() - t_handoff0) * 1000.0
+        if gen_span is not None:
+            gen_span.end(
+                n_tokens=1 + len(body["output_ids"]),
+                disagg="handoff", decode_url=decode_url,
+                handoff_ms=self._last_handoff_ms,
+            )
+        return web.json_response({
+            "qid": qid,
+            "output_ids": [first] + [int(t) for t in body["output_ids"]],
+            "output_logprobs": (
+                res.output_logprobs
+                + [float(x) for x in body["output_logprobs"]]
+            ),
+            "no_eos": bool(body["no_eos"]),
+            "interrupted": bool(body["interrupted"]),
+            "version_start": res.version_start,
+            "version_end": int(body["version_end"]),
+            "latency": time.monotonic() - (t_handoff0 - res.latency),
+            "disagg": {
+                "decode_url": decode_url,
+                "handoff_bytes": len(payload),
+                "handoff_ms": self._last_handoff_ms,
+            },
+        })
+
+    async def _disagg_local_remainder(self, d, g, first_res, first,
+                                      gen_span, reason: str):
+        """Handoff fallback: finish the request on THIS engine (it holds
+        or recomputes the prefix) so disaggregation failures degrade to
+        unified serving instead of losing the rollout."""
+        self._handoff_fallback += 1
+        cont = self._gen_request_from(d, g)
+        cont.input_ids = [int(t) for t in d["input_ids"]] + [first]
+        cont.max_new_tokens = int(g.get("max_new_tokens", 256)) - 1
+        cont.min_new_tokens = max(0, int(g.get("min_new_tokens", 0)) - 1)
+        cont.priority = 0
+        try:
+            res2 = await self._submit_and_wait(cont)
+        except RuntimeError as e:
+            if gen_span is not None:
+                gen_span.end(error=str(e))
+            return web.json_response(
+                {"qid": cont.qid, "error": str(e)}, status=500
+            )
+        if res2.error is not None:
+            if gen_span is not None:
+                gen_span.end(error=res2.error)
+            return web.json_response(
+                {"qid": res2.qid, "error": res2.error}, status=500
+            )
+        if res2.interrupted:
+            self._n_interrupted += 1
+        if gen_span is not None:
+            gen_span.end(
+                n_tokens=1 + len(res2.output_ids),
+                disagg="local-fallback", fallback_reason=reason,
+            )
+        merged = self._gen_response(
+            res2, disagg={"fallback": reason},
+        )
+        merged["output_ids"] = [first] + list(res2.output_ids)
+        merged["output_logprobs"] = (
+            list(first_res.output_logprobs) + list(res2.output_logprobs)
+        )
+        merged["version_start"] = first_res.version_start
+        merged["latency"] = first_res.latency + res2.latency
+        return web.json_response(merged)
+
+    async def _h_kv_handoff(self, request: web.Request) -> web.Response:
+        """Decode side: pull the blob from the prefill server (chunked,
+        hash-verified, Range-resumable), import it into the engine, and
+        run the decode stream as a priority-0 continuation."""
+        await faults.maybe_fail_async("gserver.kv_import")
+        d = await request.json()
+        from areal_tpu.engine.kv_handoff import (
+            KVHandoffError, KVHandoffVersionMismatch,
+        )
+
+        qid = str(d["qid"])
+        meta = d["meta"]
+        source = d["source"]
+        imp_span = tracing.start_span(
+            "server.kv_import", ctx=tracing.extract_from(d),
+            qid=qid, source=source,
+            n_tokens=int(meta.get("n_tokens", 0)),
+        )
+        t0 = time.monotonic()
+        try:
+            payload = await self._fetch_handoff_payload(source, qid, meta)
+        except Exception as e:
+            if imp_span is not None:
+                imp_span.end(error=repr(e))
+            return web.json_response(
+                {"qid": qid, "error": f"transfer failed: {e!r}"}, status=502
+            )
+        self._last_kv_transfer_ms = (time.monotonic() - t0) * 1000.0
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.import_kv_handoff, meta, payload
+            )
+        except KVHandoffVersionMismatch as e:
+            if imp_span is not None:
+                imp_span.end(error=repr(e))
+            return web.json_response(
+                {"qid": qid, "error": str(e),
+                 "version": self.engine.version},
+                status=409,
+            )
+        except (KVHandoffError, RuntimeError, TimeoutError) as e:
+            if imp_span is not None:
+                imp_span.end(error=repr(e))
+            return web.json_response(
+                {"qid": qid, "error": str(e)}, status=503
+            )
+        g = d.get("gconfig", {})
+        cont = self._gen_request_from(
+            {"qid": qid,
+             "input_ids": list(meta["tokens"]) + [int(d["first_token"])],
+             "priority": 0},
+            g,
+        )
+        try:
+            res = await self._submit_and_wait(cont)
+        except RuntimeError as e:
+            if imp_span is not None:
+                imp_span.end(error=str(e))
+            return web.json_response({"qid": qid, "error": str(e)}, status=500)
+        if res.error is not None:
+            if imp_span is not None:
+                imp_span.end(error=res.error)
+            return web.json_response(
+                {"qid": qid, "error": res.error}, status=500
+            )
+        if res.interrupted:
+            self._n_interrupted += 1
+        if imp_span is not None:
+            imp_span.end(
+                bytes=len(payload),
+                transfer_ms=self._last_kv_transfer_ms,
+                import_ms=self.engine.last_kv_import_ms,
+                n_tokens_out=len(res.output_ids),
+            )
+        return web.json_response(self._gen_response(
+            res,
+            transfer_ms=self._last_kv_transfer_ms,
+            import_ms=self.engine.last_kv_import_ms,
+        ))
+
+    async def _fetch_handoff_payload(
+        self, source: str, qid: str, meta: Dict
+    ) -> bytes:
+        """Chunked pull of the export stash: per-chunk sha256 verify,
+        mid-chunk Range resume on torn reads — the weight-plane transfer
+        discipline applied to the KV hop."""
+        from areal_tpu.base.chunking import chunk_spans, verify_chunk
+
+        index = meta["chunks"]
+        total = int(index["total_bytes"])
+        buf = bytearray(total)
+        sess = await self._handoff_sess()
+        for i, (off, length) in enumerate(
+            chunk_spans(total, int(index["chunk_bytes"]))
+        ):
+            got = 0
+            for attempt in range(4):
+                start = off + got
+                try:
+                    async with sess.get(
+                        f"{source}/kv_handoff/blob",
+                        params={"qid": qid},
+                        headers={"Range":
+                                 f"bytes={start}-{off + length - 1}"},
+                    ) as r:
+                        if r.status not in (200, 206):
+                            raise RuntimeError(
+                                f"blob fetch {r.status}: "
+                                f"{(await r.text())[:200]}"
+                            )
+                        data = await r.read()
+                        if r.status == 200:
+                            # Range-less server: slice the full payload.
+                            data = data[start: off + length]
+                except Exception:
+                    if attempt == 3:
+                        raise
+                    await asyncio.sleep(0.05)
+                    continue
+                take = min(len(data), length - got)
+                buf[start: start + take] = data[:take]
+                got += take
+                if got >= length:
+                    if verify_chunk(bytes(buf[off: off + length]),
+                                    index["hashes"][i]):
+                        break
+                    got = 0  # corrupt chunk: refetch whole
+            else:
+                raise RuntimeError(f"chunk {i} unrecoverable after retries")
+        return bytes(buf)
+
+    async def _h_kv_blob(self, request: web.Request) -> web.Response:
+        qid = request.query.get("qid", "")
+        ent = self._handoff_store.get(qid)
+        if ent is None:
+            return web.json_response(
+                {"error": f"no handoff blob for {qid!r}"}, status=404
+            )
+        payload = ent[1]
+        rng = request.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            try:
+                a, _, b = rng[len("bytes="):].partition("-")
+                start = int(a)
+                end = int(b) if b else len(payload) - 1
+            except ValueError:
+                return web.Response(status=416)
+            if start >= len(payload):
+                return web.Response(status=416)
+            end = min(end, len(payload) - 1)
+            return web.Response(
+                body=payload[start: end + 1], status=206,
+                headers={"Content-Range":
+                         f"bytes {start}-{end}/{len(payload)}"},
+            )
+        return web.Response(body=payload)
+
+    async def _h_set_role(self, request: web.Request) -> web.Response:
+        """Elastic re-role (manager sizer): flip the live pool role.
+        Drain + flip — in-flight requests finish under the old behavior
+        (the engine is identical either way); the manager already
+        stopped routing the old kind of work here. Weights stay
+        resident."""
+        d = await request.json()
+        role = str(d.get("role", ""))
+        if role not in ("unified", "prefill", "decode"):
+            return web.json_response(
+                {"success": False, "error": f"bad role {role!r}"}, status=400
+            )
+        with self._role_lock:
+            prev, self.role = self.role, role
+        tracing.event(
+            "server.set_role", ctx=tracing.extract_from(d),
+            role=role, previous=prev, n_running=self.engine.n_running,
+        )
+        logger.info(f"re-roled {prev} -> {role} "
+                    f"({self.engine.n_running} in flight)")
+        return web.json_response({
+            "success": True, "role": role, "previous": prev,
+            "n_running": self.engine.n_running,
+            "queue_depth": self.engine.queue_depth,
+        })
+
+    async def _h_configure(self, request: web.Request) -> web.Response:
+        """Runtime admission-watermark overrides (bench A/B arms flip
+        backpressure off and back without restarting the fleet)."""
+        d = await request.json()
+        changed = {}
+        for key, cast in (("max_queue_depth", int),
+                          ("max_queued_tokens", int),
+                          ("shed_retry_after_s", float)):
+            if key in d:
+                val = d[key]
+                setattr(self.cfg, key, None if val is None else cast(val))
+                changed[key] = val
+        return web.json_response({"success": True, "changed": changed})
 
     async def _h_update_weights(self, request: web.Request) -> web.Response:
         await faults.maybe_fail_async("gserver.update_weights")
@@ -700,6 +1176,21 @@ class GenerationServer(Worker):
             f"areal:weight_version {float(self.engine.version)}",
             f"areal:kv_pages_free {m['kv_pages_free']}",
             f"areal:kv_pages_total {m['kv_pages_total']}",
+            # Disaggregated serving: live pool role (string surface, like
+            # the histogram lines), elastic eligibility (configured role
+            # is the re-role pool), and the KV-handoff counters.
+            f"areal:role {self.role}",
+            f"areal:elastic {1.0 if self.cfg.role == 'unified' else 0.0}",
+            f"areal:kv_export_total {m['kv_export_total']}",
+            f"areal:kv_export_bytes {m['kv_export_bytes']}",
+            f"areal:last_kv_export_ms {m['last_kv_export_ms']}",
+            f"areal:kv_import_total {m['kv_import_total']}",
+            f"areal:kv_import_bytes {m['kv_import_bytes']}",
+            f"areal:last_kv_import_ms {m['last_kv_import_ms']}",
+            f"areal:last_kv_transfer_ms {self._last_kv_transfer_ms}",
+            f"areal:kv_handoff_ok {float(self._handoff_ok)}",
+            f"areal:kv_handoff_failed {float(self._handoff_failed)}",
+            f"areal:kv_handoff_fallback {float(self._handoff_fallback)}",
             f"areal:num_preempted_reqs {m['num_preempted_reqs']}",
             f"areal:prefix_cache_hits {m['prefix_cache_hits']}",
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",
@@ -734,7 +1225,10 @@ class GenerationServer(Worker):
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _h_health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok", "version": self.engine.version})
+        return web.json_response(
+            {"status": "ok", "version": self.engine.version,
+             "role": self.role}
+        )
 
     # ------------------------------------------------------------------
 
@@ -757,6 +1251,10 @@ class GenerationServer(Worker):
     def _exit_hook(self):
         try:
             self.engine.stop()
+            if self._handoff_session is not None:
+                asyncio.run_coroutine_threadsafe(
+                    self._handoff_session.close(), self._http_loop
+                ).result(timeout=5)
             self._http_loop.call_soon_threadsafe(self._http_loop.stop)
             self._http_thread.join(timeout=5)
         except Exception:

@@ -111,6 +111,25 @@ class GserverManager(Worker):
         self._affinity: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
+        # Disaggregated prefill/decode pools: live role per server
+        # (reported via heartbeat payload + /metrics, updated directly
+        # when the elastic sizer re-roles), elastic eligibility
+        # (configured role "unified"), and the poll-fed load signals the
+        # pool routing keys on — queued prompt tokens for the prefill
+        # pool, free KV pages for the decode pool.
+        self._server_roles: Dict[str, str] = {
+            u: "unified" for u in self.server_urls
+        }
+        self._server_elastic: Dict[str, bool] = {}
+        self._server_queued_toks = {u: 0.0 for u in self.server_urls}
+        self._server_free_pages: Dict[str, float] = {}
+        self._server_total_pages: Dict[str, float] = {}
+        self._server_kv: Dict[str, Dict[str, float]] = {}
+        # Elastic sizer bookkeeping: what we flipped (url -> the role it
+        # held before OUR flip, for the flip-back path) + an audit log.
+        self._rerole_orig: Dict[str, str] = {}
+        self._rerole_log: List[Dict] = []
+        self._last_rerole = 0.0
         self._server_shed_until = {u: 0.0 for u in self.server_urls}
         self._server_tokens_pending = {u: 0.0 for u in self.server_urls}
         self._server_shed_total = {u: 0.0 for u in self.server_urls}
@@ -193,16 +212,29 @@ class GserverManager(Worker):
             + self._server_tokens_pending.get(u, 0.0),
         )
 
-    def _choose_server(self, meta: Dict) -> Tuple[Optional[str], str]:
-        """Pick a healthy server; returns (url, policy) where policy
-        names the routing decision (recorded in the request trace):
-        'affinity' (session's prefix-holding server), 'spill' (affinity
-        target saturated/shedding -> least-loaded), 'sticky' (legacy
-        previous-server hint), or the configured base policy. (None,
-        'none') when the whole fleet is unhealthy."""
+    def _role(self, u: str) -> str:
+        return self._server_roles.get(u, "unified")
+
+    def _disagg_split(self, candidates: List[str]) -> bool:
+        """True when the healthy fleet holds at least one dedicated
+        prefill or decode server — pool routing engages only then; an
+        all-unified fleet keeps the PR 6 single-pool behavior."""
+        return any(self._role(u) != "unified" for u in candidates)
+
+    def _choose_server(
+        self, meta: Dict
+    ) -> Tuple[Optional[str], str, Optional[str]]:
+        """Pick a healthy server; returns (url, policy, decode_url)
+        where policy names the routing decision (recorded in the request
+        trace): 'affinity' (session's prefix-holding server), 'spill'
+        (affinity target saturated/shedding -> least-loaded), 'sticky'
+        (legacy previous-server hint), 'disagg' (prefill/decode pair —
+        decode_url is set and the client forwards it into /generate), or
+        the configured base policy. (None, 'none', None) when the whole
+        fleet is unhealthy."""
         candidates = self._healthy_urls()
         if not candidates:
-            return None, "none"
+            return None, "none", None
         now = time.monotonic()
         open_ = [
             u for u in candidates
@@ -212,6 +244,8 @@ class GserverManager(Worker):
         # backs off on the 429 itself); a shed hint is advisory.
         pool = open_ or candidates
         qid = str(meta.get("qid") or "")
+        if self._disagg_split(candidates):
+            return self._choose_disagg(meta, candidates, pool, qid, now)
         if self.cfg.session_affinity and qid:
             aff = self._affinity.get(qid)
             if aff is not None and aff in candidates:
@@ -224,9 +258,9 @@ class GserverManager(Worker):
                     # KV-prefix reuse survives weight-version bumps: the
                     # engine flushes stale KV on swap, so the worst case
                     # is the same re-prefill any server would pay.
-                    return aff, "affinity"
+                    return aff, "affinity", None
                 spill_pool = [u for u in pool if u != aff] or pool
-                return min(spill_pool, key=self._load_key), "spill"
+                return min(spill_pool, key=self._load_key), "spill", None
         prev = meta.get("previous_server_url") or ""
         prev_version = int(meta.get("previous_version", -1))
         # Legacy sticky hint (clients predating the affinity map, or a
@@ -235,38 +269,114 @@ class GserverManager(Worker):
         # sticky only while the weight version is unchanged — version
         # bumps are the periodic rebalancing trigger.
         if prev in pool and prev_version == self.weight_version:
-            return prev, "sticky"
+            return prev, "sticky", None
         policy = self.cfg.schedule_policy
         if policy == "least_requests":
-            return min(pool, key=lambda u: self._server_reqs[u]), policy
+            return min(pool, key=lambda u: self._server_reqs[u]), policy, None
         if policy == "least_token_usage":
             return min(
                 pool,
                 key=lambda u: self._server_tokens[u]
                 + self._server_tokens_pending.get(u, 0.0),
-            ), policy
+            ), policy, None
         url = pool[self._rr % len(pool)]
         self._rr += 1
-        return url, "round_robin"
+        return url, "round_robin", None
 
-    def _route(self, meta: Dict) -> Tuple[Optional[str], str]:
+    def _choose_disagg(self, meta, candidates, pool, qid, now):
+        """Pool routing for a split fleet: continuations follow their
+        decode-side KV (session affinity), fresh work pairs the least
+        prompt-loaded prefill server with the most page-free decode
+        server — each pool batches and scales on its own signal."""
+        prefill_pool = [u for u in pool if self._role(u) != "decode"]
+        decode_pool = [u for u in pool if self._role(u) != "prefill"]
+        # A failure retry re-pairs through the pools instead of riding
+        # affinity: the affinity entry was recorded at PAIRING time, so
+        # after a prefill server died mid-handoff it may point at a
+        # decode server that never received the session's KV — the
+        # retry must land on a surviving prefill server, not turn the
+        # decode server into an accidental unified one.
+        retry = bool(meta.get("failed_server_url"))
+        if self.cfg.session_affinity and qid and not retry:
+            aff = self._affinity.get(qid)
+            if aff is not None and aff in candidates:
+                # The session's KV parked on its decode server; a direct
+                # /generate there prefills only the delta. Honored even
+                # if the sizer has since re-roled that server prefill-
+                # ward — any role serves plain /generate, and the
+                # parked delta is far cheaper than the full re-prefill
+                # a KV-less decode server would pay. Spill like the
+                # unified path when it sheds/saturates.
+                sat = self.cfg.affinity_saturation_requests
+                shedding = self._server_shed_until.get(aff, 0.0) > now
+                saturated = (
+                    sat is not None and self._server_reqs.get(aff, 0) >= sat
+                )
+                if not shedding and not saturated:
+                    return aff, "affinity", None
+                if decode_pool:
+                    spill = [u for u in decode_pool if u != aff] or decode_pool
+                    return (
+                        min(spill, key=self._load_key), "spill", None
+                    )
+        if not prefill_pool or not decode_pool:
+            # Degenerate split (one pool empty): serve unified on
+            # whatever remains rather than stalling.
+            rest = prefill_pool or decode_pool or pool
+            return min(rest, key=self._load_key), "disagg-degenerate", None
+        # Prefill by queued-prompt-token load (the signal that actually
+        # queues there), decode by free-page/slot headroom.
+        purl = min(
+            prefill_pool,
+            key=lambda u: (
+                self._server_queued_toks.get(u, 0.0)
+                + self._server_tokens_pending.get(u, 0.0),
+                self._server_reqs.get(u, 0),
+            ),
+        )
+        durl = min(
+            decode_pool,
+            key=lambda u: (
+                self._server_reqs.get(u, 0),
+                -self._server_free_pages.get(u, 0.0),
+            ),
+        )
+        if purl == durl:
+            # Same (unified) server won both pools: plain local serve.
+            return purl, "disagg-local", None
+        return purl, "disagg", durl
+
+    def _route(self, meta: Dict) -> Tuple[Optional[str], str, Optional[str]]:
         """Choose a server AND do the routing-side bookkeeping: bump the
         in-flight request estimate, fold the scheduled tokens into the
         load estimate until the next /metrics poll refreshes the
         snapshot (a burst between polls must not pile onto one server),
-        and record the session's affinity."""
+        and record the session's affinity. For a disaggregated pair the
+        prompt tokens land on the prefill server's estimate, the decode
+        budget on the decode server's — and the session's affinity
+        points at the DECODE server, where its KV will live."""
         qid = str(meta.get("qid") or "")
         with self._lock:
-            url, policy = self._choose_server(meta)
+            url, policy, decode_url = self._choose_server(meta)
             if url is not None:
                 self._server_reqs[url] += 1
                 self._server_tokens_pending[url] = (
                     self._server_tokens_pending.get(url, 0.0)
                     + float(meta.get("prompt_len") or 0)
-                    + float(meta.get("new_token_budget") or 0)
+                    + (0.0 if decode_url
+                       else float(meta.get("new_token_budget") or 0))
                 )
-                self._record_affinity(qid, url)
-        return url, policy
+                if decode_url is not None:
+                    self._server_reqs[decode_url] = (
+                        self._server_reqs.get(decode_url, 0) + 1
+                    )
+                    self._server_tokens_pending[decode_url] = (
+                        self._server_tokens_pending.get(decode_url, 0.0)
+                        + float(meta.get("prompt_len") or 0)
+                        + float(meta.get("new_token_budget") or 0)
+                    )
+                self._record_affinity(qid, decode_url or url)
+        return url, policy, decode_url
 
     def _record_affinity(self, qid: str, url: str):
         """LRU-bounded qid -> url map (call under self._lock)."""
@@ -379,10 +489,22 @@ class GserverManager(Worker):
                 self._server_gen_reqs,
                 self._server_spec_emitted, self._server_spec_steps,
                 self._server_tokens_pending, self._server_shed_until,
-                self._server_shed_total,
+                self._server_shed_total, self._server_queued_toks,
             ):
                 d.pop(old, None)
                 d[new] = 0.0
+            for d in (
+                self._server_free_pages, self._server_total_pages,
+                self._server_kv, self._server_elastic,
+            ):
+                d.pop(old, None)
+            # Role unknown until the new incarnation's first heartbeat
+            # (same _poll_health pass that readmits it — the entry here
+            # is a placeholder the eviction gate keeps out of routing);
+            # our sizer's flip died with the old incarnation.
+            self._server_roles.pop(old, None)
+            self._server_roles[new] = "unified"
+            self._rerole_orig.pop(old, None)
             self._server_reqs.pop(old, None)
             self._server_reqs[new] = 0
             self._server_ttft_hist.pop(old, None)
@@ -420,6 +542,12 @@ class GserverManager(Worker):
                 continue
             self._member_urls[member] = url
             alive_urls.add(url)
+            # Pool role from the heartbeat payload (fresher than the
+            # metrics poll) — but never clobber a role OUR sizer set:
+            # the server's heartbeat may predate the /set_role landing.
+            role = record.get("role")
+            if role and url not in self._rerole_orig:
+                self._server_roles[url] = str(role)
         # Adoption: a member we have NEVER seen, beating at an address
         # outside the table — its previous incarnation died before we
         # observed it. It must be the restarted owner of some evicted
@@ -578,7 +706,7 @@ class GserverManager(Worker):
                     self._server_shed_total.get(shed, 0.0) + 1.0
                 )
         qid = str(meta.get("qid") or "")
-        url, policy = self._route(meta)
+        url, policy, decode_url = self._route(meta)
         tracing.event(
             "manager.schedule", ctx=trace_ctx,
             server=url or "", routed=url is not None, policy=policy,
@@ -589,9 +717,19 @@ class GserverManager(Worker):
                 {"error": "no healthy generation servers", "retry_after": 0.5},
                 status=503,
             )
-        return web.json_response(
-            {"url": url, "version": self.weight_version, "policy": policy}
-        )
+        resp = {"url": url, "version": self.weight_version, "policy": policy}
+        if decode_url is not None:
+            # The prefill->decode pairing decision, recorded for the
+            # merged timeline (who prefilled, who decoded, why).
+            tracing.event(
+                "manager.pair", ctx=trace_ctx, qid=qid,
+                prefill=url, decode=decode_url,
+                prefill_queued_tokens=self._server_queued_toks.get(url, 0.0),
+                decode_free_pages=self._server_free_pages.get(
+                    decode_url, 0.0),
+            )
+            resp["decode_url"] = decode_url
+        return web.json_response(resp)
 
     async def _h_allocate(self, request: web.Request) -> web.Response:
         d = await request.json()
@@ -651,8 +789,53 @@ class GserverManager(Worker):
             evicted = dict(self._evicted)
             versions = dict(self._server_versions)
             wp_last = dict(self._wp_last)
+            roles = {
+                u: self._server_roles.get(u, "unified")
+                for u in self.server_urls
+            }
+            pools = {
+                "roles": roles,
+                "prefill": sorted(
+                    u for u in healthy if roles[u] != "decode"
+                ),
+                "decode": sorted(
+                    u for u in healthy if roles[u] != "prefill"
+                ),
+                "elastic": sorted(
+                    u for u in healthy
+                    if self._server_elastic.get(u, False)
+                ),
+                # Per-pool load signals the routing keys on.
+                "queued_prompt_tokens": {
+                    u: self._server_queued_toks.get(u, 0.0) for u in healthy
+                },
+                "kv_pages_free": {
+                    u: self._server_free_pages.get(u, 0.0) for u in healthy
+                },
+                # Fleet KV-handoff totals (ratio-of-sums rule: raw sums).
+                "kv_handoff": {
+                    "exports": sum(
+                        s.get("exports", 0.0)
+                        for s in self._server_kv.values()
+                    ),
+                    "imports": sum(
+                        s.get("imports", 0.0)
+                        for s in self._server_kv.values()
+                    ),
+                    "export_bytes": sum(
+                        s.get("export_bytes", 0.0)
+                        for s in self._server_kv.values()
+                    ),
+                    "import_bytes": sum(
+                        s.get("import_bytes", 0.0)
+                        for s in self._server_kv.values()
+                    ),
+                },
+                "reroles": list(self._rerole_log),
+            }
         return web.json_response(
             {
+                "pools": pools,
                 "weight_version": self.weight_version,
                 "rollout_stat": self.rollout_stat.as_dict(),
                 "servers": self.server_urls,
@@ -674,6 +857,142 @@ class GserverManager(Worker):
                 "weight_plane": wp_last,
             }
         )
+
+    # ------------------------------------------------------------------
+    # Elastic pool sizing (disaggregated serving, docs/serving.md)
+    # ------------------------------------------------------------------
+
+    def _post_set_role(self, url: str, role: str) -> bool:
+        async def _push():
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=15)
+            ) as sess:
+                async with sess.post(
+                    f"{url}/set_role", json={"role": role}
+                ) as r:
+                    body = await r.json()
+                    return bool(body.get("success"))
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_push(), self._http_loop)
+            return fut.result(timeout=20)
+        except Exception:
+            logger.warning(f"set_role({role}) failed for {url}",
+                           exc_info=True)
+            return False
+
+    def _rerole(self, url: str, to_role: str, reason: str) -> bool:
+        """Flip one elastic server's pool. Routing flips FIRST (under
+        the lock) so no new work of the old kind lands during the drain;
+        in-flight requests finish under the old behavior — the flip
+        itself is just a label, weights stay resident."""
+        with self._lock:
+            from_role = self._server_roles.get(url, "unified")
+            if from_role == to_role:
+                return False
+            self._rerole_orig.setdefault(url, from_role)
+            self._server_roles[url] = to_role
+        if not self._post_set_role(url, to_role):
+            with self._lock:  # server unreachable: roll the map back
+                self._server_roles[url] = from_role
+                if self._rerole_orig.get(url) == from_role:
+                    self._rerole_orig.pop(url, None)
+            return False
+        if to_role == self._rerole_orig.get(url):
+            # Back to its pre-flip pool: the flip-back completed.
+            self._rerole_orig.pop(url, None)
+        entry = {
+            "t": time.time(), "url": url,
+            "from": from_role, "to": to_role, "reason": reason,
+        }
+        with self._lock:
+            self._rerole_log.append(entry)
+            del self._rerole_log[:-32]
+        self._last_rerole = time.monotonic()
+        tracing.event("manager.rerole", server=url,
+                      from_role=from_role, to_role=to_role, reason=reason)
+        logger.info(f"re-roled {url}: {from_role} -> {to_role} ({reason})")
+        return True
+
+    def _maybe_rerole(self):
+        """Watermark-driven pool sizing over the elastic (configured-
+        unified) servers: prefill queue pressure pulls a server out of
+        the decode pool; a drained prefill queue (or a decode free-page
+        floor breach) sends it back."""
+        cfg = self.cfg
+        if not cfg.elastic_pools:
+            return
+        if time.monotonic() - self._last_rerole < cfg.rerole_cooldown_s:
+            return
+        with self._lock:
+            healthy = self._healthy_urls()
+            roles = {u: self._server_roles.get(u, "unified") for u in healthy}
+            elastic = {
+                u for u in healthy if self._server_elastic.get(u, False)
+            }
+            queued = dict(self._server_queued_toks)
+            free = dict(self._server_free_pages)
+            total = dict(self._server_total_pages)
+            flipped = {
+                u: orig for u, orig in self._rerole_orig.items()
+                if u in healthy
+            }
+        if not healthy:
+            return
+        prefill_pool = [u for u in healthy if roles[u] != "decode"]
+        decode_pool = [u for u in healthy if roles[u] != "prefill"]
+        prefill_queue = sum(queued.get(u, 0.0) for u in prefill_pool)
+        dec_free = sum(free.get(u, 0.0) for u in decode_pool)
+        dec_total = sum(total.get(u, 0.0) for u in decode_pool)
+        dec_free_frac = dec_free / dec_total if dec_total > 0 else 1.0
+
+        if (
+            prefill_queue >= cfg.prefill_queue_high_tokens
+            and dec_free_frac >= cfg.decode_free_page_min_frac
+        ):
+            # Prompts are queueing: grow the prefill pool from elastic
+            # decode-side servers (most free pages = cheapest to take),
+            # keeping the decode pool at its floor.
+            cands = [
+                u for u in decode_pool
+                if u in elastic and roles[u] != "prefill"
+                and len(decode_pool) - 1 >= cfg.pool_min_decode
+            ]
+            if cands:
+                u = max(cands, key=lambda c: free.get(c, 0.0))
+                self._rerole(
+                    u, "prefill",
+                    f"prefill queue {prefill_queue:.0f} tokens >= "
+                    f"{cfg.prefill_queue_high_tokens}",
+                )
+            return
+        if dec_free_frac < cfg.decode_free_page_min_frac:
+            # Decode pool starving for pages: pull an elastic prefill
+            # server back in.
+            cands = [
+                u for u in prefill_pool
+                if u in elastic and roles[u] != "decode"
+                and len(prefill_pool) - 1 >= cfg.pool_min_prefill
+            ]
+            if cands:
+                u = min(cands, key=lambda c: queued.get(c, 0.0))
+                self._rerole(
+                    u, "decode",
+                    f"decode free pages {dec_free_frac:.2f} < "
+                    f"{cfg.decode_free_page_min_frac}",
+                )
+            return
+        if prefill_queue <= cfg.prefill_queue_low_tokens and flipped:
+            # Pressure gone: return the server we flipped prefill-ward
+            # to its original pool (and vice versa).
+            for u, orig in sorted(flipped.items()):
+                if roles.get(u) != orig:
+                    if self._rerole(
+                        u, orig,
+                        f"prefill queue {prefill_queue:.0f} tokens <= "
+                        f"{cfg.prefill_queue_low_tokens}",
+                    ):
+                        return
 
     # ------------------------------------------------------------------
     # Weight-update fanout (runs on the worker poll loop)
@@ -1116,6 +1435,48 @@ class GserverManager(Worker):
                             self._server_spec_steps[u] = float(
                                 line.split()[-1]
                             )
+                        elif line.startswith("areal:queued_prompt_tokens"):
+                            self._server_queued_toks[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:kv_pages_free"):
+                            self._server_free_pages[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:kv_pages_total"):
+                            self._server_total_pages[u] = float(
+                                line.split()[-1]
+                            )
+                        elif line.startswith("areal:role "):
+                            role = line.split()[-1]
+                            # The sizer's view wins for servers it
+                            # re-roled until the server's own surface
+                            # catches up (it does, on the next beat).
+                            if u not in self._rerole_orig or (
+                                role == self._server_roles.get(u)
+                            ):
+                                self._server_roles[u] = role
+                        elif line.startswith("areal:elastic"):
+                            self._server_elastic[u] = (
+                                float(line.split()[-1]) > 0.5
+                            )
+                        elif line.startswith("areal:kv_export_total"):
+                            self._server_kv.setdefault(u, {})["exports"] = (
+                                float(line.split()[-1])
+                            )
+                        elif line.startswith("areal:kv_export_bytes"):
+                            self._server_kv.setdefault(u, {})[
+                                "export_bytes"] = float(line.split()[-1])
+                        elif line.startswith("areal:kv_import_total"):
+                            self._server_kv.setdefault(u, {})["imports"] = (
+                                float(line.split()[-1])
+                            )
+                        elif line.startswith("areal:kv_import_bytes"):
+                            self._server_kv.setdefault(u, {})[
+                                "import_bytes"] = float(line.split()[-1])
+                        elif line.startswith("areal:last_kv_transfer_ms"):
+                            self._server_kv.setdefault(u, {})[
+                                "last_transfer_ms"] = float(line.split()[-1])
                 except Exception:
                     logger.warning(f"metrics poll failed for {u}")
 
@@ -1159,6 +1520,11 @@ class GserverManager(Worker):
             except Exception:
                 pass
             self._last_metrics_poll = time.monotonic()
+            # Elastic pool sizing rides the fresh load snapshot.
+            try:
+                self._maybe_rerole()
+            except Exception:
+                logger.warning("elastic rerole pass failed", exc_info=True)
         # Periodic generation-throughput log (reference
         # gserver_manager.py:279-285): interval tokens/s over all servers
         # plus the rollout counters.

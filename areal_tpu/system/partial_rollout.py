@@ -196,6 +196,10 @@ class PartialRolloutManager:
                 await asyncio.sleep(self._backoff(retries, sched))
                 continue
             url, server_version = sched["url"], int(sched.get("version", -1))
+            # Disaggregated pairing: the manager chose a decode server
+            # for this chunk; the prefill server hands the KV off to it
+            # and proxies the combined result back (docs/serving.md).
+            decode_url = sched.get("decode_url")
             chunk = min(budget, self.new_tokens_per_chunk)
             # A resubmission carries the accumulated prefix: every token
             # of prompt+prefix is prefill work the server repeats.
@@ -212,6 +216,7 @@ class PartialRolloutManager:
             payload = tracing.inject_ctx_into(
                 dict(
                     qid=qid,
+                    decode_url=decode_url,
                     input_ids=list(prompt_ids) + acc_out,
                     # Continuations/re-prefills admit ahead of fresh
                     # requests (engine priority class 0): their prefix

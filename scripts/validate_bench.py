@@ -47,7 +47,57 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "overload_baseline_p99_ttft_ms",
         "overload_baseline_goodput_rps",
     ),
+    # The disaggregation A/B is only evidence as a PAIR: a record
+    # carrying one arm's tail latency without the other cannot show the
+    # interference delta the phase exists to measure.
+    "serving_disagg": (
+        "offered_rate_rps",
+        "unified_itl_p99_ms",
+        "unified_ttft_p99_ms",
+        "disagg_itl_p99_ms",
+        "disagg_ttft_p99_ms",
+        "kv_handoffs",
+        "kv_handoff_bytes",
+    ),
 }
+
+# Phases whose records may carry a p99-TTFT SLO stamp; key = the value
+# field holding the headline p99 the stamp judges.
+SLO_HEADLINE_KEYS = {
+    "serving_openloop": "headline_ttft_p99_ms",
+    "serving_disagg": "disagg_ttft_p99_ms",
+}
+
+
+def _validate_ttft_slo(name: str, val: Dict) -> List[str]:
+    """A record carrying an SLO limit must stamp itself honestly: p99
+    over the limit without ttft_slo_violated=true is exactly the silent
+    headline-eligibility the satellite forbids."""
+    slo = val.get("ttft_slo_ms")
+    if not isinstance(slo, (int, float)) or isinstance(slo, bool):
+        return []
+    headline_key = SLO_HEADLINE_KEYS.get(name)
+    p99 = val.get(headline_key) if headline_key else None
+    problems: List[str] = []
+    if not isinstance(p99, (int, float)) or isinstance(p99, bool):
+        problems.append(
+            f"{name}: carries ttft_slo_ms but no numeric "
+            f"{headline_key!r} to judge it against"
+        )
+        return problems
+    violated = bool(val.get("ttft_slo_violated"))
+    if p99 > float(slo) and not violated:
+        problems.append(
+            f"{name}: p99 TTFT {p99:.0f}ms exceeds the {slo:.0f}ms SLO "
+            f"but the record is not stamped ttft_slo_violated — "
+            f"refusing silent headline eligibility"
+        )
+    if p99 <= float(slo) and violated:
+        problems.append(
+            f"{name}: stamped ttft_slo_violated but p99 {p99:.0f}ms is "
+            f"within the {slo:.0f}ms SLO"
+        )
+    return problems
 
 # Numeric keys every serving_openloop arrival-rate sweep point must
 # carry: a record without the sweep (or with points missing p99 TTFT)
@@ -112,6 +162,14 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         )
     if name == "serving_openloop":
         problems.extend(_validate_openloop_sweep(val))
+    if name == "serving_disagg":
+        failed = val.get("disagg_failed")
+        if isinstance(failed, (int, float)) and failed > 0:
+            problems.append(
+                f"{name}: {failed:.0f} failed request(s) in the "
+                f"disaggregated arm — handoff evidence must be loss-free"
+            )
+    problems.extend(_validate_ttft_slo(name, rec.get("value") or {}))
     return problems
 
 
@@ -150,6 +208,21 @@ def validate_report(rep: Dict, require_driver: bool = False) -> List[str]:
                 problems.append(
                     f"proxy/{name}: proxy evidence cannot be driver_verified"
                 )
+
+    # Report-level SLO gating consistency: a record stamped
+    # ttft_slo_violated must surface in the report's slo_violations —
+    # the stamp exists so a breach is never silently headline-eligible.
+    stamped = set()
+    for section in ("phases", "proxy"):
+        for name, rec in (rep.get(section) or {}).items():
+            if ((rec or {}).get("value") or {}).get("ttft_slo_violated"):
+                stamped.add(name)
+    surfaced = set(rep.get("slo_violations") or {})
+    for name in sorted(stamped - surfaced):
+        problems.append(
+            f"{name}: record is stamped ttft_slo_violated but the "
+            f"report's slo_violations does not surface it"
+        )
 
     headline = rep.get("headline") or {}
     any_unverified_headline = False

@@ -83,6 +83,7 @@ def test_merge_worker_stats_semantics():
     assert m["loss"] == 4.0
 
 
+@pytest.mark.slow  # ~24s: profiler capture round-trip; noop path is cheap
 def test_maybe_profile_noop_and_capture(tmp_path, monkeypatch):
     # disabled: no-op
     monkeypatch.delenv("AREAL_DUMP_TRACE", raising=False)

@@ -146,6 +146,7 @@ def code_data(tmp_path_factory):
 # ----------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~28s: full eval pipeline; preset/unit tests stay tier-1
 def test_evaluator_math_end_to_end(tiny_ckpt, math_data, tmp_path):
     save_root, _ = tiny_ckpt
     ev = AutomaticEvaluator(
@@ -166,6 +167,7 @@ def test_evaluator_math_end_to_end(tiny_ckpt, math_data, tmp_path):
     assert out["n_prompts"] == 2 and len(out["details"]) == 2
 
 
+@pytest.mark.slow  # ~27s: full eval pipeline; preset/unit tests stay tier-1
 def test_evaluator_code_end_to_end(tiny_ckpt, code_data, tmp_path):
     """A code checkpoint eval produces a score JSON (VERDICT r2 item 10)."""
     save_root, _ = tiny_ckpt

@@ -34,6 +34,7 @@ TINY_CFG = dict(
 )
 
 
+@pytest.mark.slow  # ~44s: full mock-SFT through the cluster controller
 def test_cluster_controller_sft_mock(tmp_path):
     exp, trial = f"cc-sft-{uuid.uuid4().hex[:6]}", "t0"
     rows = fixtures.make_sft_rows(32, seed=3)

@@ -1,0 +1,297 @@
+"""ISSUE 7 acceptance: disaggregated prefill/decode serving across real
+process boundaries — 2 prefill-role + 1 decode-role GenerationServer
+processes (real ServingEngines on CPU jax) behind a real GserverManager,
+driven by the real PartialRolloutManager client.
+
+Asserted end to end:
+- mixed-length rollouts complete with the KV handed off over HTTP
+  (hash-verified chunk pull: decode-side kv_import counters match the
+  prefill-side exports, bytes > 0);
+- the manager's pairing routes prefill by queued-prompt-token load and
+  decode by free pages, with the pairing visible in /status pools;
+- chaos (AREAL_FAULTS): a prefill server killed MID-HANDOFF (after the
+  KV export, before the decode POST completes) -> the client's failover
+  resubmits through the manager, which evicts the dead server and
+  re-routes to the surviving prefill server; every rollout completes —
+  zero failed rollouts.
+
+Time budget: ~50 s (3 CPU-jax child processes + warm XLA cache; the
+chaos phase reuses the same fleet).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import uuid
+
+import pytest
+
+from tests import fixtures
+
+# Multi-process, compile-bound: keep off shared workers (pytest.ini).
+pytestmark = [pytest.mark.serial, pytest.mark.chaos]
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+MODEL_CFG = dict(
+    n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+    intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+    param_dtype="float32",
+)
+ROLES = ["prefill", "prefill", "decode"]
+
+CHILD = '''
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax; jax.config.update("jax_platforms", "cpu")
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=%(nr)r)
+from areal_tpu.api.system_api import GenerationServerConfig
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.system.generation_server import GenerationServer
+import areal_tpu.engine.factories  # registry
+cfg = GenerationServerConfig(
+    experiment_name=%(exp)r, trial_name=%(trial)r, server_index=%(idx)d,
+    model=ModelAbstraction("tpu_transformer", args=dict(config=%(model_cfg)r)),
+    max_concurrent_requests=2, max_seq_len=512, kv_page_size=8,
+    decode_block_steps=4, prompt_bucket=16, prefill_chunk=16,
+    prefix_cache_tokens=4096, role=%(role)r, seed=0,
+)
+w = GenerationServer()
+w.configure(cfg, experiment_name=cfg.experiment_name, trial_name=cfg.trial_name,
+            worker_name=cfg.worker_name)
+w.run()
+'''
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _metrics(url):
+    text = urllib.request.urlopen(url + "/metrics", timeout=30).read().decode()
+    out = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                out[parts[0]] = parts[1]
+    return out
+
+
+def _wait_until(cond, timeout, msg, proc_check=None):
+    deadline = time.monotonic() + fixtures.scale_timeout(timeout)
+    while time.monotonic() < deadline:
+        if proc_check is not None:
+            proc_check()
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.mark.timeout(600)
+def test_disagg_fleet_handoff_and_prefill_death_failover(
+    tmp_path, monkeypatch
+):
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.api.system_api import GserverManagerConfig
+    from areal_tpu.base import constants, name_resolve, names
+    from areal_tpu.system.gserver_manager import GserverManager
+    from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+    nr = str(tmp_path / "nr")
+    exp, trial = f"disagg-{uuid.uuid4().hex[:6]}", "t0"
+    monkeypatch.setenv("AREAL_HEALTH_TTL", "1.0")
+    monkeypatch.setattr(
+        constants, "PARAM_REALLOC_ROOT", str(tmp_path / "realloc")
+    )
+    repo = name_resolve.reconfigure("nfs", record_root=nr)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["AREAL_HEALTH_TTL"] = "1.0"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs, logs, cleanup = [], [], []
+    loop = asyncio.new_event_loop()
+    try:
+        for idx, role in enumerate(ROLES):
+            child_env = dict(env)
+            if idx == 0:
+                # Chaos arm: server 0's FIRST kv-export handoff dies
+                # mid-flight — after the KV left the engine, before the
+                # decode server's pull completes. The client sees a dead
+                # socket on /generate.
+                child_env["AREAL_FAULTS"] = (
+                    "gserver.kv_export@generation_server/0=die:k=1"
+                )
+            log_path = tmp_path / f"server{idx}.log"
+            log_f = open(log_path, "w")
+            logs.append(log_path)
+            cleanup.append(log_f.close)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", CHILD % dict(
+                    repo=REPO, nr=nr, exp=exp, trial=trial, idx=idx,
+                    model_cfg=MODEL_CFG, role=role,
+                )],
+                env=child_env, cwd=REPO, stdout=log_f,
+                stderr=subprocess.STDOUT,
+            ))
+
+        def alive(indices=range(len(ROLES))):
+            for i in indices:
+                assert procs[i].poll() is None, (
+                    f"server {i} died:\n" + logs[i].read_text()[-3000:]
+                )
+
+        urls = {}
+
+        def discovered():
+            alive()
+            for i in range(len(ROLES)):
+                if i not in urls:
+                    try:
+                        urls[i] = name_resolve.get(
+                            names.gen_server_url(exp, trial, str(i))
+                        )
+                    except name_resolve.NameEntryNotFoundError:
+                        return False
+            return True
+
+        _wait_until(discovered, 240, "server discovery")
+
+        m = GserverManager()
+        m.configure(GserverManagerConfig(
+            experiment_name=exp, trial_name=trial, model_name="actor",
+            n_servers=len(ROLES), train_batch_size=4,
+            max_head_offpolicyness=1000,
+            flush_request_timeout=fixtures.scale_timeout(30.0),
+            health_check_interval=0.2,
+        ))
+        mt = threading.Thread(target=m.run, daemon=True)
+        mt.start()
+        cleanup.append(lambda: mt.join(timeout=10))
+        _wait_until(
+            lambda: len(m._healthy_urls()) == len(ROLES), 60,
+            "manager sees 3 healthy servers", proc_check=alive,
+        )
+        _wait_until(
+            lambda: [
+                m._server_roles.get(urls[i]) for i in range(len(ROLES))
+            ] == ROLES,
+            30, "manager learned the pool roles", proc_check=alive,
+        )
+
+        prm = PartialRolloutManager(
+            m.address, request_timeout=fixtures.scale_timeout(120),
+            max_retries=8, retry_backoff_s=0.05,
+        )
+        cleanup.append(lambda: loop.run_until_complete(prm.close()))
+
+        # Mixed-length rollouts, concurrently: long prompts take the
+        # chunked-prefill path on the prefill pool, short ones the
+        # batched path; every decode stream runs on the decode server.
+        # Rollout q0 (first scheduled) lands on prefill server 0, whose
+        # chaos arm kills it mid-handoff.
+        prompts = {
+            "q0": list(range(1, 33)),        # 32 tokens: chunked path
+            "q1": [3, 5, 7, 9, 11, 13, 15, 17],
+            "q2": list(range(2, 50)),        # 48 tokens: chunked path
+            "q3": [8, 6, 4, 2, 10, 12, 14, 16],
+        }
+
+        async def run_all():
+            g = GenerationHyperparameters(max_new_tokens=10, greedy=True)
+            outs = await asyncio.gather(*[
+                prm._generate_one(qid, p, g) for qid, p in prompts.items()
+            ])
+            return dict(zip(prompts, outs))
+
+        outs = loop.run_until_complete(run_all())
+        # ZERO failed rollouts: every episode completed its full budget
+        # despite the prefill-server death mid-handoff.
+        for qid, out in outs.items():
+            assert len(out.output_ids) == 10, (qid, out)
+
+        # The chaos arm fired: server 0 died and was evicted; the
+        # survivors carried the fleet.
+        _wait_until(
+            lambda: procs[0].poll() is not None, 30, "chaos kill landed"
+        )
+        _wait_until(lambda: urls[0] in m._evicted, 30, "eviction")
+        assert set(m._healthy_urls()) == {urls[1], urls[2]}
+
+        # KV crossed real process boundaries, hash-verified: the decode
+        # server imported at least as many blobs as completed handoffs,
+        # with real bytes.
+        m_dec = _metrics(urls[2])
+        assert m_dec["areal:role"] == "decode"
+        assert m_dec["areal:kv_import_total"] >= 3.0, m_dec
+        assert m_dec["areal:kv_import_bytes"] > 0
+        m_p1 = _metrics(urls[1])
+        assert m_p1["areal:kv_export_total"] >= 1.0
+        # Decode streams ran where they should: the decode engine
+        # emitted the tokens, the surviving prefill server only ever
+        # prefilled (1 token per handed-off request).
+        assert m_dec["areal:total_generated_tokens"] >= 3 * 9
+
+        # Pools + fleet handoff totals on the manager surface.
+        _wait_until(
+            lambda: _get_json(m.address + "/status")["pools"][
+                "kv_handoff"]["imports"] >= 3,
+            30, "fleet kv_handoff totals",
+        )
+        st = _get_json(m.address + "/status")
+        assert st["pools"]["roles"][urls[2]] == "decode"
+        assert urls[1] in st["pools"]["prefill"]
+        assert urls[2] not in st["pools"]["prefill"]
+
+        # The fleet still serves new sessions after the death.
+        post = loop.run_until_complete(run_one(prm, "post/0"))
+        assert len(post.output_ids) == 6
+
+        name_resolve.add(
+            names.experiment_status(exp, trial), "COMPLETE", replace=True
+        )
+    finally:
+        try:
+            name_resolve.add(
+                names.experiment_status(exp, trial), "COMPLETE", replace=True
+            )
+        except Exception:
+            pass
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for fn in cleanup:
+            try:
+                fn()
+            except Exception:
+                pass
+        loop.close()
+        repo.reset()
+
+
+async def run_one(prm, qid):
+    from areal_tpu.api.model_api import GenerationHyperparameters
+
+    return await prm._generate_one(
+        qid, [5, 6, 7, 8, 9, 10, 11, 12],
+        GenerationHyperparameters(max_new_tokens=6, greedy=True),
+    )

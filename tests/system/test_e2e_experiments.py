@@ -143,6 +143,7 @@ def test_sft_e2e_mock(tmp_path, n_workers):
 
 
 @pytest.mark.serial
+@pytest.mark.slow  # ~44s: the sync-PPO loop is covered at unit level
 def test_sync_ppo_e2e_tiny_real(tmp_path):
     """Sync PPO DFG (gen -> {rew, ref} -> train) with the real JAX engine
     on a tiny model, single worker hosting actor+ref+reward."""

@@ -29,6 +29,14 @@ def _manager(policy="round_robin", **cfg_kw):
     m._server_shed_until = {u: 0.0 for u in m.server_urls}
     m._server_shed_total = {u: 0.0 for u in m.server_urls}
     m._affinity = collections.OrderedDict()
+    # Disaggregated-pool state (all-unified here: single-pool routing).
+    m._server_roles = {u: "unified" for u in m.server_urls}
+    m._server_queued_toks = {u: 0.0 for u in m.server_urls}
+    m._server_free_pages = {}
+    m._server_total_pages = {}
+    m._server_elastic = {}
+    m._rerole_orig = {}
+    m._rerole_log = []
     m.weight_version = 0
     return m
 
@@ -47,46 +55,46 @@ def test_least_token_usage_folds_inflight_between_polls():
 
 def test_affinity_routes_follow_up_to_prefix_holder_across_versions():
     m = _manager("least_requests")
-    url1, policy1 = m._route({"qid": "s/0", "prompt_len": 10})
+    url1, policy1, _d = m._route({"qid": "s/0", "prompt_len": 10})
     assert policy1 == "least_requests"
     # Load the affinity target heavily: affinity still wins (the prefix
     # is there), and survives a weight-version bump.
     m._server_reqs[url1] = 50
     m.weight_version = 7
-    url2, policy2 = m._route({"qid": "s/0", "prompt_len": 20})
+    url2, policy2, _d = m._route({"qid": "s/0", "prompt_len": 20})
     assert (url2, policy2) == (url1, "affinity")
 
 
 def test_affinity_spills_on_shed_window_then_returns():
     m = _manager("round_robin")
-    url1, _ = m._route({"qid": "s/1", "prompt_len": 10})
+    url1, _, _d = m._route({"qid": "s/1", "prompt_len": 10})
     other = B if url1 == A else A
     # The server shed a client with 429: routed around for Retry-After.
     m._server_shed_until[url1] = time.monotonic() + 30.0
-    url2, policy2 = m._route({"qid": "s/1", "prompt_len": 10})
+    url2, policy2, _d = m._route({"qid": "s/1", "prompt_len": 10})
     assert (url2, policy2) == (other, "spill")
     # Spill re-recorded the affinity on the server now holding the
     # session's newest prefix.
     m._server_shed_until[url1] = 0.0
-    url3, policy3 = m._route({"qid": "s/1", "prompt_len": 10})
+    url3, policy3, _d = m._route({"qid": "s/1", "prompt_len": 10})
     assert (url3, policy3) == (other, "affinity")
 
 
 def test_affinity_spills_on_saturation_threshold():
     m = _manager("least_requests", affinity_saturation_requests=4)
-    url1, _ = m._route({"qid": "s/2", "prompt_len": 10})
+    url1, _, _d = m._route({"qid": "s/2", "prompt_len": 10})
     m._server_reqs[url1] = 4
     other = B if url1 == A else A
     m._server_reqs[other] = 0
-    url2, policy2 = m._route({"qid": "s/2", "prompt_len": 10})
+    url2, policy2, _d = m._route({"qid": "s/2", "prompt_len": 10})
     assert (url2, policy2) == (other, "spill")
 
 
 def test_affinity_ignores_unhealthy_target_and_map_is_bounded():
     m = _manager("round_robin", affinity_map_size=2)
-    url1, _ = m._route({"qid": "s/3", "prompt_len": 10})
+    url1, _, _d = m._route({"qid": "s/3", "prompt_len": 10})
     m._healthy.discard(url1)
-    url2, policy2 = m._route({"qid": "s/3", "prompt_len": 10})
+    url2, policy2, _d = m._route({"qid": "s/3", "prompt_len": 10})
     assert url2 != url1 and policy2 != "affinity"
     # LRU bound: oldest entries fall out.
     for i in range(5):
@@ -98,5 +106,5 @@ def test_whole_fleet_shedding_still_routes():
     m = _manager("least_requests")
     now = time.monotonic()
     m._server_shed_until = {A: now + 30, B: now + 30}
-    url, _ = m._route({"qid": "s/4", "prompt_len": 10})
+    url, _, _d = m._route({"qid": "s/4", "prompt_len": 10})
     assert url in (A, B)

@@ -1,0 +1,87 @@
+"""ISSUE 7 acceptance (bench leg): the `serving_disagg` phase banks an
+attested CPU-proxy record whose unified-vs-1P+1D A/B shows decode ITL
+p99 in the disaggregated fleet at or below the unified fleet's under
+the same mixed long-prefill/short-decode open-loop load, with the KV
+handoff really crossing process boundaries — and `validate_bench.py`
+accepts the record (and rejects a record missing either arm).
+
+Time budget: ~100 s (two 2-subprocess fleets run sequentially; warm
+XLA cache).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.timeout(420)
+def test_disagg_ab_banks_itl_win_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    # Trimmed interference script (defaults are sized for bench runs):
+    # 3 decode streams alive through 3 long-prompt injections.
+    monkeypatch.setenv("AREAL_DISAGG_STREAM_TOKENS", "200")
+    monkeypatch.setenv("AREAL_DISAGG_N_LONG", "3")
+    monkeypatch.setenv("AREAL_DISAGG_LONG_GAP_S", "0.7")
+    from areal_tpu.bench.workloads import serving_disagg_phase
+
+    val = serving_disagg_phase("measure")
+    path = bank.write_record(
+        bank.make_record("serving_disagg", "measure", "ok", value=val), b
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("serving_disagg", rec) == []
+    assert validator.validate_bank_dir(b) == []
+
+    v = rec["value"]
+    # Zero failed rollouts in either arm; the handoff really ran (KV
+    # crossed the process boundary, hash-verified, no local fallbacks).
+    assert v["unified_failed"] == 0 and v["disagg_failed"] == 0
+    assert v["kv_handoffs"] >= 3
+    assert v["kv_handoff_bytes"] > 0
+    assert v["kv_handoff_fallbacks"] == 0
+    # THE acceptance number: the disaggregated fleet's decode ITL p99
+    # never exceeds the unified fleet's under the same scripted load —
+    # long prefills no longer steal decode batch slots.
+    assert v["disagg_itl_p99_ms"] <= v["unified_itl_p99_ms"], v
+
+    # The validator refuses a record missing either arm of the pair...
+    for missing in ("unified_itl_p99_ms", "disagg_itl_p99_ms"):
+        bad = json.loads(json.dumps(rec))
+        del bad["value"][missing]
+        assert any(
+            missing in p
+            for p in validator.validate_phase_value("serving_disagg", bad)
+        )
+    # ...and one whose disaggregated arm lost requests.
+    lossy = json.loads(json.dumps(rec))
+    lossy["value"]["disagg_failed"] = 2.0
+    assert any(
+        "loss-free" in p
+        for p in validator.validate_phase_value("serving_disagg", lossy)
+    )
