@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import typing
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from areal_tpu.api.model_api import GenerationHyperparameters
 from areal_tpu.api.system_api import ExperimentSaveEvalControl
@@ -342,6 +342,26 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "overruns are surfaced in /status + logs, not fatal"
         },
     )
+    gen_weight_wire_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "'int8' ships weight updates over the plane as "
+            "quantized data+scale streams (~half the bytes per "
+            "version; servers dequantize at assembly). The trainer "
+            "dump publishes the companion bin; None ships raw bytes"
+        },
+    )
+    gen_weight_shards: str = dataclasses.field(
+        default="",
+        metadata={
+            "help": "comma-separated 'rank/degree' weight-shard spec "
+            "per generation server index (e.g. '0/2,1/2' for a 2-way "
+            "fleet TP group): each server fetches only its slice of "
+            "every weight version and same-shard peers fan chunks to "
+            "each other. Empty entries = unsharded (full payload)"
+        },
+    )
+
     # Disaggregated prefill/decode serving (docs/serving.md).
     gen_server_roles: str = dataclasses.field(
         default="",
@@ -397,6 +417,68 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
     agent_type: str = "math-single-step"
     agent_num_turns: int = 4
     agent_turn_discount: float = 1.0
+
+    def __post_init__(self):
+        super().__post_init__()
+        # Config-parse-time validation: bad serving precisions and
+        # malformed weight-shard specs must fail HERE, not at engine
+        # construction deep inside server startup.
+        if self.gen_decode_weight_dtype not in (None, "model", "int8"):
+            raise ValueError(
+                f"gen_decode_weight_dtype="
+                f"{self.gen_decode_weight_dtype!r}: expected None, "
+                f"'model', or 'int8'"
+            )
+        if self.gen_weight_wire_dtype not in (None, "int8"):
+            raise ValueError(
+                f"gen_weight_wire_dtype={self.gen_weight_wire_dtype!r}: "
+                f"expected None or 'int8'"
+            )
+        for i, spec in enumerate(parse_weight_shards(
+            self.gen_weight_shards, self.n_generation_servers
+        )):
+            # The engine can only place a sliced cutover when its mesh
+            # tensor extent matches the fleet shard degree — catch the
+            # mismatch here, not after a full fleet transfer.
+            if spec is not None and spec[1] != self.gen_tensor_parallel:
+                raise ValueError(
+                    f"gen_weight_shards[{i}] degree {spec[1]} != "
+                    f"gen_tensor_parallel {self.gen_tensor_parallel}"
+                )
+
+
+def parse_weight_shards(
+    spec: str, n_servers: int
+) -> List[Optional[Tuple[int, int]]]:
+    """'0/2,1/2' -> [(0, 2), (1, 2), ...] padded with None (unsharded)
+    per generation-server index; raises ValueError on malformed or
+    out-of-range entries."""
+    entries = (spec or "").split(",")
+    if spec and len(entries) > n_servers:
+        raise ValueError(
+            f"gen_weight_shards lists {len(entries)} entries for "
+            f"{n_servers} generation server(s)"
+        )
+    out: List[Optional[Tuple[int, int]]] = []
+    for i, ent in enumerate(entries):
+        ent = ent.strip()
+        if not ent:
+            out.append(None)
+            continue
+        try:
+            rank_s, degree_s = ent.split("/")
+            rank, degree = int(rank_s), int(degree_s)
+        except ValueError:
+            raise ValueError(
+                f"gen_weight_shards[{i}]={ent!r}: expected 'rank/degree'"
+            )
+        if degree < 1 or not (0 <= rank < degree):
+            raise ValueError(
+                f"gen_weight_shards[{i}]={ent!r}: rank out of range"
+            )
+        out.append((rank, degree))
+    out += [None] * (n_servers - len(out))
+    return out[:n_servers]
 
 
 # ---------------------------------------------------------------------------

@@ -73,6 +73,13 @@ class ModelWorkerConfig:
     # Chunk size for that source (mirrors the manager-hosted fallback's
     # GserverManagerConfig.weight_chunk_bytes).
     weight_chunk_bytes: int = 8 << 20
+    # Quantized weight wire: "int8" makes every raw dump also publish a
+    # params-v{N}.int8.bin companion (matmul leaves as int8 data +
+    # float32 per-output-channel scales, ops/wquant.py convention) the
+    # plane can serve instead of the raw bytes — roughly half the
+    # transfer per version; servers dequantize at assembly. Mirrors
+    # GserverManagerConfig.weight_wire_dtype. None disables.
+    weight_wire_dtype: Optional[str] = None
 
     @property
     def worker_name(self) -> str:
@@ -204,6 +211,17 @@ class GenerationServerConfig:
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1
+    # Shard-aware weight plane (docs/weight_updates.md): this server's
+    # coordinates in a FLEET-level tensor-parallel group. When set, the
+    # server fetches only its slice of each weight version (a sliced
+    # shard manifest — per-server ingress and host staging drop by
+    # ~degree; same-shard peers fan chunks to each other) and cutover
+    # device_puts the shard slabs directly under the engine's
+    # NamedSharding. Both set or both None; requires a multi-host-style
+    # deployment where this process hosts exactly the mesh slice for
+    # weight_shard_rank (the manager groups fanout trees by shard).
+    weight_shard_rank: Optional[int] = None
+    weight_shard_degree: Optional[int] = None
     # Pre-compile the serving programs (prefill bucket + decode block,
     # ServingEngine.warm) BEFORE the server registers for discovery:
     # the first real rollout request then never eats a multi-second XLA
@@ -255,6 +273,12 @@ class GserverManagerConfig:
     # Chunk size for the manager-hosted origin (a trainer-side source
     # uses its own); per-chunk hashed, Range-resumable.
     weight_chunk_bytes: int = 8 << 20
+    # Quantized weight wire for plane fanouts: "int8" fetches/ships the
+    # dump's quantized companion stream (~half the bytes per version;
+    # servers dequantize at assembly). Requires the dump side to arm
+    # ModelWorkerConfig.weight_wire_dtype with the same value. None
+    # ships raw bytes.
+    weight_wire_dtype: Optional[str] = None
     # Children per node in the fanout tree: origin egress is bounded by
     # degree * payload; deeper trees trade origin egress for extra hops.
     weight_fanout_degree: int = 2

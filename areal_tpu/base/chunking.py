@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 CHUNK_SCHEMA = "areal-weight-chunks/v1"
 
@@ -119,3 +119,189 @@ class StreamChunker:
 
 def verify_chunk(data, expected_hash: str) -> bool:
     return hash_chunk(data) == expected_hash
+
+
+# ----------------------------------------------------------------------
+# Slice -> byte-range resolution (the shard-aware manifest layer)
+#
+# A generation server that holds only one tensor-parallel shard of the
+# model should fetch only that shard's bytes. The per-leaf layout
+# (path -> shape/offset/nbytes in the raw bin, published by
+# system/weight_transfer.dump_raw_params) plus a per-dimension slice
+# tuple (derived from parallel/sharding.py partition specs by the
+# caller — this module stays jax-free) resolves to the minimal covering
+# set of byte ranges in the bin; the weight-plane origin concatenates
+# those ranges into a per-shard chunk stream with its own content
+# hashes, so sliced transfer keeps the full hash-authority discipline.
+# ----------------------------------------------------------------------
+
+
+def slice_byte_ranges(
+    offset: int, shape, itemsize: int, slices
+) -> List[Tuple[int, int]]:
+    """Minimal covering [(byte_off, length), ...] for a row-major slab.
+
+    ``slices`` is one ``(start, stop)`` per dimension (``len(shape)``
+    entries). Contiguous runs are maximized: trailing dimensions that
+    are fully covered fold into a single run per outer index, and
+    adjacent runs merge. A scalar (empty shape) is one full-leaf range.
+    """
+    shape = list(shape)
+    slices = [(int(a), int(b)) for a, b in slices]
+    if len(slices) != len(shape):
+        raise ValueError(
+            f"{len(slices)} slices for a rank-{len(shape)} leaf"
+        )
+    for (a, b), dim in zip(slices, shape):
+        if not (0 <= a <= b <= dim):
+            raise ValueError(f"slice ({a}, {b}) out of bounds for dim {dim}")
+        if b == a:
+            return []  # empty slice: nothing to fetch
+    if not shape:
+        return [(offset, itemsize)]
+    # Innermost dim k whose suffix (k+1..) is fully covered: everything
+    # from k inward is one contiguous run per outer index combination.
+    k = len(shape) - 1
+    while k > 0 and all(
+        s == (0, d) for s, d in zip(slices[k:], shape[k:])
+    ):
+        k -= 1
+    inner = itemsize
+    for d in shape[k + 1:]:
+        inner *= d
+    run_len = (slices[k][1] - slices[k][0]) * inner
+    # Strides (in bytes) of dims 0..k-1.
+    strides = []
+    s = inner * shape[k]
+    for d in reversed(shape[:k]):
+        strides.append(s)
+        s *= d
+    strides.reverse()
+    ranges: List[Tuple[int, int]] = []
+
+    def emit(dim_idx: int, base: int):
+        if dim_idx == k:
+            start = base + slices[k][0] * inner
+            if ranges and ranges[-1][0] + ranges[-1][1] == start:
+                ranges[-1] = (ranges[-1][0], ranges[-1][1] + run_len)
+            else:
+                ranges.append((start, run_len))
+            return
+        a, b = slices[dim_idx]
+        for i in range(a, b):
+            emit(dim_idx + 1, base + i * strides[dim_idx])
+
+    emit(0, offset)
+    return ranges
+
+
+def shard_stream_plan(segments: List[Dict]) -> Dict:
+    """Plan a shard's virtual payload from sliced layout segments.
+
+    Each segment describes one sliced slab of the source bin:
+    ``{"offset", "shape", "nbytes", "slices", ...}`` (``nbytes`` is the
+    FULL slab's size, from which the itemsize is derived; extra keys
+    pass through). Returns::
+
+        {"segments": [...],   # inputs + local_offset/local_nbytes/local_shape
+         "ranges": [...],     # (bin_off, len) gather list, stream order
+         "total_bytes": int}
+
+    The shard stream is the concatenation of every segment's covering
+    ranges in segment order — the origin serves chunks of this stream by
+    gathering the ranges; the client's local buffer holds each segment's
+    sliced slab contiguously at ``local_offset`` with ``local_shape``.
+    """
+    out_segments: List[Dict] = []
+    ranges: List[Tuple[int, int]] = []
+    cursor = 0
+    for seg in segments:
+        shape = list(seg["shape"])
+        n_items = 1
+        for d in shape:
+            n_items *= d
+        if n_items <= 0:
+            raise ValueError(f"empty-shape segment: {seg}")
+        itemsize = int(seg["nbytes"]) // n_items
+        if itemsize * n_items != int(seg["nbytes"]):
+            raise ValueError(
+                f"nbytes {seg['nbytes']} not divisible by {n_items} items"
+            )
+        slc = list(seg["slices"])
+        seg_ranges = slice_byte_ranges(
+            int(seg["offset"]), shape, itemsize, slc
+        )
+        local_shape = [b - a for a, b in slc]
+        local_nbytes = itemsize
+        for d in local_shape:
+            local_nbytes *= d
+        assert sum(r[1] for r in seg_ranges) == local_nbytes
+        entry = dict(seg)
+        entry["local_shape"] = local_shape
+        entry["local_offset"] = cursor
+        entry["local_nbytes"] = local_nbytes
+        out_segments.append(entry)
+        # Merge ranges only WITHIN the stream order (ranges must stay in
+        # stream order so offset->range lookup is a prefix sum).
+        for r in seg_ranges:
+            if ranges and ranges[-1][0] + ranges[-1][1] == r[0]:
+                ranges[-1] = (ranges[-1][0], ranges[-1][1] + r[1])
+            else:
+                ranges.append(r)
+        cursor += local_nbytes
+    return {
+        "segments": out_segments,
+        "ranges": ranges,
+        "total_bytes": cursor,
+    }
+
+
+def stream_prefix(ranges: List[Tuple[int, int]]) -> List[int]:
+    """Cumulative stream offset at which each range begins (plus the
+    total as a final sentinel). Built once per cached shard plan so
+    ``gather_stream`` can bisect instead of scanning — a fine-grained
+    slicing (one range per outer index of a last-dim-sharded leaf) can
+    produce 1e5+ ranges, and the origin serves one window per chunk."""
+    pre = [0]
+    for _, ln in ranges:
+        pre.append(pre[-1] + ln)
+    return pre
+
+
+def gather_stream(
+    read_at, ranges: List[Tuple[int, int]], start: int, length: int,
+    prefix: Optional[List[int]] = None,
+) -> bytes:
+    """Read ``[start, start+length)`` of the virtual stream defined by
+    ``ranges`` via ``read_at(bin_offset, n) -> bytes`` (the origin's
+    pread). ``prefix`` (see :func:`stream_prefix`) makes the first-range
+    lookup O(log n); without it the scan starts at range 0. Raises
+    OSError on short reads (GC race; caller 404s)."""
+    import bisect
+
+    out = []
+    need = length
+    if prefix is not None:
+        i = max(0, bisect.bisect_right(prefix, start) - 1)
+        pos = prefix[i]
+    else:
+        i, pos = 0, 0
+    for off, ln in ranges[i:]:
+        if need <= 0:
+            break
+        if start < pos + ln:
+            lo = max(0, start - pos)
+            take = min(ln - lo, need)
+            data = read_at(off + lo, take)
+            if len(data) != take:
+                raise OSError(
+                    f"short stream read: wanted {take}, got {len(data)}"
+                )
+            out.append(data)
+            need -= take
+        pos += ln
+    if need > 0:
+        raise ValueError(
+            f"stream read past end: [{start}, {start + length}) of {pos}"
+        )
+    return b"".join(out)

@@ -54,6 +54,11 @@ class PhaseSpec:
     # Included in a bare `python bench.py` run (non-default phases run
     # only when asked for by name or picked up by the daemon).
     default: bool = True
+    # Extra env for the runner subprocess (applied before env_extra;
+    # XLA_FLAGS values APPEND to the inherited flags so e.g. a phase
+    # can request a fake multi-device CPU mesh without clobbering the
+    # host's settings).
+    env: Optional[Dict[str, str]] = None
     description: str = ""
 
     def resolve(self) -> Callable[[str], Dict]:
@@ -225,6 +230,26 @@ register(PhaseSpec(
                 "fanout over loopback HTTP — weight_update_ms with the "
                 "transfer/cutover split and the O(1)-origin-egress "
                 "invariant (host-side; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
+    name="weight_plane_sharded",
+    entrypoint="areal_tpu.bench.workloads:weight_plane_sharded_phase",
+    priority=13,
+    est_compile_s=0.0,  # host + loopback HTTP + tiny CPU-mesh engines
+    est_measure_s=180.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    description="Shard-aware + quantized weight plane: per-server "
+                "ingress bytes/version vs TP degree (1 vs 2) and wire "
+                "dtype (raw vs int8) over a live origin, same-shard "
+                "peer replica at zero origin cost, O(1)-origin "
+                "invariant, dequant-parity, and greedy-decode parity "
+                "of a 2-way-TP engine cut over from sliced shard "
+                "streams (byte accounting is exact and "
+                "machine-independent; CPU-proxy evidence)",
 ))
 
 register(PhaseSpec(

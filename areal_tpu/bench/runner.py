@@ -84,6 +84,13 @@ def run_phase(
         # Proxy phases are CPU evidence by construction — never let one
         # accidentally attest a TPU platform.
         env["JAX_PLATFORMS"] = "cpu"
+    for k, v in (spec.env or {}).items():
+        if k == "XLA_FLAGS":
+            # Append: the phase asks for extra flags (e.g. a fake
+            # multi-device CPU mesh) on top of whatever the host set.
+            env[k] = (env.get(k, "") + " " + v).strip()
+        else:
+            env.setdefault(k, v)
     if env_extra:
         env.update(env_extra)
 

@@ -330,11 +330,17 @@ class ServingEngine:
             decode_weight_dtype = (
                 os.environ.get("AREAL_DECODE_WEIGHT_DTYPE") or None
             )
-        if decode_weight_dtype not in (None, "model") and mesh is not None:
+        if decode_weight_dtype not in (None, "model", "int8"):
             raise ValueError(
-                "decode_weight_dtype with a TP mesh is not supported yet "
-                "(quantized-scale shardings unverified); drop one"
+                f"decode_weight_dtype={decode_weight_dtype!r}: expected "
+                f"None, 'model', or 'int8'"
             )
+        # int8 + TP mesh IS supported: the quantize transform runs under
+        # jit on the sharded params, so GSPMD places the scales (absmax
+        # reduces axis -2 — an all-reduce max for row-parallel weights,
+        # free for column-parallel) and the decode block consumes the
+        # (q, s) pairs like any other sharded leaf. Greedy parity vs the
+        # unsharded int8 engine is pinned by tests/engine/test_wquant_tp.
         self.decode_weight_dtype = decode_weight_dtype
         self._qparams = None
         self._refresh_qparams()
@@ -766,6 +772,22 @@ class ServingEngine:
         since cancelled) is dropped — an older staging finishing last
         must never overwrite newer weights with stale ones. Unversioned
         updates are never dropped and never consume a pinned version."""
+
+        def build():
+            if self.mesh is not None:
+                from areal_tpu.parallel.sharding import shard_params
+
+                return shard_params(params, self.mesh)
+            return jax.tree_util.tree_map(jnp.asarray, params)
+
+        self._stage_update(build, allow_interrupt, version)
+
+    def _stage_update(self, build, allow_interrupt: bool,
+                      version: Optional[int]):
+        """Shared staging machinery behind update_params /
+        stage_shard_leaves: version gating, pending-copy eviction, the
+        host->device transfer via ``build()`` (returns the staged device
+        tree), and the pending-params publish + optional interrupt."""
         with self._stage_lock:
             if version is not None and version <= self._highest_pinned:
                 logger.info(
@@ -801,12 +823,7 @@ class ServingEngine:
                 self._pending_params = None
                 self._pending_version = None
             t0 = time.monotonic()
-            if self.mesh is not None:
-                from areal_tpu.parallel.sharding import shard_params
-
-                staged = shard_params(params, self.mesh)
-            else:
-                staged = jax.tree_util.tree_map(jnp.asarray, params)
+            staged = build()
             # Bound transfer completion (safe here: we're off the serve
             # loop): block_until_ready doesn't wait on tunneled devices,
             # so fetch one element of the last-dispatched leaf instead.
@@ -844,8 +861,12 @@ class ServingEngine:
         self.update_params(
             params, allow_interrupt=allow_interrupt, version=int(version)
         )
+        return self._await_pinned(int(version), t0, timeout_s)
+
+    def _await_pinned(self, version: int, t0: float,
+                      timeout_s: float) -> float:
         deadline = t0 + timeout_s
-        while self._applied_pinned < int(version):
+        while self._applied_pinned < version:
             if self.fatal_error is not None:
                 raise RuntimeError(
                     f"cutover v{version}: serve loop died: "
@@ -859,6 +880,136 @@ class ServingEngine:
             time.sleep(0.002)
         self.last_weight_cutover_s = time.monotonic() - t0
         return self.last_weight_cutover_s
+
+    # -- shard-aware cutover (the weight plane's sliced-manifest path) --
+
+    def _addressable_tensor_coords(self) -> Dict[Any, int]:
+        """{device: tensor-axis coordinate} for this PROCESS's devices.
+        Under multi-host TP each process sees only its own mesh slice
+        (so it needs only its own ranks' shard leaves); single-process
+        meshes see every coordinate."""
+        coords: Dict[Any, int] = {}
+        t_ax = list(self.mesh.axis_names).index("tensor")
+        local = {d.id for d in jax.local_devices()}
+        for idx, dev in np.ndenumerate(self.mesh.devices):
+            if dev.id in local:
+                coords[dev] = int(idx[t_ax])
+        return coords
+
+    def _build_from_shard_leaves(self, leaves_by_rank, degree: int,
+                                 global_shapes=None):
+        """Staged device tree from per-rank HOST shard leaves (flat
+        {path: local ndarray} per tensor rank, e.g. assemble_leaves of
+        shard-manifest ChunkStores): each addressable device gets its
+        rank's slab via device_put, then the global arrays form through
+        jax.make_array_from_single_device_arrays under the engine's own
+        NamedSharding. No model-sized host buffer and no resharding
+        copy ever exists — the sliced wire bytes ARE the device shards."""
+        from jax.sharding import NamedSharding
+
+        from areal_tpu.parallel.sharding import fitted_param_spec
+        from areal_tpu.system.weight_transfer import unflatten_leaves
+
+        mesh = self.mesh
+        if mesh is None:
+            raise ValueError(
+                "shard-leaves cutover needs a mesh-sharded engine"
+            )
+        t_size = mesh.shape.get("tensor", 1)
+        if degree != t_size:
+            raise ValueError(
+                f"shard degree {degree} != mesh tensor size {t_size}"
+            )
+        for ax, size in mesh.shape.items():
+            if ax != "tensor" and size != 1:
+                raise ValueError(
+                    f"shard-leaves cutover supports tensor-only meshes; "
+                    f"axis {ax!r} has size {size}"
+                )
+        coords = self._addressable_tensor_coords()
+        missing = sorted(
+            {t for t in coords.values()} - set(leaves_by_rank)
+        )
+        if missing:
+            raise ValueError(
+                f"missing shard leaves for addressable tensor ranks "
+                f"{missing}"
+            )
+        any_rank = next(iter(leaves_by_rank))
+        paths = sorted(leaves_by_rank[any_rank])
+        sizes = dict(mesh.shape)
+        flat = {}
+        for path in paths:
+            local0 = leaves_by_rank[any_rank][path]
+            if global_shapes is not None and path in global_shapes:
+                # Shard manifests record each leaf's global shape —
+                # authoritative (no inference edge cases on tiny dims).
+                gshape = list(global_shapes[path])
+            else:
+                # Infer: local shapes agree with the global on every dim
+                # except those the fitted spec shards on 'tensor', which
+                # concatenate across ranks. Fit against the local shape,
+                # scale the tensor-sharded dims, then re-fit against the
+                # recovered global shape.
+                gshape = list(local0.shape)
+                spec = fitted_param_spec(path, gshape, sizes)
+                entries = list(spec) + [None] * (len(gshape) - len(spec))
+                for i, entry in enumerate(entries):
+                    names = (
+                        entry if isinstance(entry, tuple)
+                        else (entry,) if entry else ()
+                    )
+                    if "tensor" in names:
+                        gshape[i] *= t_size
+            spec = fitted_param_spec(path, gshape, sizes)
+            sharding = NamedSharding(mesh, spec)
+            idx_map = sharding.devices_indices_map(tuple(gshape))
+            shards = []
+            for dev, t in coords.items():
+                local = leaves_by_rank[t][path]
+                want = tuple(
+                    (sl.stop if sl.stop is not None else dim)
+                    - (sl.start or 0)
+                    for sl, dim in zip(idx_map[dev], gshape)
+                )
+                if tuple(local.shape) != want:
+                    raise ValueError(
+                        f"{path}: rank-{t} shard shape {local.shape} != "
+                        f"device shard {want} (global {tuple(gshape)})"
+                    )
+                shards.append(jax.device_put(local, dev))
+            flat[path] = jax.make_array_from_single_device_arrays(
+                tuple(gshape), sharding, shards
+            )
+        return unflatten_leaves(flat)
+
+    def stage_shard_leaves(self, leaves_by_rank, degree: int,
+                           version: Optional[int] = None,
+                           allow_interrupt: bool = True,
+                           global_shapes=None):
+        """update_params for pre-sliced host shards (see
+        _build_from_shard_leaves)."""
+        self._stage_update(
+            lambda: self._build_from_shard_leaves(
+                leaves_by_rank, degree, global_shapes
+            ),
+            allow_interrupt, version,
+        )
+
+    def cutover_shard_leaves(
+        self, leaves_by_rank, degree: int, version: int,
+        allow_interrupt: bool = True, timeout_s: float = 120.0,
+        global_shapes=None,
+    ) -> float:
+        """cutover_params for pre-sliced host shards: stage each rank's
+        slabs straight onto its devices, then block until the serve
+        loop lands the version."""
+        t0 = time.monotonic()
+        self.stage_shard_leaves(
+            leaves_by_rank, degree, version=int(version),
+            allow_interrupt=allow_interrupt, global_shapes=global_shapes,
+        )
+        return self._await_pinned(int(version), t0, timeout_s)
 
     @property
     def queue_depth(self) -> int:

@@ -45,14 +45,51 @@ def http_get_json(url: str, timeout: float = 10.0) -> Dict:
         return json.loads(r.read())
 
 
+def stream_params(
+    wire: Optional[str] = None,
+    tp_degree: Optional[int] = None,
+    tp_rank: Optional[int] = None,
+) -> Dict[str, str]:
+    """Query params that pick ONE chunk stream of a version: the wire
+    precision and (for shard-aware fetch) the tensor-parallel slice.
+    Omitted/default values are left off the URL so unsharded holders
+    keep the PR 5 contract byte-for-byte."""
+    q: Dict[str, str] = {}
+    if wire and wire != "raw":
+        q["wire"] = str(wire)
+    if tp_degree and int(tp_degree) > 1:
+        q["tp_degree"] = str(int(tp_degree))
+        q["tp_rank"] = str(int(tp_rank or 0))
+    return q
+
+
+def manifest_stream_params(manifest: Dict) -> Dict[str, str]:
+    """The stream-identity params of a fetched manifest (what
+    ChunkStore appends to every chunk URL so peers and the origin serve
+    the matching stream)."""
+    shard = manifest.get("shard") or {}
+    return stream_params(
+        wire=manifest.get("wire"),
+        tp_degree=shard.get("tp_degree"),
+        tp_rank=shard.get("tp_rank"),
+    )
+
+
 def fetch_manifest(
-    base_url: str, version: Optional[int] = None, timeout: float = 10.0
+    base_url: str, version: Optional[int] = None, timeout: float = 10.0,
+    wire: Optional[str] = None,
+    tp_degree: Optional[int] = None, tp_rank: Optional[int] = None,
 ) -> Dict:
     """GET ``{base_url}/weights/manifest`` (optionally pinned to a
-    version: the holder 404s until it can serve exactly that one)."""
-    url = f"{base_url}/weights/manifest"
+    version: the holder 404s until it can serve exactly that one).
+    ``wire``/``tp_degree``/``tp_rank`` pick a quantized and/or sliced
+    chunk stream (the origin builds shard streams on demand)."""
+    q = stream_params(wire=wire, tp_degree=tp_degree, tp_rank=tp_rank)
     if version is not None:
-        url += f"?version={int(version)}"
+        q["version"] = str(int(version))
+    url = f"{base_url}/weights/manifest"
+    if q:
+        url += "?" + urllib.parse.urlencode(q)
     man = http_get_json(url, timeout=timeout)
     if man.get("schema") != CHUNK_SCHEMA:
         raise WeightFetchError(
@@ -86,8 +123,14 @@ class ChunkStore:
             f"manifest n_chunks {manifest['n_chunks']} != computed "
             f"{self.n_chunks}"
         )
+        # Shard-aware staging: for a sliced manifest this buffer is
+        # SHARD-sized (total_bytes is the shard stream's length), so a
+        # TP-degree-D fleet's per-server host high-water drops by ~D.
         self.buf = bytearray(self.total_bytes)
         self._have = [False] * self.n_chunks
+        # Stream identity (wire + shard) appended to every chunk URL so
+        # upstreams serve the matching stream.
+        self._stream_q = manifest_stream_params(manifest)
         # Telemetry: who served us how much (origin vs peer accounting
         # for the O(1)-egress assertion), and time split fetch vs verify.
         self.bytes_from: Dict[str, int] = {}
@@ -116,7 +159,9 @@ class ChunkStore:
     ) -> bytes:
         url = (
             f"{base_url}/weights/chunk?"
-            + urllib.parse.urlencode({"version": self.version, "idx": idx})
+            + urllib.parse.urlencode(
+                {"version": self.version, "idx": idx, **self._stream_q}
+            )
         )
         req = urllib.request.Request(url)
         if start:
@@ -215,39 +260,82 @@ class ChunkStore:
         from_origin = sum(
             n for u, n in self.bytes_from.items() if u == origin
         )
+        total_in = sum(self.bytes_from.values())
+        # Shard-aware expectations: a sliced fetch is COMPLETE at its
+        # own shard bytes (total_bytes of ITS manifest), not the full
+        # model's — dashboards divide ingress by expected_bytes, so a
+        # TP shard at 1.0 reads as complete, never as a torn transfer.
+        expected = self.total_bytes
         return {
             "version": self.version,
             "total_bytes": self.total_bytes,
+            "expected_bytes": expected,
+            "model_total_bytes": int(
+                self.manifest.get("model_total_bytes", self.total_bytes)
+            ),
+            "wire": self.manifest.get("wire", "raw"),
+            "shard": self.manifest.get("shard"),
+            "ingress_payload_equivalents": (
+                total_in / expected if expected else 0.0
+            ),
             "n_chunks": self.n_chunks,
             "fetch_s": self.fetch_s,
             "verify_s": self.verify_s,
             "resumed_chunks": self.resumed_chunks,
             "bytes_from": dict(self.bytes_from),
             "bytes_from_origin": from_origin,
-            "bytes_from_peers": sum(self.bytes_from.values()) - from_origin,
+            "bytes_from_peers": total_in - from_origin,
         }
 
 
-def assemble_params(store: ChunkStore) -> Tuple[Any, int]:
-    """Reinterpret a complete store's buffer as the params pytree —
-    zero-copy numpy views over the host buffer (jax.device_put during
-    cutover streams straight from these pages, exactly like the mmap
-    fast path in weight_transfer.load_raw_params)."""
+def assemble_leaves(store: ChunkStore) -> Dict[str, Any]:
+    """Flat {path: array} view of a complete store's buffer.
+
+    Raw-wire leaves are ZERO-COPY numpy views over the host buffer
+    (jax.device_put during cutover streams straight from these pages,
+    exactly like the mmap fast path in weight_transfer.load_raw_params).
+    int8-wire leaves dequantize here (one float multiply per element,
+    cast back to the logical dtype). For a SHARD manifest the arrays are
+    the leaf's local shard (``shape`` is already the local shape) — the
+    engine device_puts them directly under its NamedSharding, so no
+    model-sized host buffer ever exists on a sharded server."""
     import ml_dtypes  # noqa: F401  registers bfloat16 et al. by name
     import numpy as np
-
-    from areal_tpu.system.weight_transfer import unflatten_leaves
 
     if not store.complete():
         raise WeightFetchError(
             f"assemble on incomplete store v{store.version}"
         )
     base = np.frombuffer(store.buf, dtype=np.uint8)
+
+    def view(off, nbytes, dtype, shape):
+        return base[off : off + nbytes].view(dtype).reshape(shape)
+
     leaves = {}
     for e in store.manifest["leaves"]:
         dt = np.dtype(e["dtype"])
-        n = int(np.prod(e["shape"], dtype=np.int64)) * dt.itemsize
-        leaves[e["path"]] = (
-            base[e["offset"] : e["offset"] + n].view(dt).reshape(e["shape"])
-        )
-    return unflatten_leaves(leaves), store.version
+        if e.get("wire", "raw") == "int8":
+            from areal_tpu.system.weight_transfer import dequantize_wire_leaf
+
+            q = view(e["offset"], int(e["nbytes"]), np.int8, e["shape"])
+            s = view(
+                int(e["scale_offset"]), int(e["scale_nbytes"]),
+                np.float32, e["scale_shape"],
+            )
+            leaves[e["path"]] = dequantize_wire_leaf(q, s, dt)
+        else:
+            nbytes = int(
+                e.get("nbytes")
+                or int(np.prod(e["shape"], dtype=np.int64)) * dt.itemsize
+            )
+            leaves[e["path"]] = view(e["offset"], nbytes, dt, e["shape"])
+    return leaves
+
+
+def assemble_params(store: ChunkStore) -> Tuple[Any, int]:
+    """A complete store's buffer as the (nested-dict) params pytree +
+    its version — full manifests yield full leaves; shard manifests
+    yield each leaf's LOCAL shard (see assemble_leaves)."""
+    from areal_tpu.system.weight_transfer import unflatten_leaves
+
+    return unflatten_leaves(assemble_leaves(store)), store.version

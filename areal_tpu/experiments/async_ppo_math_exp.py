@@ -145,6 +145,13 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         for r in (cfg.gen_server_roles or "").split(",")
     ]
     roles += ["unified"] * (cfg.n_generation_servers - len(roles))
+    # Shard-aware weight plane: per-server (rank, degree) fleet-TP
+    # coordinates (validated at config parse).
+    from areal_tpu.api.cli_args import parse_weight_shards
+
+    shards = parse_weight_shards(
+        cfg.gen_weight_shards, cfg.n_generation_servers
+    )
     gen_servers = [
         GenerationServerConfig(
             experiment_name=cfg.experiment_name,
@@ -170,6 +177,8 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             tensor_parallel=cfg.gen_tensor_parallel,
             role=roles[i],
             kv_handoff_compress=cfg.gen_kv_handoff_compress,
+            weight_shard_rank=shards[i][0] if shards[i] else None,
+            weight_shard_degree=shards[i][1] if shards[i] else None,
             seed=cfg.seed,
         )
         for i in range(cfg.n_generation_servers)
@@ -187,6 +196,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         weight_chunk_bytes=cfg.gen_weight_chunk_mb << 20,
         weight_fanout_degree=cfg.gen_weight_fanout,
         weight_cutover_budget_s=cfg.gen_weight_cutover_budget_s,
+        weight_wire_dtype=cfg.gen_weight_wire_dtype,
         elastic_pools=cfg.gen_elastic_pools,
         prefill_queue_high_tokens=cfg.gen_prefill_queue_high_tokens,
         prefill_queue_low_tokens=cfg.gen_prefill_queue_low_tokens,

@@ -88,13 +88,18 @@ def _moe_fsdp_fallback(name: str, ndim: int) -> Optional[P]:
     return None
 
 
-def _axis_size(mesh: Mesh, entry) -> int:
+def _axis_size(mesh, entry) -> int:
+    """Mesh-axis product for one PartitionSpec entry. ``mesh`` is a
+    jax Mesh OR a plain ``{axis: size}`` mapping — the latter keeps the
+    slice-resolution path (weight-plane shard manifests) usable without
+    constructing devices."""
     if entry is None:
         return 1
+    sizes = getattr(mesh, "shape", mesh)
     names = entry if isinstance(entry, tuple) else (entry,)
     size = 1
     for n in names:
-        size *= mesh.shape[n]
+        size *= sizes[n]
     return size
 
 
@@ -108,22 +113,85 @@ def fit_spec_to_shape(spec: P, shape, mesh: Mesh) -> P:
     return P(*fitted)
 
 
+def fitted_param_spec(path: str, shape, mesh) -> P:
+    """The PartitionSpec a parameter actually gets on this mesh: the
+    megatron-style rule spec, fitted to the shape (indivisible axes
+    dropped), with the MoE ZeRO fallback applied. ``mesh`` may be a jax
+    Mesh or an ``{axis: size}`` mapping (see ``_axis_size``) — the
+    SINGLE source of truth shared by ``param_shardings`` (device
+    placement) and the weight plane's shard manifests (byte slicing),
+    so what a shard manifest ships is exactly what the engine's
+    NamedSharding will place."""
+    spec = param_partition_spec(path, len(shape))
+    fitted = fit_spec_to_shape(spec, shape, mesh)
+    if len(spec) > 1 and spec[1] == "fsdp" and fitted[1] is None:
+        # Expert dim indivisible by fsdp: fall back to hidden-dim
+        # ZeRO sharding rather than replicating the expert weights.
+        alt = _moe_fsdp_fallback(path.split("/")[-1], len(shape))
+        if alt is not None:
+            fitted = fit_spec_to_shape(alt, shape, mesh)
+    return fitted
+
+
 def param_shardings(params: Params, mesh: Mesh) -> Params:
     """Pytree of NamedShardings matching `params`' structure."""
 
     def one(path, leaf):
-        ps = _path_str(path)
-        spec = param_partition_spec(ps, leaf.ndim)
-        fitted = fit_spec_to_shape(spec, leaf.shape, mesh)
-        if len(spec) > 1 and spec[1] == "fsdp" and fitted[1] is None:
-            # Expert dim indivisible by fsdp: fall back to hidden-dim
-            # ZeRO sharding rather than replicating the expert weights.
-            alt = _moe_fsdp_fallback(ps.split("/")[-1], leaf.ndim)
-            if alt is not None:
-                fitted = fit_spec_to_shape(alt, leaf.shape, mesh)
-        return NamedSharding(mesh, fitted)
+        return NamedSharding(
+            mesh, fitted_param_spec(_path_str(path), leaf.shape, mesh)
+        )
 
     return jax.tree_util.tree_map_with_path(one, params)
+
+
+def spec_slices(spec: P, shape, axis_sizes, coords):
+    """Per-dimension ``(start, stop)`` of one mesh coordinate's shard of
+    a row-major array under ``spec`` — pure integer math, mirroring
+    ``NamedSharding.devices_indices_map`` (tuple entries shard over the
+    product with the FIRST named axis varying slowest).
+
+    ``axis_sizes``: {axis: size}; ``coords``: {axis: coordinate}. The
+    caller passes a spec already fitted to the shape
+    (``fitted_param_spec``): every sharded dim must divide evenly."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for dim, entry in zip(shape, entries):
+        size = _axis_size(axis_sizes, entry)
+        if size == 1:
+            out.append((0, int(dim)))
+            continue
+        if dim % size != 0:
+            raise ValueError(
+                f"dim {dim} not divisible by mesh extent {size} "
+                f"for entry {entry!r} (spec not fitted?)"
+            )
+        names = entry if isinstance(entry, tuple) else (entry,)
+        c = 0
+        for n in names:
+            c = c * axis_sizes[n] + coords[n]
+        shard = dim // size
+        out.append((c * shard, (c + 1) * shard))
+    return out
+
+
+def leaf_shard_slices(path: str, shape, axis_sizes, coords):
+    """(start, stop) per dim of this mesh coordinate's shard of one
+    parameter, by pytree path — fitted spec + slice math in one step."""
+    return spec_slices(
+        fitted_param_spec(path, shape, axis_sizes), shape, axis_sizes, coords
+    )
+
+
+def tensor_shard_slices(path: str, shape, degree: int, rank: int):
+    """Shard slices for rank ``rank`` of a ``degree``-way TENSOR-parallel
+    group (the serving-mesh case: every other axis is 1). Replicated
+    leaves come back as full-extent slices — each rank fetches its own
+    copy of norms/biases, the small +ε on top of payload/TP."""
+    if degree < 1 or not (0 <= rank < degree):
+        raise ValueError(f"bad tensor shard rank {rank}/{degree}")
+    sizes = {"data": 1, "fsdp": 1, "seq": 1, "tensor": degree}
+    coords = {"data": 0, "fsdp": 0, "seq": 0, "tensor": rank}
+    return leaf_shard_slices(path, shape, sizes, coords)
 
 
 def shard_params(params: Params, mesh: Mesh) -> Params:

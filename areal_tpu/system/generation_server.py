@@ -147,6 +147,50 @@ class GenerationServer(Worker):
         self._last_kv_transfer_ms = 0.0
         self._handoff_session = None  # lazy aiohttp session (HTTP loop)
 
+        # Shard-aware weight plane: this server's coordinates in a
+        # fleet-level tensor-parallel group (None = fetch full
+        # payloads). The manager groups fanout trees by this spec —
+        # only same-shard peers hold the same chunk stream.
+        rank, degree = config.weight_shard_rank, config.weight_shard_degree
+        if (rank is None) != (degree is None):
+            raise ValueError(
+                "weight_shard_rank and weight_shard_degree must be set "
+                f"together (got {rank!r}/{degree!r})"
+            )
+        if degree is not None and not (degree >= 1 and 0 <= rank < degree):
+            raise ValueError(f"bad weight shard {rank}/{degree}")
+        self._weight_shard = (
+            (int(rank), int(degree)) if degree is not None else None
+        )
+        if self._weight_shard is not None and degree > 1:
+            # Fail at STARTUP, not after a full fleet transfer: a sliced
+            # cutover can only land when this process hosts exactly the
+            # mesh slice for its rank. A single-process mesh owns every
+            # tensor coordinate, so sliced fetch needs a multi-host
+            # (jax.distributed) deployment.
+            t_size = (
+                self.engine.mesh.shape.get("tensor", 1)
+                if self.engine.mesh is not None else 1
+            )
+            if t_size != degree:
+                raise ValueError(
+                    f"weight_shard {rank}/{degree} requires a tensor "
+                    f"mesh of extent {degree} (engine has {t_size}); "
+                    f"set tensor_parallel={degree}"
+                )
+            coords = set(
+                self.engine._addressable_tensor_coords().values()
+            )
+            if coords != {int(rank)}:
+                raise ValueError(
+                    f"weight_shard {rank}/{degree} requires this "
+                    f"process to host exactly tensor coordinate {rank} "
+                    f"of the mesh, but it hosts {sorted(coords)} — "
+                    f"sliced weight fetch needs a multi-host "
+                    f"(jax.distributed) mesh, one rank per server "
+                    f"process"
+                )
+
         # Weight-plane prefetch state machine: idle -> fetching -> ready
         # (-> failed). The store outlives its own cutover so this server
         # keeps serving chunks to later-wave siblings and to chaos
@@ -161,6 +205,13 @@ class GenerationServer(Worker):
         self._wp_bytes_from_peers = 0
         self._wp_chunks_served = 0
         self._wp_bytes_served = 0
+        # Shard-aware expectations for /metrics: a sliced fetch is
+        # complete at its SHARD bytes — dashboards must divide ingress
+        # by this, not the full payload, or every sliced fetch reads as
+        # a torn transfer.
+        self._wp_expected_bytes = 0
+        self._wp_ingress_eq = 0.0
+        self._wp_wire = "raw"
 
         # HTTP server on its own thread + loop.
         self._http_loop = asyncio.new_event_loop()
@@ -193,6 +244,10 @@ class GenerationServer(Worker):
         payload["url"] = self.address
         payload["server_index"] = self.cfg.server_index
         payload["role"] = self.role
+        if self._weight_shard is not None:
+            # (rank, degree): the manager plans per-shard fanout groups
+            # from this.
+            payload["weight_shard"] = list(self._weight_shard)
         return payload
 
     # ------------------------------------------------------------------
@@ -883,6 +938,26 @@ class GenerationServer(Worker):
         version = int(d["version"])
         upstreams = [u for u in (d.get("upstreams") or []) if u]
         origin = d.get("origin")
+        # A sharded server accepts exactly ITS shard's stream: fetching
+        # another rank's slice would waste a full shard of ingress and
+        # the cutover below could never place it.
+        man_shard = (d.get("manifest") or {}).get("shard") or {}
+        man_key = (
+            int(man_shard.get("tp_rank") or 0),
+            int(man_shard.get("tp_degree") or 1),
+        )
+        want_key = getattr(self, "_weight_shard", None) or (0, 1)
+        if man_key != want_key:
+            # Teach the caller our real spec: a manager whose shard map
+            # hasn't caught up yet (fanout racing the first heartbeat)
+            # corrects itself from this instead of evicting us.
+            return web.json_response(
+                {"success": False,
+                 "error": f"manifest shard {man_key} != server shard "
+                          f"{want_key}",
+                 "weight_shard": list(want_key)},
+                status=409,
+            )
         fetch_span = tracing.start_span(
             "server.weight_fetch",
             ctx=tracing.extract_from(d),
@@ -1024,6 +1099,9 @@ class GenerationServer(Worker):
                 self._wp_verify_ms = stats["verify_s"] * 1000.0
                 self._wp_bytes_from_origin = stats["bytes_from_origin"]
                 self._wp_bytes_from_peers = stats["bytes_from_peers"]
+                self._wp_expected_bytes = stats["expected_bytes"]
+                self._wp_ingress_eq = stats["ingress_payload_equivalents"]
+                self._wp_wire = stats.get("wire") or "raw"
         logger.info(
             f"weight-plane prefetch v{version}: "
             f"{stats['total_bytes']} bytes in {stats['fetch_s']:.3f}s "
@@ -1078,6 +1156,30 @@ class GenerationServer(Worker):
         n_running = self.engine.n_running
 
         def _cut():
+            shard = store.manifest.get("shard") or {}
+            degree = int(shard.get("tp_degree") or 1)
+            if degree > 1:
+                # Sliced manifest: the leaves ARE this rank's local
+                # shards — device_put them straight under the engine's
+                # NamedSharding (make_array path), no model-sized host
+                # assembly. Requires the engine's addressable mesh slice
+                # to be exactly this rank (multi-host TP); anything else
+                # fails loudly and the manager evicts/re-syncs.
+                from areal_tpu.engine.weight_client import assemble_leaves
+
+                rank = int(shard.get("tp_rank") or 0)
+                leaves = assemble_leaves(store)
+                gshapes = {
+                    e["path"]: tuple(e["global_shape"])
+                    for e in store.manifest["leaves"]
+                    if "global_shape" in e
+                }
+                return self.engine.cutover_shard_leaves(
+                    {rank: leaves}, degree, version=store.version,
+                    allow_interrupt=bool(d.get("allow_interrupt", True)),
+                    timeout_s=max(120.0, budget_s * 10.0),
+                    global_shapes=gshapes,
+                )
             params, v = assemble_params(store)
             return self.engine.cutover_params(
                 params, version=v,
@@ -1221,6 +1323,18 @@ class GenerationServer(Worker):
             f"areal:weight_bytes_from_peers {float(self._wp_bytes_from_peers)}",
             f"areal:weight_chunks_served {float(self._wp_chunks_served)}",
             f"areal:weight_bytes_served {float(self._wp_bytes_served)}",
+            # Shard-aware expectations: expected_bytes is THIS server's
+            # chunk stream size (shard slice and/or quantized wire), so
+            # ingress/expected reads 1.0 for a complete sliced fetch —
+            # never "incomplete" against the full payload.
+            f"areal:weight_expected_bytes {float(self._wp_expected_bytes)}",
+            f"areal:weight_ingress_payload_equivalents {self._wp_ingress_eq}",
+            f"areal:weight_wire {self._wp_wire}",
+            "areal:weight_shard "
+            + (
+                f"{self._weight_shard[0]}/{self._weight_shard[1]}"
+                if self._weight_shard else "-"
+            ),
         ]
         return web.Response(text="\n".join(lines) + "\n")
 

@@ -120,6 +120,10 @@ class GserverManager(Worker):
         self._server_roles: Dict[str, str] = {
             u: "unified" for u in self.server_urls
         }
+        # Shard-aware weight plane: url -> (tp_rank, tp_degree) from the
+        # heartbeat payload (None = unsharded). Fanout trees are planned
+        # per shard group — only same-shard peers hold the same stream.
+        self._server_shards: Dict[str, Optional[Tuple[int, int]]] = {}
         self._server_elastic: Dict[str, bool] = {}
         self._server_queued_toks = {u: 0.0 for u in self.server_urls}
         self._server_free_pages: Dict[str, float] = {}
@@ -504,6 +508,10 @@ class GserverManager(Worker):
             # our sizer's flip died with the old incarnation.
             self._server_roles.pop(old, None)
             self._server_roles[new] = "unified"
+            # Shard spec likewise refreshes from the new incarnation's
+            # first heartbeat (the config travels with the worker, but a
+            # stale entry must not route another rank's stream at it).
+            self._server_shards.pop(old, None)
             self._rerole_orig.pop(old, None)
             self._server_reqs.pop(old, None)
             self._server_reqs[new] = 0
@@ -548,6 +556,9 @@ class GserverManager(Worker):
             role = record.get("role")
             if role and url not in self._rerole_orig:
                 self._server_roles[url] = str(role)
+            shard = record.get("weight_shard")
+            if shard and len(shard) == 2:
+                self._server_shards[url] = (int(shard[0]), int(shard[1]))
         # Adoption: a member we have NEVER seen, beating at an address
         # outside the table — its previous incarnation died before we
         # observed it. It must be the restarted owner of some evicted
@@ -1058,15 +1069,25 @@ class GserverManager(Worker):
             )
         return self._own_source.address
 
-    def _fetch_plane_manifest(self, origin: str, version: int) -> Dict:
+    def _fetch_plane_manifest(
+        self, origin: str, version: int,
+        tp_degree: Optional[int] = None, tp_rank: Optional[int] = None,
+    ) -> Dict:
         """Pinned-version manifest from the origin, with a short retry:
-        model_version publication can race the dump landing on disk."""
+        model_version publication can race the dump landing on disk.
+        ``tp_degree``/``tp_rank`` request one shard group's sliced
+        stream; the configured ``weight_wire_dtype`` picks the
+        quantized companion stream when armed."""
         from areal_tpu.engine.weight_client import fetch_manifest
 
         deadline = time.monotonic() + 15.0
         while True:
             try:
-                return fetch_manifest(origin, version=version, timeout=5.0)
+                return fetch_manifest(
+                    origin, version=version, timeout=5.0,
+                    wire=getattr(self.cfg, "weight_wire_dtype", None),
+                    tp_degree=tp_degree, tp_rank=tp_rank,
+                )
             except Exception:
                 if time.monotonic() > deadline:
                     raise
@@ -1136,7 +1157,7 @@ class GserverManager(Worker):
         concurrently: one short interrupt window per server, measured
         separately from transfer."""
         faults.maybe_fail("manager.plane_fanout")
-        from areal_tpu.system.weight_plane import plan_fanout
+        from areal_tpu.system.weight_plane import group_by_shard, plan_fanout
 
         t_start = time.monotonic()
         version = self._new_version
@@ -1155,10 +1176,34 @@ class GserverManager(Worker):
         cutover_ms: Dict[str, float] = {}
         ready: List[str] = []
         try:
-            man = self._fetch_plane_manifest(origin, version)
-            waves = plan_fanout(
-                origin, targets, self.cfg.weight_fanout_degree
+            # Shard-aware fanout: servers holding the same (degree,
+            # rank) slice form a peer group with its OWN sliced chunk
+            # stream, fanout tree, and re-parent pool — a rank-0 holder
+            # can never feed a rank-1 fetcher. Unsharded fleets collapse
+            # to one (1, 0) group, byte-identical to the PR 5 behavior.
+            # Σ over groups of shard bytes ≈ one full payload, so the
+            # O(1)-origin invariant is preserved per version.
+            groups = group_by_shard(
+                targets, {u: self._server_shards.get(u) for u in targets}
             )
+            plans = {}  # key -> {"man", "waves", "ready": [urls]}
+            merged_waves: List[List[Tuple[str, str]]] = []
+            for key in sorted(groups):
+                degree, rank = key
+                man = self._fetch_plane_manifest(
+                    origin, version,
+                    tp_degree=degree if degree > 1 else None,
+                    tp_rank=rank if degree > 1 else None,
+                )
+                g_waves = plan_fanout(
+                    origin, groups[key], self.cfg.weight_fanout_degree
+                )
+                plans[key] = {"man": man, "waves": g_waves, "ready": []}
+                for i, w in enumerate(g_waves):
+                    while len(merged_waves) <= i:
+                        merged_waves.append([])
+                    merged_waves[i].extend((u, p, key) for u, p in w)
+            waves = merged_waves
 
             async def _run_wave(wave):
                 async with aiohttp.ClientSession(
@@ -1172,26 +1217,31 @@ class GserverManager(Worker):
                     )
                 ) as sess:
                     tasks = []
-                    for url, parent in wave:
-                        # Re-parent onto a surviving holder when the
-                        # planned parent never reached READY.
+                    for url, parent, key in wave:
+                        # Re-parent onto a surviving SAME-SHARD holder
+                        # when the planned parent never reached READY.
+                        g_ready = plans[key]["ready"]
                         eff = parent
-                        if eff != origin and eff not in ready:
-                            eff = ready[0] if ready else origin
+                        if eff != origin and eff not in g_ready:
+                            eff = g_ready[0] if g_ready else origin
                         upstreams = (
                             [eff]
-                            + [u for u in ready if u != eff][:2]
+                            + [u for u in g_ready if u != eff][:2]
                             + ([origin] if eff != origin else [])
                         )
                         tasks.append(self._post_distribute(
                             sess, url, eff,
-                            {"version": version, "manifest": man,
+                            {"version": version,
+                             "manifest": plans[key]["man"],
                              "upstreams": upstreams, "origin": origin,
                              "deadline_s": self.cfg.flush_request_timeout},
                             fanout_span,
                         ))
                     return await asyncio.gather(*tasks)
 
+            url_group = {
+                u: key for key, urls in groups.items() for u in urls
+            }
             for wave in waves:
                 # Each wave can take a full transfer; keep our lease.
                 self._beat()
@@ -1203,8 +1253,26 @@ class GserverManager(Worker):
                 ):
                     if ok:
                         ready.append(url)
+                        plans[url_group[url]]["ready"].append(url)
                         transfer_ms[url] = float(
                             body.get("transfer_ms") or 0.0
+                        )
+                    elif body.get("weight_shard"):
+                        # Shard-spec mismatch 409: OUR map was stale
+                        # (fanout raced the server's first heartbeat),
+                        # not a sick server. Learn the spec it reported
+                        # and leave it healthy — the next fanout plans
+                        # it into the right group.
+                        ws = body["weight_shard"]
+                        spec = (int(ws[0]), int(ws[1]))
+                        self._server_shards[url] = (
+                            None if spec == (0, 1) else spec
+                        )
+                        logger.warning(
+                            f"weight plane v{version}: {url} holds "
+                            f"shard {spec[0]}/{spec[1]}, not "
+                            f"{url_group[url]}; corrected for the "
+                            f"next fanout"
                         )
                     else:
                         failures[url] = f"prefetch failed: {body}"
@@ -1260,12 +1328,30 @@ class GserverManager(Worker):
             for u in successes:
                 self._server_versions[u] = version
             self.last_weight_sync_s = time.monotonic() - t_start
+            any_man = next(iter(plans.values()))["man"]
             self._wp_last = {
                 "version": version,
                 "origin": origin,
-                "tree": [[list(e) for e in w] for w in waves],
-                "total_bytes": int(man["total_bytes"]),
-                "n_chunks": int(man["n_chunks"]),
+                "tree": [[[u, p] for u, p, _ in w] for w in waves],
+                # Sum-of-streams view so the pair stays coherent:
+                # total_bytes / n_chunks describe what the origin serves
+                # per version across ALL groups (for an unsharded fleet
+                # that IS the full manifest, byte-identical to PR 5).
+                "total_bytes": sum(
+                    int(g["man"]["total_bytes"]) for g in plans.values()
+                ),
+                "n_chunks": sum(
+                    int(g["man"]["n_chunks"]) for g in plans.values()
+                ),
+                "wire": any_man.get("wire", "raw"),
+                "groups": {
+                    f"{key[1]}/{key[0]}": {
+                        "servers": list(urls),
+                        "shard_bytes": int(plans[key]["man"]["total_bytes"]),
+                        "n_chunks": int(plans[key]["man"]["n_chunks"]),
+                    }
+                    for key, urls in groups.items()
+                },
                 "transfer_ms": dict(transfer_ms),
                 "cutover_ms": dict(cutover_ms),
                 "failures": dict(failures),
@@ -1460,6 +1546,16 @@ class GserverManager(Worker):
                             self._server_elastic[u] = (
                                 float(line.split()[-1]) > 0.5
                             )
+                        elif line.startswith("areal:weight_shard "):
+                            # Second source besides the heartbeat: a
+                            # fanout racing a server's first beat must
+                            # not plan it into the unsharded group.
+                            tok = line.split()[-1]
+                            if "/" in tok:
+                                r_s, d_s = tok.split("/", 1)
+                                self._server_shards[u] = (
+                                    int(r_s), int(d_s)
+                                )
                         elif line.startswith("areal:kv_export_total"):
                             self._server_kv.setdefault(u, {})["exports"] = (
                                 float(line.split()[-1])

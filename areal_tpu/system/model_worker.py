@@ -556,15 +556,21 @@ class ModelWorker(Worker):
             # Match the sidecar's chunk size to the plane's knob so the
             # source serves the dump-time index instead of re-hashing.
             cb = getattr(self.cfg, "weight_chunk_bytes", 8 << 20)
+            # Quantized wire: the dump pass also publishes the int8
+            # companion bin the plane serves at ~half the bytes per
+            # version (weight_wire_dtype knob; servers dequantize).
+            wire = getattr(self.cfg, "weight_wire_dtype", None)
             dump_s = dump_raw_params(
-                params, d, version=model.version, chunk_bytes=cb
+                params, d, version=model.version, chunk_bytes=cb,
+                wire_dtype=wire,
             )
             shm = shm_transfer_dir(
                 self.cfg.experiment_name, self.cfg.trial_name, role
             )
             if shm is not None:
                 dump_s += dump_raw_params(
-                    params, shm, version=model.version, chunk_bytes=cb
+                    params, shm, version=model.version, chunk_bytes=cb,
+                    wire_dtype=wire,
                 )
             logger.info(
                 f"param_realloc dump for {role} step {step}: raw dump "

@@ -43,7 +43,12 @@ from areal_tpu.base import logging
 from areal_tpu.base.chunking import (
     CHUNK_SCHEMA,
     DEFAULT_CHUNK_BYTES,
+    StreamChunker,
     build_chunk_index,
+    chunk_spans,
+    gather_stream,
+    shard_stream_plan,
+    stream_prefix,
 )
 from areal_tpu.base.fault_injection import faults
 
@@ -80,22 +85,48 @@ def _sidecar_index(
 
 
 def chunk_manifest_for_dump(
-    dump_dir: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES
+    dump_dir: str, chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    wire: Optional[str] = None,
 ) -> Optional[Dict]:
     """Merge the dump's params.json with a content-hash chunk index.
     Returns None when no (complete, schema-matching) raw dump is present;
     retries once on the GC race (manifest read, bin unlinked, manifest
     replaced). The params.json read shares weight_transfer's reader so a
     future raw-dump schema bump is refused here exactly like the mmap
-    path refuses it — not chunked and distributed with misread layout."""
-    from areal_tpu.system.weight_transfer import _read_manifest
+    path refuses it — not chunked and distributed with misread layout.
+
+    ``wire="int8"`` returns the manifest of the quantized companion bin
+    (``params-v{N}.int8.bin``, written by dump_raw_params when the
+    ``weight_wire_dtype`` knob is armed): leaves carry the int8
+    data/scale layout and servers dequantize at assembly. None when the
+    dump was written without that wire."""
+    from areal_tpu.system.weight_transfer import (
+        _read_manifest,
+        read_layout_sidecar,
+        wire_bin_name,
+    )
 
     for _ in range(2):
         man = _read_manifest(dump_dir)
         if man is None:
             return None
-        try:
+        version = int(man["version"])
+        if wire not in (None, "raw", "model"):
+            # Quantized wire: the companion bin's layout sidecar is the
+            # source of truth for leaves AND total (params.json only
+            # describes the raw bin).
+            bin_name = wire_bin_name(version, wire)
+            layout = read_layout_sidecar(dump_dir, bin_name)
+            if layout is None or layout.get("wire") != wire:
+                return None
+            leaves = layout["leaves"]
+            want_total = int(layout["total_bytes"])
+        else:
+            wire = None
             bin_name = man["bin"]
+            leaves = man["leaves"]
+            want_total = man.get("total_bytes")
+        try:
             idx = _sidecar_index(dump_dir, bin_name, chunk_bytes)
             if idx is None:
                 idx = build_chunk_index(
@@ -105,15 +136,136 @@ def chunk_manifest_for_dump(
             continue
         except (OSError, ValueError, KeyError):
             return None
-        if idx["total_bytes"] != man.get("total_bytes"):
+        if idx["total_bytes"] != want_total:
             return None  # torn write (or a stale sidecar)
         return {
             **idx,
-            "version": int(man["version"]),
+            "version": version,
             "bin": bin_name,
-            "leaves": man["leaves"],
+            "wire": wire or "raw",
+            # The FULL payload of this wire: the denominator for both
+            # the origin's full_payload_equivalents and a sliced
+            # fetcher's ingress fraction.
+            "model_total_bytes": int(idx["total_bytes"]),
+            "leaves": leaves,
         }
     return None
+
+
+def _leaf_segments(leaf: Dict, slices) -> List[Dict]:
+    """shard_stream_plan segments for one layout leaf: the sliced data
+    slab, plus the sliced scale slab for int8-wire leaves (scales reduce
+    the quantization axis -2, so their slices drop that entry)."""
+    seg = {
+        "path": leaf["path"], "kind": "data", "offset": int(leaf["offset"]),
+        "shape": list(leaf["shape"]), "nbytes": int(leaf["nbytes"]),
+        "slices": [list(s) for s in slices],
+    }
+    if leaf.get("wire", "raw") == "raw":
+        return [seg]
+    scale_slices = [list(s) for s in slices]
+    del scale_slices[-2]
+    return [
+        seg,
+        {
+            "path": leaf["path"], "kind": "scales",
+            "offset": int(leaf["scale_offset"]),
+            "shape": list(leaf["scale_shape"]),
+            "nbytes": int(leaf["scale_nbytes"]),
+            "slices": scale_slices,
+        },
+    ]
+
+
+def _leaves_with_nbytes(leaves: List[Dict]) -> List[Dict]:
+    """Layout leaves with nbytes filled in (pre-sidecar dumps recorded
+    only dtype/shape/offset in params.json)."""
+    out = []
+    for e in leaves:
+        if "nbytes" in e:
+            out.append(e)
+            continue
+        import ml_dtypes  # noqa: F401  registers bfloat16 by name
+        import numpy as np
+
+        n = int(np.prod(e["shape"], dtype=np.int64)) if e["shape"] else 1
+        out.append(dict(e, nbytes=n * np.dtype(e["dtype"]).itemsize))
+    return out
+
+
+def shard_manifest_from_full(
+    man: Dict, tp_degree: int, tp_rank: int
+) -> Tuple[Dict, List[Tuple[int, int]]]:
+    """Slice a full (per-wire) manifest into one tensor-parallel rank's
+    shard manifest plus the bin gather ranges its chunk stream reads.
+
+    The shard's payload is a VIRTUAL stream: each leaf's shard slab
+    (and, for int8-wire leaves, its sliced scales) concatenated in leaf
+    order. It gets its OWN chunk grid and content hashes (filled in by
+    the caller, which streams the ranges once), so sliced transfer keeps
+    the full hash-authority discipline — sub-chunk Range reads against
+    the raw bin's hashes could never verify. Slices come from
+    parallel/sharding.py partition specs, i.e. exactly what the engine's
+    NamedSharding will place; replicated leaves (norms, biases) appear
+    in every rank's stream — the small +epsilon over payload/degree."""
+    from areal_tpu.parallel.sharding import tensor_shard_slices
+
+    segments = []
+    for leaf in _leaves_with_nbytes(man["leaves"]):
+        slices = tensor_shard_slices(
+            leaf["path"], leaf["shape"], tp_degree, tp_rank
+        )
+        segments.extend(_leaf_segments(leaf, slices))
+    plan = shard_stream_plan(segments)
+    by_path: Dict[str, Dict] = {}
+    order: List[str] = []
+    for seg in plan["segments"]:
+        path = seg["path"]
+        if path not in by_path:
+            order.append(path)
+        if seg["kind"] == "data":
+            by_path[path] = {
+                "path": path, "shape": seg["local_shape"],
+                "global_shape": seg["shape"], "slices": seg["slices"],
+                "offset": seg["local_offset"], "nbytes": seg["local_nbytes"],
+            }
+        else:
+            by_path[path].update(
+                scale_offset=seg["local_offset"],
+                scale_nbytes=seg["local_nbytes"],
+                scale_shape=seg["local_shape"],
+            )
+    for leaf in man["leaves"]:
+        e = by_path[leaf["path"]]
+        e["dtype"] = leaf["dtype"]
+        e["wire"] = leaf.get("wire", "raw")
+    shard_man = {
+        "schema": CHUNK_SCHEMA,
+        "version": int(man["version"]),
+        "bin": man["bin"],
+        "wire": man.get("wire", "raw"),
+        "shard": {"tp_degree": int(tp_degree), "tp_rank": int(tp_rank)},
+        "chunk_bytes": int(man["chunk_bytes"]),
+        "total_bytes": int(plan["total_bytes"]),
+        "n_chunks": len(chunk_spans(plan["total_bytes"], man["chunk_bytes"])),
+        "model_total_bytes": int(
+            man.get("model_total_bytes", man["total_bytes"])
+        ),
+        "hashes": [],  # caller fills from one pass over the ranges
+        "leaves": [by_path[p] for p in order],
+    }
+    return shard_man, plan["ranges"]
+
+
+def manifest_stream_key(man_or_query: Dict) -> Tuple[str, int, int]:
+    """(wire, tp_degree, tp_rank) identity of a chunk stream — the key
+    holders match requests against (a rank-0 peer must not serve rank-1
+    chunk indices: same version, different bytes)."""
+    wire = man_or_query.get("wire") or "raw"
+    shard = man_or_query.get("shard") or {}
+    degree = int(man_or_query.get("tp_degree") or shard.get("tp_degree") or 1)
+    rank = int(man_or_query.get("tp_rank") or shard.get("tp_rank") or 0)
+    return (str(wire), degree, rank)
 
 
 # ----------------------------------------------------------------------
@@ -147,6 +299,19 @@ def chunk_response(data: memoryview, start: int, chunk_hash: str) -> web.Respons
     )
 
 
+def _store_matches_query(store, query) -> bool:
+    """A holder serves exactly ONE chunk stream: its manifest's
+    (wire, shard) identity. A request for a different stream — or with
+    an unparseable one — 404s (the fetcher falls through to a
+    same-shard peer or the origin); rank-0 bytes must never satisfy
+    rank-1 chunk indices."""
+    try:
+        want = manifest_stream_key(dict(query))
+    except ValueError:
+        return False
+    return manifest_stream_key(store.manifest) == want
+
+
 def serve_store_manifest(store, request: web.Request) -> web.Response:
     """Shared /weights/manifest contract for ChunkStore holders
     (PeerStoreServer and the generation server's mounted handler)."""
@@ -157,6 +322,10 @@ def serve_store_manifest(store, request: web.Request) -> web.Response:
         return web.json_response({"error": "bad version"}, status=400)
     if store is None or (want_v is not None and store.version != want_v):
         return web.json_response({"error": "not holding"}, status=404)
+    if not _store_matches_query(store, request.query):
+        return web.json_response(
+            {"error": "holding a different chunk stream"}, status=404
+        )
     return web.json_response(store.manifest)
 
 
@@ -175,7 +344,12 @@ def serve_store_chunk(
             web.json_response({"error": "version/idx required"}, status=400),
             0,
         )
-    if store is None or store.version != version or not store.has(idx):
+    if (
+        store is None
+        or store.version != version
+        or not _store_matches_query(store, request.query)
+        or not store.has(idx)
+    ):
         return web.json_response({"error": "chunk not held"}, status=404), 0
     data = store.chunk(idx)
     start = parse_range_start(request)
@@ -250,7 +424,13 @@ class WeightPlaneSource(_PlaneHTTP):
         super().__init__(host=host)
         self.dump_dir = dump_dir
         self.chunk_bytes = chunk_bytes
-        self._man: Optional[Dict] = None
+        # Cached full manifests, one per wire ("raw" / "int8").
+        self._man: Dict[str, Optional[Dict]] = {}
+        # Cached shard streams: (version, wire, degree, rank) ->
+        # (manifest-with-hashes, bin gather ranges). Building one costs
+        # a single pass over the shard's bytes (slice + sha256); pruned
+        # to the two GC-live versions.
+        self._shards: Dict[Tuple[int, str, int, int], Tuple[Dict, List]] = {}
         self._lock = threading.Lock()
         # Serializes manifest (re)builds WITHOUT blocking chunk serving:
         # a rebuild may sha256 the whole bin (sidecar missing), and
@@ -260,10 +440,12 @@ class WeightPlaneSource(_PlaneHTTP):
         # Per-version egress counters (monotonic; survive re-dumps).
         self.chunks_served: Dict[int, int] = {}
         self.bytes_served: Dict[int, int] = {}
-        # Payload size per version served: full_payload_equivalents must
-        # divide each version's egress by ITS OWN total, not whichever
-        # manifest happens to be cached when stats() is read.
-        self._payload_bytes: Dict[int, int] = {}
+        # Egress + full-payload size per (version, wire): the O(1)-origin
+        # invariant divides each wire's egress by ITS OWN full payload
+        # (an int8 stream is ~half the raw bytes; shard streams sum to
+        # ~one full payload per TP group), then sums the wires.
+        self._bytes_by_wire: Dict[Tuple[int, str], int] = {}
+        self._full_by_wire: Dict[Tuple[int, str], int] = {}
 
     def routes(self, app: web.Application):
         app.router.add_get("/weights/manifest", self._h_manifest)
@@ -291,13 +473,15 @@ class WeightPlaneSource(_PlaneHTTP):
         except (OSError, ValueError, KeyError, json.JSONDecodeError):
             return None
 
-    def _cached_manifest(self, want_version: Optional[int]) -> Optional[Dict]:
+    def _cached_manifest(
+        self, want_version: Optional[int], wire: str
+    ) -> Optional[Dict]:
         """The cached chunk manifest, or None when it can't serve this
         request (absent, wrong pinned version, or — for an unpinned
         request, which wants the LATEST dump — lagging a newer version
         whose predecessor's bin may already be GC'd)."""
         with self._lock:
-            man = self._man
+            man = self._man.get(wire)
         if man is None:
             return None
         if want_version is None:
@@ -307,8 +491,10 @@ class WeightPlaneSource(_PlaneHTTP):
             return man
         return man if man["version"] == want_version else None
 
-    def _manifest(self, want_version: Optional[int]) -> Optional[Dict]:
-        man = self._cached_manifest(want_version)
+    def _manifest(
+        self, want_version: Optional[int], wire: str = "raw"
+    ) -> Optional[Dict]:
+        man = self._cached_manifest(want_version, wire)
         if man is not None:
             return man
         # A rebuild may hash the full bin (sidecar missing). Check the
@@ -318,62 +504,185 @@ class WeightPlaneSource(_PlaneHTTP):
         if want_version is not None and self._dump_version() != want_version:
             return None
         with self._build_lock:
-            man = self._cached_manifest(want_version)  # built while we waited
+            # built while we waited
+            man = self._cached_manifest(want_version, wire)
             if man is None:
-                man = chunk_manifest_for_dump(self.dump_dir, self.chunk_bytes)
+                man = chunk_manifest_for_dump(
+                    self.dump_dir, self.chunk_bytes,
+                    wire=None if wire == "raw" else wire,
+                )
                 if man is not None:
                     with self._lock:
-                        self._man = man
+                        self._man[wire] = man
         if man is None:
             return None
         if want_version is not None and man["version"] != want_version:
             return None
         return man
 
+    def _shard_stream(
+        self, want_version: Optional[int], wire: str, degree: int, rank: int
+    ) -> Optional[Tuple[Dict, List, List]]:
+        """(shard manifest, bin gather ranges, stream prefix sums) for
+        one TP rank's sliced stream, built (one slice+hash pass over the
+        shard's bytes) and cached per (version, wire, degree, rank)."""
+        full = self._manifest(want_version, wire)
+        if full is None:
+            return None
+        version = int(full["version"])
+        key = (version, wire, int(degree), int(rank))
+        with self._lock:
+            hit = self._shards.get(key)
+        if hit is not None:
+            return hit
+        with self._build_lock:
+            with self._lock:
+                hit = self._shards.get(key)
+            if hit is not None:
+                return hit
+            try:
+                man, ranges = shard_manifest_from_full(full, degree, rank)
+            except (ValueError, KeyError) as e:
+                logger.warning(
+                    f"shard manifest v{version} {wire} {rank}/{degree} "
+                    f"failed: {e!r}"
+                )
+                return None
+            chunker = StreamChunker(man["chunk_bytes"])
+            try:
+                with open(
+                    os.path.join(self.dump_dir, full["bin"]), "rb"
+                ) as f:
+                    for off, length in ranges:
+                        f.seek(off)
+                        remaining = length
+                        while remaining:
+                            piece = f.read(min(remaining, 4 << 20))
+                            if not piece:
+                                raise OSError("short read (GC race)")
+                            chunker.update(piece)
+                            remaining -= len(piece)
+            except OSError:
+                return None
+            idx = chunker.finish()
+            if idx["total_bytes"] != man["total_bytes"]:
+                return None
+            man["hashes"] = idx["hashes"]
+            entry = (man, ranges, stream_prefix(ranges))
+            with self._lock:
+                # Prune streams for GC'd versions (keep the two live).
+                for k in [k for k in self._shards if k[0] < version - 1]:
+                    del self._shards[k]
+                self._shards[key] = entry
+        return entry
+
+    @staticmethod
+    def _parse_stream_query(query) -> Tuple[Optional[int], str, int, int]:
+        want = query.get("version")
+        want_v = int(want) if want is not None else None
+        wire = query.get("wire") or "raw"
+        degree = int(query.get("tp_degree") or 1)
+        rank = int(query.get("tp_rank") or 0)
+        if degree < 1 or not (0 <= rank < degree):
+            raise ValueError(f"bad shard {rank}/{degree}")
+        return want_v, wire, degree, rank
+
     async def _h_manifest(self, request: web.Request) -> web.Response:
-        want = request.query.get("version")
         try:
-            want_v = int(want) if want is not None else None
+            want_v, wire, degree, rank = self._parse_stream_query(
+                request.query
+            )
         except ValueError:
-            return web.json_response({"error": "bad version"}, status=400)
-        # A cache miss sha256-hashes the whole bin (build_chunk_index):
-        # off the event loop, so pending chunk requests keep flowing.
-        man = await asyncio.get_running_loop().run_in_executor(
-            None, self._manifest, want_v
-        )
+            return web.json_response({"error": "bad stream query"}, status=400)
+        # A cache miss sha256-hashes the whole bin / shard stream
+        # (build_chunk_index): off the event loop, so pending chunk
+        # requests keep flowing.
+        if degree > 1:
+            got = await asyncio.get_running_loop().run_in_executor(
+                None, self._shard_stream, want_v, wire, degree, rank
+            )
+            man = got[0] if got else None
+        else:
+            man = await asyncio.get_running_loop().run_in_executor(
+                None, self._manifest, want_v, wire
+            )
         if man is None:
             return web.json_response(
-                {"error": "no dump for requested version", "retry_after": 0.2},
+                {"error": "no dump for requested stream", "retry_after": 0.2},
                 status=404,
             )
         return web.json_response(man)
 
+    def _count_egress(
+        self, version: int, wire: str, full_bytes: int, served: int
+    ) -> None:
+        with self._lock:
+            self.chunks_served[version] = (
+                self.chunks_served.get(version, 0) + 1
+            )
+            self.bytes_served[version] = (
+                self.bytes_served.get(version, 0) + served
+            )
+            self._bytes_by_wire[(version, wire)] = (
+                self._bytes_by_wire.get((version, wire), 0) + served
+            )
+            self._full_by_wire[(version, wire)] = full_bytes
+
     def _read_chunk(
-        self, version: int, idx: int, start: int
+        self, version: int, idx: int, start: int,
+        wire: str, degree: int, rank: int,
     ) -> web.Response:
         """Blocking part of /weights/chunk (manifest build + pread),
         run on an executor thread."""
-        man = self._manifest(version)
-        if man is None or not (0 <= idx < man["n_chunks"]):
-            return web.json_response({"error": "unknown chunk"}, status=404)
-        off = idx * man["chunk_bytes"]
-        length = min(man["chunk_bytes"], man["total_bytes"] - off)
-        # One pread per request off the page cache; the bin is mmap-hot
-        # on the dump host already (the shm/disk fast paths read it too).
-        try:
-            with open(os.path.join(self.dump_dir, man["bin"]), "rb") as f:
-                f.seek(off)
-                data = f.read(length)
-        except OSError:
-            return web.json_response({"error": "bin vanished (GC race)"}, status=404)
-        if len(data) != length:
-            return web.json_response({"error": "short read"}, status=404)
-        with self._lock:
-            self.chunks_served[version] = self.chunks_served.get(version, 0) + 1
-            self.bytes_served[version] = (
-                self.bytes_served.get(version, 0) + max(0, length - start)
-            )
-            self._payload_bytes[version] = man["total_bytes"]
+        if degree > 1:
+            got = self._shard_stream(version, wire, degree, rank)
+            if got is None:
+                return web.json_response({"error": "unknown stream"}, status=404)
+            man, ranges, prefix = got
+            if not (0 <= idx < man["n_chunks"]):
+                return web.json_response({"error": "unknown chunk"}, status=404)
+            off = idx * man["chunk_bytes"]
+            length = min(man["chunk_bytes"], man["total_bytes"] - off)
+            try:
+                with open(
+                    os.path.join(self.dump_dir, man["bin"]), "rb"
+                ) as f:
+
+                    def read_at(o, n):
+                        f.seek(o)
+                        return f.read(n)
+
+                    data = gather_stream(
+                        read_at, ranges, off, length, prefix=prefix
+                    )
+            except (OSError, ValueError):
+                return web.json_response(
+                    {"error": "bin vanished (GC race)"}, status=404
+                )
+        else:
+            man = self._manifest(version, wire)
+            if man is None or not (0 <= idx < man["n_chunks"]):
+                return web.json_response({"error": "unknown chunk"}, status=404)
+            off = idx * man["chunk_bytes"]
+            length = min(man["chunk_bytes"], man["total_bytes"] - off)
+            # One pread per request off the page cache; the bin is
+            # mmap-hot on the dump host already (the shm/disk fast paths
+            # read it too).
+            try:
+                with open(os.path.join(self.dump_dir, man["bin"]), "rb") as f:
+                    f.seek(off)
+                    data = f.read(length)
+            except OSError:
+                return web.json_response(
+                    {"error": "bin vanished (GC race)"}, status=404
+                )
+            if len(data) != length:
+                return web.json_response({"error": "short read"}, status=404)
+        self._count_egress(
+            version, wire,
+            int(man.get("model_total_bytes", man["total_bytes"])),
+            max(0, length - start),
+        )
         return chunk_response(memoryview(data), start, man["hashes"][idx])
 
     async def _h_chunk(self, request: web.Request) -> web.Response:
@@ -381,10 +690,12 @@ class WeightPlaneSource(_PlaneHTTP):
         try:
             version = int(request.query["version"])
             idx = int(request.query["idx"])
+            _, wire, degree, rank = self._parse_stream_query(request.query)
         except (KeyError, ValueError):
             return web.json_response({"error": "version/idx required"}, status=400)
         return await asyncio.get_running_loop().run_in_executor(
-            None, self._read_chunk, version, idx, parse_range_start(request)
+            None, self._read_chunk, version, idx,
+            parse_range_start(request), wire, degree, rank,
         )
 
     def stats(self) -> Dict:
@@ -394,13 +705,21 @@ class WeightPlaneSource(_PlaneHTTP):
                 "bytes_served": dict(self.bytes_served),
                 # Full-payload equivalents egressed per version: the
                 # number the O(1)-origin assertion is written against.
-                # Each version divides by its own payload size —
-                # payloads can differ across versions and the counters
-                # outlive the cached manifest.
+                # Each (version, wire)'s egress divides by that wire's
+                # OWN full payload (quantized streams are ~half the raw
+                # bytes; a TP group's shard streams sum to ~one full
+                # payload + the replicated-leaf epsilon), then wires
+                # sum per version. Counters outlive the cached manifest.
                 "full_payload_equivalents": {
-                    v: (b / self._payload_bytes[v]
-                        if self._payload_bytes.get(v) else 0.0)
-                    for v, b in self.bytes_served.items()
+                    v: sum(
+                        (b / self._full_by_wire[(vv, w)]
+                         if self._full_by_wire.get((vv, w)) else 0.0)
+                        for (vv, w), b in self._bytes_by_wire.items()
+                        if vv == v
+                    )
+                    for v in {vv for vv, _ in self._bytes_by_wire}
+                } or {
+                    v: 0.0 for v in self.bytes_served
                 },
             }
 
@@ -472,6 +791,30 @@ def plan_fanout(
 
 def fanout_edges(waves: List[List[Tuple[str, str]]]) -> List[Tuple[str, str]]:
     return [edge for wave in waves for edge in wave]
+
+
+def group_by_shard(
+    server_urls: List[str],
+    shards: Dict[str, Optional[Tuple[int, int]]],
+) -> Dict[Tuple[int, int], List[str]]:
+    """Partition servers into same-shard peer groups: key is
+    ``(tp_degree, tp_rank)`` (unsharded servers land in ``(1, 0)``).
+    Only same-shard peers hold the same chunk stream, so the fanout
+    tree — and mid-transfer re-parenting — is planned PER GROUP; the
+    origin still uploads each shard's bytes once, so fleet-wide cost
+    stays ~one full payload per version regardless of group count."""
+    groups: Dict[Tuple[int, int], List[str]] = {}
+    for u in server_urls:
+        spec = shards.get(u)
+        if spec is None:
+            key = (1, 0)
+        else:
+            rank, degree = int(spec[0]), int(spec[1])
+            if degree < 1 or not (0 <= rank < degree):
+                raise ValueError(f"bad shard {rank}/{degree} for {u}")
+            key = (degree, rank)
+        groups.setdefault(key, []).append(u)
+    return groups
 
 
 # ----------------------------------------------------------------------

@@ -39,6 +39,26 @@ logger = logging.getLogger("weight_transfer")
 _MANIFEST = "params.json"
 _SCHEMA = 1
 
+LAYOUT_SCHEMA = "areal-weight-layout/v1"
+
+# Quantized-wire convention (mirrors ops/wquant.py): symmetric int8 with
+# per-output-channel scales reduced over axis -2, w ~= q * s. Slicing any
+# dimension commutes with the dequant (s broadcasts along -2 only), so a
+# shard of the quantized bin dequantizes to exactly the shard of the
+# dequantized full bin — the property the weight plane's dequant-parity
+# check asserts.
+_WIRE_Q = 127.0
+_WIRE_QAXIS = -2
+
+# Leaf NAMES the int8 wire quantizes: the matmul weights + embedding/LM
+# head — the bulk of the payload. Kept in sync with ops/wquant._QUANT_KEYS
+# (weight_transfer stays jax-free, so no import); norms, biases, router
+# tables, and integer leaves ship raw — the small +epsilon of a dump.
+WIRE_QUANT_KEYS = frozenset({
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "w_in", "w_out",
+    "weight", "w",
+})
+
 
 class WeightVersionMismatch(RuntimeError):
     """load_for_serving found weights, but not the requested version.
@@ -77,21 +97,143 @@ def chunk_sidecar_name(bin_name: str) -> str:
     return bin_name[: -len(".bin")] + ".chunks.json"
 
 
+def layout_sidecar_name(bin_name: str) -> str:
+    """Per-leaf layout sidecar for a bin (``params-v{N}.layout.json``):
+    path -> dtype/shape -> byte extent. Makes each bin self-describing
+    (params.json only describes the NEWEST dump, but GC keeps two bins)
+    and is what the weight plane's shard manifests slice against."""
+    return bin_name[: -len(".bin")] + ".layout.json"
+
+
+def wire_bin_name(version: int, wire_dtype: str) -> str:
+    """The quantized-wire companion bin (``params-v{N}.int8.bin``)."""
+    return f"params-v{version}.{wire_dtype}.bin"
+
+
+def _wire_quantizable(path: str, arr: np.ndarray) -> bool:
+    """Leaves the int8 wire quantizes: float matrices (ndim >= 2) whose
+    leaf name marks a matmul weight / embedding (WIRE_QUANT_KEYS).
+    Everything else ships raw — the scale convention needs an input dim
+    and norm/bias precision is not worth trading for their few bytes."""
+    return (
+        arr.ndim >= 2
+        and path.split("/")[-1] in WIRE_QUANT_KEYS
+        and (
+            np.issubdtype(arr.dtype, np.floating)
+            or arr.dtype.name == "bfloat16"
+        )
+    )
+
+
+def quantize_wire_leaf(arr: np.ndarray):
+    """(int8 data, float32 scales) for one leaf under the wire
+    convention (see _WIRE_Q/_WIRE_QAXIS). Host-side numpy mirror of
+    ops/wquant.quantize_weight, bit-equal in convention so W8A16
+    serving could adopt wire-quantized leaves without requantizing."""
+    w32 = np.asarray(arr, dtype=np.float32)
+    s = np.maximum(np.max(np.abs(w32), axis=_WIRE_QAXIS), 1e-8) / _WIRE_Q
+    q = np.clip(
+        np.rint(w32 / np.expand_dims(s, _WIRE_QAXIS)), -_WIRE_Q, _WIRE_Q
+    ).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def dequantize_wire_leaf(q: np.ndarray, s: np.ndarray, dtype) -> np.ndarray:
+    """Inverse of quantize_wire_leaf, cast back to the logical dtype."""
+    return (
+        q.astype(np.float32) * np.expand_dims(s, _WIRE_QAXIS)
+    ).astype(dtype)
+
+
+def _write_json_atomic(dump_dir: str, name: str, payload: Dict) -> None:
+    tmp = os.path.join(dump_dir, name + f".tmp.{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dump_dir, name))
+
+
+def _dump_wire_bin(
+    dump_dir: str, version: int, wire_dtype: str,
+    leaves, chunk_bytes: int,
+) -> Dict[str, Any]:
+    """Write the quantized-wire companion bin + its chunk/layout
+    sidecars; returns the layout dict. Per leaf the int8 data slab is
+    immediately followed by its float32 scale slab, so a shard manifest
+    slices them as adjacent segments of one stream."""
+    if wire_dtype != "int8":
+        raise ValueError(f"unsupported weight_wire_dtype {wire_dtype!r}")
+    bin_name = wire_bin_name(version, wire_dtype)
+    layout: Dict[str, Any] = {
+        "schema": LAYOUT_SCHEMA, "version": int(version), "bin": bin_name,
+        "wire": wire_dtype, "leaves": [],
+    }
+    offset = 0
+    chunker = StreamChunker(chunk_bytes)
+    tmp_bin = os.path.join(dump_dir, bin_name + f".tmp.{os.getpid()}")
+    with open(tmp_bin, "wb") as f:
+
+        def put(data: bytes):
+            nonlocal offset
+            f.write(data)
+            chunker.update(data)
+            offset += len(data)
+
+        for path, leaf in leaves:
+            arr = np.ascontiguousarray(np.asarray(leaf))
+            entry: Dict[str, Any] = {
+                "path": path, "dtype": arr.dtype.name,
+                "shape": list(arr.shape), "offset": offset,
+            }
+            if _wire_quantizable(path, arr):
+                q, s = quantize_wire_leaf(arr)
+                entry.update(
+                    wire="int8", nbytes=q.nbytes,
+                    scale_offset=offset + q.nbytes, scale_nbytes=s.nbytes,
+                    scale_shape=list(s.shape), scale_dtype="float32",
+                )
+                put(q.tobytes())
+                put(s.tobytes())
+            else:
+                entry.update(wire="raw", nbytes=arr.nbytes)
+                put(arr.tobytes())
+            layout["leaves"].append(entry)
+        f.flush()
+        os.fsync(f.fileno())
+    layout["total_bytes"] = offset
+    os.replace(tmp_bin, os.path.join(dump_dir, bin_name))
+    _write_json_atomic(dump_dir, chunk_sidecar_name(bin_name), chunker.finish())
+    _write_json_atomic(dump_dir, layout_sidecar_name(bin_name), layout)
+    return layout
+
+
 def dump_raw_params(
     params: Any, dump_dir: str, version: int,
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    wire_dtype: Optional[str] = None,
 ) -> float:
     """Write the raw dump; returns seconds spent. Safe against concurrent
     readers (see module docstring); single writer assumed (the dp-rank-0
     dump rule, system/model_worker._param_realloc).
 
-    Also publishes a ``params-v{N}.chunks.json`` sidecar: the content
-    hashes of the bin's fixed-size chunks, computed while the bytes
-    stream through this loop anyway — the weight-plane origin serves its
-    chunk manifest from this instead of re-reading + re-hashing the
-    whole bin on every version bump (``chunk_bytes`` should match the
-    plane's ``weight_chunk_bytes`` knob; a mismatched sidecar is simply
-    ignored by the reader)."""
+    Also publishes per-bin sidecars the weight-distribution plane serves
+    from without re-reading the multi-GB bin:
+
+    - ``params-v{N}.chunks.json`` — content hashes of the bin's
+      fixed-size chunks, computed while the bytes stream through this
+      loop anyway (``chunk_bytes`` should match the plane's
+      ``weight_chunk_bytes`` knob; a mismatched sidecar is ignored).
+    - ``params-v{N}.layout.json`` — per-leaf path/dtype/shape/byte
+      extent, making the bin self-describing (params.json only describes
+      the newest dump while GC keeps two) and sliceable into per-shard
+      manifests.
+    - with ``wire_dtype="int8"``: ``params-v{N}.int8.bin`` + its own
+      sidecars — each float matrix leaf quantized to int8 data +
+      float32 per-output-channel scales (ops/wquant.py convention),
+      roughly halving bytes on the wire per version again; servers
+      dequantize at assembly.
+    """
     t0 = time.monotonic()
     os.makedirs(dump_dir, exist_ok=True)
     leaves = _flatten(params)
@@ -113,7 +255,8 @@ def dump_raw_params(
             # .str '<V2' which round-trips to a raw void type.
             manifest["leaves"].append(
                 {"path": path, "dtype": arr.dtype.name,
-                 "shape": list(arr.shape), "offset": offset}
+                 "shape": list(arr.shape), "offset": offset,
+                 "nbytes": arr.nbytes}
             )
             offset += arr.nbytes
         # fsync BEFORE the rename pair below: rename ordering alone is
@@ -125,28 +268,38 @@ def dump_raw_params(
         os.fsync(f.fileno())
     manifest["total_bytes"] = offset
     os.replace(tmp_bin, os.path.join(dump_dir, bin_name))
-    sidecar = chunk_sidecar_name(bin_name)
-    tmp_sc = os.path.join(dump_dir, sidecar + f".tmp.{os.getpid()}")
-    with open(tmp_sc, "w") as f:
-        json.dump(chunker.finish(), f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp_sc, os.path.join(dump_dir, sidecar))
-    tmp_man = os.path.join(dump_dir, _MANIFEST + f".tmp.{os.getpid()}")
-    with open(tmp_man, "w") as f:
-        json.dump(manifest, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp_man, os.path.join(dump_dir, _MANIFEST))
-    # GC old bins + their sidecars (keep the newest 2 so an in-flight
-    # reader can finish).
-    bins = sorted(
-        (b for b in os.listdir(dump_dir)
-         if b.startswith("params-v") and b.endswith(".bin")),
-        key=lambda b: int(b[len("params-v"):-len(".bin")]),
+    _write_json_atomic(dump_dir, chunk_sidecar_name(bin_name), chunker.finish())
+    _write_json_atomic(
+        dump_dir, layout_sidecar_name(bin_name),
+        {"schema": LAYOUT_SCHEMA, "version": int(version), "bin": bin_name,
+         "wire": "raw", "total_bytes": offset,
+         "leaves": [dict(e, wire="raw") for e in manifest["leaves"]]},
     )
-    for b in bins[:-2]:
-        for victim in (b, chunk_sidecar_name(b)):
+    if wire_dtype not in (None, "model", "raw"):
+        # Quantize during the dump pass (before the manifest lands), so
+        # a reader that sees params.json advertise the wire can rely on
+        # the wire bin existing for that version.
+        wire_layout = _dump_wire_bin(
+            dump_dir, version, wire_dtype, leaves, chunk_bytes
+        )
+        manifest["wire_dtypes"] = [wire_dtype]
+        manifest["wire_total_bytes"] = {
+            wire_dtype: wire_layout["total_bytes"]
+        }
+    _write_json_atomic(dump_dir, _MANIFEST, manifest)
+    # GC old versions (bins + every sidecar/wire companion; keep the
+    # newest 2 so an in-flight reader can finish).
+    versions = set()
+    for b in os.listdir(dump_dir):
+        if b.startswith("params-v") and b.endswith(".bin"):
+            v = b[len("params-v"):-len(".bin")].split(".", 1)[0]
+            if v.isdigit():
+                versions.add(int(v))
+    for v in sorted(versions)[:-2]:
+        victims = []
+        for b in (f"params-v{v}.bin", wire_bin_name(v, "int8")):
+            victims += [b, chunk_sidecar_name(b), layout_sidecar_name(b)]
+        for victim in victims:
             try:
                 os.unlink(os.path.join(dump_dir, victim))
             except OSError:
@@ -165,6 +318,21 @@ def unflatten_leaves(leaves: Dict[str, np.ndarray]) -> Any:
             node = node.setdefault(p, {})
         node[parts[-1]] = arr
     return root
+
+
+def read_layout_sidecar(
+    dump_dir: str, bin_name: str
+) -> Optional[Dict[str, Any]]:
+    """The bin's layout sidecar, or None when absent/malformed (callers
+    synthesize a raw layout from params.json for pre-sidecar dumps)."""
+    try:
+        with open(os.path.join(dump_dir, layout_sidecar_name(bin_name))) as f:
+            layout = json.load(f)
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+    if layout.get("schema") != LAYOUT_SCHEMA:
+        return None
+    return layout
 
 
 def _read_manifest(dump_dir: str) -> Optional[Dict[str, Any]]:

@@ -39,6 +39,15 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "weight_update_ms", "weight_transfer_ms", "weight_cutover_ms",
         "origin_full_payloads",
     ),
+    # Quantized-wire evidence without its dequant-parity check field is
+    # not evidence: a record could bank a great ingress number off a
+    # stream that assembles to garbage weights.
+    "weight_plane_sharded": (
+        "full_payload_bytes", "tp1_ingress_frac", "tp2_ingress_frac",
+        "tp2_int8_ingress_frac", "origin_full_payloads",
+        "replica_bytes_from_origin",
+        "dequant_parity_ok", "dequant_max_abs_err",
+    ),
     "serving_openloop": (
         "capacity_rps",
         "overload_offered_rps",
@@ -140,6 +149,52 @@ def _validate_openloop_sweep(val: Dict) -> List[str]:
     return problems
 
 
+def _num(val: Dict, key: str):
+    v = val.get(key)
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def _validate_sharded_plane(val: Dict) -> List[str]:
+    """The sharded-plane phase exists to show ingress SHRINKING: with
+    the TP degree (each server fetches only its slice) and again with
+    the quantized wire. A record where it doesn't — or whose quantized
+    stream failed the dequant-parity check, or whose same-shard replica
+    leaned on the origin — is refused, not published."""
+    problems: List[str] = []
+    tp1, tp2 = _num(val, "tp1_ingress_frac"), _num(val, "tp2_ingress_frac")
+    tpq = _num(val, "tp2_int8_ingress_frac")
+    if tp1 is not None and tp2 is not None and tp2 >= tp1 * 0.75:
+        problems.append(
+            f"weight_plane_sharded: per-server ingress does not shrink "
+            f"with TP degree (tp1 {tp1:.3f} -> tp2 {tp2:.3f})"
+        )
+    if tp2 is not None and tpq is not None and tpq >= tp2 * 0.75:
+        problems.append(
+            f"weight_plane_sharded: quantized wire does not shrink "
+            f"ingress (tp2 {tp2:.3f} -> int8 {tpq:.3f})"
+        )
+    if _num(val, "dequant_parity_ok") != 1:
+        problems.append(
+            "weight_plane_sharded: quantized-wire record failed (or "
+            "lacks) the dequant-parity check"
+        )
+    rep = _num(val, "replica_bytes_from_origin")
+    if rep is not None and rep > 0:
+        problems.append(
+            f"weight_plane_sharded: same-shard replica pulled "
+            f"{rep:.0f} bytes from the origin — peer serving degraded"
+        )
+    if (
+        _num(val, "decode_parity_checked") == 1
+        and _num(val, "decode_parity_ok") != 1
+    ):
+        problems.append(
+            "weight_plane_sharded: sharded-cutover greedy decode "
+            "diverged from the unsharded baseline"
+        )
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -160,6 +215,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
             f"{name}: origin served {ofp:.2f} full payloads — peer "
             f"fanout silently degraded to an origin broadcast"
         )
+    if name == "weight_plane_sharded":
+        problems.extend(_validate_sharded_plane(val))
     if name == "serving_openloop":
         problems.extend(_validate_openloop_sweep(val))
     if name == "serving_disagg":

@@ -141,12 +141,26 @@ def test_engine_all_three_serving_extensions(params):
         eng.stop()
 
 
-def test_engine_rejects_tp_with_int8_weights(params):
+def test_engine_accepts_tp_with_int8_weights(params):
+    """int8 decode weights under a TP mesh are SUPPORTED now (ISSUE 8):
+    construction must build the sharded quantized tree, and its values
+    must match the unsharded transform exactly (GSPMD placement cannot
+    change a code or scale — greedy-parity e2e lives in
+    tests/engine/test_wquant_tp.py)."""
+    import jax
+
     from areal_tpu.engine.serving import serving_mesh
 
-    with pytest.raises(ValueError, match="decode_weight_dtype"):
-        ServingEngine(CFG, params, decode_weight_dtype="int8",
-                      mesh=serving_mesh(2))
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device virtual CPU platform")
+    eng = ServingEngine(CFG, params, decode_weight_dtype="int8",
+                        mesh=serving_mesh(2))
+    ref = ServingEngine(CFG, params, decode_weight_dtype="int8")
+    assert eng._qparams is not None
+    q_tp, s_tp = eng._qparams["head_q"]
+    q_ref, s_ref = ref._qparams["head_q"]
+    np.testing.assert_array_equal(np.asarray(q_tp), np.asarray(q_ref))
+    np.testing.assert_array_equal(np.asarray(s_tp), np.asarray(s_ref))
 
 
 def test_bad_dtype_rejected(params):

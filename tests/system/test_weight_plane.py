@@ -376,9 +376,12 @@ class _SlowSource(WeightPlaneSource):
 class _DistributeHarness(_PlaneHTTP):
     """A real GenerationServer's /distribute_weights handler mounted on
     a bare HTTP server — the prefetch state machine without the engine
-    (cutover paths are covered by test_weight_plane_e2e.py)."""
+    (cutover paths are covered by test_weight_plane_e2e.py). ``shard``
+    = (rank, degree) makes it a shard-configured 'fake-device server':
+    it accepts exactly its slice's chunk stream and serves it to
+    same-shard siblings over the mounted /weights peer hop."""
 
-    def __init__(self):
+    def __init__(self, shard=None):
         super().__init__()
         import threading
         import types
@@ -396,6 +399,10 @@ class _DistributeHarness(_PlaneHTTP):
         srv._wp_bytes_from_peers = 0
         srv._wp_chunks_served = 0
         srv._wp_bytes_served = 0
+        srv._wp_expected_bytes = 0
+        srv._wp_ingress_eq = 0.0
+        srv._wp_wire = "raw"
+        srv._weight_shard = shard
         srv.engine = types.SimpleNamespace(version=0, n_running=0)
         self.srv = srv
 
@@ -403,6 +410,10 @@ class _DistributeHarness(_PlaneHTTP):
         app.router.add_post(
             "/distribute_weights", self.srv._h_distribute_weights
         )
+        app.router.add_get(
+            "/weights/manifest", self.srv._h_weights_manifest
+        )
+        app.router.add_get("/weights/chunk", self.srv._h_weights_chunk)
 
 
 def _post_json(url, payload, timeout=60.0):
@@ -528,6 +539,220 @@ def test_superseded_fetch_does_not_clobber_stats(tmp_path):
         harness.close()
         fast.close()
         slow.close()
+
+
+# ----------------------------------------------------------------------
+# Shard-aware + quantized wire (ISSUE 8)
+# ----------------------------------------------------------------------
+
+
+def test_group_by_shard_partitions_and_validates():
+    from areal_tpu.system.weight_plane import group_by_shard
+
+    groups = group_by_shard(
+        ["u0", "u1", "u2", "u3"],
+        {"u0": (0, 2), "u1": (1, 2), "u2": (0, 2), "u3": None},
+    )
+    assert groups == {(2, 0): ["u0", "u2"], (2, 1): ["u1"], (1, 0): ["u3"]}
+    with pytest.raises(ValueError, match="bad shard"):
+        group_by_shard(["u"], {"u": (2, 2)})
+
+
+def _tiny_model():
+    import jax
+
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+        intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+        param_dtype="float32",
+    )
+    mk = lambda seed: jax.tree_util.tree_map(  # noqa: E731
+        np.asarray, init_params(cfg, jax.random.PRNGKey(seed))
+    )
+    return cfg, mk
+
+
+def _greedy(eng, ids, n=8):
+    import queue as _q
+
+    from areal_tpu.engine.serving import GenRequest
+
+    q = _q.Queue()
+    eng.submit(GenRequest(
+        qid="q", input_ids=list(ids), max_new_tokens=n, greedy=True,
+        done_cb=q.put,
+    ))
+    r = q.get(timeout=300)
+    assert r.error is None, r.error
+    return r.output_ids
+
+
+@pytest.mark.timeout(600)
+def test_sharded_pair_ingress_and_decode_parity(tmp_path):
+    """ISSUE 8 satellite: a 2-way-TP pair of fake-device servers each
+    ingresses <= ~0.5 + epsilon payloads per version (epsilon = the
+    replicated norm/bias leaves every rank carries), rank 1's stream is
+    servable peer-to-peer between same-shard holders, and a TP=2
+    ServingEngine cut over from the two sliced streams matches the
+    float unsharded engine's greedy decode token-for-token."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device virtual CPU platform")
+    from areal_tpu.engine.serving import ServingEngine, serving_mesh
+
+    cfg, mk = _tiny_model()
+    p_serve, p_boot = mk(9), mk(0)
+    d = str(tmp_path / "dump")
+    dump_raw_params(p_serve, d, version=1, chunk_bytes=1 << 12)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    servers = {r: _DistributeHarness(shard=(r, 2)).start() for r in (0, 1)}
+    engines = []
+    try:
+        full_bytes = fetch_manifest(src.address, version=1)["total_bytes"]
+        for r, harness in servers.items():
+            man = fetch_manifest(
+                src.address, version=1, tp_degree=2, tp_rank=r
+            )
+            body, status = _post_json(
+                f"{harness.address}/distribute_weights",
+                {"version": 1, "manifest": man,
+                 "upstreams": [src.address], "origin": src.address},
+            )
+            assert status == 200 and body["success"], body
+            st = harness.srv._wp_store
+            stats = st.stats(src.address)
+            # Each server fetched ONLY its slice: <= 0.5 + epsilon of
+            # the full payload, and complete by its own expectation.
+            assert stats["bytes_from_origin"] <= 0.55 * full_bytes
+            assert stats["expected_bytes"] == man["total_bytes"]
+            assert stats["ingress_payload_equivalents"] == pytest.approx(1.0)
+        # Wrong-rank stream at a shard-configured server: 409, before
+        # any staging.
+        man0 = fetch_manifest(src.address, version=1, tp_degree=2, tp_rank=0)
+        body, status = _post_json(
+            f"{servers[1].address}/distribute_weights",
+            {"version": 1, "manifest": man0,
+             "upstreams": [src.address], "origin": src.address},
+        )
+        assert status == 409 and "shard" in body["error"]
+        # Same-shard peer hop: a rank-0 replica fed by the rank-0
+        # holder costs the origin nothing; total origin egress for the
+        # version stays ~1.0 full payloads.
+        rep = ChunkStore(man0)
+        rep_stats = rep.fetch(
+            [servers[0].address, src.address], origin=src.address
+        )
+        assert rep_stats["bytes_from_origin"] == 0
+        fpe = src.stats()["full_payload_equivalents"][1]
+        assert 1.0 <= fpe <= 1.1, fpe
+
+        # Decode parity: unsharded float baseline vs TP=2 engine cut
+        # over from the two sliced streams.
+        base = ServingEngine(
+            cfg, p_serve, max_batch_size=2, max_seq_len=128,
+            decode_block_steps=4, page_size=8, seed=0,
+        )
+        base.start()
+        engines.append(base)
+        want = _greedy(base, [5, 6, 7])
+
+        from areal_tpu.engine.weight_client import assemble_leaves
+
+        leaves_by_rank, gshapes = {}, {}
+        for r, harness in servers.items():
+            st = harness.srv._wp_store
+            leaves_by_rank[r] = assemble_leaves(st)
+            gshapes.update({
+                e["path"]: tuple(e["global_shape"])
+                for e in st.manifest["leaves"]
+            })
+        tp = ServingEngine(
+            cfg, p_boot, max_batch_size=2, max_seq_len=128,
+            decode_block_steps=4, page_size=8, seed=0,
+            mesh=serving_mesh(2),
+        )
+        tp.start()
+        engines.append(tp)
+        cut_s = tp.cutover_shard_leaves(
+            leaves_by_rank, 2, version=1, global_shapes=gshapes
+        )
+        assert cut_s < 120
+        assert _greedy(tp, [5, 6, 7]) == want
+    finally:
+        for e in engines:
+            e.stop()
+        for h in servers.values():
+            h.close()
+        src.close()
+
+
+def test_int8_wire_distribute_assembles_dequantized(tmp_path):
+    """Quantized wire end to end: the int8 stream is ~half the raw
+    bytes (bf16 leaves), the harness accepts and completes it, and
+    assembly dequantizes to exactly the host-side reference
+    (dequantize(quantize(w)) — slicing not involved here)."""
+    import ml_dtypes
+
+    from areal_tpu.engine.weight_client import assemble_params
+    from areal_tpu.system.weight_transfer import (
+        dequantize_wire_leaf, quantize_wire_leaf,
+    )
+
+    rng = np.random.default_rng(3)
+    params = {
+        "emb": {"w": rng.standard_normal((64, 32)).astype(ml_dtypes.bfloat16)},
+        "l0": {"wq": rng.standard_normal((4, 32, 32)).astype(ml_dtypes.bfloat16),
+               "norm": rng.standard_normal((4, 32)).astype(np.float32)},
+    }
+    d = str(tmp_path / "dump")
+    dump_raw_params(params, d, version=2, chunk_bytes=1 << 12,
+                    wire_dtype="int8")
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    harness = _DistributeHarness().start()
+    try:
+        raw_bytes = fetch_manifest(src.address, version=2)["total_bytes"]
+        man = fetch_manifest(src.address, version=2, wire="int8")
+        assert man["total_bytes"] < 0.75 * raw_bytes
+        body, status = _post_json(
+            f"{harness.address}/distribute_weights",
+            {"version": 2, "manifest": man,
+             "upstreams": [src.address], "origin": src.address},
+        )
+        assert status == 200 and body["success"], body
+        st = harness.srv._wp_store
+        assert harness.srv._wp_wire == "int8"
+        assert harness.srv._wp_expected_bytes == man["total_bytes"]
+        got, v = assemble_params(st)
+        assert v == 2
+        for path, orig in (
+            ("emb/w", params["emb"]["w"]),
+            ("l0/wq", params["l0"]["wq"]),
+        ):
+            node = got
+            for p in path.split("/"):
+                node = node[p]
+            assert node.dtype == orig.dtype
+            ref = dequantize_wire_leaf(
+                *quantize_wire_leaf(np.asarray(orig)), orig.dtype
+            )
+            np.testing.assert_array_equal(
+                np.asarray(node, np.float32), np.asarray(ref, np.float32)
+            )
+        # Norms ship raw: bit-exact.
+        np.testing.assert_array_equal(
+            np.asarray(got["l0"]["norm"]), params["l0"]["norm"]
+        )
+        # fpe divides by the WIRE's own payload: one int8 fetch == 1.0.
+        assert src.stats()["full_payload_equivalents"][2] == pytest.approx(
+            1.0
+        )
+    finally:
+        harness.close()
+        src.close()
 
 
 def test_peer_store_404s_chunks_it_does_not_hold(tmp_path):
