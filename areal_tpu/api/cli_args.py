@@ -160,6 +160,18 @@ class BaseExperimentConfig:
     # the single-host local launcher.
     allocation_mode: str = "d1"
     n_model_workers: int = 1
+    train_n_hosts: int = dataclasses.field(
+        default=1,
+        metadata={
+            "help": "host processes sharing ONE train mesh via "
+            "jax.distributed: each model worker becomes one host of the "
+            "train partition (coordinator elected through name_resolve, "
+            "parallel/distributed.setup_host_group), builds the GLOBAL "
+            "allocation_mode train mesh, and iterates the dataset in "
+            "lockstep (dp handled inside the mesh, not across workers). "
+            "1 = single-host (worker-local meshes, the default)"
+        },
+    )
     recover_mode: str = "disabled"  # disabled | auto | resume
     recover_retries: int = 1
     # Per-worker fault domain: serving-plane workers (generation server /

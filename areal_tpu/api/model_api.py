@@ -79,10 +79,12 @@ class TrainEngine(abc.ABC):
         loss_fn: Any,
         loss_weight_fn: Any,
         token_normalize_scope: str = "global",
-        version_steps: int = 0,
+        version_steps: Optional[int] = None,
         loss_name: str = "loss",
     ) -> Dict[str, float]:
-        """Run forward+backward+update over micro-batches; returns host stats."""
+        """Run forward+backward+update over micro-batches; returns host
+        stats. `version_steps` positions the LR schedule (None = the
+        engine's own step count); see JaxTrainEngine.train_batch."""
 
     @abc.abstractmethod
     def forward(

@@ -64,6 +64,13 @@ class ModelWorkerConfig:
     stream_dataset: bool = False
     n_pullers: int = 1
     shuffle_dataset: bool = True
+    # Multi-host sharded training: when > 1, this worker is ONE host of
+    # the train partition's jax.distributed world — it joins the host
+    # group (coordinator elected via name_resolve) BEFORE building any
+    # model, builds the global train mesh, and its mesh slice is
+    # verified at startup (parallel/distributed.verify_host_mesh_slice).
+    train_n_hosts: int = 1
+    train_host_rank: int = 0
     # Streaming weight-distribution plane: when True the dump rank
     # serves its raw-bin dumps over chunked HTTP and registers as the
     # fanout origin (system/weight_plane.WeightPlaneSource). Mirrors

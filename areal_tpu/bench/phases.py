@@ -153,6 +153,23 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="train_tflops_scaling",
+    entrypoint="areal_tpu.bench.workloads:train_tflops_scaling_phase",
+    priority=2,
+    est_compile_s=300.0,
+    est_measure_s=180.0,
+    min_window_s=60.0,
+    # Harmless on TPU (the flag only shapes the HOST platform); makes a
+    # CPU round bank a labeled 2-point sanity curve instead of nothing.
+    env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    description="Weak-scaling train curve 1->N chips: per-chip TFLOP/s "
+                "per power-of-2 FSDP mesh (batch grows with the mesh), "
+                "banked as points so scaling curves assemble across "
+                "rounds — the daemon spends the next real multi-chip "
+                "window here unattended",
+))
+
+register(PhaseSpec(
     name="gen_long_tps",
     entrypoint="areal_tpu.bench.workloads:gen_long_phase",
     priority=2,
@@ -249,6 +266,25 @@ register(PhaseSpec(
                 "invariant, dequant-parity, and greedy-decode parity "
                 "of a 2-way-TP engine cut over from sliced shard "
                 "streams (byte accounting is exact and "
+                "machine-independent; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
+    name="train_sharded",
+    entrypoint="areal_tpu.bench.workloads:train_sharded_phase",
+    priority=14,
+    est_compile_s=0.0,  # tiny CPU-mesh programs; the measure pass pays
+    est_measure_s=120.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    description="Sharded training end-to-end on a 2-fake-device mesh: "
+                "loss-trajectory parity single-device vs FSDP2 vs TP2, "
+                "per-mesh step-time breakdown, and the shard-local "
+                "trainer dump's host high-water reduction with a "
+                "byte-identical round trip through the weight-plane "
+                "origin (parity + byte accounting are exact and "
                 "machine-independent; CPU-proxy evidence)",
 ))
 

@@ -115,6 +115,30 @@ def build_report(
     if tr is not None and tr["status"] == "ok":
         for k, v in (tr["value"].get("overlap") or {}).items():
             report[f"train_{k}"] = round(float(v), 4)
+        # Per-chip derivation stays consistent with the phase: the
+        # banked train_tflops IS per-chip; surface the mesh it ran on.
+        if tr["value"].get("n_devices") is not None:
+            report["train_n_devices"] = int(tr["value"]["n_devices"])
+        if isinstance(tr["value"].get("mesh_shape"), dict):
+            report["train_mesh_shape"] = tr["value"]["mesh_shape"]
+
+    # 1->N scaling curve summary (full points stay in the record): the
+    # top-level block scaling dashboards read without opening records.
+    sc = measures.get("train_tflops_scaling")
+    if sc is not None and sc["status"] == "ok":
+        pts = sc["value"].get("points") or []
+        if pts:
+            report["train_scaling"] = {
+                "n_devices_max": pts[-1].get("n_devices"),
+                "per_chip_at_1": pts[0].get("train_tflops_per_chip"),
+                "per_chip_at_max": pts[-1].get("train_tflops_per_chip"),
+                "scaling_efficiency": round(
+                    float(sc["value"].get("scaling_efficiency", 0.0)), 4
+                ),
+                "driver_verified": bool(
+                    sc["attestation"].get("driver_verified", False)
+                ),
+            }
 
     # Default driver phases that never banked an ok measure -> partial.
     for spec in phases.default_phases():

@@ -226,6 +226,13 @@ def load_engine_state(engine, load_dir: str):
             ]
         engine.opt_state = jax.tree_util.tree_unflatten(treedef, restored)
     engine.version = int(state.get("version", 0))
+    if hasattr(engine, "_lr_steps"):
+        # The LR schedule position for callers that omit version_steps:
+        # pre-PR-9 it rode in opt_state's scale_by_schedule count (now a
+        # constant unit-LR schedule, see make_optimizer external_lr);
+        # resume it at the restored version so a recovery restart does
+        # not snap the schedule back to warmup start.
+        engine._lr_steps = int(state.get("version", 0))
     logger.info(f"loaded engine state from {load_dir}")
 
 

@@ -43,7 +43,11 @@ from areal_tpu.models.generation import generate_tokens
 from areal_tpu.models.packing import PackedBatch, pack_sequences
 from areal_tpu.models.transformer import forward as model_forward
 from areal_tpu.ops.loss import fused_next_token_logprobs
-from areal_tpu.engine.optimizer import OptimizerConfig, make_optimizer
+from areal_tpu.engine.optimizer import (
+    OptimizerConfig,
+    make_lr_schedule,
+    make_optimizer,
+)
 from areal_tpu.parallel.mesh import single_device_mesh
 from areal_tpu.parallel.sharding import batch_sharding, param_shardings
 
@@ -191,8 +195,20 @@ class JaxTrainEngine(TrainEngine):
         self.optimizer = None
         self.opt_state = None
         self._opt_shardings = None
+        self._lr_schedule = None
+        # LR-schedule position when callers do not pass version_steps
+        # (one optimizer step per train_batch, the pre-PR-9 behavior).
+        self._lr_steps = 0
         if optimizer_config is not None:
-            self.optimizer = make_optimizer(optimizer_config, total_train_steps)
+            # The optimizer applies a UNIT learning rate; the step
+            # programs scale updates by the schedule value evaluated at
+            # `version_steps` (see train_batch docstring).
+            self.optimizer = make_optimizer(
+                optimizer_config, total_train_steps, external_lr=True
+            )
+            self._lr_schedule = make_lr_schedule(
+                optimizer_config, total_train_steps
+            )
             opt_shape = jax.eval_shape(self.optimizer.init, self.params)
             self._opt_shardings = opt_state_shardings(opt_shape, self.params, self.mesh)
             self.opt_state = jax.jit(
@@ -348,7 +364,7 @@ class JaxTrainEngine(TrainEngine):
 
         mb_loss = self._mb_loss_fn(loss_fn)
 
-        def step(params, opt_state, rows, inv_denom):
+        def step(params, opt_state, rows, inv_denom, lr):
             if n_mbs > 1:
                 # rows: [n_mbs, R, T]; accumulate grads in fp32.
                 def body(grads_acc, mb_rows):
@@ -377,8 +393,11 @@ class JaxTrainEngine(TrainEngine):
             grads = jax.tree_util.tree_map(lambda g: g * inv_denom, grads)
             gnorm = optax_global_norm(grads)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            # The optimizer ran with a unit LR; scale by the schedule
+            # value for this version (multiplication commutes bitwise,
+            # so the math equals an internal-schedule adamw at this lr).
             params = jax.tree_util.tree_map(
-                lambda p, u: p + u.astype(p.dtype), params, updates
+                lambda p, u: p + (u * lr).astype(p.dtype), params, updates
             )
             params = jax.lax.with_sharding_constraint(params, self._param_shardings)
             opt_state = jax.lax.with_sharding_constraint(
@@ -450,13 +469,13 @@ class JaxTrainEngine(TrainEngine):
         if key in self._jit_cache:
             return self._jit_cache[key]
 
-        def apply(params, opt_state, carry, inv_denom):
+        def apply(params, opt_state, carry, inv_denom, lr):
             grads, loss_sum, aux = carry
             grads = jax.tree_util.tree_map(lambda g: g * inv_denom, grads)
             gnorm = optax_global_norm(grads)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = jax.tree_util.tree_map(
-                lambda p, u: p + u.astype(p.dtype), params, updates
+                lambda p, u: p + (u * lr).astype(p.dtype), params, updates
             )
             params = jax.lax.with_sharding_constraint(
                 params, self._param_shardings
@@ -547,7 +566,7 @@ class JaxTrainEngine(TrainEngine):
                 nxt.lower(params_sds, carry_sds, mb_sds).compile()
                 apply = self._apply_step_fn(loss_name)
                 apply.lower(
-                    params_sds, opt_sds, carry_sds, scalar_sds
+                    params_sds, opt_sds, carry_sds, scalar_sds, scalar_sds
                 ).compile()
                 compiled = 3
             else:
@@ -555,7 +574,7 @@ class JaxTrainEngine(TrainEngine):
                     loss_name, loss_fn, row_keys, len(mbs)
                 )
                 step.lower(
-                    params_sds, opt_sds, rows_sds, scalar_sds
+                    params_sds, opt_sds, rows_sds, scalar_sds, scalar_sds
                 ).compile()
                 compiled = 1
         except Exception as e:
@@ -660,7 +679,7 @@ class JaxTrainEngine(TrainEngine):
         loss_fn: PackedLossFn,
         loss_weight_fn: Callable[[SequenceSample], float],
         token_normalize_scope: str = "global",
-        version_steps: int = 0,
+        version_steps: Optional[int] = None,
         loss_name: str = "loss",
         dp_token_weights_fn=None,
     ) -> Dict[str, float]:
@@ -672,8 +691,18 @@ class JaxTrainEngine(TrainEngine):
         donated jitted program, lax.scan accumulation), which 'dp'
         normalization and serialized-dispatch CPU meshes use.
 
-        `version_steps` is accepted for TrainEngine API parity but the LR
-        schedule position is tracked by the optimizer's own step count.
+        `version_steps` is HONORED as the LR-schedule position (it was
+        previously accepted and silently ignored): the schedule value at
+        `version_steps` scales this step's updates, so e.g. every PPO
+        minibatch update of one version trains at that version's LR —
+        the reference's scheduler semantics — and a recovery restart
+        resumes the schedule at the restored version. Adam's bias
+        correction still counts actual optimizer updates. `None` (the
+        default) falls back to the engine's own train_batch count — the
+        pre-honoring behavior for callers that never pass it, resumed
+        at the restored version on checkpoint load
+        (engine/checkpoint.py) — and the applied value is reported as
+        `<loss_name>/lr`.
 
         `token_normalize_scope='dp'` reproduces the reference's per-rank
         normalization (mean over dp ranks of grad_r / tokens_r,
@@ -693,6 +722,9 @@ class JaxTrainEngine(TrainEngine):
             raise ValueError(
                 f"unknown token_normalize_scope {token_normalize_scope!r}"
             )
+        lr_pos = self._lr_steps if version_steps is None else int(version_steps)
+        self._lr_steps += 1
+        lr = float(self._lr_schedule(lr_pos))
         # The overlapped pipeline needs per-micro-batch programs; the
         # fused path keeps the single donated executable. 'dp' scope stays
         # fused (its per-shard denominators need every micro-batch's loss
@@ -708,7 +740,8 @@ class JaxTrainEngine(TrainEngine):
             mb_iter, groups, _, _ = input_.split_lazy(mb_spec)
             if len(groups) > 1:
                 return self._train_batch_overlapped(
-                    mb_iter, len(groups), loss_fn, loss_weight_fn, loss_name
+                    mb_iter, len(groups), loss_fn, loss_weight_fn, loss_name,
+                    lr,
                 )
             # One micro-batch: nothing to pipeline against; run eagerly.
             mbs = list(mb_iter)
@@ -756,11 +789,12 @@ class JaxTrainEngine(TrainEngine):
         self.params, self.opt_state, packed, aux = step(
             self.params, self.opt_state, rows_dev,
             jnp.asarray(1.0 / global_denom, jnp.float32),
+            jnp.asarray(lr, jnp.float32),
         )
         if self._serial_dispatch:
             jax.block_until_ready(self.params)
         return self._fetch_train_stats(
-            packed, aux, loss_name, global_denom, len(mbs)
+            packed, aux, loss_name, global_denom, len(mbs), lr
         )
 
     def _train_batch_overlapped(
@@ -770,6 +804,7 @@ class JaxTrainEngine(TrainEngine):
         loss_fn: PackedLossFn,
         loss_weight_fn: Callable[[SequenceSample], float],
         loss_name: str,
+        lr: float,
     ) -> Dict[str, float]:
         """Pipelined gradient accumulation: a background thread FFD-packs,
         pads-to-bucket and `device_put`s micro-batch i+1 while micro-batch
@@ -819,6 +854,7 @@ class JaxTrainEngine(TrainEngine):
         self.params, self.opt_state, packed, aux = apply(
             self.params, self.opt_state, carry,
             jnp.asarray(1.0 / global_denom, jnp.float32),
+            jnp.asarray(lr, jnp.float32),
         )
         self.last_overlap = {
             "packing_efficiency": n_tok / max(n_cells, 1),
@@ -827,7 +863,9 @@ class JaxTrainEngine(TrainEngine):
             "overlap_events": float(pf.overlap_count()),
         }
         self._record_overlap_stats()
-        return self._fetch_train_stats(packed, aux, loss_name, global_denom, n_mbs)
+        return self._fetch_train_stats(
+            packed, aux, loss_name, global_denom, n_mbs, lr
+        )
 
     def _record_overlap_stats(self):
         """Ship the last pipeline's telemetry through the stats tracker so
@@ -848,7 +886,8 @@ class JaxTrainEngine(TrainEngine):
         )
 
     def _fetch_train_stats(
-        self, packed, aux, loss_name: str, global_denom: float, n_mbs: int
+        self, packed, aux, loss_name: str, global_denom: float, n_mbs: int,
+        lr: float = 0.0,
     ) -> Dict[str, float]:
         """ONE host transfer for all scalars (each float() would be its own
         device round trip — expensive on remote-tunneled TPUs). `aux`
@@ -870,6 +909,7 @@ class JaxTrainEngine(TrainEngine):
             stats = dict(self._last_train_stats)
             stats[f"{loss_name}/n_tokens"] = global_denom
             stats[f"{loss_name}/n_mbs"] = float(n_mbs)
+            stats[f"{loss_name}/lr"] = lr  # host-side: exact even when stale
             stats[f"{loss_name}/stats_stale"] = 1.0
             return stats
         aux_leaves, aux_treedef = jax.tree_util.tree_flatten(aux)
@@ -882,6 +922,7 @@ class JaxTrainEngine(TrainEngine):
             f"{loss_name}/grad_norm": gnorm,
             f"{loss_name}/n_tokens": global_denom,
             f"{loss_name}/n_mbs": float(n_mbs),
+            f"{loss_name}/lr": lr,
         }
         for k, v in aux_vals.items():
             if k.startswith("mean:"):

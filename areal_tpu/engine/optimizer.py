@@ -59,11 +59,25 @@ def _decay_mask(params):
 
 
 def make_optimizer(
-    cfg: OptimizerConfig, total_train_steps: int, params_example=None
+    cfg: OptimizerConfig, total_train_steps: int, params_example=None,
+    external_lr: bool = False,
 ) -> optax.GradientTransformation:
+    """With ``external_lr=True`` the transformation applies a UNIT
+    learning rate (as a constant schedule, so the optimizer-state
+    structure — including the schedule's count leaf — stays identical to
+    the internal-schedule build and old checkpoints keep loading); the
+    caller scales the returned updates by the schedule value it wants.
+    This is how `JaxTrainEngine.train_batch` honors `version_steps` as
+    the LR-schedule position (reference semantics: several PPO minibatch
+    updates share one schedule step) while Adam's bias correction keeps
+    counting actual updates."""
     if cfg.type != "adamw":
         raise NotImplementedError(f"optimizer type {cfg.type!r}")
-    schedule = make_lr_schedule(cfg, total_train_steps)
+    schedule = (
+        optax.constant_schedule(1.0)
+        if external_lr
+        else make_lr_schedule(cfg, total_train_steps)
+    )
     tx = optax.chain(
         optax.clip_by_global_norm(cfg.gradient_clipping)
         if cfg.gradient_clipping

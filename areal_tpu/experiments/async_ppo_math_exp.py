@@ -103,10 +103,17 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
 
     workers = []
     for i in range(n_workers):
+        # The decoupled allocation's TRAIN partition (devices after the
+        # gen partition) drives the trainer mesh: fsdp/tensor axes from
+        # allocation_mode now reach the engine instead of being dropped.
+        t_mesh, t_devs = C.train_mesh_for_worker(cfg, i, n_workers)
         shards = [
             ModelShardSpec(
                 id=ModelShardID(actor, host_rank=i, n_hosts=n_workers),
-                model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+                model=C.model_abstraction(
+                    cfg.actor, cfg.tokenizer_path,
+                    mesh_spec=t_mesh, device_ids=t_devs,
+                ),
                 backend=C.backend_abstraction(cfg.actor, train=True),
                 interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
             )
@@ -116,7 +123,10 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             shards.append(
                 ModelShardSpec(
                     id=ModelShardID(ref, host_rank=i, n_hosts=n_workers),
-                    model=C.model_abstraction(ref_cfg, cfg.tokenizer_path),
+                    model=C.model_abstraction(
+                        ref_cfg, cfg.tokenizer_path,
+                        mesh_spec=t_mesh, device_ids=t_devs,
+                    ),
                     backend=C.backend_abstraction(ref_cfg, train=False),
                     interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
                 )

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from areal_tpu.api.cli_args import (
     BaseExperimentConfig,
@@ -28,19 +28,68 @@ from areal_tpu.parallel.mesh import AllocationMode
 
 
 def model_abstraction(m: ModelTrainEvalConfig, tokenizer_path: Optional[str],
-                      is_critic: bool = False) -> ModelAbstraction:
+                      is_critic: bool = False,
+                      mesh_spec: Optional[str] = None,
+                      device_ids: Optional[List[int]] = None,
+                      ) -> ModelAbstraction:
+    """``mesh_spec``/``device_ids`` (usually from ``train_mesh_for_worker``)
+    place the model on its slice of the allocation; an explicit per-model
+    ``m.mesh_spec`` always wins (the pre-PR-9 worker-local knob)."""
     args: Dict = dict(
         tokenizer_path=tokenizer_path or m.path,
         is_critic=is_critic or m.is_critic,
         dtype=m.dtype,
-        mesh_spec=m.mesh_spec,
+        mesh_spec=m.mesh_spec or mesh_spec,
     )
+    if m.mesh_spec is None and device_ids is not None:
+        args["device_ids"] = list(device_ids)
     if m.path and not m.init_from_scratch:
         args["model_path"] = m.path
     else:
         assert m.config is not None, "need model config for scratch init"
         args["config"] = dict(m.config)
     return ModelAbstraction("tpu_transformer", args=args)
+
+
+def train_mesh_for_worker(
+    cfg: BaseExperimentConfig, worker_index: int, n_workers: int
+) -> Tuple[Optional[str], Optional[List[int]]]:
+    """(mesh_spec, device_ids) for one model worker's slice of the
+    allocation's TRAIN partition — the system-layer wiring that makes
+    `allocation_mode` actually drive sharded training (previously only
+    the data axis was consumed, as the worker count; fsdp/tensor/seq
+    axes were silently dropped).
+
+    - Single-host (train_n_hosts == 1): the train data axis splits
+      across workers (each worker is one DP rank of the MFC layer, as
+      before); worker i gets a LOCAL (data/n_workers, fsdp, seq, tensor)
+      mesh over its contiguous device slice (offset past the gen
+      partition when the allocation is decoupled).
+    - Multi-host (train_n_hosts > 1): every worker-host builds the
+      GLOBAL train mesh over the jax.distributed world's devices
+      (device_ids None = all); DP happens inside the mesh.
+    - Returns (None, None) for single-device allocations or when the
+      data axis doesn't divide the worker count (legacy behavior:
+      single-device mesh per worker).
+    """
+    try:
+        alloc = AllocationMode.parse(cfg.allocation_mode)
+    except (ValueError, AttributeError):
+        return None, None
+    ts = alloc.train_spec
+    if ts.size <= 1:
+        return None, None
+    n_hosts = int(getattr(cfg, "train_n_hosts", 1) or 1)
+    if n_hosts > 1:
+        # One worker per host; the global mesh spans the distributed
+        # world's devices, so no per-worker device slice applies.
+        return str(ts), None
+    if ts.data % max(1, n_workers) != 0:
+        return None, None
+    local = dataclasses.replace(ts, data=ts.data // max(1, n_workers))
+    offset = alloc.gen_spec.size if alloc.decoupled else 0
+    start = offset + worker_index * local.size
+    return str(local), list(range(start, start + local.size))
 
 
 def backend_abstraction(m: ModelTrainEvalConfig, train: bool = True) -> ModelBackendAbstraction:
@@ -88,7 +137,11 @@ def worker_names(n: int) -> List[str]:
 
 def resolve_n_workers(cfg: BaseExperimentConfig) -> int:
     """The local single-host launcher maps the allocation's train data axis
-    onto model workers when n_model_workers is left at default."""
+    onto model workers when n_model_workers is left at default. With
+    train_n_hosts > 1 there is exactly one worker per host of the shared
+    jax.distributed train mesh."""
+    if int(getattr(cfg, "train_n_hosts", 1) or 1) > 1:
+        return int(cfg.train_n_hosts)
     if cfg.n_model_workers > 1:
         return cfg.n_model_workers
     try:
@@ -106,6 +159,12 @@ def base_model_worker(
     with_dataset: bool = True,
     stream_dataset: bool = False,
 ) -> ModelWorkerConfig:
+    # Multi-host SPMD training: every worker-host iterates the SAME
+    # dataset shard (dp_rank 0 of 1) so the hosts dispatch identical
+    # global programs in lockstep — DP happens inside the shared mesh,
+    # not across workers (training/multihost.py's contract, now at the
+    # system layer).
+    multihost = int(getattr(cfg, "train_n_hosts", 1) or 1) > 1
     return ModelWorkerConfig(
         experiment_name=cfg.experiment_name,
         trial_name=cfg.trial_name,
@@ -113,8 +172,10 @@ def base_model_worker(
         shards=shards,
         datasets=[dataset_abstraction(cfg.dataset)] if with_dataset else [],
         tokenizer_path=cfg.tokenizer_path,
-        dataset_dp_rank=index,
-        dataset_dp_size=n_workers,
+        dataset_dp_rank=0 if multihost else index,
+        dataset_dp_size=1 if multihost else n_workers,
+        train_n_hosts=int(getattr(cfg, "train_n_hosts", 1) or 1),
+        train_host_rank=index if multihost else 0,
         train_batch_size=cfg.train_batch_size,
         total_train_epochs=resolved_total_train_epochs(cfg),
         seed=cfg.seed,

@@ -171,10 +171,16 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
     iface_args = actor_interface_args(cfg)
     workers = []
     for i in range(n_workers):
+        # The allocation's train partition drives every jax engine on
+        # this worker (actor + colocated ref/critic share the slice).
+        t_mesh, t_devs = C.train_mesh_for_worker(cfg, i, n_workers)
         shards = [
             ModelShardSpec(
                 id=ModelShardID(actor, host_rank=i, n_hosts=n_workers),
-                model=C.model_abstraction(cfg.actor, cfg.tokenizer_path),
+                model=C.model_abstraction(
+                    cfg.actor, cfg.tokenizer_path,
+                    mesh_spec=t_mesh, device_ids=t_devs,
+                ),
                 backend=C.backend_abstraction(cfg.actor, train=True),
                 interface=ModelInterfaceAbstraction("ppo_actor", args=iface_args),
             ),
@@ -190,7 +196,10 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
             shards.append(
                 ModelShardSpec(
                     id=ModelShardID(ref, host_rank=i, n_hosts=n_workers),
-                    model=C.model_abstraction(ref_cfg, cfg.tokenizer_path),
+                    model=C.model_abstraction(
+                        ref_cfg, cfg.tokenizer_path,
+                        mesh_spec=t_mesh, device_ids=t_devs,
+                    ),
                     backend=C.backend_abstraction(ref_cfg, train=False),
                     interface=ModelInterfaceAbstraction(
                         "ppo_actor", args=iface_args
@@ -205,7 +214,8 @@ def build_ppo_math_experiment(cfg: PPOMATHExpConfig) -> ExperimentConfig:
                             ModelName("critic", replica), host_rank=i, n_hosts=n_workers
                         ),
                         model=C.model_abstraction(
-                            cfg.critic, cfg.tokenizer_path, is_critic=True
+                            cfg.critic, cfg.tokenizer_path, is_critic=True,
+                            mesh_spec=t_mesh, device_ids=t_devs,
                         ),
                         backend=C.backend_abstraction(
                             cfg.critic, train=(replica == 1)

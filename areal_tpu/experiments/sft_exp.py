@@ -28,10 +28,14 @@ def build_sft_experiment(cfg: SFTExpConfig) -> ExperimentConfig:
     )
     workers = []
     for i in range(n_workers):
+        mesh_spec, device_ids = C.train_mesh_for_worker(cfg, i, n_workers)
         shards = [
             ModelShardSpec(
                 id=ModelShardID(model_name, host_rank=i, n_hosts=n_workers),
-                model=C.model_abstraction(cfg.model, cfg.tokenizer_path),
+                model=C.model_abstraction(
+                    cfg.model, cfg.tokenizer_path,
+                    mesh_spec=mesh_spec, device_ids=device_ids,
+                ),
                 backend=C.backend_abstraction(cfg.model, train=True),
                 interface=ModelInterfaceAbstraction("sft"),
             )

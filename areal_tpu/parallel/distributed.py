@@ -65,3 +65,50 @@ def setup_host_group(
         group_name, host_rank, n_hosts, addr,
     )
     return HostGroupInfo(addr, host_rank, n_hosts)
+
+
+def verify_host_mesh_slice(mesh, host_rank: int, n_hosts: int) -> dict:
+    """Startup verification that this process hosts exactly its slice of
+    a train mesh — the training-side mirror of the serving fleet's
+    weight-shard check (generation_server: a sliced fetch must fail at
+    configure time, not after a full transfer). Returns a small summary
+    dict for logging; raises RuntimeError with an actionable message
+    when the mesh/process topology is inconsistent:
+
+    - the mesh spans a different number of processes than
+      ``n_hosts`` (e.g. a single-process fake-device mesh configured as
+      multi-host: ``jax.distributed`` never initialized on the peers);
+    - the processes' device contributions are uneven (a mesh slice must
+      be exactly 1/n_hosts of the devices);
+    - this process contributes no devices at all.
+    """
+    import jax
+
+    devs = list(mesh.devices.flat)
+    procs = sorted({d.process_index for d in devs})
+    if len(procs) != n_hosts:
+        raise RuntimeError(
+            f"train mesh spans {len(procs)} process(es) but "
+            f"train_n_hosts={n_hosts}: each host must contribute exactly "
+            f"its mesh slice. A single-process mesh cannot satisfy a "
+            f"multi-host config — did setup_host_group "
+            f"(jax.distributed.initialize) run on every host?"
+        )
+    mine = [d for d in devs if d.process_index == jax.process_index()]
+    if not mine:
+        raise RuntimeError(
+            f"host {host_rank}/{n_hosts} contributes no devices to the "
+            f"train mesh ({len(devs)} devices, processes {procs})"
+        )
+    if len(mine) * n_hosts != len(devs):
+        raise RuntimeError(
+            f"host {host_rank}/{n_hosts} hosts {len(mine)} of {len(devs)} "
+            f"mesh devices — not an even 1/{n_hosts} slice; the mesh "
+            f"shape must divide across hosts"
+        )
+    return {
+        "n_hosts": n_hosts,
+        "host_rank": host_rank,
+        "local_devices": len(mine),
+        "mesh_devices": len(devs),
+    }

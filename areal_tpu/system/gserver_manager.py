@@ -1077,18 +1077,56 @@ class GserverManager(Worker):
         model_version publication can race the dump landing on disk.
         ``tp_degree``/``tp_rank`` request one shard group's sliced
         stream; the configured ``weight_wire_dtype`` picks the
-        quantized companion stream when armed."""
+        quantized companion stream when armed.
+
+        When the quantized companion is unavailable for this version —
+        shard-local trainer dumps never publish it (the wire's scales
+        reduce an axis FSDP shards, weight_transfer.py), and legacy
+        dumps predate it — the fetch FALLS BACK to the raw wire rather
+        than failing every weight update: the client assembles whatever
+        wire the manifest declares, so raw is always safe, just more
+        bytes on the fanout."""
+        import urllib.error
+
         from areal_tpu.engine.weight_client import fetch_manifest
 
+        wire = getattr(self.cfg, "weight_wire_dtype", None)
         deadline = time.monotonic() + 15.0
         while True:
             try:
                 return fetch_manifest(
                     origin, version=version, timeout=5.0,
-                    wire=getattr(self.cfg, "weight_wire_dtype", None),
-                    tp_degree=tp_degree, tp_rank=tp_rank,
+                    wire=wire, tp_degree=tp_degree, tp_rank=tp_rank,
                 )
-            except Exception:
+            except Exception as e:
+                # Only a definitive MISS (origin answered 404) justifies
+                # probing raw: the dump writes the wire companion BEFORE
+                # the manifest, so a 404'd wire plus a fetchable RAW
+                # stream for this version proves the wire will never
+                # exist (sharded trainer dumps / legacy dumps) — fall
+                # back instead of burning the retry budget. Transient
+                # failures (timeouts, dropped connections) keep retrying
+                # the configured wire: downgrading on those would ship
+                # ~2x the bytes over the fanout for no reason.
+                wire_missing = (
+                    wire is not None
+                    and isinstance(e, urllib.error.HTTPError)
+                    and e.code == 404
+                )
+                if wire_missing:
+                    try:
+                        man = fetch_manifest(
+                            origin, version=version, timeout=5.0,
+                            tp_degree=tp_degree, tp_rank=tp_rank,
+                        )
+                        logger.warning(
+                            f"weight plane: no {wire!r}-wire stream for "
+                            f"v{version} (sharded trainer dumps publish "
+                            f"raw only); falling back to the raw wire"
+                        )
+                        return man
+                    except Exception:
+                        pass  # dump still landing: retry the wire
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.2)

@@ -67,6 +67,27 @@ class ModelWorker(Worker):
             config.experiment_name, config.trial_name, config.worker_name
         )
 
+        # Multi-host sharded training: join the train partition's host
+        # group BEFORE any model (or device) is touched — jax.distributed
+        # must initialize before the first backend acquires devices, and
+        # every host must rendezvous or the global mesh never forms.
+        self._train_group = None
+        if int(getattr(config, "train_n_hosts", 1) or 1) > 1:
+            from areal_tpu.parallel.distributed import setup_host_group
+
+            self._train_group = setup_host_group(
+                config.experiment_name,
+                config.trial_name,
+                "train",
+                config.train_host_rank,
+                config.train_n_hosts,
+            )
+            logger.info(
+                f"{config.worker_name}: joined train host group as "
+                f"{config.train_host_rank}/{config.train_n_hosts} "
+                f"(coordinator {self._train_group.coordinator_address})"
+            )
+
         # Datasets (only on data-hosting workers).
         self.dataloader = None
         self._dataset = None
@@ -127,6 +148,29 @@ class ModelWorker(Worker):
             model = make_model(shard.model, name=mn)
             backend = make_backend(shard.backend)
             model = backend.initialize(model, ft_spec)
+            # Startup verification that this process hosts exactly its
+            # slice of every multi-device train mesh (the training-side
+            # mirror of the serving fleet's weight-shard check): a
+            # misconfigured host must fail HERE with an actionable
+            # message, not deep inside the first collective.
+            mesh = getattr(model.module, "mesh", None)
+            if mesh is not None and mesh.size > 1:
+                from areal_tpu.parallel.distributed import (
+                    verify_host_mesh_slice,
+                )
+
+                info = verify_host_mesh_slice(
+                    mesh,
+                    getattr(config, "train_host_rank", 0),
+                    int(getattr(config, "train_n_hosts", 1) or 1),
+                )
+                logger.info(
+                    f"{config.worker_name}: {mn} mesh "
+                    f"{dict(mesh.shape)} verified — hosts "
+                    f"{info['local_devices']}/{info['mesh_devices']} "
+                    f"devices as slice {info['host_rank']}/"
+                    f"{info['n_hosts']}"
+                )
             self.models[str(mn)] = model
             self.backends[str(mn)] = backend
             self.interfaces[str(mn)] = make_interface(shard.interface)
@@ -519,34 +563,54 @@ class ModelWorker(Worker):
         realloc_root = constants.get_param_realloc_path(
             self.cfg.experiment_name, self.cfg.trial_name
         )
-        if (
-            src is not None
-            and src in self.models
-            and self._host_rank.get(src, 0) == 0
+        src_model = self.models.get(src) if src is not None else None
+        multi_proc = False
+        mesh_size = 1
+        if src_model is not None:
+            import jax
+
+            mesh = getattr(src_model.module, "mesh", None)
+            mesh_size = int(getattr(mesh, "size", 1) or 1)
+            multi_proc = any(
+                isinstance(l, jax.Array) and not l.is_fully_addressable
+                for l in jax.tree_util.tree_leaves(
+                    src_model.module.get_params()
+                )
+            )
+        # Single writer per shard: DP replicas hold identical logical
+        # params, so only rank 0 dumps — EXCEPT on a multi-process
+        # (jax.distributed) train mesh, where every process must write
+        # its own slab of the shard-local dump (rank 0 alone cannot even
+        # address the other hosts' shards).
+        if src_model is not None and (
+            self._host_rank.get(src, 0) == 0 or multi_proc
         ):
-            # Single writer: DP replicas hold identical logical params, so
-            # only rank 0 dumps (concurrent writers would tear the pickle).
-            model = self.models[src]
+            model = src_model
             role = ModelName.parse(src).role
             d = os.path.join(realloc_root, role)
             from areal_tpu.engine.checkpoint import save_engine_state
             from areal_tpu.system.weight_transfer import (
-                dump_raw_params, shm_transfer_dir,
+                LAST_DUMP_STATS, dump_raw_params, dump_raw_params_sharded,
+                mirror_dump_version, shm_transfer_dir,
             )
 
             import jax
 
-            # The realloc dump is a TRANSFER format, not a recover
-            # checkpoint: the destination reads engine_state.pkl
-            # directly (below) and this is a rank-0-only call — an
-            # orbax (collective, shard-wise) save here would deadlock
-            # multi-host and break the reader. Always pickle.
-            save_engine_state(model.module, d, backend="pickle")
-            # Raw mmap-able dumps for the generation servers: tmpfs
-            # same-host fast path + disk fallback (weight_transfer.py).
-            params = jax.tree_util.tree_map(
-                lambda x: np.asarray(x), model.module.get_params()
+            sharded = mesh_size > 1 or multi_proc
+            is_rank0 = (
+                self._host_rank.get(src, 0) == 0
+                and (not multi_proc or jax.process_index() == 0)
             )
+            if is_rank0 and not sharded:
+                # The realloc dump is a TRANSFER format, not a recover
+                # checkpoint: the destination reads engine_state.pkl
+                # directly (below) — an orbax (collective, shard-wise)
+                # save here would deadlock multi-host and break the
+                # reader. Sharded engines skip the pickle entirely: it
+                # would host-gather the full model (the exact cost the
+                # shard-local dump removes); a dst model falls back to
+                # assembling the raw dump (below).
+                save_engine_state(model.module, d, backend="pickle")
             # Stamp the dump with model.version — the exact value
             # _publish_version later announces — NOT the global step:
             # the two counters differ (step counts MFC dispatches from
@@ -560,21 +624,64 @@ class ModelWorker(Worker):
             # companion bin the plane serves at ~half the bytes per
             # version (weight_wire_dtype knob; servers dequantize).
             wire = getattr(self.cfg, "weight_wire_dtype", None)
-            dump_s = dump_raw_params(
-                params, d, version=model.version, chunk_bytes=cb,
-                wire_dtype=wire,
-            )
             shm = shm_transfer_dir(
                 self.cfg.experiment_name, self.cfg.trial_name, role
             )
-            if shm is not None:
-                dump_s += dump_raw_params(
-                    params, shm, version=model.version, chunk_bytes=cb,
+            if multi_proc:
+                # The tmpfs fast path is a SAME-HOST optimization; a
+                # multi-host dump's slabs would land on N different
+                # hosts' /dev/shm and no single origin could ever
+                # assemble the stream. Every reader (origin included)
+                # uses the shared disk dir instead.
+                shm = None
+            if sharded:
+                # Shard-local dump: each process writes only its
+                # addressable shard slabs — no whole-model host gather,
+                # host high-water ~1/mesh_size of the full payload; the
+                # weight-plane origin reassembles the identical byte
+                # stream from the slabs (weight_transfer.py).
+                raw = model.module.get_params()
+                pi = jax.process_index() if multi_proc else 0
+                pn = jax.process_count() if multi_proc else 1
+                dump_s = dump_raw_params_sharded(
+                    raw, d, version=model.version, chunk_bytes=cb,
+                    process_index=pi, n_processes=pn, wire_dtype=wire,
+                )
+                if is_rank0:
+                    # A pre-sharding run may have left engine_state.pkl
+                    # in this dir; the dst realloc branch prefers it, so
+                    # a stale pickle would silently shadow every fresh
+                    # sharded dump after a mixed-mode restart.
+                    try:
+                        os.unlink(os.path.join(d, "engine_state.pkl"))
+                    except OSError:
+                        pass
+                if shm is not None:
+                    # Mirror the finished artifacts at the FILE level
+                    # (page-cache reads) — a second dump call would
+                    # re-materialize every shard off the device.
+                    dump_s += mirror_dump_version(d, shm, model.version)
+            else:
+                # Raw mmap-able dumps for the generation servers: tmpfs
+                # same-host fast path + disk fallback.
+                params = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x), model.module.get_params()
+                )
+                dump_s = dump_raw_params(
+                    params, d, version=model.version, chunk_bytes=cb,
                     wire_dtype=wire,
                 )
+                if shm is not None:
+                    dump_s += dump_raw_params(
+                        params, shm, version=model.version, chunk_bytes=cb,
+                        wire_dtype=wire,
+                    )
+            hw = LAST_DUMP_STATS.get("high_water_bytes", 0)
             logger.info(
-                f"param_realloc dump for {role} step {step}: raw dump "
-                f"v{model.version} {dump_s:.3f}s "
+                f"param_realloc dump for {role} step {step}: "
+                f"{'shard-local ' if sharded else ''}raw dump "
+                f"v{model.version} {dump_s:.3f}s host-high-water "
+                f"{hw / float(1 << 20):.1f}MiB "
                 f"(shm={'yes' if shm is not None else 'no'})"
             )
             # Streaming weight-distribution plane: the dump rank exposes
@@ -585,14 +692,21 @@ class ModelWorker(Worker):
             # (page-cache-hot either way); armed by the experiment's
             # gen_weight_plane knob or the AREAL_WEIGHT_PLANE env gate,
             # so legacy deployments keep zero extra listeners.
-            if getattr(self.cfg, "weight_plane", False) or os.environ.get(
-                "AREAL_WEIGHT_PLANE"
+            if is_rank0 and (
+                getattr(self.cfg, "weight_plane", False)
+                or os.environ.get("AREAL_WEIGHT_PLANE")
             ):
                 self._ensure_weight_plane_source(role, shm or d)
-            tmp = os.path.join(d, "step.txt.tmp")
-            with open(tmp, "w") as f:
-                f.write(str(step))
-            os.replace(tmp, os.path.join(d, "step.txt"))
+            if is_rank0:
+                # One stamp writer: non-zero slab ranks of a multi-host
+                # mesh dumped above but must not publish step.txt (a
+                # reader could race a stamp ahead of missing slabs; the
+                # slab-completeness check in DumpStreamReader is the
+                # backstop either way).
+                tmp = os.path.join(d, "step.txt.tmp")
+                with open(tmp, "w") as f:
+                    f.write(str(step))
+                os.replace(tmp, os.path.join(d, "step.txt"))
         if dst is not None and dst in self.models:
             model = self.models[dst]
             role = ModelName.parse(dst).role
@@ -617,6 +731,31 @@ class ModelWorker(Worker):
             # Only params move; optimizer state stays local.
             import pickle
 
-            with open(os.path.join(d, "engine_state.pkl"), "rb") as f:
-                state = pickle.load(f)
-            model.module.set_params(state["params"])
+            pkl = os.path.join(d, "engine_state.pkl")
+            if os.path.exists(pkl):
+                with open(pkl, "rb") as f:
+                    state = pickle.load(f)
+                model.module.set_params(state["params"])
+            else:
+                # Sharded trainer source: no pickle was written (it
+                # would host-gather the full model). Assemble the full
+                # tree from the shard-local raw dump instead — with a
+                # bounded retry: the step.txt stamp only proves rank 0
+                # dumped, while peer hosts' slabs can still be landing
+                # on shared storage (load_raw_params reads a
+                # slab-incomplete dump as absent by design).
+                from areal_tpu.system.weight_transfer import load_raw_params
+
+                got = None
+                fallback_deadline = _time.monotonic() + 60
+                while got is None:
+                    got = load_raw_params(d)
+                    if got is not None:
+                        break
+                    if _time.monotonic() > fallback_deadline:
+                        raise FileNotFoundError(
+                            f"param_realloc: neither engine_state.pkl "
+                            f"nor a complete raw dump in {d} within 60s"
+                        )
+                    _time.sleep(0.25)
+                model.module.set_params(got[0])

@@ -111,7 +111,13 @@ def chunk_manifest_for_dump(
         if man is None:
             return None
         version = int(man["version"])
+        sharded = man.get("storage") == "sharded"
         if wire not in (None, "raw", "model"):
+            if sharded:
+                # Sharded dumps publish no quantized companion (wire
+                # scales reduce an axis FSDP shards — see
+                # dump_raw_params_sharded); only the raw wire exists.
+                return None
             # Quantized wire: the companion bin's layout sidecar is the
             # source of truth for leaves AND total (params.json only
             # describes the raw bin).
@@ -128,7 +134,27 @@ def chunk_manifest_for_dump(
             want_total = man.get("total_bytes")
         try:
             idx = _sidecar_index(dump_dir, bin_name, chunk_bytes)
-            if idx is None:
+            if idx is None and sharded:
+                # No single bin to hash: stream the virtual full bin
+                # through the slab reader once (the multi-process dump
+                # can't publish a dump-time index — process 0 never sees
+                # sibling slabs before its manifest lands) — then
+                # PERSIST it as the sidecar, so an origin restart
+                # (recover_mode relaunch) never re-sha256s a multi-GB
+                # slab set before answering its first manifest.
+                from areal_tpu.system.weight_transfer import (
+                    _write_json_atomic,
+                    chunk_sidecar_name,
+                )
+
+                idx = _index_from_reader(dump_dir, man, chunk_bytes)
+                try:
+                    _write_json_atomic(
+                        dump_dir, chunk_sidecar_name(bin_name), idx
+                    )
+                except OSError:
+                    pass  # read-only dump dir: stay lazy
+            elif idx is None:
                 idx = build_chunk_index(
                     os.path.join(dump_dir, bin_name), chunk_bytes
                 )
@@ -138,7 +164,7 @@ def chunk_manifest_for_dump(
             return None
         if idx["total_bytes"] != want_total:
             return None  # torn write (or a stale sidecar)
-        return {
+        out = {
             **idx,
             "version": version,
             "bin": bin_name,
@@ -149,7 +175,27 @@ def chunk_manifest_for_dump(
             "model_total_bytes": int(idx["total_bytes"]),
             "leaves": leaves,
         }
+        if sharded:
+            # Server-side hint only: readers fetch chunks of the same
+            # virtual stream regardless of how the dump is stored.
+            out["storage"] = "sharded"
+            out["n_slabs"] = int(man.get("n_slabs", 1))
+        return out
     return None
+
+
+def _index_from_reader(dump_dir: str, manifest: Dict, chunk_bytes: int) -> Dict:
+    """Chunk index of a sharded dump's virtual full stream, one read
+    pass over the slabs (page-cache hot on the dump host)."""
+    from areal_tpu.system.weight_transfer import (
+        DumpStreamReader,
+        chunk_index_from_reader,
+    )
+
+    with DumpStreamReader(dump_dir, manifest) as reader:
+        return chunk_index_from_reader(
+            reader, int(manifest["total_bytes"]), chunk_bytes
+        )
 
 
 def _leaf_segments(leaf: Dict, slices) -> List[Dict]:
@@ -431,6 +477,17 @@ class WeightPlaneSource(_PlaneHTTP):
         # a single pass over the shard's bytes (slice + sha256); pruned
         # to the two GC-live versions.
         self._shards: Dict[Tuple[int, str, int, int], Tuple[Dict, List]] = {}
+        # Cached stream readers per (version, wire): os.pread-based, so
+        # one reader serves concurrent chunk requests; for SHARDED dumps
+        # the reader gathers the virtual full stream from per-process
+        # slab files (the origin never materializes the whole model).
+        # Pruned readers RETIRE with a grace period instead of closing
+        # immediately: an executor thread may still hold one mid-pread
+        # (closing its fds under it would 404 a servable chunk — or,
+        # after fd reuse, read the wrong file; the client's hash verify
+        # is the backstop, not the plan).
+        self._readers: Dict[Tuple[int, str], Any] = {}
+        self._retired_readers: List[Tuple[float, Any]] = []
         self._lock = threading.Lock()
         # Serializes manifest (re)builds WITHOUT blocking chunk serving:
         # a rebuild may sha256 the whole bin (sidecar missing), and
@@ -520,6 +577,55 @@ class WeightPlaneSource(_PlaneHTTP):
             return None
         return man
 
+    def _get_reader(self, man: Dict):
+        """The (cached) stream reader for one manifest's payload, or
+        None when its bin/slabs vanished (GC race: caller 404s). Old
+        versions' readers are pruned (their fds pin unlinked files)."""
+        from areal_tpu.system.weight_transfer import DumpStreamReader
+
+        version = int(man["version"])
+        key = (version, man.get("wire", "raw"))
+        with self._lock:
+            r = self._readers.get(key)
+        if r is not None:
+            return r
+        # Wire companions have no storage tag: they are contiguous bins
+        # described by their own layout; pass the manifest straight in.
+        try:
+            r = DumpStreamReader(self.dump_dir, man)
+        except (OSError, ValueError, KeyError):
+            return None
+        now = time.monotonic()
+        with self._lock:
+            have = self._readers.get(key)
+            if have is not None:
+                r.close()
+                return have
+            for k in [k for k in self._readers if k[0] < version - 1]:
+                self._retired_readers.append((now, self._readers.pop(k)))
+            self._readers[key] = r
+            closable = [
+                old for t, old in self._retired_readers if now - t > 120.0
+            ]
+            self._retired_readers = [
+                (t, old) for t, old in self._retired_readers
+                if now - t <= 120.0
+            ]
+        for old in closable:
+            old.close()
+        return r
+
+    def close(self):
+        super().close()
+        with self._lock:
+            readers = list(self._readers.values()) + [
+                r for _, r in self._retired_readers
+            ]
+            self._readers = {}
+            self._retired_readers = []
+        for r in readers:
+            r.close()
+
     def _shard_stream(
         self, want_version: Optional[int], wire: str, degree: int, rank: int
     ) -> Optional[Tuple[Dict, List, List]]:
@@ -549,20 +655,17 @@ class WeightPlaneSource(_PlaneHTTP):
                 )
                 return None
             chunker = StreamChunker(man["chunk_bytes"])
+            reader = self._get_reader(full)
+            if reader is None:
+                return None
             try:
-                with open(
-                    os.path.join(self.dump_dir, full["bin"]), "rb"
-                ) as f:
-                    for off, length in ranges:
-                        f.seek(off)
-                        remaining = length
-                        while remaining:
-                            piece = f.read(min(remaining, 4 << 20))
-                            if not piece:
-                                raise OSError("short read (GC race)")
-                            chunker.update(piece)
-                            remaining -= len(piece)
-            except OSError:
+                for off, length in ranges:
+                    pos = 0
+                    while pos < length:
+                        n = min(4 << 20, length - pos)
+                        chunker.update(reader.read_at(off + pos, n))
+                        pos += n
+            except (OSError, ValueError):
                 return None
             idx = chunker.finish()
             if idx["total_bytes"] != man["total_bytes"]:
@@ -641,20 +744,20 @@ class WeightPlaneSource(_PlaneHTTP):
             man, ranges, prefix = got
             if not (0 <= idx < man["n_chunks"]):
                 return web.json_response({"error": "unknown chunk"}, status=404)
+            # Shard manifests slice the FULL stream: reader keyed off
+            # the full manifest (its bin), not the virtual shard stream.
+            full = self._manifest(version, wire)
+            reader = self._get_reader(full) if full is not None else None
+            if reader is None:
+                return web.json_response(
+                    {"error": "bin vanished (GC race)"}, status=404
+                )
             off = idx * man["chunk_bytes"]
             length = min(man["chunk_bytes"], man["total_bytes"] - off)
             try:
-                with open(
-                    os.path.join(self.dump_dir, man["bin"]), "rb"
-                ) as f:
-
-                    def read_at(o, n):
-                        f.seek(o)
-                        return f.read(n)
-
-                    data = gather_stream(
-                        read_at, ranges, off, length, prefix=prefix
-                    )
+                data = gather_stream(
+                    reader.read_at, ranges, off, length, prefix=prefix
+                )
             except (OSError, ValueError):
                 return web.json_response(
                     {"error": "bin vanished (GC race)"}, status=404
@@ -665,18 +768,17 @@ class WeightPlaneSource(_PlaneHTTP):
                 return web.json_response({"error": "unknown chunk"}, status=404)
             off = idx * man["chunk_bytes"]
             length = min(man["chunk_bytes"], man["total_bytes"] - off)
-            # One pread per request off the page cache; the bin is
-            # mmap-hot on the dump host already (the shm/disk fast paths
-            # read it too).
-            try:
-                with open(os.path.join(self.dump_dir, man["bin"]), "rb") as f:
-                    f.seek(off)
-                    data = f.read(length)
-            except OSError:
+            # One pread per request off the page cache; the bin (or its
+            # slab files, for a sharded trainer dump) is mmap-hot on the
+            # dump host already.
+            reader = self._get_reader(man)
+            if reader is None:
                 return web.json_response(
                     {"error": "bin vanished (GC race)"}, status=404
                 )
-            if len(data) != length:
+            try:
+                data = reader.read_at(off, length)
+            except (OSError, ValueError):
                 return web.json_response({"error": "short read"}, status=404)
         self._count_egress(
             version, wire,

@@ -24,15 +24,20 @@ dump time rather than silently mis-rebuilt.
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from areal_tpu.base import logging
-from areal_tpu.base.chunking import DEFAULT_CHUNK_BYTES, StreamChunker
+from areal_tpu.base.chunking import (
+    DEFAULT_CHUNK_BYTES,
+    StreamChunker,
+    slice_byte_ranges,
+)
 
 logger = logging.getLogger("weight_transfer")
 
@@ -40,6 +45,14 @@ _MANIFEST = "params.json"
 _SCHEMA = 1
 
 LAYOUT_SCHEMA = "areal-weight-layout/v1"
+SLAB_SCHEMA = "areal-weight-slabs/v1"
+
+# Telemetry of the most recent dump on this process: host high-water
+# (largest single host materialization — the whole-model gather the
+# sharded dump exists to avoid), total bytes, wall seconds, and whether
+# the dump was shard-local. Read by model_worker logs and the
+# `train_sharded` bench phase; single-writer by the dp-rank-0 dump rule.
+LAST_DUMP_STATS: Dict[str, Any] = {}
 
 # Quantized-wire convention (mirrors ops/wquant.py): symmetric int8 with
 # per-output-channel scales reduced over axis -2, w ~= q * s. Slicing any
@@ -108,6 +121,38 @@ def layout_sidecar_name(bin_name: str) -> str:
 def wire_bin_name(version: int, wire_dtype: str) -> str:
     """The quantized-wire companion bin (``params-v{N}.int8.bin``)."""
     return f"params-v{version}.{wire_dtype}.bin"
+
+
+def slab_bin_name(version: int, slab: int) -> str:
+    """One process's shard-local slab of a sharded dump
+    (``params-v{N}.slab{K}.bin``)."""
+    return f"params-v{version}.slab{slab}.bin"
+
+
+def slab_sidecar_name(bin_name: str) -> str:
+    """The slab's entry list (``params-v{N}.slab{K}.slabs.json``):
+    which (path, slices) live at which slab offsets, in write order."""
+    return bin_name[: -len(".bin")] + ".slabs.json"
+
+
+def _gc_old_versions(dump_dir: str, keep: int = 2) -> None:
+    """Remove every artifact (bin, wire companion, sidecars, slabs) of
+    all but the newest ``keep`` dump versions. Prefix-based so new
+    artifact kinds never need their own victim list."""
+    versions = set()
+    for b in os.listdir(dump_dir):
+        if b.startswith("params-v"):
+            v = b[len("params-v"):].split(".", 1)[0]
+            if v.isdigit():
+                versions.add(int(v))
+    for v in sorted(versions)[:-keep]:
+        prefix = f"params-v{v}."
+        for b in os.listdir(dump_dir):
+            if b.startswith(prefix):
+                try:
+                    os.unlink(os.path.join(dump_dir, b))
+                except OSError:
+                    pass
 
 
 def _wire_quantizable(path: str, arr: np.ndarray) -> bool:
@@ -243,11 +288,13 @@ def dump_raw_params(
         "leaves": [],
     }
     offset = 0
+    high_water = 0
     chunker = StreamChunker(chunk_bytes)
     tmp_bin = os.path.join(dump_dir, bin_name + f".tmp.{os.getpid()}")
     with open(tmp_bin, "wb") as f:
         for path, leaf in leaves:
             arr = np.ascontiguousarray(np.asarray(leaf))
+            high_water = max(high_water, arr.nbytes)
             data = arr.tobytes()
             f.write(data)
             chunker.update(data)
@@ -287,24 +334,344 @@ def dump_raw_params(
             wire_dtype: wire_layout["total_bytes"]
         }
     _write_json_atomic(dump_dir, _MANIFEST, manifest)
-    # GC old versions (bins + every sidecar/wire companion; keep the
-    # newest 2 so an in-flight reader can finish).
-    versions = set()
-    for b in os.listdir(dump_dir):
-        if b.startswith("params-v") and b.endswith(".bin"):
-            v = b[len("params-v"):-len(".bin")].split(".", 1)[0]
-            if v.isdigit():
-                versions.add(int(v))
-    for v in sorted(versions)[:-2]:
-        victims = []
-        for b in (f"params-v{v}.bin", wire_bin_name(v, "int8")):
-            victims += [b, chunk_sidecar_name(b), layout_sidecar_name(b)]
-        for victim in victims:
+    # GC old versions (bins + every sidecar/wire companion/slab; keep
+    # the newest 2 so an in-flight reader can finish).
+    _gc_old_versions(dump_dir)
+    dt = time.monotonic() - t0
+    LAST_DUMP_STATS.clear()
+    LAST_DUMP_STATS.update(
+        sharded=False, high_water_bytes=int(high_water),
+        total_bytes=int(offset), seconds=dt, n_slabs=0,
+    )
+    return dt
+
+
+def chunk_index_from_reader(
+    reader: "DumpStreamReader", total_bytes: int, chunk_bytes: int
+) -> Dict[str, Any]:
+    """Chunk index of a dump's (possibly slab-backed) byte stream, one
+    4 MiB-stride pass through ``reader`` — shared by the dump-time
+    sidecar write below and the weight-plane origin's lazy indexing, so
+    the two can never diverge on chunking semantics."""
+    chunker = StreamChunker(chunk_bytes)
+    pos = 0
+    while pos < total_bytes:
+        n = min(4 << 20, total_bytes - pos)
+        chunker.update(reader.read_at(pos, n))
+        pos += n
+    return chunker.finish()
+
+
+def _full_layout_leaves(leaves) -> Tuple[List[Dict[str, Any]], int]:
+    """The canonical full-stream layout of a flattened param list:
+    sorted-path order, row-major full leaves, cumulative offsets —
+    exactly the byte stream ``dump_raw_params`` writes contiguously.
+    Shape/dtype come off the (possibly jax, possibly sharded) leaves
+    WITHOUT materializing any data."""
+    out: List[Dict[str, Any]] = []
+    offset = 0
+    for path, leaf in leaves:
+        dt = np.dtype(leaf.dtype.name if hasattr(leaf.dtype, "name")
+                      else leaf.dtype)
+        shape = list(getattr(leaf, "shape", ()))
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize \
+            if shape else dt.itemsize
+        out.append({
+            "path": path, "dtype": dt.name, "shape": shape,
+            "offset": offset, "nbytes": nbytes,
+        })
+        offset += nbytes
+    return out, offset
+
+
+def _norm_slices(index, shape) -> List[Tuple[int, int]]:
+    """A jax ``Shard.index`` (tuple of slices, possibly open-ended) as
+    concrete per-dim ``(start, stop)`` pairs."""
+    out = []
+    for sl, dim in zip(index, shape):
+        a = 0 if sl.start is None else int(sl.start)
+        b = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((a, b))
+    return out
+
+
+def _owned_shards(leaf, process_index: int):
+    """This process's OWNED shards of one leaf: ``(slices, data)`` pairs
+    in deterministic (start-tuple) order. For jax arrays, ownership is
+    ``replica_id == 0`` (each distinct shard has exactly one owner
+    globally, so a replicated leaf is written once fleet-wide); plain
+    host arrays are owned by process 0. ``data`` stays lazy — the caller
+    materializes one shard at a time, which IS the high-water win."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        if process_index != 0:
+            return []
+        shape = getattr(leaf, "shape", ())
+        return [([(0, int(d)) for d in shape], leaf)]
+    owned = [
+        (_norm_slices(s.index, leaf.shape), s.data)
+        for s in shards
+        if getattr(s, "replica_id", 0) == 0
+    ]
+    owned.sort(key=lambda e: tuple(a for a, _ in e[0]))
+    return owned
+
+
+def dump_raw_params_sharded(
+    params: Any, dump_dir: str, version: int,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    process_index: int = 0, n_processes: int = 1,
+    wire_dtype: Optional[str] = None,
+) -> float:
+    """Shard-local raw dump: each process writes ONLY its addressable
+    shard slabs; no host ever materializes more than one shard at a
+    time. Returns seconds spent (this process).
+
+    The dump's logical payload is the SAME byte stream ``dump_raw_params``
+    writes (sorted-path, row-major full leaves) — but stored as one
+    ``params-v{N}.slab{K}.bin`` per process plus a ``.slabs.json``
+    sidecar mapping each slab extent back to (path, slices). Readers and
+    the weight-plane origin reassemble the stream through
+    :class:`DumpStreamReader`, so chunk hashes, shard manifests and the
+    whole PR 5/8 distribution contract are byte-identical to a
+    contiguous dump of the same values. ``params.json`` (process 0 only)
+    carries ``storage: "sharded"`` + the full virtual layout; a reader
+    that sees the manifest before every slab landed treats the dump as
+    absent and retries — the same torn-write discipline as the
+    contiguous format.
+
+    The quantized wire companion is NOT published for sharded dumps:
+    its per-output-channel scales reduce over axis -2, which FSDP
+    shards — per-shard absmax would silently diverge from the global
+    convention. The plane serves the raw wire; ``weight_wire_dtype``
+    on a sharded trainer mesh logs a warning and ships raw.
+    """
+    t0 = time.monotonic()
+    os.makedirs(dump_dir, exist_ok=True)
+    if wire_dtype not in (None, "model", "raw"):
+        logger.warning(
+            f"weight_wire_dtype={wire_dtype!r} ignored for the sharded "
+            f"dump: wire scales reduce an axis FSDP shards (see "
+            f"dump_raw_params_sharded docstring); serving the raw wire"
+        )
+    leaves = _flatten(params)
+    full_leaves, total_bytes = _full_layout_leaves(leaves)
+    bin_name = f"params-v{version}.bin"  # virtual stream name
+    slab_name = slab_bin_name(version, process_index)
+    slab: Dict[str, Any] = {
+        "schema": SLAB_SCHEMA, "version": int(version), "bin": slab_name,
+        "slab": int(process_index), "n_slabs": int(n_processes),
+        "entries": [],
+    }
+    offset = 0
+    high_water = 0
+    tmp_bin = os.path.join(dump_dir, slab_name + f".tmp.{os.getpid()}")
+    with open(tmp_bin, "wb") as f:
+        for path, leaf in leaves:
+            for slices, data in _owned_shards(leaf, process_index):
+                arr = np.ascontiguousarray(np.asarray(data))
+                high_water = max(high_water, arr.nbytes)
+                f.write(arr.tobytes())
+                slab["entries"].append({
+                    "path": path,
+                    "slices": [list(s) for s in slices],
+                    "offset": offset, "nbytes": int(arr.nbytes),
+                })
+                offset += arr.nbytes
+                del arr
+        f.flush()
+        os.fsync(f.fileno())
+    slab["total_bytes"] = offset
+    os.replace(tmp_bin, os.path.join(dump_dir, slab_name))
+    _write_json_atomic(dump_dir, slab_sidecar_name(slab_name), slab)
+    if process_index == 0:
+        manifest: Dict[str, Any] = {
+            "schema": _SCHEMA, "version": int(version), "bin": bin_name,
+            "storage": "sharded", "n_slabs": int(n_processes),
+            "leaves": full_leaves, "total_bytes": int(total_bytes),
+        }
+        _write_json_atomic(
+            dump_dir, layout_sidecar_name(bin_name),
+            {"schema": LAYOUT_SCHEMA, "version": int(version),
+             "bin": bin_name, "wire": "raw", "storage": "sharded",
+             "n_slabs": int(n_processes), "total_bytes": int(total_bytes),
+             "leaves": [dict(e, wire="raw") for e in full_leaves]},
+        )
+        if n_processes == 1:
+            # Single process: every slab is already on disk, so publish
+            # the full-stream chunk index now (one page-cache-hot read
+            # pass) — the weight-plane origin then never re-hashes.
+            # Multi-process dumps skip it (process 0 cannot see sibling
+            # slabs yet); the origin hashes lazily on first manifest.
+            with DumpStreamReader(dump_dir, manifest) as reader:
+                idx = chunk_index_from_reader(
+                    reader, total_bytes, chunk_bytes
+                )
+            _write_json_atomic(dump_dir, chunk_sidecar_name(bin_name), idx)
+        _write_json_atomic(dump_dir, _MANIFEST, manifest)
+        _gc_old_versions(dump_dir)
+    dt = time.monotonic() - t0
+    LAST_DUMP_STATS.clear()
+    LAST_DUMP_STATS.update(
+        sharded=True, high_water_bytes=int(high_water),
+        total_bytes=int(total_bytes), slab_bytes=int(offset),
+        seconds=dt, n_slabs=int(n_processes),
+    )
+    return dt
+
+
+def mirror_dump_version(src_dir: str, dst_dir: str, version: int) -> float:
+    """File-level copy of one dump version's artifacts into another dump
+    dir (the tmpfs fast-path mirror for shard-local dumps): slabs and
+    sidecars first, ``params.json`` LAST via tmp+rename, so a reader of
+    the mirror never sees a manifest ahead of its data — the same
+    ordering discipline the dump itself follows. Costs file I/O off the
+    page cache instead of a second device->host materialization of
+    every shard. Returns seconds spent."""
+    t0 = time.monotonic()
+    os.makedirs(dst_dir, exist_ok=True)
+    prefix = f"params-v{version}."
+
+    def copy_atomic(name: str) -> None:
+        tmp = os.path.join(dst_dir, name + f".tmp.{os.getpid()}")
+        with open(os.path.join(src_dir, name), "rb") as s, open(tmp, "wb") as d:
+            while True:
+                piece = s.read(4 << 20)
+                if not piece:
+                    break
+                d.write(piece)
+            d.flush()
+            os.fsync(d.fileno())
+        os.replace(tmp, os.path.join(dst_dir, name))
+
+    for name in sorted(os.listdir(src_dir)):
+        if name.startswith(prefix) and ".tmp." not in name:
+            copy_atomic(name)
+    copy_atomic(_MANIFEST)
+    _gc_old_versions(dst_dir)
+    return time.monotonic() - t0
+
+
+class DumpStreamReader:
+    """Positioned reads over one dump version's FULL byte stream.
+
+    Contiguous dumps pread the bin directly. Sharded dumps gather
+    through an interval map from stream offsets to (slab fd, slab
+    offset), built from the manifest's full layout plus every slab
+    sidecar — each slab entry's covering stream ranges
+    (``slice_byte_ranges``, row-major order) correspond 1:1 to its
+    contiguous slab bytes, because the dump wrote the shard row-major.
+    ``os.pread`` throughout, so one reader serves concurrent origin
+    requests without locking; an open reader also survives the dump GC
+    (the fds pin the unlinked files).
+
+    Raises ``FileNotFoundError`` when a bin/slab is missing (GC race or
+    slabs still landing — callers treat the dump as absent and retry)
+    and ``ValueError`` when the slabs do not tile the stream exactly.
+    """
+
+    def __init__(self, dump_dir: str, manifest: Dict[str, Any]):
+        self._fds: List[int] = []
+        self.total_bytes = int(manifest["total_bytes"])
+        try:
+            if manifest.get("storage") != "sharded":
+                fd = os.open(
+                    os.path.join(dump_dir, manifest["bin"]), os.O_RDONLY
+                )
+                self._fds.append(fd)
+                self._segments = [(0, self.total_bytes, 0, 0)]
+            else:
+                self._segments = self._build_sharded(dump_dir, manifest)
+        except Exception:
+            self.close()
+            raise
+        self._starts = [s[0] for s in self._segments]
+
+    def _build_sharded(self, dump_dir: str, manifest: Dict[str, Any]):
+        by_path = {e["path"]: e for e in manifest["leaves"]}
+        segments: List[Tuple[int, int, int, int]] = []
+        for k in range(int(manifest.get("n_slabs", 1))):
+            name = slab_bin_name(int(manifest["version"]), k)
+            with open(os.path.join(dump_dir, slab_sidecar_name(name))) as f:
+                slab = json.load(f)
+            if slab.get("schema") != SLAB_SCHEMA:
+                raise ValueError(f"bad slab schema in {name}")
+            fd = os.open(os.path.join(dump_dir, name), os.O_RDONLY)
+            self._fds.append(fd)
+            if os.fstat(fd).st_size != int(slab["total_bytes"]):
+                raise ValueError(f"torn slab {name}")
+            fd_idx = len(self._fds) - 1
+            for e in slab["entries"]:
+                leaf = by_path.get(e["path"])
+                if leaf is None:
+                    raise ValueError(f"slab entry for unknown {e['path']}")
+                shape = list(leaf["shape"])
+                n_items = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                itemsize = int(leaf["nbytes"]) // n_items
+                slab_off = int(e["offset"])
+                for off, ln in slice_byte_ranges(
+                    int(leaf["offset"]), shape, itemsize, e["slices"]
+                ):
+                    segments.append((off, ln, fd_idx, slab_off))
+                    slab_off += ln
+                if slab_off - int(e["offset"]) != int(e["nbytes"]):
+                    raise ValueError(f"slab entry size mismatch: {e}")
+        segments.sort(key=lambda s: s[0])
+        pos = 0
+        for off, ln, _, _ in segments:
+            if off != pos:
+                raise ValueError(
+                    f"slabs do not tile the stream: gap/overlap at "
+                    f"{pos} (next segment starts {off}) — slab still "
+                    f"landing or replica dedup bug"
+                )
+            pos += ln
+        if pos != self.total_bytes:
+            raise ValueError(
+                f"slabs cover {pos} of {self.total_bytes} stream bytes"
+            )
+        return segments
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        """``[offset, offset+length)`` of the stream; OSError on short
+        reads (matches the origin's pread contract)."""
+        if not (0 <= offset and offset + length <= self.total_bytes):
+            raise ValueError(
+                f"read [{offset}, {offset + length}) outside stream of "
+                f"{self.total_bytes}"
+            )
+        out = []
+        i = max(0, bisect.bisect_right(self._starts, offset) - 1)
+        need = length
+        pos = offset
+        while need > 0:
+            seg_off, seg_len, fd_idx, slab_off = self._segments[i]
+            lo = pos - seg_off
+            take = min(seg_len - lo, need)
+            data = os.pread(self._fds[fd_idx], take, slab_off + lo)
+            if len(data) != take:
+                raise OSError(
+                    f"short stream read: wanted {take}, got {len(data)}"
+                )
+            out.append(data)
+            need -= take
+            pos += take
+            i += 1
+        return b"".join(out)
+
+    def close(self):
+        for fd in self._fds:
             try:
-                os.unlink(os.path.join(dump_dir, victim))
+                os.close(fd)
             except OSError:
                 pass
-    return time.monotonic() - t0
+        self._fds = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def unflatten_leaves(leaves: Dict[str, np.ndarray]) -> Any:
@@ -360,6 +727,30 @@ def load_raw_params(dump_dir: str) -> Optional[Tuple[Any, int]]:
         manifest = _read_manifest(dump_dir)
         if manifest is None:
             return None
+        if manifest.get("storage") == "sharded":
+            # Shard-local dump: assemble full leaves through the virtual
+            # stream (no single bin exists to mmap). A missing slab
+            # means the dump is still landing on another process (or the
+            # GC race) — treat as absent like a missing bin.
+            try:
+                reader = DumpStreamReader(dump_dir, manifest)
+            except FileNotFoundError:
+                continue
+            except (OSError, ValueError, KeyError):
+                return None
+            try:
+                leaves = {}
+                for e in manifest["leaves"]:
+                    dt = np.dtype(e["dtype"])
+                    buf = reader.read_at(e["offset"], int(e["nbytes"]))
+                    leaves[e["path"]] = np.frombuffer(buf, dt).reshape(
+                        e["shape"]
+                    )
+                return unflatten_leaves(leaves), int(manifest["version"])
+            except (OSError, ValueError, KeyError):
+                return None
+            finally:
+                reader.close()
         try:
             mm = np.memmap(
                 os.path.join(dump_dir, manifest["bin"]), mode="r",

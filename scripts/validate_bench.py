@@ -35,6 +35,18 @@ from areal_tpu.bench import bank  # noqa: E402
 # carry every listed numeric key. Catches a phase body drifting away
 # from what the report/readers consume without anything failing loudly.
 PHASE_VALUE_KEYS: Dict[str, tuple] = {
+    # Mesh shape + device count ride the VALUES (not just record
+    # attestation) so scaling curves assemble across bench rounds;
+    # train_tflops stays the per-chip headline number.
+    "train_tflops": ("train_tflops", "n_devices"),
+    # Sharded-training evidence without its parity/high-water/roundtrip
+    # fields is not evidence: a record could bank mesh step times off a
+    # run whose sharded math silently diverged.
+    "train_sharded": (
+        "fsdp2_parity_ok", "tp2_parity_ok", "loss_parity_max_rel_err",
+        "dump_highwater_frac", "dump_roundtrip_ok", "n_devices",
+    ),
+    "train_tflops_scaling": ("n_devices_max", "scaling_efficiency"),
     "weight_update": (
         "weight_update_ms", "weight_transfer_ms", "weight_cutover_ms",
         "origin_full_payloads",
@@ -195,6 +207,78 @@ def _validate_sharded_plane(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_train_sharded(val: Dict) -> List[str]:
+    """The sharded-training phase exists to show the mesh paths
+    MATCHING the single-device trajectory and the shard-local dump
+    actually shrinking the host high-water while round-tripping
+    byte-identically — a record failing any of those is refused."""
+    problems: List[str] = []
+    for k in ("fsdp2_parity_ok", "tp2_parity_ok"):
+        if _num(val, k) is not None and _num(val, k) != 1:
+            problems.append(
+                f"train_sharded: {k.split('_')[0]} loss trajectory "
+                f"diverged from the single-device engine"
+            )
+    if _num(val, "dump_roundtrip_ok") != 1:
+        problems.append(
+            "train_sharded: shard-local dump did not round-trip "
+            "byte-identically through the weight plane"
+        )
+    frac = _num(val, "dump_highwater_frac")
+    if frac is not None and not (0.0 < frac <= 0.75):
+        problems.append(
+            f"train_sharded: dump host high-water frac {frac:.3f} does "
+            f"not show the ~1/mesh_size reduction (expected <= 0.75 on "
+            f"a 2-device mesh)"
+        )
+    return problems
+
+
+# Numeric keys every train_tflops_scaling curve point must carry: a
+# record without per-point per-chip throughput is not a scaling curve.
+SCALING_POINT_KEYS = ("n_devices", "step_s", "train_tflops_per_chip")
+
+
+def _validate_scaling_points(val: Dict) -> List[str]:
+    problems: List[str] = []
+    points = val.get("points")
+    if not isinstance(points, list) or not points:
+        return [
+            "train_tflops_scaling: measure value must carry a "
+            "non-empty 'points' curve"
+        ]
+    prev_n = 0.0
+    for i, pt in enumerate(points):
+        if not isinstance(pt, dict):
+            problems.append(
+                f"train_tflops_scaling: points[{i}] is not an object"
+            )
+            continue
+        for k in SCALING_POINT_KEYS:
+            if not isinstance(pt.get(k), (int, float)) or isinstance(
+                pt.get(k), bool
+            ):
+                problems.append(
+                    f"train_tflops_scaling: points[{i}] missing "
+                    f"numeric {k!r}"
+                )
+        n = pt.get("n_devices")
+        if isinstance(n, (int, float)):
+            if n <= prev_n:
+                problems.append(
+                    f"train_tflops_scaling: points[{i}] n_devices "
+                    f"{n} not increasing (curve must run 1 -> N)"
+                )
+            prev_n = float(n)
+    first_n = (points[0] or {}).get("n_devices")
+    if isinstance(first_n, (int, float)) and first_n != 1:
+        problems.append(
+            "train_tflops_scaling: curve must start at n_devices == 1 "
+            "(the per-chip baseline every other point is judged against)"
+        )
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -214,6 +298,16 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.append(
             f"{name}: origin served {ofp:.2f} full payloads — peer "
             f"fanout silently degraded to an origin broadcast"
+        )
+    if name == "train_sharded":
+        problems.extend(_validate_train_sharded(val))
+    if name == "train_tflops_scaling":
+        problems.extend(_validate_scaling_points(val))
+    if name == "train_tflops" and not isinstance(
+        val.get("mesh_shape"), dict
+    ):
+        problems.append(
+            "train_tflops: measure value missing the 'mesh_shape' dict"
         )
     if name == "weight_plane_sharded":
         problems.extend(_validate_sharded_plane(val))

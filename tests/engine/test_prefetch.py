@@ -226,6 +226,50 @@ def test_train_batch_overlap_telemetry_and_tracker_series():
     assert types["perf/packing_efficiency"] == "avg"
 
 
+def test_train_batch_mesh_paths_match_single_device():
+    """PR 9 satellite: the fused-vs-overlapped numerics pin was
+    single-device only — extend it to TP2 and FSDP2 fake-device meshes.
+    On CPU these meshes take the `_serial_dispatch` fused fallback; the
+    mesh trajectory (losses, grad norms, final params) must match the
+    single-device (overlapped-path) trajectory — GSPMD placement is a
+    scheduling change, not a numeric one. Budget: ~8 s on the virtual
+    CPU mesh (tiny model, warm XLA cache; tier-1 headroom per the PR 7
+    note discipline)."""
+    from areal_tpu.base.topology import MeshSpec
+    from areal_tpu.parallel.mesh import make_mesh
+
+    params = init_params(small_cfg(), jax.random.PRNGKey(11))
+    batch = make_batch(n=8, seed=11)
+    trajs = {}
+    finals = {}
+    for name, mesh in (
+        ("single", None),
+        ("tp2", make_mesh(MeshSpec.parse("t2"), jax.devices()[:2])),
+        ("f2", make_mesh(MeshSpec.parse("f2"), jax.devices()[:2])),
+    ):
+        eng = mk_engine(params, depth=2, mesh=mesh)
+        if mesh is not None:
+            assert eng._serial_dispatch  # CPU mesh -> fused fallback
+        traj = []
+        for step in range(3):
+            st = eng.train_batch(
+                batch, MicroBatchSpec(n_mbs=3), packed_loss, loss_weight,
+                version_steps=step, loss_name="t",
+            )
+            traj.append((st["t/loss"], st["t/grad_norm"]))
+        trajs[name] = traj
+        finals[name] = [
+            np.asarray(x, np.float32)
+            for x in jax.tree_util.tree_leaves(jax.device_get(eng.params))
+        ]
+    for name in ("tp2", "f2"):
+        for (l, g), (lr_, gr) in zip(trajs[name], trajs["single"]):
+            np.testing.assert_allclose(l, lr_, rtol=1e-4, atol=1e-6)
+            np.testing.assert_allclose(g, gr, rtol=1e-4, atol=1e-6)
+        for a, b in zip(finals[name], finals["single"]):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
 def test_forward_prefetched_equals_eager():
     """Same programs, same inputs — the deferred single-fetch forward
     must be bit-identical to the eager per-mb-fetch forward."""

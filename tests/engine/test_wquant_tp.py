@@ -61,6 +61,11 @@ def test_quantize_weight_invariant_under_sharding():
         np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
 
 
+@pytest.mark.slow  # ~26s (three engines + decode on the virtual mesh);
+# moved out of the tier-1 budget in PR 9 (wall clock was brushing
+# 870s). Tier-1 keeps the quantize-invariance pin above plus int8-TP
+# decode coverage via tests/engine/test_kv_int8.py
+# ::test_serving_engine_int8_tensor_parallel (~12s).
 @pytest.mark.timeout(600)
 def test_int8_decode_parity_tp_vs_unsharded():
     if len(jax.devices()) < 2:

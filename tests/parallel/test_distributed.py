@@ -74,11 +74,91 @@ def test_setup_host_group_two_processes(tmp_path):
     assert results[0]["coordinator"] == results[1]["coordinator"]
 
 
-def test_setup_host_group_single_host_noop():
+def test_setup_host_group_single_host_noop(monkeypatch):
+    """n_hosts == 1 must not touch jax.distributed (local meshes work
+    as-is; initialize() would grab a port and wedge single-host runs)."""
+    import jax
+
     from areal_tpu.parallel.distributed import setup_host_group
 
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
     info = setup_host_group("e", "t", "g", 0, 1)
     assert (info.process_id, info.num_processes) == (0, 1)
+    assert calls == []  # no-op: initialize never invoked
+
+
+def test_setup_host_group_coordinator_election_mocked(tmp_path, monkeypatch):
+    """Unit pin for the rendezvous (PR 9 satellite: this ran only inside
+    the slow 2-process e2e before): rank 0 elects itself coordinator and
+    publishes ip:port through name_resolve; rank 1 waits for the key;
+    both call jax.distributed.initialize with the SAME address and their
+    own process ids. jax.distributed is mocked, so this pins the
+    election protocol, not the collective fabric. Budget: <1 s."""
+    import jax
+
+    from areal_tpu.base import name_resolve
+    from areal_tpu.parallel.distributed import setup_host_group
+
+    name_resolve.reconfigure("nfs", record_root=str(tmp_path / "nr"))
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    info0 = setup_host_group("exp-m", "t0", "g0", 0, 2)
+    info1 = setup_host_group("exp-m", "t0", "g0", 1, 2, timeout=5.0)
+    assert info0.coordinator_address == info1.coordinator_address
+    host, port = info0.coordinator_address.rsplit(":", 1)
+    assert host and 0 < int(port) < 65536
+    assert [c["process_id"] for c in calls] == [0, 1]
+    assert all(c["num_processes"] == 2 for c in calls)
+    assert all(
+        c["coordinator_address"] == info0.coordinator_address for c in calls
+    )
+
+
+def test_setup_host_group_wait_timeout(tmp_path, monkeypatch):
+    """A non-zero rank whose coordinator never publishes must surface a
+    TimeoutError from the name_resolve wait — not hang the worker or
+    call jax.distributed.initialize with garbage. Budget: ~1 s."""
+    import jax
+
+    from areal_tpu.base import name_resolve
+    from areal_tpu.parallel.distributed import setup_host_group
+
+    name_resolve.reconfigure("nfs", record_root=str(tmp_path / "nr"))
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+    with pytest.raises(TimeoutError):
+        setup_host_group("exp-to", "t0", "g0", 1, 2, timeout=0.5)
+    assert calls == []  # initialize never reached
+
+
+def test_verify_host_mesh_slice_single_process():
+    """The startup mesh-slice check (model_worker mirrors the serving
+    fleet's weight-shard check): a single-host mesh passes with its
+    summary; the same mesh under a multi-host config fails fast with
+    the actionable jax.distributed message. Budget: <1 s."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from areal_tpu.parallel.distributed import verify_host_mesh_slice
+
+    mesh = Mesh(
+        np.array(jax.devices()[:2]).reshape(1, 2, 1, 1),
+        ("data", "fsdp", "seq", "tensor"),
+    )
+    info = verify_host_mesh_slice(mesh, 0, 1)
+    assert info["local_devices"] == info["mesh_devices"] == 2
+    with pytest.raises(RuntimeError, match="jax.distributed"):
+        # A single-process mesh cannot satisfy train_n_hosts=2: the
+        # peers never initialized, exactly what the check must name.
+        verify_host_mesh_slice(mesh, 0, 2)
 
 
 @pytest.mark.slow  # ~45s two-process SPMD run; kept out of the tier-1

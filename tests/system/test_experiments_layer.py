@@ -119,6 +119,54 @@ def test_build_sft_and_ppo_experiments(tmp_path):
     build_graph(exp.master.rpcs)
 
 
+def test_allocation_mode_drives_train_mesh(tmp_path):
+    """PR 9 wiring pin: the allocation DSL's fsdp/tensor axes reach the
+    trainer (previously only the data axis was consumed, as the worker
+    count). Worker-local meshes slice the train partition; the
+    decoupled form offsets past the gen partition; multi-host builds
+    the GLOBAL mesh with lockstep datasets. Budget: <2 s (config-level
+    only, no engines built)."""
+    from areal_tpu.experiments import common as C
+
+    cfg, tok_dir, data = _sft_cfg(tmp_path)
+    # Single-device allocation: unchanged legacy behavior.
+    assert C.train_mesh_for_worker(cfg, 0, 1) == (None, None)
+
+    cfg.allocation_mode = "d2f2t2"
+    n = C.resolve_n_workers(cfg)
+    assert n == 2
+    spec, devs = C.train_mesh_for_worker(cfg, 1, n)
+    assert spec == "d1f2s1t2"
+    assert devs == [4, 5, 6, 7]  # worker 1's contiguous slice
+    exp = make_experiment("sft", cfg)
+    m = exp.model_workers[1].shards[0].model
+    assert m.args["mesh_spec"] == "d1f2s1t2"
+    assert m.args["device_ids"] == [4, 5, 6, 7]
+
+    # Decoupled: the train partition starts after the gen partition.
+    cfg.allocation_mode = "gen.d2t1+d1f2"
+    spec, devs = C.train_mesh_for_worker(cfg, 0, 1)
+    assert spec == "d1f2s1t1"
+    assert devs == [2, 3]
+
+    # Multi-host: one worker per host, GLOBAL mesh, lockstep dataset.
+    cfg.allocation_mode = "d2f2"
+    cfg.train_n_hosts = 2
+    assert C.resolve_n_workers(cfg) == 2
+    spec, devs = C.train_mesh_for_worker(cfg, 1, 2)
+    assert spec == "d2f2s1t1" and devs is None
+    exp = make_experiment("sft", cfg)
+    for i, w in enumerate(exp.model_workers):
+        assert (w.train_n_hosts, w.train_host_rank) == (2, i)
+        assert (w.dataset_dp_rank, w.dataset_dp_size) == (0, 1)
+
+    # An explicit per-model mesh_spec still wins over the derivation.
+    cfg.train_n_hosts = 1
+    cfg.model.mesh_spec = "d1"
+    exp = make_experiment("sft", cfg)
+    assert exp.model_workers[0].shards[0].model.args["mesh_spec"] == "d1"
+
+
 @pytest.mark.slow
 def test_main_sft_entrypoint(tmp_path):
     """Run the real CLI entry point in a subprocess (mock engine)."""

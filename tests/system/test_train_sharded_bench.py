@@ -1,0 +1,141 @@
+"""ISSUE 9 acceptance (bench leg): the `train_sharded` phase banks an
+attested CPU-proxy record with loss-trajectory parity (single-device vs
+FSDP2 vs TP2 fake-device meshes), the per-mesh step-time breakdown, and
+the shard-local dump's host high-water reduced ~1/mesh_size with a
+byte-identical weight-plane round trip — and `validate_bench.py`
+refuses records lacking the parity / scaling / high-water fields.
+
+Loss parity and sha256 byte accounting are exact and machine
+independent, which is why a CPU-proxy record is real evidence here.
+
+The phase runs through the REAL bench runner (its own subprocess +
+PhaseSpec.env 2-fake-device mesh + child-banked attested record) — the
+exact path the daemon takes in production. Subprocess isolation is
+also load-bearing: in this container's jax 0.4.37, compiling the same
+tiny model on three meshes inside a process that already ran the full
+suite aborts natively in the XLA CPU client (suite-state sensitivity;
+standalone in-process runs pass) — the runner child sidesteps the
+whole class, exactly as it does for real TPU windows.
+
+Time budget: ~45 s (child imports + live compiles: the phase opts out
+of the persistent XLA cache, see workloads._without_persistent_xla_cache);
+tier-1 headroom is tracked per PR 7's discipline."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank, runner
+from tests.fixtures import scale_timeout
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.timeout(420)
+def test_train_sharded_record_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    # The child gets exactly the phase's requested device topology (the
+    # runner APPENDS PhaseSpec.env XLA_FLAGS to inherited ones; the
+    # suite's 8-device conftest flag would otherwise ride along).
+    monkeypatch.setenv("XLA_FLAGS", "")
+    rec = runner.run_phase(
+        "train_sharded", "measure", b, deadline_s=scale_timeout(360)
+    )
+    assert rec["status"] == "ok", rec
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("train_sharded", rec) == []
+    assert validator.validate_bank_dir(b) == []
+
+    v = rec["value"]
+    # THE acceptance numbers: mesh trajectories match the single-device
+    # engine, and the shard-local dump halves the host high-water.
+    assert v["fsdp2_parity_ok"] == 1.0 and v["tp2_parity_ok"] == 1.0
+    assert v["loss_parity_max_rel_err"] < 5e-4
+    assert v["dump_highwater_frac"] <= 0.6
+    assert v["dump_roundtrip_ok"] == 1.0
+    for k in ("single_step_s", "fsdp2_step_s", "tp2_step_s"):
+        assert v[k] > 0  # the step-time breakdown banked
+
+    # Validator teeth: records that lost the parity...
+    bad = json.loads(json.dumps(rec))
+    bad["value"]["tp2_parity_ok"] = 0.0
+    assert any(
+        "diverged" in p
+        for p in validator.validate_phase_value("train_sharded", bad)
+    )
+    # ...whose dump did not shrink the high-water...
+    bad = json.loads(json.dumps(rec))
+    bad["value"]["dump_highwater_frac"] = 1.0
+    assert any(
+        "high-water" in p
+        for p in validator.validate_phase_value("train_sharded", bad)
+    )
+    # ...or that lack the round-trip field entirely are refused.
+    bad = json.loads(json.dumps(rec))
+    del bad["value"]["dump_roundtrip_ok"]
+    assert validator.validate_phase_value("train_sharded", bad)
+
+
+def test_train_tflops_scaling_registered_and_schema_teeth():
+    """The 1->N scaling phase is registered (default, driver-facing) so
+    the daemon spends the next real TPU window on the curve — and the
+    validator refuses curves without per-point per-chip numbers or not
+    anchored at n_devices=1. Budget: <1 s (no phase body runs)."""
+    from areal_tpu.bench import phases
+
+    spec = phases.get("train_tflops_scaling")
+    assert spec.default and not spec.proxy
+    assert spec.priority < phases.get("pack_density").priority
+
+    validator = _load_validator()
+    rec = {
+        "status": "ok", "pass": "measure",
+        "value": {
+            "n_devices_max": 2.0, "scaling_efficiency": 0.9,
+            "points": [
+                {"n_devices": 1.0, "step_s": 0.1,
+                 "train_tflops_per_chip": 50.0},
+                {"n_devices": 2.0, "step_s": 0.11,
+                 "train_tflops_per_chip": 45.0},
+            ],
+        },
+    }
+    assert validator.validate_phase_value("train_tflops_scaling", rec) == []
+    bad = json.loads(json.dumps(rec))
+    bad["value"]["points"] = bad["value"]["points"][1:]  # no 1-chip anchor
+    assert any(
+        "n_devices == 1" in p
+        for p in validator.validate_phase_value("train_tflops_scaling", bad)
+    )
+    bad = json.loads(json.dumps(rec))
+    del bad["value"]["points"][0]["train_tflops_per_chip"]
+    assert any(
+        "train_tflops_per_chip" in p
+        for p in validator.validate_phase_value("train_tflops_scaling", bad)
+    )
+    bad = json.loads(json.dumps(rec))
+    del bad["value"]["points"]
+    assert any(
+        "points" in p
+        for p in validator.validate_phase_value("train_tflops_scaling", bad)
+    )
