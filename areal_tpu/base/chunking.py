@@ -19,7 +19,7 @@ import hashlib
 import os
 from typing import Dict, List, Optional, Tuple
 
-CHUNK_SCHEMA = "areal-weight-chunks/v1"
+from areal_tpu.base.wire_schemas import WEIGHT_CHUNKS_V1 as CHUNK_SCHEMA
 
 # 8 MiB default: large enough that per-chunk HTTP overhead is noise for
 # GB-scale payloads, small enough that a resumed transfer re-pays at

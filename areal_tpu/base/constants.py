@@ -34,8 +34,11 @@ PARAM_REALLOC_ROOT: Optional[str] = None
 
 
 def get_fileroot() -> str:
-    return os.environ.get(
-        "AREAL_FILEROOT", f"/tmp/areal_tpu/{getpass.getuser()}"
+    from areal_tpu.base import env_registry
+
+    return (
+        env_registry.get_str("AREAL_FILEROOT")
+        or f"/tmp/areal_tpu/{getpass.getuser()}"
     )
 
 # Mirrors the reference's NCCL timeout role: how long collective setup /

@@ -1,0 +1,323 @@
+"""Single registry of every ``AREAL_*`` environment knob.
+
+Before this module existed the tree held ~60 ad-hoc ``os.environ``
+reads with per-call-site defaults — the drift class that forced PR 1 to
+bolt construction-time snapshotting onto ``AREAL_CE_CHUNK`` /
+``AREAL_SPLASH_*`` after two call sites disagreed about a default.
+Every knob is now declared ONCE here (name, type, default, doc,
+snapshot-at-construction flag) and read through the typed accessors
+below; the ``env-knob`` checker in ``areal_tpu/lint`` flags any raw
+``os.environ``/``getenv`` read of an undeclared ``AREAL_*`` name, any
+raw read of a *declared* name outside this module (use an accessor),
+and any registry entry nothing reads (dead knob).
+
+``docs/env_vars.md`` is GENERATED from this registry
+(``python scripts/areal_lint.py --emit-env-docs docs/env_vars.md``) and
+drift-gated in tier-1, so the doc can't fork from the code.
+
+Accessor semantics (uniform, unlike the historical call sites):
+
+- unset **or empty-string** values fall back to the declared default
+  (historically ``os.environ.get(k, d)`` sites crashed on ``k=""``
+  while ``os.environ.get(k) or d`` sites silently defaulted);
+- booleans: ``"" / "0" / "false" / "no" / "off"`` (case-insensitive)
+  are False, anything else set is True (historically
+  ``AREAL_WEIGHT_PLANE=0`` meant *enabled* because the site tested
+  plain string truthiness);
+- a knob whose declared default is ``None`` returns ``None`` when
+  unset (the "optional override" pattern).
+
+This module must stay stdlib-only: it is imported by
+``areal_tpu/base/logging.py`` and by the no-jax lint gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+_FALSEY = ("", "0", "false", "no", "off")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "str" | "int" | "float" | "bool"
+    default: Any  # typed default, or None for "optional override" knobs
+    doc: str
+    # True: read once at construction/init and pinned for the object's
+    # lifetime — mid-run env edits must NOT change behavior (a retrace
+    # or retry re-reading a changed value was the PR 1 drift bug).
+    snapshot: bool = False
+
+
+def _k(name: str, kind: str, default: Any, doc: str, *,
+       snapshot: bool = False) -> Knob:
+    return Knob(name=name, kind=kind, default=default, doc=doc,
+                snapshot=snapshot)
+
+
+_KNOBS: List[Knob] = [
+    # -- engine / serving ------------------------------------------------
+    _k("AREAL_KV_CACHE_DTYPE", "str", None,
+       "KV pool precision default when the engine ctor passes None: "
+       "'model' or 'int8' (paged.py int8 KV pools). A/B hook so bench "
+       "runs need no plumbing.", snapshot=True),
+    _k("AREAL_SPEC_DRAFT", "int", 0,
+       "N-gram speculative-decoding draft length default when the "
+       "engine ctor passes 0 (engine/spec_decode.py). 0 disables.",
+       snapshot=True),
+    _k("AREAL_SPEC_WINDOW", "int", None,
+       "Backward search window (tokens) for the speculative n-gram "
+       "lookup; unset = 1024, 0 = unbounded full-history scan.",
+       snapshot=True),
+    _k("AREAL_DECODE_WEIGHT_DTYPE", "str", None,
+       "Decode-weight precision default when the engine ctor passes "
+       "None: 'model' or 'int8' (W8A16, ops/wquant.py).", snapshot=True),
+    _k("AREAL_CHUNK_SMEM_BUDGET", "int", 512 * 1024,
+       "SMEM byte budget the chunked-prefill kernel sizes its blocks "
+       "against (engine/paged.py).", snapshot=True),
+    _k("AREAL_CKPT_BACKEND", "str", "pickle",
+       "Checkpoint storage backend when the API caller passes none: "
+       "'pickle' or 'orbax' (engine/checkpoint.py)."),
+    _k("AREAL_PREFETCH_DEPTH", "int", None,
+       "Host-prefetcher queue depth override for the train engine "
+       "(engine/jax_engine.py); unset = config/ctor default.",
+       snapshot=True),
+    # -- base ------------------------------------------------------------
+    _k("AREAL_FILEROOT", "str", None,
+       "Filesystem root for logs/checkpoints/realloc params; unset = "
+       "/tmp/areal_tpu/$USER. Resolved at call time, not import time "
+       "(base/constants.py: workers import before the controller env "
+       "lands)."),
+    _k("AREAL_LOG_LEVEL", "str", "INFO",
+       "Root log level for areal_tpu loggers (base/logging.py)."),
+    _k("AREAL_FAULTS", "str", "",
+       "Deterministic chaos-injection spec, e.g. "
+       "'gserver.weight_fetch@0.5:seed=7' (base/fault_injection.py); "
+       "empty = no faults."),
+    _k("AREAL_HEALTH_TTL", "float", 10.0,
+       "Default lease TTL seconds for the health registry "
+       "(base/health.py); per-role overrides via worker config."),
+    _k("AREAL_NAME_RESOLVE_ROOT", "str", "/tmp/areal_tpu/name_resolve",
+       "Root directory for the filesystem name-resolve backend "
+       "(base/name_resolve.py)."),
+    _k("AREAL_TPU_MEMORY_KILL_THRESHOLD", "float", None,
+       "Host-memory fraction above which the monitor kills the worker "
+       "(base/monitor.py); unset = disabled."),
+    # -- tracing: TWO distinct trace trees (near-collision, kept) --------
+    _k("AREAL_DUMP_TRACE", "bool", False,
+       "Arm jax.profiler XLA/device trace dumps "
+       "(utils/profiling.py). Distinct from AREAL_RL_TRACE, which "
+       "records request-scoped RL spans."),
+    _k("AREAL_TRACE_DIR", "str", "/tmp/areal_tpu/traces",
+       "Output root for AREAL_DUMP_TRACE jax-profiler dumps. NOT the "
+       "RL span dir — that is AREAL_RL_TRACE_DIR. The names nearly "
+       "collide; both are load-bearing and documented here on purpose "
+       "(lint env-knob checker would flag a third variant)."),
+    _k("AREAL_TRACE_STEPS", "str", "",
+       "Comma/range list of train steps to profile under "
+       "AREAL_DUMP_TRACE (utils/profiling.py); empty = all."),
+    _k("AREAL_RL_TRACE", "bool", False,
+       "Arm the request-scoped RL span recorder (base/tracing.py; "
+       "merge tool: scripts/merge_rl_trace.py)."),
+    _k("AREAL_RL_TRACE_DIR", "str", None,
+       "Output dir for RL span shards; unset = "
+       "/tmp/areal_tpu/rl_trace[/<scope>]. See AREAL_TRACE_DIR note."),
+    _k("AREAL_RL_TRACE_RING", "int", 65536,
+       "Span ring-buffer capacity per worker before drops "
+       "(base/tracing.py).", snapshot=True),
+    # -- ops -------------------------------------------------------------
+    _k("AREAL_CE_CHUNK", "int", None,
+       "Cross-entropy vocab-chunk size override (ops/loss.py); unset = "
+       "heuristic. Snapshotted at first use per jit trace.",
+       snapshot=True),
+    _k("AREAL_SPLASH_BQ", "int", 512,
+       "Splash-attention query block target (ops/attention.py); "
+       "pinned at engine construction.", snapshot=True),
+    _k("AREAL_SPLASH_BKV", "int", 1024,
+       "Splash-attention KV block target.", snapshot=True),
+    _k("AREAL_SPLASH_BKVC", "int", 512,
+       "Splash-attention KV-compute block target.", snapshot=True),
+    # -- functioncall ----------------------------------------------------
+    _k("AREAL_SYMPY_TIMEOUT_S", "float", 3.0,
+       "Per-expression sympy equivalence-check timeout "
+       "(functioncall/math_grader.py)."),
+    _k("AREAL_PYEXEC_TIMEOUT", "float", 6.0,
+       "Sandboxed python-answer execution timeout seconds "
+       "(functioncall/python_answer.py)."),
+    # -- system ----------------------------------------------------------
+    _k("AREAL_WEIGHT_PLANE", "bool", False,
+       "Arm the streaming weight-distribution plane without config "
+       "plumbing (system/model_worker.py; GserverManagerConfig."
+       "weight_plane is the first-class switch)."),
+    _k("AREAL_WEIGHT_LOAD_RETRIES", "int", 40,
+       "NFS weight-load retry attempts while a dump lands "
+       "(system/weight_transfer.py)."),
+    _k("AREAL_WEIGHT_LOAD_RETRY_S", "float", 0.25,
+       "Sleep seconds between weight-load retries."),
+    # -- bench -----------------------------------------------------------
+    _k("AREAL_BENCH_BANK", "str", None,
+       "Bench evidence-bank directory; unset = "
+       "$TMPDIR/areal_bench_bank (bench/bank.py)."),
+    _k("AREAL_BENCH_STATE_TTL_S", "float", 6 * 3600.0,
+       "Age beyond which banked device state is stale for reporting "
+       "(bench/bank.py, bench/report.py)."),
+    _k("AREAL_BENCH_POLL_S", "float", 10.0,
+       "Bench daemon device-poll interval seconds (bench/daemon.py)."),
+    _k("AREAL_BENCH_WINDOW_HINT_S", "float", 90.0,
+       "Optimistic device-window length hint the daemon plans phases "
+       "against (bench/daemon.py)."),
+    _k("AREAL_BENCH_MAX_ATTEMPTS", "int", 3,
+       "Attempts per bench phase before the daemon banks a failure "
+       "(bench/daemon.py)."),
+    _k("AREAL_BENCH_DEVICE_BUDGET_S", "float", 300.0,
+       "Per-phase device-seconds budget (bench/devices.py, "
+       "bench/workloads.py)."),
+    _k("AREAL_BENCH_INIT_BACKOFF_S", "float", 5.0,
+       "Backoff after a failed device grab (bench/devices.py)."),
+    _k("AREAL_BENCH_PHASE_DEADLINE_S", "float", None,
+       "Hard wall-clock deadline override for one phase subprocess "
+       "(bench/phases.py); unset = per-phase default."),
+    _k("AREAL_BENCH_PHASE_MODULES", "str", "",
+       "Comma list of extra modules to import for phase registration "
+       "(bench/phases.py)."),
+    _k("AREAL_XLA_CACHE_DIR", "str", None,
+       "Persistent XLA compilation-cache dir; unset = "
+       "$TMPDIR/areal_xla_cache (bench/runner.py)."),
+    _k("AREAL_TTFT_SLO_MS", "float", None,
+       "p99-TTFT SLO stamped onto open-loop bench records and gated "
+       "by the report validator (bench/workloads.py); unset = no SLO."),
+    _k("AREAL_OPENLOOP_SERVERS", "int", 2,
+       "Open-loop bench: generation-server process count."),
+    _k("AREAL_OPENLOOP_POINT_S", "float", 3.0,
+       "Open-loop bench: seconds per arrival-rate sweep point."),
+    _k("AREAL_OPENLOOP_RATES", "str", "0.25,1.0,3.0",
+       "Open-loop bench: comma list of arrival-rate multipliers."),
+    _k("AREAL_OPENLOOP_WATERMARK", "int", 8,
+       "Open-loop bench: admission watermark (queued prompt kilotokens "
+       "per server)."),
+    _k("AREAL_OPENLOOP_MAX_RPS", "float", 12.0,
+       "Open-loop bench: arrival-rate ceiling."),
+    _k("AREAL_DISAGG_LONG_PLEN", "int", 768,
+       "Disagg A/B bench: long-prefill prompt length."),
+    _k("AREAL_DISAGG_SHORT_PLEN", "int", 16,
+       "Disagg A/B bench: short (decode-stream) prompt length."),
+    _k("AREAL_DISAGG_STREAMS", "int", 3,
+       "Disagg A/B bench: concurrent decode streams."),
+    _k("AREAL_DISAGG_STREAM_TOKENS", "int", 260,
+       "Disagg A/B bench: max new tokens per decode stream."),
+    _k("AREAL_DISAGG_N_LONG", "int", 5,
+       "Disagg A/B bench: number of long prefills injected."),
+    _k("AREAL_DISAGG_LONG_GAP_S", "float", 0.7,
+       "Disagg A/B bench: gap between long-prefill injections."),
+    _k("AREAL_DISAGG_LONG_MAX_NEW", "int", 8,
+       "Disagg A/B bench: max new tokens per long prefill."),
+]
+
+REGISTRY: Dict[str, Knob] = {k.name: k for k in _KNOBS}
+assert len(REGISTRY) == len(_KNOBS), "duplicate knob declaration"
+
+# Accessor names areal_tpu/lint's env-knob checker recognizes as
+# registry-routed reads (keep in sync with the functions below).
+ACCESSOR_NAMES = (
+    "get_raw", "get_str", "get_int", "get_float", "get_bool", "is_set",
+)
+
+
+class UndeclaredKnobError(KeyError):
+    pass
+
+
+def _knob(name: str) -> Knob:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise UndeclaredKnobError(
+            f"{name} is not declared in areal_tpu.base.env_registry; "
+            f"add a Knob entry (the env-knob lint checker enforces this)"
+        ) from None
+
+
+def get_raw(name: str) -> Optional[str]:
+    """Raw string value, or None when unset/empty. For call sites with
+    bespoke parsing; still validates the knob is declared."""
+    _knob(name)
+    v = os.environ.get(name)
+    return v if v else None
+
+
+def is_set(name: str) -> bool:
+    _knob(name)
+    return bool(os.environ.get(name))
+
+
+def get_str(name: str) -> Optional[str]:
+    k = _knob(name)
+    v = os.environ.get(name)
+    return v if v else k.default
+
+
+def get_int(name: str) -> Optional[int]:
+    k = _knob(name)
+    v = os.environ.get(name)
+    if not v:
+        return k.default
+    try:
+        return int(v)
+    except ValueError as e:
+        raise ValueError(f"{name}={v!r}: expected an integer") from e
+
+
+def get_float(name: str) -> Optional[float]:
+    k = _knob(name)
+    v = os.environ.get(name)
+    if not v:
+        return k.default
+    try:
+        return float(v)
+    except ValueError as e:
+        raise ValueError(f"{name}={v!r}: expected a float") from e
+
+
+def get_bool(name: str) -> bool:
+    k = _knob(name)
+    v = os.environ.get(name)
+    if not v:
+        # unset OR empty falls back to the default, like every other
+        # getter (the module contract) — not straight to False.
+        return bool(k.default)
+    return v.strip().lower() not in _FALSEY
+
+
+def render_docs() -> str:
+    """Markdown for docs/env_vars.md — generated, drift-gated; never
+    hand-edit the output file."""
+    lines = [
+        "# `AREAL_*` environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. Source of truth: "
+        "areal_tpu/base/env_registry.py. Regenerate with: "
+        "python scripts/areal_lint.py --emit-env-docs docs/env_vars.md "
+        "-->",
+        "",
+        "Every knob the system reads, generated from the registry the "
+        "`env-knob` lint checker enforces. *Snapshot* knobs are read "
+        "once at construction and pinned; editing them mid-run has no "
+        "effect by design. Unset or empty values fall back to the "
+        "default; `-` means the default is dynamic or None (see "
+        "description).",
+        "",
+        "| Knob | Type | Default | Snapshot | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(REGISTRY.values(), key=lambda k: k.name):
+        default = "-" if k.default is None else repr(k.default)
+        snap = "yes" if k.snapshot else ""
+        doc = k.doc.replace("|", "\\|")
+        lines.append(
+            f"| `{k.name}` | {k.kind} | {default} | {snap} | {doc} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

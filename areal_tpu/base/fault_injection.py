@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from areal_tpu.base import logging
+from areal_tpu.base import env_registry, logging
 
 logger = logging.getLogger("fault_injection")
 
@@ -118,7 +118,7 @@ class FaultInjector:
         lazily on the first maybe_fail so spawned workers pick the spec
         up without any bootstrap wiring."""
         if spec is None:
-            spec = os.environ.get("AREAL_FAULTS", "")
+            spec = env_registry.get_str("AREAL_FAULTS")
         with self._lock:
             self._env_loaded = True
         for entry in filter(None, (e.strip() for e in spec.split(";"))):

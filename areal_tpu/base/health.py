@@ -27,12 +27,11 @@ signal consumers key off.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import Callable, Dict, Optional
 
-from areal_tpu.base import logging, name_resolve, names
+from areal_tpu.base import env_registry, logging, name_resolve, names
 
 logger = logging.getLogger("health")
 
@@ -45,7 +44,7 @@ STALE_FACTOR = 3.0
 def default_ttl() -> float:
     """Heartbeat TTL (seconds). AREAL_HEALTH_TTL overrides for tests and
     chaos drills that need sub-second failure detection."""
-    return float(os.environ.get("AREAL_HEALTH_TTL", 10.0))
+    return env_registry.get_float("AREAL_HEALTH_TTL")
 
 
 class Heartbeat:

@@ -13,6 +13,8 @@ import os
 import sys
 from typing import Dict, Optional
 
+from areal_tpu.base import env_registry
+
 _FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
 _DATE_FORMAT = "%Y%m%d-%H:%M:%S"
 
@@ -45,7 +47,7 @@ def getLogger(name: str = "areal_tpu", file_path: Optional[str] = None) -> loggi
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
         logger.addHandler(handler)
-        logger.setLevel(os.environ.get("AREAL_LOG_LEVEL", "INFO").upper())
+        logger.setLevel(env_registry.get_str("AREAL_LOG_LEVEL").upper())
         logger.propagate = False
     if file_path is not None and (name, file_path) not in _configured_sinks:
         if os.path.dirname(file_path):

@@ -201,12 +201,11 @@ def check_memory_kill_threshold(stats: Optional[dict] = None, devices=None):
     """Raise DeviceOOMGuardError when usage exceeds the env threshold.
 
     No-op when the env var is unset or the backend reports no stats."""
-    import os
+    from areal_tpu.base import env_registry
 
-    raw = os.environ.get(MEMORY_KILL_THRESHOLD_ENV)
-    if not raw:
+    threshold = env_registry.get_float(MEMORY_KILL_THRESHOLD_ENV)
+    if threshold is None:
         return
-    threshold = float(raw)
     stats = stats if stats is not None else device_memory_stats(devices)
     if stats["mem_bytes_limit"] and stats["mem_frac_in_use"] > threshold:
         raise DeviceOOMGuardError(

@@ -27,6 +27,7 @@ import uuid
 from abc import ABC, abstractmethod
 from typing import Callable, Dict, List, Optional
 
+from areal_tpu.base import env_registry
 from areal_tpu.base import logging as areal_logging
 
 logger = areal_logging.getLogger("name_resolve")
@@ -209,7 +210,7 @@ class NfsNameRecordRepository(NameRecordRepository):
     a reader treats records older than their TTL as absent.
     """
 
-    RECORD_ROOT = os.environ.get("AREAL_NAME_RESOLVE_ROOT", "/tmp/areal_tpu/name_resolve")
+    RECORD_ROOT = env_registry.get_str("AREAL_NAME_RESOLVE_ROOT")
 
     def __init__(self, record_root: Optional[str] = None):
         self._root = record_root or self.RECORD_ROOT

@@ -51,11 +51,12 @@ import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional
 
+from areal_tpu.base import env_registry
+
 _ENV_ENABLE = "AREAL_RL_TRACE"
 _ENV_DIR = "AREAL_RL_TRACE_DIR"
 _ENV_RING = "AREAL_RL_TRACE_RING"
 _DEFAULT_DIR = "/tmp/areal_tpu/rl_trace"
-_DEFAULT_RING = 65536
 _FLUSH_EVERY = 512
 
 # Cached enablement: None = not yet read from the environment. The hot
@@ -94,12 +95,12 @@ class SpanContext:
 def enabled() -> bool:
     global _ENABLED
     if _ENABLED is None:
-        _ENABLED = os.environ.get(_ENV_ENABLE, "0") not in ("", "0", "false")
+        _ENABLED = env_registry.get_bool(_ENV_ENABLE)
     return _ENABLED
 
 
 def trace_dir() -> str:
-    d = os.environ.get(_ENV_DIR)
+    d = env_registry.get_str(_ENV_DIR)
     if d:
         return d
     if _SCOPE:
@@ -151,7 +152,7 @@ class _Recorder:
 
     def __init__(self, worker: str):
         self.worker = worker
-        self.capacity = int(os.environ.get(_ENV_RING, _DEFAULT_RING))
+        self.capacity = env_registry.get_int(_ENV_RING)
         self._buf: List[Dict] = []
         self._lock = threading.Lock()
         self.n_dropped = 0

@@ -1,0 +1,34 @@
+"""The ONE module allowed to spell ``areal-*/vN`` wire-schema strings.
+
+Every serialized artifact that crosses a process boundary stamps a
+schema tag so a reader can reject payloads from a different protocol
+generation (kv handoff, weight chunk manifests, trainer slab layouts,
+bench records). Those tags used to be module-local literals in four
+files — a version bump touching three of them would silently fork the
+protocol. The ``wire-schema`` checker in ``areal_tpu/lint`` now flags
+any ``areal-*/vN`` string literal outside this module, so a bump is a
+one-line change here plus the readers' compat logic.
+
+Bumping a version: add the new constant (keep the old one while any
+reader in the fleet still accepts it), update the producers, then
+retire the old constant — the env-knob checker's dead-entry analogue
+here is simply the unused-name report from ruff.
+
+Stdlib-only; imported by the no-jax lint gate.
+"""
+
+# Paged-KV prefill->decode handoff payload (engine/kv_handoff.py).
+KV_HANDOFF_V1 = "areal-kv-handoff/v1"
+
+# Content-hashed weight chunk stream + manifest (base/chunking.py).
+WEIGHT_CHUNKS_V1 = "areal-weight-chunks/v1"
+
+# Trainer dump layout sidecar (system/weight_transfer.py).
+WEIGHT_LAYOUT_V1 = "areal-weight-layout/v1"
+
+# Shard-local trainer slab index (system/weight_transfer.py).
+WEIGHT_SLABS_V1 = "areal-weight-slabs/v1"
+
+# Banked bench evidence record / aggregated report (bench/bank.py).
+BENCH_RECORD_V1 = "areal-bench-record/v1"
+BENCH_REPORT_V1 = "areal-bench-report/v1"

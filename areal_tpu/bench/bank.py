@@ -48,10 +48,12 @@ import tempfile
 import time
 from typing import Dict, Optional, Tuple
 
+from areal_tpu.base import env_registry
+from areal_tpu.base.wire_schemas import (
+    BENCH_RECORD_V1 as RECORD_SCHEMA,
+    BENCH_REPORT_V1 as REPORT_SCHEMA,
+)
 from areal_tpu.bench._util import repo_root
-
-RECORD_SCHEMA = "areal-bench-record/v1"
-REPORT_SCHEMA = "areal-bench-report/v1"
 
 PASSES = ("compile", "measure")
 STATUSES = ("ok", "failed", "timeout")
@@ -64,9 +66,8 @@ ATTESTATION_KEYS = (
 
 
 def bank_dir(override: Optional[str] = None) -> str:
-    return override or os.environ.get(
-        "AREAL_BENCH_BANK",
-        os.path.join(tempfile.gettempdir(), "areal_bench_bank"),
+    return override or env_registry.get_str("AREAL_BENCH_BANK") or (
+        os.path.join(tempfile.gettempdir(), "areal_bench_bank")
     )
 
 
@@ -310,7 +311,7 @@ def is_banked(
     measured on `platform` (stale or cross-platform records must not
     short-circuit a re-run)."""
     if max_age_s is None:
-        max_age_s = float(os.environ.get("AREAL_BENCH_STATE_TTL_S", 6 * 3600))
+        max_age_s = env_registry.get_float("AREAL_BENCH_STATE_TTL_S")
     rec = load_record(bank_dir(bank), phase, pass_, platform)
     if rec is None or rec["status"] != "ok":
         return False

@@ -36,6 +36,7 @@ import sys
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from areal_tpu.base import env_registry
 from areal_tpu.bench import bank, phases, runner
 from areal_tpu.bench._util import log, repo_root
 from areal_tpu.bench.devices import classify_device_error
@@ -133,13 +134,13 @@ class BenchDaemon:
         self.poll_interval_s = (
             poll_interval_s
             if poll_interval_s is not None
-            else float(os.environ.get("AREAL_BENCH_POLL_S", 10.0))
+            else env_registry.get_float("AREAL_BENCH_POLL_S")
         )
         self.max_poll_interval_s = max_poll_interval_s
         self.window_hint_s = (
             window_hint_s
             if window_hint_s is not None
-            else float(os.environ.get("AREAL_BENCH_WINDOW_HINT_S", 90.0))
+            else env_registry.get_float("AREAL_BENCH_WINDOW_HINT_S")
         )
         self.clock = clock
         self.sleep = sleep
@@ -148,7 +149,7 @@ class BenchDaemon:
         self._window_opened_at: Optional[float] = None
         # In-memory failure counts per (phase, pass): a deterministically
         # crashing phase must not eat every window the tunnel offers.
-        self.max_attempts = int(os.environ.get("AREAL_BENCH_MAX_ATTEMPTS", 3))
+        self.max_attempts = env_registry.get_int("AREAL_BENCH_MAX_ATTEMPTS")
         self._attempts: Dict[Tuple[str, str], int] = {}
 
     # -- window accounting ---------------------------------------------

@@ -18,11 +18,11 @@ both identically with a fixed attempt count.
 
 from __future__ import annotations
 
-import os
 import sys
 import time
 from typing import Callable, List, Optional
 
+from areal_tpu.base import env_registry
 from areal_tpu.bench._util import log
 
 
@@ -88,9 +88,9 @@ def get_devices_with_retry(
 
     `devices_fn`/`sleep`/`clock` are injectable for tests."""
     if budget_s is None:
-        budget_s = float(os.environ.get("AREAL_BENCH_DEVICE_BUDGET_S", 300.0))
+        budget_s = env_registry.get_float("AREAL_BENCH_DEVICE_BUDGET_S")
     if backoff_s is None:
-        backoff_s = float(os.environ.get("AREAL_BENCH_INIT_BACKOFF_S", 5.0))
+        backoff_s = env_registry.get_float("AREAL_BENCH_INIT_BACKOFF_S")
 
     if devices_fn is None:
         import jax

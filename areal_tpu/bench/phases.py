@@ -33,8 +33,9 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
-import os
 from typing import Callable, Dict, List, Optional
+
+from areal_tpu.base import env_registry
 
 # How far a phase may overrun its estimate before the runner kills it.
 DEADLINE_FACTOR = 3.0
@@ -69,9 +70,9 @@ class PhaseSpec:
         return self.est_compile_s if pass_ == "compile" else self.est_measure_s
 
     def deadline_s(self, pass_: str) -> float:
-        env = os.environ.get("AREAL_BENCH_PHASE_DEADLINE_S")
-        if env:
-            return float(env)
+        env = env_registry.get_float("AREAL_BENCH_PHASE_DEADLINE_S")
+        if env is not None:
+            return env
         return max(self.cost(pass_) * DEADLINE_FACTOR, MIN_DEADLINE_S)
 
 
@@ -114,7 +115,7 @@ def load_extra_modules(spec: Optional[str] = None) -> None:
     it."""
     global _EXTRA_LOADED
     if spec is None:
-        spec = os.environ.get("AREAL_BENCH_PHASE_MODULES", "")
+        spec = env_registry.get_str("AREAL_BENCH_PHASE_MODULES")
     if spec == _EXTRA_LOADED:
         return
     _EXTRA_LOADED = spec

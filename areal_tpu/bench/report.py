@@ -28,6 +28,7 @@ import sys
 import time
 from typing import Dict, Optional
 
+from areal_tpu.base import env_registry
 from areal_tpu.bench import bank, phases
 from areal_tpu.bench._util import repo_root
 from areal_tpu.bench.workloads import BASELINE_TFLOPS
@@ -55,7 +56,7 @@ def build_report(
     # Freshness gate mirrors is_banked's resume TTL: an ok record left
     # over from an old interrupted round must never be published as this
     # round's evidence (it becomes a missing phase -> partial instead).
-    max_age_s = float(os.environ.get("AREAL_BENCH_STATE_TTL_S", 6 * 3600))
+    max_age_s = env_registry.get_float("AREAL_BENCH_STATE_TTL_S")
     records = bank.load_bank(bank_path, max_age_s=max_age_s)
     measures = {p: r for (p, ps), r in records.items() if ps == "measure"}
     compiles = {p: r for (p, ps), r in records.items() if ps == "compile"}

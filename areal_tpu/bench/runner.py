@@ -32,6 +32,7 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from areal_tpu.base import env_registry
 from areal_tpu.bench import bank, phases
 from areal_tpu.bench._util import log, repo_root
 
@@ -231,9 +232,8 @@ def enable_compilation_cache() -> None:
     subprocess dies, the cache entries survive."""
     import jax
 
-    cache_dir = os.environ.get(
-        "AREAL_XLA_CACHE_DIR",
-        os.path.join(tempfile.gettempdir(), "areal_xla_cache"),
+    cache_dir = env_registry.get_str("AREAL_XLA_CACHE_DIR") or (
+        os.path.join(tempfile.gettempdir(), "areal_xla_cache")
     )
     try:
         os.makedirs(cache_dir, exist_ok=True)

@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+from areal_tpu.base import env_registry
 from areal_tpu.bench._util import log, repo_root
 from areal_tpu.bench.devices import get_devices_with_retry
 
@@ -339,8 +340,8 @@ def serving_http_phase(pass_: str) -> dict:
     # fail 'device busy' on the one platform the phase exists to measure.
     from areal_tpu.bench.daemon import probe_devices
 
-    p = probe_devices(timeout_s=float(
-        os.environ.get("AREAL_BENCH_DEVICE_BUDGET_S", 300.0)))
+    p = probe_devices(
+        timeout_s=env_registry.get_float("AREAL_BENCH_DEVICE_BUDGET_S"))
     if p.status != "up":
         raise RuntimeError(f"serving_http: no device ({p.status}): "
                            f"{p.detail[:300]}")
@@ -491,7 +492,7 @@ def _ttft_slo_fields(headline_p99: float) -> dict:
     set, the banked record carries the configured limit and whether its
     headline p99 violated it — the report/validator refuse to leave a
     violating record silently headline-eligible."""
-    slo = os.environ.get("AREAL_TTFT_SLO_MS")
+    slo = env_registry.get_float("AREAL_TTFT_SLO_MS")
     if not slo:
         return {}
     return {
@@ -506,8 +507,8 @@ def serving_openloop_phase(pass_: str) -> dict:
         warm_admit_shapes,
     )
 
-    n_servers = int(os.environ.get("AREAL_OPENLOOP_SERVERS") or 2)
-    point_s = float(os.environ.get("AREAL_OPENLOOP_POINT_S") or 3.0)
+    n_servers = env_registry.get_int("AREAL_OPENLOOP_SERVERS")
+    point_s = env_registry.get_float("AREAL_OPENLOOP_POINT_S")
     # Multiples of the CLOSED-LOOP capacity (batched admission, the
     # engine's peak). Open-loop sustainable throughput is lower — a
     # trickle arrival admits in singletons and loses prefill batching —
@@ -515,11 +516,11 @@ def serving_openloop_phase(pass_: str) -> dict:
     # overload.
     rate_mults = [
         float(x)
-        for x in (os.environ.get("AREAL_OPENLOOP_RATES") or "0.25,1.0,3.0")
+        for x in env_registry.get_str("AREAL_OPENLOOP_RATES")
         .split(",")
         if x
     ]
-    watermark = int(os.environ.get("AREAL_OPENLOOP_WATERMARK") or 8)
+    watermark = env_registry.get_int("AREAL_OPENLOOP_WATERMARK")
     plen, max_new, vocab = 16, 16, _OPENLOOP_MODEL["vocab_size"]
     t_start = time.monotonic()
     rng = np.random.RandomState(5)
@@ -566,7 +567,7 @@ def serving_openloop_phase(pass_: str) -> dict:
         # stays honest; the measured capacity is still banked.
         sweep_base = min(
             capacity,
-            float(os.environ.get("AREAL_OPENLOOP_MAX_RPS") or 12.0),
+            env_registry.get_float("AREAL_OPENLOOP_MAX_RPS"),
         )
         log(f"bench: serving_openloop capacity ~{capacity:.1f} req/s, "
             f"sweep base {sweep_base:.1f} req/s "
@@ -671,16 +672,16 @@ def serving_disagg_phase(pass_: str) -> dict:
     # running decode stream in the unified arm; the per-token base ITL
     # is ~4-16 ms, so one collision pushes a slot's samples several
     # log2 buckets up.
-    long_plen = int(os.environ.get("AREAL_DISAGG_LONG_PLEN") or 768)
-    short_plen = int(os.environ.get("AREAL_DISAGG_SHORT_PLEN") or 16)
-    n_streams = int(os.environ.get("AREAL_DISAGG_STREAMS") or 3)
+    long_plen = env_registry.get_int("AREAL_DISAGG_LONG_PLEN")
+    short_plen = env_registry.get_int("AREAL_DISAGG_SHORT_PLEN")
+    n_streams = env_registry.get_int("AREAL_DISAGG_STREAMS")
     # Streams must OUTLIVE the last long injection (gap * n_long plus
     # the prefill time itself), or tail injections land on an idle
     # fleet and measure nothing.
-    stream_max_new = int(os.environ.get("AREAL_DISAGG_STREAM_TOKENS") or 260)
-    n_long = int(os.environ.get("AREAL_DISAGG_N_LONG") or 5)
-    long_gap_s = float(os.environ.get("AREAL_DISAGG_LONG_GAP_S") or 0.7)
-    long_max_new = int(os.environ.get("AREAL_DISAGG_LONG_MAX_NEW") or 8)
+    stream_max_new = env_registry.get_int("AREAL_DISAGG_STREAM_TOKENS")
+    n_long = env_registry.get_int("AREAL_DISAGG_N_LONG")
+    long_gap_s = env_registry.get_float("AREAL_DISAGG_LONG_GAP_S")
+    long_max_new = env_registry.get_int("AREAL_DISAGG_LONG_MAX_NEW")
     t_start = time.monotonic()
 
     if pass_ == "compile":
@@ -1459,70 +1460,75 @@ def train_tflops_scaling_phase(pass_: str) -> dict:
     def weight(mb):
         return float(np.sum(mb.data["loss_mask"]))
 
-    t_start = time.monotonic()
-    points = []
-    compile_s = 0.0
-    for n in ns:
-        mesh = make_mesh(MeshSpec(data=1, fsdp=n), devices[:n])
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        n_params = count_params(params)
-        eng = JaxTrainEngine(
-            cfg, params, mesh=mesh,
-            optimizer_config=OptimizerConfig(
-                lr=1e-4, warmup_steps_proportion=0.0
-            ),
-            total_train_steps=1000, row_len_multiple=seqlen,
-            max_row_len=seqlen, remat=remat,
-        )
-        rng = np.random.RandomState(0)
-        n_seqs = base_seqs * n  # weak scaling
-        seqlens = [seqlen] * n_seqs
-        total = seqlen * n_seqs
-        batch = SequenceSample.from_default(
-            ids=[f"b{n}-{i}" for i in range(n_seqs)],
-            seqlens=seqlens,
-            data={
-                "packed_input_ids": rng.randint(
-                    0, cfg.vocab_size, size=total
+    # Same-shape compiles under multiple meshes poison the persistent
+    # XLA cache on this jax (entries segfault later warm processes) —
+    # the train_sharded gotcha; this phase mixes meshes too, so it
+    # opts out of the cache the same way.
+    with _without_persistent_xla_cache():
+        t_start = time.monotonic()
+        points = []
+        compile_s = 0.0
+        for n in ns:
+            mesh = make_mesh(MeshSpec(data=1, fsdp=n), devices[:n])
+            params = init_params(cfg, jax.random.PRNGKey(0))
+            n_params = count_params(params)
+            eng = JaxTrainEngine(
+                cfg, params, mesh=mesh,
+                optimizer_config=OptimizerConfig(
+                    lr=1e-4, warmup_steps_proportion=0.0
                 ),
-                "loss_mask": np.ones(total, np.float32),
-            },
-        )
-        mb_spec = MicroBatchSpec(n_mbs=1)
-        if pass_ == "compile":
-            t0 = time.perf_counter()
-            compile_s += eng.warm(batch, mb_spec, packed_loss,
-                                  loss_name="bench")
-            eng.train_batch(batch, mb_spec, packed_loss, weight,
-                            version_steps=0, loss_name="bench")
+                total_train_steps=1000, row_len_multiple=seqlen,
+                max_row_len=seqlen, remat=remat,
+            )
+            rng = np.random.RandomState(0)
+            n_seqs = base_seqs * n  # weak scaling
+            seqlens = [seqlen] * n_seqs
+            total = seqlen * n_seqs
+            batch = SequenceSample.from_default(
+                ids=[f"b{n}-{i}" for i in range(n_seqs)],
+                seqlens=seqlens,
+                data={
+                    "packed_input_ids": rng.randint(
+                        0, cfg.vocab_size, size=total
+                    ),
+                    "loss_mask": np.ones(total, np.float32),
+                },
+            )
+            mb_spec = MicroBatchSpec(n_mbs=1)
+            if pass_ == "compile":
+                t0 = time.perf_counter()
+                compile_s += eng.warm(batch, mb_spec, packed_loss,
+                                      loss_name="bench")
+                eng.train_batch(batch, mb_spec, packed_loss, weight,
+                                version_steps=0, loss_name="bench")
+                jax.block_until_ready(eng.params)
+                log(f"bench: scaling compile n={n} "
+                    f"{time.perf_counter() - t0:.1f}s")
+                del eng
+                continue
+            for i in range(n_warmup):
+                eng.train_batch(batch, mb_spec, packed_loss, weight,
+                                version_steps=i, loss_name="bench")
             jax.block_until_ready(eng.params)
-            log(f"bench: scaling compile n={n} "
-                f"{time.perf_counter() - t0:.1f}s")
-            del eng
-            continue
-        for i in range(n_warmup):
-            eng.train_batch(batch, mb_spec, packed_loss, weight,
-                            version_steps=i, loss_name="bench")
-        jax.block_until_ready(eng.params)
-        t0 = time.perf_counter()
-        for i in range(n_steps):
-            eng.train_batch(batch, mb_spec, packed_loss, weight,
-                            version_steps=n_warmup + i, loss_name="bench")
-        jax.block_until_ready(eng.params)
-        dt = (time.perf_counter() - t0) / n_steps
-        flops = train_step_flops(cfg, n_params, seqlens)
-        per_chip = flops / dt / 1e12 / n
-        points.append({
-            "n_devices": float(n),
-            "mesh": str(MeshSpec(data=1, fsdp=n)),
-            "step_s": dt,
-            "tokens_per_sec": total / dt,
-            "train_tflops_total": flops / dt / 1e12,
-            "train_tflops_per_chip": per_chip,
-        })
-        log(f"bench: scaling n={n} {dt:.3f}s/step "
-            f"{per_chip:.1f} TFLOP/s/chip")
-        del eng  # free params+moments before the next (larger) mesh
+            t0 = time.perf_counter()
+            for i in range(n_steps):
+                eng.train_batch(batch, mb_spec, packed_loss, weight,
+                                version_steps=n_warmup + i, loss_name="bench")
+            jax.block_until_ready(eng.params)
+            dt = (time.perf_counter() - t0) / n_steps
+            flops = train_step_flops(cfg, n_params, seqlens)
+            per_chip = flops / dt / 1e12 / n
+            points.append({
+                "n_devices": float(n),
+                "mesh": str(MeshSpec(data=1, fsdp=n)),
+                "step_s": dt,
+                "tokens_per_sec": total / dt,
+                "train_tflops_total": flops / dt / 1e12,
+                "train_tflops_per_chip": per_chip,
+            })
+            log(f"bench: scaling n={n} {dt:.3f}s/step "
+                f"{per_chip:.1f} TFLOP/s/chip")
+            del eng  # free params+moments before the next (larger) mesh
 
     if pass_ == "compile":
         return {"compile_s": compile_s or (time.monotonic() - t_start)}

@@ -30,7 +30,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from areal_tpu.base import logging
+from areal_tpu.base import env_registry, logging
 
 logger = logging.getLogger("checkpoint")
 
@@ -56,7 +56,7 @@ def _engine_state(engine):
 
 
 def _ckpt_backend(backend: Optional[str]) -> str:
-    return backend or os.environ.get("AREAL_CKPT_BACKEND", "pickle")
+    return backend or env_registry.get_str("AREAL_CKPT_BACKEND")
 
 
 def save_engine_state(engine, save_dir: str, backend: Optional[str] = None):

@@ -26,7 +26,6 @@ broadcast across their span).
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -36,6 +35,7 @@ import numpy as np
 
 from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
 from areal_tpu.api.model_api import GenerationHyperparameters, TrainEngine
+from areal_tpu.base import env_registry
 from areal_tpu.base import logging as areal_logging
 from areal_tpu.base import stats_tracker
 from areal_tpu.models.config import TransformerConfig
@@ -133,9 +133,9 @@ class JaxTrainEngine(TrainEngine):
         # AREAL_PREFETCH_DEPTH is an A/B hook like AREAL_KV_CACHE_DTYPE,
         # snapshotted at construction so a mid-run env change cannot flip
         # the pipeline shape between steps.
-        env_depth = os.environ.get("AREAL_PREFETCH_DEPTH")
-        if env_depth:
-            prefetch_depth = int(env_depth)
+        env_depth = env_registry.get_int("AREAL_PREFETCH_DEPTH")
+        if env_depth is not None:
+            prefetch_depth = env_depth
         if prefetch_depth < 0:
             raise ValueError(f"prefetch_depth must be >= 0, got {prefetch_depth}")
         self.prefetch_depth = prefetch_depth

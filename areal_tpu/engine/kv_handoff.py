@@ -29,8 +29,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from areal_tpu.base.chunking import chunk_spans, hash_chunk
-
-HANDOFF_SCHEMA = "areal-kv-handoff/v1"
+from areal_tpu.base.wire_schemas import KV_HANDOFF_V1 as HANDOFF_SCHEMA
 
 # 256 KiB: handoff blobs are MB-scale (one request's KV), so chunks are
 # small enough that a torn transfer re-pays little and large enough

@@ -25,12 +25,12 @@ decode block are compiled once per engine lifetime.
 from __future__ import annotations
 
 import functools
-import os
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 
+from areal_tpu.base import env_registry
 from areal_tpu.models.config import TransformerConfig
 from areal_tpu.models.transformer import _mlp, _norm
 from areal_tpu.ops.wquant import qmat
@@ -519,7 +519,7 @@ def paged_chunk_prefill(
     # kernel's other prefetched scalars. AREAL_CHUNK_SMEM_BUDGET overrides
     # for tests (forcing n_sub > 1 on CPU pools too small to need it);
     # read at trace time, so set it before the first call in a process.
-    smem_budget = int(os.environ.get("AREAL_CHUNK_SMEM_BUDGET", 512 * 1024))
+    smem_budget = env_registry.get_int("AREAL_CHUNK_SMEM_BUDGET")
     rows_cap = max(8, smem_budget // (P * 4))
     # Balanced ceil-division with a padded tail, NOT a divisor search:
     # any chunk size (prime included) splits into n_sub equal sub-chunks;

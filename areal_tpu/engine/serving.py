@@ -40,7 +40,6 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
-import os
 import queue
 import threading
 import time
@@ -50,7 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from areal_tpu.base import logging, tracing
+from areal_tpu.base import env_registry, logging, tracing
 from areal_tpu.base.latency import LatencyHistogram
 from areal_tpu.engine.paged import (
     TRASH_PAGE,
@@ -152,6 +151,33 @@ def _prefill_batch(params, cfg: TransformerConfig, input_ids, lengths,
         logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
     )[:, 0]
     return last, k, v
+
+
+# Machine-checked engine-loop thread contract (areal_tpu/lint,
+# checker `loop-only`; docs/static_analysis.md). The attrs listed here
+# are owned by the engine loop thread and have NO locks by design —
+# the loop is the only writer/reader; `_run_on_loop` is the one legal
+# cross-thread door (closures run between laps). Off-loop code needing
+# a value reads a loop-maintained snapshot (e.g. `_backlog_len`,
+# `_kv_pages_free`) instead. `instance_hints` extends the check to
+# other modules: `self.engine.<attr>` in an HTTP handler is the same
+# race spelled differently.
+AREAL_LINT_LOOP_ONLY = {
+    "ServingEngine": {
+        "roots": ["_loop"],
+        "door": "_run_on_loop",
+        "attrs": [
+            "_backlog", "_prefix_cache", "_allocator",
+            "_k_pages", "_v_pages", "_dstate", "_page_table",
+            "_pt_dirty", "_pt_dev", "_len", "_pending_deact",
+            "_slot_req", "_slot_out", "_slot_lp", "_slot_vstart",
+            "_slot_pages", "_slot_emit_t", "_rng", "_history",
+            "_admit_inflight", "_blocks_since_admit",
+        ],
+        "init_ok": ["__init__"],
+        "instance_hints": ["engine", "eng"],
+    },
+}
 
 
 @jax.jit
@@ -283,7 +309,7 @@ class ServingEngine:
         # "int8 KV pools"). AREAL_KV_CACHE_DTYPE flips the default so
         # bench/probe A/Bs need no plumbing.
         if kv_cache_dtype is None:
-            kv_cache_dtype = os.environ.get("AREAL_KV_CACHE_DTYPE") or None
+            kv_cache_dtype = env_registry.get_str("AREAL_KV_CACHE_DTYPE")
         if kv_cache_dtype not in (None, "model", "int8"):
             raise ValueError(
                 f"kv_cache_dtype={kv_cache_dtype!r}: expected None, "
@@ -297,9 +323,7 @@ class ServingEngine:
         if speculative_draft_len == 0:
             # A/B hook, like AREAL_KV_CACHE_DTYPE: flips the default
             # without plumbing (bench/probe runs). Empty string == unset.
-            speculative_draft_len = int(
-                os.environ.get("AREAL_SPEC_DRAFT") or 0
-            )
+            speculative_draft_len = env_registry.get_int("AREAL_SPEC_DRAFT")
         assert speculative_draft_len >= 0 and speculative_ngram >= 1, (
             f"bad speculative config: draft_len={speculative_draft_len}, "
             f"ngram={speculative_ngram}"
@@ -312,8 +336,8 @@ class ServingEngine:
         # one. Default 1k recent tokens — where math-RL repeats live.
         # None = default/env; 0 = unbounded full-history scan.
         if speculative_window is None:
-            env_w = os.environ.get("AREAL_SPEC_WINDOW")
-            speculative_window = int(env_w) if env_w else 1024
+            env_w = env_registry.get_int("AREAL_SPEC_WINDOW")
+            speculative_window = env_w if env_w is not None else 1024
         assert speculative_window >= 0, (
             f"speculative_window must be >= 0 (0 = unbounded), got "
             f"{speculative_window}"
@@ -327,8 +351,8 @@ class ServingEngine:
         # stream per decode step; prefill keeps the bf16 params, so
         # prompt processing is identical to the unquantized engine.
         if decode_weight_dtype is None:
-            decode_weight_dtype = (
-                os.environ.get("AREAL_DECODE_WEIGHT_DTYPE") or None
+            decode_weight_dtype = env_registry.get_str(
+                "AREAL_DECODE_WEIGHT_DTYPE"
             )
         if decode_weight_dtype not in (None, "model", "int8"):
             raise ValueError(
@@ -444,6 +468,15 @@ class ServingEngine:
         # admission-prefill stalls between blocks — the interference
         # disaggregation removes — show up in the histogram.
         self._slot_emit_t = [0.0] * self.B
+        # Off-thread telemetry snapshots of loop-only state, refreshed
+        # once per serve-loop lap (and by _fail_all): queue_depth and
+        # metrics() are polled from the server/manager threads, and
+        # len(self._backlog) / self._allocator.n_free there were
+        # unlocked reads of engine-thread state (areal-lint loop-only).
+        # One-lap staleness is fine for an admission watermark; plain
+        # int stores are atomic under the GIL.
+        self._backlog_len = 0
+        self._kv_pages_free = self._allocator.n_free
         # Disaggregated-serving handoff telemetry.
         self.kv_exports = 0
         self.kv_export_bytes = 0
@@ -1013,8 +1046,9 @@ class ServingEngine:
 
     @property
     def queue_depth(self) -> int:
-        """Requests accepted but not yet admitted to a slot."""
-        return self._queue.qsize() + len(self._backlog)
+        """Requests accepted but not yet admitted to a slot. Uses the
+        loop-maintained backlog-length snapshot (loop-only contract)."""
+        return self._queue.qsize() + self._backlog_len
 
     def latency_snapshot(self, reset: bool = False) -> Dict[str, Any]:
         """Raw TTFT/ITL bucket counts (areal_tpu.base.latency edges) +
@@ -1046,7 +1080,7 @@ class ServingEngine:
             "itl_p99_ms": self.itl_hist.percentile(99.0),
             "ttft_count": float(self.ttft_hist.total()),
             "itl_count": float(self.itl_hist.total()),
-            "kv_pages_free": float(self._allocator.n_free),
+            "kv_pages_free": float(self._kv_pages_free),
             "kv_pages_total": float(self.n_pages - 1),
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
@@ -1144,14 +1178,18 @@ class ServingEngine:
         return [i for i in range(self.B) if self._slot_req[i] is None]
 
     def _drain_queue(self):
-        while True:
-            try:
+        try:
+            while True:
                 self._backlog.append(self._queue.get_nowait())
-            except queue.Empty:
-                return
+        except queue.Empty:
+            pass
+        # Keep the off-thread snapshot near-live across the queue ->
+        # backlog move, so queue_depth doesn't under-report for a lap.
+        self._backlog_len = len(self._backlog)
 
     def _pop_backlog(self, idx: int = 0) -> GenRequest:
         req = self._backlog.pop(idx)
+        self._backlog_len = len(self._backlog)
         with self._fatal_lock:
             self.queued_prompt_tokens = max(
                 0, self.queued_prompt_tokens - len(req.input_ids)
@@ -1794,6 +1832,7 @@ class ServingEngine:
         # if the failure hit partway through the slotting loop.
         reqs.extend(self._backlog)
         self._backlog.clear()
+        self._backlog_len = 0
         seen = {id(r) for r in reqs}
         reqs.extend(e[1] for e in self._admit_inflight
                     if id(e[1]) not in seen)
@@ -1828,6 +1867,9 @@ class ServingEngine:
         while not self._stop.is_set():
             # Handoff export/import closures (engine-thread state only).
             self._drain_cmds()
+            # Refresh the off-thread telemetry snapshots (see __init__).
+            self._backlog_len = len(self._backlog)
+            self._kv_pages_free = self._allocator.n_free
             if self._interrupt.is_set():
                 self._interrupt_all()
                 self._apply_pending_params()

@@ -22,7 +22,6 @@ judging behavior:
 from __future__ import annotations
 
 import multiprocessing
-import os
 import re
 from typing import Any, List, Optional, Tuple
 
@@ -30,7 +29,9 @@ from typing import Any, List, Optional, Tuple
 # a loaded machine (full test suite, busy CI) 3s starves legitimate
 # equivalences into False. AREAL_SYMPY_TIMEOUT_S widens the budget
 # without touching the production default (tests/conftest.py sets it).
-SYMPY_TIMEOUT_S = float(os.environ.get("AREAL_SYMPY_TIMEOUT_S", "3.0"))
+from areal_tpu.base import env_registry
+
+SYMPY_TIMEOUT_S = env_registry.get_float("AREAL_SYMPY_TIMEOUT_S")
 REL_TOL = 1e-4
 
 

@@ -18,7 +18,6 @@ code block, execution fails, or nothing is produced.
 
 from __future__ import annotations
 
-import os
 import re
 from typing import Optional
 
@@ -73,7 +72,9 @@ def _default_timeout() -> float:
     # Wall-time per program INCLUDING interpreter spawn; on a loaded CI
     # machine the spawn alone can take seconds, so tests raise this via
     # AREAL_PYEXEC_TIMEOUT rather than loosening the eval-time default.
-    return float(os.environ.get("AREAL_PYEXEC_TIMEOUT", 6.0))
+    from areal_tpu.base import env_registry
+
+    return env_registry.get_float("AREAL_PYEXEC_TIMEOUT")
 
 
 def execute_python_answer(

@@ -1,0 +1,26 @@
+"""areal-lint: repo-specific AST static analysis (stdlib ``ast`` only).
+
+Four checkers over the contracts the system already relies on but no
+generic tool enforces:
+
+- ``loop-only`` — engine-loop thread discipline (serving.py state that
+  has no locks *by design* may only be touched from the loop call
+  graph or through the ``_run_on_loop`` door);
+- ``blocking-async`` — no blocking work on an asyncio event loop
+  (``time.sleep``, sync HTTP, file I/O, subprocess, jax device ops
+  inside ``async def`` unless pushed to an executor);
+- ``env-knob`` — every ``AREAL_*`` env read goes through
+  ``areal_tpu.base.env_registry`` and every registry entry is alive;
+- ``wire-schema`` — ``areal-*/vN`` schema strings come from
+  ``areal_tpu.base.wire_schemas`` only.
+
+CLI: ``python scripts/areal_lint.py [paths...]``. Gate: a tier-1 test
+runs the linter over ``areal_tpu/`` and fails on any unallowlisted
+finding. See docs/static_analysis.md.
+
+This package must import neither jax nor anything that does: the gate
+asserts ``jax`` stays out of ``sys.modules``.
+"""
+
+from areal_tpu.lint.common import Finding, LintConfigError  # noqa: F401
+from areal_tpu.lint.runner import LintConfig, run_lint  # noqa: F401

@@ -1,0 +1,315 @@
+"""Checker ``blocking-async``: no blocking work on an asyncio loop.
+
+The tail-latency incidents this encodes: the model-sized staging
+buffer allocated on the serving loop (PR 5, fixed by pushing
+``ChunkStore`` construction to an executor) and cold-compile stalls
+misread as queueing (PR 7). One blocking call in an ``async def``
+handler stalls every in-flight response on that loop.
+
+A call is flagged when its *nearest* enclosing function is an
+``async def``. Work inside a nested sync ``def``/``lambda`` is exempt —
+that is exactly the ``run_in_executor`` / loop-door shape
+(``await loop.run_in_executor(None, _fetch)``); passing a blocking
+function as an executor *argument* is not a Call node, so the wrapped
+pattern never trips the checker. Two indirection holes are also
+covered, same-module only:
+
+- a nested sync helper defined in the async function and then called
+  directly from async code;
+- ``self._x()`` / bare ``helper()`` calls from async code where the
+  same-class method / module-level function (transitively) performs a
+  blocking call outside any nested def of its own.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "blocking-async"
+
+# Exact dotted calls (post import-alias resolution).
+BLOCKING_CALLS: Set[str] = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen", "os.makedirs", "os.replace", "os.rename",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "urllib.request.urlopen", "urllib.request.urlretrieve",
+    "shutil.copy", "shutil.copy2", "shutil.copytree", "shutil.rmtree",
+    "jax.device_get", "jax.device_put", "jax.block_until_ready",
+    "jax.make_array_from_single_device_arrays",
+    # Repo-specific CPU-bound helpers: sha256 over multi-MB chunks.
+    # ~10ms+ per call on the 2-core host — a decode stream's ITL budget.
+    "areal_tpu.base.chunking.verify_chunk",
+    "areal_tpu.base.chunking.build_chunk_index",
+    # name_resolve's default backend is files under AREAL_FILEROOT —
+    # NFS in production deployments, so a read is tens of ms of I/O.
+    "areal_tpu.base.name_resolve.get",
+    "areal_tpu.base.name_resolve.get_subtree",
+    "areal_tpu.base.name_resolve.add",
+    "areal_tpu.base.name_resolve.add_subentry",
+    "areal_tpu.base.name_resolve.delete",
+}
+
+# Any call rooted at these modules blocks (sync HTTP clients).
+BLOCKING_ROOTS: Set[str] = {"requests", "urllib3", "http.client"}
+
+# Builtins.
+BLOCKING_BUILTINS: Set[str] = {"open", "input"}
+
+# Method names that block regardless of receiver type. Deliberately
+# conservative: names here must be unambiguous enough that a false
+# positive is unlikely (``.read()``/``.join()`` are NOT listed).
+# The ServingEngine entries block on the engine-loop door (up to its
+# 60s timeout) or on device transfers — exactly the PR 7 class of
+# event-loop stall when called from an aiohttp handler.
+BLOCKING_METHODS: Set[str] = {
+    "read_text", "write_text", "read_bytes", "write_bytes",
+    "block_until_ready",
+    "export_kv_handoff", "import_kv_handoff", "update_params",
+    "cutover_params", "stage_shard_leaves", "cutover_shard_leaves",
+    "run_until_complete",
+}
+
+
+def _called_name(mod: Module, call: ast.Call) -> Optional[str]:
+    return mod.dotted_name(call.func)
+
+
+def _is_blocking_dotted(dotted: Optional[str]) -> bool:
+    return bool(dotted) and (
+        dotted in BLOCKING_CALLS
+        or dotted.split(".")[0] in BLOCKING_ROOTS
+        or dotted in BLOCKING_BUILTINS
+    )
+
+
+def _direct_blocking_line(mod: Module, fn: ast.FunctionDef) -> Optional[int]:
+    """Line of the first blocking call whose nearest enclosing function
+    is ``fn`` itself (blocking work inside a nested def is the executor
+    pattern and doesn't count)."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and _is_blocking_dotted(_called_name(mod, node))
+            and mod.enclosing_function(node) is fn
+        ):
+            return node.lineno
+    return None
+
+
+def _blocking_sync_callables(mod: Module):
+    """Same-module transitive blocking sets.
+
+    Returns ``(module_fns, methods_by_class)``: module-level sync
+    function names, and per-class sync method names, that (transitively
+    within the module/class) perform a blocking call in their own
+    bodies. Each maps name -> human-readable reason."""
+    tree = mod.tree
+    module_fns: dict = {}
+    fn_nodes: dict = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if isinstance(node, ast.FunctionDef):
+            fn_nodes[node.name] = node
+            line = _direct_blocking_line(mod, node)
+            if line is not None:
+                module_fns[node.name] = f"blocks at {mod.rel}:{line}"
+    # one transitive hop set at a time, to fixpoint
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fn_nodes.items():
+            if name in module_fns:
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in module_fns
+                    and mod.enclosing_function(node) is fn
+                ):
+                    module_fns[name] = (
+                        f"calls {node.func.id}() "
+                        f"({module_fns[node.func.id]})"
+                    )
+                    changed = True
+                    break
+
+    methods_by_class: dict = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {
+            n.name: n for n in cls.body if isinstance(n, ast.FunctionDef)
+        }
+        blocking: dict = {}
+        for name, m in methods.items():
+            line = _direct_blocking_line(mod, m)
+            if line is not None:
+                blocking[name] = f"blocks at {mod.rel}:{line}"
+            else:
+                # module-level blocking helpers called from the method
+                for node in ast.walk(m):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in module_fns
+                        and mod.enclosing_function(node) is m
+                    ):
+                        blocking[name] = (
+                            f"calls {node.func.id}() "
+                            f"({module_fns[node.func.id]})"
+                        )
+                        break
+        changed = True
+        while changed:
+            changed = False
+            for name, m in methods.items():
+                if name in blocking:
+                    continue
+                for node in ast.walk(m):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in blocking
+                        and mod.enclosing_function(node) is m
+                    ):
+                        blocking[name] = (
+                            f"calls self.{node.func.attr}() "
+                            f"({blocking[node.func.attr]})"
+                        )
+                        changed = True
+                        break
+        if blocking:
+            methods_by_class[cls.name] = (methods, blocking)
+    return module_fns, methods_by_class
+
+
+def check(mod: Module) -> List[Finding]:
+    findings: List[Finding] = []
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        enclosing = mod.enclosing_function(node)
+        if not isinstance(enclosing, ast.AsyncFunctionDef):
+            continue
+
+        reason = None
+        dotted = _called_name(mod, node)
+        if dotted is not None:
+            if dotted in BLOCKING_CALLS:
+                reason = f"blocking call {dotted}()"
+            elif dotted.split(".")[0] in BLOCKING_ROOTS:
+                reason = f"synchronous {dotted.split('.')[0]} call {dotted}()"
+            elif dotted in BLOCKING_BUILTINS:
+                reason = f"blocking builtin {dotted}()"
+        if (
+            reason is None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in BLOCKING_METHODS
+            # jnp arrays etc. are fine: only flag when the receiver is
+            # not itself awaited (awaited => asyncio object).
+            and not isinstance(mod.parent(node), ast.Await)
+        ):
+            reason = f"blocking method .{node.func.attr}()"
+        # threading.Event.wait lookalikes: a .wait() that is NOT awaited
+        # inside async code blocks the loop (asyncio .wait() must be
+        # awaited anyway, so an un-awaited one is a bug either way).
+        if (
+            reason is None
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "wait"
+            and not isinstance(mod.parent(node), ast.Await)
+        ):
+            reason = "un-awaited .wait() (threading.Event.wait blocks " \
+                     "the loop; asyncio waits must be awaited)"
+
+        if reason is not None:
+            findings.append(Finding(
+                mod.rel, node.lineno, CHECKER,
+                f"{reason} inside async def {enclosing.name!r}: move to "
+                f"run_in_executor (or the loop-door helper) so the event "
+                f"loop keeps serving",
+            ))
+
+    # Indirection hole #2: sync same-class methods / module functions
+    # that (transitively) block, invoked synchronously from async code.
+    module_fns, methods_by_class = _blocking_sync_callables(mod)
+    class_of_fn = {}
+    for cls in ast.walk(mod.tree):
+        if isinstance(cls, ast.ClassDef):
+            for n in cls.body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_of_fn[n] = cls.name
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        enclosing = mod.enclosing_function(node)
+        if not isinstance(enclosing, ast.AsyncFunctionDef):
+            continue
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in module_fns
+        ):
+            findings.append(Finding(
+                mod.rel, node.lineno, CHECKER,
+                f"sync call of {node.func.id}() from async def "
+                f"{enclosing.name!r}, and it {module_fns[node.func.id]}: "
+                f"hand it to run_in_executor",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "self"
+        ):
+            cls_name = class_of_fn.get(enclosing)
+            if cls_name in methods_by_class:
+                _, blocking = methods_by_class[cls_name]
+                m = node.func.attr
+                if m in blocking:
+                    findings.append(Finding(
+                        mod.rel, node.lineno, CHECKER,
+                        f"sync call of self.{m}() from async def "
+                        f"{enclosing.name!r}, and it {blocking[m]}: "
+                        f"hand it to run_in_executor",
+                    ))
+
+    # Residual hole: nested sync def containing blocking calls, invoked
+    # DIRECTLY from async code in the same function.
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        nested_blocking: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.FunctionDef) and mod.enclosing_function(sub) is fn:
+                for c in ast.walk(sub):
+                    if isinstance(c, ast.Call):
+                        d = _called_name(mod, c)
+                        if d and (d in BLOCKING_CALLS
+                                  or d.split(".")[0] in BLOCKING_ROOTS
+                                  or d in BLOCKING_BUILTINS):
+                            nested_blocking.add(sub.name)
+                            break
+        if not nested_blocking:
+            continue
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in nested_blocking
+                and mod.enclosing_function(node) is fn
+            ):
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"direct call of {node.func.id}() (which blocks) from "
+                    f"async def {fn.name!r}: hand it to run_in_executor "
+                    f"instead",
+                ))
+    return findings

@@ -1,0 +1,115 @@
+"""areal-lint CLI. Entry point: ``scripts/areal_lint.py``.
+
+Exit codes: 0 clean, 1 findings, 2 configuration error."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from areal_tpu.lint.common import LintConfigError
+from areal_tpu.lint.runner import LintConfig, run_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+DEFAULT_ALLOWLIST = os.path.join(
+    REPO_ROOT, "areal_tpu", "lint", "allowlist.txt"
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="areal_lint",
+        description="repo-specific AST checks: loop-only, "
+                    "blocking-async, env-knob, wire-schema "
+                    "(docs/static_analysis.md)",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                    help="allowlist file (default: "
+                         "areal_tpu/lint/allowlist.txt)")
+    ap.add_argument("--checker", action="append", dest="checkers",
+                    choices=["loop-only", "blocking-async", "env-knob",
+                             "wire-schema"],
+                    help="run only these checkers (repeatable)")
+    ap.add_argument("--dead-knobs", action="store_true",
+                    help="force the dead-registry-entry check even when "
+                         "the scan does not cover env_registry.py")
+    ap.add_argument("--no-dead-knobs", action="store_true",
+                    help="suppress the dead-registry-entry check")
+    ap.add_argument("--emit-env-docs", metavar="FILE",
+                    help="write generated docs/env_vars.md content to "
+                         "FILE and exit")
+    ap.add_argument("--check-env-docs", metavar="FILE",
+                    help="fail if FILE differs from the generated "
+                         "registry docs (drift gate)")
+    args = ap.parse_args(argv)
+
+    from areal_tpu.base import env_registry
+
+    if args.emit_env_docs:
+        with open(args.emit_env_docs, "w", encoding="utf-8") as f:
+            f.write(env_registry.render_docs())
+        print(f"wrote {args.emit_env_docs} "
+              f"({len(env_registry.REGISTRY)} knobs)")
+        if not args.paths:
+            return 0
+
+    if not args.paths and not args.check_env_docs:
+        ap.error("no paths given")
+
+    rc = 0
+    if args.check_env_docs:
+        try:
+            with open(args.check_env_docs, "r", encoding="utf-8") as f:
+                on_disk = f.read()
+        except OSError as e:
+            print(f"env-docs drift gate: cannot read "
+                  f"{args.check_env_docs}: {e}", file=sys.stderr)
+            return 2
+        if on_disk != env_registry.render_docs():
+            print(
+                f"{args.check_env_docs}: stale — regenerate with "
+                f"'python scripts/areal_lint.py --emit-env-docs "
+                f"{args.check_env_docs}'",
+                file=sys.stderr,
+            )
+            rc = 1
+
+    if args.paths:
+        dead = None
+        if args.dead_knobs:
+            dead = True
+        if args.no_dead_knobs:
+            dead = False
+        cfg = LintConfig(
+            root=REPO_ROOT,
+            allowlist_path=args.allowlist,
+            check_dead_knobs=dead,
+            checkers=set(args.checkers) if args.checkers else
+            {"loop-only", "blocking-async", "env-knob", "wire-schema"},
+        )
+        try:
+            findings = run_lint(args.paths, cfg)
+        except LintConfigError as e:
+            print(f"areal-lint config error: {e}", file=sys.stderr)
+            return 2
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\nareal-lint: {len(findings)} finding(s). Fix them, "
+                  f"or allowlist with justification in "
+                  f"{os.path.relpath(args.allowlist, REPO_ROOT)} "
+                  f"(docs/static_analysis.md).", file=sys.stderr)
+            rc = 1
+        elif rc == 0:
+            n = len(args.paths)
+            print(f"areal-lint: clean ({n} path(s))")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,240 @@
+"""Shared lint plumbing: parsed-module model, findings, allowlist."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+class LintConfigError(Exception):
+    """Bad linter configuration (malformed allowlist, missing registry).
+
+    Distinct from findings: config errors exit 2, findings exit 1."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int
+    checker: str
+    message: str
+
+    def key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.checker)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} [{self.checker}] {self.message}"
+
+
+class Module:
+    """One parsed source file plus the derived maps every checker needs.
+
+    ``parents``: child node -> parent node (ast has no parent links).
+    ``imports``: local name -> dotted module/attr it refers to, e.g.
+      ``import urllib.request``        -> {"urllib": "urllib"}
+      ``import numpy as np``           -> {"np": "numpy"}
+      ``from time import sleep``       -> {"sleep": "time.sleep"}
+      ``from areal_tpu.base import env_registry as envr``
+                                       -> {"envr": "areal_tpu.base.env_registry"}
+    ``str_constants``: module-level ``NAME = "literal"`` bindings, so a
+    read like ``os.environ.get(_ENV_DIR)`` resolves through the
+    constant.
+    """
+
+    def __init__(self, path: str, rel: str, source: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.imports: Dict[str, str] = {}
+        self.str_constants: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        # ``import urllib.request as ur`` binds the full
+                        # dotted path to the alias.
+                        self.imports[a.asname] = a.name
+                    else:
+                        # ``import urllib.request`` binds only the root.
+                        root = a.name.split(".")[0]
+                        self.imports[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+        for node in tree.body if isinstance(tree, ast.Module) else []:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                self.str_constants[node.targets[0].id] = node.value.value
+
+    # -- helpers ---------------------------------------------------------
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(node)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda, or
+        None at module/class level. A ``def`` line itself belongs to the
+        *outer* scope (decorators/defaults evaluate there)."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def dotted_name(self, node: ast.AST) -> Optional[str]:
+        """``a.b.c`` for Name/Attribute chains, with the root resolved
+        through the import map (``np.x`` -> ``numpy.x``)."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.imports.get(cur.id, cur.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        """Literal string value of an expression, following module-level
+        string constants one hop."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.str_constants.get(node.id)
+        return None
+
+
+def parse_module(path: str, root: str) -> Tuple[Optional[Module], Optional[Finding]]:
+    rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        return None, Finding(rel, getattr(e, "lineno", 1) or 1, "parse",
+                             f"cannot parse: {e}")
+    return Module(path, rel, source, tree), None
+
+
+def iter_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return out
+
+
+# -- allowlist -----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class AllowEntry:
+    path: str
+    line: int
+    checker: str
+    justification: str
+    src_line: int  # line in the allowlist file (for diagnostics)
+
+    def key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.checker)
+
+
+def parse_allowlist(path: str) -> List[AllowEntry]:
+    """Format, one entry per line::
+
+        <repo-rel-path>:<line> <checker> -- <justification>
+
+    ``#`` comments and blank lines are skipped. The justification is
+    MANDATORY — an entry without one is a config error, not a finding:
+    the allowlist exists to record *why* a contract is waived."""
+    entries: List[AllowEntry] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError as e:
+        raise LintConfigError(f"cannot read allowlist {path}: {e}")
+    for i, raw in enumerate(lines, start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, sep, justification = line.partition(" -- ")
+        justification = justification.strip()
+        if not sep or not justification:
+            raise LintConfigError(
+                f"{path}:{i}: allowlist entry missing ' -- <justification>'"
+            )
+        parts = head.split()
+        if len(parts) != 2 or ":" not in parts[0]:
+            raise LintConfigError(
+                f"{path}:{i}: expected '<path>:<line> <checker> -- "
+                f"<justification>', got {line!r}"
+            )
+        loc, checker = parts
+        fpath, _, lineno = loc.rpartition(":")
+        try:
+            n = int(lineno)
+        except ValueError:
+            raise LintConfigError(f"{path}:{i}: bad line number {lineno!r}")
+        entries.append(AllowEntry(fpath.replace(os.sep, "/"), n, checker,
+                                  justification, i))
+    return entries
+
+
+def apply_allowlist(
+    findings: List[Finding], entries: List[AllowEntry], allowlist_rel: str,
+    scanned_rels: Optional[set] = None,
+    active_checkers: Optional[set] = None,
+) -> List[Finding]:
+    """Drop allowlisted findings; report stale entries (nothing matched)
+    as findings themselves so the allowlist can't accrete dead waivers.
+
+    Staleness is only judged for entries IN SCOPE of this run — the
+    entry's file was scanned and its checker was active. A subset run
+    (``--checker env-knob``, a single file path) never generates the
+    waived finding, and must not spuriously fail on the waiver."""
+    allowed = {e.key(): e for e in entries}
+    matched = set()
+    kept: List[Finding] = []
+    for f in findings:
+        if f.key() in allowed:
+            matched.add(f.key())
+        else:
+            kept.append(f)
+    for e in entries:
+        if e.key() in matched:
+            continue
+        if scanned_rels is not None and e.path not in scanned_rels:
+            continue
+        if active_checkers is not None and e.checker not in active_checkers:
+            continue
+        kept.append(Finding(
+            allowlist_rel, e.src_line, "allowlist",
+            f"stale allowlist entry (no such finding): "
+            f"{e.path}:{e.line} [{e.checker}]",
+        ))
+    return kept

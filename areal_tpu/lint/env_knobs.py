@@ -1,0 +1,190 @@
+"""Checker ``env-knob``: every ``AREAL_*`` env read goes through the
+registry, and every registry entry is alive.
+
+Flags, per module:
+
+- a raw ``os.environ[...]`` / ``os.environ.get(...)`` / ``os.getenv``
+  read of an ``AREAL_*`` name that is NOT declared in
+  ``areal_tpu.base.env_registry`` (undeclared knob — the drift class
+  PR 1's snapshotting bolt-on was cleaning up after);
+- a raw read of a *declared* name anywhere but the registry module
+  itself (migrate to the typed accessor — per-call-site defaults are
+  how two sites end up disagreeing);
+- an ``env_registry.get_*()`` call naming an undeclared knob;
+- a dynamically-built ``AREAL_*`` name (f-string) — unverifiable, so
+  disallowed;
+- registry entries no scanned module reads (dead knob) — only when the
+  scan includes the registry module itself, so linting a file subset
+  doesn't misreport the whole registry dead.
+
+Writes (``os.environ[k] = v``, ``setdefault``, ``pop``) are exempt:
+arming a child process's env is how knobs propagate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "env-knob"
+
+ENV_PREFIX = "AREAL_"
+REGISTRY_MODULE = "areal_tpu.base.env_registry"
+REGISTRY_REL = "areal_tpu/base/env_registry.py"
+
+
+@dataclasses.dataclass
+class EnvKnobConfig:
+    declared: Set[str]
+    accessor_names: Tuple[str, ...]
+    registry_rel: str = REGISTRY_REL
+    registry_module: str = REGISTRY_MODULE
+
+
+def default_config() -> EnvKnobConfig:
+    # Import is deliberate (not AST-parsing the registry): it validates
+    # the declarations execute, and the module is stdlib-only so the
+    # no-jax gate is preserved.
+    from areal_tpu.base import env_registry
+
+    return EnvKnobConfig(
+        declared=set(env_registry.REGISTRY),
+        accessor_names=tuple(env_registry.ACCESSOR_NAMES),
+    )
+
+
+def _env_read_name(mod: Module, node: ast.AST) -> Optional[ast.AST]:
+    """Return the name-expression node of a raw env READ, else None."""
+    # os.environ[...] loads
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        if mod.dotted_name(node.value) == "os.environ":
+            return node.slice
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = mod.dotted_name(node.func)
+    if dotted in ("os.environ.get", "os.getenv") and node.args:
+        return node.args[0]
+    return None
+
+
+def check(mod: Module, cfg: EnvKnobConfig,
+          uses: Dict[str, int]) -> List[Finding]:
+    """Per-module pass; records knob uses into ``uses`` for the
+    cross-module dead-entry check."""
+    findings: List[Finding] = []
+    is_registry = mod.rel == cfg.registry_rel
+
+    for node in ast.walk(mod.tree):
+        # -- raw reads ---------------------------------------------------
+        name_node = _env_read_name(mod, node)
+        if name_node is not None:
+            if (
+                isinstance(name_node, ast.JoinedStr)
+                and name_node.values
+                and isinstance(name_node.values[0], ast.Constant)
+                and str(name_node.values[0].value).startswith(ENV_PREFIX)
+            ):
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    "dynamically-built AREAL_* env name: the registry "
+                    "cannot verify it; read a declared knob instead",
+                ))
+                continue
+            name = mod.resolve_str(name_node)
+            if name is None or not name.startswith(ENV_PREFIX):
+                continue
+            uses[name] = uses.get(name, 0) + 1
+            if name not in cfg.declared:
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"read of undeclared env knob {name}: declare it in "
+                    f"{cfg.registry_module} (name, type, default, doc)",
+                ))
+            elif not is_registry:
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"raw os.environ read of declared knob {name}: use "
+                    f"the {cfg.registry_module} accessor so the default "
+                    f"lives in one place",
+                ))
+            continue
+
+        # -- accessor calls ----------------------------------------------
+        if isinstance(node, ast.Call):
+            dotted = mod.dotted_name(node.func)
+            if dotted is None or not node.args:
+                continue
+            head, _, attr = dotted.rpartition(".")
+            if attr not in cfg.accessor_names:
+                continue
+            if head:
+                if head != cfg.registry_module and not head.endswith(
+                    "env_registry"
+                ):
+                    continue
+            elif not mod.imports.get(attr, "").startswith(
+                cfg.registry_module
+            ):
+                # bare get_int(...) not imported from the registry
+                continue
+            name = mod.resolve_str(node.args[0])
+            if name is None:
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"{attr}() with a non-literal knob name: the "
+                    f"registry checker cannot verify it",
+                ))
+                continue
+            uses[name] = uses.get(name, 0) + 1
+            if name not in cfg.declared:
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"accessor read of undeclared env knob {name}: "
+                    f"declare it in {cfg.registry_module}",
+                ))
+    return findings
+
+
+def check_dead(cfg: EnvKnobConfig, uses: Dict[str, int],
+               registry_lines: Dict[str, int]) -> List[Finding]:
+    """Registry entries nothing reads. ``registry_lines`` maps knob
+    name -> declaration line in the registry source (best effort)."""
+    findings: List[Finding] = []
+    for name in sorted(cfg.declared):
+        if not uses.get(name):
+            findings.append(Finding(
+                cfg.registry_rel, registry_lines.get(name, 1), CHECKER,
+                f"dead registry entry {name}: no scanned module reads "
+                f"it — delete the Knob or the feature that grew past it",
+            ))
+    return findings
+
+
+def registry_decl_lines(mod: Module) -> Dict[str, int]:
+    """Line of each ``_k("NAME", ...)`` / ``Knob(name=...)`` call in the
+    registry module, for anchoring dead-entry findings."""
+    lines: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname not in ("_k", "Knob"):
+            continue
+        name = None
+        if node.args and isinstance(node.args[0], ast.Constant):
+            name = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+        if isinstance(name, str):
+            lines[name] = node.lineno
+    return lines

@@ -1,0 +1,249 @@
+"""Checker ``loop-only``: engine-loop thread discipline.
+
+``ServingEngine``'s hot state (backlog, page allocator, donated KV
+pool arrays, per-slot bookkeeping) has **no locks by design** — the
+engine loop thread owns it, and the single cross-thread door is
+``_run_on_loop`` (closures run between decode laps). That contract was
+previously guarded only by comments; this checker machine-verifies it.
+
+A class opts in by declaring a module-level literal registry::
+
+    AREAL_LINT_LOOP_ONLY = {
+        "ServingEngine": {
+            "roots": ["_loop"],          # thread-target call-graph roots
+            "door": "_run_on_loop",      # the one legal crossing
+            "attrs": ["_backlog", ...],  # loop-owned attributes
+            "init_ok": ["__init__"],     # pre-thread-start methods
+            "instance_hints": ["engine"],  # names other modules hold
+        },
+    }
+
+Rules enforced:
+
+- ``self.<attr>`` for a registered attr may appear only in methods
+  reachable from the roots (the loop call graph), in ``init_ok``
+  methods (construction precedes ``start()``), or inside closures that
+  are passed to the door (transitively: helpers called from a
+  door-passed closure are also loop context).
+- In EVERY scanned module, ``<x>.<attr>`` where ``<x>``'s terminal
+  name is an instance hint (e.g. ``self.engine._backlog`` in an HTTP
+  handler) is flagged: other threads/processes go through the door or
+  the public API, never through the state.
+
+The call graph is per-class and intra-module — dynamic dispatch is out
+of scope; the registry names what matters and the checker makes the
+cheap races (direct off-thread pokes) impossible to land silently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "loop-only"
+REGISTRY_NAME = "AREAL_LINT_LOOP_ONLY"
+
+_ALLOWED_KEYS = {"roots", "door", "attrs", "init_ok", "instance_hints"}
+
+
+def collect_registry(mod: Module) -> Dict[str, Dict]:
+    """Literal-eval the module's AREAL_LINT_LOOP_ONLY, if any."""
+    tree = mod.tree
+    if not isinstance(tree, ast.Module):
+        return {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == REGISTRY_NAME
+        ):
+            try:
+                value = ast.literal_eval(node.value)
+            except (ValueError, TypeError, SyntaxError, MemoryError):
+                # literal_eval raises TypeError/SyntaxError on some
+                # non-literal shapes; all must land as a finding, not a
+                # linter traceback.
+                return {"__error__": {"line": node.lineno,
+                                      "msg": "registry must be a literal"}}
+            if not isinstance(value, dict):
+                return {"__error__": {"line": node.lineno,
+                                      "msg": "registry must be a dict"}}
+            for cls, spec in value.items():
+                bad = set(spec) - _ALLOWED_KEYS
+                if bad or not spec.get("roots") or not spec.get("attrs"):
+                    return {"__error__": {
+                        "line": node.lineno,
+                        "msg": f"class {cls!r}: needs 'roots' and 'attrs'"
+                               + (f", unknown keys {sorted(bad)}" if bad
+                                  else ""),
+                    }}
+            return value
+    return {}
+
+
+def _method_map(cls: ast.ClassDef) -> Dict[str, ast.AST]:
+    return {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _loop_reachable(methods: Dict[str, ast.AST],
+                    roots: List[str]) -> Set[str]:
+    """Transitive closure over ``self.X`` references (calls AND bound-
+    method passes both create reachability)."""
+    edges: Dict[str, Set[str]] = {}
+    for name, fn in methods.items():
+        refs = set()
+        for node in ast.walk(fn):
+            a = _self_attr(node)
+            if a and a in methods:
+                refs.add(a)
+        edges[name] = refs
+    seen: Set[str] = set()
+    work = [r for r in roots if r in methods]
+    while work:
+        cur = work.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        work.extend(edges.get(cur, ()))
+    return seen
+
+
+def _door_exempt_functions(mod: Module, method: ast.AST,
+                           door: str) -> Set[ast.AST]:
+    """Nested defs/lambdas inside ``method`` whose bodies run on the
+    loop because they are handed to the door (transitively)."""
+    nested: Dict[str, ast.FunctionDef] = {}
+    lambdas: List[ast.Lambda] = []
+    for node in ast.walk(method):
+        if isinstance(node, ast.FunctionDef) and node is not method:
+            nested[node.name] = node
+        elif isinstance(node, ast.Lambda):
+            lambdas.append(node)
+
+    exempt: Set[ast.AST] = set()
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Call):
+            continue
+        if _self_attr(node.func) != door:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in nested:
+                exempt.add(nested[arg.id])
+            elif isinstance(arg, ast.Lambda):
+                exempt.add(arg)
+
+    # Transitive: a helper referenced from a door-passed closure also
+    # runs on the loop.
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(exempt):
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and node.id in nested
+                    and nested[node.id] not in exempt
+                ):
+                    exempt.add(nested[node.id])
+                    changed = True
+    return exempt
+
+
+def check_declaring_module(mod: Module, registry: Dict[str, Dict]
+                           ) -> List[Finding]:
+    findings: List[Finding] = []
+    if "__error__" in registry:
+        err = registry["__error__"]
+        return [Finding(mod.rel, err["line"], CHECKER,
+                        f"malformed {REGISTRY_NAME}: {err['msg']}")]
+
+    classes = {
+        n.name: n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)
+    }
+    for cls_name, spec in registry.items():
+        cls = classes.get(cls_name)
+        if cls is None:
+            findings.append(Finding(
+                mod.rel, 1, CHECKER,
+                f"{REGISTRY_NAME} names unknown class {cls_name!r}",
+            ))
+            continue
+        attrs = set(spec["attrs"])
+        door = spec.get("door")
+        init_ok = set(spec.get("init_ok", ["__init__"])) | {"__init__"}
+        methods = _method_map(cls)
+        loop_methods = _loop_reachable(methods, list(spec["roots"]))
+
+        for name, fn in methods.items():
+            if name in loop_methods or name in init_ok:
+                continue
+            exempt = (
+                _door_exempt_functions(mod, fn, door) if door else set()
+            )
+            for node in ast.walk(fn):
+                a = _self_attr(node)
+                if a is None or a not in attrs:
+                    continue
+                # ok if inside (or nested within) a door-passed closure
+                cur = mod.enclosing_function(node)
+                ok = False
+                while cur is not None and cur is not fn:
+                    if cur in exempt:
+                        ok = True
+                        break
+                    cur = mod.enclosing_function(cur)
+                if ok:
+                    continue
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"{cls_name}.{name} touches loop-only attr "
+                    f"self.{a} off the engine-loop call graph "
+                    f"(roots {spec['roots']}): route it through "
+                    f"{door or 'the loop door'} or maintain a "
+                    f"loop-updated snapshot",
+                ))
+    return findings
+
+
+def check_instance_hints(mod: Module, hints: Dict[str, Set[str]]
+                         ) -> List[Finding]:
+    """In non-declaring modules: flag ``<hint>.<loop-only attr>``."""
+    if not hints:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        hint_names = hints.get(node.attr)
+        if not hint_names:
+            continue
+        recv = node.value
+        terminal = None
+        if isinstance(recv, ast.Name):
+            terminal = recv.id
+        elif isinstance(recv, ast.Attribute):
+            terminal = recv.attr
+        if terminal in hint_names:
+            findings.append(Finding(
+                mod.rel, node.lineno, CHECKER,
+                f"{terminal}.{node.attr} pokes engine-loop-only state "
+                f"from outside the engine: use the public API or the "
+                f"loop door",
+            ))
+    return findings

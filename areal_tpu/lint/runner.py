@@ -1,0 +1,116 @@
+"""Orchestrates the four checkers over a file set and applies the
+allowlist. Two passes: parse + collect cross-file facts (loop-only
+registries, env-knob uses), then check."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set
+
+from areal_tpu.lint import blocking_async, env_knobs, loop_only, wire_schema
+from areal_tpu.lint.common import (
+    Finding,
+    Module,
+    apply_allowlist,
+    iter_py_files,
+    parse_allowlist,
+    parse_module,
+)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    root: str  # repo root all finding paths are relative to
+    allowlist_path: Optional[str] = None
+    env_cfg: Optional[env_knobs.EnvKnobConfig] = None
+    # None = auto: dead-knob check runs iff the scan covers the
+    # registry module (linting one file must not misreport the whole
+    # registry as dead).
+    check_dead_knobs: Optional[bool] = None
+    wire_constants_rel: str = wire_schema.CONSTANTS_REL
+    checkers: Set[str] = dataclasses.field(default_factory=lambda: {
+        "loop-only", "blocking-async", "env-knob", "wire-schema",
+    })
+
+
+def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
+    files = iter_py_files(paths)
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for path in files:
+        mod, err = parse_module(path, cfg.root)
+        if err is not None:
+            findings.append(err)
+        if mod is not None:
+            modules.append(mod)
+
+    env_cfg = cfg.env_cfg
+    if env_cfg is None and "env-knob" in cfg.checkers:
+        env_cfg = env_knobs.default_config()
+
+    # -- pass 1: cross-file facts ---------------------------------------
+    registries: Dict[str, Dict] = {}  # rel -> registry
+    hint_map: Dict[str, Set[str]] = {}  # attr -> instance hint names
+    registry_mod: Optional[Module] = None
+    for mod in modules:
+        if "loop-only" in cfg.checkers:
+            reg = loop_only.collect_registry(mod)
+            if reg:
+                registries[mod.rel] = reg
+                for spec in reg.values():
+                    if not isinstance(spec, dict):
+                        continue
+                    for attr in spec.get("attrs", ()):
+                        hint_map.setdefault(attr, set()).update(
+                            spec.get("instance_hints", ())
+                        )
+        if env_cfg is not None and mod.rel == env_cfg.registry_rel:
+            registry_mod = mod
+
+    # -- pass 2: checks --------------------------------------------------
+    env_uses: Dict[str, int] = {}
+    for mod in modules:
+        if "blocking-async" in cfg.checkers:
+            findings.extend(blocking_async.check(mod))
+        if "wire-schema" in cfg.checkers:
+            findings.extend(wire_schema.check(mod, cfg.wire_constants_rel))
+        if "env-knob" in cfg.checkers and env_cfg is not None:
+            findings.extend(env_knobs.check(mod, env_cfg, env_uses))
+        if "loop-only" in cfg.checkers:
+            if mod.rel in registries:
+                findings.extend(loop_only.check_declaring_module(
+                    mod, registries[mod.rel]
+                ))
+            elif registries:
+                findings.extend(loop_only.check_instance_hints(
+                    mod, hint_map
+                ))
+
+    if "env-knob" in cfg.checkers and env_cfg is not None:
+        dead = cfg.check_dead_knobs
+        if dead is None:
+            dead = registry_mod is not None
+        if dead:
+            decl_lines = (
+                env_knobs.registry_decl_lines(registry_mod)
+                if registry_mod is not None else {}
+            )
+            findings.extend(
+                env_knobs.check_dead(env_cfg, env_uses, decl_lines)
+            )
+
+    # -- allowlist -------------------------------------------------------
+    if cfg.allowlist_path and os.path.exists(cfg.allowlist_path):
+        entries = parse_allowlist(cfg.allowlist_path)
+        rel = os.path.relpath(
+            os.path.abspath(cfg.allowlist_path), cfg.root
+        ).replace(os.sep, "/")
+        findings = apply_allowlist(
+            findings, entries, rel,
+            scanned_rels={m.rel for m in modules},
+            active_checkers=set(cfg.checkers) | {"parse", "allowlist"},
+        )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings

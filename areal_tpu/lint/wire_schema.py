@@ -1,0 +1,40 @@
+"""Checker ``wire-schema``: ``areal-*/vN`` schema strings come from
+``areal_tpu/base/wire_schemas.py`` and nowhere else.
+
+A schema tag spelled locally in a producer can't be version-bumped
+without forking the protocol (kv_handoff, chunking, weight_transfer
+and bench/bank each used to carry their own literal). The rule is
+full-string match on the ``areal-<name>/v<N>`` shape, so prose that
+merely *mentions* a schema in a docstring doesn't trip it."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "wire-schema"
+
+SCHEMA_RE = re.compile(r"\Aareal-[a-z0-9][a-z0-9-]*/v[0-9]+\Z")
+CONSTANTS_REL = "areal_tpu/base/wire_schemas.py"
+
+
+def check(mod: Module, constants_rel: str = CONSTANTS_REL) -> List[Finding]:
+    if mod.rel == constants_rel:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and SCHEMA_RE.match(node.value)
+        ):
+            findings.append(Finding(
+                mod.rel, node.lineno, CHECKER,
+                f"wire-schema literal {node.value!r}: import the "
+                f"constant from {constants_rel} so a version bump is "
+                f"one change, not a protocol fork",
+            ))
+    return findings

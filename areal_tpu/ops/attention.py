@@ -16,11 +16,12 @@ and per-token positions; attention is masked to (same segment) AND
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from areal_tpu.base import env_registry
 
 NEG_INF = -2.0**30
 LANES = 128  # TPU lane width; splash blocks must be lane-aligned
@@ -101,17 +102,20 @@ def snapshot_splash_blocks():
     constructing a fresh engine per setting (scripts/mfu_sweep.py)."""
     global _SPLASH_SNAP
 
-    def target(name, default):
-        v = int(os.environ.get(name, default))
+    def check(name, v):
+        # Defaults live in the env registry, not here (the per-call-site
+        # default drift this registry exists to end); knob names stay
+        # literal at each get_int so the env-knob checker can see them.
         if v < LANES:
             raise ValueError(f"{name}={v}: splash block targets must be "
                              f">= {LANES}")
         return v
 
     _SPLASH_SNAP = (
-        target("AREAL_SPLASH_BQ", 512),
-        target("AREAL_SPLASH_BKV", 1024),
-        target("AREAL_SPLASH_BKVC", 512),
+        check("AREAL_SPLASH_BQ", env_registry.get_int("AREAL_SPLASH_BQ")),
+        check("AREAL_SPLASH_BKV", env_registry.get_int("AREAL_SPLASH_BKV")),
+        check("AREAL_SPLASH_BKVC",
+              env_registry.get_int("AREAL_SPLASH_BKVC")),
     )
     return _SPLASH_SNAP
 

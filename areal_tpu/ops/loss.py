@@ -9,10 +9,11 @@ collectives the hand-written vocab-parallel CE performs.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax
+
+from areal_tpu.base import env_registry
 import jax.numpy as jnp
 
 
@@ -85,12 +86,10 @@ def snapshot_ce_chunk() -> Optional[int]:
     env between settings (scripts/mfu_sweep.py) re-pin simply by
     constructing a fresh engine."""
     global _CE_CHUNK_SNAP
-    env = os.environ.get("AREAL_CE_CHUNK")
-    val: Optional[int] = None
-    if env:
-        val = int(env)  # ValueError surfaces at snapshot time
-        if val <= 0:
-            raise ValueError(f"AREAL_CE_CHUNK={env}: must be positive")
+    # ValueError (unparseable value) surfaces at snapshot time.
+    val: Optional[int] = env_registry.get_int("AREAL_CE_CHUNK")
+    if val is not None and val <= 0:
+        raise ValueError(f"AREAL_CE_CHUNK={val}: must be positive")
     _CE_CHUNK_SNAP = (val,)
     return val
 

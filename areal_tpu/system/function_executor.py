@@ -12,6 +12,7 @@ cleared on every worker.
 from __future__ import annotations
 
 import asyncio
+import functools
 from typing import Dict, List, Optional
 
 from areal_tpu.api.data_api import SequenceSample
@@ -110,13 +111,23 @@ class FunctionExecutor:
                 # Dataset exhausted and nothing new: avoid a hot loop.
                 await asyncio.sleep(0.01)
             # Publish the global sample counter for the staleness gate
-            # (reference function_executor.py:192-201).
+            # (reference function_executor.py:192-201). Off-loop: the
+            # write is file I/O (NFS-backed in production) and this
+            # loop also drives every MFC request round-trip — an inline
+            # write per fetch lap stalled them all (areal-lint
+            # blocking-async regression note).
             if self.experiment_name:
-                name_resolve.add(
-                    names.training_samples(self.experiment_name, self.trial_name),
-                    str(self._samples_loaded),
-                    replace=True,
-                    keepalive_ttl=None,
+                await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    functools.partial(
+                        name_resolve.add,
+                        names.training_samples(
+                            self.experiment_name, self.trial_name
+                        ),
+                        str(self._samples_loaded),
+                        replace=True,
+                        keepalive_ttl=None,
+                    ),
                 )
 
     async def clear_gpu_cache(self):

@@ -714,7 +714,14 @@ class GenerationServer(Worker):
     ) -> bytes:
         """Chunked pull of the export stash: per-chunk sha256 verify,
         mid-chunk Range resume on torn reads — the weight-plane transfer
-        discipline applied to the KV hop."""
+        discipline applied to the KV hop.
+
+        Regression note (areal-lint blocking-async): verify_chunk used
+        to run inline here — sha256 over a multi-MB KV chunk is ~10ms+
+        of CPU per chunk on the 2-core host, paid ON the event loop
+        while this decode server is streaming other requests' tokens
+        (the PR 7 ITL-stall class). It now runs in the default
+        executor, like the weight plane's ChunkStore.fetch."""
         from areal_tpu.base.chunking import chunk_spans, verify_chunk
 
         index = meta["chunks"]
@@ -752,8 +759,11 @@ class GenerationServer(Worker):
                 buf[start: start + take] = data[:take]
                 got += take
                 if got >= length:
-                    if verify_chunk(bytes(buf[off: off + length]),
-                                    index["hashes"][i]):
+                    ok = await asyncio.get_running_loop().run_in_executor(
+                        None, verify_chunk,
+                        bytes(buf[off: off + length]), index["hashes"][i],
+                    )
+                    if ok:
                         break
                     got = 0  # corrupt chunk: refetch whole
             else:

@@ -89,6 +89,12 @@ class GserverManager(Worker):
         self.rollout_stat = RolloutStat()
         self._lock = threading.Lock()
         self._last_metrics_poll = 0.0
+        # Training-samples counter snapshot, refreshed on the worker
+        # poll thread (_poll): the staleness gate reads THIS, never
+        # name_resolve directly — that read is file I/O (NFS in
+        # production) and is_staled() runs inside /allocate_rollout on
+        # the HTTP event loop, under _lock (areal-lint blocking-async).
+        self._training_samples_cache = 0
         self._server_gen_totals = {u: 0.0 for u in self.server_urls}
         self._server_prefix_hits = {u: 0.0 for u in self.server_urls}
         self._server_prefix_reused = {u: 0.0 for u in self.server_urls}
@@ -178,6 +184,13 @@ class GserverManager(Worker):
         self._wp_last: Dict = {}
 
         self._http_loop = asyncio.new_event_loop()
+        # Prime the staleness-gate snapshot BEFORE the HTTP server can
+        # field /allocate_rollout: a restarted manager starts with
+        # rollout_stat.submitted == 0, so without this read it would
+        # admit over-stale rollouts until the first poll lap refreshes
+        # the cache (the durable KV counter is the only restart-
+        # surviving input to is_staled).
+        self._refresh_training_samples()
         self._http_ready = threading.Event()
         self._http_thread = threading.Thread(target=self._serve_http, daemon=True)
         self._http_thread.start()
@@ -613,8 +626,22 @@ class GserverManager(Worker):
                 )
 
     def _training_samples(self) -> int:
+        """Cached global-sample counter for the staleness gate.
+
+        Regression note (areal-lint blocking-async): this used to read
+        name_resolve inline — file I/O, NFS-backed in production — and
+        is_staled() calls it from the /allocate_rollout handler ON the
+        HTTP event loop while holding self._lock, so one slow NFS stat
+        stalled every concurrent admission/schedule request. The poll
+        thread now refreshes the snapshot (_refresh_training_samples);
+        one poll lap of staleness is harmless — the counter only grows,
+        and rollout_stat.submitted (the other max() arm) is live."""
+        return self._training_samples_cache
+
+    def _refresh_training_samples(self) -> None:
+        """Poll-thread-only: fetch the published counter (file I/O)."""
         try:
-            return int(
+            self._training_samples_cache = int(
                 name_resolve.get(
                     names.training_samples(
                         self.cfg.experiment_name, self.cfg.trial_name
@@ -622,7 +649,7 @@ class GserverManager(Worker):
                 )
             )
         except (name_resolve.NameEntryNotFoundError, ValueError):
-            return 0
+            pass
 
     def prefix_cache_fleet(self) -> Dict[str, float]:
         """Fleet prefix-cache effectiveness as ratios of SUMS (the
@@ -1625,6 +1652,10 @@ class GserverManager(Worker):
                 return None
         except name_resolve.NameEntryNotFoundError:
             pass
+
+        # Staleness-gate input, fetched HERE (poll thread) so the HTTP
+        # loop's is_staled() never does file I/O.
+        self._refresh_training_samples()
 
         # Health registry: evict dead servers, readmit returning ones.
         if time.monotonic() - self._last_health_poll > self.cfg.health_check_interval:

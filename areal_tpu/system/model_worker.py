@@ -38,7 +38,7 @@ from areal_tpu.api.model_api import (
     make_model,
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, seeding, stats_tracker, timeutil, tracing
+from areal_tpu.base import constants, env_registry, logging, name_resolve, names, seeding, stats_tracker, timeutil, tracing
 from areal_tpu.system import eval_scores
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
@@ -694,7 +694,7 @@ class ModelWorker(Worker):
             # so legacy deployments keep zero extra listeners.
             if is_rank0 and (
                 getattr(self.cfg, "weight_plane", False)
-                or os.environ.get("AREAL_WEIGHT_PLANE")
+                or env_registry.get_bool("AREAL_WEIGHT_PLANE")
             ):
                 self._ensure_weight_plane_source(role, shm or d)
             if is_rank0:

@@ -306,11 +306,20 @@ class RolloutWorker(AsyncWorker):
 
     async def _poll_async(self) -> Optional[PollResult]:
         # Experiment status gate (reference rollout_worker.py:216-228).
+        # Regression note (areal-lint blocking-async): the name_resolve
+        # read is file I/O (NFS-backed in production) and this poll runs
+        # on the SAME event loop as every live episode's generate/reward
+        # round-trips — an inline read stalled all of them for the
+        # duration of one slow stat. Executor keeps the loop serving.
+        loop = asyncio.get_running_loop()
         try:
-            status = name_resolve.get(
-                names.experiment_status(
-                    self.cfg.experiment_name, self.cfg.trial_name
-                )
+            status = await loop.run_in_executor(
+                None,
+                lambda: name_resolve.get(
+                    names.experiment_status(
+                        self.cfg.experiment_name, self.cfg.trial_name
+                    )
+                ),
             )
             if status in ("COMPLETE", "ABORT"):
                 for t in self._tasks.values():
@@ -348,8 +357,9 @@ class RolloutWorker(AsyncWorker):
             logger.warning("allocate_rollout failed; retrying", exc_info=True)
             # A restarted gserver manager re-registers at a NEW address;
             # re-resolve so this worker follows it instead of hammering
-            # the dead endpoint forever.
-            self._rediscover_manager()
+            # the dead endpoint forever. Off-loop: the lookup is file
+            # I/O (areal-lint blocking-async, see poll-gate note above).
+            await loop.run_in_executor(None, self._rediscover_manager)
             await asyncio.sleep(0.5)
             return PollResult(batch_count=0)
         if not ok:

@@ -32,7 +32,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from areal_tpu.base import logging
+from areal_tpu.base import env_registry, logging
 from areal_tpu.base.chunking import (
     DEFAULT_CHUNK_BYTES,
     StreamChunker,
@@ -44,8 +44,10 @@ logger = logging.getLogger("weight_transfer")
 _MANIFEST = "params.json"
 _SCHEMA = 1
 
-LAYOUT_SCHEMA = "areal-weight-layout/v1"
-SLAB_SCHEMA = "areal-weight-slabs/v1"
+from areal_tpu.base.wire_schemas import (  # noqa: E402 (module constants)
+    WEIGHT_LAYOUT_V1 as LAYOUT_SCHEMA,
+    WEIGHT_SLABS_V1 as SLAB_SCHEMA,
+)
 
 # Telemetry of the most recent dump on this process: host high-water
 # (largest single host materialization — the whole-model gather the
@@ -863,9 +865,9 @@ def load_for_serving(
     """
     t0 = time.monotonic()
     if retries is None:
-        retries = int(os.environ.get("AREAL_WEIGHT_LOAD_RETRIES", "40"))
+        retries = env_registry.get_int("AREAL_WEIGHT_LOAD_RETRIES")
     if retry_s is None:
-        retry_s = float(os.environ.get("AREAL_WEIGHT_LOAD_RETRY_S", "0.25"))
+        retry_s = env_registry.get_float("AREAL_WEIGHT_LOAD_RETRY_S")
     attempts = max(1, retries)
     last_info = None
     raw_seen: Dict[str, int] = {}

@@ -20,21 +20,24 @@ import os
 import time
 from typing import Dict, Iterator, List, Optional
 
+from areal_tpu.base import env_registry
 from areal_tpu.base import logging as areal_logging
 
 logger = areal_logging.getLogger("profiling")
 
 
 def trace_enabled() -> bool:
-    return os.environ.get("AREAL_DUMP_TRACE", "0") not in ("", "0", "false")
+    return env_registry.get_bool("AREAL_DUMP_TRACE")
 
 
 def _trace_dir() -> str:
-    return os.environ.get("AREAL_TRACE_DIR", "/tmp/areal_tpu/traces")
+    # NOT AREAL_RL_TRACE_DIR: this is the jax-profiler dump root; the
+    # RL span recorder has its own tree (see env_registry docs).
+    return env_registry.get_str("AREAL_TRACE_DIR")
 
 
 def _step_selected(step: Optional[int]) -> bool:
-    sel = os.environ.get("AREAL_TRACE_STEPS", "")
+    sel = env_registry.get_str("AREAL_TRACE_STEPS")
     if not sel or step is None:
         return True
     try:

@@ -1,0 +1,20 @@
+#!/usr/bin/env python3
+"""Repo-specific static analysis gate — see areal_tpu/lint/ and
+docs/static_analysis.md.
+
+    python scripts/areal_lint.py areal_tpu/
+    python scripts/areal_lint.py --emit-env-docs docs/env_vars.md
+
+Kept jax-free on purpose: the tier-1 gate runs this in a subprocess
+and asserts jax never loads, so the check costs AST time, not XLA
+time."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

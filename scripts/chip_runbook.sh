@@ -24,6 +24,15 @@ OUT="${OUT:-chip_results}"
 cd "$(dirname "$0")/.."
 mkdir -p "$OUT"   # after the cd: relative OUT lands in the repo root
 
+echo "== preflight: lint gates (SKIP_LINT=1 to bypass) =="
+# A contract violation (blocking call on a serving loop, undeclared env
+# knob, forked wire schema) burns the scarce chip window on broken
+# code; the check costs ~2s of AST time, no jax import.
+if [ "${SKIP_LINT:-0}" != "1" ]; then
+    bash scripts/lint.sh || {
+        echo "preflight lint failed — fix or rerun with SKIP_LINT=1"; exit 1; }
+fi
+
 echo "== 0. device probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
     echo "TPU unreachable: leaving the bench DAEMON armed instead —"

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# The one lint entry point (docs/static_analysis.md):
+#
+#   1. ruff  — generic hygiene (undefined names, unused imports;
+#              baseline rule set in pyproject.toml). Skipped with a
+#              note when ruff is not installed — the container image
+#              does not bake it in.
+#   2. areal-lint — repo-specific AST contract checks (loop-only,
+#              blocking-async, env-knob, wire-schema) + the
+#              docs/env_vars.md drift gate. Always runs; stdlib-only.
+#
+# Exit nonzero if either gate fails. Used by chip_runbook.sh preflight
+# and intended as the single command future PRs/CI wire in.
+
+set -u
+cd "$(dirname "$0")/.."
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== lint: ruff =="
+    ruff check areal_tpu scripts tests || rc=1
+else
+    echo "== lint: ruff not installed; skipping (baseline config in pyproject.toml) =="
+fi
+
+echo "== lint: areal-lint =="
+python scripts/areal_lint.py areal_tpu --check-env-docs docs/env_vars.md || rc=1
+
+exit $rc

@@ -1,0 +1,372 @@
+"""areal-lint unit fixtures + the tier-1 gate.
+
+One positive and one negative snippet per checker (the seeded
+violations the acceptance criteria require), allowlist semantics
+(honored, justification mandatory, stale entries reported), and a gate
+run over the real tree: zero unallowlisted findings, no jax import,
+env-docs drift-free. Fixtures are AST-parsed, never imported, so they
+need no runnable dependencies."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from areal_tpu.lint.common import LintConfigError, parse_allowlist
+from areal_tpu.lint.env_knobs import EnvKnobConfig
+from areal_tpu.lint.runner import LintConfig, run_lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _lint(tmp_path, source, *, name="mod.py", checkers=None, env_cfg=None,
+          allowlist=None, check_dead=False, wire_rel="wire_schemas.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    cfg = LintConfig(
+        root=str(tmp_path),
+        allowlist_path=str(allowlist) if allowlist else None,
+        env_cfg=env_cfg,
+        check_dead_knobs=check_dead,
+        wire_constants_rel=wire_rel,
+        checkers=set(checkers) if checkers else {
+            "loop-only", "blocking-async", "env-knob", "wire-schema",
+        },
+    )
+    return run_lint([str(p)], cfg)
+
+
+def _keys(findings):
+    return [(f.path, f.line, f.checker) for f in findings]
+
+
+# ----------------------------------------------------------------------
+# blocking-async
+# ----------------------------------------------------------------------
+
+
+def test_blocking_async_positive(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+
+        async def handler(request):
+            time.sleep(1)
+    """, checkers=["blocking-async"])
+    assert len(findings) == 1
+    assert findings[0].checker == "blocking-async"
+    assert "time.sleep" in findings[0].message
+
+
+def test_blocking_async_executor_wrap_is_clean(tmp_path):
+    findings = _lint(tmp_path, """
+        import asyncio
+        import time
+
+        async def handler(request):
+            def _work():
+                time.sleep(1)
+                return open("/tmp/x").read()
+            return await asyncio.get_running_loop().run_in_executor(
+                None, _work
+            )
+    """, checkers=["blocking-async"])
+    assert findings == []
+
+
+def test_blocking_async_direct_nested_call_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+
+        async def handler(request):
+            def _work():
+                time.sleep(1)
+            _work()
+    """, checkers=["blocking-async"])
+    assert len(findings) == 1
+    assert "_work" in findings[0].message
+
+
+def test_blocking_async_transitive_method_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import json
+
+        class S:
+            def _load(self):
+                with open("/tmp/s.json") as f:
+                    return json.load(f)
+
+            def _hop(self):
+                return self._load()
+
+            async def handler(self, request):
+                return self._hop()
+    """, checkers=["blocking-async"])
+    assert len(findings) == 1
+    assert "self._hop()" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# env-knob
+# ----------------------------------------------------------------------
+
+_ENV_CFG = EnvKnobConfig(
+    declared={"AREAL_DECLARED", "AREAL_DEAD"},
+    accessor_names=("get_raw", "get_str", "get_int", "get_float",
+                    "get_bool", "is_set"),
+    registry_rel="env_registry.py",
+    registry_module="areal_tpu.base.env_registry",
+)
+
+
+def test_env_knob_undeclared_read_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import os
+        x = os.environ.get("AREAL_NOT_DECLARED")
+    """, checkers=["env-knob"], env_cfg=_ENV_CFG)
+    assert len(findings) == 1
+    assert "undeclared env knob AREAL_NOT_DECLARED" in findings[0].message
+
+
+def test_env_knob_raw_read_of_declared_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import os
+        x = os.getenv("AREAL_DECLARED", "1")
+    """, checkers=["env-knob"], env_cfg=_ENV_CFG)
+    assert len(findings) == 1
+    assert "raw os.environ read" in findings[0].message
+
+
+def test_env_knob_accessor_read_is_clean(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base import env_registry
+        x = env_registry.get_str("AREAL_DECLARED")
+    """, checkers=["env-knob"], env_cfg=_ENV_CFG)
+    assert findings == []
+
+
+def test_env_knob_name_resolved_through_constant(tmp_path):
+    findings = _lint(tmp_path, """
+        import os
+        _ENV = "AREAL_NOT_DECLARED"
+        x = os.environ.get(_ENV)
+    """, checkers=["env-knob"], env_cfg=_ENV_CFG)
+    assert len(findings) == 1
+    assert "AREAL_NOT_DECLARED" in findings[0].message
+
+
+def test_env_knob_dynamic_name_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import os
+        k = "BQ"
+        x = os.environ.get(f"AREAL_SPLASH_{k}")
+    """, checkers=["env-knob"], env_cfg=_ENV_CFG)
+    assert len(findings) == 1
+    assert "dynamically-built" in findings[0].message
+
+
+def test_env_knob_dead_entry_flagged(tmp_path):
+    (tmp_path / "env_registry.py").write_text(
+        'Knob = dict\n_k = dict\n'
+        'REGISTRY = {}\n'
+    )
+    mod = tmp_path / "user.py"
+    mod.write_text(
+        "from areal_tpu.base import env_registry\n"
+        'x = env_registry.get_str("AREAL_DECLARED")\n'
+    )
+    cfg = LintConfig(
+        root=str(tmp_path), env_cfg=_ENV_CFG, check_dead_knobs=True,
+        checkers={"env-knob"},
+    )
+    findings = run_lint([str(tmp_path)], cfg)
+    assert len(findings) == 1
+    assert "dead registry entry AREAL_DEAD" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# wire-schema
+# ----------------------------------------------------------------------
+
+
+def test_wire_schema_literal_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        SCHEMA = "areal-my-thing/v1"
+    """, checkers=["wire-schema"])
+    assert len(findings) == 1
+    assert "areal-my-thing/v1" in findings[0].message
+
+
+def test_wire_schema_constants_module_and_prose_exempt(tmp_path):
+    # the constants module itself
+    assert _lint(tmp_path, """
+        KV = "areal-kv-handoff/v1"
+    """, name="wire_schemas.py", checkers=["wire-schema"]) == []
+    # prose mentioning a schema inside a longer string
+    assert _lint(tmp_path, """
+        DOC = "the payload follows areal-kv-handoff/v1 framing"
+    """, checkers=["wire-schema"]) == []
+
+
+# ----------------------------------------------------------------------
+# loop-only
+# ----------------------------------------------------------------------
+
+_LOOP_FIXTURE = """
+    AREAL_LINT_LOOP_ONLY = {{
+        "Engine": {{
+            "roots": ["_loop"],
+            "door": "_run_on_loop",
+            "attrs": ["_backlog"],
+            "instance_hints": ["engine"],
+        }},
+    }}
+
+    class Engine:
+        def __init__(self):
+            self._backlog = []
+
+        def _run_on_loop(self, fn):
+            return fn()
+
+        def _loop(self):
+            self._serve()
+
+        def _serve(self):
+            self._backlog.append(1)
+
+        def off_thread(self):
+            {off_thread_body}
+"""
+
+
+def test_loop_only_off_thread_access_flagged(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _LOOP_FIXTURE.format(off_thread_body="return len(self._backlog)"),
+        checkers=["loop-only"],
+    )
+    assert len(findings) == 1
+    assert "_backlog" in findings[0].message
+    assert "off_thread" in findings[0].message
+
+
+def test_loop_only_door_closure_is_clean(tmp_path):
+    findings = _lint(
+        tmp_path,
+        _LOOP_FIXTURE.format(
+            off_thread_body=(
+                "return self._run_on_loop(lambda: len(self._backlog))"
+            )
+        ),
+        checkers=["loop-only"],
+    )
+    assert findings == []
+
+
+def test_loop_only_instance_hint_cross_module(tmp_path):
+    (tmp_path / "eng.py").write_text(textwrap.dedent(
+        _LOOP_FIXTURE.format(off_thread_body="pass")
+    ))
+    (tmp_path / "server.py").write_text(textwrap.dedent("""
+        class Server:
+            async def handler(self, request):
+                return len(self.engine._backlog)
+    """))
+    cfg = LintConfig(root=str(tmp_path), checkers={"loop-only"})
+    findings = run_lint([str(tmp_path)], cfg)
+    assert _keys(findings) == [("server.py", 4, "loop-only")]
+
+
+# ----------------------------------------------------------------------
+# allowlist
+# ----------------------------------------------------------------------
+
+
+def test_allowlist_honored_and_stale_reported(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "# comment\n"
+        "mod.py:5 blocking-async -- event loop is quiesced here\n"
+        "mod.py:99 blocking-async -- stale entry (line drifted away)\n"
+    )
+    findings = _lint(tmp_path, """
+        import time
+
+        async def handler(request):
+            time.sleep(1)
+    """, checkers=["blocking-async"], allowlist=allow)
+    # the real finding is waived; the in-scope stale entry surfaces
+    assert _keys(findings) == [("allow.txt", 3, "allowlist")]
+
+
+def test_allowlist_out_of_scope_entries_not_stale(tmp_path):
+    """A subset run (one checker / one file) never generates waived
+    findings for other checkers/files — those entries must not be
+    reported stale, or every `--checker X` run fails spuriously."""
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "mod.py:5 env-knob -- different checker, not active this run\n"
+        "other.py:7 blocking-async -- file not scanned this run\n"
+    )
+    findings = _lint(tmp_path, """
+        import time
+
+        async def handler(request):
+            pass
+    """, checkers=["blocking-async"], allowlist=allow)
+    assert findings == []
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("mod.py:5 blocking-async\n")
+    with pytest.raises(LintConfigError):
+        parse_allowlist(str(allow))
+    allow.write_text("mod.py:5 blocking-async -- \n")
+    with pytest.raises(LintConfigError):
+        parse_allowlist(str(allow))
+
+
+# ----------------------------------------------------------------------
+# tier-1 gate
+# ----------------------------------------------------------------------
+
+
+def test_gate_tree_is_clean_no_jax_and_docs_fresh():
+    """THE gate: linting areal_tpu/ finds nothing unallowlisted, never
+    imports jax (AST-only — this is what keeps it <10s on the 2-core
+    host), and docs/env_vars.md matches the registry."""
+    code = (
+        "import sys\n"
+        "from areal_tpu.lint.cli import main\n"
+        "rc = main(['areal_tpu', '--check-env-docs', 'docs/env_vars.md'])\n"
+        "assert 'jax' not in sys.modules, 'lint gate imported jax'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, (
+        f"areal-lint gate failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_gate_cli_seeded_violation_fires(tmp_path):
+    """End-to-end CLI run over a seeded violation: nonzero exit + a
+    rendered finding line."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\n\nasync def h(r):\n    time.sleep(1)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "areal_lint.py"),
+         str(bad)],
+        capture_output=True, text=True, timeout=60, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "blocking-async" in proc.stdout

@@ -32,6 +32,12 @@ def _manager(exp, trial, weight_version, submitted, offpolicyness=2, tbs=8):
     m.weight_version = weight_version
     m.rollout_stat = RolloutStat()
     m.rollout_stat.submitted = submitted
+    # is_staled() reads a snapshot the poll thread maintains (the
+    # name_resolve read is file I/O and must stay off the HTTP loop —
+    # areal-lint blocking-async); _configure primes it the same way
+    # before the HTTP server starts serving /allocate_rollout.
+    m._training_samples_cache = 0
+    m._refresh_training_samples()
     return m
 
 

@@ -10,6 +10,7 @@ import pytest
 from aiohttp import web
 
 from areal_tpu.base.chunking import chunk_spans, hash_chunk
+from areal_tpu.base.wire_schemas import WEIGHT_CHUNKS_V1
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.engine.weight_client import (
     ChunkStore,
@@ -242,7 +243,7 @@ def test_torn_chunk_resumes_with_range():
     chunk_bytes = 1 << 12
     spans = chunk_spans(len(payload), chunk_bytes)
     man = {
-        "schema": "areal-weight-chunks/v1",
+        "schema": WEIGHT_CHUNKS_V1,
         "version": 1,
         "chunk_bytes": chunk_bytes,
         "total_bytes": len(payload),
