@@ -392,6 +392,43 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "None ships the pool's own precision"
         },
     )
+    # Tiered KV plane (docs/serving.md "KV tiering + global prefix
+    # index").
+    gen_kv_tier_mb: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "host-RAM KV tier capacity (MiB) per generation "
+            "server: prefix-cache evictions spill there (handoff wire "
+            "format) instead of being freed, and returning sessions "
+            "restore instead of re-prefilling. None = "
+            "AREAL_KV_TIER_BYTES (default off)"
+        },
+    )
+    gen_kv_tier_disk_dir: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "optional local-disk second KV tier directory "
+            "(host-LRU evictions demote there, hash-verified on "
+            "read-back). None = AREAL_KV_TIER_DISK_DIR"
+        },
+    )
+    gen_kv_spill_dtype: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "'int8' quantizes FLOAT KV pools' prefixes on the "
+            "spill wire (halves tier bytes; int8 pools always spill "
+            "their data+scales form). None = AREAL_KV_SPILL_DTYPE"
+        },
+    )
+    gen_kv_index_size: Optional[int] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "LRU cap on the manager's global prefix index "
+            "(qid -> holder + tier; lets ANY server serve a returning "
+            "session by pulling its prefix from the holder). None = "
+            "AREAL_KV_INDEX_SIZE; 0 disables index-aware routing"
+        },
+    )
     gen_elastic_pools: bool = dataclasses.field(
         default=False,
         metadata={

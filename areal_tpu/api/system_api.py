@@ -215,6 +215,21 @@ class GenerationServerConfig:
     # server-to-server hop; the importer dequantizes). None ships the
     # pool's own precision.
     kv_handoff_compress: Optional[str] = None
+    # Tiered KV plane (engine/kv_tier.py, docs/serving.md): host-RAM
+    # capacity for spilled prefixes. Prefix-cache evictions spill here
+    # (handoff wire format) instead of being freed; returning sessions
+    # restore instead of re-prefilling, and peers can pull held
+    # prefixes over /kv/{manifest,chunk}. None = AREAL_KV_TIER_BYTES
+    # (default 0 = disabled).
+    kv_tier_bytes: Optional[int] = None
+    # Optional local-disk second tier: host-LRU evictions demote here
+    # (hash-verified on read-back). None = AREAL_KV_TIER_DISK_DIR.
+    kv_tier_disk_dir: Optional[str] = None
+    kv_tier_disk_bytes: Optional[int] = None
+    # Spill wire precision: 'int8' quantizes FLOAT pools' prefixes on
+    # the spill wire (halves tier bytes; int8 pools always spill their
+    # (data, scales) form). None = AREAL_KV_SPILL_DTYPE.
+    kv_spill_dtype: Optional[str] = None
     # Shard the engine over this many local devices (megatron-style TP
     # via GSPMD; see engine/serving.serving_mesh).
     tensor_parallel: int = 1
@@ -262,6 +277,13 @@ class GserverManagerConfig:
     affinity_saturation_requests: Optional[int] = None
     # LRU cap on the affinity map (entries are one url per qid).
     affinity_map_size: int = 65536
+    # Global prefix index (tiered KV plane, docs/serving.md): LRU cap
+    # on the qid -> (holder, tier) map fed from each server's
+    # /kv/index. Affinity is the fast path; this index lets ANY server
+    # serve a returning session by pulling its prefix from whichever
+    # peer/tier holds it. None = AREAL_KV_INDEX_SIZE (default 65536);
+    # 0 disables index-aware routing.
+    kv_index_size: Optional[int] = None
     max_head_offpolicyness: int = 0
     train_batch_size: int = 8
     flush_request_timeout: float = 120.0

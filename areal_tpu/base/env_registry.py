@@ -78,6 +78,31 @@ _KNOBS: List[Knob] = [
     _k("AREAL_CHUNK_SMEM_BUDGET", "int", 512 * 1024,
        "SMEM byte budget the chunked-prefill kernel sizes its blocks "
        "against (engine/paged.py).", snapshot=True),
+    # -- tiered KV plane (engine/kv_tier.py, docs/serving.md) ------------
+    _k("AREAL_KV_TIER_BYTES", "int", 0,
+       "Host-RAM KV tier capacity in bytes when the engine ctor passes "
+       "None: prefix-cache evictions SPILL here (handoff wire format) "
+       "instead of being freed, and a returning session restores the "
+       "prefix instead of re-prefilling. 0 disables the tier.",
+       snapshot=True),
+    _k("AREAL_KV_TIER_DISK_DIR", "str", None,
+       "Optional local-disk second KV tier: host-tier LRU evictions "
+       "demote into this directory instead of being dropped (read back "
+       "with per-chunk hash verification). Unset = no disk tier.",
+       snapshot=True),
+    _k("AREAL_KV_TIER_DISK_BYTES", "int", 1 << 30,
+       "Capacity of the local-disk KV tier (AREAL_KV_TIER_DISK_DIR); "
+       "LRU entries beyond it are dropped for good.", snapshot=True),
+    _k("AREAL_KV_SPILL_DTYPE", "str", None,
+       "KV spill wire precision when the engine ctor passes None: "
+       "'int8' quantizes a FLOAT pool's prefixes on the spill wire "
+       "(quantize_kv — halves tier bytes); int8 pools always spill "
+       "their (data, scales) form unchanged. None/'model' ships the "
+       "pool's own precision.", snapshot=True),
+    _k("AREAL_KV_INDEX_SIZE", "int", 65536,
+       "LRU capacity of the gserver manager's global prefix index "
+       "(qid -> holder + tier, fed from each server's /kv/index) when "
+       "GserverManagerConfig.kv_index_size is unset."),
     _k("AREAL_CKPT_BACKEND", "str", "pickle",
        "Checkpoint storage backend when the API caller passes none: "
        "'pickle' or 'orbax' (engine/checkpoint.py)."),

@@ -20,6 +20,14 @@ Stdlib-only; imported by the no-jax lint gate.
 # Paged-KV prefill->decode handoff payload (engine/kv_handoff.py).
 KV_HANDOFF_V1 = "areal-kv-handoff/v1"
 
+# Tiered-KV manifest: a spilled/parked prefix advertised by a holder
+# (engine/kv_tier.py store entries; the /kv/{manifest,index} surface on
+# generation servers; the manager's global prefix index). The payload
+# bytes inside stay byte-identical KV_HANDOFF_V1 blobs — this schema
+# only wraps WHERE a prefix lives (holder url + tier), never HOW its
+# KV is encoded.
+KV_TIER_V1 = "areal-kv-tier/v1"
+
 # Content-hashed weight chunk stream + manifest (base/chunking.py).
 WEIGHT_CHUNKS_V1 = "areal-weight-chunks/v1"
 

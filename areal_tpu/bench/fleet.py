@@ -224,6 +224,8 @@ class ProcessFleet:
         }
         if sched.get("decode_url"):
             payload["decode_url"] = sched["decode_url"]
+        if sched.get("kv_source"):
+            payload["kv_source"] = sched["kv_source"]
         try:
             return _post(sched["url"], "/generate", payload, timeout=timeout)
         except urllib.error.HTTPError as e:

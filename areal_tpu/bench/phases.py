@@ -225,6 +225,23 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="sessions_resident",
+    entrypoint="areal_tpu.bench.workloads:sessions_resident_phase",
+    priority=6,
+    est_compile_s=90.0,
+    est_measure_s=240.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Tiered-KV plane: resident-session sweep past the HBM "
+                "prefix budget on real server processes — returning-"
+                "session TTFT with the host tier vs the full-re-prefill "
+                "baseline, hit rate by tier (hbm/host/peer/miss), zero "
+                "true prefix loss under pressure, and the int8-vs-float "
+                "spill-wire byte ratio (CPU-proxy)",
+))
+
+register(PhaseSpec(
     name="pack_density",
     entrypoint="areal_tpu.bench.workloads:pack_density_phase",
     priority=10,

@@ -24,6 +24,7 @@ tests can use it without touching a device.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -134,6 +135,10 @@ def build_meta(
         "qid": str(qid),
         "version": int(version),
         "n_tokens": len(tokens),
+        # Prefix identity for the tiered-KV plane's global index: two
+        # holders of the same hash hold interchangeable KV (same tokens,
+        # same version check at import).
+        "content_hash": prefix_content_hash(tokens),
         "tokens": [int(t) for t in tokens],
         "kv_wire": kv_wire,
         "n_layers": int(cfg.n_layers),
@@ -158,6 +163,37 @@ def check_geometry(meta: Dict, cfg) -> None:
             raise KVHandoffError(
                 f"geometry mismatch: blob {field}={got}, engine has {want}"
             )
+
+
+def prefix_content_hash(tokens: List[int]) -> str:
+    """Content hash of a token prefix — the identity the tiered-KV
+    plane's global index keys on besides the qid (two sessions sharing
+    an exact prefix hash identically; a qid reused for different content
+    does not). Stable across processes: hashes the int64-LE encoding."""
+    return hashlib.sha256(
+        np.asarray(tokens, np.int64).tobytes()
+    ).hexdigest()
+
+
+def unpack_kv_int8(meta: Dict, payload: bytes, verify: bool = True):
+    """(k_data, k_scales, v_data, v_scales) for an int8 wire WITHOUT the
+    float round trip: an int8 KV pool scatters these straight back in
+    (paged.scatter_prefill_int8), so a spill + restore of an int8 pool
+    is bit-exact and never pays quantize→dequantize→quantize.
+
+    Raises KVHandoffError for non-int8 wires — the caller dispatches on
+    ``meta["kv_wire"]``."""
+    if meta.get("kv_wire") != "int8":
+        raise KVHandoffError(
+            f"unpack_kv_int8 on a {meta.get('kv_wire')!r} wire"
+        )
+    arrs = unpack_arrays(meta, payload, verify=verify)
+    return (
+        np.asarray(arrs["k_data"], np.int8),
+        np.asarray(arrs["k_scales"], np.float32),
+        np.asarray(arrs["v_data"], np.int8),
+        np.asarray(arrs["v_scales"], np.float32),
+    )
 
 
 def unpack_kv_float(meta: Dict, payload: bytes, verify: bool = True):

@@ -593,6 +593,32 @@ def scatter_prefill(k_pages, v_pages, k_pref, v_pref, flat_page_ids):
     return write(k_pages, k_pref), write(v_pages, v_pref)
 
 
+@functools.partial(jax.jit, donate_argnames=("k_pages", "v_pages"))
+def scatter_prefill_int8(k_pages, v_pages, k_data, k_scales, v_data,
+                         v_scales, page_ids):
+    """Write an int8-wire KV prefix straight into an int8 pool — the
+    tier-restore fast path (ISSUE 11 satellite): the wire's (data,
+    scales) pairs ARE the pool encoding, so a spill + restore round
+    trip is bit-exact and never pays dequantize→re-quantize (nor the
+    4x float staging bytes).
+
+    k_data/v_data: [L, Hkv, pad, hd] int8 token-major (padded to whole
+    pages); k_scales/v_scales: [L, Hkv, pad] f32; page_ids: [pad//pg]
+    pool pages in order. Pools must be (data, scales) pairs."""
+    L, Hkv, pad, hd = k_data.shape
+    pg = k_pages[0].shape[3]
+    n_chunks = pad // pg
+
+    def write(pool, data, scales):
+        d = data.reshape(L, Hkv, n_chunks, pg, hd)
+        s = scales.reshape(L, Hkv, n_chunks, pg)
+        return (pool[0].at[:, :, page_ids].set(d),
+                pool[1].at[:, :, page_ids].set(s))
+
+    return (write(k_pages, k_data, k_scales),
+            write(v_pages, v_data, v_scales))
+
+
 # ----------------------------------------------------------------------
 # Per-slot sampling (shared by the decode block and batched prefill)
 # ----------------------------------------------------------------------

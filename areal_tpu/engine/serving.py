@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from areal_tpu.base import env_registry, logging, tracing
+from areal_tpu.base.fault_injection import faults
 from areal_tpu.base.latency import LatencyHistogram
 from areal_tpu.engine.paged import (
     TRASH_PAGE,
@@ -173,6 +174,11 @@ AREAL_LINT_LOOP_ONLY = {
             "_slot_req", "_slot_out", "_slot_lp", "_slot_vstart",
             "_slot_pages", "_slot_emit_t", "_rng", "_history",
             "_admit_inflight", "_blocks_since_admit",
+            # Tiered-KV spill state: the parked-qids snapshot clock is
+            # loop-owned (other threads read the _parked_qids snapshot
+            # dict itself, replaced wholesale — the _backlog_len
+            # pattern — plus the thread-safe _spill_q / kv_tier store).
+            "_parked_snap_t",
         ],
         "init_ok": ["__init__"],
         "instance_hints": ["engine", "eng"],
@@ -218,6 +224,10 @@ class ServingEngine:
         decode_weight_dtype: Optional[str] = None,
         prefill_token_budget: Optional[int] = None,
         decode_blocks_per_admit: int = 1,
+        kv_tier_bytes: Optional[int] = None,
+        kv_tier_disk_dir: Optional[str] = None,
+        kv_tier_disk_bytes: Optional[int] = None,
+        kv_spill_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
         # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
@@ -485,6 +495,72 @@ class ServingEngine:
         self.kv_import_bytes = 0
         self.last_kv_import_ms = 0.0
 
+        # Tiered KV plane (engine/kv_tier.py, docs/serving.md): prefix
+        # evictions SPILL to a host-RAM (+ optional disk) tier in the
+        # handoff wire format instead of being freed; a returning
+        # session restores through the import scatter path instead of
+        # paying a full re-prefill. The gather is dispatched ON the
+        # loop thread (pool arrays are donated by the decode block),
+        # but the device fetch + hashing + quantize run on a dedicated
+        # spill thread — the PR 10 blocking-async discipline applied to
+        # the serve loop itself.
+        if kv_tier_bytes is None:
+            kv_tier_bytes = env_registry.get_int("AREAL_KV_TIER_BYTES")
+        if kv_tier_disk_dir is None:
+            kv_tier_disk_dir = env_registry.get_str("AREAL_KV_TIER_DISK_DIR")
+        if kv_tier_disk_bytes is None:
+            kv_tier_disk_bytes = env_registry.get_int(
+                "AREAL_KV_TIER_DISK_BYTES"
+            )
+        if kv_spill_dtype is None:
+            kv_spill_dtype = env_registry.get_str("AREAL_KV_SPILL_DTYPE")
+        if kv_spill_dtype not in (None, "model", "int8"):
+            raise ValueError(
+                f"kv_spill_dtype={kv_spill_dtype!r}: expected None, "
+                f"'model', or 'int8'"
+            )
+        self.kv_spill_dtype = (
+            None if kv_spill_dtype == "model" else kv_spill_dtype
+        )
+        self.kv_tier = None
+        if kv_tier_bytes and int(kv_tier_bytes) > 0:
+            from areal_tpu.engine.kv_tier import KVTierStore
+
+            self.kv_tier = KVTierStore(
+                int(kv_tier_bytes),
+                disk_dir=kv_tier_disk_dir,
+                disk_capacity_bytes=int(kv_tier_disk_bytes or (1 << 30)),
+            )
+        # Bounded: each item pins one gathered-KV device array pair
+        # until the spill thread drains it; overflow drops the spill
+        # (counted as prefix loss) rather than holding device memory.
+        self._spill_q: "queue.Queue" = queue.Queue(maxsize=64)
+        self._spill_thread: Optional[threading.Thread] = None
+        # Weight-swap tier flush, executed BY the spill thread: the
+        # clear does per-entry disk unlinks under the store lock —
+        # work the serve loop must never pay mid-swap.
+        self._tier_clear = threading.Event()
+        self.kv_spills = 0          # spill thread
+        self.kv_spill_bytes = 0     # spill thread
+        self.kv_spill_tokens = 0    # spill thread
+        self.kv_restores = 0        # restore callers (server executor)
+        self.kv_restore_host = 0
+        self.kv_restore_disk = 0
+        self.kv_restore_tokens = 0
+        # Residual TRUE prefix loss (ISSUE 11 satellite): pages freed
+        # while their KV was still valid and could not be spilled —
+        # tier disabled, spill queue overflow, or a spill-thread
+        # failure. Split per writer thread so the increments never
+        # race; /metrics exposes the sum as kv_prefix_lost_total.
+        self._kv_lost_evict = 0     # engine loop
+        self._kv_lost_spill = 0     # spill thread
+        # Off-thread snapshot of the parked-prefix qids (loop-only
+        # _prefix_cache must never be read from server threads; the
+        # loop refreshes this dict wholesale every ~0.2s — same pattern
+        # as _backlog_len / _kv_pages_free).
+        self._parked_qids: Dict[str, int] = {}
+        self._parked_snap_t = 0.0
+
     # ------------------------------------------------------------------
     # Public API
     # ------------------------------------------------------------------
@@ -492,11 +568,25 @@ class ServingEngine:
     def start(self):
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if self.kv_tier is not None:
+            self._spill_thread = threading.Thread(
+                target=self._spill_worker, daemon=True
+            )
+            self._spill_thread.start()
 
     def stop(self):
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._spill_thread:
+            # Best-effort wake only: the worker polls with a short get
+            # timeout, and a blocking put on a full queue with a
+            # stopped consumer would deadlock shutdown.
+            try:
+                self._spill_q.put_nowait(None)
+            except queue.Full:
+                pass
+            self._spill_thread.join(timeout=10)
 
     def submit(self, req: GenRequest):
         # _fatal_lock closes the submit-vs-_fail_all race: without it a
@@ -663,30 +753,30 @@ class ServingEngine:
                 self._cached_tokens -= len(ent[0])
                 self._allocator.free(ent[1])
 
-        ent, toks, pages, version, k, v = self._run_on_loop(_peek_and_gather)
         try:
-            if isinstance(k, tuple):  # int8 pool: (data, scales)
-                arrays = [
-                    ("k_data", np.asarray(k[0])),
-                    ("k_scales", np.asarray(k[1], np.float32)),
-                    ("v_data", np.asarray(v[0])),
-                    ("v_scales", np.asarray(v[1], np.float32)),
-                ]
-                wire = "int8"
-            elif compress == "int8":
-                kw, ks = quantize_kv(k)
-                vw, vs = quantize_kv(v)
-                arrays = [
-                    ("k_data", np.asarray(kw)),
-                    ("k_scales", np.asarray(ks[..., 0], np.float32)),
-                    ("v_data", np.asarray(vw)),
-                    ("v_scales", np.asarray(vs[..., 0], np.float32)),
-                ]
-                wire = "int8"
-            else:
-                kh, vh = np.asarray(k), np.asarray(v)
-                arrays = [("k", kh), ("v", vh)]
-                wire = kh.dtype.name
+            ent, toks, pages, version, k, v = self._run_on_loop(
+                _peek_and_gather
+            )
+        except KeyError:
+            # Pool pressure spilled the park to the host tier: serve the
+            # blob from there — the tier makes the old evicted-before-
+            # export silent-loss window a served export instead. The
+            # entry is consumed, like the HBM pop (the decode side owns
+            # the sequence now).
+            got = (
+                self.kv_tier.get(qid, count=False)
+                if self.kv_tier is not None else None
+            )
+            if got is None:
+                raise
+            meta, payload, _tier = got
+            self.kv_tier.discard(qid)
+            self.kv_exports += 1
+            self.kv_export_bytes += len(payload)
+            self.last_kv_export_ms = (time.monotonic() - t0) * 1000.0
+            return meta, payload
+        try:
+            arrays, wire = self._pack_kv_wire(k, v, compress)
             segments, chunks, payload = kvh.pack_arrays(arrays)
             meta = kvh.build_meta(
                 qid, version, toks, wire, self.cfg, segments, chunks
@@ -711,31 +801,73 @@ class ServingEngine:
         leave stale KV parked), and KVHandoffError on geometry/hash
         problems or pool exhaustion."""
         from areal_tpu.engine import kv_handoff as kvh
+        from areal_tpu.engine.paged import scatter_prefill_int8
 
         t0 = time.monotonic()
         kvh.check_geometry(meta, self.cfg)
-        kf, vf = kvh.unpack_kv_float(meta, payload)  # [L, Hkv, n, hd]
         qid = str(meta["qid"])
         toks = [int(t) for t in meta["tokens"]]
         n = len(toks)
-        if n != int(meta["n_tokens"]) or kf.shape[2] != n:
-            raise kvh.KVHandoffError(
-                f"token/KV length mismatch: {n} tokens, KV {kf.shape}"
-            )
         n_pg = pages_needed(n, self.page_size)
         pad = n_pg * self.page_size
 
-        def to_pref(x):
-            # [L, Hkv, n, hd] -> scatter_prefill's [L, 1, pad, Hkv, hd]
-            L, H, _, hd = x.shape
-            out = np.zeros((L, 1, pad, H, hd), np.float32)
-            out[:, 0, :n] = x.transpose(0, 2, 1, 3)
-            return out
+        if meta["kv_wire"] == "int8" and self.kv_cache_dtype == "int8":
+            # int8-preserving fast path (ISSUE 11 satellite): the wire's
+            # (data, scales) pairs ARE an int8 pool's encoding, so they
+            # scatter straight in — no dequantize→re-quantize round
+            # trip (a spill + restore is bit-exact) and a quarter the
+            # staged host/transfer bytes of the float path.
+            kd, ks, vd, vs = kvh.unpack_kv_int8(meta, payload)
+            if n != int(meta["n_tokens"]) or kd.shape[2] != n:
+                raise kvh.KVHandoffError(
+                    f"token/KV length mismatch: {n} tokens, KV {kd.shape}"
+                )
 
-        # Stage the (small) host->device transfers off the loop thread;
-        # only the scatter dispatch runs on it.
-        k_dev = jnp.asarray(to_pref(kf))
-        v_dev = jnp.asarray(to_pref(vf))
+            def pad_d(x):
+                L, H, _, hd = x.shape
+                out = np.zeros((L, H, pad, hd), x.dtype)
+                out[:, :, :n] = x
+                return out
+
+            def pad_s(s):
+                L, H, _ = s.shape
+                out = np.zeros((L, H, pad), np.float32)
+                out[:, :, :n] = s
+                return out
+
+            kd_dev, ks_dev = jnp.asarray(pad_d(kd)), jnp.asarray(pad_s(ks))
+            vd_dev, vs_dev = jnp.asarray(pad_d(vd)), jnp.asarray(pad_s(vs))
+
+            # Pools in, pools out: the loop-only attr writes stay inside
+            # the door-passed _write below (areal-lint loop-only).
+            def scatter(k_pages, v_pages, pages_dev):
+                return scatter_prefill_int8(
+                    k_pages, v_pages,
+                    kd_dev, ks_dev, vd_dev, vs_dev, pages_dev,
+                )
+        else:
+            kf, vf = kvh.unpack_kv_float(meta, payload)  # [L, Hkv, n, hd]
+            if n != int(meta["n_tokens"]) or kf.shape[2] != n:
+                raise kvh.KVHandoffError(
+                    f"token/KV length mismatch: {n} tokens, KV {kf.shape}"
+                )
+
+            def to_pref(x):
+                # [L, Hkv, n, hd] -> scatter_prefill's [L, 1, pad, Hkv, hd]
+                L, H, _, hd = x.shape
+                out = np.zeros((L, 1, pad, H, hd), np.float32)
+                out[:, 0, :n] = x.transpose(0, 2, 1, 3)
+                return out
+
+            # Stage the (small) host->device transfers off the loop
+            # thread; only the scatter dispatch runs on it.
+            k_dev = jnp.asarray(to_pref(kf))
+            v_dev = jnp.asarray(to_pref(vf))
+
+            def scatter(k_pages, v_pages, pages_dev):
+                return scatter_prefill(
+                    k_pages, v_pages, k_dev, v_dev, pages_dev,
+                )
 
         def _write():
             if int(meta["version"]) != self.version:
@@ -749,9 +881,8 @@ class ServingEngine:
                     f"pool exhausted: need {n_pg} pages, "
                     f"{self._allocator.n_free} free"
                 )
-            self._k_pages, self._v_pages = scatter_prefill(
-                self._k_pages, self._v_pages, k_dev, v_dev,
-                jnp.asarray(pages, jnp.int32),
+            self._k_pages, self._v_pages = scatter(
+                self._k_pages, self._v_pages, jnp.asarray(pages, jnp.int32)
             )
             old = self._prefix_cache.pop(qid, None)
             if old is not None:
@@ -1098,6 +1229,25 @@ class ServingEngine:
             "kv_import_total": float(self.kv_imports),
             "kv_import_bytes": float(self.kv_import_bytes),
             "last_kv_import_ms": float(self.last_kv_import_ms),
+            # Tiered KV plane: spill/restore counters + per-tier store
+            # telemetry (zeros when the tier is disabled).
+            "kv_spill_total": float(self.kv_spills),
+            "kv_spill_bytes": float(self.kv_spill_bytes),
+            "kv_spill_tokens": float(self.kv_spill_tokens),
+            "kv_restore_total": float(self.kv_restores),
+            "kv_restore_host": float(self.kv_restore_host),
+            "kv_restore_disk": float(self.kv_restore_disk),
+            "kv_restore_tokens": float(self.kv_restore_tokens),
+            "kv_prefix_lost_total": float(
+                self._kv_lost_evict + self._kv_lost_spill
+            ),
+            **{
+                f"kv_tier_{k}": v
+                for k, v in (
+                    self.kv_tier.stats() if self.kv_tier is not None
+                    else {}
+                ).items()
+            },
             # Speculative decoding yield: emitted tokens per decode STEP
             # across slots that were active (1.0 = no speculation value;
             # the ceiling is 1 + draft_len). The number that decides
@@ -1571,30 +1721,245 @@ class ServingEngine:
                 jnp.asarray(rows),
             )
 
-    def _evict_one_prefix(self, pinned: Optional[set] = None) -> bool:
-        """Free the least-recently-used cached prefix's pages. Entries
-        whose qid is in `pinned` (a request for them is already queued —
-        a KV-handoff import or a continuation about to admit) are
+    def _evict_one_prefix(self, pinned: Optional[set] = None,
+                          spill: bool = True) -> bool:
+        """Evict the least-recently-used cached prefix's pages — but
+        SPILL the KV to the host tier first when one is configured
+        (handoff wire format; the gather dispatches here on the loop,
+        the device fetch + pack run on the spill thread), so eviction
+        demotes the prefix instead of destroying it. Entries whose qid
+        is in `pinned` (a request for them is already queued — a
+        KV-handoff import or a continuation about to admit) are
         skipped: evicting them turns a one-token delta prefill into a
         full re-prefill ON the serve loop, stalling every running decode
-        stream. Returns False when nothing (unpinned) is evictable."""
+        stream. Returns False when nothing (unpinned) is evictable.
+        ``spill=False`` is the weight-swap flush: that KV is stale the
+        moment the swap lands, so spilling it would only poison the
+        tier."""
         if not self._prefix_cache:
             return False
+        qid = None
         if pinned:
-            for qid in self._prefix_cache:  # oldest-first iteration
-                if qid not in pinned:
-                    toks, pages = self._prefix_cache.pop(qid)
-                    self._allocator.free(pages)
-                    self._cached_tokens -= len(toks)
-                    return True
-            return False
-        qid, (toks, pages) = self._prefix_cache.popitem(last=False)
+            for q in self._prefix_cache:  # oldest-first iteration
+                if q not in pinned:
+                    qid = q
+                    break
+            if qid is None:
+                return False
+            toks, pages = self._prefix_cache.pop(qid)
+        else:
+            qid, (toks, pages) = self._prefix_cache.popitem(last=False)
+        self._spill_or_lose(qid, toks, pages, spill)
         self._allocator.free(pages)
         self._cached_tokens -= len(toks)
         return True
 
+    def _spill_or_lose(self, qid: str, toks: List[int], pages: List[int],
+                       spill: bool):
+        """Loop-thread half of a spill: dispatch the token-major gather
+        while the pages are still allocated (the results are fresh
+        arrays, safe to device_get off-loop), then hand the rest to the
+        spill thread. Anything that prevents the spill while the KV was
+        still valid counts as a TRUE prefix loss (kv_prefix_lost_total
+        on /metrics — the residual the tier exists to eliminate)."""
+        if not spill:
+            return  # weight-swap flush: the KV is stale, not lost
+        if self.kv_tier is None:
+            self._kv_lost_evict += 1
+            return
+        from areal_tpu.engine.paged import gather_kv_tokens
+
+        n = len(toks)
+        n_pg = pages_needed(n, self.page_size)
+        k = gather_kv_tokens(self._k_pages, pages[:n_pg], n)
+        v = gather_kv_tokens(self._v_pages, pages[:n_pg], n)
+        try:
+            self._spill_q.put_nowait(
+                (qid, list(toks), self.version, k, v)
+            )
+        except queue.Full:
+            # Dropping here (not blocking) keeps the serve loop's
+            # latency bounded; the continuation pays a re-prefill.
+            self._kv_lost_evict += 1
+
+    def _pack_kv_wire(self, k, v, compress: Optional[str]):
+        """(arrays, wire) for a gathered (possibly int8-pool) KV pair —
+        shared by the handoff export and the spill worker. int8 pools
+        ship their (data, scales) form unchanged; float pools optionally
+        quantize on the wire (``compress='int8'``)."""
+        if isinstance(k, tuple):  # int8 pool: (data, scales)
+            arrays = [
+                ("k_data", np.asarray(k[0])),
+                ("k_scales", np.asarray(k[1], np.float32)),
+                ("v_data", np.asarray(v[0])),
+                ("v_scales", np.asarray(v[1], np.float32)),
+            ]
+            return arrays, "int8"
+        if compress == "int8":
+            kw, ks = quantize_kv(k)
+            vw, vs = quantize_kv(v)
+            arrays = [
+                ("k_data", np.asarray(kw)),
+                ("k_scales", np.asarray(ks[..., 0], np.float32)),
+                ("v_data", np.asarray(vw)),
+                ("v_scales", np.asarray(vs[..., 0], np.float32)),
+            ]
+            return arrays, "int8"
+        kh, vh = np.asarray(k), np.asarray(v)
+        return [("k", kh), ("v", vh)], kh.dtype.name
+
+    def _spill_worker(self):
+        """Dedicated spill thread: device fetch (np.asarray of the
+        fresh gathered arrays), optional int8 quantize, chunk hashing,
+        and the tier insert — all the blocking work the serve loop must
+        never pay (PR 10 discipline). One failure loses one prefix
+        (counted), never the thread."""
+        from areal_tpu.engine import kv_handoff as kvh
+
+        while not self._stop.is_set():
+            if self._tier_clear.is_set():
+                # Weight swap landed: every tiered prefix is stale.
+                # Cleared HERE (disk unlinks, store lock) so the serve
+                # loop's swap window never pays for it.
+                self._tier_clear.clear()
+                self.kv_tier.clear()
+            try:
+                item = self._spill_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if item is None:
+                continue
+            qid, toks, version, k, v = item
+            if version != self.version:
+                # Spilled under weights that are no longer live (a swap
+                # landed while the item queued): restoring it would be
+                # version-rejected anyway — stale, not lost. Dropping
+                # here also keeps post-clear re-population impossible.
+                continue
+            t0 = tracing.now_ns() if tracing.enabled() else 0
+            try:
+                faults.maybe_fail("engine.kv_spill")
+                arrays, wire = self._pack_kv_wire(
+                    k, v, self.kv_spill_dtype
+                )
+                segments, chunks, payload = kvh.pack_arrays(arrays)
+                meta = kvh.build_meta(
+                    qid, version, toks, wire, self.cfg, segments, chunks
+                )
+                self.kv_tier.put(qid, meta, payload)
+                self.kv_spills += 1
+                self.kv_spill_bytes += len(payload)
+                self.kv_spill_tokens += len(toks)
+                if tracing.enabled():
+                    tracing.record_span(
+                        "server.kv_spill", t0, qid=qid,
+                        n_tokens=len(toks), bytes=len(payload), wire=wire,
+                    )
+            except Exception:
+                self._kv_lost_spill += 1
+                logger.warning(f"kv spill failed for {qid!r}",
+                               exc_info=True)
+
+    def restore_from_tier(self, qid: str,
+                          prompt_ids: Optional[List[int]] = None) -> int:
+        """Pull a spilled prefix back from the tier into the paged pool
+        (import scatter path) and park it, so the continuation about to
+        be submitted admits as a delta prefill. Returns the restored
+        token count, 0 on a miss/mismatch. Runs on server executor
+        threads — never the event loop, never the serve loop directly
+        (import_kv_handoff takes the loop door itself).
+
+        A version-mismatched entry (spilled under older weights) is
+        dropped; a prompt that does not extend the spilled tokens leaves
+        the entry in place (another turn may still match)."""
+        from areal_tpu.engine import kv_handoff as kvh
+
+        if self.kv_tier is None:
+            return 0
+        # Validate against the META first (always host-resident): a
+        # rejected probe must not pay a disk read/promotion nor count a
+        # tier hit — that would churn the LRU and overstate the tier's
+        # effectiveness vs kv_restore_total.
+        meta0 = self.kv_tier.peek_meta(qid, count_miss=True)
+        if meta0 is None:
+            return 0
+        if int(meta0.get("version", -1)) != self.version:
+            self.kv_tier.discard(qid)  # stale forever under new weights
+            return 0
+        if prompt_ids is not None:
+            toks = [int(t) for t in meta0["tokens"]]
+            use = min(len(toks), len(prompt_ids) - 1)
+            if use < self.page_size or toks[:use] != [
+                int(t) for t in prompt_ids[:use]
+            ]:
+                return 0
+        got = self.kv_tier.get(qid)
+        if got is None:
+            return 0  # raced an LRU ageout between peek and get
+        meta, payload, tier = got
+        try:
+            self.import_kv_handoff(meta, payload)
+        except kvh.KVHandoffVersionMismatch:
+            self.kv_tier.discard(qid)  # stale forever under new weights
+            return 0
+        except (kvh.KVHandoffError, RuntimeError, TimeoutError):
+            # Pool exhaustion / transient loop trouble: keep the entry —
+            # this continuation re-prefills, a later one may restore.
+            return 0
+        self.kv_tier.discard(qid)  # HBM owns the prefix again
+        self.kv_restores += 1
+        self.kv_restore_tokens += int(meta["n_tokens"])
+        if tier == "disk":
+            self.kv_restore_disk += 1
+        else:
+            self.kv_restore_host += 1
+        return int(meta["n_tokens"])
+
+    def has_parked(self, qid: str) -> bool:
+        """Whether the engine holds a parked HBM prefix for qid, from
+        the loop-refreshed snapshot (up to ~0.2s stale — callers use it
+        to skip redundant tier probes, and admission revalidates)."""
+        return qid in self._parked_qids
+
+    def parked_index(self, cap: int = 8192) -> List[Dict[str, Any]]:
+        """HBM-parked entries for the /kv/index surface (snapshot-fed;
+        tier entries come from kv_tier.held())."""
+        out = []
+        for q, n in list(self._parked_qids.items()):
+            if len(out) >= cap:
+                break
+            out.append({
+                "qid": q, "tier": "hbm", "n_tokens": int(n),
+                "content_hash": "", "version": int(self.version),
+            })
+        return out
+
+    def stage_peer_export(self, qid: str) -> Dict[str, Any]:
+        """Peer-pull staging (/kv/manifest): return the handoff meta for
+        a prefix this server holds, guaranteeing its payload is servable
+        from the tier. A tier entry is served as-is (kept until LRU ages
+        it); an HBM park is exported (consumed — the session is moving)
+        and parked in the tier so /kv/chunk can stream its bytes.
+        Raises KeyError when neither tier holds qid."""
+        if self.kv_tier is None:
+            raise KeyError(f"no kv tier to stage peer export for {qid!r}")
+        got = self.kv_tier.get(qid, count=False)
+        if got is not None:
+            return got[0]
+        meta, payload = self.export_kv_handoff(qid)
+        self.kv_tier.put(qid, meta, payload)
+        return meta
+
+    def peer_payload(self, qid: str) -> Optional[Tuple[Dict, bytes]]:
+        """(meta, payload) for /kv/chunk byte serving — no hit
+        accounting, no consume (the peer may pull many chunks)."""
+        if self.kv_tier is None:
+            return None
+        got = self.kv_tier.get(qid, count=False)
+        return None if got is None else (got[0], got[1])
+
     def _flush_prefix_cache(self):
-        while self._evict_one_prefix():
+        while self._evict_one_prefix(spill=False):
             pass
 
     def _pinned_qids(self) -> set:
@@ -1786,6 +2151,14 @@ class ServingEngine:
             jax.device_get(last_leaf.ravel()[:1])
             self.last_weight_swap_s = time.monotonic() - t0
             self.version = version if version is not None else self.version + 1
+            # The spill tier holds KV from the OLD version: flag the
+            # flush for the spill thread (disk unlinks + store lock are
+            # its kind of work, never this loop's) AFTER the version
+            # bump, so its version gate also drops any pre-swap items
+            # still sitting in the spill queue. Until it runs (<0.2s),
+            # restores of stale entries are version-rejected anyway.
+            if self.kv_tier is not None:
+                self._tier_clear.set()
             logger.info(
                 f"serving engine weights updated to v{self.version} "
                 f"in {self.last_weight_swap_s:.3f}s"
@@ -1870,6 +2243,15 @@ class ServingEngine:
             # Refresh the off-thread telemetry snapshots (see __init__).
             self._backlog_len = len(self._backlog)
             self._kv_pages_free = self._allocator.n_free
+            now_lap = time.monotonic()
+            if now_lap - self._parked_snap_t > 0.2:
+                # Parked-prefix snapshot for off-thread consumers
+                # (has_parked / the /kv/index surface): replaced
+                # wholesale, like _backlog_len.
+                self._parked_qids = {
+                    q: len(e[0]) for q, e in self._prefix_cache.items()
+                }
+                self._parked_snap_t = now_lap
             if self._interrupt.is_set():
                 self._interrupt_all()
                 self._apply_pending_params()

@@ -187,6 +187,12 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             tensor_parallel=cfg.gen_tensor_parallel,
             role=roles[i],
             kv_handoff_compress=cfg.gen_kv_handoff_compress,
+            kv_tier_bytes=(
+                cfg.gen_kv_tier_mb << 20
+                if cfg.gen_kv_tier_mb is not None else None
+            ),
+            kv_tier_disk_dir=cfg.gen_kv_tier_disk_dir,
+            kv_spill_dtype=cfg.gen_kv_spill_dtype,
             weight_shard_rank=shards[i][0] if shards[i] else None,
             weight_shard_degree=shards[i][1] if shards[i] else None,
             seed=cfg.seed,
@@ -207,6 +213,7 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         weight_fanout_degree=cfg.gen_weight_fanout,
         weight_cutover_budget_s=cfg.gen_weight_cutover_budget_s,
         weight_wire_dtype=cfg.gen_weight_wire_dtype,
+        kv_index_size=cfg.gen_kv_index_size,
         elastic_pools=cfg.gen_elastic_pools,
         prefill_queue_high_tokens=cfg.gen_prefill_queue_high_tokens,
         prefill_queue_low_tokens=cfg.gen_prefill_queue_low_tokens,

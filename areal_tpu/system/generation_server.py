@@ -116,6 +116,10 @@ class GenerationServer(Worker):
             decode_weight_dtype=config.decode_weight_dtype,
             prefill_token_budget=config.prefill_token_budget,
             decode_blocks_per_admit=config.decode_blocks_per_admit,
+            kv_tier_bytes=config.kv_tier_bytes,
+            kv_tier_disk_dir=config.kv_tier_disk_dir,
+            kv_tier_disk_bytes=config.kv_tier_disk_bytes,
+            kv_spill_dtype=config.kv_spill_dtype,
             mesh=mesh,
         )
         self.engine.start()
@@ -146,6 +150,16 @@ class GenerationServer(Worker):
         self._last_handoff_ms = 0.0
         self._last_kv_transfer_ms = 0.0
         self._handoff_session = None  # lazy aiohttp session (HTTP loop)
+        # Tiered KV plane (docs/serving.md): peer-pull counters — a
+        # returning session routed here without its prefix pulls it
+        # from whichever peer the manager's global index names.
+        self._kv_peer_hits = 0
+        self._kv_peer_bytes = 0
+        self._kv_peer_failed = 0
+        self._last_kv_restore_ms = 0.0
+        self._kv_manifests_served = 0
+        self._kv_chunks_served = 0
+        self._kv_chunk_bytes_served = 0
 
         # Shard-aware weight plane: this server's coordinates in a
         # fleet-level tensor-parallel group (None = fetch full
@@ -260,6 +274,9 @@ class GenerationServer(Worker):
         app.router.add_post("/generate", self._h_generate)
         app.router.add_post("/kv_handoff", self._h_kv_handoff)
         app.router.add_get("/kv_handoff/blob", self._h_kv_blob)
+        app.router.add_get("/kv/manifest", self._h_kv_manifest)
+        app.router.add_get("/kv/chunk", self._h_kv_chunk)
+        app.router.add_get("/kv/index", self._h_kv_index)
         app.router.add_post("/set_role", self._h_set_role)
         app.router.add_post("/configure", self._h_configure)
         app.router.add_post("/update_weights_from_disk", self._h_update_weights)
@@ -332,6 +349,14 @@ class GenerationServer(Worker):
             qid=str(d.get("qid", "")),
             prompt_len=len(d.get("input_ids") or []),
         )
+        # Tiered-KV restore (docs/serving.md): a returning session
+        # routed here without its parked prefix restores it from the
+        # local host/disk tier — or pulls it from the peer the
+        # manager's global prefix index named (``kv_source``) — BEFORE
+        # submission, so admission sees a parked prefix and prefills
+        # only the delta. Any failure degrades to the full re-prefill
+        # this path exists to avoid; it can never fail the request.
+        await self._maybe_restore_prefix(d)
         g = d.get("gconfig", {})
         # Disaggregated path: the manager paired a decode server into
         # this request — prefill to the first token here, hand the KV
@@ -629,6 +654,182 @@ class GenerationServer(Worker):
         merged["latency"] = first_res.latency + res2.latency
         return web.json_response(merged)
 
+    # ------------------------------------------------------------------
+    # Tiered KV plane: restore + peer pull + /kv endpoints
+    # (docs/serving.md "KV tiering + global prefix index")
+    # ------------------------------------------------------------------
+
+    async def _maybe_restore_prefix(self, d: Dict) -> Optional[str]:
+        """Best-effort prefix restore for a returning session; returns
+        the tier it hit ('host'/'disk'/'peer') or None. Never raises —
+        every failure path is a plain re-prefill."""
+        try:
+            return await self._restore_prefix_impl(d)
+        except Exception:
+            logger.warning(
+                f"kv restore for {d.get('qid')!r} failed; "
+                f"falling back to re-prefill", exc_info=True,
+            )
+            return None
+
+    async def _restore_prefix_impl(self, d: Dict) -> Optional[str]:
+        qid = str(d.get("qid") or "")
+        input_ids = [int(t) for t in (d.get("input_ids") or [])]
+        eng = self.engine
+        if (
+            not qid
+            or len(input_ids) <= self.cfg.kv_page_size
+            or eng.has_parked(qid)
+        ):
+            return None
+        kv_source = str(d.get("kv_source") or "")
+        if eng.kv_tier is None and (
+            not kv_source or kv_source == self.address
+        ):
+            return None
+        # Chaos point: tests arm this to break restores and prove the
+        # continuation still completes via re-prefill.
+        await faults.maybe_fail_async("gserver.kv_restore")
+        loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
+        span_t0 = tracing.now_ns() if tracing.enabled() else 0
+        # 1) Local tier (restore_from_tier blocks on the engine loop
+        #    door + device staging: executor, never the event loop).
+        if eng.kv_tier is not None:
+            n = await loop.run_in_executor(
+                None, eng.restore_from_tier, qid, input_ids
+            )
+            if n:
+                self._last_kv_restore_ms = (time.monotonic() - t0) * 1000.0
+                if tracing.enabled():
+                    tracing.record_span(
+                        "server.kv_restore", span_t0,
+                        ctx=tracing.extract_from(d), qid=qid,
+                        tier="local", n_tokens=n,
+                    )
+                return "local"
+        # 2) Peer pull over /kv/{manifest,chunk} — the weight-plane hop
+        #    applied to KV: hash-verified chunks, Range resume.
+        if not kv_source or kv_source == self.address:
+            return None
+        sess = await self._handoff_sess()
+        async with sess.get(
+            f"{kv_source}/kv/manifest", params={"qid": qid}
+        ) as r:
+            if r.status != 200:
+                self._kv_peer_failed += 1
+                return None
+            man = await r.json()
+        hmeta = man.get("meta") or {}
+        toks = [int(t) for t in (hmeta.get("tokens") or [])]
+        use = min(len(toks), len(input_ids) - 1)
+        if (
+            use < self.cfg.kv_page_size
+            or toks[:use] != input_ids[:use]
+            or int(hmeta.get("version", -1)) != eng.version
+        ):
+            # Wrong content or stale version: don't pay the transfer.
+            return None
+        payload = await self._fetch_handoff_payload(
+            kv_source, qid, hmeta, path="/kv/chunk"
+        )
+        await loop.run_in_executor(
+            None, eng.import_kv_handoff, hmeta, payload
+        )
+        self._kv_peer_hits += 1
+        self._kv_peer_bytes += len(payload)
+        self._last_kv_restore_ms = (time.monotonic() - t0) * 1000.0
+        if tracing.enabled():
+            tracing.record_span(
+                "server.kv_restore", span_t0,
+                ctx=tracing.extract_from(d), qid=qid, tier="peer",
+                source=kv_source, n_tokens=len(toks),
+                bytes=len(payload),
+            )
+        return "peer"
+
+    async def _h_kv_manifest(self, request: web.Request) -> web.Response:
+        """Peer-pull hop 1: the handoff meta for a prefix this server
+        holds (tier entry served as-is; an HBM park is exported into
+        the tier first so /kv/chunk can stream its bytes)."""
+        from areal_tpu.base.wire_schemas import KV_TIER_V1
+
+        qid = request.query.get("qid", "")
+        try:
+            # stage_peer_export can block on the engine loop door (HBM
+            # export path): executor, never the event loop.
+            meta = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.stage_peer_export, qid
+            )
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        except Exception as e:
+            return web.json_response({"error": repr(e)}, status=503)
+        self._kv_manifests_served += 1
+        return web.json_response({
+            "schema": KV_TIER_V1, "qid": qid,
+            "holder": self.address, "meta": meta,
+        })
+
+    @staticmethod
+    def _serve_ranged(payload: bytes, request: web.Request) -> web.Response:
+        """Range-aware byte serving shared by the handoff blob and the
+        tier chunk endpoints."""
+        rng = request.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            try:
+                a, _, b = rng[len("bytes="):].partition("-")
+                start = int(a)
+                end = int(b) if b else len(payload) - 1
+            except ValueError:
+                return web.Response(status=416)
+            if start >= len(payload):
+                return web.Response(status=416)
+            end = min(end, len(payload) - 1)
+            return web.Response(
+                body=payload[start: end + 1], status=206,
+                headers={"Content-Range":
+                         f"bytes {start}-{end}/{len(payload)}"},
+            )
+        return web.Response(body=payload)
+
+    async def _h_kv_chunk(self, request: web.Request) -> web.Response:
+        """Peer-pull hop 2: serve a held prefix's payload bytes (the
+        puller verifies per-chunk hashes — the hash, not this server,
+        is the authority)."""
+        qid = request.query.get("qid", "")
+        # peer_payload may read (and hash-verify) a disk-tier entry:
+        # executor, never the event loop.
+        got = await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.peer_payload, qid
+        )
+        if got is None:
+            return web.json_response(
+                {"error": f"no tiered prefix for {qid!r}"}, status=404
+            )
+        resp = self._serve_ranged(got[1], request)
+        self._kv_chunks_served += 1
+        # Bytes actually on the wire (the Range slice), not the whole
+        # payload per chunk request — a 10-chunk pull must read as one
+        # payload, not ten.
+        self._kv_chunk_bytes_served += len(resp.body or b"")
+        return resp
+
+    async def _h_kv_index(self, request: web.Request) -> web.Response:
+        """Holdings advertisement for the manager's global prefix
+        index: HBM parks (loop-refreshed snapshot) + tier entries."""
+        from areal_tpu.base.wire_schemas import KV_TIER_V1
+
+        eng = self.engine
+        held = eng.parked_index()
+        if eng.kv_tier is not None:
+            held += await asyncio.get_running_loop().run_in_executor(
+                None, eng.kv_tier.held
+            )
+        return web.json_response({
+            "schema": KV_TIER_V1, "url": self.address, "held": held,
+        })
+
     async def _h_kv_handoff(self, request: web.Request) -> web.Response:
         """Decode side: pull the blob from the prefill server (chunked,
         hash-verified, Range-resumable), import it into the engine, and
@@ -710,11 +911,13 @@ class GenerationServer(Worker):
         ))
 
     async def _fetch_handoff_payload(
-        self, source: str, qid: str, meta: Dict
+        self, source: str, qid: str, meta: Dict,
+        path: str = "/kv_handoff/blob",
     ) -> bytes:
-        """Chunked pull of the export stash: per-chunk sha256 verify,
-        mid-chunk Range resume on torn reads — the weight-plane transfer
-        discipline applied to the KV hop.
+        """Chunked pull of a KV blob (the disagg export stash, or a
+        peer's KV tier via ``path="/kv/chunk"``): per-chunk sha256
+        verify, mid-chunk Range resume on torn reads — the weight-plane
+        transfer discipline applied to the KV hop.
 
         Regression note (areal-lint blocking-async): verify_chunk used
         to run inline here — sha256 over a multi-MB KV chunk is ~10ms+
@@ -736,7 +939,7 @@ class GenerationServer(Worker):
                 start = off + got
                 try:
                     async with sess.get(
-                        f"{source}/kv_handoff/blob",
+                        f"{source}{path}",
                         params={"qid": qid},
                         headers={"Range":
                                  f"bytes={start}-{off + length - 1}"},
@@ -777,24 +980,7 @@ class GenerationServer(Worker):
             return web.json_response(
                 {"error": f"no handoff blob for {qid!r}"}, status=404
             )
-        payload = ent[1]
-        rng = request.headers.get("Range")
-        if rng and rng.startswith("bytes="):
-            try:
-                a, _, b = rng[len("bytes="):].partition("-")
-                start = int(a)
-                end = int(b) if b else len(payload) - 1
-            except ValueError:
-                return web.Response(status=416)
-            if start >= len(payload):
-                return web.Response(status=416)
-            end = min(end, len(payload) - 1)
-            return web.Response(
-                body=payload[start: end + 1], status=206,
-                headers={"Content-Range":
-                         f"bytes {start}-{end}/{len(payload)}"},
-            )
-        return web.Response(body=payload)
+        return self._serve_ranged(ent[1], request)
 
     async def _h_set_role(self, request: web.Request) -> web.Response:
         """Elastic re-role (manager sizer): flip the live pool role.
@@ -1303,6 +1489,33 @@ class GenerationServer(Worker):
             f"areal:kv_handoff_ok {float(self._handoff_ok)}",
             f"areal:kv_handoff_failed {float(self._handoff_failed)}",
             f"areal:kv_handoff_fallback {float(self._handoff_fallback)}",
+            # Tiered KV plane: spill/restore counters + per-tier
+            # hit/miss/bytes (docs/serving.md). kv_prefix_lost_total is
+            # the residual TRUE-loss count the tier exists to zero out
+            # (chaos bench asserts 0 under pressure).
+            f"areal:kv_spill_total {m['kv_spill_total']}",
+            f"areal:kv_spill_bytes {m['kv_spill_bytes']}",
+            f"areal:kv_spill_tokens {m['kv_spill_tokens']}",
+            f"areal:kv_restore_total {m['kv_restore_total']}",
+            f"areal:kv_restore_host {m['kv_restore_host']}",
+            f"areal:kv_restore_disk {m['kv_restore_disk']}",
+            f"areal:kv_restore_tokens {m['kv_restore_tokens']}",
+            f"areal:kv_prefix_lost_total {m['kv_prefix_lost_total']}",
+            f"areal:kv_tier_host_bytes {m.get('kv_tier_host_bytes', 0.0)}",
+            f"areal:kv_tier_disk_bytes {m.get('kv_tier_disk_bytes', 0.0)}",
+            f"areal:kv_tier_host_entries "
+            f"{m.get('kv_tier_host_entries', 0.0)}",
+            f"areal:kv_tier_disk_entries "
+            f"{m.get('kv_tier_disk_entries', 0.0)}",
+            f"areal:kv_tier_misses {m.get('kv_tier_misses', 0.0)}",
+            f"areal:kv_tier_corrupt_dropped "
+            f"{m.get('kv_tier_dropped_corrupt', 0.0)}",
+            f"areal:kv_tier_peer_hits {float(self._kv_peer_hits)}",
+            f"areal:kv_tier_peer_bytes {float(self._kv_peer_bytes)}",
+            f"areal:kv_tier_peer_failed {float(self._kv_peer_failed)}",
+            f"areal:last_kv_restore_ms {self._last_kv_restore_ms}",
+            f"areal:kv_manifests_served {float(self._kv_manifests_served)}",
+            f"areal:kv_chunks_served {float(self._kv_chunks_served)}",
             f"areal:num_preempted_reqs {m['num_preempted_reqs']}",
             f"areal:prefix_cache_hits {m['prefix_cache_hits']}",
             f"areal:prefix_tokens_reused {m['prefix_tokens_reused']}",

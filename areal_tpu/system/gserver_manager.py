@@ -43,7 +43,7 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.api.system_api import GserverManagerConfig
-from areal_tpu.base import constants, health, logging, name_resolve, names, network, tracing
+from areal_tpu.base import constants, env_registry, health, logging, name_resolve, names, network, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.worker_base import PollResult, Worker
 
@@ -117,6 +117,23 @@ class GserverManager(Worker):
         self._affinity: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
+        # Global prefix index (tiered KV plane, docs/serving.md):
+        # qid -> {url, tier, n_tokens, version}, LRU-bounded, fed from
+        # each server's /kv/index on the metrics poll. Affinity is the
+        # FAST PATH (route the session back to its holder); the index
+        # is what makes it only that — a session routed anywhere else
+        # gets a ``kv_source`` hint and the target pulls the prefix
+        # over /kv/{manifest,chunk} instead of re-prefilling.
+        idx_size = config.kv_index_size
+        if idx_size is None:
+            idx_size = env_registry.get_int("AREAL_KV_INDEX_SIZE")
+        self._kv_index_size = int(idx_size or 0)
+        self._prefix_index: "collections.OrderedDict[str, Dict]" = (
+            collections.OrderedDict()
+        )
+        # url -> qids last advertised by that server (for pruning
+        # entries the holder no longer has, and evictee migration).
+        self._server_kv_index: Dict[str, set] = {}
         # Disaggregated prefill/decode pools: live role per server
         # (reported via heartbeat payload + /metrics, updated directly
         # when the elastic sizer re-roles), elastic eligibility
@@ -238,20 +255,38 @@ class GserverManager(Worker):
         all-unified fleet keeps the PR 6 single-pool behavior."""
         return any(self._role(u) != "unified" for u in candidates)
 
+    def _index_holder(self, qid: str,
+                      candidates: List[str]) -> Optional[str]:
+        """Healthy holder of qid's prefix per the global index (call
+        under self._lock). None when indexing is off or nobody holds."""
+        if not qid or not self._kv_index_size:
+            return None
+        ent = self._prefix_index.get(qid)
+        if ent is None:
+            return None
+        url = ent.get("url")
+        return url if url in candidates else None
+
     def _choose_server(
         self, meta: Dict
-    ) -> Tuple[Optional[str], str, Optional[str]]:
-        """Pick a healthy server; returns (url, policy, decode_url)
-        where policy names the routing decision (recorded in the request
-        trace): 'affinity' (session's prefix-holding server), 'spill'
-        (affinity target saturated/shedding -> least-loaded), 'sticky'
-        (legacy previous-server hint), 'disagg' (prefill/decode pair —
-        decode_url is set and the client forwards it into /generate), or
-        the configured base policy. (None, 'none', None) when the whole
-        fleet is unhealthy."""
+    ) -> Tuple[Optional[str], str, Optional[str], Optional[str]]:
+        """Pick a healthy server; returns (url, policy, decode_url,
+        kv_source) where policy names the routing decision (recorded in
+        the request trace): 'affinity' (session's prefix-holding server,
+        from the affinity map), 'kv-index' (same, recovered from the
+        global prefix index after the affinity map forgot), 'spill'
+        (holder saturated/shedding -> least-loaded, with kv_source
+        pointing back at the holder so the target PULLS the prefix),
+        'sticky' (legacy previous-server hint), 'disagg' (prefill/decode
+        pair — decode_url is set and the client forwards it into
+        /generate), or the configured base policy. kv_source, when set,
+        names a server holding the session's KV prefix that is NOT the
+        routed server — the client forwards it and the target restores
+        over /kv/{manifest,chunk} instead of re-prefilling.
+        (None, 'none', None, None) when the whole fleet is unhealthy."""
         candidates = self._healthy_urls()
         if not candidates:
-            return None, "none", None
+            return None, "none", None, None
         now = time.monotonic()
         open_ = [
             u for u in candidates
@@ -263,8 +298,14 @@ class GserverManager(Worker):
         qid = str(meta.get("qid") or "")
         if self._disagg_split(candidates):
             return self._choose_disagg(meta, candidates, pool, qid, now)
+        holder = self._index_holder(qid, candidates)
         if self.cfg.session_affinity and qid:
             aff = self._affinity.get(qid)
+            policy_hit = "affinity"
+            if aff is None or aff not in candidates:
+                # Affinity map forgot (LRU cap, manager restart) but the
+                # global index still knows a holder: same fast path.
+                aff, policy_hit = holder, "kv-index"
             if aff is not None and aff in candidates:
                 sat = self.cfg.affinity_saturation_requests
                 shedding = self._server_shed_until.get(aff, 0.0) > now
@@ -275,9 +316,14 @@ class GserverManager(Worker):
                     # KV-prefix reuse survives weight-version bumps: the
                     # engine flushes stale KV on swap, so the worst case
                     # is the same re-prefill any server would pay.
-                    return aff, "affinity", None
+                    return aff, policy_hit, None, None
                 spill_pool = [u for u in pool if u != aff] or pool
-                return min(spill_pool, key=self._load_key), "spill", None
+                spilled = min(spill_pool, key=self._load_key)
+                # The spilled-to server can pull the prefix from the
+                # saturated holder — spill costs a transfer, not a
+                # re-prefill.
+                src = aff if spilled != aff else None
+                return spilled, "spill", None, src
         prev = meta.get("previous_server_url") or ""
         prev_version = int(meta.get("previous_version", -1))
         # Legacy sticky hint (clients predating the affinity map, or a
@@ -286,19 +332,28 @@ class GserverManager(Worker):
         # sticky only while the weight version is unchanged — version
         # bumps are the periodic rebalancing trigger.
         if prev in pool and prev_version == self.weight_version:
-            return prev, "sticky", None
+            return (
+                prev, "sticky", None,
+                holder if holder and holder != prev else None,
+            )
         policy = self.cfg.schedule_policy
         if policy == "least_requests":
-            return min(pool, key=lambda u: self._server_reqs[u]), policy, None
-        if policy == "least_token_usage":
-            return min(
+            url = min(pool, key=lambda u: self._server_reqs[u])
+        elif policy == "least_token_usage":
+            url = min(
                 pool,
                 key=lambda u: self._server_tokens[u]
                 + self._server_tokens_pending.get(u, 0.0),
-            ), policy, None
-        url = pool[self._rr % len(pool)]
-        self._rr += 1
-        return url, "round_robin", None
+            )
+        else:
+            policy = "round_robin"
+            url = pool[self._rr % len(pool)]
+            self._rr += 1
+        # Affinity off (or fresh session under load-balance policies):
+        # the index still pays — whoever we route to pulls the prefix.
+        return url, policy, None, (
+            holder if holder and holder != url else None
+        )
 
     def _choose_disagg(self, meta, candidates, pool, qid, now):
         """Pool routing for a split fleet: continuations follow their
@@ -314,8 +369,12 @@ class GserverManager(Worker):
         # retry must land on a surviving prefill server, not turn the
         # decode server into an accidental unified one.
         retry = bool(meta.get("failed_server_url"))
+        holder = None if retry else self._index_holder(qid, candidates)
         if self.cfg.session_affinity and qid and not retry:
             aff = self._affinity.get(qid)
+            policy_hit = "affinity"
+            if aff is None or aff not in candidates:
+                aff, policy_hit = holder, "kv-index"
             if aff is not None and aff in candidates:
                 # The session's KV parked on its decode server; a direct
                 # /generate there prefills only the delta. Honored even
@@ -323,24 +382,30 @@ class GserverManager(Worker):
                 # ward — any role serves plain /generate, and the
                 # parked delta is far cheaper than the full re-prefill
                 # a KV-less decode server would pay. Spill like the
-                # unified path when it sheds/saturates.
+                # unified path when it sheds/saturates — with a
+                # kv_source hint so the spill target pulls the prefix.
                 sat = self.cfg.affinity_saturation_requests
                 shedding = self._server_shed_until.get(aff, 0.0) > now
                 saturated = (
                     sat is not None and self._server_reqs.get(aff, 0) >= sat
                 )
                 if not shedding and not saturated:
-                    return aff, "affinity", None
+                    return aff, policy_hit, None, None
                 if decode_pool:
                     spill = [u for u in decode_pool if u != aff] or decode_pool
+                    spilled = min(spill, key=self._load_key)
                     return (
-                        min(spill, key=self._load_key), "spill", None
+                        spilled, "spill", None,
+                        aff if spilled != aff else None,
                     )
         if not prefill_pool or not decode_pool:
             # Degenerate split (one pool empty): serve unified on
             # whatever remains rather than stalling.
             rest = prefill_pool or decode_pool or pool
-            return min(rest, key=self._load_key), "disagg-degenerate", None
+            url = min(rest, key=self._load_key)
+            return url, "disagg-degenerate", None, (
+                holder if holder and holder != url else None
+            )
         # Prefill by queued-prompt-token load (the signal that actually
         # queues there), decode by free-page/slot headroom.
         purl = min(
@@ -360,10 +425,18 @@ class GserverManager(Worker):
         )
         if purl == durl:
             # Same (unified) server won both pools: plain local serve.
-            return purl, "disagg-local", None
-        return purl, "disagg", durl
+            return purl, "disagg-local", None, (
+                holder if holder and holder != purl else None
+            )
+        # The prefill server does the (delta) prefill, so it is the one
+        # that profits from pulling the session's prefix.
+        return purl, "disagg", durl, (
+            holder if holder and holder != purl else None
+        )
 
-    def _route(self, meta: Dict) -> Tuple[Optional[str], str, Optional[str]]:
+    def _route(
+        self, meta: Dict
+    ) -> Tuple[Optional[str], str, Optional[str], Optional[str]]:
         """Choose a server AND do the routing-side bookkeeping: bump the
         in-flight request estimate, fold the scheduled tokens into the
         load estimate until the next /metrics poll refreshes the
@@ -374,7 +447,7 @@ class GserverManager(Worker):
         points at the DECODE server, where its KV will live."""
         qid = str(meta.get("qid") or "")
         with self._lock:
-            url, policy, decode_url = self._choose_server(meta)
+            url, policy, decode_url, kv_source = self._choose_server(meta)
             if url is not None:
                 self._server_reqs[url] += 1
                 self._server_tokens_pending[url] = (
@@ -393,7 +466,7 @@ class GserverManager(Worker):
                         + float(meta.get("new_token_budget") or 0)
                     )
                 self._record_affinity(qid, decode_url or url)
-        return url, policy, decode_url
+        return url, policy, decode_url, kv_source
 
     def _record_affinity(self, qid: str, url: str):
         """LRU-bounded qid -> url map (call under self._lock)."""
@@ -407,6 +480,17 @@ class GserverManager(Worker):
     # ------------------------------------------------------------------
     # Fault-domain isolation: eviction + readmission
     # ------------------------------------------------------------------
+
+    def _drop_index_for(self, url: str):
+        """Evictee migration for the global prefix index (call under
+        self._lock): a dead/replaced server's process RAM — and so its
+        whole KV tier — is gone; entries pointing at it would route
+        returning sessions into guaranteed pull failures."""
+        qids = self._server_kv_index.pop(url, None) or set()
+        for q in qids:
+            ent = self._prefix_index.get(q)
+            if ent is not None and ent.get("url") == url:
+                self._prefix_index.pop(q, None)
 
     def _mark_unhealthy(self, url: str, reason: str):
         if url not in self.server_urls:
@@ -422,6 +506,7 @@ class GserverManager(Worker):
             self._server_tokens[url] = 0.0
             self._server_tokens_pending[url] = 0.0
             self._server_shed_until[url] = 0.0
+            self._drop_index_for(url)
         logger.warning(
             f"evicted generation server {url}: {reason} "
             f"({len(self._healthy_urls())}/{len(self.server_urls)} healthy)"
@@ -532,9 +617,11 @@ class GserverManager(Worker):
             self._server_itl_hist.pop(old, None)
             # The new incarnation holds no KV: affinity entries pointing
             # at the dead address would route sessions to a cold cache
-            # AND (worse) to an evicted url. Drop them.
+            # AND (worse) to an evicted url. Drop them — and the global
+            # prefix index's entries with them (same reasoning).
             for qid in [q for q, u in self._affinity.items() if u == old]:
                 self._affinity.pop(qid, None)
+            self._drop_index_for(old)
             self._server_versions.pop(old, None)
             self._server_versions[new] = 0
             self._healthy.discard(old)
@@ -744,11 +831,11 @@ class GserverManager(Worker):
                     self._server_shed_total.get(shed, 0.0) + 1.0
                 )
         qid = str(meta.get("qid") or "")
-        url, policy, decode_url = self._route(meta)
+        url, policy, decode_url, kv_source = self._route(meta)
         tracing.event(
             "manager.schedule", ctx=trace_ctx,
             server=url or "", routed=url is not None, policy=policy,
-            qid=qid,
+            qid=qid, kv_source=kv_source or "",
         )
         if url is None:
             return web.json_response(
@@ -756,6 +843,12 @@ class GserverManager(Worker):
                 status=503,
             )
         resp = {"url": url, "version": self.weight_version, "policy": policy}
+        if kv_source is not None:
+            # Global-prefix-index hint: a DIFFERENT server holds this
+            # session's KV — the client forwards kv_source into
+            # /generate and the routed server pulls the prefix over
+            # /kv/{manifest,chunk} instead of re-prefilling.
+            resp["kv_source"] = kv_source
         if decode_url is not None:
             # The prefill->decode pairing decision, recorded for the
             # merged timeline (who prefilled, who decoded, why).
@@ -871,9 +964,34 @@ class GserverManager(Worker):
                 },
                 "reroles": list(self._rerole_log),
             }
+            # Tiered KV plane: global prefix index size (by tier) +
+            # fleet spill/restore/lost sums (ratio-of-sums rule).
+            by_tier: Dict[str, int] = {}
+            for ent in self._prefix_index.values():
+                t = ent.get("tier", "host")
+                by_tier[t] = by_tier.get(t, 0) + 1
+            kv_tier = {
+                "index_entries": len(self._prefix_index),
+                "index_by_tier": by_tier,
+                "spills": sum(
+                    s.get("spills", 0.0) for s in self._server_kv.values()
+                ),
+                "restores": sum(
+                    s.get("restores", 0.0)
+                    for s in self._server_kv.values()
+                ),
+                "peer_hits": sum(
+                    s.get("peer_hits", 0.0)
+                    for s in self._server_kv.values()
+                ),
+                "prefix_lost": sum(
+                    s.get("lost", 0.0) for s in self._server_kv.values()
+                ),
+            }
         return web.json_response(
             {
                 "pools": pools,
+                "kv_tier": kv_tier,
                 "weight_version": self.weight_version,
                 "rollout_stat": self.rollout_stat.as_dict(),
                 "servers": self.server_urls,
@@ -1638,8 +1756,64 @@ class GserverManager(Worker):
                         elif line.startswith("areal:last_kv_transfer_ms"):
                             self._server_kv.setdefault(u, {})[
                                 "last_transfer_ms"] = float(line.split()[-1])
+                        elif line.startswith("areal:kv_spill_total"):
+                            self._server_kv.setdefault(u, {})["spills"] = (
+                                float(line.split()[-1])
+                            )
+                        elif line.startswith("areal:kv_restore_total"):
+                            self._server_kv.setdefault(u, {})["restores"] = (
+                                float(line.split()[-1])
+                            )
+                        elif line.startswith("areal:kv_prefix_lost_total"):
+                            self._server_kv.setdefault(u, {})["lost"] = (
+                                float(line.split()[-1])
+                            )
+                        elif line.startswith("areal:kv_tier_peer_hits"):
+                            self._server_kv.setdefault(u, {})[
+                                "peer_hits"] = float(line.split()[-1])
+                    if self._kv_index_size:
+                        await self._poll_kv_index(sess, u)
                 except Exception:
                     logger.warning(f"metrics poll failed for {u}")
+
+    async def _poll_kv_index(self, sess, u: str):
+        """Fold one server's /kv/index advertisement into the global
+        prefix index: entries it newly holds point at it; entries it
+        stopped advertising (consumed, aged out) are dropped if they
+        still pointed at it; the map stays LRU-bounded."""
+        try:
+            async with sess.get(f"{u}/kv/index") as r:
+                if r.status != 200:
+                    return
+                body = await r.json()
+        except Exception:
+            return
+        held = body.get("held") or []
+        with self._lock:
+            prev = self._server_kv_index.get(u) or set()
+            now_qids = set()
+            for e in held:
+                qid = str(e.get("qid") or "")
+                if not qid:
+                    continue
+                now_qids.add(qid)
+                self._prefix_index.pop(qid, None)
+                self._prefix_index[qid] = {
+                    "url": u,
+                    "tier": str(e.get("tier") or "host"),
+                    "n_tokens": int(e.get("n_tokens") or 0),
+                    "version": int(e.get("version", -1)),
+                }
+            for qid in prev - now_qids:
+                ent = self._prefix_index.get(qid)
+                if ent is not None and ent.get("url") == u:
+                    self._prefix_index.pop(qid, None)
+            self._server_kv_index[u] = now_qids
+            while len(self._prefix_index) > self._kv_index_size:
+                old_qid, old_ent = self._prefix_index.popitem(last=False)
+                s = self._server_kv_index.get(old_ent.get("url"))
+                if s is not None:
+                    s.discard(old_qid)
 
     def _poll(self) -> Optional[PollResult]:
         try:

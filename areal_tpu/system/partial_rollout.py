@@ -200,6 +200,10 @@ class PartialRolloutManager:
             # for this chunk; the prefill server hands the KV off to it
             # and proxies the combined result back (docs/serving.md).
             decode_url = sched.get("decode_url")
+            # Tiered-KV hint: a DIFFERENT server holds this session's
+            # prefix — the routed server pulls it over the /kv plane
+            # before admission instead of re-prefilling.
+            kv_source = sched.get("kv_source")
             chunk = min(budget, self.new_tokens_per_chunk)
             # A resubmission carries the accumulated prefix: every token
             # of prompt+prefix is prefill work the server repeats.
@@ -217,6 +221,7 @@ class PartialRolloutManager:
                 dict(
                     qid=qid,
                     decode_url=decode_url,
+                    kv_source=kv_source,
                     input_ids=list(prompt_ids) + acc_out,
                     # Continuations/re-prefills admit ahead of fresh
                     # requests (engine priority class 0): their prefix

@@ -68,6 +68,22 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "overload_baseline_p99_ttft_ms",
         "overload_baseline_goodput_rps",
     ),
+    # The tiered-KV probe is only evidence as a PAIR (tier vs full-
+    # re-prefill baseline) WITH its per-tier hit accounting and loss
+    # counter: a fast TTFT number without those could just mean the
+    # sweep never exceeded HBM.
+    "sessions_resident": (
+        "n_resident_max",
+        "tier_ttft_p99_ms",
+        "baseline_ttft_p99_ms",
+        "hit_rate_hbm",
+        "hit_rate_host",
+        "hit_rate_peer",
+        "miss_rate",
+        "kv_spill_total",
+        "kv_prefix_lost",
+        "int8_spill_bytes_ratio",
+    ),
     # The disaggregation A/B is only evidence as a PAIR: a record
     # carrying one arm's tail latency without the other cannot show the
     # interference delta the phase exists to measure.
@@ -279,6 +295,78 @@ def _validate_scaling_points(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_sessions_resident(val: Dict) -> List[str]:
+    """The tiered-KV phase exists to show a returning session's TTFT
+    measurably below the full re-prefill baseline once residency
+    exceeds HBM, with the tier actually engaged (spills happened, host
+    restores happened, nothing truly lost) and the int8 spill wire at
+    least halving tier bytes. Records not showing that are refused."""
+    problems: List[str] = []
+    tier = _num(val, "tier_ttft_p99_ms")
+    base = _num(val, "baseline_ttft_p99_ms")
+    if tier is not None and base is not None and tier > 0.75 * base:
+        problems.append(
+            f"sessions_resident: tier-hit returning p99 TTFT "
+            f"{tier:.0f}ms is not measurably below the full-re-prefill "
+            f"baseline {base:.0f}ms"
+        )
+    lost = _num(val, "kv_prefix_lost")
+    if lost is None or lost > 0:
+        problems.append(
+            f"sessions_resident: {lost} true prefix losses under "
+            f"pressure — spill-not-loss is the phase's contract"
+        )
+    if (_num(val, "kv_spill_total") or 0) < 1:
+        problems.append(
+            "sessions_resident: no spills recorded — residency never "
+            "exceeded the HBM budget, nothing was measured"
+        )
+    if (_num(val, "hit_rate_host") or 0) <= 0:
+        problems.append(
+            "sessions_resident: zero host-tier restores — the tier "
+            "never engaged"
+        )
+    if (_num(val, "hit_rate_peer") or 0) <= 0:
+        problems.append(
+            "sessions_resident: zero peer pulls — the global prefix "
+            "index path never engaged"
+        )
+    for k in ("hit_rate_hbm", "hit_rate_host", "hit_rate_disk",
+              "hit_rate_peer", "miss_rate"):
+        v = _num(val, k)
+        if v is not None and not (0.0 <= v <= 1.0):
+            problems.append(f"sessions_resident: {k} {v} outside [0, 1]")
+    ratio = _num(val, "int8_spill_bytes_ratio")
+    if ratio is not None and not (0.1 <= ratio <= 0.62):
+        problems.append(
+            f"sessions_resident: int8 spill wire is {ratio:.2f}x the "
+            f"float wire — expected <= 0.62 (halved or better) and a "
+            f"sane floor"
+        )
+    sweep = val.get("sweep")
+    if not isinstance(sweep, list) or len(sweep) < 2:
+        problems.append(
+            "sessions_resident: measure value must carry a residency "
+            "'sweep' list with >= 2 points"
+        )
+    else:
+        for i, pt in enumerate(sweep):
+            if not isinstance(pt, dict):
+                problems.append(
+                    f"sessions_resident: sweep[{i}] is not an object"
+                )
+                continue
+            for k in ("n_resident", "ttft_p99_ms", "hit_rate"):
+                if not isinstance(pt.get(k), (int, float)) or isinstance(
+                    pt.get(k), bool
+                ):
+                    problems.append(
+                        f"sessions_resident: sweep[{i}] missing "
+                        f"numeric {k!r}"
+                    )
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -313,6 +401,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_sharded_plane(val))
     if name == "serving_openloop":
         problems.extend(_validate_openloop_sweep(val))
+    if name == "sessions_resident":
+        problems.extend(_validate_sessions_resident(val))
     if name == "serving_disagg":
         failed = val.get("disagg_failed")
         if isinstance(failed, (int, float)) and failed > 0:

@@ -1,0 +1,308 @@
+"""Tiered KV plane at the engine layer (ISSUE 11 tentpole): prefix
+evictions SPILL to the host/disk tier in the handoff wire format, a
+returning session RESTORES through the import scatter path, and the
+round trip is greedy-parity-exact against an engine that never evicted
+— for float pools and (bit-exactly, via the int8-preserving wire) for
+int8 pools.
+
+Time budget: ~35 s (tiny float32 model, shared compiled programs with
+the other engine suites; store-only tests are milliseconds).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.engine import kv_handoff as kvh
+from areal_tpu.engine.kv_tier import KVTierStore
+from tests.engine.serving_utils import TINY_SERVING_CFG, run_requests
+
+PAGE = 16
+PROMPT = [7, 3, 9, 11, 2, 15, 30, 31] * 4  # 32 tokens = 2 pages
+
+
+def _blob(tag: str, n_bytes: int = 512):
+    rng = np.random.RandomState(hash(tag) % 2**31)
+    arr = rng.randn(n_bytes // 8).astype(np.float64)
+    segments, chunks, payload = kvh.pack_arrays(
+        [("x", arr)], chunk_bytes=128
+    )
+
+    class _C:
+        n_layers, n_kv_heads, head_dim = 1, 1, 8
+
+    meta = kvh.build_meta(tag, 0, [1, 2, 3], "float32", _C, segments, chunks)
+    return meta, payload
+
+
+# ----------------------------------------------------------------------
+# Store-only (no jax): LRU, disk demotion/promotion, corruption
+# ----------------------------------------------------------------------
+
+
+def test_store_lru_demotes_to_disk_and_promotes_back(tmp_path):
+    store = KVTierStore(
+        1100, disk_dir=str(tmp_path / "kvd"), disk_capacity_bytes=1 << 20
+    )
+    for tag in ("a", "b", "c"):
+        meta, payload = _blob(tag)
+        store.put(tag, meta, payload)
+    # 3 x ~512B > 1100B host budget: the oldest demoted to disk.
+    assert store.peek_tier("a") == "disk"
+    assert store.peek_tier("b") == "host"
+    assert store.peek_tier("c") == "host"
+    st = store.stats()
+    assert st["demoted_to_disk"] == 1 and st["dropped_capacity"] == 0
+    # A disk hit verifies hashes and promotes back to host...
+    meta, payload, tier = store.get("a")
+    assert tier == "disk"
+    assert verifies(meta, payload)
+    assert store.peek_tier("a") == "host"
+    # ...which pushed the now-oldest host entry out.
+    assert store.peek_tier("b") == "disk"
+    assert store.stats()["disk_hits"] == 1
+
+
+def verifies(meta, payload):
+    from areal_tpu.engine.kv_tier import verify_payload
+
+    return verify_payload(meta, payload)
+
+
+def test_store_without_disk_drops_for_good_and_counts():
+    store = KVTierStore(1100)
+    for tag in ("a", "b", "c"):
+        meta, payload = _blob(tag)
+        store.put(tag, meta, payload)
+    assert store.get("a") is None  # dropped, counted as a miss
+    st = store.stats()
+    assert st["dropped_capacity"] == 1 and st["misses"] == 1
+    assert len(store) == 2
+
+
+def test_store_rejects_corrupted_disk_entry(tmp_path):
+    """The hash, not the filesystem, is the authority: a flipped byte
+    in a demoted payload reads as a miss (counted), never as KV."""
+    import glob
+    import os
+
+    d = str(tmp_path / "kvd")
+    store = KVTierStore(1100, disk_dir=d)
+    for tag in ("a", "b", "c"):
+        meta, payload = _blob(tag)
+        store.put(tag, meta, payload)
+    assert store.peek_tier("a") == "disk"
+    (bin_path,) = glob.glob(os.path.join(d, "*.bin"))
+    raw = bytearray(open(bin_path, "rb").read())
+    raw[10] ^= 0xFF
+    with open(bin_path, "wb") as f:
+        f.write(raw)
+    assert store.get("a") is None
+    st = store.stats()
+    assert st["dropped_corrupt"] == 1
+    assert store.peek_tier("a") is None  # gone for good
+
+
+# ----------------------------------------------------------------------
+# Engine spill -> restore parity (float and int8 pools)
+# ----------------------------------------------------------------------
+
+
+def _mk_engine(params, **kw):
+    from areal_tpu.engine.serving import ServingEngine
+
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 256)
+    kw.setdefault("decode_block_steps", 4)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("eos_token_id", None)
+    e = ServingEngine(TINY_SERVING_CFG, params, **kw)
+    e.start()
+    return e
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    import jax
+
+    from areal_tpu.models.transformer import init_params
+
+    return init_params(TINY_SERVING_CFG, jax.random.PRNGKey(4))
+
+
+def _wait_spill(engine, n=1, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if engine.kv_spills >= n:
+            return
+        time.sleep(0.02)
+    raise AssertionError(
+        f"spill never landed ({engine.kv_spills}/{n}; "
+        f"lost={engine._kv_lost_evict + engine._kv_lost_spill})"
+    )
+
+
+def _two_turns(engine, qid, first_new=4, second_new=4):
+    from areal_tpu.engine.serving import GenRequest
+
+    r1 = run_requests(engine, [GenRequest(
+        qid=qid, input_ids=list(PROMPT), max_new_tokens=first_new,
+        greedy=True,
+    )])[qid]
+    r2 = run_requests(engine, [GenRequest(
+        qid=qid, input_ids=list(PROMPT) + r1.output_ids,
+        max_new_tokens=second_new, greedy=True, priority=0,
+    )])[qid]
+    return r1, r2
+
+
+@pytest.mark.parametrize("pool_dtype", [None, "int8"])
+def test_spill_restore_greedy_parity_vs_never_evicted(
+    tiny_params, pool_dtype
+):
+    """Budget pressure evicts the park -> spill; restore_from_tier
+    brings it back; the continuation's greedy tokens match an engine
+    that never evicted. For int8 pools the wire keeps (data, scales)
+    end to end — the restore is bit-exact (no requantization), so
+    parity is exact there too."""
+    from areal_tpu.engine.serving import GenRequest
+
+    eng = _mk_engine(
+        tiny_params, prefix_cache_tokens=16, kv_tier_bytes=1 << 20,
+        kv_cache_dtype=pool_dtype, seed=3,
+    )
+    ref = _mk_engine(
+        tiny_params, prefix_cache_tokens=4096, kv_cache_dtype=pool_dtype,
+        seed=3,
+    )
+    try:
+        r1 = run_requests(eng, [GenRequest(
+            qid="s0", input_ids=list(PROMPT), max_new_tokens=4,
+            greedy=True,
+        )])["s0"]
+        # 16-token budget < ~35 parked tokens: the park trims itself
+        # out immediately -> spilled, not lost.
+        _wait_spill(eng)
+        assert eng._kv_lost_evict + eng._kv_lost_spill == 0
+        got = eng.kv_tier.get("s0", count=False)
+        assert got is not None
+        wire = got[0]["kv_wire"]
+        assert wire == ("int8" if pool_dtype == "int8" else "float32")
+
+        n = eng.restore_from_tier("s0", list(PROMPT) + r1.output_ids)
+        assert n >= len(PROMPT)
+        assert eng.kv_restore_host == 1
+        assert eng.kv_tier.peek_tier("s0") is None  # HBM owns it again
+        r2 = run_requests(eng, [GenRequest(
+            qid="s0", input_ids=list(PROMPT) + r1.output_ids,
+            max_new_tokens=4, greedy=True, priority=0,
+        )])["s0"]
+        # Admission consumed the restored park as a delta prefill.
+        assert eng.prefix_cache_hits == 1
+        assert eng.prefix_tokens_reused >= len(PROMPT)
+
+        s1, s2 = _two_turns(ref, "s0")
+        assert s1.output_ids == r1.output_ids
+        assert s2.output_ids == r2.output_ids
+    finally:
+        eng.stop()
+        ref.stop()
+
+
+def test_int8_spill_halves_tier_bytes_vs_float(tiny_params):
+    """kv_spill_dtype='int8' on a float pool: the spilled payload is
+    well under half the float wire (int8 data + per-token scales vs
+    float32), the tier-bytes halving the satellite requires."""
+    f = _mk_engine(tiny_params, prefix_cache_tokens=16,
+                   kv_tier_bytes=1 << 20, seed=5)
+    q = _mk_engine(tiny_params, prefix_cache_tokens=16,
+                   kv_tier_bytes=1 << 20, kv_spill_dtype="int8", seed=5)
+    try:
+        from areal_tpu.engine.serving import GenRequest
+
+        for eng in (f, q):
+            run_requests(eng, [GenRequest(
+                qid="b0", input_ids=list(PROMPT), max_new_tokens=4,
+                greedy=True,
+            )])
+            _wait_spill(eng)
+        bf = f.kv_tier.get("b0", count=False)
+        bq = q.kv_tier.get("b0", count=False)
+        assert bf[0]["kv_wire"] == "float32"
+        assert bq[0]["kv_wire"] == "int8"
+        assert len(bq[1]) < 0.55 * len(bf[1]), (len(bq[1]), len(bf[1]))
+        # An int8-wire spill still restores (float path: dequantize +
+        # scatter re-quantizes nothing — the pool is float).
+        assert q.restore_from_tier("b0") >= len(PROMPT)
+    finally:
+        f.stop()
+        q.stop()
+
+
+def test_export_handoff_falls_back_to_tier_after_eviction(tiny_params):
+    """The old evicted-before-export silent-loss window: with the tier
+    armed the export serves the spilled blob instead of raising — and a
+    second engine imports it for a delta-prefill continuation."""
+    from areal_tpu.engine.serving import GenRequest
+
+    pre = _mk_engine(tiny_params, prefix_cache_tokens=16,
+                     kv_tier_bytes=1 << 20, seed=7)
+    dec = _mk_engine(tiny_params, prefix_cache_tokens=4096, seed=8)
+    try:
+        r1 = run_requests(pre, [GenRequest(
+            qid="e0", input_ids=list(PROMPT), max_new_tokens=1,
+            greedy=True,
+        )])["e0"]
+        _wait_spill(pre)
+        meta, payload = pre.export_kv_handoff("e0")
+        assert meta["schema"] == kvh.HANDOFF_SCHEMA
+        assert pre.kv_tier.peek_tier("e0") is None  # consumed
+        dec.import_kv_handoff(meta, payload)
+        r2 = run_requests(dec, [GenRequest(
+            qid="e0", input_ids=list(PROMPT) + r1.output_ids,
+            max_new_tokens=4, greedy=True, priority=0,
+        )])["e0"]
+        assert len(r2.output_ids) == 4
+        assert dec.prefix_cache_hits == 1
+    finally:
+        pre.stop()
+        dec.stop()
+
+
+def test_weight_swap_clears_tier_and_no_spill_on_flush(tiny_params):
+    """A weight swap makes every spilled prefix stale: the tier is
+    cleared with the prefix cache, and the swap-time flush itself must
+    NOT spill (it would only poison the tier) nor count losses."""
+    from areal_tpu.engine.serving import GenRequest
+
+    eng = _mk_engine(tiny_params, prefix_cache_tokens=4096,
+                     kv_tier_bytes=1 << 20, seed=11)
+    try:
+        run_requests(eng, [GenRequest(
+            qid="w0", input_ids=list(PROMPT), max_new_tokens=2,
+            greedy=True,
+        )])
+        # Force one real spill so the tier is non-empty.
+        eng._run_on_loop(lambda: eng._evict_one_prefix())
+        _wait_spill(eng)
+        assert len(eng.kv_tier) == 1
+        spills_before = eng.kv_spills
+        run_requests(eng, [GenRequest(
+            qid="w1", input_ids=list(PROMPT), max_new_tokens=2,
+            greedy=True,
+        )])
+        eng.update_params(tiny_params, version=5)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and (
+            len(eng.kv_tier) or eng.version != 5
+        ):
+            time.sleep(0.02)
+        assert eng.version == 5
+        assert len(eng.kv_tier) == 0
+        time.sleep(0.3)  # a stray flush-spill would land by now
+        assert eng.kv_spills == spills_before
+        assert eng._kv_lost_evict + eng._kv_lost_spill == 0
+    finally:
+        eng.stop()

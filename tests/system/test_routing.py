@@ -1,8 +1,10 @@
 """Routing-policy units for the gserver manager's production scheduler:
-prefix-/session-affinity, shed-aware + saturation spill, and the
-in-flight fold that keeps least_token_usage honest between /metrics
-polls (ISSUE 6 satellite: a burst must not pile onto one server just
-because the snapshot is stale)."""
+prefix-/session-affinity, shed-aware + saturation spill, the in-flight
+fold that keeps least_token_usage honest between /metrics polls (ISSUE
+6 satellite: a burst must not pile onto one server just because the
+snapshot is stale), and the ISSUE 11 global prefix index — affinity as
+a fast path, with any other routing decision carrying a ``kv_source``
+pull hint instead of forcing a re-prefill."""
 
 import collections
 import threading
@@ -29,6 +31,10 @@ def _manager(policy="round_robin", **cfg_kw):
     m._server_shed_until = {u: 0.0 for u in m.server_urls}
     m._server_shed_total = {u: 0.0 for u in m.server_urls}
     m._affinity = collections.OrderedDict()
+    # Tiered-KV global prefix index (ISSUE 11).
+    m._kv_index_size = 65536
+    m._prefix_index = collections.OrderedDict()
+    m._server_kv_index = {}
     # Disaggregated-pool state (all-unified here: single-pool routing).
     m._server_roles = {u: "unified" for u in m.server_urls}
     m._server_queued_toks = {u: 0.0 for u in m.server_urls}
@@ -55,46 +61,50 @@ def test_least_token_usage_folds_inflight_between_polls():
 
 def test_affinity_routes_follow_up_to_prefix_holder_across_versions():
     m = _manager("least_requests")
-    url1, policy1, _d = m._route({"qid": "s/0", "prompt_len": 10})
+    url1, policy1, _d, _k = m._route({"qid": "s/0", "prompt_len": 10})
     assert policy1 == "least_requests"
     # Load the affinity target heavily: affinity still wins (the prefix
     # is there), and survives a weight-version bump.
     m._server_reqs[url1] = 50
     m.weight_version = 7
-    url2, policy2, _d = m._route({"qid": "s/0", "prompt_len": 20})
+    url2, policy2, _d, _k = m._route({"qid": "s/0", "prompt_len": 20})
     assert (url2, policy2) == (url1, "affinity")
 
 
-def test_affinity_spills_on_shed_window_then_returns():
+def test_affinity_spills_on_shed_window_with_kv_source_then_returns():
     m = _manager("round_robin")
-    url1, _, _d = m._route({"qid": "s/1", "prompt_len": 10})
+    url1, _, _d, _k = m._route({"qid": "s/1", "prompt_len": 10})
     other = B if url1 == A else A
-    # The server shed a client with 429: routed around for Retry-After.
+    # The server shed a client with 429: routed around for Retry-After —
+    # and the spill target gets a kv_source hint pointing back at the
+    # prefix holder, so the spill costs a transfer, not a re-prefill.
     m._server_shed_until[url1] = time.monotonic() + 30.0
-    url2, policy2, _d = m._route({"qid": "s/1", "prompt_len": 10})
+    url2, policy2, _d, kv_src = m._route({"qid": "s/1", "prompt_len": 10})
     assert (url2, policy2) == (other, "spill")
+    assert kv_src == url1
     # Spill re-recorded the affinity on the server now holding the
     # session's newest prefix.
     m._server_shed_until[url1] = 0.0
-    url3, policy3, _d = m._route({"qid": "s/1", "prompt_len": 10})
+    url3, policy3, _d, _k = m._route({"qid": "s/1", "prompt_len": 10})
     assert (url3, policy3) == (other, "affinity")
 
 
 def test_affinity_spills_on_saturation_threshold():
     m = _manager("least_requests", affinity_saturation_requests=4)
-    url1, _, _d = m._route({"qid": "s/2", "prompt_len": 10})
+    url1, _, _d, _k = m._route({"qid": "s/2", "prompt_len": 10})
     m._server_reqs[url1] = 4
     other = B if url1 == A else A
     m._server_reqs[other] = 0
-    url2, policy2, _d = m._route({"qid": "s/2", "prompt_len": 10})
+    url2, policy2, _d, kv_src = m._route({"qid": "s/2", "prompt_len": 10})
     assert (url2, policy2) == (other, "spill")
+    assert kv_src == url1
 
 
 def test_affinity_ignores_unhealthy_target_and_map_is_bounded():
     m = _manager("round_robin", affinity_map_size=2)
-    url1, _, _d = m._route({"qid": "s/3", "prompt_len": 10})
+    url1, _, _d, _k = m._route({"qid": "s/3", "prompt_len": 10})
     m._healthy.discard(url1)
-    url2, policy2, _d = m._route({"qid": "s/3", "prompt_len": 10})
+    url2, policy2, _d, _k = m._route({"qid": "s/3", "prompt_len": 10})
     assert url2 != url1 and policy2 != "affinity"
     # LRU bound: oldest entries fall out.
     for i in range(5):
@@ -106,5 +116,71 @@ def test_whole_fleet_shedding_still_routes():
     m = _manager("least_requests")
     now = time.monotonic()
     m._server_shed_until = {A: now + 30, B: now + 30}
-    url, _, _d = m._route({"qid": "s/4", "prompt_len": 10})
+    url, _, _d, _k = m._route({"qid": "s/4", "prompt_len": 10})
     assert url in (A, B)
+
+
+# ----------------------------------------------------------------------
+# Global prefix index (ISSUE 11): affinity becomes a fast path — the
+# index recovers forgotten sessions and hands out pull hints.
+# ----------------------------------------------------------------------
+
+
+def test_index_recovers_session_after_affinity_map_forgot():
+    """Affinity map empty (LRU'd out / restarted manager) but the
+    global index knows server A spilled the prefix: route to A with the
+    'kv-index' policy — the same fast path, from the durable map."""
+    m = _manager("least_requests")
+    m._prefix_index["q/0"] = {"url": A, "tier": "host", "n_tokens": 64,
+                              "version": 0}
+    m._server_kv_index[A] = {"q/0"}
+    url, policy, _d, kv_src = m._route({"qid": "q/0", "prompt_len": 10})
+    assert (url, policy, kv_src) == (A, "kv-index", None)
+
+
+def test_affinity_disabled_routes_by_policy_with_pull_hint():
+    """session_affinity=False: the configured policy places the request
+    (round robin here), and when it lands AWAY from the holder the
+    response carries kv_source so the target pulls the prefix —
+    affinity is an optimization, never a correctness requirement."""
+    m = _manager("round_robin", session_affinity=False)
+    m._prefix_index["q/1"] = {"url": A, "tier": "host", "n_tokens": 64,
+                              "version": 0}
+    m._server_kv_index[A] = {"q/1"}
+    seen = {}
+    for _ in range(2):
+        url, policy, _d, kv_src = m._route({"qid": "q/1", "prompt_len": 10})
+        assert policy == "round_robin"
+        seen[url] = kv_src
+    # The round-robin pass that landed on B got the pull hint; the one
+    # that landed on the holder itself did not.
+    assert seen[B] == A
+    assert seen[A] is None
+
+
+def test_index_saturated_holder_spills_with_pull_hint():
+    m = _manager("least_requests", affinity_saturation_requests=2)
+    m._prefix_index["q/2"] = {"url": A, "tier": "disk", "n_tokens": 64,
+                              "version": 0}
+    m._server_kv_index[A] = {"q/2"}
+    m._server_reqs[A] = 5
+    url, policy, _d, kv_src = m._route({"qid": "q/2", "prompt_len": 10})
+    assert (url, policy, kv_src) == (B, "spill", A)
+
+
+def test_eviction_migrates_index_entries_away():
+    """A dead server's process RAM (and so its KV tier) is gone: its
+    index entries must vanish with it, or returning sessions would be
+    routed into guaranteed pull failures."""
+    m = _manager("least_requests")
+    m._evicted = {}
+    m._prefix_index["q/3"] = {"url": A, "tier": "host", "n_tokens": 8,
+                              "version": 0}
+    m._prefix_index["q/4"] = {"url": B, "tier": "host", "n_tokens": 8,
+                              "version": 0}
+    m._server_kv_index = {A: {"q/3"}, B: {"q/4"}}
+    m._mark_unhealthy(A, "test")
+    assert "q/3" not in m._prefix_index
+    assert "q/4" in m._prefix_index
+    url, policy, _d, kv_src = m._route({"qid": "q/3", "prompt_len": 10})
+    assert url == B and kv_src is None

@@ -1,0 +1,131 @@
+"""ISSUE 11 acceptance (bench leg): the `sessions_resident` phase banks
+an attested CPU-proxy record showing a returning session's p99 TTFT on
+a tier hit measurably below the full-re-prefill baseline once residency
+exceeds the HBM prefix budget, with hit rate reported by tier
+(hbm/host/peer/miss), ZERO true prefix loss under pressure, and the
+int8 spill wire at most ~0.6x the float wire's bytes per token — and
+`validate_bench.py` accepts the record (and rejects records missing the
+baseline pair, carrying losses, or whose int8 wire failed to shrink).
+
+Time budget (slow lane): ~150 s — four real-process fleets run
+sequentially on a warm XLA cache. Tier-1 keeps the validator-teeth test
+(milliseconds) plus the engine parity suite (tests/engine/test_kv_tier)
+and the cross-server e2e (tests/system/test_kv_tier_e2e).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_record():
+    """A well-formed sessions_resident value (what a healthy run banks)
+    for validator-teeth tests that must not pay the 4-fleet wall
+    clock."""
+    return {
+        "n_resident_max": 16.0,
+        "tier_ttft_p99_ms": 96.0,
+        "baseline_ttft_p99_ms": 384.0,
+        "hit_rate_hbm": 0.25,
+        "hit_rate_host": 0.69,
+        "hit_rate_disk": 0.0,
+        "hit_rate_peer": 0.5,
+        "miss_rate": 0.06,
+        "kv_spill_total": 12.0,
+        "kv_prefix_lost": 0.0,
+        "int8_spill_bytes_ratio": 0.3,
+        "sweep": [
+            {"n_resident": 2.0, "ttft_p99_ms": 48.0, "hit_rate": 1.0},
+            {"n_resident": 16.0, "ttft_p99_ms": 96.0, "hit_rate": 0.94},
+        ],
+    }
+
+
+def test_validator_teeth_for_sessions_resident():
+    """Tier-1 guard: the schema refuses records that could launder a
+    non-measurement into tiered-KV evidence."""
+    validator = _load_validator()
+    rec = {"status": "ok", "pass": "measure", "value": _fake_record()}
+    assert validator.validate_phase_value("sessions_resident", rec) == []
+
+    def probs(**edits):
+        bad = json.loads(json.dumps(rec))
+        bad["value"].update(edits)
+        for k, v in list(edits.items()):
+            if v is None:
+                del bad["value"][k]
+        return validator.validate_phase_value("sessions_resident", bad)
+
+    # Missing the baseline half of the pair.
+    assert any("baseline_ttft_p99_ms" in p
+               for p in probs(baseline_ttft_p99_ms=None))
+    # Tier p99 not measurably below the re-prefill baseline.
+    assert any("not measurably below" in p
+               for p in probs(tier_ttft_p99_ms=380.0))
+    # True prefix loss under pressure.
+    assert any("loss" in p for p in probs(kv_prefix_lost=2.0))
+    # Residency never exceeded HBM (nothing spilled).
+    assert any("no spills" in p for p in probs(kv_spill_total=0.0))
+    # The tier / the index path never engaged.
+    assert any("never engaged" in p for p in probs(hit_rate_host=0.0))
+    assert any("peer" in p for p in probs(hit_rate_peer=0.0))
+    # int8 wire failed to at least halve tier bytes.
+    assert any("int8" in p for p in probs(int8_spill_bytes_ratio=0.8))
+    # Sweep must exist with per-point TTFT.
+    assert any("sweep" in p for p in probs(sweep=[]))
+
+
+@pytest.mark.slow  # ~150s over four real-process fleets; tier-1 keeps
+# the validator teeth above + engine parity + the cross-server e2e.
+@pytest.mark.timeout(560)
+def test_sessions_resident_banks_tier_win_and_validates(
+    tmp_path, monkeypatch
+):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import sessions_resident_phase
+
+    val = sessions_resident_phase("measure")
+    path = bank.write_record(
+        bank.make_record("sessions_resident", "measure", "ok", value=val),
+        b,
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("sessions_resident", rec) == []
+    assert validator.validate_bank_dir(b) == []
+
+    v = rec["value"]
+    # THE acceptance numbers: residency exceeded HBM (spills happened),
+    # returning sessions hit some tier ~always, nothing was truly lost,
+    # and a tier-hit return is far cheaper than the re-prefill baseline.
+    assert v["kv_spill_total"] >= 1
+    assert v["kv_prefix_lost"] == 0
+    assert v["hit_rate_host"] > 0
+    assert v["hit_rate_peer"] > 0
+    assert v["tier_ttft_p99_ms"] <= 0.75 * v["baseline_ttft_p99_ms"], v
+    assert v["int8_spill_bytes_ratio"] <= 0.62
