@@ -461,6 +461,45 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
             "it an elastic prefill-side server flips to decode"
         },
     )
+    gen_elastic_fleet: bool = dataclasses.field(
+        default=True,
+        metadata={
+            "help": "elastic fleet control plane: adopt runtime "
+            "joiners (peer weight bootstrap before routing), forget "
+            "graceful drain departures, persist the manager HA lease "
+            "(system/fleet_controller.py). False = fixed fleet"
+        },
+    )
+    gen_autoscale: bool = dataclasses.field(
+        default=False,
+        metadata={
+            "help": "watermark autoscaling of the generation fleet: "
+            "scale-out/in from the queued-token / free-page signals "
+            "(requires a launcher attached to the manager)"
+        },
+    )
+    gen_scale_out_queued_tokens: int = dataclasses.field(
+        default=4096,
+        metadata={
+            "help": "fleet-average queued prompt tokens per routable "
+            "server at/above which the autoscaler launches a server"
+        },
+    )
+    gen_scale_in_queued_tokens: int = dataclasses.field(
+        default=64,
+        metadata={
+            "help": "fleet-average queued prompt tokens at/below "
+            "which the autoscaler drains the least-loaded server"
+        },
+    )
+    gen_pool_min_servers: int = dataclasses.field(
+        default=1,
+        metadata={"help": "autoscaler floor on fleet size"},
+    )
+    gen_pool_max_servers: int = dataclasses.field(
+        default=8,
+        metadata={"help": "autoscaler ceiling on fleet size"},
+    )
     schedule_policy: str = "round_robin"
     # rollout agent: "math-single-step" | "math-multi-turn"
     agent_type: str = "math-single-step"

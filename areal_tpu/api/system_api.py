@@ -250,6 +250,10 @@ class GenerationServerConfig:
     # compile. Costs startup latency; pays off whenever a persistent
     # compilation cache is configured.
     warm_on_start: bool = False
+    # Drain-then-leave (POST /drain): upper bound on waiting for
+    # in-flight requests to finish before the parked-prefix migration
+    # starts (admission is already shedding by then).
+    drain_wait_s: float = 60.0
     seed: int = 1
 
     @property
@@ -337,6 +341,45 @@ class GserverManagerConfig:
     # Each pool keeps at least this many servers through re-roles.
     pool_min_prefill: int = 1
     pool_min_decode: int = 1
+    # ---- Elastic fleet control plane (system/fleet_controller.py,
+    # docs/fault_tolerance.md "Fleet elasticity + manager HA") --------
+    # Runtime join/leave + manager HA: unknown heartbeating servers are
+    # ADOPTED (weight-bootstrapped from peers before routing), graceful
+    # departures are forgotten cleanly, and the manager persists an
+    # epoch/weight-version lease so a restart rebuilds everything else
+    # from heartbeats + /metrics. False = fixed fleet, no lease (the
+    # pre-ISSUE-12 behavior).
+    elastic_fleet: bool = True
+    # Warm standby: block in configure until the current lease holder's
+    # record expires, then take over (instead of failing after 300 s).
+    standby: bool = False
+    # Joiner weight source: "peers" fetches chunk streams from
+    # same-shard holders (origin last resort, never NFS); "origin"
+    # forces the plane origin (the bench's baseline arm).
+    join_bootstrap: str = "peers"
+    # A drain that hasn't completed (graceful departure observed) by
+    # this deadline is EVICTED while it finishes quiescing — a drain
+    # cannot be cancelled server-side, so the server could never take
+    # traffic again; its graceful stop (or death) stays the terminal
+    # transition.
+    drain_timeout_s: float = 120.0
+    # Watermark autoscaling (fleet_controller.WatermarkAutoscaler):
+    # scale-out/in decisions from the SAME queued-token / free-page
+    # signals the re-role sizer polls, actuated through a launcher
+    # attached via GserverManager.attach_launcher. Off by default —
+    # policy without actuation only logs a warning.
+    autoscale: bool = False
+    # Fleet-average queued prompt tokens per routable server at/above
+    # which the fleet grows; at/below scale_in the least-loaded server
+    # is drained (only while free pages are comfortable).
+    scale_out_queued_tokens: int = 4096
+    scale_in_queued_tokens: int = 64
+    scale_free_page_min_frac: float = 0.5
+    pool_min_servers: int = 1
+    pool_max_servers: int = 8
+    scale_cooldown_s: float = 15.0
+    # Consecutive over/under-watermark metrics polls before acting.
+    scale_sustain_polls: int = 2
 
     @property
     def worker_name(self) -> str:

@@ -125,6 +125,11 @@ _KNOBS: List[Knob] = [
     _k("AREAL_HEALTH_TTL", "float", 10.0,
        "Default lease TTL seconds for the health registry "
        "(base/health.py); per-role overrides via worker config."),
+    _k("AREAL_FLEET_LEASE_TTL", "float", None,
+       "Gserver-manager HA lease TTL seconds "
+       "(system/fleet_controller.py): a successor takes over once the "
+       "record is stale by 3x this. Unset = AREAL_HEALTH_TTL, so one "
+       "knob tunes both failure-detection horizons."),
     _k("AREAL_NAME_RESOLVE_ROOT", "str", "/tmp/areal_tpu/name_resolve",
        "Root directory for the filesystem name-resolve backend "
        "(base/name_resolve.py)."),

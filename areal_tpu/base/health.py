@@ -184,6 +184,23 @@ class HealthRegistry:
             <= float(r.get("ttl", default_ttl())) * STALE_FACTOR
         }
 
+    def classified(self) -> "tuple[Dict[str, Dict], Dict[str, Dict]]":
+        """(alive, stopped) from ONE subtree walk. Consumers folding
+        both views every poll (the gserver manager's health fold) must
+        not pay two full scans — each record read is file I/O, NFS in
+        production."""
+        now = time.time()
+        alive: Dict[str, Dict] = {}
+        stopped: Dict[str, Dict] = {}
+        for m, r in self._records().items():
+            if r.get("stopped"):
+                stopped[m] = r
+            elif now - float(r.get("ts", 0)) <= float(
+                r.get("ttl", default_ttl())
+            ) * STALE_FACTOR:
+                alive[m] = r
+        return alive, stopped
+
     def stopped_members(self) -> Dict[str, Dict]:
         """Members that announced a graceful shutdown (Heartbeat.stop).
         Consumers treat these as departed, NOT dead — no failure

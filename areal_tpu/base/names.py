@@ -96,6 +96,14 @@ def weight_plane_source(experiment_name: str, trial_name: str, model_name: str) 
     return f"{trial_root(experiment_name, trial_name)}/weight_plane/{model_name}"
 
 
+def fleet_manager_lease(experiment_name: str, trial_name: str) -> str:
+    """The gserver manager's HA lease record (epoch + weight version,
+    system/fleet_controller.py): written with delete_on_exit=False so
+    it survives a manager death — its staleness IS the takeover
+    signal for a successor/standby."""
+    return f"{trial_root(experiment_name, trial_name)}/fleet_manager_lease"
+
+
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
 

@@ -40,3 +40,9 @@ WEIGHT_SLABS_V1 = "areal-weight-slabs/v1"
 # Banked bench evidence record / aggregated report (bench/bank.py).
 BENCH_RECORD_V1 = "areal-bench-record/v1"
 BENCH_REPORT_V1 = "areal-bench-report/v1"
+
+# Gserver-manager HA lease: the tiny epoch + weight-version record a
+# manager persists in name_resolve so a successor can fence the old
+# generation and resume at the right version
+# (system/fleet_controller.py).
+FLEET_LEASE_V1 = "areal-fleet-lease/v1"

@@ -52,6 +52,23 @@ w.configure(cfg, experiment_name=cfg.experiment_name,
 w.run()
 '''
 
+_MGR_CHILD = '''
+import os, sys
+sys.path.insert(0, %(repo)r)
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=%(nr)r)
+from areal_tpu.api.system_api import GserverManagerConfig
+from areal_tpu.system.gserver_manager import GserverManager
+cfg = GserverManagerConfig(
+    experiment_name=%(exp)r, trial_name=%(trial)r, model_name="actor",
+    n_servers=%(n)d, train_batch_size=4, max_head_offpolicyness=1 << 20,
+    health_check_interval=0.5, **%(mgr)r)
+m = GserverManager()
+m.configure(cfg, experiment_name=cfg.experiment_name,
+            trial_name=cfg.trial_name, worker_name=cfg.worker_name)
+m.run()
+'''
+
 
 def _post(url: str, path: str, payload: Dict, timeout: float = 300.0) -> Dict:
     req = urllib.request.Request(
@@ -75,80 +92,191 @@ class ProcessFleet:
         tmp_dir: Optional[str] = None,
         tag: str = "fleet",
         spawn_timeout_s: float = 600.0,
+        manager_subprocess: bool = False,
+        manager_env: Optional[Dict] = None,
     ):
         import tempfile
 
         from areal_tpu.base import name_resolve, names
-        from areal_tpu.api.system_api import GserverManagerConfig
-        from areal_tpu.system.gserver_manager import GserverManager
 
         self._names = names
         self._name_resolve = name_resolve
         self.tmp = tmp_dir or tempfile.mkdtemp(prefix=f"areal_{tag}_")
         self.exp = f"bench-{tag}-{uuid.uuid4().hex[:6]}"
         self.trial = "t0"
-        nr = os.path.join(self.tmp, "nr")
-        self._repo_handle = name_resolve.reconfigure("nfs", record_root=nr)
+        self._model_cfg = dict(model_cfg)
+        self._nr = os.path.join(self.tmp, "nr")
+        self._repo_handle = name_resolve.reconfigure(
+            "nfs", record_root=self._nr
+        )
         repo = repo_root()
         env = dict(os.environ)
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
         env.setdefault("AREAL_HEALTH_TTL", "60")
+        self._env = env
+        self._repo = repo
         self.procs: List[subprocess.Popen] = []
         self.logs: List[str] = []
         self._log_files = []
+        self.urls: List[Optional[str]] = []
         for idx, srv in enumerate(servers):
-            srv = dict(srv)
-            child_env = dict(env)
-            for k, v in (srv.pop("env", None) or {}).items():
-                child_env[k] = v
-            log_path = os.path.join(self.tmp, f"server{idx}.log")
-            self.logs.append(log_path)
-            log_f = open(log_path, "w")
-            self._log_files.append(log_f)
-            self.procs.append(subprocess.Popen(
-                [sys.executable, "-c", _CHILD % dict(
-                    repo=repo, nr=nr, exp=self.exp, trial=self.trial,
-                    idx=idx, model_cfg=model_cfg, srv=srv,
-                )],
-                env=child_env, cwd=repo, stdout=log_f,
-                stderr=subprocess.STDOUT,
+            self._spawn_server_child(idx, dict(srv))
+        self._await_discovery(
+            range(len(servers)), spawn_timeout_s=spawn_timeout_s
+        )
+        # Manager: in-thread (legacy, cheap) or a REAL subprocess —
+        # required by the fleet_elastic killover arm (you cannot
+        # SIGKILL a thread) and by manager-HA e2es.
+        self.manager = None
+        self._mthread = None
+        self.mgr_procs: List[subprocess.Popen] = []
+        self._manager_kw = dict(manager_kw or {})
+        self._manager_env = dict(manager_env or {})
+        self._n_servers0 = len(servers)
+        if manager_subprocess:
+            self.spawn_manager()
+        else:
+            from areal_tpu.api.system_api import GserverManagerConfig
+            from areal_tpu.system.gserver_manager import GserverManager
+
+            self.manager = GserverManager()
+            self.manager.configure(GserverManagerConfig(
+                experiment_name=self.exp, trial_name=self.trial,
+                model_name="actor", n_servers=len(servers),
+                train_batch_size=4, max_head_offpolicyness=1 << 20,
+                health_check_interval=0.5,
+                **self._manager_kw,
             ))
-        # Discovery.
-        self.urls: List[Optional[str]] = [None] * len(servers)
+            self._mthread = threading.Thread(
+                target=self.manager.run, daemon=True
+            )
+            self._mthread.start()
+        self.wait_healthy(len(servers))
+
+    # ------------------------------------------------------------------
+    # Elastic-fleet harness surface (ISSUE 12)
+    # ------------------------------------------------------------------
+
+    def _spawn_server_child(self, idx: int, srv: Dict) -> subprocess.Popen:
+        child_env = dict(self._env)
+        for k, v in (srv.pop("env", None) or {}).items():
+            child_env[k] = v
+        log_path = os.path.join(self.tmp, f"server{idx}.log")
+        self.logs.append(log_path)
+        log_f = open(log_path, "w")
+        self._log_files.append(log_f)
+        p = subprocess.Popen(
+            [sys.executable, "-c", _CHILD % dict(
+                repo=self._repo, nr=self._nr, exp=self.exp,
+                trial=self.trial, idx=idx, model_cfg=self._model_cfg,
+                srv=srv,
+            )],
+            env=child_env, cwd=self._repo, stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        self.procs.append(p)
+        while len(self.urls) <= idx:
+            self.urls.append(None)
+        return p
+
+    def _await_discovery(self, indices, spawn_timeout_s: float = 600.0):
         deadline = time.monotonic() + spawn_timeout_s
-        while any(u is None for u in self.urls):
-            for i, u in enumerate(self.urls):
-                if u is not None:
-                    continue
+        pending = [i for i in indices if self.urls[i] is None]
+        while pending:
+            for i in list(pending):
                 if self.procs[i].poll() is not None:
                     with open(self.logs[i]) as f:
                         tail = f.read()[-3000:]
                     raise RuntimeError(f"fleet server {i} died:\n{tail}")
                 try:
-                    self.urls[i] = name_resolve.get(
-                        names.gen_server_url(self.exp, self.trial, str(i))
+                    self.urls[i] = self._name_resolve.get(
+                        self._names.gen_server_url(
+                            self.exp, self.trial, str(i)
+                        )
                     )
+                    pending.remove(i)
                 except Exception:
                     pass
             if time.monotonic() > deadline:
                 raise TimeoutError("fleet servers never registered")
             time.sleep(0.2)
-        # Manager.
-        self.manager = GserverManager()
-        self.manager.configure(GserverManagerConfig(
-            experiment_name=self.exp, trial_name=self.trial,
-            model_name="actor", n_servers=len(servers),
-            train_batch_size=4, max_head_offpolicyness=1 << 20,
-            health_check_interval=0.5,
-            **(manager_kw or {}),
-        ))
-        self._mthread = threading.Thread(target=self.manager.run, daemon=True)
-        self._mthread.start()
-        deadline = time.monotonic() + 60
-        while len(self.manager._healthy_urls()) < len(servers):
-            if time.monotonic() > deadline:
-                raise TimeoutError("manager never saw the whole fleet")
-            time.sleep(0.1)
+
+    def spawn_server(self, srv: Optional[Dict] = None,
+                     spawn_timeout_s: float = 600.0) -> str:
+        """Runtime JOIN: spawn one more GenerationServer child (next
+        index) and wait for its discovery registration; the manager
+        adopts it from its first heartbeat. Returns its url."""
+        idx = len(self.procs)
+        self._spawn_server_child(idx, dict(srv or {}))
+        self._await_discovery([idx], spawn_timeout_s=spawn_timeout_s)
+        return self.urls[idx]
+
+    def spawn_manager(self, env: Optional[Dict] = None) -> subprocess.Popen:
+        """Spawn a gserver-manager subprocess (successors take over the
+        HA lease from a dead predecessor). ``env`` overrides the
+        construction-time manager_env — a successor must not re-inherit
+        a predecessor's chaos arm."""
+        if env is not None:
+            self._manager_env = dict(env)
+        i = len(self.mgr_procs)
+        log_path = os.path.join(self.tmp, f"manager{i}.log")
+        log_f = open(log_path, "w")
+        self._log_files.append(log_f)
+        p = subprocess.Popen(
+            [sys.executable, "-c", _MGR_CHILD % dict(
+                repo=self._repo, nr=self._nr, exp=self.exp,
+                trial=self.trial, n=self._n_servers0,
+                mgr=self._manager_kw,
+            )],
+            env={**self._env, **self._manager_env},
+            cwd=self._repo, stdout=log_f, stderr=subprocess.STDOUT,
+        )
+        self.mgr_procs.append(p)
+        return p
+
+    def manager_addr(self) -> str:
+        """The CURRENT manager address: in-thread manager's directly, a
+        subprocess manager's via its name_resolve registration (which a
+        successor overwrites on takeover)."""
+        if self.manager is not None:
+            return self.manager.address
+        return self._name_resolve.get(
+            self._names.gen_server_manager(self.exp, self.trial)
+        )
+
+    def status(self) -> Dict:
+        with urllib.request.urlopen(
+            self.manager_addr() + "/status", timeout=30
+        ) as r:
+            return json.loads(r.read())
+
+    def wait_healthy(self, n: int, timeout_s: float = 120.0,
+                     epoch: Optional[int] = None):
+        """Block until /status shows n healthy servers (and, when
+        given, the manager epoch — takeover convergence)."""
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                st = self.status()
+                last = (len(st["healthy_servers"]),
+                        st.get("fleet", {}).get("epoch"))
+                if len(st["healthy_servers"]) == n and (
+                    epoch is None or last[1] == epoch
+                ):
+                    return st
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"manager never reached {n} healthy servers"
+            + (f" at epoch {epoch}" if epoch is not None else "")
+            + f" (last seen: {last})"
+        )
+
+    def drain_server(self, url: str, reason: str = "harness") -> Dict:
+        return _post(self.manager_addr(), "/drain_server",
+                     {"url": url, "reason": reason}, timeout=30)
 
     # ------------------------------------------------------------------
 
@@ -157,27 +285,24 @@ class ProcessFleet:
         server's role (pool routing engages only then)."""
         want = {self.urls[i]: r for i, r in enumerate(roles)}
         deadline = time.monotonic() + timeout_s
+        got = None
         while time.monotonic() < deadline:
-            got = {
-                u: self.manager._server_roles.get(u) for u in want
-            }
-            if got == want:
-                return
+            try:
+                st_roles = self.status()["pools"]["roles"]
+                got = {u: st_roles.get(u) for u in want}
+                if got == want:
+                    return
+            except Exception:
+                pass
             time.sleep(0.2)
-        raise TimeoutError(f"manager never learned roles {want}")
+        raise TimeoutError(f"manager never learned roles {want} ({got})")
 
     def metrics(self, url: str) -> Dict:
+        from areal_tpu.system.fleet_controller import parse_metrics
+
         text = urllib.request.urlopen(
             url + "/metrics", timeout=30).read().decode()
-        out: Dict = {}
-        for line in text.splitlines():
-            parts = line.split()
-            if len(parts) == 2:
-                try:
-                    out[parts[0]] = float(parts[1])
-                except ValueError:
-                    out[parts[0]] = parts[1]
-        return out
+        return parse_metrics(text)
 
     def hist_counts(self, urls: List[str]) -> Dict[str, List[int]]:
         """Fleet-merged raw TTFT/ITL bucket counts over `urls`."""
@@ -195,7 +320,7 @@ class ProcessFleet:
             _post(u, "/configure", payload, timeout=30)
 
     def schedule(self, meta: Dict) -> Dict:
-        return _post(self.manager.address, "/schedule_request", meta,
+        return _post(self.manager_addr(), "/schedule_request", meta,
                      timeout=30)
 
     def generate_direct(self, url: str, qid: str, input_ids: List[int],
@@ -243,15 +368,21 @@ class ProcessFleet:
             )
         except Exception:
             pass
-        for p in self.procs:
-            p.terminate()
-        for p in self.procs:
+        for p in self.procs + self.mgr_procs:
+            try:
+                p.terminate()
+            except Exception:
+                pass
+        for p in self.procs + self.mgr_procs:
             try:
                 p.wait(timeout=15)
             except subprocess.TimeoutExpired:
                 p.kill()
+            except Exception:
+                pass
         try:
-            self._mthread.join(timeout=10)
+            if self._mthread is not None:
+                self._mthread.join(timeout=10)
         except Exception:
             pass
         for f in self._log_files:

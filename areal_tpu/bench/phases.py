@@ -242,6 +242,24 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="fleet_elastic",
+    entrypoint="areal_tpu.bench.workloads:fleet_elastic_phase",
+    priority=7,
+    est_compile_s=90.0,
+    est_measure_s=300.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Elastic fleet control plane: one real-process fleet "
+                "lives through runtime join (peer-bootstrap vs origin "
+                "A/B on join-to-first-routed-token + origin bytes), a "
+                "manager SIGKILL + lease-takeover successor, and a "
+                "drain-then-leave KV migration — under sustained "
+                "PartialRolloutManager load with zero failed rollouts "
+                "(CPU-proxy)",
+))
+
+register(PhaseSpec(
     name="pack_density",
     entrypoint="areal_tpu.bench.workloads:pack_density_phase",
     priority=10,

@@ -1872,3 +1872,347 @@ def weight_update_phase(pass_: str) -> dict:
         if src is not None:
             src.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ----------------------------------------------------------------------
+# fleet_elastic: the elastic fleet control plane's headline probe
+# (ISSUE 12). One real-process fleet lives through the whole elastic
+# story under sustained PartialRolloutManager load: a runtime JOIN
+# bootstrapped from peers (zero origin bytes), a manager SIGKILL +
+# successor takeover (lease epoch bump, zero failed rollouts), a second
+# join forced through the origin (the baseline arm of the
+# peer-vs-origin A/B), and a drain-then-leave that migrates every
+# parked prefix to the survivors over the /kv wire.
+# ----------------------------------------------------------------------
+
+_FLEET_SRV = dict(
+    max_concurrent_requests=4, max_seq_len=256, kv_page_size=16,
+    decode_block_steps=4, prompt_bucket=16, prefill_chunk=16,
+    prefix_cache_tokens=512, warm_on_start=True,
+)
+_FLEET_CHUNK = 1 << 15
+_FLEET_PLEN = 48
+_FLEET_TURN_NEW = 6
+
+
+class _FleetLoad:
+    """Sustained 2-turn-session load through the real
+    PartialRolloutManager client on a dedicated asyncio thread — the
+    production retry/rediscovery path, so a manager death mid-run is
+    ridden out instead of failing rollouts."""
+
+    def __init__(self, fleet, n_streams: int):
+        import asyncio
+        import threading
+
+        from areal_tpu.api.model_api import GenerationHyperparameters
+        from areal_tpu.base import name_resolve, names
+        from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+        self.completed = 0
+        self.failed = 0
+        self._stop = threading.Event()
+
+        def resolver():
+            return name_resolve.get(
+                names.gen_server_manager(fleet.exp, fleet.trial)
+            )
+
+        async def session(prm, i, k):
+            rng = np.random.RandomState(9000 + i * 131 + k)
+            prompt = rng.randint(
+                1, _OPENLOOP_MODEL["vocab_size"], size=_FLEET_PLEN
+            ).tolist()
+            g = GenerationHyperparameters(
+                max_new_tokens=_FLEET_TURN_NEW, greedy=True
+            )
+            out1 = await prm._generate_one(f"ld{i}-{k}", prompt, g)
+            out2 = await prm._generate_one(
+                f"ld{i}-{k}", prompt + list(out1.output_ids) + [3], g
+            )
+            if len(out2.output_ids) != _FLEET_TURN_NEW:
+                raise RuntimeError(f"short turn 2: {out2}")
+
+        async def stream(prm, i):
+            k = 0
+            while not self._stop.is_set():
+                try:
+                    await session(prm, i, k)
+                    self.completed += 1
+                except Exception as e:
+                    self.failed += 1
+                    log(f"bench: fleet_elastic load failure: {e!r}")
+                k += 1
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            prm = PartialRolloutManager(
+                fleet.manager_addr(), request_timeout=120.0,
+                max_retries=8, retry_backoff_s=0.1,
+                addr_resolver=resolver,
+            )
+            try:
+                loop.run_until_complete(asyncio.gather(
+                    *[stream(prm, i) for i in range(n_streams)]
+                ))
+                loop.run_until_complete(prm.close())
+            finally:
+                loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 120.0) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+        return {"completed": self.completed, "failed": self.failed}
+
+
+def _fleet_wait(cond, timeout_s: float, msg: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise RuntimeError(f"fleet_elastic: timed out waiting for {msg}")
+
+
+def _fleet_first_routed_token_ms(fleet, url: str, t0: float,
+                                 tag: str) -> float:
+    """Route requests through the manager until one lands on `url`
+    (its total_requests counter moves); returns ms since t0 — the
+    join-to-first-routed-token clock."""
+    base = fleet.metrics(url).get("areal:total_requests", 0.0)
+    i = 0
+    while fleet.metrics(url).get("areal:total_requests", 0.0) <= base:
+        rng = np.random.RandomState(7000 + i)
+        fleet.generate_routed(
+            f"{tag}{i}",
+            rng.randint(1, _OPENLOOP_MODEL["vocab_size"],
+                        size=8).tolist(),
+            2, timeout=120,
+        )
+        i += 1
+        if i > 200:
+            raise RuntimeError(
+                f"fleet_elastic: {url} never served a routed token"
+            )
+    return (time.monotonic() - t0) * 1000.0
+
+
+def fleet_elastic_phase(pass_: str) -> dict:
+    import tempfile
+
+    import jax
+
+    from areal_tpu.base import constants, name_resolve, names
+    from areal_tpu.bench.fleet import ProcessFleet
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    t_start = time.monotonic()
+    tier_env = {"AREAL_KV_TIER_BYTES": str(64 << 20)}
+
+    if pass_ == "compile":
+        # One fleet, one 2-turn session: compiles the chunked prefill,
+        # decode block, and restore-path programs into the persistent
+        # cache so the measure pass's six server spawns all hit warm.
+        t0 = time.perf_counter()
+        with ProcessFleet(
+            _OPENLOOP_MODEL, [dict(_FLEET_SRV, env=tier_env)],
+            tag="flec",
+        ) as fleet:
+            rng = np.random.RandomState(1)
+            p = rng.randint(1, _OPENLOOP_MODEL["vocab_size"],
+                            size=_FLEET_PLEN).tolist()
+            out = fleet.generate_routed("c0", p, _FLEET_TURN_NEW,
+                                        timeout=600)
+            assert "output_ids" in out, out
+            fleet.generate_routed(
+                "c0", p + [int(t) for t in out["output_ids"]] + [3],
+                _FLEET_TURN_NEW, timeout=600,
+            )
+        dt = time.perf_counter() - t0
+        log(f"bench: fleet_elastic compile pass {dt:.1f}s")
+        return {"compile_s": dt}
+
+    # Children and this process must agree on the param-realloc path
+    # (the weight-plane origin serves the dump dir): pin AREAL_FILEROOT
+    # before the fleet copies the environment — and restore/clean it in
+    # the finally below so a later phase in the same process doesn't
+    # inherit this phase's scratch root.
+    prev_fileroot = env_registry.get_raw("AREAL_FILEROOT")
+    fileroot = tempfile.mkdtemp(prefix="areal_flel_")
+    os.environ["AREAL_FILEROOT"] = fileroot
+    mgr_kw = dict(
+        weight_plane=True, weight_chunk_bytes=_FLEET_CHUNK,
+        weight_fanout_degree=2, flush_request_timeout=120.0,
+        drain_timeout_s=240.0, join_bootstrap="peers",
+    )
+    src = None
+    load = None
+    fleet = None
+    try:
+        # Inside the try: a child dying at spawn must still restore
+        # AREAL_FILEROOT and remove the scratch root in the finally.
+        fleet = ProcessFleet(
+            _OPENLOOP_MODEL, [dict(_FLEET_SRV, env=tier_env)] * 2,
+            manager_kw=mgr_kw, manager_subprocess=True,
+            manager_env={"AREAL_FLEET_LEASE_TTL": "2"}, tag="flee",
+        )
+        # ---- Trainer-side dump v1 + plane source + version publish:
+        # the substrate every join bootstraps from.
+        role_dir = os.path.join(
+            constants.get_param_realloc_path(fleet.exp, fleet.trial),
+            "actor",
+        )
+        os.makedirs(role_dir, exist_ok=True)
+        with open(os.path.join(role_dir, "engine_state.pkl"), "wb") as f:
+            f.write(b"gate")  # existence gate for check_new_params
+        cfg = TransformerConfig(**_OPENLOOP_MODEL)
+        p1 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x), init_params(cfg, jax.random.PRNGKey(7))
+        )
+        dump_raw_params(p1, role_dir, version=1, chunk_bytes=_FLEET_CHUNK)
+        src = WeightPlaneSource(role_dir, chunk_bytes=_FLEET_CHUNK).start()
+        src.register(fleet.exp, fleet.trial, "actor")
+        name_resolve.add(
+            names.model_version(fleet.exp, fleet.trial, "actor"), "1",
+            replace=True,
+        )
+        _fleet_wait(
+            lambda: fleet.status()["weight_version"] == 1, 120.0,
+            "v1 plane fanout",
+        )
+
+        load = _FleetLoad(fleet, n_streams=2)
+        _fleet_wait(lambda: load.completed >= 2, 180.0,
+                    "load warm-up sessions")
+
+        # ---- Arm A: runtime JOIN, bootstrapped from PEERS.
+        t0 = time.monotonic()
+        url2 = fleet.spawn_server(dict(_FLEET_SRV, env=tier_env))
+        st = fleet.wait_healthy(3, timeout_s=300)
+        join_peer_ms = _fleet_first_routed_token_ms(
+            fleet, url2, t0, "ja")
+        joins = fleet.status()["fleet"]["joins"]
+        jp = [e for e in joins if e["url"] == url2][-1]
+        log(f"bench: fleet_elastic peer join: {jp} "
+            f"first-token {join_peer_ms:.0f}ms")
+
+        # ---- Manager killover: SIGKILL the live manager mid-load,
+        # spawn a successor that takes the lease (epoch 2) and
+        # rebuilds; the load's rediscovery path must ride it out.
+        epoch0 = st["fleet"]["epoch"]
+        fleet.mgr_procs[-1].kill()
+        t0 = time.monotonic()
+        fleet._manager_kw["join_bootstrap"] = "origin"
+        fleet.spawn_manager()
+        st = fleet.wait_healthy(3, timeout_s=300, epoch=epoch0 + 1)
+        killover_ms = (time.monotonic() - t0) * 1000.0
+        log(f"bench: fleet_elastic killover: epoch {st['fleet']['epoch']} "
+            f"in {killover_ms:.0f}ms")
+
+        # ---- Arm B: a second join forced through the ORIGIN (the
+        # baseline the peer arm beats on origin egress).
+        t0 = time.monotonic()
+        url3 = fleet.spawn_server(dict(_FLEET_SRV, env=tier_env))
+        fleet.wait_healthy(4, timeout_s=300)
+        join_origin_ms = _fleet_first_routed_token_ms(
+            fleet, url3, t0, "jb")
+        joins = fleet.status()["fleet"]["joins"]
+        jo = [e for e in joins if e["url"] == url3][-1]
+        log(f"bench: fleet_elastic origin join: {jo} "
+            f"first-token {join_origin_ms:.0f}ms")
+
+        # ---- Drain-then-leave: park prefixes on the victim, then
+        # drain it; the parked KV must MIGRATE to survivors over the
+        # /kv wire (no loss) and the departure must be clean.
+        rng = np.random.RandomState(55)
+        parked = {}
+        for i in range(3):
+            p = rng.randint(1, _OPENLOOP_MODEL["vocab_size"],
+                            size=_FLEET_PLEN).tolist()
+            out = fleet.generate_direct(url2, f"park{i}", p,
+                                        _FLEET_TURN_NEW)
+            parked[f"park{i}"] = (p, [int(t) for t in out["output_ids"]])
+        res = fleet.drain_server(url2, reason="bench scale-in")
+        assert res.get("success"), res
+        _fleet_wait(
+            lambda: any(
+                e["url"] == url2 and e["status"] == "departed"
+                for e in fleet.status()["fleet"]["drains"]
+            ),
+            300.0, "drain departure",
+        )
+        drain = [
+            e for e in fleet.status()["fleet"]["drains"]
+            if e["url"] == url2 and e["status"] == "departed"
+        ][-1]
+        st = fleet.wait_healthy(3, timeout_s=60)
+        # The parked sessions RESUME elsewhere via the migrated tier
+        # entries (manager index re-fed by the survivors' /kv/index).
+        resumed = 0
+        for qid, (p, out1) in parked.items():
+            out = fleet.generate_routed(qid, p + out1 + [3],
+                                        _FLEET_TURN_NEW, timeout=120)
+            if "output_ids" in out:
+                resumed += 1
+
+        stats = load.stop()
+        load = None
+        survivors = [u for u in fleet.urls if u and u != url2]
+        lost = accepted = 0.0
+        for u in survivors:
+            try:
+                m = fleet.metrics(u)
+                lost += m.get("areal:kv_prefix_lost_total", 0.0)
+                accepted += m.get("areal:kv_accepted", 0.0)
+            except Exception:
+                pass
+        out = {
+            "n_servers_start": 2.0,
+            "n_servers_max": 4.0,
+            "n_servers_end": float(len(st["healthy_servers"])),
+            "join_peer_ms": join_peer_ms,
+            "join_peer_bootstrap_ms": float(jp.get("bootstrap_ms", 0.0)),
+            "join_peer_source": jp.get("source", ""),
+            "join_peer_origin_bytes": float(
+                jp.get("bytes_from_origin", 0.0)),
+            "join_peer_peer_bytes": float(jp.get("bytes_from_peers", 0.0)),
+            "join_origin_ms": join_origin_ms,
+            "join_origin_source": jo.get("source", ""),
+            "join_origin_bytes": float(jo.get("bytes_from_origin", 0.0)),
+            "killover_recovery_ms": killover_ms,
+            "killover_epoch": float(st["fleet"]["epoch"]),
+            "failed_rollouts": float(stats["failed"]),
+            "completed_rollouts": float(stats["completed"]),
+            "drain_held": float(drain.get("migrated", 0)
+                                + drain.get("lost", 0)),
+            "drain_migrated": float(drain.get("migrated", 0)),
+            "drain_lost": float(drain.get("lost", 0)),
+            "drain_resumed_sessions": float(resumed),
+            "kv_accepted": accepted,
+            "kv_prefix_lost": lost,
+            "fleet": "process",
+            "wall_s": time.monotonic() - t_start,
+        }
+        log(f"bench: fleet_elastic {out}")
+        return out
+    finally:
+        if load is not None:
+            load.stop(timeout=30)
+        if src is not None:
+            src.close()
+        if fleet is not None:
+            fleet.close()
+        if prev_fileroot is None:
+            os.environ.pop("AREAL_FILEROOT", None)
+        else:
+            os.environ["AREAL_FILEROOT"] = prev_fileroot
+        import shutil
+
+        shutil.rmtree(fileroot, ignore_errors=True)

@@ -1921,6 +1921,20 @@ class ServingEngine:
         to skip redundant tier probes, and admission revalidates)."""
         return qid in self._parked_qids
 
+    def parked_qids_now(self, timeout_s: float = 30.0) -> Dict[str, int]:
+        """Authoritative qid -> token-count map of parked HBM prefixes,
+        read ON the loop thread via the door. The off-thread
+        ``_parked_qids`` snapshot is up to ~0.2s stale — fine for index
+        advertisement, NOT for a drain enumerating what it must migrate
+        (a just-parked prefix missed there would silently die with the
+        process)."""
+        def _read():
+            return {
+                q: len(e[0]) for q, e in self._prefix_cache.items()
+            }
+
+        return self._run_on_loop(_read, timeout_s)
+
     def parked_index(self, cap: int = 8192) -> List[Dict[str, Any]]:
         """HBM-parked entries for the /kv/index surface (snapshot-fed;
         tier entries come from kv_tier.held())."""

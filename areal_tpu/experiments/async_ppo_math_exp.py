@@ -218,6 +218,12 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
         prefill_queue_high_tokens=cfg.gen_prefill_queue_high_tokens,
         prefill_queue_low_tokens=cfg.gen_prefill_queue_low_tokens,
         decode_free_page_min_frac=cfg.gen_decode_free_page_min_frac,
+        elastic_fleet=cfg.gen_elastic_fleet,
+        autoscale=cfg.gen_autoscale,
+        scale_out_queued_tokens=cfg.gen_scale_out_queued_tokens,
+        scale_in_queued_tokens=cfg.gen_scale_in_queued_tokens,
+        pool_min_servers=cfg.gen_pool_min_servers,
+        pool_max_servers=cfg.gen_pool_max_servers,
     )
     rollouts = [
         RolloutWorkerConfig(

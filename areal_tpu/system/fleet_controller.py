@@ -1,0 +1,475 @@
+"""Elastic fleet control plane: join/leave, autoscaling, manager HA.
+
+The three legs of ROADMAP item 1 (docs/fault_tolerance.md "Fleet
+elasticity + manager HA"):
+
+- **Runtime join/leave.** The gserver manager no longer treats the
+  fleet as fixed at launch: a freshly spawned ``GenerationServer``
+  registers through the normal name_resolve/heartbeat path, the
+  manager ADOPTS it (``_admit_server``), bootstraps its weight shard
+  from *peers* over the PR 5/8 weight plane (origin last resort, never
+  NFS), and only then admits it to routing. Leave is drain-then-exit:
+  the server quiesces admission, finishes in-flight work, migrates its
+  parked KV prefixes to peers over the PR 7/11 KV wire, and departs
+  with a graceful heartbeat-stop marker the manager folds into a clean
+  ``_forget_server``.
+
+- **Watermark autoscaling.** :class:`WatermarkAutoscaler` turns the
+  same queued-token / free-page signals the PR 7 re-role sizer polls
+  into scale-out/in decisions (sustained-watermark + cooldown + pool
+  floors/ceilings), actuated through a pluggable :class:`Launcher`
+  (:class:`SubprocessLauncher` locally; production substitutes its own
+  scheduler client — the interface is the contract).
+
+- **Manager HA.** :class:`ManagerLease` persists the only state a
+  manager restart cannot rebuild — a tiny epoch + weight-version
+  record in name_resolve. Everything else (membership, roles, shards,
+  shed totals, per-server versions) is rebuilt from heartbeats and
+  ``/metrics`` by :func:`rebuild_fleet_state`; the affinity map is
+  best-effort lost (the global prefix index re-feeds from the next
+  ``/kv/index`` poll, so returning sessions still find their KV).
+  A successor takes over by waiting out the lease and writing the next
+  epoch; ``partial_rollout`` clients ride the outage with rediscovery
+  + jittered backoff instead of failing rollouts.
+
+Everything here runs on the manager's worker POLL thread (or at
+configure time) — never on its HTTP event loop: lease reads/writes are
+name_resolve file I/O and :func:`fetch_metrics` is a blocking HTTP GET
+(the areal-lint blocking-async contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from areal_tpu.base import env_registry, health, logging, name_resolve, names
+from areal_tpu.base.wire_schemas import FLEET_LEASE_V1
+
+logger = logging.getLogger("fleet_controller")
+
+# Machine-checked thread contract (areal_tpu/lint, checker `loop-only`;
+# docs/static_analysis.md): the autoscaler's debounce/cooldown counters
+# are owned by the manager's poll thread — `observe` is the only entry
+# point and has no locks by design. Anything else (the /status surface)
+# reads the manager's own lock-guarded scale log, never these.
+AREAL_LINT_LOOP_ONLY = {
+    "WatermarkAutoscaler": {
+        "roots": ["observe"],
+        "attrs": ["_over_polls", "_under_polls", "_cooldown_until"],
+        "init_ok": ["__init__"],
+        "instance_hints": ["autoscaler"],
+    },
+}
+
+
+def lease_ttl() -> float:
+    """Manager-lease TTL seconds (AREAL_FLEET_LEASE_TTL overrides; the
+    default tracks the health-registry TTL so one knob tunes both
+    failure-detection horizons in tests and chaos drills)."""
+    v = env_registry.get_float("AREAL_FLEET_LEASE_TTL")
+    return v if v is not None else health.default_ttl()
+
+
+@dataclasses.dataclass
+class LeaseRecord:
+    epoch: int
+    addr: str
+    weight_version: int
+    ts: float
+    ttl: float
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """Stale by more than STALE_FACTOR TTLs — same slack the health
+        registry gives a missed beat (one hiccup must not trigger a
+        takeover of a live manager)."""
+        now = time.time() if now is None else now
+        return now - self.ts > self.ttl * health.STALE_FACTOR
+
+
+class ManagerLease:
+    """The manager's tiny durable record: epoch + weight version.
+
+    This is deliberately ALL the state a manager persists. The epoch
+    fences generations (a successor writes epoch+1, so two managers can
+    never both believe they own the fleet after a partition heals — the
+    stale one sees a higher epoch on its next renew and stands down);
+    ``weight_version`` is the one routing input that cannot be rebuilt
+    from the fleet itself race-free (a successor inheriting version 0
+    would re-fanout and re-sync healthy servers for nothing). Records
+    are written with ``delete_on_exit=False``: the lease must survive
+    the manager's death — its staleness IS the takeover signal.
+    """
+
+    def __init__(self, experiment_name: str, trial_name: str,
+                 ttl: Optional[float] = None):
+        self._key = names.fleet_manager_lease(experiment_name, trial_name)
+        self.ttl = ttl if ttl is not None else lease_ttl()
+        self._last_renew = 0.0
+        self.epoch = 0
+        self.addr = ""
+
+    def read(self) -> Optional[LeaseRecord]:
+        try:
+            raw = json.loads(name_resolve.get(self._key))
+        except (name_resolve.NameEntryNotFoundError, ValueError):
+            return None
+        if raw.get("schema") != FLEET_LEASE_V1:
+            return None
+        try:
+            return LeaseRecord(
+                epoch=int(raw["epoch"]),
+                addr=str(raw.get("addr", "")),
+                weight_version=int(raw.get("weight_version", 0)),
+                ts=float(raw.get("ts", 0.0)),
+                ttl=float(raw.get("ttl", self.ttl)),
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def _write(self, epoch: int, addr: str, weight_version: int):
+        record = {
+            "schema": FLEET_LEASE_V1,
+            "epoch": int(epoch),
+            "addr": addr,
+            "weight_version": int(weight_version),
+            "ts": time.time(),
+            "ttl": self.ttl,
+        }
+        name_resolve.add(
+            self._key, json.dumps(record, separators=(",", ":")),
+            delete_on_exit=False, replace=True,
+        )
+        self.epoch, self.addr = int(epoch), addr
+        self._last_renew = time.monotonic()
+
+    def wait_expired(self, timeout: float = 300.0) -> Optional[LeaseRecord]:
+        """Block until the current holder's lease is expired (or there
+        is none); returns the last-seen prior record. A warm standby
+        parks here and takes over the moment the holder stops
+        renewing."""
+        deadline = time.monotonic() + timeout
+        prior = self.read()
+        while prior is not None and not prior.expired():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"manager lease still held by {prior.addr} "
+                    f"(epoch {prior.epoch}) after {timeout:.0f}s"
+                )
+            time.sleep(min(0.2, self.ttl / 4))
+            prior = self.read()
+        return prior
+
+    def take(self, addr: str, weight_version: int,
+             prior: Optional[LeaseRecord] = None) -> int:
+        """Write the next epoch and become the holder; returns the new
+        epoch. ``prior`` is the record ``wait_expired`` returned (None
+        on first boot)."""
+        epoch = (prior.epoch if prior is not None else 0) + 1
+        self._write(epoch, addr, weight_version)
+        logger.info(
+            f"manager lease epoch {epoch} taken by {addr} "
+            f"(weight_version={weight_version})"
+        )
+        return epoch
+
+    def renew(self, weight_version: int, force: bool = False) -> bool:
+        """Rate-limited (ttl/3) renewal from the holder's poll loop.
+        Returns False — and does NOT write — when a higher epoch has
+        appeared: the caller has been superseded and must stand down
+        instead of dueling the successor's routing state."""
+        if not force and time.monotonic() - self._last_renew < self.ttl / 3:
+            return True
+        cur = self.read()
+        if cur is not None and (
+            cur.epoch > self.epoch
+            or (cur.epoch == self.epoch and cur.addr != self.addr)
+        ):
+            # Higher epoch: a successor fenced us. SAME epoch but a
+            # different address: two racing takeovers wrote the same
+            # epoch (take() is last-writer-wins, not compare-and-swap)
+            # — the one whose write lost the race stands down here, so
+            # an equal-epoch duel resolves within one renew period.
+            logger.warning(
+                f"manager lease epoch {cur.epoch} (holder {cur.addr}) "
+                f"superseded ours (epoch {self.epoch}, {self.addr}); "
+                f"standing down"
+            )
+            return False
+        try:
+            self._write(self.epoch, self.addr, weight_version)
+        except Exception:
+            # A flaky KV write must not kill the manager it protects;
+            # the next poll lap retries (the slack is STALE_FACTOR TTLs).
+            logger.warning("manager lease renew failed", exc_info=True)
+        return True
+
+
+# ----------------------------------------------------------------------
+# State rebuild (manager HA)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetState:
+    """What a successor manager rebuilds from heartbeats + /metrics —
+    everything /status needs except the affinity map (best-effort; the
+    global prefix index re-feeds from the next /kv/index poll) and
+    in-flight load estimates (refreshed by the first metrics poll)."""
+
+    urls: List[str] = dataclasses.field(default_factory=list)
+    member_urls: Dict[str, str] = dataclasses.field(default_factory=dict)
+    roles: Dict[str, str] = dataclasses.field(default_factory=dict)
+    shards: Dict[str, Optional[Tuple[int, int]]] = dataclasses.field(
+        default_factory=dict
+    )
+    elastic: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    versions: Dict[str, int] = dataclasses.field(default_factory=dict)
+    shed_totals: Dict[str, float] = dataclasses.field(default_factory=dict)
+    draining: List[str] = dataclasses.field(default_factory=list)
+    server_indices: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+def parse_metrics(text: str) -> Dict[str, Any]:
+    """One server's /metrics text -> {line_key: float-or-str} (the
+    ProcessFleet/e2e parsing shape, shared here for the rebuild)."""
+    out: Dict[str, Any] = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                out[parts[0]] = parts[1]
+    return out
+
+
+def fetch_metrics(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """Blocking GET {url}/metrics -> parsed dict ({} when unreachable).
+    Poll-thread / configure-time only (never the HTTP event loop)."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
+            return parse_metrics(r.read().decode())
+    except Exception:
+        return {}
+
+
+def _shard_of(record_shard, metrics_shard) -> Optional[Tuple[int, int]]:
+    if record_shard and len(record_shard) == 2:
+        return (int(record_shard[0]), int(record_shard[1]))
+    if isinstance(metrics_shard, str) and "/" in metrics_shard:
+        r_s, d_s = metrics_shard.split("/", 1)
+        try:
+            return (int(r_s), int(d_s))
+        except ValueError:
+            return None
+    return None
+
+
+def rebuild_fleet_state(
+    heartbeats: Dict[str, Dict],
+    metrics: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> FleetState:
+    """Rebuild the routing-table view from a health-registry snapshot
+    (member -> record) plus optional per-url parsed /metrics.
+
+    Heartbeat payloads are authoritative for membership and identity
+    (url, server_index, weight shard, draining flag) — a server that
+    beats IS in the fleet; /metrics refines live surfaces (role as the
+    server sees it, weight version, elastic eligibility, shed totals).
+    Pure function: the satellite-3 unit test drives it over fakes and
+    diffs the result against a pre-kill manager's /status."""
+    metrics = metrics or {}
+    st = FleetState()
+    for member, record in sorted(heartbeats.items()):
+        url = record.get("url")
+        if not url or record.get("stopped"):
+            continue
+        m = metrics.get(url) or {}
+        st.urls.append(url)
+        st.member_urls[member] = url
+        role = m.get("areal:role") or record.get("role") or "unified"
+        st.roles[url] = str(role)
+        st.shards[url] = _shard_of(
+            record.get("weight_shard"), m.get("areal:weight_shard")
+        )
+        st.elastic[url] = bool(float(m.get("areal:elastic") or 0.0) > 0.5)
+        st.versions[url] = int(float(m.get("areal:weight_version") or 0.0))
+        st.shed_totals[url] = float(m.get("areal:load_shed_total") or 0.0)
+        if record.get("draining") or float(
+            m.get("areal:draining") or 0.0
+        ) > 0.5:
+            st.draining.append(url)
+        if record.get("server_index") is not None:
+            st.server_indices[url] = int(record["server_index"])
+    st.urls.sort()
+    return st
+
+
+# ----------------------------------------------------------------------
+# Watermark autoscaling
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Scale-out/in thresholds over the fleet's queued-token and
+    free-page watermarks (the PR 7 re-role sizer's signals, one level
+    up: the sizer moves servers BETWEEN pools, this adds/removes
+    servers)."""
+
+    # Fleet-average queued prompt tokens per routable server at or
+    # above which the fleet grows (sustained, see sustain_polls).
+    scale_out_queued_tokens: int = 4096
+    # ... at or below which the fleet shrinks (only while the decode
+    # free-page fraction is comfortable — draining a server under page
+    # pressure would amplify it).
+    scale_in_queued_tokens: int = 64
+    scale_free_page_min_frac: float = 0.5
+    pool_min_servers: int = 1
+    pool_max_servers: int = 8
+    cooldown_s: float = 15.0
+    # Consecutive over/under-watermark observations before acting — one
+    # bursty poll must not launch a server.
+    sustain_polls: int = 2
+
+
+class WatermarkAutoscaler:
+    """Debounced watermark policy. ``observe`` is called once per
+    metrics poll from the manager's poll thread and returns "out",
+    "in", or None; actuation (launcher / drain) belongs to the caller,
+    which reports back via the decision's side effect on the next
+    observation (n_pending / n_routable)."""
+
+    def __init__(self, policy: AutoscalePolicy,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy
+        self._clock = clock
+        self._over_polls = 0
+        self._under_polls = 0
+        self._cooldown_until = 0.0
+
+    def observe(
+        self,
+        n_routable: int,
+        n_pending: int,
+        queued_tokens_total: float,
+        free_page_frac: float,
+    ) -> Optional[str]:
+        """One poll's decision. ``n_routable`` = healthy non-draining
+        servers; ``n_pending`` = launches in flight + joiners mid-
+        bootstrap (they count against pool_max so a slow join can't
+        trigger a launch storm); ``free_page_frac`` = fleet free/total
+        KV pages (1.0 when unreported)."""
+        p = self.policy
+        if n_routable <= 0:
+            # An unroutable fleet is an availability incident, not
+            # load: launching into it is right ONLY while nothing is
+            # already on its way — otherwise every cooldown period
+            # would add another server to a blip that resolves itself.
+            self._over_polls = self._over_polls + 1 if n_pending == 0 else 0
+            self._under_polls = 0
+        else:
+            avg_q = queued_tokens_total / n_routable
+            if avg_q >= p.scale_out_queued_tokens:
+                self._over_polls += 1
+                self._under_polls = 0
+            elif (
+                avg_q <= p.scale_in_queued_tokens
+                and free_page_frac >= p.scale_free_page_min_frac
+            ):
+                self._under_polls += 1
+                self._over_polls = 0
+            else:
+                self._over_polls = 0
+                self._under_polls = 0
+        now = self._clock()
+        if now < self._cooldown_until:
+            return None
+        if (
+            self._over_polls >= p.sustain_polls
+            and n_routable + n_pending < p.pool_max_servers
+        ):
+            self._over_polls = 0
+            self._cooldown_until = now + p.cooldown_s
+            return "out"
+        if (
+            self._under_polls >= p.sustain_polls
+            and n_routable > p.pool_min_servers
+            and n_pending == 0
+        ):
+            self._under_polls = 0
+            self._cooldown_until = now + p.cooldown_s
+            return "in"
+        return None
+
+
+# ----------------------------------------------------------------------
+# Launchers
+# ----------------------------------------------------------------------
+
+class Launcher:
+    """Actuation interface for scale-out. Production deployments plug
+    their scheduler here (k8s Job, slurm, GKE instance group); the
+    contract is just: start a generation server that will register
+    itself with ``server_index`` through the normal discovery path.
+    The manager only ever calls ``launch`` and ``reap`` from its poll
+    thread."""
+
+    def launch(self, server_index: int) -> Any:
+        raise NotImplementedError
+
+    def stop(self, handle: Any) -> None:  # best-effort; drain is the
+        raise NotImplementedError         # graceful path
+
+    def reap(self) -> None:
+        """Collect exited children (avoid zombies); optional."""
+
+
+class SubprocessLauncher(Launcher):
+    """Local actuation: ``spawn_fn(server_index) -> subprocess.Popen``.
+    The bench harness and the e2e hand in their child template; the
+    launcher only owns handle bookkeeping."""
+
+    def __init__(self, spawn_fn: Callable[[int], "subprocess.Popen"]):
+        self._spawn_fn = spawn_fn
+        self._lock = threading.Lock()
+        self.procs: List["subprocess.Popen"] = []
+
+    def launch(self, server_index: int) -> "subprocess.Popen":
+        p = self._spawn_fn(server_index)
+        with self._lock:
+            self.procs.append(p)
+        logger.info(
+            f"launched generation server index {server_index} (pid {p.pid})"
+        )
+        return p
+
+    def stop(self, handle: "subprocess.Popen") -> None:
+        try:
+            handle.terminate()
+        except Exception:
+            pass
+
+    def reap(self) -> None:
+        with self._lock:
+            for p in self.procs:
+                p.poll()
+
+    def close(self, timeout: float = 15.0) -> None:
+        with self._lock:
+            procs = list(self.procs)
+        for p in procs:
+            self.stop(p)
+        for p in procs:
+            try:
+                p.wait(timeout=timeout)
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass

@@ -141,6 +141,22 @@ class GenerationServer(Worker):
             )
         self.role = config.role
         self._role_lock = threading.Lock()
+        # Drain-then-leave (docs/fault_tolerance.md): once draining,
+        # admission sheds every new /generate with 429 (the manager
+        # already stopped routing here), in-flight work finishes, the
+        # parked prefixes migrate to peers over the /kv wire, and the
+        # worker departs with a graceful heartbeat stop. _draining is a
+        # plain bool flipped on the HTTP loop and read by the poll
+        # thread (GIL-atomic); _drain_state is mutated only by the
+        # drain task on the HTTP loop.
+        self._draining = False
+        self._drain_state: Dict[str, Any] = {
+            "draining": False, "done": False, "held": 0, "migrated": 0,
+            "lost": 0, "stale_dropped": 0, "drain_ms": 0.0, "reason": "",
+        }
+        # Drain-migration ingest counters (/kv/accept).
+        self._kv_accepted = 0
+        self._kv_accept_bytes = 0
         self._handoff_store: "collections.OrderedDict[str, tuple]" = (
             collections.OrderedDict()
         )
@@ -258,6 +274,9 @@ class GenerationServer(Worker):
         payload["url"] = self.address
         payload["server_index"] = self.cfg.server_index
         payload["role"] = self.role
+        # The drain flag rides the heartbeat so even a RESTARTED
+        # manager learns in-progress drains without asking.
+        payload["draining"] = bool(self._draining)
         if self._weight_shard is not None:
             # (rank, degree): the manager plans per-shard fanout groups
             # from this.
@@ -277,6 +296,9 @@ class GenerationServer(Worker):
         app.router.add_get("/kv/manifest", self._h_kv_manifest)
         app.router.add_get("/kv/chunk", self._h_kv_chunk)
         app.router.add_get("/kv/index", self._h_kv_index)
+        app.router.add_post("/kv/accept", self._h_kv_accept)
+        app.router.add_post("/drain", self._h_drain)
+        app.router.add_get("/drain", self._h_drain_status)
         app.router.add_post("/set_role", self._h_set_role)
         app.router.add_post("/configure", self._h_configure)
         app.router.add_post("/update_weights_from_disk", self._h_update_weights)
@@ -301,6 +323,11 @@ class GenerationServer(Worker):
         when /generate must shed, None when the request may queue. Reads
         only host counters the engine maintains — no device sync."""
         cfg = self.cfg
+        if self._draining:
+            # Quiesce: the manager stopped routing here; stragglers
+            # (in-flight schedule decisions, stale affinity) get the
+            # normal shed treatment and retry elsewhere.
+            return cfg.shed_retry_after_s
         depth_wm = cfg.max_queue_depth
         token_wm = cfg.max_queued_tokens
         if depth_wm is None and token_wm is None:
@@ -982,6 +1009,285 @@ class GenerationServer(Worker):
             )
         return self._serve_ranged(ent[1], request)
 
+    # ------------------------------------------------------------------
+    # Drain-then-leave + KV migration (docs/fault_tolerance.md
+    # "Fleet elasticity + manager HA")
+    # ------------------------------------------------------------------
+
+    async def _h_drain(self, request: web.Request) -> web.Response:
+        """Drain-then-leave, server side: quiesce admission NOW (every
+        new /generate sheds 429), let in-flight work finish, migrate
+        parked prefixes to the given peers over the /kv wire, then
+        deregister and exit with a graceful heartbeat-stop marker the
+        manager folds into a clean removal. Returns immediately; GET
+        /drain reports progress."""
+        await faults.maybe_fail_async("gserver.drain")
+        d = await request.json()
+        if self._draining:
+            return web.json_response(
+                {"success": True, "already": True, **self._drain_state}
+            )
+        self._draining = True
+        migrate = [
+            u for u in (d.get("migrate_to") or [])
+            if u and u != self.address
+        ]
+        self._drain_state.update(
+            draining=True, reason=str(d.get("reason") or "")
+        )
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            # Advertise the drain through the heartbeat (name_resolve
+            # file I/O: executor, never the event loop).
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: hb.update_payload(draining=True)
+            )
+        # Keep a strong reference: the loop holds tasks weakly, and a
+        # GC'd drain task would leave the server shedding 429 forever
+        # without ever migrating or exiting.
+        self._drain_task_handle = asyncio.get_running_loop().create_task(
+            self._drain_task(migrate, bool(d.get("exit", True)))
+        )
+        tracing.event(
+            "server.drain", ctx=tracing.extract_from(d),
+            n_targets=len(migrate), reason=str(d.get("reason") or ""),
+        )
+        logger.info(
+            f"drain started ({d.get('reason')!r}): migrating KV to "
+            f"{len(migrate)} peer(s), {self.engine.n_running} in flight"
+        )
+        return web.json_response({"success": True, "draining": True})
+
+    async def _h_drain_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "address": self.address, **self._drain_state,
+            "n_running": self.engine.n_running,
+            "queue_depth": self.engine.queue_depth,
+        })
+
+    async def _drain_task(self, migrate_to, exit_after: bool):
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        # Function-scope counters: the failure path below must report
+        # honest numbers (whatever was NOT migrated when the task died
+        # is lost with the process — never a clean 0/0 departure).
+        held: Dict[str, int] = {}
+        migrated = lost = stale = 0
+        # Lower bound for the failure path: if the authoritative
+        # loop-door enumeration below never completes (wedged engine),
+        # the snapshot count keeps the loss report honest instead of a
+        # clean 0/0 departure. Not used for migration itself — the
+        # snapshot can contain already-consumed parks.
+        snap_count = len(self.engine.parked_index())
+        try:
+            # 1) Quiesce: admission already sheds; wait out in-flight
+            #    requests (bounded — a wedged slot must not block the
+            #    departure forever).
+            deadline = t0 + self.cfg.drain_wait_s
+            while time.monotonic() < deadline:
+                if (
+                    self.engine.n_running == 0
+                    and self.engine.queue_depth == 0
+                ):
+                    break
+                await asyncio.sleep(0.1)
+            # 2) Migrate parked prefixes (HBM parks + tier entries)
+            #    over the hash-verified /kv wire: peers pull chunks
+            #    from our /kv/chunk and park them in THEIR tier, so
+            #    returning sessions restore there instead of paying a
+            #    full re-prefill. Version-stale entries are dropped
+            #    (unrestorable under the current weights — not a loss).
+            # Authoritative loop-door read, NOT the ~0.2s-stale
+            # snapshot: a prefix parked moments before the drain must
+            # not be silently left behind (parked entries carry the
+            # live engine version).
+            parked = await loop.run_in_executor(
+                None, self.engine.parked_qids_now
+            )
+            for qid in parked:
+                held[qid] = int(self.engine.version)
+            if self.engine.kv_tier is not None:
+                for e in await loop.run_in_executor(
+                    None, self.engine.kv_tier.held
+                ):
+                    held.setdefault(e["qid"], int(e.get("version", -1)))
+            self._drain_state["held"] = len(held)
+            sess = (
+                await self._handoff_sess() if migrate_to and held else None
+            )
+            for i, (qid, ver) in enumerate(sorted(held.items())):
+                if ver >= 0 and ver != self.engine.version:
+                    stale += 1
+                    continue
+                ok = False
+                peer_409 = False
+                if sess is not None:
+                    try:
+                        # stage_peer_export blocks on the engine loop
+                        # door for HBM parks: executor.
+                        meta = await loop.run_in_executor(
+                            None, self.engine.stage_peer_export, qid
+                        )
+                    except Exception:
+                        logger.warning(
+                            f"drain: staging {qid!r} failed",
+                            exc_info=True,
+                        )
+                        meta = None
+                    # Rotate through EVERY peer starting at this
+                    # prefix's round-robin home: one tierless or
+                    # blipping peer must not turn its share of the
+                    # prefixes into losses the others would accept.
+                    k = i % len(migrate_to)
+                    targets = migrate_to[k:] + migrate_to[:k]
+                    for target in targets if meta is not None else []:
+                        try:
+                            async with sess.post(
+                                f"{target}/kv/accept",
+                                json={"qid": qid, "meta": meta,
+                                      "source": self.address},
+                            ) as r:
+                                body = await r.json()
+                                ok = r.status == 200 and bool(
+                                    body.get("success")
+                                )
+                                peer_409 = r.status == 409
+                        except Exception:
+                            logger.warning(
+                                f"drain: migrating {qid!r} to "
+                                f"{target} failed", exc_info=True,
+                            )
+                        if ok or peer_409:
+                            # 409 = version skew; every peer sits at
+                            # the same fleet version — no point asking
+                            # the rest.
+                            break
+                if ok:
+                    migrated += 1
+                elif peer_409:
+                    # The PEER rejected on version skew: the fleet cut
+                    # over to a new version while we drained (draining
+                    # servers are excluded from fanouts, so OUR engine
+                    # version froze and the local check above cannot
+                    # see it). The prefix is unrestorable under the
+                    # fleet's current weights — stale, not lost.
+                    stale += 1
+                else:
+                    lost += 1
+            self._drain_state.update(
+                migrated=migrated, lost=lost, stale_dropped=stale,
+                drain_ms=(time.monotonic() - t0) * 1000.0, done=True,
+            )
+            # 3) Deregister the per-index discovery record (the
+            #    heartbeat-stop in the worker exit path is the
+            #    authoritative departed marker); carry the drain
+            #    results on that final record for the manager's log.
+            def _dereg():
+                try:
+                    name_resolve.delete(names.gen_server_url(
+                        self.cfg.experiment_name, self.cfg.trial_name,
+                        str(self.cfg.server_index),
+                    ))
+                except Exception:
+                    pass
+
+            await loop.run_in_executor(None, _dereg)
+            hb = getattr(self, "_heartbeat", None)
+            if hb is not None:
+                await loop.run_in_executor(
+                    None,
+                    lambda: hb.update_payload(
+                        drain_migrated=migrated, drain_lost=lost
+                    ),
+                )
+            logger.info(
+                f"drain complete in "
+                f"{self._drain_state['drain_ms']:.0f}ms: migrated "
+                f"{migrated}, lost {lost}, stale {stale} of "
+                f"{len(held)} held prefix(es)"
+            )
+        except Exception:
+            # Honest accounting: everything held and not yet migrated
+            # (or proven stale) dies with this process — report it as
+            # lost on the final heartbeat instead of a clean 0/0. The
+            # snapshot lower bound covers failures BEFORE the
+            # authoritative enumeration populated `held`.
+            lost = max(
+                lost,
+                len(held) - migrated - stale,
+                snap_count - migrated - stale,
+            )
+            self._drain_state.update(
+                migrated=migrated, lost=lost, stale_dropped=stale,
+                done=True, failed=True,
+                drain_ms=(time.monotonic() - t0) * 1000.0,
+            )
+            hb = getattr(self, "_heartbeat", None)
+            if hb is not None:
+                try:
+                    await loop.run_in_executor(
+                        None,
+                        lambda: hb.update_payload(
+                            drain_migrated=migrated, drain_lost=lost
+                        ),
+                    )
+                except Exception:
+                    pass
+            logger.exception("drain task failed")
+        finally:
+            if exit_after:
+                # Poll loop exits; Worker.run()'s finally stops the
+                # heartbeat with the graceful marker and runs
+                # _exit_hook.
+                self.exit()
+
+    async def _h_kv_accept(self, request: web.Request) -> web.Response:
+        """Drain-migration ingest: pull a departing peer's prefix blob
+        over the hash-verified /kv/chunk wire and park it in the LOCAL
+        tier (no HBM import — the session may return to any server;
+        the entry is advertised via /kv/index, so the manager's global
+        prefix index re-routes returning sessions here)."""
+        await faults.maybe_fail_async("gserver.kv_accept")
+        d = await request.json()
+        qid = str(d.get("qid") or "")
+        meta = d.get("meta") or {}
+        source = str(d.get("source") or "")
+        if self.engine.kv_tier is None:
+            return web.json_response(
+                {"success": False, "error": "no kv tier"}, status=503
+            )
+        if not qid or not source or not meta:
+            return web.json_response(
+                {"success": False, "error": "qid/meta/source required"},
+                status=400,
+            )
+        if int(meta.get("version", -1)) != self.engine.version:
+            return web.json_response(
+                {"success": False,
+                 "error": f"version {meta.get('version')} != "
+                          f"{self.engine.version}"},
+                status=409,
+            )
+        try:
+            payload = await self._fetch_handoff_payload(
+                source, qid, meta, path="/kv/chunk"
+            )
+        except Exception as e:
+            return web.json_response(
+                {"success": False, "error": f"transfer failed: {e!r}"},
+                status=502,
+            )
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.kv_tier.put, qid, meta, payload
+        )
+        self._kv_accepted += 1
+        self._kv_accept_bytes += len(payload)
+        tracing.event(
+            "server.kv_accept", ctx=tracing.extract_from(d),
+            qid=qid, source=source, bytes=len(payload),
+        )
+        return web.json_response({"success": True, "bytes": len(payload)})
+
     async def _h_set_role(self, request: web.Request) -> web.Response:
         """Elastic re-role (manager sizer): flip the live pool role.
         Drain + flip — in-flight requests finish under the old behavior
@@ -1513,6 +1819,15 @@ class GenerationServer(Worker):
             f"areal:kv_tier_peer_hits {float(self._kv_peer_hits)}",
             f"areal:kv_tier_peer_bytes {float(self._kv_peer_bytes)}",
             f"areal:kv_tier_peer_failed {float(self._kv_peer_failed)}",
+            # Elastic fleet: drain state + KV migration counters
+            # (docs/fault_tolerance.md). kv_drain_lost is the drain
+            # analogue of kv_prefix_lost_total — the e2e pins it to 0.
+            f"areal:draining {1.0 if self._draining else 0.0}",
+            f"areal:kv_migrated_out "
+            f"{float(self._drain_state.get('migrated', 0))}",
+            f"areal:kv_drain_lost {float(self._drain_state.get('lost', 0))}",
+            f"areal:kv_accepted {float(self._kv_accepted)}",
+            f"areal:kv_accept_bytes {float(self._kv_accept_bytes)}",
             f"areal:last_kv_restore_ms {self._last_kv_restore_ms}",
             f"areal:kv_manifests_served {float(self._kv_manifests_served)}",
             f"areal:kv_chunks_served {float(self._kv_chunks_served)}",

@@ -132,6 +132,18 @@ class PartialRolloutManager:
         n_shed = 0
         consec_shed = 0
         shed_budget = max(32, self.max_retries * 8)
+        # Manager-unreachable is a CONTROL-PLANE condition with its own
+        # (generous) budget: a manager restart/failover costs seconds
+        # and every sample sees it at once — burning the per-sample
+        # server-failure budget on it turned one manager blip into
+        # fleet-wide aborted rollouts (and, through the failure
+        # reports, spurious eviction pressure). Rediscovery runs
+        # against the name_resolve key on every attempt, with jittered
+        # backoff so thousands of workers don't hammer the successor
+        # the instant it registers.
+        mgr_fails = 0
+        consec_mgr_fails = 0
+        mgr_budget = max(16, self.max_retries * 4)
         # Interruption-cost accounting: any submission carrying an
         # already-accumulated prefix makes the server (re-)prefill
         # prompt+prefix under (possibly new) weights; prefix caching may
@@ -170,17 +182,29 @@ class PartialRolloutManager:
             except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                 # The manager itself blipped (or was restarted at a new
                 # address): accumulated tokens must survive a control-
-                # plane failure too.
-                retries += 1
-                if retries > self.max_retries:
-                    raise
+                # plane failure too. Retryable REDISCOVERY, never part
+                # of the server-failure budget.
+                mgr_fails += 1
+                consec_mgr_fails += 1
+                if mgr_fails > mgr_budget:
+                    raise RuntimeError(
+                        f"{qid}: gserver manager unreachable after "
+                        f"{mgr_fails} attempts (last: {e!r})"
+                    ) from e
                 logger.warning(
                     f"{qid}: schedule_request failed ({e!r}); "
-                    f"retry {retries}/{self.max_retries}"
+                    f"rediscovering manager "
+                    f"({mgr_fails}/{mgr_budget})"
                 )
                 self._refresh_manager_addr()
-                await asyncio.sleep(self._backoff(retries))
+                delay = min(
+                    5.0,
+                    self.retry_backoff_s
+                    * (2 ** min(consec_mgr_fails - 1, 6)),
+                )
+                await asyncio.sleep(delay * (0.5 + random.random()))
                 continue
+            consec_mgr_fails = 0
             failed_url = None
             shed_url, shed_ra_hint = None, 0.0
             if "url" not in sched:

@@ -84,6 +84,22 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "kv_prefix_lost",
         "int8_spill_bytes_ratio",
     ),
+    # Elastic-fleet evidence is only evidence when nothing was lost
+    # along the way: a record with ANY failed rollout, a "peer" join
+    # that actually read origin bytes, or drained prefixes that did not
+    # migrate is a broken control plane with good-looking timings.
+    "fleet_elastic": (
+        "join_peer_ms",
+        "join_origin_ms",
+        "join_peer_origin_bytes",
+        "killover_recovery_ms",
+        "killover_epoch",
+        "failed_rollouts",
+        "drain_migrated",
+        "drain_lost",
+        "kv_prefix_lost",
+        "n_servers_max",
+    ),
     # The disaggregation A/B is only evidence as a PAIR: a record
     # carrying one arm's tail latency without the other cannot show the
     # interference delta the phase exists to measure.
@@ -367,6 +383,61 @@ def _validate_sessions_resident(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_fleet_elastic(val: Dict) -> List[str]:
+    """The elastic control plane's contract: joins bootstrap from
+    peers (the 'peer' arm must read ZERO origin bytes — a fallback to
+    origin broadcast is the regression the phase exists to catch), the
+    manager killover costs zero rollouts, and a drain migrates every
+    live prefix instead of losing it."""
+    problems: List[str] = []
+    failed = _num(val, "failed_rollouts")
+    if failed is None or failed > 0:
+        problems.append(
+            f"fleet_elastic: {failed} failed rollout(s) — the elastic "
+            f"control plane's contract is zero across join, killover, "
+            f"and drain"
+        )
+    if val.get("join_peer_source") != "peer":
+        problems.append(
+            f"fleet_elastic: peer-arm join source is "
+            f"{val.get('join_peer_source')!r}, not 'peer' — the join "
+            f"fell back to the origin broadcast"
+        )
+    if (_num(val, "join_peer_origin_bytes") or 0) > 0:
+        problems.append(
+            "fleet_elastic: the 'peer' join read bytes from the origin "
+            "— origin egress is no longer O(1) under joins"
+        )
+    if (_num(val, "join_peer_peer_bytes") or 0) <= 0:
+        problems.append(
+            "fleet_elastic: the peer join transferred zero peer bytes "
+            "— the bootstrap path never engaged"
+        )
+    for k in ("drain_lost", "kv_prefix_lost"):
+        v = _num(val, k)
+        if v is None or v > 0:
+            problems.append(
+                f"fleet_elastic: {k} = {v} — drained prefixes must "
+                f"migrate, never be lost"
+            )
+    if (_num(val, "drain_migrated") or 0) < 1:
+        problems.append(
+            "fleet_elastic: zero migrated prefixes — the drain path "
+            "never exercised the KV wire"
+        )
+    if (_num(val, "killover_epoch") or 0) < 2:
+        problems.append(
+            "fleet_elastic: killover epoch < 2 — no successor manager "
+            "ever took the lease"
+        )
+    if (_num(val, "n_servers_max") or 0) < 3:
+        problems.append(
+            "fleet_elastic: fleet never grew past its launch size — "
+            "no runtime join was measured"
+        )
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -403,6 +474,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_openloop_sweep(val))
     if name == "sessions_resident":
         problems.extend(_validate_sessions_resident(val))
+    if name == "fleet_elastic":
+        problems.extend(_validate_fleet_elastic(val))
     if name == "serving_disagg":
         failed = val.get("disagg_failed")
         if isinstance(failed, (int, float)) and failed > 0:

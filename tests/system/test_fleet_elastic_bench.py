@@ -1,0 +1,125 @@
+"""ISSUE 12 acceptance (bench leg): the `fleet_elastic` phase banks an
+attested CPU-proxy record for the elastic control plane — runtime join
+peer-vs-origin A/B (join-to-first-routed-token + origin bytes), manager
+SIGKILL + lease-takeover recovery, drain-then-leave KV migration —
+under sustained PartialRolloutManager load, and `validate_bench.py`
+refuses records with ANY failed rollout, a 'peer' join that actually
+read origin bytes, or drained prefixes that were lost instead of
+migrated.
+
+Time budget (slow lane): ~300 s — one real-process fleet lives through
+six server spawns and two manager incarnations. Tier-1 keeps the
+validator-teeth test (milliseconds) plus the join/drain e2e and the
+fleet_controller units.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_record():
+    """A well-formed fleet_elastic value (what a healthy run banks)."""
+    return {
+        "n_servers_start": 2.0,
+        "n_servers_max": 4.0,
+        "n_servers_end": 3.0,
+        "join_peer_ms": 12000.0,
+        "join_peer_bootstrap_ms": 300.0,
+        "join_peer_source": "peer",
+        "join_peer_origin_bytes": 0.0,
+        "join_peer_peer_bytes": 427264.0,
+        "join_origin_ms": 14000.0,
+        "join_origin_source": "origin",
+        "join_origin_bytes": 427264.0,
+        "killover_recovery_ms": 9000.0,
+        "killover_epoch": 2.0,
+        "failed_rollouts": 0.0,
+        "completed_rollouts": 12.0,
+        "drain_held": 3.0,
+        "drain_migrated": 3.0,
+        "drain_lost": 0.0,
+        "drain_resumed_sessions": 3.0,
+        "kv_accepted": 3.0,
+        "kv_prefix_lost": 0.0,
+    }
+
+
+def test_validator_teeth_for_fleet_elastic():
+    """Tier-1 guard: the schema refuses records that could launder a
+    broken control plane into elasticity evidence."""
+    validator = _load_validator()
+    rec = {"status": "ok", "pass": "measure", "value": _fake_record()}
+    assert validator.validate_phase_value("fleet_elastic", rec) == []
+
+    def probs(**edits):
+        bad = json.loads(json.dumps(rec))
+        bad["value"].update(edits)
+        for k, v in list(edits.items()):
+            if v is None:
+                del bad["value"][k]
+        return validator.validate_phase_value("fleet_elastic", bad)
+
+    # ANY failed rollout poisons the record.
+    assert any("failed rollout" in p for p in probs(failed_rollouts=1.0))
+    assert any("failed rollout" in p for p in probs(failed_rollouts=None))
+    # A 'peer' join that fell back to the origin broadcast.
+    assert any("origin" in p for p in probs(join_peer_source="origin"))
+    assert any(
+        "origin" in p for p in probs(join_peer_origin_bytes=1024.0)
+    )
+    assert any(
+        "never engaged" in p for p in probs(join_peer_peer_bytes=0.0)
+    )
+    # Drained prefixes must migrate, never be lost.
+    assert any("lost" in p for p in probs(drain_lost=1.0))
+    assert any("lost" in p for p in probs(kv_prefix_lost=2.0))
+    assert any("KV wire" in p for p in probs(drain_migrated=0.0))
+    # Killover evidence requires a real lease takeover and a join.
+    assert any("lease" in p for p in probs(killover_epoch=1.0))
+    assert any("grew" in p for p in probs(n_servers_max=2.0))
+    # Missing required numerics.
+    assert any("killover_recovery_ms" in p
+               for p in probs(killover_recovery_ms=None))
+
+
+@pytest.mark.slow  # ~300 s: one fleet, six server spawns, two manager
+# incarnations; tier-1 keeps the validator teeth + e2e + units.
+@pytest.mark.timeout(1200)
+def test_fleet_elastic_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import fleet_elastic_phase
+
+    val = fleet_elastic_phase("measure")
+    path = bank.write_record(
+        bank.make_record("fleet_elastic", "measure", "ok", value=val), b
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("fleet_elastic", rec) == []
+    assert validator.validate_bank_dir(b) == []

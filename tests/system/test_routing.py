@@ -41,8 +41,15 @@ def _manager(policy="round_robin", **cfg_kw):
     m._server_free_pages = {}
     m._server_total_pages = {}
     m._server_elastic = {}
+    m._server_shards = {}
     m._rerole_orig = {}
     m._rerole_log = []
+    # Elastic fleet control plane (ISSUE 12): no drains/joins in these
+    # units — routing just filters on the empty sets.
+    m._draining = set()
+    m._drain_deadline = {}
+    m._join_t0 = {}
+    m._join_info = {}
     m.weight_version = 0
     return m
 
