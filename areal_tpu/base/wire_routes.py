@@ -1,0 +1,175 @@
+"""Single registry of every HTTP route on the fleet's wire.
+
+The fleet is real processes talking over ~25 hand-paired aiohttp
+routes: generation servers, the gserver manager, the weight plane,
+plus the bench harness and tests as clients. Until this registry the
+pairing was string-matched and unchecked — a renamed path turned a
+client into a connection-refused loop (PR 5's version-stamp skew and
+PR 7's per-server weight divergence were both cross-process contract
+bugs found the hard way).
+
+Every route is declared ONCE here (method, path, serving modules,
+deliberate non-200 statuses, doc); the ``wire-contract`` checker in
+``areal_tpu/lint`` flags:
+
+- ``app.router.add_*`` registrations for undeclared (method, path);
+- client references (f-string URL suffixes, ``url + "/path"`` concats,
+  ``_post(url, "/path")`` helpers, ``path=`` kwargs) to paths no route
+  declares, or with the wrong method;
+- client-handled status codes no referenced route declares, and
+  declared statuses no server module emits (both directions of the
+  deliberate-codes contract: shed-429, drain-409, tier-404...);
+- declared routes nothing registers, and non-``operator`` routes no
+  client calls (dead wire surface).
+
+``statuses`` lists the DELIBERATE non-2xx codes of the route's
+contract; 200/206 plus the generic 500-on-exception are implicit
+everywhere and not declared. ``operator=True`` marks surfaces exposed
+for humans or external probes (k8s, curl) that legitimately have no
+in-repo client — the dead-route check skips them, nothing else does.
+
+This module must stay stdlib-only: it is imported by the no-jax lint
+gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+GS = "areal_tpu/system/generation_server.py"
+WP = "areal_tpu/system/weight_plane.py"
+MGR = "areal_tpu/system/gserver_manager.py"
+
+
+@dataclasses.dataclass(frozen=True)
+class Route:
+    method: str  # "GET" | "POST"
+    path: str  # exact path, no query string
+    servers: Tuple[str, ...]  # repo-rel modules that register it
+    doc: str
+    statuses: Tuple[int, ...] = ()  # deliberate non-2xx codes
+    operator: bool = False  # human/probe surface; no in-repo client
+
+
+def _r(method: str, path: str, servers: Tuple[str, ...], doc: str, *,
+       statuses: Tuple[int, ...] = (), operator: bool = False) -> Route:
+    return Route(method=method, path=path, servers=servers, doc=doc,
+                 statuses=statuses, operator=operator)
+
+
+_ROUTES: List[Route] = [
+    # -- generation server: serving --------------------------------------
+    _r("POST", "/generate", (GS,),
+       "One (possibly chunked) generation; sheds 429 + Retry-After at "
+       "the admission watermark — deliberate backpressure clients "
+       "retry elsewhere, never a failure.",
+       statuses=(429,)),
+    _r("GET", "/metrics", (GS,),
+       "The areal:* text surface (base/metrics_registry.py); polled "
+       "by the manager, the fleet controller rebuild, and the bench."),
+    _r("GET", "/health", (GS,),
+       "Liveness probe for external supervisors (k8s/LB); in-repo "
+       "liveness rides the name_resolve heartbeat registry instead.",
+       operator=True),
+    _r("POST", "/configure", (GS,),
+       "Live re-configuration (admission watermarks, bench knobs)."),
+    # -- generation server: disagg KV handoff wire -----------------------
+    _r("POST", "/kv_handoff", (GS,),
+       "Prefill->decode handoff offer: decode side pulls the blob and "
+       "continues the generation; 502 when the transfer dies "
+       "mid-pull.",
+       statuses=(502,)),
+    _r("GET", "/kv_handoff/blob", (GS,),
+       "Ranged, hash-verified handoff blob chunks.",
+       statuses=(404, 416)),
+    # -- generation server: tiered KV plane ------------------------------
+    _r("GET", "/kv/manifest", (GS,),
+       "Tiered-prefix manifest for a qid (peer restore step 1); 404 "
+       "when not held, 503 when the tier is off.",
+       statuses=(404, 503)),
+    _r("GET", "/kv/chunk", (GS,),
+       "Ranged tiered-prefix chunk (peer restore step 2).",
+       statuses=(404, 416)),
+    _r("GET", "/kv/index", (GS,),
+       "Held-prefix advertisement feeding the manager's global prefix "
+       "index."),
+    _r("POST", "/kv/accept", (GS,),
+       "Drain migration target: accept a parked prefix from a "
+       "draining peer. 409 = already holding a newer version, 502 = "
+       "pull from the drainer failed, 503 = no tier here.",
+       statuses=(400, 409, 502, 503)),
+    # -- generation server: elastic fleet --------------------------------
+    _r("POST", "/drain", (GS,),
+       "Drain-then-leave: quiesce admission now, migrate parked "
+       "prefixes, exit with a graceful heartbeat marker."),
+    _r("GET", "/drain", (GS,),
+       "Drain progress for operators watching a departure; the "
+       "manager tracks progress via heartbeats + /metrics instead.",
+       operator=True),
+    _r("POST", "/set_role", (GS,),
+       "Elastic re-role (prefill/decode/unified) from the manager's "
+       "watermark sizer.",
+       statuses=(400,)),
+    # -- generation server: weights --------------------------------------
+    _r("POST", "/update_weights_from_disk", (GS,),
+       "Load a weight version from the shared dump; 409 = stale "
+       "version ordering (a newer version already landed).",
+       statuses=(400, 409)),
+    _r("POST", "/distribute_weights", (GS,),
+       "Weight-plane fanout trigger: fetch my chunk stream, serve "
+       "peers. 409 = a transfer for another version is in flight.",
+       statuses=(409,)),
+    _r("POST", "/cutover_weights", (GS,),
+       "Swap the staged version in (the bounded interrupt window); "
+       "409 = nothing staged / wrong version.",
+       statuses=(409,)),
+    _r("GET", "/weights/manifest", (GS, WP),
+       "Chunk-stream manifest for (version, wire, shard); served by "
+       "the origin plane and re-served by peers.",
+       statuses=(400, 404)),
+    _r("GET", "/weights/chunk", (GS, WP),
+       "Ranged, hash-verified weight chunk; 404 covers the bin-"
+       "vanished GC race clients retry through.",
+       statuses=(400, 404, 416)),
+    _r("GET", "/weights/stats", (WP,),
+       "Origin egress counters for operators attesting peer-fanout "
+       "claims (in-repo attestation reads the store in-process).",
+       operator=True),
+    # -- gserver manager -------------------------------------------------
+    _r("POST", "/schedule_request", (MGR,),
+       "Route one rollout request: returns the target server URL (or "
+       "503 + retry_after while no server is routable).",
+       statuses=(503,)),
+    _r("POST", "/allocate_rollout", (MGR,),
+       "Claim a rollout slot against the staleness window."),
+    _r("POST", "/finish_rollout", (MGR,),
+       "Release a rollout slot (accepted or dropped)."),
+    _r("POST", "/drain_server", (MGR,),
+       "Drain-then-leave orchestration: pick migration targets, POST "
+       "/drain to the server, track the departure. 409 = already "
+       "draining.",
+       statuses=(409,)),
+    _r("GET", "/status", (MGR,),
+       "Manager view: healthy/evicted servers, pools, shards, fleet "
+       "epoch, drain/join logs. The HA successor parity check and "
+       "every bench wait loop read it."),
+]
+
+REGISTRY: Dict[Tuple[str, str], Route] = {
+    (r.method, r.path): r for r in _ROUTES
+}
+assert len(REGISTRY) == len(_ROUTES), "duplicate route declaration"
+
+# Paths -> methods, for client refs where the HTTP verb is not
+# syntactically recoverable (urlopen(url + "/status")).
+PATHS: Dict[str, Tuple[str, ...]] = {}
+for _route in _ROUTES:
+    PATHS[_route.path] = tuple(
+        sorted(set(PATHS.get(_route.path, ())) | {_route.method})
+    )
+del _route
+
+# Statuses every route may emit without declaring: success, ranged
+# success, and the generic unhandled-exception 500.
+IMPLICIT_STATUSES = (200, 206, 500)

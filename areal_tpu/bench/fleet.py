@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.bench._util import log, repo_root
 
 _CHILD = '''
@@ -311,8 +312,8 @@ class ProcessFleet:
         ttft, itl = [], []
         for u in urls:
             m = self.metrics(u)
-            ttft.append(decode_counts(str(m.get("areal:ttft_hist") or "")))
-            itl.append(decode_counts(str(m.get("areal:itl_hist") or "")))
+            ttft.append(decode_counts(str(m.get(mreg.TTFT_HIST) or "")))
+            itl.append(decode_counts(str(m.get(mreg.ITL_HIST) or "")))
         return {"ttft": merge_counts(ttft), "itl": merge_counts(itl)}
 
     def configure_servers(self, payload: Dict, urls: Optional[List[str]] = None):

@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from areal_tpu.base import env_registry
+from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.bench._util import log, repo_root
 from areal_tpu.bench.devices import get_devices_with_retry
 
@@ -191,8 +192,8 @@ def train_phase(pass_: str) -> dict:
     perf = stats_tracker.export(key="perf")
     overlap = {
         k[len("perf/"):]: float(v) for k, v in perf.items()
-        if k in ("perf/packing_efficiency", "perf/h2d_wait_ms",
-                 "perf/dispatch_gap_ms")
+        if k in (mreg.PERF_PACKING_EFFICIENCY, mreg.PERF_H2D_WAIT_MS,
+                 mreg.PERF_DISPATCH_GAP_MS)
     }
     log(f"bench: overlap telemetry {overlap}")
     return {
@@ -755,11 +756,11 @@ def serving_disagg_phase(pass_: str) -> dict:
         ttft_urls_idx=[0], itl_urls_idx=[1],
         roles=["prefill", "decode"],
     )
-    m_pre = next(m for m in m_dis.values() if m.get("areal:role") == "prefill")
-    m_dec = next(m for m in m_dis.values() if m.get("areal:role") == "decode")
-    handoffs = m_dec.get("areal:kv_import_total", 0.0)
-    handoff_bytes = m_dec.get("areal:kv_import_bytes", 0.0)
-    fallbacks = m_pre.get("areal:kv_handoff_fallback", 0.0)
+    m_pre = next(m for m in m_dis.values() if m.get(mreg.ROLE) == "prefill")
+    m_dec = next(m for m in m_dis.values() if m.get(mreg.ROLE) == "decode")
+    handoffs = m_dec.get(mreg.KV_IMPORT_TOTAL, 0.0)
+    handoff_bytes = m_dec.get(mreg.KV_IMPORT_BYTES, 0.0)
+    fallbacks = m_pre.get(mreg.KV_HANDOFF_FALLBACK, 0.0)
 
     log(f"bench: serving_disagg A/B: unified itl p99 "
         f"{uni['itl_p99_ms']:.1f}ms ttft p99 {uni['p99_ttft_ms']:.1f}ms | "
@@ -860,7 +861,7 @@ def _sres_point(fleet, n_resident: int, tag: str) -> dict:
     last = [-1.0]
 
     def settled():
-        cur = m_sum("areal:kv_spill_total")
+        cur = m_sum(mreg.KV_SPILL_TOTAL)
         ok = cur == last[0]
         last[0] = cur
         return ok
@@ -868,10 +869,10 @@ def _sres_point(fleet, n_resident: int, tag: str) -> dict:
     time.sleep(0.3)
     _sres_wait(settled, 30.0, "spills to settle")
 
-    base_hits = m_sum("areal:prefix_cache_hits")
-    base_rest_h = m_sum("areal:kv_restore_host")
-    base_rest_d = m_sum("areal:kv_restore_disk")
-    base_peer = m_sum("areal:kv_tier_peer_hits")
+    base_hits = m_sum(mreg.PREFIX_CACHE_HITS)
+    base_rest_h = m_sum(mreg.KV_RESTORE_HOST)
+    base_rest_d = m_sum(mreg.KV_RESTORE_DISK)
+    base_peer = m_sum(mreg.KV_TIER_PEER_HITS)
     base_t = fleet.hist_counts(fleet.urls)["ttft"]
     for i in range(n_resident):
         qid = f"{tag}{i}"
@@ -880,10 +881,10 @@ def _sres_point(fleet, n_resident: int, tag: str) -> dict:
         assert "output_ids" in out, out
     after_t = fleet.hist_counts(fleet.urls)["ttft"]
     dt = [max(0, a - b) for a, b in zip(after_t, base_t)]
-    hits = m_sum("areal:prefix_cache_hits") - base_hits
-    rest_h = m_sum("areal:kv_restore_host") - base_rest_h
-    rest_d = m_sum("areal:kv_restore_disk") - base_rest_d
-    peer = m_sum("areal:kv_tier_peer_hits") - base_peer
+    hits = m_sum(mreg.PREFIX_CACHE_HITS) - base_hits
+    rest_h = m_sum(mreg.KV_RESTORE_HOST) - base_rest_h
+    rest_d = m_sum(mreg.KV_RESTORE_DISK) - base_rest_d
+    peer = m_sum(mreg.KV_TIER_PEER_HITS) - base_peer
     # Every restore (host/disk/peer) re-parks the prefix and is then
     # consumed as an admission hit; HBM-only hits are the remainder.
     hbm = max(0.0, hits - rest_h - rest_d - peer)
@@ -936,10 +937,10 @@ def sessions_resident_phase(pass_: str) -> dict:
         for n in sweep_ns:
             sweep.append(_sres_point(fleet, n, f"t{n}-"))
         m = fleet.metrics(fleet.urls[0])
-        tier_lost = m.get("areal:kv_prefix_lost_total", 0.0)
-        tier_spills = m.get("areal:kv_spill_total", 0.0)
-        f_bytes = m.get("areal:kv_spill_bytes", 0.0)
-        f_tokens = m.get("areal:kv_spill_tokens", 0.0)
+        tier_lost = m.get(mreg.KV_PREFIX_LOST_TOTAL, 0.0)
+        tier_spills = m.get(mreg.KV_SPILL_TOTAL, 0.0)
+        f_bytes = m.get(mreg.KV_SPILL_BYTES, 0.0)
+        f_tokens = m.get(mreg.KV_SPILL_TOKENS, 0.0)
     top = sweep[-1]
 
     # --- Baseline arm: tier DISABLED — evicted sessions pay the full
@@ -964,8 +965,8 @@ def sessions_resident_phase(pass_: str) -> dict:
     ) as fleet:
         _sres_point(fleet, 8, "q-")
         m = fleet.metrics(fleet.urls[0])
-        q_bytes = m.get("areal:kv_spill_bytes", 0.0)
-        q_tokens = m.get("areal:kv_spill_tokens", 0.0)
+        q_bytes = m.get(mreg.KV_SPILL_BYTES, 0.0)
+        q_tokens = m.get(mreg.KV_SPILL_TOKENS, 0.0)
     f_bpt = f_bytes / max(1.0, f_tokens)
     q_bpt = q_bytes / max(1.0, q_tokens)
 
@@ -1008,11 +1009,11 @@ def sessions_resident_phase(pass_: str) -> dict:
                                         timeout=300)
             assert "output_ids" in out, out
         peer_hits = sum(
-            fleet.metrics(u).get("areal:kv_tier_peer_hits", 0.0)
+            fleet.metrics(u).get(mreg.KV_TIER_PEER_HITS, 0.0)
             for u in fleet.urls
         )
         peer_lost = sum(
-            fleet.metrics(u).get("areal:kv_prefix_lost_total", 0.0)
+            fleet.metrics(u).get(mreg.KV_PREFIX_LOST_TOTAL, 0.0)
             for u in fleet.urls
         )
 
@@ -1150,8 +1151,8 @@ def prefetch_overlap_phase(pass_: str) -> dict:
     perf = stats_tracker.export(key="perf")
     out = {
         k[len("perf/"):]: float(v) for k, v in perf.items()
-        if k in ("perf/packing_efficiency", "perf/h2d_wait_ms",
-                 "perf/dispatch_gap_ms", "perf/overlap_events")
+        if k in (mreg.PERF_PACKING_EFFICIENCY, mreg.PERF_H2D_WAIT_MS,
+                 mreg.PERF_DISPATCH_GAP_MS, mreg.PERF_OVERLAP_EVENTS)
     }
     out["step_s"] = dt
     log(f"bench: prefetch_overlap {out}")
@@ -1983,9 +1984,9 @@ def _fleet_first_routed_token_ms(fleet, url: str, t0: float,
     """Route requests through the manager until one lands on `url`
     (its total_requests counter moves); returns ms since t0 — the
     join-to-first-routed-token clock."""
-    base = fleet.metrics(url).get("areal:total_requests", 0.0)
+    base = fleet.metrics(url).get(mreg.TOTAL_REQUESTS, 0.0)
     i = 0
-    while fleet.metrics(url).get("areal:total_requests", 0.0) <= base:
+    while fleet.metrics(url).get(mreg.TOTAL_REQUESTS, 0.0) <= base:
         rng = np.random.RandomState(7000 + i)
         fleet.generate_routed(
             f"{tag}{i}",
@@ -2169,8 +2170,8 @@ def fleet_elastic_phase(pass_: str) -> dict:
         for u in survivors:
             try:
                 m = fleet.metrics(u)
-                lost += m.get("areal:kv_prefix_lost_total", 0.0)
-                accepted += m.get("areal:kv_accepted", 0.0)
+                lost += m.get(mreg.KV_PREFIX_LOST_TOTAL, 0.0)
+                accepted += m.get(mreg.KV_ACCEPTED, 0.0)
             except Exception:
                 pass
         out = {

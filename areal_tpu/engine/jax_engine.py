@@ -884,6 +884,15 @@ class JaxTrainEngine(TrainEngine):
                 "perf/dispatch_gap_ms": ov["dispatch_gap_ms"],
             },
         )
+        # Regression note: the prefetch_overlap bench has parsed
+        # perf/overlap_events since it landed, but this method never
+        # shipped it — the engagement proof silently read as absent.
+        # Found by the metrics-registry lint checker (parsed-but-never-
+        # emitted); SUM so multi-step windows accumulate.
+        stats_tracker.scalar(
+            reduce_type=stats_tracker.ReduceType.SUM,
+            **{"perf/overlap_events": ov["overlap_events"]},
+        )
 
     def _fetch_train_stats(
         self, packed, aux, loss_name: str, global_denom: float, n_mbs: int,

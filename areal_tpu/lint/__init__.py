@@ -1,7 +1,7 @@
 """areal-lint: repo-specific AST static analysis (stdlib ``ast`` only).
 
-Four checkers over the contracts the system already relies on but no
-generic tool enforces:
+Eight checkers over the contracts the system already relies on but no
+generic tool enforces. Single-process (PR 10):
 
 - ``loop-only`` — engine-loop thread discipline (serving.py state that
   has no locks *by design* may only be touched from the loop call
@@ -14,9 +14,25 @@ generic tool enforces:
 - ``wire-schema`` — ``areal-*/vN`` schema strings come from
   ``areal_tpu.base.wire_schemas`` only.
 
-CLI: ``python scripts/areal_lint.py [paths...]``. Gate: a tier-1 test
-runs the linter over ``areal_tpu/`` and fails on any unallowlisted
-finding. See docs/static_analysis.md.
+Cross-process (PR 13), each backed by a declared registry so the
+contract is machine-readable:
+
+- ``wire-contract`` — every HTTP route, client path, and deliberate
+  status code pairs against ``areal_tpu.base.wire_routes``;
+- ``metrics-registry`` — every ``areal:*`` /metrics line and
+  ``perf/*`` stats scalar key is declared in
+  ``areal_tpu.base.metrics_registry``; parse sites use its constants;
+- ``chaos-registry`` — every fault-injection point and
+  ``AREAL_FAULTS`` spec names a point declared in
+  ``areal_tpu.base.fault_points``;
+- ``lock-order`` — sync-lock deadlock classes: await-under-lock,
+  loop-door-under-lock, AB/BA acquisition cycles.
+
+CLI: ``python scripts/areal_lint.py [paths...]``. Gate: tier-1 tests
+run the linter over ``areal_tpu/`` (all checkers + generated-docs
+drift) and over ``tests/``+``scripts/`` (the cross-process client
+side) and fail on any unallowlisted finding. See
+docs/static_analysis.md.
 
 This package must import neither jax nor anything that does: the gate
 asserts ``jax`` stays out of ``sys.modules``.

@@ -194,7 +194,7 @@ def _blocking_sync_callables(mod: Module):
 def check(mod: Module) -> List[Finding]:
     findings: List[Finding] = []
 
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         if not isinstance(node, ast.Call):
             continue
         enclosing = mod.enclosing_function(node)
@@ -243,12 +243,12 @@ def check(mod: Module) -> List[Finding]:
     # that (transitively) block, invoked synchronously from async code.
     module_fns, methods_by_class = _blocking_sync_callables(mod)
     class_of_fn = {}
-    for cls in ast.walk(mod.tree):
+    for cls in mod.nodes:
         if isinstance(cls, ast.ClassDef):
             for n in cls.body:
                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     class_of_fn[n] = cls.name
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         if not isinstance(node, ast.Call):
             continue
         enclosing = mod.enclosing_function(node)
@@ -283,7 +283,7 @@ def check(mod: Module) -> List[Finding]:
 
     # Residual hole: nested sync def containing blocking calls, invoked
     # DIRECTLY from async code in the same function.
-    for fn in ast.walk(mod.tree):
+    for fn in mod.nodes:
         if not isinstance(fn, ast.AsyncFunctionDef):
             continue
         nested_blocking: Set[str] = set()

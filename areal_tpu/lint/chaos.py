@@ -1,0 +1,291 @@
+"""Checker ``chaos-registry``: every named fault-injection point is
+declared in ``areal_tpu.base.fault_points`` and alive.
+
+Chaos tests arm injection points by BARE STRING — in-process
+(``faults.arm("gserver.drain", ...)``) or across process boundaries
+via ``AREAL_FAULTS`` env specs. A renamed point turns the chaos test
+into a silent no-op that still passes: the fault-tolerance suite
+keeps going green while testing nothing. Flags, per module:
+
+- ``maybe_fail``/``maybe_fail_async`` with an undeclared point name
+  (the production side of the contract) or a non-literal name;
+- ``faults.arm(...)`` / ``faults.hits(...)`` naming an unknown point
+  (the test side);
+- ``AREAL_FAULTS`` spec strings (setenv, env-dict literals,
+  ``env["AREAL_FAULTS"] = ...`` assignments, ``faults.load_env``)
+  whose ``<point>[@scope]=<action>`` entries name unknown points —
+  including the leading literal part of f-string specs;
+- dead registry entries no production ``maybe_fail`` site fires —
+  only when the scan covers the registry module itself.
+
+Points under ``fault_points.TEST_PREFIX`` (``test.``) are reserved
+for the injector's own unit suite and exempt everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "chaos-registry"
+
+REGISTRY_MODULE = "areal_tpu.base.fault_points"
+REGISTRY_REL = "areal_tpu/base/fault_points.py"
+
+_MAYBE_FAIL = ("maybe_fail", "maybe_fail_async")
+_TEST_SIDE = ("arm", "hits")
+# A spec entry's point token: starts a fragment, ends at @ or =.
+_SPEC_POINT_RE = re.compile(r"\A\s*([a-z][a-z0-9_.]*)[@=]")
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    declared: Set[str]
+    test_prefix: str = "test."
+    registry_rel: str = REGISTRY_REL
+    registry_module: str = REGISTRY_MODULE
+
+
+def default_config() -> ChaosConfig:
+    # Import is deliberate: it validates the declarations execute, and
+    # the module is stdlib-only so the no-jax gate is preserved.
+    from areal_tpu.base import fault_points
+
+    return ChaosConfig(
+        declared=set(fault_points.REGISTRY),
+        test_prefix=fault_points.TEST_PREFIX,
+    )
+
+
+def _point_finding(mod: Module, lineno: int, point: str,
+                   cfg: ChaosConfig, where: str) -> Finding:
+    return Finding(
+        mod.rel, lineno, CHECKER,
+        f"{where} names undeclared chaos point {point!r}: declare it "
+        f"in {cfg.registry_module} (a renamed point turns chaos tests "
+        f"into silent no-ops)",
+    )
+
+
+def _check_spec(mod: Module, lineno: int, node: ast.AST,
+                cfg: ChaosConfig, findings: List[Finding]):
+    """Validate every point token inside an AREAL_FAULTS spec
+    expression (plain string or f-string)."""
+    parts: List[Optional[str]] = []  # None marks an interpolation
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        parts = [node.value]
+    elif isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(None)
+    elif isinstance(node, ast.Name):
+        s = mod.resolve_str(node)
+        if s is None:
+            return
+        parts = [s]
+    else:
+        return
+
+    # Walk the parts; a point token is checkable only when it starts
+    # at the string head or right after a literal ';' — a token cut by
+    # an interpolation boundary is skipped, not guessed at.
+    at_entry_start = True
+    for part in parts:
+        if part is None:
+            at_entry_start = False
+            continue
+        fragments = part.split(";")
+        for i, frag in enumerate(fragments):
+            if i > 0:
+                at_entry_start = True
+            if not at_entry_start:
+                continue
+            if not frag.strip():
+                continue
+            m = _SPEC_POINT_RE.match(frag)
+            if m:
+                point = m.group(1)
+                if (
+                    point not in cfg.declared
+                    and not point.startswith(cfg.test_prefix)
+                ):
+                    findings.append(_point_finding(
+                        mod, lineno, point, cfg, "AREAL_FAULTS spec"
+                    ))
+            elif "=" not in frag and "@" not in frag:
+                # Fragment holds a bare (possibly cut) point head;
+                # the boundary lives in a later part — unverifiable.
+                at_entry_start = False
+
+
+def _fstring_test_point(node: ast.AST, cfg: ChaosConfig) -> bool:
+    """An interpolated point is acceptable only inside the reserved
+    test namespace (``f"test.fake{i}.generate"``)."""
+    return (
+        isinstance(node, ast.JoinedStr)
+        and node.values
+        and isinstance(node.values[0], ast.Constant)
+        and isinstance(node.values[0].value, str)
+        and node.values[0].value.startswith(cfg.test_prefix)
+    )
+
+
+def _receiver_is_faults(mod: Module, func: ast.Attribute) -> bool:
+    recv = func.value
+    if isinstance(recv, ast.Name):
+        name = mod.imports.get(recv.id, recv.id)
+        return name.endswith("faults")
+    if isinstance(recv, ast.Attribute):
+        return recv.attr == "faults"
+    return False
+
+
+def check(mod: Module, cfg: ChaosConfig,
+          uses: Dict[str, int]) -> List[Finding]:
+    """Per-module pass; records production ``maybe_fail`` uses into
+    ``uses`` for the cross-module dead-entry check."""
+    if mod.rel == cfg.registry_rel:
+        return []
+    findings: List[Finding] = []
+    is_injector = mod.rel.endswith("base/fault_injection.py")
+
+    for node in mod.nodes:
+        # -- env specs ---------------------------------------------------
+        if isinstance(node, ast.Call):
+            func = node.func
+            attr = func.attr if isinstance(func, ast.Attribute) else None
+            if attr == "setenv" and len(node.args) >= 2:
+                k = mod.resolve_str(node.args[0])
+                if k == "AREAL_FAULTS":
+                    _check_spec(mod, node.lineno, node.args[1], cfg,
+                                findings)
+            elif attr == "load_env" and node.args and isinstance(
+                func, ast.Attribute
+            ) and _receiver_is_faults(mod, func):
+                _check_spec(mod, node.lineno, node.args[0], cfg,
+                            findings)
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and k.value == "AREAL_FAULTS"
+                    and v is not None
+                ):
+                    _check_spec(mod, k.lineno, v, cfg, findings)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.slice, ast.Constant)
+                and t.slice.value == "AREAL_FAULTS"
+            ):
+                _check_spec(mod, node.lineno, node.value, cfg, findings)
+
+        # -- named point references --------------------------------------
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+        elif isinstance(node.func, ast.Name) and node.func.id in _MAYBE_FAIL:
+            # ``from ..fault_injection import maybe_fail`` then a bare
+            # call — same contract as the faults.maybe_fail spelling;
+            # the names are distinctive enough to match without a
+            # receiver. Regression note: CLI-drive find, PR 13.
+            attr = node.func.id
+        else:
+            continue
+        if attr in _MAYBE_FAIL:
+            if is_injector:
+                continue  # the injector defines these, it has no points
+            if not node.args:
+                continue
+            point = mod.resolve_str(node.args[0])
+            if point is None:
+                if _fstring_test_point(node.args[0], cfg):
+                    continue
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"{attr}() with a non-literal point name: the "
+                    f"chaos registry cannot verify it (points under "
+                    f"{cfg.test_prefix!r} may interpolate)",
+                ))
+                continue
+            if point.startswith(cfg.test_prefix):
+                continue
+            uses[point] = uses.get(point, 0) + 1
+            if point not in cfg.declared:
+                findings.append(_point_finding(
+                    mod, node.lineno, point, cfg, f"{attr}()"
+                ))
+        elif attr in _TEST_SIDE and _receiver_is_faults(mod, node.func):
+            if is_injector or not node.args:
+                continue
+            point = mod.resolve_str(node.args[0])
+            if point is None:
+                # Same contract as maybe_fail: a non-literal point the
+                # registry cannot verify is exactly how a renamed
+                # production point turns an armed chaos test into a
+                # silent no-op. Regression note: review find, PR 13.
+                if _fstring_test_point(node.args[0], cfg):
+                    continue
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"faults.{attr}() with a non-literal point name: "
+                    f"the chaos registry cannot verify it (points "
+                    f"under {cfg.test_prefix!r} may interpolate)",
+                ))
+                continue
+            if point.startswith(cfg.test_prefix):
+                continue
+            if point not in cfg.declared:
+                findings.append(_point_finding(
+                    mod, node.lineno, point, cfg, f"faults.{attr}()"
+                ))
+    return findings
+
+
+def check_dead(cfg: ChaosConfig, uses: Dict[str, int],
+               registry_lines: Dict[str, int]) -> List[Finding]:
+    """Registry entries with no production maybe_fail site."""
+    findings: List[Finding] = []
+    for name in sorted(cfg.declared):
+        if not uses.get(name):
+            findings.append(Finding(
+                cfg.registry_rel, registry_lines.get(name, 1), CHECKER,
+                f"dead chaos point {name}: no scanned maybe_fail site "
+                f"fires it — delete the FaultPoint or restore the "
+                f"injection site",
+            ))
+    return findings
+
+
+def registry_decl_lines(mod: Module) -> Dict[str, int]:
+    """Line of each ``_p("name", ...)`` / ``FaultPoint(name=...)``
+    call in the registry module."""
+    lines: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname not in ("_p", "FaultPoint"):
+            continue
+        name: Optional[str] = None
+        if node.args and isinstance(node.args[0], ast.Constant):
+            name = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+        if isinstance(name, str):
+            lines[name] = node.lineno
+    return lines

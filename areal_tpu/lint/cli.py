@@ -10,7 +10,7 @@ import sys
 from typing import List, Optional
 
 from areal_tpu.lint.common import LintConfigError
-from areal_tpu.lint.runner import LintConfig, run_lint
+from areal_tpu.lint.runner import ALL_CHECKERS, LintConfig, run_lint
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)
@@ -20,20 +20,32 @@ DEFAULT_ALLOWLIST = os.path.join(
 )
 
 
+def _docs_sources():
+    """name -> (render callable, emit flag) for every generated doc.
+    Imported lazily so ``--help`` costs nothing."""
+    from areal_tpu.base import env_registry, fault_points, metrics_registry
+
+    return {
+        "env": (env_registry.render_docs, "--emit-env-docs"),
+        "metrics": (metrics_registry.render_docs, "--emit-metrics-docs"),
+        "fault": (fault_points.render_docs, "--emit-fault-docs"),
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="areal_lint",
         description="repo-specific AST checks: loop-only, "
-                    "blocking-async, env-knob, wire-schema "
-                    "(docs/static_analysis.md)",
+                    "blocking-async, env-knob, wire-schema, "
+                    "wire-contract, metrics-registry, chaos-registry, "
+                    "lock-order (docs/static_analysis.md)",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to lint")
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
                     help="allowlist file (default: "
                          "areal_tpu/lint/allowlist.txt)")
     ap.add_argument("--checker", action="append", dest="checkers",
-                    choices=["loop-only", "blocking-async", "env-knob",
-                             "wire-schema"],
+                    choices=list(ALL_CHECKERS),
                     help="run only these checkers (repeatable)")
     ap.add_argument("--dead-knobs", action="store_true",
                     help="force the dead-registry-entry check even when "
@@ -45,36 +57,64 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "FILE and exit")
     ap.add_argument("--check-env-docs", metavar="FILE",
                     help="fail if FILE differs from the generated "
-                         "registry docs (drift gate)")
+                         "env-knob registry docs (drift gate)")
+    ap.add_argument("--emit-metrics-docs", metavar="FILE",
+                    help="write generated docs/metrics.md content to "
+                         "FILE")
+    ap.add_argument("--check-metrics-docs", metavar="FILE",
+                    help="fail if FILE differs from the generated "
+                         "metrics registry docs (drift gate)")
+    ap.add_argument("--emit-fault-docs", metavar="FILE",
+                    help="write generated docs/fault_points.md content "
+                         "to FILE")
+    ap.add_argument("--check-fault-docs", metavar="FILE",
+                    help="fail if FILE differs from the generated "
+                         "fault-point registry docs (drift gate)")
     args = ap.parse_args(argv)
 
-    from areal_tpu.base import env_registry
+    docs = _docs_sources()
+    emit_args = {
+        "env": args.emit_env_docs,
+        "metrics": args.emit_metrics_docs,
+        "fault": args.emit_fault_docs,
+    }
+    check_args = {
+        "env": args.check_env_docs,
+        "metrics": args.check_metrics_docs,
+        "fault": args.check_fault_docs,
+    }
 
-    if args.emit_env_docs:
-        with open(args.emit_env_docs, "w", encoding="utf-8") as f:
-            f.write(env_registry.render_docs())
-        print(f"wrote {args.emit_env_docs} "
-              f"({len(env_registry.REGISTRY)} knobs)")
-        if not args.paths:
-            return 0
+    emitted = False
+    for name, target in emit_args.items():
+        if not target:
+            continue
+        render, _ = docs[name]
+        with open(target, "w", encoding="utf-8") as f:
+            f.write(render())
+        print(f"wrote {target}")
+        emitted = True
+    if emitted and not args.paths and not any(check_args.values()):
+        return 0
 
-    if not args.paths and not args.check_env_docs:
+    if not args.paths and not any(check_args.values()):
         ap.error("no paths given")
 
     rc = 0
-    if args.check_env_docs:
+    for name, target in check_args.items():
+        if not target:
+            continue
+        render, emit_flag = docs[name]
         try:
-            with open(args.check_env_docs, "r", encoding="utf-8") as f:
+            with open(target, "r", encoding="utf-8") as f:
                 on_disk = f.read()
         except OSError as e:
-            print(f"env-docs drift gate: cannot read "
-                  f"{args.check_env_docs}: {e}", file=sys.stderr)
+            print(f"{name}-docs drift gate: cannot read {target}: {e}",
+                  file=sys.stderr)
             return 2
-        if on_disk != env_registry.render_docs():
+        if on_disk != render():
             print(
-                f"{args.check_env_docs}: stale — regenerate with "
-                f"'python scripts/areal_lint.py --emit-env-docs "
-                f"{args.check_env_docs}'",
+                f"{target}: stale — regenerate with "
+                f"'python scripts/areal_lint.py {emit_flag} {target}'",
                 file=sys.stderr,
             )
             rc = 1
@@ -90,7 +130,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             allowlist_path=args.allowlist,
             check_dead_knobs=dead,
             checkers=set(args.checkers) if args.checkers else
-            {"loop-only", "blocking-async", "env-knob", "wire-schema"},
+            set(ALL_CHECKERS),
         )
         try:
             findings = run_lint(args.paths, cfg)

@@ -78,7 +78,7 @@ def check(mod: Module, cfg: EnvKnobConfig,
     findings: List[Finding] = []
     is_registry = mod.rel == cfg.registry_rel
 
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         # -- raw reads ---------------------------------------------------
         name_node = _env_read_name(mod, node)
         if name_node is not None:

@@ -1,0 +1,243 @@
+"""Checker ``lock-order``: sync-lock discipline around event loops and
+each other.
+
+The PR 11/12 spill/drain machinery runs real threads
+(``threading.Lock``) next to aiohttp event loops, which creates two
+deadlock classes and one ordering class, all invisible to tests until
+the exact interleaving lands:
+
+- ``await`` while holding a sync lock: the coroutine parks WITH the
+  lock held; any other coroutine on the same loop that wants the lock
+  blocks the loop thread itself — instant single-thread deadlock.
+  (``async with asyncio.Lock()`` is the legal spelling and is not
+  flagged: ``AsyncWith`` is a different node.)
+- a loop-door crossing under a sync lock: ``_run_on_loop(...)`` or
+  ``asyncio.run_coroutine_threadsafe(...).result()`` BLOCKS on work
+  the loop must run; if any loop callback takes the same lock, both
+  sides wait forever.
+- cyclic acquisition order: ``with self._a: with self._b:`` in one
+  method and ``with self._b: with self._a:`` in another — classic
+  AB/BA. The graph is per class, per module (attribute identity
+  across modules is not decidable from the AST); edges through
+  helper calls are out of scope and documented as such.
+
+Lock attributes are discovered, not declared: any
+``self.X = threading.Lock()/RLock()`` assignment — or a bare
+``X = threading.Lock()`` at class body or module scope — makes ``X``
+a sync lock for that class (module); a function-local lock stays
+scoped to its function; ``with self._a, self._b:``
+acquires left-to-right and records the same ordering edges as the
+nested spelling. Nested ``def``/``lambda`` bodies under a ``with lock:`` are
+NOT "under the lock" — they run later, so the walk stops at function
+boundaries.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "lock-order"
+
+_LOCK_CTORS = ("threading.Lock", "threading.RLock")
+_DOOR_ATTRS = ("_run_on_loop",)
+
+
+@dataclasses.dataclass
+class LockConfig:
+    door_attrs: Tuple[str, ...] = _DOOR_ATTRS
+
+
+def default_config() -> LockConfig:
+    return LockConfig()
+
+
+def _is_lock_ctor(mod: Module, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = mod.dotted_name(node.func)
+    return dotted in _LOCK_CTORS
+
+
+def _enclosing_class(mod: Module, node: ast.AST) -> Optional[str]:
+    cur = mod.parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        cur = mod.parent(cur)
+    return None
+
+
+def _collect_locks(mod: Module) -> Dict[object, Set[str]]:
+    """scope -> sync-lock names assigned a threading.Lock/RLock there.
+
+    Scope is a class name for ``self.X`` (and class-body ``X = ...``)
+    locks, ``None`` for true module-level names, or the enclosing
+    function AST node for function-local names — a local lock must not
+    leak into the module bucket, or an unrelated same-named ``with x:``
+    elsewhere fails the gate spuriously. Regression notes: review
+    finds, PR 13."""
+    locks: Dict[object, Set[str]] = {}
+    for node in mod.nodes:
+        if not isinstance(node, ast.Assign) or not _is_lock_ctor(
+            mod, node.value
+        ):
+            continue
+        for t in node.targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                cls = _enclosing_class(mod, node)
+                locks.setdefault(cls, set()).add(t.attr)
+            elif isinstance(t, ast.Name):
+                cls = _enclosing_class(mod, node)
+                fn = mod.enclosing_function(node)
+                if fn is not None:
+                    locks.setdefault(fn, set()).add(t.id)
+                elif cls is not None:
+                    # Class-body ``_lock = threading.Lock()`` (the
+                    # name_resolve spelling) is read back as
+                    # ``self._lock`` — file it under the class.
+                    locks.setdefault(cls, set()).add(t.id)
+                else:
+                    locks.setdefault(None, set()).add(t.id)
+    return locks
+
+
+def _lock_id(mod: Module, expr: ast.AST,
+             locks: Dict[object, Set[str]],
+             cls: Optional[str],
+             fn: Optional[ast.AST]) -> Optional[str]:
+    """Identity of a with-item context if it is a known sync lock."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in locks.get(cls, ())
+    ):
+        return f"{cls}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        if fn is not None and expr.id in locks.get(fn, ()):
+            return f"{getattr(fn, 'name', '<lambda>')}.{expr.id}"
+        if expr.id in locks.get(None, ()):
+            return f"<module>.{expr.id}"
+    return None
+
+
+def _walk_stop_at_functions(root: ast.AST) -> Iterable[ast.AST]:
+    """Like ast.walk over the With body, but closed functions/lambdas
+    run later, not under the lock."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_blocking_door_call(mod: Module, node: ast.AST,
+                           cfg: LockConfig) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in cfg.door_attrs:
+        return f.attr
+    # asyncio.run_coroutine_threadsafe(...).result()
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr == "result"
+        and isinstance(f.value, ast.Call)
+        and mod.dotted_name(f.value.func) ==
+        "asyncio.run_coroutine_threadsafe"
+    ):
+        return "run_coroutine_threadsafe(...).result"
+    return None
+
+
+def check(mod: Module, cfg: LockConfig) -> List[Finding]:
+    locks = _collect_locks(mod)
+    if not locks:
+        return []
+    findings: List[Finding] = []
+    # (class, holder-lock) -> {(inner-lock, line)}
+    edges: Dict[str, Dict[str, int]] = {}
+
+    for w in mod.nodes:
+        if not isinstance(w, ast.With):
+            continue
+        cls = _enclosing_class(mod, w)
+        fn = mod.enclosing_function(w)
+        held_ids: List[str] = []
+        for item in w.items:
+            hid = _lock_id(mod, item.context_expr, locks, cls, fn)
+            if hid and hid not in held_ids:
+                held_ids.append(hid)
+        if not held_ids:
+            continue
+        # ``with self._a, self._b:`` acquires left-to-right — record the
+        # same edges the nested spelling would, or the one-line form of
+        # an AB/BA cycle is never seen. Regression note: review find,
+        # PR 13.
+        for a, b in zip(held_ids, held_ids[1:]):
+            edges.setdefault(a, {}).setdefault(b, w.lineno)
+        held = ", ".join(held_ids)
+        fn_name = getattr(fn, "name", "<module>")
+        for inner in _walk_stop_at_functions(w):
+            if isinstance(inner, ast.Await):
+                findings.append(Finding(
+                    mod.rel, inner.lineno, CHECKER,
+                    f"await while holding sync lock {held} "
+                    f"({fn_name}): the coroutine parks with the "
+                    f"lock held and any same-loop waiter deadlocks "
+                    f"the loop — release first, or use asyncio.Lock",
+                ))
+            door = _is_blocking_door_call(mod, inner, cfg)
+            if door is not None:
+                findings.append(Finding(
+                    mod.rel, inner.lineno, CHECKER,
+                    f"{door} under sync lock {held} ({fn_name}): "
+                    f"blocks on the loop while holding the lock — "
+                    f"if any loop callback takes {held}, both "
+                    f"sides wait forever; hop the door first, "
+                    f"then lock",
+                ))
+            if isinstance(inner, ast.With):
+                for item in inner.items:
+                    other = _lock_id(mod, item.context_expr, locks, cls,
+                                     fn)
+                    if other and other not in held_ids:
+                        edges.setdefault(held_ids[-1], {}).setdefault(
+                            other, inner.lineno
+                        )
+
+    # -- AB/BA cycle detection over the per-module edge graph -----------
+    def reachable(src: str) -> Set[str]:
+        seen: Set[str] = set()
+        work = list(edges.get(src, ()))
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(edges.get(cur, ()))
+        return seen
+
+    reported: Set[Tuple[str, str]] = set()
+    for a, inners in sorted(edges.items()):
+        for b, line in sorted(inners.items()):
+            if a in reachable(b) and (b, a) not in reported:
+                reported.add((a, b))
+                findings.append(Finding(
+                    mod.rel, line, CHECKER,
+                    f"lock-order cycle: {a} -> {b} here, but {b} "
+                    f"also reaches {a} elsewhere in this module — "
+                    f"pick one global order and stick to it",
+                ))
+    return findings

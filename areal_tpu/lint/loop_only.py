@@ -174,7 +174,7 @@ def check_declaring_module(mod: Module, registry: Dict[str, Dict]
                         f"malformed {REGISTRY_NAME}: {err['msg']}")]
 
     classes = {
-        n.name: n for n in ast.walk(mod.tree) if isinstance(n, ast.ClassDef)
+        n.name: n for n in mod.nodes if isinstance(n, ast.ClassDef)
     }
     for cls_name, spec in registry.items():
         cls = classes.get(cls_name)
@@ -227,7 +227,7 @@ def check_instance_hints(mod: Module, hints: Dict[str, Set[str]]
     if not hints:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         if not isinstance(node, ast.Attribute):
             continue
         hint_names = hints.get(node.attr)

@@ -1,0 +1,266 @@
+"""Checker ``metrics-registry``: every cross-process metric name is
+declared in ``areal_tpu.base.metrics_registry`` and alive.
+
+The /metrics text surface (``areal:*`` lines) and the stats_tracker
+scalar keys (``perf/*``) are string-matched across process boundaries
+— emitter and parser can drift silently (``perf/overlap_events`` was
+parsed by the prefetch-overlap bench but never emitted; this checker's
+founding find). Flags, per module:
+
+- an ``areal:*`` / ``perf/*`` string literal (emission line head,
+  startswith-parse prefix, dict key) naming an undeclared metric;
+- an f-string that BUILDS a metric name (``f"perf/{k}"``) — the
+  registry cannot verify it; route through a declared helper like
+  ``metrics_registry.perf_mem_stats``;
+- a ``.startswith("areal:x")`` parse whose prefix (without a trailing
+  space) matches more than one declared name — whether or not the
+  probe is itself a declared name — an ambiguous parse that reads the
+  wrong line (append a space, migrate to
+  ``metrics_registry.parse_line``, or declare a deliberate family
+  probe in ``metrics_registry.FAMILY_PREFIXES``);
+- a ``metrics_registry.<ATTR>`` reference that does not resolve
+  (constants are generated from the registry, so a typo'd constant
+  must fail the gate, not return a stale name);
+- registry entries nothing references (dead metric) — only when the
+  scan covers the registry module itself.
+
+The registry module is exempt: declarations are not uses (else the
+dead-entry check could never fire).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "metrics-registry"
+
+REGISTRY_MODULE = "areal_tpu.base.metrics_registry"
+REGISTRY_REL = "areal_tpu/base/metrics_registry.py"
+
+# A complete name never ends in '_' — trailing-underscore strings are
+# prefixes under construction (startswith probes, f-string heads).
+_NAME_RE = re.compile(
+    r"\A(areal:[a-z0-9_]*[a-z0-9]|perf/[a-z0-9_]*[a-z0-9])( ?)\Z"
+)
+_HEAD_RE = re.compile(
+    r"\A(areal:[a-z0-9_]*[a-z0-9]|perf/[a-z0-9_]*[a-z0-9]) "
+)
+# An f-string head that stops mid-name (next part is interpolated):
+# "areal:", "areal:kv_", "perf/" ... with no terminating space.
+_DANGLING_RE = re.compile(r"\A(?:areal:|perf/)[a-z0-9_]*\Z")
+
+
+@dataclasses.dataclass
+class MetricsConfig:
+    declared: Set[str]
+    constants: Dict[str, str]  # CONST_NAME -> metric name
+    # non-constant module attributes that are legal to reference
+    exported: Set[str]
+    # prefixes that deliberately match a whole family (filter loops,
+    # not single-line parses) — declared in the registry
+    family_prefixes: Tuple[str, ...] = ("areal:", "perf/")
+    registry_rel: str = REGISTRY_REL
+    registry_module: str = REGISTRY_MODULE
+
+
+def default_config() -> MetricsConfig:
+    # Import is deliberate (not AST-parsing the registry): it validates
+    # the declarations execute, and the module is stdlib-only so the
+    # no-jax gate is preserved.
+    from areal_tpu.base import metrics_registry
+
+    return MetricsConfig(
+        declared=set(metrics_registry.REGISTRY),
+        constants=dict(metrics_registry.CONSTANTS),
+        exported={
+            "REGISTRY", "CONSTANTS", "Metric", "const_name",
+            "parse_line", "perf_mem_stats", "render_docs",
+            "AREAL_PREFIX", "PERF_PREFIX", "FAMILY_PREFIXES",
+        },
+        family_prefixes=tuple(metrics_registry.FAMILY_PREFIXES),
+    )
+
+
+def _record(name: str, mod: Module, lineno: int, cfg: MetricsConfig,
+            uses: Dict[str, int], findings: List[Finding]):
+    uses[name] = uses.get(name, 0) + 1
+    if name not in cfg.declared:
+        findings.append(Finding(
+            mod.rel, lineno, CHECKER,
+            f"undeclared metric name {name}: declare it in "
+            f"{cfg.registry_module} (name, kind, emitter, doc)",
+        ))
+
+
+def check(mod: Module, cfg: MetricsConfig,
+          uses: Dict[str, int]) -> List[Finding]:
+    """Per-module pass; records metric uses into ``uses`` for the
+    cross-module dead-entry check."""
+    if mod.rel == cfg.registry_rel:
+        return []
+    findings: List[Finding] = []
+
+    for node in mod.nodes:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # Skip constants living inside an f-string: JoinedStr parts
+            # are handled below with interpolation context.
+            parent = mod.parent(node)
+            if isinstance(parent, (ast.JoinedStr, ast.FormattedValue)):
+                continue
+            m = _NAME_RE.match(node.value) or _HEAD_RE.match(node.value)
+            if m:
+                _record(m.group(1), mod, node.lineno, cfg, uses, findings)
+            continue
+
+        if isinstance(node, ast.JoinedStr):
+            for i, part in enumerate(node.values):
+                if not (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)):
+                    continue
+                # Only the part that STARTS the string can start a
+                # metric name; later constants follow interpolations.
+                if i != 0:
+                    continue
+                if _DANGLING_RE.match(part.value) and i + 1 < len(
+                    node.values
+                ):
+                    findings.append(Finding(
+                        mod.rel, node.lineno, CHECKER,
+                        f"f-string-built metric name "
+                        f"({part.value!r}...): the registry cannot "
+                        f"verify it; use a declared name or a registry "
+                        f"helper (e.g. perf_mem_stats)",
+                    ))
+                    continue
+                m = _NAME_RE.match(part.value) or _HEAD_RE.match(
+                    part.value
+                )
+                if m:
+                    _record(m.group(1), mod, node.lineno, cfg, uses,
+                            findings)
+            continue
+
+        if isinstance(node, ast.Call):
+            # startswith-parse prefix ambiguity.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "startswith"
+                and node.args
+            ):
+                s = mod.resolve_str(node.args[0])
+                if s is not None and (
+                    s.startswith("areal:") or s.startswith("perf/")
+                ):
+                    bare = s.rstrip(" ")
+                    # A trailing space pins the probe to one exact line;
+                    # a declared family prefix matches many by design.
+                    # Otherwise ANY probe matching two or more declared
+                    # names reads whichever line comes first — the
+                    # probe being a declared name itself is not
+                    # required ("areal:kv_spill_" is just as wrong).
+                    # Regression note: review find, PR 13.
+                    if s == bare and bare not in cfg.family_prefixes:
+                        clash = sorted(
+                            o for o in cfg.declared
+                            if o != bare and o.startswith(bare)
+                        )
+                        if clash and (bare in cfg.declared
+                                      or len(clash) >= 2):
+                            findings.append(Finding(
+                                mod.rel, node.lineno, CHECKER,
+                                f"ambiguous startswith parse {bare!r}: "
+                                f"also matches {', '.join(clash)} — "
+                                f"append ' ' or use "
+                                f"metrics_registry.parse_line",
+                            ))
+
+    # Registry attribute references: both `metrics_registry.X` and
+    # `from ...metrics_registry import X` forms must resolve.
+    for node in mod.nodes:
+        if not isinstance(node, ast.Attribute):
+            continue
+        dotted = mod.dotted_name(node)
+        if dotted is None:
+            continue
+        head, _, attr = dotted.rpartition(".")
+        if head != cfg.registry_module and not head.endswith(
+            ".metrics_registry"
+        ):
+            continue
+        if attr in cfg.constants:
+            name = cfg.constants[attr]
+            uses[name] = uses.get(name, 0) + 1
+        elif attr == "perf_mem_stats":
+            # The one declared dynamic builder: a call site keeps every
+            # perf/mem_* entry alive (the helper validates each key
+            # against the registry at runtime).
+            for name in cfg.declared:
+                if name.startswith("perf/mem_"):
+                    uses[name] = uses.get(name, 0) + 1
+        elif attr not in cfg.exported and not attr.startswith("__"):
+            findings.append(Finding(
+                mod.rel, node.lineno, CHECKER,
+                f"metrics_registry.{attr} does not resolve: constants "
+                f"are generated from the registry — declare the metric "
+                f"or fix the constant name",
+            ))
+    for local, target in mod.imports.items():
+        prefix = cfg.registry_module + "."
+        if not target.startswith(prefix):
+            continue
+        attr = target[len(prefix):]
+        if attr in cfg.constants:
+            name = cfg.constants[attr]
+            uses[name] = uses.get(name, 0) + 1
+        elif attr not in cfg.exported and "." not in attr:
+            findings.append(Finding(
+                mod.rel, 1, CHECKER,
+                f"import of unknown metrics_registry attr {attr}",
+            ))
+    return findings
+
+
+def check_dead(cfg: MetricsConfig, uses: Dict[str, int],
+               registry_lines: Dict[str, int]) -> List[Finding]:
+    """Registry entries nothing references (emitter or parser)."""
+    findings: List[Finding] = []
+    for name in sorted(cfg.declared):
+        if not uses.get(name):
+            findings.append(Finding(
+                cfg.registry_rel, registry_lines.get(name, 1), CHECKER,
+                f"dead registry entry {name}: no scanned module emits "
+                f"or parses it — delete the Metric or the feature that "
+                f"grew past it",
+            ))
+    return findings
+
+
+def registry_decl_lines(mod: Module) -> Dict[str, int]:
+    """Line of each ``_m("name", ...)`` / ``Metric(name=...)`` call in
+    the registry module, for anchoring dead-entry findings."""
+    lines: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname not in ("_m", "Metric"):
+            continue
+        name: Optional[str] = None
+        if node.args and isinstance(node.args[0], ast.Constant):
+            name = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+        if isinstance(name, str):
+            lines[name] = node.lineno
+    return lines

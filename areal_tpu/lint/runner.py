@@ -1,6 +1,9 @@
-"""Orchestrates the four checkers over a file set and applies the
+"""Orchestrates the checkers over a file set and applies the
 allowlist. Two passes: parse + collect cross-file facts (loop-only
-registries, env-knob uses), then check."""
+registries, env-knob uses, wire/metrics/chaos registries), then check.
+Registry-backed dead-entry passes are gated on the scan covering the
+registry module itself, so linting one file never misreports a whole
+registry dead."""
 
 from __future__ import annotations
 
@@ -8,7 +11,16 @@ import dataclasses
 import os
 from typing import Dict, List, Optional, Set
 
-from areal_tpu.lint import blocking_async, env_knobs, loop_only, wire_schema
+from areal_tpu.lint import (
+    blocking_async,
+    chaos,
+    env_knobs,
+    locks,
+    loop_only,
+    metrics,
+    wire_contract,
+    wire_schema,
+)
 from areal_tpu.lint.common import (
     Finding,
     Module,
@@ -18,20 +30,36 @@ from areal_tpu.lint.common import (
     parse_module,
 )
 
+ALL_CHECKERS = (
+    "loop-only", "blocking-async", "env-knob", "wire-schema",
+    "wire-contract", "metrics-registry", "chaos-registry", "lock-order",
+)
+
+# The linter's own test corpus: fixture sources are deliberately full
+# of seeded contract violations (fake metric names, unknown routes,
+# undeclared chaos points), so the cross-process checkers must not
+# judge them against the REAL registries.
+LINT_FIXTURE_PREFIX = "tests/lint/"
+
 
 @dataclasses.dataclass
 class LintConfig:
     root: str  # repo root all finding paths are relative to
     allowlist_path: Optional[str] = None
     env_cfg: Optional[env_knobs.EnvKnobConfig] = None
+    metrics_cfg: Optional[metrics.MetricsConfig] = None
+    chaos_cfg: Optional[chaos.ChaosConfig] = None
+    wire_cfg: Optional[wire_contract.WireConfig] = None
+    lock_cfg: Optional[locks.LockConfig] = None
     # None = auto: dead-knob check runs iff the scan covers the
     # registry module (linting one file must not misreport the whole
-    # registry as dead).
+    # registry as dead). Same gating applies to the metrics/chaos/wire
+    # global passes, always in auto mode.
     check_dead_knobs: Optional[bool] = None
     wire_constants_rel: str = wire_schema.CONSTANTS_REL
-    checkers: Set[str] = dataclasses.field(default_factory=lambda: {
-        "loop-only", "blocking-async", "env-knob", "wire-schema",
-    })
+    checkers: Set[str] = dataclasses.field(
+        default_factory=lambda: set(ALL_CHECKERS)
+    )
 
 
 def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
@@ -48,11 +76,26 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
     env_cfg = cfg.env_cfg
     if env_cfg is None and "env-knob" in cfg.checkers:
         env_cfg = env_knobs.default_config()
+    metrics_cfg = cfg.metrics_cfg
+    if metrics_cfg is None and "metrics-registry" in cfg.checkers:
+        metrics_cfg = metrics.default_config()
+    chaos_cfg = cfg.chaos_cfg
+    if chaos_cfg is None and "chaos-registry" in cfg.checkers:
+        chaos_cfg = chaos.default_config()
+    wire_cfg = cfg.wire_cfg
+    if wire_cfg is None and "wire-contract" in cfg.checkers:
+        wire_cfg = wire_contract.default_config()
+    lock_cfg = cfg.lock_cfg
+    if lock_cfg is None and "lock-order" in cfg.checkers:
+        lock_cfg = locks.default_config()
 
     # -- pass 1: cross-file facts ---------------------------------------
-    registries: Dict[str, Dict] = {}  # rel -> registry
+    registries: Dict[str, Dict] = {}  # rel -> loop-only registry
     hint_map: Dict[str, Set[str]] = {}  # attr -> instance hint names
-    registry_mod: Optional[Module] = None
+    env_registry_mod: Optional[Module] = None
+    metrics_registry_mod: Optional[Module] = None
+    chaos_registry_mod: Optional[Module] = None
+    wire_registry_mod: Optional[Module] = None
     for mod in modules:
         if "loop-only" in cfg.checkers:
             reg = loop_only.collect_registry(mod)
@@ -66,10 +109,19 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
                             spec.get("instance_hints", ())
                         )
         if env_cfg is not None and mod.rel == env_cfg.registry_rel:
-            registry_mod = mod
+            env_registry_mod = mod
+        if metrics_cfg is not None and mod.rel == metrics_cfg.registry_rel:
+            metrics_registry_mod = mod
+        if chaos_cfg is not None and mod.rel == chaos_cfg.registry_rel:
+            chaos_registry_mod = mod
+        if wire_cfg is not None and mod.rel == wire_cfg.registry_rel:
+            wire_registry_mod = mod
 
     # -- pass 2: checks --------------------------------------------------
     env_uses: Dict[str, int] = {}
+    metric_uses: Dict[str, int] = {}
+    chaos_uses: Dict[str, int] = {}
+    wire_acc = wire_contract.WireAcc()
     for mod in modules:
         if "blocking-async" in cfg.checkers:
             findings.extend(blocking_async.check(mod))
@@ -77,6 +129,18 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
             findings.extend(wire_schema.check(mod, cfg.wire_constants_rel))
         if "env-knob" in cfg.checkers and env_cfg is not None:
             findings.extend(env_knobs.check(mod, env_cfg, env_uses))
+        is_lint_fixture = mod.rel.startswith(LINT_FIXTURE_PREFIX)
+        if "metrics-registry" in cfg.checkers and metrics_cfg is not None \
+                and not is_lint_fixture:
+            findings.extend(metrics.check(mod, metrics_cfg, metric_uses))
+        if "chaos-registry" in cfg.checkers and chaos_cfg is not None \
+                and not is_lint_fixture:
+            findings.extend(chaos.check(mod, chaos_cfg, chaos_uses))
+        if "wire-contract" in cfg.checkers and wire_cfg is not None \
+                and not is_lint_fixture:
+            findings.extend(wire_contract.check(mod, wire_cfg, wire_acc))
+        if "lock-order" in cfg.checkers and lock_cfg is not None:
+            findings.extend(locks.check(mod, lock_cfg))
         if "loop-only" in cfg.checkers:
             if mod.rel in registries:
                 findings.extend(loop_only.check_declaring_module(
@@ -87,18 +151,46 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
                     mod, hint_map
                 ))
 
+    # -- gated registry-wide passes --------------------------------------
     if "env-knob" in cfg.checkers and env_cfg is not None:
         dead = cfg.check_dead_knobs
         if dead is None:
-            dead = registry_mod is not None
+            dead = env_registry_mod is not None
         if dead:
             decl_lines = (
-                env_knobs.registry_decl_lines(registry_mod)
-                if registry_mod is not None else {}
+                env_knobs.registry_decl_lines(env_registry_mod)
+                if env_registry_mod is not None else {}
             )
             findings.extend(
                 env_knobs.check_dead(env_cfg, env_uses, decl_lines)
             )
+    if (
+        "metrics-registry" in cfg.checkers
+        and metrics_cfg is not None
+        and metrics_registry_mod is not None
+    ):
+        findings.extend(metrics.check_dead(
+            metrics_cfg, metric_uses,
+            metrics.registry_decl_lines(metrics_registry_mod),
+        ))
+    if (
+        "chaos-registry" in cfg.checkers
+        and chaos_cfg is not None
+        and chaos_registry_mod is not None
+    ):
+        findings.extend(chaos.check_dead(
+            chaos_cfg, chaos_uses,
+            chaos.registry_decl_lines(chaos_registry_mod),
+        ))
+    if (
+        "wire-contract" in cfg.checkers
+        and wire_cfg is not None
+        and wire_registry_mod is not None
+    ):
+        findings.extend(wire_contract.check_global(
+            wire_cfg, wire_acc,
+            wire_contract.registry_decl_lines(wire_registry_mod),
+        ))
 
     # -- allowlist -------------------------------------------------------
     if cfg.allowlist_path and os.path.exists(cfg.allowlist_path):

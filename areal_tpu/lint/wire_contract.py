@@ -1,0 +1,430 @@
+"""Checker ``wire-contract``: every HTTP route on the fleet wire is
+declared in ``areal_tpu.base.wire_routes``, every client call names a
+declared route, and the deliberate status codes line up.
+
+The fleet's correctness rests on ~25 hand-paired aiohttp routes
+(servers <-> manager <-> clients <-> bench <-> tests), previously
+string-matched with zero checking. Flags:
+
+- ``app.router.add_get/add_post`` registering an undeclared
+  (method, path);
+- a client path reference — an f-string URL suffix
+  (``f"{url}/drain"``), a ``url + "/path"`` concat, a
+  ``_post(url, "/path")`` helper literal, or a ``path="/x"`` kwarg /
+  default — naming a path no route declares, or using a verb no
+  route for that path has;
+- a server module emitting ``status=N`` for a deliberate code no
+  route on that module declares (the shed-429 / drain-409 class);
+- a client comparing ``resp.status`` / ``err.code`` against a code
+  none of its referenced routes declare;
+- declared routes never registered, deliberate statuses never
+  emitted, and non-``operator`` routes with no client call site —
+  the global pass, gated on the scan covering the registry module.
+
+Path references are only harvested inside HTTP verb calls (session or
+URL-ish receiver, or a known helper) or behind URL-ish receivers
+(terminal name containing url/addr/host/endpoint/peer/source, or
+``u``) so filesystem joins and name_resolve keys — dict-``.get`` with a
+slash-bearing f-string included — never false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "wire-contract"
+
+REGISTRY_MODULE = "areal_tpu.base.wire_routes"
+REGISTRY_REL = "areal_tpu/base/wire_routes.py"
+
+_PATH_RE = re.compile(r"\A/[a-z][a-z0-9_/]*\Z")
+_ADD_METHODS = {"add_get": "GET", "add_post": "POST"}
+_GET_HELPERS = ("_get", "_get_json", "urlopen")
+_POST_HELPERS = ("_post",)
+_URLISH_SUBSTR = ("url", "addr", "host", "endpoint", "peer", "source")
+_SESSIONISH_SUBSTR = ("sess", "client", "http")
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteSpec:
+    servers: Tuple[str, ...]
+    statuses: Tuple[int, ...]
+    operator: bool
+
+
+@dataclasses.dataclass
+class WireConfig:
+    routes: Dict[Tuple[str, str], RouteSpec]
+    implicit_statuses: Tuple[int, ...] = (200, 206, 500)
+    registry_rel: str = REGISTRY_REL
+    registry_module: str = REGISTRY_MODULE
+
+    @property
+    def paths(self) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        for (m, p) in self.routes:
+            out.setdefault(p, set()).add(m)
+        return out
+
+    def server_modules(self) -> Set[str]:
+        return {s for spec in self.routes.values() for s in spec.servers}
+
+
+def default_config() -> WireConfig:
+    # Import is deliberate: it validates the declarations execute, and
+    # the module is stdlib-only so the no-jax gate is preserved.
+    from areal_tpu.base import wire_routes
+
+    return WireConfig(
+        routes={
+            key: RouteSpec(r.servers, r.statuses, r.operator)
+            for key, r in wire_routes.REGISTRY.items()
+        },
+        implicit_statuses=tuple(wire_routes.IMPLICIT_STATUSES),
+    )
+
+
+@dataclasses.dataclass
+class WireAcc:
+    """Cross-file facts for the gated global pass."""
+    registered: Dict[Tuple[str, str], List[str]] = dataclasses.field(
+        default_factory=dict
+    )
+    # path -> HTTP methods clients were seen using (None = the call
+    # site's verb was not spellable); the dead-route pass is
+    # (method, path)-exact so a POST-only client cannot keep a dead
+    # GET twin alive. Regression note: review find, PR 13.
+    client_verbs: Dict[str, Set[Optional[str]]] = dataclasses.field(
+        default_factory=dict
+    )
+    emitted_by_module: Dict[str, Set[int]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _terminal(node.func)
+    if isinstance(node, ast.Subscript):
+        if isinstance(node.slice, ast.Constant) and isinstance(
+            node.slice.value, str
+        ):
+            return node.slice.value
+        return _terminal(node.value)
+    if isinstance(node, ast.Await):
+        return _terminal(node.value)
+    return None
+
+
+def _urlish(node: ast.AST) -> bool:
+    name = _terminal(node)
+    if name is None:
+        return False
+    n = name.lower()
+    return n == "u" or any(t in n for t in _URLISH_SUBSTR)
+
+
+def _norm_path(raw: str) -> Optional[str]:
+    path = raw.split("?", 1)[0]
+    return path if _PATH_RE.match(path) else None
+
+
+def _http_verb_receiver(func: ast.AST) -> bool:
+    """A bare ``.get``/``.post`` counts as an HTTP verb only when its
+    receiver looks like a session or URL — ``mapping.get(f"{k}/x")`` or
+    ``name_resolve.get(f"{root}/lease")`` carrying a slash-bearing
+    f-string must not be harvested as a wire path (name_resolve keys
+    ARE slash-separated). Regression note: review find, PR 13."""
+    if not isinstance(func, ast.Attribute):
+        return False
+    recv = func.value
+    name = _terminal(recv)
+    n = (name or "").lower()
+    return any(t in n for t in _SESSIONISH_SUBSTR) or _urlish(recv)
+
+
+def _enclosing_http_method(mod: Module, node: ast.AST) -> Optional[str]:
+    """HTTP verb of the nearest enclosing client call, if spellable."""
+    cur: Optional[ast.AST] = node
+    for _ in range(4):
+        cur = mod.parent(cur) if cur is not None else None
+        if cur is None:
+            return None
+        if isinstance(cur, ast.Call):
+            name = _terminal(cur.func)
+            if name in _POST_HELPERS:
+                return "POST"
+            if name in _GET_HELPERS:
+                return "GET"
+            if name in ("post", "get") and _http_verb_receiver(cur.func):
+                return "POST" if name == "post" else "GET"
+            return None
+    return None
+
+
+def _status_codes(node: ast.AST) -> List[int]:
+    """Int literals inside a status expression (handles the
+    ``200 if ok else 409`` idiom)."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.append(n.value)
+    return out
+
+
+def check(mod: Module, cfg: WireConfig, acc: WireAcc) -> List[Finding]:
+    if mod.rel == cfg.registry_rel:
+        return []
+    findings: List[Finding] = []
+    paths = cfg.paths
+    is_server = mod.rel in cfg.server_modules()
+    declared_statuses = {
+        s
+        for spec in cfg.routes.values()
+        if mod.rel in spec.servers
+        for s in spec.statuses
+    }
+    mod_client_paths: Set[str] = set()
+    client_status_sites: List[Tuple[int, int]] = []  # (line, code)
+
+    def ref_path(raw: str, lineno: int, method: Optional[str]):
+        path = _norm_path(raw)
+        if path is None:
+            return
+        mod_client_paths.add(path)
+        acc.client_verbs.setdefault(path, set()).add(method)
+        if path not in paths:
+            findings.append(Finding(
+                mod.rel, lineno, CHECKER,
+                f"client references path {path!r} no route declares: "
+                f"declare it in {cfg.registry_module} or fix the path",
+            ))
+        elif method is not None and (method, path) not in cfg.routes:
+            have = ", ".join(sorted(paths[path]))
+            findings.append(Finding(
+                mod.rel, lineno, CHECKER,
+                f"client uses {method} {path} but the declared "
+                f"method(s) are {have}",
+            ))
+
+    for node in mod.nodes:
+        # -- server route registrations ----------------------------------
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _ADD_METHODS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and node.args[0].value.startswith("/"):
+                method = _ADD_METHODS[node.func.attr]
+                path = node.args[0].value
+                key = (method, path)
+                acc.registered.setdefault(key, []).append(
+                    f"{mod.rel}:{node.lineno}"
+                )
+                if key not in cfg.routes:
+                    findings.append(Finding(
+                        mod.rel, node.lineno, CHECKER,
+                        f"registers undeclared route {method} {path}: "
+                        f"declare it in {cfg.registry_module} (method, "
+                        f"path, servers, statuses, doc)",
+                    ))
+            continue
+
+        # -- client refs: f"{url}/path" ----------------------------------
+        if isinstance(node, ast.JoinedStr):
+            # Inside an HTTP verb call (sess.post(f"{target}/kv/accept"))
+            # the string is a URL by construction; elsewhere the
+            # receiver must look URL-ish so fs joins never match.
+            method = _enclosing_http_method(mod, node)
+            for i, part in enumerate(node.values):
+                if (
+                    i > 0
+                    and isinstance(part, ast.Constant)
+                    and isinstance(part.value, str)
+                    and part.value.startswith("/")
+                    and isinstance(node.values[i - 1], ast.FormattedValue)
+                    and (method is not None
+                         or _urlish(node.values[i - 1].value))
+                ):
+                    ref_path(part.value, node.lineno, method)
+            continue
+
+        # -- client refs: url + "/path" ----------------------------------
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            if (
+                isinstance(node.right, ast.Constant)
+                and isinstance(node.right.value, str)
+                and node.right.value.startswith("/")
+                and _urlish(node.left)
+            ):
+                ref_path(node.right.value, node.lineno,
+                         _enclosing_http_method(mod, node))
+            continue
+
+        if isinstance(node, ast.Call):
+            name = _terminal(node.func)
+            # -- client refs: _post(url, "/path", ...) helpers -----------
+            if name in _POST_HELPERS + _GET_HELPERS:
+                method = "POST" if name in _POST_HELPERS else "GET"
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ) and arg.value.startswith("/"):
+                        ref_path(arg.value, node.lineno, method)
+            # -- client refs: path="/x" kwargs ---------------------------
+            for kw in node.keywords:
+                if kw.arg == "path" and isinstance(
+                    kw.value, ast.Constant
+                ) and isinstance(kw.value.value, str) \
+                        and kw.value.value.startswith("/"):
+                    ref_path(kw.value.value, node.lineno, None)
+            # -- server-side deliberate statuses -------------------------
+            if is_server:
+                for kw in node.keywords:
+                    if kw.arg == "status":
+                        for code in _status_codes(kw.value):
+                            acc.emitted_by_module.setdefault(
+                                mod.rel, set()
+                            ).add(code)
+                            if code not in declared_statuses and \
+                                    code not in cfg.implicit_statuses:
+                                findings.append(Finding(
+                                    mod.rel, kw.value.lineno, CHECKER,
+                                    f"handler emits status {code} but "
+                                    f"no route served by this module "
+                                    f"declares it: add it to the "
+                                    f"route's statuses in "
+                                    f"{cfg.registry_module} (clients "
+                                    f"must know deliberate codes)",
+                                ))
+            continue
+
+        # -- path= defaults on client helper functions -------------------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            all_args = args.posonlyargs + args.args + args.kwonlyargs
+            defaults = (
+                [None] * (len(args.posonlyargs) + len(args.args)
+                          - len(args.defaults))
+                + list(args.defaults) + list(args.kw_defaults)
+            )
+            for a, d in zip(all_args, defaults):
+                if (
+                    a.arg == "path"
+                    and isinstance(d, ast.Constant)
+                    and isinstance(d.value, str)
+                    and d.value.startswith("/")
+                ):
+                    ref_path(d.value, d.lineno, None)
+            continue
+
+        # -- client status handling --------------------------------------
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            has_status_attr = any(
+                isinstance(s, ast.Attribute) and s.attr in ("status",
+                                                            "code")
+                for s in sides
+            )
+            if not has_status_attr:
+                continue
+            for s in sides:
+                for code in _status_codes(s):
+                    if 300 <= code < 600:
+                        client_status_sites.append((node.lineno, code))
+
+    # Client status codes are judged against ALL declared route
+    # statuses (not just this module's refs: helper modules like
+    # weight_client own the path while the caller owns the status
+    # branch). A module touching no declared path is not a wire client
+    # and is skipped.
+    if mod_client_paths & set(paths):
+        allowed = set(cfg.implicit_statuses)
+        for spec in cfg.routes.values():
+            allowed.update(spec.statuses)
+        for lineno, code in client_status_sites:
+            if code not in allowed:
+                findings.append(Finding(
+                    mod.rel, lineno, CHECKER,
+                    f"client handles status {code} but no declared "
+                    f"route emits it: the handler branch is dead (or "
+                    f"the route's statuses in {cfg.registry_module} "
+                    f"are stale)",
+                ))
+    return findings
+
+
+def check_global(cfg: WireConfig, acc: WireAcc,
+                 registry_lines: Dict[str, int]) -> List[Finding]:
+    """Dead-declaration pass; the runner gates this on the scan
+    covering the registry module (a single-file run must not misreport
+    the whole wire dead)."""
+    findings: List[Finding] = []
+    for (method, path), spec in sorted(cfg.routes.items()):
+        anchor = registry_lines.get(f"{method} {path}", 1)
+        if (method, path) not in acc.registered:
+            findings.append(Finding(
+                cfg.registry_rel, anchor, CHECKER,
+                f"route {method} {path} declared but never registered "
+                f"by any scanned server: delete the Route or restore "
+                f"the handler",
+            ))
+            continue
+        verbs = acc.client_verbs.get(path, set())
+        if not spec.operator and method not in verbs and None not in verbs:
+            findings.append(Finding(
+                cfg.registry_rel, anchor, CHECKER,
+                f"dead route {method} {path}: no scanned client calls "
+                f"it — delete it, wire a client, or mark it "
+                f"operator=True with a doc saying who curls it",
+            ))
+        for code in spec.statuses:
+            if not any(
+                code in acc.emitted_by_module.get(srv, set())
+                for srv in spec.servers
+            ):
+                findings.append(Finding(
+                    cfg.registry_rel, anchor, CHECKER,
+                    f"route {method} {path} declares status {code} "
+                    f"but no serving module emits it: stale contract",
+                ))
+    return findings
+
+
+def registry_decl_lines(mod: Module) -> Dict[str, int]:
+    """Line of each ``_r("METHOD", "/path", ...)`` call in the
+    registry module, keyed ``"METHOD /path"``."""
+    lines: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname not in ("_r", "Route"):
+            continue
+        vals: List[Optional[str]] = [None, None]
+        for i in (0, 1):
+            if len(node.args) > i and isinstance(node.args[i],
+                                                 ast.Constant):
+                vals[i] = node.args[i].value
+        for kw in node.keywords:
+            if kw.arg == "method" and isinstance(kw.value, ast.Constant):
+                vals[0] = kw.value.value
+            if kw.arg == "path" and isinstance(kw.value, ast.Constant):
+                vals[1] = kw.value.value
+        if isinstance(vals[0], str) and isinstance(vals[1], str):
+            lines[f"{vals[0]} {vals[1]}"] = node.lineno
+    return lines

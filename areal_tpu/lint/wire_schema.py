@@ -25,7 +25,7 @@ def check(mod: Module, constants_rel: str = CONSTANTS_REL) -> List[Finding]:
     if mod.rel == constants_rel:
         return []
     findings: List[Finding] = []
-    for node in ast.walk(mod.tree):
+    for node in mod.nodes:
         if (
             isinstance(node, ast.Constant)
             and isinstance(node.value, str)

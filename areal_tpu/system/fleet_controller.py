@@ -48,6 +48,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from areal_tpu.base import env_registry, health, logging, name_resolve, names
+from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.base.wire_schemas import FLEET_LEASE_V1
 
 logger = logging.getLogger("fleet_controller")
@@ -293,16 +294,19 @@ def rebuild_fleet_state(
         m = metrics.get(url) or {}
         st.urls.append(url)
         st.member_urls[member] = url
-        role = m.get("areal:role") or record.get("role") or "unified"
+        # Registry constants, not literals: a renamed /metrics line is
+        # a lint failure here, not a takeover that rebuilds every
+        # surface as its zero-value default.
+        role = m.get(mreg.ROLE) or record.get("role") or "unified"
         st.roles[url] = str(role)
         st.shards[url] = _shard_of(
-            record.get("weight_shard"), m.get("areal:weight_shard")
+            record.get("weight_shard"), m.get(mreg.WEIGHT_SHARD)
         )
-        st.elastic[url] = bool(float(m.get("areal:elastic") or 0.0) > 0.5)
-        st.versions[url] = int(float(m.get("areal:weight_version") or 0.0))
-        st.shed_totals[url] = float(m.get("areal:load_shed_total") or 0.0)
+        st.elastic[url] = bool(float(m.get(mreg.ELASTIC) or 0.0) > 0.5)
+        st.versions[url] = int(float(m.get(mreg.WEIGHT_VERSION) or 0.0))
+        st.shed_totals[url] = float(m.get(mreg.LOAD_SHED_TOTAL) or 0.0)
         if record.get("draining") or float(
-            m.get("areal:draining") or 0.0
+            m.get(mreg.DRAINING) or 0.0
         ) > 0.5:
             st.draining.append(url)
         if record.get("server_index") is not None:

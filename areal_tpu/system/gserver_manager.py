@@ -44,6 +44,7 @@ from aiohttp import web
 
 from areal_tpu.api.system_api import GserverManagerConfig
 from areal_tpu.base import constants, env_registry, health, logging, name_resolve, names, network, tracing
+from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.worker_base import PollResult, Worker
 
@@ -2358,62 +2359,52 @@ class GserverManager(Worker):
                 try:
                     async with sess.get(f"{u}/metrics") as r:
                         text = await r.text()
+                    # Regression note: this chain used to startswith-
+                    # match raw literals, the prefix-ambiguity class
+                    # the metrics-registry checker now flags
+                    # ("areal:role" needed a hand-added trailing space
+                    # to dodge it). parse_line splits on the declared
+                    # EXACT name, and every branch references the
+                    # registry constant, so a renamed /metrics line is
+                    # a lint failure here instead of a silent zero.
                     for line in text.splitlines():
-                        if line.startswith("areal:num_used_tokens"):
-                            self._server_tokens[u] = float(line.split()[-1])
+                        parsed = mreg.parse_line(line)
+                        if parsed is None:
+                            continue
+                        name, val = parsed
+                        if name == mreg.NUM_USED_TOKENS:
+                            self._server_tokens[u] = float(val)
                             # Fresh snapshot: the since-last-poll
                             # in-flight fold restarts from zero.
                             self._server_tokens_pending[u] = 0.0
-                        elif line.startswith("areal:num_running_reqs"):
-                            self._server_reqs[u] = int(float(line.split()[-1]))
-                        elif line.startswith("areal:load_shed_total"):
-                            self._server_shed_total[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:ttft_hist"):
-                            self._server_ttft_hist[u] = decode_counts(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:itl_hist"):
-                            self._server_itl_hist[u] = decode_counts(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:total_generated_tokens"):
-                            self._server_gen_totals[u] = float(line.split()[-1])
-                        elif line.startswith("areal:prefix_cache_hits"):
-                            self._server_prefix_hits[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:prefix_tokens_reused"):
-                            self._server_prefix_reused[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:total_requests"):
-                            self._server_gen_reqs[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:spec_emitted_tokens"):
-                            self._server_spec_emitted[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:spec_active_steps"):
-                            self._server_spec_steps[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:queued_prompt_tokens"):
-                            self._server_queued_toks[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:kv_pages_free"):
-                            self._server_free_pages[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:kv_pages_total"):
-                            self._server_total_pages[u] = float(
-                                line.split()[-1]
-                            )
-                        elif line.startswith("areal:role "):
-                            role = line.split()[-1]
+                        elif name == mreg.NUM_RUNNING_REQS:
+                            self._server_reqs[u] = int(float(val))
+                        elif name == mreg.LOAD_SHED_TOTAL:
+                            self._server_shed_total[u] = float(val)
+                        elif name == mreg.TTFT_HIST:
+                            self._server_ttft_hist[u] = decode_counts(val)
+                        elif name == mreg.ITL_HIST:
+                            self._server_itl_hist[u] = decode_counts(val)
+                        elif name == mreg.TOTAL_GENERATED_TOKENS:
+                            self._server_gen_totals[u] = float(val)
+                        elif name == mreg.PREFIX_CACHE_HITS:
+                            self._server_prefix_hits[u] = float(val)
+                        elif name == mreg.PREFIX_TOKENS_REUSED:
+                            self._server_prefix_reused[u] = float(val)
+                        elif name == mreg.TOTAL_REQUESTS:
+                            self._server_gen_reqs[u] = float(val)
+                        elif name == mreg.SPEC_EMITTED_TOKENS:
+                            self._server_spec_emitted[u] = float(val)
+                        elif name == mreg.SPEC_ACTIVE_STEPS:
+                            self._server_spec_steps[u] = float(val)
+                        elif name == mreg.QUEUED_PROMPT_TOKENS:
+                            self._server_queued_toks[u] = float(val)
+                        elif name == mreg.KV_PAGES_FREE:
+                            self._server_free_pages[u] = float(val)
+                        elif name == mreg.KV_PAGES_TOTAL:
+                            self._server_total_pages[u] = float(val)
+                        elif name == mreg.ROLE:
+                            role = val
                             # The sizer's view wins for servers it
                             # re-roled until the server's own surface
                             # catches up (it does, on the next beat).
@@ -2421,52 +2412,49 @@ class GserverManager(Worker):
                                 role == self._server_roles.get(u)
                             ):
                                 self._server_roles[u] = role
-                        elif line.startswith("areal:elastic"):
-                            self._server_elastic[u] = (
-                                float(line.split()[-1]) > 0.5
-                            )
-                        elif line.startswith("areal:weight_shard "):
+                        elif name == mreg.ELASTIC:
+                            self._server_elastic[u] = float(val) > 0.5
+                        elif name == mreg.WEIGHT_SHARD:
                             # Second source besides the heartbeat: a
                             # fanout racing a server's first beat must
                             # not plan it into the unsharded group.
-                            tok = line.split()[-1]
-                            if "/" in tok:
-                                r_s, d_s = tok.split("/", 1)
+                            if "/" in val:
+                                r_s, d_s = val.split("/", 1)
                                 self._server_shards[u] = (
                                     int(r_s), int(d_s)
                                 )
-                        elif line.startswith("areal:kv_export_total"):
+                        elif name == mreg.KV_EXPORT_TOTAL:
                             self._server_kv.setdefault(u, {})["exports"] = (
-                                float(line.split()[-1])
+                                float(val)
                             )
-                        elif line.startswith("areal:kv_export_bytes"):
+                        elif name == mreg.KV_EXPORT_BYTES:
                             self._server_kv.setdefault(u, {})[
-                                "export_bytes"] = float(line.split()[-1])
-                        elif line.startswith("areal:kv_import_total"):
+                                "export_bytes"] = float(val)
+                        elif name == mreg.KV_IMPORT_TOTAL:
                             self._server_kv.setdefault(u, {})["imports"] = (
-                                float(line.split()[-1])
+                                float(val)
                             )
-                        elif line.startswith("areal:kv_import_bytes"):
+                        elif name == mreg.KV_IMPORT_BYTES:
                             self._server_kv.setdefault(u, {})[
-                                "import_bytes"] = float(line.split()[-1])
-                        elif line.startswith("areal:last_kv_transfer_ms"):
+                                "import_bytes"] = float(val)
+                        elif name == mreg.LAST_KV_TRANSFER_MS:
                             self._server_kv.setdefault(u, {})[
-                                "last_transfer_ms"] = float(line.split()[-1])
-                        elif line.startswith("areal:kv_spill_total"):
+                                "last_transfer_ms"] = float(val)
+                        elif name == mreg.KV_SPILL_TOTAL:
                             self._server_kv.setdefault(u, {})["spills"] = (
-                                float(line.split()[-1])
+                                float(val)
                             )
-                        elif line.startswith("areal:kv_restore_total"):
+                        elif name == mreg.KV_RESTORE_TOTAL:
                             self._server_kv.setdefault(u, {})["restores"] = (
-                                float(line.split()[-1])
+                                float(val)
                             )
-                        elif line.startswith("areal:kv_prefix_lost_total"):
+                        elif name == mreg.KV_PREFIX_LOST_TOTAL:
                             self._server_kv.setdefault(u, {})["lost"] = (
-                                float(line.split()[-1])
+                                float(val)
                             )
-                        elif line.startswith("areal:kv_tier_peer_hits"):
+                        elif name == mreg.KV_TIER_PEER_HITS:
                             self._server_kv.setdefault(u, {})[
-                                "peer_hits"] = float(line.split()[-1])
+                                "peer_hits"] = float(val)
                     if self._kv_index_size:
                         await self._poll_kv_index(sess, u)
                 except Exception:

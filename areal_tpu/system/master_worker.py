@@ -14,6 +14,7 @@ from typing import Dict, List, Optional
 from areal_tpu.api.dfg import build_graph
 from areal_tpu.api.system_api import MasterWorkerConfig
 from areal_tpu.base import constants, logging, name_resolve, names, recover, timeutil, tracing
+from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.base.recover import RecoverInfo, StepInfo
 from areal_tpu.system import request_reply_stream as rrs
@@ -275,24 +276,30 @@ class MasterWorker(Worker):
             for k, v in st.items():
                 if not isinstance(v, (int, float)):
                     continue
-                if k == "perf/elapsed":
+                if k == mreg.PERF_ELAPSED:
                     scalars[f"timeperf/{name}"] = v
-                elif k == "perf/tflops":
+                elif k == mreg.PERF_TFLOPS:
                     scalars[f"tflops/{name}"] = v
-                elif k == "perf/flops":
+                elif k == mreg.PERF_FLOPS:
                     total_flops += v
-                elif k == "perf/gen_tokens_per_sec":
+                elif k == mreg.PERF_GEN_TOKENS_PER_SEC:
                     scalars[f"gen_tokens_per_sec/{name}"] = v
                 elif k in (
-                    "perf/packing_efficiency",
-                    "perf/h2d_wait_ms",
-                    "perf/dispatch_gap_ms",
+                    mreg.PERF_PACKING_EFFICIENCY,
+                    mreg.PERF_H2D_WAIT_MS,
+                    mreg.PERF_DISPATCH_GAP_MS,
+                    # Regression note: perf/overlap_events was shipped
+                    # nowhere and parsed by the bench anyway until the
+                    # metrics-registry checker caught it; now the
+                    # engine emits it and the master folds it into the
+                    # overlap series like its sibling telemetry.
+                    mreg.PERF_OVERLAP_EVENTS,
                     # Rollout-pipeline series (PR 3): episode e2e latency
                     # percentiles + interruption re-prefill tokens, from
                     # trajectory metadata (async runs only).
-                    "perf/rollout_e2e_p50_ms",
-                    "perf/rollout_e2e_p95_ms",
-                    "perf/reprefill_tokens",
+                    mreg.PERF_ROLLOUT_E2E_P50_MS,
+                    mreg.PERF_ROLLOUT_E2E_P95_MS,
+                    mreg.PERF_REPREFILL_TOKENS,
                 ):
                     # Input-pipeline telemetry: per-MFC series + running
                     # mean in perf_summary["overlap"].
@@ -328,8 +335,8 @@ class MasterWorker(Worker):
             if k.startswith((
                 "timeperf/", "tflops/", "gen_tokens_per_sec/",
                 "packing_efficiency/", "h2d_wait_ms/", "dispatch_gap_ms/",
-                "rollout_e2e_p50_ms/", "rollout_e2e_p95_ms/",
-                "reprefill_tokens/",
+                "overlap_events/", "rollout_e2e_p50_ms/",
+                "rollout_e2e_p95_ms/", "reprefill_tokens/",
             ))
         ]
         logger.info(

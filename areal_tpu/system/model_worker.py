@@ -38,7 +38,7 @@ from areal_tpu.api.model_api import (
     make_model,
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
-from areal_tpu.base import constants, env_registry, logging, name_resolve, names, seeding, stats_tracker, timeutil, tracing
+from areal_tpu.base import constants, env_registry, logging, metrics_registry, name_resolve, names, seeding, stats_tracker, timeutil, tracing
 from areal_tpu.system import eval_scores
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
@@ -304,7 +304,12 @@ class ModelWorker(Worker):
         # model_worker.py:1507-1610 GPU-memory watch + kill threshold):
         # zeros on backends without memory_stats, so always logged.
         mem = monitor.device_memory_stats()
-        stats.update({f"perf/{k}": v for k, v in mem.items()})
+        # Regression note: this used to f-string-build `perf/{k}` keys,
+        # invisible to the metrics registry — a renamed monitor stat
+        # would ship an undeclared key downstream consumers silently
+        # drop. perf_mem_stats validates every key against the
+        # registry (metrics-registry lint checker).
+        stats.update(metrics_registry.perf_mem_stats(mem))
         monitor.check_memory_kill_threshold(mem)
         cfg = getattr(model.module, "model_cfg", None)
         if cfg is not None:

@@ -5,11 +5,18 @@
 #              baseline rule set in pyproject.toml). Skipped with a
 #              note when ruff is not installed — the container image
 #              does not bake it in.
-#   2. areal-lint — repo-specific AST contract checks (loop-only,
-#              blocking-async, env-knob, wire-schema) + the
-#              docs/env_vars.md drift gate. Always runs; stdlib-only.
+#   2. areal-lint over areal_tpu/ — repo-specific AST contract checks
+#              (loop-only, blocking-async, env-knob, wire-schema,
+#              wire-contract, metrics-registry, chaos-registry,
+#              lock-order) + the generated-docs drift gates
+#              (env_vars.md, metrics.md, fault_points.md).
+#   3. areal-lint over tests/ + scripts/ — the CLIENT side of the
+#              cross-process contracts only (wire routes, metric
+#              names, AREAL_FAULTS chaos specs): a chaos test arming
+#              a renamed point must fail HERE, not silently no-op on
+#              a chip window.
 #
-# Exit nonzero if either gate fails. Used by chip_runbook.sh preflight
+# Exit nonzero if any gate fails. Used by chip_runbook.sh preflight
 # and intended as the single command future PRs/CI wire in.
 
 set -u
@@ -23,7 +30,16 @@ else
     echo "== lint: ruff not installed; skipping (baseline config in pyproject.toml) =="
 fi
 
-echo "== lint: areal-lint =="
-python scripts/areal_lint.py areal_tpu --check-env-docs docs/env_vars.md || rc=1
+echo "== lint: areal-lint (areal_tpu + docs drift) =="
+python scripts/areal_lint.py areal_tpu \
+    --check-env-docs docs/env_vars.md \
+    --check-metrics-docs docs/metrics.md \
+    --check-fault-docs docs/fault_points.md || rc=1
+
+echo "== lint: areal-lint (tests/scripts cross-process contracts) =="
+python scripts/areal_lint.py tests scripts \
+    --checker wire-contract \
+    --checker metrics-registry \
+    --checker chaos-registry || rc=1
 
 exit $rc

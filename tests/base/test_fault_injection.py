@@ -18,57 +18,57 @@ def clean_global():
 def test_unarmed_point_is_noop():
     inj = FaultInjector()
     for _ in range(5):
-        inj.maybe_fail("some.point")
-    assert inj.hits("some.point") == 5
+        inj.maybe_fail("test.some_point")
+    assert inj.hits("test.some_point") == 5
 
 
 def test_raise_on_kth_hit_only():
     inj = FaultInjector()
-    inj.arm("p", action="raise", at_hit=3)
-    inj.maybe_fail("p")
-    inj.maybe_fail("p")
+    inj.arm("test.p", action="raise", at_hit=3)
+    inj.maybe_fail("test.p")
+    inj.maybe_fail("test.p")
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
+        inj.maybe_fail("test.p")
     # times=1: the fault fired once and is spent.
-    inj.maybe_fail("p")
-    assert inj.hits("p") == 4
+    inj.maybe_fail("test.p")
+    assert inj.hits("test.p") == 4
 
 
 def test_repeat_counts():
     inj = FaultInjector()
-    inj.arm("p", action="raise", at_hit=2, times=2)
-    inj.maybe_fail("p")
+    inj.arm("test.p", action="raise", at_hit=2, times=2)
+    inj.maybe_fail("test.p")
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
+        inj.maybe_fail("test.p")
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
-    inj.maybe_fail("p")
+        inj.maybe_fail("test.p")
+    inj.maybe_fail("test.p")
 
 
 def test_every_hit_from_k():
     inj = FaultInjector()
-    inj.arm("p", action="raise", at_hit=2, times=0)
-    inj.maybe_fail("p")
+    inj.arm("test.p", action="raise", at_hit=2, times=0)
+    inj.maybe_fail("test.p")
     for _ in range(3):
         with pytest.raises(FaultInjected):
-            inj.maybe_fail("p")
+            inj.maybe_fail("test.p")
 
 
 def test_delay_action():
     inj = FaultInjector()
-    inj.arm("p", action="delay", delay_s=0.1)
+    inj.arm("test.p", action="delay", delay_s=0.1)
     t0 = time.monotonic()
-    inj.maybe_fail("p")
+    inj.maybe_fail("test.p")
     assert time.monotonic() - t0 >= 0.1
 
 
 def test_async_delay_action():
     inj = FaultInjector()
-    inj.arm("p", action="delay", delay_s=0.05)
+    inj.arm("test.p", action="delay", delay_s=0.05)
 
     async def go():
         t0 = time.monotonic()
-        await inj.maybe_fail_async("p")
+        await inj.maybe_fail_async("test.p")
         return time.monotonic() - t0
 
     assert asyncio.run(go()) >= 0.05
@@ -76,20 +76,20 @@ def test_async_delay_action():
 
 def test_scope_filtering():
     inj = FaultInjector()
-    inj.arm("p", action="raise", scope="generation_server/1")
+    inj.arm("test.p", action="raise", scope="generation_server/1")
     inj.set_scope("generation_server/0")
-    inj.maybe_fail("p")  # wrong scope: no fire
+    inj.maybe_fail("test.p")  # wrong scope: no fire
     inj.set_scope("generation_server/1")
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
+        inj.maybe_fail("test.p")
 
 
 def test_on_trigger_callback():
     inj = FaultInjector()
     fired = []
-    inj.arm("p", action="raise", on_trigger=lambda: fired.append(1))
+    inj.arm("test.p", action="raise", on_trigger=lambda: fired.append(1))
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
+        inj.maybe_fail("test.p")
     assert fired == [1]
 
 
@@ -109,14 +109,14 @@ def test_env_spec_parsing(monkeypatch):
 
 
 def test_env_spec_loaded_lazily(monkeypatch):
-    monkeypatch.setenv("AREAL_FAULTS", "lazy.point=raise")
+    monkeypatch.setenv("AREAL_FAULTS", "test.lazy_point=raise")
     inj = FaultInjector()
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("lazy.point")
+        inj.maybe_fail("test.lazy_point")
 
 
 def test_bad_env_entry_ignored():
     inj = FaultInjector()
-    inj.load_env("not-a-valid-entry;p=raise")
+    inj.load_env("not-a-valid-entry;test.p=raise")
     with pytest.raises(FaultInjected):
-        inj.maybe_fail("p")
+        inj.maybe_fail("test.p")

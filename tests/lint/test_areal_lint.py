@@ -337,13 +337,17 @@ def test_allowlist_requires_justification(tmp_path):
 
 
 def test_gate_tree_is_clean_no_jax_and_docs_fresh():
-    """THE gate: linting areal_tpu/ finds nothing unallowlisted, never
-    imports jax (AST-only — this is what keeps it <10s on the 2-core
-    host), and docs/env_vars.md matches the registry."""
+    """THE gate: linting areal_tpu/ with all eight checkers finds
+    nothing unallowlisted, never imports jax (AST-only — this is what
+    keeps it <10s on the 2-core host), and every generated doc
+    (env_vars, metrics, fault_points) matches its registry."""
     code = (
         "import sys\n"
         "from areal_tpu.lint.cli import main\n"
-        "rc = main(['areal_tpu', '--check-env-docs', 'docs/env_vars.md'])\n"
+        "rc = main(['areal_tpu',\n"
+        "           '--check-env-docs', 'docs/env_vars.md',\n"
+        "           '--check-metrics-docs', 'docs/metrics.md',\n"
+        "           '--check-fault-docs', 'docs/fault_points.md'])\n"
         "assert 'jax' not in sys.modules, 'lint gate imported jax'\n"
         "sys.exit(rc)\n"
     )
@@ -353,6 +357,32 @@ def test_gate_tree_is_clean_no_jax_and_docs_fresh():
     )
     assert proc.returncode == 0, (
         f"areal-lint gate failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+
+
+def test_gate_cross_process_contracts_cover_tests_and_scripts():
+    """Tests and scripts are the CLIENT side of the wire/metrics/chaos
+    contracts (AREAL_FAULTS specs, /metrics passthroughs, bench route
+    calls), so the three cross-process checkers sweep them too. The
+    older single-process checkers (env-knob, loop-only, ...) stay
+    scoped to areal_tpu/ — test-local knobs are legitimate."""
+    code = (
+        "import sys\n"
+        "from areal_tpu.lint.cli import main\n"
+        "rc = main(['tests', 'scripts',\n"
+        "           '--checker', 'wire-contract',\n"
+        "           '--checker', 'metrics-registry',\n"
+        "           '--checker', 'chaos-registry'])\n"
+        "assert 'jax' not in sys.modules, 'lint gate imported jax'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, (
+        f"areal-lint cross-process gate failed:\n"
+        f"{proc.stdout}\n{proc.stderr}"
     )
 
 

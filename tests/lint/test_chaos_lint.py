@@ -1,0 +1,153 @@
+"""chaos-registry checker fixtures: seeded violations (undeclared
+maybe_fail/arm points, unknown AREAL_FAULTS spec points in every env
+shape, non-literal names) plus the exempt patterns (the test.*
+namespace, interpolated scopes, dead-entry gating)."""
+
+import textwrap
+
+from areal_tpu.lint.chaos import ChaosConfig
+from areal_tpu.lint.runner import LintConfig, run_lint
+
+_CFG = ChaosConfig(
+    declared={"good.point", "other.point"},
+    registry_rel="fault_points.py",
+)
+
+
+def _lint(tmp_path, source, *, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    cfg = LintConfig(root=str(tmp_path), chaos_cfg=_CFG,
+                     checkers={"chaos-registry"})
+    return run_lint([str(p)], cfg)
+
+
+def test_undeclared_maybe_fail_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def work():
+            faults.maybe_fail("good.point")
+            faults.maybe_fail("renamed.point")
+    """)
+    assert len(findings) == 1
+    assert "renamed.point" in findings[0].message
+
+
+def test_bare_import_maybe_fail_flagged(tmp_path):
+    # ``from ..fault_injection import maybe_fail`` then a bare call is
+    # the same contract as the faults.maybe_fail spelling — it must not
+    # slip past the attribute-call match.
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import maybe_fail
+
+        def work():
+            maybe_fail("renamed.point")
+    """)
+    assert len(findings) == 1
+    assert "renamed.point" in findings[0].message
+
+
+def test_non_literal_point_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def work(p):
+            faults.maybe_fail(p)
+    """)
+    assert len(findings) == 1
+    assert "non-literal" in findings[0].message
+
+
+def test_non_literal_arm_flagged(tmp_path):
+    # Arming a computed point is the same silent-no-op hazard as firing
+    # one: a renamed production point leaves the arm matching nothing.
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def work(p):
+            faults.arm(p, action="raise")
+    """)
+    assert len(findings) == 1
+    assert "non-literal" in findings[0].message
+    assert "arm" in findings[0].message
+
+
+def test_test_namespace_exempt(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def work(i):
+            faults.maybe_fail("test.anything")
+            faults.maybe_fail(f"test.fake{i}.generate")
+            faults.arm(f"test.fake{i}.generate", action="raise")
+    """)
+    assert findings == []
+
+
+def test_arm_and_hits_unknown_point_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def work():
+            faults.arm("unknown.armed", action="die")
+            assert faults.hits("unknown.hits") == 0
+    """)
+    assert len(findings) == 2
+    assert "unknown.armed" in findings[0].message
+    assert "unknown.hits" in findings[1].message
+
+
+def test_env_spec_shapes_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def work(monkeypatch, child_env, scope):
+            monkeypatch.setenv("AREAL_FAULTS", "nope.a=die:k=3")
+            child_env["AREAL_FAULTS"] = "good.point=raise;nope.b=die"
+            env = {"AREAL_FAULTS": f"nope.c@{scope}=hang"}
+            return env
+    """)
+    assert sorted(
+        f.message.split("chaos point ")[1].split(":")[0]
+        for f in findings
+    ) == ["'nope.a'", "'nope.b'", "'nope.c'"]
+
+
+def test_env_spec_interpolated_scope_ok(tmp_path):
+    # The point is literal, the scope interpolated: verifiable, clean.
+    findings = _lint(tmp_path, """
+        def work(monkeypatch, name):
+            monkeypatch.setenv(
+                "AREAL_FAULTS", f"good.point@{name}=raise:k=2"
+            )
+    """)
+    assert findings == []
+
+
+def test_env_spec_point_cut_by_interpolation_skipped(tmp_path):
+    # A point assembled across the interpolation boundary cannot be
+    # verified; it must be skipped, not half-matched.
+    findings = _lint(tmp_path, """
+        def work(monkeypatch, suffix):
+            monkeypatch.setenv("AREAL_FAULTS", f"good.{suffix}=raise")
+    """)
+    assert findings == []
+
+
+def test_dead_point_gated_on_registry_scan(tmp_path):
+    (tmp_path / "fault_points.py").write_text(
+        '_p = dict\nPTS = [_p("good.point"), _p("other.point")]\n'
+    )
+    (tmp_path / "user.py").write_text(textwrap.dedent("""
+        from areal_tpu.base.fault_injection import faults
+
+        def work():
+            faults.maybe_fail("good.point")
+    """))
+    cfg = LintConfig(root=str(tmp_path), chaos_cfg=_CFG,
+                     checkers={"chaos-registry"})
+    findings = run_lint([str(tmp_path)], cfg)
+    assert len(findings) == 1
+    assert "dead chaos point other.point" in findings[0].message
+
+    findings = run_lint([str(tmp_path / "user.py")], cfg)
+    assert findings == []

@@ -1,0 +1,158 @@
+"""metrics-registry checker fixtures: seeded violations per rule
+(undeclared emission, f-string-built name, ambiguous startswith,
+unresolved constant, dead entry) plus the exempt-pattern negatives
+(prose, perf_mem_stats liveness, subset-scan gating)."""
+
+import textwrap
+
+from areal_tpu.lint.metrics import MetricsConfig
+from areal_tpu.lint.runner import LintConfig, run_lint
+
+_CFG = MetricsConfig(
+    declared={"areal:good", "areal:amb", "areal:amb_extra",
+              "perf/thing", "perf/mem_bytes"},
+    constants={"GOOD": "areal:good", "AMB": "areal:amb",
+               "AMB_EXTRA": "areal:amb_extra", "PERF_THING": "perf/thing",
+               "PERF_MEM_BYTES": "perf/mem_bytes"},
+    exported={"REGISTRY", "CONSTANTS", "parse_line", "perf_mem_stats",
+              "render_docs"},
+    registry_rel="metrics_registry.py",
+)
+
+
+def _lint(tmp_path, source, *, name="mod.py", paths=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    cfg = LintConfig(
+        root=str(tmp_path), metrics_cfg=_CFG,
+        checkers={"metrics-registry"},
+    )
+    return run_lint(paths or [str(p)], cfg)
+
+
+def test_undeclared_emission_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def emit(v):
+            return [f"areal:good {v}", f"areal:brand_new {v}"]
+    """)
+    assert len(findings) == 1
+    assert "areal:brand_new" in findings[0].message
+
+
+def test_undeclared_parse_key_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def read(m):
+            return m.get("areal:goood")
+    """)
+    assert len(findings) == 1
+    assert "areal:goood" in findings[0].message
+
+
+def test_prose_not_flagged(tmp_path):
+    # A docstring MENTIONING a name mid-sentence is not a reference.
+    findings = _lint(tmp_path, '''
+        def f():
+            """The poll reads areal:brand_new_thing from servers."""
+    ''')
+    assert findings == []
+
+
+def test_fstring_built_name_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def emit(k, v):
+            return {f"perf/{k}": v}
+    """)
+    assert len(findings) == 1
+    assert "f-string-built" in findings[0].message
+
+
+def test_ambiguous_startswith_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def parse(line):
+            if line.startswith("areal:amb"):
+                return line
+    """)
+    assert len(findings) == 1
+    assert "ambiguous" in findings[0].message
+    assert "areal:amb_extra" in findings[0].message
+
+
+def test_ambiguous_incomplete_prefix_flagged(tmp_path):
+    # The probe need not be a declared name itself: "areal:amb_" is a
+    # trailing-underscore prefix (skipped by the undeclared-literal
+    # rule as a name under construction) yet matches two declared
+    # names — it reads whichever line comes first.
+    cfg = MetricsConfig(
+        declared={"areal:amb_extra", "areal:amb_other"},
+        constants={}, exported=set(),
+        registry_rel="metrics_registry.py",
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""
+        def parse(line):
+            if line.startswith("areal:amb_"):
+                return line
+    """))
+    lint_cfg = LintConfig(root=str(tmp_path), metrics_cfg=cfg,
+                          checkers={"metrics-registry"})
+    findings = run_lint([str(p)], lint_cfg)
+    assert len(findings) == 1
+    assert "ambiguous" in findings[0].message
+    assert "areal:amb_extra" in findings[0].message
+
+
+def test_family_prefix_probe_clean(tmp_path):
+    # startswith("areal:") is a deliberate whole-family filter,
+    # declared in FAMILY_PREFIXES — not an ambiguous line parse.
+    findings = _lint(tmp_path, """
+        def split(lines):
+            return [l for l in lines if l.startswith("areal:")]
+    """)
+    assert findings == []
+
+
+def test_trailing_space_disambiguates(tmp_path):
+    findings = _lint(tmp_path, """
+        def parse(line):
+            if line.startswith("areal:amb "):
+                return line
+    """)
+    assert findings == []
+
+
+def test_unresolved_constant_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        from areal_tpu.base import metrics_registry
+
+        def read(m):
+            return m.get(metrics_registry.GOOD), metrics_registry.TYPO
+    """)
+    assert len(findings) == 1
+    assert "TYPO" in findings[0].message
+
+
+def test_dead_entry_flagged_only_with_registry_in_scan(tmp_path):
+    (tmp_path / "metrics_registry.py").write_text(
+        '_m = dict\nREG = [_m("areal:good"), _m("areal:amb"),\n'
+        '       _m("areal:amb_extra"), _m("perf/thing"),\n'
+        '       _m("perf/mem_bytes")]\n'
+    )
+    (tmp_path / "user.py").write_text(textwrap.dedent("""
+        from areal_tpu.base import metrics_registry
+
+        def emit(v, mem):
+            x = f"areal:good {v}"
+            y = "areal:amb", "areal:amb_extra"
+            return x, y, metrics_registry.perf_mem_stats(mem)
+    """))
+    cfg = LintConfig(root=str(tmp_path), metrics_cfg=_CFG,
+                     checkers={"metrics-registry"})
+    findings = run_lint([str(tmp_path)], cfg)
+    # perf/thing is dead; perf/mem_bytes stays alive through the
+    # perf_mem_stats call (the declared dynamic builder).
+    assert len(findings) == 1
+    assert "dead registry entry perf/thing" in findings[0].message
+
+    # Subset scan (registry not covered): no dead-entry noise.
+    findings = run_lint([str(tmp_path / "user.py")], cfg)
+    assert findings == []

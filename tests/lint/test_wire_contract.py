@@ -1,0 +1,278 @@
+"""wire-contract checker fixtures: seeded violations prove each rule
+fires; exempt-pattern negatives prove the harvest heuristics don't
+swallow filesystem joins or non-wire modules. AST-only, no aiohttp."""
+
+import textwrap
+
+from areal_tpu.lint.runner import LintConfig, run_lint
+from areal_tpu.lint.wire_contract import RouteSpec, WireConfig
+
+SRV = "srv.py"
+
+_CFG_ROUTES = {
+    ("POST", "/generate"): RouteSpec((SRV,), (429,), False),
+    ("GET", "/metrics"): RouteSpec((SRV,), (), False),
+    ("GET", "/health"): RouteSpec((SRV,), (), True),  # operator
+}
+
+
+def _cfg(registry_rel="wire_routes.py"):
+    return WireConfig(routes=dict(_CFG_ROUTES), registry_rel=registry_rel)
+
+
+def _lint(tmp_path, source, *, name=SRV, cfg=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    lint_cfg = LintConfig(
+        root=str(tmp_path), wire_cfg=cfg or _cfg(),
+        checkers={"wire-contract"},
+    )
+    return run_lint([str(p)], lint_cfg)
+
+
+def test_undeclared_route_registration_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+            app.router.add_get("/totally_new", h)
+    """)
+    assert len(findings) == 1
+    assert "GET /totally_new" in findings[0].message
+
+
+def test_unknown_client_path_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        async def go(sess, url):
+            async with sess.post(f"{url}/genrate", json={}) as r:
+                pass
+    """)
+    assert len(findings) == 1
+    assert "/genrate" in findings[0].message
+
+
+def test_method_mismatch_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        async def go(sess, url):
+            async with sess.get(f"{url}/generate") as r:
+                pass
+    """)
+    assert len(findings) == 1
+    assert "GET /generate" in findings[0].message
+    assert "POST" in findings[0].message
+
+
+def test_fs_join_not_harvested(tmp_path):
+    # Neither a URL-ish receiver nor an HTTP call: must not be treated
+    # as a wire path even though it looks like one.
+    findings = _lint(tmp_path, """
+        def save(base_dir, name):
+            return f"{base_dir}/checkpoints/{name}"
+    """)
+    assert findings == []
+
+
+def test_dict_get_with_slash_fstring_not_harvested(tmp_path):
+    # ``.get``/``.post`` on a non-session, non-URL receiver is not an
+    # HTTP verb: dict lookups and name_resolve keys (which ARE
+    # slash-separated) must not trip the wire gate.
+    findings = _lint(tmp_path, """
+        from areal_tpu.base import name_resolve
+
+        def look(mapping, root, key):
+            a = mapping.get(f"{key}/lease")
+            b = name_resolve.get(f"{root}/lease")
+            return a, b
+    """)
+    assert findings == []
+
+
+def test_concat_and_helper_refs_clean(tmp_path):
+    findings = _lint(tmp_path, """
+        import urllib.request
+
+        def _post(url, path, payload):
+            return (url, path, payload)
+
+        def go(url):
+            urllib.request.urlopen(url + "/metrics")
+            _post(url, "/generate", {})
+    """)
+    assert findings == []
+
+
+def test_client_unknown_status_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        async def go(sess, url):
+            async with sess.post(f"{url}/generate", json={}) as r:
+                if r.status == 429:
+                    pass  # declared: clean
+                if r.status == 418:
+                    pass  # no route declares 418
+    """)
+    assert len(findings) == 1
+    assert "418" in findings[0].message
+
+
+def test_status_check_skipped_off_wire(tmp_path):
+    # A module referencing no declared path is not a wire client; its
+    # status comparisons (e.g. subprocess returncodes) are none of our
+    # business.
+    findings = _lint(tmp_path, """
+        def check(proc):
+            return proc.status == 418
+    """)
+    assert findings == []
+
+
+def test_server_undeclared_status_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        from aiohttp import web
+
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+
+        async def h(request):
+            return web.json_response({}, status=409)
+    """)
+    assert len(findings) == 1
+    assert "status 409" in findings[0].message
+
+
+def test_server_declared_and_implicit_statuses_clean(tmp_path):
+    findings = _lint(tmp_path, """
+        from aiohttp import web
+
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+
+        async def h(request):
+            if bad(request):
+                return web.json_response({}, status=429)
+            return web.json_response({}, status=200 if ok(request) else 500)
+    """)
+    assert findings == []
+
+
+_REGISTRY_FIXTURE = """
+    def _r(method, path):
+        return (method, path)
+
+    _ROUTES = [
+        _r("POST", "/generate"),
+        _r("GET", "/metrics"),
+        _r("GET", "/health"),
+    ]
+"""
+
+
+def _global_lint(tmp_path, server_src, client_src=None):
+    (tmp_path / "wire_routes.py").write_text(
+        textwrap.dedent(_REGISTRY_FIXTURE)
+    )
+    (tmp_path / SRV).write_text(textwrap.dedent(server_src))
+    if client_src is not None:
+        (tmp_path / "client.py").write_text(textwrap.dedent(client_src))
+    lint_cfg = LintConfig(
+        root=str(tmp_path), wire_cfg=_cfg(), checkers={"wire-contract"},
+    )
+    return run_lint([str(tmp_path)], lint_cfg)
+
+
+def test_global_dead_and_unregistered_routes(tmp_path):
+    # /generate registered + called; /metrics registered, never called
+    # (dead); /health never called but operator (exempt); 429 declared
+    # but never emitted (stale status). The registry fixture anchors
+    # finding lines.
+    findings = _global_lint(tmp_path, """
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+            app.router.add_get("/metrics", h)
+            app.router.add_get("/health", h)
+    """, """
+        async def go(sess, url):
+            async with sess.post(f"{url}/generate", json={}) as r:
+                pass
+    """)
+    msgs = [f.message for f in findings]
+    assert any("dead route GET /metrics" in m for m in msgs)
+    assert any("declares status 429" in m for m in msgs)
+    assert not any("/health" in m for m in msgs)  # operator exempt
+    assert len(findings) == 2
+
+
+def test_global_never_registered(tmp_path):
+    findings = _global_lint(tmp_path, """
+        from aiohttp import web
+
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+            app.router.add_get("/health", h)
+
+        async def h(request):
+            return web.json_response({}, status=429)
+    """, """
+        async def go(sess, url):
+            async with sess.post(f"{url}/generate", json={}) as r:
+                pass
+            async with sess.get(f"{url}/metrics") as r:
+                pass
+    """)
+    msgs = [f.message for f in findings]
+    assert any(
+        "GET /metrics declared but never registered" in m for m in msgs
+    )
+    assert len(findings) == 1
+
+
+def test_dead_route_is_method_exact(tmp_path):
+    # A POST-only client must not keep a clientless GET twin of the
+    # same path alive; a verb-unknown ref (path= kwarg) keeps both.
+    dual = {
+        ("POST", "/flip"): RouteSpec((SRV,), (), False),
+        ("GET", "/flip"): RouteSpec((SRV,), (), False),
+    }
+    (tmp_path / "wire_routes.py").write_text(
+        textwrap.dedent(_REGISTRY_FIXTURE)
+    )
+    (tmp_path / SRV).write_text(textwrap.dedent("""
+        def routes(app, h):
+            app.router.add_post("/flip", h)
+            app.router.add_get("/flip", h)
+    """))
+    (tmp_path / "client.py").write_text(textwrap.dedent("""
+        async def go(sess, url):
+            async with sess.post(f"{url}/flip", json={}) as r:
+                pass
+    """))
+    cfg = WireConfig(routes=dual, registry_rel="wire_routes.py")
+    lint_cfg = LintConfig(
+        root=str(tmp_path), wire_cfg=cfg, checkers={"wire-contract"},
+    )
+    findings = run_lint([str(tmp_path)], lint_cfg)
+    msgs = [f.message for f in findings]
+    assert any("dead route GET /flip" in m for m in msgs)
+    assert not any("dead route POST /flip" in m for m in msgs)
+
+    # Same tree plus a verb-unknown path= ref: both verbs stay alive.
+    (tmp_path / "client.py").write_text(textwrap.dedent("""
+        async def go(sess, url):
+            async with sess.post(f"{url}/flip", json={}) as r:
+                pass
+
+        def probe(fetch):
+            return fetch(path="/flip")
+    """))
+    cfg = WireConfig(routes=dict(dual), registry_rel="wire_routes.py")
+    lint_cfg = LintConfig(
+        root=str(tmp_path), wire_cfg=cfg, checkers={"wire-contract"},
+    )
+    assert run_lint([str(tmp_path)], lint_cfg) == []
+
+
+def test_subset_scan_skips_global_pass(tmp_path):
+    # Without the registry module in the scan, no dead-route noise.
+    findings = _lint(tmp_path, """
+        def routes(app, h):
+            app.router.add_post("/generate", h)
+    """)
+    assert findings == []

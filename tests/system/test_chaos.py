@@ -100,7 +100,7 @@ class FakeGenServer:
         self.n_generate += 1
         if self.dead:
             return web.json_response({"error": "dead"}, status=500)
-        await faults.maybe_fail_async(f"fake{self.idx}.generate")
+        await faults.maybe_fail_async(f"test.fake{self.idx}.generate")
         d = await request.json()
         n = int(d["gconfig"]["max_new_tokens"])
         return web.json_response({
@@ -281,7 +281,7 @@ def test_server_death_mid_rollout_degrades_then_recovers(chaos_env):
     # lexicographically-first server — kill exactly that one, mid-rollout.
     victim, survivor = sorted(servers, key=lambda s: s.address)
     faults.arm(
-        f"fake{victim.idx}.generate", action="raise", at_hit=1,
+        f"test.fake{victim.idx}.generate", action="raise", at_hit=1,
         on_trigger=victim.kill,
     )
 
@@ -588,7 +588,7 @@ def test_rl_trace_emitters_wellformed_under_failover(
         m = _start_manager(env, n_servers=2)
         victim, _ = sorted(servers, key=lambda s: s.address)
         faults.arm(
-            f"fake{victim.idx}.generate", action="raise", at_hit=1,
+            f"test.fake{victim.idx}.generate", action="raise", at_hit=1,
             on_trigger=victim.kill,
         )
 
