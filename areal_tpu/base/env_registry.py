@@ -177,6 +177,49 @@ _KNOBS: List[Knob] = [
     _k("AREAL_PYEXEC_TIMEOUT", "float", 6.0,
        "Sandboxed python-answer execution timeout seconds "
        "(functioncall/python_answer.py)."),
+    # -- RPC substrate (base/rpc.py, docs/fault_tolerance.md) ------------
+    _k("AREAL_RPC_ATTEMPTS", "int", 4,
+       "Default attempts per cross-process RPC (base/rpc.py "
+       "default_policy) — replaces the per-call-site magic numbers "
+       "(e.g. generation_server's old hardcoded 4-attempt KV pull)."),
+    _k("AREAL_RPC_BACKOFF_S", "float", 0.05,
+       "Base of the jittered exponential backoff between RPC "
+       "attempts; a server's Retry-After floors the computed wait."),
+    _k("AREAL_RPC_BACKOFF_MAX_S", "float", 2.0,
+       "Backoff ceiling for the default RPC policy."),
+    _k("AREAL_RPC_TIMEOUT_S", "float", 30.0,
+       "Per-attempt timeout CAP; the effective timeout is "
+       "min(cap, remaining deadline budget) so a call with 2s left "
+       "never waits 30s on one attempt."),
+    _k("AREAL_RPC_HEDGE", "bool", True,
+       "Enable hedged reads for idempotent hash-verified GETs "
+       "(weight /weights/chunk, KV /kv/chunk) when multiple holders "
+       "exist. The rpc_resilience bench A/B flips this."),
+    _k("AREAL_RPC_HEDGE_DELAY_S", "float", 0.25,
+       "Silence window after which a hedge request launches against "
+       "the next holder; first success wins, losers are cancelled."),
+    _k("AREAL_RPC_BREAKER_FAILS", "int", 5,
+       "Consecutive failures that open a per-peer circuit breaker "
+       "(closed -> open); sheds (429) never count."),
+    _k("AREAL_RPC_BREAKER_COOLDOWN_S", "float", 2.0,
+       "Open-breaker cooldown before ONE half-open probe is allowed "
+       "through; probe success closes the circuit, failure re-opens."),
+    _k("AREAL_RPC_REDISCOVERY_ATTEMPTS", "int", 64,
+       "Manager-blip budget shared by partial_rollout and the rollout "
+       "worker (base/rpc.py rediscovery_policy): control-plane "
+       "restarts cost seconds and hit every client at once, so this "
+       "is deliberately generous and separate from per-sample "
+       "failure budgets."),
+    _k("AREAL_RPC_REDISCOVERY_BACKOFF_MAX_S", "float", 5.0,
+       "Backoff ceiling while rediscovering a restarted manager "
+       "(jittered so thousands of workers don't hammer the successor "
+       "the instant it registers)."),
+    _k("AREAL_CHAOS_HTTP", "bool", False,
+       "Arm the generation server's /configure chaos-control surface "
+       "(runtime AREAL_FAULTS arming + hit introspection) so the "
+       "all-points chaos campaign can sweep one long-lived subprocess "
+       "fleet. OFF in production: with it off, /configure refuses "
+       "fault specs with 403."),
     # -- system ----------------------------------------------------------
     _k("AREAL_WEIGHT_PLANE", "bool", False,
        "Arm the streaming weight-distribution plane without config "

@@ -4,10 +4,16 @@ Production code declares *named injection points* — one-line calls like
 ``faults.maybe_fail("gserver.generate")`` — that are free no-ops until a
 test arms them. An armed point fires a chosen action on its k-th hit:
 
-- ``raise``: raise ``FaultInjected`` (a transient software failure)
-- ``die``:   ``os._exit(1)`` (a killed process / native crash)
-- ``delay``: sleep ``delay_s`` seconds, then proceed (a slow peer)
-- ``hang``:  sleep effectively forever (a dropped request / wedged peer)
+- ``raise``:   raise ``FaultInjected`` (a transient software failure)
+- ``die``:     ``os._exit(1)`` (a killed process / native crash)
+- ``delay``:   sleep ``delay_s`` seconds, then proceed (a slow peer)
+- ``hang``:    sleep effectively forever (a dropped request / wedged peer)
+- ``flaky``:   raise ``FaultInjected`` for the first ``n`` hits, then
+  succeed (defaults to n=2) — the canonical retry-policy exercise:
+  a substrate with attempts > n MUST absorb it invisibly
+- ``corrupt``: flip payload bytes AFTER the hash was stamped — only
+  meaningful at ``maybe_corrupt`` points (byte-serving sites); the
+  sha256 verify on the receiving side must catch and reject it
 
 Arming is either in-process (``faults.arm(...)``, unit/integration
 tests in one process) or via the ``AREAL_FAULTS`` environment variable
@@ -50,13 +56,19 @@ class _Arm:
     __slots__ = ("action", "at_hit", "times", "delay_s", "scope",
                  "on_trigger", "fired")
 
-    def __init__(self, action: str, at_hit: int = 1, times: int = 1,
+    def __init__(self, action: str, at_hit: int = 1,
+                 times: Optional[int] = None,
                  delay_s: float = 0.0, scope: Optional[str] = None,
                  on_trigger: Optional[Callable[[], None]] = None):
-        if action not in ("raise", "die", "delay", "hang"):
+        if action not in ("raise", "die", "delay", "hang", "flaky",
+                          "corrupt"):
             raise ValueError(f"unknown fault action {action!r}")
         self.action = action
         self.at_hit = max(1, int(at_hit))
+        if times is None:
+            # flaky's whole point is fail-then-SUCCEED under one knob:
+            # the bare spec "<point>=flaky" fails twice then passes.
+            times = 2 if action == "flaky" else 1
         self.times = int(times)  # 0 = every hit from at_hit on
         self.delay_s = float(delay_s)
         self.scope = scope
@@ -87,14 +99,15 @@ class FaultInjector:
             self._scope = scope
 
     def arm(self, point: str, action: str = "raise", at_hit: int = 1,
-            times: int = 1, delay_s: float = 0.0,
+            times: Optional[int] = None, delay_s: float = 0.0,
             scope: Optional[str] = None,
             on_trigger: Optional[Callable[[], None]] = None):
         """Arm `point` to fire `action` on its at_hit-th hit (then for
-        `times` consecutive hits; times=0 = forever). `on_trigger` runs
-        right before the action — chaos tests use it to flip auxiliary
-        state (e.g. stop a fake server's heartbeat) atomically with the
-        injected failure."""
+        `times` consecutive hits; times=0 = forever; None = the
+        action's default, 1 for everything but flaky's 2). `on_trigger`
+        runs right before the action — chaos tests use it to flip
+        auxiliary state (e.g. stop a fake server's heartbeat)
+        atomically with the injected failure."""
         with self._lock:
             self._arms.setdefault(point, []).append(
                 _Arm(action, at_hit, times, delay_s, scope, on_trigger)
@@ -145,6 +158,36 @@ class FaultInjector:
                 logger.error(f"bad AREAL_FAULTS entry {entry!r}; ignored",
                              exc_info=True)
 
+    # -- registry-verified dynamic API ----------------------------------
+    # The chaos-registry lint checker verifies LITERAL point names
+    # statically; sweeps that iterate the registry (the all-points
+    # chaos campaign, the manager's HTTP faults_hits query) can't name
+    # points literally. These variants are the runtime equivalent of
+    # the static check: an undeclared point raises instead of arming a
+    # silent no-op, so the "renamed point keeps the test green" failure
+    # mode the checker exists for stays impossible.
+
+    @staticmethod
+    def check_declared(point: str):
+        from areal_tpu.base import fault_points
+
+        if point.startswith(fault_points.TEST_PREFIX):
+            return
+        if point not in fault_points.REGISTRY:
+            raise ValueError(
+                f"undeclared chaos point {point!r}: declare it in "
+                f"areal_tpu.base.fault_points (or use the reserved "
+                f"{fault_points.TEST_PREFIX!r} namespace)"
+            )
+
+    def arm_declared(self, point: str, **kwargs):
+        self.check_declared(point)
+        return self.arm(point, **kwargs)
+
+    def hits_declared(self, point: str) -> int:
+        self.check_declared(point)
+        return self.hits(point)
+
     # -- introspection --------------------------------------------------
 
     def hits(self, point: str) -> int:
@@ -181,10 +224,15 @@ class FaultInjector:
         if arm.action == "die":
             # Mimic a hard kill: no cleanup, no exit hooks, nonzero code.
             os._exit(1)
-        if arm.action == "raise":
+        if arm.action in ("raise", "flaky"):
             raise FaultInjected(f"injected fault at {point!r}")
         if arm.action == "delay":
             return arm.delay_s
+        if arm.action == "corrupt":
+            # Only byte-serving maybe_corrupt sites can corrupt; at a
+            # plain maybe_fail point the arm is inert by design (the
+            # chaos campaign sweeps every (point, action) pair).
+            return 0.0
         return _HANG_SECONDS  # hang
 
     def maybe_fail(self, point: str):
@@ -201,6 +249,62 @@ class FaultInjector:
             import asyncio
 
             await asyncio.sleep(self._fire(arm, point))
+
+    def maybe_corrupt(self, point: str, data: bytes) -> bytes:
+        """Byte-serving injection point: a no-op pass-through unless
+        armed. A ``corrupt`` arm flips bytes AFTER every hash was
+        stamped — the receiving side's sha256 verify must catch it and
+        re-fetch (the silent-corruption drill). Any other action fires
+        exactly like ``maybe_fail`` (so raise/delay/flaky sweeps cover
+        these points too). Cheap and sync on purpose: one dict lookup
+        when unarmed, a byte-flip when armed — safe at serving sites."""
+        arm = self._step(point)
+        if arm is None:
+            return data
+        if arm.action == "corrupt":
+            logger.warning(
+                f"fault injection: corrupting {len(data)} bytes at "
+                f"{point!r} (hit {self._hits.get(point)})"
+            )
+            if arm.on_trigger is not None:
+                arm.on_trigger()
+            return corrupt_bytes(data)
+        time.sleep(self._fire(arm, point))
+        return data
+
+    async def maybe_corrupt_async(self, point: str, data: bytes) -> bytes:
+        """``maybe_corrupt`` for byte-serving sites that run ON an
+        event loop (aiohttp handlers building a response inline): a
+        ``delay``/``hang`` arm sleeps via asyncio so it wedges the one
+        request it targets, never the whole server process. Sites that
+        serve bytes from executor threads keep the sync variant."""
+        arm = self._step(point)
+        if arm is None:
+            return data
+        if arm.action == "corrupt":
+            logger.warning(
+                f"fault injection: corrupting {len(data)} bytes at "
+                f"{point!r} (hit {self._hits.get(point)})"
+            )
+            if arm.on_trigger is not None:
+                arm.on_trigger()
+            return corrupt_bytes(data)
+        import asyncio
+
+        await asyncio.sleep(self._fire(arm, point))
+        return data
+
+
+def corrupt_bytes(data: bytes) -> bytes:
+    """Deterministically flip bytes (first, middle, last) so a
+    content-hash verifier MUST reject the payload; empty payloads pass
+    through (nothing to corrupt, nothing to verify)."""
+    if not data:
+        return data
+    buf = bytearray(data)
+    for pos in {0, len(buf) // 2, len(buf) - 1}:
+        buf[pos] ^= 0xFF
+    return bytes(buf)
 
 
 # Process-global injector: production code imports this singleton so

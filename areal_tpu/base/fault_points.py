@@ -88,6 +88,18 @@ _POINTS: List[FaultPoint] = [
         "areal_tpu/system/generation_server.py"), "async",
        "A serving peer/origin fails mid-chunk (the bench kills a "
        "mid-transfer peer via serve_chunk=raise:k=40:n=3)."),
+    _p("weight_plane.chunk_bytes",
+       ("areal_tpu/system/weight_plane.py",), "sync",
+       "Weight chunk payload corrupted on the wire AFTER its hash was "
+       "stamped (bit-rot, torn proxy) — the puller's sha256 verify "
+       "must reject and re-fetch; corrupt weights never cut over. "
+       "Fires for every /weights/chunk byte path (origin, peer "
+       "holders, gserver peer hop) via chunk_response."),
+    _p("gserver.kv_chunk_bytes", _GS, "async",
+       "KV chunk/blob payload corrupted after its chunk index was "
+       "minted (tier chunk, handoff blob) — the puller's per-chunk "
+       "sha256 verify must reject and re-fetch, never scatter corrupt "
+       "KV into the paged pool."),
     _p("worker.poll",
        ("areal_tpu/system/worker_base.py",), "both",
        "A worker's poll loop dies or hangs — THE generic worker "

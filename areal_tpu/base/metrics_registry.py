@@ -262,6 +262,38 @@ _METRICS: List[Metric] = [
        "Raw spec-decode emitted-token sum (fleet yield numerator)."),
     _m("areal:spec_active_steps", "counter", _GS,
        "Raw spec-decode active-step sum (fleet yield denominator)."),
+    # -- RPC substrate (base/rpc.py, docs/fault_tolerance.md) ------------
+    _m("areal:rpc_attempts", "counter", _GS,
+       "Outbound RPC attempts this process made through base/rpc.py "
+       "(retries included)."),
+    _m("areal:rpc_retries", "counter", _GS,
+       "Attempts that were retries of a failed/shed predecessor."),
+    _m("areal:rpc_failures", "counter", _GS,
+       "Calls that exhausted their retry budget (includes each "
+       "exhausted hedge LEG; see rpc_hedge_failures for whole races "
+       "lost)."),
+    _m("areal:rpc_hedges", "counter", _GS,
+       "Secondary (hedge) requests launched after the primary went "
+       "AREAL_RPC_HEDGE_DELAY_S without answering."),
+    _m("areal:rpc_hedge_wins", "counter", _GS,
+       "Races a hedge won — the rpc_resilience bench's proof that "
+       "hedging, not luck, cut the tail."),
+    _m("areal:rpc_hedge_cancelled", "counter", _GS,
+       "Losing hedge legs cancelled/abandoned; their bytes are "
+       "dropped, never double-counted into ingress/egress."),
+    _m("areal:rpc_hedge_failures", "counter", _GS,
+       "Whole hedged races lost (every leg failed), counted once per "
+       "race — a transient leg failure inside a race the hedge won "
+       "does NOT land here."),
+    _m("areal:rpc_deadline_expired", "counter", _GS,
+       "Calls short-circuited because the propagated X-Areal-Deadline "
+       "budget was already spent (includes refusals before attempt "
+       "1)."),
+    _m("areal:rpc_breaker_rejections", "counter", _GS,
+       "Attempts refused locally by an OPEN per-peer circuit "
+       "breaker — budget saved, not failures."),
+    _m("areal:rpc_breaker_opens", "counter", _GS,
+       "closed->open (and failed-probe re-open) breaker transitions."),
     # ====================================================================
     # perf/* — stats_tracker scalar keys (worker -> master MFC stats
     # payloads; master_worker perf history + bench workloads).

@@ -1,0 +1,881 @@
+"""Budget-aware RPC substrate for every cross-process HTTP call.
+
+Until this module the fleet's five wire planes (routing, weight, KV,
+handoff, fleet-lease) each grew a private retry loop with hand-picked
+timeouts and no shared deadline: a slow peer was indistinguishable from
+a dead one, and a rollout with 2 s of budget left could still wait 30 s
+on a chunk pull. Everything here exists to make those calls share ONE
+discipline:
+
+- **Deadline propagation.** The outermost caller mints a
+  :class:`Deadline`; every outbound hop stamps the *remaining* seconds
+  into the ``X-Areal-Deadline`` header (:data:`DEADLINE_HEADER`, wire
+  rule declared in ``base/wire_routes.py``) and every server parses it
+  back with :meth:`Deadline.from_headers`. Budgets therefore decrement
+  across hops — the KV pull a decode server makes on behalf of a
+  rollout inherits the rollout's remaining budget, not a fresh 30 s.
+
+- **Unified retry policy.** :class:`RetryPolicy` carries the attempt
+  count, jittered exponential backoff (Retry-After floors the wait),
+  and the per-attempt timeout *derived from the remaining budget*.
+  :func:`retry_sync` / :func:`retry_async` are the only two retry
+  loops the tree needs; the ``rpc-discipline`` lint checker flags any
+  other HTTP-call-plus-sleep loop outside this module.
+
+- **Hedged reads** (:func:`hedged_sync` / :func:`hedged_async`) for
+  idempotent, hash-verified GETs where several holders can serve the
+  same bytes (weight ``/weights/chunk``, KV ``/kv/chunk``): the
+  secondary launches after ``hedge_delay_s`` of primary silence, first
+  success wins, losers are cancelled and their bytes never reach the
+  caller — so egress/ingress accounting cannot double-count.
+
+- **Per-peer circuit breakers** (:class:`CircuitBreaker`,
+  closed -> open -> half-open) pooled in a :class:`BreakerBoard`. The
+  gserver manager feeds its board into routing/health so a flapping
+  peer stops eating every caller's budget; servers keep a process
+  board for their own peer pulls.
+
+All counters land in the process-global :data:`stats` and surface as
+``areal:rpc_*`` /metrics lines (``base/metrics_registry.py``) and the
+manager's ``/status`` rpc section.
+
+Import discipline: stdlib-only at import time (the no-jax lint gate
+imports this for the rpc-discipline registry); aiohttp is imported
+lazily inside the async helpers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+import threading
+import time
+from typing import (
+    Any,
+    Awaitable,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    TypeVar,
+)
+
+from areal_tpu.base import env_registry, logging
+from areal_tpu.base.wire_routes import DEADLINE_HEADER
+
+logger = logging.getLogger("rpc")
+
+T = TypeVar("T")
+
+# Default retryable failures. asyncio.TimeoutError is spelled out
+# because on Python < 3.11 it is NOT a subclass of builtin
+# TimeoutError — and it is exactly what an aiohttp total-timeout
+# raises, the single most retryable failure the substrate sees.
+RETRYABLE_DEFAULT = (OSError, TimeoutError, asyncio.TimeoutError, ValueError)
+
+# Below this many seconds of remaining budget an attempt cannot
+# plausibly complete; the call short-circuits with RpcDeadlineExceeded
+# instead of burning a socket on a doomed request.
+MIN_ATTEMPT_S = 0.01
+
+
+class RpcError(RuntimeError):
+    """Base class for substrate failures."""
+
+
+class RpcDeadlineExceeded(RpcError):
+    """The propagated deadline expired (possibly before attempt 1)."""
+
+
+class BreakerOpen(RpcError):
+    """The peer's circuit breaker is open; no attempt was made."""
+
+    def __init__(self, peer: str, detail: str = ""):
+        super().__init__(f"circuit open for {peer}{': ' if detail else ''}{detail}")
+        self.peer = peer
+
+
+class RpcShed(RpcError):
+    """The peer shed the request (429). Deliberate backpressure, not a
+    failure: carries the server's Retry-After so callers (or the retry
+    loop itself) can floor their backoff on it."""
+
+    def __init__(self, peer: str, retry_after: float):
+        super().__init__(f"{peer} shed request (retry after {retry_after:.2f}s)")
+        self.peer = peer
+        self.retry_after = float(retry_after)
+
+
+# ----------------------------------------------------------------------
+# Deadline propagation
+# ----------------------------------------------------------------------
+
+
+class Deadline:
+    """A monotonic-clock budget minted once at the outermost caller and
+    decremented implicitly as time passes. Serialized on the wire as
+    REMAINING seconds (``X-Areal-Deadline: 12.345``) so clocks never
+    need to agree across hosts — each hop re-anchors against its own
+    monotonic clock, losing only the network latency of the hop."""
+
+    __slots__ = ("_expires",)
+
+    def __init__(self, expires_monotonic: Optional[float]):
+        self._expires = expires_monotonic
+
+    @classmethod
+    def after(cls, budget_s: float) -> "Deadline":
+        return cls(time.monotonic() + float(budget_s))
+
+    @classmethod
+    def unbounded(cls) -> "Deadline":
+        return cls(None)
+
+    @classmethod
+    def from_header_value(cls, value: Optional[str]) -> Optional["Deadline"]:
+        if not value:
+            return None
+        try:
+            return cls.after(float(value))
+        except ValueError:
+            return None
+
+    @classmethod
+    def from_headers(cls, headers) -> Optional["Deadline"]:
+        """Parse the propagated deadline out of a request's headers
+        (any mapping with .get). None when the caller sent none."""
+        try:
+            return cls.from_header_value(headers.get(DEADLINE_HEADER))
+        except Exception:
+            return None
+
+    def remaining(self) -> float:
+        if self._expires is None:
+            return float("inf")
+        return self._expires - time.monotonic()
+
+    def expired(self) -> bool:
+        return self._expires is not None and self.remaining() <= 0.0
+
+    def bounded(self) -> bool:
+        return self._expires is not None
+
+    def header_value(self) -> Optional[str]:
+        if self._expires is None:
+            return None
+        return f"{max(0.0, self.remaining()):.3f}"
+
+    def headers(self, base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+        """``base`` plus the deadline header (omitted when unbounded)."""
+        out = dict(base or {})
+        v = self.header_value()
+        if v is not None:
+            out[DEADLINE_HEADER] = v
+        return out
+
+    def cap(self, budget_s: float) -> "Deadline":
+        """The tighter of this deadline and a fresh ``budget_s`` window
+        — the standard way a hop bounds its own work without ever
+        EXTENDING the caller's budget."""
+        capped = time.monotonic() + float(budget_s)
+        if self._expires is None or capped < self._expires:
+            return Deadline(capped)
+        return Deadline(self._expires)
+
+
+def ensure_deadline(
+    deadline: Optional[Deadline], default_budget_s: float
+) -> Deadline:
+    """The caller's deadline, or a freshly minted one — used at the
+    outermost edges (client entry points) so every call below them is
+    always budgeted."""
+    if deadline is not None:
+        return deadline
+    return Deadline.after(default_budget_s)
+
+
+# ----------------------------------------------------------------------
+# Retry policy
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """One declared retry discipline: how many attempts, how long each
+    may take, how long to wait between them. Per-attempt timeouts are
+    derived from the remaining budget at attempt time, never a fixed
+    constant — the deadline always wins."""
+
+    attempts: int = 4
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    attempt_timeout_s: float = 30.0
+    jitter: float = 0.5  # +-fraction of the computed backoff
+
+    def attempt_timeout(self, deadline: Optional[Deadline]) -> float:
+        """Timeout for the next attempt: the policy cap clipped to the
+        remaining budget. Raises RpcDeadlineExceeded (and counts the
+        short-circuit) when the budget cannot fit an attempt."""
+        if deadline is None:
+            return self.attempt_timeout_s
+        rem = deadline.remaining()
+        if rem <= MIN_ATTEMPT_S:
+            stats.incr("deadline_expired")
+            raise RpcDeadlineExceeded(
+                f"deadline expired ({rem:.3f}s remaining)"
+            )
+        return min(self.attempt_timeout_s, rem)
+
+    def backoff(
+        self,
+        consecutive_failures: int,
+        retry_after: Optional[float] = None,
+        deadline: Optional[Deadline] = None,
+    ) -> float:
+        """Jittered exponential backoff after the k-th consecutive
+        failure (k >= 1); a server's Retry-After floors it; the
+        remaining budget caps it (no point sleeping past the
+        deadline)."""
+        k = max(1, int(consecutive_failures))
+        delay = min(self.backoff_max_s, self.backoff_base_s * (2 ** (k - 1)))
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        if retry_after is not None:
+            delay = max(delay, float(retry_after))
+        if deadline is not None and deadline.bounded():
+            delay = min(delay, max(0.0, deadline.remaining()))
+        return delay
+
+
+def default_policy(**overrides) -> RetryPolicy:
+    """The fleet-wide declared policy, tuned by AREAL_RPC_* knobs."""
+    kw: Dict[str, Any] = dict(
+        attempts=env_registry.get_int("AREAL_RPC_ATTEMPTS"),
+        backoff_base_s=env_registry.get_float("AREAL_RPC_BACKOFF_S"),
+        backoff_max_s=env_registry.get_float("AREAL_RPC_BACKOFF_MAX_S"),
+        attempt_timeout_s=env_registry.get_float("AREAL_RPC_TIMEOUT_S"),
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def rediscovery_policy(**overrides) -> RetryPolicy:
+    """The manager-blip policy shared by partial_rollout and the
+    rollout worker: a control-plane restart costs seconds and every
+    client sees it at once, so the budget is generous and the backoff
+    ceiling high enough to not hammer the successor."""
+    kw: Dict[str, Any] = dict(
+        attempts=env_registry.get_int("AREAL_RPC_REDISCOVERY_ATTEMPTS"),
+        backoff_base_s=env_registry.get_float("AREAL_RPC_BACKOFF_S"),
+        backoff_max_s=env_registry.get_float(
+            "AREAL_RPC_REDISCOVERY_BACKOFF_MAX_S"
+        ),
+        attempt_timeout_s=env_registry.get_float("AREAL_RPC_TIMEOUT_S"),
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def shed_backoff(
+    consecutive_sheds: int, retry_after: float, cap: float = 10.0
+) -> float:
+    """THE client-side 429 discipline: a jittered wait around the
+    server's Retry-After hint with a mild exponential ramp on
+    consecutive sheds — synchronized retries from many workers would
+    re-create the very burst that tripped the admission watermark.
+    Sheds are deliberate backpressure: they never touch breakers or
+    failure budgets."""
+    k = max(1, int(consecutive_sheds))
+    delay = min(cap, float(retry_after) * (2 ** min(k - 1, 3)))
+    return delay * (0.5 + random.random())
+
+
+def hedge_delay_s() -> float:
+    return env_registry.get_float("AREAL_RPC_HEDGE_DELAY_S")
+
+
+def hedging_enabled() -> bool:
+    return env_registry.get_bool("AREAL_RPC_HEDGE")
+
+
+# ----------------------------------------------------------------------
+# Circuit breakers
+# ----------------------------------------------------------------------
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Per-peer closed -> open -> half-open breaker.
+
+    ``fail_threshold`` consecutive failures open the circuit; after
+    ``cooldown_s`` ONE probe is allowed through (half-open); its
+    success closes the circuit, its failure re-opens it for another
+    cooldown. Thread-safe: the manager's poll thread and HTTP loop
+    both touch the board."""
+
+    __slots__ = (
+        "peer", "fail_threshold", "cooldown_s", "_lock",
+        "_consecutive", "_opened_at", "_probing", "opens", "rejections",
+    )
+
+    def __init__(self, peer: str, fail_threshold: int, cooldown_s: float):
+        self.peer = peer
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self._probing = False
+        self.opens = 0
+        self.rejections = 0
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return STATE_CLOSED
+        if self._probing or (
+            time.monotonic() - self._opened_at >= self.cooldown_s
+        ):
+            return STATE_HALF_OPEN
+        return STATE_OPEN
+
+    def allow(self) -> bool:
+        """May a call proceed right now? In half-open exactly one
+        caller wins the probe slot; everyone else is rejected until
+        the probe resolves."""
+        with self._lock:
+            st = self._state_locked()
+            if st == STATE_CLOSED:
+                return True
+            if st == STATE_HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            self.rejections += 1
+            stats.incr("breaker_rejections")
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
+            self._probing = False
+
+    def release_probe(self):
+        """Give an allow()-granted probe slot back without an outcome
+        (the attempt ended in something that is neither success nor a
+        peer failure — e.g. a non-retryable application error). The
+        slot MUST be resolved one way or another: a leaked slot makes
+        _state_locked() report half-open forever and every future
+        allow() reject, wedging the peer out permanently."""
+        with self._lock:
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._consecutive += 1
+            if self._probing:
+                # Failed half-open probe: re-open for a fresh cooldown.
+                self._probing = False
+                self._opened_at = time.monotonic()
+                self.opens += 1
+                stats.incr("breaker_opens")
+                return
+            if self._opened_at is not None:
+                # record()-fed boards (the manager never calls allow();
+                # failures arrive as client reports / its own polls):
+                # once the cooldown has elapsed the breaker is
+                # half-open by time, and this failure IS the failed
+                # probe — re-open for a fresh cooldown, or the peer
+                # would sit half-open forever and re-enter rotation
+                # while still failing. A failure landing inside the
+                # cooldown leaves the open window untouched.
+                if time.monotonic() - self._opened_at >= self.cooldown_s:
+                    self._opened_at = time.monotonic()
+                    self.opens += 1
+                    stats.incr("breaker_opens")
+                return
+            if self._consecutive >= self.fail_threshold:
+                self._opened_at = time.monotonic()
+                self.opens += 1
+                stats.incr("breaker_opens")
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "state": self._state_locked(),
+                "consecutive_failures": self._consecutive,
+                "opens": self.opens,
+                "rejections": self.rejections,
+            }
+
+
+class BreakerBoard:
+    """All of one process's per-peer breakers. The gserver manager
+    folds its board into routing (an open peer is unroutable, like a
+    shedding one — never evicted for it) and surfaces it on /status."""
+
+    def __init__(
+        self,
+        fail_threshold: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+    ):
+        self.fail_threshold = (
+            int(fail_threshold)
+            if fail_threshold is not None
+            else env_registry.get_int("AREAL_RPC_BREAKER_FAILS")
+        )
+        self.cooldown_s = (
+            float(cooldown_s)
+            if cooldown_s is not None
+            else env_registry.get_float("AREAL_RPC_BREAKER_COOLDOWN_S")
+        )
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+
+    def breaker(self, peer: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(peer)
+            if br is None:
+                br = CircuitBreaker(
+                    peer, self.fail_threshold, self.cooldown_s
+                )
+                self._breakers[peer] = br
+            return br
+
+    def allow(self, peer: str) -> bool:
+        return self.breaker(peer).allow()
+
+    def record(self, peer: str, ok: bool):
+        br = self.breaker(peer)
+        if ok:
+            br.record_success()
+        else:
+            br.record_failure()
+
+    def drop(self, peer: str):
+        """Forget a departed peer (manager _forget_server hook)."""
+        with self._lock:
+            self._breakers.pop(peer, None)
+
+    def open_peers(self) -> List[str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return sorted(p for p, b in items if b.state() == STATE_OPEN)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {p: b.snapshot() for p, b in items}
+
+
+# ----------------------------------------------------------------------
+# Stats (areal:rpc_* surface)
+# ----------------------------------------------------------------------
+
+
+class RpcStats:
+    """Process-global substrate counters, emitted as areal:rpc_* lines
+    by generation_server._h_metrics and the manager /status rpc
+    section. Monotonic since process start, like every /metrics
+    counter."""
+
+    FIELDS = (
+        "attempts", "retries", "failures",
+        "hedges", "hedge_wins", "hedge_cancelled", "hedge_failures",
+        "deadline_expired", "breaker_rejections", "breaker_opens",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {f: 0 for f in self.FIELDS}
+
+    def incr(self, field: str, n: int = 1):
+        with self._lock:
+            self._c[field] += n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._c)
+
+    def reset(self):
+        """Test/bench hook only — production counters never reset."""
+        with self._lock:
+            for f in self.FIELDS:
+                self._c[f] = 0
+
+
+stats = RpcStats()
+
+
+# ----------------------------------------------------------------------
+# Sync substrate (urllib; executor/poll threads only, never an event
+# loop — the blocking-async lint contract)
+# ----------------------------------------------------------------------
+
+
+def retry_sync(
+    fn: Callable[[float], T],
+    *,
+    policy: RetryPolicy,
+    deadline: Optional[Deadline] = None,
+    peer: Optional[str] = None,
+    board: Optional[BreakerBoard] = None,
+    retryable: Tuple[type, ...] = RETRYABLE_DEFAULT,
+    what: str = "rpc",
+) -> T:
+    """THE sync retry loop. ``fn(timeout_s)`` runs up to
+    ``policy.attempts`` times with budget-derived per-attempt timeouts;
+    ``retryable`` failures back off (jittered, Retry-After-floored via
+    :class:`RpcShed`) and retry; anything else propagates. The breaker
+    (when given) gates every attempt and records the outcome."""
+    last: Optional[BaseException] = None
+    br = board.breaker(peer) if (board is not None and peer) else None
+    for attempt in range(1, policy.attempts + 1):
+        timeout = policy.attempt_timeout(deadline)  # raises when expired
+        if br is not None and not br.allow():
+            raise BreakerOpen(peer or "?", what)
+        stats.incr("attempts")
+        try:
+            out = fn(timeout)
+        except RpcShed as e:
+            # Shed is deliberate backpressure, and PROOF the peer is
+            # alive and answering: a success for breaker purposes
+            # (also resolves a held half-open probe slot — a leaked
+            # slot would reject the peer forever).
+            last = e
+            if br is not None:
+                br.record_success()
+            if attempt >= policy.attempts:
+                break
+            stats.incr("retries")
+            time.sleep(policy.backoff(attempt, retry_after=e.retry_after,
+                                      deadline=deadline))
+            continue
+        except retryable as e:
+            last = e
+            if br is not None:
+                br.record_failure()
+            if attempt >= policy.attempts:
+                break
+            stats.incr("retries")
+            logger.debug(f"{what}: attempt {attempt} failed: {e!r}")
+            time.sleep(policy.backoff(attempt, deadline=deadline))
+            continue
+        except BaseException:
+            # Non-retryable application error: neither a peer failure
+            # nor a success — but the probe slot must not leak.
+            if br is not None:
+                br.release_probe()
+            raise
+        if br is not None:
+            br.record_success()
+        return out
+    stats.incr("failures")
+    raise RpcError(
+        f"{what}: failed after {policy.attempts} attempt(s): {last!r}"
+    ) from last
+
+
+def get_bytes_sync(
+    url: str,
+    *,
+    policy: Optional[RetryPolicy] = None,
+    deadline: Optional[Deadline] = None,
+    headers: Optional[Dict[str, str]] = None,
+    peer: Optional[str] = None,
+    board: Optional[BreakerBoard] = None,
+    what: str = "GET",
+) -> bytes:
+    """Budget-aware GET returning the body bytes. 429s raise
+    :class:`RpcShed` internally so the loop floors its backoff on the
+    server's Retry-After."""
+    import urllib.error
+    import urllib.request
+
+    policy = policy or default_policy()
+
+    def attempt(timeout: float) -> bytes:
+        dl = deadline or Deadline.after(timeout)
+        req = urllib.request.Request(url, headers=dl.headers(headers))
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 429:
+                ra = e.headers.get("Retry-After") if e.headers else None
+                raise RpcShed(url, float(ra or 1.0)) from e
+            if e.code >= 500:
+                raise OSError(f"{url}: server error {e.code}") from e
+            # Deliberate non-retryable status (404/416/...): re-wrap —
+            # HTTPError subclasses OSError via URLError, so a bare
+            # `raise` would be swallowed by RETRYABLE_DEFAULT and
+            # burned against the budget attempts-1 more times.
+            raise RpcError(f"{url}: HTTP {e.code}") from e
+        except urllib.error.URLError as e:
+            raise OSError(f"{url}: {e.reason}") from e
+
+    return retry_sync(
+        attempt, policy=policy, deadline=deadline, peer=peer,
+        board=board, what=f"{what} {url}",
+    )
+
+
+def get_json_sync(url: str, **kw) -> Any:
+    import json
+
+    return json.loads(get_bytes_sync(url, **kw))
+
+
+def hedged_sync(
+    fns: Sequence[Callable[[], T]],
+    *,
+    hedge_delay: Optional[float] = None,
+    deadline: Optional[Deadline] = None,
+    what: str = "hedged",
+) -> Tuple[T, int]:
+    """Hedged execution of idempotent fetchers: ``fns[0]`` starts
+    immediately; each time the race has gone ``hedge_delay`` seconds
+    without a winner the next fn launches. First SUCCESS wins and is
+    returned with its index; losers are abandoned (their results are
+    dropped on the floor, never returned — callers therefore cannot
+    double-count loser bytes) and counted in ``hedge_cancelled``.
+
+    Sync variant runs hedges on daemon threads (urllib cannot be
+    cancelled mid-read; the abandoned socket dies with the thread).
+    Raises the primary's error once every launched fn has failed."""
+    if not fns:
+        raise ValueError("hedged_sync: no fetchers")
+    if hedge_delay is None:
+        hedge_delay = hedge_delay_s()
+    done = threading.Event()
+    lock = threading.Lock()
+    results: Dict[int, Tuple[bool, Any]] = {}
+
+    def run(i: int):
+        try:
+            out = fns[i]()
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — race bookkeeping
+            out = e
+            ok = False
+        with lock:
+            results[i] = (ok, out)
+        done.set()
+
+    launched = 0
+
+    def launch():
+        nonlocal launched
+        i = launched
+        launched += 1
+        if i > 0:
+            stats.incr("hedges")
+        threading.Thread(
+            target=run, args=(i,), daemon=True, name=f"rpc-hedge-{i}"
+        ).start()
+
+    launch()
+    while True:
+        rem = deadline.remaining() if deadline is not None else float("inf")
+        if rem <= 0:
+            stats.incr("deadline_expired")
+            raise RpcDeadlineExceeded(f"{what}: deadline expired mid-race")
+        wait = min(hedge_delay, rem) if launched < len(fns) else min(rem, 60.0)
+        fired = done.wait(wait)
+        with lock:
+            done.clear()
+            winner = next(
+                (i for i, (ok, _) in sorted(results.items()) if ok), None
+            )
+            failures = sum(1 for ok, _ in results.values() if not ok)
+            if winner is not None:
+                out = results[winner][1]
+                # Everything else launched loses: abandoned threads and
+                # late results alike are dropped, never returned.
+                losers = launched - 1 - failures
+                if losers > 0:
+                    stats.incr("hedge_cancelled", losers)
+                if winner > 0:
+                    stats.incr("hedge_wins")
+                return out, winner
+        if failures >= len(fns):
+            # hedge_failures counts WHOLE races lost, exactly once —
+            # the per-leg retry exhaustion already landed in
+            # "failures", and a transient leg failure inside a race
+            # the hedge still WON must not read as a hedge failure.
+            stats.incr("failures")
+            stats.incr("hedge_failures")
+            err0 = results[0][1]
+            raise RpcError(f"{what}: every hedge failed") from (
+                err0 if isinstance(err0, BaseException) else None
+            )
+        # Launch the next hedge on silence, or immediately when every
+        # launched attempt has already failed.
+        if launched < len(fns) and (not fired or failures >= launched):
+            launch()
+
+
+# ----------------------------------------------------------------------
+# Async substrate (aiohttp; event-loop callers)
+# ----------------------------------------------------------------------
+
+
+async def retry_async(
+    fn: Callable[[float], Awaitable[T]],
+    *,
+    policy: RetryPolicy,
+    deadline: Optional[Deadline] = None,
+    peer: Optional[str] = None,
+    board: Optional[BreakerBoard] = None,
+    retryable: Tuple[type, ...] = RETRYABLE_DEFAULT,
+    what: str = "rpc",
+) -> T:
+    """Async twin of :func:`retry_sync`: same policy semantics, same
+    breaker/deadline/shed handling, sleeps on the event loop."""
+    import asyncio
+
+    last: Optional[BaseException] = None
+    br = board.breaker(peer) if (board is not None and peer) else None
+    for attempt in range(1, policy.attempts + 1):
+        timeout = policy.attempt_timeout(deadline)  # raises when expired
+        if br is not None and not br.allow():
+            raise BreakerOpen(peer or "?", what)
+        stats.incr("attempts")
+        try:
+            out = await fn(timeout)
+        except RpcShed as e:
+            # Alive-and-answering: a breaker success (and probe-slot
+            # resolution), same as the sync twin.
+            last = e
+            if br is not None:
+                br.record_success()
+            if attempt >= policy.attempts:
+                break
+            stats.incr("retries")
+            await asyncio.sleep(policy.backoff(
+                attempt, retry_after=e.retry_after, deadline=deadline
+            ))
+            continue
+        except asyncio.CancelledError:
+            if br is not None:
+                br.release_probe()
+            raise
+        except retryable as e:
+            last = e
+            if br is not None:
+                br.record_failure()
+            if attempt >= policy.attempts:
+                break
+            stats.incr("retries")
+            logger.debug(f"{what}: attempt {attempt} failed: {e!r}")
+            await asyncio.sleep(policy.backoff(attempt, deadline=deadline))
+            continue
+        except BaseException:
+            if br is not None:
+                br.release_probe()
+            raise
+        if br is not None:
+            br.record_success()
+        return out
+    stats.incr("failures")
+    raise RpcError(
+        f"{what}: failed after {policy.attempts} attempt(s): {last!r}"
+    ) from last
+
+
+async def hedged_async(
+    fns: Sequence[Callable[[], Awaitable[T]]],
+    *,
+    hedge_delay: Optional[float] = None,
+    deadline: Optional[Deadline] = None,
+    what: str = "hedged",
+) -> Tuple[T, int]:
+    """Async hedged execution with REAL loser cancellation: the first
+    success wins (returned with its launch index), every other
+    in-flight task is cancelled — the socket is torn down, the bytes
+    never arrive, so callers cannot double-count loser traffic — and
+    counted in ``hedge_cancelled``. A new hedge launches after each
+    ``hedge_delay`` of silence, or immediately when every in-flight
+    attempt has already failed. Raises once all fns have failed."""
+    import asyncio
+
+    if not fns:
+        raise ValueError("hedged_async: no fetchers")
+    if hedge_delay is None:
+        hedge_delay = hedge_delay_s()
+
+    async def indexed(i: int) -> Tuple[int, T]:
+        return i, await fns[i]()
+
+    inflight: List[asyncio.Task] = [asyncio.ensure_future(indexed(0))]
+    launched = 1
+    failed = 0
+    first_err: Optional[BaseException] = None
+    try:
+        while True:
+            rem = (
+                deadline.remaining() if deadline is not None else float("inf")
+            )
+            if rem <= 0:
+                stats.incr("deadline_expired")
+                raise RpcDeadlineExceeded(f"{what}: deadline expired mid-race")
+            can_launch = launched < len(fns)
+            wait = min(hedge_delay, rem) if can_launch else min(rem, 60.0)
+            done, pending = await asyncio.wait(
+                inflight, timeout=wait, return_when=asyncio.FIRST_COMPLETED
+            )
+            for t in done:
+                inflight.remove(t)
+                if t.cancelled():
+                    failed += 1
+                    continue
+                if t.exception() is not None:
+                    failed += 1
+                    if first_err is None:
+                        first_err = t.exception()
+                    continue
+                winner_idx, out = t.result()
+                if pending:
+                    for p in pending:
+                        p.cancel()
+                    stats.incr("hedge_cancelled", len(pending))
+                    await asyncio.gather(*pending, return_exceptions=True)
+                if winner_idx > 0:
+                    stats.incr("hedge_wins")
+                return out, winner_idx
+            if failed >= len(fns):
+                stats.incr("failures")
+                stats.incr("hedge_failures")
+                raise RpcError(f"{what}: every hedge failed") from first_err
+            # Launch the next hedge on silence (timeout) or immediately
+            # when everything in flight has already failed.
+            if can_launch and (not done or not inflight):
+                stats.incr("hedges")
+                inflight.append(asyncio.ensure_future(indexed(launched)))
+                launched += 1
+    finally:
+        for t in inflight:
+            if not t.done():
+                t.cancel()
+
+
+# ----------------------------------------------------------------------
+# rpc-discipline lint registry
+# ----------------------------------------------------------------------
+
+# The ONE module allowed to hold raw HTTP retry loops. The
+# rpc-discipline checker (areal_tpu/lint/rpc_discipline.py) flags
+# HTTP-call-plus-sleep loops and numeric-literal per-call timeouts in
+# any module not named here. Deliberately a one-entry tuple: new
+# entries need a justification comment AND the checker's tests keep
+# the contract honest. (repo-relative paths)
+LINT_RPC_MODULES = ("areal_tpu/base/rpc.py",)

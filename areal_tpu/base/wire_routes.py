@@ -73,7 +73,11 @@ _ROUTES: List[Route] = [
        "liveness rides the name_resolve heartbeat registry instead.",
        operator=True),
     _r("POST", "/configure", (GS,),
-       "Live re-configuration (admission watermarks, bench knobs)."),
+       "Live re-configuration (admission watermarks, bench knobs). "
+       "Chaos control (faults/faults_reset/faults_hits keys) answers "
+       "403 unless the server booted with AREAL_CHAOS_HTTP=1, and 400 "
+       "for a hits query naming an undeclared fault point.",
+       statuses=(400, 403)),
     # -- generation server: disagg KV handoff wire -----------------------
     _r("POST", "/kv_handoff", (GS,),
        "Prefill->decode handoff offer: decode side pulls the blob and "
@@ -173,3 +177,15 @@ del _route
 # Statuses every route may emit without declaring: success, ranged
 # success, and the generic unhandled-exception 500.
 IMPLICIT_STATUSES = (200, 206, 500)
+
+# -- cross-route header contract ------------------------------------------
+# The ONE deadline header every route honors (base/rpc.py). Wire rule:
+# the OUTERMOST caller mints a budget; every outbound hop stamps the
+# REMAINING seconds (decimal, e.g. "12.345") into this header, and
+# every server re-anchors it against its own monotonic clock — budgets
+# therefore decrement across hops and clocks never need to agree. A
+# request arriving with an expired budget is answered with whatever
+# cheap refusal the route already declares (429/503/etc.) instead of
+# burning work the caller will never consume; absence of the header
+# means "unbounded" (operator curl, legacy callers).
+DEADLINE_HEADER = "X-Areal-Deadline"

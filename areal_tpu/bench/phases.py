@@ -272,6 +272,22 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="rpc_resilience",
+    entrypoint="areal_tpu.bench.workloads:rpc_resilience_phase",
+    priority=12,
+    est_compile_s=0.0,  # host + loopback HTTP only: no compile pass
+    est_measure_s=30.0,
+    min_window_s=0.0,
+    proxy=True,
+    description="RPC substrate tail-latency A/B: hedged vs unhedged "
+                "hash-verified chunk pulls from two loopback holders "
+                "under the injected-delay chaos action — hedged p99 "
+                "must sit near the hedge delay, unhedged near the "
+                "injected tail, with win/cancel accounting "
+                "(host-side; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
     name="weight_update",
     entrypoint="areal_tpu.bench.workloads:weight_update_phase",
     priority=12,

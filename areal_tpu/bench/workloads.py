@@ -1805,6 +1805,138 @@ def train_tflops_scaling_phase(pass_: str) -> dict:
     }
 
 
+def rpc_resilience_phase(pass_: str) -> dict:
+    """Hedged vs unhedged chunk-pull tail latency under injected delay
+    (ISSUE 14 acceptance): two peer holders serve the same hash-verified
+    /weights/chunk stream over loopback HTTP; the chaos ``delay``
+    action makes every ODD serve slow (alternating at_hit windows), so
+    an unhedged client eats the injected tail on half its pulls while a
+    hedged client (base/rpc.py hedged_sync, first verified chunk wins,
+    losers abandoned) escapes it for the price of the hedge delay.
+    Proxy evidence by construction (loopback, injected tail): what it
+    banks is the SUBSTRATE's behavior — hedged p99 must sit near the
+    hedge delay, unhedged p99 near the injected delay — plus the
+    win/cancel accounting the no-double-count tests pin."""
+    if pass_ == "compile":
+        return {"compile_s": 0.0}  # host + loopback only
+    import shutil
+    import tempfile
+
+    from areal_tpu.base import rpc
+    from areal_tpu.base.chunking import verify_chunk
+    from areal_tpu.base.fault_injection import faults
+    from areal_tpu.engine.weight_client import ChunkStore, fetch_manifest
+    from areal_tpu.system.weight_plane import (
+        PeerStoreServer, WeightPlaneSource,
+    )
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    delay_s = 0.35       # injected tail (the slow-peer stand-in)
+    hedge_delay_s = 0.05  # silence window before the hedge launches
+    rng = np.random.RandomState(3)
+    params = {
+        "layers": {
+            f"l{i:02d}": {
+                "w": rng.standard_normal((128, 128)).astype(np.float32)
+            }
+            for i in range(16)
+        }
+    }
+    tmp = tempfile.mkdtemp(prefix="areal_rpc_bench_")
+    src = None
+    peers = []
+    faults.reset()
+    try:
+        dump_raw_params(params, tmp, version=1, chunk_bytes=1 << 15)
+        src = WeightPlaneSource(tmp, chunk_bytes=1 << 15).start()
+        man = fetch_manifest(src.address, version=1)
+        n_chunks = int(man["n_chunks"])
+        for _ in range(2):
+            peer = PeerStoreServer().start()
+            peer.store = ChunkStore(man)
+            peer.store.fetch([src.address], origin=src.address)
+            peers.append(peer)
+
+        def pull(peer_url, idx):
+            def fetch():
+                data = rpc.get_bytes_sync(
+                    f"{peer_url}/weights/chunk?version=1&idx={idx}",
+                    policy=rpc.default_policy(attempts=2),
+                    what="bench chunk",
+                )
+                if not verify_chunk(data, man["hashes"][idx]):
+                    raise ValueError(f"chunk {idx} hash mismatch")
+                return data
+            return fetch
+
+        def arm_odd_hits_slow():
+            """Every odd serve_chunk hit sleeps ``delay_s``: the
+            unhedged arm's every-other-pull tail, and the hedged arm's
+            every-primary tail (primary odd, hedge even)."""
+            faults.reset()
+            for i in range(2 * n_chunks + 4):
+                faults.arm(
+                    "weight_plane.serve_chunk", action="delay",
+                    delay_s=delay_s, at_hit=2 * i + 1, times=1,
+                )
+
+        def p(q, xs):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+        # -- arm A: unhedged (one holder, no race) ----------------------
+        arm_odd_hits_slow()
+        unhedged_ms = []
+        for i in range(n_chunks):
+            t0 = time.perf_counter()
+            pull(peers[0].address, i)()
+            unhedged_ms.append((time.perf_counter() - t0) * 1000.0)
+
+        # -- arm B: hedged (two holders, loser cancelled) ---------------
+        arm_odd_hits_slow()
+        before = rpc.stats.snapshot()
+        hedged_ms = []
+        for i in range(n_chunks):
+            t0 = time.perf_counter()
+            rpc.hedged_sync(
+                [pull(peers[0].address, i), pull(peers[1].address, i)],
+                hedge_delay=hedge_delay_s,
+            )
+            hedged_ms.append((time.perf_counter() - t0) * 1000.0)
+        after = rpc.stats.snapshot()
+
+        out = {
+            "n_chunks": float(n_chunks),
+            "injected_delay_ms": delay_s * 1000.0,
+            "hedge_delay_ms": hedge_delay_s * 1000.0,
+            "unhedged_p50_ms": p(0.5, unhedged_ms),
+            "unhedged_p99_ms": p(0.99, unhedged_ms),
+            "hedged_p50_ms": p(0.5, hedged_ms),
+            "hedged_p99_ms": p(0.99, hedged_ms),
+            "hedge_wins": float(
+                after["hedge_wins"] - before["hedge_wins"]
+            ),
+            "hedge_cancelled": float(
+                after["hedge_cancelled"] - before["hedge_cancelled"]
+            ),
+            # The dedicated whole-race counter, NOT "failures": a
+            # transient single-leg blip inside a race the hedge WON
+            # would otherwise fail the validator's zero-failures tooth.
+            "hedge_failures": float(
+                after["hedge_failures"] - before["hedge_failures"]
+            ),
+        }
+        log(f"bench: rpc_resilience {out}")
+        return out
+    finally:
+        faults.reset()
+        for peer in peers:
+            peer.close()
+        if src is not None:
+            src.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def weight_update_phase(pass_: str) -> dict:
     """Weight-distribution plane end-to-end on loopback HTTP: dump a
     raw-bin payload, serve it from a WeightPlaneSource origin, fan it

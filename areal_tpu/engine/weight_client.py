@@ -26,18 +26,24 @@ import urllib.parse
 import urllib.request
 from typing import Any, Dict, List, Optional, Tuple
 
-from areal_tpu.base import logging
+from areal_tpu.base import logging, rpc
 from areal_tpu.base.chunking import CHUNK_SCHEMA, chunk_spans, verify_chunk
 
 logger = logging.getLogger("weight_client")
 
-# Per-chunk, per-upstream (re)connection budget. Mid-chunk drops resume
-# with a Range request, so each retry re-pays at most the torn tail.
+# Per-chunk, per-upstream (re)connection budget (base/rpc.py policy).
+# Mid-chunk drops resume with a Range request, so each retry re-pays at
+# most the torn tail.
 _CHUNK_ATTEMPTS = 3
 
 
 class WeightFetchError(RuntimeError):
     """The payload could not be completed from any upstream."""
+
+
+class ChunkHashMismatch(ValueError):
+    """A chunk's bytes failed sha256 verification (torn or corrupted
+    upstream). Retryable: the re-fetch restarts the whole chunk."""
 
 
 def http_get_json(url: str, timeout: float = 10.0) -> Dict:
@@ -170,39 +176,57 @@ class ChunkStore:
             return r.read(length - start)
 
     def _fetch_chunk(
-        self, base_url: str, idx: int, timeout: float
+        self, base_url: str, idx: int, timeout: float,
+        deadline: Optional[rpc.Deadline] = None,
     ) -> Optional[bytes]:
-        """One chunk from one upstream, resuming torn reads mid-chunk.
-        Returns verified bytes or None (upstream failed / hash lied)."""
+        """One chunk from one upstream under the unified RPC policy
+        (base/rpc.py): budget-derived attempt timeouts, jittered
+        backoff, mid-chunk Range resume on torn reads, and a full
+        re-fetch on hash mismatch (a corrupted upstream is retryable —
+        the ``corrupt`` chaos action must never complete a transfer).
+        Returns verified bytes or None (upstream exhausted)."""
         _, length = self.spans[idx]
         expected = self.manifest["hashes"][idx]
         part = b""
-        for attempt in range(_CHUNK_ATTEMPTS):
-            try:
-                got = self._get_range(base_url, idx, len(part), length, timeout)
-            except (urllib.error.URLError, OSError, ValueError) as e:
-                logger.debug(
-                    f"chunk {idx} from {base_url}: attempt {attempt} "
-                    f"failed at {len(part)}/{length}: {e}"
-                )
-                time.sleep(0.05 * (attempt + 1))
-                continue
+
+        def attempt(attempt_timeout: float) -> bytes:
+            nonlocal part
+            got = self._get_range(
+                base_url, idx, len(part), length,
+                min(timeout, attempt_timeout),
+            )
             if part:
-                self.resumed_chunks += 1
+                with self._lock:
+                    self.resumed_chunks += 1
             part += got
             if len(part) < length:
-                continue  # short read: resume from the new offset
+                raise OSError(
+                    f"short read {len(part)}/{length}"
+                )  # resume from the new offset next attempt
             t0 = time.monotonic()
             ok = verify_chunk(part, expected)
-            self.verify_s += time.monotonic() - t0
-            if ok:
-                return part
-            logger.warning(
-                f"chunk {idx} from {base_url}: content-hash mismatch; "
-                f"discarding and trying the next upstream"
+            with self._lock:
+                self.verify_s += time.monotonic() - t0
+            if not ok:
+                part = b""  # poisoned: restart the whole chunk
+                raise ChunkHashMismatch(
+                    f"chunk {idx} from {base_url}: content-hash mismatch"
+                )
+            return part
+
+        try:
+            return rpc.retry_sync(
+                attempt,
+                policy=rpc.default_policy(attempts=_CHUNK_ATTEMPTS),
+                deadline=deadline,
+                retryable=(urllib.error.URLError, OSError, ValueError),
+                what=f"weights/chunk {idx} <- {base_url}",
             )
+        except rpc.RpcDeadlineExceeded:
+            raise
+        except rpc.RpcError as e:
+            logger.debug(f"chunk {idx} from {base_url}: {e}")
             return None
-        return None
 
     def fetch(
         self,
@@ -210,11 +234,21 @@ class ChunkStore:
         origin: Optional[str] = None,
         timeout: float = 30.0,
         deadline_s: float = 600.0,
+        deadline: Optional[rpc.Deadline] = None,
+        hedge: Optional[bool] = None,
     ) -> Dict[str, Any]:
         """Pull every missing chunk, trying ``upstreams`` in order per
         chunk (sticky: the last upstream that delivered is tried first
-        for the next chunk). Raises WeightFetchError if any chunk cannot
-        be completed from any upstream before the deadline.
+        for the next chunk). When several PEER holders can serve the
+        stream, each chunk pull is HEDGED (base/rpc.py): a second
+        holder races the first after ``AREAL_RPC_HEDGE_DELAY_S`` of
+        silence, first verified chunk wins, and only the winner's
+        bytes land in ``bytes_from`` — losers are abandoned, so the
+        ingress accounting can never double-count. The origin is
+        deliberately excluded from hedges: the O(1)-origin-egress
+        assertion must hold even under tail latency. Raises
+        WeightFetchError if any chunk cannot be completed from any
+        upstream before the deadline.
 
         Returns the transfer stats dict (also kept on the store)."""
         t_start = time.monotonic()
@@ -222,11 +256,15 @@ class ChunkStore:
         if not order:
             raise WeightFetchError("no upstreams to fetch from")
         origin = origin.rstrip("/") if origin else None
+        if deadline is None:
+            deadline = rpc.Deadline.after(deadline_s)
+        if hedge is None:
+            hedge = rpc.hedging_enabled()
         preferred = 0
         for idx in range(self.n_chunks):
             if self._have[idx]:
                 continue
-            if time.monotonic() - t_start > deadline_s:
+            if deadline.expired():
                 raise WeightFetchError(
                     f"weight fetch v{self.version} deadline after "
                     f"{idx}/{self.n_chunks} chunks"
@@ -235,25 +273,65 @@ class ChunkStore:
             tried = [order[preferred]] + [
                 u for i, u in enumerate(order) if i != preferred
             ]
-            for u in tried:
-                got = self._fetch_chunk(u, idx, timeout)
-                if got is not None:
-                    preferred = order.index(u)
-                    with self._lock:
-                        self.bytes_from[u] = (
-                            self.bytes_from.get(u, 0) + len(got)
-                        )
-                    break
+            # Hedge candidates: the first two PEER upstreams in sticky
+            # order (never the origin).
+            peers = [u for u in tried if u != origin]
+            if hedge and len(peers) >= 2:
+                def _mk(u):
+                    return lambda: self._hedge_fetch(u, idx, timeout, deadline)
+                try:
+                    got, winner = rpc.hedged_sync(
+                        [_mk(peers[0]), _mk(peers[1])],
+                        deadline=deadline,
+                        what=f"weights/chunk {idx} v{self.version}",
+                    )
+                    winner_url = peers[winner]
+                except rpc.RpcDeadlineExceeded:
+                    raise
+                except rpc.RpcError:
+                    got = None
+                # Hedge losers resolved: fall through to the remaining
+                # upstreams (origin included) only on total miss.
+                if got is None:
+                    rest = [u for u in tried if u not in peers[:2]]
+                    for u in rest:
+                        got = self._fetch_chunk(u, idx, timeout, deadline)
+                        if got is not None:
+                            winner_url = u
+                            break
+            else:
+                winner_url = None
+                for u in tried:
+                    got = self._fetch_chunk(u, idx, timeout, deadline)
+                    if got is not None:
+                        winner_url = u
+                        break
             if got is None:
                 raise WeightFetchError(
                     f"chunk {idx}/{self.n_chunks} of v{self.version} "
                     f"unavailable from all of {tried}"
+                )
+            if winner_url in order:
+                preferred = order.index(winner_url)
+            with self._lock:
+                self.bytes_from[winner_url] = (
+                    self.bytes_from.get(winner_url, 0) + len(got)
                 )
             off, _ = self.spans[idx]
             self.buf[off : off + len(got)] = got
             self._have[idx] = True
         self.fetch_s = time.monotonic() - t_start
         return self.stats(origin)
+
+    def _hedge_fetch(
+        self, url: str, idx: int, timeout: float, deadline: rpc.Deadline
+    ) -> bytes:
+        """One hedge leg: like _fetch_chunk but raising on miss so the
+        race can distinguish failure from success."""
+        got = self._fetch_chunk(url, idx, timeout, deadline)
+        if got is None:
+            raise OSError(f"chunk {idx} unavailable from {url}")
+        return got
 
     def stats(self, origin: Optional[str] = None) -> Dict[str, Any]:
         origin = origin.rstrip("/") if origin else None

@@ -22,10 +22,10 @@ from __future__ import annotations
 
 import asyncio
 import os
-import random
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.base import logging as areal_logging
+from areal_tpu.base import rpc
 
 logger = areal_logging.getLogger("functioncall.remote")
 
@@ -49,38 +49,46 @@ def remote_enabled() -> bool:
 async def _post_with_retries(
     session, url: str, batch: List[Dict], timeout_s: float
 ) -> List[Dict]:
+    """One batch POST under the unified RPC policy (base/rpc.py):
+    the substrate owns attempts/backoff/per-attempt timeout; the
+    verifier keeps only its contract — every failure is retryable
+    (a reward must never take the trainer down) and exhaustion scores
+    the whole batch False via []."""
     import aiohttp
 
-    delay = INITIAL_RETRY_S
-    last_err: Optional[BaseException] = None
-    for attempt in range(MAX_RETRIES + 1):
-        try:
-            async with session.post(
-                url, json=batch,
-                timeout=aiohttp.ClientTimeout(total=timeout_s),
-            ) as resp:
-                if resp.status >= 500:
-                    raise RuntimeError(f"server error {resp.status}")
-                resp.raise_for_status()
-                out = await resp.json()
-                if not isinstance(out, list):
-                    raise ValueError(f"malformed response: {type(out)}")
-                return out
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:  # noqa: BLE001 — every failure retries
-            last_err = e
-            if attempt == MAX_RETRIES:
-                break
-            sleep_s = min(delay * (2 ** attempt) + random.uniform(0, 0.5),
-                          MAX_RETRY_S)
-            logger.warning(
-                f"verifier call failed (attempt {attempt + 1}/"
-                f"{MAX_RETRIES + 1}): {e!r}; retrying in {sleep_s:.1f}s"
-            )
-            await asyncio.sleep(sleep_s)
-    logger.error(f"verifier batch failed permanently: {last_err!r}")
-    return []
+    async def attempt(attempt_timeout: float) -> List[Dict]:
+        async with session.post(
+            url, json=batch,
+            timeout=aiohttp.ClientTimeout(total=attempt_timeout),
+        ) as resp:
+            if resp.status >= 500:
+                raise OSError(f"server error {resp.status}")
+            resp.raise_for_status()
+            out = await resp.json()
+            if not isinstance(out, list):
+                raise ValueError(f"malformed response: {type(out)}")
+            return out
+
+    try:
+        # No deadline on purpose: the historical contract grants every
+        # attempt the FULL timeout_s with backoff sleeps on top (a
+        # shared budget would silently shorten the last attempts) — a
+        # reward verifier answers to the trainer's patience, not to a
+        # propagated rollout budget.
+        return await rpc.retry_async(
+            attempt,
+            policy=rpc.RetryPolicy(
+                attempts=MAX_RETRIES + 1,
+                backoff_base_s=INITIAL_RETRY_S,
+                backoff_max_s=MAX_RETRY_S,
+                attempt_timeout_s=timeout_s,
+            ),
+            retryable=(Exception,),
+            what=f"verifier {url}",
+        )
+    except rpc.RpcError as e:
+        logger.error(f"verifier batch failed permanently: {e!r}")
+        return []
 
 
 async def batch_verify_async(

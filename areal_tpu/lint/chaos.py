@@ -20,6 +20,12 @@ keeps going green while testing nothing. Flags, per module:
 
 Points under ``fault_points.TEST_PREFIX`` (``test.``) are reserved
 for the injector's own unit suite and exempt everywhere.
+
+Registry-driven sweeps (the all-points chaos campaign) can't name
+points literally; they use ``faults.arm_declared`` /
+``faults.hits_declared``, whose runtime registry check is the dynamic
+equivalent of this checker — those calls pass with non-literal names,
+while literal names are still verified statically.
 """
 
 from __future__ import annotations
@@ -36,8 +42,14 @@ CHECKER = "chaos-registry"
 REGISTRY_MODULE = "areal_tpu.base.fault_points"
 REGISTRY_REL = "areal_tpu/base/fault_points.py"
 
-_MAYBE_FAIL = ("maybe_fail", "maybe_fail_async")
+_MAYBE_FAIL = ("maybe_fail", "maybe_fail_async", "maybe_corrupt",
+               "maybe_corrupt_async")
 _TEST_SIDE = ("arm", "hits")
+# Registry-verified-at-runtime variants (fault_injection.arm_declared /
+# hits_declared): a non-literal point is allowed — the injector raises
+# on an undeclared name, which is the dynamic equivalent of this
+# checker — but a LITERAL point still gets verified here for free.
+_TEST_SIDE_DYNAMIC = ("arm_declared", "hits_declared")
 # A spec entry's point token: starts a fragment, ends at @ or =.
 _SPEC_POINT_RE = re.compile(r"\A\s*([a-z][a-z0-9_.]*)[@=]")
 
@@ -222,6 +234,18 @@ def check(mod: Module, cfg: ChaosConfig,
             if point not in cfg.declared:
                 findings.append(_point_finding(
                     mod, node.lineno, point, cfg, f"{attr}()"
+                ))
+        elif attr in _TEST_SIDE_DYNAMIC and _receiver_is_faults(
+            mod, node.func
+        ):
+            if is_injector or not node.args:
+                continue
+            point = mod.resolve_str(node.args[0])
+            if point is None or point.startswith(cfg.test_prefix):
+                continue  # runtime _check_declared carries the contract
+            if point not in cfg.declared:
+                findings.append(_point_finding(
+                    mod, node.lineno, point, cfg, f"faults.{attr}()"
                 ))
         elif attr in _TEST_SIDE and _receiver_is_faults(mod, node.func):
             if is_injector or not node.args:

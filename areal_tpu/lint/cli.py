@@ -38,7 +38,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="repo-specific AST checks: loop-only, "
                     "blocking-async, env-knob, wire-schema, "
                     "wire-contract, metrics-registry, chaos-registry, "
-                    "lock-order (docs/static_analysis.md)",
+                    "lock-order, rpc-discipline "
+                    "(docs/static_analysis.md)",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to lint")
     ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,

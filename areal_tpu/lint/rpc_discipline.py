@@ -1,0 +1,277 @@
+"""Checker ``rpc-discipline``: cross-process HTTP calls go through
+``base/rpc.py``, not private retry loops or hand-picked timeouts.
+
+PR 14 folded ~10 scattered retry loops (each with its own attempt
+count, fixed backoff, and naked timeout) into one budget-aware
+substrate — deadline propagation, jittered backoff, Retry-After,
+hedged reads, per-peer breakers. This checker keeps the tree folded:
+a new raw loop would silently opt its call site out of every one of
+those behaviors (a slow peer becomes indistinguishable from a dead
+one again). Flags, per module outside the registry:
+
+- **raw retry loops**: a ``for``/``while`` body that both performs an
+  HTTP call (``urllib.request.urlopen``, ``requests.*``, or a
+  ``get/post/put/delete/request`` method on a session-like receiver)
+  and sleeps (``time.sleep``/``asyncio.sleep``) — the
+  call-then-backoff shape ``rpc.retry_sync``/``retry_async`` exists
+  to own. Loops that only poll state (no HTTP) or only pace load (no
+  sleep-after-failure shape) are not flagged.
+- **naked per-call timeouts**: a NUMERIC LITERAL ``timeout=`` at an
+  HTTP call site (``urlopen(..., timeout=5)``,
+  ``sess.get(..., timeout=aiohttp.ClientTimeout(total=30))``).
+  Per-attempt timeouts must derive from the remaining deadline budget
+  (``policy.attempt_timeout``) or a registered ``AREAL_RPC_*`` knob —
+  a literal is exactly the "rollout with 2s left waits 30s" bug.
+  Session-scoped defaults (``aiohttp.ClientSession(timeout=...)``)
+  are exempt: they are declared once and capped by per-call deadlines.
+
+A loop whose every wait comes from a DECLARED policy —
+``policy.backoff(...)`` / ``rpc.shed_backoff(...)`` (any callee named
+``*backoff``) — is not a raw loop: that is precisely what a client
+state machine migrated onto the substrate looks like
+(``partial_rollout``'s per-sample loop owns failover/shed/submit
+decisions the substrate cannot, but every one of its waits is the
+declared discipline).
+
+The registry is ``areal_tpu.base.rpc.LINT_RPC_MODULES`` — the modules
+allowed to hold raw HTTP retry machinery (deliberately one entry).
+Like the chaos/metrics registries, a registry entry naming a module
+that no longer exists is itself a finding, so the list cannot rot.
+Two scaffolding trees are exempt: ``tests/`` (a wait-until-up poll
+loop is test plumbing, not a fleet caller) and ``areal_tpu/bench/``
+(load generators measure the wire AS-IS — client-side retries or
+hedges in the harness would contaminate the latencies the bench
+exists to bank; the unhedged arm of rpc_resilience depends on raw
+calls staying raw).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterator, List, Optional, Sequence, Set, Tuple
+
+from areal_tpu.lint.common import Finding, Module
+
+CHECKER = "rpc-discipline"
+
+REGISTRY_MODULE = "areal_tpu.base.rpc"
+REGISTRY_REL = "areal_tpu/base/rpc.py"
+
+_HTTP_METHODS = ("get", "post", "put", "delete", "request", "head")
+# Receiver-name fragments that mark a session-like object: aiohttp
+# ClientSession instances in this tree are uniformly named sess /
+# session / _session / _handoff_sess(); ``requests`` resolves through
+# the import map instead.
+_SESSION_HINTS = ("sess", "session")
+_SLEEPS = ("time.sleep", "asyncio.sleep")
+
+
+@dataclasses.dataclass
+class RpcConfig:
+    allowed: Set[str]  # repo-relative modules allowed raw HTTP loops
+    registry_rel: str = REGISTRY_REL
+    registry_module: str = REGISTRY_MODULE
+    # Scaffolding prefixes (see module docstring): test plumbing and
+    # the bench harness, whose raw calls are the measurement.
+    exempt_prefixes: Tuple[str, ...] = ("tests/", "areal_tpu/bench/")
+
+
+def default_config() -> RpcConfig:
+    # Import is deliberate (chaos-registry precedent): it validates
+    # the registry executes, and base/rpc.py is stdlib-only at import
+    # time so the no-jax gate is preserved.
+    from areal_tpu.base import rpc
+
+    return RpcConfig(allowed=set(rpc.LINT_RPC_MODULES))
+
+
+def _is_numeric_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) and not isinstance(
+            node.value, bool
+        )
+    # ``-1`` / ``+0.5`` parse as UnaryOp(Constant).
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.UAdd, ast.USub)
+    ):
+        return _is_numeric_literal(node.operand)
+    return False
+
+
+def _session_like(mod: Module, recv: ast.AST) -> bool:
+    """Receiver smells like an HTTP session: ``sess``, ``session``,
+    ``self._session`` — or resolves to the requests module."""
+    if isinstance(recv, ast.Name):
+        resolved = mod.imports.get(recv.id, recv.id)
+        if resolved == "requests" or resolved.startswith("requests."):
+            return True
+        name = recv.id
+    elif isinstance(recv, ast.Attribute):
+        dotted = mod.dotted_name(recv)
+        if dotted == "requests" or (
+            dotted or ""
+        ).startswith("requests."):
+            return True
+        name = recv.attr
+    else:
+        return False
+    lowered = name.lower()
+    return any(h in lowered for h in _SESSION_HINTS)
+
+
+def _http_call_kind(mod: Module, call: ast.Call) -> Optional[str]:
+    """'urlopen' | 'session' | 'requests' when ``call`` is an HTTP
+    request primitive, else None."""
+    func = call.func
+    dotted = mod.dotted_name(func)
+    if dotted is not None:
+        if dotted.endswith("urllib.request.urlopen") or dotted == "urlopen":
+            return "urlopen"
+        if dotted.startswith("requests.") and dotted.split(".")[-1] in (
+            _HTTP_METHODS
+        ):
+            return "requests"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in _HTTP_METHODS
+        and _session_like(mod, func.value)
+    ):
+        # ``.get`` is also dict/cfg access (a var NAMED session can
+        # hold a dict): demand HTTP call shape — exactly one
+        # positional (the url; dict.get(k, default) takes two) that
+        # is not a plain path-less string literal, or HTTP keywords.
+        if len(call.args) > 1:
+            return None
+        if call.args:
+            first = mod.resolve_str(call.args[0])
+            if first is not None and "/" not in first:
+                return None
+            return "session"
+        if any(
+            kw.arg in ("json", "data", "params", "headers", "timeout")
+            for kw in call.keywords
+        ):
+            return "session"
+    return None
+
+
+def _body_walk(stmts: Sequence[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk loop-body statements without descending into nested
+    function/class definitions — a helper DEFINED inside a loop is not
+    the loop retrying."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _policy_backoff_arg(node: ast.Call) -> bool:
+    """The sleep's duration comes from a declared policy
+    (``policy.backoff(k)``, ``self._backoff(...)``,
+    ``rpc.shed_backoff(...)``) — a migrated client state machine, not
+    a raw hand-rolled wait."""
+    if not node.args or not isinstance(node.args[0], ast.Call):
+        return False
+    callee = node.args[0].func
+    name = (
+        callee.attr if isinstance(callee, ast.Attribute)
+        else callee.id if isinstance(callee, ast.Name) else ""
+    )
+    return name.endswith("backoff")
+
+
+def _loop_shape(
+    mod: Module, loop: ast.AST
+) -> Optional[Tuple[int, str]]:
+    """(line, http-kind) when the loop body both makes an HTTP call
+    and raw-sleeps — the raw retry-loop shape. Policy-derived waits
+    (``*.backoff(...)`` arguments) don't count as raw."""
+    http: Optional[Tuple[int, str]] = None
+    sleeps = False
+    for node in _body_walk(loop.body):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _http_call_kind(mod, node)
+        if kind is not None and http is None:
+            http = (node.lineno, kind)
+        dotted = mod.dotted_name(node.func)
+        if dotted in _SLEEPS and not _policy_backoff_arg(node):
+            sleeps = True
+    if http is not None and sleeps:
+        return http
+    return None
+
+
+def check(mod: Module, cfg: RpcConfig) -> List[Finding]:
+    if mod.rel in cfg.allowed or mod.rel.startswith(cfg.exempt_prefixes):
+        return []
+    findings: List[Finding] = []
+    for node in mod.nodes:
+        # -- raw retry loops --------------------------------------------
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            shape = _loop_shape(mod, node)
+            if shape is not None:
+                line, kind = shape
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    f"raw HTTP retry loop ({kind} call at line {line} "
+                    f"plus sleep): use rpc.retry_sync/retry_async with "
+                    f"a declared RetryPolicy — a private loop opts "
+                    f"this call out of deadline propagation, "
+                    f"Retry-After, and breaker accounting "
+                    f"(base/rpc.py)",
+                ))
+        # -- naked per-call timeouts ------------------------------------
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _http_call_kind(mod, node)
+        if kind is None:
+            continue
+        for kw in node.keywords:
+            if kw.arg != "timeout":
+                continue
+            naked = _is_numeric_literal(kw.value)
+            if (
+                not naked
+                and isinstance(kw.value, ast.Call)
+                and isinstance(kw.value.func, ast.Attribute)
+                and kw.value.func.attr == "ClientTimeout"
+            ):
+                naked = any(
+                    _is_numeric_literal(k.value)
+                    for k in kw.value.keywords
+                )
+            if naked:
+                findings.append(Finding(
+                    mod.rel, node.lineno, CHECKER,
+                    "naked numeric timeout on an HTTP call: derive it "
+                    "from the remaining deadline budget "
+                    "(policy.attempt_timeout) or a registered "
+                    "AREAL_RPC_* knob — a literal here is the "
+                    "'2s of budget left, 30s wait' bug base/rpc.py "
+                    "exists to end",
+                ))
+    return findings
+
+
+def check_registry(cfg: RpcConfig, root: str) -> List[Finding]:
+    """Registry hygiene: every LINT_RPC_MODULES entry must name an
+    existing file (an entry left behind by a move would silently
+    exempt a path nobody audits)."""
+    findings: List[Finding] = []
+    for rel in sorted(cfg.allowed):
+        if not os.path.exists(os.path.join(root, rel)):
+            findings.append(Finding(
+                cfg.registry_rel, 1, CHECKER,
+                f"LINT_RPC_MODULES entry {rel!r} names a missing "
+                f"file: update {cfg.registry_module}.LINT_RPC_MODULES",
+            ))
+    return findings

@@ -18,6 +18,7 @@ from areal_tpu.lint import (
     locks,
     loop_only,
     metrics,
+    rpc_discipline,
     wire_contract,
     wire_schema,
 )
@@ -33,6 +34,7 @@ from areal_tpu.lint.common import (
 ALL_CHECKERS = (
     "loop-only", "blocking-async", "env-knob", "wire-schema",
     "wire-contract", "metrics-registry", "chaos-registry", "lock-order",
+    "rpc-discipline",
 )
 
 # The linter's own test corpus: fixture sources are deliberately full
@@ -51,6 +53,7 @@ class LintConfig:
     chaos_cfg: Optional[chaos.ChaosConfig] = None
     wire_cfg: Optional[wire_contract.WireConfig] = None
     lock_cfg: Optional[locks.LockConfig] = None
+    rpc_cfg: Optional[rpc_discipline.RpcConfig] = None
     # None = auto: dead-knob check runs iff the scan covers the
     # registry module (linting one file must not misreport the whole
     # registry as dead). Same gating applies to the metrics/chaos/wire
@@ -88,6 +91,9 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
     lock_cfg = cfg.lock_cfg
     if lock_cfg is None and "lock-order" in cfg.checkers:
         lock_cfg = locks.default_config()
+    rpc_cfg = cfg.rpc_cfg
+    if rpc_cfg is None and "rpc-discipline" in cfg.checkers:
+        rpc_cfg = rpc_discipline.default_config()
 
     # -- pass 1: cross-file facts ---------------------------------------
     registries: Dict[str, Dict] = {}  # rel -> loop-only registry
@@ -96,6 +102,7 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
     metrics_registry_mod: Optional[Module] = None
     chaos_registry_mod: Optional[Module] = None
     wire_registry_mod: Optional[Module] = None
+    rpc_registry_mod: Optional[Module] = None
     for mod in modules:
         if "loop-only" in cfg.checkers:
             reg = loop_only.collect_registry(mod)
@@ -116,6 +123,8 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
             chaos_registry_mod = mod
         if wire_cfg is not None and mod.rel == wire_cfg.registry_rel:
             wire_registry_mod = mod
+        if rpc_cfg is not None and mod.rel == rpc_cfg.registry_rel:
+            rpc_registry_mod = mod
 
     # -- pass 2: checks --------------------------------------------------
     env_uses: Dict[str, int] = {}
@@ -141,6 +150,9 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
             findings.extend(wire_contract.check(mod, wire_cfg, wire_acc))
         if "lock-order" in cfg.checkers and lock_cfg is not None:
             findings.extend(locks.check(mod, lock_cfg))
+        if "rpc-discipline" in cfg.checkers and rpc_cfg is not None \
+                and not is_lint_fixture:
+            findings.extend(rpc_discipline.check(mod, rpc_cfg))
         if "loop-only" in cfg.checkers:
             if mod.rel in registries:
                 findings.extend(loop_only.check_declaring_module(
@@ -191,6 +203,12 @@ def run_lint(paths: List[str], cfg: LintConfig) -> List[Finding]:
             wire_cfg, wire_acc,
             wire_contract.registry_decl_lines(wire_registry_mod),
         ))
+    if (
+        "rpc-discipline" in cfg.checkers
+        and rpc_cfg is not None
+        and rpc_registry_mod is not None
+    ):
+        findings.extend(rpc_discipline.check_registry(rpc_cfg, cfg.root))
 
     # -- allowlist -------------------------------------------------------
     if cfg.allowlist_path and os.path.exists(cfg.allowlist_path):

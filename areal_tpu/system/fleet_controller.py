@@ -47,7 +47,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from areal_tpu.base import env_registry, health, logging, name_resolve, names
+from areal_tpu.base import env_registry, health, logging, name_resolve, names, rpc
 from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.base.wire_schemas import FLEET_LEASE_V1
 
@@ -250,12 +250,18 @@ def parse_metrics(text: str) -> Dict[str, Any]:
 
 def fetch_metrics(url: str, timeout: float = 5.0) -> Dict[str, Any]:
     """Blocking GET {url}/metrics -> parsed dict ({} when unreachable).
-    Poll-thread / configure-time only (never the HTTP event loop)."""
-    import urllib.request
-
+    Poll-thread / configure-time only (never the HTTP event loop).
+    Single-attempt on purpose — the poll loop IS the retry — but routed
+    through base/rpc.py so the timeout is the declared budget, not a
+    naked literal."""
     try:
-        with urllib.request.urlopen(url + "/metrics", timeout=timeout) as r:
-            return parse_metrics(r.read().decode())
+        body = rpc.get_bytes_sync(
+            url + "/metrics",
+            policy=rpc.default_policy(attempts=1, attempt_timeout_s=timeout),
+            deadline=rpc.Deadline.after(timeout),
+            what="fleet metrics",
+        )
+        return parse_metrics(body.decode())
     except Exception:
         return {}
 

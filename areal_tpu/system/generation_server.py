@@ -49,7 +49,7 @@ from aiohttp import web
 
 from areal_tpu.api import data_api
 from areal_tpu.api.system_api import GenerationServerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, network, seeding, tracing
+from areal_tpu.base import constants, logging, name_resolve, names, network, rpc, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.engine.serving import GenRequest, ServingEngine
 from areal_tpu.engine.weight_client import ChunkStore, assemble_params
@@ -345,6 +345,19 @@ class GenerationServer(Worker):
         # server mid-rollout and prove clients fail over.
         await faults.maybe_fail_async("gserver.generate")
         d = await request.json()
+        # Propagated deadline (base/rpc.py wire rule): a request whose
+        # budget already expired is refused CHEAPLY — prefilling tokens
+        # the caller will never consume just steals budget from live
+        # requests. 429 + Retry-After 0: the client re-mints a budget
+        # on its next attempt.
+        deadline = rpc.Deadline.from_headers(request.headers)
+        if deadline is not None and deadline.expired():
+            rpc.stats.incr("deadline_expired")
+            return web.json_response(
+                {"qid": str(d.get("qid", "")), "error": "deadline expired",
+                 "retry_after": 0.0},
+                status=429, headers={"Retry-After": "0"},
+            )
         # Admission control BEFORE the engine sees the request: beyond
         # the queue-depth/token watermark the server load-sheds with 429
         # so open-loop tail latency stays bounded (clients back off with
@@ -383,7 +396,7 @@ class GenerationServer(Worker):
         # submission, so admission sees a parked prefix and prefills
         # only the delta. Any failure degrades to the full re-prefill
         # this path exists to avoid; it can never fail the request.
-        await self._maybe_restore_prefix(d)
+        await self._maybe_restore_prefix(d, deadline=deadline)
         g = d.get("gconfig", {})
         # Disaggregated path: the manager paired a decode server into
         # this request — prefill to the first token here, hand the KV
@@ -395,7 +408,9 @@ class GenerationServer(Worker):
             and decode_url != self.address
             and int(g.get("max_new_tokens", 256)) > 1
         ):
-            return await self._h_generate_disagg(d, g, decode_url, gen_span)
+            return await self._h_generate_disagg(
+                d, g, decode_url, gen_span, deadline=deadline
+            )
         req = self._gen_request_from(d, g)
         try:
             res = await self._submit_and_wait(req)
@@ -502,7 +517,8 @@ class GenerationServer(Worker):
         while len(self._handoff_store) > cap:
             self._handoff_store.popitem(last=False)
 
-    async def _h_generate_disagg(self, d, g, decode_url, gen_span):
+    async def _h_generate_disagg(self, d, g, decode_url, gen_span,
+                                 deadline=None):
         from areal_tpu.engine.kv_handoff import KVHandoffError
 
         qid = str(d["qid"])
@@ -574,8 +590,15 @@ class GenerationServer(Worker):
         self._stash_handoff(qid, meta, payload)
         try:
             sess = await self._handoff_sess()
+            # The decode hop inherits the rollout's REMAINING budget
+            # (base/rpc.py wire rule), so its blob pull-back can never
+            # out-wait the client that asked for it.
+            hop_headers = (
+                deadline.headers() if deadline is not None else {}
+            )
             async with sess.post(
                 f"{decode_url}/kv_handoff",
+                headers=hop_headers,
                 json=tracing.inject_ctx_into(
                     {
                         "qid": qid,
@@ -686,12 +709,14 @@ class GenerationServer(Worker):
     # (docs/serving.md "KV tiering + global prefix index")
     # ------------------------------------------------------------------
 
-    async def _maybe_restore_prefix(self, d: Dict) -> Optional[str]:
+    async def _maybe_restore_prefix(
+        self, d: Dict, deadline: Optional[rpc.Deadline] = None,
+    ) -> Optional[str]:
         """Best-effort prefix restore for a returning session; returns
         the tier it hit ('host'/'disk'/'peer') or None. Never raises —
         every failure path is a plain re-prefill."""
         try:
-            return await self._restore_prefix_impl(d)
+            return await self._restore_prefix_impl(d, deadline=deadline)
         except Exception:
             logger.warning(
                 f"kv restore for {d.get('qid')!r} failed; "
@@ -699,7 +724,9 @@ class GenerationServer(Worker):
             )
             return None
 
-    async def _restore_prefix_impl(self, d: Dict) -> Optional[str]:
+    async def _restore_prefix_impl(
+        self, d: Dict, deadline: Optional[rpc.Deadline] = None,
+    ) -> Optional[str]:
         qid = str(d.get("qid") or "")
         input_ids = [int(t) for t in (d.get("input_ids") or [])]
         eng = self.engine
@@ -758,7 +785,7 @@ class GenerationServer(Worker):
             # Wrong content or stale version: don't pay the transfer.
             return None
         payload = await self._fetch_handoff_payload(
-            kv_source, qid, hmeta, path="/kv/chunk"
+            kv_source, qid, hmeta, path="/kv/chunk", deadline=deadline
         )
         await loop.run_in_executor(
             None, eng.import_kv_handoff, hmeta, payload
@@ -799,9 +826,17 @@ class GenerationServer(Worker):
         })
 
     @staticmethod
-    def _serve_ranged(payload: bytes, request: web.Request) -> web.Response:
+    async def _serve_ranged(
+        payload: bytes, request: web.Request
+    ) -> web.Response:
         """Range-aware byte serving shared by the handoff blob and the
-        tier chunk endpoints."""
+        tier chunk endpoints. The ``gserver.kv_chunk_bytes`` chaos
+        point (corrupt action) fires on the bytes ACTUALLY SERVED —
+        the Range slice, like weight_plane.chunk_bytes — so an armed
+        corruption is guaranteed to reach the puller's sha256 verify
+        instead of possibly flipping bytes outside the requested
+        window (a silent no-op drill); async because a delay/hang arm
+        must wedge this one request, not the event loop."""
         rng = request.headers.get("Range")
         if rng and rng.startswith("bytes="):
             try:
@@ -813,12 +848,18 @@ class GenerationServer(Worker):
             if start >= len(payload):
                 return web.Response(status=416)
             end = min(end, len(payload) - 1)
+            body = await faults.maybe_corrupt_async(
+                "gserver.kv_chunk_bytes", payload[start: end + 1]
+            )
             return web.Response(
-                body=payload[start: end + 1], status=206,
+                body=body, status=206,
                 headers={"Content-Range":
                          f"bytes {start}-{end}/{len(payload)}"},
             )
-        return web.Response(body=payload)
+        body = await faults.maybe_corrupt_async(
+            "gserver.kv_chunk_bytes", payload
+        )
+        return web.Response(body=body)
 
     async def _h_kv_chunk(self, request: web.Request) -> web.Response:
         """Peer-pull hop 2: serve a held prefix's payload bytes (the
@@ -834,7 +875,7 @@ class GenerationServer(Worker):
             return web.json_response(
                 {"error": f"no tiered prefix for {qid!r}"}, status=404
             )
-        resp = self._serve_ranged(got[1], request)
+        resp = await self._serve_ranged(got[1], request)
         self._kv_chunks_served += 1
         # Bytes actually on the wire (the Range slice), not the whole
         # payload per chunk request — a 10-chunk pull must read as one
@@ -877,7 +918,10 @@ class GenerationServer(Worker):
         )
         t0 = time.monotonic()
         try:
-            payload = await self._fetch_handoff_payload(source, qid, meta)
+            payload = await self._fetch_handoff_payload(
+                source, qid, meta,
+                deadline=rpc.Deadline.from_headers(request.headers),
+            )
         except Exception as e:
             if imp_span is not None:
                 imp_span.end(error=repr(e))
@@ -940,11 +984,17 @@ class GenerationServer(Worker):
     async def _fetch_handoff_payload(
         self, source: str, qid: str, meta: Dict,
         path: str = "/kv_handoff/blob",
+        deadline: Optional[rpc.Deadline] = None,
     ) -> bytes:
         """Chunked pull of a KV blob (the disagg export stash, or a
         peer's KV tier via ``path="/kv/chunk"``): per-chunk sha256
         verify, mid-chunk Range resume on torn reads — the weight-plane
-        transfer discipline applied to the KV hop.
+        transfer discipline applied to the KV hop. Per-chunk attempts,
+        timeouts and backoff come from the unified RPC policy
+        (AREAL_RPC_* knobs, base/rpc.py) instead of the old hardcoded
+        4-attempt/0.05s loop, and the caller's propagated deadline caps
+        every attempt — a rollout with 2s of budget left never waits a
+        full blob timeout here.
 
         Regression note (areal-lint blocking-async): verify_chunk used
         to run inline here — sha256 over a multi-MB KV chunk is ~10ms+
@@ -958,21 +1008,28 @@ class GenerationServer(Worker):
         total = int(index["total_bytes"])
         buf = bytearray(total)
         sess = await self._handoff_sess()
+        policy = rpc.default_policy()
         for i, (off, length) in enumerate(
             chunk_spans(total, int(index["chunk_bytes"]))
         ):
-            got = 0
-            for attempt in range(4):
-                start = off + got
+            state = {"got": 0}
+
+            async def attempt(attempt_timeout: float) -> None:
+                import aiohttp
+
+                start = off + state["got"]
+                dl = (deadline or rpc.Deadline.after(attempt_timeout))
                 try:
                     async with sess.get(
                         f"{source}{path}",
                         params={"qid": qid},
-                        headers={"Range":
-                                 f"bytes={start}-{off + length - 1}"},
+                        headers=dl.headers(
+                            {"Range": f"bytes={start}-{off + length - 1}"}
+                        ),
+                        timeout=aiohttp.ClientTimeout(total=attempt_timeout),
                     ) as r:
                         if r.status not in (200, 206):
-                            raise RuntimeError(
+                            raise OSError(
                                 f"blob fetch {r.status}: "
                                 f"{(await r.text())[:200]}"
                             )
@@ -980,24 +1037,33 @@ class GenerationServer(Worker):
                         if r.status == 200:
                             # Range-less server: slice the full payload.
                             data = data[start: off + length]
-                except Exception:
-                    if attempt == 3:
-                        raise
-                    await asyncio.sleep(0.05)
-                    continue
-                take = min(len(data), length - got)
+                except aiohttp.ClientError as e:
+                    raise OSError(f"blob fetch failed: {e!r}") from e
+                take = min(len(data), length - state["got"])
                 buf[start: start + take] = data[:take]
-                got += take
-                if got >= length:
-                    ok = await asyncio.get_running_loop().run_in_executor(
-                        None, verify_chunk,
-                        bytes(buf[off: off + length]), index["hashes"][i],
-                    )
-                    if ok:
-                        break
-                    got = 0  # corrupt chunk: refetch whole
-            else:
-                raise RuntimeError(f"chunk {i} unrecoverable after retries")
+                state["got"] += take
+                if state["got"] < length:
+                    raise OSError(
+                        f"short read {state['got']}/{length}"
+                    )  # Range resume continues from the new offset
+                ok = await asyncio.get_running_loop().run_in_executor(
+                    None, verify_chunk,
+                    bytes(buf[off: off + length]), index["hashes"][i],
+                )
+                if not ok:
+                    state["got"] = 0  # corrupt chunk: refetch whole
+                    raise ValueError(f"chunk {i} content-hash mismatch")
+
+            try:
+                await rpc.retry_async(
+                    attempt, policy=policy, deadline=deadline,
+                    retryable=rpc.RETRYABLE_DEFAULT,
+                    what=f"kv chunk {i} <- {source}{path}",
+                )
+            except rpc.RpcError as e:
+                raise RuntimeError(
+                    f"chunk {i} unrecoverable after retries: {e}"
+                ) from e
         return bytes(buf)
 
     async def _h_kv_blob(self, request: web.Request) -> web.Response:
@@ -1007,7 +1073,7 @@ class GenerationServer(Worker):
             return web.json_response(
                 {"error": f"no handoff blob for {qid!r}"}, status=404
             )
-        return self._serve_ranged(ent[1], request)
+        return await self._serve_ranged(ent[1], request)
 
     # ------------------------------------------------------------------
     # Drain-then-leave + KV migration (docs/fault_tolerance.md
@@ -1270,7 +1336,8 @@ class GenerationServer(Worker):
             )
         try:
             payload = await self._fetch_handoff_payload(
-                source, qid, meta, path="/kv/chunk"
+                source, qid, meta, path="/kv/chunk",
+                deadline=rpc.Deadline.from_headers(request.headers),
             )
         except Exception as e:
             return web.json_response(
@@ -1316,8 +1383,48 @@ class GenerationServer(Worker):
 
     async def _h_configure(self, request: web.Request) -> web.Response:
         """Runtime admission-watermark overrides (bench A/B arms flip
-        backpressure off and back without restarting the fleet)."""
+        backpressure off and back without restarting the fleet), plus —
+        ONLY when the AREAL_CHAOS_HTTP knob armed it at boot — runtime
+        fault-injection control: ``{"faults": "<AREAL_FAULTS spec>"}``
+        arms points in THIS process, ``{"faults_reset": true}`` clears
+        them, and the response carries per-point hit counts. The chaos
+        campaign (tests/system/test_chaos_campaign.py) sweeps every
+        declared fault point against one long-lived subprocess fleet
+        through this; a production fleet (knob off) refuses with 403."""
         d = await request.json()
+        chaos_keys = (
+            "faults" in d or d.get("faults_reset") or "faults_hits" in d
+        )
+        # Refusals FIRST, before anything mutates: a request the server
+        # answers 403/400 must leave zero trace — no half-applied
+        # watermarks, no arms left standing behind an error response.
+        if chaos_keys:
+            from areal_tpu.base import env_registry
+
+            if not env_registry.get_bool("AREAL_CHAOS_HTTP"):
+                return web.json_response(
+                    {"success": False,
+                     "error": "chaos control disabled "
+                              "(set AREAL_CHAOS_HTTP=1 at server boot)"},
+                    status=403,
+                )
+            try:
+                # Registry-verified: a typo'd point in a remote hits
+                # query must 400, not silently report 0 hits — and a
+                # typo'd point in an arming spec must 400, not arm a
+                # silent no-op behind success:True.
+                for p in d.get("faults_hits", []):
+                    faults.check_declared(str(p))
+                for entry in str(d.get("faults") or "").split(";"):
+                    entry = entry.strip()
+                    if entry:
+                        faults.check_declared(
+                            entry.partition("=")[0].partition("@")[0].strip()
+                        )
+            except ValueError as e:
+                return web.json_response(
+                    {"success": False, "error": str(e)}, status=400,
+                )
         changed = {}
         for key, cast in (("max_queue_depth", int),
                           ("max_queued_tokens", int),
@@ -1326,7 +1433,21 @@ class GenerationServer(Worker):
                 val = d[key]
                 setattr(self.cfg, key, None if val is None else cast(val))
                 changed[key] = val
-        return web.json_response({"success": True, "changed": changed})
+        resp = {"success": True, "changed": changed}
+        if chaos_keys:
+            if d.get("faults_reset"):
+                faults.reset()
+                changed["faults_reset"] = True
+            spec = d.get("faults")
+            if spec:
+                faults.load_env(str(spec))
+                changed["faults"] = spec
+            resp["faults_armed"] = faults.armed_points()
+            resp["faults_hits"] = {
+                p: faults.hits_declared(str(p))
+                for p in d.get("faults_hits", [])
+            }
+        return web.json_response(resp)
 
     async def _h_update_weights(self, request: web.Request) -> web.Response:
         await faults.maybe_fail_async("gserver.update_weights")
@@ -1756,6 +1877,7 @@ class GenerationServer(Worker):
 
         m = self.engine.metrics()
         snap = self.engine.latency_snapshot()
+        rpc_snap = rpc.stats.snapshot()
         lines = [
             f"areal:num_running_reqs {m['num_running_reqs']}",
             f"areal:num_used_tokens {m['num_used_tokens']}",
@@ -1844,6 +1966,21 @@ class GenerationServer(Worker):
             # per-server ratios.
             f"areal:spec_emitted_tokens {m['spec_emitted_tokens']}",
             f"areal:spec_active_steps {m['spec_active_steps']}",
+            # RPC substrate counters (base/rpc.py process-global stats):
+            # this server's OWN outbound calls — KV/weight chunk pulls,
+            # handoff hops — under the unified retry/hedge/breaker
+            # discipline (docs/fault_tolerance.md).
+            f"areal:rpc_attempts {float(rpc_snap['attempts'])}",
+            f"areal:rpc_retries {float(rpc_snap['retries'])}",
+            f"areal:rpc_failures {float(rpc_snap['failures'])}",
+            f"areal:rpc_hedges {float(rpc_snap['hedges'])}",
+            f"areal:rpc_hedge_wins {float(rpc_snap['hedge_wins'])}",
+            f"areal:rpc_hedge_cancelled {float(rpc_snap['hedge_cancelled'])}",
+            f"areal:rpc_hedge_failures {float(rpc_snap['hedge_failures'])}",
+            f"areal:rpc_deadline_expired {float(rpc_snap['deadline_expired'])}",
+            f"areal:rpc_breaker_rejections "
+            f"{float(rpc_snap['breaker_rejections'])}",
+            f"areal:rpc_breaker_opens {float(rpc_snap['breaker_opens'])}",
             f"areal:last_weight_swap_s {m['last_weight_swap_s']}",
             f"areal:last_weight_stage_s {m['last_weight_stage_s']}",
             f"areal:last_weight_load_s "

@@ -43,7 +43,7 @@ import aiohttp
 from aiohttp import web
 
 from areal_tpu.api.system_api import GserverManagerConfig
-from areal_tpu.base import constants, env_registry, health, logging, name_resolve, names, network, tracing
+from areal_tpu.base import constants, env_registry, health, logging, name_resolve, names, network, rpc, tracing
 from areal_tpu.base import metrics_registry as mreg
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.worker_base import PollResult, Worker
@@ -64,6 +64,23 @@ class RolloutStat:
 
 
 class GserverManager(Worker):
+    @property
+    def breakers(self) -> rpc.BreakerBoard:
+        """Per-peer circuit breakers (base/rpc.py): fed by the
+        manager's OWN calls (metrics poll, fanout/cutover posts) and by
+        client-reported request failures. An OPEN breaker makes the
+        peer unroutable exactly like an active shed window — never
+        evicted for it (eviction stays the health registry's call) —
+        so a flapping server stops eating every caller's budget
+        between heartbeat-driven evictions. Surfaced on /status.
+        Lazily built so harness-built partial managers (tests construct
+        via ``__new__``) get a board without running _configure."""
+        b = self.__dict__.get("_breaker_board")
+        if b is None:
+            b = rpc.BreakerBoard()
+            self.__dict__["_breaker_board"] = b
+        return b
+
     def _configure(self, config: GserverManagerConfig):
         from areal_tpu.system import fleet_controller
 
@@ -478,12 +495,16 @@ class GserverManager(Worker):
         if not candidates:
             return None, "none", None, None
         now = time.monotonic()
+        tripped = set(self.breakers.open_peers())
         open_ = [
             u for u in candidates
             if self._server_shed_until.get(u, 0.0) <= now
+            and u not in tripped
         ]
-        # Whole fleet inside a shed window: route anyway (the client
-        # backs off on the 429 itself); a shed hint is advisory.
+        # Whole fleet inside a shed window / breaker-open: route anyway
+        # (the client backs off on the 429 itself, and a half-open
+        # probe needs SOME traffic); shed hints and breakers are
+        # advisory, never a second eviction mechanism.
         pool = open_ or candidates
         qid = str(meta.get("qid") or "")
         if self._disagg_split(candidates):
@@ -739,6 +760,7 @@ class GserverManager(Worker):
         self._server_reqs.pop(url, None)
         self._server_roles.pop(url, None)
         self._server_versions.pop(url, None)
+        self.breakers.drop(url)
         for member in [m for m, u in self._member_urls.items() if u == url]:
             self._member_urls.pop(member, None)
         self._healthy.discard(url)
@@ -1469,6 +1491,12 @@ class GserverManager(Worker):
         # once its heartbeat proves it alive and it re-syncs weights).
         failed = meta.get("failed_server_url")
         if failed:
+            # Breaker first: eviction clears routing state, but the
+            # breaker REMEMBERS — a flapping server that heartbeats its
+            # way back keeps failing its way to open and stays
+            # unroutable through the cooldown instead of re-entering
+            # rotation on every readmission.
+            self.breakers.record(failed, ok=False)
             self._mark_unhealthy(failed, "client-reported request failure")
         # A 429 is DELIBERATE load-shedding, never a failure: route
         # around the server for its Retry-After window (sessions with
@@ -1698,6 +1726,15 @@ class GserverManager(Worker):
                     "per_server": dict(self._server_shed_total),
                 },
                 "affinity_entries": len(self._affinity),
+                # RPC substrate health (base/rpc.py): this process's
+                # areal:rpc_* counters plus the per-peer breaker board
+                # the routing pool consults — an "open" entry here IS
+                # why a healthy-looking server takes no traffic.
+                "rpc": {
+                    "stats": rpc.stats.snapshot(),
+                    "breakers": self.breakers.snapshot(),
+                    "open": self.breakers.open_peers(),
+                },
                 # Last tree fanout: per-server transfer vs cutover ms
                 # (separate by design), the planned tree, and any
                 # evictions it caused. Empty when the plane is off.
@@ -1979,8 +2016,14 @@ class GserverManager(Worker):
             server=url, parent=parent,
         )
         try:
+            # Fanout hop under the wire deadline rule (base/rpc.py):
+            # the transfer inherits the wave's remaining flush budget,
+            # so a wedged edge fails inside the wave instead of
+            # outliving it.
+            dl = rpc.Deadline.after(self.cfg.flush_request_timeout)
             async with sess.post(
                 f"{url}/distribute_weights",
+                headers=dl.headers(),
                 json=tracing.inject_ctx_into(
                     dict(payload),
                     edge_span.ctx if edge_span
@@ -1991,6 +2034,7 @@ class GserverManager(Worker):
             ok = bool(body.get("success"))
         except Exception as e:
             ok, body = False, {"error": repr(e)}
+        self.breakers.record(url, ok=ok)
         if edge_span is not None:
             edge_span.end(
                 ok=ok,
@@ -2005,8 +2049,10 @@ class GserverManager(Worker):
             ctx=span.ctx if span else None, server=url,
         )
         try:
+            dl = rpc.Deadline.after(self.cfg.flush_request_timeout)
             async with sess.post(
                 f"{url}/cutover_weights",
+                headers=dl.headers(),
                 json=tracing.inject_ctx_into(
                     {"version": version, "allow_interrupt": True,
                      "budget_s": self.cfg.weight_cutover_budget_s},
@@ -2018,6 +2064,7 @@ class GserverManager(Worker):
             ok = bool(body.get("success"))
         except Exception as e:
             ok, body = False, {"error": repr(e)}
+        self.breakers.record(url, ok=ok)
         if cut_span is not None:
             cut_span.end(
                 ok=ok, cutover_ms=float(body.get("cutover_ms") or 0.0),
@@ -2457,7 +2504,18 @@ class GserverManager(Worker):
                                 "peer_hits"] = float(val)
                     if self._kv_index_size:
                         await self._poll_kv_index(sess, u)
+                    # A served /metrics clears stray strikes on a
+                    # HEALTHY breaker only. It must never close a
+                    # tripped one: a wedged engine whose HTTP loop
+                    # still answers /metrics would otherwise re-enter
+                    # rotation every poll interval — closing a tripped
+                    # breaker takes a DATA-PLANE success (fanout/
+                    # cutover record) or the peer's removal.
+                    br = self.breakers.breaker(u)
+                    if br.state() == rpc.STATE_CLOSED:
+                        br.record_success()
                 except Exception:
+                    self.breakers.record(u, ok=False)
                     logger.warning(f"metrics poll failed for {u}")
 
     async def _poll_kv_index(self, sess, u: str):

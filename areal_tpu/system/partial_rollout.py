@@ -13,7 +13,6 @@ gathered into `BundledGenerationOutputs`.
 from __future__ import annotations
 
 import asyncio
-import random
 from typing import Dict, List, Optional
 
 import aiohttp
@@ -24,7 +23,7 @@ from areal_tpu.api.model_api import (
     BundledGenerationOutputs,
     GenerationHyperparameters,
 )
-from areal_tpu.base import logging, tracing
+from areal_tpu.base import logging, rpc, tracing
 
 logger = logging.getLogger("partial_rollout")
 
@@ -58,6 +57,20 @@ class PartialRolloutManager:
         # the fleet stays unroutable through the whole backoff ramp.
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        # Declared retry disciplines (base/rpc.py): the per-sample
+        # failure policy keeps this client's historical ctor knobs; the
+        # manager-rediscovery policy is the FLEET-WIDE one shared with
+        # rollout_worker, so a manager blip has exactly one declared
+        # budget instead of two private ones.
+        self.policy = rpc.RetryPolicy(
+            attempts=max(1, max_retries),
+            backoff_base_s=retry_backoff_s,
+            backoff_max_s=2.0,
+            attempt_timeout_s=request_timeout,
+        )
+        self.mgr_policy = rpc.rediscovery_policy(
+            backoff_base_s=retry_backoff_s
+        )
         # Optional () -> current manager address. A restarted gserver
         # manager re-registers at a NEW address; in-flight samples follow
         # it instead of dying with their accumulated tokens.
@@ -89,17 +102,16 @@ class PartialRolloutManager:
             await self._session.close()
 
     def _backoff(self, attempt: int, sched: Optional[Dict] = None) -> float:
-        """Exponential backoff, capped at 2s; a 503's retry_after hint
-        floors the wait."""
-        delay = min(2.0, self.retry_backoff_s * (2 ** (attempt - 1)))
-        if sched:
-            delay = max(delay, float(sched.get("retry_after", 0.0)))
-        return delay
+        """Declared-policy backoff (base/rpc.py): jittered exponential,
+        a 503's retry_after hint floors the wait."""
+        ra = float(sched.get("retry_after", 0.0)) if sched else None
+        return self.policy.backoff(attempt, retry_after=ra)
 
     async def _schedule(self, meta: Dict) -> Dict:
         sess = await self._sess()
         async with sess.post(
-            f"{self.manager_addr}/schedule_request", json=meta
+            f"{self.manager_addr}/schedule_request", json=meta,
+            headers=rpc.Deadline.after(self.request_timeout).headers(),
         ) as r:
             return await r.json()
 
@@ -143,7 +155,7 @@ class PartialRolloutManager:
         # the instant it registers.
         mgr_fails = 0
         consec_mgr_fails = 0
-        mgr_budget = max(16, self.max_retries * 4)
+        mgr_budget = self.mgr_policy.attempts
         # Interruption-cost accounting: any submission carrying an
         # already-accumulated prefix makes the server (re-)prefill
         # prompt+prefix under (possibly new) weights; prefix caching may
@@ -197,12 +209,9 @@ class PartialRolloutManager:
                     f"({mgr_fails}/{mgr_budget})"
                 )
                 self._refresh_manager_addr()
-                delay = min(
-                    5.0,
-                    self.retry_backoff_s
-                    * (2 ** min(consec_mgr_fails - 1, 6)),
+                await asyncio.sleep(
+                    self.mgr_policy.backoff(consec_mgr_fails)
                 )
-                await asyncio.sleep(delay * (0.5 + random.random()))
                 continue
             consec_mgr_fails = 0
             failed_url = None
@@ -267,7 +276,14 @@ class PartialRolloutManager:
             )
             shed_ra: Optional[float] = None
             try:
-                async with sess.post(f"{url}/generate", json=payload) as r:
+                # Outermost deadline mint (base/rpc.py): the server and
+                # every hop it makes on our behalf (decode pairing, KV
+                # pulls) inherit this chunk's remaining budget.
+                chunk_dl = rpc.Deadline.after(self.request_timeout)
+                async with sess.post(
+                    f"{url}/generate", json=payload,
+                    headers=chunk_dl.headers(),
+                ) as r:
                     if r.status == 429:
                         # Deliberate load-shedding, not a failure: honor
                         # Retry-After, tell the manager (shed hint, for
@@ -339,9 +355,9 @@ class PartialRolloutManager:
                 # Jittered backoff around the server's hint (plus a mild
                 # exponential ramp on consecutive sheds): synchronized
                 # retries from many workers would re-create the very
-                # burst that tripped the watermark.
-                delay = min(10.0, shed_ra * (2 ** min(consec_shed - 1, 3)))
-                await asyncio.sleep(delay * (0.5 + random.random()))
+                # burst that tripped the watermark (rpc.shed_backoff is
+                # the one declared client-shed discipline).
+                await asyncio.sleep(rpc.shed_backoff(consec_shed, shed_ra))
                 continue
             consec_shed = 0
             if version_start < 0:

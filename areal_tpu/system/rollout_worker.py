@@ -22,7 +22,7 @@ from areal_tpu.api import data_api
 from areal_tpu.api.agent_api import make_agent
 from areal_tpu.api.env_api import make_env
 from areal_tpu.api.system_api import RolloutWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, seeding, tracing
+from areal_tpu.base import constants, logging, name_resolve, names, rpc, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system import eval_scores
 from areal_tpu.system.partial_rollout import PartialRolloutManager
@@ -49,6 +49,12 @@ class _TracedEnv:
 
 
 class RolloutWorker(AsyncWorker):
+    # Class-level defaults so harness-built partial workers (tests
+    # construct via __new__) get the manager-blip discipline without
+    # running _configure.
+    _mgr_fails = 0
+    _mgr_policy: Optional[rpc.RetryPolicy] = None
+
     @property
     def pending_scores(self) -> Dict[str, float]:
         """Per-episode success rates accumulated locally, merged into the
@@ -130,6 +136,8 @@ class RolloutWorker(AsyncWorker):
         self._tasks: Dict[str, asyncio.Task] = {}
         self._push_count = 0
         self._episode_counter = itertools.count()
+        self._mgr_policy = rpc.rediscovery_policy()
+        self._mgr_fails = 0
         logger.info(
             f"{config.worker_name} configured; manager at {self.manager_addr}"
         )
@@ -178,20 +186,23 @@ class RolloutWorker(AsyncWorker):
 
     async def _release_quota(self, accepted: bool):
         """Release this episode's quota slot, retrying through transient
-        manager failures — a leaked slot would permanently shrink the
-        rollout quota (and enough of them starve it entirely)."""
-        for attempt in range(3):
-            try:
-                await self._finish(accepted)
-                return
-            except Exception:
-                if attempt == 2:
-                    logger.warning(
-                        "finish_rollout failed; quota slot leaks until "
-                        "the manager resyncs", exc_info=True,
-                    )
-                else:
-                    await asyncio.sleep(0.2 * (attempt + 1))
+        manager failures under the declared RPC policy — a leaked slot
+        would permanently shrink the rollout quota (and enough of them
+        starve it entirely)."""
+
+        async def attempt(_timeout: float):
+            await self._finish(accepted)
+
+        try:
+            await rpc.retry_async(
+                attempt, policy=rpc.default_policy(attempts=3),
+                retryable=(Exception,), what="finish_rollout",
+            )
+        except rpc.RpcError:
+            logger.warning(
+                "finish_rollout failed; quota slot leaks until "
+                "the manager resyncs", exc_info=True,
+            )
 
     async def rollout_task(self, prompt, trace_parent=None):
         """One episode: agent coroutine + generation servicing
@@ -359,9 +370,16 @@ class RolloutWorker(AsyncWorker):
             # re-resolve so this worker follows it instead of hammering
             # the dead endpoint forever. Off-loop: the lookup is file
             # I/O (areal-lint blocking-async, see poll-gate note above).
+            # Backoff comes from the SAME declared rediscovery policy
+            # partial_rollout uses (base/rpc.py), so a manager blip has
+            # one fleet-wide budget, not two private ones.
             await loop.run_in_executor(None, self._rediscover_manager)
-            await asyncio.sleep(0.5)
+            if self._mgr_policy is None:
+                self._mgr_policy = rpc.rediscovery_policy()
+            self._mgr_fails += 1
+            await asyncio.sleep(self._mgr_policy.backoff(self._mgr_fails))
             return PollResult(batch_count=0)
+        self._mgr_fails = 0
         if not ok:
             await asyncio.sleep(0.1)
             return PollResult(batch_count=0)

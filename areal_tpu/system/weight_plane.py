@@ -334,8 +334,12 @@ def parse_range_start(request: web.Request) -> int:
 def chunk_response(data: memoryview, start: int, chunk_hash: str) -> web.Response:
     if start >= len(data):
         return web.json_response({"error": "range start past chunk"}, status=416)
+    # Chaos point (corrupt action): flip payload bytes AFTER the hash
+    # header was stamped — every consumer's sha256 verify must catch it
+    # and re-fetch; corrupt weights must never cut over silently.
+    body = faults.maybe_corrupt("weight_plane.chunk_bytes", bytes(data[start:]))
     return web.Response(
-        body=bytes(data[start:]),
+        body=body,
         status=206 if start else 200,
         headers={
             "X-Chunk-Hash": chunk_hash,

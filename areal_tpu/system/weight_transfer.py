@@ -871,7 +871,13 @@ def load_for_serving(
     attempts = max(1, retries)
     last_info = None
     raw_seen: Dict[str, int] = {}
-    for attempt in range(attempts):
+
+    class _VersionLag(OSError):
+        """Dump not (yet) at the pinned version — retryable while the
+        publisher's write lands across NFS attribute caching."""
+
+    def attempt(_timeout: float):
+        nonlocal last_info
         params, info = _load_once(
             model_path, shm_dir, t0,
             want_version=want_version, raw_seen=raw_seen,
@@ -879,8 +885,20 @@ def load_for_serving(
         if want_version is None or info["version"] == want_version:
             return params, info
         last_info = info
-        if attempt < attempts - 1:
-            time.sleep(retry_s)
+        raise _VersionLag(f"dump at {info['version']} != {want_version}")
+
+    # Fixed-interval local wait (the historical 40 x 0.25 s cadence,
+    # no jitter): an NFS write landing, not a congested peer —
+    # deliberately NOT routed through rpc.retry_sync, whose
+    # process-global areal:rpc_* counters must only ever describe
+    # network calls (a routine weight swap would otherwise read as a
+    # phantom RPC retry storm on every dashboard).
+    for att in range(attempts):
+        try:
+            return attempt(3600.0)
+        except _VersionLag:
+            if att + 1 < attempts:
+                time.sleep(retry_s)
     raise WeightVersionMismatch(
         f"requested weight version {want_version} but "
         + (

@@ -51,6 +51,15 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "weight_update_ms", "weight_transfer_ms", "weight_cutover_ms",
         "origin_full_payloads",
     ),
+    # The hedging A/B is only evidence as a PAIR with its win/cancel
+    # accounting: a low hedged p99 without hedge_wins could just mean
+    # the injected tail never landed.
+    "rpc_resilience": (
+        "n_chunks", "injected_delay_ms", "hedge_delay_ms",
+        "unhedged_p50_ms", "unhedged_p99_ms",
+        "hedged_p50_ms", "hedged_p99_ms",
+        "hedge_wins", "hedge_cancelled", "hedge_failures",
+    ),
     # Quantized-wire evidence without its dequant-parity check field is
     # not evidence: a record could bank a great ingress number off a
     # stream that assembles to garbage weights.
@@ -438,6 +447,59 @@ def _validate_fleet_elastic(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_rpc_resilience(val: Dict) -> List[str]:
+    """The hedging contract (ISSUE 14 acceptance): under the injected
+    delay tail, the hedged arm's p99 must be MEASURABLY lower than the
+    unhedged arm's — sitting below the injected tail, which the
+    unhedged arm must actually have eaten (otherwise the A/B measured
+    nothing) — and the win/cancel accounting must prove hedges ran,
+    won, and cancelled their losers instead of double-counting."""
+    problems: List[str] = []
+    injected = _num(val, "injected_delay_ms") or 0.0
+    unhedged = _num(val, "unhedged_p99_ms")
+    hedged = _num(val, "hedged_p99_ms")
+    if injected <= 0:
+        problems.append(
+            "rpc_resilience: no injected delay — the A/B has no tail "
+            "to escape"
+        )
+    if unhedged is None or unhedged < injected:
+        problems.append(
+            f"rpc_resilience: unhedged p99 {unhedged} ms below the "
+            f"injected {injected} ms tail — the slow peer never "
+            f"landed, so the hedged number proves nothing"
+        )
+    if hedged is None or unhedged is None or hedged >= unhedged:
+        problems.append(
+            f"rpc_resilience: hedged p99 {hedged} ms not below "
+            f"unhedged {unhedged} ms — hedging bought nothing"
+        )
+    if hedged is not None and injected > 0 and hedged >= injected:
+        problems.append(
+            f"rpc_resilience: hedged p99 {hedged} ms still at/above "
+            f"the injected {injected} ms tail — the hedge never "
+            f"escaped the slow holder"
+        )
+    if (_num(val, "hedge_wins") or 0) < 1:
+        problems.append(
+            "rpc_resilience: zero hedge wins — a low hedged p99 "
+            "without wins just means the tail never landed on the "
+            "hedged arm"
+        )
+    if (_num(val, "hedge_cancelled") or 0) < 1:
+        problems.append(
+            "rpc_resilience: zero cancelled losers — every win must "
+            "abandon its loser or bytes get double-counted"
+        )
+    if (_num(val, "hedge_failures") or 0) > 0:
+        problems.append(
+            f"rpc_resilience: {val.get('hedge_failures')} hedged pull "
+            f"failure(s) — both holders serve the same verified bytes, "
+            f"a failure means the substrate dropped a request"
+        )
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -476,6 +538,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_sessions_resident(val))
     if name == "fleet_elastic":
         problems.extend(_validate_fleet_elastic(val))
+    if name == "rpc_resilience":
+        problems.extend(_validate_rpc_resilience(val))
     if name == "serving_disagg":
         failed = val.get("disagg_failed")
         if isinstance(failed, (int, float)) and failed > 0:

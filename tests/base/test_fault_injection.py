@@ -120,3 +120,19 @@ def test_bad_env_entry_ignored():
     inj.load_env("not-a-valid-entry;test.p=raise")
     with pytest.raises(FaultInjected):
         inj.maybe_fail("test.p")
+
+
+def test_declared_variants_verify_registry():
+    # The dynamic-sweep API: declared and test.* points pass through,
+    # an undeclared point raises instead of arming a silent no-op.
+    inj = FaultInjector()
+    inj.arm_declared("worker.poll", action="raise")
+    with pytest.raises(FaultInjected):
+        inj.maybe_fail("worker.poll")
+    assert inj.hits_declared("worker.poll") == 1
+
+    inj.arm_declared("test.dynamic_ok", action="raise")
+    with pytest.raises(ValueError, match="undeclared chaos point"):
+        inj.arm_declared("renamed.or_typod", action="raise")
+    with pytest.raises(ValueError, match="undeclared chaos point"):
+        inj.hits_declared("renamed.or_typod")

@@ -1,0 +1,462 @@
+"""Unit coverage for the RPC substrate (base/rpc.py): deadline
+arithmetic and wire round-trip, budget-derived attempt timeouts (the
+deadline-exceeded short-circuit BEFORE attempt 1), retry/backoff/shed
+semantics, the breaker state machine (closed -> open -> half-open
+probe), and hedged reads with loser cancellation — no double-count of
+loser results, sync and async."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from areal_tpu.base import rpc
+from areal_tpu.base.wire_routes import DEADLINE_HEADER
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    rpc.stats.reset()
+    yield
+    rpc.stats.reset()
+
+
+# -- Deadline -------------------------------------------------------------
+
+def test_deadline_remaining_and_header_roundtrip():
+    d = rpc.Deadline.after(5.0)
+    assert 4.5 < d.remaining() <= 5.0
+    assert not d.expired()
+    hv = d.header_value()
+    assert hv is not None
+    # Wire rule: REMAINING seconds, re-anchored by the receiving hop.
+    back = rpc.Deadline.from_headers({DEADLINE_HEADER: hv})
+    assert back is not None
+    assert abs(back.remaining() - d.remaining()) < 0.5
+
+
+def test_deadline_headers_merge_and_unbounded():
+    d = rpc.Deadline.after(2.0)
+    h = d.headers({"Range": "bytes=0-1"})
+    assert h["Range"] == "bytes=0-1" and DEADLINE_HEADER in h
+    ub = rpc.Deadline.unbounded()
+    assert ub.headers() == {}
+    assert ub.remaining() == float("inf")
+    assert rpc.Deadline.from_headers({}) is None
+    assert rpc.Deadline.from_header_value("junk") is None
+
+
+def test_deadline_cap_never_extends():
+    d = rpc.Deadline.after(0.5)
+    capped = d.cap(100.0)
+    assert capped.remaining() <= 0.5 + 1e-3
+    widened = rpc.Deadline.unbounded().cap(1.0)
+    assert widened.bounded() and widened.remaining() <= 1.0 + 1e-3
+
+
+def test_ensure_deadline_prefers_callers():
+    d = rpc.Deadline.after(1.0)
+    assert rpc.ensure_deadline(d, 100.0) is d
+    fresh = rpc.ensure_deadline(None, 0.25)
+    assert fresh.remaining() <= 0.25 + 1e-3
+
+
+# -- RetryPolicy ----------------------------------------------------------
+
+def test_attempt_timeout_clips_to_budget():
+    pol = rpc.RetryPolicy(attempt_timeout_s=30.0)
+    assert pol.attempt_timeout(None) == 30.0
+    assert pol.attempt_timeout(rpc.Deadline.after(2.0)) <= 2.0
+
+
+def test_expired_deadline_short_circuits_before_first_attempt():
+    """The headline behavior: a call whose budget is already spent
+    makes ZERO socket attempts — RpcDeadlineExceeded fires from the
+    policy, and the counter proves no attempt was burned."""
+    pol = rpc.default_policy()
+    dead = rpc.Deadline.after(-1.0)
+    calls = []
+    with pytest.raises(rpc.RpcDeadlineExceeded):
+        rpc.retry_sync(
+            lambda t: calls.append(t), policy=pol, deadline=dead,
+        )
+    assert calls == []
+    snap = rpc.stats.snapshot()
+    assert snap["deadline_expired"] == 1
+    assert snap["attempts"] == 0
+
+
+def test_backoff_floors_on_retry_after_and_caps_on_deadline():
+    pol = rpc.RetryPolicy(backoff_base_s=0.01, backoff_max_s=0.04)
+    assert pol.backoff(1, retry_after=3.0) >= 3.0
+    # No point sleeping past the deadline.
+    d = rpc.Deadline.after(0.05)
+    assert pol.backoff(1, retry_after=3.0, deadline=d) <= 0.06
+    # Jitter stays within +-jitter fraction of the computed delay.
+    pol0 = rpc.RetryPolicy(backoff_base_s=0.1, backoff_max_s=10.0,
+                           jitter=0.0)
+    assert pol0.backoff(3) == pytest.approx(0.4)
+
+
+def test_policies_read_registered_knobs(monkeypatch):
+    monkeypatch.setenv("AREAL_RPC_ATTEMPTS", "7")
+    monkeypatch.setenv("AREAL_RPC_REDISCOVERY_ATTEMPTS", "9")
+    assert rpc.default_policy().attempts == 7
+    assert rpc.default_policy(attempts=2).attempts == 2
+    assert rpc.rediscovery_policy().attempts == 9
+
+
+def test_shed_backoff_ramps_and_jitters():
+    waits = {rpc.shed_backoff(1, 1.0) for _ in range(16)}
+    assert all(0.5 <= w <= 1.5 for w in waits)
+    assert len(waits) > 1  # jittered — never synchronized
+    assert rpc.shed_backoff(10, 1.0, cap=4.0) <= 4.0 * 1.5
+
+
+# -- retry loops ----------------------------------------------------------
+
+def test_retry_sync_flaky_then_success():
+    fails = {"n": 0}
+
+    def fn(timeout):
+        if fails["n"] < 2:
+            fails["n"] += 1
+            raise OSError("flaky")
+        return "ok"
+
+    pol = rpc.RetryPolicy(attempts=4, backoff_base_s=0.001,
+                          backoff_max_s=0.002)
+    assert rpc.retry_sync(fn, policy=pol) == "ok"
+    snap = rpc.stats.snapshot()
+    assert snap["attempts"] == 3 and snap["retries"] == 2
+    assert snap["failures"] == 0
+
+
+def test_retry_sync_exhaustion_raises_with_cause():
+    pol = rpc.RetryPolicy(attempts=2, backoff_base_s=0.001,
+                          backoff_max_s=0.002)
+
+    def fn(timeout):
+        raise ValueError("always")
+
+    with pytest.raises(rpc.RpcError) as ei:
+        rpc.retry_sync(fn, policy=pol)
+    assert isinstance(ei.value.__cause__, ValueError)
+    assert rpc.stats.snapshot()["failures"] == 1
+
+
+def test_retry_sync_nonretryable_propagates():
+    def fn(timeout):
+        raise KeyError("not transient")
+
+    with pytest.raises(KeyError):
+        rpc.retry_sync(fn, policy=rpc.RetryPolicy(attempts=3))
+
+
+def test_retry_sync_shed_never_counts_as_breaker_failure():
+    board = rpc.BreakerBoard(fail_threshold=1, cooldown_s=60.0)
+
+    def fn(timeout):
+        raise rpc.RpcShed("peer", retry_after=0.001)
+
+    pol = rpc.RetryPolicy(attempts=2, backoff_base_s=0.001,
+                          backoff_max_s=0.002)
+    with pytest.raises(rpc.RpcError):
+        rpc.retry_sync(fn, policy=pol, peer="p1", board=board)
+    # Sheds are deliberate backpressure: breaker still closed.
+    assert board.breaker("p1").state() == rpc.STATE_CLOSED
+
+
+def test_probe_slot_resolves_on_shed_and_nonretryable():
+    # An allow()-granted half-open probe slot must be resolved by EVERY
+    # attempt outcome. A leaked slot keeps _state_locked() half-open
+    # with _probing set, so every future allow() rejects and the peer
+    # is wedged out forever.
+    board = rpc.BreakerBoard(fail_threshold=1, cooldown_s=0.02)
+    board.record("p", ok=False)
+    time.sleep(0.03)  # half-open by time
+
+    def shed(timeout):
+        raise rpc.RpcShed("p", retry_after=0.0)
+
+    # Probe answers 429: the peer is alive and answering — breaker
+    # closes (and the slot resolves) even though the call itself fails.
+    with pytest.raises(rpc.RpcError):
+        rpc.retry_sync(shed, policy=rpc.RetryPolicy(attempts=1),
+                       peer="p", board=board)
+    assert board.breaker("p").state() == rpc.STATE_CLOSED
+
+    board2 = rpc.BreakerBoard(fail_threshold=1, cooldown_s=0.02)
+    board2.record("q", ok=False)
+    time.sleep(0.03)
+
+    def boom(timeout):
+        raise KeyError("non-retryable application bug")
+
+    with pytest.raises(KeyError):
+        rpc.retry_sync(boom, policy=rpc.RetryPolicy(attempts=1),
+                       peer="q", board=board2)
+    # Slot released, not leaked: the next caller can still probe.
+    assert board2.allow("q")
+
+
+def test_retry_async_matches_sync_semantics():
+    async def run():
+        fails = {"n": 0}
+
+        async def fn(timeout):
+            if fails["n"] < 1:
+                fails["n"] += 1
+                raise OSError("flaky")
+            return 42
+
+        pol = rpc.RetryPolicy(attempts=3, backoff_base_s=0.001,
+                              backoff_max_s=0.002)
+        return await rpc.retry_async(fn, policy=pol)
+
+    assert asyncio.run(run()) == 42
+
+
+# -- breaker state machine ------------------------------------------------
+
+def test_breaker_opens_after_threshold_and_rejects():
+    br = rpc.CircuitBreaker("p", fail_threshold=3, cooldown_s=60.0)
+    for _ in range(2):
+        br.record_failure()
+    assert br.state() == rpc.STATE_CLOSED and br.allow()
+    br.record_failure()
+    assert br.state() == rpc.STATE_OPEN
+    assert not br.allow()
+    assert br.rejections == 1
+    assert rpc.stats.snapshot()["breaker_rejections"] == 1
+    assert rpc.stats.snapshot()["breaker_opens"] == 1
+
+
+def test_breaker_half_open_single_probe_then_close():
+    br = rpc.CircuitBreaker("p", fail_threshold=1, cooldown_s=0.02)
+    br.record_failure()
+    assert br.state() == rpc.STATE_OPEN
+    time.sleep(0.03)
+    assert br.state() == rpc.STATE_HALF_OPEN
+    # Exactly ONE caller wins the probe slot.
+    assert br.allow()
+    assert not br.allow()
+    br.record_success()
+    assert br.state() == rpc.STATE_CLOSED
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens_for_fresh_cooldown():
+    br = rpc.CircuitBreaker("p", fail_threshold=1, cooldown_s=0.02)
+    br.record_failure()
+    time.sleep(0.03)
+    assert br.allow()  # the probe
+    br.record_failure()
+    assert br.state() == rpc.STATE_OPEN  # re-opened, cooldown restarted
+    assert br.opens == 2
+    snap = br.snapshot()
+    assert snap["state"] == rpc.STATE_OPEN and snap["opens"] == 2
+
+
+def test_breaker_record_fed_reopens_without_allow():
+    # The manager's board is fed ONLY through record() (its own polls +
+    # client-reported failures) — it never takes the allow() probe
+    # slot. Once the cooldown elapses, the next recorded failure must
+    # act as the failed probe and re-open for a fresh cooldown;
+    # otherwise the breaker sits half-open forever and the still-
+    # failing peer re-enters rotation on every open_peers() poll.
+    board = rpc.BreakerBoard(fail_threshold=1, cooldown_s=0.02)
+    board.record("p", ok=False)
+    assert board.open_peers() == ["p"]
+    time.sleep(0.03)
+    assert board.open_peers() == []  # half-open: probe traffic allowed
+    board.record("p", ok=False)      # the probe failed
+    assert board.open_peers() == ["p"]
+    assert board.breaker("p").opens == 2
+    # A failure landing INSIDE the open window must not reset the
+    # cooldown clock (or a polling manager would hold it open forever).
+    br = board.breaker("p")
+    opened_at = br._opened_at
+    board.record("p", ok=False)
+    assert br._opened_at == opened_at
+    # And success while half-open closes it for good.
+    time.sleep(0.03)
+    board.record("p", ok=True)
+    assert br.state() == rpc.STATE_CLOSED
+
+
+def test_hedge_failures_counts_whole_races_once():
+    # A transient leg failure inside a race the hedge WON must not
+    # count as a hedge failure (the bench's validator refuses records
+    # with hedge_failures > 0); a fully-lost race counts exactly once.
+    rpc.stats.reset()
+
+    def ok():
+        time.sleep(0.01)
+        return b"x"
+
+    def bad():
+        raise OSError("leg down")
+
+    out, winner = rpc.hedged_sync([bad, ok], hedge_delay=0.001)
+    assert out == b"x" and winner == 1
+    assert rpc.stats.snapshot()["hedge_failures"] == 0
+
+    with pytest.raises(rpc.RpcError):
+        rpc.hedged_sync([bad, bad], hedge_delay=0.001)
+    assert rpc.stats.snapshot()["hedge_failures"] == 1
+
+
+def test_retry_async_retries_asyncio_timeout():
+    # On Python < 3.11 asyncio.TimeoutError is NOT builtin TimeoutError,
+    # yet it is exactly what an aiohttp total-timeout raises: the
+    # default retryable set must absorb it or one slow attempt aborts
+    # the whole call un-retried.
+    import asyncio
+
+    calls = {"n": 0}
+
+    async def attempt(timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise asyncio.TimeoutError("slow peer")
+        return "ok"
+
+    out = asyncio.run(rpc.retry_async(
+        attempt, policy=rpc.RetryPolicy(attempts=2, backoff_base_s=0.001),
+    ))
+    assert out == "ok" and calls["n"] == 2
+
+
+def test_retry_sync_stops_at_open_breaker():
+    board = rpc.BreakerBoard(fail_threshold=1, cooldown_s=60.0)
+    board.record("p1", ok=False)
+
+    def fn(timeout):
+        raise AssertionError("must not be called: breaker is open")
+
+    with pytest.raises(rpc.BreakerOpen):
+        rpc.retry_sync(fn, policy=rpc.RetryPolicy(attempts=3),
+                       peer="p1", board=board)
+
+
+def test_board_tracks_peers_independently_and_drops():
+    board = rpc.BreakerBoard(fail_threshold=1, cooldown_s=60.0)
+    board.record("a", ok=False)
+    board.record("b", ok=True)
+    assert board.open_peers() == ["a"]
+    assert not board.allow("a") and board.allow("b")
+    board.drop("a")
+    assert board.open_peers() == []
+    assert board.allow("a")  # fresh breaker after drop
+    assert set(board.snapshot()) == {"a", "b"}
+
+
+# -- hedged reads ---------------------------------------------------------
+
+def test_hedged_sync_primary_wins_without_hedging():
+    out, idx = rpc.hedged_sync(
+        [lambda: "fast", lambda: "never"], hedge_delay=5.0,
+    )
+    assert (out, idx) == ("fast", 0)
+    snap = rpc.stats.snapshot()
+    assert snap["hedges"] == 0 and snap["hedge_wins"] == 0
+
+
+def test_hedged_sync_slow_primary_loses_no_double_count():
+    """The hedge launches after the silence window, wins, and the slow
+    primary's eventual result is dropped on the floor: exactly one
+    result reaches the caller (no ingress double-count) and the loser
+    is recorded in hedge_cancelled."""
+    primary_done = threading.Event()
+
+    def slow():
+        time.sleep(0.25)
+        primary_done.set()
+        return "slow"
+
+    out, idx = rpc.hedged_sync(
+        [slow, lambda: "hedge"], hedge_delay=0.02,
+    )
+    assert (out, idx) == ("hedge", 1)
+    snap = rpc.stats.snapshot()
+    assert snap["hedges"] == 1
+    assert snap["hedge_wins"] == 1
+    assert snap["hedge_cancelled"] == 1
+    primary_done.wait(2.0)  # let the abandoned thread drain
+
+
+def test_hedged_sync_failed_primary_launches_hedge_immediately():
+    t0 = time.monotonic()
+
+    def bad():
+        raise OSError("down")
+
+    out, idx = rpc.hedged_sync([bad, lambda: "ok"], hedge_delay=30.0)
+    assert (out, idx) == ("ok", 1)
+    assert time.monotonic() - t0 < 5.0  # did not sit out the window
+
+
+def test_hedged_sync_all_fail_raises_primary_cause():
+    def bad():
+        raise OSError("down")
+
+    with pytest.raises(rpc.RpcError):
+        rpc.hedged_sync([bad, bad], hedge_delay=0.01)
+    assert rpc.stats.snapshot()["failures"] == 1
+
+
+def test_hedged_sync_deadline_expires_mid_race():
+    with pytest.raises(rpc.RpcDeadlineExceeded):
+        rpc.hedged_sync(
+            [lambda: time.sleep(5.0)], hedge_delay=0.01,
+            deadline=rpc.Deadline.after(0.05),
+        )
+
+
+def test_hedged_async_cancels_losers():
+    async def run():
+        cancelled = asyncio.Event()
+
+        async def slow():
+            try:
+                await asyncio.sleep(30.0)
+                return "slow"
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        async def hedge():
+            return "hedge"
+
+        out, idx = await rpc.hedged_async(
+            [slow, hedge], hedge_delay=0.02,
+        )
+        # Loser was truly cancelled — its socket torn down, its bytes
+        # never delivered.
+        await asyncio.wait_for(cancelled.wait(), 2.0)
+        return out, idx
+
+    out, idx = asyncio.run(run())
+    assert (out, idx) == ("hedge", 1)
+    snap = rpc.stats.snapshot()
+    assert snap["hedge_wins"] == 1 and snap["hedge_cancelled"] == 1
+
+
+def test_hedged_async_all_fail():
+    async def run():
+        async def bad():
+            raise OSError("down")
+
+        with pytest.raises(rpc.RpcError):
+            await rpc.hedged_async([bad, bad], hedge_delay=0.01)
+
+    asyncio.run(run())
+
+
+def test_hedge_knobs(monkeypatch):
+    monkeypatch.setenv("AREAL_RPC_HEDGE", "0")
+    assert not rpc.hedging_enabled()
+    monkeypatch.setenv("AREAL_RPC_HEDGE_DELAY_S", "0.75")
+    assert rpc.hedge_delay_s() == 0.75

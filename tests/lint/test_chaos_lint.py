@@ -85,6 +85,28 @@ def test_test_namespace_exempt(tmp_path):
     assert findings == []
 
 
+def test_declared_variants_allow_dynamic_but_check_literals(tmp_path):
+    # arm_declared/hits_declared carry the registry contract at
+    # runtime (the injector raises on an undeclared name), so a
+    # computed point is fine — that's how the all-points campaign
+    # sweeps the registry. A LITERAL name is still verified statically:
+    # the free check catches the typo before any test runs.
+    findings = _lint(tmp_path, """
+        from areal_tpu.base.fault_injection import faults
+
+        def sweep(points):
+            for p in points:
+                faults.arm_declared(p, action="raise")
+                assert faults.hits_declared(p) >= 0
+            faults.arm_declared("good.point", action="raise")
+            faults.arm_declared("renamed.point", action="raise")
+            assert faults.hits_declared("also.renamed") == 0
+    """)
+    assert len(findings) == 2
+    assert "renamed.point" in findings[0].message
+    assert "also.renamed" in findings[1].message
+
+
 def test_arm_and_hits_unknown_point_flagged(tmp_path):
     findings = _lint(tmp_path, """
         from areal_tpu.base.fault_injection import faults

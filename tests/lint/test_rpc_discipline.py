@@ -1,0 +1,272 @@
+"""rpc-discipline checker fixtures: seeded raw HTTP retry loops and
+naked per-call timeouts, plus the exempt shapes — the registry module
+itself, policy-derived backoff waits (a migrated client state
+machine), session-scoped ClientSession timeouts, knob-derived
+timeouts, scaffolding prefixes, and registry-entry rot."""
+
+import textwrap
+
+from areal_tpu.lint.rpc_discipline import RpcConfig
+from areal_tpu.lint.runner import LintConfig, run_lint
+
+_CFG = RpcConfig(
+    allowed={"allowed/rpc.py"},
+    registry_rel="allowed/rpc.py",
+)
+
+
+def _lint(tmp_path, source, *, name="mod.py", cfg=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    lint_cfg = LintConfig(root=str(tmp_path), rpc_cfg=cfg or _CFG,
+                          checkers={"rpc-discipline"})
+    return run_lint([str(p)], lint_cfg)
+
+
+# -- raw retry loops ------------------------------------------------------
+
+def test_urlopen_sleep_loop_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+        import urllib.request
+
+        def fetch(url):
+            for attempt in range(4):
+                try:
+                    with urllib.request.urlopen(url) as r:
+                        return r.read()
+                except OSError:
+                    time.sleep(0.05 * attempt)
+    """)
+    assert len(findings) == 1
+    assert "raw HTTP retry loop" in findings[0].message
+
+
+def test_async_session_sleep_loop_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import asyncio
+
+        async def fetch(sess, url):
+            while True:
+                try:
+                    async with sess.post(url, json={}) as r:
+                        return await r.json()
+                except Exception:
+                    await asyncio.sleep(0.5)
+    """)
+    assert len(findings) == 1
+    assert "raw HTTP retry loop" in findings[0].message
+
+
+def test_requests_loop_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+        import requests
+
+        def fetch(url):
+            for _ in range(3):
+                try:
+                    return requests.get(url).json()
+                except Exception:
+                    time.sleep(1.0)
+    """)
+    assert len(findings) == 1
+    assert "raw HTTP retry loop" in findings[0].message
+
+
+def test_policy_backoff_wait_exempt(tmp_path):
+    # partial_rollout's shape: the loop owns failover/shed decisions
+    # but every wait is the declared policy — not a raw loop.
+    findings = _lint(tmp_path, """
+        import asyncio
+        from areal_tpu.base import rpc
+
+        async def run(self, sess, url):
+            fails = 0
+            while True:
+                try:
+                    async with sess.post(url, json={}) as r:
+                        return await r.json()
+                except Exception:
+                    fails += 1
+                    await asyncio.sleep(self.policy.backoff(fails))
+    """)
+    assert findings == []
+
+
+def test_poll_loop_without_http_exempt(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+
+        def wait(flag):
+            while not flag():
+                time.sleep(0.1)
+    """)
+    assert findings == []
+
+
+def test_http_loop_without_sleep_exempt(tmp_path):
+    # Paginated fetch, no backoff: iteration, not retry.
+    findings = _lint(tmp_path, """
+        import urllib.request
+
+        def fetch_all(urls):
+            return [urllib.request.urlopen(u).read() for u in urls]
+
+        def fetch_pages(sess_urls):
+            out = []
+            for u in sess_urls:
+                with urllib.request.urlopen(u) as r:
+                    out.append(r.read())
+            return out
+    """)
+    assert findings == []
+
+
+def test_helper_defined_in_loop_not_conflated(tmp_path):
+    # A sleeping helper DEFINED inside a loop that also fetches is not
+    # the loop retrying.
+    findings = _lint(tmp_path, """
+        import time
+        import urllib.request
+
+        def build(urls):
+            fns = []
+            for u in urls:
+                def poll():
+                    time.sleep(1.0)
+                fns.append(poll)
+                urllib.request.urlopen(u).close()
+            return fns
+    """)
+    assert findings == []
+
+
+# -- naked per-call timeouts ----------------------------------------------
+
+def test_urlopen_literal_timeout_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url, timeout=30) as r:
+                return r.read()
+    """)
+    assert len(findings) == 1
+    assert "naked numeric timeout" in findings[0].message
+
+
+def test_session_clienttimeout_literal_flagged(tmp_path):
+    findings = _lint(tmp_path, """
+        import aiohttp
+
+        async def fetch(sess, url):
+            async with sess.get(
+                url, timeout=aiohttp.ClientTimeout(total=30.0)
+            ) as r:
+                return await r.read()
+    """)
+    assert len(findings) == 1
+    assert "naked numeric timeout" in findings[0].message
+
+
+def test_budget_derived_timeout_exempt(tmp_path):
+    findings = _lint(tmp_path, """
+        import urllib.request
+
+        def fetch(url, attempt_timeout):
+            with urllib.request.urlopen(url, timeout=attempt_timeout) as r:
+                return r.read()
+    """)
+    assert findings == []
+
+
+def test_session_scoped_default_exempt(tmp_path):
+    # ClientSession(timeout=...) is a session default declared once,
+    # capped per call by deadlines — not a per-call literal.
+    findings = _lint(tmp_path, """
+        import aiohttp
+
+        def make_session():
+            return aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5)
+            )
+    """)
+    assert findings == []
+
+
+def test_dict_get_not_an_http_call(tmp_path):
+    # ``session.get("key")``-shaped dict access on a session-named var
+    # must not be mistaken for HTTP without HTTP-call keywords.
+    findings = _lint(tmp_path, """
+        import time
+
+        def drain(session):
+            while session:
+                session.get("key")
+                time.sleep(0.1)
+    """)
+    assert findings == []
+
+
+# -- registry / scoping ---------------------------------------------------
+
+def test_registry_module_exempt(tmp_path):
+    findings = _lint(tmp_path, """
+        import time
+        import urllib.request
+
+        def retry(url):
+            for k in range(4):
+                try:
+                    return urllib.request.urlopen(url, timeout=30).read()
+                except OSError:
+                    time.sleep(2 ** k)
+    """, name="allowed/rpc.py")
+    assert findings == []
+
+
+def test_scaffolding_prefixes_exempt(tmp_path):
+    src = """
+        import time
+        import urllib.request
+
+        def wait_up(url):
+            while True:
+                try:
+                    return urllib.request.urlopen(url, timeout=5).read()
+                except OSError:
+                    time.sleep(0.2)
+    """
+    assert _lint(tmp_path, src, name="tests/system/helper.py") == []
+    assert _lint(tmp_path, src, name="areal_tpu/bench/driver.py") == []
+    assert len(_lint(tmp_path, src, name="areal_tpu/system/x.py")) == 2
+
+
+def test_registry_rot_flagged(tmp_path):
+    cfg = RpcConfig(
+        allowed={"allowed/rpc.py", "moved/away.py"},
+        registry_rel="allowed/rpc.py",
+    )
+    findings = _lint(tmp_path, "x = 1\n", name="allowed/rpc.py", cfg=cfg)
+    assert len(findings) == 1
+    assert "moved/away.py" in findings[0].message
+
+
+def test_real_tree_is_clean():
+    """The production tree itself holds the line: zero findings with
+    the real registry and an EMPTY allowlist (the acceptance bar)."""
+    import os
+
+    from areal_tpu.lint import rpc_discipline
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(rpc_discipline.__file__)
+    )))
+    cfg = LintConfig(
+        root=root,
+        checkers={"rpc-discipline"},
+    )
+    findings = run_lint([os.path.join(root, "areal_tpu")], cfg)
+    assert findings == [], [f.render() for f in findings]

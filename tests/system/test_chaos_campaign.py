@@ -1,0 +1,1105 @@
+"""All-points chaos campaign (ISSUE 14 tentpole): every fault point
+declared in ``base/fault_points.py`` is fired against real machinery
+and the fleet invariants are asserted — zero failed work, honest loss
+accounting (``kv_prefix_lost_total`` stays 0 everywhere the contract
+promises preservation; the one point whose DOCUMENTED contract is
+"count the loss, never wedge" — ``engine.kv_spill`` — asserts the
+exact injected count instead), and clean eviction-or-recovery.
+
+Before this module, chaos coverage was per-PR anecdotes: each PR armed
+the two or three points its feature introduced and nothing swept the
+rest. The campaign is the systematic gate:
+
+- ``test_campaign_covers_every_declared_point`` (tier-1, milliseconds)
+  fails the moment a new FaultPoint lands without a campaign driver —
+  declaring a point now REQUIRES declaring how it is swept.
+- The fast half (tier-1) drives every point whose machinery runs
+  without a serving fleet: the weight plane in-process, the
+  fake-fleet control plane (real GserverManager + PartialRolloutManager
+  + RolloutWorker episode loop), the bench runner, the worker poll
+  loop, and a real CPU-jax ServingEngine for the spill path.
+- The fleet half (``slow``-marked, one shared 2-server subprocess
+  fleet like test_kv_tier_e2e) drives the generation-server points
+  end to end, arming subprocesses at runtime through the
+  AREAL_CHAOS_HTTP /configure surface.
+
+Actions swept include the PR 14 additions: ``flaky`` (fail-N-then-
+succeed — the substrate's retry budget must absorb it invisibly) and
+``corrupt`` (bytes flipped after the hash was stamped — the sha256
+verify on weight AND KV chunk paths must reject and re-fetch).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Callable, Dict
+
+import pytest
+
+from areal_tpu.base import fault_points
+from areal_tpu.base.fault_injection import FaultInjected, faults
+from tests import fixtures
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+FAST: Dict[str, Callable] = {}
+FLEET: Dict[str, Callable] = {}
+
+
+def _fast(point):
+    def deco(fn):
+        FAST[point] = fn
+        return fn
+    return deco
+
+
+def _fleet(point):
+    def deco(fn):
+        FLEET[point] = fn
+        return fn
+    return deco
+
+
+def _fired(point, n=1):
+    assert faults.hits_declared(point) >= n, (
+        f"campaign drive never reached {point!r} "
+        f"({faults.hits_declared(point)}/{n} hits) — the sweep would "
+        f"be a silent no-op"
+    )
+
+
+# ======================================================================
+# The systematic gate (tier-1): every declared point has a driver.
+# ======================================================================
+
+
+def test_campaign_covers_every_declared_point():
+    declared = set(fault_points.REGISTRY)
+    covered = set(FAST) | set(FLEET)
+    missing = sorted(declared - covered)
+    stale = sorted(covered - declared)
+    assert not missing, (
+        f"fault points with NO chaos-campaign driver: {missing} — "
+        f"declaring a point requires declaring how the campaign "
+        f"sweeps it (tests/system/test_chaos_campaign.py)"
+    )
+    assert not stale, f"campaign drivers for undeclared points: {stale}"
+    assert not (set(FAST) & set(FLEET)), "a point must have ONE driver"
+
+
+# ======================================================================
+# Fast half — in-process harnesses, tier-1.
+# ======================================================================
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- weight plane (in-process source + stores, no jax) -----------------
+
+
+def _plane_roundtrip(tmp_path, arm):
+    """Dump -> source -> ChunkStore fetch with ``arm()`` applied; the
+    transfer must complete with content parity (corruption/failure
+    absorbed by retry + hash verify, never delivered)."""
+    from areal_tpu.engine.weight_client import ChunkStore, fetch_manifest
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from tests.system.test_weight_plane import (
+        _assert_tree_equal, _params, assemble_params,
+    )
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    d = str(tmp_path / "dump")
+    p = _params(11)
+    dump_raw_params(p, d, version=1)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    try:
+        man = fetch_manifest(src.address, version=1)
+        assert man["n_chunks"] >= 3
+        arm()
+        store = ChunkStore(man)
+        store.fetch([src.address], origin=src.address)
+        assert store.complete()
+        got, v = assemble_params(store)
+        assert v == 1
+        _assert_tree_equal(p, got)
+    finally:
+        src.close()
+
+
+@_fast("weight_plane.serve_chunk")
+def _drive_serve_chunk(tmp_path, monkeypatch):
+    # A serving peer fails one chunk request mid-transfer: the unified
+    # retry policy (base/rpc.py) absorbs it; the transfer completes.
+    _plane_roundtrip(tmp_path, lambda: faults.arm(
+        "weight_plane.serve_chunk", action="raise", at_hit=2, times=1,
+    ))
+    _fired("weight_plane.serve_chunk")
+
+
+@_fast("weight_plane.chunk_bytes")
+def _drive_weight_corrupt(tmp_path, monkeypatch):
+    # corrupt action: bytes flipped AFTER the hash header was stamped.
+    # The puller's sha256 verify must reject the chunk and the re-fetch
+    # must deliver clean bytes — content parity proves corrupt weights
+    # never complete a transfer.
+    _plane_roundtrip(tmp_path, lambda: faults.arm(
+        "weight_plane.chunk_bytes", action="corrupt", at_hit=2, times=1,
+    ))
+    _fired("weight_plane.chunk_bytes")
+
+
+def _post_raw(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except ValueError:
+            return e.code, {"raw": body.decode(errors="replace")}
+
+
+def _distribute_harness_roundtrip(tmp_path, point, action):
+    """Fire ``point`` inside a REAL GenerationServer /distribute_weights
+    handler (partial server, no engine): the injected failure costs one
+    500 the manager-side re-fanout machinery retries; the second push
+    completes with parity."""
+    from areal_tpu.engine.weight_client import fetch_manifest
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from tests.system.test_weight_plane import (
+        _DistributeHarness, _assert_tree_equal, _params, assemble_params,
+    )
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    d = str(tmp_path / "dump")
+    p = _params(12)
+    dump_raw_params(p, d, version=1)
+    src = WeightPlaneSource(d, chunk_bytes=1 << 12).start()
+    harness = _DistributeHarness().start()
+    try:
+        man = fetch_manifest(src.address, version=1)
+        faults.arm_declared(point, action=action, at_hit=1, times=1)
+        body = {
+            "version": 1, "manifest": man,
+            "upstreams": [src.address], "origin": src.address,
+        }
+        status1, resp1 = _post_raw(
+            f"{harness.address}/distribute_weights", body
+        )
+        assert status1 == 500, (status1, resp1)
+        _fired(point)
+        # The manager's re-fanout (idempotent, version-pinned) retries:
+        status2, resp2 = _post_raw(
+            f"{harness.address}/distribute_weights", body
+        )
+        assert status2 == 200 and resp2["success"], (status2, resp2)
+        got, v = assemble_params(harness.srv._wp_store)
+        assert v == 1
+        _assert_tree_equal(p, got)
+    finally:
+        harness.close()
+        src.close()
+
+
+@_fast("gserver.distribute_weights")
+def _drive_distribute(tmp_path, monkeypatch):
+    _distribute_harness_roundtrip(
+        tmp_path, "gserver.distribute_weights", "raise"
+    )
+
+
+@_fast("gserver.weight_fetch")
+def _drive_weight_fetch(tmp_path, monkeypatch):
+    _distribute_harness_roundtrip(
+        tmp_path, "gserver.weight_fetch", "raise"
+    )
+
+
+# -- control plane (fake fleet: real manager/client/worker) ------------
+
+
+def _ctl_env(tmp_path, monkeypatch):
+    """Crib of test_chaos.chaos_env as a plain helper (module reuse)."""
+    from areal_tpu.base import constants, name_resolve
+
+    monkeypatch.setenv("AREAL_HEALTH_TTL", "0.25")
+    monkeypatch.setattr(
+        constants, "PARAM_REALLOC_ROOT", str(tmp_path / "realloc")
+    )
+    repo = name_resolve.reconfigure(
+        "nfs", record_root=str(tmp_path / "name_resolve")
+    )
+    env = {
+        "exp": f"campaign-{uuid.uuid4().hex[:6]}", "trial": "t0",
+        "cleanup": [lambda: repo.reset()],
+    }
+    return env
+
+
+def _ctl_teardown(env):
+    from areal_tpu.base import name_resolve, names
+
+    try:
+        name_resolve.add(
+            names.experiment_status(env["exp"], env["trial"]),
+            "COMPLETE", replace=True,
+        )
+    except Exception:
+        pass
+    time.sleep(0.1)
+    for fn in reversed(env["cleanup"]):
+        try:
+            fn()
+        except Exception:
+            pass
+
+
+@_fast("manager.fanout")
+def _drive_manager_fanout(tmp_path, monkeypatch):
+    """The manager dies^W fails inside the legacy update-weights fanout
+    wave: the poll-loop contract is that weight_version stays put and
+    the idempotent, version-pinned fanout retries — the fleet converges
+    with zero servers stranded on the old version."""
+    from areal_tpu.base import name_resolve, names
+    from tests.system.test_chaos import FakeGenServer, _start_manager, _wait_until
+
+    env = _ctl_env(tmp_path, monkeypatch)
+    try:
+        servers = [
+            FakeGenServer(env["exp"], env["trial"], i) for i in range(2)
+        ]
+        env["cleanup"] += [s.close for s in servers]
+        for s in servers:
+            name_resolve.add_subentry(
+                names.gen_servers(env["exp"], env["trial"]), s.address
+            )
+        m = _start_manager(env, n_servers=2)
+        _wait_until(lambda: len(m._healthy_urls()) == 2,
+                    msg="manager sees 2 healthy fakes")
+        faults.arm("manager.fanout", action="raise", at_hit=1, times=1)
+        m._new_version = 1
+        with pytest.raises(RuntimeError):
+            m.flush_requests_and_update_weights("/fake/path/v1")
+        _fired("manager.fanout")
+        assert m.weight_version == 0  # stays put for the retry
+        # The retry (what the next _poll does) converges the fleet.
+        m.flush_requests_and_update_weights("/fake/path/v1")
+        assert m.weight_version == 1
+        _wait_until(
+            lambda: all(s.versions and s.versions[-1] == 1
+                        for s in servers),
+            msg="both fakes at v1",
+        )
+        assert len(m._healthy_urls()) == 2  # nobody evicted for it
+        m.exit()
+    finally:
+        _ctl_teardown(env)
+
+
+@_fast("manager.plane_fanout")
+def _drive_manager_plane_fanout(tmp_path, monkeypatch):
+    """Fires the declared point through the real method: the injected
+    failure surfaces as the wave failing loudly (the _poll caller
+    catches, keeps weight_version put, and retries next poll — the
+    same contract test_campaign's manager.fanout drive pins end to
+    end)."""
+    from areal_tpu.system.gserver_manager import GserverManager
+
+    faults.arm("manager.plane_fanout", action="raise", at_hit=1, times=1)
+    m = object.__new__(GserverManager)
+    with pytest.raises(FaultInjected):
+        m._plane_update_weights("http://origin:0")
+    _fired("manager.plane_fanout")
+
+
+@_fast("worker.poll")
+def _drive_worker_poll(tmp_path, monkeypatch):
+    """A worker's poll loop dies: the contract is a LOUD prompt death
+    (status ERROR, exception out of run()) the controller restarts —
+    never a silent wedge. Covered end to end by
+    test_controller_restart; here the campaign pins the loud half
+    against a real Worker.run loop."""
+    from tests.system.chaos_workers import SleeperConfig, SleeperWorker
+
+    env = _ctl_env(tmp_path, monkeypatch)
+    try:
+        w = SleeperWorker()
+        w.configure(
+            SleeperConfig(env["exp"], env["trial"], 0),
+            experiment_name=env["exp"], trial_name=env["trial"],
+            worker_name="sleeper/0",
+        )
+        err = {}
+
+        def run():
+            try:
+                w.run()
+            except FaultInjected as e:
+                err["e"] = e
+
+        faults.arm("worker.poll", action="raise", at_hit=3, times=1)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=fixtures.scale_timeout(20))
+        assert not t.is_alive(), "worker wedged instead of dying loudly"
+        assert isinstance(err.get("e"), FaultInjected)
+        _fired("worker.poll")
+    finally:
+        _ctl_teardown(env)
+
+
+@_fast("rollout.episode")
+def _drive_rollout_episode(tmp_path, monkeypatch):
+    """One episode crashes mid-flight: its quota slot is released
+    (leaks would starve the rollout quota), other episodes complete,
+    and the worker keeps going — zero FLEET damage from one bad
+    episode."""
+    from areal_tpu.base import name_resolve, names
+    from areal_tpu.system.push_pull_stream import ZMQJsonPuller
+    from tests.system.test_chaos import (
+        FakeGenServer, _drive_episodes, _mk_rollout_worker,
+        _start_manager, _wait_until,
+    )
+
+    env = _ctl_env(tmp_path, monkeypatch)
+    try:
+        servers = [FakeGenServer(env["exp"], env["trial"], 0)]
+        env["cleanup"] += [s.close for s in servers]
+        name_resolve.add_subentry(
+            names.gen_servers(env["exp"], env["trial"]),
+            servers[0].address,
+        )
+        m = _start_manager(env, n_servers=1)
+        _wait_until(lambda: len(m._healthy_urls()) == 1,
+                    msg="manager sees the fake")
+        puller = ZMQJsonPuller(host="127.0.0.1")
+        env["cleanup"].append(puller.close)
+        faults.arm("rollout.episode", action="raise", at_hit=1, times=1)
+        w = _mk_rollout_worker(env, m.address, puller.port)
+        asyncio.run(_drive_episodes(w, 3))
+        _fired("rollout.episode")
+        # Quota fully released: no slot leaked by the crashed episode.
+        _wait_until(lambda: m.rollout_stat.running == 0,
+                    msg="all quota slots released")
+        assert m.rollout_stat.accepted >= 2  # survivors pushed
+        m.exit()
+    finally:
+        _ctl_teardown(env)
+
+
+@_fast("master.step")
+def _drive_master_step(tmp_path, monkeypatch):
+    """The master is NOT a restartable fault domain: a step failure
+    must escalate out of _poll (whole-experiment relaunch, recover.py)
+    — never be swallowed. Fires the declared site in
+    MasterWorker._poll; the relaunch machinery itself is pinned by
+    test_recover/test_controller_restart."""
+    from areal_tpu.system.master_worker import MasterWorker
+
+    faults.arm("master.step", action="raise", at_hit=1, times=1)
+    m = object.__new__(MasterWorker)
+    with pytest.raises(FaultInjected):
+        m._poll()
+    _fired("master.step")
+
+
+@_fast("bench.runner.phase")
+def _drive_bench_phase(tmp_path, monkeypatch):
+    """A bench phase subprocess crashes: the parent banks an honest
+    failure record (never clobbers the bank, never wedges the round)
+    and a clean re-run banks ok — a flap costs one phase, not the
+    bank."""
+    from areal_tpu.bench import bank, runner
+
+    scratch = tmp_path / "scratch"
+    scratch.mkdir()
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    monkeypatch.setenv("AREAL_BENCH_TEST_SCRATCH", str(scratch))
+    monkeypatch.setenv(
+        "AREAL_BENCH_PHASE_MODULES", "tests.system.bench_phases"
+    )
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "")
+    monkeypatch.setenv(
+        "AREAL_FAULTS", "bench.runner.phase@bench/t_alpha=raise"
+    )
+    rec = runner.run_phase("t_alpha", "measure", b,
+                           deadline_s=fixtures.scale_timeout(120))
+    assert rec["status"] == "failed"
+    bank.validate_record(bank.load_record(b, "t_alpha", "measure"))
+    monkeypatch.delenv("AREAL_FAULTS")
+    rec2 = runner.run_phase("t_alpha", "measure", b,
+                            deadline_s=fixtures.scale_timeout(120))
+    assert rec2["status"] == "ok"
+    # The injected fault fired in the CHILD (hit counters are per
+    # process), proven by the failed-then-ok record pair above.
+
+
+@_fast("engine.kv_spill")
+def _drive_engine_kv_spill(tmp_path, monkeypatch):
+    """A spill write fails: the eviction falls back to a clean free,
+    counted HONESTLY as kv_prefix_lost (the one point whose documented
+    contract is count-the-loss, not zero-loss), the engine never
+    wedges, and later spills succeed."""
+    import jax
+
+    from areal_tpu.engine.serving import GenRequest
+    from areal_tpu.models.transformer import init_params
+    from tests.engine.serving_utils import TINY_SERVING_CFG, run_requests
+    from tests.engine.test_kv_tier import PROMPT, _mk_engine, _wait_spill
+
+    params = init_params(TINY_SERVING_CFG, jax.random.PRNGKey(4))
+    eng = _mk_engine(
+        params, prefix_cache_tokens=16, kv_tier_bytes=1 << 20, seed=3
+    )
+    try:
+        faults.arm("engine.kv_spill", action="raise", at_hit=1, times=1)
+        outs = {}
+        for i in range(3):
+            outs[i] = run_requests(eng, [GenRequest(
+                qid=f"s{i}", input_ids=list(PROMPT), max_new_tokens=4,
+                greedy=True,
+            )])[f"s{i}"]
+            assert len(outs[i].output_ids) == 4
+        # Parking s1 evicted s0 -> spill 1 injected to fail (lost, not
+        # wedged); parking s2 evicted s1 -> spill succeeds.
+        _wait_spill(eng, n=1)
+        _fired("engine.kv_spill")
+        deadline = time.monotonic() + fixtures.scale_timeout(30)
+        while eng._kv_lost_spill < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert eng._kv_lost_spill == 1
+        m = eng.metrics()
+        assert m["kv_prefix_lost_total"] == 1.0
+        assert eng.kv_spills >= 1  # the tier still works after
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("point", sorted(FAST))
+def test_campaign_fast(point, tmp_path, monkeypatch):
+    FAST[point](tmp_path, monkeypatch)
+
+
+# ======================================================================
+# Fleet half — one shared 2-server CPU-jax subprocess fleet, armed at
+# runtime through the AREAL_CHAOS_HTTP /configure surface. slow-marked
+# (subprocess jax boots); run with ``-m slow`` or the full campaign:
+#   JAX_PLATFORMS=cpu pytest tests/system/test_chaos_campaign.py -m ''
+# ======================================================================
+
+MODEL_CFG = dict(
+    n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=2, head_dim=16,
+    intermediate_dim=64, vocab_size=64, compute_dtype="float32",
+    param_dtype="float32",
+)
+
+CHILD = '''
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax; jax.config.update("jax_platforms", "cpu")
+from areal_tpu.base import name_resolve
+name_resolve.reconfigure("nfs", record_root=%(nr)r)
+from areal_tpu.api.system_api import GenerationServerConfig
+from areal_tpu.api.config import ModelAbstraction
+from areal_tpu.system.generation_server import GenerationServer
+import areal_tpu.engine.factories  # registry
+cfg = GenerationServerConfig(
+    experiment_name=%(exp)r, trial_name=%(trial)r, server_index=%(idx)d,
+    model=ModelAbstraction("tpu_transformer", args=dict(config=%(model_cfg)r)),
+    max_concurrent_requests=2, max_seq_len=256, kv_page_size=8,
+    decode_block_steps=4, prompt_bucket=16, prefill_chunk=16,
+    prefix_cache_tokens=64, kv_tier_bytes=1 << 20, seed=0,
+)
+w = GenerationServer()
+w.configure(cfg, experiment_name=cfg.experiment_name, trial_name=cfg.trial_name,
+            worker_name=cfg.worker_name)
+w.run()
+'''
+
+PROMPT = list(range(1, 33))  # 32 tokens: chunked-prefill path
+
+
+class _Fleet:
+    """2 real GenerationServer subprocesses + a real GserverManager in
+    a thread, with the /configure chaos surface armed
+    (AREAL_CHAOS_HTTP=1) so each campaign step arms its point at
+    runtime in the right child process."""
+
+    def __init__(self, tmp_path):
+        from areal_tpu.api.system_api import GserverManagerConfig
+        from areal_tpu.base import constants, name_resolve, names
+        from areal_tpu.system.gserver_manager import GserverManager
+
+        self._names = names
+        self._name_resolve = name_resolve
+        self.nr = str(tmp_path / "nr")
+        self.exp = f"campaign-{uuid.uuid4().hex[:6]}"
+        self.trial = "t0"
+        self.repo = name_resolve.reconfigure("nfs", record_root=self.nr)
+        self.role_dir = os.path.join(
+            constants.get_param_realloc_path(self.exp, self.trial),
+            "actor",
+        )
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ""
+        env["AREAL_HEALTH_TTL"] = "60"
+        env["AREAL_CHAOS_HTTP"] = "1"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        self.procs, self.logs, self.cleanup = [], [], []
+        for idx in range(2):
+            log_path = tmp_path / f"server{idx}.log"
+            log_f = open(log_path, "w")
+            self.logs.append(log_path)
+            self.cleanup.append(log_f.close)
+            self.procs.append(subprocess.Popen(
+                [sys.executable, "-c", CHILD % dict(
+                    repo=REPO, nr=self.nr, exp=self.exp,
+                    trial=self.trial, idx=idx, model_cfg=MODEL_CFG,
+                )],
+                env=env, cwd=REPO, stdout=log_f,
+                stderr=subprocess.STDOUT,
+            ))
+        self.urls = {}
+        self._wait(self._discovered, 240, "server discovery")
+        self.m = GserverManager()
+        self.m.configure(GserverManagerConfig(
+            experiment_name=self.exp, trial_name=self.trial,
+            model_name="actor", n_servers=2, train_batch_size=4,
+            max_head_offpolicyness=1000, health_check_interval=0.5,
+            session_affinity=False, schedule_policy="round_robin",
+        ))
+        mt = threading.Thread(target=self.m.run, daemon=True)
+        mt.start()
+        self.cleanup.append(lambda: mt.join(timeout=10))
+        self._wait(lambda: len(self.m._healthy_urls()) == 2, 120,
+                   "manager sees 2 healthy servers")
+
+    # -- plumbing -------------------------------------------------------
+
+    def alive(self):
+        for i, p in enumerate(self.procs):
+            assert p.poll() is None, (
+                f"server {i} died:\n" + self.logs[i].read_text()[-3000:]
+            )
+
+    def _discovered(self):
+        self.alive()
+        for i in range(2):
+            if i not in self.urls:
+                try:
+                    self.urls[i] = self._name_resolve.get(
+                        self._names.gen_server_url(
+                            self.exp, self.trial, str(i)
+                        )
+                    )
+                except self._name_resolve.NameEntryNotFoundError:
+                    return False
+        return True
+
+    def _wait(self, cond, timeout, msg):
+        deadline = time.monotonic() + fixtures.scale_timeout(timeout)
+        while time.monotonic() < deadline:
+            self.alive()
+            if cond():
+                return
+            time.sleep(0.1)
+        raise AssertionError(f"timed out waiting for {msg}")
+
+    def post(self, url, path, payload, timeout=120):
+        return _post_raw(url + path, payload,
+                         timeout=fixtures.scale_timeout(timeout))
+
+    def metrics(self, idx):
+        text = urllib.request.urlopen(
+            self.urls[idx] + "/metrics", timeout=30
+        ).read().decode()
+        out = {}
+        for line in text.splitlines():
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    out[parts[0]] = float(parts[1])
+                except ValueError:
+                    out[parts[0]] = parts[1]
+        return out
+
+    # -- chaos control (the AREAL_CHAOS_HTTP surface) -------------------
+
+    def arm(self, idx, spec):
+        status, body = self.post(
+            self.urls[idx], "/configure",
+            {"faults_reset": True, "faults": spec},
+        )
+        assert status == 200 and body["success"], (status, body)
+
+    def hits(self, idx, point):
+        status, body = self.post(
+            self.urls[idx], "/configure", {"faults_hits": [point]},
+        )
+        assert status == 200, (status, body)
+        return body["faults_hits"][point]
+
+    def disarm_all(self):
+        for idx in range(2):
+            self.post(self.urls[idx], "/configure",
+                      {"faults_reset": True})
+
+    # -- workload -------------------------------------------------------
+
+    def gen(self, idx, qid, input_ids, max_new, kv_source=None,
+            decode_url=None):
+        payload = {
+            "qid": qid, "input_ids": list(input_ids),
+            "gconfig": {"max_new_tokens": max_new, "greedy": True},
+        }
+        if kv_source:
+            payload["kv_source"] = kv_source
+        if decode_url:
+            payload["decode_url"] = decode_url
+        status, body = self.post(self.urls[idx], "/generate", payload,
+                                 timeout=300)
+        return status, body
+
+    def schedule(self, qid, prompt_len, failed=None):
+        meta = {"qid": qid, "prompt_len": prompt_len,
+                "new_token_budget": 6}
+        if failed:
+            meta["failed_server_url"] = failed
+        return self.post(self.m.address, "/schedule_request", meta,
+                         timeout=30)[1]
+
+    def idx_of(self, url):
+        return next(i for i, u in self.urls.items() if u == url)
+
+    def assert_zero_loss(self):
+        for i in range(2):
+            m = self.metrics(i)
+            assert m["areal:kv_prefix_lost_total"] == 0.0, (i, m)
+
+    def close(self):
+        try:
+            self._name_resolve.add(
+                self._names.experiment_status(self.exp, self.trial),
+                "COMPLETE", replace=True,
+            )
+        except Exception:
+            pass
+        try:
+            self.m.exit()
+        except Exception:
+            pass
+        for p in self.procs:
+            p.terminate()
+        for p in self.procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        for fn in reversed(self.cleanup):
+            try:
+                fn()
+            except Exception:
+                pass
+        self.repo.reset()
+
+
+def _tier_holds(fleet, idx, qid):
+    with urllib.request.urlopen(
+        fleet.urls[idx] + "/kv/index", timeout=30
+    ) as r:
+        held = json.loads(r.read()).get("held") or []
+    return any(e.get("qid") == qid for e in held)
+
+
+def _spill_session(fleet, idx, qid):
+    """Park ``qid`` on server ``idx``, then park filler sessions until
+    the 64-token prefix budget has evicted qid's park into the tier
+    (the server's /kv/index advertises it — older sessions' parks may
+    absorb the first evictions)."""
+    status, out = fleet.gen(idx, qid, PROMPT, 8)
+    assert status == 200 and len(out["output_ids"]) == 8, (status, out)
+    for f in range(4):
+        if _tier_holds(fleet, idx, qid):
+            break
+        status, _ = fleet.gen(
+            idx, f"filler{f}-{qid}",
+            [(i + 2 * f) % 60 + 1 for i in range(2, 34)], 8,
+        )
+        assert status == 200
+        time.sleep(0.3)
+    fleet._wait(lambda: _tier_holds(fleet, idx, qid), 30,
+                f"{qid} spilled into server {idx}'s tier")
+    return out
+
+
+# -- per-point fleet drivers (run in _FLEET_ORDER, one shared fleet) ---
+
+
+@_fleet("gserver.generate")
+def _fleet_generate(fleet):
+    """The flaky action end to end: server A's engine path fails twice
+    then heals. The failover client path (what partial_rollout does on
+    a 5xx) reroutes via the manager, the request completes, A is
+    evicted on the client report — feeding its breaker — and
+    readmitted once its heartbeat proves it alive."""
+    a = fleet.urls[0]
+    fleet.arm(0, "gserver.generate=flaky")
+    failed = None
+    saw_failure = completed = 0
+    for k in range(10):
+        sched = fleet.schedule(f"camp-gen{k}", len(PROMPT),
+                               failed=failed)
+        url = sched.get("url")
+        if not url:  # whole fleet momentarily unroutable: back off
+            time.sleep(0.5)
+            continue
+        status, body = fleet.gen(
+            fleet.idx_of(url), f"camp-gen{k}", PROMPT, 6
+        )
+        if status == 200:
+            assert len(body["output_ids"]) == 6, body
+            completed += 1
+            failed = None
+            if saw_failure and completed >= 2:
+                break
+        else:
+            saw_failure += 1
+            failed = url  # what partial_rollout reports on a 5xx
+    # The injected flaky failure was observed AND absorbed: requests
+    # kept completing via failover (zero failed rollouts).
+    assert saw_failure >= 1, "flaky arm never fired"
+    assert completed >= 2
+    assert fleet.hits(0, "gserver.generate") >= 1
+    # Eviction happened on the client report; the breaker remembers it
+    # on the manager's board; the heartbeat readmits.
+    st = fleet.post(fleet.m.address, "/schedule_request",
+                    {"qid": "probe", "prompt_len": 3,
+                     "new_token_budget": 1})[1]
+    assert st.get("url")
+    # The client report fed A's per-peer breaker on the manager's
+    # board, surfaced in /status (PR 14: flapping is remembered across
+    # evict/readmit cycles, not reset by them).
+    assert a in _status(fleet)["rpc"]["breakers"]
+    fleet._wait(lambda: len(fleet.m._healthy_urls()) == 2, 60,
+                "server A readmitted")
+    fleet.assert_zero_loss()
+
+
+def _status(fleet):
+    with urllib.request.urlopen(
+        fleet.m.address + "/status", timeout=30
+    ) as r:
+        return json.loads(r.read())
+
+
+@_fleet("gserver.kv_restore")
+def _fleet_kv_restore(fleet):
+    """A tier restore fails mid delta-prefill: the session silently
+    degrades to a full re-prefill and still completes — restore is an
+    optimization, never a correctness dependency."""
+    out1 = _spill_session(fleet, 0, "camp-restore")
+    fleet.arm(0, "gserver.kv_restore=raise")
+    turn2 = PROMPT + [int(t) for t in out1["output_ids"]] + [50, 51]
+    status, out2 = fleet.gen(0, "camp-restore", turn2, 6)
+    assert status == 200 and len(out2["output_ids"]) == 6, (status, out2)
+    assert fleet.hits(0, "gserver.kv_restore") >= 1
+    fleet.assert_zero_loss()
+
+
+@_fleet("gserver.kv_chunk_bytes")
+def _fleet_kv_corrupt(fleet):
+    """corrupt action on the KV wire: server A serves a /kv/chunk with
+    bytes flipped AFTER the chunk index was minted. The puller's
+    per-chunk sha256 verify must reject it and the unified retry must
+    re-fetch clean bytes — corrupt KV never scatters into B's pool,
+    the continuation still completes."""
+    out1 = _spill_session(fleet, 0, "camp-corrupt")
+    turn2 = PROMPT + [int(t) for t in out1["output_ids"]] + [52, 53]
+    # Wait until the manager's /kv/index poll learned THIS qid (the
+    # schedule then carries kv_source=A for a request routed to B).
+    sched = {}
+
+    def routed_with_hint():
+        s = fleet.schedule("camp-corrupt", len(turn2))
+        if s.get("url") == fleet.urls[1] and (
+            s.get("kv_source") == fleet.urls[0]
+        ):
+            sched.update(s)
+            return True
+        return False
+
+    fleet._wait(routed_with_hint, 60,
+                "B offered with kv_source=A for camp-corrupt")
+    fleet.arm(0, "gserver.kv_chunk_bytes=corrupt")
+    status, out2 = fleet.gen(
+        1, "camp-corrupt", turn2, 6, kv_source=sched.get("kv_source")
+    )
+    assert status == 200 and len(out2["output_ids"]) == 6, (status, out2)
+    assert fleet.hits(0, "gserver.kv_chunk_bytes") >= 1
+    fleet.assert_zero_loss()
+
+
+@_fleet("gserver.kv_export")
+def _fleet_kv_export(fleet):
+    """Prefill side dies MID-handoff (after the export, before the
+    decode hop): the point is deliberately outside the server's
+    fallback path — it models process death, and the CLIENT failover
+    (failed_server_url -> eviction -> reroute) is what absorbs it.
+    The rollout completes on the other server; A is readmitted."""
+    fleet.arm(0, "gserver.kv_export=raise")
+    status, out = fleet.gen(
+        0, "camp-export", PROMPT, 6, decode_url=fleet.urls[1]
+    )
+    assert status == 500, (status, out)  # the mid-handoff death
+    assert fleet.hits(0, "gserver.kv_export") >= 1
+    # The failover hop partial_rollout makes on a 5xx:
+    sched = fleet.schedule("camp-export", len(PROMPT),
+                           failed=fleet.urls[0])
+    url = sched.get("url")
+    assert url == fleet.urls[1], sched  # A just got evicted
+    status, out = fleet.gen(1, "camp-export", PROMPT, 6)
+    assert status == 200 and len(out["output_ids"]) == 6, (status, out)
+    fleet._wait(lambda: len(fleet.m._healthy_urls()) == 2, 60,
+                "server A readmitted after mid-handoff death")
+    fleet.assert_zero_loss()
+
+
+@_fleet("gserver.kv_import")
+def _fleet_kv_import(fleet):
+    """Decode side dies mid KV handoff import: same fallback contract
+    from the other end of the wire."""
+    fleet.arm(1, "gserver.kv_import=raise")
+    before = fleet.metrics(0)["areal:kv_handoff_fallback"]
+    status, out = fleet.gen(
+        0, "camp-import", PROMPT, 6, decode_url=fleet.urls[1]
+    )
+    assert status == 200 and len(out["output_ids"]) == 6, (status, out)
+    assert fleet.hits(1, "gserver.kv_import") >= 1
+    assert fleet.metrics(0)["areal:kv_handoff_fallback"] > before
+    fleet.assert_zero_loss()
+
+
+@_fleet("gserver.update_weights")
+def _fleet_update_weights(fleet):
+    """Weight load from the shared dump dies mid-update: the injected
+    failure costs one 500 the (idempotent, version-pinned) fanout
+    retry absorbs; both servers land on v1."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    os.makedirs(fleet.role_dir, exist_ok=True)
+    cfg = TransformerConfig(**MODEL_CFG)
+    p1 = jax.tree_util.tree_map(
+        lambda x: np.asarray(x), init_params(cfg, jax.random.PRNGKey(7))
+    )
+    dump_raw_params(p1, fleet.role_dir, version=1)
+    fleet.arm(0, "gserver.update_weights=raise")
+    body = {"model_path": fleet.role_dir, "version": 1,
+            "allow_interrupt": True}
+    status, resp = fleet.post(
+        fleet.urls[0], "/update_weights_from_disk", body, timeout=300
+    )
+    assert status == 500, (status, resp)
+    assert fleet.hits(0, "gserver.update_weights") >= 1
+    # The fanout retry (idempotent, version-pinned):
+    status, resp = fleet.post(
+        fleet.urls[0], "/update_weights_from_disk", body, timeout=300
+    )
+    assert status == 200 and resp["success"], (status, resp)
+    status, resp = fleet.post(
+        fleet.urls[1], "/update_weights_from_disk", body, timeout=300
+    )
+    assert status == 200 and resp["success"], (status, resp)
+    for i in range(2):
+        fleet._wait(
+            lambda i=i: fleet.metrics(i)["areal:weight_version"] == 1.0,
+            60, f"server {i} at v1",
+        )
+
+
+@_fleet("gserver.cutover_weights")
+def _fleet_cutover(fleet):
+    """The cutover window dies between interrupt and swap: one 500,
+    the retry completes the (already staged, version-pinned) swap —
+    both servers serve v2."""
+    import jax
+    import numpy as np
+
+    from areal_tpu.engine.weight_client import fetch_manifest
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    cfg = TransformerConfig(**MODEL_CFG)
+    p2 = jax.tree_util.tree_map(
+        lambda x: np.asarray(x), init_params(cfg, jax.random.PRNGKey(8))
+    )
+    dump_raw_params(p2, fleet.role_dir, version=2)
+    src = WeightPlaneSource(fleet.role_dir, chunk_bytes=1 << 15).start()
+    try:
+        man = fetch_manifest(src.address, version=2)
+        for i in range(2):
+            status, resp = fleet.post(
+                fleet.urls[i], "/distribute_weights",
+                {"version": 2, "manifest": man,
+                 "upstreams": [src.address], "origin": src.address},
+                timeout=300,
+            )
+            assert status == 200 and resp["success"], (i, status, resp)
+        fleet.arm(0, "gserver.cutover_weights=raise")
+        cut = {"version": 2, "allow_interrupt": True, "budget_s": 10.0}
+        status, resp = fleet.post(
+            fleet.urls[0], "/cutover_weights", cut, timeout=300
+        )
+        assert status == 500, (status, resp)
+        assert fleet.hits(0, "gserver.cutover_weights") >= 1
+        status, resp = fleet.post(
+            fleet.urls[0], "/cutover_weights", cut, timeout=300
+        )
+        assert status == 200 and resp["success"], (status, resp)
+        status, resp = fleet.post(
+            fleet.urls[1], "/cutover_weights", cut, timeout=300
+        )
+        assert status == 200 and resp["success"], (status, resp)
+        for i in range(2):
+            fleet._wait(
+                lambda i=i: fleet.metrics(i)[
+                    "areal:weight_version"] == 2.0,
+                60, f"server {i} at v2",
+            )
+    finally:
+        src.close()
+
+
+@_fleet("gserver.drain")
+def _fleet_drain_abort(fleet):
+    """Drain-then-leave dies at the very start of the drain: the
+    request fails loudly, the server never enters the shedding state,
+    and keeps serving — an aborted drain is a no-op, not a limbo.
+    (The full drain-depart path is pinned by the elastic e2e.)"""
+    fleet.arm(0, "gserver.drain=raise")
+    status, resp = fleet.post(
+        fleet.urls[0], "/drain",
+        {"migrate_to": [fleet.urls[1]], "exit": False,
+         "reason": "campaign"},
+    )
+    assert status == 500, (status, resp)
+    assert fleet.hits(0, "gserver.drain") >= 1
+    with urllib.request.urlopen(
+        fleet.urls[0] + "/drain", timeout=30
+    ) as r:
+        st = json.loads(r.read())
+    assert not st.get("draining"), st
+    status, out = fleet.gen(0, "camp-drain-probe", PROMPT, 4)
+    assert status == 200 and len(out["output_ids"]) == 4
+    fleet.assert_zero_loss()
+
+
+@_fleet("gserver.kv_accept")
+def _fleet_kv_accept(fleet):
+    """A migration target blips while accepting a parked prefix from a
+    draining peer: the drain's peer rotation retries the accept, so a
+    transient target failure never turns a prefix into a loss. Runs
+    LAST: the drained server stays quiesced (exit=False) afterwards."""
+    _spill_session(fleet, 0, "camp-accept")
+    # Two rotation slots (the two-survivor shape in a 2-server fleet):
+    # the first accept is injected to fail, the rotation's second
+    # attempt lands it.
+    fleet.arm(1, "gserver.kv_accept=raise")
+    status, resp = fleet.post(
+        fleet.urls[0], "/drain",
+        {"migrate_to": [fleet.urls[1], fleet.urls[1]], "exit": False,
+         "reason": "campaign-accept"},
+    )
+    assert status == 200 and resp["success"], (status, resp)
+
+    def drained():
+        with urllib.request.urlopen(
+            fleet.urls[0] + "/drain", timeout=30
+        ) as r:
+            st = json.loads(r.read())
+        return st.get("migrated") is not None and (
+            st.get("migrated", 0) + st.get("lost", 0)
+            + st.get("stale", 0) > 0
+            or st.get("held") == 0
+        )
+
+    fleet._wait(drained, 120, "drain migration completed")
+    assert fleet.hits(1, "gserver.kv_accept") >= 2  # fail + retry
+    with urllib.request.urlopen(
+        fleet.urls[0] + "/drain", timeout=30
+    ) as r:
+        st = json.loads(r.read())
+    assert st.get("lost", 0) == 0, st  # rotation absorbed the blip
+    assert st.get("migrated", 0) >= 1, st
+    fleet._wait(
+        lambda: fleet.metrics(1)["areal:kv_accepted"] >= 1.0,
+        30, "B accepted the migrated prefix",
+    )
+    fleet.assert_zero_loss()
+
+
+_FLEET_ORDER = [
+    "gserver.generate",
+    "gserver.kv_restore",
+    "gserver.kv_chunk_bytes",
+    "gserver.kv_export",
+    "gserver.kv_import",
+    "gserver.update_weights",
+    "gserver.cutover_weights",
+    "gserver.drain",
+    "gserver.kv_accept",  # leaves server 0 quiesced: must run last
+]
+
+
+@pytest.mark.slow
+@pytest.mark.serial
+@pytest.mark.timeout(900)
+def test_campaign_fleet(tmp_path):
+    """The serving-plane sweep: every gserver.* point fired against ONE
+    long-lived real fleet, in an order that keeps the fleet healthy
+    until the final (quiescing) drain-migration point."""
+    assert set(_FLEET_ORDER) == set(FLEET)
+    fleet = _Fleet(tmp_path)
+    try:
+        # Warm both servers' serving programs first so per-point drives
+        # measure behavior, not first-request XLA compiles.
+        for i in range(2):
+            status, out = fleet.gen(i, f"warm{i}", PROMPT, 4)
+            assert status == 200 and len(out["output_ids"]) == 4
+        for point in _FLEET_ORDER:
+            fleet.disarm_all()
+            faults.reset()
+            FLEET[point](fleet)
+            fleet.alive()
+    finally:
+        fleet.close()

@@ -1,0 +1,96 @@
+"""ISSUE 14 acceptance (bench leg): the `rpc_resilience` phase banks
+an attested CPU-proxy record for the substrate's hedged-read A/B —
+hash-verified chunk pulls from two loopback holders under the injected
+`delay` chaos action — and `validate_bench.py` refuses records whose
+hedged p99 isn't measurably below the unhedged one, whose unhedged arm
+never ate the injected tail (an A/B that measured nothing), or whose
+win/cancel accounting shows the hedges never ran or leaked losers.
+
+Time budget: the phase itself is ~10 s of loopback HTTP (tier-1); the
+validator-teeth test is milliseconds.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_record():
+    """A well-formed rpc_resilience value (what a healthy run banks)."""
+    return {
+        "n_chunks": 32.0,
+        "injected_delay_ms": 350.0,
+        "hedge_delay_ms": 50.0,
+        "unhedged_p50_ms": 60.0,
+        "unhedged_p99_ms": 420.0,
+        "hedged_p50_ms": 70.0,
+        "hedged_p99_ms": 105.0,
+        "hedge_wins": 32.0,
+        "hedge_cancelled": 32.0,
+        "hedge_failures": 0.0,
+    }
+
+
+def test_validator_teeth_for_rpc_resilience():
+    validator = _load_validator()
+
+    def problems(**mut):
+        val = {**_fake_record(), **mut}
+        rec = {"status": "ok", "pass": "measure", "value": val}
+        return validator.validate_phase_value("rpc_resilience", rec)
+
+    assert problems() == []
+    # Hedging bought nothing: hedged p99 at/above unhedged.
+    assert problems(hedged_p99_ms=500.0)
+    # The slow peer never landed: the hedged number proves nothing.
+    assert problems(unhedged_p99_ms=100.0)
+    # Hedged arm still stuck at the injected tail.
+    assert problems(hedged_p99_ms=360.0, unhedged_p99_ms=420.0)
+    # Accounting: a low p99 without wins/cancels isn't hedging evidence.
+    assert problems(hedge_wins=0.0)
+    assert problems(hedge_cancelled=0.0)
+    assert problems(hedge_failures=1.0)
+    # Schema: every declared key must be present and numeric.
+    incomplete = _fake_record()
+    del incomplete["hedge_wins"]
+    rec = {"status": "ok", "pass": "measure", "value": incomplete}
+    assert validator.validate_phase_value("rpc_resilience", rec)
+
+
+def test_rpc_resilience_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import rpc_resilience_phase
+
+    val = rpc_resilience_phase("measure")
+    path = bank.write_record(
+        bank.make_record("rpc_resilience", "measure", "ok", value=val), b
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("rpc_resilience", rec) == []
+    assert validator.validate_bank_dir(b) == []
