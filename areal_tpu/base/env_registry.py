@@ -110,6 +110,14 @@ _KNOBS: List[Knob] = [
        "Host-prefetcher queue depth override for the train engine "
        "(engine/jax_engine.py); unset = config/ctor default.",
        snapshot=True),
+    _k("AREAL_DECODE_RESIDENT", "bool", True,
+       "Device-resident decode dispatch (engine/serving.py): page-table "
+       "edits land as donated per-slot row scatters and chunk-prefill "
+       "control crosses as ONE fused array, so only admission/eviction "
+       "DELTAS pay H2D between decode blocks. False restores the "
+       "legacy full-table restage + per-scalar staging (the "
+       "kernel_micro_decode_state A/B arm; greedy-token parity between "
+       "the modes is pinned in tests).", snapshot=True),
     # -- base ------------------------------------------------------------
     _k("AREAL_FILEROOT", "str", None,
        "Filesystem root for logs/checkpoints/realloc params; unset = "
@@ -170,6 +178,13 @@ _KNOBS: List[Knob] = [
        "Splash-attention KV block target.", snapshot=True),
     _k("AREAL_SPLASH_BKVC", "int", 512,
        "Splash-attention KV-compute block target.", snapshot=True),
+    _k("AREAL_GAE_IMPL", "str", "auto",
+       "Trainer GAE implementation (ops/gae.packed_gae): 'auto' "
+       "(associative scan), 'scan' (the serial lax.scan oracle), "
+       "'assoc', or 'pallas' (blocked Pallas scan kernel, shape-gated; "
+       "opt-in until kernel_micro_gae banks device crossover "
+       "evidence). Pinned when the PPO prep program is first traced.",
+       snapshot=True),
     # -- functioncall ----------------------------------------------------
     _k("AREAL_SYMPY_TIMEOUT_S", "float", 3.0,
        "Per-expression sympy equivalence-check timeout "

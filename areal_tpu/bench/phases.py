@@ -259,6 +259,64 @@ register(PhaseSpec(
                 "(CPU-proxy)",
 ))
 
+# kernel_micro family (ROADMAP item 3): per-kernel parity + timing
+# evidence for the hot-path kernels, DEFAULT phases so the daemon
+# spends the next unattended TPU window banking all of it. Off-TPU the
+# records self-label cpu_proxy (validate_bench refuses unlabeled ones);
+# they are NOT proxy=True phases — that would pin the subprocess to
+# JAX_PLATFORMS=cpu and the device window would never measure them.
+
+register(PhaseSpec(
+    name="kernel_micro_gae",
+    entrypoint="areal_tpu.bench.workloads:kernel_micro_gae_phase",
+    priority=8,
+    est_compile_s=30.0,
+    est_measure_s=40.0,
+    min_window_s=10.0,
+    description="Trainer GAE kernels: serial lax.scan baseline vs the "
+                "associative scan 'auto' dispatches vs the blocked "
+                "Pallas scan + host loop, parity per case "
+                "(packed multi-segment rows, misaligned starts)",
+))
+
+register(PhaseSpec(
+    name="kernel_micro_paged_decode",
+    entrypoint="areal_tpu.bench.workloads:kernel_micro_paged_decode_phase",
+    priority=8,
+    est_compile_s=60.0,
+    est_measure_s=60.0,
+    min_window_s=15.0,
+    description="Paged decode attention across the scheduler's pow2 "
+                "admit batches: XLA gather baseline vs the 'auto'-"
+                "resolved kernel for float AND int8 pools, parity + "
+                "quant error per case",
+))
+
+register(PhaseSpec(
+    name="kernel_micro_splash",
+    entrypoint="areal_tpu.bench.workloads:kernel_micro_splash_phase",
+    priority=9,
+    est_compile_s=60.0,
+    est_measure_s=40.0,
+    min_window_s=10.0,
+    description="Splash prefill attention vs the reference einsum "
+                "oracle on a packed multi-segment stream (parity-only "
+                "interpret case off-TPU)",
+))
+
+register(PhaseSpec(
+    name="kernel_micro_decode_state",
+    entrypoint="areal_tpu.bench.workloads:kernel_micro_decode_state_phase",
+    priority=9,
+    est_compile_s=90.0,
+    est_measure_s=90.0,
+    min_window_s=20.0,
+    description="Device-resident decode-state A/B "
+                "(AREAL_DECODE_RESIDENT on vs off): per-decode-block "
+                "H2D transfers/bytes + throughput for both arms with "
+                "greedy token parity asserted in-phase",
+))
+
 register(PhaseSpec(
     name="pack_density",
     entrypoint="areal_tpu.bench.workloads:pack_density_phase",

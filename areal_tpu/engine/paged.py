@@ -70,12 +70,12 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 # has no KV quantization (realhf/impl/model/backend/sglang.py). Pools
 # stay plain arrays when not quantized; every helper accepts both.
 
-# Dequant convention: x ~= int8 * scale / 127.5. Duplicated from
-# ops/pallas/paged_decode_int8.KV_INT8_MAX (equality pinned in
-# tests/engine/test_kv_int8.py) so importing this module doesn't pull
-# the Pallas stack — all kernel imports here are lazy, at the branches
-# that dispatch to them.
-KV_INT8_MAX = 127.5
+# Dequant convention: x ~= int8 * scale / 127.5. ONE source of truth
+# (ops/quant_const — dependency-free, so importing this module still
+# doesn't pull the Pallas stack; all kernel imports here stay lazy, at
+# the branches that dispatch to them). The structural identity of this
+# re-export with the kernel's is pinned in tests/engine/test_kv_int8.py.
+from areal_tpu.ops.quant_const import KV_INT8_MAX  # noqa: F401  (re-export)
 
 
 def kv_pool_data(pool) -> jnp.ndarray:
@@ -207,6 +207,49 @@ def _paged_attention_xla(q, k_pages, v_pages, lengths, page_indices, scale):
     return out.reshape(B, Hq, hd).astype(q.dtype)
 
 
+def resolve_paged_decode_impl(
+    impl: str,
+    quantized: bool,
+    page_size: int,
+    head_dim: int,
+    pages_per_seq: int,
+    tp_ok: bool = True,
+) -> str:
+    """Resolve 'auto' to a concrete paged-decode impl (trace-time static
+    decision, mirroring ops/attention.resolve_attn_impl — and the
+    dispatch table kernel_micro_paged_decode measures case by case).
+    Explicit impls pass through untouched.
+
+    int8 pools use OUR kernel (ops/pallas/paged_decode_int8) on TPU:
+    the stock kernel broadcasts the scales to full head_dim in f32
+    before pallas_call (jax .../paged_attention_kernel.py:421-431),
+    materializing 2x the bf16 pool per call. impl='kernel' stays
+    available for an explicit A/B. Off-TPU (and whenever shapes or the
+    TP head split disqualify a kernel) everything resolves to the XLA
+    gather path."""
+    if impl != "auto":
+        return impl
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if quantized:
+        if on_tpu and tp_ok:
+            # Import inside the on_tpu arm: keeps the Pallas stack off
+            # CPU-only import paths.
+            from areal_tpu.ops.pallas.paged_decode_int8 import (
+                int8_paged_kernel_ok,
+            )
+
+            if int8_paged_kernel_ok(page_size, head_dim):
+                return "int8_kernel"
+        return "xla"
+    return (
+        "kernel"
+        if on_tpu
+        and paged_attention_kernel_ok(page_size, head_dim, pages_per_seq)
+        and tp_ok
+        else "xla"
+    )
+
+
 def paged_decode_attention(
     q,  # [B, Hq, hd]
     k_pages,  # [Hkv, N, pg, hd]
@@ -238,29 +281,7 @@ def paged_decode_attention(
     # partitionable einsum path handles that layout instead.
     tp_ok = Hkv % tensor_size == 0 and Hq % tensor_size == 0
     if impl == "auto":
-        on_tpu = jax.default_backend() in ("tpu", "axon")
-        if quantized:
-            # int8 pools use OUR kernel (ops/pallas/paged_decode_int8):
-            # the stock kernel broadcasts the scales to full head_dim in
-            # f32 before pallas_call (jax .../paged_attention_kernel.py:
-            # 421-431), materializing 2x the bf16 pool per call.
-            # impl='kernel' stays available for an explicit A/B.
-            # (Import inside the on_tpu arm: keeps the Pallas stack off
-            # CPU-only import paths.)
-            impl = "xla"
-            if on_tpu and tp_ok:
-                from areal_tpu.ops.pallas.paged_decode_int8 import (
-                    int8_paged_kernel_ok,
-                )
-
-                if int8_paged_kernel_ok(pg, hd):
-                    impl = "int8_kernel"
-        else:
-            impl = (
-                "kernel"
-                if on_tpu and paged_attention_kernel_ok(pg, hd, P) and tp_ok
-                else "xla"
-            )
+        impl = resolve_paged_decode_impl(impl, quantized, pg, hd, P, tp_ok)
     elif impl in ("kernel", "int8_kernel") and not tp_ok:
         raise ValueError(
             f"paged-attention kernel under tensor={tensor_size} needs head "
@@ -471,12 +492,7 @@ def paged_decode_step(
 # ----------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("cfg", "attn_impl", "mesh"),
-    donate_argnames=("k_pages", "v_pages"),
-)
-def paged_chunk_prefill(
+def _chunk_prefill_body(
     params,
     cfg: TransformerConfig,
     tokens,  # [C] chunk token ids, right-padded to the chunk size
@@ -553,6 +569,62 @@ def paged_chunk_prefill(
         body, (k_pages, v_pages, acc0), (tokens.reshape(n_sub, sub), bases)
     )
     return last, k_pages, v_pages
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "mesh"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def paged_chunk_prefill(
+    params,
+    cfg: TransformerConfig,
+    tokens,
+    k_pages,
+    v_pages,
+    page_row,
+    start,
+    valid_len,
+    attn_impl: str = "auto",
+    mesh=None,
+):
+    """Legacy 3-transfer entry point (tokens + start + valid_len staged
+    separately): see ``_chunk_prefill_body`` for the semantics. Kept as
+    the AREAL_DECODE_RESIDENT=0 arm of the decode-state A/B."""
+    return _chunk_prefill_body(
+        params, cfg, tokens, k_pages, v_pages, page_row, start, valid_len,
+        attn_impl=attn_impl, mesh=mesh,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "attn_impl", "mesh"),
+    donate_argnames=("k_pages", "v_pages"),
+)
+def paged_chunk_prefill_packed(
+    params,
+    cfg: TransformerConfig,
+    ctl,  # [C + 2] int32: tokens[0:C] | start | valid_len
+    k_pages,
+    v_pages,
+    page_row,
+    attn_impl: str = "auto",
+    mesh=None,
+):
+    """``_chunk_prefill_body`` with the per-chunk control — token ids,
+    absolute start position, valid length — packed into ONE staged int32
+    array. The legacy entry point pays three H2D transfers per chunk
+    (tokens + two scalars); each transfer is a separate dispatch (and on
+    remote-tunneled devices a separate round trip), so a 16k prompt at
+    C=512 paid ~96 stagings where this pays ~32. Scalars are sliced out
+    on device — trace-identical math, pinned by the decode-state parity
+    tests."""
+    C = ctl.shape[0] - 2
+    return _chunk_prefill_body(
+        params, cfg, ctl[:C], k_pages, v_pages, page_row, ctl[C],
+        ctl[C + 1], attn_impl=attn_impl, mesh=mesh,
+    )
 
 
 # ----------------------------------------------------------------------
@@ -773,6 +845,37 @@ def apply_deactivations(active, deact_mask):
     land on the device active mask BEFORE the next block, or the dead
     slot would keep writing KV into pages the allocator already freed."""
     return active & ~deact_mask
+
+
+@functools.partial(
+    jax.jit, donate_argnames=("pt_dev",), static_argnames=("n_slots",)
+)
+def update_page_rows(
+    pt_dev,  # [B, P] int32 device page table (donated)
+    packed_rows,  # [m, P + 1] int32: col 0 = slot index (< 0 padding),
+    #               cols 1: = that slot's replacement page row
+    n_slots: int,
+):
+    """Scatter only the CHANGED page-table rows into the device table.
+
+    The device-resident half of the decode-state contract
+    (AREAL_DECODE_RESIDENT): the legacy path re-staged the whole
+    [B, max_pages] host mirror every time any slot's row changed — at
+    B=64 slots x a 16k-context table that is ~35 KB of H2D per admit/
+    finish/page-growth lap for a one-row edit. Here only the dirty rows
+    cross the host boundary, fused with their slot indices into ONE
+    staged array (each transfer is its own dispatch — and on
+    remote-tunneled devices its own round trip — so splitting control
+    into slots/valid/rows arrays would triple the count the A/B
+    measures); the table itself stays device-resident (donated, like
+    apply_admits). Padding rows (slot < 0) route to the scratch row
+    past the real slots — same clip-semantics guard as apply_admits."""
+    slots = packed_rows[:, 0]
+    rows = packed_rows[:, 1:]
+    idx = jnp.where(slots >= 0, slots, n_slots).astype(jnp.int32)
+    ext = jnp.concatenate([pt_dev, pt_dev[:1]], axis=0)
+    ext = ext.at[idx].set(rows.astype(pt_dev.dtype))
+    return ext[:n_slots]
 
 
 @functools.partial(

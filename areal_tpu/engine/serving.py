@@ -58,10 +58,12 @@ from areal_tpu.engine.paged import (
     apply_admits,
     apply_deactivations,
     paged_chunk_prefill,
+    paged_chunk_prefill_packed,
     paged_decode_block,
     pages_needed,
     quantize_kv,
     scatter_prefill,
+    update_page_rows,
     warp_sample,
 )
 from areal_tpu.models.config import TransformerConfig
@@ -170,7 +172,8 @@ AREAL_LINT_LOOP_ONLY = {
         "attrs": [
             "_backlog", "_prefix_cache", "_allocator",
             "_k_pages", "_v_pages", "_dstate", "_page_table",
-            "_pt_dirty", "_pt_dev", "_len", "_pending_deact",
+            "_pt_dirty", "_pt_dirty_slots", "_pt_dev", "_len",
+            "_pending_deact",
             "_slot_req", "_slot_out", "_slot_lp", "_slot_vstart",
             "_slot_pages", "_slot_emit_t", "_rng", "_history",
             "_admit_inflight", "_blocks_since_admit",
@@ -228,6 +231,7 @@ class ServingEngine:
         kv_tier_disk_dir: Optional[str] = None,
         kv_tier_disk_bytes: Optional[int] = None,
         kv_spill_dtype: Optional[str] = None,
+        decode_resident: Optional[bool] = None,
     ):
         self.cfg = cfg
         # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
@@ -407,12 +411,37 @@ class ServingEngine:
         )
         self._rng = jax.random.PRNGKey(seed)
 
+        # Device-resident decode dispatch (snapshot knob, A/B-able per
+        # engine): page-table edits land as donated per-slot row
+        # scatters (paged.update_page_rows) and chunked-prefill control
+        # crosses as ONE fused array (paged_chunk_prefill_packed), so
+        # between decode blocks only admission/eviction DELTAS pay H2D.
+        # False restores the legacy full-table restage + per-scalar
+        # staging; greedy-token parity between the modes is pinned in
+        # tests/engine/test_decode_resident.py.
+        if decode_resident is None:
+            decode_resident = env_registry.get_bool("AREAL_DECODE_RESIDENT")
+        self.decode_resident = bool(decode_resident)
+
         # Host mirrors + page bookkeeping.
         self._page_table = np.full((B, self.max_pages), TRASH_PAGE, np.int32)
         self._pt_dirty = True
+        # Slots whose page-table row changed since the last device flush
+        # (engine-thread only): the resident path stages exactly these
+        # rows; _pt_dirty stays the "full restage" flag (init, legacy
+        # mode, too-many-dirty fallback).
+        self._pt_dirty_slots: set = set()
         self._pt_dev = None
         self._len = np.zeros((B,), np.int64)
         self._pending_deact = np.zeros((B,), bool)
+
+        # Decode-dispatch H2D telemetry (engine-thread writers; metrics()
+        # reads the plain ints off-thread like total_generated). Counts
+        # every host->device staging on the admit/decode hot path — the
+        # per-block evidence the kernel_micro_decode_state A/B banks.
+        self.h2d_transfers = 0
+        self.h2d_bytes = 0
+        self.decode_blocks = 0
 
         # host-side slot bookkeeping
         self._slot_req: List[Optional[GenRequest]] = [None] * self.B
@@ -1213,6 +1242,17 @@ class ServingEngine:
             "itl_count": float(self.itl_hist.total()),
             "kv_pages_free": float(self._kv_pages_free),
             "kv_pages_total": float(self.n_pages - 1),
+            # Decode-dispatch H2D accounting (device-resident decode
+            # state, docs/perf_notes.md Round 15): stagings + bytes on
+            # the admit/decode hot path, and the decode-block count they
+            # amortize over. The kernel_micro_decode_state A/B banks the
+            # per-block ratio resident-vs-legacy.
+            "h2d_transfers_total": float(self.h2d_transfers),
+            "h2d_bytes_total": float(self.h2d_bytes),
+            "decode_blocks_total": float(self.decode_blocks),
+            "h2d_per_decode_block": float(self.h2d_transfers)
+            / max(1.0, float(self.decode_blocks)),
+            "decode_resident": 1.0 if self.decode_resident else 0.0,
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
             "last_weight_stage_s": float(self.last_weight_stage_s),
@@ -1376,6 +1416,16 @@ class ServingEngine:
         if any(self._effective_priority(r) != 0 for r in self._backlog):
             self._backlog.sort(key=self._effective_priority)
 
+    def _h2d(self, arr) -> jnp.ndarray:
+        """jnp.asarray with decode-dispatch H2D accounting (engine
+        thread only): every staging on the admit/decode hot path goes
+        through here so the per-block transfer counts the decode-state
+        A/B banks are measured, not estimated."""
+        a = jnp.asarray(arr)
+        self.h2d_transfers += 1
+        self.h2d_bytes += int(a.nbytes)
+        return a
+
     def _chunked_prefill_one(
         self, input_ids: List[int], pages: List[int], start: int = 0
     ):
@@ -1384,24 +1434,40 @@ class ServingEngine:
         positions below `start` already hold valid KV in `pages`).
         Returns the device [V] logits row of the final token (for
         first-token sampling). One compiled program total — chunk size,
-        page-table width, and pool shapes are all static."""
+        page-table width, and pool shapes are all static. Resident mode
+        fuses each chunk's (tokens, start, valid) control into ONE
+        staged array; legacy mode keeps the three separate transfers."""
         # Cache-hit deltas run even when chunked prefill is not
         # configured; the prompt bucket doubles as the chunk size then.
         C = self.prefill_chunk or self.prompt_bucket
         self._ensure_pool()
         prow = np.full((self.max_pages,), TRASH_PAGE, np.int32)
         prow[: len(pages)] = pages
-        prow_dev = jnp.asarray(prow)
+        prow_dev = self._h2d(prow)
         last = None
         for s0 in range(start, len(input_ids), C):
             seg = input_ids[s0 : s0 + C]
             valid = len(seg)
+            if self.decode_resident:
+                ctl = np.zeros((C + 2,), np.int32)
+                ctl[:valid] = seg
+                ctl[C] = s0
+                ctl[C + 1] = valid
+                last, self._k_pages, self._v_pages = (
+                    paged_chunk_prefill_packed(
+                        self.params, self.cfg, self._h2d(ctl),
+                        self._k_pages, self._v_pages, prow_dev,
+                        attn_impl=self.attn_impl, mesh=self.mesh,
+                    )
+                )
+                continue
             toks = np.zeros((C,), np.int32)
             toks[:valid] = seg
             last, self._k_pages, self._v_pages = paged_chunk_prefill(
-                self.params, self.cfg, jnp.asarray(toks), self._k_pages,
-                self._v_pages, prow_dev, jnp.asarray(s0, jnp.int32),
-                jnp.asarray(valid, jnp.int32), attn_impl=self.attn_impl,
+                self.params, self.cfg, self._h2d(toks), self._k_pages,
+                self._v_pages, prow_dev,
+                self._h2d(np.int32(s0)), self._h2d(np.int32(valid)),
+                attn_impl=self.attn_impl,
                 mesh=self.mesh,
             )
         return last
@@ -1580,7 +1646,7 @@ class ServingEngine:
                 ids[i, :plen] = req.input_ids
                 lens[i] = plen
             short_logits, k_pref, v_pref = _prefill_batch(
-                self.params, self.cfg, jnp.asarray(ids), jnp.asarray(lens),
+                self.params, self.cfg, self._h2d(ids), self._h2d(lens),
                 pad_len=pad, mesh=self.mesh,
             )
             # Scatter prefill KV into the pool. Chunks past a row's
@@ -1597,7 +1663,7 @@ class ServingEngine:
             self._ensure_pool()
             self._k_pages, self._v_pages = scatter_prefill(
                 self._k_pages, self._v_pages, k_pref, v_pref,
-                jnp.asarray(flat.reshape(-1)),
+                self._h2d(flat.reshape(-1)),
             )
             if long:
                 # Only the mixed case pays for per-row slicing; the
@@ -1631,10 +1697,10 @@ class ServingEngine:
         tks = col(lambda r: r.top_k, np.int32, -1)
         greedy = col(lambda r: r.greedy, bool, False)
         packed = np.asarray(_first_sample_packed(
-            last_logits, sub, jnp.asarray(temps), jnp.asarray(tps),
-            jnp.asarray(tks), jnp.asarray(greedy),
-            jnp.asarray(col(lambda r: r.min_new_tokens > 0, bool, False)),
-            jnp.asarray(eos_rows),
+            last_logits, sub, self._h2d(temps), self._h2d(tps),
+            self._h2d(tks), self._h2d(greedy),
+            self._h2d(col(lambda r: r.min_new_tokens > 0, bool, False)),
+            self._h2d(eos_rows),
         ))  # one fetch: [n_b, 2]
         # First token is on host: TTFT = submit -> now (queue wait +
         # prefill + first sample, the SLO number the openloop bench
@@ -1663,6 +1729,7 @@ class ServingEngine:
             self._page_table[slot, :] = TRASH_PAGE
             self._page_table[slot, : len(pages)] = pages
             self._pt_dirty = True
+            self._pt_dirty_slots.add(slot)
             is_eos = tok_i in self._eos_set(req)
             budget_left = req.max_new_tokens - 1
             if (is_eos and req.min_new_tokens <= 1) or budget_left <= 0:
@@ -1693,16 +1760,16 @@ class ServingEngine:
         pad_n = m - len(adm_slots)
         self._dstate = apply_admits(
             self._dstate,
-            jnp.asarray(adm_slots + [0] * pad_n, jnp.int32),
-            jnp.asarray(adm_valid + [False] * pad_n),
-            jnp.asarray(adm_plens + [0] * pad_n, jnp.int32),
-            jnp.asarray(adm_toks + [0] * pad_n, jnp.int32),
-            jnp.asarray(adm_budget + [0] * pad_n, jnp.int32),
-            jnp.asarray(adm_minr + [0] * pad_n, jnp.int32),
-            jnp.asarray(adm_t + [1.0] * pad_n, jnp.float32),
-            jnp.asarray(adm_tp + [1.0] * pad_n, jnp.float32),
-            jnp.asarray(adm_tk + [-1] * pad_n, jnp.int32),
-            jnp.asarray(adm_g + [False] * pad_n),
+            self._h2d(np.asarray(adm_slots + [0] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_valid + [False] * pad_n)),
+            self._h2d(np.asarray(adm_plens + [0] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_toks + [0] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_budget + [0] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_minr + [0] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_t + [1.0] * pad_n, np.float32)),
+            self._h2d(np.asarray(adm_tp + [1.0] * pad_n, np.float32)),
+            self._h2d(np.asarray(adm_tk + [-1] * pad_n, np.int32)),
+            self._h2d(np.asarray(adm_g + [False] * pad_n)),
             n_slots=self.B,
         )
         if self._history is not None:
@@ -1716,9 +1783,9 @@ class ServingEngine:
                 rows[i, plen] = self._slot_out[slot][0]
             self._history = set_history(
                 self._history,
-                jnp.asarray(adm_slots + [0] * pad_n, jnp.int32),
-                jnp.asarray(adm_valid + [False] * pad_n),
-                jnp.asarray(rows),
+                self._h2d(np.asarray(adm_slots + [0] * pad_n, np.int32)),
+                self._h2d(np.asarray(adm_valid + [False] * pad_n)),
+                self._h2d(rows),
             )
 
     def _evict_one_prefix(self, pinned: Optional[set] = None,
@@ -2040,6 +2107,7 @@ class ServingEngine:
                 continue
             self._page_table[slot, cur:need] = got
             self._pt_dirty = True
+            self._pt_dirty_slots.add(slot)
             self._slot_pages[slot].extend(got)
 
     def _eos_set(self, req: Optional[GenRequest]) -> set:
@@ -2121,6 +2189,7 @@ class ServingEngine:
         self._slot_pages[slot] = []
         self._page_table[slot, :] = TRASH_PAGE
         self._pt_dirty = True
+        self._pt_dirty_slots.add(slot)
         # The device active mask may still have this slot on (host-side
         # stop, preemption, interrupt): deactivate before the next block
         # so its freed pages are never written again.
@@ -2181,19 +2250,39 @@ class ServingEngine:
 
     def _flush_device_control(self):
         """Apply pending deactivations + page-table changes (async
-        dispatches, no host sync)."""
+        dispatches, no host sync).
+
+        Resident mode stages only the DIRTY page-table rows (donated
+        scatter, paged.update_page_rows) — the full [B, max_pages]
+        restage is kept for init / legacy mode / more-than-half-dirty
+        laps (at that point one bulk transfer beats many row
+        scatters)."""
         if self._pending_deact.any():
             (lengths, next_input, active, remaining, min_remaining,
              temps, top_ps, top_ks, greedy) = self._dstate
             active = apply_deactivations(
-                active, jnp.asarray(self._pending_deact)
+                active, self._h2d(self._pending_deact)
             )
             self._dstate = (lengths, next_input, active, remaining,
                             min_remaining, temps, top_ps, top_ks, greedy)
             self._pending_deact[:] = False
-        if self._pt_dirty or self._pt_dev is None:
-            self._pt_dev = jnp.asarray(self._page_table)
-            self._pt_dirty = False
+        dirty = self._pt_dirty_slots
+        if self._pt_dev is None or (self._pt_dirty and not dirty) or (
+            dirty
+            and (not self.decode_resident or len(dirty) > self.B // 2)
+        ):
+            self._pt_dev = self._h2d(self._page_table)
+        elif dirty:
+            slots = sorted(dirty)
+            m = _pow2_at_least(len(slots), self.B)
+            packed = np.full((m, self.max_pages + 1), -1, np.int32)
+            packed[: len(slots), 0] = slots
+            packed[: len(slots), 1:] = self._page_table[slots]
+            self._pt_dev = update_page_rows(
+                self._pt_dev, self._h2d(packed), n_slots=self.B,
+            )
+        self._pt_dirty = False
+        dirty.clear()
 
     def _loop(self):
         try:
@@ -2333,6 +2422,7 @@ class ServingEngine:
                             min_remaining, temps, top_ps, top_ks, greedy)
             p = np.asarray(packed)  # the block's single device fetch
             self._blocks_since_admit += 1
+            self.decode_blocks += 1
             t_blk1 = time.monotonic()
             if tracing.enabled():
                 tracing.record_span(

@@ -38,7 +38,8 @@ from areal_tpu.api.model_api import (
 from areal_tpu.base import logging as areal_logging
 from areal_tpu.base import stats_tracker
 from areal_tpu.interfaces import functional as F
-from areal_tpu.ops.gae import gae_rows
+from areal_tpu.base import env_registry
+from areal_tpu.ops.gae import packed_gae
 from areal_tpu.ops.loss import masked_normalization
 
 logger = areal_logging.getLogger("ppo")
@@ -230,6 +231,13 @@ class PPOActorInterface(ModelInterface):
 
     def _prep_fn(self, engine):
         if not hasattr(self, "_jit_prep"):
+            # GAE impl pinned when the prep program is first built (the
+            # AREAL_CE_CHUNK snapshot discipline: a mid-run retrace must
+            # not silently switch kernels). 'auto' resolves per shape at
+            # trace time (ops/gae.resolve_gae_impl — the associative
+            # scan; the serial lax.scan stays the oracle + explicit
+            # fallback, the Pallas kernel the measured opt-in).
+            gae_impl = env_registry.get_str("AREAL_GAE_IMPL")
 
             def prep(rows, kl_coef):
                 resp_mask = response_scoring_mask(
@@ -274,13 +282,14 @@ class PPOActorInterface(ModelInterface):
                     else jnp.zeros_like(resp_mask)
                 )
                 masked_values = values * resp_mask
-                adv, ret = gae_rows(
+                adv, ret = packed_gae(
                     rewards * resp_mask,
                     masked_values,
                     score_seg,
                     bootstrap,
                     gamma=self.discount,
                     lam=self.gae_lambda,
+                    impl=gae_impl,
                 )
                 adv = adv * resp_mask
                 ret = ret * resp_mask

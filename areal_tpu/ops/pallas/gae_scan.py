@@ -1,0 +1,124 @@
+"""Blocked reverse affine-scan kernel (Pallas, TPU) — the GAE core.
+
+The GAE recursion over packed rows is a reverse scan of affine maps
+x_t = a_t * x_{t+1} + b_t (ops/gae._gae_affine_elems builds a and b; the
+segment structure lives entirely inside them, so this kernel is a plain
+segment-free scan). ``jax.lax.associative_scan`` already gives O(log T)
+*depth*, but it materializes ~log2(T) full [R, T] intermediates through
+HBM. This kernel reads (a, b) once and writes x once:
+
+- Grid (T // bt,) walking time blocks in REVERSE order via the BlockSpec
+  index_map. TPU grid execution is sequential by construction, which the
+  inter-block carry relies on (this kernel is wrong on a parallel-grid
+  backend; interpret mode is sequential too).
+- Within a block: an inclusive reverse scan of the affine pairs by
+  doubling — log2(bt) vectorized combine steps entirely in VMEM/VPU,
+  shifting with static slices + identity fill (a=1, b=0) past the block
+  end. C[t] then composes e_t .. e_blockend.
+- Across blocks: a [R, LANES] VMEM scratch carries x at the NEXT (later)
+  block's first position; x[t] = C[t].a * x_carry + C[t].b, and this
+  block's first column becomes the next carry.
+
+Shape gate ``gae_pallas_ok``: T must be lane-aligned (128 | T) and R
+sublane-aligned (8 | R, f32 tiles are 8x128). Padding a packed batch to
+those is the caller's trade (ops/gae dispatches to 'assoc' otherwise).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_SUBLANES = 8
+# Largest time block held in VMEM at once: 4 live [R, bt] f32 arrays
+# (a, b and their shifted halves) + in/out blocks — at R=256, bt=512
+# that is ~3 MB, comfortably under the ~16 MB budget.
+_BLOCK_T = 512
+
+
+def gae_pallas_ok(r: int, t: int) -> bool:
+    """Shape gate: t rides the lane axis (128-aligned), r the sublane
+    axis (8-aligned for f32 tiles)."""
+    return t % _LANES == 0 and r % _SUBLANES == 0 and r > 0
+
+
+def _largest_block(n: int, cap: int) -> int:
+    d = (min(cap, n) // _LANES) * _LANES
+    while n % d:
+        d -= _LANES
+    return d
+
+
+def _scan_kernel(a_ref, b_ref, x_ref, carry_sc):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        # First processed block == LAST time block: x past the end is 0.
+        carry_sc[...] = jnp.zeros_like(carry_sc)
+
+    A = a_ref[...].astype(jnp.float32)
+    B = b_ref[...].astype(jnp.float32)
+    rows, bt = A.shape
+    # Inclusive reverse scan by doubling: after step s, (A, B)[t]
+    # composes e_t .. e_{min(t + 2s - 1, end)}. Shift-by-s reads the
+    # partial composition starting at t+s; identity (a=1, b=0) past the
+    # block end leaves the suffix combines unchanged.
+    s = 1
+    while s < bt:
+        A_s = jnp.concatenate(
+            [A[:, s:], jnp.ones((rows, s), jnp.float32)], axis=1
+        )
+        B_s = jnp.concatenate(
+            [B[:, s:], jnp.zeros((rows, s), jnp.float32)], axis=1
+        )
+        # (f_t . f_{t+s..}): outer = the earlier element (this lane).
+        B = B + A * B_s
+        A = A * A_s
+        s *= 2
+    x_next = carry_sc[...][:, :1]  # [rows, 1]: x at blockend + 1
+    x = A * x_next + B
+    x_ref[...] = x
+    # This block's first column is x at its first position — the carry
+    # for the NEXT processed (earlier-time) block.
+    carry_sc[...] = jnp.broadcast_to(x[:, :1], carry_sc.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_t"))
+def segment_scan_reverse(
+    a: jnp.ndarray,  # [R, T] f32 multipliers (0 at segment boundaries)
+    b: jnp.ndarray,  # [R, T] f32 offsets (deltas)
+    interpret: bool = False,
+    block_t: int = _BLOCK_T,
+) -> jnp.ndarray:
+    """x[t] = a[t] * x[t+1] + b[t], scanned right-to-left per row, with
+    x[T] = 0. Returns [R, T] f32."""
+    R, T = a.shape
+    if not gae_pallas_ok(R, T):
+        raise ValueError(
+            f"segment_scan_reverse needs 128 | T and 8 | R, got "
+            f"[R={R}, T={T}]"
+        )
+    bt = _largest_block(T, block_t)
+    nb = T // bt
+
+    def imap(j):
+        return (0, nb - 1 - j)  # reverse time order
+
+    return pl.pallas_call(
+        _scan_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((R, bt), imap),
+            pl.BlockSpec((R, bt), imap),
+        ],
+        out_specs=pl.BlockSpec((R, bt), imap),
+        out_shape=jax.ShapeDtypeStruct((R, T), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((R, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(a.astype(jnp.float32), b.astype(jnp.float32))

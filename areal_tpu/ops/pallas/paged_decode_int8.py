@@ -42,8 +42,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # Dequant convention shared with engine/paged.quantize_kv (and the stock
-# kernel's quantization_utils): x ~= int8 * scale / 127.5.
-KV_INT8_MAX = 127.5
+# kernel's quantization_utils): x ~= int8 * scale / 127.5. Re-exported
+# from the one dependency-free source of truth (ops/quant_const) —
+# structural identity pinned in tests/engine/test_kv_int8.py.
+from areal_tpu.ops.quant_const import KV_INT8_MAX  # noqa: F401  (re-export)
 
 _NEG_INF = -1e30  # finite: keeps exp() clean for fully-masked positions
 _LANES = 128

@@ -1,0 +1,22 @@
+"""Quantization wire/pool constants shared across import domains.
+
+``KV_INT8_MAX`` is the int8 KV dequant convention (``x ~= int8 * scale
+/ 127.5``) consumed by BOTH ``engine/paged.py`` (host-side quantize /
+dequantize + the XLA gather path) and
+``ops/pallas/paged_decode_int8.py`` (in-VMEM dequant inside the Pallas
+kernel). It used to live as a numeric duplicate in each module — paged
+must not import the Pallas stack, and the kernel must not import the
+engine — pinned equal only by a test. This module is the one importable
+source of truth: dependency-free (no jax, no Pallas), so either side
+can import it without pulling the other's stack, and the pin test is
+now structural (both modules re-export THIS object) instead of
+comparing two literals that could drift to a third value together.
+
+The exact-max element clips to 127 (~0.4% error on that one element)
+instead of wrapping at rint(127.5) = 128 — see
+``engine/paged.quantize_kv``.
+"""
+
+from __future__ import annotations
+
+KV_INT8_MAX = 127.5

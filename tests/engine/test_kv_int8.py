@@ -142,12 +142,19 @@ def test_int8_kernel_gate():
 
 
 def test_kv_int8_max_constants_agree():
-    """engine/paged duplicates the dequant constant to keep Pallas off
-    its import path; the two must never drift."""
+    """The dequant constant has ONE source of truth (ops/quant_const);
+    engine/paged and the Pallas kernel must both re-export THAT object —
+    a structural pin, not a numeric one: two equal literals could still
+    drift to a third value together, but a re-export cannot diverge from
+    its source. Time budget: milliseconds."""
     from areal_tpu.engine.paged import KV_INT8_MAX as a
     from areal_tpu.ops.pallas.paged_decode_int8 import KV_INT8_MAX as b
+    from areal_tpu.ops.quant_const import KV_INT8_MAX as src
 
-    assert a == b
+    assert a is src and b is src
+    assert src == 127.5  # the wire convention itself (spill blobs on
+    # disk + cross-process handoffs encode it; changing it is a
+    # wire-format break, not a tuning tweak)
 
 
 def test_scatter_prefill_quantized_roundtrip():

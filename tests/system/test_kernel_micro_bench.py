@@ -1,0 +1,284 @@
+"""kernel_micro bench family (ISSUE 15 tentpole, bench front).
+
+Tier-1 teeth: ``validate_bench.py`` must refuse kernel_micro records
+that lack parity fields, show the optimized path slower than its
+baseline, fail greedy parity on the decode-state A/B, or present
+non-driver-verified numbers without the cpu_proxy/evidence=proxy
+labels. Banking tests run the real phase bodies at their CPU shapes
+and assert the banked attested records validate cleanly.
+
+Time budget docstrings per test; the banked-record tests re-use one
+phase run each (gae/paged/splash a few seconds of tiny jits; the
+decode-state A/B runs two 2-layer engines — heaviest, but warm the
+persistent XLA cache holds both arms' programs and the module stays
+~10 s total).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank, phases
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+KMICRO_PHASES = (
+    "kernel_micro_gae",
+    "kernel_micro_paged_decode",
+    "kernel_micro_splash",
+    "kernel_micro_decode_state",
+)
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _case(**mut):
+    c = {
+        "name": "decode_b8_float",
+        "baseline_impl": "xla",
+        "optimized_impl": "kernel",
+        "parity_max_rel": 2e-7,
+        "timed": 1.0,
+        "baseline_ms": 4.0,
+        "optimized_ms": 1.0,
+        "speedup": 4.0,
+    }
+    c.update(mut)
+    return c
+
+
+def _cases_value(case=None, **mut):
+    val = {
+        "cases": [case or _case()],
+        "n_cases": 1.0,
+        "best_speedup": 4.0,
+        "cpu_proxy": 1.0,
+        "evidence": "proxy",
+    }
+    val.update(mut)
+    return val
+
+
+def _rec(val, driver_verified=False, platform="cpu"):
+    return {
+        "status": "ok",
+        "pass": "measure",
+        "value": val,
+        "attestation": {"platform": platform,
+                        "driver_verified": driver_verified},
+    }
+
+
+def test_validator_teeth_for_kmicro_cases():
+    """Case-level refusals: missing/failed parity, timed case without
+    timings, optimized slower than baseline, empty case list. Time
+    budget: milliseconds (pure dict validation)."""
+    v = _load_validator()
+
+    def problems(case=None, **mut):
+        return v.validate_phase_value(
+            "kernel_micro_paged_decode", _rec(_cases_value(case, **mut))
+        )
+
+    assert problems() == []
+    # No cases at all: a kernel_micro record without cases measures
+    # nothing.
+    assert problems(cases=[])
+    # Parity missing: a timing without its parity check is refused.
+    c = _case()
+    del c["parity_max_rel"]
+    assert problems(c)
+    # Parity diverged.
+    assert problems(_case(parity_max_rel=1e-2))
+    # Optimized slower than baseline: a regression is not evidence.
+    assert problems(_case(optimized_ms=5.0))
+    # Timed case lacking its timing keys.
+    c = _case()
+    del c["speedup"]
+    assert problems(c)
+    # Parity-only (timed=0) cases are legal without timings (the
+    # interpret-mode arms off-TPU).
+    ok = {k: _case()[k] for k in
+          ("name", "baseline_impl", "optimized_impl", "parity_max_rel")}
+    ok["timed"] = 0.0
+    assert problems(ok) == []
+
+
+def test_validator_teeth_for_proxy_labeling():
+    """CPU-proxy labeling is cross-checked against the record's own
+    attestation, both directions. Time budget: milliseconds."""
+    v = _load_validator()
+
+    def problems(val, dv):
+        return v.validate_phase_value(
+            "kernel_micro_paged_decode", _rec(val, driver_verified=dv,
+                                              platform="tpu" if dv else "cpu")
+        )
+
+    # Non-verified record missing the labels: refused.
+    unlabeled = _cases_value()
+    del unlabeled["evidence"]
+    assert problems(unlabeled, dv=False)
+    bad = _cases_value(cpu_proxy=0.0)
+    assert problems(bad, dv=False)
+    # Verified record claiming proxy: also refused (conflation both
+    # ways).
+    proxy_on_tpu = _cases_value()
+    assert problems(proxy_on_tpu, dv=True)
+    ok_tpu = _cases_value(cpu_proxy=0.0)
+    del ok_tpu["evidence"]
+    assert problems(ok_tpu, dv=True) == []
+
+
+def test_validator_teeth_for_decode_state():
+    """Decode-state A/B refusals: token-parity failure, resident arm
+    not below legacy transfers, delta path moving more bytes. Time
+    budget: milliseconds."""
+    v = _load_validator()
+
+    def problems(**mut):
+        val = {
+            "token_parity_ok": 1.0,
+            "h2d_per_block_resident": 2.0,
+            "h2d_per_block_legacy": 5.0,
+            "h2d_bytes_per_block_resident": 300.0,
+            "h2d_bytes_per_block_legacy": 400.0,
+            "gen_tps_resident": 100.0,
+            "gen_tps_legacy": 90.0,
+            "cpu_proxy": 1.0,
+            "evidence": "proxy",
+        }
+        val.update(mut)
+        return v.validate_phase_value(
+            "kernel_micro_decode_state", _rec(val)
+        )
+
+    assert problems() == []
+    assert problems(token_parity_ok=0.0)
+    assert problems(h2d_per_block_resident=5.0)  # not below legacy
+    assert problems(h2d_per_block_resident=6.0)
+    assert problems(h2d_bytes_per_block_resident=900.0)
+    incomplete = problems()
+    # Schema: dropping any declared key is refused.
+    val = {
+        "token_parity_ok": 1.0,
+        "h2d_per_block_resident": 2.0,
+        "h2d_per_block_legacy": 5.0,
+        "cpu_proxy": 1.0,
+        "evidence": "proxy",
+    }
+    assert v.validate_phase_value("kernel_micro_decode_state", _rec(val))
+    assert incomplete == []
+
+
+def test_kmicro_phases_registered_as_daemon_defaults():
+    """All four kernel_micro phases must sit in the DEFAULT phase set —
+    that is what makes the next unattended TPU window measure them —
+    and must NOT be proxy-pinned (a proxy phase runs its subprocess on
+    JAX_PLATFORMS=cpu forever, which would defeat the point). Time
+    budget: milliseconds."""
+    defaults = {s.name for s in phases.default_phases()}
+    for name in KMICRO_PHASES:
+        assert name in defaults, f"{name} not a default daemon phase"
+        spec = phases.get(name)
+        assert not spec.proxy, f"{name} must not be CPU-pinned"
+        assert not spec.headline
+
+
+def _bank_and_validate(phase_name, fn, bank_dir):
+    val = fn("measure")
+    path = bank.write_record(
+        bank.make_record(phase_name, "measure", "ok", value=val), bank_dir
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["driver_verified"] is False
+    assert rec["value"]["cpu_proxy"] == 1.0
+    assert rec["value"]["evidence"] == "proxy"
+    v = _load_validator()
+    assert v.validate_phase_value(phase_name, rec) == []
+    return rec
+
+
+def test_gae_phase_banks_and_validates(tmp_path, monkeypatch):
+    """Acceptance (GAE leg): a banked kernel_micro GAE record shows the
+    scan-depth win (assoc over serial scan) with parity attached and
+    validates. Time budget: ~10 s warm (tiny CPU jits + an 18 ms host
+    loop)."""
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import kernel_micro_gae_phase
+
+    rec = _bank_and_validate("kernel_micro_gae", kernel_micro_gae_phase, b)
+    case = rec["value"]["cases"][0]
+    assert case["optimized_impl"] == "assoc"
+    assert case["speedup"] > 1.0, "assoc did not beat the serial scan"
+    assert case["assoc_depth"] < case["scan_depth"]
+    assert rec["value"]["gae_auto_impl"] == "assoc"
+    v = _load_validator()
+    assert v.validate_bank_dir(b) == []
+
+
+def test_paged_decode_and_splash_phases_bank(tmp_path, monkeypatch):
+    """The paged-decode sweep (pow2 admit shapes, float + int8) and the
+    splash parity case bank attested CPU-proxy records that validate.
+    Time budget: ~15 s warm (tiny pools; splash runs ONE interpret
+    case)."""
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import (
+        kernel_micro_paged_decode_phase, kernel_micro_splash_phase,
+    )
+
+    rec = _bank_and_validate(
+        "kernel_micro_paged_decode", kernel_micro_paged_decode_phase, b
+    )
+    names = {c["name"] for c in rec["value"]["cases"]}
+    assert {"decode_b2_float", "decode_b2_int8", "decode_b8_float"} <= names
+    int8 = [c for c in rec["value"]["cases"] if c["name"].endswith("int8")]
+    assert all("quant_max_rel_vs_float" in c for c in int8)
+
+    rec2 = _bank_and_validate(
+        "kernel_micro_splash", kernel_micro_splash_phase, b
+    )
+    case = rec2["value"]["cases"][0]
+    assert case["timed"] == 0.0  # interpret-only off-TPU: parity, no timing
+    assert case["parity_max_rel"] <= 1e-4
+
+
+def test_decode_state_phase_banks_and_validates(tmp_path, monkeypatch):
+    """Acceptance (decode leg): the A/B banks token parity + the
+    per-block H2D reduction and validates. Time budget: ~5 s warm (two
+    tiny engines; the persistent XLA cache holds their programs), ~40 s
+    cold."""
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import kernel_micro_decode_state_phase
+
+    val = kernel_micro_decode_state_phase("measure")
+    path = bank.write_record(
+        bank.make_record("kernel_micro_decode_state", "measure", "ok",
+                         value=val), b
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    v = _load_validator()
+    assert v.validate_phase_value("kernel_micro_decode_state", rec) == []
+    assert rec["value"]["token_parity_ok"] == 1.0
+    assert (rec["value"]["h2d_per_block_resident"]
+            < rec["value"]["h2d_per_block_legacy"])
