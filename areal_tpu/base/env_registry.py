@@ -106,6 +106,31 @@ _KNOBS: List[Knob] = [
     _k("AREAL_CKPT_BACKEND", "str", "pickle",
        "Checkpoint storage backend when the API caller passes none: "
        "'pickle' or 'orbax' (engine/checkpoint.py)."),
+    _k("AREAL_CKPT_ASYNC", "bool", False,
+       "Route pickle-backend engine checkpoints through the background "
+       "writer (engine/checkpoint.py): the step loop pays only a "
+       "reference-snapshot stall while device->host fetch + fsync + "
+       "rename run off-thread. Orbax saves stay synchronous "
+       "(collectives are not thread-safe off the main loop)."),
+    _k("AREAL_WAL", "bool", True,
+       "Arm the rollout-buffer write-ahead log + exactly-once sample "
+       "ledger (system/wal.py, system/stream_dataset.py, "
+       "system/push_pull_stream.py): accepted samples journal to disk "
+       "before acking the pusher, restarts replay unconsumed entries. "
+       "False restores the fire-and-forget pre-WAL wire."),
+    _k("AREAL_WAL_FSYNC_MS", "float", 50.0,
+       "Max milliseconds an appended WAL record may sit before the "
+       "batched fsync (and its deferred pusher ack) flushes it "
+       "(system/wal.py). 0 = fsync every append."),
+    _k("AREAL_WAL_ACK_TIMEOUT_S", "float", 5.0,
+       "Seconds a pushed sample may sit unacked before the pusher "
+       "redelivers it (system/push_pull_stream.py); the puller-side "
+       "ledger makes redelivery idempotent."),
+    _k("AREAL_WAL_REDELIVER_MAX", "int", 0,
+       "Redelivery attempts per unacked sample before the pusher drops "
+       "it and counts areal:train_samples_lost_total "
+       "(system/push_pull_stream.py); 0 = retry forever (exactly-once "
+       "mode: nothing is ever dropped)."),
     _k("AREAL_PREFETCH_DEPTH", "int", None,
        "Host-prefetcher queue depth override for the train engine "
        "(engine/jax_engine.py); unset = config/ctor default.",

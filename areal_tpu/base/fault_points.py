@@ -122,6 +122,22 @@ _POINTS: List[FaultPoint] = [
        ("areal_tpu/bench/runner.py",), "sync",
        "A bench phase subprocess dies or wedges (daemon "
        "resume/attempt-budget machinery)."),
+    _p("train.checkpoint",
+       ("areal_tpu/engine/checkpoint.py",), "sync",
+       "The trainer dies at the engine-checkpoint commit point, after "
+       "artifacts landed but around the manifest rename — recovery "
+       "must resume from the previous complete checkpoint, never a "
+       "torn one."),
+    _p("buffer.wal_append",
+       ("areal_tpu/system/wal.py",), "sync",
+       "The trainer dies inside a rollout-WAL append (possibly leaving "
+       "a torn final record) — replay must drop the torn tail and the "
+       "unacked sample must be redelivered by the pusher."),
+    _p("buffer.consume",
+       ("areal_tpu/system/buffer.py",), "sync",
+       "The trainer dies handing a batch to training, after buffer "
+       "admission but before the consumed-seq watermark persists — "
+       "the ledger must re-admit exactly once on resume."),
 ]
 
 REGISTRY: Dict[str, FaultPoint] = {p.name: p for p in _POINTS}

@@ -351,6 +351,35 @@ _METRICS: List[Metric] = [
        "in_use/limit fraction (the OOM-guard input).", reduce="max"),
     _m("perf/mem_devices_reporting", "scalar", "base/monitor.py",
        "Local devices that reported memory stats.", reduce="max"),
+    # Durable training plane (rollout WAL + exactly-once ledger +
+    # async checkpoint). The two headline invariant counters are
+    # expected to read 0 — the kill-anywhere e2e asserts exactly that.
+    _m("areal:train_samples_lost_total", "counter",
+       "system/push_pull_stream.py",
+       "Pushed samples dropped after exhausting the redelivery budget "
+       "(AREAL_WAL_REDELIVER_MAX). 0 under the default unbounded "
+       "budget — the exactly-once invariant."),
+    _m("areal:train_samples_duplicated_total", "counter",
+       "system/buffer.py",
+       "Samples DETECTED entering training twice (a sequence id "
+       "consumed again after the ledger marked it). A defensive "
+       "invariant detector, not a dedup count: redeliveries/replays "
+       "the ledger filters at admission are counted separately "
+       "(areal:train_wal_dup_dropped_total). Expected 0."),
+    _m("areal:train_wal_replayed_total", "counter",
+       "system/stream_dataset.py",
+       "WAL records replayed into the stream dataset at restart "
+       "(in-flight rollouts that survived a trainer kill)."),
+    _m("areal:train_wal_dup_dropped_total", "counter",
+       "system/stream_dataset.py",
+       "Redelivered/replayed samples dropped at admission because "
+       "their sequence id was already journaled or consumed — the "
+       "ledger doing its job (each drop is a prevented duplicate)."),
+    _m("areal:train_ckpt_stall_ms", "gauge", "engine/checkpoint.py",
+       "Step-loop stall of the most recent engine checkpoint: full "
+       "save duration when synchronous, reference-snapshot handoff "
+       "only when AREAL_CKPT_ASYNC routes the write off-thread (the "
+       "recovery_slo bench A/Bs the two)."),
 ]
 
 REGISTRY: Dict[str, Metric] = {m.name: m for m in _METRICS}

@@ -14,6 +14,7 @@ import pickle
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.base import constants
+from areal_tpu.base.wire_schemas import RECOVER_INFO_V1
 
 
 @dataclasses.dataclass
@@ -39,6 +40,15 @@ class RecoverInfo:
     eval_ctl_info: Dict[str, Any] = dataclasses.field(default_factory=dict)
     data_loading_dp_idx: int = 0
     hash_vals_to_ignore: List[int] = dataclasses.field(default_factory=list)
+    # Exactly-once sample ledger snapshot (system/wal.py SeqLedger
+    # to_dict form): which rollout sequence ids were fully consumed as
+    # of this checkpoint barrier. Persisted atomically WITH the step
+    # counters so a resume filters WAL replay and pusher redelivery
+    # against the same cut the engine state was taken at.
+    consumed_seqs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Per-dataset read cursors (worker_name -> dataloader state dict),
+    # the master-side copy of what each model worker checkpoints.
+    dataset_cursors: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> str:
@@ -46,11 +56,21 @@ def dump_path(experiment: Optional[str] = None, trial: Optional[str] = None) -> 
 
 
 def dump(info: RecoverInfo, experiment: Optional[str] = None, trial: Optional[str] = None):
+    """Atomic, schema-versioned dump: tmp + fsync + rename so a crash
+    mid-write can never poison the next recover_mode=auto start, and a
+    reader from a different protocol generation rejects the payload."""
     path = dump_path(experiment, trial)
-    tmp = path + ".tmp"
+    tmp = path + f".tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
-        pickle.dump(info, f)
+        pickle.dump({"schema": RECOVER_INFO_V1, "info": info}, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    dir_fd = os.open(os.path.dirname(path), os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
 
 
 def load(experiment: Optional[str] = None, trial: Optional[str] = None) -> RecoverInfo:
@@ -58,7 +78,14 @@ def load(experiment: Optional[str] = None, trial: Optional[str] = None) -> Recov
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no recover info at {path}")
     with open(path, "rb") as f:
-        return pickle.load(f)
+        payload = pickle.load(f)
+    if isinstance(payload, RecoverInfo):
+        # Legacy (pre-schema) record written by an older master.
+        return payload
+    schema = payload.get("schema")
+    if schema != RECOVER_INFO_V1:
+        raise ValueError(f"unsupported recover-info schema {schema!r} at {path}")
+    return payload["info"]
 
 
 def discover_ckpt(model_name: str, experiment=None, trial=None) -> Optional[str]:

@@ -40,6 +40,28 @@ def get_shuffle_seed(key: str = "shuffle") -> int:
     return (_BASE_SEED + _hash_key(f"{_SEED_FROM}/{key}")) % (2**31)
 
 
+def state_dict() -> dict:
+    """Snapshot of this process's host-side RNG state for checkpointing:
+    the (base_seed, key) identity plus the live python/numpy generator
+    states, so a recovered run continues the exact sample stream an
+    uninterrupted one would have produced."""
+    return {
+        "base_seed": _BASE_SEED,
+        "seed_from": _SEED_FROM,
+        "python_random": random.getstate(),
+        "numpy_random": np.random.get_state(),
+    }
+
+
+def load_state(state: dict):
+    """Restore a state_dict() snapshot taken at checkpoint time."""
+    global _BASE_SEED, _SEED_FROM
+    _BASE_SEED = int(state["base_seed"])
+    _SEED_FROM = state["seed_from"]
+    random.setstate(state["python_random"])
+    np.random.set_state(state["numpy_random"])
+
+
 def prng_key(key: str):
     """A jax PRNGKey derived from the experiment seed, this process's
     identity key (from set_random_seed), and a string key — distinct

@@ -46,3 +46,19 @@ BENCH_REPORT_V1 = "areal-bench-report/v1"
 # generation and resume at the right version
 # (system/fleet_controller.py).
 FLEET_LEASE_V1 = "areal-fleet-lease/v1"
+
+# Trainer checkpoint manifest: the commit record written LAST (atomic
+# rename) after every engine-state artifact landed, carrying the
+# version, LR-schedule position, RNG state, and dataset cursors a
+# resume needs to continue bit-identically (engine/checkpoint.py).
+TRAIN_CKPT_V1 = "areal-train-ckpt/v1"
+
+# Rollout-buffer write-ahead log: the append-only journal of samples
+# accepted into the training plane, replayed on restart so in-flight
+# rollouts survive a trainer kill (system/wal.py).
+BUFFER_WAL_V1 = "areal-buffer-wal/v1"
+
+# Master recovery record: RecoverInfo pickle wrapper, including the
+# consumed-sequence ledger persisted atomically with each checkpoint
+# barrier (base/recover.py).
+RECOVER_INFO_V1 = "areal-recover-info/v1"

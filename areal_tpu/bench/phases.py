@@ -346,6 +346,22 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="recovery_slo",
+    entrypoint="areal_tpu.bench.workloads:recovery_slo_phase",
+    priority=12,
+    est_compile_s=0.0,  # host + loopback ZMQ only: no compile pass
+    est_measure_s=30.0,
+    min_window_s=0.0,
+    proxy=True,
+    description="Durable-training-plane SLOs: async-vs-sync checkpoint "
+                "stall A/B on synthetic engine state, cold-recovery "
+                "MTTR (manifest + state + WAL replay against the "
+                "checkpointed ledger cut), and exactly-once accounting "
+                "under a forced redelivery storm — lost and duplicated "
+                "must both be zero (host-side; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
     name="weight_update",
     entrypoint="areal_tpu.bench.workloads:weight_update_phase",
     priority=12,

@@ -2862,3 +2862,176 @@ def kernel_micro_decode_state_phase(pass_: str) -> dict:
         f"bytes/block {st_res['h2d_bytes_per_block']:.0f} vs "
         f"{st_leg['h2d_bytes_per_block']:.0f}")
     return val
+
+
+def recovery_slo_phase(pass_: str) -> dict:
+    """Durable-training-plane SLOs (ISSUE 16 acceptance), host-side
+    CPU-proxy evidence in three measurements. (1) Checkpoint-stall A/B:
+    mean caller-thread stall of `save_engine_state` with the async
+    writer off vs on over the same synthetic state — the async arm pays
+    a snapshot handoff, not the pickle+fsync, so its stall must be
+    measurably lower. (2) MTTR: the full cold-recovery critical path —
+    load the committed manifest, restore engine state, replay the WAL
+    and filter it against the checkpointed ledger cut. (3) Exactly-once
+    under a redelivery storm: an acked loopback push/pull stream with a
+    forced redeliver mid-drain; the ledger must absorb every duplicate
+    (samples_duplicated is the DETECTOR, not the prevention counter)
+    and nothing may be lost."""
+    if pass_ == "compile":
+        return {"compile_s": 0.0}  # host-only: nothing to compile
+    import shutil
+    import tempfile
+
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.system import push_pull_stream as pps
+    from areal_tpu.system.wal import RolloutWAL, SeqLedger
+
+    rng = np.random.RandomState(5)
+
+    class _Eng:
+        """Checkpointable stand-in: ~16 MiB of numpy state, replaced
+        (never mutated) like the real engines, so async snapshots by
+        reference are crash-consistent."""
+
+        def __init__(self):
+            self.params = {
+                f"l{i:02d}": rng.standard_normal((512, 256)).astype(
+                    np.float32
+                )
+                for i in range(32)
+            }
+            self.opt_state = None
+            self.version = 0
+
+        def set_params(self, params):
+            self.params = params
+
+    n_saves = 8
+    state_mb = 32 * 512 * 256 * 4 / 2**20
+    tmp = tempfile.mkdtemp(prefix="areal_recovery_bench_")
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("AREAL_CKPT_ASYNC", "AREAL_CKPT_BACKEND")
+    }
+    pusher = puller = None
+    try:
+        os.environ["AREAL_CKPT_BACKEND"] = "pickle"
+        eng = _Eng()
+
+        # -- arm A: synchronous saves (the stall IS the full write) ----
+        os.environ["AREAL_CKPT_ASYNC"] = "0"
+        sync_ms = []
+        for v in range(1, n_saves + 1):
+            eng.version = v
+            t0 = time.perf_counter()
+            checkpoint.save_engine_state(eng, os.path.join(tmp, "sync"))
+            sync_ms.append((time.perf_counter() - t0) * 1000.0)
+
+        # -- arm B: async saves (the stall is the snapshot handoff) ----
+        os.environ["AREAL_CKPT_ASYNC"] = "1"
+        async_ms = []
+        for v in range(1, n_saves + 1):
+            eng.version = v
+            t0 = time.perf_counter()
+            checkpoint.save_engine_state(eng, os.path.join(tmp, "async"))
+            async_ms.append((time.perf_counter() - t0) * 1000.0)
+        checkpoint.wait_pending_writes(timeout=120)
+        os.environ["AREAL_CKPT_ASYNC"] = "0"
+
+        # -- MTTR: commit a barrier cut, then time cold recovery -------
+        n_wal, n_consumed = 256, 128
+        ledger = SeqLedger()
+        for i in range(n_consumed):
+            ledger.mark(f"b0/{i}")
+        ckpt_dir = os.path.join(tmp, "mttr")
+        checkpoint.save_engine_state(
+            eng, ckpt_dir,
+            dataset_cursors={"consumed_seqs": ledger.to_dict()},
+        )
+        wal_path = os.path.join(tmp, "wal", "puller0.wal")
+        wal = RolloutWAL(wal_path, fsync_ms=0)
+        payload = {"traj": list(range(64))}
+        for i in range(n_wal):
+            wal.append({"seq": f"b0/{i}", "data": payload})
+        wal.close()
+
+        t0 = time.perf_counter()
+        man = checkpoint.load_manifest(ckpt_dir)
+        eng2 = _Eng()
+        checkpoint.load_engine_state(eng2, ckpt_dir)
+        cut = SeqLedger.from_dict(
+            (man.get("dataset_cursors") or {}).get("consumed_seqs")
+        )
+        replayed = sum(
+            1 for rec in RolloutWAL(wal_path, fsync_ms=0).replay()
+            if rec["seq"] not in cut
+        )
+        mttr_ms = (time.perf_counter() - t0) * 1000.0
+        if eng2.version != eng.version or replayed != n_wal - n_consumed:
+            raise RuntimeError(
+                f"recovery_slo: recovered state wrong (version "
+                f"{eng2.version}/{eng.version}, replayed {replayed})"
+            )
+
+        # -- exactly-once under a forced redelivery storm --------------
+        n_msgs = 64
+        puller = pps.ZMQJsonPuller(host="127.0.0.1")
+        pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port, ack=True)
+        for i in range(n_msgs):
+            pusher.push({"i": i}, seq=f"s0/{i}")
+        consumed, duplicated, redelivered = SeqLedger(), 0, 0
+        trained = 0
+        deadline = time.monotonic() + 60
+        while trained < n_msgs and time.monotonic() < deadline:
+            try:
+                puller.pull(timeout_ms=200)
+            except TimeoutError:
+                redelivered += pusher.redeliver(timeout_s=0.0)
+                continue
+            seq = puller.last_seq
+            if seq in consumed:
+                duplicated += 1  # would have trained twice
+            else:
+                consumed.mark(seq)
+                trained += 1
+            puller.ack(seq, puller.last_ack_addr)
+            pusher.drain_acks()
+            if trained == n_msgs // 2:
+                # Mid-drain storm: re-send everything still unacked.
+                redelivered += pusher.redeliver(timeout_s=0.0)
+        ack_deadline = time.monotonic() + 10
+        while pusher.unacked() and time.monotonic() < ack_deadline:
+            pusher.drain_acks()
+            time.sleep(0.01)
+
+        def mean(xs):
+            return sum(xs) / len(xs)
+
+        out = {
+            "state_mb": state_mb,
+            "n_ckpt_saves": float(n_saves),
+            "sync_stall_ms_mean": mean(sync_ms),
+            "async_stall_ms_mean": mean(async_ms),
+            "async_stall_saved_frac": (
+                1.0 - mean(async_ms) / mean(sync_ms) if mean(sync_ms) else 0.0
+            ),
+            "mttr_ms": mttr_ms,
+            "wal_records": float(n_wal),
+            "wal_replayed": float(replayed),
+            "redelivered": float(redelivered),
+            "samples_lost": float(n_msgs - trained),
+            "samples_duplicated": float(duplicated),
+        }
+        log(f"bench: recovery_slo {out}")
+        return out
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if pusher is not None:
+            pusher.close()
+        if puller is not None:
+            puller.close()
+        shutil.rmtree(tmp, ignore_errors=True)

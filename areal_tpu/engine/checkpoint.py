@@ -19,27 +19,77 @@ argument):
 
 Loading auto-detects which backend wrote a directory, so the flag only
 matters for new saves.
+
+Crash consistency (the durable-training-plane contract): every artifact
+lands tmp+fsync+rename, and a ``manifest.json`` (areal-train-ckpt/v1)
+is written LAST as the commit record — carrying version, the LR
+schedule position (version_steps), RNG state, and dataset cursors. A
+kill anywhere mid-save leaves the previous complete checkpoint intact,
+so recovery resumes at most one version behind. With AREAL_CKPT_ASYNC
+the pickle backend routes through `AsyncCheckpointWriter`: the step
+loop pays only an on-device snapshot dispatch (donation-safe copies,
+see `_snapshot_tree`) and the device->host fetch + serialization +
+fsync run on a background thread.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
-from typing import Any, Optional
+import queue
+import threading
+import time
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
-from areal_tpu.base import env_registry, logging
+from areal_tpu.base import env_registry, logging, seeding
+from areal_tpu.base.fault_injection import faults
+from areal_tpu.base.wire_schemas import TRAIN_CKPT_V1
 
 logger = logging.getLogger("checkpoint")
 
 _STATE_FILE = "engine_state.pkl"
 _ORBAX_DIR = "engine_state_orbax"
+_MANIFEST_FILE = "manifest.json"
+_RNG_SIDECAR = "rng_state.pkl"
+
+# Step-loop stall of the most recent save on this process: full save
+# duration when synchronous, submit-handoff only when async (the
+# recovery_slo bench reads this A/B).
+ckpt_stats = {"areal:train_ckpt_stall_ms": 0.0}
+
+# The loop-only contract for the background writer: `_run` (the writer
+# thread) owns the in-flight job state; everyone else goes through
+# submit()/wait(), which only touch the condition-guarded counters.
+AREAL_LINT_LOOP_ONLY = {
+    "AsyncCheckpointWriter": {
+        "roots": ["_run"],
+        "attrs": ["_active", "_completed"],
+        "init_ok": ["__init__"],
+        "instance_hints": ["ckpt_writer"],
+    },
+}
 
 
 def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def _snapshot_tree(tree: Any) -> Any:
+    """Donation-safe snapshot for the async writer: the train step's
+    fused program donates params/opt buffers (jax_engine donate_argnums),
+    so a bare reference would be DELETED once training races ahead.
+    jnp.copy dispatches an on-device copy asynchronously — the step loop
+    pays a dispatch, not a transfer; host (numpy) leaves are replaced,
+    never mutated, so references suffice there."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree
+    )
 
 
 def _engine_state(engine):
@@ -59,9 +109,232 @@ def _ckpt_backend(backend: Optional[str]) -> str:
     return backend or env_registry.get_str("AREAL_CKPT_BACKEND")
 
 
-def save_engine_state(engine, save_dir: str, backend: Optional[str] = None):
+def _fsync_dir(path: str):
+    dir_fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def _collect_meta(engine, dataset_cursors: Optional[Dict] = None) -> Dict[str, Any]:
+    """Everything a resume needs beyond the weight/opt pytrees, captured
+    on the CALLER thread (atomically with the param refs)."""
+    version = int(engine.version)
+    return {
+        "version": version,
+        "version_steps": int(getattr(engine, "_lr_steps", version)),
+        "rng": engine.rng_state() if hasattr(engine, "rng_state") else {},
+        "host_rng": seeding.state_dict(),
+        "dataset_cursors": dataset_cursors,
+    }
+
+
+def _write_manifest(save_dir: str, meta: Dict[str, Any], artifact: str):
+    """The commit record, written LAST: a checkpoint without a current
+    manifest is not a checkpoint (recovery falls back to the previous
+    complete one). host_rng is pickled state, not JSON — it rides the
+    artifact (pickle backend) or the rng sidecar (orbax), never here."""
+    manifest = {
+        "schema": TRAIN_CKPT_V1,
+        "version": meta["version"],
+        "version_steps": meta["version_steps"],
+        "rng": meta["rng"],
+        "dataset_cursors": meta["dataset_cursors"],
+        "artifact": artifact,
+    }
+    path = os.path.join(save_dir, _MANIFEST_FILE)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # The commit point: a kill here (armed in the chaos campaign) must
+    # leave either the old manifest or the new one, never a torn file.
+    faults.maybe_fail("train.checkpoint")
+    os.replace(tmp, path)
+    _fsync_dir(save_dir)
+
+
+def load_manifest(load_dir: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(load_dir, _MANIFEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        m = json.load(f)
+    if m.get("schema") != TRAIN_CKPT_V1:
+        logger.warning("ignoring manifest with schema %r at %s",
+                       m.get("schema"), load_dir)
+        return None
+    return m
+
+
+def _write_pickle_state(save_dir: str, state: Dict[str, Any]):
+    tmp = os.path.join(save_dir, f"{_STATE_FILE}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(save_dir, _STATE_FILE))
+    _fsync_dir(save_dir)
+    stale_dir = os.path.join(save_dir, _ORBAX_DIR)
+    if os.path.isdir(stale_dir):
+        import shutil
+
+        shutil.rmtree(stale_dir, ignore_errors=True)
+
+
+class AsyncCheckpointWriter:
+    """Background pickle-checkpoint writer (AREAL_CKPT_ASYNC).
+
+    `submit()` runs on the step loop and only dispatches an on-device
+    snapshot copy plus the resume metadata — donation-safe against the
+    train step's buffer reuse, so the snapshot stays crash-consistent
+    while training races ahead (`_snapshot_tree`); the
+    device->host gather, pickling, fsync and manifest commit all happen
+    on the single writer thread (one thread, so overlapping submits for
+    the same directory serialize instead of interleaving). Errors
+    surface at the next submit()/wait(); `wait()` is the read barrier
+    load/has_engine_state take before trusting the directory.
+    """
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._cond = threading.Condition()
+        self._pending = 0
+        self._last_error: Optional[BaseException] = None
+        self._last_write_s = 0.0
+        self._active: Optional[str] = None
+        self._completed = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ckpt-writer"
+        )
+        self._thread.start()
+
+    def submit(self, engine, save_dir: str,
+               dataset_cursors: Optional[Dict] = None) -> float:
+        """Snapshot + enqueue; returns the step-loop stall in ms."""
+        t0 = time.monotonic()
+        self._raise_pending_error()
+        params, opt = _engine_state(engine)
+        job = {
+            "save_dir": save_dir,
+            "params": _snapshot_tree(params),
+            "opt": _snapshot_tree(opt) if opt is not None else None,
+            "meta": _collect_meta(engine, dataset_cursors),
+        }
+        with self._cond:
+            self._pending += 1
+        self._q.put(job)
+        stall_ms = (time.monotonic() - t0) * 1e3
+        ckpt_stats["areal:train_ckpt_stall_ms"] = stall_ms
+        return stall_ms
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until every submitted write committed; re-raise the
+        first writer-thread error, if any."""
+        with self._cond:
+            if not self._cond.wait_for(
+                lambda: self._pending == 0, timeout=timeout
+            ):
+                raise TimeoutError(
+                    f"async checkpoint writes still pending after {timeout}s"
+                )
+        self._raise_pending_error()
+
+    def _raise_pending_error(self):
+        with self._cond:
+            err, self._last_error = self._last_error, None
+        if err is not None:
+            raise err
+
+    def pending(self) -> int:
+        with self._cond:
+            return self._pending
+
+    def last_write_s(self) -> float:
+        with self._cond:
+            return self._last_write_s
+
+    def _run(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            self._active = job["save_dir"]
+            err: Optional[BaseException] = None
+            t0 = time.monotonic()
+            try:
+                os.makedirs(job["save_dir"], exist_ok=True)
+                state = {
+                    "params": _to_host(job["params"]),
+                    "opt_state": (
+                        _to_host(job["opt"]) if job["opt"] is not None else None
+                    ),
+                    "version": job["meta"]["version"],
+                    "version_steps": job["meta"]["version_steps"],
+                    "rng": job["meta"]["rng"],
+                    "host_rng": job["meta"]["host_rng"],
+                }
+                _write_pickle_state(job["save_dir"], state)
+                _write_manifest(job["save_dir"], job["meta"], _STATE_FILE)
+                logger.info("saved engine state (async) to %s", job["save_dir"])
+            except BaseException as e:  # surfaced at next submit()/wait()
+                logger.exception("async checkpoint write failed")
+                err = e
+            self._active = None
+            self._completed += 1
+            elapsed = time.monotonic() - t0
+            with self._cond:
+                self._pending -= 1
+                self._last_write_s = elapsed
+                if err is not None and self._last_error is None:
+                    self._last_error = err
+                self._cond.notify_all()
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=30)
+
+
+_ASYNC_WRITER: Optional[AsyncCheckpointWriter] = None
+_WRITER_INIT_LOCK = threading.Lock()
+
+
+def get_async_writer() -> AsyncCheckpointWriter:
+    global _ASYNC_WRITER
+    with _WRITER_INIT_LOCK:
+        if _ASYNC_WRITER is None:
+            _ASYNC_WRITER = AsyncCheckpointWriter()
+        return _ASYNC_WRITER
+
+
+def wait_pending_writes(timeout: Optional[float] = None):
+    """Read barrier: block until any in-flight async checkpoint writes
+    committed (no-op when the writer was never created)."""
+    writer = _ASYNC_WRITER
+    if writer is not None:
+        writer.wait(timeout)
+
+
+def save_engine_state(engine, save_dir: str, backend: Optional[str] = None,
+                      dataset_cursors: Optional[Dict] = None):
+    if _ckpt_backend(backend) != "orbax" and env_registry.get_bool(
+        "AREAL_CKPT_ASYNC"
+    ):
+        get_async_writer().submit(engine, save_dir, dataset_cursors)
+        return
+    t0 = time.monotonic()
+    _save_engine_state_sync(engine, save_dir, backend, dataset_cursors)
+    ckpt_stats["areal:train_ckpt_stall_ms"] = (time.monotonic() - t0) * 1e3
+
+
+def _save_engine_state_sync(engine, save_dir: str,
+                            backend: Optional[str] = None,
+                            dataset_cursors: Optional[Dict] = None):
     os.makedirs(save_dir, exist_ok=True)
     params, opt = _engine_state(engine)
+    meta = _collect_meta(engine, dataset_cursors)
     if _ckpt_backend(backend) == "orbax":
         import orbax.checkpoint as ocp
 
@@ -98,22 +371,31 @@ def save_engine_state(engine, save_dir: str, backend: Optional[str] = None):
         stale = os.path.join(save_dir, _STATE_FILE)
         if os.path.exists(stale):
             os.remove(stale)
+        # RNG state rides a pickle sidecar (numpy generator state is not
+        # JSON and not worth an orbax tree); the manifest written after
+        # it is still the commit record for the whole set.
+        tmp = os.path.join(save_dir, f"{_RNG_SIDECAR}.tmp.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            pickle.dump(
+                {"rng": meta["rng"], "host_rng": meta["host_rng"]},
+                f, protocol=pickle.HIGHEST_PROTOCOL,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(save_dir, _RNG_SIDECAR))
+        _write_manifest(save_dir, meta, _ORBAX_DIR)
         logger.info(f"saved engine state (orbax) to {save_dir}")
         return
     state = {
         "params": _to_host(params),
         "opt_state": _to_host(opt) if opt is not None else None,
-        "version": engine.version,
+        "version": meta["version"],
+        "version_steps": meta["version_steps"],
+        "rng": meta["rng"],
+        "host_rng": meta["host_rng"],
     }
-    tmp = os.path.join(save_dir, f"{_STATE_FILE}.tmp.{os.getpid()}")
-    with open(tmp, "wb") as f:
-        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, os.path.join(save_dir, _STATE_FILE))
-    stale_dir = os.path.join(save_dir, _ORBAX_DIR)
-    if os.path.isdir(stale_dir):
-        import shutil
-
-        shutil.rmtree(stale_dir, ignore_errors=True)
+    _write_pickle_state(save_dir, state)
+    _write_manifest(save_dir, meta, _STATE_FILE)
     logger.info(f"saved engine state to {save_dir}")
 
 
@@ -190,9 +472,19 @@ def _load_orbax(engine, path: str) -> dict:
 
 
 def load_engine_state(engine, load_dir: str):
+    # Read barrier: an in-flight async write to this (or any) directory
+    # must commit before the artifacts are trusted.
+    wait_pending_writes()
     orbax_path = os.path.join(os.path.abspath(load_dir), _ORBAX_DIR)
     if os.path.isdir(orbax_path):
         state = _load_orbax(engine, orbax_path)
+        rng_path = os.path.join(load_dir, _RNG_SIDECAR)
+        if os.path.exists(rng_path):
+            with open(rng_path, "rb") as f:
+                state.update(pickle.load(f))
+        manifest = load_manifest(load_dir)
+        if manifest is not None:
+            state.setdefault("version_steps", manifest.get("version_steps"))
     else:
         path = os.path.join(load_dir, _STATE_FILE)
         with open(path, "rb") as f:
@@ -230,13 +522,23 @@ def load_engine_state(engine, load_dir: str):
         # The LR schedule position for callers that omit version_steps:
         # pre-PR-9 it rode in opt_state's scale_by_schedule count (now a
         # constant unit-LR schedule, see make_optimizer external_lr);
-        # resume it at the restored version so a recovery restart does
-        # not snap the schedule back to warmup start.
-        engine._lr_steps = int(state.get("version", 0))
+        # resume it at the checkpointed position (legacy checkpoints
+        # without version_steps fall back to the version) so a recovery
+        # restart does not snap the schedule back to warmup start.
+        vs = state.get("version_steps")
+        engine._lr_steps = int(vs if vs is not None else state.get("version", 0))
+    # RNG restore: "recovered" must mean "same stream as uninterrupted".
+    rng = state.get("rng")
+    if rng and hasattr(engine, "load_rng_state"):
+        engine.load_rng_state(rng)
+    host_rng = state.get("host_rng")
+    if host_rng:
+        seeding.load_state(host_rng)
     logger.info(f"loaded engine state from {load_dir}")
 
 
 def has_engine_state(load_dir: str) -> bool:
+    wait_pending_writes()
     return os.path.exists(os.path.join(load_dir, _STATE_FILE)) or os.path.isdir(
         os.path.join(load_dir, _ORBAX_DIR)
     )

@@ -1166,6 +1166,22 @@ class JaxTrainEngine(TrainEngine):
         self._host_params = None
         self._host_opt_state = None
 
+    def rng_state(self) -> dict:
+        """Checkpointable RNG/counter state: the call counters every
+        engine-derived PRNGKey folds in (generate's default key is
+        PRNGKey(_gen_calls)), so a restored engine continues the exact
+        sampling stream an uninterrupted run would have produced."""
+        return {
+            "gen_calls": int(self._gen_calls),
+            "train_calls": int(self._train_calls),
+            "lr_steps": int(self._lr_steps),
+        }
+
+    def load_rng_state(self, state: dict):
+        self._gen_calls = int(state.get("gen_calls", 0))
+        self._train_calls = int(state.get("train_calls", 0))
+        self._lr_steps = int(state.get("lr_steps", self._lr_steps))
+
     def set_params(self, params):
         if self._offloaded and self._host_opt_state is not None:
             # Param realloc swaps weights but 'optimizer state stays

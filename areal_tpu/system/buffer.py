@@ -18,6 +18,8 @@ from typing import Dict, List, Optional, Set, Tuple
 from areal_tpu.api.data_api import SequenceSample
 from areal_tpu.api.dfg import MFCDef
 from areal_tpu.base import logging, tracing
+from areal_tpu.base.fault_injection import faults
+from areal_tpu.system.wal import SeqLedger
 
 logger = logging.getLogger("buffer")
 
@@ -61,6 +63,23 @@ class AsyncIOSequenceBuffer:
         # resident duplicates skipped on put (epoch carryover); surfaced
         # in logs so silent data-accounting drift stays visible.
         self.n_dropped_duplicates = 0
+        # Exactly-once over rollout sequence ids (wal_seq metadata from
+        # the stream dataset): seqs are globally unique, so unlike
+        # ignore_ids membership is PERMANENT. Seeded from RecoverInfo at
+        # recovery; persisted back at every checkpoint barrier.
+        self.seq_ledger = SeqLedger()
+        # seq -> resident sample ids not yet fully consumed; a seq is
+        # marked in the ledger only once its last id is GC'd.
+        self._seq_pending: Dict[str, Set[str]] = {}
+        self._id_seq: Dict[str, str] = {}
+        # Replayed/redelivered samples dropped at admission because
+        # their seq is ledgered or already resident under another id
+        # set (prevented duplicates, expected nonzero after recovery).
+        self.n_ledger_filtered = 0
+        # The invariant DETECTOR, not a dedup count: a sample whose seq
+        # was already ledger-marked reaching full consumption again.
+        # Expected 0 — the kill-anywhere e2e asserts exactly that.
+        self.counters = {"areal:train_samples_duplicated_total": 0}
         # Advanced by the master each step; stamped on buffer.wait spans
         # so the trace report can derive staleness (train step minus the
         # policy version that STARTED the sample's generation).
@@ -87,9 +106,23 @@ class AsyncIOSequenceBuffer:
             new_ids = set()
             resident_dups = set()
             ignored_seen = set()
+            ledgered = set()
             for s in samples:
+                seqs = s.metadata.get("wal_seq")
                 for i in range(s.bs):
                     sample_id = s.ids[i]
+                    seq = seqs[i] if seqs else None
+                    if seq is not None and (
+                        seq in self.seq_ledger
+                        or (seq in self._seq_pending
+                            and sample_id not in self._seq_pending[seq])
+                    ):
+                        # WAL replay / pusher redelivery of a sequence
+                        # already consumed (ledgered) or resident: drop
+                        # at admission — this is exactly-once working,
+                        # counted so recovery accounting stays visible.
+                        ledgered.add(sample_id)
+                        continue
                     if (
                         sample_id in self.ignore_ids
                         and sample_id not in ignored_seen
@@ -106,6 +139,13 @@ class AsyncIOSequenceBuffer:
                             f"put_batch call"
                         )
                     new_ids.add(sample_id)
+            if ledgered:
+                self.n_ledger_filtered += len(ledgered)
+                logger.info(
+                    "seq ledger filtered %d already-delivered sample(s) at "
+                    "admission (total %d)",
+                    len(ledgered), self.n_ledger_filtered,
+                )
             if resident_dups:
                 self.n_dropped_duplicates += len(resident_dups)
                 logger.warning(
@@ -121,15 +161,22 @@ class AsyncIOSequenceBuffer:
                 )
             n = 0
             for s in samples:
+                seqs = s.metadata.get("wal_seq")
                 for sid in range(s.bs):
                     sub = s._select_indices([sid]) if s.bs > 1 else s
                     sample_id = sub.ids[0]
+                    if sample_id in ledgered:
+                        continue
                     if sample_id in self.ignore_ids:
                         # consumed before a crash; skip exactly once
                         self.ignore_ids.discard(sample_id)
                         continue
                     if sample_id in resident_dups:
                         continue
+                    seq = seqs[sid] if seqs else None
+                    if seq is not None:
+                        self._seq_pending.setdefault(seq, set()).add(sample_id)
+                        self._id_seq[sample_id] = seq
                     self._slots[sample_id] = _Slot(
                         idx=next(self._counter),
                         sample=sub,
@@ -173,6 +220,9 @@ class AsyncIOSequenceBuffer:
         self, rpc: MFCDef
     ) -> Tuple[List[str], SequenceSample]:
         """Await and consume a batch of rpc.n_seqs samples (oldest first)."""
+        # The kill window the ledger exists for: batch handed to
+        # training, consumed-seq watermark not yet durable.
+        faults.maybe_fail("buffer.consume")
         async with self._cond:
             while True:
                 cand = self._candidates(rpc)
@@ -213,6 +263,7 @@ class AsyncIOSequenceBuffer:
                         if len(slot.consumed_by) == self._n_rpcs:
                             del self._slots[slot.sample_id]
                             self.consumed_this_epoch.add(slot.sample_id)
+                            self._mark_consumed(slot.sample_id)
                     ids = [s.sample_id for s in chosen]
                     # Restrict to the rpc's input keys: candidates may have
                     # heterogeneous extra keys (amended at different times),
@@ -223,6 +274,39 @@ class AsyncIOSequenceBuffer:
                     )
                     return ids, batch
                 await self._cond.wait()
+
+    def _mark_consumed(self, sample_id: str):
+        """A sample left the buffer fully consumed: once the LAST id of
+        its sequence goes, the seq is ledger-marked (and from then on
+        admission rejects it forever)."""
+        seq = self._id_seq.pop(sample_id, None)
+        if seq is None:
+            return
+        if seq in self.seq_ledger:
+            # A ledgered seq reached full consumption AGAIN — the
+            # exactly-once invariant broke somewhere upstream. Count it
+            # loudly; the kill-anywhere e2e asserts this stays 0.
+            self.counters["areal:train_samples_duplicated_total"] += 1
+            logger.error(
+                "sample %s of already-consumed seq %s trained twice",
+                sample_id, seq,
+            )
+        pending = self._seq_pending.get(seq)
+        if pending is not None:
+            pending.discard(sample_id)
+            if not pending:
+                del self._seq_pending[seq]
+                self.seq_ledger.mark(seq)
+
+    def consumed_seqs(self) -> Dict:
+        """Ledger snapshot for the recover record (checkpoint barrier)."""
+        return self.seq_ledger.to_dict()
+
+    def seed_consumed_seqs(self, snapshot: Optional[Dict]):
+        """Recovery: re-arm the ledger from the last durable snapshot so
+        WAL replay and pusher redelivery filter against the same cut the
+        engine state was taken at."""
+        self.seq_ledger = SeqLedger.from_dict(snapshot)
 
     async def poll_ready_count(self, rpc: MFCDef) -> int:
         async with self._cond:

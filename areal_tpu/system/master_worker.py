@@ -168,6 +168,12 @@ class MasterWorker(Worker):
         self.ckpt_ctl.load_state_dict(info.ckpt_ctl_info)
         self.eval_ctl.load_state_dict(info.eval_ctl_info)
         self.buffer.ignore_ids |= set(info.hash_vals_to_ignore)
+        # Re-arm the exactly-once ledger from the same durable cut the
+        # engine state was taken at: WAL replay and pusher redelivery
+        # of already-consumed sequences are filtered at admission.
+        # (getattr: a pre-ledger recover record unpickles without the
+        # field — dataclass defaults do not apply on unpickle.)
+        self.buffer.seed_consumed_seqs(getattr(info, "consumed_seqs", None))
         req = self.stream.request(
             self.cfg.data_hosts + self._all_model_workers(),
             "restore",
@@ -187,6 +193,12 @@ class MasterWorker(Worker):
             ckpt_ctl_info=self.ckpt_ctl.state_dict(),
             eval_ctl_info=self.eval_ctl.state_dict(),
             hash_vals_to_ignore=sorted(self.buffer.consumed_this_epoch),
+            # The consumed-seq watermark commits atomically WITH the
+            # step counters (one fsynced rename in recover.dump) — the
+            # exactly-once cut. Model workers compact their WALs against
+            # this record at the NEXT ckpt barrier (one-barrier lag:
+            # truncation is GC, safe to run behind).
+            consumed_seqs=self.buffer.consumed_seqs(),
         )
         recover.dump(info, self.cfg.experiment_name, self.cfg.trial_name)
 

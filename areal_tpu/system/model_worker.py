@@ -38,7 +38,7 @@ from areal_tpu.api.model_api import (
     make_model,
 )
 from areal_tpu.api.system_api import ModelWorkerConfig
-from areal_tpu.base import constants, env_registry, logging, metrics_registry, name_resolve, names, seeding, stats_tracker, timeutil, tracing
+from areal_tpu.base import constants, env_registry, logging, metrics_registry, name_resolve, names, recover, seeding, stats_tracker, timeutil, tracing
 from areal_tpu.system import eval_scores
 from areal_tpu.system import request_reply_stream as rrs
 from areal_tpu.system.data_manager import DataManager
@@ -439,9 +439,43 @@ class ModelWorker(Worker):
                 f"dataloader_{self.cfg.worker_index}.json",
             )
             os.makedirs(os.path.dirname(state_path), exist_ok=True)
-            with open(state_path, "w") as f:
+            # Atomic like every other recovery artifact: a kill
+            # mid-write must leave the previous cursor, not a torn file.
+            tmp = state_path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
                 json.dump(self.dataloader.state_dict(), f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, state_path)
+        self._compact_stream_wal()
         return {"ok": True}
+
+    def _compact_stream_wal(self):
+        """Checkpoint-barrier WAL truncation, one barrier behind: drop
+        journaled rollouts whose seqs the PREVIOUS durable recover
+        record already marked consumed. (The record for THIS barrier is
+        written by the master after this handler returns; compacting
+        against the previous one keeps truncation strictly behind the
+        durable ledger — it is GC, safe to lag, never safe to lead.)"""
+        dataset = self._dataset
+        if dataset is None or not hasattr(dataset, "compact_wal"):
+            return
+        try:
+            info = recover.load(self.cfg.experiment_name, self.cfg.trial_name)
+        except (FileNotFoundError, ValueError):
+            return
+        from areal_tpu.system.wal import SeqLedger
+
+        snapshot = getattr(info, "consumed_seqs", None)
+        if not snapshot:
+            return
+        try:
+            dropped = dataset.compact_wal(SeqLedger.from_dict(snapshot))
+            if dropped:
+                logger.info("WAL compaction dropped %d consumed record(s)",
+                            dropped)
+        except Exception:
+            logger.exception("WAL compaction failed (journal kept as-is)")
 
     def _handle_restore(self, req):
         from areal_tpu.engine.checkpoint import has_engine_state
@@ -524,6 +558,14 @@ class ModelWorker(Worker):
         return PollResult(batch_count=1)
 
     def _exit_hook(self):
+        try:
+            # A clean exit must not abandon an in-flight async
+            # checkpoint write (the daemon writer dies with the process).
+            from areal_tpu.engine.checkpoint import wait_pending_writes
+
+            wait_pending_writes(timeout=60)
+        except Exception:
+            logger.exception("pending checkpoint writes not drained on exit")
         try:
             for src in getattr(self, "_wp_sources", {}).values():
                 src.close()

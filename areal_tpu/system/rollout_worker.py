@@ -22,7 +22,7 @@ from areal_tpu.api import data_api
 from areal_tpu.api.agent_api import make_agent
 from areal_tpu.api.env_api import make_env
 from areal_tpu.api.system_api import RolloutWorkerConfig
-from areal_tpu.base import constants, logging, name_resolve, names, rpc, seeding, tracing
+from areal_tpu.base import constants, env_registry, logging, name_resolve, names, rpc, seeding, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system import eval_scores
 from areal_tpu.system.partial_rollout import PartialRolloutManager
@@ -54,6 +54,9 @@ class RolloutWorker(AsyncWorker):
     # running _configure.
     _mgr_fails = 0
     _mgr_policy: Optional[rpc.RetryPolicy] = None
+    # Ack/seq mode stays off for partial workers too: their hand-built
+    # pushers have no ack socket to drain.
+    _wal_acks = False
 
     @property
     def pending_scores(self) -> Dict[str, float]:
@@ -125,17 +128,24 @@ class RolloutWorker(AsyncWorker):
                 )
             ),
         )
+        # Ack mode rides the WAL switch: with the durable plane armed,
+        # every trajectory carries a minted sequence id and stays in the
+        # pusher's unacked window until the trainer journals it.
+        self._wal_acks = env_registry.get_bool("AREAL_WAL")
         self.pusher = NameResolvingZmqPusher(
             config.experiment_name,
             config.trial_name,
             pusher_index=config.worker_index,
             n_pushers=config.n_rollout_workers,
             n_pullers=config.n_pullers,
+            ack=self._wal_acks,
         )
         self._session: Optional[aiohttp.ClientSession] = None
         self._tasks: Dict[str, asyncio.Task] = {}
         self._push_count = 0
         self._episode_counter = itertools.count()
+        self._seq_counter = itertools.count()
+        self._last_redeliver = 0.0
         self._mgr_policy = rpc.rediscovery_policy()
         self._mgr_fails = 0
         logger.info(
@@ -292,7 +302,11 @@ class RolloutWorker(AsyncWorker):
                 )
                 if ep is not None:
                     t.metadata["trace_ctx"] = [ep.ctx.to_dict()] * t.bs
-                self.pusher.push(data_api.sample_to_json(t))
+                seq = (
+                    f"{self.cfg.worker_index}/{next(self._seq_counter)}"
+                    if self._wal_acks else None
+                )
+                self.pusher.push(data_api.sample_to_json(t), seq=seq)
                 self._push_count += 1
             accepted = bool(trajs)
         except Exception:
@@ -350,6 +364,35 @@ class RolloutWorker(AsyncWorker):
             elif not t.cancelled() and t.exception() is not None:
                 logger.error(f"episode task {k} died", exc_info=t.exception())
         self._tasks = live
+
+        if self._wal_acks:
+            self.pusher.drain_acks()
+            if self.pusher.unacked():
+                # Samples past the ack timeout mean the trainer died (or
+                # is wedged) before journaling them. A restarted puller
+                # re-registers under the same stream name on a NEW port,
+                # so re-resolve (file I/O — executor, same rule as the
+                # status gate above) and re-target before re-sending.
+                # Sockets stay loop-thread-only: reconnect/redeliver run
+                # inline here, never on the executor.
+                now = time.monotonic()
+                if now - self._last_redeliver >= 1.0:
+                    self._last_redeliver = now
+                    try:
+                        addr = await loop.run_in_executor(
+                            None,
+                            lambda: name_resolve.get(self.pusher.stream_key),
+                        )
+                    except name_resolve.NameEntryNotFoundError:
+                        addr = None
+                    if addr:
+                        host, port = addr.rsplit(":", 1)
+                        self.pusher.reconnect(host, int(port))
+                        n = self.pusher.redeliver()
+                        if n:
+                            logger.warning(
+                                "redelivered %d unacked trajectory(ies)", n
+                            )
 
         if len(self._tasks) >= self.cfg.max_concurrent_rollouts:
             await asyncio.sleep(0.02)

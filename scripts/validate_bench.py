@@ -60,6 +60,17 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "hedged_p50_ms", "hedged_p99_ms",
         "hedge_wins", "hedge_cancelled", "hedge_failures",
     ),
+    # Durable-plane evidence is only evidence when nothing was lost OR
+    # double-trained along the way and the async arm actually bought
+    # its stall reduction: a fast MTTR next to a nonzero loss counter
+    # is a broken plane with a good-looking timing.
+    "recovery_slo": (
+        "state_mb", "n_ckpt_saves",
+        "sync_stall_ms_mean", "async_stall_ms_mean",
+        "async_stall_saved_frac", "mttr_ms",
+        "wal_records", "wal_replayed", "redelivered",
+        "samples_lost", "samples_duplicated",
+    ),
     # Quantized-wire evidence without its dequant-parity check field is
     # not evidence: a record could bank a great ingress number off a
     # stream that assembles to garbage weights.
@@ -516,6 +527,48 @@ def _validate_rpc_resilience(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_recovery_slo(val: Dict) -> List[str]:
+    """The durable-plane contract (ISSUE 16 acceptance): the recovery
+    path must have a measured MTTR, the exactly-once ledger must show
+    ZERO lost and ZERO duplicated samples even though redelivery and
+    WAL replay were actually exercised, and the async checkpoint arm's
+    caller stall must be measurably below the sync arm's — otherwise
+    the background writer bought nothing."""
+    problems: List[str] = []
+    mttr = _num(val, "mttr_ms")
+    if mttr is None or mttr <= 0:
+        problems.append(
+            f"recovery_slo: mttr_ms = {mttr} — no measured recovery "
+            f"path, the SLO record is empty"
+        )
+    for k in ("samples_lost", "samples_duplicated"):
+        v = _num(val, k)
+        if v is None or v > 0:
+            problems.append(
+                f"recovery_slo: {k} = {v} — exactly-once means zero, "
+                f"a durable plane that loses or double-trains samples "
+                f"is broken regardless of its timings"
+            )
+    if (_num(val, "wal_replayed") or 0) < 1:
+        problems.append(
+            "recovery_slo: zero WAL records replayed — the MTTR number "
+            "never exercised the journal"
+        )
+    if (_num(val, "redelivered") or 0) < 1:
+        problems.append(
+            "recovery_slo: zero redeliveries — the exactly-once "
+            "counters were never put under stress"
+        )
+    sync_ms = _num(val, "sync_stall_ms_mean")
+    async_ms = _num(val, "async_stall_ms_mean")
+    if sync_ms is None or async_ms is None or async_ms >= sync_ms:
+        problems.append(
+            f"recovery_slo: async stall {async_ms} ms not below sync "
+            f"stall {sync_ms} ms — the background writer bought nothing"
+        )
+    return problems
+
+
 # Parity ceiling for kernel_micro cases: impls reassociate float32
 # sums, so agreement is ~1e-7..1e-6 relative (ops/gae docstring); a
 # case past this diverged, it didn't round.
@@ -683,6 +736,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_fleet_elastic(val))
     if name == "rpc_resilience":
         problems.extend(_validate_rpc_resilience(val))
+    if name == "recovery_slo":
+        problems.extend(_validate_recovery_slo(val))
     if name in KMICRO_CASE_PHASES:
         problems.extend(_validate_kmicro_cases(name, val))
     if name == "kernel_micro_decode_state":

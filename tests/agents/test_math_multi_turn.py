@@ -137,7 +137,7 @@ def test_rollout_worker_service_gen_loops():
             )
 
     class StubPusher:
-        def push(self, payload):
+        def push(self, payload, seq=None):
             pushed.append(payload)
 
     w = RolloutWorker.__new__(RolloutWorker)

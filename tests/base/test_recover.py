@@ -35,6 +35,61 @@ def test_dump_load_roundtrip(recover_root):
     assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
 
 
+def test_dump_load_roundtrip_durable_plane_fields(recover_root):
+    """ISSUE 16 pins: the exactly-once ledger snapshot and per-dataset
+    cursors ride the recover record and round-trip exactly."""
+    info = RecoverInfo(
+        last_step_info=StepInfo(epoch=0, epoch_step=7, global_step=7),
+        consumed_seqs={"water": {"w0": 4, "w1": 1}, "extras": {"w0": [7]}},
+        dataset_cursors={"model_worker/0": {"epoch": 0, "offset": 64}},
+    )
+    recover.dump(info, EXP, TRIAL)
+    loaded = recover.load(EXP, TRIAL)
+    assert loaded.consumed_seqs == info.consumed_seqs
+    assert loaded.dataset_cursors == info.dataset_cursors
+    assert loaded == info
+
+
+def test_dump_is_schema_versioned(recover_root):
+    import pickle
+
+    recover.dump(RecoverInfo(), EXP, TRIAL)
+    with open(recover.dump_path(EXP, TRIAL), "rb") as f:
+        payload = pickle.load(f)
+    assert payload["schema"] == "areal-recover-info/v1"
+    assert isinstance(payload["info"], RecoverInfo)
+
+
+def test_load_accepts_legacy_raw_record(recover_root):
+    """Pre-schema records (a bare pickled RecoverInfo) still load — a
+    rolling upgrade must not strand an older trial's recover state."""
+    import pickle
+
+    info = RecoverInfo(data_loading_dp_idx=2)
+    path = recover.dump_path(EXP, TRIAL)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(info, f)
+    assert recover.load(EXP, TRIAL) == info
+
+
+def test_load_rejects_unknown_schema(recover_root):
+    import pickle
+
+    path = recover.dump_path(EXP, TRIAL)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump({"schema": "areal-recover-info/v999", "info": None}, f)
+    with pytest.raises(ValueError, match="unsupported recover-info schema"):
+        recover.load(EXP, TRIAL)
+
+
+def test_dump_leaves_no_tmp_litter(recover_root):
+    recover.dump(RecoverInfo(), EXP, TRIAL)
+    d = os.path.dirname(recover.dump_path(EXP, TRIAL))
+    assert not [f for f in os.listdir(d) if ".tmp." in f]
+
+
 def test_load_without_dump_raises(recover_root):
     with pytest.raises(FileNotFoundError):
         recover.load(EXP, "no-such-trial")

@@ -1,0 +1,196 @@
+"""Child trainer process for the kill-anywhere durable-plane e2e.
+
+One incarnation of a minimal-but-REAL training data plane: a
+PullerStreamDataset (ZMQ pull + rollout WAL) feeding an
+AsyncIOSequenceBuffer (exactly-once seq ledger) feeding a trivially
+verifiable "training" step — a fold over the integer encoded in each
+sample id. Checkpoints go through the real engine-checkpoint machinery
+(`save_engine_state` manifest commit, async writer when
+AREAL_CKPT_ASYNC), each barrier into a fresh version directory, with
+the ledger snapshot riding `dataset_cursors` so fold state and
+consumed-cut commit ATOMICALLY (one manifest rename covers both).
+
+The parent arms AREAL_FAULTS `die` actions at the declared points and
+SIGKILL-respawns this process until a clean run completes; because the
+fold is exact integer arithmetic, "every sample trained exactly once"
+is a single equality at the end — any lost or duplicated sample across
+any kill shifts the sum.
+
+Run: python tests/system/durable_harness.py '<json spec>'
+Spec keys: nr_root, exp, trial, ckpt_root, recover_root, progress_path,
+result_path, n_total, batch, ckpt_every.
+"""
+
+import json
+import os
+import sys
+
+
+class FoldEngine:
+    """The smallest engine the checkpoint path accepts: params is the
+    fold accumulator [sum, count], REPLACED (never mutated) per step so
+    async snapshot references stay crash-consistent."""
+
+    def __init__(self):
+        import numpy as np
+
+        self.params = {"fold": np.zeros(2, dtype=np.float64)}
+        self.opt_state = None
+        self.version = 0
+
+    def set_params(self, params):
+        self.params = params
+
+    def fold(self, values):
+        import numpy as np
+
+        f = self.params["fold"]
+        self.params = {
+            "fold": np.array(
+                [f[0] + sum(values), f[1] + len(values)], dtype=np.float64
+            )
+        }
+
+
+def latest_committed(ckpt_root):
+    """Newest version directory with a COMMITTED manifest — a kill
+    mid-save leaves a manifest-less directory recovery must skip."""
+    from areal_tpu.engine.checkpoint import load_manifest
+
+    if not os.path.isdir(ckpt_root):
+        return None, None
+    for step in sorted(
+        (d for d in os.listdir(ckpt_root) if d.isdigit()),
+        key=int, reverse=True,
+    ):
+        d = os.path.join(ckpt_root, step)
+        man = load_manifest(d)
+        if man is not None:
+            return d, man
+    return None, None
+
+
+def run(spec):
+    import asyncio
+
+    from areal_tpu.api.config import ModelName
+    from areal_tpu.api.dfg import MFCDef, ModelInterfaceType, build_graph
+    from areal_tpu.base import constants, name_resolve, recover
+    from areal_tpu.base.recover import RecoverInfo, StepInfo
+    from areal_tpu.engine import checkpoint
+    from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+    from areal_tpu.system.stream_dataset import PullerStreamDataset
+    from areal_tpu.system.wal import SeqLedger
+
+    name_resolve.reconfigure("nfs", record_root=spec["nr_root"])
+    constants.RECOVER_ROOT = spec["recover_root"]
+    exp, trial = spec["exp"], spec["trial"]
+    ckpt_root = spec["ckpt_root"]
+    progress = open(spec["progress_path"], "a")
+
+    def log(event, **kw):
+        progress.write(json.dumps({"event": event, **kw}) + "\n")
+        progress.flush()
+
+    train = MFCDef(
+        name="train",
+        model_name=ModelName("actor", 0),
+        interface_type=ModelInterfaceType.TRAIN_STEP,
+        interface_impl=None,
+        n_seqs=spec["batch"],
+        input_keys=("packed_prompts",),
+        output_keys=(),
+    )
+    build_graph([train])
+
+    eng = FoldEngine()
+    buf = AsyncIOSequenceBuffer([train])
+
+    # -- recovery: the committed manifest is the single source of truth
+    # for BOTH fold state and the consumed-seq cut.
+    ckpt_dir, man = latest_committed(ckpt_root)
+    if ckpt_dir is not None:
+        checkpoint.load_engine_state(eng, ckpt_dir)
+        cursors = man.get("dataset_cursors") or {}
+        buf.seed_consumed_seqs(cursors.get("consumed_seqs"))
+
+    # Constructing the dataset replays the WAL (admission dedup against
+    # the seeded ledger makes over-replay harmless).
+    ds = PullerStreamDataset(exp, trial, puller_index=0)
+    log("resume", version=eng.version,
+        count=int(eng.params["fold"][1]),
+        replayed=ds.counters["areal:train_wal_replayed_total"])
+
+    def barrier():
+        eng.version += 1
+        snap = buf.consumed_seqs()
+        d = os.path.join(ckpt_root, str(eng.version))
+        # One atomic commit point (the manifest rename) covers fold
+        # state AND the ledger cut it was taken at.
+        checkpoint.save_engine_state(
+            eng, d, dataset_cursors={"consumed_seqs": snap}
+        )
+        # The recover record rides the same snapshot (master-worker
+        # parity: test asserts it stays loadable + schema-versioned).
+        recover.dump(
+            RecoverInfo(
+                last_step_info=StepInfo(global_step=eng.version),
+                consumed_seqs=snap,
+            ),
+            exp, trial,
+        )
+        # WAL truncation must never LEAD durable state: compact against
+        # the newest manifest actually committed on disk (with the
+        # async writer that can lag the snapshot just taken — safe, GC
+        # only).
+        _, committed = latest_committed(ckpt_root)
+        dropped = 0
+        if committed is not None:
+            cur = committed.get("dataset_cursors") or {}
+            dropped = ds.compact_wal(
+                SeqLedger.from_dict(cur.get("consumed_seqs"))
+            )
+        log("barrier", version=eng.version,
+            count=int(eng.params["fold"][1]),
+            wal_dropped=dropped,
+            dup=buf.counters["areal:train_samples_duplicated_total"])
+
+    async def train_loop():
+        steps = 0
+        while int(eng.params["fold"][1]) < spec["n_total"]:
+            batch = ds.poll_batch(max_samples=spec["batch"] * 2)
+            if batch is not None:
+                await buf.put_batch([batch])
+            if await buf.poll_ready_count(train) >= train.n_seqs:
+                ids, _ = await buf.get_batch_for_rpc(train)
+                eng.fold([int(i[1:]) for i in ids])  # ids are "s<int>"
+                steps += 1
+                if steps % spec["ckpt_every"] == 0:
+                    barrier()
+            else:
+                await asyncio.sleep(0.01)
+        barrier()  # the final cut
+        checkpoint.wait_pending_writes(timeout=60)
+
+    asyncio.run(train_loop())
+    # Give the WAL's deferred acks one idle cycle to flush, then report.
+    result = {
+        "fold_sum": float(eng.params["fold"][0]),
+        "count": int(eng.params["fold"][1]),
+        "version": eng.version,
+        "replayed": ds.counters["areal:train_wal_replayed_total"],
+        "stream_dup_dropped": ds.counters["areal:train_wal_dup_dropped_total"],
+        "ledger_filtered": buf.n_ledger_filtered,
+        "duplicated_total": buf.counters["areal:train_samples_duplicated_total"],
+    }
+    tmp = spec["result_path"] + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, spec["result_path"])
+    log("done", **result)
+    ds.close()
+    progress.close()
+
+
+if __name__ == "__main__":
+    run(json.loads(sys.argv[1]))

@@ -19,13 +19,13 @@ def _sample(i, keys=("packed_prompts",), seqlen=4):
     )
 
 
-def _rpcs():
+def _rpcs(n_seqs=2):
     gen = MFCDef(
         name="gen",
         model_name=ModelName("actor", 0),
         interface_type=ModelInterfaceType.GENERATE,
         interface_impl=None,
-        n_seqs=2,
+        n_seqs=n_seqs,
         input_keys=("packed_prompts",),
         output_keys=("seq", "logp"),
     )
@@ -34,7 +34,7 @@ def _rpcs():
         model_name=ModelName("actor", 1),
         interface_type=ModelInterfaceType.TRAIN_STEP,
         interface_impl=None,
-        n_seqs=2,
+        n_seqs=n_seqs,
         input_keys=("seq", "logp"),
         output_keys=(),
     )
@@ -121,6 +121,113 @@ def test_duplicate_id_semantics():
         assert buf.size == 1
 
     asyncio.run(run())
+
+
+def _seq_sample(i, seq, keys=("packed_prompts",), seqlen=4):
+    data = {k: np.arange(seqlen, dtype=np.int32) for k in keys}
+    return SequenceSample.from_default(
+        ids=[f"s{i}"], seqlens=[seqlen], data=data,
+        metadata={"wal_seq": [seq]},
+    )
+
+
+async def _consume_fully(buf, gen, train, n=2):
+    ids, _ = await buf.get_batch_for_rpc(gen)
+    out = SequenceSample.from_default(
+        ids=ids, seqlens=[5] * len(ids),
+        data={
+            "seq": np.zeros(5 * len(ids), dtype=np.int32),
+            "logp": np.zeros(5 * len(ids), dtype=np.float32),
+        },
+    )
+    await buf.amend_batch(out)
+    await buf.get_batch_for_rpc(train)
+    return ids
+
+
+def test_seq_ledger_blocks_redelivery_after_consumption():
+    """ISSUE 16 exactly-once pin: a redelivered/replayed sample whose
+    seq was fully consumed is dropped at admission — it trains exactly
+    once, and the duplicate-consumption DETECTOR stays 0."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_seq_sample(0, "w0/0"), _seq_sample(1, "w0/1")])
+        await _consume_fully(buf, gen, train)
+        assert buf.size == 0
+        assert "w0/0" in buf.seq_ledger and "w0/1" in buf.seq_ledger
+        # Pusher redelivery of the same seqs (same OR different ids):
+        n = await buf.put_batch(
+            [_seq_sample(0, "w0/0"), _seq_sample(9, "w0/1")]
+        )
+        assert n == 0
+        assert buf.n_ledger_filtered == 2
+        assert buf.counters["areal:train_samples_duplicated_total"] == 0
+
+    asyncio.run(main())
+
+
+def test_seq_pending_blocks_readmission_under_new_id():
+    """A redelivered copy of a RESIDENT seq under a different sample id
+    must not slip past the resident-id dedup — the pending-seq check
+    catches it."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_seq_sample(0, "w0/0")])
+        # Same seq, different id: dropped at admission.
+        n = await buf.put_batch([_seq_sample(7, "w0/0")])
+        assert n == 0 and buf.n_ledger_filtered == 1
+        # Same seq, SAME id: the resident-duplicate path (counted there).
+        n = await buf.put_batch([_seq_sample(0, "w0/0")])
+        assert n == 0 and buf.n_dropped_duplicates == 1
+        assert buf.size == 1
+
+    asyncio.run(main())
+
+
+def test_seeded_ledger_filters_wal_replay():
+    """Recovery: the ledger snapshot from the recover record re-arms
+    admission, so WAL replay of already-consumed seqs is filtered
+    against the same cut the engine state was taken at."""
+    gen, train = _rpcs(n_seqs=1)
+    buf = AsyncIOSequenceBuffer([gen, train])
+    buf.seed_consumed_seqs({"water": {"w0": 0}, "extras": {"w0": [2]}})
+
+    async def main():
+        n = await buf.put_batch([
+            _seq_sample(0, "w0/0"),  # below watermark: consumed pre-kill
+            _seq_sample(1, "w0/1"),  # the gap: NOT consumed, admitted
+            _seq_sample(2, "w0/2"),  # extra: consumed pre-kill
+        ])
+        assert n == 1
+        assert buf.n_ledger_filtered == 2
+        ids = await _consume_fully(buf, gen, train)
+        assert ids == ["s1"]
+        # The next barrier's snapshot now covers all three.
+        snap = buf.consumed_seqs()
+        assert snap == {"water": {"w0": 2}, "extras": {}}
+
+    asyncio.run(main())
+
+
+def test_samples_without_seq_bypass_ledger():
+    """Dataset-sourced samples (no wal_seq metadata) never touch the
+    ledger — exactly-once for them stays the ignore_ids contract."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_sample(0), _sample(1)])
+        await _consume_fully(buf, gen, train)
+        assert buf.consumed_seqs() == {"water": {}, "extras": {}}
+        # Epoch 2 re-put of the same row ids is legal.
+        n = await buf.put_batch([_sample(0), _sample(1)])
+        assert n == 2
+
+    asyncio.run(main())
 
 
 def test_overflow_precheck_counts_unique_ids():

@@ -424,6 +424,89 @@ def _drive_master_step(tmp_path, monkeypatch):
     _fired("master.step")
 
 
+@_fast("train.checkpoint")
+def _drive_train_checkpoint(tmp_path, monkeypatch):
+    """The trainer dies at the checkpoint COMMIT point (between the
+    manifest tmp-write and its rename): the new manifest must not be
+    half-committed — the directory either still lacks a manifest (this
+    first-save case) or keeps the previous complete one — and the retry
+    (what recovery's next barrier does) commits cleanly."""
+    import numpy as np
+
+    from areal_tpu.engine import checkpoint
+
+    class _Eng:
+        version = 3
+        params = {"w": np.zeros(4, dtype=np.float32)}
+        opt_state = None
+
+    d = str(tmp_path / "ckpt")
+    monkeypatch.setenv("AREAL_CKPT_BACKEND", "pickle")
+    faults.arm("train.checkpoint", action="raise", at_hit=1, times=1)
+    with pytest.raises(FaultInjected):
+        checkpoint.save_engine_state(_Eng(), d)
+    _fired("train.checkpoint")
+    # Kill at the commit point: NO manifest — the checkpoint does not
+    # exist yet (recovery keeps using the previous complete one).
+    assert checkpoint.load_manifest(d) is None
+    checkpoint.save_engine_state(_Eng(), d)  # the retry commits
+    man = checkpoint.load_manifest(d)
+    assert man is not None and man["version"] == 3
+
+
+@_fast("buffer.wal_append")
+def _drive_wal_append(tmp_path, monkeypatch):
+    """A WAL append dies before the record hits the journal: the sample
+    was never acked, so the pusher redelivers it — the journal itself
+    stays intact and later appends land cleanly."""
+    from areal_tpu.system.wal import RolloutWAL
+
+    path = str(tmp_path / "w.wal")
+    wal = RolloutWAL(path, fsync_ms=0)
+    assert wal.replay() == []
+    faults.arm("buffer.wal_append", action="raise", at_hit=1, times=1)
+    with pytest.raises(FaultInjected):
+        wal.append({"seq": "p/0", "data": {"x": 1}})
+    _fired("buffer.wal_append")
+    wal.append({"seq": "p/1", "data": {"x": 2}})  # journal still works
+    wal.close()
+    wal2 = RolloutWAL(path, fsync_ms=0)
+    try:
+        # Only the journaled record survives; the injected one is the
+        # pusher-redelivery case, not a WAL case.
+        assert [r["seq"] for r in wal2.replay()] == ["p/1"]
+    finally:
+        wal2.close()
+
+
+@_fast("buffer.consume")
+def _drive_buffer_consume(tmp_path, monkeypatch):
+    """The master dies handing a batch to training (the window the seq
+    ledger exists for: consumed-watermark not yet durable). The fault
+    fires BEFORE consumption is recorded, so nothing is marked consumed
+    — on restart WAL replay re-admits and the batch trains exactly
+    once."""
+    from areal_tpu.system.buffer import AsyncIOSequenceBuffer
+    from tests.system.test_buffer import _rpcs, _sample
+
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def drive():
+        await buf.put_batch([_sample(0), _sample(1)])
+        with pytest.raises(FaultInjected):
+            await buf.get_batch_for_rpc(gen)
+        _fired("buffer.consume")
+        # Nothing consumed by the aborted hand-off: the retry gets the
+        # full batch and the ledger stays clean.
+        ids, _ = await buf.get_batch_for_rpc(gen)
+        assert ids == ["s0", "s1"]
+        assert buf.counters["areal:train_samples_duplicated_total"] == 0
+
+    faults.arm("buffer.consume", action="raise", at_hit=1, times=1)
+    asyncio.run(drive())
+
+
 @_fast("bench.runner.phase")
 def _drive_bench_phase(tmp_path, monkeypatch):
     """A bench phase subprocess crashes: the parent banks an honest

@@ -1,0 +1,197 @@
+"""Kill-anywhere recovery e2e (ISSUE 16 tentpole): SIGKILL-equivalent
+faults (`die` = os._exit) at every durable-plane point — WAL append,
+buffer consume, checkpoint manifest commit — while a live pusher keeps
+feeding samples, then a clean incarnation finishes the run.
+
+The trainer child (tests/system/durable_harness.py) folds the integer in
+each sample id, so exactly-once is ONE equality at the end: the fold sum
+over n samples trained exactly once is n*(n-1)/2. Any loss or duplicate
+across any kill shifts it. The parent plays the rollout side with a
+single ack-enabled pusher surviving all child incarnations: unacked
+samples are redelivered to each restarted puller, and the child's
+WAL + seq ledger must make that redelivery storm invisible to training.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.data_api import SequenceSample, sample_to_json
+from areal_tpu.base import name_resolve, recover
+from areal_tpu.system import push_pull_stream as pps
+from tests import fixtures
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+HARNESS = os.path.join(REPO, "tests", "system", "durable_harness.py")
+
+pytestmark = [pytest.mark.serial, pytest.mark.chaos]
+
+N_TOTAL = 24
+BATCH = 4
+
+# One incarnation per fault point, then a clean run to drain. k values
+# are chosen so each incarnation makes SOME progress before dying (the
+# interesting recoveries are mid-stream, not at-start).
+KILL_PLAN = [
+    "buffer.wal_append=die:k=5",
+    "buffer.consume=die:k=2",
+    "train.checkpoint=die:k=2",
+    "",
+]
+
+
+def _payloads():
+    out = []
+    for i in range(N_TOTAL):
+        s = SequenceSample.from_default(
+            ids=[f"s{i}"], seqlens=[4],
+            data={"packed_prompts": np.arange(4, dtype=np.int32)},
+        )
+        out.append(sample_to_json(s))
+    return out
+
+
+def _progress_events(path):
+    """Torn-tolerant JSONL parse — the child can die mid-write."""
+    events = []
+    if not os.path.exists(path):
+        return events
+    with open(path) as f:
+        for line in f:
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events
+
+
+@pytest.mark.timeout(900)
+def test_kill_anywhere_trains_every_sample_exactly_once(tmp_path, monkeypatch):
+    nr = str(tmp_path / "nr")
+    recover_root = str(tmp_path / "recover")
+    exp, trial = f"durable-{uuid.uuid4().hex[:6]}", "t0"
+    name_resolve.reconfigure("nfs", record_root=nr)
+
+    spec = {
+        "nr_root": nr,
+        "exp": exp,
+        "trial": trial,
+        "ckpt_root": str(tmp_path / "ckpt"),
+        "recover_root": recover_root,
+        "progress_path": str(tmp_path / "progress.jsonl"),
+        "result_path": str(tmp_path / "result.json"),
+        "n_total": N_TOTAL,
+        "batch": BATCH,
+        "ckpt_every": 1,
+    }
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["AREAL_WAL"] = "1"
+    env["AREAL_CKPT_ASYNC"] = "1"
+    env["AREAL_CKPT_BACKEND"] = "pickle"
+    env["AREAL_WAL_FSYNC_MS"] = "5"
+    env.pop("AREAL_FAULTS", None)
+
+    payloads = _payloads()
+    n_pushed = 0
+    pusher = None
+    exits = []
+    logs = []
+    try:
+        for incarnation, faults_spec in enumerate(KILL_PLAN):
+            child_env = dict(env)
+            if faults_spec:
+                child_env["AREAL_FAULTS"] = faults_spec
+            log_path = tmp_path / f"child{incarnation}.log"
+            logs.append(log_path)
+            with open(log_path, "w") as log_f:
+                proc = subprocess.Popen(
+                    [sys.executable, HARNESS, json.dumps(spec)],
+                    env=child_env, cwd=REPO,
+                    stdout=log_f, stderr=subprocess.STDOUT,
+                )
+            try:
+                if pusher is None:
+                    # Blocks until the first incarnation's puller
+                    # registers; later incarnations re-register the same
+                    # name and re_resolve() below follows them.
+                    pusher = pps.NameResolvingZmqPusher(
+                        exp, trial, pusher_index=0, n_pushers=1,
+                        n_pullers=1, ack=True,
+                    )
+                deadline = time.monotonic() + fixtures.scale_timeout(180)
+                while proc.poll() is None:
+                    assert time.monotonic() < deadline, (
+                        f"incarnation {incarnation} "
+                        f"({faults_spec or 'clean'}) hung:\n"
+                        + log_path.read_text()[-3000:]
+                    )
+                    while n_pushed < len(payloads):
+                        pusher.push(payloads[n_pushed], seq=f"p0/{n_pushed}")
+                        n_pushed += 1
+                    pusher.drain_acks()
+                    if pusher.unacked():
+                        # Follow the (possibly restarted) puller, then
+                        # re-send anything unacked past the timeout —
+                        # the child's dedup must absorb the storm.
+                        pusher.re_resolve(timeout=0.2)
+                        pusher.redeliver(timeout_s=0.5)
+                    time.sleep(0.05)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+            exits.append(proc.returncode)
+            if os.path.exists(spec["result_path"]):
+                break
+
+        # The three fault'd incarnations died; the clean one finished.
+        assert len(exits) == len(KILL_PLAN), exits
+        assert all(code != 0 for code in exits[:-1]), (exits, KILL_PLAN)
+        assert exits[-1] == 0, (
+            exits, logs[-1].read_text()[-3000:]
+        )
+
+        with open(spec["result_path"]) as f:
+            result = json.load(f)
+
+        # THE invariant: every sample trained exactly once, across three
+        # kills, redelivery, and WAL replay.
+        assert result["count"] == N_TOTAL
+        assert result["fold_sum"] == float(sum(range(N_TOTAL)))
+        # The duplicate-consumption DETECTOR (not the prevention
+        # counters) must be zero.
+        assert result["duplicated_total"] == 0
+
+        # Transport: nothing dropped from the unacked window.
+        assert pusher.counters["areal:train_samples_lost_total"] == 0
+
+        # Recovery actually happened: later incarnations resumed from
+        # journaled state (this fails if the WAL silently lost its job).
+        events = _progress_events(spec["progress_path"])
+        resumes = [e for e in events if e["event"] == "resume"]
+        assert len(resumes) == len(exits)
+        assert resumes[0]["count"] == 0
+        assert sum(e["replayed"] for e in resumes) > 0
+        assert all(
+            e["dup"] == 0 for e in events if e["event"] == "barrier"
+        )
+
+        # The recover record rides the same snapshot discipline.
+        from areal_tpu.base import constants
+
+        monkeypatch.setattr(constants, "RECOVER_ROOT", recover_root)
+        info = recover.load(exp, trial)
+        assert info.last_step_info.global_step == result["version"]
+        water = (info.consumed_seqs or {}).get("water", {})
+        assert water.get("p0") == N_TOTAL - 1  # ledger covers every seq
+    finally:
+        if pusher is not None:
+            pusher.close()

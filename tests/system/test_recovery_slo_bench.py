@@ -1,0 +1,99 @@
+"""ISSUE 16 acceptance (bench leg): the `recovery_slo` phase banks an
+attested CPU-proxy record for the durable training plane — the
+async-vs-sync checkpoint-stall A/B, cold-recovery MTTR (manifest +
+engine state + WAL replay against the checkpointed ledger cut), and
+exactly-once accounting under a forced redelivery storm — and
+`validate_bench.py` refuses records with ANY lost or duplicated sample,
+a missing/empty MTTR, an unexercised WAL or redelivery path, or an
+async stall that isn't measurably below the sync stall.
+
+Time budget: the phase itself is ~2 s of host-side pickle + loopback
+ZMQ (tier-1); the validator-teeth test is milliseconds.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_record():
+    """A well-formed recovery_slo value (what a healthy run banks)."""
+    return {
+        "state_mb": 16.0,
+        "n_ckpt_saves": 8.0,
+        "sync_stall_ms_mean": 40.0,
+        "async_stall_ms_mean": 0.2,
+        "async_stall_saved_frac": 0.995,
+        "mttr_ms": 110.0,
+        "wal_records": 256.0,
+        "wal_replayed": 128.0,
+        "redelivered": 32.0,
+        "samples_lost": 0.0,
+        "samples_duplicated": 0.0,
+    }
+
+
+def test_validator_teeth_for_recovery_slo():
+    validator = _load_validator()
+
+    def problems(**mut):
+        val = {**_fake_record(), **mut}
+        rec = {"status": "ok", "pass": "measure", "value": val}
+        return validator.validate_phase_value("recovery_slo", rec)
+
+    assert problems() == []
+    # Exactly-once means ZERO — timings next to losses are worthless.
+    assert problems(samples_lost=1.0)
+    assert problems(samples_duplicated=1.0)
+    # No measured recovery path: the SLO record is empty.
+    assert problems(mttr_ms=0.0)
+    # The journal / redelivery path was never actually exercised.
+    assert problems(wal_replayed=0.0)
+    assert problems(redelivered=0.0)
+    # The background writer bought nothing.
+    assert problems(async_stall_ms_mean=45.0)
+    # Schema: every declared key must be present and numeric.
+    incomplete = _fake_record()
+    del incomplete["mttr_ms"]
+    rec = {"status": "ok", "pass": "measure", "value": incomplete}
+    assert validator.validate_phase_value("recovery_slo", rec)
+
+
+def test_recovery_slo_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import recovery_slo_phase
+
+    assert recovery_slo_phase("compile") == {"compile_s": 0.0}
+    val = recovery_slo_phase("measure")
+    path = bank.write_record(
+        bank.make_record("recovery_slo", "measure", "ok", value=val), b
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("recovery_slo", rec) == []
+    assert validator.validate_bank_dir(b) == []

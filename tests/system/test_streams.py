@@ -97,6 +97,116 @@ def test_push_pull_grouping():
     assert sorted(sum(g.values(), [])) == list(range(7))
 
 
+def test_push_ack_roundtrip():
+    """ISSUE 16 exactly-once transport: a pushed sample sits in the
+    unacked window until the puller acks it durable; the seq/ack keys
+    never leak into the delivered payload."""
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port, ack=True)
+    try:
+        pusher.push({"traj": [1, 2]}, seq="w0/0")
+        assert pusher.unacked() == 1
+        d = puller.pull(timeout_ms=5000)
+        assert d == {"traj": [1, 2]}  # reserved keys stripped
+        assert puller.last_seq == "w0/0"
+        assert puller.last_ack_addr == pusher.ack_addr
+        puller.ack(puller.last_seq, puller.last_ack_addr)
+        deadline = time.monotonic() + 5
+        while pusher.unacked() and time.monotonic() < deadline:
+            pusher.drain_acks()
+            time.sleep(0.01)
+        assert pusher.unacked() == 0
+        assert pusher.counters["areal:train_samples_lost_total"] == 0
+        # A timeout resets the per-message attribution.
+        with pytest.raises(TimeoutError):
+            puller.pull(timeout_ms=20)
+        assert puller.last_seq is None and puller.last_ack_addr is None
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_push_without_seq_skips_window():
+    """ack=True but no seq minted (AREAL_WAL off at the worker): plain
+    fire-and-forget push, nothing windowed."""
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port, ack=True)
+    try:
+        pusher.push({"x": 1})
+        assert pusher.unacked() == 0
+        d = puller.pull(timeout_ms=5000)
+        assert d == {"x": 1}
+        assert puller.last_seq is None
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_redeliver_after_ack_timeout():
+    """An unacked sample is re-sent after the ack timeout; the puller
+    sees the duplicate (dedup is the WAL/ledger's job) and a late ack
+    still clears the window."""
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port, ack=True)
+    try:
+        pusher.push({"x": 1}, seq="w0/0")
+        puller.pull(timeout_ms=5000)  # delivered but never acked
+        assert pusher.redeliver(timeout_s=0.0) == 1
+        d = puller.pull(timeout_ms=5000)
+        assert d == {"x": 1} and puller.last_seq == "w0/0"
+        assert pusher.unacked() == 1  # still windowed until acked
+        # Not yet timed out again? timeout_s=1h: nothing redelivered.
+        assert pusher.redeliver(timeout_s=3600) == 0
+        puller.ack("w0/0", puller.last_ack_addr)
+        deadline = time.monotonic() + 5
+        while pusher.unacked() and time.monotonic() < deadline:
+            pusher.drain_acks()
+            time.sleep(0.01)
+        assert pusher.unacked() == 0
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_redeliver_budget_exhaustion_counts_lost():
+    """With a finite AREAL_WAL_REDELIVER_MAX the drop is counted in
+    areal:train_samples_lost_total — honest loss accounting, never a
+    silent leak (the default budget 0 = retry forever)."""
+    puller = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", puller.port, ack=True)
+    try:
+        pusher.push({"x": 1}, seq="w0/0")
+        assert pusher.redeliver(timeout_s=0.0, max_redeliver=1) == 1
+        assert pusher.redeliver(timeout_s=0.0, max_redeliver=1) == 0
+        assert pusher.unacked() == 0
+        assert pusher.counters["areal:train_samples_lost_total"] == 1
+    finally:
+        pusher.close()
+        puller.close()
+
+
+def test_reconnect_redelivers_to_restarted_puller():
+    """The trainer-kill path: the old puller dies unacked, a new one
+    binds a fresh port, the pusher reconnects and redelivery lands the
+    sample on the survivor."""
+    old = pps.ZMQJsonPuller(host="127.0.0.1")
+    pusher = pps.ZMQJsonPusher("127.0.0.1", old.port, ack=True)
+    try:
+        pusher.push({"x": 42}, seq="w0/0")
+        old.pull(timeout_ms=5000)
+        old.close()  # SIGKILL'd trainer: sample journal never fsync'd
+        new = pps.ZMQJsonPuller(host="127.0.0.1")
+        try:
+            pusher.reconnect("127.0.0.1", new.port)
+            assert pusher.redeliver(timeout_s=0.0) == 1
+            d = new.pull(timeout_ms=5000)
+            assert d == {"x": 42} and new.last_seq == "w0/0"
+        finally:
+            new.close()
+    finally:
+        pusher.close()
+
+
 def test_push_pull_json(tmp_name_resolve, experiment_context):
     exp, trial = experiment_context
     puller = pps.NameResolvingZmqPuller(exp, trial, puller_index=0)

@@ -1,0 +1,229 @@
+"""Rollout WAL + seq ledger unit semantics (ISSUE 16): append/replay
+round-trip, torn-tail tolerance (a kill mid-append must cost the torn
+record only — redelivery covers it — never the journal), checkpoint-
+barrier compaction, and the ledger's watermark+extras compression."""
+
+import json
+import os
+
+import pytest
+
+from areal_tpu.base.wire_schemas import BUFFER_WAL_V1
+from areal_tpu.system.wal import RolloutWAL, SeqLedger
+
+
+# ======================================================================
+# SeqLedger
+# ======================================================================
+
+
+def test_ledger_mark_and_contains():
+    led = SeqLedger()
+    assert "w0/0" not in led
+    led.mark("w0/0")
+    led.mark("w0/1")
+    assert "w0/0" in led and "w0/1" in led
+    assert "w0/2" not in led
+    assert "w1/0" not in led  # per-pusher namespaces
+
+
+def test_ledger_out_of_order_absorbs_into_watermark():
+    led = SeqLedger()
+    led.mark("w0/2")  # gap: 0,1 pending
+    assert "w0/2" in led and "w0/0" not in led
+    assert led.to_dict() == {"water": {"w0": -1}, "extras": {"w0": [2]}}
+    led.mark("w0/0")
+    led.mark("w0/1")  # closes the gap: extras collapse into the water
+    assert led.to_dict() == {"water": {"w0": 2}, "extras": {}}
+    for n in range(3):
+        assert f"w0/{n}" in led
+
+
+def test_ledger_mark_is_idempotent_and_permanent():
+    led = SeqLedger()
+    led.mark("w0/0")
+    led.mark("w0/0")
+    assert led.to_dict() == {"water": {"w0": 0}, "extras": {}}
+    # membership is permanent (unlike the buffer's skip-once ignore_ids)
+    assert "w0/0" in led
+    assert "w0/0" in led
+
+
+def test_ledger_roundtrip_through_dict():
+    led = SeqLedger()
+    for seq in ["a/0", "a/1", "a/5", "b/0"]:
+        led.mark(seq)
+    clone = SeqLedger.from_dict(led.to_dict())
+    for seq in ["a/0", "a/1", "a/5", "b/0"]:
+        assert seq in clone
+    for seq in ["a/2", "a/4", "b/1"]:
+        assert seq not in clone
+    assert clone.to_dict() == led.to_dict()
+    # None/empty snapshots (legacy RecoverInfo) give an empty ledger.
+    assert SeqLedger.from_dict(None).to_dict() == {"water": {}, "extras": {}}
+
+
+def test_ledger_seq_with_slash_in_pusher_name():
+    led = SeqLedger()
+    led.mark("host/worker/3/7")  # pusher = "host/worker/3"
+    assert "host/worker/3/7" in led
+    assert "host/worker/3/6" not in led
+
+
+# ======================================================================
+# RolloutWAL
+# ======================================================================
+
+
+def _wal(tmp_path, name="j.wal", **kw):
+    kw.setdefault("fsync_ms", 0)
+    return RolloutWAL(str(tmp_path / name), **kw)
+
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    w = _wal(tmp_path)
+    assert w.replay() == []
+    recs = [{"seq": f"w0/{i}", "data": {"x": i}} for i in range(3)]
+    for r in recs:
+        w.append(r)
+    w.close()
+    w2 = _wal(tmp_path)
+    try:
+        assert w2.replay() == recs
+    finally:
+        w2.close()
+
+
+def test_wal_schema_header_is_first_line(tmp_path):
+    w = _wal(tmp_path)
+    w.replay()
+    w.append({"seq": "w0/0"})
+    w.close()
+    with open(w.path) as f:
+        first = json.loads(f.readline())
+    assert first == {"schema": BUFFER_WAL_V1}
+
+
+def test_wal_rejects_unknown_schema(tmp_path):
+    path = tmp_path / "bad.wal"
+    path.write_text('{"schema":"somebody-elses/v9"}\n')
+    w = RolloutWAL(str(path), fsync_ms=0)
+    with pytest.raises(ValueError, match="unsupported schema"):
+        w.replay()
+
+
+def test_wal_torn_tail_truncated_not_fatal(tmp_path):
+    """A kill between append and fsync tears the final record: replay
+    must return every intact record, truncate the torn bytes off the
+    file, and leave the journal appendable."""
+    w = _wal(tmp_path)
+    w.replay()
+    w.append({"seq": "w0/0", "data": {"x": 0}})
+    w.append({"seq": "w0/1", "data": {"x": 1}})
+    w.close()
+    # Simulate the torn append: half a record, no terminating newline.
+    with open(w.path, "ab") as f:
+        f.write(b'{"seq":"w0/2","da')
+    w2 = _wal(tmp_path)
+    try:
+        assert [r["seq"] for r in w2.replay()] == ["w0/0", "w0/1"]
+        # The torn bytes are gone from disk (later appends never
+        # interleave with them)...
+        w2.append({"seq": "w0/3", "data": {"x": 3}})
+    finally:
+        w2.close()
+    w3 = _wal(tmp_path)
+    try:
+        # ...and a third incarnation sees a clean journal.
+        assert [r["seq"] for r in w3.replay()] == ["w0/0", "w0/1", "w0/3"]
+    finally:
+        w3.close()
+
+
+def test_wal_torn_tail_with_newline_garbage(tmp_path):
+    """Garbage that IS newline-terminated (torn then overwritten by
+    noise) still truncates at the first undecodable line."""
+    w = _wal(tmp_path)
+    w.replay()
+    w.append({"seq": "w0/0"})
+    w.close()
+    with open(w.path, "ab") as f:
+        f.write(b"\x00\xff not json\n")
+        f.write(b'{"seq":"w0/9"}\n')  # after garbage: unreachable
+    w2 = _wal(tmp_path)
+    try:
+        assert [r["seq"] for r in w2.replay()] == ["w0/0"]
+    finally:
+        w2.close()
+
+
+def test_wal_empty_and_header_only_files(tmp_path):
+    # Zero-byte file (kill before the header fsync'd): clean replay.
+    path = tmp_path / "empty.wal"
+    path.write_bytes(b"")
+    w = RolloutWAL(str(path), fsync_ms=0)
+    assert w.replay() == []
+    w.close()
+    # Header-only journal replays empty too.
+    w2 = RolloutWAL(str(path), fsync_ms=0)
+    assert w2.replay() == []
+    w2.close()
+
+
+def test_wal_on_durable_fires_after_fsync_batching(tmp_path):
+    """The deferred-ack contract: on_durable callbacks fire only when
+    the fsync covering their record lands — with a large fsync window
+    nothing fires until forced."""
+    w = _wal(tmp_path, fsync_ms=60_000)
+    w.replay()
+    acked = []
+    w.append({"seq": "w0/0"}, on_durable=lambda: acked.append("w0/0"))
+    w.append({"seq": "w0/1"}, on_durable=lambda: acked.append("w0/1"))
+    assert acked == []  # window not elapsed: ack would be premature
+    assert w.maybe_sync() is False
+    assert w.maybe_sync(force=True) is True
+    assert acked == ["w0/0", "w0/1"]
+    # Idempotent: a later sync with nothing dirty fires nothing.
+    assert w.sync() is False
+    assert acked == ["w0/0", "w0/1"]
+    w.close()
+
+
+def test_wal_zero_window_acks_inline(tmp_path):
+    w = _wal(tmp_path, fsync_ms=0)
+    w.replay()
+    acked = []
+    w.append({"seq": "w0/0"}, on_durable=lambda: acked.append(1))
+    assert acked == [1]
+    w.close()
+
+
+def test_wal_compact_drops_consumed_keeps_pending(tmp_path):
+    led = SeqLedger()
+    led.mark("w0/0")
+    led.mark("w0/2")
+    w = _wal(tmp_path)
+    w.replay()
+    for i in range(4):
+        w.append({"seq": f"w0/{i}", "data": {"x": i}})
+    dropped = w.compact(lambda rec: rec.get("seq") not in led)
+    assert dropped == 2
+    # The journal stays appendable after the atomic rewrite.
+    w.append({"seq": "w0/4", "data": {"x": 4}})
+    w.close()
+    w2 = _wal(tmp_path)
+    try:
+        assert [r["seq"] for r in w2.replay()] == ["w0/1", "w0/3", "w0/4"]
+    finally:
+        w2.close()
+    # No tmp litter from the rewrite.
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_wal_compact_before_any_replay(tmp_path):
+    """Compaction on a fresh (never-replayed) WAL must not crash — the
+    model worker's barrier can fire before the stream saw traffic."""
+    w = _wal(tmp_path)
+    w.replay()
+    assert w.compact(lambda rec: True) == 0
+    w.close()
