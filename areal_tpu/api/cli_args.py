@@ -61,6 +61,33 @@ class ModelTrainEvalConfig:
             "tagged <loss>/stats_stale=1"
         },
     )
+    # MoE overlay knobs: applied on top of config["moe"] by
+    # experiments/common.model_abstraction, so sweeps can flip dispatch
+    # or capacity without rewriting the whole nested model config.
+    moe_dispatch: Optional[str] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "override config['moe']['dispatch'] for this model: "
+            "'capacity' (einsum, drops beyond capacity) or 'dropless' "
+            "(ragged grouped matmul; expert-parallel when the fsdp "
+            "mesh axis divides num_experts)"
+        },
+    )
+    moe_capacity_factor: Optional[float] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "override config['moe']['capacity_factor'] "
+            "(capacity dispatch only; >= num_experts/top_k guarantees "
+            "zero drops)"
+        },
+    )
+    moe_aux_loss_coef: Optional[float] = dataclasses.field(
+        default=None,
+        metadata={
+            "help": "override config['moe']['aux_loss_coef'] (the "
+            "Switch load-balance loss weight)"
+        },
+    )
 
 
 @dataclasses.dataclass

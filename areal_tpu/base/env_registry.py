@@ -210,6 +210,21 @@ _KNOBS: List[Knob] = [
        "opt-in until kernel_micro_gae banks device crossover "
        "evidence). Pinned when the PPO prep program is first traced.",
        snapshot=True),
+    # -- MoE dispatch (models/moe.py, engine/jax_engine.py) --------------
+    _k("AREAL_MOE_DISPATCH", "str", None,
+       "Training-time MoE dispatch override ('capacity' or 'dropless'); "
+       "unset = the model config's moe.dispatch. Applied at engine "
+       "construction (engine/jax_engine.py), so it participates in the "
+       "jit cache key via the model config.", snapshot=True),
+    _k("AREAL_MOE_DECODE_DISPATCH", "str", "dropless",
+       "Decode-time MoE dispatch (engine/paged.py): 'dropless' (default "
+       "— decode token counts are tiny, so capacity buckets quantize "
+       "badly), 'capacity', or 'model' to follow the model config.",
+       snapshot=True),
+    _k("AREAL_MOE_DECODE_CAPACITY", "float", None,
+       "Decode-time capacity_factor override used when the decode "
+       "dispatch resolves to 'capacity'; unset = the model config's "
+       "moe.capacity_factor.", snapshot=True),
     # -- functioncall ----------------------------------------------------
     _k("AREAL_SYMPY_TIMEOUT_S", "float", 3.0,
        "Per-expression sympy equivalence-check timeout "

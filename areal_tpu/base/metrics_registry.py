@@ -102,6 +102,13 @@ _METRICS: List[Metric] = [
        "partial_rollout with the accumulated prefix)."),
     _m("areal:num_preempted_reqs", "counter", _GS,
        "Requests preempted by the scheduler for page pressure."),
+    # -- MoE decode router telemetry -------------------------------------
+    _m("areal:moe_drop_rate", "gauge", _GS,
+       "Decode-time realized MoE token-drop rate, layer-mean over the "
+       "last decode block (0 for dense models and dropless dispatch)."),
+    _m("areal:moe_router_entropy", "gauge", _GS,
+       "Decode-time MoE router entropy (nats), layer-mean over the "
+       "last decode block; collapse detector for serving-side drift."),
     # -- latency SLOs ----------------------------------------------------
     _m("areal:ttft_p50_ms", "gauge", _GS,
        "Per-server TTFT p50 (humans; fleet math uses the hist)."),
@@ -329,6 +336,24 @@ _METRICS: List[Metric] = [
     _m("perf/overlap_events", "scalar", "engine/jax_engine.py",
        "Microbatches staged during a previous step's compute (the "
        "prefetch-overlap bench's engagement proof).", reduce="sum"),
+    # MoE router telemetry (engine/jax_engine._record_moe_stats; per-MFC
+    # fold in master_worker perf_summary, bench JSON passthrough).
+    _m("perf/moe_drop_rate", "scalar", "engine/jax_engine.py",
+       "Realized fraction of routed (token, expert) assignments dropped "
+       "by capacity buckets this step; exactly 0 on dropless arms.",
+       reduce="avg"),
+    _m("perf/moe_router_entropy", "scalar", "engine/jax_engine.py",
+       "Mean per-token router-softmax entropy (nats). Collapse toward "
+       "0 means the router funnels everything to few experts.",
+       reduce="avg"),
+    _m("perf/moe_expert_overload", "scalar", "engine/jax_engine.py",
+       "max_e(f_e) * E — hottest expert's token share relative to the "
+       "uniform ideal (1.0 = perfectly balanced). MAX across DP "
+       "workers: the hottest shard bounds the step.", reduce="max"),
+    _m("perf/moe_a2a_bytes", "scalar", "engine/jax_engine.py",
+       "Trace-time estimate of bytes exchanged by the expert-parallel "
+       "dispatch per step (0 at EP1); SUM accumulates the window "
+       "total.", reduce="sum"),
     _m("perf/rollout_e2e_p50_ms", "scalar",
        "system/model_function_call.py",
        "Rollout end-to-end p50 from RL spans.", reduce="max"),

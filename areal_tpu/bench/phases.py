@@ -415,6 +415,27 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="moe_scaling",
+    entrypoint="areal_tpu.bench.workloads:moe_scaling_phase",
+    priority=15,
+    est_compile_s=0.0,  # tiny CPU-mesh programs; the measure pass pays
+    est_measure_s=150.0,
+    min_window_s=0.0,
+    proxy=True,
+    # Default: the daemon banks the MoE evidence unattended; CPU rounds
+    # self-label proxy, on-chip rounds make the step times meaningful.
+    env={"XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    description="Expert-parallel MoE fast path: dense vs MoE per-token "
+                "step time at matched active FLOPs, dropless EP1 vs EP2 "
+                "loss-trajectory parity + step times, capacity-vs-"
+                "dropless dispatch A/B with a capacity-factor drop-rate "
+                "sweep, and the expert-sliced weight stream's ~1/EP "
+                "per-rank ingress over a live origin (parity, drop "
+                "rates, and byte accounting are exact and machine-"
+                "independent; CPU-proxy evidence)",
+))
+
+register(PhaseSpec(
     name="prefetch_overlap",
     entrypoint="areal_tpu.bench.workloads:prefetch_overlap_phase",
     priority=11,

@@ -112,6 +112,15 @@ class JaxTrainEngine(TrainEngine):
         prefetch_depth: int = 2,
         stats_fetch_interval: int = 1,
     ):
+        # AREAL_MOE_DISPATCH is the trainer-side dispatch A/B hook
+        # (capacity vs dropless without config plumbing), snapshotted at
+        # construction like the other engine A/B knobs.
+        env_dispatch = env_registry.get_str("AREAL_MOE_DISPATCH")
+        if env_dispatch is not None and model_cfg.moe is not None:
+            model_cfg = dataclasses.replace(
+                model_cfg,
+                moe=dataclasses.replace(model_cfg.moe, dispatch=env_dispatch),
+            )
         self.model_cfg = model_cfg
         # Pin AREAL_CE_CHUNK / AREAL_SPLASH_* now: retraces mid-run must
         # not mix tuning settings, and bad values must fail at init.
@@ -160,25 +169,14 @@ class JaxTrainEngine(TrainEngine):
             "overlap_events": 0.0,
         }
 
-        if (
-            model_cfg.moe is not None
-            and model_cfg.moe.dispatch == "dropless"
-            and self.mesh.shape.get("fsdp", 1) > 1
-            # EP only applies when E divides the fsdp axis; otherwise
-            # sharding.py's fallback shards the hidden dim instead and
-            # ragged_dot contracts an unsharded expert axis — legal.
-            and model_cfg.moe.num_experts % self.mesh.shape["fsdp"] == 0
-        ):
-            # Expert weights shard E over fsdp (parallel/sharding.py),
-            # but lax.ragged_dot cannot contract a sharded expert axis:
-            # GSPMD would all-gather the full stacked expert weights
-            # every layer every step — silently losing exactly the HBM
-            # the EP sharding protects. Fail at config time instead.
-            raise NotImplementedError(
-                "dispatch='dropless' does not shard over the expert "
-                "(fsdp) axis; use dispatch='capacity' for expert-"
-                "parallel meshes or run with fsdp=1"
-            )
+        # dispatch='dropless' on an expert-parallel mesh (fsdp > 1
+        # dividing num_experts) routes through the shard_map EP path
+        # (models/moe.py _moe_mlp_ep): per-shard ragged_dot over local
+        # experts with an all-gather + psum_scatter token exchange, so
+        # the expert weights are never all-gathered. The indivisible
+        # case keeps sharding.py's hidden-dim ZeRO fallback (ragged_dot
+        # contracts an UNsharded expert axis there — legal under GSPMD).
+        # Until PR 17 this combination raised NotImplementedError.
         self._param_shardings = param_shardings(params, self.mesh)
         self.params = jax.device_put(params, self._param_shardings)
         self._batch_sharding = batch_sharding(self.mesh)
@@ -343,6 +341,23 @@ class JaxTrainEngine(TrainEngine):
                 aux["mean:moe_drop_rate"] = (
                     moe_aux["drop_rate"] / self.model_cfg.n_layers
                 )
+                # Router telemetry (PR 17): layer-mean router entropy,
+                # expert overload factor (E * max_e layer-mean routing
+                # fraction; 1.0 = perfectly balanced), and EP-exchange
+                # bytes per device per step (layer-summed; 0 off
+                # expert-parallel meshes). Same "mean:" convention as
+                # drop_rate — these are ratios/volumes, not loss-like
+                # token-scaled sums.
+                n_layers = self.model_cfg.n_layers
+                aux["mean:moe_router_entropy"] = (
+                    moe_aux["router_entropy"] / n_layers
+                )
+                aux["mean:moe_expert_overload"] = (
+                    jnp.max(moe_aux["expert_load"])
+                    / n_layers
+                    * moe_cfg.num_experts
+                )
+                aux["mean:moe_a2a_bytes"] = moe_aux["a2a_bytes"]
             return loss_sum, aux
 
         return compute
@@ -894,6 +909,35 @@ class JaxTrainEngine(TrainEngine):
             **{"perf/overlap_events": ov["overlap_events"]},
         )
 
+    def _record_moe_stats(self, stats: Dict[str, float], loss_name: str):
+        """Ship router telemetry through the stats tracker so model
+        workers export it per MFC (perf/moe_* keys reach the master's
+        perf_summary and the bench JSON passthrough). No-op for dense
+        models — keyed off the moe aux stats the loss fetch surfaced."""
+        if f"{loss_name}/moe_drop_rate" not in stats:
+            return
+        stats_tracker.scalar(
+            **{
+                "perf/moe_drop_rate": stats[f"{loss_name}/moe_drop_rate"],
+                "perf/moe_router_entropy":
+                    stats[f"{loss_name}/moe_router_entropy"],
+            }
+        )
+        # Overload merges as MAX across DP workers: the hottest expert
+        # bounds the step, averaging would understate the imbalance.
+        stats_tracker.scalar(
+            reduce_type=stats_tracker.ReduceType.MAX,
+            **{
+                "perf/moe_expert_overload":
+                    stats[f"{loss_name}/moe_expert_overload"],
+            },
+        )
+        # Bytes SUM so multi-step windows accumulate total exchange.
+        stats_tracker.scalar(
+            reduce_type=stats_tracker.ReduceType.SUM,
+            **{"perf/moe_a2a_bytes": stats[f"{loss_name}/moe_a2a_bytes"]},
+        )
+
     def _fetch_train_stats(
         self, packed, aux, loss_name: str, global_denom: float, n_mbs: int,
         lr: float = 0.0,
@@ -920,6 +964,7 @@ class JaxTrainEngine(TrainEngine):
             stats[f"{loss_name}/n_mbs"] = float(n_mbs)
             stats[f"{loss_name}/lr"] = lr  # host-side: exact even when stale
             stats[f"{loss_name}/stats_stale"] = 1.0
+            self._record_moe_stats(stats, loss_name)
             return stats
         aux_leaves, aux_treedef = jax.tree_util.tree_flatten(aux)
         del aux_leaves
@@ -944,6 +989,7 @@ class JaxTrainEngine(TrainEngine):
         if self.stats_fetch_interval > 1:
             stats[f"{loss_name}/stats_stale"] = 0.0
         self._last_train_stats = dict(stats)
+        self._record_moe_stats(stats, loss_name)
         return stats
 
     # ------------------------------------------------------------------

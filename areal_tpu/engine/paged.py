@@ -422,22 +422,39 @@ def _paged_decode_layer(
     x = x + attn_out
     h = _norm(x, lp["ln2"], cfg)
     if cfg.moe is not None:
-        from areal_tpu.models.moe import moe_mlp
+        from areal_tpu.models.moe import decode_moe_overrides, moe_mlp
 
-        m, _ = moe_mlp(h, lp["mlp"], cfg, cdt)
+        # Decode-time dispatch/capacity differ from training: the
+        # capacity formula quantizes badly at decode row counts (C=1
+        # drops on any router skew), so decode defaults to dropless —
+        # see decode_moe_overrides.
+        d_dispatch, d_cap = decode_moe_overrides(cfg)
+        m, moe_aux = moe_mlp(
+            h, lp["mlp"], cfg, cdt,
+            capacity_factor=d_cap, dispatch=d_dispatch,
+        )
+        aux = {
+            "moe_drop_rate": moe_aux["drop_rate"].astype(jnp.float32),
+            "moe_router_entropy":
+                moe_aux["router_entropy"].astype(jnp.float32),
+        }
     else:
         m = _mlp(h, lp["mlp"], cfg, cdt)
+        aux = {}
     x = x + m
-    return x, kp_l, vp_l
+    return x, kp_l, vp_l, aux
 
 
 def paged_decode_step(
     params, cfg: TransformerConfig, tokens, k_pages, v_pages, page_indices,
     lengths, active, mesh=None, attn_impl: str = "auto",
+    return_moe_stats: bool = False,
 ):
     """One decode step for all slots. tokens: [B] just-sampled inputs;
     lengths: [B] fill BEFORE this token; active: [B] bool (inactive slots'
-    writes are routed to the trash page). Returns (logits, pools)."""
+    writes are routed to the trash page). Returns (logits, pools); with
+    return_moe_stats, also a dict of layer-mean router scalars
+    (moe_drop_rate / moe_router_entropy; empty for dense models)."""
     cdt = jnp.dtype(cfg.compute_dtype)
     pg = kv_pool_data(k_pages).shape[3]
     B = tokens.shape[0]
@@ -465,15 +482,16 @@ def paged_decode_step(
 
     def body(x, layer):
         lp, kp, vp = layer
-        x, kp, vp = _paged_decode_layer(
+        x, kp, vp, aux = _paged_decode_layer(
             x, lp, cfg, cos, sin, kp, vp, w_pidx, w_off, page_indices,
             lengths, cdt, mesh, attn_impl,
         )
-        return x, (kp, vp)
+        return x, (kp, vp, aux)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
+    x, (k_pages, v_pages, aux) = jax.lax.scan(
         body, x, (params["layers"], k_pages, v_pages)
     )
+    moe_stats = {k: v.mean() for k, v in aux.items()}  # mean over layers
     x = _norm(x, params["final_norm"], cfg)
     if "head_q" in params:  # int8 decode weights (ops/wquant.py)
         logits = qmat(x, params["head_q"], cdt).astype(jnp.float32)
@@ -484,6 +502,8 @@ def paged_decode_step(
             else params["head"]["weight"]
         )
         logits = (x @ head_w.astype(cdt)).astype(jnp.float32)
+    if return_moe_stats:
+        return logits, k_pages, v_pages, moe_stats
     return logits, k_pages, v_pages
 
 
@@ -917,16 +937,27 @@ def paged_decode_block(
     so the host needs exactly one device fetch per block (per-array
     fetches are serial round trips; ruinous on remote-tunneled TPUs).
     Emission is prefix-contiguous per slot (active only ever falls within
-    a block), so tokens[:n_emitted] is the emitted sequence."""
+    a block), so tokens[:n_emitted] is the emitted sequence.
+
+    MoE models get TWO extra packed columns — [B, 2n+6] instead of
+    [B, 2n+4] — broadcasting the block-mean decode router stats
+    (moe_drop_rate, moe_router_entropy) so the serving /metrics surface
+    sees them without a second device fetch."""
     B = lengths.shape[0]
+    is_moe = cfg.moe is not None
 
     def body(i, carry):
         (kp, vp, lengths, next_input, active, remaining, min_remaining,
-         rng, out_t, out_lp, out_m, hit_eos) = carry
-        logits, kp, vp = paged_decode_step(
+         rng, out_t, out_lp, out_m, hit_eos, moe_acc) = carry
+        logits, kp, vp, moe_stats = paged_decode_step(
             params, cfg, next_input, kp, vp, page_indices, lengths, active,
-            mesh=mesh, attn_impl=attn_impl,
+            mesh=mesh, attn_impl=attn_impl, return_moe_stats=True,
         )
+        if is_moe:
+            moe_acc = (
+                moe_acc[0] + moe_stats["moe_drop_rate"],
+                moe_acc[1] + moe_stats["moe_router_entropy"],
+            )
         rng, sub = jax.random.split(rng)
         tokens, logprobs = warp_sample(
             logits, sub, temps, top_ps, top_ks, greedy_mask,
@@ -948,27 +979,30 @@ def paged_decode_block(
         lengths = lengths + emit.astype(lengths.dtype)
         next_input = tokens
         return (kp, vp, lengths, next_input, active, remaining, min_remaining,
-                rng, out_t, out_lp, out_m, hit_eos)
+                rng, out_t, out_lp, out_m, hit_eos, moe_acc)
 
     out_t = jnp.zeros((B, n_steps), jnp.int32)
     out_lp = jnp.zeros((B, n_steps), jnp.float32)
     out_m = jnp.zeros((B, n_steps), bool)
     hit_eos = jnp.zeros((B,), bool)
+    moe_acc = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
     carry = (k_pages, v_pages, lengths, next_input, active, remaining,
-             min_remaining, rng, out_t, out_lp, out_m, hit_eos)
+             min_remaining, rng, out_t, out_lp, out_m, hit_eos, moe_acc)
     carry = jax.lax.fori_loop(0, n_steps, body, carry)
     (k_pages, v_pages, lengths, next_input, active, remaining, min_remaining,
-     rng, out_t, out_lp, out_m, hit_eos) = carry
-    packed = jnp.concatenate(
-        [
-            out_t.astype(jnp.float32),
-            out_lp,
-            jnp.sum(out_m, axis=1, keepdims=True).astype(jnp.float32),
-            hit_eos[:, None].astype(jnp.float32),
-            active[:, None].astype(jnp.float32),
-            lengths[:, None].astype(jnp.float32),
-        ],
-        axis=1,
-    )
+     rng, out_t, out_lp, out_m, hit_eos, moe_acc) = carry
+    cols = [
+        out_t.astype(jnp.float32),
+        out_lp,
+        jnp.sum(out_m, axis=1, keepdims=True).astype(jnp.float32),
+        hit_eos[:, None].astype(jnp.float32),
+        active[:, None].astype(jnp.float32),
+        lengths[:, None].astype(jnp.float32),
+    ]
+    if is_moe:
+        inv = 1.0 / float(n_steps)
+        cols.append(jnp.broadcast_to(moe_acc[0] * inv, (B,))[:, None])
+        cols.append(jnp.broadcast_to(moe_acc[1] * inv, (B,))[:, None])
+    packed = jnp.concatenate(cols, axis=1)
     return (packed, k_pages, v_pages, lengths, next_input, active,
             remaining, min_remaining, rng)

@@ -123,15 +123,22 @@ def _pow2_at_least(n: int, cap: int) -> int:
     return min(p, cap)
 
 
-def serving_mesh(n_devices: Optional[int] = None) -> "jax.sharding.Mesh":
-    """Tensor-parallel serving mesh: 4 axes so model-side sharding
-    constraints (parallel/sharding.py) resolve, with only `tensor` > 1."""
+def serving_mesh(
+    n_devices: Optional[int] = None, axis: str = "tensor"
+) -> "jax.sharding.Mesh":
+    """Single-axis serving mesh: 4 axes so model-side sharding
+    constraints (parallel/sharding.py) resolve, with only ``axis`` > 1
+    ("tensor" for TP serving, "fsdp" for expert-parallel serving —
+    experts shard over fsdp)."""
     from jax.sharding import Mesh
 
     devs = jax.devices()
     n = n_devices or len(devs)
-    arr = np.asarray(devs[:n]).reshape(1, 1, 1, n)
-    return Mesh(arr, ("data", "fsdp", "seq", "tensor"))
+    names = ("data", "fsdp", "seq", "tensor")
+    shape = [1, 1, 1, 1]
+    shape[names.index(axis)] = n
+    arr = np.asarray(devs[:n]).reshape(shape)
+    return Mesh(arr, names)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "pad_len", "mesh"))
@@ -442,6 +449,13 @@ class ServingEngine:
         self.h2d_transfers = 0
         self.h2d_bytes = 0
         self.decode_blocks = 0
+
+        # Decode-time MoE router telemetry: last-block layer-mean drop
+        # rate / router entropy from the two extra packed columns the
+        # decode block emits for MoE models (zeros for dense models and
+        # on the spec-decode path, which keeps its own packed layout).
+        self.moe_drop_rate = 0.0
+        self.moe_router_entropy = 0.0
 
         # host-side slot bookkeeping
         self._slot_req: List[Optional[GenRequest]] = [None] * self.B
@@ -1076,13 +1090,13 @@ class ServingEngine:
 
     # -- shard-aware cutover (the weight plane's sliced-manifest path) --
 
-    def _addressable_tensor_coords(self) -> Dict[Any, int]:
-        """{device: tensor-axis coordinate} for this PROCESS's devices.
-        Under multi-host TP each process sees only its own mesh slice
-        (so it needs only its own ranks' shard leaves); single-process
-        meshes see every coordinate."""
+    def _addressable_axis_coords(self, axis: str) -> Dict[Any, int]:
+        """{device: ``axis`` coordinate} for this PROCESS's devices.
+        Under multi-host sharding each process sees only its own mesh
+        slice (so it needs only its own ranks' shard leaves);
+        single-process meshes see every coordinate."""
         coords: Dict[Any, int] = {}
-        t_ax = list(self.mesh.axis_names).index("tensor")
+        t_ax = list(self.mesh.axis_names).index(axis)
         local = {d.id for d in jax.local_devices()}
         for idx, dev in np.ndenumerate(self.mesh.devices):
             if dev.id in local:
@@ -1090,14 +1104,20 @@ class ServingEngine:
         return coords
 
     def _build_from_shard_leaves(self, leaves_by_rank, degree: int,
-                                 global_shapes=None):
+                                 global_shapes=None, axis: str = "tensor"):
         """Staged device tree from per-rank HOST shard leaves (flat
-        {path: local ndarray} per tensor rank, e.g. assemble_leaves of
+        {path: local ndarray} per shard rank, e.g. assemble_leaves of
         shard-manifest ChunkStores): each addressable device gets its
         rank's slab via device_put, then the global arrays form through
         jax.make_array_from_single_device_arrays under the engine's own
         NamedSharding. No model-sized host buffer and no resharding
-        copy ever exists — the sliced wire bytes ARE the device shards."""
+        copy ever exists — the sliced wire bytes ARE the device shards.
+
+        ``axis`` is the mesh axis the ranks shard: "tensor" (TP-sliced
+        streams) or "fsdp" (expert-sliced streams — the EP stream ships
+        each rank only its experts, with non-expert leaves replicated;
+        a replicated slab that the serving mesh nonetheless shards gets
+        sliced down host-side to the device's window)."""
         from jax.sharding import NamedSharding
 
         from areal_tpu.parallel.sharding import fitted_param_spec
@@ -1108,18 +1128,28 @@ class ServingEngine:
             raise ValueError(
                 "shard-leaves cutover needs a mesh-sharded engine"
             )
-        t_size = mesh.shape.get("tensor", 1)
+        if axis not in mesh.shape:
+            raise ValueError(f"mesh has no axis {axis!r}")
+        t_size = mesh.shape.get(axis, 1)
         if degree != t_size:
             raise ValueError(
-                f"shard degree {degree} != mesh tensor size {t_size}"
+                f"shard degree {degree} != mesh {axis} size {t_size}"
             )
         for ax, size in mesh.shape.items():
-            if ax != "tensor" and size != 1:
+            if ax != axis and size != 1:
                 raise ValueError(
-                    f"shard-leaves cutover supports tensor-only meshes; "
+                    f"shard-leaves cutover supports single-axis meshes; "
                     f"axis {ax!r} has size {size}"
                 )
-        coords = self._addressable_tensor_coords()
+        if axis != "tensor" and global_shapes is None:
+            # TP shapes are inferrable (every fitted-tensor dim scales
+            # by degree); an EP stream mixes sliced expert leaves with
+            # replicated ones, so only the manifest's recorded global
+            # shapes disambiguate.
+            raise ValueError(
+                f"shard-leaves cutover over {axis!r} needs global_shapes"
+            )
+        coords = self._addressable_axis_coords(axis)
         missing = sorted(
             {t for t in coords.values()} - set(leaves_by_rank)
         )
@@ -1139,6 +1169,11 @@ class ServingEngine:
                 # authoritative (no inference edge cases on tiny dims).
                 gshape = list(global_shapes[path])
             else:
+                if axis != "tensor":
+                    raise ValueError(
+                        f"{path}: global shape required for "
+                        f"{axis!r}-sharded leaves"
+                    )
                 # Infer: local shapes agree with the global on every dim
                 # except those the fitted spec shards on 'tensor', which
                 # concatenate across ranks. Fit against the local shape,
@@ -1166,10 +1201,17 @@ class ServingEngine:
                     for sl, dim in zip(idx_map[dev], gshape)
                 )
                 if tuple(local.shape) != want:
-                    raise ValueError(
-                        f"{path}: rank-{t} shard shape {local.shape} != "
-                        f"device shard {want} (global {tuple(gshape)})"
-                    )
+                    if tuple(local.shape) == tuple(gshape):
+                        # The stream replicated this leaf (e.g. an EP
+                        # stream's attention weights) but the serving
+                        # mesh shards it: take the device's window.
+                        local = local[idx_map[dev]]
+                    else:
+                        raise ValueError(
+                            f"{path}: rank-{t} shard shape {local.shape}"
+                            f" != device shard {want} "
+                            f"(global {tuple(gshape)})"
+                        )
                 shards.append(jax.device_put(local, dev))
             flat[path] = jax.make_array_from_single_device_arrays(
                 tuple(gshape), sharding, shards
@@ -1179,12 +1221,12 @@ class ServingEngine:
     def stage_shard_leaves(self, leaves_by_rank, degree: int,
                            version: Optional[int] = None,
                            allow_interrupt: bool = True,
-                           global_shapes=None):
+                           global_shapes=None, axis: str = "tensor"):
         """update_params for pre-sliced host shards (see
         _build_from_shard_leaves)."""
         self._stage_update(
             lambda: self._build_from_shard_leaves(
-                leaves_by_rank, degree, global_shapes
+                leaves_by_rank, degree, global_shapes, axis=axis
             ),
             allow_interrupt, version,
         )
@@ -1192,15 +1234,17 @@ class ServingEngine:
     def cutover_shard_leaves(
         self, leaves_by_rank, degree: int, version: int,
         allow_interrupt: bool = True, timeout_s: float = 120.0,
-        global_shapes=None,
+        global_shapes=None, axis: str = "tensor",
     ) -> float:
         """cutover_params for pre-sliced host shards: stage each rank's
         slabs straight onto its devices, then block until the serve
-        loop lands the version."""
+        loop lands the version. ``axis="fsdp"`` lands expert-sliced
+        (EP) streams on an expert-parallel serving mesh."""
         t0 = time.monotonic()
         self.stage_shard_leaves(
             leaves_by_rank, degree, version=int(version),
             allow_interrupt=allow_interrupt, global_shapes=global_shapes,
+            axis=axis,
         )
         return self._await_pinned(int(version), t0, timeout_s)
 
@@ -1253,6 +1297,8 @@ class ServingEngine:
             "h2d_per_decode_block": float(self.h2d_transfers)
             / max(1.0, float(self.decode_blocks)),
             "decode_resident": 1.0 if self.decode_resident else 0.0,
+            "moe_drop_rate": float(self.moe_drop_rate),
+            "moe_router_entropy": float(self.moe_router_entropy),
             "num_preempted_reqs": float(self.n_preempted),
             "last_weight_swap_s": float(self.last_weight_swap_s),
             "last_weight_stage_s": float(self.last_weight_stage_s),
@@ -2423,6 +2469,11 @@ class ServingEngine:
             p = np.asarray(packed)  # the block's single device fetch
             self._blocks_since_admit += 1
             self.decode_blocks += 1
+            if self.cfg.moe is not None and p.shape[1] >= 2 * n + 6:
+                # MoE packed layout appends [moe_drop_rate,
+                # moe_router_entropy] broadcast columns (paged.py).
+                self.moe_drop_rate = float(p[0, 2 * n + 4])
+                self.moe_router_entropy = float(p[0, 2 * n + 5])
             t_blk1 = time.monotonic()
             if tracing.enabled():
                 tracing.record_span(

@@ -55,17 +55,22 @@ def stream_params(
     wire: Optional[str] = None,
     tp_degree: Optional[int] = None,
     tp_rank: Optional[int] = None,
+    ep_degree: Optional[int] = None,
+    ep_rank: Optional[int] = None,
 ) -> Dict[str, str]:
     """Query params that pick ONE chunk stream of a version: the wire
-    precision and (for shard-aware fetch) the tensor-parallel slice.
-    Omitted/default values are left off the URL so unsharded holders
-    keep the PR 5 contract byte-for-byte."""
+    precision and (for shard-aware fetch) the tensor- and/or
+    expert-parallel slice. Omitted/default values are left off the URL
+    so unsharded holders keep the PR 5 contract byte-for-byte."""
     q: Dict[str, str] = {}
     if wire and wire != "raw":
         q["wire"] = str(wire)
     if tp_degree and int(tp_degree) > 1:
         q["tp_degree"] = str(int(tp_degree))
         q["tp_rank"] = str(int(tp_rank or 0))
+    if ep_degree and int(ep_degree) > 1:
+        q["ep_degree"] = str(int(ep_degree))
+        q["ep_rank"] = str(int(ep_rank or 0))
     return q
 
 
@@ -78,6 +83,8 @@ def manifest_stream_params(manifest: Dict) -> Dict[str, str]:
         wire=manifest.get("wire"),
         tp_degree=shard.get("tp_degree"),
         tp_rank=shard.get("tp_rank"),
+        ep_degree=shard.get("ep_degree"),
+        ep_rank=shard.get("ep_rank"),
     )
 
 
@@ -85,12 +92,17 @@ def fetch_manifest(
     base_url: str, version: Optional[int] = None, timeout: float = 10.0,
     wire: Optional[str] = None,
     tp_degree: Optional[int] = None, tp_rank: Optional[int] = None,
+    ep_degree: Optional[int] = None, ep_rank: Optional[int] = None,
 ) -> Dict:
     """GET ``{base_url}/weights/manifest`` (optionally pinned to a
     version: the holder 404s until it can serve exactly that one).
-    ``wire``/``tp_degree``/``tp_rank`` pick a quantized and/or sliced
-    chunk stream (the origin builds shard streams on demand)."""
-    q = stream_params(wire=wire, tp_degree=tp_degree, tp_rank=tp_rank)
+    ``wire``/``tp_degree``/``tp_rank``/``ep_degree``/``ep_rank`` pick a
+    quantized and/or sliced chunk stream (the origin builds shard
+    streams on demand; an ep stream ships only that rank's experts)."""
+    q = stream_params(
+        wire=wire, tp_degree=tp_degree, tp_rank=tp_rank,
+        ep_degree=ep_degree, ep_rank=ep_rank,
+    )
     if version is not None:
         q["version"] = str(int(version))
     url = f"{base_url}/weights/manifest"

@@ -47,8 +47,34 @@ def model_abstraction(m: ModelTrainEvalConfig, tokenizer_path: Optional[str],
         args["model_path"] = m.path
     else:
         assert m.config is not None, "need model config for scratch init"
-        args["config"] = dict(m.config)
+        args["config"] = _apply_moe_overrides(m, dict(m.config))
     return ModelAbstraction("tpu_transformer", args=args)
+
+
+def _apply_moe_overrides(m: ModelTrainEvalConfig, config: Dict) -> Dict:
+    """Overlay the flat moe_* CLI knobs onto the nested config['moe']
+    block (TransformerConfig.__post_init__ coerces the dict to an
+    MoEConfig). Setting a knob on a dense model (no 'moe' block) is a
+    silently-ignored sweep bug — refuse it."""
+    overrides = {
+        "dispatch": m.moe_dispatch,
+        "capacity_factor": m.moe_capacity_factor,
+        "aux_loss_coef": m.moe_aux_loss_coef,
+    }
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    if not overrides:
+        return config
+    if not config.get("moe"):
+        raise ValueError(
+            f"moe_* overrides {sorted(overrides)} set but the model "
+            f"config has no 'moe' block — they would be silently ignored"
+        )
+    moe = dict(config["moe"]) if isinstance(config["moe"], dict) else (
+        dataclasses.asdict(config["moe"])
+    )
+    moe.update(overrides)
+    config["moe"] = moe
+    return config
 
 
 def train_mesh_for_worker(

@@ -30,9 +30,13 @@ class MoEConfig:
     #   beyond capacity (drop rate surfaced in train stats as
     #   moe_drop_rate). "dropless": sort-by-expert + lax.ragged_dot
     #   grouped matmuls — zero drops at any router skew (the reference
-    #   dispatcher's guarantee, token_dispatcher.py), static shapes, but
-    #   no EP sharding of the ragged grouped matmul yet. Tradeoff
-    #   documented in docs/perf_notes.md.
+    #   dispatcher's guarantee, token_dispatcher.py), static shapes;
+    #   when the mesh's fsdp extent divides num_experts it runs
+    #   expert-parallel via shard_map (models/moe.py _moe_mlp_ep: each
+    #   shard computes only its own experts' ragged grouped matmuls and
+    #   results combine with psum_scatter), otherwise it falls back to
+    #   the single-program GSPMD path. Tradeoff documented in
+    #   docs/perf_notes.md (Round 17).
     dispatch: str = "capacity"
     # Dense layers interleaved with MoE (e.g. first k layers dense).
     first_k_dense: int = 0
@@ -91,6 +95,12 @@ class TransformerConfig:
     def __post_init__(self):
         if self.n_q_heads % self.n_kv_heads != 0:
             raise ValueError("n_q_heads must be a multiple of n_kv_heads")
+        if isinstance(self.moe, dict):
+            # Experiment configs arrive as plain kwargs dicts
+            # (cli_args ModelTrainEvalConfig.config -> factories.py
+            # TransformerConfig(**config)); coerce the nested MoE block
+            # so `model.config.moe.num_experts=8` works end-to-end.
+            self.moe = MoEConfig(**self.moe)
 
     @property
     def q_dim(self) -> int:

@@ -92,9 +92,16 @@ def _decode_layer(x, lp, cfg, cos, sin, k_cache_l, v_cache_l, lengths, cdt):
     x = x + attn_out
     h = _norm(x, lp["ln2"], cfg)
     if cfg.moe is not None:
-        from areal_tpu.models.moe import moe_mlp
+        from areal_tpu.models.moe import decode_moe_overrides, moe_mlp
 
-        m, _ = moe_mlp(h, lp["mlp"], cfg, cdt)
+        # Same decode-time dispatch/capacity as engine/paged.py, so the
+        # batch generator and the paged server produce identical greedy
+        # streams for MoE models.
+        d_dispatch, d_cap = decode_moe_overrides(cfg)
+        m, _ = moe_mlp(
+            h, lp["mlp"], cfg, cdt,
+            capacity_factor=d_cap, dispatch=d_dispatch,
+        )
     else:
         m = _mlp(h, lp["mlp"], cfg, cdt)
     x = x + m

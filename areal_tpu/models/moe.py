@@ -1,4 +1,4 @@
-"""Mixture-of-experts layer: top-k router + capacity-based dispatch.
+"""Mixture-of-experts layer: top-k router + capacity/dropless dispatch.
 
 Counterpart of the reference's MoE modules (realhf/impl/model/modules/moe/
 router.py:242, token_dispatcher.py, experts.py) rebuilt TPU-first: instead
@@ -22,19 +22,238 @@ The alternative `dispatch="dropless"` path matches the reference
 dispatcher's zero-drop guarantee (token_dispatcher.py) the TPU way:
 tokens sort by expert id and the expert FFN runs as `lax.ragged_dot`
 grouped matmuls with per-expert group sizes — static shapes, no
-capacity buffer, exact at any router skew. Tradeoff: the grouped
-matmul does not yet shard over the expert axis (no EP), so capacity
-dispatch remains the default for expert-parallel runs.
+capacity buffer, exact at any router skew. On an expert-parallel mesh
+(fsdp > 1 with num_experts divisible) the dropless path now runs under
+`shard_map` over the fsdp axis (`_moe_mlp_ep`): each shard holds only
+its E/ep experts, the (token, choice) streams are exchanged with an
+all-gather + psum_scatter pair (the static-shape stand-in for a ragged
+all-to-all; jax 0.4.x has none), and the per-shard grouped matmul runs
+local experts only — so the zero-drop guarantee and 1/ep expert HBM
+coexist. Tradeoff: the gather-side grouped matmul touches every
+exchanged row (dummy zero-weight groups absorb non-local rows), so
+dropless-EP spends up to ep x the expert-FFN FLOPs of capacity
+dispatch for its zero drops and 1/ep weight memory — measured, not
+assumed, by the `moe_scaling` bench phase (docs/perf_notes.md Round
+17), with capacity dispatch kept as the FLOPs-optimal EP baseline.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from areal_tpu.base import env_registry
 from areal_tpu.models.config import TransformerConfig
+
+
+def moe_ep_degree(cfg: TransformerConfig, mesh, x_shape=None) -> int:
+    """Expert-parallel degree the dropless path can use on this mesh.
+
+    The fsdp extent when it divides num_experts (the sharding.py EP
+    layout: stacked expert weights put E on fsdp) AND the activation
+    shape divides the mesh's token tiling; else 1 — no shard_map, the
+    indivisible case falls through to GSPMD with sharding.py's
+    hidden-dim ZeRO fallback (ragged_dot contracts an UNsharded expert
+    axis there, which is legal)."""
+    if cfg.moe is None or mesh is None:
+        return 1
+    sizes = getattr(mesh, "shape", {})
+    ep = int(sizes.get("fsdp", 1))
+    if ep <= 1 or cfg.moe.num_experts % ep != 0:
+        return 1
+    if x_shape is not None:
+        if len(x_shape) != 3:
+            return 1
+        rows = int(sizes.get("data", 1)) * ep
+        seq = int(sizes.get("seq", 1))
+        if x_shape[0] % rows != 0 or x_shape[1] % seq != 0:
+            return 1
+    return ep
+
+
+def decode_moe_overrides(cfg: TransformerConfig) -> Tuple[str, Optional[float]]:
+    """(dispatch, capacity_factor) for DECODE-time MoE calls.
+
+    At decode T is a handful of tokens, so the training capacity formula
+    `C = max(1, capacity_factor*T*k/E)` quantizes badly — C=1 drops at
+    the slightest router skew while larger T wastes HBM. Default routes
+    decode through the dropless grouped matmul (exact at any skew, and
+    trivially cheap at decode row counts). AREAL_MOE_DECODE_DISPATCH
+    ('model' = follow cfg.moe.dispatch) and AREAL_MOE_DECODE_CAPACITY
+    (capacity_factor override when the resolved dispatch is 'capacity')
+    are trace-time A/B hooks."""
+    dispatch = env_registry.get_str("AREAL_MOE_DECODE_DISPATCH") or "dropless"
+    if dispatch == "model":
+        dispatch = cfg.moe.dispatch
+    if dispatch not in ("capacity", "dropless"):
+        raise ValueError(
+            f"AREAL_MOE_DECODE_DISPATCH={dispatch!r}: expected "
+            f"'dropless', 'capacity', or 'model'"
+        )
+    cap = env_registry.get_float("AREAL_MOE_DECODE_CAPACITY")
+    return dispatch, cap
+
+
+def _router(xt, router_w, moe):
+    """fp32 router: probs, renormalized top-k gates, expert choices."""
+    logits = xt.astype(jnp.float32) @ router_w.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, moe.top_k)  # [T, k]
+    if moe.routed_scaling_factor != 1.0:
+        top_p = top_p * moe.routed_scaling_factor
+    # renormalize the selected gates (mixtral convention)
+    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
+    return logits, probs, top_p, top_e
+
+
+def _router_stats(logits, probs, top_e, E):
+    """(f_e, P_e, load_balance, z, entropy) over this shard's tokens.
+
+    f_e is the per-expert fraction of (token, choice) routings — the
+    expert-load histogram surfaced in telemetry; load_balance is the
+    Switch loss E * sum_e f_e * P_e."""
+    f_e = jnp.mean(jax.nn.one_hot(top_e, E, dtype=jnp.float32), axis=(0, 1))
+    P_e = jnp.mean(probs, axis=0)
+    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    entropy = jnp.mean(-jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1))
+    return f_e, P_e, z, entropy
+
+
+def _moe_mlp_ep(
+    x: jnp.ndarray,  # [R, T, D]
+    mp: Dict[str, Any],
+    cfg: TransformerConfig,
+    cdt,
+    mesh,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Expert-parallel dropless dispatch under shard_map over `fsdp`.
+
+    Each shard routes its LOCAL tokens, the (token, choice) streams are
+    all-gathered across the fsdp axis (within each (data, seq) group),
+    the shard's grouped matmul runs ONLY its E/ep experts — rows routed
+    to other shards' experts fall into dummy zero-weight groups and
+    contribute exact zeros — and psum_scatter returns each token's
+    combined output to its home shard. Zero drops at any skew, expert
+    weights never all-gathered. The F dim stays column-parallel on
+    `tensor` when divisible (psum over tensor closes the row-parallel
+    w_down)."""
+    from areal_tpu.utils.jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    moe = cfg.moe
+    E, k = moe.num_experts, moe.top_k
+    R, T, D = x.shape
+    ep = mesh.shape["fsdp"]
+    eloc = E // ep
+    F = mp["w_gate"].shape[-1]
+    tp = mesh.shape.get("tensor", 1)
+    tp_shards = tp if (tp > 1 and F % tp == 0) else 1
+    rows = ("data", "fsdp")
+    n_local = (R // (mesh.shape.get("data", 1) * ep)) * (
+        T // mesh.shape.get("seq", 1)
+    )
+    # Per-device exchange bytes this layer (telemetry, trace-time
+    # constant): all-gather receives (ep-1) peers' activation rows and
+    # (choice, gate, token) streams; the reduce-scatter combine sends
+    # the same activation volume back.
+    a2a_bytes = float(
+        (ep - 1) * n_local * (2 * D * jnp.dtype(cdt).itemsize + k * 12)
+    )
+    act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    f_spec = "tensor" if tp_shards > 1 else None
+    red = ("data", "fsdp", "seq")  # equal-count shards: pmean is exact
+
+    def body(xb, router_w, wg, wu, wd):
+        # xb [r, t, D] local block; wg/wu [eloc, D, Floc]; wd [eloc, Floc, D]
+        xt = xb.reshape(-1, D)
+        n = xt.shape[0]
+        logits, probs, top_p, top_e = _router(xt, router_w, moe)
+        choice_e = top_e.T.reshape(-1)  # [kn] choice-major
+        gate = top_p.T.reshape(-1)
+        tok = jnp.tile(jnp.arange(n), k)
+
+        # Exchange: every EP peer of this (data, seq) group sees the full
+        # token set; token slots are offset by source shard so the
+        # combine can scatter straight back.
+        me = jax.lax.axis_index("fsdp")
+        xg = jax.lax.all_gather(xt.astype(cdt), "fsdp", axis=0, tiled=True)
+        ceg = jax.lax.all_gather(choice_e, "fsdp", axis=0, tiled=True)
+        gg = jax.lax.all_gather(gate, "fsdp", axis=0, tiled=True)
+        tokg = jax.lax.all_gather(
+            tok + me * n, "fsdp", axis=0, tiled=True
+        )
+
+        order = jnp.argsort(ceg)  # stable: keeps (shard, choice) priority
+        sizes = jnp.bincount(ceg, length=E)
+        xs = xg[tokg[order]]  # [ep*kn, D] sorted by expert id
+
+        # Grouped matmul over LOCAL experts only: rows of experts before/
+        # after this shard's block land in dummy zero-weight prefix/
+        # suffix groups — their outputs are exact zeros, so the combine
+        # needs no mask and psum_scatter sums shards' disjoint
+        # contributions.
+        e0 = me * eloc
+        prefix = jnp.sum(jnp.where(jnp.arange(E) < e0, sizes, 0))
+        local_sizes = jax.lax.dynamic_slice(sizes, (e0,), (eloc,))
+        suffix = xs.shape[0] - prefix - jnp.sum(local_sizes)
+        gsizes = jnp.concatenate(
+            [prefix[None], local_sizes, suffix[None]]
+        ).astype(jnp.int32)
+        zgu = jnp.zeros((1,) + wg.shape[1:], cdt)
+        zd = jnp.zeros((1,) + wd.shape[1:], cdt)
+        wgp = jnp.concatenate([zgu, wg.astype(cdt), zgu], 0)
+        wup = jnp.concatenate([zgu, wu.astype(cdt), zgu], 0)
+        wdp = jnp.concatenate([zd, wd.astype(cdt), zd], 0)
+        h = act(jax.lax.ragged_dot(xs, wgp, gsizes))
+        h = h * jax.lax.ragged_dot(xs, wup, gsizes)
+        ys = jax.lax.ragged_dot(h, wdp, gsizes)  # [ep*kn, D]
+
+        yg = (
+            jnp.zeros((xg.shape[0], D), cdt)
+            .at[tokg[order]]
+            .add(gg[order].astype(cdt)[:, None] * ys)
+        )
+        y = jax.lax.psum_scatter(
+            yg, "fsdp", scatter_dimension=0, tiled=True
+        )  # [n, D]: this shard's tokens, summed over expert shards
+        if tp_shards > 1:
+            y = jax.lax.psum(y, "tensor")
+
+        f_e, P_e, z, entropy = _router_stats(logits, probs, top_e, E)
+        f_e = jax.lax.pmean(f_e, red)
+        P_e = jax.lax.pmean(P_e, red)
+        aux = {
+            "load_balance_loss": E * jnp.sum(f_e * P_e),
+            "z_loss": jax.lax.pmean(z, red),
+            "drop_rate": jnp.zeros((), jnp.float32),
+            "router_entropy": jax.lax.pmean(entropy, red),
+            "expert_load": f_e,
+            "a2a_bytes": jnp.asarray(a2a_bytes, jnp.float32),
+        }
+        return y.reshape(xb.shape), aux
+
+    y, aux = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(rows, "seq", None),
+            P(None, None),
+            P("fsdp", None, f_spec),
+            P("fsdp", None, f_spec),
+            P("fsdp", f_spec, None),
+        ),
+        out_specs=(
+            P(rows, "seq", None),
+            {k_: P() for k_ in (
+                "load_balance_loss", "z_loss", "drop_rate",
+                "router_entropy", "expert_load", "a2a_bytes",
+            )},
+        ),
+        check_vma=False,
+    )(x, mp["router"], mp["w_gate"], mp["w_up"], mp["w_down"])
+    return y, aux
 
 
 def moe_mlp(
@@ -44,37 +263,40 @@ def moe_mlp(
     cdt,
     capacity_factor: float = None,
     token_mask: jnp.ndarray = None,  # [...] bool, True = real token
+    mesh=None,
+    dispatch: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Returns (y with x's shape, {"load_balance_loss", "z_loss",
-    "drop_rate"}). token_mask marks real (non-padding) tokens: the
-    reported drop_rate then counts only real routings — padding rows
-    route too (static shapes) and would otherwise dilute the rate."""
+    """Returns (y with x's shape, aux dict: load_balance_loss, z_loss,
+    drop_rate, router_entropy, expert_load [E], a2a_bytes).
+
+    token_mask marks real (non-padding) tokens: the reported drop_rate
+    then counts only real routings — padding rows route too (static
+    shapes) and would otherwise dilute the rate. `mesh` enables the
+    expert-parallel dropless path (`_moe_mlp_ep`) when the fsdp axis
+    divides num_experts; `dispatch` overrides cfg.moe.dispatch (the
+    decode path passes decode_moe_overrides)."""
     moe = cfg.moe
     if capacity_factor is None:
         capacity_factor = moe.capacity_factor
+    if dispatch is None:
+        dispatch = moe.dispatch
+    if dispatch == "dropless" and moe_ep_degree(cfg, mesh, x.shape) > 1:
+        return _moe_mlp_ep(x, mp, cfg, cdt, mesh)
+
     E, k = moe.num_experts, moe.top_k
     lead_shape = x.shape[:-1]
     D = x.shape[-1]
     xt = x.reshape(-1, D)
     T = xt.shape[0]
 
-    # Router in fp32 for stable softmax (reference router.py casts too).
-    logits = (xt.astype(jnp.float32) @ mp["router"].astype(jnp.float32))  # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-
-    # top-k expert choice per token.
-    top_p, top_e = jax.lax.top_k(probs, k)  # [T, k]
-    if moe.routed_scaling_factor != 1.0:
-        top_p = top_p * moe.routed_scaling_factor
-    # renormalize the selected gates (mixtral convention)
-    top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
-
+    logits, probs, top_p, top_e = _router(xt, mp["router"], moe)
     choice_e = top_e.T.reshape(-1)  # [k*T] expert ids, choice-major
     gate = top_p.T.reshape(-1)  # [kT], aligned with choice_e
     tok_idx = jnp.tile(jnp.arange(T), k)
     act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+    a2a_bytes = jnp.zeros((), jnp.float32)
 
-    if moe.dispatch == "dropless":
+    if dispatch == "dropless":
         # Sort (token, choice) pairs by expert; the expert FFN becomes
         # ragged grouped matmuls with per-expert group sizes. Static
         # shapes (kT rows regardless of skew), zero drops.
@@ -132,18 +354,25 @@ def moe_mlp(
             drop_rate = jnp.maximum(
                 1.0 - jnp.mean(keep.astype(jnp.float32)), 0.0
             )
+        ep = moe_ep_degree(cfg, mesh)
+        if ep > 1:
+            # GSPMD inserts the token all-to-all for the [E, C, D]
+            # dispatch/combine contractions on an EP mesh; estimate the
+            # per-device bytes so capacity vs dropless-EP exchange
+            # volume is comparable in telemetry.
+            a2a_bytes = jnp.asarray(
+                2.0 * (ep - 1) / ep * E * C * D * jnp.dtype(cdt).itemsize,
+                jnp.float32,
+            )
 
-    # Switch load-balance loss: E * sum_e f_e * P_e, where f_e is the
-    # fraction of (token, choice) routings to e and P_e the mean prob.
-    f_e = jnp.mean(jax.nn.one_hot(top_e, E, dtype=jnp.float32), axis=(0, 1))
-    P_e = jnp.mean(probs, axis=0)
-    load_balance = E * jnp.sum(f_e * P_e)
-    z = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
-
+    f_e, P_e, z, entropy = _router_stats(logits, probs, top_e, E)
     return y.reshape(*lead_shape, D), {
-        "load_balance_loss": load_balance,
+        "load_balance_loss": E * jnp.sum(f_e * P_e),
         "z_loss": z,
         "drop_rate": drop_rate,
+        "router_entropy": entropy,
+        "expert_load": f_e,
+        "a2a_bytes": a2a_bytes,
     }
 
 

@@ -356,8 +356,10 @@ def forward(
         from areal_tpu.models.moe import moe_mlp
 
         moe_token_mask = segment_ids > 0  # real-token drop accounting
+        # mesh enables the expert-parallel dropless path (moe.py
+        # _moe_mlp_ep) when the fsdp axis divides num_experts.
         mlp_fn = lambda h, mp: moe_mlp(
-            h, mp, cfg, cdt, token_mask=moe_token_mask
+            h, mp, cfg, cdt, token_mask=moe_token_mask, mesh=mesh
         )
     else:
         mlp_fn = lambda h, mp: _mlp(h, mp, cfg, cdt)
@@ -384,6 +386,14 @@ def forward(
         "load_balance_loss": jnp.zeros((), jnp.float32),
         "z_loss": jnp.zeros((), jnp.float32),
         "drop_rate": jnp.zeros((), jnp.float32),  # summed; /n_layers = mean
+        # Router telemetry (summed over layers like drop_rate):
+        # per-expert routing-fraction histogram, router entropy, and
+        # EP-exchange bytes per device (0 off expert-parallel meshes).
+        "router_entropy": jnp.zeros((), jnp.float32),
+        "expert_load": jnp.zeros(
+            (cfg.moe.num_experts if use_moe else 1,), jnp.float32
+        ),
+        "a2a_bytes": jnp.zeros((), jnp.float32),
     }
     if remat_mode == "full":
         body = jax.checkpoint(layer_body)

@@ -194,6 +194,46 @@ def tensor_shard_slices(path: str, shape, degree: int, rank: int):
     return leaf_shard_slices(path, shape, sizes, coords)
 
 
+def expert_shard_slices(path: str, shape, degree: int, rank: int):
+    """Shard slices for rank ``rank`` of a ``degree``-way EXPERT-parallel
+    group: stacked expert leaves ([L, E, ...] MoE weights, the bulk of
+    an expert-dominated checkpoint) slice E ``degree`` ways; every other
+    leaf comes back full-extent — each gserver fetches all attention /
+    norm / router weights but only its OWN experts (ROADMAP item 5).
+    An expert dim indivisible by ``degree`` degrades that leaf to
+    full-extent (replicated) rather than slicing something else: the
+    stream stays byte-correct, just without the 1/EP saving."""
+    if degree < 1 or not (0 <= rank < degree):
+        raise ValueError(f"bad expert shard rank {rank}/{degree}")
+    spec = param_partition_spec(path, len(shape))
+    if (
+        len(shape) == 4 and len(spec) > 1 and spec[1] == "fsdp"
+        and shape[1] % degree == 0
+    ):
+        sizes = {"data": 1, "fsdp": degree, "seq": 1, "tensor": 1}
+        coords = {"data": 0, "fsdp": rank, "seq": 0, "tensor": 0}
+        return spec_slices(P(None, "fsdp"), shape, sizes, coords)
+    return [(0, int(d)) for d in shape]
+
+
+def compose_shard_slices(a, b, shape):
+    """Intersect two shard-slice lists that slice DISJOINT dims (e.g. a
+    TP slice of F and an EP slice of E on the same [L, E, D, F] leaf).
+    Per dim, at most one of the two may be a proper sub-slice."""
+    out = []
+    for (a0, a1), (b0, b1), dim in zip(a, b, shape):
+        if (a0, a1) == (0, int(dim)):
+            out.append((b0, b1))
+        elif (b0, b1) == (0, int(dim)):
+            out.append((a0, a1))
+        else:
+            raise ValueError(
+                f"both shardings slice the same dim of {tuple(shape)}: "
+                f"{(a0, a1)} vs {(b0, b1)}"
+            )
+    return out
+
+
 def shard_params(params: Params, mesh: Mesh) -> Params:
     """Place a host pytree onto the mesh with megatron-equivalent sharding."""
     return jax.device_put(params, param_shardings(params, mesh))

@@ -1902,6 +1902,11 @@ class GenerationServer(Worker):
             f"areal:weight_version {float(self.engine.version)}",
             f"areal:kv_pages_free {m['kv_pages_free']}",
             f"areal:kv_pages_total {m['kv_pages_total']}",
+            # Decode-time MoE router telemetry (zeros for dense models):
+            # last-block layer-mean realized drop rate and router
+            # entropy, from the packed decode-block columns.
+            f"areal:moe_drop_rate {m.get('moe_drop_rate', 0.0)}",
+            f"areal:moe_router_entropy {m.get('moe_router_entropy', 0.0)}",
             # Disaggregated serving: live pool role (string surface, like
             # the histogram lines), elastic eligibility (configured role
             # is the re-role pool), and the KV-handoff counters.

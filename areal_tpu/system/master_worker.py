@@ -312,6 +312,14 @@ class MasterWorker(Worker):
                     mreg.PERF_ROLLOUT_E2E_P50_MS,
                     mreg.PERF_ROLLOUT_E2E_P95_MS,
                     mreg.PERF_REPREFILL_TOKENS,
+                    # MoE router health (PR 17): realized drop rate,
+                    # entropy, hottest-expert load, a2a volume — per-MFC
+                    # series + running mean in perf_summary for the
+                    # moe_scaling bench passthrough.
+                    mreg.PERF_MOE_DROP_RATE,
+                    mreg.PERF_MOE_ROUTER_ENTROPY,
+                    mreg.PERF_MOE_EXPERT_OVERLOAD,
+                    mreg.PERF_MOE_A2A_BYTES,
                 ):
                     # Input-pipeline telemetry: per-MFC series + running
                     # mean in perf_summary["overlap"].
@@ -349,6 +357,8 @@ class MasterWorker(Worker):
                 "packing_efficiency/", "h2d_wait_ms/", "dispatch_gap_ms/",
                 "overlap_events/", "rollout_e2e_p50_ms/",
                 "rollout_e2e_p95_ms/", "reprefill_tokens/",
+                "moe_drop_rate/", "moe_router_entropy/",
+                "moe_expert_overload/", "moe_a2a_bytes/",
             ))
         ]
         logger.info(

@@ -240,7 +240,8 @@ def _leaves_with_nbytes(leaves: List[Dict]) -> List[Dict]:
 
 
 def shard_manifest_from_full(
-    man: Dict, tp_degree: int, tp_rank: int
+    man: Dict, tp_degree: int, tp_rank: int,
+    ep_degree: int = 1, ep_rank: int = 0,
 ) -> Tuple[Dict, List[Tuple[int, int]]]:
     """Slice a full (per-wire) manifest into one tensor-parallel rank's
     shard manifest plus the bin gather ranges its chunk stream reads.
@@ -254,13 +255,28 @@ def shard_manifest_from_full(
     parallel/sharding.py partition specs, i.e. exactly what the engine's
     NamedSharding will place; replicated leaves (norms, biases) appear
     in every rank's stream — the small +epsilon over payload/degree."""
-    from areal_tpu.parallel.sharding import tensor_shard_slices
+    from areal_tpu.parallel.sharding import (
+        compose_shard_slices, expert_shard_slices, tensor_shard_slices,
+    )
 
     segments = []
     for leaf in _leaves_with_nbytes(man["leaves"]):
         slices = tensor_shard_slices(
             leaf["path"], leaf["shape"], tp_degree, tp_rank
         )
+        if ep_degree > 1:
+            # (wire, ep_degree, ep_rank) streams additionally slice the
+            # EXPERT dim of stacked MoE leaves (disjoint from the TP
+            # dim, so the two compose): the rank fetches only its own
+            # experts and ingress scales ~1/EP for expert-dominated
+            # checkpoints.
+            slices = compose_shard_slices(
+                slices,
+                expert_shard_slices(
+                    leaf["path"], leaf["shape"], ep_degree, ep_rank
+                ),
+                leaf["shape"],
+            )
         segments.extend(_leaf_segments(leaf, slices))
     plan = shard_stream_plan(segments)
     by_path: Dict[str, Dict] = {}
@@ -290,7 +306,12 @@ def shard_manifest_from_full(
         "version": int(man["version"]),
         "bin": man["bin"],
         "wire": man.get("wire", "raw"),
-        "shard": {"tp_degree": int(tp_degree), "tp_rank": int(tp_rank)},
+        "shard": (
+            {"tp_degree": int(tp_degree), "tp_rank": int(tp_rank)}
+            if ep_degree <= 1 else
+            {"tp_degree": int(tp_degree), "tp_rank": int(tp_rank),
+             "ep_degree": int(ep_degree), "ep_rank": int(ep_rank)}
+        ),
         "chunk_bytes": int(man["chunk_bytes"]),
         "total_bytes": int(plan["total_bytes"]),
         "n_chunks": len(chunk_spans(plan["total_bytes"], man["chunk_bytes"])),
@@ -303,15 +324,20 @@ def shard_manifest_from_full(
     return shard_man, plan["ranges"]
 
 
-def manifest_stream_key(man_or_query: Dict) -> Tuple[str, int, int]:
-    """(wire, tp_degree, tp_rank) identity of a chunk stream — the key
-    holders match requests against (a rank-0 peer must not serve rank-1
-    chunk indices: same version, different bytes)."""
+def manifest_stream_key(man_or_query: Dict) -> Tuple[str, int, int, int, int]:
+    """(wire, tp_degree, tp_rank, ep_degree, ep_rank) identity of a
+    chunk stream — the key holders match requests against (a rank-0
+    peer must not serve rank-1 chunk indices: same version, different
+    bytes; likewise an EP-sliced stream vs a TP-sliced one)."""
     wire = man_or_query.get("wire") or "raw"
     shard = man_or_query.get("shard") or {}
     degree = int(man_or_query.get("tp_degree") or shard.get("tp_degree") or 1)
     rank = int(man_or_query.get("tp_rank") or shard.get("tp_rank") or 0)
-    return (str(wire), degree, rank)
+    ep_degree = int(
+        man_or_query.get("ep_degree") or shard.get("ep_degree") or 1
+    )
+    ep_rank = int(man_or_query.get("ep_rank") or shard.get("ep_rank") or 0)
+    return (str(wire), degree, rank, ep_degree, ep_rank)
 
 
 # ----------------------------------------------------------------------
@@ -631,16 +657,21 @@ class WeightPlaneSource(_PlaneHTTP):
             r.close()
 
     def _shard_stream(
-        self, want_version: Optional[int], wire: str, degree: int, rank: int
+        self, want_version: Optional[int], wire: str, degree: int, rank: int,
+        ep_degree: int = 1, ep_rank: int = 0,
     ) -> Optional[Tuple[Dict, List, List]]:
         """(shard manifest, bin gather ranges, stream prefix sums) for
-        one TP rank's sliced stream, built (one slice+hash pass over the
-        shard's bytes) and cached per (version, wire, degree, rank)."""
+        one TP/EP rank's sliced stream, built (one slice+hash pass over
+        the shard's bytes) and cached per (version, wire, degree, rank,
+        ep_degree, ep_rank)."""
         full = self._manifest(want_version, wire)
         if full is None:
             return None
         version = int(full["version"])
-        key = (version, wire, int(degree), int(rank))
+        key = (
+            version, wire, int(degree), int(rank),
+            int(ep_degree), int(ep_rank),
+        )
         with self._lock:
             hit = self._shards.get(key)
         if hit is not None:
@@ -651,11 +682,13 @@ class WeightPlaneSource(_PlaneHTTP):
             if hit is not None:
                 return hit
             try:
-                man, ranges = shard_manifest_from_full(full, degree, rank)
+                man, ranges = shard_manifest_from_full(
+                    full, degree, rank, ep_degree=ep_degree, ep_rank=ep_rank
+                )
             except (ValueError, KeyError) as e:
                 logger.warning(
-                    f"shard manifest v{version} {wire} {rank}/{degree} "
-                    f"failed: {e!r}"
+                    f"shard manifest v{version} {wire} tp {rank}/{degree} "
+                    f"ep {ep_rank}/{ep_degree} failed: {e!r}"
                 )
                 return None
             chunker = StreamChunker(man["chunk_bytes"])
@@ -684,7 +717,9 @@ class WeightPlaneSource(_PlaneHTTP):
         return entry
 
     @staticmethod
-    def _parse_stream_query(query) -> Tuple[Optional[int], str, int, int]:
+    def _parse_stream_query(
+        query,
+    ) -> Tuple[Optional[int], str, int, int, int, int]:
         want = query.get("version")
         want_v = int(want) if want is not None else None
         wire = query.get("wire") or "raw"
@@ -692,21 +727,25 @@ class WeightPlaneSource(_PlaneHTTP):
         rank = int(query.get("tp_rank") or 0)
         if degree < 1 or not (0 <= rank < degree):
             raise ValueError(f"bad shard {rank}/{degree}")
-        return want_v, wire, degree, rank
+        ep_degree = int(query.get("ep_degree") or 1)
+        ep_rank = int(query.get("ep_rank") or 0)
+        if ep_degree < 1 or not (0 <= ep_rank < ep_degree):
+            raise ValueError(f"bad expert shard {ep_rank}/{ep_degree}")
+        return want_v, wire, degree, rank, ep_degree, ep_rank
 
     async def _h_manifest(self, request: web.Request) -> web.Response:
         try:
-            want_v, wire, degree, rank = self._parse_stream_query(
-                request.query
-            )
+            (want_v, wire, degree, rank,
+             ep_degree, ep_rank) = self._parse_stream_query(request.query)
         except ValueError:
             return web.json_response({"error": "bad stream query"}, status=400)
         # A cache miss sha256-hashes the whole bin / shard stream
         # (build_chunk_index): off the event loop, so pending chunk
         # requests keep flowing.
-        if degree > 1:
+        if degree > 1 or ep_degree > 1:
             got = await asyncio.get_running_loop().run_in_executor(
-                None, self._shard_stream, want_v, wire, degree, rank
+                None, self._shard_stream, want_v, wire, degree, rank,
+                ep_degree, ep_rank,
             )
             man = got[0] if got else None
         else:
@@ -738,11 +777,14 @@ class WeightPlaneSource(_PlaneHTTP):
     def _read_chunk(
         self, version: int, idx: int, start: int,
         wire: str, degree: int, rank: int,
+        ep_degree: int = 1, ep_rank: int = 0,
     ) -> web.Response:
         """Blocking part of /weights/chunk (manifest build + pread),
         run on an executor thread."""
-        if degree > 1:
-            got = self._shard_stream(version, wire, degree, rank)
+        if degree > 1 or ep_degree > 1:
+            got = self._shard_stream(
+                version, wire, degree, rank, ep_degree, ep_rank
+            )
             if got is None:
                 return web.json_response({"error": "unknown stream"}, status=404)
             man, ranges, prefix = got
@@ -796,12 +838,14 @@ class WeightPlaneSource(_PlaneHTTP):
         try:
             version = int(request.query["version"])
             idx = int(request.query["idx"])
-            _, wire, degree, rank = self._parse_stream_query(request.query)
+            (_, wire, degree, rank,
+             ep_degree, ep_rank) = self._parse_stream_query(request.query)
         except (KeyError, ValueError):
             return web.json_response({"error": "version/idx required"}, status=400)
         return await asyncio.get_running_loop().run_in_executor(
             None, self._read_chunk, version, idx,
             parse_range_start(request), wire, degree, rank,
+            ep_degree, ep_rank,
         )
 
     def stats(self) -> Dict:

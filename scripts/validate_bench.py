@@ -120,6 +120,17 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "kv_prefix_lost",
         "n_servers_max",
     ),
+    # MoE fast-path evidence is only evidence with its parity, drop, and
+    # ingress accounting: a fast EP2 step time next to a diverged loss
+    # trajectory, a "dropless" arm that realized drops, or an
+    # expert-sliced stream that did not shrink ingress is the exact
+    # failure the phase exists to catch.
+    "moe_scaling": (
+        "n_devices", "dense_step_s", "moe_ep1_step_s", "moe_ep2_step_s",
+        "capacity_step_s", "ep_parity_ok", "capacity_parity_ok",
+        "ep_loss_max_rel_err", "dropless_drop_rate", "ep_degree",
+        "ep_ingress_frac_max", "origin_full_payloads",
+    ),
     # kernel_micro family: per-kernel timing is only evidence NEXT TO
     # its parity number, and a CPU round must label itself proxy
     # (enforced against the record's own attestation below).
@@ -696,6 +707,63 @@ def _validate_decode_state(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_moe_scaling(val: Dict) -> List[str]:
+    """The MoE fast-path contract: EP and no-drop-capacity loss
+    trajectories must MATCH dropless-EP1 (parity-missing records are
+    refused by the key schema), a 'dropless' arm that realized drops is
+    a broken dispatcher, and the expert-sliced stream must actually
+    shrink per-rank ingress toward 1/EP."""
+    problems: List[str] = []
+    for k, arm in (("ep_parity_ok", "dropless-EP2"),
+                   ("capacity_parity_ok", "no-drop capacity")):
+        if _num(val, k) != 1:
+            problems.append(
+                f"moe_scaling: {arm} loss trajectory diverged from "
+                f"dropless-EP1 (or parity missing) — refusing"
+            )
+    for k in ("dropless_drop_rate", "ep2_drop_rate"):
+        dr = _num(val, k)
+        if dr is not None and dr > 0:
+            problems.append(
+                f"moe_scaling: {k} = {dr:.4f} — a dropless dispatch "
+                f"that drops tokens is a broken dispatcher"
+            )
+    ep = _num(val, "ep_degree")
+    frac = _num(val, "ep_ingress_frac_max")
+    if ep and frac is not None and frac > 1.0 / ep + 0.25:
+        problems.append(
+            f"moe_scaling: per-rank ingress frac {frac:.3f} does not "
+            f"shrink toward 1/{ep:.0f} — the expert-sliced stream is "
+            f"not engaged"
+        )
+    sweep = val.get("capacity_sweep")
+    if not isinstance(sweep, list) or not sweep:
+        problems.append(
+            "moe_scaling: measure value must carry a non-empty "
+            "'capacity_sweep'"
+        )
+    else:
+        prev = None
+        for i, pt in enumerate(sweep):
+            cf = pt.get("capacity_factor") if isinstance(pt, dict) else None
+            dr = pt.get("drop_rate") if isinstance(pt, dict) else None
+            if not isinstance(cf, (int, float)) or not isinstance(
+                dr, (int, float)
+            ):
+                problems.append(
+                    f"moe_scaling: capacity_sweep[{i}] missing numeric "
+                    f"capacity_factor/drop_rate"
+                )
+                continue
+            if prev is not None and (cf <= prev[0] or dr > prev[1] + 1e-9):
+                problems.append(
+                    f"moe_scaling: capacity_sweep[{i}] drop rate must "
+                    f"be non-increasing in capacity_factor"
+                )
+            prev = (cf, dr)
+    return problems
+
+
 def validate_phase_value(name: str, rec: Dict) -> List[str]:
     """Schema problems for one banked record's value dict (measure/ok
     records of phases with a declared schema only)."""
@@ -720,6 +788,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_train_sharded(val))
     if name == "train_tflops_scaling":
         problems.extend(_validate_scaling_points(val))
+    if name == "moe_scaling":
+        problems.extend(_validate_moe_scaling(val))
     if name == "train_tflops" and not isinstance(
         val.get("mesh_shape"), dict
     ):

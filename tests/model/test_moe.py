@@ -300,15 +300,20 @@ def test_moe_drop_rate_counts_real_tokens_only(params):
     assert float(aux_masked["drop_rate"]) < float(aux_unmasked["drop_rate"])
 
 
-def test_moe_dropless_rejected_on_expert_parallel_mesh():
-    """ragged_dot can't contract a sharded expert axis — the engine must
-    refuse dropless dispatch on an fsdp>1 mesh instead of silently
-    all-gathering the expert weights every layer."""
+def test_moe_dropless_trains_on_expert_parallel_mesh():
+    """The old dropless x fsdp guard is gone: on an fsdp>1 mesh the
+    engine dispatches into the shard_map expert-parallel path
+    (models/moe._moe_mlp_ep) — zero drops, expert weights never
+    all-gathered — and the router telemetry flows through train stats
+    (a2a_bytes > 0 proves the EP exchange path was taken, not the
+    single-device ragged_dot fallback)."""
     import dataclasses
 
+    from areal_tpu.api.data_api import MicroBatchSpec, SequenceSample
     from areal_tpu.base.topology import MeshSpec
     from areal_tpu.engine.jax_engine import JaxTrainEngine
     from areal_tpu.engine.optimizer import OptimizerConfig
+    from areal_tpu.interfaces.sft import sft_loss_weight, sft_row_loss
     from areal_tpu.parallel.mesh import make_mesh
 
     cfg = dataclasses.replace(
@@ -316,8 +321,110 @@ def test_moe_dropless_rejected_on_expert_parallel_mesh():
     )
     mesh = make_mesh(MeshSpec.parse("d1f2t1"), devices=jax.devices()[:2])
     params = init_params(cfg, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="dropless"):
+    eng = JaxTrainEngine(
+        cfg, params, optimizer_config=OptimizerConfig(lr=1e-3),
+        total_train_steps=10, remat=False, mesh=mesh, row_len_multiple=16,
+    )
+    rng = np.random.RandomState(0)
+    seqlens = [16, 16, 16, 16]
+    toks = np.concatenate(
+        [rng.randint(0, 64, n) for n in seqlens]
+    ).astype(np.int32)
+    pm = np.concatenate(
+        [np.r_[np.ones(3, bool), np.zeros(n - 3, bool)] for n in seqlens]
+    )
+    s = SequenceSample.from_default(
+        ids=["a", "b", "c", "d"],
+        seqlens=seqlens,
+        data=dict(packed_input_ids=toks, prompt_mask=pm),
+    )
+    stats = eng.train_batch(
+        s, MicroBatchSpec(), loss_fn=sft_row_loss,
+        loss_weight_fn=sft_loss_weight, loss_name="sft",
+    )
+    assert np.isfinite(stats["sft/loss"])
+    assert stats["sft/moe_drop_rate"] == 0.0
+    assert stats["sft/moe_a2a_bytes"] > 0.0
+    assert stats["sft/moe_router_entropy"] > 0.0
+
+
+def test_moe_env_dispatch_override(monkeypatch):
+    """AREAL_MOE_DISPATCH rewrites the model config's moe.dispatch at
+    engine construction — the env-shaped end of the cli knob."""
+    from areal_tpu.engine.jax_engine import JaxTrainEngine
+    from areal_tpu.engine.optimizer import OptimizerConfig
+
+    monkeypatch.setenv("AREAL_MOE_DISPATCH", "dropless")
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    eng = JaxTrainEngine(
+        CFG, params, optimizer_config=OptimizerConfig(lr=1e-3),
+        total_train_steps=10, remat=False,
+    )
+    assert eng.model_cfg.moe.dispatch == "dropless"
+    assert CFG.moe.dispatch == "capacity"  # caller's config untouched
+
+    monkeypatch.setenv("AREAL_MOE_DISPATCH", "bogus")
+    with pytest.raises(ValueError, match="dispatch"):
         JaxTrainEngine(
-            cfg, params, optimizer_config=OptimizerConfig(lr=1e-3),
-            total_train_steps=10, remat=False, mesh=mesh,
+            CFG, params, optimizer_config=OptimizerConfig(lr=1e-3),
+            total_train_steps=10, remat=False,
+        )
+
+
+def test_moe_config_dict_coercion():
+    """Experiment configs arrive as plain kwargs dicts (cli_args ->
+    factories TransformerConfig(**config)); the nested moe block must
+    coerce to an MoEConfig, typos and all."""
+    cfg = TransformerConfig(
+        n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=1, head_dim=16,
+        intermediate_dim=64, vocab_size=64,
+        moe={"num_experts": 8, "top_k": 2, "dispatch": "dropless"},
+    )
+    assert isinstance(cfg.moe, MoEConfig)
+    assert cfg.moe.num_experts == 8 and cfg.moe.dispatch == "dropless"
+    with pytest.raises(ValueError, match="dispatch"):
+        TransformerConfig(
+            n_layers=2, hidden_dim=32, n_q_heads=2, n_kv_heads=1,
+            head_dim=16, intermediate_dim=64, vocab_size=64,
+            moe={"dispatch": "droppless"},
+        )
+
+
+def test_moe_cli_overrides_end_to_end():
+    """The flat moe_* knobs on ModelTrainEvalConfig overlay the nested
+    config['moe'] block through model_abstraction, and setting them on
+    a dense model refuses instead of silently no-opping."""
+    from areal_tpu.api.cli_args import ModelTrainEvalConfig
+    from areal_tpu.experiments.common import model_abstraction
+
+    base = {
+        "n_layers": 2, "hidden_dim": 32, "n_q_heads": 2, "n_kv_heads": 1,
+        "head_dim": 16, "intermediate_dim": 64, "vocab_size": 64,
+        "moe": {"num_experts": 4, "top_k": 2},
+    }
+    m = ModelTrainEvalConfig(
+        config=dict(base), init_from_scratch=True,
+        moe_dispatch="dropless", moe_capacity_factor=2.0,
+    )
+    out = model_abstraction(m, tokenizer_path=None).args["config"]
+    assert out["moe"]["dispatch"] == "dropless"
+    assert out["moe"]["capacity_factor"] == 2.0
+    assert out["moe"]["num_experts"] == 4  # untouched fields survive
+    assert base["moe"] == {"num_experts": 4, "top_k": 2}  # no mutation
+    # The overlaid dict builds a real model config.
+    cfg = TransformerConfig(**out)
+    assert cfg.moe.dispatch == "dropless"
+    # No knobs -> config passes through untouched.
+    plain = ModelTrainEvalConfig(config=dict(base), init_from_scratch=True)
+    assert model_abstraction(
+        plain, tokenizer_path=None
+    ).args["config"]["moe"] == base["moe"]
+    dense = dict(base)
+    del dense["moe"]
+    with pytest.raises(ValueError, match="no 'moe' block"):
+        model_abstraction(
+            ModelTrainEvalConfig(
+                config=dense, init_from_scratch=True, moe_dispatch="dropless"
+            ),
+            tokenizer_path=None,
         )

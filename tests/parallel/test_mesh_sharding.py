@@ -127,3 +127,57 @@ def test_critic_head_fits_tensor_mesh():
     params = init_params(cfg, jax.random.PRNGKey(5))
     sharded = shard_params(params, mesh)  # must not raise
     assert sharded["head"]["weight"].shape == (32, 1)
+
+
+def test_moe_fsdp_fallback_specs():
+    """When num_experts doesn't divide fsdp, expert weights must fall
+    back to hidden-dim ZeRO sharding, never silent replication (the
+    expert leaves are the bulk of model memory)."""
+    from areal_tpu.parallel.sharding import fitted_param_spec
+
+    mesh = make_mesh(MeshSpec.parse("f2t2"), jax.devices()[:4])
+    # E=4 divides fsdp=2: the expert dim shards.
+    assert fitted_param_spec(
+        "layers/mlp/w_gate", (2, 4, 32, 64), mesh
+    ) == P(None, "fsdp", None, "tensor")
+    # E=3 does not: hidden dim takes the fsdp shard instead.
+    assert fitted_param_spec(
+        "layers/mlp/w_gate", (2, 3, 32, 64), mesh
+    ) == P(None, None, "fsdp", "tensor")
+    assert fitted_param_spec(
+        "layers/mlp/w_up", (2, 3, 32, 64), mesh
+    ) == P(None, None, "fsdp", "tensor")
+    assert fitted_param_spec(
+        "layers/mlp/w_down", (2, 3, 64, 32), mesh
+    ) == P(None, None, "tensor", "fsdp")
+
+
+def test_fitted_param_spec_matches_devices_indices_map():
+    """spec_slices (the weight plane's byte slicer) and
+    NamedSharding.devices_indices_map (what the engine actually places)
+    must agree per device for every MoE leaf shape — including the
+    indivisible-E ZeRO fallback."""
+    from jax.sharding import NamedSharding
+
+    from areal_tpu.parallel.sharding import fitted_param_spec, spec_slices
+
+    mesh = make_mesh(MeshSpec.parse("f2t2"), jax.devices()[:4])
+    cases = [
+        ("layers/mlp/w_gate", (2, 4, 32, 64)),   # EP-shardable
+        ("layers/mlp/w_gate", (2, 3, 32, 64)),   # ZeRO fallback
+        ("layers/mlp/w_down", (2, 3, 64, 32)),   # fallback, F/D swapped
+        ("layers/mlp/router", (2, 32, 4)),       # non-expert leaf
+        ("layers/attn/wq", (2, 32, 32)),
+    ]
+    sizes = dict(mesh.shape)
+    for path, shape in cases:
+        spec = fitted_param_spec(path, shape, mesh)
+        idx_map = NamedSharding(mesh, spec).devices_indices_map(shape)
+        for idx, dev in np.ndenumerate(mesh.devices):
+            coords = dict(zip(mesh.axis_names, map(int, idx)))
+            want = [
+                (sl.start or 0, sl.stop if sl.stop is not None else d)
+                for sl, d in zip(idx_map[dev], shape)
+            ]
+            got = spec_slices(spec, shape, sizes, coords)
+            assert got == want, (path, shape, dev)

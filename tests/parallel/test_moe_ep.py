@@ -103,6 +103,105 @@ def test_moe_ep_gradients_match(params):
     )
 
 
+def _layer_mlp(params):
+    return jax.tree_util.tree_map(lambda a: a[0], params["layers"]["mlp"])
+
+
+def _dropless_cfg():
+    import dataclasses
+
+    return dataclasses.replace(
+        CFG, moe=dataclasses.replace(CFG.moe, dispatch="dropless")
+    )
+
+
+@pytest.mark.parametrize("spec_str", ["f2", "f4", "d2f2", "d1f2t2"])
+def test_moe_dropless_ep_matches_single_device(params, spec_str):
+    """The shard_map EP dropless path (all-gather + local ragged_dot +
+    psum_scatter) must agree with the single-device ragged_dot oracle —
+    per-row matmuls are order-independent, so float32 agreement is
+    essentially exact."""
+    from areal_tpu.models.moe import moe_ep_degree, moe_mlp
+
+    cfg = _dropless_cfg()
+    lp = _layer_mlp(params)
+    x = jax.random.normal(jax.random.PRNGKey(7), (4, 16, CFG.hidden_dim),
+                          jnp.float32)
+    y_ref, aux_ref = moe_mlp(x, lp, cfg, jnp.float32)
+
+    spec = MeshSpec.parse(spec_str)
+    mesh = make_mesh(spec, jax.devices()[: spec.size])
+    assert moe_ep_degree(cfg, mesh, x.shape) == mesh.shape["fsdp"]
+    y_ep, aux_ep = jax.jit(
+        lambda xx: moe_mlp(xx, lp, cfg, jnp.float32, mesh=mesh)
+    )(x)
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.asarray(y_ref), rtol=1e-6, atol=1e-6
+    )
+    assert float(aux_ep["drop_rate"]) == 0.0
+    assert float(aux_ep["a2a_bytes"]) > 0.0
+    np.testing.assert_allclose(
+        np.asarray(aux_ep["load_balance_loss"]),
+        np.asarray(aux_ref["load_balance_loss"]), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(aux_ep["expert_load"]),
+        np.asarray(aux_ref["expert_load"]), rtol=1e-5, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(aux_ep["router_entropy"]),
+        np.asarray(aux_ref["router_entropy"]), rtol=1e-5,
+    )
+
+
+def test_moe_dropless_ep_gradients_match(params):
+    """Backward through the exchange (all_gather <-> psum_scatter are
+    transposes) must match the single-device dropless backward."""
+    from areal_tpu.models.moe import moe_mlp
+
+    cfg = _dropless_cfg()
+    lp = _layer_mlp(params)
+    x = jax.random.normal(jax.random.PRNGKey(8), (4, 16, CFG.hidden_dim),
+                          jnp.float32)
+
+    def loss(p, xx, mesh):
+        y, aux = moe_mlp(xx, p, cfg, jnp.float32, mesh=mesh)
+        return jnp.sum(y.astype(jnp.float32) ** 2) + aux["load_balance_loss"]
+
+    g_ref = jax.grad(loss)(lp, x, None)
+    mesh = make_mesh(MeshSpec.parse("f2"), jax.devices()[:2])
+    g_ep = jax.jit(jax.grad(lambda p, xx: loss(p, xx, mesh)))(lp, x)
+    for k in ("router", "w_gate", "w_up", "w_down"):
+        np.testing.assert_allclose(
+            np.asarray(g_ep[k]), np.asarray(g_ref[k]),
+            rtol=2e-5, atol=2e-6, err_msg=k,
+        )
+
+
+def test_moe_ep_degree_gating():
+    """moe_ep_degree: fsdp extent when it divides E and the activation
+    tiling fits; 1 (GSPMD fallback) otherwise."""
+    import dataclasses
+
+    from areal_tpu.models.moe import moe_ep_degree
+
+    cfg = _dropless_cfg()
+    mesh = make_mesh(MeshSpec.parse("f4"), jax.devices()[:4])
+    assert moe_ep_degree(cfg, mesh) == 4
+    assert moe_ep_degree(cfg, None) == 1
+    # E=6 doesn't divide fsdp=4 -> no shard_map (sharding falls back to
+    # hidden-dim ZeRO, ragged_dot contracts an unsharded expert axis).
+    cfg6 = dataclasses.replace(
+        cfg, moe=dataclasses.replace(cfg.moe, num_experts=6)
+    )
+    assert moe_ep_degree(cfg6, mesh) == 1
+    # Activation rows must tile over (data, fsdp): 3 rows on f2 don't.
+    mesh2 = make_mesh(MeshSpec.parse("f2"), jax.devices()[:2])
+    assert moe_ep_degree(cfg, mesh2, (3, 16, 32)) == 1
+    assert moe_ep_degree(cfg, mesh2, (4, 16, 32)) == 2
+    assert moe_ep_degree(cfg, mesh2, (4, 16)) == 1  # decode [T, D] shapes
+
+
 def test_indivisible_experts_fall_back_to_zero_sharding():
     """E=6 on fsdp=4 can't shard experts — the hidden dim takes the fsdp
     axis instead, so ZeRO-3 never silently degrades to replication."""
