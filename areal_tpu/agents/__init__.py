@@ -2,3 +2,4 @@ from areal_tpu.agents import math_single_step  # noqa: F401  (registers)
 from areal_tpu.agents import envs  # noqa: F401
 from areal_tpu.agents import math_multi_turn  # noqa: F401
 from areal_tpu.agents import null  # noqa: F401
+from areal_tpu.agents import tool_use  # noqa: F401

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from areal_tpu.api.data_api import SequenceSample
@@ -10,7 +12,7 @@ from areal_tpu.api.model_api import BundledGenerationOutputs
 
 def bundle_to_sample(
     qid: str, bundle: BundledGenerationOutputs, rewards: np.ndarray,
-    score: float,
+    score: float, task: Optional[str] = None,
 ) -> SequenceSample:
     """Assemble one grouped trajectory SequenceSample from a generation
     bundle (the packed-keys layout every RL interface consumes; logprobs
@@ -62,5 +64,9 @@ def bundle_to_sample(
             "version_end": [max(bundle.version_end)],
             "scores": [score],
             "birth_time": [0],
+            # Per-task staleness tag (buffer admission windows +
+            # per-task master scalars); None -> untagged, global gate
+            # only.
+            **({"task": [task]} if task is not None else {}),
         },
     )

@@ -183,6 +183,9 @@ class MathMultiTurnAgent(Agent):
                 "version_end": [max(v_end)],
                 "scores": [float(np.mean(successes))],
                 "birth_time": [0],
+                # Per-task staleness tag: math rides the TIGHT admission
+                # window (AREAL_TASK_STALENESS_WINDOWS).
+                "task": [task],
             },
         )
         return [sample]

@@ -87,7 +87,7 @@ class MathSingleStepAgent(Agent):
         )
         from areal_tpu.agents.common import bundle_to_sample
 
-        return [bundle_to_sample(qid, bundle, rewards, score=sr)]
+        return [bundle_to_sample(qid, bundle, rewards, score=sr, task=task)]
 
 
 register_agent("math-single-step", MathSingleStepAgent)

@@ -528,10 +528,13 @@ class AsyncPPOMATHExpConfig(PPOMATHExpConfig):
         metadata={"help": "autoscaler ceiling on fleet size"},
     )
     schedule_policy: str = "round_robin"
-    # rollout agent: "math-single-step" | "math-multi-turn"
+    # rollout agent: "math-single-step" | "math-multi-turn" | "tool-use"
     agent_type: str = "math-single-step"
     agent_num_turns: int = 4
     agent_turn_discount: float = 1.0
+    # tool-use agent only: deterministic tool turns before the model is
+    # trusted to emit its own <tool:...> calls (0 = fully model-driven).
+    agent_scripted_tool_turns: int = 0
 
     def __post_init__(self):
         super().__post_init__()

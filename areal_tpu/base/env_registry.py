@@ -232,6 +232,33 @@ _KNOBS: List[Knob] = [
     _k("AREAL_PYEXEC_TIMEOUT", "float", 6.0,
        "Sandboxed python-answer execution timeout seconds "
        "(functioncall/python_answer.py)."),
+    # -- pooled reward executor (system/reward_executor.py, docs/agentic.md)
+    _k("AREAL_REXEC_WORKERS", "int", 2,
+       "Warm sandbox worker subprocesses per reward-executor service. "
+       "Workers are REUSED across jobs (no per-case fork); a job that "
+       "times out or crashes costs one respawn, not the pool."),
+    _k("AREAL_REXEC_QUEUE_MAX", "int", 64,
+       "Bounded pending-job queue per executor service; submits beyond "
+       "it shed 429 + Retry-After (deliberate backpressure, clients "
+       "fail over / retry elsewhere)."),
+    _k("AREAL_REXEC_MEM_MB", "int", 1024,
+       "RLIMIT_AS ceiling (MiB) applied inside each warm sandbox "
+       "worker at spawn (the code_verify guard, paid once per worker "
+       "instead of once per case)."),
+    _k("AREAL_REXEC_TIMEOUT_S", "float", 6.0,
+       "Default per-job wall timeout on the executor pool; an overrun "
+       "kills + respawns the one worker running the job."),
+    _k("AREAL_REXEC_MAX_REUSE", "int", 0,
+       "Jobs served per warm worker before a preventive recycle "
+       "(leak hygiene for long campaigns); 0 = unlimited reuse."),
+    # -- per-task staleness (system/buffer.py, docs/agentic.md) ----------
+    _k("AREAL_TASK_STALENESS_WINDOWS", "str", "math:2,agentic:8",
+       "Per-task buffer-admission version windows, 'task:window' comma "
+       "list: a sample whose metadata carries a matching `task` tag is "
+       "DROPPED at put_batch when current_train_step - version_end "
+       "exceeds its window (math tight, agentic loose). Samples with "
+       "no/unlisted task tag keep the global gserver-manager gate "
+       "only."),
     # -- RPC substrate (base/rpc.py, docs/fault_tolerance.md) ------------
     _k("AREAL_RPC_ATTEMPTS", "int", 4,
        "Default attempts per cross-process RPC (base/rpc.py "

@@ -138,6 +138,17 @@ _POINTS: List[FaultPoint] = [
        "The trainer dies handing a batch to training, after buffer "
        "admission but before the consumed-seq watermark persists — "
        "the ledger must re-admit exactly once on resume."),
+    _p("rexec.case",
+       ("areal_tpu/system/reward_executor.py",), "sync",
+       "One sandboxed reward job fails inside a warm executor worker "
+       "(guarded exec raises / worker OOM-kill) — the case must come "
+       "back as a failed result, never take the pool or the caller "
+       "down."),
+    _p("rexec.die",
+       ("areal_tpu/system/reward_executor.py",), "sync",
+       "A whole reward-executor service dies mid-flight (container "
+       "kill) — its heartbeat goes stale and clients must fail over "
+       "to a surviving executor with zero failed episodes."),
 ]
 
 REGISTRY: Dict[str, FaultPoint] = {p.name: p for p in _POINTS}

@@ -301,6 +301,42 @@ _METRICS: List[Metric] = [
        "breaker — budget saved, not failures."),
     _m("areal:rpc_breaker_opens", "counter", _GS,
        "closed->open (and failed-probe re-open) breaker transitions."),
+    # -- pooled reward executor (system/reward_executor.py) --------------
+    _m("areal:rexec_jobs_total", "counter",
+       "system/reward_executor.py",
+       "Sandboxed jobs completed (ok or failed) by this executor's "
+       "warm worker pool; saturation-sweep throughput numerator."),
+    _m("areal:rexec_job_failures", "counter",
+       "system/reward_executor.py",
+       "Jobs that returned failed (guarded exec raised, nonzero "
+       "exit, rlimit kill) — episode-level failures, distinct from "
+       "sheds."),
+    _m("areal:rexec_timeouts", "counter",
+       "system/reward_executor.py",
+       "Jobs killed at their wall timeout (the one worker running "
+       "the job is killed + respawned; the pool survives)."),
+    _m("areal:rexec_shed_total", "counter",
+       "system/reward_executor.py",
+       "Submits shed with 429 + Retry-After past the bounded queue "
+       "watermark. Deliberate backpressure, NOT failures — clients "
+       "fail over or back off."),
+    _m("areal:rexec_queue_depth", "gauge",
+       "system/reward_executor.py",
+       "Jobs pending or running on the pool right now; the "
+       "saturation sweep's load signal."),
+    _m("areal:rexec_workers_alive", "gauge",
+       "system/reward_executor.py",
+       "Warm sandbox workers currently alive in the pool."),
+    _m("areal:rexec_worker_respawns", "counter",
+       "system/reward_executor.py",
+       "Worker respawns (timeout kill, crash, preventive recycle) "
+       "since start; the warm-reuse test pins this at 0 under clean "
+       "load."),
+    _m("areal:rexec_warm_hits", "counter",
+       "system/reward_executor.py",
+       "Jobs served by an already-warm worker (no spawn on the job's "
+       "critical path) — the pooled service's whole point; the bench "
+       "asserts warm_hits/jobs ~ 1 after warmup."),
     # ====================================================================
     # perf/* — stats_tracker scalar keys (worker -> master MFC stats
     # payloads; master_worker perf history + bench workloads).
@@ -363,6 +399,26 @@ _METRICS: List[Metric] = [
     _m("perf/reprefill_tokens", "scalar",
        "system/model_function_call.py",
        "Tokens re-prefilled after interrupts this MFC.", reduce="sum"),
+    # Multi-turn episode telemetry (trajectory metadata stamped by the
+    # agents, folded at MFC aggregation like rollout_e2e above).
+    _m("perf/episode_turns", "scalar",
+       "system/model_function_call.py",
+       "Agent turns across the episodes consumed by this train MFC.",
+       reduce="sum"),
+    _m("perf/episode_tool_calls", "scalar",
+       "system/model_function_call.py",
+       "Tool invocations (executor-pool python exec, calculator, "
+       "search) across the consumed episodes.", reduce="sum"),
+    _m("perf/task_staleness_math", "scalar",
+       "system/model_function_call.py",
+       "Mean version lag (train step - version_end) of consumed "
+       "samples tagged task=math — the tight per-task window.",
+       reduce="max"),
+    _m("perf/task_staleness_agentic", "scalar",
+       "system/model_function_call.py",
+       "Mean version lag of consumed samples tagged task=agentic — "
+       "the loose window (multi-turn episodes live longer).",
+       reduce="max"),
     # HBM telemetry (monitor.device_memory_stats, shipped per MFC by
     # model_worker through perf_mem_stats below).
     _m("perf/mem_bytes_in_use", "scalar", "base/monitor.py",
@@ -400,6 +456,12 @@ _METRICS: List[Metric] = [
        "Redelivered/replayed samples dropped at admission because "
        "their sequence id was already journaled or consumed — the "
        "ledger doing its job (each drop is a prevented duplicate)."),
+    _m("areal:train_stale_dropped_total", "counter",
+       "system/buffer.py",
+       "Samples dropped at buffer admission because their task's "
+       "staleness window (AREAL_TASK_STALENESS_WINDOWS) was exceeded "
+       "— per-task admission on top of the gserver manager's global "
+       "allocation gate."),
     _m("areal:train_ckpt_stall_ms", "gauge", "engine/checkpoint.py",
        "Step-loop stall of the most recent engine checkpoint: full "
        "save duration when synchronous, reference-snapshot handoff "

@@ -104,6 +104,20 @@ def fleet_manager_lease(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/fleet_manager_lease"
 
 
+def reward_executor_url(
+    experiment_name: str, trial_name: str, executor_id: str
+) -> str:
+    """HTTP endpoint of one pooled reward-executor service
+    (system/reward_executor.py). Liveness rides the health registry
+    (member ``reward_executor/<id>``); this key is the URL record
+    clients resolve after filtering by heartbeat freshness."""
+    return f"{trial_root(experiment_name, trial_name)}/reward_executor_url/{executor_id}"
+
+
+def reward_executor_url_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/reward_executor_url/"
+
+
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
 

@@ -40,6 +40,7 @@ from typing import Dict, List, Tuple
 GS = "areal_tpu/system/generation_server.py"
 WP = "areal_tpu/system/weight_plane.py"
 MGR = "areal_tpu/system/gserver_manager.py"
+REX = "areal_tpu/system/reward_executor.py"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,10 +66,12 @@ _ROUTES: List[Route] = [
        "the admission watermark — deliberate backpressure clients "
        "retry elsewhere, never a failure.",
        statuses=(429,)),
-    _r("GET", "/metrics", (GS,),
+    _r("GET", "/metrics", (GS, REX),
        "The areal:* text surface (base/metrics_registry.py); polled "
-       "by the manager, the fleet controller rebuild, and the bench."),
-    _r("GET", "/health", (GS,),
+       "by the manager, the fleet controller rebuild, and the bench. "
+       "Reward executors serve their areal:rexec_* lines on the same "
+       "contract."),
+    _r("GET", "/health", (GS, REX),
        "Liveness probe for external supervisors (k8s/LB); in-repo "
        "liveness rides the name_resolve heartbeat registry instead.",
        operator=True),
@@ -140,6 +143,14 @@ _ROUTES: List[Route] = [
        "Origin egress counters for operators attesting peer-fanout "
        "claims (in-repo attestation reads the store in-process).",
        operator=True),
+    # -- pooled reward executor (docs/agentic.md) ------------------------
+    _r("POST", "/rexec/submit", (REX,),
+       "Batched sandboxed reward-job submit (code cases, python tool "
+       "exec, sympy equivalence) against the warm worker pool; sheds "
+       "429 + Retry-After past the bounded pending-queue watermark — "
+       "deliberate backpressure clients fail over on, never a "
+       "failure.",
+       statuses=(429,)),
     # -- gserver manager -------------------------------------------------
     _r("POST", "/schedule_request", (MGR,),
        "Route one rollout request: returns the target server URL (or "

@@ -436,6 +436,24 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="agentic_rollout",
+    entrypoint="areal_tpu.bench.workloads:agentic_rollout_phase",
+    priority=16,
+    est_compile_s=90.0,
+    est_measure_s=240.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Multi-turn tool-use rollouts over real server "
+                "processes + the pooled reward executor: session-"
+                "continuation vs session-blind A/B (re-prefill ratio + "
+                "per-turn TTFT), real sandboxed tool-call latency, zero "
+                "failed episodes, and an executor saturation sweep that "
+                "must shed (429 backpressure) without starving any job "
+                "(CPU-proxy)",
+))
+
+register(PhaseSpec(
     name="prefetch_overlap",
     entrypoint="areal_tpu.bench.workloads:prefetch_overlap_phase",
     priority=11,

@@ -3264,3 +3264,337 @@ def recovery_slo_phase(pass_: str) -> dict:
         if puller is not None:
             puller.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+# ----------------------------------------------------------------------
+# agentic_rollout: multi-turn tool-use episodes over real server
+# processes + the pooled reward executor (system/reward_executor.py).
+# Continuation turns ride the session-prefix path (delta re-prefill +
+# sticky-qid affinity); the baseline arm resubmits every turn session-
+# blind, so the re-prefill ratio is the continuation path's value.
+# ----------------------------------------------------------------------
+
+_AGENTIC_SRV = dict(
+    max_concurrent_requests=4, max_seq_len=256, kv_page_size=16,
+    decode_block_steps=4, prompt_bucket=16, prefill_chunk=16,
+    prefix_cache_tokens=2048, warm_on_start=True,
+)
+_AGENTIC_PLEN = 96
+_AGENTIC_TURN_NEW = 6
+_AGENTIC_TURNS = 3
+_AGENTIC_EPISODES = 4
+# Fixed "tool output" token frame appended between turns (vocab 256);
+# the bench drives the PRM + executor wire directly — the tokenizer-level
+# tool grammar lives in agents/tool_use.py and its own e2e.
+_AGENTIC_TOOL_TOKENS = [7, 11, 13, 5]
+_AGENTIC_TOOL_JOB = {"kind": "python", "code": "print(sum(range(100)))"}
+# Saturation-sweep job: holds a warm worker ~50ms so the bounded
+# queue actually fills at the top offered level and 429s happen.
+_AGENTIC_SAT_JOB = {
+    "kind": "python",
+    "code": "import time; time.sleep(0.05); print(1)",
+}
+
+
+def _agentic_prompt(i: int):
+    rng = np.random.RandomState(4200 + i)
+    return rng.randint(1, _OPENLOOP_MODEL["vocab_size"],
+                       size=_AGENTIC_PLEN).tolist()
+
+
+def _agentic_episodes(fleet, pool_client, n_episodes, n_turns, tag,
+                      continuation):
+    """Run n_episodes concurrent n_turn episodes through a fresh
+    PartialRolloutManager. Continuation arm: one sticky session qid per
+    episode, turns 2+ submitted as continuations. Baseline arm: a fresh
+    qid per TURN, so every turn pays the session-blind full prefill.
+    Returns per-arm accounting incl. the PRM's prefill counters."""
+    import asyncio
+
+    from areal_tpu.api.model_api import GenerationHyperparameters
+    from areal_tpu.system.partial_rollout import PartialRolloutManager
+
+    tool_ms: list = []
+    failed = [0]
+    tool_failures = [0]
+
+    async def episode(prm, i):
+        prompt = _agentic_prompt(i)
+        g = GenerationHyperparameters(
+            max_new_tokens=_AGENTIC_TURN_NEW, greedy=True
+        )
+        ids = list(prompt)
+        for turn in range(n_turns):
+            qid = (f"{tag}{i}" if continuation
+                   else f"{tag}{i}-t{turn}")
+            out = await prm._generate_one(
+                qid, list(ids), g,
+                continuation=continuation and turn > 0,
+            )
+            if len(out.output_ids) < 1:
+                raise RuntimeError(f"empty turn {turn} on {qid}")
+            ids += [int(t) for t in out.output_ids]
+            if turn < n_turns - 1:
+                # One real sandboxed tool call between turns, off the
+                # episode's event loop like the production envs.
+                t0 = time.perf_counter()
+                res = (await asyncio.get_event_loop().run_in_executor(
+                    None, pool_client.submit, [dict(_AGENTIC_TOOL_JOB)]
+                ))[0]
+                tool_ms.append((time.perf_counter() - t0) * 1e3)
+                if not res.get("ok"):
+                    tool_failures[0] += 1
+                ids += _AGENTIC_TOOL_TOKENS
+
+    async def run_all():
+        prm = PartialRolloutManager(
+            fleet.manager_addr(), request_timeout=120.0,
+            max_retries=8, retry_backoff_s=0.1,
+        )
+        try:
+            results = await asyncio.gather(
+                *[episode(prm, i) for i in range(n_episodes)],
+                return_exceptions=True,
+            )
+            for r in results:
+                if isinstance(r, BaseException):
+                    failed[0] += 1
+                    log(f"bench: agentic episode failed: {r!r}")
+            return (prm.reprefill_tokens_total,
+                    prm.full_prefill_tokens_total)
+        finally:
+            await prm.close()
+
+    base_ttft = fleet.hist_counts(fleet.urls)["ttft"]
+    t0 = time.monotonic()
+    reprefill, full = asyncio.run(run_all())
+    wall = time.monotonic() - t0
+    after_ttft = fleet.hist_counts(fleet.urls)["ttft"]
+    dt = [max(0, a - b) for a, b in zip(after_ttft, base_ttft)]
+    from areal_tpu.base.latency import percentile_from_counts
+
+    return {
+        "episodes": n_episodes,
+        "failed": failed[0],
+        "wall_s": wall,
+        "ttft_p50_ms": percentile_from_counts(dt, 50.0),
+        "ttft_p99_ms": percentile_from_counts(dt, 99.0),
+        "tool_ms": tool_ms,
+        "tool_failures": tool_failures[0],
+        "reprefill_tokens": float(reprefill),
+        "full_prefill_tokens": float(full),
+    }
+
+
+def _agentic_saturation_sweep(url: str, levels=(2, 8, 24)) -> dict:
+    """Offered-concurrency sweep straight at ONE executor's bounded
+    queue: `level` submitter threads, each posting small batches until
+    its share of jobs is done. Sheds (429 + Retry-After) are expected at
+    the top level — the client-side retry loop must absorb every one of
+    them (backpressure, not starvation)."""
+    import concurrent.futures as cf
+
+    from areal_tpu.base import rpc
+    from areal_tpu.functioncall.remote import _post_json_sync
+
+    policy = rpc.RetryPolicy(
+        attempts=12, backoff_base_s=0.1, backoff_max_s=1.0,
+        attempt_timeout_s=30.0,
+    )
+    points = []
+    for level in levels:
+        jobs_per_thread = 2
+        batch = 2
+
+        def submit_one(_i):
+            def attempt(timeout):
+                out = _post_json_sync(
+                    url + "/rexec/submit",
+                    {"jobs": [dict(_AGENTIC_SAT_JOB)] * batch,
+                     "timeout_s": 10.0},
+                    timeout,
+                )
+                return out["results"]
+
+            results = rpc.retry_sync(
+                attempt, policy=policy, what="rexec saturation",
+            )
+            return sum(1 for r in results if r.get("ok"))
+
+        t0 = time.perf_counter()
+        n_jobs = level * jobs_per_thread * batch
+        ok = 0
+        fails = 0
+        with cf.ThreadPoolExecutor(level) as ex:
+            futs = [ex.submit(submit_one, i)
+                    for i in range(level * jobs_per_thread)]
+            for f in futs:
+                try:
+                    ok += f.result()
+                except Exception as e:
+                    fails += batch
+                    log(f"bench: saturation submit failed: {e!r}")
+        dt = time.perf_counter() - t0
+        points.append({
+            "offered_threads": float(level),
+            "jobs": float(n_jobs),
+            "jobs_ok": float(ok),
+            "jobs_failed": float(n_jobs - ok),
+            "jobs_per_s": n_jobs / max(1e-9, dt),
+        })
+        log(f"bench: agentic saturation point {points[-1]}")
+    return {
+        "points": points,
+        "peak_jobs_per_s": max(p["jobs_per_s"] for p in points),
+        "failed": sum(p["jobs_failed"] for p in points),
+    }
+
+
+def _rexec_metrics(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            out[parts[0]] = float(parts[1])
+    return out
+
+
+def agentic_rollout_phase(pass_: str) -> dict:
+    from areal_tpu.base import rpc
+    from areal_tpu.bench.fleet import ProcessFleet
+    from areal_tpu.functioncall.remote import ExecutorPoolClient
+    from areal_tpu.system.reward_executor import RewardExecutorService
+
+    t_start = time.monotonic()
+
+    if pass_ == "compile":
+        # One server, one 2-turn continuation episode + one sandboxed
+        # job: compiles the chunked prefill and decode-block programs
+        # into the persistent cache; the executor pool has nothing to
+        # compile (warm subprocess workers).
+        t0 = time.perf_counter()
+        with ProcessFleet(
+            _OPENLOOP_MODEL, [dict(_AGENTIC_SRV)], tag="agrc",
+        ) as fleet:
+            svc = RewardExecutorService(
+                fleet.exp, fleet.trial, executor_id=0, n_workers=1,
+            )
+            svc.start()
+            try:
+                client = ExecutorPoolClient(fleet.exp, fleet.trial)
+                arm = _agentic_episodes(
+                    fleet, client, 1, 2, "c", continuation=True
+                )
+                assert arm["failed"] == 0, arm
+            finally:
+                svc.stop()
+        dt = time.perf_counter() - t0
+        log(f"bench: agentic_rollout compile pass {dt:.1f}s")
+        return {"compile_s": dt}
+
+    svc = None
+    sat_svc = None
+    with ProcessFleet(
+        _OPENLOOP_MODEL, [dict(_AGENTIC_SRV)] * 2, tag="agrm",
+    ) as fleet:
+        try:
+            svc = RewardExecutorService(
+                fleet.exp, fleet.trial, executor_id=0, n_workers=2,
+            )
+            svc.start()
+            client = ExecutorPoolClient(
+                fleet.exp, fleet.trial,
+                policy=rpc.RetryPolicy(
+                    attempts=8, backoff_base_s=0.1, backoff_max_s=1.0,
+                    attempt_timeout_s=60.0,
+                ),
+            )
+
+            # --- Arm A: session-blind baseline — fresh qid per turn,
+            # every turn re-prefills its whole conversation.
+            base = _agentic_episodes(
+                fleet, client, _AGENTIC_EPISODES, _AGENTIC_TURNS, "b",
+                continuation=False,
+            )
+
+            # --- Arm B: continuation — sticky session qid, turns 2+
+            # re-prefill only the turn delta past the parked prefix.
+            hits0 = sum(
+                fleet.metrics(u).get(mreg.PREFIX_CACHE_HITS, 0.0)
+                for u in fleet.urls
+            )
+            cont = _agentic_episodes(
+                fleet, client, _AGENTIC_EPISODES, _AGENTIC_TURNS, "s",
+                continuation=True,
+            )
+            affinity_hits = sum(
+                fleet.metrics(u).get(mreg.PREFIX_CACHE_HITS, 0.0)
+                for u in fleet.urls
+            ) - hits0
+            em = _rexec_metrics(svc.address)
+
+            # --- Executor saturation sweep against a dedicated
+            # small-queue service (the episode service keeps its big
+            # queue; backpressure evidence needs a tight watermark).
+            svc.stop()
+            svc = None
+            sat_svc = RewardExecutorService(
+                fleet.exp, fleet.trial, executor_id=1, n_workers=2,
+                queue_max=6,
+            )
+            sat_svc.start()
+            sat = _agentic_saturation_sweep(sat_svc.address)
+            sat_m = _rexec_metrics(sat_svc.address)
+        finally:
+            for s in (svc, sat_svc):
+                if s is not None:
+                    s.stop()
+
+    n_turns_total = _AGENTIC_EPISODES * _AGENTIC_TURNS
+    tool_all = base["tool_ms"] + cont["tool_ms"]
+    tool_sorted = sorted(tool_all) or [0.0]
+    full = max(1.0, cont["full_prefill_tokens"])
+    out = {
+        "episodes": float(_AGENTIC_EPISODES * 2),
+        "turns_per_episode": float(_AGENTIC_TURNS),
+        "failed_episodes": float(base["failed"] + cont["failed"]),
+        "episodes_per_s": _AGENTIC_EPISODES / max(1e-9, cont["wall_s"]),
+        "turn_ttft_p50_ms": cont["ttft_p50_ms"],
+        "turn_ttft_p99_ms": cont["ttft_p99_ms"],
+        "baseline_turn_ttft_p50_ms": base["ttft_p50_ms"],
+        "baseline_turn_ttft_p99_ms": base["ttft_p99_ms"],
+        "tool_calls": float(len(tool_all)),
+        "tool_failures": float(
+            base["tool_failures"] + cont["tool_failures"]
+        ),
+        "tool_call_ms_p50": tool_sorted[len(tool_sorted) // 2],
+        "tool_call_ms_p99": tool_sorted[-1],
+        "reprefill_tokens": cont["reprefill_tokens"],
+        "full_prefill_tokens": cont["full_prefill_tokens"],
+        "reprefill_ratio": cont["reprefill_tokens"] / full,
+        "affinity_prefix_hits": float(affinity_hits),
+        "exec_jobs_total": em.get(mreg.REXEC_JOBS_TOTAL, 0.0),
+        "exec_warm_hits": em.get(mreg.REXEC_WARM_HITS, 0.0),
+        "exec_worker_respawns": em.get(mreg.REXEC_WORKER_RESPAWNS, 0.0),
+        "exec_workers_alive": em.get(mreg.REXEC_WORKERS_ALIVE, 0.0),
+        "sat_points": sat["points"],
+        "sat_peak_jobs_per_s": sat["peak_jobs_per_s"],
+        "sat_failed": sat["failed"],
+        "sat_shed_total": sat_m.get(mreg.REXEC_SHED_TOTAL, 0.0),
+        "n_turns_total": float(n_turns_total * 2),
+        "fleet": "process",
+        "wall_s": time.monotonic() - t_start,
+    }
+    log(
+        f"bench: agentic_rollout: {out['episodes']:.0f} episodes "
+        f"({out['failed_episodes']:.0f} failed), re-prefill ratio "
+        f"{out['reprefill_ratio']:.3f} vs session-blind 1.0, turn TTFT "
+        f"p50 {out['turn_ttft_p50_ms']:.0f}ms vs baseline "
+        f"{out['baseline_turn_ttft_p50_ms']:.0f}ms, tool p50 "
+        f"{out['tool_call_ms_p50']:.0f}ms, sheds "
+        f"{out['sat_shed_total']:.0f}"
+    )
+    return out

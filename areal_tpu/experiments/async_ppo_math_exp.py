@@ -31,8 +31,21 @@ from areal_tpu.experiments.ppo_math_exp import actor_interface_args
 
 def _agent_abstraction(cfg: AsyncPPOMATHExpConfig) -> AgentAbstraction:
     """Rollout agent from config: `agent_type` picks "math-single-step"
-    (default; one group per prompt) or "math-multi-turn" (feedback loop,
-    reference math_multi_turn_agent.py)."""
+    (default; one group per prompt), "math-multi-turn" (feedback loop,
+    reference math_multi_turn_agent.py), or "tool-use" (multi-turn tool
+    calls through the pooled reward executor, agents/tool_use.py)."""
+    if cfg.agent_type == "tool-use":
+        return AgentAbstraction(
+            "tool-use",
+            args=dict(
+                gconfig=dataclasses.asdict(cfg.ppo.gconfig.new(n=1)),
+                num_turns=cfg.agent_num_turns,
+                turn_level_discount=cfg.agent_turn_discount,
+                reward_scaling=cfg.ppo.reward_output_scaling,
+                reward_bias=cfg.ppo.reward_output_bias,
+                scripted_tool_turns=cfg.agent_scripted_tool_turns,
+            ),
+        )
     if cfg.agent_type == "math-multi-turn":
         return AgentAbstraction(
             "math-multi-turn",
@@ -234,7 +247,10 @@ def build_async_ppo_math_experiment(cfg: AsyncPPOMATHExpConfig) -> ExperimentCon
             n_pullers=n_workers,
             model_name=actor.role,
             agent=_agent_abstraction(cfg),
-            env=EnvServiceAbstraction("math-code-single-step"),
+            env=EnvServiceAbstraction(
+                "tool-use" if cfg.agent_type == "tool-use"
+                else "math-code-single-step"
+            ),
             datasets=[C.dataset_abstraction(cfg.dataset)],
             tokenizer_path=cfg.tokenizer_path or cfg.actor.path,
             new_tokens_per_chunk=cfg.ppo.new_tokens_per_chunk,

@@ -265,12 +265,15 @@ def _sympy_worker(a: str, b: str, q) -> None:
     q.put(_sympy_equal_raw(a, b))
 
 
-def _sympy_equal(a: str, b: str, timeout: float = SYMPY_TIMEOUT_S) -> bool:
-    """sympy equivalence in a child process with a hard timeout —
-    simplify() can hang on adversarial model outputs, and a stuck reward
-    stalls the whole rollout pipeline (reference grader.py:337)."""
-    if len(a) > 400 or len(b) > 400:  # refuse adversarially long inputs
-        return False
+def _sympy_equal_local(
+    a: str, b: str, timeout: float = SYMPY_TIMEOUT_S
+) -> bool:
+    """Fork-per-call fallback path: sympy equivalence in a fresh child
+    with a hard timeout — simplify() can hang on adversarial model
+    outputs, and a stuck reward stalls the whole rollout pipeline
+    (reference grader.py:337). Pays a cold sympy import every call; the
+    pooled executor path amortizes that, but this MUST keep working
+    standalone (no executor fleet in unit tests / small runs)."""
     ctx = multiprocessing.get_context("fork")
     q = ctx.Queue(1)
     p = ctx.Process(target=_sympy_worker, args=(a, b, q), daemon=True)
@@ -287,6 +290,27 @@ def _sympy_equal(a: str, b: str, timeout: float = SYMPY_TIMEOUT_S) -> bool:
     finally:
         if p.is_alive():
             p.kill()
+
+
+def _sympy_equal(a: str, b: str, timeout: float = SYMPY_TIMEOUT_S) -> bool:
+    """sympy equivalence, routed through the warm reward-executor pool
+    when one is registered (functioncall/remote.py) so hot grading paths
+    skip the cold fork+import; falls back to the local fork-per-call
+    sandbox whenever no pool is registered, none is live, or the pooled
+    job itself errors (an executor outage must degrade to slower
+    grading, never to wrong grades)."""
+    if len(a) > 400 or len(b) > 400:  # refuse adversarially long inputs
+        return False
+    from areal_tpu.functioncall import remote
+
+    pool = remote.get_executor_pool()
+    if pool is not None and pool.available():
+        res = pool.submit(
+            [{"kind": "sympy_equal", "a": a, "b": b}], timeout_s=timeout
+        )[0]
+        if res.get("ok"):
+            return bool(res.get("equal"))
+    return _sympy_equal_local(a, b, timeout)
 
 
 # ---------------------------------------------------------------------------

@@ -21,11 +21,14 @@ False and callers use the local verifiers.
 from __future__ import annotations
 
 import asyncio
+import json
 import os
+import threading
 from typing import Any, Dict, List, Optional
 
 from areal_tpu.base import logging as areal_logging
-from areal_tpu.base import rpc
+from areal_tpu.base import name_resolve, names, rpc
+from areal_tpu.base.health import HealthRegistry
 
 logger = areal_logging.getLogger("functioncall.remote")
 
@@ -143,3 +146,181 @@ def batch_verify(
     return asyncio.run(
         batch_verify_async(payloads, task, domain=domain, timeout_s=timeout_s)
     )
+
+
+# ----------------------------------------------------------------------
+# Pooled reward-executor client (system/reward_executor.py)
+# ----------------------------------------------------------------------
+
+
+def _post_json_sync(
+    url: str,
+    payload: Dict[str, Any],
+    timeout: float,
+    deadline: Optional[rpc.Deadline] = None,
+) -> Any:
+    """One POST attempt on the executor wire, mapped onto the substrate's
+    exception contract: 429 -> RpcShed (Retry-After floored backoff),
+    5xx/connection -> retryable OSError, other codes -> terminal."""
+    import urllib.error
+    import urllib.request
+
+    dl = deadline or rpc.Deadline.after(timeout)
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers=dl.headers({"Content-Type": "application/json"}),
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 429:
+            ra = e.headers.get("Retry-After") if e.headers else None
+            raise rpc.RpcShed(url, float(ra or 1.0)) from e
+        if e.code >= 500:
+            raise OSError(f"{url}: server error {e.code}") from e
+        raise rpc.RpcError(f"{url}: HTTP {e.code}") from e
+    except urllib.error.URLError as e:
+        raise OSError(f"{url}: {e.reason}") from e
+
+
+class ExecutorPoolClient:
+    """Client for the pooled reward-executor fleet.
+
+    Discovery rides the PR 1 health registry (members
+    ``reward_executor/<id>``, payload carries the URL) with the
+    ``names.reward_executor_url`` records as fallback, so a freshly
+    armed `rexec.die` chaos kill drops out of the candidate set within
+    one staleness window. Submits round-robin across live executors and
+    fail over on connection errors/sheds via the unified retry loop
+    (base/rpc.py) — every retry RE-discovers, so a death mid-batch
+    lands on a survivor. Exhaustion returns failed RESULTS, never an
+    exception: a reward must never take the trainer down."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        trial_name: str,
+        policy: Optional[rpc.RetryPolicy] = None,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self._registry = HealthRegistry(
+            experiment_name, trial_name, prefix="reward_executor"
+        )
+        self._policy = policy
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._cache: List[str] = []
+        self._cache_ts = -1e9
+
+    def discover(self, fresh: bool = False, max_age_s: float = 2.0) -> List[str]:
+        """Live executor URLs, heartbeat-fresh first. Cached briefly so
+        hot grading paths don't pay a registry walk per call; failover
+        retries pass ``fresh=True`` to re-scan past a just-died peer."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            if not fresh and now - self._cache_ts < max_age_s:
+                return list(self._cache)
+        urls = self._discover_uncached()
+        with self._lock:
+            self._cache = list(urls)
+            self._cache_ts = now
+        return urls
+
+    def _discover_uncached(self) -> List[str]:
+        urls: List[str] = []
+        for _m, rec in sorted(self._registry.snapshot().items()):
+            u = rec.get("url")
+            if u:
+                urls.append(u)
+        if not urls:
+            root = names.reward_executor_url_root(
+                self.experiment_name, self.trial_name
+            ).rstrip("/")
+            for key in sorted(name_resolve.find_subtree(root)):
+                try:
+                    urls.append(name_resolve.get(key))
+                except name_resolve.NameEntryNotFoundError:
+                    continue
+        return urls
+
+    def available(self) -> bool:
+        return bool(self.discover())
+
+    def submit(
+        self,
+        jobs: List[Dict[str, Any]],
+        timeout_s: Optional[float] = None,
+        deadline: Optional[rpc.Deadline] = None,
+    ) -> List[Dict[str, Any]]:
+        """Run a job batch on some live executor; aligned results."""
+        if not jobs:
+            return []
+        from areal_tpu.base import env_registry
+
+        timeout_s = timeout_s or env_registry.get_float(
+            "AREAL_REXEC_TIMEOUT_S"
+        )
+        # The HTTP attempt must outlive the sandbox wall timeout of the
+        # slowest wave of jobs across the pool, plus dispatch slack.
+        http_timeout = timeout_s * max(1, len(jobs)) + 10.0
+        policy = self._policy or rpc.default_policy(
+            attempt_timeout_s=http_timeout
+        )
+
+        attempt_no = {"n": 0}
+
+        def attempt(attempt_timeout: float) -> List[Dict[str, Any]]:
+            attempt_no["n"] += 1
+            urls = self.discover(fresh=attempt_no["n"] > 1)
+            if not urls:
+                raise OSError("no live reward executor")
+            with self._lock:
+                self._rr += 1
+                url = urls[self._rr % len(urls)]
+            out = _post_json_sync(
+                url + "/rexec/submit",
+                {"jobs": jobs, "timeout_s": timeout_s},
+                attempt_timeout,
+                deadline,
+            )
+            results = out.get("results") if isinstance(out, dict) else None
+            if not isinstance(results, list) or len(results) != len(jobs):
+                raise ValueError("malformed executor reply")
+            return results
+
+        try:
+            return rpc.retry_sync(
+                attempt, policy=policy, deadline=deadline,
+                what="rexec submit",
+            )
+        except (rpc.RpcError, Exception) as e:
+            logger.error(f"executor pool submit failed permanently: {e!r}")
+            return [
+                {"ok": False, "error": f"executor unavailable: {e}"}
+                for _ in jobs
+            ]
+
+
+_executor_pool: Optional[ExecutorPoolClient] = None
+_executor_pool_lock = threading.Lock()
+
+
+def register_executor_pool(client: Optional[ExecutorPoolClient]):
+    """Install (or clear, with None) the process-wide executor-pool
+    client. Rollout/trainer workers register one at startup when the
+    experiment runs a pooled executor fleet; math_grader and the tool
+    envs then route sandboxed work through it."""
+    global _executor_pool
+    with _executor_pool_lock:
+        _executor_pool = client
+
+
+def get_executor_pool() -> Optional[ExecutorPoolClient]:
+    with _executor_pool_lock:
+        return _executor_pool

@@ -17,11 +17,32 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from areal_tpu.api.data_api import SequenceSample
 from areal_tpu.api.dfg import MFCDef
-from areal_tpu.base import logging, tracing
+from areal_tpu.base import env_registry, logging, tracing
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.system.wal import SeqLedger
 
 logger = logging.getLogger("buffer")
+
+
+def parse_task_windows(spec: Optional[str]) -> Dict[str, int]:
+    """Parse AREAL_TASK_STALENESS_WINDOWS ('math:2,agentic:8') into
+    task tag -> max admitted version lag. Malformed entries are skipped
+    loudly — a typo'd window must not silently drop a task's samples."""
+    out: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        task, sep, win = part.partition(":")
+        try:
+            if not sep:
+                raise ValueError("missing ':'")
+            out[task.strip()] = int(win)
+        except ValueError:
+            logger.warning(
+                "ignoring malformed task-staleness entry %r", part
+            )
+    return out
 
 
 @dataclasses.dataclass
@@ -79,7 +100,21 @@ class AsyncIOSequenceBuffer:
         # The invariant DETECTOR, not a dedup count: a sample whose seq
         # was already ledger-marked reaching full consumption again.
         # Expected 0 — the kill-anywhere e2e asserts exactly that.
-        self.counters = {"areal:train_samples_duplicated_total": 0}
+        # train_stale_dropped counts per-task staleness-window drops at
+        # admission (below).
+        self.counters = {
+            "areal:train_samples_duplicated_total": 0,
+            "areal:train_stale_dropped_total": 0,
+        }
+        # Per-task admission windows on top of the gserver manager's
+        # GLOBAL allocation gate: a task tag listed here is dropped at
+        # put_batch once current_train_step - version_end exceeds its
+        # window (math wants tight on-policyness; slow agentic episodes
+        # tolerate a loose one). Untagged/unlisted samples keep the
+        # global gate only.
+        self.task_windows = parse_task_windows(
+            env_registry.get_str("AREAL_TASK_STALENESS_WINDOWS")
+        )
         # Advanced by the master each step; stamped on buffer.wait spans
         # so the trace report can derive staleness (train step minus the
         # policy version that STARTED the sample's generation).
@@ -107,11 +142,21 @@ class AsyncIOSequenceBuffer:
             resident_dups = set()
             ignored_seen = set()
             ledgered = set()
+            stale = set()
             for s in samples:
                 seqs = s.metadata.get("wal_seq")
+                tasks = s.metadata.get("task")
+                v_ends = s.metadata.get("version_end")
                 for i in range(s.bs):
                     sample_id = s.ids[i]
                     seq = seqs[i] if seqs else None
+                    task = tasks[i] if tasks else None
+                    win = self.task_windows.get(task) if task else None
+                    if win is not None and v_ends:
+                        lag = self.current_train_step - int(v_ends[i])
+                        if lag > win:
+                            stale.add(sample_id)
+                            continue
                     if seq is not None and (
                         seq in self.seq_ledger
                         or (seq in self._seq_pending
@@ -146,6 +191,14 @@ class AsyncIOSequenceBuffer:
                     "admission (total %d)",
                     len(ledgered), self.n_ledger_filtered,
                 )
+            if stale:
+                self.counters["areal:train_stale_dropped_total"] += len(stale)
+                logger.info(
+                    "per-task staleness window dropped %d sample(s) at "
+                    "admission (total %d)",
+                    len(stale),
+                    self.counters["areal:train_stale_dropped_total"],
+                )
             if resident_dups:
                 self.n_dropped_duplicates += len(resident_dups)
                 logger.warning(
@@ -165,7 +218,7 @@ class AsyncIOSequenceBuffer:
                 for sid in range(s.bs):
                     sub = s._select_indices([sid]) if s.bs > 1 else s
                     sample_id = sub.ids[0]
-                    if sample_id in ledgered:
+                    if sample_id in ledgered or sample_id in stale:
                         continue
                     if sample_id in self.ignore_ids:
                         # consumed before a crash; skip exactly once

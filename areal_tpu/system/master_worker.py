@@ -320,6 +320,13 @@ class MasterWorker(Worker):
                     mreg.PERF_MOE_ROUTER_ENTROPY,
                     mreg.PERF_MOE_EXPERT_OVERLOAD,
                     mreg.PERF_MOE_A2A_BYTES,
+                    # Agentic episodes (PR 18): turn/tool-call volume and
+                    # the PER-TASK staleness means that back the split
+                    # admission windows (math tight, agentic loose).
+                    mreg.PERF_EPISODE_TURNS,
+                    mreg.PERF_EPISODE_TOOL_CALLS,
+                    mreg.PERF_TASK_STALENESS_MATH,
+                    mreg.PERF_TASK_STALENESS_AGENTIC,
                 ):
                     # Input-pipeline telemetry: per-MFC series + running
                     # mean in perf_summary["overlap"].

@@ -228,6 +228,38 @@ class ModelFunctionCall:
             ]
             if reprefill:
                 stats["perf/reprefill_tokens"] = float(np.sum(reprefill))
+            turns = [
+                int(v)
+                for v in batch.metadata.get("turns") or []
+                if isinstance(v, (int, float))
+            ]
+            if turns:
+                stats["perf/episode_turns"] = float(np.mean(turns))
+            tool_calls = [
+                int(v)
+                for v in batch.metadata.get("tool_calls") or []
+                if isinstance(v, (int, float))
+            ]
+            if tool_calls:
+                stats["perf/episode_tool_calls"] = float(np.mean(tool_calls))
+            # Per-task staleness actually consumed this step: train-step
+            # lag of each sample's version_end, split by its task tag, so
+            # the tight math window and the loose agentic window are both
+            # observable on the dashboard.
+            tasks = batch.metadata.get("task") or []
+            v_ends = batch.metadata.get("version_end") or []
+            step = int(self.buffer.current_train_step)
+            for tag, key in (
+                ("math", "perf/task_staleness_math"),
+                ("agentic", "perf/task_staleness_agentic"),
+            ):
+                lags = [
+                    step - int(v)
+                    for t, v in zip(tasks, v_ends)
+                    if t == tag and isinstance(v, (int, float))
+                ]
+                if lags:
+                    stats[key] = float(np.mean(lags))
         # DP workers run concurrently: wall time is the max, flops add,
         # so MFC TFLOP/s is aggregate-over-workers per wall second.
         if stats.get("perf/flops") and stats.get("perf/sec"):

@@ -76,6 +76,21 @@ class PartialRolloutManager:
         # it instead of dying with their accumulated tokens.
         self._addr_resolver = addr_resolver
         self._session: Optional[aiohttp.ClientSession] = None
+        # Session continuation state: member qid -> total tokens
+        # (prompt + output) the fleet has already prefilled/generated
+        # for that session. A continuation turn re-prefills only the
+        # delta beyond this (the parked prefix KV covers the rest via
+        # the manager's sticky-qid affinity), so multi-turn agents pay
+        # per-turn deltas instead of whole-conversation re-prefills.
+        self._session_prefix: Dict[str, int] = {}
+        self._session_prefix_cap = 4096
+        # Client-side prefill accounting (successful chunks only):
+        # reprefill is what the fleet actually re-prefilled, full is the
+        # session-blind counterfactual — the bench's re-prefill ratio is
+        # their quotient. Plain ints mutated from this client's single
+        # owning loop.
+        self.reprefill_tokens_total = 0
+        self.full_prefill_tokens_total = 0
 
     def _refresh_manager_addr(self):
         if self._addr_resolver is None:
@@ -116,16 +131,29 @@ class PartialRolloutManager:
             return await r.json()
 
     async def _generate_one(
-        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+        self,
+        qid: str,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        continuation: bool = False,
     ) -> APIGenerateOutput:
         """Generate one sample, chunk by chunk, resubmitting with the
         accumulated prefix after interrupts (reference _run_gen:92,
         refresh_generation:181)."""
-        with tracing.span("gen.sample", qid=qid, prompt_len=len(prompt_ids)):
-            return await self._generate_one_impl(qid, prompt_ids, gconfig)
+        with tracing.span(
+            "gen.sample", qid=qid, prompt_len=len(prompt_ids),
+            continuation=continuation,
+        ):
+            return await self._generate_one_impl(
+                qid, prompt_ids, gconfig, continuation
+            )
 
     async def _generate_one_impl(
-        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+        self,
+        qid: str,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        continuation: bool = False,
     ) -> APIGenerateOutput:
         acc_out: List[int] = []
         acc_lp: List[float] = []
@@ -163,6 +191,15 @@ class PartialRolloutManager:
         # re-prefill report quantifies.
         reprefill_tokens = 0
         n_interruptions = 0
+        # Continuation turns: the session key already generated earlier
+        # turns on the fleet, so only the tokens BEYOND the known prefix
+        # (the previous turn's feedback / tool output) are re-prefill
+        # work — the sticky-qid route lands on the server whose prefix
+        # cache holds the rest. A session this client never saw gets the
+        # conservative full-prompt accounting.
+        known_len = (
+            self._session_prefix.get(qid, 0) if continuation else 0
+        )
         budget = gconfig.max_new_tokens
         sess = await self._sess()
         while budget > 0:
@@ -239,10 +276,16 @@ class PartialRolloutManager:
             kv_source = sched.get("kv_source")
             chunk = min(budget, self.new_tokens_per_chunk)
             # A resubmission carries the accumulated prefix: every token
-            # of prompt+prefix is prefill work the server repeats.
-            chunk_reprefill = (
-                len(prompt_ids) + len(acc_out) if acc_out else 0
-            )
+            # of prompt+prefix is prefill work the server repeats. A
+            # continuation's FIRST submission repeats only the turn
+            # delta past the fleet-known session prefix.
+            full_prefill = len(prompt_ids) + len(acc_out)
+            if acc_out:
+                chunk_reprefill = full_prefill
+            elif continuation:
+                chunk_reprefill = max(0, len(prompt_ids) - known_len)
+            else:
+                chunk_reprefill = 0
             # Manual span: reprefill_tokens is stamped only on the
             # SUCCESSFUL attempt, so the trace-derived re-prefill total
             # matches the client accounting below even when failed
@@ -258,8 +301,10 @@ class PartialRolloutManager:
                     input_ids=list(prompt_ids) + acc_out,
                     # Continuations/re-prefills admit ahead of fresh
                     # requests (engine priority class 0): their prefix
-                    # pages are already paid for.
-                    priority=0 if acc_out else 1,
+                    # pages are already paid for. Session continuations
+                    # (multi-turn episodes) ride the same class — an
+                    # in-flight episode beats a fresh prompt.
+                    priority=0 if (acc_out or continuation) else 1,
                     gconfig=dict(
                         max_new_tokens=chunk,
                         min_new_tokens=max(
@@ -312,6 +357,12 @@ class PartialRolloutManager:
                         if chunk_span is not None:
                             chunk_span.end(
                                 reprefill_tokens=chunk_reprefill,
+                                # The counterfactual: what a session-
+                                # blind client would have re-prefilled.
+                                # The trace e2e asserts continuation
+                                # deltas stay strictly below it.
+                                full_prefill_tokens=full_prefill,
+                                continuation=continuation,
                                 n_tokens=len(out.get("output_ids") or []),
                             )
             except (
@@ -364,6 +415,8 @@ class PartialRolloutManager:
                 version_start = int(out.get("version_start", server_version))
             version_end = int(out.get("version_end", server_version))
             reprefill_tokens += chunk_reprefill
+            self.reprefill_tokens_total += chunk_reprefill
+            self.full_prefill_tokens_total += full_prefill
             if out.get("interrupted", False):
                 n_interruptions += 1
                 tracing.event(
@@ -391,6 +444,15 @@ class PartialRolloutManager:
             # chunk budget ran out (continue with the next chunk).
             if budget <= 0:
                 break
+        # The fleet now holds prompt+output KV for this session key; a
+        # continuation turn built on top pays only its delta. Bounded:
+        # evict oldest entries past the cap (insertion-ordered dict).
+        self._session_prefix.pop(qid, None)
+        self._session_prefix[qid] = len(prompt_ids) + len(acc_out)
+        while len(self._session_prefix) > self._session_prefix_cap:
+            self._session_prefix.pop(
+                next(iter(self._session_prefix))
+            )
         return APIGenerateOutput(
             qid=qid,
             prompt_ids=list(prompt_ids),
@@ -405,12 +467,22 @@ class PartialRolloutManager:
         )
 
     async def generate_group(
-        self, qid: str, prompt_ids: List[int], gconfig: GenerationHyperparameters
+        self,
+        qid: str,
+        prompt_ids: List[int],
+        gconfig: GenerationHyperparameters,
+        continuation: bool = False,
     ) -> BundledGenerationOutputs:
-        """n samples for one prompt, concurrently."""
+        """n samples for one prompt, concurrently. ``continuation=True``
+        marks a follow-up turn of a session this manager generated
+        earlier (same qid): members keep their qid-stable session keys
+        (``{qid}/{i}``), admit at priority 0, and account only the turn
+        delta as re-prefill."""
         outs = await asyncio.gather(
             *[
-                self._generate_one(f"{qid}/{i}", prompt_ids, gconfig)
+                self._generate_one(
+                    f"{qid}/{i}", prompt_ids, gconfig, continuation
+                )
                 for i in range(gconfig.n)
             ]
         )

@@ -112,6 +112,18 @@ class RolloutWorker(AsyncWorker):
             agent_kwargs["gconfig"] = _dc.asdict(config.gconfig)
         self.agent = make_agent(config.agent, **agent_kwargs)
         self.env = _TracedEnv(make_env(config.env))
+        # Pooled reward-executor discovery: install the process-wide
+        # client (functioncall/remote.py). ToolEnv's python tool and
+        # math_grader's sympy path route through it whenever an executor
+        # fleet is live; with no fleet registered available() is False
+        # and everything degrades to the local fork-per-call sandboxes.
+        from areal_tpu.functioncall import remote as fc_remote
+
+        fc_remote.register_executor_pool(
+            fc_remote.ExecutorPoolClient(
+                config.experiment_name, config.trial_name
+            )
+        )
 
         self.manager_addr = name_resolve.wait(
             names.gen_server_manager(config.experiment_name, config.trial_name),
@@ -239,15 +251,24 @@ class RolloutWorker(AsyncWorker):
         if ep is not None:
             tracing.set_current(ep.ctx)
 
+        seen_qids: set = set()
+
         async def service_gen():
             # Serve generation requests until the agent finishes — an
             # agent may issue any number of them (multi-turn agents issue
             # one per turn; reference rollout_worker.py:330 loops the
             # same way). The task is cancelled once the agent returns.
+            # A repeated qid within the episode is a SESSION CONTINUATION
+            # (the agent's next turn on the same conversation): it rides
+            # the priority-0 affinity path and re-prefills only the turn
+            # delta instead of the whole transcript.
             while True:
                 qid, prompt_ids, gconfig = await obs_queue.get()
+                continuation = str(qid) in seen_qids
+                seen_qids.add(str(qid))
                 bundle = await self.prm.generate_group(
-                    str(qid), prompt_ids, gconfig
+                    str(qid), prompt_ids, gconfig,
+                    continuation=continuation,
                 )
                 ep_gen["reprefill_tokens"] += sum(bundle.reprefill_tokens)
                 ep_gen["interruptions"] += sum(bundle.n_interruptions)

@@ -33,6 +33,14 @@ if [ "${SKIP_LINT:-0}" != "1" ]; then
         echo "preflight lint failed — fix or rerun with SKIP_LINT=1"; exit 1; }
 fi
 
+echo "== preflight: pooled reward executor (spawn + health-probe + teardown) =="
+# Agentic rollouts route tool calls and sympy grading through the
+# executor pool; a pool that can't spawn warm workers here would
+# silently degrade every chip-window rollout to fork-per-call sandboxes.
+timeout 180 python -m areal_tpu.system.reward_executor --selftest || {
+    echo "reward-executor preflight failed — fix before burning the window"
+    exit 1; }
+
 echo "== 0. device probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
     echo "TPU unreachable: leaving the bench DAEMON armed instead —"

@@ -131,6 +131,19 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "ep_loss_max_rel_err", "dropless_drop_rate", "ep_degree",
         "ep_ingress_frac_max", "origin_full_payloads",
     ),
+    # Agentic-rollout evidence is only evidence when every episode
+    # finished, the continuation path measurably beat the session-blind
+    # baseline, the affinity/prefix path actually engaged, and the
+    # executor sweep shed under load WITHOUT starving a single job.
+    "agentic_rollout": (
+        "episodes", "failed_episodes", "episodes_per_s",
+        "turn_ttft_p50_ms", "baseline_turn_ttft_p50_ms",
+        "tool_calls", "tool_failures", "tool_call_ms_p50",
+        "reprefill_tokens", "full_prefill_tokens", "reprefill_ratio",
+        "affinity_prefix_hits",
+        "exec_jobs_total", "exec_warm_hits", "exec_workers_alive",
+        "sat_peak_jobs_per_s", "sat_failed", "sat_shed_total",
+    ),
     # kernel_micro family: per-kernel timing is only evidence NEXT TO
     # its parity number, and a CPU round must label itself proxy
     # (enforced against the record's own attestation below).
@@ -485,6 +498,67 @@ def _validate_fleet_elastic(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_agentic_rollout(val: Dict) -> List[str]:
+    """The agentic-rollout contract (ISSUE 18 acceptance): episodes are
+    loss-free, the session-continuation path measurably beats full
+    re-prefill (ratio strictly below 1 AND prefix affinity actually
+    engaged — a good ratio with zero prefix hits means the accounting
+    lied), tool calls all landed, and the executor sweep proves
+    BACKPRESSURE (sheds happened, nothing starved)."""
+    problems: List[str] = []
+    failed = _num(val, "failed_episodes")
+    if failed is None or failed > 0:
+        problems.append(
+            f"agentic_rollout: {failed} failed episode(s) — multi-turn "
+            f"rollout evidence must be loss-free"
+        )
+    ratio = _num(val, "reprefill_ratio")
+    if ratio is None or ratio >= 1.0:
+        problems.append(
+            f"agentic_rollout: re-prefill ratio {ratio} not below 1.0 "
+            f"— continuation turns paid the session-blind full "
+            f"re-prefill, the path never engaged"
+        )
+    if (_num(val, "reprefill_tokens") or 0) <= 0:
+        problems.append(
+            "agentic_rollout: zero re-prefill tokens — either no "
+            "continuation turn ran or the client accounting is dead"
+        )
+    if (_num(val, "affinity_prefix_hits") or 0) < 1:
+        problems.append(
+            "agentic_rollout: zero prefix-cache hits during the "
+            "continuation arm — sticky-qid affinity never engaged, so "
+            "the delta re-prefills hit servers without the parked KV"
+        )
+    if (_num(val, "tool_failures") or 0) > 0:
+        problems.append(
+            f"agentic_rollout: {val.get('tool_failures')} failed tool "
+            f"call(s) — the pooled executor starved mid-episode"
+        )
+    if (_num(val, "exec_warm_hits") or 0) < 1:
+        problems.append(
+            "agentic_rollout: zero warm-worker hits — every job paid a "
+            "cold spawn, the pool's whole point"
+        )
+    if (_num(val, "exec_workers_alive") or 0) < 1:
+        problems.append(
+            "agentic_rollout: no executor worker alive at the end of "
+            "the episode arms"
+        )
+    if (_num(val, "sat_shed_total") or 0) < 1:
+        problems.append(
+            "agentic_rollout: saturation sweep never shed — the "
+            "bounded queue's 429 backpressure was not exercised"
+        )
+    if (_num(val, "sat_failed") or 0) > 0:
+        problems.append(
+            f"agentic_rollout: {val.get('sat_failed')} job(s) failed "
+            f"in the saturation sweep — sheds must back clients off, "
+            f"never starve them"
+        )
+    return problems
+
+
 def _validate_rpc_resilience(val: Dict) -> List[str]:
     """The hedging contract (ISSUE 14 acceptance): under the injected
     delay tail, the hedged arm's p99 must be MEASURABLY lower than the
@@ -806,6 +880,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_fleet_elastic(val))
     if name == "rpc_resilience":
         problems.extend(_validate_rpc_resilience(val))
+    if name == "agentic_rollout":
+        problems.extend(_validate_agentic_rollout(val))
     if name == "recovery_slo":
         problems.extend(_validate_recovery_slo(val))
     if name in KMICRO_CASE_PHASES:

@@ -128,7 +128,9 @@ def test_rollout_worker_service_gen_loops():
     pushed = []
 
     class StubPRM:
-        async def generate_group(self, qid, prompt_ids, gconfig):
+        async def generate_group(
+            self, qid, prompt_ids, gconfig, continuation=False
+        ):
             seq = list(prompt_ids) + [7, 8]
             return BundledGenerationOutputs(
                 qid=qid, prompt_ids=list(prompt_ids), seqs=[seq],

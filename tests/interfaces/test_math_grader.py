@@ -165,3 +165,67 @@ def test_code_verify_stops_on_first_failure():
     cases = {"inputs": ["1\n", "2\n", "3\n"], "outputs": ["9\n", "2\n", "3\n"]}
     res = run_test_cases(sol, cases, stop_on_first_failure=True)
     assert res == [False, False, False]
+
+
+class _StubPool:
+    """Minimal ExecutorPoolClient stand-in for routing tests."""
+
+    def __init__(self, live=True, results=None):
+        self.live = live
+        self.results = results
+        self.calls = []
+
+    def available(self):
+        return self.live
+
+    def submit(self, jobs, timeout_s=None):
+        self.calls.append(jobs)
+        if self.results is not None:
+            return self.results
+        return [
+            {"ok": True, "equal": j["a"].strip() == j["b"].strip()}
+            for j in jobs
+        ]
+
+
+@pytest.fixture
+def _pool_registry():
+    from areal_tpu.functioncall import remote
+
+    yield remote
+    remote.register_executor_pool(None)
+
+
+def test_sympy_routes_through_registered_pool(_pool_registry):
+    """ISSUE 18: with a live executor pool registered, sympy
+    equivalence rides the warm pool instead of forking a sandbox."""
+    from areal_tpu.functioncall.math_grader import _sympy_equal
+
+    pool = _StubPool()
+    _pool_registry.register_executor_pool(pool)
+    assert _sympy_equal("x", "x")
+    assert pool.calls and pool.calls[0][0]["kind"] == "sympy_equal"
+
+
+def test_sympy_local_fallback_when_no_pool(_pool_registry):
+    """The pinned degradation path: no pool registered (or none live)
+    -> the local fork-per-call sandbox still grades correctly."""
+    from areal_tpu.functioncall.math_grader import _sympy_equal
+
+    _pool_registry.register_executor_pool(None)
+    assert _sympy_equal("x + x", "2*x")
+    dead = _StubPool(live=False)
+    _pool_registry.register_executor_pool(dead)
+    assert _sympy_equal("x + x", "2*x")
+    assert dead.calls == []  # an unavailable pool is never submitted to
+
+
+def test_sympy_pool_error_degrades_to_local(_pool_registry):
+    """A pooled job that errors must degrade to slower local grading,
+    never to a wrong grade."""
+    from areal_tpu.functioncall.math_grader import _sympy_equal
+
+    broken = _StubPool(results=[{"ok": False, "error": "worker died"}])
+    _pool_registry.register_executor_pool(broken)
+    assert _sympy_equal("x + x", "2*x")
+    assert broken.calls  # the pool WAS tried first

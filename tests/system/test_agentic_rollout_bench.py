@@ -1,0 +1,131 @@
+"""ISSUE 18 acceptance (bench leg): the `agentic_rollout` phase banks
+an attested CPU-proxy record — multi-turn tool-use episodes through a
+real fleet + pooled executor, with the session-continuation re-prefill
+measured against a session-blind full-re-prefill baseline and an
+executor saturation sweep — and `validate_bench.py` refuses the failure
+classes that would make such a record meaningless: failed episodes,
+continuation arms whose re-prefill ratio never beat the baseline,
+unengaged prefix affinity, starved tool calls, cold-only executor
+pools, and saturation sweeps that never shed (backpressure untested).
+
+The teeth run in tier-1 against a synthetic record; the full phase run
+(ProcessFleet + executor services, ~2-4 min) is slow-marked."""
+
+import importlib.util
+import os
+
+import pytest
+
+from areal_tpu.bench import bank, runner
+from tests.fixtures import scale_timeout
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _good_record():
+    """A record shaped like a healthy banked measure pass."""
+    return {
+        "status": "ok",
+        "pass": "measure",
+        "value": {
+            "episodes": 8.0,
+            "turns_per_episode": 3.0,
+            "failed_episodes": 0.0,
+            "episodes_per_s": 0.5,
+            "turn_ttft_p50_ms": 16.0,
+            "turn_ttft_p99_ms": 40.0,
+            "baseline_turn_ttft_p50_ms": 64.0,
+            "baseline_turn_ttft_p99_ms": 120.0,
+            "tool_calls": 16.0,
+            "tool_failures": 0.0,
+            "tool_call_ms_p50": 30.0,
+            "tool_call_ms_p99": 80.0,
+            "reprefill_tokens": 64.0,
+            "full_prefill_tokens": 2600.0,
+            "reprefill_ratio": 0.025,
+            "affinity_prefix_hits": 8.0,
+            "exec_jobs_total": 40.0,
+            "exec_warm_hits": 38.0,
+            "exec_worker_respawns": 0.0,
+            "exec_workers_alive": 2.0,
+            "sat_points": 3.0,
+            "sat_peak_jobs_per_s": 30.0,
+            "sat_failed": 0.0,
+            "sat_shed_total": 83.0,
+            "n_turns_total": 24.0,
+            "wall_s": 60.0,
+        },
+    }
+
+
+def test_agentic_rollout_teeth():
+    v = _load_validator()
+    assert v.validate_phase_value("agentic_rollout", _good_record()) == []
+
+    # Each mutation is one failure class the validator must refuse.
+    cases = [
+        ("failed_episodes", 1.0, "failed episode"),
+        ("reprefill_ratio", 1.0, "not below 1.0"),
+        ("reprefill_tokens", 0.0, "zero re-prefill tokens"),
+        ("affinity_prefix_hits", 0.0, "affinity never engaged"),
+        ("tool_failures", 2.0, "starved mid-episode"),
+        ("exec_warm_hits", 0.0, "cold spawn"),
+        ("exec_workers_alive", 0.0, "no executor worker alive"),
+        ("sat_shed_total", 0.0, "never shed"),
+        ("sat_failed", 3.0, "saturation sweep"),
+    ]
+    for key, bad, needle in cases:
+        rec = _good_record()
+        rec["value"][key] = bad
+        problems = v.validate_phase_value("agentic_rollout", rec)
+        assert problems, f"validator swallowed {key}={bad}"
+        assert any(needle in p for p in problems), (key, problems)
+
+    # A missing schema key is refused before the semantic teeth.
+    rec = _good_record()
+    del rec["value"]["reprefill_ratio"]
+    assert any(
+        "reprefill_ratio" in p
+        for p in v.validate_phase_value("agentic_rollout", rec)
+    )
+
+
+@pytest.mark.serial
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_agentic_rollout_record_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    rec = runner.run_phase(
+        "agentic_rollout", "measure", b, deadline_s=scale_timeout(360)
+    )
+    assert rec["status"] == "ok", rec
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("agentic_rollout", rec) == []
+    assert validator.validate_bank_dir(b) == []
+
+    v = rec["value"]
+    # THE acceptance numbers: loss-free episodes whose continuation
+    # turns re-prefilled measurably less than the session-blind
+    # baseline, with affinity and executor backpressure both engaged.
+    assert v["failed_episodes"] == 0.0
+    assert v["reprefill_ratio"] < 1.0
+    assert v["affinity_prefix_hits"] >= 1
+    assert v["tool_failures"] == 0.0
+    assert v["exec_warm_hits"] >= 1
+    assert v["sat_shed_total"] >= 1 and v["sat_failed"] == 0.0

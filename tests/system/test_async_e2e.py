@@ -104,6 +104,46 @@ def _assert_rl_trace(tmp_path, result):
     assert "overlap_score" in rl
 
 
+def _assert_continuation_reprefill(tmp_path):
+    """Session-continuation acceptance: multi-turn episodes re-put the
+    SAME qid per turn, so turns 2+ ride the continuation path — their
+    gen.chunk spans must account a re-prefill strictly below the
+    session-blind counterfactual stamped next to it."""
+    from areal_tpu.base import tracing
+    from areal_tpu.utils import rl_trace
+
+    tracing.flush()
+    shards = rl_trace.load_shards(str(tmp_path / "rl_trace"))
+    cont = [
+        sp for s in shards for sp in s.spans
+        if sp["name"] == "gen.chunk"
+        and (sp.get("attrs") or {}).get("continuation")
+    ]
+    assert cont, (
+        "no continuation gen.chunk spans — the multi-turn agent never "
+        "rode the session-continuation path"
+    )
+    # An interruption resubmission (weight update landed mid-turn)
+    # legitimately re-prefills the accumulated prefix even on a
+    # continuation, so the claim is aggregate: the continuation path
+    # must shrink TOTAL re-prefill strictly below the session-blind
+    # counterfactual, with delta-only chunks the common case.
+    reprefill = sum(sp["attrs"]["reprefill_tokens"] for sp in cont)
+    full = sum(sp["attrs"]["full_prefill_tokens"] for sp in cont)
+    n_delta = sum(
+        1 for sp in cont
+        if sp["attrs"]["reprefill_tokens"] < sp["attrs"]["full_prefill_tokens"]
+    )
+    assert reprefill < full, (
+        f"continuation turns re-prefilled the full conversation: "
+        f"{reprefill} >= {full} over {len(cont)} chunks"
+    )
+    assert n_delta > len(cont) // 2, (
+        f"only {n_delta}/{len(cont)} continuation chunks re-prefilled "
+        f"the turn delta"
+    )
+
+
 def _trainer_parts(exp, trial, tok_dir):
     """The trainer side shared by every async e2e variant: train MFC
     (with the weight-publish hook), stream-dataset model worker, and a
@@ -253,6 +293,8 @@ def test_async_ppo_e2e(tmp_path, monkeypatch, agent_abs, gen_extra):
         result = ctl.run()
         assert result["global_step"] == 2
         _assert_rl_trace(tmp_path, result)
+        if agent_abs.type_ == "math-multi-turn":
+            _assert_continuation_reprefill(tmp_path)
     finally:
         # Un-cache process-global tracing state on EVERY exit path —
         # monkeypatch restores the env but not tracing's cached flag.

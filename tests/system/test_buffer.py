@@ -230,6 +230,63 @@ def test_samples_without_seq_bypass_ledger():
     asyncio.run(main())
 
 
+def _task_sample(i, task, v_end, seqlen=4):
+    data = {"packed_prompts": np.arange(seqlen, dtype=np.int32)}
+    return SequenceSample.from_default(
+        ids=[f"s{i}"], seqlens=[seqlen], data=data,
+        metadata={"task": [task], "version_end": [v_end]},
+    )
+
+
+def test_per_task_staleness_windows_gate_admission():
+    """ISSUE 18: per-task staleness — trajectories carry a `task` tag and
+    admission applies a PER-TASK version window (math tight, agentic
+    loose), so slow agentic episodes survive the gate that drops stale
+    math samples. Drops are counted, never silent."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+    assert buf.task_windows == {"math": 2, "agentic": 8}  # registry default
+    buf.current_train_step = 10
+
+    async def main():
+        n = await buf.put_batch([
+            _task_sample(0, "math", 8),      # lag 2 == window: admitted
+            _task_sample(1, "math", 7),      # lag 3 > 2: dropped
+            _task_sample(2, "agentic", 2),   # lag 8 == window: admitted
+            _task_sample(3, "agentic", 1),   # lag 9 > 8: dropped
+            _task_sample(4, "mystery", 0),   # no window for the task
+            _sample(5),                      # no task tag at all
+        ])
+        assert n == 4
+        assert buf.counters["areal:train_stale_dropped_total"] == 2
+        assert buf.size == 4
+
+    asyncio.run(main())
+
+
+def test_task_windows_env_override(monkeypatch):
+    """The windows knob parses operator overrides and shrugs off
+    malformed entries instead of taking the trainer down."""
+    monkeypatch.setenv(
+        "AREAL_TASK_STALENESS_WINDOWS", "math:0,agentic:4,junk,bad:x"
+    )
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+    assert buf.task_windows == {"math": 0, "agentic": 4}
+    buf.current_train_step = 1
+
+    async def main():
+        # math window 0: anything behind the current step is stale.
+        n = await buf.put_batch([
+            _task_sample(0, "math", 1),
+            _task_sample(1, "math", 0),
+        ])
+        assert n == 1
+        assert buf.counters["areal:train_stale_dropped_total"] == 1
+
+    asyncio.run(main())
+
+
 def test_overflow_precheck_counts_unique_ids():
     """ADVICE r1 (e): the capacity precheck must not overcount — filling
     to exactly max_size succeeds."""

@@ -581,6 +581,63 @@ def _drive_engine_kv_spill(tmp_path, monkeypatch):
         eng.stop()
 
 
+@_fast("rexec.case")
+def _drive_rexec_case(tmp_path, monkeypatch):
+    """One sandboxed reward job fails inside the warm pool: it comes
+    back as a failed RESULT aligned to its job (never a raise that
+    fails the whole batch), the worker is not respawned for it, and
+    the next job rides the same warm worker."""
+    from areal_tpu.system.reward_executor import WorkerPool
+
+    p = WorkerPool(n_workers=1)
+    try:
+        faults.arm("rexec.case", action="raise", at_hit=1, times=1)
+        good, bad = p.submit(
+            [{"kind": "ping"}, {"kind": "ping"}]
+        )
+        _fired("rexec.case")
+        failed = [r for r in (good, bad) if not r["ok"]]
+        assert len(failed) == 1 and "case fault" in failed[0]["error"]
+        assert p.counters["worker_respawns"] == 0
+        assert p.submit([{"kind": "ping"}])[0]["ok"]
+    finally:
+        p.close()
+
+
+@_fast("rexec.die")
+def _drive_rexec_die(tmp_path, monkeypatch):
+    """The whole executor service dies mid-request. The real
+    process-death sweep (two subprocess executors, one armed
+    ``rexec.die=die``, client fails over on the stale lease) is
+    tests/system/test_reward_executor.py::
+    test_client_fails_over_when_executor_dies; here the campaign pins
+    the loud half against a real in-process service: the armed submit
+    is a 500 the client's retry/rediscovery absorbs — never a hung
+    connection or a silently-empty result — and the warm pool survives
+    for the retry."""
+    from areal_tpu.base import name_resolve
+    from areal_tpu.system.reward_executor import RewardExecutorService
+
+    name_resolve.reconfigure("memory")
+    svc = RewardExecutorService(
+        "campaign-rexec", "t0", executor_id=0, n_workers=1,
+    )
+    url = svc.start()
+    try:
+        faults.arm("rexec.die", action="raise", at_hit=1, times=1)
+        body = {"jobs": [{"kind": "ping"}]}
+        status1, resp1 = _post_raw(url + "/rexec/submit", body)
+        assert status1 == 500, (status1, resp1)
+        _fired("rexec.die")
+        # The client-side retry (one-shot arm): same warm pool serves.
+        status2, resp2 = _post_raw(url + "/rexec/submit", body)
+        assert status2 == 200 and resp2["results"][0]["ok"], (
+            status2, resp2,
+        )
+    finally:
+        svc.stop()
+
+
 @pytest.mark.parametrize("point", sorted(FAST))
 def test_campaign_fast(point, tmp_path, monkeypatch):
     FAST[point](tmp_path, monkeypatch)

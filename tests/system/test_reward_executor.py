@@ -1,0 +1,249 @@
+"""Pooled reward-executor tests (ISSUE 18): warm worker reuse, rlimit
+containment, timeout kill + respawn, bounded-queue shed, chaos-point
+failure shapes, and client failover across a real executor death."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from areal_tpu.base import name_resolve, names
+from areal_tpu.base.fault_injection import faults
+from areal_tpu.functioncall.remote import ExecutorPoolClient
+from areal_tpu.system.reward_executor import RewardExecutorService, WorkerPool
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def pool():
+    p = WorkerPool(n_workers=1)
+    yield p
+    p.close()
+
+
+class TestWorkerPool:
+    def test_warm_reuse_same_pid(self, pool):
+        r1 = pool.submit([{"kind": "ping"}])[0]
+        r2 = pool.submit([{"kind": "ping"}])[0]
+        assert r1["ok"] and r2["ok"]
+        # The SAME warm subprocess served both jobs — no per-call spawn.
+        assert r1["pid"] == r2["pid"]
+        assert r2["reuse"] > r1["reuse"]
+        assert pool.counters["warm_hits"] >= 1
+        assert pool.counters["worker_respawns"] == 0
+
+    def test_python_job_stdout_stdin(self, pool):
+        res = pool.submit([
+            {"kind": "python",
+             "code": "import sys; print(int(sys.stdin.read()) * 2)",
+             "stdin": "21"},
+        ])[0]
+        assert res["ok"], res
+        assert "42" in res["stdout"]
+
+    def test_failed_python_job_is_result_not_raise(self, pool):
+        res = pool.submit([{"kind": "python", "code": "1/0"}])[0]
+        assert not res["ok"]
+        assert "ZeroDivisionError" in res.get("stderr", "") + res.get(
+            "error", ""
+        )
+        # The worker survives a guarded-exec failure (no respawn).
+        assert pool.counters["worker_respawns"] == 0
+        assert pool.submit([{"kind": "ping"}])[0]["ok"]
+
+    def test_timeout_kills_and_respawns(self, pool):
+        t0 = time.monotonic()
+        res = pool.submit(
+            [{"kind": "python", "code": "import time; time.sleep(60)"}],
+            timeout_s=0.5,
+        )[0]
+        assert time.monotonic() - t0 < 10.0
+        assert not res["ok"] and res.get("timeout"), res
+        assert pool.counters["timeouts"] == 1
+        assert pool.counters["worker_respawns"] == 1
+        # A fresh warm worker replaced the killed one.
+        assert pool.submit([{"kind": "ping"}])[0]["ok"]
+
+    def test_rlimit_contains_oom(self):
+        p = WorkerPool(n_workers=1, mem_mb=128)
+        try:
+            res = p.submit([
+                {"kind": "python", "code": "x = bytearray(1 << 30)"},
+            ])[0]
+            assert not res["ok"], res
+            assert p.submit([{"kind": "ping"}])[0]["ok"]
+        finally:
+            p.close()
+
+    def test_sympy_equal_job(self, pool):
+        eq = pool.submit(
+            [{"kind": "sympy_equal", "a": "x + x", "b": "2*x"},
+             {"kind": "sympy_equal", "a": "x + 1", "b": "x + 2"}],
+            timeout_s=30.0,
+        )
+        assert eq[0]["ok"] and eq[0]["equal"] is True
+        assert eq[1]["ok"] and eq[1]["equal"] is False
+
+    def test_chaos_case_comes_back_as_failed_result(self, pool):
+        faults.reset()
+        faults.arm("rexec.case", "raise")
+        try:
+            res = pool.submit([{"kind": "ping"}])[0]
+            assert not res["ok"]
+            assert "case fault" in res["error"]
+            # One-shot arm: the pool is healthy again afterwards.
+            assert pool.submit([{"kind": "ping"}])[0]["ok"]
+        finally:
+            faults.reset()
+
+
+class TestServiceHTTP:
+    def _post(self, url, payload, timeout=60.0):
+        req = urllib.request.Request(
+            url + "/rexec/submit", json.dumps(payload).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def test_submit_metrics_health_and_shed(self):
+        name_resolve.reconfigure("memory")
+        svc = RewardExecutorService(
+            "rexec-ut", "t0", executor_id=0, n_workers=1, queue_max=2,
+        )
+        url = svc.start()
+        try:
+            out = self._post(
+                url, {"jobs": [{"kind": "python", "code": "print(7)"}]}
+            )
+            assert out["results"][0]["ok"]
+            with urllib.request.urlopen(url + "/health", timeout=10) as r:
+                h = json.loads(r.read())
+            assert h["status"] == "ok" and h["workers_alive"] >= 1
+
+            # Saturate the 1-worker pool past queue_max=2 with slow
+            # jobs from concurrent submitters: 429s with Retry-After.
+            slow = {"kind": "python",
+                    "code": "import time; time.sleep(0.3); print(1)"}
+            codes = []
+
+            def fire():
+                try:
+                    self._post(url, {"jobs": [slow, slow]})
+                    codes.append(200)
+                except urllib.error.HTTPError as e:
+                    codes.append(e.code)
+                    if e.code == 429:
+                        assert e.headers.get("Retry-After") is not None
+
+            ts = [threading.Thread(target=fire) for _ in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert 429 in codes, codes
+            with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            metrics = dict(
+                line.split() for line in text.splitlines() if line
+            )
+            assert float(metrics["areal:rexec_shed_total"]) >= 1
+            assert float(metrics["areal:rexec_jobs_total"]) >= 1
+            assert float(metrics["areal:rexec_workers_alive"]) >= 1
+        finally:
+            svc.stop()
+
+    def test_expired_deadline_sheds(self):
+        name_resolve.reconfigure("memory")
+        svc = RewardExecutorService(
+            "rexec-dl", "t0", executor_id=0, n_workers=1,
+        )
+        url = svc.start()
+        try:
+            req = urllib.request.Request(
+                url + "/rexec/submit",
+                json.dumps({"jobs": [{"kind": "ping"}]}).encode(),
+                # The wire deadline is REMAINING seconds; 0 = expired.
+                {"Content-Type": "application/json",
+                 "X-Areal-Deadline": "0"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 429
+        finally:
+            svc.stop()
+
+
+def _spawn_executor(idx, exp, trial, nr_root, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AREAL_HEALTH_TTL"] = "2"
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "areal_tpu.system.reward_executor",
+         "--experiment", exp, "--trial", trial, "--index", str(idx),
+         "--workers", "1", "--name-resolve-root", nr_root],
+        env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def test_client_fails_over_when_executor_dies(tmp_path, monkeypatch):
+    """The executor-death chaos arm: two REAL executor subprocesses, one
+    armed to die (`rexec.die` via AREAL_FAULTS) on its first submit. The
+    client's retry loop must re-discover and land the batch on the
+    survivor — failed RESULTS never reach the caller."""
+    monkeypatch.setenv("AREAL_HEALTH_TTL", "2")
+    nr_root = str(tmp_path / "nr")
+    name_resolve.reconfigure("nfs", record_root=nr_root)
+    exp, trial = "rexec-chaos", "t0"
+    procs = [
+        _spawn_executor(
+            0, exp, trial, nr_root,
+            {"AREAL_FAULTS": "rexec.die=die"},
+        ),
+        _spawn_executor(1, exp, trial, nr_root),
+    ]
+    try:
+        deadline = time.monotonic() + 60
+        urls = {}
+        while len(urls) < 2 and time.monotonic() < deadline:
+            for i in range(2):
+                try:
+                    urls[i] = name_resolve.get(
+                        names.reward_executor_url(exp, trial, str(i))
+                    )
+                except name_resolve.NameEntryNotFoundError:
+                    pass
+            time.sleep(0.2)
+        assert len(urls) == 2, "executors never registered"
+
+        client = ExecutorPoolClient(exp, trial)
+        # Round-robin starts somewhere; submit twice so executor 0 is
+        # guaranteed to be hit (and die) within the first batch's retry
+        # loop or the second's.
+        for k in range(2):
+            res = client.submit(
+                [{"kind": "python", "code": f"print({k} + 40)"}],
+                timeout_s=20.0,
+            )[0]
+            assert res["ok"], res
+        # The armed executor really died (chaos engaged, not skipped).
+        assert procs[0].wait(timeout=30) is not None
+        assert procs[1].poll() is None
+        # Steady state after the death: the survivor serves alone.
+        res = client.submit([{"kind": "ping"}])[0]
+        assert res["ok"], res
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        name_resolve.reconfigure("memory")

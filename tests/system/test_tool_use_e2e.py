@@ -1,0 +1,195 @@
+"""Multi-turn tool-use e2e (ISSUE 18 acceptance): 2 real generation
+servers + real gserver manager + a REAL pooled reward-executor fleet +
+rollout worker running ToolUseAgent episodes + stream-dataset trainer,
+with the executor-death chaos arm live — executor 0 is armed to `die`
+on its first submit, so every episode's tool traffic must fail over to
+the survivor and still finish (zero failed episodes)."""
+
+import json
+import urllib.request
+import uuid
+
+import pytest
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+)
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    GenerationServerConfig,
+    GserverManagerConfig,
+    RolloutWorkerConfig,
+)
+from areal_tpu.base import name_resolve, names
+from areal_tpu.system.controller import LocalController
+from tests import fixtures
+from tests.system.test_async_e2e import (
+    N_SEQS,
+    _assert_continuation_reprefill,
+    _deflaked_env,
+    _trainer_parts,
+)
+from tests.system.test_e2e_experiments import _mk_tokenizer_files
+from tests.system.test_reward_executor import _spawn_executor
+
+pytestmark = pytest.mark.serial
+
+
+def _wait_executor_urls(exp, trial, n, timeout=60.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    urls = {}
+    while len(urls) < n and time.monotonic() < deadline:
+        for i in range(n):
+            try:
+                urls[i] = name_resolve.get(
+                    names.reward_executor_url(exp, trial, str(i))
+                )
+            except name_resolve.NameEntryNotFoundError:
+                pass
+        time.sleep(0.2)
+    assert len(urls) == n, f"only {sorted(urls)} of {n} executors registered"
+    return urls
+
+
+def _rexec_metrics(url):
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    return dict(
+        (k, float(v))
+        for k, v in (line.split() for line in text.splitlines() if line)
+    )
+
+
+@pytest.mark.slow
+def test_tool_use_e2e(tmp_path, monkeypatch):
+    exp, trial = f"e2e-tool-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    mc_rows = [
+        r for r in fixtures.make_math_code_rows(16, seed=13)
+        if r["task"] == "math"
+    ]
+    data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
+    nr_root = str(tmp_path / "name_resolve")
+
+    worker_env = _deflaked_env(tmp_path, monkeypatch)
+    # Split admission windows live in the buffer for this run (math
+    # tight, agentic loose) — the master's per-task staleness scalars
+    # asserted below prove the task tag flowed rollout -> buffer ->
+    # train batch.
+    worker_env["AREAL_TASK_STALENESS_WINDOWS"] = "math:2,agentic:8"
+
+    # The REAL executor fleet, as subprocesses on the shared
+    # name_resolve root. Executor 0 carries the chaos arm: `rexec.die`
+    # fires on its FIRST submit and os._exit()s the whole service.
+    name_resolve.reconfigure("nfs", record_root=nr_root)
+    procs = [
+        _spawn_executor(
+            0, exp, trial, nr_root, {"AREAL_FAULTS": "rexec.die=die"}
+        ),
+        _spawn_executor(1, exp, trial, nr_root),
+    ]
+
+    model_args, mw, master = _trainer_parts(exp, trial, tok_dir)
+    gen_servers = [
+        GenerationServerConfig(
+            experiment_name=exp,
+            trial_name=trial,
+            server_index=i,
+            model=ModelAbstraction("tpu_transformer", args=model_args),
+            tokenizer_path=tok_dir,
+            max_concurrent_requests=4,
+            max_seq_len=256,
+            decode_block_steps=4,
+            # Turn continuations re-enter on sticky-qid routes; the
+            # prefix cache is what makes the re-prefill delta real.
+            prefix_cache_tokens=2048,
+        )
+        for i in range(2)
+    ]
+    gserver_mgr = GserverManagerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        model_name="actor",
+        n_servers=2,
+        train_batch_size=N_SEQS,
+        max_head_offpolicyness=100,  # don't gate in this tiny test
+    )
+    rollout = RolloutWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        n_rollout_workers=1,
+        n_pullers=1,
+        agent=AgentAbstraction(
+            "tool-use",
+            args=dict(
+                gconfig=dict(max_new_tokens=8),
+                num_turns=3,
+                # Tiny random models never emit tool syntax; script the
+                # first two turns so every episode exercises the
+                # executor pool + continuation path deterministically.
+                scripted_tool_turns=2,
+            ),
+        ),
+        env=EnvServiceAbstraction("tool-use"),
+        datasets=[
+            DatasetAbstraction(
+                "math_code_prompt", args=dict(dataset_path=data_path)
+            )
+        ],
+        tokenizer_path=tok_dir,
+        # The ISSUE acceptance shape: 4 concurrent 3-turn episodes.
+        max_concurrent_rollouts=4,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        master=master,
+        model_workers=[mw],
+        rollout_workers=[rollout],
+        gserver_manager=gserver_mgr,
+        generation_servers=gen_servers,
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={"backend": "nfs", "record_root": nr_root},
+        worker_env=worker_env,
+    )
+    try:
+        urls = _wait_executor_urls(exp, trial, 2)
+        result = ctl.run()
+        assert result["global_step"] == 2
+
+        # Turns 2+ rode the session-continuation path with a re-prefill
+        # strictly below the session-blind counterfactual.
+        _assert_continuation_reprefill(tmp_path)
+
+        # Episode telemetry surfaced as master scalars: every trained
+        # episode ran its full 3 turns (zero failed/truncated episodes)
+        # and both scripted tool calls executed.
+        overlap = result["perf_summary"]["overlap"]
+        assert overlap.get("episode_turns") == 3.0, overlap
+        assert overlap.get("episode_tool_calls") == 2.0, overlap
+        # Per-task staleness: the agentic tag survived rollout ->
+        # buffer admission -> train batch -> master scalar.
+        assert "task_staleness_agentic" in overlap, overlap
+
+        # The chaos arm engaged: executor 0 died on its first submit...
+        assert procs[0].wait(timeout=30) is not None
+        # ...and the survivor absorbed the fleet's tool traffic.
+        assert procs[1].poll() is None
+        m = _rexec_metrics(urls[1])
+        assert m["areal:rexec_jobs_total"] >= 1, m
+        assert m["areal:rexec_workers_alive"] >= 1, m
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        from areal_tpu.base import tracing
+
+        tracing.reconfigure()
