@@ -201,8 +201,14 @@ class SequenceSample:
             vals = []
             for s in samples:
                 if mk not in s.metadata:
-                    raise ValueError(f"metadata key {mk!r} missing in some samples")
-                vals.extend(s.metadata[mk])
+                    # Mixed-stream batches (math + agentic episodes
+                    # sharing one buffer) legally carry stream-specific
+                    # metadata (turns/tool_calls vs task-only); pad the
+                    # absent samples with None to keep the per-sample
+                    # alignment — every consumer filters on isinstance.
+                    vals.extend([None] * s.bs)
+                else:
+                    vals.extend(s.metadata[mk])
             metadata[mk] = vals
         return cls(
             ids=ids,

@@ -1,0 +1,254 @@
+"""OpenAI-compatible public wire schemas for the tenant gateway.
+
+The parsing/formatting half of the multi-tenant front door
+(system/gateway.py): request validation for ``POST /v1/completions``
+and ``POST /v1/chat/completions``, SSE chunk/terminator framing, and
+the response envelopes — every JSON body is stamped with the
+``areal-gateway/v1`` schema tag (base/wire_schemas.py) so clients can
+reject payloads from a different protocol generation.
+
+Deliberately stdlib-only and transport-free: no aiohttp, no engine
+imports — the gateway owns sockets and scheduling, this module owns
+bytes. Prompts may arrive as text OR as raw token-id lists (the
+OpenAI completions API allows both); without a real tokenizer the
+text path uses a byte-level codec (UTF-8 bytes as token ids), which is
+exact against the 256-vocab harness models and a documented
+approximation elsewhere — production deployments inject a tokenizer
+pair into the gateway instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base.wire_schemas import GATEWAY_V1
+
+
+class PublicApiError(Exception):
+    """A client-visible request defect: maps to a 4xx with a JSON error
+    body (never a stack trace on the wire)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+# -- prompt codec (tokenizer-free fallback) -------------------------------
+
+def encode_text(text: str) -> List[int]:
+    """Byte-level text -> token ids (UTF-8 bytes). Identity-exact for
+    vocab-256 harness models; a real tokenizer replaces this via the
+    gateway's ``tokenizer`` hook."""
+    return list(text.encode("utf-8"))
+
+
+def decode_tokens(token_ids: List[int]) -> str:
+    """Token ids -> display text for SSE chunks. Ids outside the byte
+    range are folded (& 0xFF): display fidelity only, the authoritative
+    payload is always the ``token_ids`` field alongside."""
+    return bytes(int(t) & 0xFF for t in token_ids).decode(
+        "utf-8", errors="replace"
+    )
+
+
+# -- request parsing ------------------------------------------------------
+
+@dataclasses.dataclass
+class ParsedRequest:
+    kind: str  # "completion" | "chat"
+    model: str
+    prompt_ids: List[int]
+    max_tokens: int
+    stream: bool
+    temperature: float
+    top_p: float
+    greedy: bool
+    # Optional client session key: requests sharing one ride the
+    # manager's prefix-affinity routing (multi-turn tenants keep their
+    # parked KV + kv_source hints).
+    session: Optional[str] = None
+
+
+def _prompt_to_ids(prompt: Any) -> List[int]:
+    if isinstance(prompt, str):
+        return encode_text(prompt)
+    if isinstance(prompt, list):
+        if all(isinstance(t, int) for t in prompt):
+            return [int(t) for t in prompt]
+        if len(prompt) == 1 and isinstance(prompt[0], str):
+            return encode_text(prompt[0])
+        raise PublicApiError(
+            400, "prompt must be a string, a token-id list, or a "
+                 "single-element string list (batched prompts are not "
+                 "supported)"
+        )
+    raise PublicApiError(400, f"unsupported prompt type {type(prompt).__name__}")
+
+
+def _common_fields(body: Dict[str, Any], kind: str,
+                   prompt_ids: List[int]) -> ParsedRequest:
+    if not prompt_ids:
+        raise PublicApiError(400, "empty prompt")
+    try:
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 1.0))
+        top_p = float(body.get("top_p", 1.0))
+    except (TypeError, ValueError) as e:
+        raise PublicApiError(400, f"bad sampling field: {e}") from None
+    if max_tokens < 1:
+        raise PublicApiError(400, "max_tokens must be >= 1")
+    n = body.get("n", 1)
+    if n not in (1, None):
+        raise PublicApiError(400, "n > 1 is not supported")
+    session = body.get("session")
+    if session is not None and not isinstance(session, str):
+        raise PublicApiError(400, "session must be a string")
+    return ParsedRequest(
+        kind=kind,
+        model=str(body.get("model") or "areal"),
+        prompt_ids=prompt_ids,
+        max_tokens=max_tokens,
+        stream=bool(body.get("stream", True)),
+        temperature=temperature,
+        top_p=top_p,
+        greedy=bool(body.get("greedy", temperature == 0.0)),
+        session=session,
+    )
+
+
+def parse_completion_request(body: Dict[str, Any]) -> ParsedRequest:
+    if not isinstance(body, dict):
+        raise PublicApiError(400, "request body must be a JSON object")
+    if "prompt" not in body:
+        raise PublicApiError(400, "missing 'prompt'")
+    return _common_fields(body, "completion", _prompt_to_ids(body["prompt"]))
+
+
+def render_chat_prompt(messages: List[Dict[str, Any]]) -> str:
+    """Flatten a chat transcript into one prompt string. Minimal
+    role-tagged template — the byte codec (or an injected tokenizer)
+    sees exactly this text."""
+    lines = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        if not isinstance(content, str):
+            raise PublicApiError(400, "message content must be a string")
+        lines.append(f"{role}: {content}")
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def parse_chat_request(body: Dict[str, Any]) -> ParsedRequest:
+    if not isinstance(body, dict):
+        raise PublicApiError(400, "request body must be a JSON object")
+    messages = body.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise PublicApiError(400, "missing or empty 'messages'")
+    prompt_ids = encode_text(render_chat_prompt(messages))
+    return _common_fields(body, "chat", prompt_ids)
+
+
+# -- response framing -----------------------------------------------------
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def sse_event(payload: Dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(
+        payload, separators=(",", ":")
+    ).encode() + b"\n\n"
+
+
+def _base_obj(request_id: str, model: str, obj: str) -> Dict[str, Any]:
+    return {
+        "schema": GATEWAY_V1,
+        "id": request_id,
+        "object": obj,
+        "created": int(time.time()),
+        "model": model,
+    }
+
+
+def completion_chunk(request_id: str, model: str, token_ids: List[int],
+                     finish_reason: Optional[str] = None) -> Dict[str, Any]:
+    out = _base_obj(request_id, model, "text_completion.chunk")
+    out["choices"] = [{
+        "index": 0,
+        "text": decode_tokens(token_ids),
+        "token_ids": [int(t) for t in token_ids],
+        "finish_reason": finish_reason,
+    }]
+    return out
+
+
+def chat_chunk(request_id: str, model: str, token_ids: List[int],
+               first: bool = False,
+               finish_reason: Optional[str] = None) -> Dict[str, Any]:
+    delta: Dict[str, Any] = {"content": decode_tokens(token_ids)}
+    if first:
+        delta["role"] = "assistant"
+    out = _base_obj(request_id, model, "chat.completion.chunk")
+    out["choices"] = [{
+        "index": 0,
+        "delta": delta,
+        "token_ids": [int(t) for t in token_ids],
+        "finish_reason": finish_reason,
+    }]
+    return out
+
+
+def usage_fields(prompt_tokens: int, completion_tokens: int
+                 ) -> Dict[str, int]:
+    return {
+        "prompt_tokens": int(prompt_tokens),
+        "completion_tokens": int(completion_tokens),
+        "total_tokens": int(prompt_tokens) + int(completion_tokens),
+    }
+
+
+def completion_body(request_id: str, model: str, token_ids: List[int],
+                    prompt_tokens: int, finish_reason: str
+                    ) -> Dict[str, Any]:
+    """Non-streaming aggregate response (stream=false)."""
+    out = _base_obj(request_id, model, "text_completion")
+    out["choices"] = [{
+        "index": 0,
+        "text": decode_tokens(token_ids),
+        "token_ids": [int(t) for t in token_ids],
+        "finish_reason": finish_reason,
+    }]
+    out["usage"] = usage_fields(prompt_tokens, len(token_ids))
+    return out
+
+
+def chat_body(request_id: str, model: str, token_ids: List[int],
+              prompt_tokens: int, finish_reason: str) -> Dict[str, Any]:
+    out = _base_obj(request_id, model, "chat.completion")
+    out["choices"] = [{
+        "index": 0,
+        "message": {"role": "assistant",
+                    "content": decode_tokens(token_ids)},
+        "token_ids": [int(t) for t in token_ids],
+        "finish_reason": finish_reason,
+    }]
+    out["usage"] = usage_fields(prompt_tokens, len(token_ids))
+    return out
+
+
+def error_body(status: int, message: str,
+               retry_after: Optional[float] = None) -> Dict[str, Any]:
+    err: Dict[str, Any] = {
+        "message": message,
+        "type": {400: "invalid_request_error",
+                 401: "authentication_error",
+                 429: "rate_limit_error"}.get(status, "api_error"),
+        "code": status,
+    }
+    if retry_after is not None:
+        err["retry_after"] = float(retry_after)
+    return {"schema": GATEWAY_V1, "error": err}

@@ -251,6 +251,46 @@ _KNOBS: List[Knob] = [
     _k("AREAL_REXEC_MAX_REUSE", "int", 0,
        "Jobs served per warm worker before a preventive recycle "
        "(leak hygiene for long campaigns); 0 = unlimited reuse."),
+    # -- multi-tenant gateway (system/gateway.py, docs/serving.md) -------
+    _k("AREAL_GW_TENANTS", "str", None,
+       "Tenant table for the multi-tenant gateway: comma list of "
+       "'name:api_key:weight:tokens_per_s:burst:max_streams' entries "
+       "(e.g. 'acme:sk-acme:4:200:400:8'). Weight drives the "
+       "fair-share quantum, tokens_per_s/burst the per-tenant token "
+       "bucket, max_streams the concurrent-stream cap. The reserved "
+       "'trainer' tenant (internal rollout traffic, infinite weight, "
+       "never shed) always exists and may not be redeclared. Unset = "
+       "no external tenants (every /v1 request answers 401)."),
+    _k("AREAL_GW_FAIR_SHARE", "bool", True,
+       "Weighted deficit-round-robin fair-share scheduling across "
+       "tenant queues on the gateway. False = naive FIFO admission "
+       "(the tenant_fairness bench's unfair A/B arm: documents the "
+       "noisy-neighbor collapse)."),
+    _k("AREAL_GW_CHUNK_TOKENS", "int", 32,
+       "New-token budget per gateway->server /generate hop; between "
+       "chunks the request re-schedules through the manager, so "
+       "weight cutovers and reroutes interpose at chunk granularity "
+       "(same contract as partial_rollout's trainer chunking)."),
+    _k("AREAL_GW_MAX_INFLIGHT", "int", 8,
+       "Upstream streams the gateway runs concurrently across ALL "
+       "tenants; admitted requests beyond it wait in their tenant's "
+       "fair-share queue (this cap is what makes the DRR order "
+       "matter)."),
+    _k("AREAL_GW_RETRY_AFTER_FLOOR_S", "float", 0.05,
+       "Floor on the Retry-After seconds a gateway 429 carries; the "
+       "advertised value is max(floor, the TENANT'S OWN bucket refill "
+       "time for the request's cost) — never derived from fleet "
+       "load."),
+    _k("AREAL_GW_REQUEST_TIMEOUT_S", "float", 120.0,
+       "Gateway->fleet HTTP session timeout and the default deadline "
+       "budget minted for a /v1 request that arrives without "
+       "X-Areal-Deadline."),
+    _k("AREAL_GW_TRAINER_VIA_GATEWAY", "bool", False,
+       "Route rollout workers' partial-rollout SCHEDULING hops "
+       "through the gateway's /schedule_request trainer-tenant proxy "
+       "instead of straight at the manager (system/rollout_worker.py) "
+       "— the fairness-accounting regression arm; allocate/finish "
+       "stay on the manager either way."),
     # -- per-task staleness (system/buffer.py, docs/agentic.md) ----------
     _k("AREAL_TASK_STALENESS_WINDOWS", "str", "math:2,agentic:8",
        "Per-task buffer-admission version windows, 'task:window' comma "

@@ -149,6 +149,18 @@ _POINTS: List[FaultPoint] = [
        "A whole reward-executor service dies mid-flight (container "
        "kill) — its heartbeat goes stale and clients must fail over "
        "to a surviving executor with zero failed episodes."),
+    _p("gw.auth",
+       ("areal_tpu/system/gateway.py",), "sync",
+       "The gateway's API-key lookup dies mid-auth (key store "
+       "flake) — the request must come back as a clean 401-class "
+       "refusal the client can retry, never a hung stream or a "
+       "half-admitted tenant slot."),
+    _p("gw.shed",
+       ("areal_tpu/system/gateway.py",), "sync",
+       "The gateway dies inside the admission/shed decision (right "
+       "as a 429 is being minted) — the tenant's bucket charge must "
+       "not leak and the usage ledger must not double-count the shed "
+       "after restart replay."),
 ]
 
 REGISTRY: Dict[str, FaultPoint] = {p.name: p for p in _POINTS}

@@ -337,6 +337,57 @@ _METRICS: List[Metric] = [
        "Jobs served by an already-warm worker (no spawn on the job's "
        "critical path) — the pooled service's whole point; the bench "
        "asserts warm_hits/jobs ~ 1 after warmup."),
+    # -- multi-tenant gateway (system/gateway.py, docs/serving.md) -------
+    _m("areal:gw_requests_total", "counter", "system/gateway.py",
+       "/v1 requests ADMITTED through auth + bucket + fair-share "
+       "(completed or failed upstream); the tenant_fairness bench's "
+       "throughput denominator."),
+    _m("areal:gw_auth_failures_total", "counter", "system/gateway.py",
+       "Requests refused 401 (missing/unknown API key, or the gw.auth "
+       "chaos point firing in the key lookup)."),
+    _m("areal:gw_shed_total", "counter", "system/gateway.py",
+       "Requests shed 429 by a tenant's OWN token bucket or stream "
+       "cap, Retry-After from that bucket. Deliberate per-tenant "
+       "backpressure, NOT failures — the fleet never sees these."),
+    _m("areal:gw_prompt_tokens_total", "counter", "system/gateway.py",
+       "Prompt tokens metered across tenants (ledger grand total; "
+       "/v1/usage carries the per-tenant split)."),
+    _m("areal:gw_completion_tokens_total", "counter",
+       "system/gateway.py",
+       "Completion tokens metered across tenants, billed as emitted "
+       "— a mid-stream failover resumes from the billed prefix, so "
+       "retried chunks never double-count."),
+    _m("areal:gw_active_streams", "gauge", "system/gateway.py",
+       "Upstream SSE streams running right now (bounded by "
+       "AREAL_GW_MAX_INFLIGHT)."),
+    _m("areal:gw_queue_depth", "gauge", "system/gateway.py",
+       "Admitted requests waiting in tenant fair-share queues."),
+    _m("areal:gw_fairshare_picks_total", "counter",
+       "system/gateway.py",
+       "DRR dispatch decisions taken while 2+ tenant queues were "
+       "nonempty — proof the fair-share queue actually arbitrated "
+       "(validate_bench refuses tenant_fairness records where this "
+       "never moved)."),
+    _m("areal:gw_ttft_hist", "hist", "system/gateway.py",
+       "Gateway-observed TTFT bucket counts across tenants "
+       "(base/latency.py edges; per-tenant hists ride /v1/usage)."),
+    _m("areal:gw_itl_hist", "hist", "system/gateway.py",
+       "Gateway-observed inter-token latency bucket counts across "
+       "tenants."),
+    _m("areal:gw_upstream_failovers_total", "counter",
+       "system/gateway.py",
+       "Mid-stream server deaths survived by rerouting through the "
+       "manager with the accumulated prefix (PR 14 discipline on the "
+       "gateway->server hop)."),
+    _m("areal:gw_usage_replayed_total", "counter",
+       "system/gateway.py",
+       "Usage-WAL records replayed into the ledger at gateway "
+       "restart."),
+    _m("areal:gw_usage_dup_dropped_total", "counter",
+       "system/gateway.py",
+       "Usage records dropped at replay/append because their request "
+       "id was already accounted — the exactly-once ledger doing its "
+       "job across restarts."),
     # ====================================================================
     # perf/* — stats_tracker scalar keys (worker -> master MFC stats
     # payloads; master_worker perf history + bench workloads).
@@ -419,6 +470,17 @@ _METRICS: List[Metric] = [
        "Mean version lag of consumed samples tagged task=agentic — "
        "the loose window (multi-turn episodes live longer).",
        reduce="max"),
+    _m("perf/task_stale_dropped_math", "scalar",
+       "system/model_function_call.py",
+       "Samples tagged task=math dropped at buffer admission by the "
+       "math staleness window since the last train step — the "
+       "per-task split of areal:train_stale_dropped_total.",
+       reduce="sum"),
+    _m("perf/task_stale_dropped_agentic", "scalar",
+       "system/model_function_call.py",
+       "Samples tagged task=agentic dropped at buffer admission by "
+       "the agentic staleness window since the last train step.",
+       reduce="sum"),
     # HBM telemetry (monitor.device_memory_stats, shipped per MFC by
     # model_worker through perf_mem_stats below).
     _m("perf/mem_bytes_in_use", "scalar", "base/monitor.py",

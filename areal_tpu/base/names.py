@@ -118,6 +118,14 @@ def reward_executor_url_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/reward_executor_url/"
 
 
+def gateway_url(experiment_name: str, trial_name: str) -> str:
+    """HTTP endpoint of the multi-tenant inference gateway
+    (system/gateway.py). Liveness rides the health registry (member
+    ``gateway/<id>``); this key is the URL record external clients and
+    the trainer-via-gateway rollout path resolve."""
+    return f"{trial_root(experiment_name, trial_name)}/gateway_url"
+
+
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
 

@@ -41,6 +41,7 @@ GS = "areal_tpu/system/generation_server.py"
 WP = "areal_tpu/system/weight_plane.py"
 MGR = "areal_tpu/system/gserver_manager.py"
 REX = "areal_tpu/system/reward_executor.py"
+GW = "areal_tpu/system/gateway.py"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,12 +67,12 @@ _ROUTES: List[Route] = [
        "the admission watermark — deliberate backpressure clients "
        "retry elsewhere, never a failure.",
        statuses=(429,)),
-    _r("GET", "/metrics", (GS, REX),
+    _r("GET", "/metrics", (GS, REX, GW),
        "The areal:* text surface (base/metrics_registry.py); polled "
        "by the manager, the fleet controller rebuild, and the bench. "
-       "Reward executors serve their areal:rexec_* lines on the same "
-       "contract."),
-    _r("GET", "/health", (GS, REX),
+       "Reward executors serve their areal:rexec_* lines and the "
+       "gateway its areal:gw_* lines on the same contract."),
+    _r("GET", "/health", (GS, REX, GW),
        "Liveness probe for external supervisors (k8s/LB); in-repo "
        "liveness rides the name_resolve heartbeat registry instead.",
        operator=True),
@@ -151,10 +152,32 @@ _ROUTES: List[Route] = [
        "deliberate backpressure clients fail over on, never a "
        "failure.",
        statuses=(429,)),
+    # -- multi-tenant gateway (docs/serving.md "Tenant gateway") ---------
+    _r("POST", "/v1/completions", (GW,),
+       "OpenAI-compatible streaming completion (SSE chunks, "
+       "areal-gateway/v1 envelope): API key -> tenant auth (401 on a "
+       "bad/missing key), per-tenant token-bucket + concurrent-stream "
+       "admission (429 + Retry-After derived from the TENANT'S OWN "
+       "bucket, never the fleet's), then weighted fair-share "
+       "scheduling onto the manager's routing.",
+       statuses=(400, 401, 429)),
+    _r("POST", "/v1/chat/completions", (GW,),
+       "Chat-shaped twin of /v1/completions: messages are rendered to "
+       "one prompt, the stream carries chat.completion.chunk deltas; "
+       "same auth/admission/fair-share contract and statuses.",
+       statuses=(400, 401, 429)),
+    _r("GET", "/v1/usage", (GW,),
+       "Per-tenant metered usage report (prompt/completion tokens, "
+       "TTFT/ITL percentiles, sheds) rebuilt exactly-once from the "
+       "gateway usage WAL; operators reconcile billing against it.",
+       operator=True),
     # -- gserver manager -------------------------------------------------
-    _r("POST", "/schedule_request", (MGR,),
+    _r("POST", "/schedule_request", (MGR, GW),
        "Route one rollout request: returns the target server URL (or "
-       "503 + retry_after while no server is routable).",
+       "503 + retry_after while no server is routable). The gateway "
+       "re-serves this route as a trainer-tenant proxy (weight "
+       "infinity, never shed) so internal rollout traffic rides the "
+       "same fairness plane without starving.",
        statuses=(503,)),
     _r("POST", "/allocate_rollout", (MGR,),
        "Claim a rollout slot against the staleness window."),

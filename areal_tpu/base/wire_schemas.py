@@ -62,3 +62,15 @@ BUFFER_WAL_V1 = "areal-buffer-wal/v1"
 # consumed-sequence ledger persisted atomically with each checkpoint
 # barrier (base/recover.py).
 RECOVER_INFO_V1 = "areal-recover-info/v1"
+
+# Multi-tenant gateway public wire: the OpenAI-compatible request /
+# SSE-chunk envelope served on /v1/completions and /v1/chat/completions
+# (api/public.py, system/gateway.py). Stamped into every non-SSE JSON
+# response and the /v1/usage report.
+GATEWAY_V1 = "areal-gateway/v1"
+
+# Gateway usage-ledger write-ahead log: one journaled record per
+# completed request / shed, replayed at gateway restart with
+# request-id dedup for exactly-once tenant accounting
+# (system/gateway.py over the system/wal.py journal machinery).
+GW_USAGE_WAL_V1 = "areal-gw-usage-wal/v1"

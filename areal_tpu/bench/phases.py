@@ -259,6 +259,23 @@ register(PhaseSpec(
                 "(CPU-proxy)",
 ))
 
+register(PhaseSpec(
+    name="tenant_fairness",
+    entrypoint="areal_tpu.bench.workloads:tenant_fairness_phase",
+    priority=7,
+    est_compile_s=90.0,
+    est_measure_s=240.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Tenant gateway fairness A/B: a real gateway subprocess "
+                "in front of a real-process fleet, noisy-aggressor flood "
+                "vs an interactive victim — victim p99 TTFT (admission-"
+                "to-first-token) solo vs fair-share ON vs FIFO, with the "
+                "aggressor shed against its own stream cap and the DRR "
+                "queue demonstrably engaged (CPU-proxy)",
+))
+
 # kernel_micro family (ROADMAP item 3): per-kernel parity + timing
 # evidence for the hot-path kernels, DEFAULT phases so the daemon
 # spends the next unattended TPU window banking all of it. Off-TPU the

@@ -2591,6 +2591,271 @@ def fleet_elastic_phase(pass_: str) -> dict:
 
 
 # ----------------------------------------------------------------------
+# tenant_fairness: the gateway's weighted-fair-share claim, as a banked
+# A/B (ISSUE 19). A noisy aggressor tenant floods past its stream cap
+# through a REAL gateway subprocess in front of a real-process fleet
+# while an interactive victim issues sequential completions; the arm
+# with fair share ON must hold the victim's p99 TTFT (admission-to-
+# first-token, so queue wait counts) below the FIFO arm, with the
+# aggressor shed against its OWN limits and the DRR queue demonstrably
+# engaged. The OFF arm documents the collapse it prevents.
+# ----------------------------------------------------------------------
+
+# Aggressor: weight 1 with a stream cap ABOVE the gateway's inflight
+# cap — admitted flood requests form a standing queue (the thing DRR
+# vs FIFO decide about) while the overflow beyond 8 streams is shed.
+# Victim: weight 4. Buckets are generous on purpose — sheds must come
+# from the stream cap and victim latency from QUEUEING, not token
+# exhaustion.
+_GWF_TENANTS = ("agg:sk-gwf-agg:1:1000000:2000000:8,"
+                "victim:sk-gwf-vic:4:1000000:2000000:8")
+_GWF_FLOOD_THREADS = 12
+_GWF_VICTIM_REQS = 10
+_GWF_MAX_NEW = 6
+
+
+def _gwf_req(url, path, payload=None, key=None, timeout=120.0):
+    """(status, parsed-json) against the gateway; 4xx/5xx returned."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    h = {"Content-Type": "application/json"}
+    if key:
+        h["Authorization"] = f"Bearer {key}"
+    data = _json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url + path, data, h)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, _json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, _json.loads(body or b"{}")
+        except Exception:
+            return e.code, {"raw": body.decode(errors="replace")}
+
+
+def _gwf_spawn(fleet, wal_path: str, fair: bool, not_url=None):
+    """Spawn a gateway subprocess in front of `fleet`; returns
+    (Popen, url) once /health answers. AREAL_GW_MAX_INFLIGHT is pinned
+    low so admitted requests contend in the gateway's queue — the spot
+    where DRR (or FIFO, fair off) decides who goes next."""
+    import subprocess
+
+    from areal_tpu.base import name_resolve, names
+
+    env = dict(fleet._env)
+    env["AREAL_GW_FAIR_SHARE"] = "1" if fair else "0"
+    env["AREAL_GW_MAX_INFLIGHT"] = "2"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "areal_tpu.system.gateway",
+            "--experiment", fleet.exp, "--trial", fleet.trial,
+            "--manager-addr", fleet.manager_addr(),
+            "--tenants", _GWF_TENANTS,
+            "--usage-wal", wal_path,
+            "--name-resolve-root", fleet._nr,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 60.0
+    key = names.gateway_url(fleet.exp, fleet.trial)
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"tenant_fairness: gateway died rc={proc.returncode}"
+            )
+        try:
+            url = name_resolve.get(key)
+        except Exception:
+            url = None
+        if url and url != not_url:
+            try:
+                st, _ = _gwf_req(url, "/health", timeout=5.0)
+                if st == 200:
+                    return proc, url
+            except Exception:
+                pass
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError("tenant_fairness: gateway never became healthy")
+
+
+def _gwf_completion(url: str, key: str, seed: int):
+    rng = np.random.RandomState(seed)
+    return _gwf_req(
+        url, "/v1/completions",
+        payload={
+            "prompt": rng.randint(
+                1, _OPENLOOP_MODEL["vocab_size"], size=_FLEET_PLEN
+            ).tolist(),
+            "max_tokens": _GWF_MAX_NEW,
+            "temperature": 0.0,
+            "stream": False,
+        },
+        key=key,
+    )
+
+
+def _gwf_metric(url: str, name: str) -> float:
+    """Read one counter off the gateway's text /metrics endpoint."""
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def _gwf_victim_arm(url: str, flood: bool):
+    """One measurement arm: optionally saturate the gateway with
+    aggressor threads for the WHOLE victim window, issue the victim's
+    sequential completions, return (victim_failed, usage-json)."""
+    import threading as _threading
+
+    stop = _threading.Event()
+    threads = []
+    if flood:
+        def _flood(tid):
+            i = 0
+            while not stop.is_set():
+                try:
+                    _gwf_completion(url, "sk-gwf-agg", 9000 + tid * 997 + i)
+                except Exception:
+                    pass
+                i += 1
+
+        threads = [
+            _threading.Thread(target=_flood, args=(t,), daemon=True)
+            for t in range(_GWF_FLOOD_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # let the flood build a standing queue first
+    failed = 0
+    try:
+        for i in range(_GWF_VICTIM_REQS):
+            st, body = _gwf_completion(url, "sk-gwf-vic", 100 + i)
+            if st != 200:
+                failed += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    st, usage = _gwf_req(url, "/v1/usage", key="sk-gwf-vic")
+    assert st == 200, usage
+    return failed, usage
+
+
+def _gwf_row(usage: dict, tenant: str) -> dict:
+    row = usage["tenants"].get(tenant)
+    assert row is not None, usage
+    return row
+
+
+def tenant_fairness_phase(pass_: str) -> dict:
+    import tempfile
+
+    from areal_tpu.bench.fleet import ProcessFleet
+
+    t_start = time.monotonic()
+
+    if pass_ == "compile":
+        # One server + one gateway + one completion: compiles the
+        # serving programs AND proves the gateway wiring end-to-end so
+        # the measure pass never debugs plumbing inside its window.
+        t0 = time.perf_counter()
+        with ProcessFleet(
+            _OPENLOOP_MODEL, [dict(_FLEET_SRV)], tag="gwfc",
+        ) as fleet:
+            wal = os.path.join(tempfile.mkdtemp(prefix="areal_gwf_"),
+                               "usage.jsonl")
+            proc, url = _gwf_spawn(fleet, wal, fair=True)
+            try:
+                st, body = _gwf_completion(url, "sk-gwf-vic", 1)
+                assert st == 200, body
+            finally:
+                proc.kill()
+                proc.wait(timeout=10)
+        dt = time.perf_counter() - t0
+        log(f"bench: tenant_fairness compile pass {dt:.1f}s")
+        return {"compile_s": dt}
+
+    fleet = None
+    gw = None
+    tmp = tempfile.mkdtemp(prefix="areal_gwf_")
+    try:
+        fleet = ProcessFleet(
+            _OPENLOOP_MODEL, [dict(_FLEET_SRV)] * 2, tag="gwf",
+        )
+
+        # ---- Solo baseline: the victim alone, fair share on (it has
+        # no one to arbitrate against — this is the latency floor).
+        # Warm the serving path on the AGGRESSOR's key first so cold-
+        # start cost never lands in the victim's baseline histogram.
+        gw, url = _gwf_spawn(fleet, os.path.join(tmp, "solo.jsonl"),
+                             fair=True)
+        for i in range(4):
+            st, body = _gwf_completion(url, "sk-gwf-agg", 500 + i)
+            assert st == 200, body
+        failed_solo, usage = _gwf_victim_arm(url, flood=False)
+        solo_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
+        gw.kill()
+        gw.wait(timeout=10)
+
+        # ---- Fair ON under flood: victim p99 must stay livable while
+        # the aggressor saturates its stream cap and gets shed.
+        gw, url2 = _gwf_spawn(fleet, os.path.join(tmp, "fair.jsonl"),
+                              fair=True, not_url=url)
+        failed_fair, usage = _gwf_victim_arm(url2, flood=True)
+        fair_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
+        agg_sheds = float(_gwf_row(usage, "agg")["sheds"])
+        picks = _gwf_metric(url2, "areal:gw_fairshare_picks_total")
+        gw.kill()
+        gw.wait(timeout=10)
+
+        # ---- Fair OFF (FIFO) under the same flood: documents the
+        # collapse weighted fair share prevents.
+        gw, url3 = _gwf_spawn(fleet, os.path.join(tmp, "unfair.jsonl"),
+                              fair=False, not_url=url2)
+        failed_unfair, usage = _gwf_victim_arm(url3, flood=True)
+        unfair_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
+        gw.kill()
+        gw.wait(timeout=10)
+        gw = None
+
+        out = {
+            "solo_p99_ttft_ms": solo_p99,
+            "fair_p99_ttft_ms": fair_p99,
+            "unfair_p99_ttft_ms": unfair_p99,
+            "fair_over_solo": fair_p99 / max(solo_p99, 1e-9),
+            "unfair_over_fair": unfair_p99 / max(fair_p99, 1e-9),
+            "aggressor_sheds": agg_sheds,
+            "fairshare_picks": picks,
+            "victim_failed": float(
+                failed_solo + failed_fair + failed_unfair
+            ),
+            "fleet": "process",
+            "wall_s": time.monotonic() - t_start,
+        }
+        log(f"bench: tenant_fairness {out}")
+        return out
+    finally:
+        if gw is not None:
+            gw.kill()
+        if fleet is not None:
+            fleet.close()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ----------------------------------------------------------------------
 # kernel_micro family: banked per-kernel evidence for the serving/train
 # hot-path kernels (ROADMAP item 3). Every case carries its parity
 # number next to its timing — a fast kernel that diverged is refused by

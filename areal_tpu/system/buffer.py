@@ -106,6 +106,11 @@ class AsyncIOSequenceBuffer:
             "areal:train_samples_duplicated_total": 0,
             "areal:train_stale_dropped_total": 0,
         }
+        # Per-task attribution of the staleness drops above: task tag ->
+        # count. Mixed-stream runs (math + agentic through ONE buffer)
+        # assert each task's window admits/drops independently; the
+        # trainer folds these into perf/task_stale_dropped_<task>.
+        self.stale_dropped_by_task: Dict[str, int] = {}
         # Per-task admission windows on top of the gserver manager's
         # GLOBAL allocation gate: a task tag listed here is dropped at
         # put_batch once current_train_step - version_end exceeds its
@@ -127,6 +132,13 @@ class AsyncIOSequenceBuffer:
     def size(self) -> int:
         return len(self._slots)
 
+    def resident_ids(self, ids) -> Set[str]:
+        """Subset of `ids` currently holding a live slot. Used by the
+        step-end cache clear to spare epoch-carryover copies: a consumed
+        id that was re-admitted mid-step still needs its tracker entry
+        and worker-side data next step."""
+        return {i for i in ids if i in self._slots}
+
     async def put_batch(self, samples: List[SequenceSample]) -> int:
         """Insert samples whose dataset keys are ready. Returns #inserted."""
         async with self._cond:
@@ -143,6 +155,7 @@ class AsyncIOSequenceBuffer:
             ignored_seen = set()
             ledgered = set()
             stale = set()
+            stale_tasks: Dict[str, int] = {}
             for s in samples:
                 seqs = s.metadata.get("wal_seq")
                 tasks = s.metadata.get("task")
@@ -156,6 +169,7 @@ class AsyncIOSequenceBuffer:
                         lag = self.current_train_step - int(v_ends[i])
                         if lag > win:
                             stale.add(sample_id)
+                            stale_tasks[task] = stale_tasks.get(task, 0) + 1
                             continue
                     if seq is not None and (
                         seq in self.seq_ledger
@@ -193,11 +207,16 @@ class AsyncIOSequenceBuffer:
                 )
             if stale:
                 self.counters["areal:train_stale_dropped_total"] += len(stale)
+                for t, n in stale_tasks.items():
+                    self.stale_dropped_by_task[t] = (
+                        self.stale_dropped_by_task.get(t, 0) + n
+                    )
                 logger.info(
                     "per-task staleness window dropped %d sample(s) at "
-                    "admission (total %d)",
+                    "admission (total %d; by task %r)",
                     len(stale),
                     self.counters["areal:train_stale_dropped_total"],
+                    dict(self.stale_dropped_by_task),
                 )
             if resident_dups:
                 self.n_dropped_duplicates += len(resident_dups)

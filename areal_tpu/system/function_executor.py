@@ -133,7 +133,22 @@ class FunctionExecutor:
     async def clear_gpu_cache(self):
         """Drop this step's consumed samples everywhere
         (reference function_executor.py:100-105)."""
-        ids = sorted(self.ctrl.used_ids)
+        used = set(self.ctrl.used_ids)
+        # Epoch carryover: a consumed id may have been RE-admitted to the
+        # buffer mid-step (tiny datasets re-issue row ids every epoch).
+        # Clearing such an id now would wipe the tracker ownership and
+        # worker-side data its resident copy needs next step ("no owner"
+        # at derive_plan). Defer it — its next consumption re-adds it to
+        # used_ids and the clear happens then.
+        resident = self.buffer.resident_ids(used)
+        ids = sorted(used - resident)
+        self.ctrl.used_ids.clear()
+        if resident:
+            logger.warning(
+                "deferring cache clear of %d id(s) re-admitted to the "
+                "buffer (epoch carryover), e.g. %r",
+                len(resident), next(iter(resident)),
+            )
         if not ids:
             return
         all_workers = sorted(
@@ -144,7 +159,6 @@ class FunctionExecutor:
         )
         await asyncio.gather(*[async_poll(self.stream, rid) for rid in req_ids])
         self.tracker.drop_samples(ids)
-        self.ctrl.used_ids.clear()
 
     async def execute_step(self) -> Dict:
         """One DFG traversal; returns train stats keyed by MFC name."""

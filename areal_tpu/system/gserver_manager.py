@@ -81,6 +81,47 @@ class GserverManager(Worker):
             self.__dict__["_breaker_board"] = b
         return b
 
+    @property
+    def gateway_registry(self) -> Optional[health.HealthRegistry]:
+        """Health-registry view over tenant-gateway heartbeats
+        (system/gateway.py): each gateway's heartbeat payload carries
+        its per-tenant usage brief, which /status folds into
+        ``gateway_tenants`` rows — no extra wire route needed. Lazily
+        built like ``breakers``; returns None for harness-built
+        partial managers with no trial identity."""
+        r = self.__dict__.get("_gateway_registry")
+        if r is None:
+            try:
+                r = health.HealthRegistry(
+                    self.cfg.experiment_name, self.cfg.trial_name,
+                    prefix="gateway",
+                )
+            except Exception:
+                return None
+            self.__dict__["_gateway_registry"] = r
+        return r
+
+    def gateway_tenants(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant usage rows summed across live gateways. Blocking
+        (name_resolve reads) — call via run_in_executor from async."""
+        reg = self.gateway_registry
+        if reg is None:
+            return {}
+        try:
+            snap = reg.snapshot()
+        except Exception:
+            return {}
+        out: Dict[str, Dict[str, int]] = {}
+        for rec in snap.values():
+            for tenant, row in (rec.get("tenants") or {}).items():
+                agg = out.setdefault(tenant, {
+                    "requests": 0, "sheds": 0,
+                    "prompt_tokens": 0, "completion_tokens": 0,
+                })
+                for k in agg:
+                    agg[k] += int(row.get(k, 0) or 0)
+        return out
+
     def _configure(self, config: GserverManagerConfig):
         from areal_tpu.system import fleet_controller
 
@@ -1607,6 +1648,8 @@ class GserverManager(Worker):
         )
 
     async def _h_status(self, request: web.Request) -> web.Response:
+        loop = asyncio.get_event_loop()
+        gw_tenants = await loop.run_in_executor(None, self.gateway_tenants)
         with self._lock:
             healthy = self._healthy_urls()
             evicted = dict(self._evicted)
@@ -1739,6 +1782,10 @@ class GserverManager(Worker):
                 # (separate by design), the planned tree, and any
                 # evictions it caused. Empty when the plane is off.
                 "weight_plane": wp_last,
+                # Per-tenant gateway usage rows (system/gateway.py),
+                # folded from gateway heartbeat payloads. Empty when no
+                # gateway is deployed.
+                "gateway_tenants": gw_tenants,
             }
         )
 

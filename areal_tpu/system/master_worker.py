@@ -327,6 +327,11 @@ class MasterWorker(Worker):
                     mreg.PERF_EPISODE_TOOL_CALLS,
                     mreg.PERF_TASK_STALENESS_MATH,
                     mreg.PERF_TASK_STALENESS_AGENTIC,
+                    # Mixed-stream runs (PR 19): admission-side drop
+                    # attribution, the per-task split of the buffer's
+                    # stale-drop counter.
+                    mreg.PERF_TASK_STALE_DROPPED_MATH,
+                    mreg.PERF_TASK_STALE_DROPPED_AGENTIC,
                 ):
                     # Input-pipeline telemetry: per-MFC series + running
                     # mean in perf_summary["overlap"].

@@ -260,6 +260,16 @@ class ModelFunctionCall:
                 ]
                 if lags:
                     stats[key] = float(np.mean(lags))
+            # Admission-side complement: per-task counts of samples the
+            # buffer's staleness window DROPPED (mixed-stream runs
+            # assert each window fires independently).
+            for tag, key in (
+                ("math", "perf/task_stale_dropped_math"),
+                ("agentic", "perf/task_stale_dropped_agentic"),
+            ):
+                dropped = self.buffer.stale_dropped_by_task.get(tag, 0)
+                if dropped:
+                    stats[key] = float(dropped)
         # DP workers run concurrently: wall time is the max, flops add,
         # so MFC TFLOP/s is aggregate-over-workers per wall second.
         if stats.get("perf/flops") and stats.get("perf/sec"):

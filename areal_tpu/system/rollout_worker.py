@@ -129,16 +129,41 @@ class RolloutWorker(AsyncWorker):
             names.gen_server_manager(config.experiment_name, config.trial_name),
             timeout=300,
         )
-        self.prm = PartialRolloutManager(
-            self.manager_addr,
-            new_tokens_per_chunk=config.new_tokens_per_chunk,
-            request_timeout=config.rollout_request_timeout,
-            max_retries=config.rollout_max_retries,
-            addr_resolver=lambda: name_resolve.get(
+        # Trainer-via-gateway (system/gateway.py): scheduling hops ride
+        # the gateway's /schedule_request trainer proxy, which tags
+        # metas as the reserved never-shed ``trainer`` tenant before
+        # forwarding to the manager — internal traffic shows up in the
+        # usage ledger but can never be queued or rate-limited behind
+        # external tenants. allocate/finish stay on the manager either
+        # way (they are quota bookkeeping, not serving traffic).
+        self._prm_via_gateway = env_registry.get_bool(
+            "AREAL_GW_TRAINER_VIA_GATEWAY"
+        )
+        if self._prm_via_gateway:
+            prm_addr = name_resolve.wait(
+                names.gateway_url(
+                    config.experiment_name, config.trial_name
+                ),
+                timeout=300,
+            )
+            prm_resolver = lambda: name_resolve.get(  # noqa: E731
+                names.gateway_url(
+                    config.experiment_name, config.trial_name
+                )
+            )
+        else:
+            prm_addr = self.manager_addr
+            prm_resolver = lambda: name_resolve.get(  # noqa: E731
                 names.gen_server_manager(
                     config.experiment_name, config.trial_name
                 )
-            ),
+            )
+        self.prm = PartialRolloutManager(
+            prm_addr,
+            new_tokens_per_chunk=config.new_tokens_per_chunk,
+            request_timeout=config.rollout_request_timeout,
+            max_retries=config.rollout_max_retries,
+            addr_resolver=prm_resolver,
         )
         # Ack mode rides the WAL switch: with the durable plane armed,
         # every trajectory carries a minted sequence id and stays in the
@@ -178,7 +203,10 @@ class RolloutWorker(AsyncWorker):
                 f"gserver manager moved {self.manager_addr} -> {addr}"
             )
             self.manager_addr = addr
-            self.prm.manager_addr = addr
+            # In gateway mode the PRM follows the GATEWAY record via
+            # its own addr_resolver, not the manager's.
+            if not self._prm_via_gateway:
+                self.prm.manager_addr = addr
 
     async def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:

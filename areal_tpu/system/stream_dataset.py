@@ -56,6 +56,12 @@ class PullerStreamDataset:
         self._wal_lock = threading.Lock()
         self._seen: set = set()
         self._replayed: deque = deque()
+        # Samples held back because their ids collided with an earlier
+        # sample in the same poll_batch drain (epoch carryover: a tiny
+        # dataset re-issues row ids faster than the trainer drains the
+        # queue). gather() refuses duplicate ids, so collisions are
+        # deferred to the next batch rather than poisoning this one.
+        self._held: deque = deque()
         if env_registry.get_bool("AREAL_WAL"):
             path = os.path.join(
                 constants.get_recover_path(experiment_name, trial_name),
@@ -145,14 +151,32 @@ class PullerStreamDataset:
                     continue
 
     def qsize(self) -> int:
-        return self._queue.qsize() + len(self._replayed)
+        return self._queue.qsize() + len(self._replayed) + len(self._held)
 
     def poll_batch(self, max_samples: int = 64) -> Optional["data_api.SequenceSample"]:
         """Drain up to max_samples pulled trajectories into one batch
-        (WAL-replayed survivors first)."""
+        (held-back collisions first, then WAL-replayed survivors).
+
+        A sample whose ids repeat an earlier sample in the SAME drain is
+        a later-epoch episode of the same dataset row; it is deferred to
+        the next batch (gather refuses duplicate ids, and one fetch must
+        never deliver two copies of an id anyway — the master's buffer
+        and storage tracker key on ids)."""
         samples: List[data_api.SequenceSample] = []
+        batch_ids: set = set()
+        deferred: List[data_api.SequenceSample] = []
+
+        def take(sample: "data_api.SequenceSample"):
+            if batch_ids.intersection(sample.ids):
+                deferred.append(sample)
+                return
+            batch_ids.update(sample.ids)
+            samples.append(sample)
+
+        while len(samples) < max_samples and self._held:
+            take(self._held.popleft())
         while len(samples) < max_samples and self._replayed:
-            samples.append(self._replayed.popleft())
+            take(self._replayed.popleft())
         while len(samples) < max_samples:
             try:
                 recv_ns, sample = self._queue.get_nowait()
@@ -165,7 +189,8 @@ class PullerStreamDataset:
                     ctx=tracing.extract(ctx),
                     qid=str(sample.ids[0]) if sample.ids else "",
                 )
-            samples.append(sample)
+            take(sample)
+        self._held.extend(deferred)
         if not samples:
             return None
         return data_api.SequenceSample.gather(samples)

@@ -102,8 +102,13 @@ class RolloutWAL:
     AREAL_WAL_FSYNC_MS elapsed, or immediately on `sync()`.
     """
 
-    def __init__(self, path: str, fsync_ms: Optional[float] = None):
+    def __init__(self, path: str, fsync_ms: Optional[float] = None,
+                 schema: str = BUFFER_WAL_V1):
         self.path = path
+        # Which areal-*-wal/vN header this journal carries: the buffer
+        # WAL by default; the gateway's usage ledger reuses the same
+        # torn-tail/compaction machinery under its own schema tag.
+        self.schema = schema
         if fsync_ms is None:
             fsync_ms = env_registry.get_float("AREAL_WAL_FSYNC_MS")
         self._fsync_s = max(0.0, float(fsync_ms)) / 1000.0
@@ -151,7 +156,7 @@ class RolloutWAL:
                     break
                 if rec is not None:
                     if first:
-                        if rec.get("schema") != BUFFER_WAL_V1:
+                        if rec.get("schema") != self.schema:
                             raise ValueError(
                                 f"WAL {self.path} has unsupported schema "
                                 f"{rec.get('schema')!r}"
@@ -179,7 +184,7 @@ class RolloutWAL:
         self._f = open(self.path, "ab")
         if write_header:
             self._f.write(
-                json.dumps({"schema": BUFFER_WAL_V1},
+                json.dumps({"schema": self.schema},
                            separators=(",", ":")).encode() + b"\n"
             )
             self._f.flush()
@@ -255,7 +260,7 @@ class RolloutWAL:
                         dropped += 1
         tmp = self.path + f".tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
-            f.write(json.dumps({"schema": BUFFER_WAL_V1},
+            f.write(json.dumps({"schema": self.schema},
                                separators=(",", ":")).encode() + b"\n")
             for line in kept:
                 f.write(line + b"\n")

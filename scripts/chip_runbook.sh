@@ -41,6 +41,14 @@ timeout 180 python -m areal_tpu.system.reward_executor --selftest || {
     echo "reward-executor preflight failed — fix before burning the window"
     exit 1; }
 
+echo "== preflight: tenant gateway (stub fleet + streaming completion + ledger) =="
+# Serving windows front external traffic through the gateway; a gateway
+# that can't auth, stream, or bill against an in-process stub here
+# would burn the window debugging the front door instead of measuring.
+timeout 120 python -m areal_tpu.system.gateway --selftest || {
+    echo "gateway preflight failed — fix before burning the window"
+    exit 1; }
+
 echo "== 0. device probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
     echo "TPU unreachable: leaving the bench DAEMON armed instead —"

@@ -120,6 +120,16 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "kv_prefix_lost",
         "n_servers_max",
     ),
+    # Gateway fairness evidence is only evidence as the full A/B/C
+    # triple with its shed and queue accounting: a good-looking fair-arm
+    # p99 without aggressor sheds (the flood never saturated), without
+    # DRR picks (the queue never arbitrated), or without the FIFO arm's
+    # collapse next to it proves nothing about fair share.
+    "tenant_fairness": (
+        "solo_p99_ttft_ms", "fair_p99_ttft_ms", "unfair_p99_ttft_ms",
+        "fair_over_solo", "unfair_over_fair",
+        "aggressor_sheds", "fairshare_picks", "victim_failed",
+    ),
     # MoE fast-path evidence is only evidence with its parity, drop, and
     # ingress accounting: a fast EP2 step time next to a diverged loss
     # trajectory, a "dropless" arm that realized drops, or an
@@ -559,6 +569,49 @@ def _validate_agentic_rollout(val: Dict) -> List[str]:
     return problems
 
 
+def _validate_tenant_fairness(val: Dict) -> List[str]:
+    """The tenant gateway's fairness contract (ISSUE 19 acceptance):
+    under the aggressor flood, weighted fair share must hold the
+    victim's p99 TTFT below the FIFO arm's, the aggressor must be shed
+    against its OWN limits (a flood that never saturated proves
+    nothing), the DRR queue must have actually arbitrated, and not one
+    victim request may fail — fairness by starving no one."""
+    problems: List[str] = []
+    fair = _num(val, "fair_p99_ttft_ms")
+    unfair = _num(val, "unfair_p99_ttft_ms")
+    if fair is None or unfair is None or fair >= unfair:
+        problems.append(
+            f"tenant_fairness: fair-share victim p99 {fair}ms is not "
+            f"below the FIFO arm's {unfair}ms — the weighted queue "
+            f"bought the victim nothing"
+        )
+    if (_num(val, "solo_p99_ttft_ms") or 0) <= 0:
+        problems.append(
+            "tenant_fairness: no solo baseline p99 — the record cannot "
+            "anchor the flood arms to an idle-fleet floor"
+        )
+    if (_num(val, "aggressor_sheds") or 0) < 1:
+        problems.append(
+            "tenant_fairness: zero aggressor sheds — the flood never "
+            "exceeded its stream cap, so the arms measured an idle "
+            "gateway"
+        )
+    if (_num(val, "fairshare_picks") or 0) < 1:
+        problems.append(
+            "tenant_fairness: zero DRR picks in the fair arm — "
+            "admitted requests never contended in the gateway queue, "
+            "so fair share was never exercised"
+        )
+    victim_failed = _num(val, "victim_failed")
+    if victim_failed is None or victim_failed > 0:
+        problems.append(
+            f"tenant_fairness: {victim_failed} failed victim "
+            f"request(s) — fair share must protect the victim, not "
+            f"starve it"
+        )
+    return problems
+
+
 def _validate_rpc_resilience(val: Dict) -> List[str]:
     """The hedging contract (ISSUE 14 acceptance): under the injected
     delay tail, the hedged arm's p99 must be MEASURABLY lower than the
@@ -880,6 +933,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_fleet_elastic(val))
     if name == "rpc_resilience":
         problems.extend(_validate_rpc_resilience(val))
+    if name == "tenant_fairness":
+        problems.extend(_validate_tenant_fairness(val))
     if name == "agentic_rollout":
         problems.extend(_validate_agentic_rollout(val))
     if name == "recovery_slo":

@@ -1,0 +1,126 @@
+"""Unit tests for the OpenAI-compatible public wire module
+(api/public.py): prompt codec, request validation, SSE framing, and
+response envelopes — the transport-free half of the tenant gateway."""
+
+import json
+
+import pytest
+
+from areal_tpu.api import public
+from areal_tpu.base.wire_schemas import GATEWAY_V1
+
+
+def test_byte_codec_roundtrip():
+    assert public.encode_text("hi") == [104, 105]
+    assert public.decode_tokens([104, 105]) == "hi"
+    text = "héllo"
+    assert public.decode_tokens(public.encode_text(text)) == text
+    # Out-of-byte-range ids are folded for display, never a crash (the
+    # authoritative payload is the token_ids field alongside).
+    assert public.decode_tokens([65 + 256]) == "A"
+
+
+def test_parse_completion_defaults():
+    p = public.parse_completion_request({"prompt": "hi"})
+    assert p.kind == "completion"
+    assert p.prompt_ids == [104, 105]
+    assert p.max_tokens == 16 and p.stream is True
+    assert p.temperature == 1.0 and p.greedy is False
+    assert p.session is None and p.model == "areal"
+
+
+def test_parse_completion_token_ids_and_fields():
+    p = public.parse_completion_request({
+        "prompt": [1, 2, 3], "max_tokens": 4, "stream": False,
+        "temperature": 0.0, "model": "m1", "session": "s1",
+    })
+    assert p.prompt_ids == [1, 2, 3]
+    assert p.max_tokens == 4 and p.stream is False
+    assert p.greedy is True  # temperature 0 implies greedy
+    assert p.session == "s1" and p.model == "m1"
+    # A single-element string list is the OpenAI batched-form of one
+    # prompt; real batches are rejected.
+    p = public.parse_completion_request({"prompt": ["hi"]})
+    assert p.prompt_ids == [104, 105]
+
+
+@pytest.mark.parametrize("body,frag", [
+    ({}, "missing 'prompt'"),
+    ({"prompt": ""}, "empty prompt"),
+    ({"prompt": ["a", "b"]}, "batched prompts"),
+    ({"prompt": 7}, "unsupported prompt type"),
+    ({"prompt": "x", "max_tokens": 0}, "max_tokens"),
+    ({"prompt": "x", "max_tokens": "lots"}, "bad sampling field"),
+    ({"prompt": "x", "n": 2}, "n > 1"),
+    ({"prompt": "x", "session": 5}, "session must be a string"),
+])
+def test_parse_completion_rejects(body, frag):
+    with pytest.raises(public.PublicApiError) as ei:
+        public.parse_completion_request(body)
+    assert ei.value.status == 400
+    assert frag in ei.value.message
+
+
+def test_parse_chat_renders_template():
+    p = public.parse_chat_request({"messages": [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hi"},
+    ]})
+    assert p.kind == "chat"
+    text = public.decode_tokens(p.prompt_ids)
+    assert text == "system: be brief\nuser: hi\nassistant:"
+
+
+@pytest.mark.parametrize("body", [
+    {}, {"messages": []}, {"messages": "hi"},
+    {"messages": [{"role": "user", "content": [1]}]},
+])
+def test_parse_chat_rejects(body):
+    with pytest.raises(public.PublicApiError):
+        public.parse_chat_request(body)
+
+
+def test_sse_framing():
+    ev = public.sse_event({"a": 1})
+    assert ev == b'data: {"a":1}\n\n'
+    assert public.SSE_DONE == b"data: [DONE]\n\n"
+
+
+def test_completion_chunk_fields():
+    c = public.completion_chunk("cmpl-1", "m", [104, 105])
+    assert c["schema"] == GATEWAY_V1
+    assert c["object"] == "text_completion.chunk"
+    ch = c["choices"][0]
+    assert ch["text"] == "hi" and ch["token_ids"] == [104, 105]
+    assert ch["finish_reason"] is None
+    final = public.completion_chunk("cmpl-1", "m", [], "stop")
+    assert final["choices"][0]["finish_reason"] == "stop"
+
+
+def test_chat_chunk_role_on_first_only():
+    first = public.chat_chunk("c", "m", [104], first=True)
+    later = public.chat_chunk("c", "m", [105])
+    assert first["choices"][0]["delta"]["role"] == "assistant"
+    assert "role" not in later["choices"][0]["delta"]
+    assert later["object"] == "chat.completion.chunk"
+
+
+def test_bodies_and_usage():
+    b = public.completion_body("cmpl-1", "m", [104, 105], 3, "length")
+    assert b["usage"] == {
+        "prompt_tokens": 3, "completion_tokens": 2, "total_tokens": 5}
+    assert b["choices"][0]["finish_reason"] == "length"
+    cb = public.chat_body("chatcmpl-1", "m", [104], 2, "stop")
+    assert cb["choices"][0]["message"] == {
+        "role": "assistant", "content": "h"}
+    assert json.loads(json.dumps(cb)) == cb  # wire-serializable
+
+
+def test_error_body_types():
+    assert public.error_body(401, "no")["error"]["type"] == (
+        "authentication_error")
+    e = public.error_body(429, "slow down", retry_after=1.5)
+    assert e["error"]["type"] == "rate_limit_error"
+    assert e["error"]["retry_after"] == 1.5
+    assert e["schema"] == GATEWAY_V1
+    assert public.error_body(503, "down")["error"]["type"] == "api_error"

@@ -113,6 +113,25 @@ def test_metadata_alignment():
     assert sub.metadata["scores"] == [2.0]
 
 
+def test_gather_pads_stream_specific_metadata():
+    """Mixed-stream batches (ISSUE 19): agentic samples stamp
+    turns/tool_calls, math samples don't — gather pads the absent
+    samples with None instead of refusing the batch, keeping per-sample
+    alignment for the train-step folds (which filter on isinstance)."""
+    agentic = make_sample(2, seed=1)
+    agentic.metadata.update(
+        {"task": ["agentic", "agentic"], "tool_calls": [2, 1]}
+    )
+    math = make_sample(2, seed=2)
+    math.metadata.update({"task": ["math", "math"]})
+    g = SequenceSample.gather([agentic, math])
+    assert g.metadata["task"] == ["agentic", "agentic", "math", "math"]
+    assert g.metadata["tool_calls"] == [2, 1, None, None]
+    # The padding survives a split back out.
+    back = g._select_indices([2, 0])
+    assert back.metadata["tool_calls"] == [None, 2]
+
+
 def test_grouped_inner_seqlens():
     # One id holding a group of 2 sequences under one key (GRPO-style).
     s = SequenceSample(

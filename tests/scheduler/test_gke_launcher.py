@@ -1,0 +1,130 @@
+"""GkeLauncher: the elastic-fleet Launcher protocol actuated over the
+Kubernetes scheduler client, driven against the fake kubectl (pods are
+real local processes, so launch/drain/failure paths exercise the whole
+submit/find/delete plumbing)."""
+
+import json
+import os
+import signal
+import stat
+import sys
+import time
+
+import pytest
+
+from areal_tpu.scheduler.client import JobState, make_scheduler
+from areal_tpu.scheduler.gke import GkeLauncher
+
+FAKE = os.path.join(os.path.dirname(__file__), "fake_kubectl.py")
+
+
+@pytest.fixture()
+def kubectl(tmp_path, monkeypatch):
+    state = tmp_path / "k8s_state"
+    monkeypatch.setenv("FAKE_K8S_STATE", str(state))
+    wrapper = tmp_path / "kubectl"
+    wrapper.write_text(f"#!/bin/sh\nexec {sys.executable} {FAKE} \"$@\"\n")
+    wrapper.chmod(wrapper.stat().st_mode | stat.S_IEXEC)
+    return str(wrapper), state
+
+
+def _launcher(cmd, body="import time; time.sleep(60)", env_fn=None):
+    client = make_scheduler("gke", kubectl_cmd=cmd)
+    return (
+        GkeLauncher(
+            client,
+            cmd_fn=lambda i: [sys.executable, "-c", body],
+            env_fn=env_fn,
+        ),
+        client,
+    )
+
+
+def _wait_state(client, name, want, timeout=10):
+    deadline = time.monotonic() + timeout
+    while client.find(name).state != want:
+        assert time.monotonic() < deadline, f"{name} never reached {want}"
+        time.sleep(0.05)
+
+
+def test_launch_runs_job_and_records_handle(kubectl):
+    cmd, _ = kubectl
+    launcher, client = _launcher(cmd)
+    handle = launcher.launch(0)
+    assert handle == "gen-server-0"
+    assert launcher.launched == {"gen-server-0": 0}
+    _wait_state(client, handle, JobState.RUNNING)
+    # A healthy running job is neither reaped nor reported as a failure.
+    launcher.reap()
+    assert launcher.launched == {"gen-server-0": 0}
+    assert launcher.failures == []
+    client.stop_all()
+
+
+def test_launch_passes_env(kubectl):
+    cmd, state = kubectl
+    launcher, client = _launcher(
+        cmd,
+        body="import os, sys; sys.exit(0 if os.environ['SRV'] == '3' else 9)",
+        env_fn=lambda i: {"SRV": str(i)},
+    )
+    launcher.launch(3)
+    _wait_state(client, "gen-server-3", JobState.COMPLETED)
+
+
+def test_stop_drains_job(kubectl):
+    cmd, _ = kubectl
+    launcher, client = _launcher(cmd)
+    handle = launcher.launch(1)
+    _wait_state(client, handle, JobState.RUNNING)
+    launcher.stop(handle)
+    assert client.find(handle).state == JobState.NOT_FOUND
+    # A drained (deleted) job is forgotten without counting as a failure.
+    launcher.reap()
+    assert launcher.launched == {}
+    assert launcher.failures == []
+
+
+def test_killed_pod_reaps_as_failure(kubectl):
+    cmd, state = kubectl
+    launcher, client = _launcher(cmd)
+    handle = launcher.launch(2)
+    _wait_state(client, handle, JobState.RUNNING)
+    with open(state / f"{handle}.json") as f:
+        pid = json.load(f)["pid"]
+    os.killpg(pid, signal.SIGKILL)
+    _wait_state(client, handle, JobState.FAILED)
+    launcher.reap()
+    assert launcher.launched == {}
+    assert launcher.failures == [handle]
+
+
+def test_completed_job_reaps_without_failure(kubectl):
+    cmd, _ = kubectl
+    launcher, client = _launcher(cmd, body="print('ok')")
+    handle = launcher.launch(0)
+    _wait_state(client, handle, JobState.COMPLETED)
+    launcher.reap()
+    assert launcher.launched == {}
+    assert launcher.failures == []
+
+
+def test_apply_failure_raises_and_leaves_no_handle(tmp_path, monkeypatch):
+    """kubectl apply rc!=0 must surface as a raise (fleet controller
+    retries the decision next poll) with no phantom bookkeeping."""
+    monkeypatch.setenv("FAKE_K8S_STATE", str(tmp_path / "k8s_state"))
+    broken = tmp_path / "kubectl"
+    broken.write_text("#!/bin/sh\necho 'boom' >&2\nexit 1\n")
+    broken.chmod(broken.stat().st_mode | stat.S_IEXEC)
+    launcher, _ = _launcher(str(broken))
+    with pytest.raises(RuntimeError, match="apply failed"):
+        launcher.launch(0)
+    assert launcher.launched == {}
+    assert launcher.failures == []
+
+
+def test_stop_swallows_kubectl_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKE_K8S_STATE", str(tmp_path / "k8s_state"))
+    missing = str(tmp_path / "no-such-kubectl")
+    launcher, _ = _launcher(missing)
+    launcher.stop("gen-server-0")  # must not raise

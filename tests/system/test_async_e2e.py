@@ -144,7 +144,7 @@ def _assert_continuation_reprefill(tmp_path):
     )
 
 
-def _trainer_parts(exp, trial, tok_dir):
+def _trainer_parts(exp, trial, tok_dir, n_seqs=N_SEQS):
     """The trainer side shared by every async e2e variant: train MFC
     (with the weight-publish hook), stream-dataset model worker, and a
     2-step benchmark master."""
@@ -154,7 +154,7 @@ def _trainer_parts(exp, trial, tok_dir):
         model_name=actor,
         interface_type=ModelInterfaceType.TRAIN_STEP,
         interface_impl=None,
-        n_seqs=N_SEQS,
+        n_seqs=n_seqs,
         input_keys=(
             "packed_input_ids",
             "prompt_mask",
@@ -184,7 +184,7 @@ def _trainer_parts(exp, trial, tok_dir):
             )
         ],
         tokenizer_path=tok_dir,
-        train_batch_size=N_SEQS,
+        train_batch_size=n_seqs,
         total_train_epochs=1,
         stream_dataset=True,
         n_pullers=1,
@@ -197,7 +197,7 @@ def _trainer_parts(exp, trial, tok_dir):
         model_topos={str(actor): ["model_worker/0"]},
         data_hosts=["model_worker/0"],
         n_model_workers=1,
-        train_batch_size=N_SEQS,
+        train_batch_size=n_seqs,
     )
     return model_args, mw, master
 

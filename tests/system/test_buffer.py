@@ -264,6 +264,32 @@ def test_per_task_staleness_windows_gate_admission():
     asyncio.run(main())
 
 
+def test_stale_drops_attributed_per_task():
+    """ISSUE 19 (mixed-stream remainder): staleness drops are
+    attributed to the task stream that suffered them, so a mixed
+    math+agentic run can tell WHICH stream is falling behind (the
+    trainer surfaces these as perf/task_stale_dropped_*)."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+    buf.current_train_step = 10
+
+    async def main():
+        await buf.put_batch([
+            _task_sample(0, "math", 7),     # stale
+            _task_sample(1, "math", 6),     # stale
+            _task_sample(2, "agentic", 1),  # stale
+            _task_sample(3, "agentic", 2),  # admitted
+            _task_sample(4, "math", 10),    # admitted
+        ])
+        assert buf.stale_dropped_by_task == {"math": 2, "agentic": 1}
+        assert buf.counters["areal:train_stale_dropped_total"] == 3
+        # The attribution accumulates across batches, like the counter.
+        await buf.put_batch([_task_sample(5, "math", 0)])
+        assert buf.stale_dropped_by_task == {"math": 3, "agentic": 1}
+
+    asyncio.run(main())
+
+
 def test_task_windows_env_override(monkeypatch):
     """The windows knob parses operator overrides and shrugs off
     malformed entries instead of taking the trainer down."""
@@ -299,3 +325,36 @@ def test_overflow_precheck_counts_unique_ids():
             await buf.put_batch([_sample(4)])
 
     asyncio.run(run())
+
+
+def test_resident_ids_spares_carryover_copies():
+    """The step-end cache clear asks the buffer which consumed ids were
+    re-admitted mid-step (epoch carryover): those must keep their tracker
+    entries and worker-side data for the next step."""
+    gen, train = _rpcs()
+    buf = AsyncIOSequenceBuffer([gen, train])
+
+    async def main():
+        await buf.put_batch([_sample(0), _sample(1)])
+        assert buf.resident_ids({"s0", "s1", "zz"}) == {"s0", "s1"}
+        # Consume s0/s1 through both rpcs -> GC'd.
+        _, b = await buf.get_batch_for_rpc(gen)
+        await buf.amend_batch(
+            SequenceSample(
+                ids=list(b.ids),
+                keys={"seq", "logp"},
+                data={
+                    "seq": np.zeros(b.bs, dtype=np.int32),
+                    "logp": np.zeros(b.bs, dtype=np.float32),
+                },
+                seqlens={"seq": [[1]] * b.bs, "logp": [[1]] * b.bs},
+            )
+        )
+        await buf.get_batch_for_rpc(train)
+        assert buf.resident_ids({"s0", "s1"}) == set()
+        # Re-admission of the same row id (next epoch) makes it resident
+        # again, so the clear must defer it.
+        await buf.put_batch([_sample(0)])
+        assert buf.resident_ids({"s0", "s1"}) == {"s0"}
+
+    asyncio.run(main())

@@ -638,6 +638,98 @@ def _drive_rexec_die(tmp_path, monkeypatch):
         svc.stop()
 
 
+# -- gateway front door (real GatewayService + stub upstream) ----------
+
+
+def _gw_harness(tmp_path):
+    from areal_tpu.base import name_resolve
+    from areal_tpu.system.gateway import GatewayService, _StubUpstream
+
+    name_resolve.reconfigure("memory")
+    stub = _StubUpstream()
+    stub.start()
+    svc = GatewayService(
+        "campaign-gw", "t0",
+        manager_addr=stub.address,
+        tenant_spec="acme:sk-acme:1:100000:200000:4",
+        usage_wal_path=str(tmp_path / "gw_usage.jsonl"),
+    )
+    url = svc.start()
+    return stub, svc, url
+
+
+def _gw_post(url, payload, key=None, timeout=60.0):
+    headers = {"Content-Type": "application/json"}
+    if key:
+        headers["Authorization"] = f"Bearer {key}"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(errors="replace")
+
+
+@_fast("gw.auth")
+def _drive_gw_auth(tmp_path, monkeypatch):
+    """The gateway's key-lookup path dies mid-auth: the contract is
+    fail-CLOSED — a clean 401 refusal, never a routed request and never
+    a 500 — and the same valid key is served normally once healed."""
+    stub, svc, url = _gw_harness(tmp_path)
+    try:
+        faults.arm("gw.auth", action="raise", at_hit=1, times=1)
+        body = {"prompt": "hi", "max_tokens": 4, "stream": False}
+        status, text = _gw_post(
+            f"{url}/v1/completions", body, key="sk-acme"
+        )
+        assert status == 401, (status, text)
+        _fired("gw.auth")
+        assert svc.counters["auth_failures_total"] == 1
+        # Fail-closed is not fail-broken: the retry is served.
+        status, text = _gw_post(
+            f"{url}/v1/completions", body, key="sk-acme"
+        )
+        assert status == 200, (status, text)
+        assert json.loads(text)["usage"]["completion_tokens"] >= 1
+    finally:
+        svc.stop()
+        stub.stop()
+
+
+@_fast("gw.shed")
+def _drive_gw_shed(tmp_path, monkeypatch):
+    """The admission path crashes INSIDE the shed decision (after auth,
+    before the bucket charge): the request fails loudly but must not
+    leak a bucket charge, a ledger row, or a stream slot — the retry is
+    admitted and billed exactly once."""
+    stub, svc, url = _gw_harness(tmp_path)
+    try:
+        t = svc.tenants["acme"]
+        level0 = t.level  # full burst: any leak would show as a drop
+        faults.arm("gw.shed", action="raise", at_hit=1, times=1)
+        body = {"prompt": "hi", "max_tokens": 4, "stream": False}
+        status, text = _gw_post(
+            f"{url}/v1/completions", body, key="sk-acme"
+        )
+        assert status == 500, (status, text)
+        _fired("gw.shed")
+        assert t.level == level0, "bucket charge leaked by the crash"
+        assert t.active_streams == 0, "stream slot leaked by the crash"
+        assert svc.ledger.snapshot() == {}, "phantom ledger row"
+        status, text = _gw_post(
+            f"{url}/v1/completions", body, key="sk-acme"
+        )
+        assert status == 200, (status, text)
+        snap = svc.ledger.snapshot()
+        assert snap["acme"]["requests"] == 1, snap
+        assert snap["acme"]["sheds"] == 0, snap
+    finally:
+        svc.stop()
+        stub.stop()
+
+
 @pytest.mark.parametrize("point", sorted(FAST))
 def test_campaign_fast(point, tmp_path, monkeypatch):
     FAST[point](tmp_path, monkeypatch)

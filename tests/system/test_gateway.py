@@ -1,0 +1,524 @@
+"""Tenant gateway (system/gateway.py) unit + edge tests: tenant spec
+parsing, token buckets, exact weighted-DRR arbitration, the exactly-
+once usage ledger, and the HTTP front door's refusal paths — 401,
+over-quota 429 with the tenant's OWN Retry-After, SSE mid-stream
+upstream death absorbed by failover WITHOUT double-billing, and usage
+WAL replay across a gateway restart."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from areal_tpu.base import latency, name_resolve, network
+from areal_tpu.system.gateway import (
+    GatewayService,
+    Tenant,
+    UsageLedger,
+    _StubUpstream,
+    parse_tenant_spec,
+)
+
+pytestmark = pytest.mark.serial
+
+
+# ======================================================================
+# Tenant spec + token bucket
+# ======================================================================
+
+
+def test_parse_tenant_spec():
+    t = parse_tenant_spec(
+        "acme:sk-a:2:100:200:4,beta:sk-b:1:50:50:1")
+    assert set(t) == {"acme", "beta"}
+    a = t["acme"]
+    assert (a.api_key, a.weight, a.tokens_per_s, a.burst,
+            a.max_streams) == ("sk-a", 2.0, 100.0, 200.0, 4)
+    assert parse_tenant_spec(None) == {}
+    assert parse_tenant_spec("") == {}
+
+
+@pytest.mark.parametrize("spec", [
+    "acme:sk-a:2:100:200",              # wrong arity
+    ":sk-a:1:1:1:1",                    # empty name
+    "acme::1:1:1:1",                    # empty key
+    "trainer:sk-t:1:1:1:1",             # reserved name
+    "a:k:1:1:1:1,a:k2:1:1:1:1",         # duplicate
+    "a:k:1:0:1:1",                      # non-positive rate
+    "a:k:1:1:1:0",                      # max_streams < 1
+])
+def test_parse_tenant_spec_rejects(spec):
+    with pytest.raises(ValueError):
+        parse_tenant_spec(spec)
+
+
+def test_tenant_bucket_charges_and_refills():
+    t = Tenant("a", "k", weight=1.0, tokens_per_s=10.0, burst=100.0,
+               max_streams=2)
+    now = 1000.0
+    assert t.try_charge(60.0, now) is None      # burst covers it
+    assert t.try_charge(60.0, now) is not None  # only 40 left
+    # The wait quote comes from THIS bucket's own rate: need 20 more
+    # tokens at 10/s -> 2s.
+    assert t.time_to_afford(60.0, now) == pytest.approx(2.0)
+    # After 2 simulated seconds the same charge is affordable.
+    assert t.try_charge(60.0, now + 2.0) is None
+    # Refill never exceeds burst.
+    t2 = Tenant("b", "k2", 1.0, 10.0, 50.0, 1)
+    t2.try_charge(0.0, now)
+    assert t2.level <= 50.0
+    t2._refill(now + 1e6)
+    assert t2.level == 50.0
+
+
+# ======================================================================
+# Weighted DRR arbitration (white-box, no sockets)
+# ======================================================================
+
+
+def _svc(tenant_spec, tmp_path, fair_share=True,
+         manager_addr="http://127.0.0.1:1", **kw):
+    return GatewayService(
+        "gwtest", "t0",
+        manager_addr=manager_addr,
+        tenant_spec=tenant_spec,
+        usage_wal_path=str(tmp_path / "usage.jsonl"),
+        fair_share=fair_share,
+        **kw,
+    )
+
+
+def test_drr_weighted_shares(tmp_path):
+    svc = _svc("heavy:kh:4:1000:1000:64,light:kl:1:1000:1000:64",
+               tmp_path)
+    try:
+        async def drive():
+            from areal_tpu.system.gateway import _QueueItem
+
+            loop = asyncio.get_event_loop()
+            svc._queue_event = asyncio.Event()
+            svc.max_inflight = 1000
+            for _ in range(10):
+                svc._enqueue(_QueueItem(
+                    "heavy", 64.0, loop.create_future()))
+                svc._enqueue(_QueueItem(
+                    "light", 64.0, loop.create_future()))
+            order = []
+            for _ in range(10):
+                assert svc._dispatch_one()
+                # The last-served tenant rotated to the back of _rr.
+                order.append(svc._rr[-1])
+            return order
+
+        order = asyncio.run(drive())
+        heavy = order.count("heavy")
+        # Weight 4 vs 1: the heavy tenant dominates but the light one
+        # is never starved.
+        assert heavy >= 6, order
+        assert 10 - heavy >= 2, order
+        assert svc.counters["fairshare_picks_total"] > 0
+    finally:
+        svc.ledger.close()
+
+
+def test_fifo_when_fair_share_off(tmp_path):
+    svc = _svc("a:ka:1:1000:1000:64,b:kb:4:1000:1000:64", tmp_path,
+               fair_share=False)
+    try:
+        async def drive():
+            from areal_tpu.system.gateway import _QueueItem
+
+            loop = asyncio.get_event_loop()
+            svc._queue_event = asyncio.Event()
+            svc.max_inflight = 1000
+            items = [
+                _QueueItem(n, 64.0, loop.create_future())
+                for n in ("a", "b", "a", "b")
+            ]
+            for it in items:
+                svc._enqueue(it)
+            served = []
+            while svc._dispatch_one():
+                pass
+            for it in items:
+                served.append(it.fut.done())
+            return served
+
+        assert asyncio.run(drive()) == [True] * 4
+        assert svc.counters["fairshare_picks_total"] == 0
+    finally:
+        svc.ledger.close()
+
+
+def test_dispatch_respects_max_inflight(tmp_path):
+    svc = _svc("a:ka:1:1000:1000:64", tmp_path)
+    try:
+        async def drive():
+            from areal_tpu.system.gateway import _QueueItem
+
+            loop = asyncio.get_event_loop()
+            svc._queue_event = asyncio.Event()
+            svc.max_inflight = 2
+            for _ in range(5):
+                svc._enqueue(_QueueItem(
+                    "a", 64.0, loop.create_future()))
+            n = 0
+            while svc._dispatch_one():
+                n += 1
+            assert n == 2
+            assert svc._queue_depth() == 3
+            svc._release_slot()
+            assert svc._dispatch_one()
+
+        asyncio.run(drive())
+    finally:
+        svc.ledger.close()
+
+
+# ======================================================================
+# Usage ledger: exactly-once across duplicates and restarts
+# ======================================================================
+
+
+def test_usage_ledger_exactly_once(tmp_path):
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path)
+    itl = [0] * latency.N_BUCKETS
+    itl[3] = 4
+    led.record_usage("r1", "acme", 10, 5, ttft_ms=12.0, itl_counts=itl)
+    led.record_usage("r2", "acme", 2, 3, ttft_ms=50.0,
+                     itl_counts=[0] * latency.N_BUCKETS)
+    led.record_shed("r3", "acme")
+    # A duplicate rid (retried journal write) must not double-bill.
+    led.record_usage("r1", "acme", 10, 5, ttft_ms=12.0, itl_counts=itl)
+    snap = led.snapshot()["acme"]
+    assert snap["requests"] == 2 and snap["sheds"] == 1
+    assert snap["prompt_tokens"] == 12
+    assert snap["completion_tokens"] == 8
+    assert led.dup_dropped == 1
+    led.close()
+
+    # Restart: the WAL replay reconstructs identical totals, once.
+    led2 = UsageLedger(path)
+    assert led2.replayed == 3
+    assert led2.dup_dropped == 0
+    assert led2.snapshot()["acme"] == snap
+    led2.close()
+
+
+def test_usage_ledger_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path)
+    led.record_usage("r1", "a", 1, 1, ttft_ms=None,
+                     itl_counts=[0] * latency.N_BUCKETS)
+    led.close()
+    with open(path, "ab") as f:
+        f.write(b'{"rid": "r2", "tenant": "a"')  # crash mid-append
+    led2 = UsageLedger(path)
+    assert led2.snapshot()["a"]["requests"] == 1
+    led2.close()
+
+
+# ======================================================================
+# HTTP front door edges (real GatewayService + stub upstream)
+# ======================================================================
+
+
+@pytest.fixture()
+def memory_nr():
+    name_resolve.reconfigure("memory")
+    yield
+
+
+def _post(url, payload, key=None, headers=None, timeout=60.0):
+    hdrs = {"Content-Type": "application/json"}
+    if key:
+        hdrs["Authorization"] = f"Bearer {key}"
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=hdrs)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode(errors="replace")
+
+
+def test_front_door_401_and_metrics(tmp_path, memory_nr):
+    stub = _StubUpstream()
+    stub.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:4", tmp_path,
+               manager_addr=stub.address)
+    url = svc.start()
+    try:
+        body = {"prompt": "hi", "max_tokens": 2, "stream": False}
+        status, _, text = _post(f"{url}/v1/completions", body)
+        assert status == 401, text
+        assert json.loads(text)["error"]["type"] == (
+            "authentication_error")
+        status, _, text = _post(f"{url}/v1/completions", body,
+                                key="sk-wrong")
+        assert status == 401, text
+        assert svc.counters["auth_failures_total"] == 2
+        # An unauthenticated request never reaches the ledger.
+        assert svc.ledger.snapshot() == {}
+    finally:
+        svc.stop()
+        stub.stop()
+
+
+def test_429_retry_after_from_own_bucket(tmp_path, memory_nr):
+    stub = _StubUpstream()
+    stub.start()
+    # small: 10 tok/s, burst 40. Cost of ("hi" + 30 max_tokens) = 32.
+    svc = _svc(
+        "small:sk-small:1:10:40:4,big:sk-big:1:100000:200000:4",
+        tmp_path, manager_addr=stub.address)
+    url = svc.start()
+    try:
+        body = {"prompt": "hi", "max_tokens": 30, "stream": False}
+        status, _, text = _post(f"{url}/v1/completions", body,
+                                key="sk-small")
+        assert status == 200, text
+        # Second request: 8 tokens left, needs 32 -> ~2.4s at 10/s.
+        status, hdrs, text = _post(f"{url}/v1/completions", body,
+                                   key="sk-small")
+        assert status == 429, text
+        ra = float(hdrs["Retry-After"])
+        assert 1.0 < ra < 5.0, ra  # quoted from the SMALL bucket's rate
+        assert json.loads(text)["error"]["retry_after"] == (
+            pytest.approx(ra, abs=1e-3))
+        # The other tenant's bucket is untouched: still admitted.
+        status, _, text = _post(f"{url}/v1/completions", body,
+                                key="sk-big")
+        assert status == 200, text
+        snap = svc.ledger.snapshot()
+        assert snap["small"]["sheds"] == 1
+        assert snap["small"]["requests"] == 1
+        assert snap["big"]["sheds"] == 0
+        assert svc.counters["shed_total"] == 1
+    finally:
+        svc.stop()
+        stub.stop()
+
+
+def test_429_stream_cap_floor(tmp_path, memory_nr):
+    """At the concurrent-stream cap with an otherwise-full bucket, the
+    Retry-After quote is the configured floor, never 0."""
+    stub = _StubUpstream()
+    stub.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:2", tmp_path,
+               manager_addr=stub.address)
+    url = svc.start()
+    try:
+        svc.tenants["acme"].active_streams = 2  # cap reached
+        body = {"prompt": "hi", "max_tokens": 2, "stream": False}
+        status, hdrs, text = _post(f"{url}/v1/completions", body,
+                                   key="sk-acme")
+        assert status == 429, text
+        assert float(hdrs["Retry-After"]) == pytest.approx(
+            svc.retry_after_floor)
+    finally:
+        svc.tenants["acme"].active_streams = 0
+        svc.stop()
+        stub.stop()
+
+
+class _FlakyFleet:
+    """A stub manager + two stub gservers where server A serves one
+    chunk then dies: the shape of a mid-stream upstream death. The
+    manager honors failed_server_url by rerouting to B."""
+
+    def __init__(self):
+        from aiohttp import web
+
+        self._web = web
+        self._ready = threading.Event()
+        self.sched_metas = []
+        self.a_calls = 0
+        self.manager_addr = None
+        self.a_addr = None
+        self.b_addr = None
+
+    async def _h_sched(self, request):
+        meta = await request.json()
+        self.sched_metas.append(meta)
+        url = (self.b_addr if meta.get("failed_server_url")
+               == self.a_addr else self.a_addr)
+        return self._web.json_response({"url": url, "version": 0})
+
+    async def _h_gen_a(self, request):
+        await request.json()
+        self.a_calls += 1
+        if self.a_calls > 1:
+            return self._web.json_response(
+                {"error": "server died"}, status=500)
+        return self._web.json_response({
+            "output_ids": [65, 65, 65, 65], "no_eos": True,
+            "version_start": 0, "version_end": 0,
+        })
+
+    async def _h_gen_b(self, request):
+        await request.json()
+        return self._web.json_response({
+            "output_ids": [66, 66, 66, 66], "no_eos": True,
+            "version_start": 0, "version_end": 0,
+        })
+
+    def _run(self):
+        web = self._web
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        host = network.gethostip()
+        addrs = []
+        for handler in (self._h_sched, self._h_gen_a, self._h_gen_b):
+            app = web.Application()
+            app.router.add_post("/schedule_request", self._h_sched)
+            app.router.add_post("/generate", handler)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            port = network.find_free_port()
+            loop.run_until_complete(
+                web.TCPSite(runner, host, port).start())
+            addrs.append(f"http://{host}:{port}")
+        self.manager_addr, self.a_addr, self.b_addr = addrs
+        self._ready.set()
+        loop.run_forever()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+
+    def stop(self):
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+def test_midstream_failover_no_double_billing(
+        tmp_path, memory_nr, monkeypatch):
+    """Server A dies after emitting the first SSE chunk: the gateway
+    fails over through the manager (failed_server_url), the client sees
+    every token exactly once, and the ledger bills exactly the emitted
+    tokens — the exactly-once contract under mid-stream death."""
+    monkeypatch.setenv("AREAL_GW_CHUNK_TOKENS", "4")
+    fleet = _FlakyFleet()
+    fleet.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:4", tmp_path,
+               manager_addr=fleet.manager_addr)
+    url = svc.start()
+    try:
+        body = {"prompt": "hi", "max_tokens": 8, "stream": True}
+        status, _, text = _post(f"{url}/v1/completions", body,
+                                key="sk-acme", timeout=120.0)
+        assert status == 200, text
+        assert text.rstrip().endswith("data: [DONE]")
+        pieces = []
+        for line in text.splitlines():
+            if not line.startswith("data: ") or "[DONE]" in line:
+                continue
+            ev = json.loads(line[len("data: "):])
+            pieces.append(ev["choices"][0]["text"])
+        # Every token exactly once, in order: A's chunk then B's.
+        assert "".join(pieces) == "AAAABBBB", pieces
+        assert fleet.a_calls == 2  # served once, died once
+        assert any(m.get("failed_server_url") == fleet.a_addr
+                   for m in fleet.sched_metas)
+        assert svc.counters["upstream_failovers_total"] == 1
+        snap = svc.ledger.snapshot()["acme"]
+        assert snap["requests"] == 1
+        assert snap["completion_tokens"] == 8  # billed-as-emitted
+    finally:
+        svc.stop()
+        fleet.stop()
+
+
+def test_expired_inbound_deadline_rejected(tmp_path, memory_nr):
+    from areal_tpu.base import rpc
+
+    stub = _StubUpstream()
+    stub.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:4", tmp_path,
+               manager_addr=stub.address)
+    url = svc.start()
+    try:
+        dead = rpc.Deadline.after(0.0)
+        time.sleep(0.01)
+        body = {"prompt": "hi", "max_tokens": 2, "stream": False}
+        status, hdrs, text = _post(
+            f"{url}/v1/completions", body, key="sk-acme",
+            headers=dead.headers())
+        assert status == 429, text
+        assert hdrs["Retry-After"] == "0"
+        assert svc.ledger.snapshot() == {}  # nothing billed
+    finally:
+        svc.stop()
+        stub.stop()
+
+
+def test_gateway_restart_replays_usage(tmp_path, memory_nr):
+    """Usage survives a gateway restart exactly once: the second
+    service instance replays the WAL into identical totals."""
+    stub = _StubUpstream()
+    stub.start()
+    wal = tmp_path / "usage.jsonl"
+    svc = GatewayService(
+        "gwtest", "t0", manager_addr=stub.address,
+        tenant_spec="acme:sk-acme:1:100000:200000:4",
+        usage_wal_path=str(wal))
+    url = svc.start()
+    try:
+        body = {"prompt": "hi", "max_tokens": 4, "stream": False}
+        for _ in range(2):
+            status, _, text = _post(f"{url}/v1/completions", body,
+                                    key="sk-acme")
+            assert status == 200, text
+        before = svc.ledger.snapshot()["acme"]
+    finally:
+        svc.stop()
+
+    svc2 = GatewayService(
+        "gwtest", "t0", gateway_id=1, manager_addr=stub.address,
+        tenant_spec="acme:sk-acme:1:100000:200000:4",
+        usage_wal_path=str(wal))
+    try:
+        assert svc2.ledger.replayed == 2
+        assert svc2.ledger.dup_dropped == 0
+        after = svc2.ledger.snapshot()["acme"]
+        assert after == before
+        assert after["requests"] == 2
+    finally:
+        svc2.ledger.close()
+        stub.stop()
+
+
+def test_trainer_schedule_proxy(tmp_path, memory_nr):
+    """POST /schedule_request on the gateway forwards to the manager
+    tagged with the reserved trainer tenant (never shed, never
+    queued)."""
+    fleet = _FlakyFleet()  # its manager stub logs metas
+    fleet.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:4", tmp_path,
+               manager_addr=fleet.manager_addr)
+    url = svc.start()
+    try:
+        status, _, text = _post(
+            f"{url}/schedule_request",
+            {"qid": "train/0", "prompt_len": 4, "new_token_budget": 8})
+        assert status == 200, text
+        assert json.loads(text)["url"]
+        assert fleet.sched_metas[-1]["tenant"] == "trainer"
+        assert svc._trainer_sched == 1
+        # /v1/usage surfaces the trainer row alongside tenant rows.
+        with urllib.request.urlopen(f"{url}/v1/usage",
+                                    timeout=30.0) as r:
+            usage = json.loads(r.read())
+        assert usage["tenants"]["trainer"]["sched_requests"] == 1
+    finally:
+        svc.stop()
+        fleet.stop()

@@ -1,0 +1,420 @@
+"""Tenant-gateway e2e (ISSUE 19 acceptance).
+
+`test_async_ppo_through_gateway`: the full async-PPO stack with
+AREAL_GW_TRAINER_VIA_GATEWAY armed — every trainer scheduling hop rides
+the gateway's /schedule_request proxy as the reserved never-shed
+``trainer`` tenant, and the run still trains 2 steps with zero sheds
+(the regression pin for internal traffic being rate-limited behind
+external tenants).
+
+`test_gateway_acceptance_multi_tenant`: 2 real GenerationServer
+processes + real manager + a gateway SUBPROCESS and 3 tenant roles —
+an aggressor flooding at 3x its stream cap (shed with Retry-After from
+its OWN bucket), an interactive victim whose p99 TTFT must hold near
+its solo baseline while the flood runs, and trainer-proxy traffic with
+zero failures — then the gateway is SIGKILLed mid-life and restarted
+on the same usage WAL: the replayed ledger must match the pre-kill
+rows AND the client-side token tally exactly (exactly-once billing
+across restarts)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import numpy as np
+import pytest
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+)
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    GenerationServerConfig,
+    GserverManagerConfig,
+    RolloutWorkerConfig,
+)
+from areal_tpu.base import name_resolve, names
+from areal_tpu.system.controller import LocalController
+from areal_tpu.system.gateway import GatewayService
+from tests import fixtures
+from tests.system.test_async_e2e import _deflaked_env, _trainer_parts
+from tests.system.test_e2e_experiments import _mk_tokenizer_files
+
+pytestmark = pytest.mark.serial
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _gw_req(url, path, payload=None, key=None, timeout=120.0,
+            headers=None):
+    """(status, headers, parsed-json) against a gateway; 4xx/5xx are
+    returned, not raised."""
+    h = {"Content-Type": "application/json"}
+    if key:
+        h["Authorization"] = f"Bearer {key}"
+    h.update(headers or {})
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url + path, data, h)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            parsed = json.loads(body)
+        except Exception:
+            parsed = {"raw": body.decode(errors="replace")}
+        return e.code, dict(e.headers), parsed
+
+
+@pytest.mark.slow
+def test_async_ppo_through_gateway(tmp_path, monkeypatch):
+    """Satellite regression: a live PPO rollout stream scheduled
+    THROUGH the gateway — tagged as the reserved trainer tenant, never
+    queued, never shed."""
+    exp, trial = f"e2e-gwppo-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    mc_rows = [
+        r for r in fixtures.make_math_code_rows(12, seed=21)
+        if r["task"] == "math"
+    ]
+    data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
+    nr_root = str(tmp_path / "name_resolve")
+
+    worker_env = _deflaked_env(tmp_path, monkeypatch)
+    worker_env["AREAL_GW_TRAINER_VIA_GATEWAY"] = "1"
+
+    # The gateway rides the run's name_resolve plane; it can only start
+    # once the manager registered, so a sidecar thread waits for it the
+    # same way rollout workers do.
+    name_resolve.reconfigure("nfs", record_root=nr_root)
+    holder = {}
+
+    def _start_gateway():
+        addr = name_resolve.wait(
+            names.gen_server_manager(exp, trial), timeout=300
+        )
+        svc = GatewayService(
+            exp, trial, manager_addr=addr,
+            tenant_spec="acme:sk-acme:1:100000:200000:8",
+            usage_wal_path=str(tmp_path / "gw_usage.jsonl"),
+        )
+        holder["svc"] = svc
+        svc.start()
+
+    gw_thread = threading.Thread(target=_start_gateway, daemon=True)
+    gw_thread.start()
+
+    model_args, mw, master = _trainer_parts(exp, trial, tok_dir)
+    gen_server = GenerationServerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        server_index=0,
+        model=ModelAbstraction("tpu_transformer", args=model_args),
+        tokenizer_path=tok_dir,
+        max_concurrent_requests=4,
+        max_seq_len=256,
+        decode_block_steps=4,
+    )
+    gserver_mgr = GserverManagerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        model_name="actor",
+        n_servers=1,
+        train_batch_size=2,
+        max_head_offpolicyness=100,
+    )
+    rollout = RolloutWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        n_rollout_workers=1,
+        n_pullers=1,
+        agent=AgentAbstraction(
+            "math-single-step",
+            args=dict(gconfig=dict(n=2, max_new_tokens=8)),
+        ),
+        env=EnvServiceAbstraction("math-code-single-step"),
+        datasets=[
+            DatasetAbstraction(
+                "math_code_prompt", args=dict(dataset_path=data_path)
+            )
+        ],
+        tokenizer_path=tok_dir,
+        max_concurrent_rollouts=4,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        master=master,
+        model_workers=[mw],
+        rollout_workers=[rollout],
+        gserver_manager=gserver_mgr,
+        generation_servers=[gen_server],
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={"backend": "nfs", "record_root": nr_root},
+        worker_env=worker_env,
+    )
+    try:
+        result = ctl.run()
+        assert result["global_step"] == 2
+
+        svc = holder["svc"]
+        # Every trainer scheduling hop rode the proxy...
+        assert svc._trainer_sched > 0
+        # ...and internal traffic was NEVER queued or shed behind
+        # external tenants.
+        assert svc.counters["shed_total"] == 0
+        st, _, usage = _gw_req(svc.address, "/v1/usage")
+        assert st == 200
+        trow = usage["tenants"]["trainer"]
+        assert trow["sched_requests"] == svc._trainer_sched
+        assert trow["sheds"] == 0
+    finally:
+        svc = holder.get("svc")
+        if svc is not None:
+            svc.stop()
+        from areal_tpu.base import tracing
+
+        tracing.reconfigure()
+
+
+# ----------------------------------------------------------------------
+# Multi-tenant acceptance over a real-process fleet
+# ----------------------------------------------------------------------
+
+PLEN = 32
+MAX_NEW = 6
+
+
+def _spawn_gateway(fleet, tenants, wal, log_path, extra_env=None):
+    env = dict(fleet._env)
+    env.update(extra_env or {})
+    log_f = open(log_path, "a")
+    p = subprocess.Popen(
+        [
+            sys.executable, "-m", "areal_tpu.system.gateway",
+            "--experiment", fleet.exp, "--trial", fleet.trial,
+            "--manager-addr", fleet.manager_addr(),
+            "--tenants", tenants,
+            "--usage-wal", wal,
+            "--name-resolve-root", fleet._nr,
+        ],
+        env=env, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+    )
+    p._log_f = log_f  # closed by the caller's finally
+    return p
+
+
+def _wait_gateway(fleet, proc, not_url=None, timeout_s=60.0):
+    """Poll name_resolve until the gateway registered a LIVE url
+    (different from `not_url` across restarts)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"gateway died at startup (rc={proc.returncode})"
+            )
+        try:
+            url = name_resolve.get(
+                names.gateway_url(fleet.exp, fleet.trial)
+            )
+            if url and url != not_url:
+                st, _, _ = _gw_req(url, "/health", timeout=5.0)
+                if st == 200:
+                    return url
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError("gateway never registered a live url")
+
+
+class _Tally:
+    """Client-side ground truth: what each tenant actually received."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rows = {}
+
+    def add(self, tenant, status, body):
+        with self.lock:
+            r = self.rows.setdefault(tenant, {
+                "requests": 0, "sheds": 0,
+                "prompt_tokens": 0, "completion_tokens": 0,
+            })
+            if status == 200:
+                r["requests"] += 1
+                u = body["usage"]
+                r["prompt_tokens"] += u["prompt_tokens"]
+                r["completion_tokens"] += u["completion_tokens"]
+            elif status == 429:
+                r["sheds"] += 1
+            else:
+                raise AssertionError(f"{tenant}: unexpected {status}: {body}")
+
+
+@pytest.mark.slow
+def test_gateway_acceptance_multi_tenant(tmp_path):
+    from areal_tpu.bench.fleet import ProcessFleet
+    from areal_tpu.bench.workloads import _FLEET_SRV, _OPENLOOP_MODEL
+
+    rng = np.random.RandomState(7)
+    tenants = (
+        "agg:sk-agg:1:100000:200000:4,"
+        "solo:sk-solo:4:100000:200000:8,"
+        "victim:sk-vic:4:100000:200000:8"
+    )
+    wal = str(tmp_path / "gw_usage.jsonl")
+    gw_log = str(tmp_path / "gateway.log")
+    tally = _Tally()
+    gw_procs = []
+    fleet_dir = tmp_path / "fleet"
+    fleet_dir.mkdir()
+    with ProcessFleet(
+        _OPENLOOP_MODEL, [dict(_FLEET_SRV)] * 2, tag="gwacc",
+        tmp_dir=str(fleet_dir),
+    ) as fleet:
+        try:
+            gw_procs.append(_spawn_gateway(
+                fleet, tenants, wal, gw_log,
+                # A tight dispatch window so contention shows up AT THE
+                # GATEWAY (where fair-share arbitrates), not just on
+                # the servers.
+                extra_env={"AREAL_GW_MAX_INFLIGHT": "4"},
+            ))
+            url = _wait_gateway(fleet, gw_procs[0])
+
+            def completion(tenant_key, tenant, i):
+                st, hdrs, body = _gw_req(url, "/v1/completions", {
+                    "prompt": rng.randint(1, 200, size=PLEN).tolist(),
+                    "max_tokens": MAX_NEW, "stream": False,
+                }, key=tenant_key, timeout=180.0)
+                tally.add(tenant, st, body)
+                return st, hdrs, body
+
+            # ---- Solo baseline: the interactive class alone on an
+            # idle fleet.
+            for i in range(6):
+                st, _, body = completion("sk-solo", "solo", i)
+                assert st == 200, body
+                assert len(body["choices"][0]["token_ids"]) == MAX_NEW
+            _, _, usage = _gw_req(url, "/v1/usage")
+            solo_p99 = usage["tenants"]["solo"]["ttft_p99_ms"]
+            assert solo_p99 > 0.0
+
+            # ---- Contention: the aggressor floods at 3x its stream
+            # cap while the victim keeps its interactive cadence.
+            agg_done = []
+
+            def agg_fire(i):
+                st, hdrs, body = completion("sk-agg", "agg", i)
+                if st == 429:
+                    # The Retry-After is the AGGRESSOR's own bucket's
+                    # advice, never the fleet's.
+                    ra = float(hdrs["Retry-After"])
+                    assert ra >= 0.05
+                    assert body["error"]["retry_after"] == pytest.approx(
+                        ra, abs=1e-3)
+                agg_done.append(st)
+
+            threads = [
+                threading.Thread(target=agg_fire, args=(i,), daemon=True)
+                for i in range(12)
+            ]
+            for th in threads:
+                th.start()
+            for i in range(6):
+                st, _, body = completion("sk-vic", "victim", i)
+                assert st == 200, body
+            for th in threads:
+                th.join(timeout=300)
+            assert len(agg_done) == 12
+
+            _, _, usage = _gw_req(url, "/v1/usage")
+            rows = usage["tenants"]
+            # The aggressor was shed (3x its cap of 4 concurrent
+            # streams) and NOBODY else was.
+            assert rows["agg"]["sheds"] >= 1, rows
+            assert rows["victim"]["sheds"] == 0
+            assert rows["solo"]["sheds"] == 0
+            # Fairness held: the victim's p99 TTFT (admission clock,
+            # queue wait included) stayed within 2x its solo baseline
+            # plus bounded CPU-box scheduling noise.
+            vic_p99 = rows["victim"]["ttft_p99_ms"]
+            assert vic_p99 <= 2.0 * solo_p99 + 1500.0, (
+                f"victim p99 {vic_p99}ms vs solo {solo_p99}ms"
+            )
+
+            # ---- Trainer stream through the proxy: zero failures.
+            for i in range(6):
+                st, _, sched = _gw_req(url, "/schedule_request", {
+                    "qid": f"train{i}", "prompt_len": PLEN,
+                    "new_token_budget": MAX_NEW,
+                }, timeout=60.0)
+                assert st == 200 and "url" in sched, sched
+                st2, _, out = _gw_req(sched["url"], "/generate", {
+                    "qid": f"train{i}",
+                    "input_ids": rng.randint(1, 200, size=PLEN).tolist(),
+                    "gconfig": {"max_new_tokens": MAX_NEW,
+                                "greedy": True},
+                }, timeout=180.0)
+                assert st2 == 200 and len(out["output_ids"]) == MAX_NEW
+            _, _, usage = _gw_req(url, "/v1/usage")
+            assert usage["tenants"]["trainer"]["sched_requests"] == 6
+            assert usage["tenants"]["trainer"]["sheds"] == 0
+
+            # ---- Exactly-once billing across a SIGKILL + restart.
+            pre = {
+                n: {k: r[k] for k in ("requests", "sheds",
+                                      "prompt_tokens",
+                                      "completion_tokens")}
+                for n, r in usage["tenants"].items() if n != "trainer"
+            }
+            # The ledger already matches the client-side ground truth
+            # token for token...
+            assert pre == tally.rows
+            gw_procs[0].kill()
+            gw_procs[0].wait(timeout=15)
+            gw_procs.append(_spawn_gateway(
+                fleet, tenants, wal, gw_log,
+                extra_env={"AREAL_GW_MAX_INFLIGHT": "4"},
+            ))
+            url2 = _wait_gateway(fleet, gw_procs[1], not_url=url)
+            _, _, usage2 = _gw_req(url2, "/v1/usage")
+            # ...and the WAL replay reconstructs EXACTLY those rows:
+            # nothing lost, nothing double-billed.
+            assert usage2["usage_replayed"] > 0
+            post = {
+                n: {k: r[k] for k in ("requests", "sheds",
+                                      "prompt_tokens",
+                                      "completion_tokens")}
+                for n, r in usage2["tenants"].items() if n != "trainer"
+            }
+            assert post == pre
+            # The restarted gateway still serves.
+            st, _, body = _gw_req(url2, "/v1/completions", {
+                "prompt": rng.randint(1, 200, size=PLEN).tolist(),
+                "max_tokens": MAX_NEW, "stream": False,
+            }, key="sk-solo", timeout=180.0)
+            assert st == 200, body
+        finally:
+            for p in gw_procs:
+                if p.poll() is None:
+                    p.kill()
+                try:
+                    p._log_f.close()
+                except Exception:
+                    pass

@@ -1,0 +1,163 @@
+"""Mixed-stream RUN e2e (ISSUE 19 satellite): a math stream AND an
+agentic tool-use stream feed ONE buffer through the same trainer, with
+per-task staleness windows gating admission independently (math tight,
+agentic loose) and per-task attribution surfaced as master scalars —
+zero failed episodes on either stream."""
+
+import uuid
+
+import pytest
+
+from areal_tpu.api.config import (
+    AgentAbstraction,
+    DatasetAbstraction,
+    EnvServiceAbstraction,
+    ModelAbstraction,
+)
+from areal_tpu.api.system_api import (
+    ExperimentConfig,
+    GenerationServerConfig,
+    GserverManagerConfig,
+    RolloutWorkerConfig,
+)
+from areal_tpu.base import name_resolve
+from areal_tpu.system.controller import LocalController
+from tests import fixtures
+from tests.system.test_async_e2e import _deflaked_env, _trainer_parts
+from tests.system.test_e2e_experiments import _mk_tokenizer_files
+from tests.system.test_reward_executor import _spawn_executor
+
+pytestmark = pytest.mark.serial
+
+
+@pytest.mark.slow
+def test_mixed_math_and_agentic_streams_share_one_buffer(
+    tmp_path, monkeypatch
+):
+    exp, trial = f"e2e-mixed-{uuid.uuid4().hex[:6]}", "t0"
+    rows, tok_dir = _mk_tokenizer_files(tmp_path)
+    mc_rows = [
+        r for r in fixtures.make_math_code_rows(16, seed=17)
+        if r["task"] == "math"
+    ]
+    data_path = fixtures.write_jsonl(mc_rows, tmp_path / "mc.jsonl")
+    nr_root = str(tmp_path / "name_resolve")
+
+    worker_env = _deflaked_env(tmp_path, monkeypatch)
+    # The point of the run: per-task windows, admitted/dropped
+    # independently per stream (math tight, agentic loose).
+    worker_env["AREAL_TASK_STALENESS_WINDOWS"] = "math:2,agentic:8"
+
+    # One real reward executor for the tool-use stream's tool calls.
+    name_resolve.reconfigure("nfs", record_root=nr_root)
+    procs = [_spawn_executor(0, exp, trial, nr_root)]
+
+    # n_seqs=4 so every train batch has room for BOTH streams — the
+    # buffer is FIFO and a 2-seq batch can fill from one stream alone.
+    model_args, mw, master = _trainer_parts(exp, trial, tok_dir, n_seqs=4)
+    gen_server = GenerationServerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        server_index=0,
+        model=ModelAbstraction("tpu_transformer", args=model_args),
+        tokenizer_path=tok_dir,
+        max_concurrent_requests=8,
+        max_seq_len=256,
+        decode_block_steps=4,
+        # Tool-turn continuations re-enter on sticky-qid routes.
+        prefix_cache_tokens=2048,
+    )
+    gserver_mgr = GserverManagerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        model_name="actor",
+        n_servers=1,
+        train_batch_size=4,
+        max_head_offpolicyness=100,  # the BUFFER's windows gate, not this
+    )
+    # Worker 0: the fast math stream, throttled (1 in flight, chunked
+    # decode) so it cannot starve the slower agentic stream out of
+    # every FIFO batch.
+    math_worker = RolloutWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=0,
+        n_rollout_workers=2,
+        n_pullers=1,
+        agent=AgentAbstraction(
+            "math-single-step",
+            args=dict(gconfig=dict(n=1, max_new_tokens=8)),
+        ),
+        env=EnvServiceAbstraction("math-code-single-step"),
+        datasets=[
+            DatasetAbstraction(
+                "math_code_prompt", args=dict(dataset_path=data_path)
+            )
+        ],
+        tokenizer_path=tok_dir,
+        max_concurrent_rollouts=1,
+        new_tokens_per_chunk=4,
+    )
+    # Worker 1: the agentic stream — multi-turn tool-use episodes
+    # through the real executor.
+    tool_worker = RolloutWorkerConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        worker_index=1,
+        n_rollout_workers=2,
+        n_pullers=1,
+        agent=AgentAbstraction(
+            "tool-use",
+            args=dict(
+                gconfig=dict(max_new_tokens=8),
+                num_turns=2,
+                scripted_tool_turns=1,
+            ),
+        ),
+        env=EnvServiceAbstraction("tool-use"),
+        datasets=[
+            DatasetAbstraction(
+                "math_code_prompt", args=dict(dataset_path=data_path)
+            )
+        ],
+        tokenizer_path=tok_dir,
+        max_concurrent_rollouts=4,
+    )
+    cfg = ExperimentConfig(
+        experiment_name=exp,
+        trial_name=trial,
+        master=master,
+        model_workers=[mw],
+        rollout_workers=[math_worker, tool_worker],
+        gserver_manager=gserver_mgr,
+        generation_servers=[gen_server],
+    )
+    ctl = LocalController(
+        cfg,
+        name_resolve_cfg={"backend": "nfs", "record_root": nr_root},
+        worker_env=worker_env,
+    )
+    try:
+        result = ctl.run()
+        assert result["global_step"] == 2
+
+        overlap = result["perf_summary"]["overlap"]
+        # BOTH task tags survived rollout -> shared buffer -> train
+        # batch -> master scalars: the streams were genuinely mixed.
+        assert "task_staleness_math" in overlap, overlap
+        assert "task_staleness_agentic" in overlap, overlap
+        # Zero failed episodes on the agentic stream: episode_turns /
+        # episode_tool_calls are stamped ONLY by tool-use episodes, so
+        # the means are exact — every trained agentic episode ran its
+        # full 2 turns and executed its scripted tool call.
+        assert overlap.get("episode_turns") == 2.0, overlap
+        assert overlap.get("episode_tool_calls") == 1.0, overlap
+        # The executor that served the tool calls stayed alive.
+        assert procs[0].poll() is None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        from areal_tpu.base import tracing
+
+        tracing.reconfigure()

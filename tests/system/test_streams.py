@@ -228,3 +228,35 @@ def test_push_pull_json(tmp_name_resolve, experiment_context):
         puller.close()
         for p in pushers:
             p.close()
+
+
+def test_poll_batch_defers_same_id_collisions():
+    """Epoch carryover: two episodes of the same dataset row landing in
+    one drain must not poison the batch (gather refuses duplicate ids) —
+    the collision is held back and served by the NEXT poll."""
+    import queue as _queue
+    from collections import deque
+
+    from areal_tpu.api.data_api import SequenceSample
+    from areal_tpu.system.stream_dataset import PullerStreamDataset
+
+    def _traj(sample_id):
+        return SequenceSample.from_default(
+            ids=[sample_id], seqlens=[3],
+            data={"packed_input_ids": np.arange(3)},
+        )
+
+    ds = object.__new__(PullerStreamDataset)
+    ds._queue = _queue.Queue()
+    ds._replayed = deque()
+    ds._held = deque()
+    ds._queue.put((0, _traj("x")))
+    ds._queue.put((0, _traj("y")))
+    ds._queue.put((0, _traj("x")))  # later-epoch episode of row x
+
+    batch = ds.poll_batch()
+    assert sorted(batch.ids) == ["x", "y"]
+    assert ds.qsize() == 1  # the held-back copy still counts as queued
+    batch2 = ds.poll_batch()
+    assert batch2.ids == ["x"]
+    assert ds.poll_batch() is None

@@ -1,0 +1,113 @@
+"""ISSUE 19 acceptance (bench leg): the `tenant_fairness` phase banks
+an attested CPU-proxy record — a real gateway subprocess in front of a
+real-process fleet, noisy-aggressor flood vs an interactive victim,
+victim p99 TTFT (admission-to-first-token) solo vs fair-share ON vs
+FIFO — and `validate_bench.py` refuses the failure classes that would
+make such a record meaningless: a fair arm that did not beat FIFO, a
+flood that never shed (the arms measured an idle gateway), a DRR queue
+that never arbitrated, a missing solo anchor, and any starved victim
+request.
+
+The teeth run in tier-1 against a synthetic record; the full phase run
+(ProcessFleet + 3 gateway spawns, ~1-2 min) is slow-marked."""
+
+import importlib.util
+import os
+
+import pytest
+
+from areal_tpu.bench import bank, runner
+from tests.fixtures import scale_timeout
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _good_record():
+    """A record shaped like a healthy banked measure pass."""
+    return {
+        "status": "ok",
+        "pass": "measure",
+        "value": {
+            "solo_p99_ttft_ms": 32.0,
+            "fair_p99_ttft_ms": 128.0,
+            "unfair_p99_ttft_ms": 512.0,
+            "fair_over_solo": 4.0,
+            "unfair_over_fair": 4.0,
+            "aggressor_sheds": 445.0,
+            "fairshare_picks": 12.0,
+            "victim_failed": 0.0,
+            "wall_s": 20.0,
+        },
+    }
+
+
+def test_tenant_fairness_teeth():
+    v = _load_validator()
+    assert v.validate_phase_value("tenant_fairness", _good_record()) == []
+
+    # Each mutation is one failure class the validator must refuse.
+    cases = [
+        # Fair arm no better than FIFO: the weighted queue bought nothing.
+        ("fair_p99_ttft_ms", 512.0, "not below the FIFO arm"),
+        # No solo anchor: the flood arms float unmoored.
+        ("solo_p99_ttft_ms", 0.0, "no solo baseline"),
+        # Flood never saturated: both arms measured an idle gateway.
+        ("aggressor_sheds", 0.0, "zero aggressor sheds"),
+        # Queue never arbitrated: fair share was never exercised.
+        ("fairshare_picks", 0.0, "zero DRR picks"),
+        # Fairness by starvation is not fairness.
+        ("victim_failed", 1.0, "failed victim"),
+    ]
+    for key, bad, needle in cases:
+        rec = _good_record()
+        rec["value"][key] = bad
+        problems = v.validate_phase_value("tenant_fairness", rec)
+        assert problems, f"validator swallowed {key}={bad}"
+        assert any(needle in p for p in problems), (key, problems)
+
+    # A missing schema key is refused before the semantic teeth.
+    rec = _good_record()
+    del rec["value"]["unfair_p99_ttft_ms"]
+    assert any(
+        "unfair_p99_ttft_ms" in p
+        for p in v.validate_phase_value("tenant_fairness", rec)
+    )
+
+
+@pytest.mark.serial
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_tenant_fairness_record_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    rec = runner.run_phase(
+        "tenant_fairness", "measure", b, deadline_s=scale_timeout(360)
+    )
+    assert rec["status"] == "ok", rec
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("tenant_fairness", rec) == []
+    assert validator.validate_bank_dir(b) == []
+
+    v = rec["value"]
+    # THE acceptance numbers: weighted fair share held the victim's p99
+    # below the FIFO collapse while the aggressor was shed against its
+    # own stream cap and no victim request failed.
+    assert v["fair_p99_ttft_ms"] < v["unfair_p99_ttft_ms"]
+    assert v["aggressor_sheds"] >= 1
+    assert v["fairshare_picks"] >= 1
+    assert v["victim_failed"] == 0.0
