@@ -285,6 +285,24 @@ _KNOBS: List[Knob] = [
        "Gateway->fleet HTTP session timeout and the default deadline "
        "budget minted for a /v1 request that arrives without "
        "X-Areal-Deadline."),
+    _k("AREAL_GW_INTERNAL_TOKEN", "str", None,
+       "Shared secret gating the gateway's INTERNAL surfaces: the "
+       "/schedule_request trainer proxy and the /v1/usage + /metrics "
+       "operator endpoints (presented as X-Areal-Gateway-Token or a "
+       "Bearer token). Unset = each gateway instance mints a random "
+       "token at startup. Either way the active token is published to "
+       "name_resolve (names.gateway_internal_token) where rollout "
+       "workers — but no external tenant — can read it; a caller "
+       "without it gets 401, so tenant auth/quotas/metering can never "
+       "be bypassed by POSTing the proxy directly."),
+    _k("AREAL_GW_USAGE_COMPACT_EVERY", "int", 4096,
+       "Usage-WAL compaction cadence: after this many journaled "
+       "billing records the gateway folds the journal into one "
+       "aggregated per-tenant row set (RolloutWAL.compact) and ages "
+       "request ids out of the dedup set down to a bounded recent "
+       "window — disk, replay time, and dedup memory stay O(cadence) "
+       "instead of growing with lifetime traffic. 0 disables "
+       "compaction (tests pinning raw-record replay)."),
     _k("AREAL_GW_TRAINER_VIA_GATEWAY", "bool", False,
        "Route rollout workers' partial-rollout SCHEDULING hops "
        "through the gateway's /schedule_request trainer-tenant proxy "

@@ -388,6 +388,12 @@ _METRICS: List[Metric] = [
        "Usage records dropped at replay/append because their request "
        "id was already accounted — the exactly-once ledger doing its "
        "job across restarts."),
+    _m("areal:gw_usage_compactions_total", "counter",
+       "system/gateway.py",
+       "Usage-WAL compactions: every AREAL_GW_USAGE_COMPACT_EVERY "
+       "billing records the journal folds into one aggregated "
+       "per-tenant record, bounding disk, replay time, and the "
+       "request-id dedup set for long-lived gateways."),
     # ====================================================================
     # perf/* — stats_tracker scalar keys (worker -> master MFC stats
     # payloads; master_worker perf history + bench workloads).

@@ -118,12 +118,29 @@ def reward_executor_url_root(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/reward_executor_url/"
 
 
-def gateway_url(experiment_name: str, trial_name: str) -> str:
-    """HTTP endpoint of the multi-tenant inference gateway
+def gateway_url(experiment_name: str, trial_name: str, gateway_id) -> str:
+    """HTTP endpoint of ONE multi-tenant inference gateway instance
     (system/gateway.py). Liveness rides the health registry (member
-    ``gateway/<id>``); this key is the URL record external clients and
-    the trainer-via-gateway rollout path resolve."""
-    return f"{trial_root(experiment_name, trial_name)}/gateway_url"
+    ``gateway/<id>``); keyed per instance so concurrent gateways never
+    clobber (or delete) each other's record — clients discover any live
+    instance via ``gateway_url_root``."""
+    return f"{trial_root(experiment_name, trial_name)}/gateway_url/{gateway_id}"
+
+
+def gateway_url_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/gateway_url/"
+
+
+def gateway_internal_token(
+    experiment_name: str, trial_name: str, gateway_id
+) -> str:
+    """Shared-secret record one gateway instance publishes for the
+    training plane: rollout workers read it off name_resolve (which
+    external tenants cannot reach) and present it on the gateway's
+    /schedule_request trainer proxy and operator surfaces. Keyed per
+    instance alongside ``gateway_url``."""
+    return (f"{trial_root(experiment_name, trial_name)}"
+            f"/gateway_token/{gateway_id}")
 
 
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:

@@ -71,7 +71,11 @@ _ROUTES: List[Route] = [
        "The areal:* text surface (base/metrics_registry.py); polled "
        "by the manager, the fleet controller rebuild, and the bench. "
        "Reward executors serve their areal:rexec_* lines and the "
-       "gateway its areal:gw_* lines on the same contract."),
+       "gateway its areal:gw_* lines on the same contract — but the "
+       "GATEWAY's copy sits on a tenant-facing listener, so it alone "
+       "answers 401 without the internal token (cross-tenant traffic "
+       "counts must not leak to tenants).",
+       statuses=(401,)),
     _r("GET", "/health", (GS, REX, GW),
        "Liveness probe for external supervisors (k8s/LB); in-repo "
        "liveness rides the name_resolve heartbeat registry instead.",
@@ -169,16 +173,21 @@ _ROUTES: List[Route] = [
     _r("GET", "/v1/usage", (GW,),
        "Per-tenant metered usage report (prompt/completion tokens, "
        "TTFT/ITL percentiles, sheds) rebuilt exactly-once from the "
-       "gateway usage WAL; operators reconcile billing against it.",
-       operator=True),
+       "gateway usage WAL; operators reconcile billing against it. "
+       "The internal token sees every row; a tenant API key sees ONLY "
+       "its own row; anyone else gets 401 — usage is per-tenant "
+       "confidential, same rationale as the own-bucket Retry-After.",
+       statuses=(401,), operator=True),
     # -- gserver manager -------------------------------------------------
     _r("POST", "/schedule_request", (MGR, GW),
        "Route one rollout request: returns the target server URL (or "
        "503 + retry_after while no server is routable). The gateway "
        "re-serves this route as a trainer-tenant proxy (weight "
        "infinity, never shed) so internal rollout traffic rides the "
-       "same fairness plane without starving.",
-       statuses=(503,)),
+       "same fairness plane without starving — gated by the internal "
+       "token (401 without it), since the proxy shares the tenant-"
+       "facing listener and would otherwise bypass auth and quotas.",
+       statuses=(401, 503)),
     _r("POST", "/allocate_rollout", (MGR,),
        "Claim a rollout slot against the staleness window."),
     _r("POST", "/finish_rollout", (MGR,),

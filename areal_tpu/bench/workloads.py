@@ -2614,8 +2614,11 @@ _GWF_VICTIM_REQS = 10
 _GWF_MAX_NEW = 6
 
 
-def _gwf_req(url, path, payload=None, key=None, timeout=120.0):
-    """(status, parsed-json) against the gateway; 4xx/5xx returned."""
+def _gwf_req(url, path, payload=None, key=None, timeout=120.0,
+             op_token=None):
+    """(status, parsed-json) against the gateway; 4xx/5xx returned.
+    ``op_token`` is the gateway's internal token (operator surfaces +
+    trainer proxy are gated on it)."""
     import json as _json
     import urllib.error
     import urllib.request
@@ -2623,6 +2626,8 @@ def _gwf_req(url, path, payload=None, key=None, timeout=120.0):
     h = {"Content-Type": "application/json"}
     if key:
         h["Authorization"] = f"Bearer {key}"
+    if op_token:
+        h["X-Areal-Gateway-Token"] = op_token
     data = _json.dumps(payload).encode() if payload is not None else None
     req = urllib.request.Request(url + path, data, h)
     try:
@@ -2638,9 +2643,10 @@ def _gwf_req(url, path, payload=None, key=None, timeout=120.0):
 
 def _gwf_spawn(fleet, wal_path: str, fair: bool, not_url=None):
     """Spawn a gateway subprocess in front of `fleet`; returns
-    (Popen, url) once /health answers. AREAL_GW_MAX_INFLIGHT is pinned
-    low so admitted requests contend in the gateway's queue — the spot
-    where DRR (or FIFO, fair off) decides who goes next."""
+    (Popen, url, internal_token) once /health answers — the token
+    gates the operator surfaces the arms read. AREAL_GW_MAX_INFLIGHT
+    is pinned low so admitted requests contend in the gateway's queue
+    — the spot where DRR (or FIFO, fair off) decides who goes next."""
     import subprocess
 
     from areal_tpu.base import name_resolve, names
@@ -2662,7 +2668,8 @@ def _gwf_spawn(fleet, wal_path: str, fair: bool, not_url=None):
         stderr=subprocess.DEVNULL,
     )
     deadline = time.monotonic() + 60.0
-    key = names.gateway_url(fleet.exp, fleet.trial)
+    key = names.gateway_url(fleet.exp, fleet.trial, 0)
+    token_key = names.gateway_internal_token(fleet.exp, fleet.trial, 0)
     while time.monotonic() < deadline:
         if proc.poll() is not None:
             raise RuntimeError(
@@ -2670,13 +2677,14 @@ def _gwf_spawn(fleet, wal_path: str, fair: bool, not_url=None):
             )
         try:
             url = name_resolve.get(key)
+            token = name_resolve.get(token_key)
         except Exception:
-            url = None
-        if url and url != not_url:
+            url, token = None, None
+        if url and token and url != not_url:
             try:
                 st, _ = _gwf_req(url, "/health", timeout=5.0)
                 if st == 200:
-                    return proc, url
+                    return proc, url, token
             except Exception:
                 pass
         time.sleep(0.2)
@@ -2700,11 +2708,16 @@ def _gwf_completion(url: str, key: str, seed: int):
     )
 
 
-def _gwf_metric(url: str, name: str) -> float:
-    """Read one counter off the gateway's text /metrics endpoint."""
+def _gwf_metric(url: str, name: str, op_token: str) -> float:
+    """Read one counter off the gateway's text /metrics endpoint
+    (internal-token gated)."""
     import urllib.request
 
-    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+    req = urllib.request.Request(
+        url + "/metrics",
+        headers={"X-Areal-Gateway-Token": op_token},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
         text = r.read().decode()
     for line in text.splitlines():
         if line.startswith(name + " "):
@@ -2712,10 +2725,13 @@ def _gwf_metric(url: str, name: str) -> float:
     return 0.0
 
 
-def _gwf_victim_arm(url: str, flood: bool):
+def _gwf_victim_arm(url: str, flood: bool, op_token: str = ""):
     """One measurement arm: optionally saturate the gateway with
     aggressor threads for the WHOLE victim window, issue the victim's
-    sequential completions, return (victim_failed, usage-json)."""
+    sequential completions, return (victim_failed, usage-json). The
+    usage read rides the operator token: it needs EVERY tenant's row
+    (victim latency + aggressor sheds), which a tenant key no longer
+    sees."""
     import threading as _threading
 
     stop = _threading.Event()
@@ -2747,7 +2763,7 @@ def _gwf_victim_arm(url: str, flood: bool):
         stop.set()
         for t in threads:
             t.join(timeout=30)
-    st, usage = _gwf_req(url, "/v1/usage", key="sk-gwf-vic")
+    st, usage = _gwf_req(url, "/v1/usage", op_token=op_token)
     assert st == 200, usage
     return failed, usage
 
@@ -2775,7 +2791,7 @@ def tenant_fairness_phase(pass_: str) -> dict:
         ) as fleet:
             wal = os.path.join(tempfile.mkdtemp(prefix="areal_gwf_"),
                                "usage.jsonl")
-            proc, url = _gwf_spawn(fleet, wal, fair=True)
+            proc, url, _tok = _gwf_spawn(fleet, wal, fair=True)
             try:
                 st, body = _gwf_completion(url, "sk-gwf-vic", 1)
                 assert st == 200, body
@@ -2798,32 +2814,33 @@ def tenant_fairness_phase(pass_: str) -> dict:
         # no one to arbitrate against — this is the latency floor).
         # Warm the serving path on the AGGRESSOR's key first so cold-
         # start cost never lands in the victim's baseline histogram.
-        gw, url = _gwf_spawn(fleet, os.path.join(tmp, "solo.jsonl"),
-                             fair=True)
+        gw, url, tok = _gwf_spawn(fleet, os.path.join(tmp, "solo.jsonl"),
+                                  fair=True)
         for i in range(4):
             st, body = _gwf_completion(url, "sk-gwf-agg", 500 + i)
             assert st == 200, body
-        failed_solo, usage = _gwf_victim_arm(url, flood=False)
+        failed_solo, usage = _gwf_victim_arm(url, flood=False, op_token=tok)
         solo_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
         gw.kill()
         gw.wait(timeout=10)
 
         # ---- Fair ON under flood: victim p99 must stay livable while
         # the aggressor saturates its stream cap and gets shed.
-        gw, url2 = _gwf_spawn(fleet, os.path.join(tmp, "fair.jsonl"),
-                              fair=True, not_url=url)
-        failed_fair, usage = _gwf_victim_arm(url2, flood=True)
+        gw, url2, tok2 = _gwf_spawn(fleet, os.path.join(tmp, "fair.jsonl"),
+                                    fair=True, not_url=url)
+        failed_fair, usage = _gwf_victim_arm(url2, flood=True, op_token=tok2)
         fair_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
         agg_sheds = float(_gwf_row(usage, "agg")["sheds"])
-        picks = _gwf_metric(url2, "areal:gw_fairshare_picks_total")
+        picks = _gwf_metric(url2, "areal:gw_fairshare_picks_total", tok2)
         gw.kill()
         gw.wait(timeout=10)
 
         # ---- Fair OFF (FIFO) under the same flood: documents the
         # collapse weighted fair share prevents.
-        gw, url3 = _gwf_spawn(fleet, os.path.join(tmp, "unfair.jsonl"),
-                              fair=False, not_url=url2)
-        failed_unfair, usage = _gwf_victim_arm(url3, flood=True)
+        gw, url3, tok3 = _gwf_spawn(fleet, os.path.join(tmp, "unfair.jsonl"),
+                                    fair=False, not_url=url2)
+        failed_unfair, usage = _gwf_victim_arm(url3, flood=True,
+                                               op_token=tok3)
         unfair_p99 = float(_gwf_row(usage, "victim")["ttft_p99_ms"])
         gw.kill()
         gw.wait(timeout=10)

@@ -36,7 +36,14 @@ workers opting in via AREAL_GW_TRAINER_VIA_GATEWAY route their
 ``/schedule_request`` hops through this gateway's trainer proxy, which
 tags metas with the reserved ``trainer`` tenant, bypasses buckets and
 queues entirely (weight ∞, never shed) and forwards to the manager
-with the caller's deadline intact.
+with the caller's deadline intact. The proxy — and the ``/v1/usage`` +
+``/metrics`` operator surfaces — share the tenant-facing listener, so
+they are gated by an INTERNAL TOKEN (AREAL_GW_INTERNAL_TOKEN, or a
+random one minted at startup) published only through name_resolve
+(``names.gateway_internal_token``): rollout workers and operators can
+read it, external tenants cannot, and a caller without it gets a 401
+instead of a free ride past auth, quotas, and metering. A tenant API
+key on ``/v1/usage`` sees exactly its own row.
 
 Prompts arrive as text (byte-level codec, exact for the vocab-256
 harness models — api/public.py) or raw token-id lists; production
@@ -48,6 +55,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import collections
+import hmac
 import json
 import math
 import os
@@ -83,6 +91,56 @@ logger = logging.getLogger("gateway")
 # trainer proxy tags scheduling metas with it so manager-side
 # accounting and /status can attribute load, nothing more.
 TRAINER_TENANT = "trainer"
+
+# Header internal callers present on the trainer proxy and operator
+# surfaces; the value is the gateway's internal token.
+INTERNAL_TOKEN_HEADER = "X-Areal-Gateway-Token"
+
+
+def resolve_gateway_once(
+    experiment_name: str, trial_name: str
+) -> Optional[Tuple[str, str]]:
+    """One non-blocking discovery pass over the per-instance gateway
+    records: returns (url, internal_token) of the lowest-id registered
+    instance, or None while no gateway is up. Both records are written
+    by the same instance at start(), so the pair is consistent."""
+    try:
+        keys = name_resolve.find_subtree(
+            names.gateway_url_root(experiment_name, trial_name))
+    except Exception:
+        return None
+    for key in sorted(keys):
+        gid = key.rsplit("/", 1)[-1]
+        try:
+            url = name_resolve.get(
+                names.gateway_url(experiment_name, trial_name, gid))
+            token = name_resolve.get(
+                names.gateway_internal_token(
+                    experiment_name, trial_name, gid))
+        except Exception:
+            continue
+        if url and token:
+            return url, token
+    return None
+
+
+def discover_gateway(
+    experiment_name: str, trial_name: str, timeout: float = 300.0
+) -> Tuple[str, str]:
+    """Block until some gateway instance registers; returns
+    (url, internal_token). The trainer-via-gateway rollout path's
+    counterpart of name_resolve.wait on the manager key."""
+    deadline = time.monotonic() + timeout
+    while True:
+        got = resolve_gateway_once(experiment_name, trial_name)
+        if got is not None:
+            return got
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"no gateway registered for "
+                f"{experiment_name}/{trial_name} within {timeout:.0f}s"
+            )
+        time.sleep(0.5)
 
 
 class Tenant:
@@ -137,9 +195,12 @@ class Tenant:
 def parse_tenant_spec(spec: Optional[str]) -> Dict[str, Tenant]:
     """Parse AREAL_GW_TENANTS: comma list of
     ``name:api_key:weight:tokens_per_s:burst:max_streams`` entries.
-    Raises ValueError on malformed entries, duplicates, non-positive
-    quotas, or an attempt to redeclare the reserved trainer tenant."""
+    Raises ValueError on malformed entries, duplicate names, duplicate
+    API keys (a shared key would silently bill whichever tenant parses
+    last), non-positive quotas, or an attempt to redeclare the
+    reserved trainer tenant."""
     tenants: Dict[str, Tenant] = {}
+    keys_seen: Dict[str, str] = {}
     if not spec:
         return tenants
     for entry in spec.split(","):
@@ -162,6 +223,13 @@ def parse_tenant_spec(spec: Optional[str]) -> Dict[str, Tenant]:
             )
         if name in tenants:
             raise ValueError(f"duplicate tenant {name!r}")
+        if api_key in keys_seen:
+            raise ValueError(
+                f"tenant {name!r} reuses the API key of tenant "
+                f"{keys_seen[api_key]!r}: keys must be unique or "
+                f"auth cannot attribute traffic"
+            )
+        keys_seen[api_key] = name
         t = Tenant(name, api_key, float(weight), float(rate),
                    float(burst), int(streams))
         if t.weight <= 0 or t.tokens_per_s <= 0 or t.burst <= 0 \
@@ -183,20 +251,46 @@ class UsageLedger:
     the same ``_apply`` with rid dedup, so a record is counted exactly
     once no matter how many times the gateway dies and replays.
     Thread-safe: the HTTP loop journals through run_in_executor while
-    the supervisor thread reads briefs."""
+    the supervisor thread reads briefs.
 
-    def __init__(self, path: str):
+    A long-lived gateway must not grow without bound: every
+    AREAL_GW_USAGE_COMPACT_EVERY journaled records the WAL is folded
+    into ONE aggregated per-tenant ``agg`` record (RolloutWAL.compact —
+    the totals the individual records sum to, so a restart replays the
+    aggregate plus whatever landed after it into identical rows), and
+    the request-id dedup set is aged down to a bounded recent window.
+    Disk, replay time, and dedup memory are all O(cadence), not
+    O(lifetime traffic)."""
+
+    # Request ids kept for duplicate defence across a compaction; only
+    # in-flight retries can legitimately re-present a rid, so a short
+    # recency window is enough.
+    SEEN_WINDOW = 1024
+
+    def __init__(self, path: str, compact_every: Optional[int] = None):
         self._lock = threading.Lock()
         self._wal = RolloutWAL(path, schema=GW_USAGE_WAL_V1)
         self._seen: set = set()
+        self._recent: Deque[str] = collections.deque(
+            maxlen=self.SEEN_WINDOW)
+        if compact_every is None:
+            compact_every = env_registry.get_int(
+                "AREAL_GW_USAGE_COMPACT_EVERY")
+        self._compact_every = max(0, int(compact_every))
+        self._records = 0  # journal records since the last compaction
         self._rows: Dict[str, Dict[str, Any]] = {}
         self.replayed = 0
         self.dup_dropped = 0
+        self.compactions = 0
         for rec in self._wal.replay():
             if self._apply(rec):
                 self.replayed += 1
+                self._records += 1
             else:
                 self.dup_dropped += 1
+        # A journal that replayed past the cadence (e.g. a crash loop)
+        # compacts immediately instead of carrying the backlog forward.
+        self._maybe_compact_locked()
 
     def _row(self, tenant: str) -> Dict[str, Any]:
         row = self._rows.get(tenant)
@@ -217,6 +311,21 @@ class UsageLedger:
         if not rid or rid in self._seen:
             return False
         self._seen.add(rid)
+        self._recent.append(rid)
+        if rec.get("kind") == "agg":
+            # A compaction record: the summed totals of every
+            # individual record it replaced, added wholesale.
+            for tenant, agg in (rec.get("rows") or {}).items():
+                row = self._row(str(tenant))
+                for k in ("requests", "sheds", "prompt_tokens",
+                          "completion_tokens"):
+                    row[k] += int(agg.get(k) or 0)
+                for key in ("ttft_counts", "itl_counts"):
+                    for i, n in enumerate(
+                        latency.decode_counts(agg.get(key) or "")
+                    ):
+                        row[key][i] += n
+            return True
         row = self._row(str(rec.get("tenant") or "unknown"))
         if rec.get("kind") == "shed":
             row["sheds"] += 1
@@ -232,6 +341,50 @@ class UsageLedger:
         for i, n in enumerate(itl):
             row["itl_counts"][i] += n
         return True
+
+    def _maybe_compact_locked(self):
+        """Compact once the cadence is reached; caller holds the lock
+        (or is the single-threaded constructor)."""
+        if self._compact_every <= 0 \
+                or self._records < self._compact_every:
+            return
+        agg_rid = "agg-" + uuid.uuid4().hex
+        rec = {
+            "rid": agg_rid,
+            "kind": "agg",
+            "ts": time.time(),
+            "rows": {
+                name: {
+                    "requests": r["requests"],
+                    "sheds": r["sheds"],
+                    "prompt_tokens": r["prompt_tokens"],
+                    "completion_tokens": r["completion_tokens"],
+                    "ttft_counts": latency.encode_counts(
+                        r["ttft_counts"]),
+                    "itl_counts": latency.encode_counts(
+                        r["itl_counts"]),
+                }
+                for name, r in self._rows.items()
+            },
+        }
+        # The aggregate IS the current in-memory rows (every applied
+        # record was journaled first), so it is NOT re-applied here —
+        # it exists purely for the next replay. Append, fsync, then
+        # drop everything else: the journal becomes [agg].
+        self._wal.append(rec)
+        self._wal.sync()
+        dropped = self._wal.compact(
+            lambda r: r.get("rid") == agg_rid)
+        # Age the dedup set down to the recent window (+ the aggregate
+        # itself): only in-flight duplicates need defending against.
+        self._seen = set(self._recent)
+        self._seen.add(agg_rid)
+        self._records = 1  # the agg record itself
+        self.compactions += 1
+        logger.info(
+            f"usage WAL compacted: {dropped} records folded into one "
+            f"aggregate ({len(rec['rows'])} tenants)"
+        )
 
     def record_usage(self, rid: str, tenant: str, prompt_tokens: int,
                      completion_tokens: int, ttft_ms: Optional[float],
@@ -256,7 +409,10 @@ class UsageLedger:
                 return False
             self._wal.append(rec)
             self._wal.sync()
-            return self._apply(rec)
+            applied = self._apply(rec)
+            self._records += 1
+            self._maybe_compact_locked()
+            return applied
 
     def record_shed(self, rid: str, tenant: str) -> bool:
         rec = {"rid": rid, "kind": "shed", "tenant": tenant,
@@ -267,7 +423,10 @@ class UsageLedger:
                 return False
             self._wal.append(rec)
             self._wal.sync()
-            return self._apply(rec)
+            applied = self._apply(rec)
+            self._records += 1
+            self._maybe_compact_locked()
+            return applied
 
     def totals(self) -> Tuple[int, int, List[int], List[int]]:
         """(prompt_tokens, completion_tokens, merged ttft counts,
@@ -353,6 +512,7 @@ class GatewayService:
         usage_wal_path: Optional[str] = None,
         fair_share: Optional[bool] = None,
         tokenizer: Optional[Tuple[Callable, Callable]] = None,
+        internal_token: Optional[str] = None,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -375,6 +535,14 @@ class GatewayService:
                 else env_registry.get_str("AREAL_GW_TENANTS"))
         self.tenants = parse_tenant_spec(spec)
         self._by_key = {t.api_key: t for t in self.tenants.values()}
+        # Internal-surface shared secret (trainer proxy + operator
+        # endpoints): explicit arg > env knob > random mint. Published
+        # to name_resolve at start() — reachable by rollout workers
+        # and operators, never by external tenants.
+        if internal_token is None:
+            internal_token = env_registry.get_str(
+                "AREAL_GW_INTERNAL_TOKEN")
+        self.internal_token = internal_token or uuid.uuid4().hex
         # Optional (encode(text)->ids, decode(ids)->text) pair; absent,
         # api/public.py's byte codec applies.
         self.tokenizer = tokenizer
@@ -918,21 +1086,47 @@ class GatewayService:
         )
         return web.json_response(body)
 
+    # -- internal-surface auth ------------------------------------------
+
+    def _internal_ok(self, request) -> bool:
+        """True iff the caller presented the internal shared secret
+        (X-Areal-Gateway-Token, or a Bearer token) — the gate on the
+        trainer proxy and the operator surfaces, which share the
+        tenant-facing listener."""
+        tok = request.headers.get("X-Areal-Gateway-Token", "")
+        if not tok:
+            auth = request.headers.get("Authorization", "")
+            tok = auth[7:] if auth.startswith("Bearer ") else ""
+        return bool(tok) and hmac.compare_digest(
+            tok, self.internal_token)
+
     # -- trainer proxy --------------------------------------------------
 
     async def _h_schedule_proxy(self, request):
         """Reserved-tenant pass-through for the training plane: tags
         the meta as the trainer tenant (never shed, never queued) and
-        forwards to the manager with the caller's deadline intact."""
+        forwards to the manager with the caller's deadline intact.
+        Internal-token gated: an unauthenticated caller would otherwise
+        ride the never-shed trainer lane past every tenant quota."""
         from aiohttp import web
 
+        if not self._internal_ok(request):
+            self.counters["auth_failures_total"] += 1
+            return web.json_response(
+                public.error_body(
+                    401, "trainer proxy requires the internal token"),
+                status=401,
+            )
         try:
             meta = await request.json()
         except Exception:
             meta = {}
         if not isinstance(meta, dict):
             meta = {}
-        meta.setdefault("tenant", TRAINER_TENANT)
+        # Overwrite, never setdefault: the proxy's whole meaning is
+        # "this IS trainer traffic" — an internal caller must not be
+        # able to spoof some other tenant's attribution either.
+        meta["tenant"] = TRAINER_TENANT
         self._trainer_sched += 1
         dl = rpc.ensure_deadline(
             rpc.Deadline.from_headers(request.headers),
@@ -959,26 +1153,61 @@ class GatewayService:
     # -- operator surfaces ----------------------------------------------
 
     async def _h_usage(self, request):
+        """Operator token -> every tenant's row; a tenant API key ->
+        exactly that tenant's row; anyone else -> 401. Usage rows are
+        per-tenant confidential (the Retry-After design already
+        refuses to leak cross-tenant traffic — the report must not
+        hand it out for free)."""
         from aiohttp import web
 
+        operator = self._internal_ok(request)
+        tenant: Optional[Tenant] = None
+        if not operator:
+            auth = request.headers.get("Authorization", "")
+            key = auth[7:] if auth.startswith("Bearer ") else auth
+            tenant = self._by_key.get(key)
+            if tenant is None:
+                self.counters["auth_failures_total"] += 1
+                return web.json_response(
+                    public.error_body(
+                        401,
+                        "usage requires the internal token or a "
+                        "tenant API key",
+                    ),
+                    status=401,
+                )
         snap = self.ledger.snapshot()
-        trainer = snap.setdefault(TRAINER_TENANT, {
-            "requests": 0, "sheds": 0, "prompt_tokens": 0,
-            "completion_tokens": 0, "total_tokens": 0,
-        })
-        trainer["sched_requests"] = self._trainer_sched
+        if operator:
+            trainer = snap.setdefault(TRAINER_TENANT, {
+                "requests": 0, "sheds": 0, "prompt_tokens": 0,
+                "completion_tokens": 0, "total_tokens": 0,
+            })
+            trainer["sched_requests"] = self._trainer_sched
+        else:
+            snap = {tenant.name: snap.get(tenant.name, {
+                "requests": 0, "sheds": 0, "prompt_tokens": 0,
+                "completion_tokens": 0, "total_tokens": 0,
+            })}
         return web.json_response({
             "schema": GATEWAY_V1,
             "gateway": self.member,
             "fair_share": self.fair_share,
             "usage_replayed": self.ledger.replayed,
             "usage_dup_dropped": self.ledger.dup_dropped,
+            "usage_compactions": self.ledger.compactions,
             "tenants": snap,
         })
 
     async def _h_metrics(self, request):
         from aiohttp import web
 
+        if not self._internal_ok(request):
+            self.counters["auth_failures_total"] += 1
+            return web.json_response(
+                public.error_body(
+                    401, "metrics requires the internal token"),
+                status=401,
+            )
         c = self.counters
         pt, ct, ttft, itl = self.ledger.totals()
         active = sum(t.active_streams for t in self.tenants.values())
@@ -998,6 +1227,8 @@ class GatewayService:
             f"areal:gw_usage_replayed_total {self.ledger.replayed}",
             f"areal:gw_usage_dup_dropped_total "
             f"{self.ledger.dup_dropped}",
+            f"areal:gw_usage_compactions_total "
+            f"{self.ledger.compactions}",
         ]
         return web.Response(text="\n".join(lines) + "\n")
 
@@ -1059,9 +1290,20 @@ class GatewayService:
         self._http_thread.start()
         if not self._http_ready.wait(timeout):
             raise TimeoutError("gateway HTTP front did not start")
+        # Per-instance records (keyed by gateway_id, like every other
+        # fleet member): concurrent gateways never overwrite — or on
+        # stop() delete — each other's discovery state.
         name_resolve.add(
-            names.gateway_url(self.experiment_name, self.trial_name),
+            names.gateway_url(
+                self.experiment_name, self.trial_name, self.gateway_id),
             self.address,
+            delete_on_exit=True,
+            replace=True,
+        )
+        name_resolve.add(
+            names.gateway_internal_token(
+                self.experiment_name, self.trial_name, self.gateway_id),
+            self.internal_token,
             delete_on_exit=True,
             replace=True,
         )
@@ -1086,12 +1328,16 @@ class GatewayService:
         self._stop.set()
         if self._heartbeat is not None:
             self._heartbeat.stop()
-        try:
-            name_resolve.delete(
-                names.gateway_url(self.experiment_name, self.trial_name)
-            )
-        except Exception:
-            pass
+        for key in (
+            names.gateway_url(
+                self.experiment_name, self.trial_name, self.gateway_id),
+            names.gateway_internal_token(
+                self.experiment_name, self.trial_name, self.gateway_id),
+        ):
+            try:
+                name_resolve.delete(key)
+            except Exception:
+                pass
         if self._http_loop is not None:
             if self._session is not None and not self._session.closed:
                 try:
@@ -1188,6 +1434,7 @@ class _StubUpstream:
 
 
 def _selftest() -> int:
+    import urllib.error
     import urllib.request
 
     stub = _StubUpstream()
@@ -1236,9 +1483,22 @@ def _selftest() -> int:
         ) as r:
             chat = json.loads(r.read().decode())
         assert chat["usage"]["completion_tokens"] >= 1, chat
+        # Operator surfaces and the trainer proxy are internal-token
+        # gated: no token -> 401, the minted token -> full view.
+        op_hdr = {INTERNAL_TOKEN_HEADER: svc.internal_token}
+        try:
+            probe_dl = rpc.Deadline.after(policy.attempt_timeout_s)
+            urllib.request.urlopen(
+                urllib.request.Request(f"{url}/v1/usage"),
+                timeout=policy.attempt_timeout(probe_dl),
+            )
+            raise AssertionError("tokenless /v1/usage was not refused")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401, e.code
         probe_dl = rpc.Deadline.after(policy.attempt_timeout_s)
         with urllib.request.urlopen(
-            f"{url}/v1/usage", timeout=policy.attempt_timeout(probe_dl)
+            urllib.request.Request(f"{url}/v1/usage", headers=op_hdr),
+            timeout=policy.attempt_timeout(probe_dl),
         ) as r:
             usage = json.loads(r.read().decode())
         row = usage["tenants"]["selftest"]
@@ -1246,7 +1506,8 @@ def _selftest() -> int:
         assert row["completion_tokens"] >= 2, usage
         probe_dl = rpc.Deadline.after(policy.attempt_timeout_s)
         with urllib.request.urlopen(
-            f"{url}/metrics", timeout=policy.attempt_timeout(probe_dl)
+            urllib.request.Request(f"{url}/metrics", headers=op_hdr),
+            timeout=policy.attempt_timeout(probe_dl),
         ) as r:
             mtext = r.read().decode()
         assert "areal:gw_requests_total 2" in mtext, mtext

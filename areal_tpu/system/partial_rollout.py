@@ -48,6 +48,8 @@ class PartialRolloutManager:
         max_retries: int = 8,
         retry_backoff_s: float = 0.05,
         addr_resolver=None,
+        schedule_headers: Optional[Dict[str, str]] = None,
+        headers_resolver=None,
     ):
         self.manager_addr = manager_addr
         self.new_tokens_per_chunk = max(1, new_tokens_per_chunk)
@@ -75,6 +77,13 @@ class PartialRolloutManager:
         # manager re-registers at a NEW address; in-flight samples follow
         # it instead of dying with their accumulated tokens.
         self._addr_resolver = addr_resolver
+        # Extra headers on the /schedule_request hop only (the
+        # trainer-via-gateway internal token — system/gateway.py). The
+        # optional resolver re-reads them alongside address rediscovery:
+        # a restarted gateway mints a fresh token with its fresh URL.
+        self._schedule_headers: Dict[str, str] = dict(
+            schedule_headers or {})
+        self._headers_resolver = headers_resolver
         self._session: Optional[aiohttp.ClientSession] = None
         # Session continuation state: member qid -> total tokens
         # (prompt + output) the fleet has already prefilled/generated
@@ -93,6 +102,13 @@ class PartialRolloutManager:
         self.full_prefill_tokens_total = 0
 
     def _refresh_manager_addr(self):
+        if self._headers_resolver is not None:
+            try:
+                headers = self._headers_resolver()
+                if headers:
+                    self._schedule_headers = dict(headers)
+            except Exception:
+                pass
         if self._addr_resolver is None:
             return
         try:
@@ -124,9 +140,11 @@ class PartialRolloutManager:
 
     async def _schedule(self, meta: Dict) -> Dict:
         sess = await self._sess()
+        headers = rpc.Deadline.after(self.request_timeout).headers()
+        headers.update(self._schedule_headers)
         async with sess.post(
             f"{self.manager_addr}/schedule_request", json=meta,
-            headers=rpc.Deadline.after(self.request_timeout).headers(),
+            headers=headers,
         ) as r:
             return await r.json()
 

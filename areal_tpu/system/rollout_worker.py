@@ -139,18 +139,37 @@ class RolloutWorker(AsyncWorker):
         self._prm_via_gateway = env_registry.get_bool(
             "AREAL_GW_TRAINER_VIA_GATEWAY"
         )
+        prm_headers = None
+        prm_headers_resolver = None
         if self._prm_via_gateway:
-            prm_addr = name_resolve.wait(
-                names.gateway_url(
-                    config.experiment_name, config.trial_name
-                ),
-                timeout=300,
+            # The gateway's trainer proxy is internal-token gated (an
+            # unauthenticated proxy would let anyone ride the
+            # never-shed trainer lane); discovery returns the URL and
+            # the token as one consistent per-instance pair, and the
+            # resolvers re-read BOTH across a gateway restart.
+            from areal_tpu.system.gateway import (
+                INTERNAL_TOKEN_HEADER,
+                discover_gateway,
+                resolve_gateway_once,
             )
-            prm_resolver = lambda: name_resolve.get(  # noqa: E731
-                names.gateway_url(
+
+            prm_addr, gw_token = discover_gateway(
+                config.experiment_name, config.trial_name, timeout=300
+            )
+            prm_headers = {INTERNAL_TOKEN_HEADER: gw_token}
+
+            def prm_resolver():
+                got = resolve_gateway_once(
                     config.experiment_name, config.trial_name
                 )
-            )
+                return got[0] if got else None
+
+            def prm_headers_resolver():
+                got = resolve_gateway_once(
+                    config.experiment_name, config.trial_name
+                )
+                return {INTERNAL_TOKEN_HEADER: got[1]} if got else None
+
         else:
             prm_addr = self.manager_addr
             prm_resolver = lambda: name_resolve.get(  # noqa: E731
@@ -164,6 +183,8 @@ class RolloutWorker(AsyncWorker):
             request_timeout=config.rollout_request_timeout,
             max_retries=config.rollout_max_retries,
             addr_resolver=prm_resolver,
+            schedule_headers=prm_headers,
+            headers_resolver=prm_headers_resolver,
         )
         # Ack mode rides the WAL switch: with the durable plane armed,
         # every trajectory carries a minted sequence id and stays in the

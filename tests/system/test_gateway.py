@@ -47,7 +47,8 @@ def test_parse_tenant_spec():
     ":sk-a:1:1:1:1",                    # empty name
     "acme::1:1:1:1",                    # empty key
     "trainer:sk-t:1:1:1:1",             # reserved name
-    "a:k:1:1:1:1,a:k2:1:1:1:1",         # duplicate
+    "a:k:1:1:1:1,a:k2:1:1:1:1",         # duplicate name
+    "a:k:1:1:1:1,b:k:1:1:1:1",          # duplicate api key
     "a:k:1:0:1:1",                      # non-positive rate
     "a:k:1:1:1:0",                      # max_streams < 1
 ])
@@ -220,6 +221,63 @@ def test_usage_ledger_survives_torn_tail(tmp_path):
         f.write(b'{"rid": "r2", "tenant": "a"')  # crash mid-append
     led2 = UsageLedger(path)
     assert led2.snapshot()["a"]["requests"] == 1
+    led2.close()
+
+
+def test_usage_ledger_compaction(tmp_path):
+    """The journal folds into one aggregate record at the configured
+    cadence: the file stops growing, replay stays exact (totals AND
+    latency histograms), dup-protection still holds for recent rids,
+    and the in-memory seen-set is bounded."""
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path, compact_every=8)
+    itl = [0] * latency.N_BUCKETS
+    itl[2] = 3
+    for i in range(30):
+        led.record_usage(f"r{i}", "acme", 10, 5, ttft_ms=12.0,
+                         itl_counts=itl)
+    led.record_shed("s0", "acme")
+    assert led.compactions >= 3
+    snap = led.snapshot()["acme"]
+    assert snap["requests"] == 30 and snap["sheds"] == 1
+    # Compaction folded the journal: far fewer lines than events.
+    with open(path) as f:
+        lines = [ln for ln in f if ln.strip()]
+    assert len(lines) < 15, len(lines)
+    # A recent rid replayed after compaction is still deduped.
+    assert led.record_usage("r29", "acme", 10, 5, ttft_ms=12.0,
+                            itl_counts=itl) is False
+    assert led.snapshot()["acme"]["requests"] == 30
+    # The seen-set is bounded by the recent-rid window (+agg markers).
+    assert len(led._seen) <= UsageLedger.SEEN_WINDOW + led.compactions
+    pre_row = {k: (list(v) if isinstance(v, list) else v)
+               for k, v in led._rows["acme"].items()}
+    led.close()
+
+    # Restart: replay of the compacted journal reconstructs identical
+    # totals and histograms (raw bucket counts, not just percentiles).
+    led2 = UsageLedger(path, compact_every=8)
+    after = led2.snapshot()["acme"]
+    assert after["requests"] == 30
+    assert after["sheds"] == 1
+    assert after["prompt_tokens"] == 300
+    assert after["completion_tokens"] == 150
+    assert led2._rows["acme"] == pre_row
+    led2.close()
+
+
+def test_usage_ledger_compaction_disabled(tmp_path):
+    """compact_every=0 keeps the PR-19 append-only behaviour."""
+    path = str(tmp_path / "usage.jsonl")
+    led = UsageLedger(path, compact_every=0)
+    for i in range(20):
+        led.record_usage(f"r{i}", "a", 1, 1, ttft_ms=None,
+                         itl_counts=[0] * latency.N_BUCKETS)
+    assert led.compactions == 0
+    led.close()
+    led2 = UsageLedger(path, compact_every=0)
+    assert led2.replayed == 20
+    assert led2.snapshot()["a"]["requests"] == 20
     led2.close()
 
 
@@ -500,25 +558,91 @@ def test_gateway_restart_replays_usage(tmp_path, memory_nr):
 def test_trainer_schedule_proxy(tmp_path, memory_nr):
     """POST /schedule_request on the gateway forwards to the manager
     tagged with the reserved trainer tenant (never shed, never
-    queued)."""
+    queued) — but ONLY with the internal token; a tokenless caller is
+    401'd and can never ride (or spoof) the trainer lane."""
     fleet = _FlakyFleet()  # its manager stub logs metas
     fleet.start()
     svc = _svc("acme:sk-acme:1:100000:200000:4", tmp_path,
                manager_addr=fleet.manager_addr)
     url = svc.start()
+    tok = {"X-Areal-Gateway-Token": svc.internal_token}
     try:
+        sched_body = {"qid": "train/0", "prompt_len": 4,
+                      "new_token_budget": 8}
+        # No token -> 401, nothing forwarded upstream.
+        status, _, text = _post(f"{url}/schedule_request", sched_body)
+        assert status == 401, text
+        assert fleet.sched_metas == []
+        # Wrong token -> still 401.
+        status, _, text = _post(
+            f"{url}/schedule_request", sched_body,
+            headers={"X-Areal-Gateway-Token": "nope"})
+        assert status == 401, text
+        # Real token -> forwarded as the trainer tenant, even when the
+        # caller tries to smuggle a different tenant tag.
         status, _, text = _post(
             f"{url}/schedule_request",
-            {"qid": "train/0", "prompt_len": 4, "new_token_budget": 8})
+            dict(sched_body, tenant="acme"), headers=tok)
         assert status == 200, text
         assert json.loads(text)["url"]
         assert fleet.sched_metas[-1]["tenant"] == "trainer"
         assert svc._trainer_sched == 1
-        # /v1/usage surfaces the trainer row alongside tenant rows.
-        with urllib.request.urlopen(f"{url}/v1/usage",
-                                    timeout=30.0) as r:
+        # /v1/usage (operator view) surfaces the trainer row alongside
+        # tenant rows.
+        req = urllib.request.Request(f"{url}/v1/usage", headers=tok)
+        with urllib.request.urlopen(req, timeout=30.0) as r:
             usage = json.loads(r.read())
         assert usage["tenants"]["trainer"]["sched_requests"] == 1
     finally:
         svc.stop()
         fleet.stop()
+
+
+def test_operator_surfaces_token_gated(tmp_path, memory_nr):
+    """/v1/usage and /metrics 401 without credentials; a tenant key on
+    /v1/usage sees exactly its own row, never the neighbours'."""
+    stub = _StubUpstream()
+    stub.start()
+    svc = _svc("acme:sk-acme:1:100000:200000:4,"
+               "beta:sk-beta:1:100000:200000:4",
+               tmp_path, manager_addr=stub.address)
+    url = svc.start()
+    tok = {"X-Areal-Gateway-Token": svc.internal_token}
+    try:
+        body = {"prompt": "hi", "max_tokens": 2, "stream": False}
+        for k in ("sk-acme", "sk-beta"):
+            status, _, text = _post(f"{url}/v1/completions", body,
+                                    key=k)
+            assert status == 200, text
+
+        def _get(path, headers=None):
+            req = urllib.request.Request(f"{url}{path}",
+                                         headers=headers or {})
+            try:
+                with urllib.request.urlopen(req, timeout=30.0) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode(errors="replace")
+
+        # Tokenless: both operator surfaces refuse.
+        assert _get("/v1/usage")[0] == 401
+        assert _get("/metrics")[0] == 401
+        # Operator token: full multi-tenant snapshot.
+        status, text = _get("/v1/usage", headers=tok)
+        assert status == 200
+        usage = json.loads(text)
+        assert set(usage["tenants"]) >= {"acme", "beta"}
+        # Tenant key: exactly its own row — no neighbour traffic leaks.
+        status, text = _get(
+            "/v1/usage", headers={"Authorization": "Bearer sk-acme"})
+        assert status == 200
+        mine = json.loads(text)
+        assert set(mine["tenants"]) == {"acme"}
+        assert mine["tenants"]["acme"]["requests"] == 1
+        # /metrics answers the internal token too.
+        status, text = _get("/metrics", headers=tok)
+        assert status == 200
+        assert "areal:gw_requests_total" in text
+    finally:
+        svc.stop()
+        stub.stop()

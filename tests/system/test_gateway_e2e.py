@@ -178,7 +178,9 @@ def test_async_ppo_through_gateway(tmp_path, monkeypatch):
         # ...and internal traffic was NEVER queued or shed behind
         # external tenants.
         assert svc.counters["shed_total"] == 0
-        st, _, usage = _gw_req(svc.address, "/v1/usage")
+        st, _, usage = _gw_req(
+            svc.address, "/v1/usage",
+            headers={"X-Areal-Gateway-Token": svc.internal_token})
         assert st == 200
         trow = usage["tenants"]["trainer"]
         assert trow["sched_requests"] == svc._trainer_sched
@@ -221,7 +223,9 @@ def _spawn_gateway(fleet, tenants, wal, log_path, extra_env=None):
 
 def _wait_gateway(fleet, proc, not_url=None, timeout_s=60.0):
     """Poll name_resolve until the gateway registered a LIVE url
-    (different from `not_url` across restarts)."""
+    (different from `not_url` across restarts); returns (url,
+    internal_token) — the token gates the operator surfaces and the
+    trainer proxy."""
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if proc.poll() is not None:
@@ -230,12 +234,15 @@ def _wait_gateway(fleet, proc, not_url=None, timeout_s=60.0):
             )
         try:
             url = name_resolve.get(
-                names.gateway_url(fleet.exp, fleet.trial)
+                names.gateway_url(fleet.exp, fleet.trial, 0)
             )
-            if url and url != not_url:
+            token = name_resolve.get(
+                names.gateway_internal_token(fleet.exp, fleet.trial, 0)
+            )
+            if url and token and url != not_url:
                 st, _, _ = _gw_req(url, "/health", timeout=5.0)
                 if st == 200:
-                    return url
+                    return url, token
         except Exception:
             pass
         time.sleep(0.2)
@@ -295,7 +302,8 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                 # the servers.
                 extra_env={"AREAL_GW_MAX_INFLIGHT": "4"},
             ))
-            url = _wait_gateway(fleet, gw_procs[0])
+            url, gw_tok = _wait_gateway(fleet, gw_procs[0])
+            op_hdr = {"X-Areal-Gateway-Token": gw_tok}
 
             def completion(tenant_key, tenant, i):
                 st, hdrs, body = _gw_req(url, "/v1/completions", {
@@ -311,7 +319,7 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                 st, _, body = completion("sk-solo", "solo", i)
                 assert st == 200, body
                 assert len(body["choices"][0]["token_ids"]) == MAX_NEW
-            _, _, usage = _gw_req(url, "/v1/usage")
+            _, _, usage = _gw_req(url, "/v1/usage", headers=op_hdr)
             solo_p99 = usage["tenants"]["solo"]["ttft_p99_ms"]
             assert solo_p99 > 0.0
 
@@ -343,7 +351,7 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                 th.join(timeout=300)
             assert len(agg_done) == 12
 
-            _, _, usage = _gw_req(url, "/v1/usage")
+            _, _, usage = _gw_req(url, "/v1/usage", headers=op_hdr)
             rows = usage["tenants"]
             # The aggressor was shed (3x its cap of 4 concurrent
             # streams) and NOBODY else was.
@@ -358,12 +366,13 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                 f"victim p99 {vic_p99}ms vs solo {solo_p99}ms"
             )
 
-            # ---- Trainer stream through the proxy: zero failures.
+            # ---- Trainer stream through the proxy (internal-token
+            # authenticated): zero failures.
             for i in range(6):
                 st, _, sched = _gw_req(url, "/schedule_request", {
                     "qid": f"train{i}", "prompt_len": PLEN,
                     "new_token_budget": MAX_NEW,
-                }, timeout=60.0)
+                }, timeout=60.0, headers=op_hdr)
                 assert st == 200 and "url" in sched, sched
                 st2, _, out = _gw_req(sched["url"], "/generate", {
                     "qid": f"train{i}",
@@ -372,7 +381,7 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                                 "greedy": True},
                 }, timeout=180.0)
                 assert st2 == 200 and len(out["output_ids"]) == MAX_NEW
-            _, _, usage = _gw_req(url, "/v1/usage")
+            _, _, usage = _gw_req(url, "/v1/usage", headers=op_hdr)
             assert usage["tenants"]["trainer"]["sched_requests"] == 6
             assert usage["tenants"]["trainer"]["sheds"] == 0
 
@@ -392,8 +401,11 @@ def test_gateway_acceptance_multi_tenant(tmp_path):
                 fleet, tenants, wal, gw_log,
                 extra_env={"AREAL_GW_MAX_INFLIGHT": "4"},
             ))
-            url2 = _wait_gateway(fleet, gw_procs[1], not_url=url)
-            _, _, usage2 = _gw_req(url2, "/v1/usage")
+            url2, gw_tok2 = _wait_gateway(fleet, gw_procs[1],
+                                          not_url=url)
+            _, _, usage2 = _gw_req(
+                url2, "/v1/usage",
+                headers={"X-Areal-Gateway-Token": gw_tok2})
             # ...and the WAL replay reconstructs EXACTLY those rows:
             # nothing lost, nothing double-billed.
             assert usage2["usage_replayed"] > 0
