@@ -141,6 +141,13 @@ class GenerationServerConfig:
     experiment_name: str = ""
     trial_name: str = ""
     server_index: int = 0
+    # Which registered model family this server hosts (multi-model
+    # serving plane, system/model_registry.py). Stamped into the
+    # heartbeat payload so the manager pools the fleet per model; a
+    # mismatch is a routing error, never a silent cross-model KV or
+    # weight hit. None = the manager's default model_name (the
+    # single-model fleets every pre-registry deployment runs).
+    model_id: Optional[str] = None
     model_path: Optional[str] = None
     model: ModelAbstraction = None
     tokenizer_path: Optional[str] = None
@@ -380,6 +387,17 @@ class GserverManagerConfig:
     scale_cooldown_s: float = 15.0
     # Consecutive over/under-watermark metrics polls before acting.
     scale_sustain_polls: int = 2
+    # ---- Multi-model serving plane (system/model_registry.py) -------
+    # When True the manager partitions the fleet into per-model pools
+    # from registry records + heartbeat model_ids: routing, affinity,
+    # the KV prefix index, shed/breaker candidacy, and the autoscaler
+    # all become model-scoped, and each registered model's weight
+    # version is watched (and fanned out) independently. Heartbeats
+    # naming an UNREGISTERED model_id are quarantined instead of
+    # adopted. False = the legacy single-model fleet: every server is
+    # assumed to host `model_name` and extra model_version keys are
+    # ignored.
+    multi_model: bool = False
 
     @property
     def worker_name(self) -> str:

@@ -96,9 +96,11 @@ _KNOBS: List[Knob] = [
     _k("AREAL_KV_SPILL_DTYPE", "str", None,
        "KV spill wire precision when the engine ctor passes None: "
        "'int8' quantizes a FLOAT pool's prefixes on the spill wire "
-       "(quantize_kv — halves tier bytes); int8 pools always spill "
-       "their (data, scales) form unchanged. None/'model' ships the "
-       "pool's own precision.", snapshot=True),
+       "(quantize_kv — halves tier bytes); 'fp8' uses the e4m3 wire "
+       "(kv_handoff.quantize_kv_fp8 — same 1-byte footprint, floating "
+       "mantissa so small-magnitude KV keeps relative precision); "
+       "int8 pools always spill their (data, scales) form unchanged. "
+       "None/'model' ships the pool's own precision.", snapshot=True),
     _k("AREAL_KV_INDEX_SIZE", "int", 65536,
        "LRU capacity of the gserver manager's global prefix index "
        "(qid -> holder + tier, fed from each server's /kv/index) when "
@@ -303,6 +305,26 @@ _KNOBS: List[Knob] = [
        "window — disk, replay time, and dedup memory stay O(cadence) "
        "instead of growing with lifetime traffic. 0 disables "
        "compaction (tests pinning raw-record replay)."),
+    _k("AREAL_GW_MODELS", "str", None,
+       "Model ids the fleet serves, comma list; the FIRST entry is "
+       "the default a request without a meaningful OpenAI 'model' "
+       "field maps to. Set -> the gateway resolves the request field "
+       "against this list (unknown model 404, unentitled 403 via the "
+       "tenant spec's optional 7th 'a|b' entitlement field), tags the "
+       "scheduling meta with the resolved id so the manager routes "
+       "to that model's pool only, and meters usage per (tenant, "
+       "model). Unset = single-model legacy mode."),
+    _k("AREAL_GW_TLS_CERT", "str", None,
+       "PEM certificate chain for TLS termination on the gateway's "
+       "tenant-facing listener; must be set together with "
+       "AREAL_GW_TLS_KEY (exactly one set is a startup error, never "
+       "a silent plaintext listener). The published discovery URL "
+       "becomes https://. Production fleets normally terminate mTLS "
+       "at the load balancer instead (docs/serving.md)."),
+    _k("AREAL_GW_TLS_KEY", "str", None,
+       "PEM private key paired with AREAL_GW_TLS_CERT (the in-process "
+       "TLS terminator for single-box deployments and the selftest's "
+       "self-signed arm)."),
     _k("AREAL_GW_TRAINER_VIA_GATEWAY", "bool", False,
        "Route rollout workers' partial-rollout SCHEDULING hops "
        "through the gateway's /schedule_request trainer-tenant proxy "

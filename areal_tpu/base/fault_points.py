@@ -149,6 +149,13 @@ _POINTS: List[FaultPoint] = [
        "A whole reward-executor service dies mid-flight (container "
        "kill) — its heartbeat goes stale and clients must fail over "
        "to a surviving executor with zero failed episodes."),
+    _p("manager.model_registry",
+       ("areal_tpu/system/gserver_manager.py",), "sync",
+       "The model-registry read flakes during the manager's "
+       "multi-model refresh — the accepted-model set must stay at "
+       "its last good value (live pools keep routing, unregistered "
+       "joiners stay quarantined), never a poll-thread crash or a "
+       "mass quarantine of registered models."),
     _p("gw.auth",
        ("areal_tpu/system/gateway.py",), "sync",
        "The gateway's API-key lookup dies mid-auth (key store "

@@ -168,6 +168,11 @@ _METRICS: List[Metric] = [
     _m("areal:role", "string", _GS,
        "Live pool role (prefill/decode/unified) as the server sees "
        "it; the sizer's view wins until this surface catches up."),
+    _m("areal:model_id", "string", _GS,
+       "Registered model family this server hosts (multi-model "
+       "serving plane, system/model_registry.py); second source "
+       "besides the heartbeat so a manager-HA rebuild pools the "
+       "fleet per model without waiting a beat."),
     _m("areal:elastic", "gauge", _GS,
        "1.0 when the CONFIGURED role is unified (re-role pool "
        "eligibility), independent of the live role."),
@@ -388,6 +393,11 @@ _METRICS: List[Metric] = [
        "Usage records dropped at replay/append because their request "
        "id was already accounted — the exactly-once ledger doing its "
        "job across restarts."),
+    _m("areal:gw_model_rejections_total", "counter",
+       "system/gateway.py",
+       "Requests refused at model resolution: 404 (model unknown to "
+       "the registry) or 403 (tenant not entitled to it). Neither "
+       "reaches the fleet; distinct from auth failures and sheds."),
     _m("areal:gw_usage_compactions_total", "counter",
        "system/gateway.py",
        "Usage-WAL compactions: every AREAL_GW_USAGE_COMPACT_EVERY "

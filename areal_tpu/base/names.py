@@ -143,6 +143,19 @@ def gateway_internal_token(
             f"/gateway_token/{gateway_id}")
 
 
+def model_registry(experiment_name: str, trial_name: str, model_id: str) -> str:
+    """One served model family's registry record (MODEL_REGISTRY_V1
+    JSON, system/model_registry.py): model_id -> config hash, family,
+    tokenizer, pool policy. The gserver manager builds its per-model
+    pool map from the records under ``model_registry_root``; the
+    gateway resolves tenant entitlements against the same ids."""
+    return f"{trial_root(experiment_name, trial_name)}/model_registry/{model_id}"
+
+
+def model_registry_root(experiment_name: str, trial_name: str) -> str:
+    return f"{trial_root(experiment_name, trial_name)}/model_registry/"
+
+
 def used_hash_vals(experiment_name: str, trial_name: str) -> str:
     return f"{trial_root(experiment_name, trial_name)}/used_hash_vals"
 

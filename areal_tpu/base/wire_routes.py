@@ -163,16 +163,22 @@ _ROUTES: List[Route] = [
        "bad/missing key), per-tenant token-bucket + concurrent-stream "
        "admission (429 + Retry-After derived from the TENANT'S OWN "
        "bucket, never the fleet's), then weighted fair-share "
-       "scheduling onto the manager's routing.",
-       statuses=(400, 401, 429)),
+       "scheduling onto the manager's routing. Multi-model fleets "
+       "(AREAL_GW_MODELS) also resolve the OpenAI 'model' field "
+       "first: an unknown model is a 404 and a model outside the "
+       "tenant's entitlements a 403 — both BEFORE any bucket charge "
+       "or ledger row, so a rejected model never bills.",
+       statuses=(400, 401, 403, 404, 429)),
     _r("POST", "/v1/chat/completions", (GW,),
        "Chat-shaped twin of /v1/completions: messages are rendered to "
        "one prompt, the stream carries chat.completion.chunk deltas; "
-       "same auth/admission/fair-share contract and statuses.",
-       statuses=(400, 401, 429)),
+       "same auth/admission/fair-share/model-resolution contract and "
+       "statuses.",
+       statuses=(400, 401, 403, 404, 429)),
     _r("GET", "/v1/usage", (GW,),
        "Per-tenant metered usage report (prompt/completion tokens, "
-       "TTFT/ITL percentiles, sheds) rebuilt exactly-once from the "
+       "TTFT/ITL percentiles, sheds; multi-model fleets add per-model "
+       "sub-rows under each tenant) rebuilt exactly-once from the "
        "gateway usage WAL; operators reconcile billing against it. "
        "The internal token sees every row; a tenant API key sees ONLY "
        "its own row; anyone else gets 401 — usage is per-tenant "

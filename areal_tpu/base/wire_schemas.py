@@ -74,3 +74,11 @@ GATEWAY_V1 = "areal-gateway/v1"
 # request-id dedup for exactly-once tenant accounting
 # (system/gateway.py over the system/wal.py journal machinery).
 GW_USAGE_WAL_V1 = "areal-gw-usage-wal/v1"
+
+# Model-registry record: one name_resolve JSON document per served
+# model family (system/model_registry.py) — model_id, config hash,
+# tokenizer/family metadata, pool policy. The gserver manager
+# partitions the fleet into per-model pools from these records; a
+# heartbeat naming a model_id with no record here is quarantined, and
+# the gateway resolves tenant entitlements against the same ids.
+MODEL_REGISTRY_V1 = "areal-model-registry/v1"

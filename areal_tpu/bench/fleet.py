@@ -95,6 +95,7 @@ class ProcessFleet:
         spawn_timeout_s: float = 600.0,
         manager_subprocess: bool = False,
         manager_env: Optional[Dict] = None,
+        models: Optional[List[Dict]] = None,
     ):
         import tempfile
 
@@ -110,6 +111,19 @@ class ProcessFleet:
         self._repo_handle = name_resolve.reconfigure(
             "nfs", record_root=self._nr
         )
+        # Multi-model fleets: register every served family in the
+        # discovery-plane registry BEFORE anything spawns — the manager
+        # builds its pool map from list_models at configure time, and a
+        # heartbeat naming an unregistered model_id is quarantined, not
+        # adopted. Each entry is ModelRecord kwargs.
+        if models:
+            from areal_tpu.system import model_registry
+
+            for rec in models:
+                model_registry.register_model(
+                    self.exp, self.trial,
+                    model_registry.ModelRecord(**rec),
+                )
         repo = repo_root()
         env = dict(os.environ)
         env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -162,6 +176,10 @@ class ProcessFleet:
         child_env = dict(self._env)
         for k, v in (srv.pop("env", None) or {}).items():
             child_env[k] = v
+        # A multi-model fleet serves genuinely different weights per
+        # pool: a server dict may override the fleet-level model config
+        # (and carries its model_id through the remaining srv kwargs).
+        model_cfg = srv.pop("model_cfg", None) or self._model_cfg
         log_path = os.path.join(self.tmp, f"server{idx}.log")
         self.logs.append(log_path)
         log_f = open(log_path, "w")
@@ -169,7 +187,7 @@ class ProcessFleet:
         p = subprocess.Popen(
             [sys.executable, "-c", _CHILD % dict(
                 repo=self._repo, nr=self._nr, exp=self.exp,
-                trial=self.trial, idx=idx, model_cfg=self._model_cfg,
+                trial=self.trial, idx=idx, model_cfg=dict(model_cfg),
                 srv=srv,
             )],
             env=child_env, cwd=self._repo, stdout=log_f,
@@ -334,14 +352,23 @@ class ProcessFleet:
         }, timeout=timeout)
 
     def generate_routed(self, qid: str, input_ids: List[int],
-                        max_new: int, timeout: float = 300.0) -> Dict:
+                        max_new: int, timeout: float = 300.0,
+                        model: Optional[str] = None) -> Dict:
         """One request through the manager's routing (pairing included),
-        like a rollout worker. Returns the /generate body; a dict with
-        'shed'/'error' on 429/failure."""
-        sched = self.schedule({
+        like a rollout worker. ``model`` pins the request to that
+        model's pool on a multi-model fleet (the manager refuses to
+        route it anywhere else). Returns the /generate body; a dict
+        with 'shed'/'error' on 429/failure."""
+        meta = {
             "qid": qid, "prompt_len": len(input_ids),
             "new_token_budget": max_new,
-        })
+        }
+        if model:
+            meta["model"] = model
+        try:
+            sched = self.schedule(meta)
+        except urllib.error.HTTPError as e:
+            return {"error": f"schedule {e.code}: {e.read()[:200]}"}
         if "url" not in sched:
             return {"error": f"unroutable: {sched}"}
         payload = {
@@ -414,11 +441,14 @@ def open_loop_point(
     itl_urls: Optional[List[str]] = None,
     rng: Optional[np.random.RandomState] = None,
     drain_timeout_s: float = 120.0,
+    model: Optional[str] = None,
 ) -> Dict:
     """One Poisson-arrival sweep point against the real fleet, routed
-    through the manager. Fixed arrival COUNT (ceil(rate * duration)) so
-    the overload A/B is deterministic; p50/p99 come from the per-server
-    histogram DIFF over the point (the /metrics counters never reset)."""
+    through the manager (``model`` pins every request to one model's
+    pool on a multi-model fleet). Fixed arrival COUNT
+    (ceil(rate * duration)) so the overload A/B is deterministic;
+    p50/p99 come from the per-server histogram DIFF over the point
+    (the /metrics counters never reset)."""
     from areal_tpu.base.latency import merge_counts, percentile_from_counts
 
     rng = rng or np.random.RandomState(0)
@@ -434,7 +464,7 @@ def open_loop_point(
     def fire(i: int):
         out = fleet.generate_routed(
             f"{tag}{i}", prompt_fn(i), max_new,
-            timeout=max(60.0, drain_timeout_s),
+            timeout=max(60.0, drain_timeout_s), model=model,
         )
         with rlock:
             if out.get("shed"):

@@ -260,6 +260,25 @@ register(PhaseSpec(
 ))
 
 register(PhaseSpec(
+    name="multi_model_serving",
+    entrypoint="areal_tpu.bench.workloads:multi_model_serving_phase",
+    priority=7,
+    est_compile_s=90.0,
+    est_measure_s=300.0,
+    min_window_s=0.0,
+    proxy=True,
+    default=False,
+    description="Multi-model serving plane: two model families on one "
+                "real-process fleet behind a multi-model manager — "
+                "per-model routing with greedy parity vs single-model "
+                "baseline fleets (zero cross-model contamination), "
+                "unknown-model refusal, cross-model KV isolation, and "
+                "an independent weight cutover of one family under the "
+                "other family's sustained load (p99 TTFT holds, zero "
+                "failures, zero prefix loss) (CPU-proxy)",
+))
+
+register(PhaseSpec(
     name="tenant_fairness",
     entrypoint="areal_tpu.bench.workloads:tenant_fairness_phase",
     priority=7,

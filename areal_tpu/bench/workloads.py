@@ -2373,6 +2373,86 @@ def _fleet_first_routed_token_ms(fleet, url: str, t0: float,
     return (time.monotonic() - t0) * 1000.0
 
 
+def _fleet_autoscale_arm(tier_env: dict) -> dict:
+    """AUTOSCALER-driven growth (ISSUE 20 satellite): the manager's
+    WatermarkAutoscaler — not the harness — must issue the scale-out.
+    A one-server fleet with a SubprocessLauncher attached sits under
+    sustained queue pressure until the queued-token watermark trips
+    and the manager launches server 2 itself; the harness never calls
+    spawn_server. validate_bench refuses records whose growth is not
+    fully attributable to launcher actions."""
+    import threading
+
+    from areal_tpu.bench.fleet import ProcessFleet
+    from areal_tpu.system.fleet_controller import SubprocessLauncher
+
+    fleet = ProcessFleet(
+        _OPENLOOP_MODEL, [dict(_FLEET_SRV, env=tier_env)],
+        manager_kw=dict(
+            autoscale=True, scale_out_queued_tokens=32,
+            # avg_q is never negative, so -1 disables scale-in: the
+            # arm measures growth attribution, not shrink.
+            scale_in_queued_tokens=-1, pool_max_servers=2,
+            scale_cooldown_s=2.0, scale_sustain_polls=2,
+        ),
+        tag="flas",
+    )
+    stop = threading.Event()
+    failures = [0]
+
+    def pressure(i: int):
+        k = 0
+        while not stop.is_set():
+            rng = np.random.RandomState(6000 + i * 257 + k)
+            out = fleet.generate_routed(
+                f"as{i}-{k}",
+                rng.randint(1, _OPENLOOP_MODEL["vocab_size"],
+                            size=_FLEET_PLEN).tolist(),
+                16, timeout=120,
+            )
+            if "error" in out:
+                failures[0] += 1
+            k += 1
+
+    try:
+        launcher = SubprocessLauncher(
+            lambda idx: fleet._spawn_server_child(
+                idx, dict(_FLEET_SRV, env=tier_env)
+            )
+        )
+        fleet.manager.attach_launcher(launcher)
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=pressure, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for th in threads:
+            th.start()
+        _fleet_wait(
+            lambda: len(fleet.status()["healthy_servers"]) >= 2,
+            240.0, "autoscaler-driven scale-out",
+        )
+        grow_ms = (time.monotonic() - t0) * 1000.0
+        st = fleet.status()
+        outs = [
+            e for e in st["fleet"]["autoscale"] if e["action"] == "out"
+        ]
+        n_after = len(st["healthy_servers"])
+        out = {
+            "autoscale_n_before": 1.0,
+            "autoscale_n_after": float(n_after),
+            "autoscale_out_actions": float(len(outs)),
+            "autoscale_launched": float(len(launcher.procs)),
+            "autoscale_grow_ms": grow_ms,
+            "autoscale_load_failed": float(failures[0]),
+        }
+        log(f"bench: fleet_elastic autoscale arm: {out}")
+        return out
+    finally:
+        stop.set()
+        fleet.close()
+
+
 def fleet_elastic_phase(pass_: str) -> dict:
     import tempfile
 
@@ -2410,6 +2490,10 @@ def fleet_elastic_phase(pass_: str) -> dict:
         dt = time.perf_counter() - t0
         log(f"bench: fleet_elastic compile pass {dt:.1f}s")
         return {"compile_s": dt}
+
+    # ---- Arm 0: autoscaler-driven growth on its own tiny fleet (no
+    # weight plane needed — the arm is about WHO issues the launch).
+    auto = _fleet_autoscale_arm(tier_env)
 
     # Children and this process must agree on the param-realloc path
     # (the weight-plane origin serves the dump dir): pin AREAL_FILEROOT
@@ -2571,6 +2655,7 @@ def fleet_elastic_phase(pass_: str) -> dict:
             "kv_prefix_lost": lost,
             "fleet": "process",
             "wall_s": time.monotonic() - t_start,
+            **auto,
         }
         log(f"bench: fleet_elastic {out}")
         return out
@@ -2579,6 +2664,358 @@ def fleet_elastic_phase(pass_: str) -> dict:
             load.stop(timeout=30)
         if src is not None:
             src.close()
+        if fleet is not None:
+            fleet.close()
+        if prev_fileroot is None:
+            os.environ.pop("AREAL_FILEROOT", None)
+        else:
+            os.environ["AREAL_FILEROOT"] = prev_fileroot
+        import shutil
+
+        shutil.rmtree(fileroot, ignore_errors=True)
+
+
+# ----------------------------------------------------------------------
+# multi_model_serving: the multi-model serving plane's claims, banked
+# (ISSUE 20 tentpole). Two model FAMILIES (different configs, provably
+# different hashes) share one real-process fleet behind one multi-model
+# manager: per-model routing must hit only the requested model's pool
+# with greedy parity against single-model baseline fleets (zero
+# cross-model contamination), an unknown model must be refused rather
+# than routed, and model A must cut its weights over while model B's
+# sustained traffic holds its p99 TTFT with zero failures and zero
+# prefix loss — the independent-lifecycle claim.
+# ----------------------------------------------------------------------
+
+# Family B: a genuinely different config (extra layer) so its registry
+# hash, its weights, and its greedy outputs all differ from family A —
+# contamination is then token-visible, not just a counter.
+_MM_MODEL_B = dict(_OPENLOOP_MODEL, n_layers=3)
+_MM_STEADY = "actor"    # family A's pool: sustained traffic ("model B" of the A/B)
+_MM_CUTOVER = "scout"   # family B's pool: cut over under that load
+
+
+def _mm_prompts(n: int = 3):
+    return [
+        np.random.RandomState(4200 + i).randint(
+            1, _OPENLOOP_MODEL["vocab_size"], size=_FLEET_PLEN
+        ).tolist()
+        for i in range(n)
+    ]
+
+
+def _mm_baseline(model_cfg: dict, tag: str, tier_env: dict):
+    """Greedy outputs from a SINGLE-model fleet of one family — the
+    contamination reference: the multi-model fleet must reproduce these
+    token for token per pool."""
+    from areal_tpu.bench.fleet import ProcessFleet
+
+    with ProcessFleet(
+        model_cfg, [dict(_FLEET_SRV, env=tier_env)], tag=tag
+    ) as f:
+        outs = []
+        for i, p in enumerate(_mm_prompts()):
+            r = f.generate_routed(f"bl{i}", p, _FLEET_TURN_NEW,
+                                  timeout=600)
+            assert "output_ids" in r, r
+            outs.append([int(t) for t in r["output_ids"]])
+        return outs
+
+
+def multi_model_serving_phase(pass_: str) -> dict:
+    import tempfile
+    import threading
+    import urllib.error
+
+    import jax
+
+    from areal_tpu.base import constants, name_resolve, names
+    from areal_tpu.bench.fleet import ProcessFleet, open_loop_point
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system import model_registry
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    t_start = time.monotonic()
+    tier_env = {"AREAL_KV_TIER_BYTES": str(64 << 20)}
+    vocab = _OPENLOOP_MODEL["vocab_size"]
+
+    if pass_ == "compile":
+        # Warm BOTH families' serving programs (family B's extra layer
+        # is a distinct compile) so the measure pass's six server
+        # spawns all hit the persistent cache.
+        t0 = time.perf_counter()
+        for cfg, tag in ((_OPENLOOP_MODEL, "mmca"), (_MM_MODEL_B, "mmcb")):
+            with ProcessFleet(
+                cfg, [dict(_FLEET_SRV, env=tier_env)], tag=tag
+            ) as f:
+                p = _mm_prompts(1)[0]
+                out = f.generate_routed("c0", p, _FLEET_TURN_NEW,
+                                        timeout=600)
+                assert "output_ids" in out, out
+        dt = time.perf_counter() - t0
+        log(f"bench: multi_model_serving compile pass {dt:.1f}s")
+        return {"compile_s": dt}
+
+    cfgs = {_MM_STEADY: _OPENLOOP_MODEL, _MM_CUTOVER: _MM_MODEL_B}
+    hash_a = model_registry.config_hash(_OPENLOOP_MODEL)
+    hash_b = model_registry.config_hash(_MM_MODEL_B)
+
+    # Same AREAL_FILEROOT discipline as fleet_elastic: children and the
+    # weight-plane sources must agree on the param-realloc root.
+    prev_fileroot = env_registry.get_raw("AREAL_FILEROOT")
+    fileroot = tempfile.mkdtemp(prefix="areal_mms_")
+    os.environ["AREAL_FILEROOT"] = fileroot
+    srcs = []
+    fleet = None
+    try:
+        # ---- Single-model baseline fleets first: version-0 weights,
+        # the parity references.
+        base = {
+            _MM_STEADY: _mm_baseline(_OPENLOOP_MODEL, "mmba", tier_env),
+            _MM_CUTOVER: _mm_baseline(_MM_MODEL_B, "mmbb", tier_env),
+        }
+
+        # ---- The multi-model fleet: 2 family-A servers + 1 family-B
+        # server, both families registered BEFORE anything spawns.
+        fleet = ProcessFleet(
+            _OPENLOOP_MODEL,
+            [
+                dict(_FLEET_SRV, model_id=_MM_STEADY, env=tier_env),
+                dict(_FLEET_SRV, model_id=_MM_STEADY, env=tier_env),
+                dict(_FLEET_SRV, model_id=_MM_CUTOVER,
+                     model_cfg=_MM_MODEL_B, env=tier_env),
+            ],
+            manager_kw=dict(
+                multi_model=True, weight_plane=True,
+                weight_chunk_bytes=_FLEET_CHUNK, weight_fanout_degree=2,
+                flush_request_timeout=120.0,
+            ),
+            models=[
+                dict(model_id=_MM_STEADY, family="tpu_transformer",
+                     config_hash=hash_a),
+                dict(model_id=_MM_CUTOVER, family="tpu_transformer",
+                     config_hash=hash_b),
+            ],
+            tag="mms",
+        )
+        _fleet_wait(
+            lambda: {
+                m: len(r["healthy"])
+                for m, r in fleet.status()["models"].items()
+            } == {_MM_STEADY: 2, _MM_CUTOVER: 1},
+            120.0, "per-model pool map",
+        )
+        pools = {
+            m: set(r["servers"])
+            for m, r in fleet.status()["models"].items()
+        }
+
+        # ---- Arm 1: routing + greedy parity per pool vs the
+        # single-model baselines (weights still at version 0 = the
+        # baselines' init).
+        cross_routes = 0
+        parity_mismatch = 0
+        for model in (_MM_STEADY, _MM_CUTOVER):
+            for i, p in enumerate(_mm_prompts()):
+                sched = fleet.schedule({
+                    "qid": f"par-{model}-{i}", "prompt_len": len(p),
+                    "new_token_budget": _FLEET_TURN_NEW, "model": model,
+                })
+                url = sched.get("url")
+                if url not in pools[model]:
+                    cross_routes += 1
+                    continue
+                r = fleet.generate_direct(
+                    url, f"par-{model}-{i}", p, _FLEET_TURN_NEW
+                )
+                got = [int(t) for t in r.get("output_ids", [])]
+                if got != base[model][i]:
+                    parity_mismatch += 1
+        log(f"bench: multi_model_serving parity: "
+            f"mismatches={parity_mismatch} cross_routes={cross_routes}")
+
+        # ---- Arm 2: cross-model KV isolation. A session served on the
+        # cutover pool, re-requested under the steady model, must route
+        # inside the steady pool and NEVER be offered the other pool's
+        # server as a KV source — a model_id mismatch is a routing
+        # error, not a prefix hit.
+        p0 = _mm_prompts(1)[0]
+        r = fleet.generate_routed("xm0", p0, _FLEET_TURN_NEW,
+                                  model=_MM_CUTOVER, timeout=300)
+        assert "output_ids" in r, r
+        cross_kv = 0
+        sched = fleet.schedule({
+            "qid": "xm0", "prompt_len": len(p0),
+            "new_token_budget": _FLEET_TURN_NEW, "model": _MM_STEADY,
+        })
+        if sched.get("url") not in pools[_MM_STEADY]:
+            cross_kv += 1
+        if sched.get("kv_source") in pools[_MM_CUTOVER]:
+            cross_kv += 1
+
+        # ---- Arm 3: an unregistered model must be refused (503
+        # no-model-pool), never routed to some pool.
+        unknown_rejected = 0
+        unknown_routed = 0
+        try:
+            s = fleet.schedule({
+                "qid": "gh0", "prompt_len": 8, "new_token_budget": 2,
+                "model": "ghost",
+            })
+            if s.get("url"):
+                unknown_routed += 1
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                unknown_rejected += 1
+
+        # ---- Arm 4: independent weight lifecycles. Publish v1 for
+        # BOTH families (each through its own per-model plane source),
+        # then cut the cutover family to v2 while the steady family
+        # carries sustained open-loop traffic.
+        for m in (_MM_STEADY, _MM_CUTOVER):
+            d = os.path.join(
+                constants.get_param_realloc_path(fleet.exp, fleet.trial),
+                m,
+            )
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "engine_state.pkl"), "wb") as f:
+                f.write(b"gate")  # existence gate for check_new_params
+            cfg = TransformerConfig(**cfgs[m])
+            p1 = jax.tree_util.tree_map(
+                lambda x: np.asarray(x),
+                init_params(cfg, jax.random.PRNGKey(
+                    7 if m == _MM_STEADY else 8)),
+            )
+            dump_raw_params(p1, d, version=1, chunk_bytes=_FLEET_CHUNK)
+            s = WeightPlaneSource(d, chunk_bytes=_FLEET_CHUNK).start()
+            s.register(fleet.exp, fleet.trial, m)
+            srcs.append(s)
+            name_resolve.add(
+                names.model_version(fleet.exp, fleet.trial, m), "1",
+                replace=True,
+            )
+        _fleet_wait(
+            lambda: all(
+                r["version"] == 1
+                for r in fleet.status()["models"].values()
+            ),
+            240.0, "v1 fanout to both pools",
+        )
+
+        # Steady family's post-v1 outputs: the fixed point the cutover
+        # must not move. Cutover family's post-v1 outputs: the thing v2
+        # must visibly change.
+        ps = _mm_prompts(1)[0]
+        steady_pre = fleet.generate_routed(
+            "stp0", ps, _FLEET_TURN_NEW, model=_MM_STEADY, timeout=300
+        )["output_ids"]
+        cut_pre = fleet.generate_routed(
+            "ctp0", ps, _FLEET_TURN_NEW, model=_MM_CUTOVER, timeout=300
+        )["output_ids"]
+
+        steady_urls = sorted(pools[_MM_STEADY])
+
+        def prompt_fn(i):
+            return np.random.RandomState(5000 + i).randint(
+                1, vocab, size=_FLEET_PLEN
+            ).tolist()
+
+        pt_base = open_loop_point(
+            fleet, 2.0, 6.0, prompt_fn, _FLEET_TURN_NEW, "mmb",
+            ttft_urls=steady_urls, itl_urls=steady_urls,
+            rng=np.random.RandomState(11), model=_MM_STEADY,
+        )
+
+        cut_dir = os.path.join(
+            constants.get_param_realloc_path(fleet.exp, fleet.trial),
+            _MM_CUTOVER,
+        )
+        p2 = jax.tree_util.tree_map(
+            lambda x: np.asarray(x),
+            init_params(TransformerConfig(**_MM_MODEL_B),
+                        jax.random.PRNGKey(9)),
+        )
+
+        def bump():
+            time.sleep(1.5)
+            dump_raw_params(p2, cut_dir, version=2,
+                            chunk_bytes=_FLEET_CHUNK)
+            name_resolve.add(
+                names.model_version(
+                    fleet.exp, fleet.trial, _MM_CUTOVER
+                ),
+                "2", replace=True,
+            )
+
+        bt = threading.Thread(target=bump, daemon=True)
+        bt.start()
+        pt_cut = open_loop_point(
+            fleet, 2.0, 8.0, prompt_fn, _FLEET_TURN_NEW, "mmc",
+            ttft_urls=steady_urls, itl_urls=steady_urls,
+            rng=np.random.RandomState(13), model=_MM_STEADY,
+        )
+        bt.join(timeout=60)
+        _fleet_wait(
+            lambda: fleet.status()["models"][_MM_CUTOVER]["version"] == 2,
+            240.0, "cutover family v2 fanout",
+        )
+        st = fleet.status()
+        steady_v_after = st["models"][_MM_STEADY]["version"]
+        cut_v_after = st["models"][_MM_CUTOVER]["version"]
+
+        steady_post = fleet.generate_routed(
+            "stp1", ps, _FLEET_TURN_NEW, model=_MM_STEADY, timeout=300
+        )["output_ids"]
+        cut_post = fleet.generate_routed(
+            "ctp1", ps, _FLEET_TURN_NEW, model=_MM_CUTOVER, timeout=300
+        )["output_ids"]
+
+        lost = 0.0
+        for u in fleet.urls:
+            try:
+                lost += fleet.metrics(u).get(
+                    mreg.KV_PREFIX_LOST_TOTAL, 0.0
+                )
+            except Exception:
+                pass
+
+        out = {
+            "n_models": 2.0,
+            "steady_pool_servers": float(len(pools[_MM_STEADY])),
+            "cutover_pool_servers": float(len(pools[_MM_CUTOVER])),
+            "families_distinct": float(hash_a != hash_b),
+            "parity_mismatches": float(parity_mismatch),
+            "cross_model_routes": float(cross_routes),
+            "cross_model_kv_hits": float(cross_kv),
+            "unknown_model_rejected": float(unknown_rejected),
+            "unknown_model_routed": float(unknown_routed),
+            "cutover_version_before": 1.0,
+            "cutover_version_after": float(cut_v_after),
+            "steady_version_after": float(steady_v_after),
+            "steady_outputs_stable": float(
+                list(steady_pre) == list(steady_post)
+            ),
+            "cutover_outputs_changed": float(
+                list(cut_pre) != list(cut_post)
+            ),
+            "b_completed": pt_cut["n_completed"],
+            "b_failed": pt_cut["n_failed"],
+            "b_p99_ttft_base_ms": pt_base["p99_ttft_ms"],
+            "b_p99_ttft_cutover_ms": pt_cut["p99_ttft_ms"],
+            "kv_prefix_lost": lost,
+            "fleet": "process",
+            "wall_s": time.monotonic() - t_start,
+        }
+        log(f"bench: multi_model_serving {out}")
+        return out
+    finally:
+        for s in srcs:
+            try:
+                s.close()
+            except Exception:
+                pass
         if fleet is not None:
             fleet.close()
         if prev_fileroot is None:

@@ -12,11 +12,15 @@ weight-plane rule.
 Wire layout is page-agnostic (token-major ``[L, Hkv, n_tokens, hd]``):
 the exporting and importing engines may run different page sizes or
 even different KV pool precisions. ``kv_wire`` is either a float dtype
-name (the exporter's pool precision) or ``"int8"`` (quantized
+name (the exporter's pool precision), ``"int8"`` (quantized
 ``data + scales`` pairs via engine/paged.quantize_kv — the exporter
-either holds an int8 pool already or compressed at export); the
-importer always reconstructs float K/V and lets ``scatter_prefill``
-re-quantize if its own pool is int8.
+either holds an int8 pool already or compressed at export), or
+``"fp8"`` (e4m3 ``data + scales`` pairs via quantize_kv_fp8 below —
+same 1-byte-per-element wire footprint as int8 but a floating
+mantissa, so small-magnitude KV keeps relative precision instead of
+collapsing onto integer steps); the importer always reconstructs
+float K/V and lets ``scatter_prefill`` re-quantize if its own pool
+is int8.
 
 Kept jax-free (numpy + stdlib) so the server-side transfer code and
 tests can use it without touching a device.
@@ -47,10 +51,31 @@ class KVHandoffVersionMismatch(KVHandoffError):
     importing would decode against KV from other weights."""
 
 
+# Largest finite e4m3 value: the fp8 wire normalizes each
+# per-(layer, head, token) vector's absmax onto it, mirroring the int8
+# wire's KV_INT8_MAX convention (paged.py) with a floating mantissa.
+KV_FP8_MAX = 448.0
+
+
 def _np_dtype(name: str) -> np.dtype:
-    if name == "bfloat16":
-        import ml_dtypes  # noqa: F401  registers bfloat16 by name
+    if name == "bfloat16" or name.startswith("float8"):
+        import ml_dtypes  # noqa: F401  registers the dtype by name
     return np.dtype(name)
+
+
+def quantize_kv_fp8(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(data, scales) for the e4m3 spill/handoff wire: data is
+    ``float8_e4m3fn [L, Hkv, n, hd]`` scaled so each (L, H, token)
+    vector's absmax lands on KV_FP8_MAX (full exponent range used),
+    scales is ``float32 [L, Hkv, n]``. Numpy-only — runs on the spill
+    worker thread, no device round trip."""
+    import ml_dtypes
+
+    xh = np.asarray(x, np.float32)
+    s = np.maximum(np.max(np.abs(xh), axis=-1), 1e-8)
+    w = (xh / s[..., None] * KV_FP8_MAX).astype(
+        ml_dtypes.float8_e4m3fn)
+    return w, s.astype(np.float32)
 
 
 def pack_arrays(
@@ -198,7 +223,8 @@ def unpack_kv_int8(meta: Dict, payload: bytes, verify: bool = True):
 
 def unpack_kv_float(meta: Dict, payload: bytes, verify: bool = True):
     """(k, v) as float32 numpy [L, Hkv, n_tokens, hd], dequantizing an
-    int8 wire via the paged-pool convention (KV_INT8_MAX)."""
+    int8 wire via the paged-pool convention (KV_INT8_MAX) or an fp8
+    wire via KV_FP8_MAX."""
     arrs = unpack_arrays(meta, payload, verify=verify)
     if meta["kv_wire"] == "int8":
         from areal_tpu.engine.paged import KV_INT8_MAX
@@ -211,6 +237,17 @@ def unpack_kv_float(meta: Dict, payload: bytes, verify: bool = True):
         return (
             deq(arrs["k_data"], arrs["k_scales"]),
             deq(arrs["v_data"], arrs["v_scales"]),
+        )
+    if meta["kv_wire"] == "fp8":
+
+        def deq8(w, s):
+            return (
+                w.astype(np.float32) * (s[..., None] / KV_FP8_MAX)
+            ).astype(np.float32)
+
+        return (
+            deq8(arrs["k_data"], arrs["k_scales"]),
+            deq8(arrs["v_data"], arrs["v_scales"]),
         )
     return (
         np.asarray(arrs["k"], dtype=np.float32),

@@ -557,10 +557,10 @@ class ServingEngine:
             )
         if kv_spill_dtype is None:
             kv_spill_dtype = env_registry.get_str("AREAL_KV_SPILL_DTYPE")
-        if kv_spill_dtype not in (None, "model", "int8"):
+        if kv_spill_dtype not in (None, "model", "int8", "fp8"):
             raise ValueError(
                 f"kv_spill_dtype={kv_spill_dtype!r}: expected None, "
-                f"'model', or 'int8'"
+                f"'model', 'int8', or 'fp8'"
             )
         self.kv_spill_dtype = (
             None if kv_spill_dtype == "model" else kv_spill_dtype
@@ -760,7 +760,9 @@ class ServingEngine:
         finished, pool pressure evicted it, or the prompt was shorter
         than one page — callers fall back to serving locally).
         ``compress="int8"`` quantizes a float pool's KV on the wire
-        (quantize_kv); int8 pools always ship their (data, scales) form.
+        (quantize_kv) and ``compress="fp8"`` onto the e4m3 wire
+        (kv_handoff.quantize_kv_fp8); int8 pools always ship their
+        (data, scales) form.
         """
         from areal_tpu.engine import kv_handoff as kvh
         from areal_tpu.engine.paged import gather_kv_tokens
@@ -1898,8 +1900,10 @@ class ServingEngine:
     def _pack_kv_wire(self, k, v, compress: Optional[str]):
         """(arrays, wire) for a gathered (possibly int8-pool) KV pair —
         shared by the handoff export and the spill worker. int8 pools
-        ship their (data, scales) form unchanged; float pools optionally
-        quantize on the wire (``compress='int8'``)."""
+        ship their (data, scales) form unchanged; float pools
+        optionally quantize on the wire (``compress='int8'`` or the
+        e4m3 ``compress='fp8'`` — same 1-byte wire footprint, floating
+        mantissa)."""
         if isinstance(k, tuple):  # int8 pool: (data, scales)
             arrays = [
                 ("k_data", np.asarray(k[0])),
@@ -1918,6 +1922,18 @@ class ServingEngine:
                 ("v_scales", np.asarray(vs[..., 0], np.float32)),
             ]
             return arrays, "int8"
+        if compress == "fp8":
+            from areal_tpu.engine import kv_handoff as kvh
+
+            kw, ks = kvh.quantize_kv_fp8(np.asarray(k))
+            vw, vs = kvh.quantize_kv_fp8(np.asarray(v))
+            arrays = [
+                ("k_data", kw),
+                ("k_scales", ks),
+                ("v_data", vw),
+                ("v_scales", vs),
+            ]
+            return arrays, "fp8"
         kh, vh = np.asarray(k), np.asarray(v)
         return [("k", kh), ("v", vh)], kh.dtype.name
 

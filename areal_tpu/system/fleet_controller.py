@@ -224,6 +224,10 @@ class FleetState:
     urls: List[str] = dataclasses.field(default_factory=list)
     member_urls: Dict[str, str] = dataclasses.field(default_factory=dict)
     roles: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # url -> model_id ("" = undeclared legacy server): a successor of a
+    # multi-model manager must rebuild the per-model pool map too, or
+    # its first routing decisions could cross model boundaries.
+    model_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
     shards: Dict[str, Optional[Tuple[int, int]]] = dataclasses.field(
         default_factory=dict
     )
@@ -305,6 +309,8 @@ def rebuild_fleet_state(
         # surface as its zero-value default.
         role = m.get(mreg.ROLE) or record.get("role") or "unified"
         st.roles[url] = str(role)
+        mid = record.get("model_id") or m.get(mreg.MODEL_ID) or ""
+        st.model_ids[url] = "" if mid in ("-", None) else str(mid)
         st.shards[url] = _shard_of(
             record.get("weight_shard"), m.get(mreg.WEIGHT_SHARD)
         )

@@ -7,6 +7,13 @@ serving plane safely shareable by untrusted tenants:
 
 - **auth**: Bearer API keys map to tenants (AREAL_GW_TENANTS); an
   unknown key is a clean 401, never a routed request;
+- **model routing** (multi-model fleets): when AREAL_GW_MODELS names
+  the fleet's model ids, the OpenAI ``"model"`` request field is
+  resolved against them — an unknown model is a 404, a model the
+  tenant is not entitled to (the optional 7th ``models=a|b`` tenant
+  field) is a 403, and a resolved model rides the scheduling meta so
+  the manager routes to that model's server pool only. Usage rows are
+  metered per (tenant, model);
 - **quotas**: each tenant owns a token bucket (tokens/s + burst) and a
   concurrent-stream cap. A request costing more than the tenant can
   afford is shed with 429 whose Retry-After is derived from the
@@ -48,6 +55,12 @@ key on ``/v1/usage`` sees exactly its own row.
 Prompts arrive as text (byte-level codec, exact for the vocab-256
 harness models — api/public.py) or raw token-id lists; production
 deployments inject a real tokenizer pair via the ``tokenizer`` hook.
+
+TLS: AREAL_GW_TLS_CERT + AREAL_GW_TLS_KEY terminate TLS on the
+tenant-facing listener (the published gateway URL becomes https://).
+The production stance is mTLS at the load balancer with the gateway
+behind it on a private network; the in-process terminator exists for
+single-box deployments and the selftest's self-signed arm.
 """
 
 from __future__ import annotations
@@ -59,6 +72,7 @@ import hmac
 import json
 import math
 import os
+import ssl
 import sys
 import tempfile
 import threading
@@ -82,6 +96,7 @@ from areal_tpu.base import (
 from areal_tpu.base.fault_injection import faults
 from areal_tpu.base.health import Heartbeat
 from areal_tpu.base.wire_schemas import GATEWAY_V1, GW_USAGE_WAL_V1
+from areal_tpu.system import model_registry
 from areal_tpu.system.wal import RolloutWAL
 
 logger = logging.getLogger("gateway")
@@ -154,13 +169,18 @@ class Tenant:
     purely admission control)."""
 
     def __init__(self, name: str, api_key: str, weight: float,
-                 tokens_per_s: float, burst: float, max_streams: int):
+                 tokens_per_s: float, burst: float, max_streams: int,
+                 models: Optional[frozenset] = None):
         self.name = name
         self.api_key = api_key
         self.weight = float(weight)
         self.tokens_per_s = float(tokens_per_s)
         self.burst = float(burst)
         self.max_streams = int(max_streams)
+        # Model entitlements: None = every model the fleet serves;
+        # a frozenset restricts the tenant to exactly those model ids
+        # (anything else answers 403, never a cross-model route).
+        self.models = models
         self.level = float(burst)
         self.stamp = time.monotonic()
         self.active_streams = 0
@@ -192,13 +212,21 @@ class Tenant:
         return wait
 
 
-def parse_tenant_spec(spec: Optional[str]) -> Dict[str, Tenant]:
+def parse_tenant_spec(
+    spec: Optional[str],
+    known_models: Optional[set] = None,
+) -> Dict[str, Tenant]:
     """Parse AREAL_GW_TENANTS: comma list of
-    ``name:api_key:weight:tokens_per_s:burst:max_streams`` entries.
+    ``name:api_key:weight:tokens_per_s:burst:max_streams`` entries,
+    optionally followed by a 7th ``model|model`` entitlement field
+    (absent = entitled to every model the fleet serves).
     Raises ValueError on malformed entries, duplicate names, duplicate
     API keys (a shared key would silently bill whichever tenant parses
-    last), non-positive quotas, or an attempt to redeclare the
-    reserved trainer tenant."""
+    last), non-positive quotas, an attempt to redeclare the reserved
+    trainer tenant, or — when ``known_models`` is given — an
+    entitlement naming a model the fleet does not serve (a typo here
+    would silently lock the tenant out or grant nothing; fail at parse
+    time instead)."""
     tenants: Dict[str, Tenant] = {}
     keys_seen: Dict[str, str] = {}
     if not spec:
@@ -208,12 +236,26 @@ def parse_tenant_spec(spec: Optional[str]) -> Dict[str, Tenant]:
         if not entry:
             continue
         parts = entry.split(":")
-        if len(parts) != 6:
+        if len(parts) not in (6, 7):
             raise ValueError(
                 f"bad tenant entry {entry!r}: want "
                 f"name:api_key:weight:tokens_per_s:burst:max_streams"
+                f"[:model|model...]"
             )
-        name, api_key, weight, rate, burst, streams = parts
+        name, api_key, weight, rate, burst, streams = parts[:6]
+        entitled: Optional[frozenset] = None
+        if len(parts) == 7 and parts[6].strip():
+            models = [m.strip() for m in parts[6].split("|")
+                      if m.strip()]
+            for m in models:
+                model_registry.validate_model_id(m)
+                if known_models is not None and m not in known_models:
+                    raise ValueError(
+                        f"tenant {name!r} entitlement names unknown "
+                        f"model {m!r} (fleet serves "
+                        f"{sorted(known_models)})"
+                    )
+            entitled = frozenset(models)
         if not name or not api_key:
             raise ValueError(f"tenant entry {entry!r}: empty name or key")
         if name == TRAINER_TENANT:
@@ -231,7 +273,7 @@ def parse_tenant_spec(spec: Optional[str]) -> Dict[str, Tenant]:
             )
         keys_seen[api_key] = name
         t = Tenant(name, api_key, float(weight), float(rate),
-                   float(burst), int(streams))
+                   float(burst), int(streams), models=entitled)
         if t.weight <= 0 or t.tokens_per_s <= 0 or t.burst <= 0 \
                 or t.max_streams < 1:
             raise ValueError(
@@ -278,7 +320,9 @@ class UsageLedger:
                 "AREAL_GW_USAGE_COMPACT_EVERY")
         self._compact_every = max(0, int(compact_every))
         self._records = 0  # journal records since the last compaction
-        self._rows: Dict[str, Dict[str, Any]] = {}
+        # Rows are keyed (tenant, model); model "" is single-model
+        # traffic (and every pre-multi-model WAL record replays there).
+        self._rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self.replayed = 0
         self.dup_dropped = 0
         self.compactions = 0
@@ -292,8 +336,8 @@ class UsageLedger:
         # compacts immediately instead of carrying the backlog forward.
         self._maybe_compact_locked()
 
-    def _row(self, tenant: str) -> Dict[str, Any]:
-        row = self._rows.get(tenant)
+    def _row(self, tenant: str, model: str = "") -> Dict[str, Any]:
+        row = self._rows.get((tenant, model))
         if row is None:
             row = {
                 "requests": 0,
@@ -303,7 +347,7 @@ class UsageLedger:
                 "ttft_counts": [0] * latency.N_BUCKETS,
                 "itl_counts": [0] * latency.N_BUCKETS,
             }
-            self._rows[tenant] = row
+            self._rows[(tenant, model)] = row
         return row
 
     def _apply(self, rec: Dict[str, Any]) -> bool:
@@ -314,9 +358,12 @@ class UsageLedger:
         self._recent.append(rid)
         if rec.get("kind") == "agg":
             # A compaction record: the summed totals of every
-            # individual record it replaced, added wholesale.
-            for tenant, agg in (rec.get("rows") or {}).items():
-                row = self._row(str(tenant))
+            # individual record it replaced, added wholesale. Keys are
+            # "tenant" or "tenant\tmodel" (pre-multi-model aggregates
+            # have no tab and land on the "" model row).
+            for rkey, agg in (rec.get("rows") or {}).items():
+                tenant, _, model = str(rkey).partition("\t")
+                row = self._row(tenant, model)
                 for k in ("requests", "sheds", "prompt_tokens",
                           "completion_tokens"):
                     row[k] += int(agg.get(k) or 0)
@@ -326,7 +373,8 @@ class UsageLedger:
                     ):
                         row[key][i] += n
             return True
-        row = self._row(str(rec.get("tenant") or "unknown"))
+        row = self._row(str(rec.get("tenant") or "unknown"),
+                        str(rec.get("model") or ""))
         if rec.get("kind") == "shed":
             row["sheds"] += 1
             return True
@@ -354,7 +402,7 @@ class UsageLedger:
             "kind": "agg",
             "ts": time.time(),
             "rows": {
-                name: {
+                (name if not model else f"{name}\t{model}"): {
                     "requests": r["requests"],
                     "sheds": r["sheds"],
                     "prompt_tokens": r["prompt_tokens"],
@@ -364,7 +412,7 @@ class UsageLedger:
                     "itl_counts": latency.encode_counts(
                         r["itl_counts"]),
                 }
-                for name, r in self._rows.items()
+                for (name, model), r in self._rows.items()
             },
         }
         # The aggregate IS the current in-memory rows (every applied
@@ -388,7 +436,8 @@ class UsageLedger:
 
     def record_usage(self, rid: str, tenant: str, prompt_tokens: int,
                      completion_tokens: int, ttft_ms: Optional[float],
-                     itl_counts: Optional[List[int]]) -> bool:
+                     itl_counts: Optional[List[int]],
+                     model: str = "") -> bool:
         """Journal + count one served request. fsyncs before counting:
         a record is billed iff it is durable (SIGKILL right after the
         response leaves at most the terminal frame unbilled, never a
@@ -397,6 +446,7 @@ class UsageLedger:
             "rid": rid,
             "kind": "usage",
             "tenant": tenant,
+            "model": model,
             "prompt_tokens": int(prompt_tokens),
             "completion_tokens": int(completion_tokens),
             "ttft_ms": None if ttft_ms is None else float(ttft_ms),
@@ -414,9 +464,10 @@ class UsageLedger:
             self._maybe_compact_locked()
             return applied
 
-    def record_shed(self, rid: str, tenant: str) -> bool:
+    def record_shed(self, rid: str, tenant: str,
+                    model: str = "") -> bool:
         rec = {"rid": rid, "kind": "shed", "tenant": tenant,
-               "ts": time.time()}
+               "model": model, "ts": time.time()}
         with self._lock:
             if rid in self._seen:
                 self.dup_dropped += 1
@@ -439,41 +490,69 @@ class UsageLedger:
         itl = latency.merge_counts([r["itl_counts"] for r in rows])
         return pt, ct, ttft, itl
 
+    @staticmethod
+    def _present(requests: int, sheds: int, pt: int, ct: int,
+                 ttft: List[int], itl: List[int]) -> Dict[str, Any]:
+        return {
+            "requests": requests,
+            "sheds": sheds,
+            "prompt_tokens": pt,
+            "completion_tokens": ct,
+            "total_tokens": pt + ct,
+            "ttft_p50_ms": latency.percentile_from_counts(ttft, 50.0),
+            "ttft_p99_ms": latency.percentile_from_counts(ttft, 99.0),
+            "itl_p50_ms": latency.percentile_from_counts(itl, 50.0),
+            "itl_p99_ms": latency.percentile_from_counts(itl, 99.0),
+        }
+
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
-        """Per-tenant rows with computed percentiles (GET /v1/usage)."""
+        """Per-tenant rows with computed percentiles (GET /v1/usage).
+        The top-level tenant row aggregates across models (ratio-of-
+        sums over merged histogram counts); per-model sub-rows sit
+        under ``"models"`` keyed by model id. Single-model traffic
+        (model "") contributes only to the aggregate."""
         out: Dict[str, Dict[str, Any]] = {}
         with self._lock:
-            for name, r in self._rows.items():
-                out[name] = {
-                    "requests": r["requests"],
-                    "sheds": r["sheds"],
-                    "prompt_tokens": r["prompt_tokens"],
-                    "completion_tokens": r["completion_tokens"],
-                    "total_tokens": r["prompt_tokens"]
-                    + r["completion_tokens"],
-                    "ttft_p50_ms": latency.percentile_from_counts(
-                        r["ttft_counts"], 50.0),
-                    "ttft_p99_ms": latency.percentile_from_counts(
-                        r["ttft_counts"], 99.0),
-                    "itl_p50_ms": latency.percentile_from_counts(
-                        r["itl_counts"], 50.0),
-                    "itl_p99_ms": latency.percentile_from_counts(
-                        r["itl_counts"], 99.0),
+            by_tenant: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+            for (name, model), r in self._rows.items():
+                by_tenant.setdefault(name, []).append((model, r))
+            for name, rows in by_tenant.items():
+                agg = self._present(
+                    sum(r["requests"] for _, r in rows),
+                    sum(r["sheds"] for _, r in rows),
+                    sum(r["prompt_tokens"] for _, r in rows),
+                    sum(r["completion_tokens"] for _, r in rows),
+                    latency.merge_counts(
+                        [r["ttft_counts"] for _, r in rows]),
+                    latency.merge_counts(
+                        [r["itl_counts"] for _, r in rows]),
+                )
+                models = {
+                    model: self._present(
+                        r["requests"], r["sheds"],
+                        r["prompt_tokens"], r["completion_tokens"],
+                        r["ttft_counts"], r["itl_counts"])
+                    for model, r in rows if model
                 }
+                if models:
+                    agg["models"] = models
+                out[name] = agg
         return out
 
     def brief(self) -> Dict[str, Dict[str, int]]:
-        """Compact totals for the heartbeat payload (manager /status)."""
+        """Compact totals for the heartbeat payload (manager /status).
+        Aggregated across models — the wire shape predates the
+        multi-model plane and /status consumers sum rows anyway."""
         with self._lock:
-            return {
-                n: {
-                    "requests": r["requests"],
-                    "sheds": r["sheds"],
-                    "prompt_tokens": r["prompt_tokens"],
-                    "completion_tokens": r["completion_tokens"],
-                }
-                for n, r in self._rows.items()
-            }
+            out: Dict[str, Dict[str, int]] = {}
+            for (n, _model), r in self._rows.items():
+                b = out.setdefault(n, {
+                    "requests": 0, "sheds": 0,
+                    "prompt_tokens": 0, "completion_tokens": 0,
+                })
+                for k in b:
+                    b[k] += r[k]
+            return out
 
     def close(self):
         with self._lock:
@@ -513,6 +592,7 @@ class GatewayService:
         fair_share: Optional[bool] = None,
         tokenizer: Optional[Tuple[Callable, Callable]] = None,
         internal_token: Optional[str] = None,
+        model_spec: Optional[str] = None,
     ):
         self.experiment_name = experiment_name
         self.trial_name = trial_name
@@ -531,9 +611,27 @@ class GatewayService:
             fair_share if fair_share is not None
             else env_registry.get_bool("AREAL_GW_FAIR_SHARE")
         )
+        # Multi-model serving: AREAL_GW_MODELS (or the explicit arg)
+        # names the model ids the fleet serves; the first entry is the
+        # default a request without a meaningful "model" field maps
+        # to. Empty = single-model legacy mode (no model resolution,
+        # no model tag on the scheduling meta).
+        mspec = (model_spec if model_spec is not None
+                 else env_registry.get_str("AREAL_GW_MODELS"))
+        self.models: List[str] = []
+        for m in (mspec or "").split(","):
+            m = m.strip()
+            if m and m not in self.models:
+                model_registry.validate_model_id(m)
+                self.models.append(m)
+        self._known_models = set(self.models)
+        self.default_model = self.models[0] if self.models else None
         spec = (tenant_spec if tenant_spec is not None
                 else env_registry.get_str("AREAL_GW_TENANTS"))
-        self.tenants = parse_tenant_spec(spec)
+        self.tenants = parse_tenant_spec(
+            spec,
+            known_models=self._known_models or None,
+        )
         self._by_key = {t.api_key: t for t in self.tenants.values()}
         # Internal-surface shared secret (trainer proxy + operator
         # endpoints): explicit arg > env knob > random mint. Published
@@ -546,6 +644,18 @@ class GatewayService:
         # Optional (encode(text)->ids, decode(ids)->text) pair; absent,
         # api/public.py's byte codec applies.
         self.tokenizer = tokenizer
+        # TLS termination (AREAL_GW_TLS_CERT/KEY): both knobs set ->
+        # the tenant listener serves https and the published discovery
+        # URL says so. Production fleets usually terminate mTLS at the
+        # load balancer instead (docs/serving.md); exactly one knob
+        # set is a config error, not a silent plaintext listener.
+        self._tls_cert = env_registry.get_str("AREAL_GW_TLS_CERT")
+        self._tls_key = env_registry.get_str("AREAL_GW_TLS_KEY")
+        if bool(self._tls_cert) != bool(self._tls_key):
+            raise ValueError(
+                "AREAL_GW_TLS_CERT and AREAL_GW_TLS_KEY must be set "
+                "together (got exactly one)"
+            )
         if usage_wal_path is None:
             usage_wal_path = os.path.join(
                 tempfile.gettempdir(),
@@ -564,6 +674,7 @@ class GatewayService:
             "shed_total": 0,
             "fairshare_picks_total": 0,
             "upstream_failovers_total": 0,
+            "model_rejections_total": 0,
         }
         self._trainer_sched = 0
         # DRR state (event-loop confined).
@@ -708,6 +819,13 @@ class GatewayService:
 
     # -- upstream generation -------------------------------------------
 
+    def _model_tag(self, parsed: public.ParsedRequest) -> str:
+        """Ledger/meta model id: the resolved model in multi-model
+        mode, "" in single-model legacy mode (where parsed.model is
+        whatever placeholder the client sent and must not be routed
+        or billed as a pool name)."""
+        return parsed.model if self._known_models else ""
+
     async def _schedule(self, meta: Dict[str, Any]) -> Dict[str, Any]:
         sess = await self._sess()
         dl = rpc.Deadline.after(self.request_timeout)
@@ -766,6 +884,7 @@ class GatewayService:
                 shed_server_url=shed_url,
                 shed_retry_after=shed_ra_hint,
                 tenant=tenant.name,
+                model=self._model_tag(parsed),
             ))
             try:
                 sched = await self._schedule(meta)
@@ -941,6 +1060,36 @@ class GatewayService:
                 public.error_body(400, "malformed JSON body"),
                 status=400,
             )
+        if self._known_models:
+            # Multi-model resolution: the OpenAI "model" field picks
+            # the pool. "areal" is api/public.py's absent-field
+            # placeholder, so it (like "") maps to the default model;
+            # anything else must name a served model (404) the tenant
+            # is entitled to (403). The resolved id rides the
+            # scheduling meta — a wrong-pool route is the manager's
+            # error to refuse, never a silent cross-model hit.
+            requested = parsed.model
+            if requested in ("", "areal"):
+                requested = self.default_model
+            if requested not in self._known_models:
+                self.counters["model_rejections_total"] += 1
+                return web.json_response(
+                    public.error_body(
+                        404, f"unknown model {requested!r}"),
+                    status=404,
+                )
+            if tenant.models is not None \
+                    and requested not in tenant.models:
+                self.counters["model_rejections_total"] += 1
+                return web.json_response(
+                    public.error_body(
+                        403,
+                        f"tenant {tenant.name} is not entitled to "
+                        f"model {requested!r}",
+                    ),
+                    status=403,
+                )
+            parsed.model = requested
         inbound = rpc.Deadline.from_headers(request.headers)
         if inbound is not None and inbound.expired():
             return web.json_response(
@@ -971,7 +1120,8 @@ class GatewayService:
             ra = max(self.retry_after_floor, retry_after)
 
             def _journal_shed():
-                self.ledger.record_shed(rid, tenant.name)
+                self.ledger.record_shed(
+                    rid, tenant.name, model=self._model_tag(parsed))
 
             await loop.run_in_executor(None, _journal_shed)
             return web.json_response(
@@ -1046,6 +1196,7 @@ class GatewayService:
                 self.ledger.record_usage(
                     rid, tenant.name, len(parsed.prompt_ids), len(acc),
                     ttft_ms, itl_counts,
+                    model=self._model_tag(parsed),
                 )
 
             await loop.run_in_executor(None, _journal)
@@ -1192,6 +1343,7 @@ class GatewayService:
             "schema": GATEWAY_V1,
             "gateway": self.member,
             "fair_share": self.fair_share,
+            "models": self.models,
             "usage_replayed": self.ledger.replayed,
             "usage_dup_dropped": self.ledger.dup_dropped,
             "usage_compactions": self.ledger.compactions,
@@ -1224,6 +1376,8 @@ class GatewayService:
             f"areal:gw_itl_hist {latency.encode_counts(itl) or '-'}",
             f"areal:gw_upstream_failovers_total "
             f"{c['upstream_failovers_total']}",
+            f"areal:gw_model_rejections_total "
+            f"{c['model_rejections_total']}",
             f"areal:gw_usage_replayed_total {self.ledger.replayed}",
             f"areal:gw_usage_dup_dropped_total "
             f"{self.ledger.dup_dropped}",
@@ -1261,9 +1415,14 @@ class GatewayService:
         self._http_loop.run_until_complete(runner.setup())
         host = network.gethostip()
         port = self._port or network.find_free_port()
-        site = web.TCPSite(runner, host, port)
+        ssl_ctx: Optional[ssl.SSLContext] = None
+        if self._tls_cert and self._tls_key:
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ssl_ctx.load_cert_chain(self._tls_cert, self._tls_key)
+        site = web.TCPSite(runner, host, port, ssl_context=ssl_ctx)
         self._http_loop.run_until_complete(site.start())
-        self.address = f"http://{host}:{port}"
+        scheme = "https" if ssl_ctx is not None else "http"
+        self.address = f"{scheme}://{host}:{port}"
         self._dispatch_task = self._http_loop.create_task(
             self._dispatch_loop())
         self._http_ready.set()
@@ -1433,6 +1592,90 @@ class _StubUpstream:
             self._thread.join(timeout=5)
 
 
+def _selftest_tls(stub: _StubUpstream, policy) -> None:
+    """Self-signed-cert arm: mint a throwaway cert pair (openssl
+    binary), serve a second gateway over https with a two-model
+    fleet spec, and drive one completion + the 404/403 model
+    refusals through the TLS listener. Raises on any failure; a box
+    without the openssl binary skips the arm (the production stance
+    is mTLS at the LB anyway — docs/serving.md)."""
+    import shutil
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    if not shutil.which("openssl"):
+        print("gateway selftest: openssl missing, TLS arm skipped")
+        return
+    tls_dir = tempfile.mkdtemp(prefix="gw_selftest_tls_")
+    cert = os.path.join(tls_dir, "cert.pem")
+    keyf = os.path.join(tls_dir, "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", keyf, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    wal2 = os.path.join(
+        tempfile.gettempdir(), f"gw_selftest_tls_{os.getpid()}.jsonl")
+    try:
+        os.remove(wal2)
+    except OSError:
+        pass
+    os.environ["AREAL_GW_TLS_CERT"] = cert
+    os.environ["AREAL_GW_TLS_KEY"] = keyf
+    svc = None
+    try:
+        svc = GatewayService(
+            "gw_selftest_tls", "local",
+            manager_addr=stub.address,
+            tenant_spec="selftest:sk-selftest:1:100000:200000:4:alpha",
+            usage_wal_path=wal2,
+            model_spec="alpha,beta",
+        )
+        url = svc.start()
+        assert url.startswith("https://"), url
+        unverified = ssl._create_unverified_context()
+        hdrs = {"Authorization": "Bearer sk-selftest",
+                "Content-Type": "application/json"}
+
+        def _post(model):
+            data = json.dumps({"prompt": "hi", "max_tokens": 2,
+                               "stream": False,
+                               "model": model}).encode()
+            req = urllib.request.Request(
+                f"{url}/v1/completions", data=data, headers=hdrs)
+            probe_dl = rpc.Deadline.after(policy.attempt_timeout_s)
+            with urllib.request.urlopen(
+                req, timeout=policy.attempt_timeout(probe_dl),
+                context=unverified,
+            ) as r:
+                return json.loads(r.read().decode())
+
+        body = _post("alpha")
+        assert body["model"] == "alpha", body
+        for model, want in (("nope", 404), ("beta", 403)):
+            try:
+                _post(model)
+                raise AssertionError(
+                    f"model {model!r} was not refused")
+            except urllib.error.HTTPError as e:
+                assert e.code == want, (model, e.code)
+        snap = svc.ledger.snapshot()["selftest"]
+        assert snap["models"]["alpha"]["requests"] == 1, snap
+        print(f"gateway selftest TLS arm ok: {url}")
+    finally:
+        os.environ.pop("AREAL_GW_TLS_CERT", None)
+        os.environ.pop("AREAL_GW_TLS_KEY", None)
+        if svc is not None:
+            svc.stop()
+        shutil.rmtree(tls_dir, ignore_errors=True)
+        try:
+            os.remove(wal2)
+        except OSError:
+            pass
+
+
 def _selftest() -> int:
     import urllib.error
     import urllib.request
@@ -1511,6 +1754,7 @@ def _selftest() -> int:
         ) as r:
             mtext = r.read().decode()
         assert "areal:gw_requests_total 2" in mtext, mtext
+        _selftest_tls(stub, policy)
         print(f"gateway selftest ok: {url}")
         return 0
     except Exception as e:
@@ -1535,6 +1779,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--manager-addr", default=None)
     p.add_argument("--tenants", default=None,
                    help="overrides AREAL_GW_TENANTS")
+    p.add_argument("--models", default=None,
+                   help="comma list of served model ids (first is the "
+                   "default); overrides AREAL_GW_MODELS")
     p.add_argument("--usage-wal", default=None)
     p.add_argument("--name-resolve-root", default=None)
     p.add_argument(
@@ -1556,6 +1803,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.experiment, args.trial, gateway_id=args.index,
         port=args.port, manager_addr=args.manager_addr,
         tenant_spec=args.tenants, usage_wal_path=args.usage_wal,
+        model_spec=args.models,
     )
     url = svc.start()
     print(url, flush=True)

@@ -274,6 +274,11 @@ class GenerationServer(Worker):
         payload["url"] = self.address
         payload["server_index"] = self.cfg.server_index
         payload["role"] = self.role
+        if self.cfg.model_id:
+            # Multi-model fleets pool servers by this field; the
+            # manager QUARANTINES a beat naming an unregistered id
+            # rather than adopt it (system/model_registry.py).
+            payload["model_id"] = self.cfg.model_id
         # The drain flag rides the heartbeat so even a RESTARTED
         # manager learns in-progress drains without asking.
         payload["draining"] = bool(self._draining)
@@ -1911,6 +1916,7 @@ class GenerationServer(Worker):
             # the histogram lines), elastic eligibility (configured role
             # is the re-role pool), and the KV-handoff counters.
             f"areal:role {self.role}",
+            f"areal:model_id {self.cfg.model_id or '-'}",
             f"areal:elastic {1.0 if self.cfg.role == 'unified' else 0.0}",
             f"areal:kv_export_total {m['kv_export_total']}",
             f"areal:kv_export_bytes {m['kv_export_bytes']}",

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import dataclasses
 import os
 import shutil
 import threading
@@ -263,6 +264,26 @@ class GserverManager(Worker):
         # heartbeat payload (None = unsharded). Fanout trees are planned
         # per shard group — only same-shard peers hold the same stream.
         self._server_shards: Dict[str, Optional[Tuple[int, int]]] = {}
+        # Multi-model serving plane (system/model_registry.py): which
+        # registered family each server hosts (heartbeat-learned;
+        # launch-time servers default to the manager's model_name), the
+        # registered-id set adoption checks heartbeats against, the
+        # registry records (pool-policy floors/ceilings for the
+        # model-scoped autoscaler), per-model weight versions
+        # (weight_version stays the DEFAULT model's — the training
+        # plane's staleness gate keys off it), and the quarantine
+        # ledger for beats naming an unregistered model_id.
+        self._server_models: Dict[str, str] = {
+            u: config.model_name for u in self.server_urls
+        }
+        self._model_set: set = {config.model_name}
+        self._model_records: Dict = {}
+        self._model_versions: Dict[str, int] = {}
+        self._new_model: str = config.model_name
+        self._quarantined: Dict[str, str] = {}
+        self._autoscalers: Dict[str, object] = {}
+        if getattr(config, "multi_model", False):
+            self._refresh_model_set()
         self._server_elastic: Dict[str, bool] = {}
         self._server_queued_toks = {u: 0.0 for u in self.server_urls}
         self._server_free_pages: Dict[str, float] = {}
@@ -308,7 +329,10 @@ class GserverManager(Worker):
         self._join_log: List[Dict] = []
         self._drain_log: List[Dict] = []
         self._scale_log: List[Dict] = []
-        self._pending_launches: List[float] = []
+        # Launch markers the autoscaler is still waiting on:
+        # {"t": monotonic, "model": model_id or None}. Model-scoped so a
+        # multi-model fleet counts pending capacity per pool.
+        self._pending_launches: List[Dict] = []
         self._launched_indices: set = set()
         self._known_indices: set = set()
         self._launcher = None
@@ -332,6 +356,12 @@ class GserverManager(Worker):
             # authoritative for identity, /metrics for live surfaces.
             self._member_urls = dict(rebuilt.member_urls)
             self._server_roles.update(rebuilt.roles)
+            # Per-model pools must survive the takeover too: a successor
+            # that forgot which model each url hosts could make its
+            # first routing decisions across model boundaries.
+            for _u, _mid in rebuilt.model_ids.items():
+                if _mid:
+                    self._server_models[_u] = _mid
             self._server_shards.update(rebuilt.shards)
             self._server_elastic.update(rebuilt.elastic)
             self._server_shed_total.update(rebuilt.shed_totals)
@@ -395,10 +425,13 @@ class GserverManager(Worker):
         self._rollout_seen: set = set()
         self._last_health_poll = 0.0
 
-        # Weight-distribution plane: manager-hosted origin fallback (only
-        # started when weight_plane is on and no trainer-side source is
-        # registered) + the last fanout's per-server stats for /status.
-        self._own_source = None
+        # Weight-distribution plane: manager-hosted origin fallbacks
+        # (only started when weight_plane is on and no trainer-side
+        # source is registered), one per model — each model's checkpoint
+        # tree gets its own chunk stream so two models publish versions
+        # without touching each other's pools — + the last fanout's
+        # per-server stats for /status.
+        self._own_sources: Dict[str, object] = {}
         self._wp_last: Dict = {}
 
         self._http_loop = asyncio.new_event_loop()
@@ -469,14 +502,80 @@ class GserverManager(Worker):
     # Scheduling / staleness
     # ------------------------------------------------------------------
 
-    def _healthy_urls(self) -> List[str]:
+    def _healthy_urls(self, model: Optional[str] = None) -> List[str]:
         """Routable servers: healthy AND not draining. A draining
         server finishes in-flight work and serves KV pulls, but takes
-        no new routing, no weight fanouts, no re-roles."""
-        return [
+        no new routing, no weight fanouts, no re-roles. With ``model``
+        set, only that model's pool — routing, fanout, drain migration
+        and the autoscaler all pass it in a multi-model fleet, so a
+        model_id mismatch is a routing error, never a silent
+        cross-model KV or weight hit."""
+        urls = [
             u for u in self.server_urls
             if u in self._healthy and u not in self._draining
         ]
+        if model is not None:
+            urls = [u for u in urls if self._model_of(u) == model]
+        return urls
+
+    def _model_of(self, url: str) -> str:
+        """Which registered family ``url`` hosts (heartbeat-learned;
+        defaults to the manager's own model_name for legacy servers
+        that never declared one). getattr default: harness-built
+        instances predating the multi-model plane lack the map."""
+        return getattr(self, "_server_models", {}).get(
+            url, self.cfg.model_name
+        )
+
+    def _model_version(self, model: str) -> int:
+        """Current weight version of one model's pool. The default
+        model reads the legacy scalar (the training plane's staleness
+        gate and lease fencing key off it)."""
+        if model == self.cfg.model_name:
+            return self.weight_version
+        return getattr(self, "_model_versions", {}).get(model, 0)
+
+    def _set_model_version(self, model: str, version: int) -> None:
+        """Record a completed cutover (call under _lock)."""
+        self._model_versions[model] = int(version)
+        if model == self.cfg.model_name:
+            self.weight_version = int(version)
+
+    def _target_version(self, url: str) -> int:
+        """The version a (re)joining server must reach before it
+        routes: its OWN model's current version, not the default
+        model's — resyncing a model-B server to model A's version
+        would be a cross-model weight hit."""
+        return self._model_version(self._model_of(url))
+
+    def _model_watch_list(self) -> List[str]:
+        """Models whose published weight versions this manager watches
+        (check_new_params). Single-model fleets watch only their own
+        model_name — byte-identical legacy behavior."""
+        if not getattr(self.cfg, "multi_model", False):
+            return [self.cfg.model_name]
+        return sorted(self._model_set)
+
+    def _refresh_model_set(self):
+        """Configure-time / poll-thread only (file I/O): fold the
+        registry's ids into the accepted-model set. Ids are only ever
+        ADDED — a registry record disappearing must not orphan a live
+        pool mid-flight."""
+        from areal_tpu.system import model_registry
+
+        try:
+            faults.maybe_fail("manager.model_registry")
+            records = model_registry.list_models(
+                self.cfg.experiment_name, self.cfg.trial_name
+            )
+        except Exception:
+            # A registry-store flake keeps the last good model set:
+            # live pools keep routing, unknown joiners stay
+            # quarantined — never a poll crash or a mass quarantine.
+            return
+        for rec in records.values():
+            self._model_set.add(rec.model_id)
+            self._model_records[rec.model_id] = rec
 
     def _live_urls(self) -> List[str]:
         """Healthy servers INCLUDING draining ones — the metrics /
@@ -531,8 +630,21 @@ class GserverManager(Worker):
         names a server holding the session's KV prefix that is NOT the
         routed server — the client forwards it and the target restores
         over /kv/{manifest,chunk} instead of re-prefilling.
-        (None, 'none', None, None) when the whole fleet is unhealthy."""
+        (None, 'none', None, None) when the whole fleet is unhealthy.
+
+        Multi-model fleets filter candidates to the requested model's
+        pool FIRST — affinity, index, spill, sticky and the base
+        policies all operate inside it, so a session can never land on
+        (or pull KV from) another model's server. An unknown/poolless
+        model routes nowhere: (None, 'no-model-pool', None, None)."""
         candidates = self._healthy_urls()
+        if getattr(self.cfg, "multi_model", False):
+            model = str(meta.get("model") or "") or self.cfg.model_name
+            candidates = [
+                u for u in candidates if self._model_of(u) == model
+            ]
+            if not candidates:
+                return None, "no-model-pool", None, None
         if not candidates:
             return None, "none", None, None
         now = time.monotonic()
@@ -583,7 +695,9 @@ class GserverManager(Worker):
         # no saturation/shed spill, so keep the pre-affinity guard:
         # sticky only while the weight version is unchanged — version
         # bumps are the periodic rebalancing trigger.
-        if prev in pool and prev_version == self.weight_version:
+        if prev in pool and prev_version == self._model_version(
+            self._model_of(prev)
+        ):
             return (
                 prev, "sticky", None,
                 holder if holder and holder != prev else None,
@@ -756,7 +870,7 @@ class GserverManager(Worker):
     _PER_SERVER_SPARSE_MAPS = (
         "_server_free_pages", "_server_total_pages", "_server_kv",
         "_server_elastic", "_server_shards", "_rerole_orig",
-        "_server_ttft_hist", "_server_itl_hist",
+        "_server_ttft_hist", "_server_itl_hist", "_server_models",
     )
 
     def _forget_server(self, url: str, remove: bool = False):
@@ -816,6 +930,7 @@ class GserverManager(Worker):
             getattr(self, attr)[url] = 0.0
         self._server_reqs[url] = 0
         self._server_roles[url] = "unified"
+        self._server_models.setdefault(url, self.cfg.model_name)
         self._server_versions[url] = 0
 
     def _admit_server(self, url: str, member: str, record: Dict):
@@ -828,6 +943,9 @@ class GserverManager(Worker):
         role = record.get("role")
         if role:
             self._server_roles[url] = str(role)
+        mid = record.get("model_id")
+        if mid:
+            self._server_models[url] = str(mid)
         shard = record.get("weight_shard")
         if shard and len(shard) == 2:
             self._server_shards[url] = (int(shard[0]), int(shard[1]))
@@ -850,7 +968,15 @@ class GserverManager(Worker):
             and self._pending_launches
         ):
             self._launched_indices.discard(int(idx))
-            self._pending_launches.pop(0)
+            # Pop the joiner's OWN model's marker (a model-B join must
+            # not un-gate a still-in-flight model-A launch).
+            joined = self._server_models.get(url, self.cfg.model_name)
+            for i, ent in enumerate(self._pending_launches):
+                if ent.get("model") in (None, joined):
+                    self._pending_launches.pop(i)
+                    break
+            else:
+                self._pending_launches.pop(0)
 
     def _mark_unhealthy(self, url: str, reason: str):
         if url not in self.server_urls:
@@ -894,12 +1020,14 @@ class GserverManager(Worker):
             f"({len(self._healthy_urls())}/{len(self.server_urls)} healthy)"
         )
 
-    def _current_param_path(self) -> Optional[str]:
+    def _current_param_path(
+        self, model: Optional[str] = None
+    ) -> Optional[str]:
         path = os.path.join(
             constants.get_param_realloc_path(
                 self.cfg.experiment_name, self.cfg.trial_name
             ),
-            self.cfg.model_name,
+            model or self.cfg.model_name,
         )
         if os.path.exists(os.path.join(path, "engine_state.pkl")):
             return path
@@ -908,10 +1036,12 @@ class GserverManager(Worker):
     def _resync_server(self, url: str) -> bool:
         """Push the current weight version to a returning server before
         it re-enters rotation (server-side is_stale_update makes this a
-        cheap no-op when it already has the version)."""
-        if self.weight_version <= 0:
+        cheap no-op when it already has the version). Targets the
+        server's OWN model's version and checkpoint tree."""
+        target_v = self._target_version(url)
+        if target_v <= 0:
             return True
-        path = self._current_param_path()
+        path = self._current_param_path(self._model_of(url))
         if path is None:
             # Dump GC'd / not yet written: can't prove the server is
             # current, keep it out of rotation until the next fanout.
@@ -924,7 +1054,7 @@ class GserverManager(Worker):
                 async with sess.post(
                     f"{url}/update_weights_from_disk",
                     json={"model_path": path, "allow_interrupt": True,
-                          "version": self.weight_version},
+                          "version": target_v},
                 ) as r:
                     body = await r.json()
                     return bool(body.get("success"))
@@ -940,7 +1070,7 @@ class GserverManager(Worker):
             return False
         if ok:
             with self._lock:
-                self._server_versions[url] = self.weight_version
+                self._server_versions[url] = target_v
         return ok
 
     def _bootstrap_server(self, url: str) -> bool:
@@ -951,7 +1081,7 @@ class GserverManager(Worker):
         plane it falls back to the legacy /update_weights_from_disk
         re-sync. Returns False (stay evicted, retry next health poll)
         on any failure."""
-        if self.weight_version <= 0:
+        if self._target_version(url) <= 0:
             return True
         if getattr(self.cfg, "weight_plane", False):
             try:
@@ -972,12 +1102,15 @@ class GserverManager(Worker):
         poll thread (blocking manifest fetch is fine there)."""
         from areal_tpu.engine.weight_client import fetch_manifest
 
-        version = self.weight_version
+        model = self._model_of(url)
+        version = self._model_version(model)
         t0 = time.monotonic()
         with self._lock:
             shard = self._server_shards.get(url)
+            # Same-MODEL same-shard peers only: a model-B holder at the
+            # right integer version still streams the wrong weights.
             holders = [
-                u for u in self._healthy_urls()
+                u for u in self._healthy_urls(model)
                 if u != url
                 and self._server_shards.get(u) == shard
                 and self._server_versions.get(u, 0) == version
@@ -985,7 +1118,9 @@ class GserverManager(Worker):
         degree = shard[1] if shard else 1
         rank = shard[0] if shard else 0
         wire = getattr(self.cfg, "weight_wire_dtype", None)
-        origin = self._weight_plane_origin(self._current_param_path())
+        origin = self._weight_plane_origin(
+            self._current_param_path(model), model
+        )
         man = None
         if self.cfg.join_bootstrap != "origin":
             for h in holders:
@@ -1097,11 +1232,14 @@ class GserverManager(Worker):
             if self._known_indices else len(self.server_urls)
         )
 
-    def _pick_drain_victim(self) -> Optional[str]:
-        """Least-loaded routable server, never the last one; skip when
-        a disaggregated split would fall below its pool floors."""
+    def _pick_drain_victim(
+        self, model: Optional[str] = None
+    ) -> Optional[str]:
+        """Least-loaded routable server, never the last one (never the
+        last of ITS MODEL's pool when model-scoped); skip when a
+        disaggregated split would fall below its pool floors."""
         with self._lock:
-            cands = self._healthy_urls()
+            cands = self._healthy_urls(model)
             if len(cands) <= 1:
                 return None
             if self._disagg_split(cands):
@@ -1119,18 +1257,53 @@ class GserverManager(Worker):
                     return None
             return min(cands, key=self._load_key)
 
+    def _model_autoscaler(self, model: Optional[str]):
+        """The watermark instance for one pool. The default model (and
+        the single-model fleet, model=None) uses the configured
+        instance; other models get their own lazily — each pool needs
+        its own sustain/cooldown debounce — with floors/ceilings
+        overridden by the model's registry pool policy when set."""
+        if model is None or model == self.cfg.model_name:
+            return self._autoscaler
+        autoscaler = self._autoscalers.get(model)
+        if autoscaler is None:
+            pol = dataclasses.replace(self._autoscaler.policy)
+            rec = self._model_records.get(model)
+            if rec is not None:
+                if rec.min_servers > 0:
+                    pol.pool_min_servers = int(rec.min_servers)
+                if rec.max_servers > 0:
+                    pol.pool_max_servers = int(rec.max_servers)
+            autoscaler = fleet_controller.WatermarkAutoscaler(pol)
+            self._autoscalers[model] = autoscaler
+        return autoscaler
+
     def _maybe_autoscale(self):
         """Watermark autoscaling over the fresh metrics snapshot (rides
         the same poll cadence as the re-role sizer). Scale-out launches
         through the attached launcher; scale-in drains the least-loaded
-        server, which migrates its KV and departs cleanly."""
+        server, which migrates its KV and departs cleanly. Multi-model
+        fleets run one decision per model POOL — model B saturating
+        must grow B's pool, not read A's idle headroom as spare."""
         if self._autoscaler is None:
             return
         if self._launcher is not None:
             self._launcher.reap()
+        if getattr(self.cfg, "multi_model", False):
+            for model in sorted(self._model_set):
+                self._autoscale_pool(model)
+            return
+        self._autoscale_pool(None)
+
+    def _autoscale_pool(self, model: Optional[str]):
+        """One pool's watermark decision (model=None: the whole fleet —
+        the single-model behavior, byte-identical signals)."""
+        autoscaler = self._model_autoscaler(model)
+        if autoscaler is None:
+            return
         now = time.monotonic()
         with self._lock:
-            routable = self._healthy_urls()
+            routable = self._healthy_urls(model)
             queued = sum(
                 self._server_queued_toks.get(u, 0.0) for u in routable
             )
@@ -1140,15 +1313,22 @@ class GserverManager(Worker):
             total = sum(
                 self._server_total_pages.get(u, 0.0) for u in routable
             )
-            joining = [u for u in self._evicted if u in self._join_t0]
+            joining = [
+                u for u in self._evicted
+                if u in self._join_t0
+                and (model is None or self._model_of(u) == model)
+            ]
             # Launches that never registered stop counting as pending
             # after the spawn horizon, or one lost child wedges
             # scale-out forever.
             self._pending_launches = [
-                t for t in self._pending_launches if now - t < 180.0
+                e for e in self._pending_launches if now - e["t"] < 180.0
             ]
-            n_pending = len(joining) + len(self._pending_launches)
-        action = self._autoscaler.observe(
+            n_pending = len(joining) + sum(
+                1 for e in self._pending_launches
+                if model is None or e.get("model") in (None, model)
+            )
+        action = autoscaler.observe(
             len(routable), n_pending, queued,
             free / total if total > 0 else 1.0,
         )
@@ -1161,23 +1341,24 @@ class GserverManager(Worker):
             idx = self._next_server_index()
             self._known_indices.add(idx)
             try:
-                self._launcher.launch(idx)
+                self._launch_indexed(idx, model)
             except Exception:
                 logger.warning("autoscale launch failed", exc_info=True)
                 return
             self._launched_indices.add(idx)
             with self._lock:
-                self._pending_launches.append(now)
+                self._pending_launches.append({"t": now, "model": model})
                 self._scale_log.append({
                     "t": time.time(), "action": "out",
                     "server_index": idx, "queued_tokens": queued,
                     "n_routable": len(routable),
+                    "model": model or self.cfg.model_name,
                 })
                 del self._scale_log[:-32]
             tracing.event("manager.scale_out", server_index=idx,
                           queued_tokens=queued)
         elif action == "in":
-            victim = self._pick_drain_victim()
+            victim = self._pick_drain_victim(model)
             if victim is None:
                 return
             if self._drain_server_sync(
@@ -1188,10 +1369,29 @@ class GserverManager(Worker):
                         "t": time.time(), "action": "in", "url": victim,
                         "queued_tokens": queued,
                         "n_routable": len(routable),
+                        "model": model or self.cfg.model_name,
                     })
                     del self._scale_log[:-32]
                 tracing.event("manager.scale_in", server=victim,
                               queued_tokens=queued)
+
+    def _launch_indexed(self, idx: int, model: Optional[str]):
+        """Launch through the attached launcher, passing the target
+        model when the launcher's spawn path understands it (the
+        subprocess harness and legacy launchers take only the index)."""
+        if model is not None and model != self.cfg.model_name:
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    self._launcher.launch
+                ).parameters
+            except (TypeError, ValueError):
+                params = {}
+            if "model_id" in params:
+                self._launcher.launch(idx, model_id=model)
+                return
+        self._launcher.launch(idx)
 
     def _drain_server_sync(self, url: str, reason: str) -> bool:
         """Poll-thread entry to the drain orchestration (the HTTP POST
@@ -1220,7 +1420,16 @@ class GserverManager(Worker):
             if url in self._draining:
                 return {"success": False,
                         "error": f"{url} is already draining"}
-            migrate = [u for u in self._healthy_urls() if u != url]
+            # Migration targets come from the drainee's OWN model pool:
+            # parking model-A prefixes on a model-B server would hand
+            # returning sessions cross-model KV.
+            migrate = [
+                u for u in self._healthy_urls(
+                    self._model_of(url)
+                    if getattr(self.cfg, "multi_model", False) else None
+                )
+                if u != url
+            ]
             if not migrate:
                 return {"success": False,
                         "error": "cannot drain the last routable server"}
@@ -1303,6 +1512,9 @@ class GserverManager(Worker):
             role = record.get("role")
             if role and url not in self._rerole_orig:
                 self._server_roles[url] = str(role)
+            mid = record.get("model_id")
+            if mid:
+                self._server_models[url] = str(mid)
             shard = record.get("weight_shard")
             if shard and len(shard) == 2:
                 self._server_shards[url] = (int(shard[0]), int(shard[1]))
@@ -1331,6 +1543,26 @@ class GserverManager(Worker):
         # (autoscaler launch, operator scale-out): adopt it into the
         # table; it bootstraps weights before routing.
         for member, url in unknown:
+            # Multi-model gate FIRST — before dead-weight replacement
+            # and before elastic adoption: a beat naming a model_id the
+            # registry has never heard of is QUARANTINED, never adopted.
+            # Re-read the registry once on a miss (the record may have
+            # just landed); routing an unregistered model's server
+            # would risk silent cross-model weight/KV hits.
+            if getattr(self.cfg, "multi_model", False):
+                mid = str(snapshot[member].get("model_id") or "")
+                if mid and mid not in self._model_set:
+                    self._refresh_model_set()
+                if mid and mid not in self._model_set:
+                    if self._quarantined.get(member) != mid:
+                        logger.warning(
+                            f"quarantined joiner {url} ({member}): "
+                            f"heartbeat names unregistered model_id "
+                            f"{mid!r}"
+                        )
+                    self._quarantined[member] = mid
+                    continue
+                self._quarantined.pop(member, None)
             claimed = set(self._member_urls.values())
             dead_weight = sorted(
                 u for u in self.server_urls
@@ -1350,6 +1582,12 @@ class GserverManager(Worker):
                 f"fleet join: adopted {url} ({member}); weight bootstrap "
                 f"pending ({len(self.server_urls)} members)"
             )
+        # A quarantined member that stopped beating leaves the ledger
+        # (it can re-earn a row by beating again post-registration).
+        if self._quarantined:
+            self._quarantined = {
+                m: v for m, v in self._quarantined.items() if m in snapshot
+            }
         # Graceful departures (drain-then-leave): a member that announced
         # a clean stop is REMOVED, not evicted — no failure handling, no
         # readmission. Must run before death detection: a stopped member
@@ -1400,7 +1638,8 @@ class GserverManager(Worker):
             # servers can't make the supervisor hang-kill the manager.
             self._beat()
             if (
-                self._server_versions.get(url, 0) >= self.weight_version
+                self._server_versions.get(url, 0)
+                >= self._target_version(url)
                 or self._bootstrap_server(url)
             ):
                 self._readmit(url)
@@ -1559,11 +1798,24 @@ class GserverManager(Worker):
             qid=qid, kv_source=kv_source or "",
         )
         if url is None:
+            err = "no healthy generation servers"
+            if policy == "no-model-pool":
+                err = (
+                    f"no healthy generation servers for model "
+                    f"{str(meta.get('model') or self.cfg.model_name)!r}"
+                )
             return web.json_response(
-                {"error": "no healthy generation servers", "retry_after": 0.5},
+                {"error": err, "retry_after": 0.5},
                 status=503,
             )
-        resp = {"url": url, "version": self.weight_version, "policy": policy}
+        # The version the client staleness-tracks against is the ROUTED
+        # server's model's version — in a multi-model fleet the default
+        # model's scalar would be the wrong clock for every other pool.
+        resp = {
+            "url": url,
+            "version": self._model_version(self._model_of(url)),
+            "policy": policy,
+        }
         if kv_source is not None:
             # Global-prefix-index hint: a DIFFERENT server holds this
             # session's KV — the client forwards kv_source into
@@ -1749,9 +2001,28 @@ class GserverManager(Worker):
                 "drains": list(self._drain_log),
                 "autoscale": list(self._scale_log),
             }
+            # Multi-model serving plane: per-model pool membership +
+            # each pool's OWN weight version (two models cut over
+            # independently; the top-level weight_version stays the
+            # default model's for legacy readers), and the quarantine
+            # ledger (member -> the unregistered model_id it beat with).
+            model_pools: Dict[str, Dict] = {}
+            for u in self.server_urls:
+                mid = self._model_of(u)
+                row = model_pools.setdefault(mid, {
+                    "servers": [],
+                    "healthy": [],
+                    "version": self._model_version(mid),
+                })
+                row["servers"].append(u)
+                if u in healthy:
+                    row["healthy"].append(u)
+            quarantined = dict(self._quarantined)
         return web.json_response(
             {
                 "pools": pools,
+                "models": model_pools,
+                "quarantined": quarantined,
                 "kv_tier": kv_tier,
                 "fleet": fleet,
                 "weight_version": self.weight_version,
@@ -1930,49 +2201,76 @@ class GserverManager(Worker):
     # ------------------------------------------------------------------
 
     def check_new_params(self) -> Optional[str]:
-        try:
-            v = int(
-                name_resolve.get(
-                    names.model_version(
-                        self.cfg.experiment_name,
-                        self.cfg.trial_name,
-                        self.cfg.model_name,
+        """Scan the watched models' published version pointers for one
+        that moved. Single-model fleets watch only their own
+        model_name; multi-model fleets watch every registered id —
+        each model's version lives under its OWN names.model_version
+        key, so two models publish (and the manager cuts over)
+        independently. Sets ``_new_version`` AND ``_new_model`` so the
+        fanout targets the right pool."""
+        for model in self._model_watch_list():
+            try:
+                v = int(
+                    name_resolve.get(
+                        names.model_version(
+                            self.cfg.experiment_name,
+                            self.cfg.trial_name,
+                            model,
+                        )
                     )
                 )
-            )
-        except (name_resolve.NameEntryNotFoundError, ValueError):
-            return None
-        if v <= self.weight_version:
-            return None
-        path = self._current_param_path()
-        if path is None:
-            return None
-        self._new_version = v
-        return path
+            except (name_resolve.NameEntryNotFoundError, ValueError):
+                continue
+            if v <= self._model_version(model):
+                continue
+            if (
+                model != self.cfg.model_name
+                and not self._healthy_urls(model)
+            ):
+                # A non-default model with no routable pool (yet): skip
+                # it rather than let its fanout fail-and-retry wedge
+                # the scan ahead of models with live pools. The default
+                # model keeps the legacy behavior (fanout into an
+                # unhealthy fleet raises and retries — that IS the
+                # signal the trainer waits on).
+                continue
+            path = self._current_param_path(model)
+            if path is None:
+                continue
+            self._new_version = v
+            self._new_model = model
+            return path
+        return None
 
     # ------------------------------------------------------------------
     # Weight-distribution plane (system/weight_plane.py)
     # ------------------------------------------------------------------
 
-    def _weight_plane_origin(self, path: str) -> Optional[str]:
-        """The plane's origin URL, or None when the plane is disabled.
-        Prefers a trainer-side source registered in name_resolve (the
-        dump rank serving its own tmpfs/disk bytes); falls back to a
+    def _weight_plane_origin(
+        self, path: str, model: Optional[str] = None
+    ) -> Optional[str]:
+        """The plane's origin URL for ``model`` (default: the manager's
+        own model_name), or None when the plane is disabled. Prefers a
+        trainer-side source registered in name_resolve (the dump rank
+        serving its own tmpfs/disk bytes); falls back to a
         manager-hosted source over the NFS dump dir — still O(1) NFS
         reads per version (one streaming read here) vs the legacy
-        O(n_servers) full re-reads."""
+        O(n_servers) full re-reads. Sources are PER MODEL: each model's
+        checkpoint tree gets its own chunk stream, so one model's
+        publish never serves bytes into another's pool."""
         if not getattr(self.cfg, "weight_plane", False):
             return None
+        model = model or self.cfg.model_name
         try:
             return name_resolve.get(
                 names.weight_plane_source(
                     self.cfg.experiment_name, self.cfg.trial_name,
-                    self.cfg.model_name,
+                    model,
                 )
             )
         except name_resolve.NameEntryNotFoundError:
             pass
-        if self._own_source is None:
+        if self._own_sources.get(model) is None:
             if path is None:
                 # No trainer-side source registered and no dump on disk
                 # to self-host one over (e.g. a bootstrap while the
@@ -1983,16 +2281,16 @@ class GserverManager(Worker):
 
             # Bind the routable interface, not the 127.0.0.1 default:
             # this URL is handed to generation servers on OTHER hosts.
-            self._own_source = WeightPlaneSource(
+            self._own_sources[model] = WeightPlaneSource(
                 path, chunk_bytes=self.cfg.weight_chunk_bytes,
                 host=network.gethostip(),
             ).start()
             logger.info(
-                f"weight plane: no trainer-side source registered; "
-                f"manager-hosted origin at {self._own_source.address} "
-                f"over {path}"
+                f"weight plane: no trainer-side source registered for "
+                f"{model!r}; manager-hosted origin at "
+                f"{self._own_sources[model].address} over {path}"
             )
-        return self._own_source.address
+        return self._own_sources[model].address
 
     def _fetch_plane_manifest(
         self, origin: str, version: int,
@@ -2134,10 +2432,16 @@ class GserverManager(Worker):
 
         t_start = time.monotonic()
         version = self._new_version
-        targets = self._healthy_urls()
+        model = self._new_model
+        # Fanout targets are the publishing model's OWN pool: model A's
+        # cutover must never interrupt (or restream into) model B.
+        targets = self._healthy_urls(
+            model if getattr(self.cfg, "multi_model", False) else None
+        )
         if not targets:
             raise RuntimeError(
-                "weight-plane fanout: no healthy generation servers"
+                f"weight-plane fanout: no healthy generation servers "
+                f"for model {model!r}"
             )
         fanout_span = tracing.start_span(
             "manager.weight_update", version=version,
@@ -2297,13 +2601,14 @@ class GserverManager(Worker):
         for u, reason in failures.items():
             self._mark_unhealthy(u, f"weight plane: {reason}")
         with self._lock:
-            self.weight_version = version
+            self._set_model_version(model, version)
             for u in successes:
                 self._server_versions[u] = version
             self.last_weight_sync_s = time.monotonic() - t_start
             any_man = next(iter(plans.values()))["man"]
             self._wp_last = {
                 "version": version,
+                "model": model,
                 "origin": origin,
                 "tree": [[[u, p] for u, p, _ in w] for w in waves],
                 # Sum-of-streams view so the pair stays coherent:
@@ -2348,15 +2653,21 @@ class GserverManager(Worker):
 
         With the weight plane enabled this dispatches to the streaming
         tree fanout instead; the legacy NFS broadcast below stays both
-        as the default and as the re-sync path's mechanism."""
-        origin = self._weight_plane_origin(path)
+        as the default and as the re-sync path's mechanism. In a
+        multi-model fleet both paths target only the publishing model's
+        pool (check_new_params recorded it in ``_new_model``)."""
+        model = self._new_model
+        origin = self._weight_plane_origin(path, model)
         if origin is not None:
             return self._plane_update_weights(origin)
         t_start = time.monotonic()
-        targets = self._healthy_urls()
+        targets = self._healthy_urls(
+            model if getattr(self.cfg, "multi_model", False) else None
+        )
         if not targets:
             raise RuntimeError(
-                "weight-update fanout: no healthy generation servers"
+                f"weight-update fanout: no healthy generation servers "
+                f"for model {model!r}"
             )
         load_stats: list = []
         successes: List[str] = []
@@ -2420,7 +2731,7 @@ class GserverManager(Worker):
         for u, reason in failures.items():
             self._mark_unhealthy(u, f"weight update failed: {reason}")
         with self._lock:
-            self.weight_version = self._new_version
+            self._set_model_version(model, self._new_version)
             for u in successes:
                 self._server_versions[u] = self._new_version
             self.last_weight_sync_s = time.monotonic() - t_start
@@ -2428,13 +2739,13 @@ class GserverManager(Worker):
         # <3 s/transfer, blog/AReaL_v0_2.md:52-54) — always logged.
         if failures:
             logger.warning(
-                f"degraded weight-update fanout to v{self.weight_version}: "
+                f"degraded weight-update fanout to v{self._new_version}: "
                 f"{len(successes)}/{len(targets)} servers in "
                 f"{self.last_weight_sync_s:.3f}s; evicted {sorted(failures)}"
             )
         else:
             logger.info(
-                f"all servers updated to weight version {self.weight_version} "
+                f"all servers updated to weight version {self._new_version} "
                 f"in {self.last_weight_sync_s:.3f}s "
                 f"(loads: {', '.join(f'{s} {t:.3f}s' for s, t in load_stats)})"
             )
@@ -2658,6 +2969,11 @@ class GserverManager(Worker):
 
         # Health registry: evict dead servers, readmit returning ones.
         if time.monotonic() - self._last_health_poll > self.cfg.health_check_interval:
+            if getattr(self.cfg, "multi_model", False):
+                # Registry re-read on the same cadence (one subtree
+                # walk): models registered after boot enter the watch
+                # list and the adoption gate without a restart.
+                self._refresh_model_set()
             try:
                 self._poll_health()
             except Exception:
@@ -2741,8 +3057,9 @@ class GserverManager(Worker):
 
     def _exit_hook(self):
         try:
-            if self._own_source is not None:
-                self._own_source.close()
+            for src in self._own_sources.values():
+                if src is not None:
+                    src.close()
             self._http_loop.call_soon_threadsafe(self._http_loop.stop)
             self._http_thread.join(timeout=5)
         except Exception:

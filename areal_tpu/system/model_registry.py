@@ -1,0 +1,222 @@
+"""Model registry: the discovery-plane source of truth for WHICH model
+families a fleet serves (ROADMAP item 6, multi-model serving plane).
+
+Every subsystem below the gateway used to assume exactly one model per
+fleet. The registry makes "which model" a first-class runtime
+dimension: one ``MODEL_REGISTRY_V1`` JSON record per served family
+lives in name_resolve under ``names.model_registry(exp, trial,
+model_id)``, carrying the model's config hash, family/tokenizer
+metadata, and pool policy. Consumers:
+
+- The **gserver manager** builds its per-model pool map from
+  ``list_models`` at configure time and re-reads it when an unknown
+  ``model_id`` beats: a heartbeat naming a REGISTERED model joins that
+  model's pool; one naming an unregistered id is QUARANTINED — never
+  adopted — because routing it would risk silent cross-model KV or
+  weight hits (`test_model_registry.py` pins this).
+- The **gateway** resolves the OpenAI ``"model"`` request field and
+  per-tenant entitlements against registered ids (unknown → 404,
+  unentitled → 403).
+- The **weight plane** stays keyed by model name
+  (``names.model_version`` / ``names.weight_plane_source`` already
+  are); the registry's ``current_weight_version`` helper reads that
+  same pointer so two models publish versions independently.
+
+Records are written with ``delete_on_exit=False``: registration is a
+deployment act that must survive the registering process — like the
+manager lease, not like a heartbeat. Duplicate registration of a
+``model_id`` is REFUSED (``DuplicateModelError``) unless the new
+record's config hash matches the existing one (an idempotent re-run of
+the same deployment is not a conflict).
+
+Poll-thread / configure-time only: every function here does
+name_resolve file I/O (the areal-lint blocking-async contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from areal_tpu.base import name_resolve, names
+from areal_tpu.base.wire_schemas import MODEL_REGISTRY_V1
+
+# model_id becomes a name_resolve path segment, a metrics label, a
+# weight-plane namespace, and a gateway wire field — keep it to a
+# conservative charset so no consumer needs escaping.
+_MODEL_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+class DuplicateModelError(Exception):
+    """A different record already holds this model_id."""
+
+
+class UnknownModelError(Exception):
+    """No registry record exists for this model_id."""
+
+
+def config_hash(model_config: Any) -> str:
+    """Canonical short hash of a model config (dict / dataclass /
+    anything json-able): the registry's identity check for idempotent
+    re-registration, and what the bench record pins so two 'families'
+    in a parity run are provably different configs."""
+    if dataclasses.is_dataclass(model_config) and not isinstance(
+        model_config, type
+    ):
+        model_config = dataclasses.asdict(model_config)
+    blob = json.dumps(model_config, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class ModelRecord:
+    """One served model family, as registered.
+
+    ``pool_policy`` is advisory capacity intent for the model-scoped
+    autoscaler: ``min_servers`` is the floor a pool must keep even when
+    idle; ``max_servers`` (0 = fleet default) caps its growth.
+    """
+
+    model_id: str
+    family: str                 # engine family, e.g. "tpu_transformer"
+    config_hash: str            # config_hash(model config)
+    tokenizer: str = ""         # tokenizer family/path metadata
+    pool_policy: str = "shared"  # "shared" | "reserved"
+    min_servers: int = 1
+    max_servers: int = 0
+    ts: float = 0.0
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        d["schema"] = MODEL_REGISTRY_V1
+        return json.dumps(d, sort_keys=True, separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: str) -> Optional["ModelRecord"]:
+        try:
+            d = json.loads(raw)
+        except ValueError:
+            return None
+        if d.get("schema") != MODEL_REGISTRY_V1:
+            return None
+        try:
+            return cls(
+                model_id=str(d["model_id"]),
+                family=str(d.get("family", "")),
+                config_hash=str(d.get("config_hash", "")),
+                tokenizer=str(d.get("tokenizer", "")),
+                pool_policy=str(d.get("pool_policy", "shared")),
+                min_servers=int(d.get("min_servers", 1)),
+                max_servers=int(d.get("max_servers", 0)),
+                ts=float(d.get("ts", 0.0)),
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+
+
+def validate_model_id(model_id: str) -> str:
+    if not _MODEL_ID_RE.match(model_id or ""):
+        raise ValueError(
+            f"invalid model_id {model_id!r}: must match "
+            f"{_MODEL_ID_RE.pattern} (it becomes a name_resolve path "
+            f"segment and a wire field)"
+        )
+    return model_id
+
+
+def register_model(
+    experiment_name: str,
+    trial_name: str,
+    record: ModelRecord,
+) -> ModelRecord:
+    """Register one model family; refuses a CONFLICTING duplicate.
+
+    Same model_id + same config hash is an idempotent re-run (returns
+    the existing record untouched); same model_id with a different
+    hash raises ``DuplicateModelError`` — two deployments disagreeing
+    about what a model_id means is exactly the confusion the registry
+    exists to refuse.
+    """
+    validate_model_id(record.model_id)
+    if record.ts <= 0.0:
+        record = dataclasses.replace(record, ts=time.time())
+    key = names.model_registry(
+        experiment_name, trial_name, record.model_id
+    )
+    try:
+        name_resolve.add(
+            key, record.to_json(), delete_on_exit=False, replace=False
+        )
+        return record
+    except name_resolve.NameEntryExistsError:
+        existing = get_model(experiment_name, trial_name, record.model_id)
+        if existing is not None and existing.config_hash == record.config_hash:
+            return existing
+        raise DuplicateModelError(
+            f"model_id {record.model_id!r} already registered with "
+            f"config hash {existing.config_hash if existing else '?'} "
+            f"(attempted {record.config_hash}); unregister it first if "
+            f"this is an intentional replacement"
+        ) from None
+
+
+def unregister_model(
+    experiment_name: str, trial_name: str, model_id: str
+) -> None:
+    try:
+        name_resolve.delete(
+            names.model_registry(experiment_name, trial_name, model_id)
+        )
+    except name_resolve.NameEntryNotFoundError:
+        pass
+
+
+def get_model(
+    experiment_name: str, trial_name: str, model_id: str
+) -> Optional[ModelRecord]:
+    try:
+        raw = name_resolve.get(
+            names.model_registry(experiment_name, trial_name, model_id)
+        )
+    except name_resolve.NameEntryNotFoundError:
+        return None
+    return ModelRecord.from_json(raw)
+
+
+def list_models(
+    experiment_name: str, trial_name: str
+) -> Dict[str, ModelRecord]:
+    """All registered families, model_id -> record (malformed or
+    wrong-schema records are skipped, not fatal — one bad write must
+    not unroute every model)."""
+    root = names.model_registry_root(experiment_name, trial_name)
+    out: Dict[str, ModelRecord] = {}
+    try:
+        raws: List[str] = name_resolve.get_subtree(root)
+    except name_resolve.NameEntryNotFoundError:
+        return out
+    for raw in raws:
+        rec = ModelRecord.from_json(raw)
+        if rec is not None and _MODEL_ID_RE.match(rec.model_id):
+            out[rec.model_id] = rec
+    return out
+
+
+def current_weight_version(
+    experiment_name: str, trial_name: str, model_id: str
+) -> Optional[int]:
+    """The model's live weight-version pointer — read from the SAME
+    ``names.model_version`` key the trainer publishes and the manager
+    watches, so the registry never forks the version source of truth."""
+    try:
+        return int(
+            name_resolve.get(
+                names.model_version(experiment_name, trial_name, model_id)
+            )
+        )
+    except (name_resolve.NameEntryNotFoundError, ValueError):
+        return None

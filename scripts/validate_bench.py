@@ -119,6 +119,26 @@ PHASE_VALUE_KEYS: Dict[str, tuple] = {
         "drain_lost",
         "kv_prefix_lost",
         "n_servers_max",
+        "autoscale_out_actions",
+        "autoscale_launched",
+        "autoscale_n_after",
+        "autoscale_load_failed",
+    ),
+    # Multi-model evidence is only evidence with its isolation and
+    # independence accounting next to the latency pair: a clean B-side
+    # p99 with a contaminated parity row, a cross-model route/KV hit,
+    # or a steady pool whose version (or outputs) moved during the
+    # other model's cutover is the exact failure the phase refuses.
+    "multi_model_serving": (
+        "n_models", "families_distinct",
+        "parity_mismatches", "cross_model_routes", "cross_model_kv_hits",
+        "unknown_model_rejected", "unknown_model_routed",
+        "cutover_version_before", "cutover_version_after",
+        "steady_version_after", "steady_outputs_stable",
+        "cutover_outputs_changed",
+        "b_completed", "b_failed",
+        "b_p99_ttft_base_ms", "b_p99_ttft_cutover_ms",
+        "kv_prefix_lost",
     ),
     # Gateway fairness evidence is only evidence as the full A/B/C
     # triple with its shed and queue accounting: a good-looking fair-arm
@@ -504,6 +524,124 @@ def _validate_fleet_elastic(val: Dict) -> List[str]:
         problems.append(
             "fleet_elastic: fleet never grew past its launch size — "
             "no runtime join was measured"
+        )
+    # The autoscale arm's growth must be AUTOSCALER-driven: the
+    # WatermarkAutoscaler issues the launch through its attached
+    # launcher. Growth the launcher cannot account for means the
+    # harness grew the fleet and the record proves nothing about the
+    # control loop.
+    if (_num(val, "autoscale_out_actions") or 0) < 1:
+        problems.append(
+            "fleet_elastic: the autoscaler never issued a scale-out — "
+            "the watermark control loop was not exercised"
+        )
+    if (_num(val, "autoscale_launched") or 0) < 1:
+        problems.append(
+            "fleet_elastic: the autoscaler's launcher launched nothing "
+            "— any growth was harness-driven"
+        )
+    n_before = _num(val, "autoscale_n_before") or 1
+    n_after = _num(val, "autoscale_n_after") or 0
+    if n_after <= n_before:
+        problems.append(
+            f"fleet_elastic: autoscale pool never grew "
+            f"({n_before:.0f} -> {n_after:.0f})"
+        )
+    if n_after - n_before > (_num(val, "autoscale_launched") or 0):
+        problems.append(
+            "fleet_elastic: autoscale pool grew beyond what the "
+            "launcher launched — harness-driven growth is not "
+            "autoscaler evidence"
+        )
+    auto_failed = _num(val, "autoscale_load_failed")
+    if auto_failed is None or auto_failed > 0:
+        problems.append(
+            f"fleet_elastic: {auto_failed} failed request(s) under the "
+            f"autoscale arm's pressure load — scale-out must be "
+            f"loss-free"
+        )
+    return problems
+
+
+def _validate_multi_model_serving(val: Dict) -> List[str]:
+    """The multi-model serving plane's contract (ISSUE 20): pools are
+    ISOLATED (parity per pool vs single-model baselines, zero
+    cross-model routes or KV hits, unknown models refused) and weight
+    lifecycles are INDEPENDENT (one family cuts over while the other's
+    version, outputs, and tail latency hold, loss-free)."""
+    problems: List[str] = []
+    if (_num(val, "families_distinct") or 0) != 1:
+        problems.append(
+            "multi_model_serving: the two families share a config hash "
+            "— contamination would be token-invisible"
+        )
+    for k, what in (
+        ("parity_mismatches",
+         "pool outputs diverged from the single-model baseline"),
+        ("cross_model_routes",
+         "a request routed outside its model's pool"),
+        ("cross_model_kv_hits",
+         "a KV source crossed a model boundary"),
+        ("unknown_model_routed",
+         "an unregistered model was routed instead of refused"),
+    ):
+        v = _num(val, k)
+        if v is None or v > 0:
+            problems.append(f"multi_model_serving: {k} = {v} — {what}")
+    if (_num(val, "unknown_model_rejected") or 0) < 1:
+        problems.append(
+            "multi_model_serving: the unknown-model refusal was never "
+            "observed — the negative arm did not run"
+        )
+    before = _num(val, "cutover_version_before") or 0
+    if (_num(val, "cutover_version_after") or 0) <= before:
+        problems.append(
+            "multi_model_serving: the cutover family's version never "
+            "advanced — no independent cutover was measured"
+        )
+    if (_num(val, "steady_version_after") or 0) != before:
+        problems.append(
+            f"multi_model_serving: steady_version_after = "
+            f"{val.get('steady_version_after')} — the OTHER family's "
+            f"cutover moved the steady pool's version"
+        )
+    if (_num(val, "steady_outputs_stable") or 0) != 1:
+        problems.append(
+            "multi_model_serving: the steady family's greedy outputs "
+            "changed across the other family's cutover — cross-model "
+            "weight contamination"
+        )
+    if (_num(val, "cutover_outputs_changed") or 0) != 1:
+        problems.append(
+            "multi_model_serving: the cutover family's outputs did not "
+            "change at v2 — the 'cutover' never actually swapped "
+            "weights"
+        )
+    b_failed = _num(val, "b_failed")
+    if b_failed is None or b_failed > 0:
+        problems.append(
+            f"multi_model_serving: {b_failed} failed steady-family "
+            f"request(s) during the cutover — the independent-"
+            f"lifecycle claim requires zero"
+        )
+    if (_num(val, "b_completed") or 0) < 1:
+        problems.append(
+            "multi_model_serving: zero steady-family completions "
+            "during the cutover window — nothing was measured"
+        )
+    b_base = _num(val, "b_p99_ttft_base_ms") or 0.0
+    b_cut = _num(val, "b_p99_ttft_cutover_ms")
+    if b_cut is None or b_cut > 5.0 * b_base + 500.0:
+        problems.append(
+            f"multi_model_serving: steady-family p99 TTFT went "
+            f"{b_base:.0f}ms -> {b_cut}ms across the cutover — the "
+            f"other family's fanout stalled this pool"
+        )
+    lost = _num(val, "kv_prefix_lost")
+    if lost is None or lost > 0:
+        problems.append(
+            f"multi_model_serving: kv_prefix_lost = {lost} — the "
+            f"cutover must never cost a prefix"
         )
     return problems
 
@@ -931,6 +1069,8 @@ def validate_phase_value(name: str, rec: Dict) -> List[str]:
         problems.extend(_validate_sessions_resident(val))
     if name == "fleet_elastic":
         problems.extend(_validate_fleet_elastic(val))
+    if name == "multi_model_serving":
+        problems.extend(_validate_multi_model_serving(val))
     if name == "rpc_resilience":
         problems.extend(_validate_rpc_resilience(val))
     if name == "tenant_fairness":

@@ -46,6 +46,39 @@ def test_pack_unpack_roundtrip_and_hash_authority():
         kvh.check_geometry(meta_bad, _Cfg)
 
 
+def test_fp8_wire_roundtrip_ratio_and_precision():
+    """The e4m3 spill/handoff wire (ISSUE 20 satellite): 1-byte data +
+    float32 per-(L,H,token) scales — the same layout as the int8 wire,
+    so bytes/token stays at its 0.31x of float32 for head_dim 16 — and
+    the absmax normalization keeps e4m3 relative precision per vector
+    through unpack_kv_float."""
+    rng = np.random.RandomState(1)
+    # Magnitudes spanning three decades across tokens: the per-token
+    # scales, not the e4m3 exponent alone, must absorb the dynamic
+    # range.
+    mags = np.logspace(-2.0, 1.0, 5)[None, None, :, None]
+    k = (rng.randn(2, 1, 5, 16) * mags).astype(np.float32)
+    v = (rng.randn(2, 1, 5, 16) * mags).astype(np.float32)
+    kw, ks = kvh.quantize_kv_fp8(k)
+    vw, vs = kvh.quantize_kv_fp8(v)
+    assert kw.dtype.name == "float8_e4m3fn"
+    assert ks.dtype == np.float32 and ks.shape == (2, 1, 5)
+    segments, chunks, payload = kvh.pack_arrays([
+        ("k_data", kw), ("k_scales", ks),
+        ("v_data", vw), ("v_scales", vs),
+    ])
+    _, _, payload_f32 = kvh.pack_arrays([("k", k), ("v", v)])
+    assert len(payload) <= 0.32 * len(payload_f32)
+    meta = kvh.build_meta("q0", 3, [1, 2, 3, 4, 5], "fp8", _Cfg,
+                          segments, chunks)
+    k2, v2 = kvh.unpack_kv_float(meta, payload)
+    for orig, back in ((k, k2), (v, v2)):
+        vec_max = np.max(np.abs(orig), axis=-1, keepdims=True)
+        assert np.all(
+            np.abs(back - orig) <= 0.07 * np.abs(orig) + 2e-3 * vec_max
+        )
+
+
 def _mk_engine(params, **kw):
     from areal_tpu.engine.serving import ServingEngine
 

@@ -241,6 +241,63 @@ def test_int8_spill_halves_tier_bytes_vs_float(tiny_params):
         q.stop()
 
 
+def test_fp8_spill_wire_ratio_and_greedy_parity(tiny_params):
+    """kv_spill_dtype='fp8' (e4m3 wire, ISSUE 20 satellite): the
+    spilled payload is byte-for-byte the int8 wire's size (1-byte data
+    + float32 per-token scales — bytes/token never exceeds int8's
+    0.31x-of-float32 ratio at head_dim 16), and the two decoders of
+    the wire — restore_from_tier and import_kv_handoff — yield
+    token-identical greedy continuations (the fp8 wire is lossy, so
+    THIS is the restore-parity invariant: one blob, one decode, no
+    path-dependent drift)."""
+    from areal_tpu.engine.serving import GenRequest
+
+    q8 = _mk_engine(tiny_params, prefix_cache_tokens=16,
+                    kv_tier_bytes=1 << 20, kv_spill_dtype="int8",
+                    seed=5)
+    f8 = _mk_engine(tiny_params, prefix_cache_tokens=16,
+                    kv_tier_bytes=1 << 20, kv_spill_dtype="fp8",
+                    seed=5)
+    dec = _mk_engine(tiny_params, prefix_cache_tokens=4096, seed=5)
+    try:
+        outs = {}
+        for tag, eng in (("int8", q8), ("fp8", f8)):
+            outs[tag] = run_requests(eng, [GenRequest(
+                qid="b0", input_ids=list(PROMPT), max_new_tokens=4,
+                greedy=True,
+            )])["b0"]
+            _wait_spill(eng)
+        b8 = q8.kv_tier.get("b0", count=False)
+        bf = f8.kv_tier.get("b0", count=False)
+        assert b8[0]["kv_wire"] == "int8"
+        assert bf[0]["kv_wire"] == "fp8"
+        assert len(bf[1]) == len(b8[1]), (len(bf[1]), len(b8[1]))
+
+        # Same blob through the handoff-import decoder on a fresh
+        # engine (get(count=False) peeks; the tier copy survives for
+        # the restore below).
+        r1 = outs["fp8"]
+        cont = list(PROMPT) + r1.output_ids
+        dec.import_kv_handoff(bf[0], bf[1])
+        r2_import = run_requests(dec, [GenRequest(
+            qid="b0", input_ids=cont, max_new_tokens=4, greedy=True,
+            priority=0,
+        )])["b0"]
+        assert dec.prefix_cache_hits == 1
+
+        assert f8.restore_from_tier("b0", cont) >= len(PROMPT)
+        r2_restore = run_requests(f8, [GenRequest(
+            qid="b0", input_ids=cont, max_new_tokens=4, greedy=True,
+            priority=0,
+        )])["b0"]
+        assert f8.prefix_cache_hits == 1
+        assert r2_restore.output_ids == r2_import.output_ids
+    finally:
+        q8.stop()
+        f8.stop()
+        dec.stop()
+
+
 def test_export_handoff_falls_back_to_tier_after_eviction(tiny_params):
     """The old evicted-before-export silent-loss window: with the tier
     armed the export serves the spilled blob instead of raising — and a

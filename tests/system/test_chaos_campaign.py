@@ -333,6 +333,56 @@ def _drive_manager_plane_fanout(tmp_path, monkeypatch):
     _fired("manager.plane_fanout")
 
 
+@_fast("manager.model_registry")
+def _drive_manager_model_registry(tmp_path, monkeypatch):
+    """The registry read flakes during the multi-model refresh: the
+    accepted-model set must stay at its LAST GOOD value — never a
+    poll-thread crash, never a mass quarantine of registered models —
+    and the very next refresh (store recovered) folds in whatever
+    registered during the outage."""
+    from areal_tpu.api.system_api import GserverManagerConfig
+    from areal_tpu.base import name_resolve
+    from areal_tpu.system import model_registry
+    from areal_tpu.system.gserver_manager import GserverManager
+
+    repo = name_resolve.reconfigure(
+        "nfs", record_root=str(tmp_path / "name_resolve")
+    )
+    try:
+        exp, trial = "campaign-registry", "t0"
+
+        def _rec(mid, cfg):
+            return model_registry.ModelRecord(
+                model_id=mid, family="tpu_transformer",
+                config_hash=model_registry.config_hash(cfg),
+            )
+
+        model_registry.register_model(exp, trial, _rec("actor", {"l": 2}))
+        m = object.__new__(GserverManager)
+        m.cfg = GserverManagerConfig(
+            experiment_name=exp, trial_name=trial, n_servers=1,
+            train_batch_size=4, multi_model=True,
+        )
+        m._model_set = {"actor"}
+        m._model_records = {}
+        # A second model registers, then the store flakes mid-read.
+        model_registry.register_model(exp, trial, _rec("scout", {"l": 3}))
+        faults.arm(
+            "manager.model_registry", action="raise", at_hit=1, times=1
+        )
+        m._refresh_model_set()
+        _fired("manager.model_registry")
+        # Last good value: the live pool is not orphaned, the
+        # not-yet-seen model is not adopted on garbage data.
+        assert m._model_set == {"actor"}
+        # Store recovered: the next refresh converges.
+        m._refresh_model_set()
+        assert m._model_set == {"actor", "scout"}
+        assert set(m._model_records) == {"actor", "scout"}
+    finally:
+        repo.reset()
+
+
 @_fast("worker.poll")
 def _drive_worker_poll(tmp_path, monkeypatch):
     """A worker's poll loop dies: the contract is a LOUD prompt death

@@ -187,6 +187,8 @@ def _manager():
     m._server_itl_hist = {}
     m._server_roles = {u: "unified" for u in m.server_urls}
     m._server_shards = {A: (0, 2), B: (1, 2)}
+    # Multi-model plane (ISSUE 20): one more per-server sparse map.
+    m._server_models = {u: "actor" for u in m.server_urls}
     m._server_versions = {u: 7 for u in m.server_urls}
     m._member_urls = {"generation_server/0": A, "generation_server/1": B}
     m._rerole_orig = {}

@@ -61,6 +61,12 @@ def _fake_record():
         "drain_resumed_sessions": 3.0,
         "kv_accepted": 3.0,
         "kv_prefix_lost": 0.0,
+        "autoscale_n_before": 1.0,
+        "autoscale_n_after": 2.0,
+        "autoscale_out_actions": 1.0,
+        "autoscale_launched": 1.0,
+        "autoscale_grow_ms": 8000.0,
+        "autoscale_load_failed": 0.0,
     }
 
 
@@ -100,6 +106,26 @@ def test_validator_teeth_for_fleet_elastic():
     # Missing required numerics.
     assert any("killover_recovery_ms" in p
                for p in probs(killover_recovery_ms=None))
+    # Autoscale-arm growth must be AUTOSCALER-driven, attributable to
+    # the attached launcher, and loss-free — harness-driven growth
+    # (more servers than the launcher launched, or zero launcher
+    # actions) is refused.
+    assert any("scale-out" in p for p in probs(autoscale_out_actions=0.0))
+    assert any(
+        "harness-driven" in p for p in probs(autoscale_launched=0.0)
+    )
+    assert any(
+        "harness-driven" in p
+        for p in probs(autoscale_n_after=3.0, autoscale_launched=1.0)
+    )
+    assert any("never grew" in p for p in probs(autoscale_n_after=1.0))
+    assert any(
+        "loss-free" in p for p in probs(autoscale_load_failed=2.0)
+    )
+    assert any(
+        "autoscale_load_failed" in p
+        for p in probs(autoscale_load_failed=None)
+    )
 
 
 @pytest.mark.slow  # ~300 s: one fleet, six server spawns, two manager

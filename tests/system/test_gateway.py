@@ -251,7 +251,7 @@ def test_usage_ledger_compaction(tmp_path):
     # The seen-set is bounded by the recent-rid window (+agg markers).
     assert len(led._seen) <= UsageLedger.SEEN_WINDOW + led.compactions
     pre_row = {k: (list(v) if isinstance(v, list) else v)
-               for k, v in led._rows["acme"].items()}
+               for k, v in led._rows[("acme", "")].items()}
     led.close()
 
     # Restart: replay of the compacted journal reconstructs identical
@@ -262,7 +262,7 @@ def test_usage_ledger_compaction(tmp_path):
     assert after["sheds"] == 1
     assert after["prompt_tokens"] == 300
     assert after["completion_tokens"] == 150
-    assert led2._rows["acme"] == pre_row
+    assert led2._rows[("acme", "")] == pre_row
     led2.close()
 
 
@@ -643,6 +643,64 @@ def test_operator_surfaces_token_gated(tmp_path, memory_nr):
         status, text = _get("/metrics", headers=tok)
         assert status == 200
         assert "areal:gw_requests_total" in text
+    finally:
+        svc.stop()
+        stub.stop()
+
+
+def test_model_resolution_404_403_and_per_model_usage(
+        tmp_path, memory_nr):
+    """Multi-model front door (ISSUE 20): the OpenAI "model" field
+    resolves against the served set (unknown -> 404) and the tenant's
+    entitlements (unentitled -> 403); an absent field maps to the
+    DEFAULT model (first of --models); and the ledger keeps exact
+    per-(tenant, model) sub-rows — a tenant never accrues a row for a
+    model it was refused."""
+    stub = _StubUpstream()
+    stub.start()
+    svc = _svc(
+        "ta:sk-ta:1:100000:200000:4:alpha,"
+        "tb:sk-tb:1:100000:200000:4:beta",
+        tmp_path, manager_addr=stub.address, model_spec="alpha,beta",
+    )
+    url = svc.start()
+    try:
+        def body(model=None):
+            b = {"prompt": "hi", "max_tokens": 2, "stream": False}
+            if model is not None:
+                b["model"] = model
+            return b
+
+        # Entitled requests land (explicit model and the default-model
+        # mapping for an absent field).
+        status, _, text = _post(f"{url}/v1/completions", body("alpha"),
+                                key="sk-ta")
+        assert status == 200, text
+        assert json.loads(text)["model"] == "alpha"
+        status, _, text = _post(f"{url}/v1/completions", body(),
+                                key="sk-ta")
+        assert status == 200, text
+        assert json.loads(text)["model"] == "alpha"
+        status, _, text = _post(f"{url}/v1/completions", body("beta"),
+                                key="sk-tb")
+        assert status == 200, text
+        # Unknown model: 404, regardless of who asks.
+        status, _, text = _post(f"{url}/v1/completions", body("ghost"),
+                                key="sk-ta")
+        assert status == 404, text
+        assert "unknown model" in json.loads(text)["error"]["message"]
+        # Served-but-unentitled model: 403.
+        status, _, text = _post(f"{url}/v1/completions", body("beta"),
+                                key="sk-ta")
+        assert status == 403, text
+        assert "not entitled" in json.loads(text)["error"]["message"]
+        assert svc.counters["model_rejections_total"] == 2
+        # Exact per-(tenant, model) rows; refusals never billed.
+        snap = svc.ledger.snapshot()
+        assert snap["ta"]["models"]["alpha"]["requests"] == 2
+        assert "beta" not in snap["ta"]["models"]
+        assert snap["tb"]["models"]["beta"]["requests"] == 1
+        assert snap["ta"]["requests"] == 2  # aggregate matches sub-rows
     finally:
         svc.stop()
         stub.stop()

@@ -202,20 +202,23 @@ PLEN = 32
 MAX_NEW = 6
 
 
-def _spawn_gateway(fleet, tenants, wal, log_path, extra_env=None):
+def _spawn_gateway(fleet, tenants, wal, log_path, extra_env=None,
+                   models=None):
     env = dict(fleet._env)
     env.update(extra_env or {})
     log_f = open(log_path, "a")
+    argv = [
+        sys.executable, "-m", "areal_tpu.system.gateway",
+        "--experiment", fleet.exp, "--trial", fleet.trial,
+        "--manager-addr", fleet.manager_addr(),
+        "--tenants", tenants,
+        "--usage-wal", wal,
+        "--name-resolve-root", fleet._nr,
+    ]
+    if models:
+        argv += ["--models", models]
     p = subprocess.Popen(
-        [
-            sys.executable, "-m", "areal_tpu.system.gateway",
-            "--experiment", fleet.exp, "--trial", fleet.trial,
-            "--manager-addr", fleet.manager_addr(),
-            "--tenants", tenants,
-            "--usage-wal", wal,
-            "--name-resolve-root", fleet._nr,
-        ],
-        env=env, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
+        argv, env=env, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT,
     )
     p._log_f = log_f  # closed by the caller's finally
     return p

@@ -1,0 +1,210 @@
+"""ISSUE 20 acceptance (registry leg): the model registry refuses a
+model_id collision with a DIFFERENT config hash (idempotent same-hash
+re-registration is fine), a heartbeat naming an UNREGISTERED model_id
+is QUARANTINED by a multi-model manager — never adopted — until the
+registry learns the model, and gateway entitlement parsing rejects an
+entitlement naming a model the fleet does not serve.
+
+Time budget: ~10 s (one in-process manager over fake heartbeat
+servers; no jax engines)."""
+
+import http.server
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from areal_tpu.base import name_resolve, names
+from areal_tpu.base.health import Heartbeat
+from areal_tpu.system import model_registry as mr
+
+
+@pytest.fixture()
+def kv(tmp_path):
+    repo = name_resolve.reconfigure(
+        "nfs", record_root=str(tmp_path / "name_resolve")
+    )
+    yield repo
+    repo.reset()
+
+
+EXP, TRIAL = "registry-units", "t0"
+
+
+# ----------------------------------------------------------------------
+# Registration: duplicate refusal vs idempotent re-run
+# ----------------------------------------------------------------------
+
+def _rec(model_id, cfg):
+    return mr.ModelRecord(
+        model_id=model_id,
+        family="tpu_transformer",
+        config_hash=mr.config_hash(cfg),
+    )
+
+
+def test_duplicate_model_id_refused_unless_same_hash(kv):
+    """Same id + same hash = idempotent deployment re-run; same id with
+    a DIFFERENT hash is exactly the two-deployments-disagree confusion
+    the registry exists to refuse."""
+    first = mr.register_model(EXP, TRIAL, _rec("actor", {"n_layers": 2}))
+    again = mr.register_model(EXP, TRIAL, _rec("actor", {"n_layers": 2}))
+    assert again.config_hash == first.config_hash
+    assert again.ts == first.ts  # the existing record, untouched
+    with pytest.raises(mr.DuplicateModelError):
+        mr.register_model(EXP, TRIAL, _rec("actor", {"n_layers": 3}))
+    # The losing write must not have clobbered the registered record.
+    assert mr.get_model(EXP, TRIAL, "actor").config_hash \
+        == first.config_hash
+    # A second FAMILY under its own id coexists.
+    mr.register_model(EXP, TRIAL, _rec("scout", {"n_layers": 3}))
+    assert set(mr.list_models(EXP, TRIAL)) == {"actor", "scout"}
+
+
+def test_model_id_charset_enforced(kv):
+    for bad in ("", "a/b", ".hidden", "x" * 65, "a b"):
+        with pytest.raises(ValueError):
+            mr.validate_model_id(bad)
+    with pytest.raises(ValueError):
+        mr.register_model(EXP, TRIAL, _rec("a/b", {}))
+
+
+def test_unregister_then_reregister_with_new_hash(kv):
+    """Intentional replacement is unregister-then-register, per the
+    DuplicateModelError message."""
+    mr.register_model(EXP, TRIAL, _rec("actor", {"v": 1}))
+    mr.unregister_model(EXP, TRIAL, "actor")
+    mr.unregister_model(EXP, TRIAL, "actor")  # idempotent
+    rec = mr.register_model(EXP, TRIAL, _rec("actor", {"v": 2}))
+    assert mr.get_model(EXP, TRIAL, "actor").config_hash == rec.config_hash
+
+
+def test_current_weight_version_reads_model_version_pointer(kv):
+    assert mr.current_weight_version(EXP, TRIAL, "actor") is None
+    name_resolve.add(
+        names.model_version(EXP, TRIAL, "actor"), "3", replace=True
+    )
+    assert mr.current_weight_version(EXP, TRIAL, "actor") == 3
+
+
+# ----------------------------------------------------------------------
+# Manager quarantine: unregistered-model heartbeat is never adopted
+# ----------------------------------------------------------------------
+
+class _FakeGserver:
+    """Heartbeat + minimal /metrics endpoint, with a model_id in the
+    heartbeat payload (the multi-model discovery surface)."""
+
+    def __init__(self, exp, index, model_id=None, announce=True):
+        lines = [
+            "areal:weight_version 0.0",
+            "areal:role unified",
+            "areal:elastic 1.0",
+        ]
+        body = ("\n".join(lines) + "\n").encode()
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self, _body=body):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(_body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self.member = f"generation_server/{index}"
+        payload = {"url": self.url, "server_index": index}
+        if model_id:
+            payload["model_id"] = model_id
+        self.hb = Heartbeat(exp, TRIAL, self.member, payload=payload,
+                            ttl=60.0)
+        if announce:
+            name_resolve.add_subentry(names.gen_servers(exp, TRIAL),
+                                      self.url)
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_unregistered_model_heartbeat_quarantined_not_adopted(kv):
+    """The multi-model gate in the health poll: a joiner whose
+    heartbeat names a model_id the registry has never heard of lands in
+    the quarantine ledger and NEVER enters the routing table (routing
+    it would risk silent cross-model weight/KV hits). Registering the
+    model and beating again earns adoption and clears the ledger —
+    the re-read-on-miss path, pinned here per model_registry.py's
+    docstring."""
+    from areal_tpu.api.system_api import GserverManagerConfig
+    from areal_tpu.system.gserver_manager import GserverManager
+
+    exp = "registry-quarantine"
+    seed = _FakeGserver(exp, 0)  # the manager's default model_name pool
+    joiner = None
+    m = GserverManager()
+    try:
+        m.configure(GserverManagerConfig(
+            experiment_name=exp, trial_name=TRIAL, n_servers=1,
+            train_batch_size=4, health_check_interval=3600.0,
+            multi_model=True,
+        ))
+        assert m.server_urls == [seed.url]
+        # A joiner beating with an UNREGISTERED model_id.
+        joiner = _FakeGserver(exp, 1, model_id="ghost", announce=False)
+        m._poll_health()
+        assert m._quarantined == {joiner.member: "ghost"}
+        assert joiner.url not in m.server_urls
+        # Repolling neither adopts nor duplicates the ledger row.
+        m._poll_health()
+        assert m._quarantined == {joiner.member: "ghost"}
+        assert joiner.url not in m.server_urls
+        # /status surfaces the quarantine for operators.
+        with urllib.request.urlopen(m.address + "/status",
+                                    timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["quarantined"] == {joiner.member: "ghost"}
+        # Registration lands; the next poll's re-read-on-miss adopts
+        # the same still-beating member and clears its row.
+        mr.register_model(exp, TRIAL, _rec("ghost", {"n_layers": 3}))
+        m._poll_health()
+        assert joiner.member not in m._quarantined
+        assert joiner.url in m.server_urls
+        assert m._server_models[joiner.url] == "ghost"
+        # Already at the fleet's weight version (0), so the normal
+        # readmission path routes it within the same poll.
+        assert joiner.url in m._healthy
+    finally:
+        try:
+            m._exit_hook()
+        except Exception:
+            pass
+        seed.close()
+        if joiner is not None:
+            joiner.close()
+
+
+# ----------------------------------------------------------------------
+# Gateway entitlements: unknown-model refusal at parse time
+# ----------------------------------------------------------------------
+
+def test_entitlement_parse_rejects_unknown_model():
+    from areal_tpu.system.gateway import parse_tenant_spec
+
+    spec = "acme:k1:2:100:200:4:modela|modelb"
+    with pytest.raises(ValueError, match="unknown model"):
+        parse_tenant_spec(spec, known_models={"modela"})
+    # Same spec against a fleet serving both: entitlements parse.
+    t = parse_tenant_spec(spec, known_models={"modela", "modelb"})
+    assert t["acme"].models == frozenset({"modela", "modelb"})
+    # No 7th field = entitled to everything the fleet serves.
+    t = parse_tenant_spec("acme:k1:2:100:200:4",
+                          known_models={"modela"})
+    assert t["acme"].models is None
+    # Entitlement ids go through the registry charset check too.
+    with pytest.raises(ValueError):
+        parse_tenant_spec("acme:k1:2:100:200:4:bad/id")

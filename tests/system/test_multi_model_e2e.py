@@ -1,0 +1,311 @@
+"""Multi-model serving plane e2e (ISSUE 20 acceptance): two model
+FAMILIES (different layer counts, different config hashes) served by
+one real-process fleet — 2 family-A gservers + 1 family-B gserver +
+real manager — behind a gateway SUBPROCESS with two tenants holding
+DISJOINT model entitlements. Through the public front door:
+
+- per-model greedy parity vs single-model baseline fleets (the
+  multi-model plane reproduces each family token for token — zero
+  cross-model contamination);
+- an entitled tenant asking for the OTHER model gets 403, an unknown
+  model gets 404, and neither refusal is ever billed;
+- family B's weights cut over (v0 -> v1 over the weight plane) while
+  family A carries sustained tenant traffic: ZERO A failures, A's pool
+  version and greedy outputs do not move, B's outputs visibly swap,
+  and no server lost a KV prefix (`kv_prefix_lost_total == 0`);
+- /v1/usage holds EXACT per-(tenant, model) rows matching the
+  client-side token tally.
+
+Time budget (slow lane): ~200 s — three fleets (two single-model
+baselines + the 3-server multi-model fleet) and one weight fanout.
+Tier-1 keeps the registry units (test_model_registry.py), the gateway
+model-resolution unit (test_gateway.py), and the validator teeth
+(test_multi_model_serving_bench.py)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from areal_tpu.base import metrics_registry as mreg
+from areal_tpu.base import name_resolve, names
+from tests.system.test_gateway_e2e import (
+    _gw_req,
+    _spawn_gateway,
+    _wait_gateway,
+)
+
+pytestmark = pytest.mark.serial
+
+MAX_NEW = 6
+
+
+class _Tally:
+    """Client-side ground truth: (tenant, model) -> exact counts."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rows = {}
+
+    def add(self, tenant, model, body):
+        with self.lock:
+            r = self.rows.setdefault((tenant, model), {
+                "requests": 0, "prompt_tokens": 0,
+                "completion_tokens": 0,
+            })
+            r["requests"] += 1
+            r["prompt_tokens"] += body["usage"]["prompt_tokens"]
+            r["completion_tokens"] += body["usage"]["completion_tokens"]
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_multi_model_gateway_acceptance(tmp_path):
+    import jax
+
+    from areal_tpu.base import constants
+    from areal_tpu.bench.fleet import ProcessFleet
+    from areal_tpu.bench.workloads import (
+        _FLEET_CHUNK,
+        _FLEET_SRV,
+        _MM_MODEL_B,
+        _OPENLOOP_MODEL,
+        _fleet_wait,
+        _mm_baseline,
+        _mm_prompts,
+    )
+    from areal_tpu.models.config import TransformerConfig
+    from areal_tpu.models.transformer import init_params
+    from areal_tpu.system import model_registry
+    from areal_tpu.system.weight_plane import WeightPlaneSource
+    from areal_tpu.system.weight_transfer import dump_raw_params
+
+    hash_a = model_registry.config_hash(_OPENLOOP_MODEL)
+    hash_b = model_registry.config_hash(_MM_MODEL_B)
+    assert hash_a != hash_b
+
+    # Children and the weight-plane source must agree on the
+    # param-realloc root (inherited through the fleet's env).
+    prev_fileroot = os.environ.get("AREAL_FILEROOT")
+    fileroot = str(tmp_path / "fileroot")
+    os.makedirs(fileroot, exist_ok=True)
+    os.environ["AREAL_FILEROOT"] = fileroot
+
+    tenants = (
+        "ta:sk-ta:1:1000000:2000000:8:actor,"
+        "tb:sk-tb:1:1000000:2000000:8:scout"
+    )
+    wal = str(tmp_path / "gw_usage.jsonl")
+    gw_log = str(tmp_path / "gateway.log")
+    tally = _Tally()
+    gw = None
+    srcs = []
+    fleet = None
+    try:
+        # ---- Single-model baseline fleets: the parity references
+        # (version-0 seed weights, same as the multi-model fleet's).
+        # 64MB KV tier on every server: capacity evictions spill
+        # instead of counting as true prefix losses, so the
+        # kv_prefix_lost_total == 0 pin below measures the CUTOVER,
+        # not cache churn.
+        tier_env = {"AREAL_KV_TIER_BYTES": str(64 << 20)}
+        base = {
+            "actor": _mm_baseline(_OPENLOOP_MODEL, "mmea", tier_env),
+            "scout": _mm_baseline(_MM_MODEL_B, "mmeb", tier_env),
+        }
+
+        fleet = ProcessFleet(
+            _OPENLOOP_MODEL,
+            [
+                dict(_FLEET_SRV, model_id="actor", env=tier_env),
+                dict(_FLEET_SRV, model_id="actor", env=tier_env),
+                dict(_FLEET_SRV, model_id="scout",
+                     model_cfg=_MM_MODEL_B, env=tier_env),
+            ],
+            manager_kw=dict(
+                multi_model=True, weight_plane=True,
+                weight_chunk_bytes=_FLEET_CHUNK,
+                weight_fanout_degree=2,
+                flush_request_timeout=120.0,
+            ),
+            models=[
+                dict(model_id="actor", family="tpu_transformer",
+                     config_hash=hash_a),
+                dict(model_id="scout", family="tpu_transformer",
+                     config_hash=hash_b),
+            ],
+            tag="mme", tmp_dir=str(tmp_path / "fleet"),
+        )
+        _fleet_wait(
+            lambda: {
+                m: len(r["healthy"])
+                for m, r in fleet.status()["models"].items()
+            } == {"actor": 2, "scout": 1},
+            120.0, "per-model pool map",
+        )
+
+        gw = _spawn_gateway(fleet, tenants, wal, gw_log,
+                            models="actor,scout")
+        url, gw_tok = _wait_gateway(fleet, gw)
+        op_hdr = {"X-Areal-Gateway-Token": gw_tok}
+
+        def completion(key, tenant, model, prompt, expect=200):
+            st, _, body = _gw_req(url, "/v1/completions", {
+                "prompt": prompt, "max_tokens": MAX_NEW,
+                "temperature": 0.0, "stream": False, "model": model,
+            }, key=key, timeout=180.0)
+            assert st == expect, (st, body)
+            if st == 200:
+                tally.add(tenant, model, body)
+            return body
+
+        # ---- Per-model greedy parity through the front door: each
+        # tenant's pool reproduces its single-model baseline token for
+        # token (the pools hold DIFFERENT families, so any cross-model
+        # route or weight bleed is token-visible).
+        for i, p in enumerate(_mm_prompts()):
+            got = completion("sk-ta", "ta", "actor", p)
+            assert [int(t) for t in got["choices"][0]["token_ids"]] \
+                == base["actor"][i], f"actor parity, prompt {i}"
+            got = completion("sk-tb", "tb", "scout", p)
+            assert [int(t) for t in got["choices"][0]["token_ids"]] \
+                == base["scout"][i], f"scout parity, prompt {i}"
+
+        # ---- Refusals: 403 across the entitlement boundary (both
+        # directions), 404 for a model nobody serves. Never billed —
+        # pinned by the exact ledger comparison at the end.
+        p0 = _mm_prompts(1)[0]
+        body = completion("sk-ta", "ta", "scout", p0, expect=403)
+        assert "not entitled" in body["error"]["message"]
+        body = completion("sk-tb", "tb", "actor", p0, expect=403)
+        assert "not entitled" in body["error"]["message"]
+        body = completion("sk-ta", "ta", "ghost", p0, expect=404)
+        assert "unknown model" in body["error"]["message"]
+
+        # ---- Family-B cutover under sustained family-A traffic.
+        a_pre = completion("sk-ta", "ta", "actor", p0)
+        b_pre = completion("sk-tb", "tb", "scout", p0)
+
+        stop = threading.Event()
+        failures = []
+        n_load = [0]
+
+        def pressure(i):
+            k = 0
+            while not stop.is_set():
+                prompt = np.random.RandomState(
+                    9000 + 100 * i + k
+                ).randint(1, _OPENLOOP_MODEL["vocab_size"],
+                          size=len(p0)).tolist()
+                st, _, body = _gw_req(url, "/v1/completions", {
+                    "prompt": prompt, "max_tokens": MAX_NEW,
+                    "temperature": 0.0, "stream": False,
+                    "model": "actor",
+                }, key="sk-ta", timeout=180.0)
+                if st == 200:
+                    tally.add("ta", "actor", body)
+                    with tally.lock:
+                        n_load[0] += 1
+                else:
+                    failures.append((st, body))
+                k += 1
+
+        threads = [
+            threading.Thread(target=pressure, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for th in threads:
+            th.start()
+        try:
+            # Publish scout v1 over the weight plane while A traffic
+            # runs: dir gate + raw chunks + source + version pointer.
+            d = os.path.join(
+                constants.get_param_realloc_path(fleet.exp, fleet.trial),
+                "scout",
+            )
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "engine_state.pkl"), "wb") as f:
+                f.write(b"gate")
+            p1 = jax.tree_util.tree_map(
+                lambda x: np.asarray(x),
+                init_params(TransformerConfig(**_MM_MODEL_B),
+                            jax.random.PRNGKey(9)),
+            )
+            dump_raw_params(p1, d, version=1, chunk_bytes=_FLEET_CHUNK)
+            s = WeightPlaneSource(d, chunk_bytes=_FLEET_CHUNK).start()
+            s.register(fleet.exp, fleet.trial, "scout")
+            srcs.append(s)
+            name_resolve.add(
+                names.model_version(fleet.exp, fleet.trial, "scout"),
+                "1", replace=True,
+            )
+            _fleet_wait(
+                lambda: fleet.status()["models"]["scout"]["version"] == 1,
+                240.0, "scout v1 fanout",
+            )
+            # Keep the A load running a beat past the cutover so the
+            # "under sustained traffic" claim isn't vacuous.
+            time.sleep(2.0)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=300)
+
+        assert failures == [], failures[:3]
+        assert n_load[0] > 0  # A traffic genuinely overlapped the swap
+
+        # Independence: A's pool version and greedy outputs did not
+        # move; B's outputs visibly swapped to the published weights.
+        st = fleet.status()
+        assert st["models"]["actor"]["version"] == 0
+        assert st["models"]["scout"]["version"] == 1
+        a_post = completion("sk-ta", "ta", "actor", p0)
+        b_post = completion("sk-tb", "tb", "scout", p0)
+        ids = lambda b: [int(t) for t in b["choices"][0]["token_ids"]]
+        assert ids(a_post) == ids(a_pre)
+        assert ids(b_post) != ids(b_pre)
+
+        # No server lost a KV prefix across the cutover.
+        lost = sum(
+            fleet.metrics(u).get(mreg.KV_PREFIX_LOST_TOTAL, 0.0)
+            for u in fleet.urls
+        )
+        assert lost == 0.0
+
+        # ---- /v1/usage: EXACT per-(tenant, model) rows vs the
+        # client-side tally; refused models never earned a row.
+        st_code, _, usage = _gw_req(url, "/v1/usage", headers=op_hdr)
+        assert st_code == 200
+        got = {}
+        for tname, row in usage["tenants"].items():
+            if tname == "trainer":
+                continue
+            for model, r in row.get("models", {}).items():
+                got[(tname, model)] = {
+                    k: r[k] for k in ("requests", "prompt_tokens",
+                                      "completion_tokens")
+                }
+        assert got == tally.rows
+        assert set(usage["tenants"]["ta"]["models"]) == {"actor"}
+        assert set(usage["tenants"]["tb"]["models"]) == {"scout"}
+    finally:
+        if gw is not None:
+            if gw.poll() is None:
+                gw.kill()
+            try:
+                gw._log_f.close()
+            except Exception:
+                pass
+        for s in srcs:
+            try:
+                s.close()
+            except Exception:
+                pass
+        if fleet is not None:
+            fleet.close()
+        if prev_fileroot is None:
+            os.environ.pop("AREAL_FILEROOT", None)
+        else:
+            os.environ["AREAL_FILEROOT"] = prev_fileroot

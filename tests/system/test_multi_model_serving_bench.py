@@ -1,0 +1,144 @@
+"""ISSUE 20 acceptance (bench leg): the `multi_model_serving` phase
+banks an attested CPU-proxy record for the multi-model serving plane —
+two model families on one real-process fleet, per-model greedy parity
+vs single-model baseline fleets, unknown-model refusal, cross-model KV
+isolation, and an independent weight cutover of one family under the
+other's sustained load — and `validate_bench.py` refuses records with
+contaminated parity, any cross-model route/KV hit, a steady pool whose
+version or outputs moved during the other family's cutover, or
+B-degradation during the A-cutover.
+
+Time budget (slow lane): ~300 s — three fleets (two single-model
+baselines + the 3-server multi-model fleet) and two weight fanouts.
+Tier-1 keeps the validator-teeth test (milliseconds).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from areal_tpu.bench import bank
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+pytestmark = pytest.mark.serial
+
+
+def _load_validator():
+    spec = importlib.util.spec_from_file_location(
+        "validate_bench", os.path.join(REPO, "scripts", "validate_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fake_record():
+    """A well-formed multi_model_serving value (what a healthy run
+    banks)."""
+    return {
+        "n_models": 2.0,
+        "steady_pool_servers": 2.0,
+        "cutover_pool_servers": 1.0,
+        "families_distinct": 1.0,
+        "parity_mismatches": 0.0,
+        "cross_model_routes": 0.0,
+        "cross_model_kv_hits": 0.0,
+        "unknown_model_rejected": 1.0,
+        "unknown_model_routed": 0.0,
+        "cutover_version_before": 1.0,
+        "cutover_version_after": 2.0,
+        "steady_version_after": 1.0,
+        "steady_outputs_stable": 1.0,
+        "cutover_outputs_changed": 1.0,
+        "b_completed": 16.0,
+        "b_failed": 0.0,
+        "b_p99_ttft_base_ms": 120.0,
+        "b_p99_ttft_cutover_ms": 150.0,
+        "kv_prefix_lost": 0.0,
+        "fleet": "process",
+        "wall_s": 200.0,
+    }
+
+
+def test_validator_teeth_for_multi_model_serving():
+    """Tier-1 guard: the schema refuses records that could launder a
+    leaky model boundary into multi-model evidence."""
+    validator = _load_validator()
+    rec = {"status": "ok", "pass": "measure", "value": _fake_record()}
+    assert validator.validate_phase_value("multi_model_serving", rec) == []
+
+    def probs(**edits):
+        bad = json.loads(json.dumps(rec))
+        bad["value"].update(edits)
+        for k, v in list(edits.items()):
+            if v is None:
+                del bad["value"][k]
+        return validator.validate_phase_value("multi_model_serving", bad)
+
+    # Contaminated parity or any cross-model hit poisons the record.
+    assert any("baseline" in p for p in probs(parity_mismatches=1.0))
+    assert any("pool" in p for p in probs(cross_model_routes=1.0))
+    assert any("KV source" in p for p in probs(cross_model_kv_hits=1.0))
+    # The unknown-model negative arm must have run AND refused.
+    assert any("refused" in p for p in probs(unknown_model_routed=1.0))
+    assert any(
+        "negative arm" in p for p in probs(unknown_model_rejected=0.0)
+    )
+    # Independence: the cutover family advances, the steady family's
+    # version and outputs do not move, and identical config hashes are
+    # refused outright.
+    assert any(
+        "never advanced" in p for p in probs(cutover_version_after=1.0)
+    )
+    assert any(
+        "steady pool" in p for p in probs(steady_version_after=2.0)
+    )
+    assert any(
+        "contamination" in p for p in probs(steady_outputs_stable=0.0)
+    )
+    assert any(
+        "never actually swapped" in p
+        for p in probs(cutover_outputs_changed=0.0)
+    )
+    assert any("hash" in p for p in probs(families_distinct=0.0))
+    # The B side must be loss-free and hold its tail across the
+    # A-cutover.
+    assert any("failed" in p for p in probs(b_failed=1.0))
+    assert any("nothing was measured" in p for p in probs(b_completed=0.0))
+    assert any(
+        "stalled" in p for p in probs(b_p99_ttft_cutover_ms=100000.0)
+    )
+    assert any("prefix" in p for p in probs(kv_prefix_lost=1.0))
+    # Missing required numerics.
+    assert any(
+        "b_p99_ttft_base_ms" in p for p in probs(b_p99_ttft_base_ms=None)
+    )
+
+
+@pytest.mark.slow  # ~300 s: three fleets + two weight fanouts; tier-1
+# keeps the validator teeth + the multi-model e2e.
+@pytest.mark.timeout(1800)
+def test_multi_model_serving_banks_and_validates(tmp_path, monkeypatch):
+    b = str(tmp_path / "bank")
+    monkeypatch.setenv("AREAL_BENCH_BANK", b)
+    from areal_tpu.bench.workloads import multi_model_serving_phase
+
+    val = multi_model_serving_phase("measure")
+    path = bank.write_record(
+        bank.make_record("multi_model_serving", "measure", "ok", value=val),
+        b,
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    bank.validate_record(rec)
+    assert rec["attestation"]["platform"] == "cpu"
+    assert rec["attestation"]["driver_verified"] is False
+
+    validator = _load_validator()
+    assert validator.validate_phase_value("multi_model_serving", rec) == []
+    assert validator.validate_bank_dir(b) == []
